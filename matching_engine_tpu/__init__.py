@@ -1,0 +1,12 @@
+"""matching_engine_tpu — a TPU-native order-matching framework.
+
+Capability surface of julien-mrty/Matching_Engine (gRPC order gateway, Q4
+scaled-integer prices, SQLite orders/fills persistence) with the matching
+core the reference declared but never implemented, built TPU-first:
+fixed-shape struct-of-arrays books, a jit/vmap'd price-time-priority match
+kernel, symbol-sharded shard_map scaling over a device mesh, and host shells
+(gRPC front end, batch dispatcher, async storage sink) around the device
+pipeline. See SURVEY.md at the repo root for the full blueprint.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+from matching_engine_tpu.domain.price import (
+    K_TARGET_SCALE,
+    MAX_DEVICE_PRICE_Q4,
+    POW10,
+    PriceError,
+    normalize_to_q4,
+    normalize_to_q4_jax,
+)
+from matching_engine_tpu.domain.order import Order, ValidationError, validate_submit
+from matching_engine_tpu.domain.side import BUY, SELL, Side
+
+__all__ = [
+    "K_TARGET_SCALE",
+    "MAX_DEVICE_PRICE_Q4",
+    "POW10",
+    "PriceError",
+    "normalize_to_q4",
+    "normalize_to_q4_jax",
+    "Order",
+    "ValidationError",
+    "validate_submit",
+    "BUY",
+    "SELL",
+    "Side",
+]

@@ -1,0 +1,118 @@
+"""Order value type and submit-time validation.
+
+Mirrors the reference's construction-time invariant — an Order can only exist
+with a Q4-normalized price (include/domain/order.hpp:15-28 routes every
+construction through normalize_to_q4) — and the reference's validation /
+reject semantics (src/server/matching_engine_service.cpp:66-83): rejects are
+application-level (success=false + message over gRPC status OK), triggered by
+missing symbol, non-positive quantity, or non-positive LIMIT price.
+
+This framework adds one device-facing constraint: normalized Q4 prices must
+fit the engine's int32 book lanes (see domain/price.py). Violations reject
+with an overflow message, they never truncate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from matching_engine_tpu.domain.price import (
+    MAX_DEVICE_PRICE_Q4,
+    PriceError,
+    normalize_to_q4,
+)
+from matching_engine_tpu.proto import pb2
+
+# Largest per-order quantity the engine accepts. Chosen so that a full book
+# side's quantity sum stays below 2**31 for any capacity <= 1024: the device
+# kernel accumulates quantity prefix-sums at int32 lane width
+# (engine/kernel.py), so capacity * MAX_QUANTITY must not wrap.
+MAX_QUANTITY = 2_000_000
+
+
+class ValidationError(ValueError):
+    """Submit-time rejection; `.message` is the client-visible error text."""
+
+    @property
+    def message(self) -> str:
+        return str(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Order:
+    """An accepted order, price always Q4-normalized.
+
+    Use `Order.from_raw` — it is the only path that normalizes; constructing
+    directly is reserved for already-normalized values (e.g. recovery from
+    storage, which persists Q4).
+    """
+
+    order_id: str
+    client_id: str
+    symbol: str
+    price_q4: int
+    quantity: int
+    side: int
+    order_type: int = pb2.LIMIT
+
+    @classmethod
+    def from_raw(
+        cls,
+        order_id: str,
+        client_id: str,
+        symbol: str,
+        price: int,
+        scale: int,
+        quantity: int,
+        side: int,
+        order_type: int = pb2.LIMIT,
+    ) -> "Order":
+        return cls(
+            order_id=order_id,
+            client_id=client_id,
+            symbol=symbol,
+            price_q4=normalize_to_q4(price, scale),
+            quantity=quantity,
+            side=side,
+            order_type=order_type,
+        )
+
+
+def validate_submit(request: pb2.OrderRequest) -> str | None:
+    """Validate an OrderRequest; returns a rejection message or None if OK.
+
+    Ordering and conditions track the reference
+    (matching_engine_service.cpp:66-83): symbol, then quantity, then LIMIT
+    price positivity; plus this framework's side check and device price-range
+    guard. Price normalization errors (bad scale / overflow) also reject.
+    """
+    if not request.symbol:
+        return "symbol is required"
+    if request.quantity <= 0:
+        return "quantity must be positive"
+    if request.quantity > MAX_QUANTITY:
+        return (
+            f"quantity {request.quantity} exceeds the engine maximum "
+            f"{MAX_QUANTITY} (int32 book-sum safety bound)"
+        )
+    if request.side not in (pb2.BUY, pb2.SELL):
+        return "side must be BUY or SELL"
+    if request.order_type == pb2.LIMIT:
+        if request.price <= 0:
+            return "limit orders require a positive price"
+        try:
+            q4 = normalize_to_q4(request.price, request.scale)
+        except PriceError as e:
+            return str(e)
+        if q4 <= 0:
+            return "limit price normalizes to zero at Q4 resolution"
+        if q4 > MAX_DEVICE_PRICE_Q4:
+            return (
+                f"normalized Q4 price {q4} exceeds the engine's int32 price "
+                f"lane (max {MAX_DEVICE_PRICE_Q4})"
+            )
+    else:
+        # MARKET orders carry no meaningful price; only the scale must parse.
+        if not 0 <= request.scale <= 18:
+            return f"scale {request.scale} out of range [0, 18]"
+    return None

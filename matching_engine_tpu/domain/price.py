@@ -1,0 +1,105 @@
+"""Q4 fixed-point price arithmetic.
+
+Semantics preserved exactly from the reference's normalizer
+(/root/reference/include/domain/price.hpp:6-29):
+
+- Prices are scaled integers; the engine's canonical scale is 4 decimal
+  places ("Q4"): price_q4 = real_price * 10^4.
+- `normalize_to_q4(price, scale)` rescales a price quoted with `scale`
+  decimal places (0..18) to Q4.
+    * upscale (scale < 4): multiply by 10^(4-scale); int64 overflow raises.
+    * downscale (scale > 4): divide by 10^(scale-4), truncating toward zero
+      (so 10050 at scale 9 normalizes to 0).
+    * scale outside [0, 18] raises.
+
+Host math is exact arbitrary-precision Python int checked against int64
+bounds, mirroring the C++ overflow checks at price.hpp:23-24.
+
+Device-side note: the TPU engine stores book prices as int32 Q4 lanes (the
+MXU/VPU-native integer width; int64 lowers to emulated pairs on TPU). That
+bounds on-device prices to Q4 <= 2**31-1, i.e. 214,748.3647 per unit.
+Orders normalizing above that are rejected at validation with an overflow
+error — same failure mode as the reference's int64 ceiling, at the device
+lane width. `normalize_to_q4_jax` is the pure-array mirror used by on-device
+order-flow generators (sim/) and tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+K_TARGET_SCALE = 4
+INT64_MAX = 2**63 - 1
+INT64_MIN = -(2**63)
+MAX_DEVICE_PRICE_Q4 = 2**31 - 1
+
+# 10^0 .. 10^18 (largest power of ten representable in int64).
+POW10 = tuple(10**i for i in range(19))
+
+
+class PriceError(ValueError):
+    """Raised for out-of-range scales or int64 overflow during rescale."""
+
+
+def normalize_to_q4(price: int, raw_scale: int) -> int:
+    """Rescale `price` quoted with `raw_scale` decimals to the Q4 grid."""
+    if not 0 <= raw_scale <= 18:
+        raise PriceError(f"scale {raw_scale} out of range [0, 18]")
+    if not INT64_MIN <= price <= INT64_MAX:
+        raise PriceError(f"price {price} outside int64 range")
+    if raw_scale == K_TARGET_SCALE:
+        return price
+    if raw_scale < K_TARGET_SCALE:
+        scaled = price * POW10[K_TARGET_SCALE - raw_scale]
+        if not INT64_MIN <= scaled <= INT64_MAX:
+            raise PriceError(
+                f"price {price} at scale {raw_scale} overflows int64 when "
+                f"normalized to Q4"
+            )
+        return scaled
+    # Downscale: truncate toward zero (Python // floors, so divide magnitudes).
+    div = POW10[raw_scale - K_TARGET_SCALE]
+    q = abs(price) // div
+    return -q if price < 0 else q
+
+
+def normalize_to_q4_jax(price, raw_scale):
+    """Array mirror of `normalize_to_q4` for on-device flow generation.
+
+    Returns (price_q4, ok); ok=False marks out-of-range scales AND rescales
+    whose result would not fit the lane dtype (no exceptions under jit —
+    where the host path raises PriceError, this flags). Truncation toward
+    zero matches the host path wherever ok=True.
+
+    Lane-width care (the default lane is int32 with jax x64 disabled):
+    - Upscale shift is at most 4 (raw_scale >= 0), so the multiplier is at
+      most 10^4 and fits any lane; only the *product* can overflow, which is
+      detected with a bound check before multiplying.
+    - Downscale shift reaches 14 (scale 18), where 10^shift wraps int32 —
+      so the divide runs in two exact steps of at most 10^9 each
+      (trunc(trunc(x/a)/b) == trunc(x/(a*b)) for non-negative x).
+    """
+    price = jnp.asarray(price)
+    raw_scale = jnp.asarray(raw_scale, dtype=jnp.int32)
+    ok = (raw_scale >= 0) & (raw_scale <= 18)
+    shift = raw_scale - K_TARGET_SCALE
+    dt = price.dtype
+    ten = jnp.asarray(10, dtype=dt)
+    lane_max = jnp.asarray(jnp.iinfo(dt).max, dtype=dt)
+
+    # Upscale: shift in [-4, 0) => multiplier 10^k, k <= 4.
+    up_k = jnp.clip(-shift, 0, K_TARGET_SCALE)
+    up_mag = ten ** up_k
+    up_fits = jnp.abs(price) <= lane_max // up_mag
+    up = price * up_mag
+
+    # Downscale: shift in (0, 14]; split 10^shift = 10^a * 10^b, a,b <= 9.
+    down_shift = jnp.clip(shift, 0, 14)
+    a = jnp.minimum(down_shift, 9)
+    b = down_shift - a
+    down = jnp.abs(price) // (ten ** a) // (ten ** b)
+    down = jnp.sign(price) * down
+
+    out = jnp.where(shift == 0, price, jnp.where(shift < 0, up, down))
+    ok = ok & jnp.where(shift < 0, up_fits, True)
+    return jnp.where(ok, out, 0), ok

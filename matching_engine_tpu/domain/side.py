@@ -1,0 +1,16 @@
+"""Side enum pinning.
+
+`Side` aliases the proto enum, and the module import asserts BUY=1/SELL=2 so
+that the storage layer's CHECK constraints and the device-side integer
+encodings break loudly if the proto is ever renumbered — the same guard the
+reference expresses with static_asserts (include/domain/side.hpp:5-9).
+"""
+
+from matching_engine_tpu.proto import pb2
+
+Side = pb2.Side
+BUY = pb2.BUY
+SELL = pb2.SELL
+
+assert BUY == 1, "proto Side.BUY must stay 1 (storage CHECKs and device encoding rely on it)"
+assert SELL == 2, "proto Side.SELL must stay 2 (storage CHECKs and device encoding rely on it)"

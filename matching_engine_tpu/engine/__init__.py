@@ -1,0 +1,5 @@
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig
+from matching_engine_tpu.engine.oracle import Fill, OracleBook, OrderResult
+from matching_engine_tpu.engine import kernel
+
+__all__ = ["BookBatch", "EngineConfig", "Fill", "OracleBook", "OrderResult", "kernel"]

@@ -1,0 +1,132 @@
+"""Device book state: fixed-shape struct-of-arrays limit order books.
+
+The reference declared an engine and left the file empty
+(include/engine/model.hpp, 0 bytes; SURVEY.md §2 row 5). This is the
+TPU-native book it implied: one pytree holding `num_symbols` books, each side
+a fixed-capacity set of (price, qty, oid, seq) int32 lanes. Static shapes
+everywhere — XLA compiles the match step once; `qty == 0` marks a free slot
+and every read masks on `qty > 0` (that masking is the core invariant; stale
+price/oid values in freed slots are never observed).
+
+All book math is int32:
+- prices are Q4 scaled ints (domain/price.py bounds them to int32 at
+  validation),
+- quantities are bounded by MAX_QUANTITY so a full side's quantity sum stays
+  below 2**31 (the priority prefix-sum in the kernel accumulates at lane
+  width; see kernel.py),
+- `seq` is a per-book arrival counter giving FIFO within a price level.
+
+Integer-only math is what makes bit-exact fill parity with the host oracle
+possible (SURVEY.md §7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.domain.order import MAX_QUANTITY  # noqa: F401  (re-export)
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static kernel configuration (hashable; closed over at jit time)."""
+
+    num_symbols: int = 64
+    capacity: int = 128          # resting orders per side per book
+    batch: int = 8               # orders per symbol per engine step
+    max_fills: int = 1 << 15     # global fill-buffer slots per engine step
+
+    def __post_init__(self):
+        assert self.capacity <= 1024, "capacity beyond 1024 breaks int32 qty sums"
+
+
+class BookBatch(NamedTuple):
+    """All books, batched on the leading symbol axis. Shapes [S, CAP] / [S]."""
+
+    bid_price: jax.Array
+    bid_qty: jax.Array
+    bid_oid: jax.Array
+    bid_seq: jax.Array
+    ask_price: jax.Array
+    ask_qty: jax.Array
+    ask_oid: jax.Array
+    ask_seq: jax.Array
+    next_seq: jax.Array  # [S] per-book arrival counter
+
+
+class OrderBatch(NamedTuple):
+    """One dispatch of orders, grouped by symbol. Shapes [S, B], int32.
+
+    op: 0 = no-op padding, 1 = submit, 2 = cancel.
+    side: proto Side (BUY=1 / SELL=2); for cancels, the side the target
+          rests on (the host order directory knows it).
+    otype: proto OrderType (LIMIT=0 / MARKET=1); ignored for cancels.
+    price: Q4 limit price (0 for MARKET).
+    qty: order quantity (submit) / unused (cancel).
+    oid: numeric order id (submit) / target order id (cancel).
+    """
+
+    op: jax.Array
+    side: jax.Array
+    otype: jax.Array
+    price: jax.Array
+    qty: jax.Array
+    oid: jax.Array
+
+
+class StepOutput(NamedTuple):
+    """Engine-step results, sized for a cheap device->host transfer.
+
+    status/filled/remaining: [S, B] per-order outcomes (proto
+        OrderUpdate.Status values; -1 for no-op padding rows).
+    fill_*: the global compacted fill log, [max_fills] each, valid rows
+        [0, fill_count). Within a symbol, rows appear in chronological
+        (batch position) then price-time priority order — the exact order
+        the oracle emits fills.
+    fill_count: scalar count of valid fill rows.
+    fill_overflow: True if more fills occurred than buffer slots; the book
+        state is still correct, only the excess fill *records* were dropped.
+    best_bid/bid_size/best_ask/ask_size: [S] top-of-book after the step
+        (0 where the side is empty).
+    """
+
+    status: jax.Array
+    filled: jax.Array
+    remaining: jax.Array
+    fill_sym: jax.Array
+    fill_taker_oid: jax.Array
+    fill_maker_oid: jax.Array
+    fill_price: jax.Array
+    fill_qty: jax.Array
+    fill_count: jax.Array
+    fill_overflow: jax.Array
+    best_bid: jax.Array
+    bid_size: jax.Array
+    best_ask: jax.Array
+    ask_size: jax.Array
+
+
+def init_book(cfg: EngineConfig) -> BookBatch:
+    s, c = cfg.num_symbols, cfg.capacity
+
+    # Distinct buffers per field: the engine step donates the book, and
+    # aliased buffers cannot be donated twice.
+    def z():
+        return jnp.zeros((s, c), dtype=I32)
+
+    return BookBatch(
+        bid_price=z(), bid_qty=z(), bid_oid=z(), bid_seq=z(),
+        ask_price=z(), ask_qty=z(), ask_oid=z(), ask_seq=z(),
+        next_seq=jnp.zeros((s,), dtype=I32),
+    )
+
+
+def noop_orders(cfg: EngineConfig) -> OrderBatch:
+    z = jnp.zeros((cfg.num_symbols, cfg.batch), dtype=I32)
+    return OrderBatch(op=z, side=z, otype=z, price=z, qty=z, oid=z)

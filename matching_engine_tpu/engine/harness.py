@@ -1,0 +1,166 @@
+"""Host driver around the device kernel: batch building, result decoding.
+
+This is the glue between host order streams and the [S, B] device dispatch
+format — used by the parity tests, the benchmark, and the server's engine
+runner. It owns no policy: grouping/padding here, matching on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from matching_engine_tpu.engine.book import (
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+    StepOutput,
+)
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_NOOP, OP_SUBMIT, engine_step
+
+
+@dataclasses.dataclass(frozen=True)
+class HostOrder:
+    """One host-side engine op (already validated + Q4-normalized)."""
+
+    sym: int          # symbol slot in [0, num_symbols)
+    op: int           # OP_SUBMIT / OP_CANCEL
+    side: int         # BUY / SELL (for cancel: side the target rests on)
+    otype: int = 0    # LIMIT / MARKET
+    price: int = 0    # Q4
+    qty: int = 0
+    oid: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class HostFill:
+    sym: int
+    taker_oid: int
+    maker_oid: int
+    price_q4: int
+    quantity: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HostResult:
+    oid: int
+    sym: int
+    status: int
+    filled: int
+    remaining: int
+
+
+def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch]:
+    """Group a chronological order list into dense [S, B] dispatches.
+
+    Orders for the same symbol keep their relative order (placed in
+    successive batch rows of the same dispatch, overflowing into further
+    dispatches); unused rows are OP_NOOP padding the kernel ignores.
+    """
+    s, b = cfg.num_symbols, cfg.batch
+    batches: list[np.ndarray] = []  # each [S, B, 6]
+    counts = np.zeros((s,), dtype=np.int64)  # orders seen per symbol so far
+
+    for o in orders:
+        i, row = divmod(int(counts[o.sym]), b)
+        while i >= len(batches):
+            batches.append(np.zeros((s, b, 6), dtype=np.int32))
+        batches[i][o.sym, row] = (o.op, o.side, o.otype, o.price, o.qty, o.oid)
+        counts[o.sym] += 1
+
+    out = []
+    for arr in batches:
+        out.append(
+            OrderBatch(
+                op=arr[:, :, 0], side=arr[:, :, 1], otype=arr[:, :, 2],
+                price=arr[:, :, 3], qty=arr[:, :, 4], oid=arr[:, :, 5],
+            )
+        )
+    return out
+
+
+def decode_step(
+    cfg: EngineConfig, batch: OrderBatch, out: StepOutput
+) -> tuple[list[HostResult], list[HostFill], bool]:
+    """Decode one StepOutput into per-order results + the fill log."""
+    status = np.asarray(out.status)
+    filled = np.asarray(out.filled)
+    remaining = np.asarray(out.remaining)
+    op = np.asarray(batch.op)
+    oid = np.asarray(batch.oid)
+
+    results = []
+    sym_idx, row_idx = np.nonzero(op != OP_NOOP)
+    for s_i, b_i in zip(sym_idx.tolist(), row_idx.tolist()):
+        results.append(
+            HostResult(
+                oid=int(oid[s_i, b_i]),
+                sym=s_i,
+                status=int(status[s_i, b_i]),
+                filled=int(filled[s_i, b_i]),
+                remaining=int(remaining[s_i, b_i]),
+            )
+        )
+
+    # One bulk device->host transfer per array; per-element indexing of jax
+    # arrays would dispatch a device gather per int.
+    n = int(out.fill_count)
+    f_sym = np.asarray(out.fill_sym[:n])
+    f_taker = np.asarray(out.fill_taker_oid[:n])
+    f_maker = np.asarray(out.fill_maker_oid[:n])
+    f_price = np.asarray(out.fill_price[:n])
+    f_qty = np.asarray(out.fill_qty[:n])
+    fills = [
+        HostFill(
+            sym=int(f_sym[i]),
+            taker_oid=int(f_taker[i]),
+            maker_oid=int(f_maker[i]),
+            price_q4=int(f_price[i]),
+            quantity=int(f_qty[i]),
+        )
+        for i in range(n)
+    ]
+    return results, fills, bool(out.fill_overflow)
+
+
+def apply_orders(
+    cfg: EngineConfig, book: BookBatch, orders: list[HostOrder]
+) -> tuple[BookBatch, list[HostResult], list[HostFill]]:
+    """Run a chronological order list through the kernel; decode everything."""
+    results: list[HostResult] = []
+    fills: list[HostFill] = []
+    for batch in build_batches(cfg, orders):
+        book, out = engine_step(cfg, book, batch)
+        r, f, overflow = decode_step(cfg, batch, out)
+        assert not overflow, "fill buffer overflow in test harness"
+        results.extend(r)
+        fills.extend(f)
+    return book, results, fills
+
+
+def snapshot_books(book: BookBatch):
+    """Decode device books to the oracle's snapshot format.
+
+    Returns per symbol: (bids, asks), each a priority-sorted list of
+    (oid, price_q4, qty, seq).
+    """
+    bp, bq = np.asarray(book.bid_price), np.asarray(book.bid_qty)
+    bo, bs = np.asarray(book.bid_oid), np.asarray(book.bid_seq)
+    ap, aq = np.asarray(book.ask_price), np.asarray(book.ask_qty)
+    ao, as_ = np.asarray(book.ask_oid), np.asarray(book.ask_seq)
+
+    snaps = []
+    for i in range(bp.shape[0]):
+        bids = [
+            (int(bo[i, j]), int(bp[i, j]), int(bq[i, j]), int(bs[i, j]))
+            for j in np.nonzero(bq[i] > 0)[0]
+        ]
+        asks = [
+            (int(ao[i, j]), int(ap[i, j]), int(aq[i, j]), int(as_[i, j]))
+            for j in np.nonzero(aq[i] > 0)[0]
+        ]
+        bids.sort(key=lambda r: (-r[1], r[3]))
+        asks.sort(key=lambda r: (r[1], r[3]))
+        snaps.append((bids, asks))
+    return snaps

@@ -1,0 +1,267 @@
+"""The jit'd match kernel: price-time-priority CLOB matching in fixed shapes.
+
+This is the TPU-first replacement for the hot path the reference never built
+(its entire "engine" is one SQLite INSERT under a global mutex —
+src/server/matching_engine_service.cpp:100-104, SURVEY.md §3.2). Design:
+
+- **No sorting, no data-dependent loops.** For one incoming order, fills are
+  allocated with a masked priority comparison matrix: `better[k, j]` says
+  resting order k has strictly higher price-time priority than j (better
+  price, or same price and earlier seq). The quantity resting *ahead* of j is
+  a masked matvec `ahead_j = sum_k better[k,j] * elig_k * qty_k`, and
+  `fill_j = clip(Q - ahead_j, 0, qty_j)` — exactly the allocation a
+  sequential sweep produces, but as dense [CAP, CAP] int32 vector ops the
+  VPU eats whole. (seqs are unique per book, so priority is a strict total
+  order and filled slots form a priority prefix.)
+- **Sequential within a symbol, parallel across symbols.** Orders for one
+  symbol apply in batch order via `lax.scan` (a later order can match an
+  earlier one's resting remainder); `vmap` runs every symbol's scan in
+  parallel (SURVEY.md §7 "Hard parts": sequential dependence within a batch).
+- **Compact fill log.** Each step scatters its fills to priority-rank slots
+  (rank = count of eligible makers ahead — unique, prefix-dense, so no sort
+  is needed there either); after the scan a global cumsum-compaction packs
+  all [S, B, CAP] potential fill records into one bounded [max_fills] buffer
+  so the device->host transfer is O(actual fills), not O(S*B*CAP).
+- **Integer-only.** All match math is int32; results are bit-identical to
+  the host oracle (engine/oracle.py) — enforced by tests/test_kernel_parity.
+
+Matching semantics are the ones this framework defines (see oracle.py
+docstring); statuses use proto OrderUpdate.Status values.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+    StepOutput,
+)
+
+# proto OrderUpdate.Status values (pinned; side.py asserts the enum layout).
+NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = 0, 1, 2, 3, 4
+NOOP_STATUS = -1
+
+OP_NOOP, OP_SUBMIT, OP_CANCEL = 0, 1, 2
+LIMIT, MARKET = 0, 1
+BUY, SELL = 1, 2
+
+
+class _SymBook(NamedTuple):
+    """One symbol's book slices inside the vmap'd scan body."""
+
+    bid_price: jax.Array
+    bid_qty: jax.Array
+    bid_oid: jax.Array
+    bid_seq: jax.Array
+    ask_price: jax.Array
+    ask_qty: jax.Array
+    ask_oid: jax.Array
+    ask_seq: jax.Array
+    next_seq: jax.Array
+
+
+def _match_one(book: _SymBook, order):
+    """Apply one order to one book. All inputs per-symbol (no S axis).
+
+    Returns (book', (status, filled, remaining, fill_oid[CAP], fill_qty[CAP],
+    fill_price[CAP])) where fill arrays are priority-rank-indexed (slot r =
+    r-th best maker touched; zeros past the last fill).
+    """
+    op, side, otype, price, qty, oid = (
+        order.op, order.side, order.otype, order.price, order.qty, order.oid
+    )
+    is_submit = op == OP_SUBMIT
+    is_cancel = op == OP_CANCEL
+    is_buy = side == BUY
+    is_market = otype == MARKET
+
+    # ---- opposite side (maker candidates), via where-selects -------------
+    opp_price = jnp.where(is_buy, book.ask_price, book.bid_price)
+    opp_qty = jnp.where(is_buy, book.ask_qty, book.bid_qty)
+    opp_oid = jnp.where(is_buy, book.ask_oid, book.bid_oid)
+    opp_seq = jnp.where(is_buy, book.ask_seq, book.bid_seq)
+
+    # Direction-normalized price key: smaller = better priority for the
+    # maker. Buying consumes asks (low price good); selling consumes bids
+    # (high price good, so negate).
+    key = jnp.where(is_buy, opp_price, -opp_price)
+
+    price_ok = jnp.where(is_buy, opp_price <= price, opp_price >= price)
+    elig = (opp_qty > 0) & (is_market | price_ok) & is_submit
+
+    # better[k, j]: maker k strictly ahead of maker j in price-time priority.
+    better = (key[:, None] < key[None, :]) | (
+        (key[:, None] == key[None, :]) & (opp_seq[:, None] < opp_seq[None, :])
+    )
+    elig_qty = jnp.where(elig, opp_qty, 0)
+    ahead = jnp.sum(jnp.where(better, elig_qty[:, None], 0), axis=0)
+
+    take_q = jnp.where(is_submit, qty, 0)
+    fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
+    filled_total = jnp.sum(fill)
+    remaining = take_q - filled_total
+
+    new_opp_qty = opp_qty - fill
+
+    # Priority rank of each eligible maker (unique: seqs are unique). Filled
+    # slots are a priority prefix, so rank doubles as the output slot.
+    rank = jnp.sum(jnp.where(better & elig[:, None] & elig[None, :], 1, 0), axis=0)
+    has_fill = fill > 0
+    cap = fill.shape[0]
+    slot = jnp.where(has_fill, rank, cap)  # cap = trash slot
+    fill_oid = jnp.zeros((cap + 1,), I32).at[slot].set(jnp.where(has_fill, opp_oid, 0))[:cap]
+    fill_qty_out = jnp.zeros((cap + 1,), I32).at[slot].set(fill)[:cap]
+    fill_price = jnp.zeros((cap + 1,), I32).at[slot].set(jnp.where(has_fill, opp_price, 0))[:cap]
+
+    # ---- own side: rest a LIMIT remainder, or cancel a resting order -----
+    own_price = jnp.where(is_buy, book.bid_price, book.ask_price)
+    own_qty = jnp.where(is_buy, book.bid_qty, book.ask_qty)
+    own_oid = jnp.where(is_buy, book.bid_oid, book.ask_oid)
+    own_seq = jnp.where(is_buy, book.bid_seq, book.ask_seq)
+
+    do_rest = is_submit & (~is_market) & (remaining > 0)
+    free = own_qty == 0
+    has_free = jnp.any(free)
+    slot_idx = jnp.argmax(free)  # first free slot
+    rested = do_rest & has_free
+
+    idx = jnp.arange(cap)
+    at_slot = rested & (idx == slot_idx)
+    own_price = jnp.where(at_slot, price, own_price)
+    own_qty = jnp.where(at_slot, remaining, own_qty)
+    own_oid = jnp.where(at_slot, oid, own_oid)
+    own_seq = jnp.where(at_slot, book.next_seq, own_seq)
+    next_seq = book.next_seq + jnp.where(rested, 1, 0).astype(I32)
+
+    cancel_mask = is_cancel & (own_oid == oid) & (own_qty > 0)
+    cancel_qty = jnp.sum(jnp.where(cancel_mask, own_qty, 0))
+    cancel_ok = jnp.any(cancel_mask)
+    own_qty = jnp.where(cancel_mask, 0, own_qty)
+
+    # ---- write back (buy: opp=asks/own=bids; sell: the reverse) ----------
+    new_book = _SymBook(
+        bid_price=jnp.where(is_buy, own_price, opp_price),
+        bid_qty=jnp.where(is_buy, own_qty, new_opp_qty),
+        bid_oid=jnp.where(is_buy, own_oid, opp_oid),
+        bid_seq=jnp.where(is_buy, own_seq, opp_seq),
+        ask_price=jnp.where(is_buy, opp_price, own_price),
+        ask_qty=jnp.where(is_buy, new_opp_qty, own_qty),
+        ask_oid=jnp.where(is_buy, opp_oid, own_oid),
+        ask_seq=jnp.where(is_buy, opp_seq, own_seq),
+        next_seq=next_seq,
+    )
+
+    # ---- status ----------------------------------------------------------
+    submit_status = jnp.where(
+        remaining == 0,
+        FILLED,
+        jnp.where(
+            is_market,
+            CANCELED,  # market remainder is immediate-or-cancel
+            jnp.where(
+                rested,
+                jnp.where(filled_total > 0, PARTIALLY_FILLED, NEW),
+                REJECTED,  # limit remainder but book side full
+            ),
+        ),
+    )
+    cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
+    status = jnp.where(
+        is_submit,
+        submit_status,
+        jnp.where(is_cancel, cancel_status, NOOP_STATUS),
+    ).astype(I32)
+    out_remaining = jnp.where(
+        is_submit, remaining, jnp.where(is_cancel, cancel_qty, 0)
+    ).astype(I32)
+
+    return new_book, (
+        status,
+        filled_total.astype(I32),
+        out_remaining,
+        fill_oid,
+        fill_qty_out,
+        fill_price,
+    )
+
+
+def _sym_scan(book: _SymBook, orders):
+    """Scan one symbol's B orders through its book, in batch order."""
+
+    def step(b, o):
+        return _match_one(b, o)
+
+    return jax.lax.scan(step, book, orders)
+
+
+def _top_of_book(price, qty, best_is_max):
+    """[S] best price + size at best, masked on qty>0; zeros when empty."""
+    live = qty > 0
+    any_live = jnp.any(live, axis=1)
+    if best_is_max:
+        best = jnp.max(jnp.where(live, price, jnp.iinfo(I32).min), axis=1)
+    else:
+        best = jnp.min(jnp.where(live, price, jnp.iinfo(I32).max), axis=1)
+    best = jnp.where(any_live, best, 0)
+    size = jnp.sum(jnp.where(live & (price == best[:, None]), qty, 0), axis=1)
+    size = jnp.where(any_live, size, 0)
+    return best.astype(I32), size.astype(I32)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def engine_step(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
+    """Apply one [S, B] order dispatch to all books. Returns (book', StepOutput).
+
+    The book argument is donated: the update is in-place in HBM, the book
+    never round-trips to host (SURVEY.md §7 "Host<->device pipeline").
+    """
+    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+    # vmap over the symbol axis; scan over the batch axis inside.
+    new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(
+        _sym_scan
+    )(sym_book, orders)
+
+    new_book = BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq)
+
+    # ---- global fill compaction -----------------------------------------
+    # [S, B, CAP] -> flat, ordered (symbol, batch position, priority rank).
+    s, b, cap = f_qty.shape
+    flat_qty = f_qty.reshape(-1)
+    mask = flat_qty > 0
+    pos = jnp.cumsum(mask) - 1
+    total = jnp.sum(mask)
+    n = cfg.max_fills
+    dest = jnp.where(mask & (pos < n), pos, n)  # slot n = trash
+
+    def compact(flat_vals):
+        return jnp.zeros((n + 1,), I32).at[dest].set(flat_vals)[:n]
+
+    sym_ids = jnp.broadcast_to(jnp.arange(s, dtype=I32)[:, None, None], (s, b, cap))
+    taker = jnp.broadcast_to(orders.oid[:, :, None], (s, b, cap))
+    best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty, True)
+    best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
+    out = StepOutput(
+        status=status,
+        filled=filled,
+        remaining=remaining,
+        fill_sym=compact(sym_ids.reshape(-1)),
+        fill_taker_oid=compact(taker.reshape(-1)),
+        fill_maker_oid=compact(f_oid.reshape(-1)),
+        fill_price=compact(f_price.reshape(-1)),
+        fill_qty=compact(flat_qty),
+        fill_count=jnp.minimum(total, n).astype(I32),
+        fill_overflow=total > n,
+        best_bid=best_bid,
+        bid_size=bid_size,
+        best_ask=best_ask,
+        ask_size=ask_size,
+    )
+    return new_book, out

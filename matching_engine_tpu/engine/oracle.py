@@ -1,0 +1,170 @@
+"""Host oracle: an obviously-correct price-time-priority CLOB.
+
+This is the fill-parity referee for the TPU kernel (SURVEY.md §4: replay the
+same order stream through this and through the jit'd kernel, assert identical
+fills). It is deliberately simple Python — integer math only, linear scans,
+no cleverness. The reference left its engine file empty
+(include/engine/model.hpp, 0 bytes); these are the matching semantics this
+framework defines (SURVEY.md §7 "Matching semantics"):
+
+- Price-time priority: best price first (lowest ask / highest bid), FIFO by
+  arrival sequence within a price level.
+- LIMIT: crosses while the opposite best satisfies the limit; any remainder
+  rests in the book.
+- MARKET: sweeps the opposite side without a price bound; any remainder is
+  canceled (immediate-or-cancel remainder — market orders never rest).
+- Fills execute at the resting (maker) price.
+- CANCEL removes a resting order by id.
+- Each book side has a fixed capacity (the device kernel's static shape); a
+  LIMIT remainder that finds the side full is rejected after its fills are
+  honored (status REJECTED, rested=False).
+
+Statuses use the proto enum (OrderUpdate.Status): a fully filled taker is
+FILLED; partially filled LIMIT that rests is PARTIALLY_FILLED; partially
+filled MARKET ends CANCELED; an untouched resting LIMIT is NEW.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from matching_engine_tpu.proto import pb2
+
+NEW = pb2.OrderUpdate.Status.NEW
+PARTIALLY_FILLED = pb2.OrderUpdate.Status.PARTIALLY_FILLED
+FILLED = pb2.OrderUpdate.Status.FILLED
+CANCELED = pb2.OrderUpdate.Status.CANCELED
+REJECTED = pb2.OrderUpdate.Status.REJECTED
+
+
+@dataclasses.dataclass(frozen=True)
+class Fill:
+    taker_oid: int
+    maker_oid: int
+    price_q4: int
+    quantity: int
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderResult:
+    oid: int
+    status: int  # pb2.OrderUpdate.Status value
+    filled: int
+    remaining: int
+    rested: bool
+    fills: tuple[Fill, ...]
+
+
+@dataclasses.dataclass
+class _Resting:
+    oid: int
+    price_q4: int
+    qty: int
+    seq: int
+
+
+class OracleBook:
+    """Single-symbol CLOB with fixed per-side capacity."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self.bids: list[_Resting] = []
+        self.asks: list[_Resting] = []
+        self.next_seq = 0
+
+    # -- internals ---------------------------------------------------------
+
+    def _opposite(self, side: int) -> list[_Resting]:
+        return self.asks if side == pb2.BUY else self.bids
+
+    def _own(self, side: int) -> list[_Resting]:
+        return self.bids if side == pb2.BUY else self.asks
+
+    def _priority_sorted(self, side_of_resting: int, resting: list[_Resting]):
+        # Lowest ask first / highest bid first; FIFO (seq) within a level.
+        if side_of_resting == pb2.SELL:
+            return sorted(resting, key=lambda r: (r.price_q4, r.seq))
+        return sorted(resting, key=lambda r: (-r.price_q4, r.seq))
+
+    # -- operations --------------------------------------------------------
+
+    def submit(
+        self, oid: int, side: int, order_type: int, price_q4: int, qty: int
+    ) -> OrderResult:
+        assert qty > 0
+        opp_side = pb2.SELL if side == pb2.BUY else pb2.BUY
+        opp = self._opposite(side)
+        remaining = qty
+        fills: list[Fill] = []
+
+        for maker in self._priority_sorted(opp_side, opp):
+            if remaining == 0:
+                break
+            if maker.qty == 0:
+                continue
+            if order_type == pb2.LIMIT:
+                if side == pb2.BUY and maker.price_q4 > price_q4:
+                    break
+                if side == pb2.SELL and maker.price_q4 < price_q4:
+                    break
+            take = min(remaining, maker.qty)
+            maker.qty -= take
+            remaining -= take
+            fills.append(Fill(oid, maker.oid, maker.price_q4, take))
+
+        # Drop emptied makers.
+        self.asks = [r for r in self.asks if r.qty > 0]
+        self.bids = [r for r in self.bids if r.qty > 0]
+
+        filled = qty - remaining
+        if remaining == 0:
+            return OrderResult(oid, FILLED, filled, 0, False, tuple(fills))
+
+        if order_type == pb2.MARKET:
+            return OrderResult(oid, CANCELED, filled, remaining, False, tuple(fills))
+
+        own = self._own(side)
+        if len(own) >= self.capacity:
+            return OrderResult(oid, REJECTED, filled, remaining, False, tuple(fills))
+        own.append(_Resting(oid, price_q4, remaining, self.next_seq))
+        self.next_seq += 1
+        status = PARTIALLY_FILLED if filled > 0 else NEW
+        return OrderResult(oid, status, filled, remaining, True, tuple(fills))
+
+    def cancel(self, oid: int) -> OrderResult:
+        for side_list in (self.bids, self.asks):
+            for r in side_list:
+                if r.oid == oid:
+                    side_list.remove(r)
+                    return OrderResult(oid, CANCELED, 0, r.qty, False, ())
+        return OrderResult(oid, REJECTED, 0, 0, False, ())
+
+    # -- views -------------------------------------------------------------
+
+    def best_bid(self) -> tuple[int, int] | None:
+        """(price_q4, total size at that price) or None."""
+        if not self.bids:
+            return None
+        p = max(r.price_q4 for r in self.bids)
+        return p, sum(r.qty for r in self.bids if r.price_q4 == p)
+
+    def best_ask(self) -> tuple[int, int] | None:
+        if not self.asks:
+            return None
+        p = min(r.price_q4 for r in self.asks)
+        return p, sum(r.qty for r in self.asks if r.price_q4 == p)
+
+    def snapshot(self):
+        """Canonical book state: priority-sorted (oid, price, qty, seq) per side.
+
+        Used by parity tests to compare against the device book.
+        """
+        bids = [
+            (r.oid, r.price_q4, r.qty, r.seq)
+            for r in self._priority_sorted(pb2.BUY, self.bids)
+        ]
+        asks = [
+            (r.oid, r.price_q4, r.qty, r.seq)
+            for r in self._priority_sorted(pb2.SELL, self.asks)
+        ]
+        return bids, asks

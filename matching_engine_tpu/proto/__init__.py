@@ -1,0 +1,20 @@
+"""Wire contract package.
+
+`matching_engine_pb2` is generated from `matching_engine.proto` (checked in so
+no codegen toolchain is needed at runtime; regenerate with
+`scripts/regen_proto.sh`). The service/stub adapters live in `rpc.py` —
+hand-rolled because this environment ships the grpcio runtime but not
+grpcio-tools.
+"""
+
+from matching_engine_tpu.proto import matching_engine_pb2 as pb2
+
+Side = pb2.Side
+OrderType = pb2.OrderType
+BUY = pb2.BUY
+SELL = pb2.SELL
+LIMIT = pb2.LIMIT
+MARKET = pb2.MARKET
+Status = pb2.OrderUpdate.Status
+
+__all__ = ["pb2", "Side", "OrderType", "BUY", "SELL", "LIMIT", "MARKET", "Status"]

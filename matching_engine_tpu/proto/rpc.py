@@ -1,0 +1,85 @@
+"""gRPC service/stub adapters for the MatchingEngine contract.
+
+grpcio-tools is not available in this environment, so instead of generated
+`*_pb2_grpc.py` we build the equivalent objects directly from the message
+classes: a servicer base + `add_to_server` using
+`grpc.method_handlers_generic_handler`, and a client stub using channel
+`unary_unary` / `unary_stream` callables. Wire behavior is identical to
+generated code (method paths, serializers).
+
+Reference parity: the four RPCs at /root/reference/proto/matching_engine.proto:29-35,
+plus the CancelOrder/GetMetrics extensions this framework adds.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from matching_engine_tpu.proto import pb2
+
+SERVICE_NAME = "matching_engine.v1.MatchingEngine"
+
+# method name -> (kind, request class, response class)
+_METHODS = {
+    "SubmitOrder": ("unary_unary", pb2.OrderRequest, pb2.OrderResponse),
+    "GetOrderBook": ("unary_unary", pb2.OrderBookRequest, pb2.OrderBookResponse),
+    "StreamMarketData": ("unary_stream", pb2.MarketDataRequest, pb2.MarketDataUpdate),
+    "StreamOrderUpdates": ("unary_stream", pb2.OrderUpdatesRequest, pb2.OrderUpdate),
+    "CancelOrder": ("unary_unary", pb2.CancelRequest, pb2.CancelResponse),
+    "GetMetrics": ("unary_unary", pb2.MetricsRequest, pb2.MetricsResponse),
+}
+
+
+class MatchingEngineServicer:
+    """Override any subset of the RPC methods; the rest answer UNIMPLEMENTED
+    (matching the reference, whose streaming RPCs fall through to the generated
+    base class — see SURVEY.md §3.4)."""
+
+    def SubmitOrder(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "SubmitOrder not implemented")
+
+    def GetOrderBook(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetOrderBook not implemented")
+
+    def StreamMarketData(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "StreamMarketData not implemented")
+
+    def StreamOrderUpdates(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "StreamOrderUpdates not implemented")
+
+    def CancelOrder(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "CancelOrder not implemented")
+
+    def GetMetrics(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetMetrics not implemented")
+
+
+def add_matching_engine_servicer(servicer: MatchingEngineServicer, server: grpc.Server) -> None:
+    handlers = {}
+    for name, (kind, req_cls, resp_cls) in _METHODS.items():
+        factory = getattr(grpc, f"{kind}_rpc_method_handler")
+        handlers[name] = factory(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class MatchingEngineStub:
+    """Client stub; one callable attribute per RPC, like generated stubs."""
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (kind, req_cls, resp_cls) in _METHODS.items():
+            factory = getattr(channel, kind)
+            setattr(
+                self,
+                name,
+                factory(
+                    f"/{SERVICE_NAME}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in this environment, so sharding
+tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+Note: the environment's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon (the real-TPU tunnel), so mutating os.environ here is too
+late for the platform choice — use jax.config.update instead. XLA_FLAGS is
+still read at backend-init time, which happens after conftest import.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,73 @@
+"""Submit-time validation semantics (domain/order.py).
+
+Mirrors the reference's reject conditions (matching_engine_service.cpp:66-83)
+plus this framework's device-range guards.
+"""
+
+import pytest
+
+from matching_engine_tpu.domain import Order, validate_submit
+from matching_engine_tpu.domain.order import MAX_QUANTITY
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL, pb2
+
+
+def req(**kw):
+    base = dict(
+        client_id="c", symbol="SYM", order_type=LIMIT, side=BUY, price=1005,
+        scale=2, quantity=10,
+    )
+    base.update(kw)
+    return pb2.OrderRequest(**base)
+
+
+def test_valid_passes():
+    assert validate_submit(req()) is None
+    assert validate_submit(req(order_type=MARKET, price=0)) is None
+    assert validate_submit(req(side=SELL)) is None
+
+
+def test_missing_symbol_rejects():
+    assert "symbol" in validate_submit(req(symbol=""))
+
+
+def test_nonpositive_quantity_rejects():
+    assert "quantity" in validate_submit(req(quantity=0))
+    assert "quantity" in validate_submit(req(quantity=-5))
+
+
+def test_quantity_above_engine_max_rejects():
+    assert validate_submit(req(quantity=MAX_QUANTITY)) is None
+    msg = validate_submit(req(quantity=MAX_QUANTITY + 1))
+    assert msg and "quantity" in msg
+
+
+def test_limit_needs_positive_price():
+    assert "price" in validate_submit(req(price=0))
+    assert "price" in validate_submit(req(price=-1))
+    # MARKET ignores price
+    assert validate_submit(req(order_type=MARKET, price=0)) is None
+
+
+def test_unspecified_side_rejects():
+    assert "side" in validate_submit(req(side=0))
+
+
+def test_bad_scale_rejects():
+    assert "scale" in validate_submit(req(scale=19))
+    assert "scale" in validate_submit(req(order_type=MARKET, scale=-1))
+
+
+def test_subq4_price_rejects():
+    # 10050 at scale 9 truncates to 0 at Q4 -> unpriceable limit order.
+    assert "zero" in validate_submit(req(price=10050, scale=9))
+
+
+def test_int32_lane_guard():
+    msg = validate_submit(req(price=300_000, scale=0))
+    assert msg and "int32" in msg
+
+
+def test_order_from_raw_normalizes():
+    o = Order.from_raw("OID-1", "c", "SYM", price=100500000, scale=8,
+                       quantity=5, side=BUY)
+    assert o.price_q4 == 10050

@@ -1,0 +1,133 @@
+"""Behavioral tests of the host oracle CLOB (engine/oracle.py).
+
+These pin down the matching semantics this framework defines (the reference's
+engine file is empty — SURVEY.md §2 row 5), so the oracle can then serve as
+the parity referee for the device kernel.
+"""
+
+from matching_engine_tpu.engine.oracle import (
+    CANCELED,
+    FILLED,
+    NEW,
+    PARTIALLY_FILLED,
+    REJECTED,
+    OracleBook,
+)
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+
+def test_limit_rests_when_no_cross():
+    b = OracleBook()
+    r = b.submit(1, BUY, LIMIT, 10000, 5)
+    assert r.status == NEW and r.rested and r.filled == 0
+    assert b.best_bid() == (10000, 5)
+    assert b.best_ask() is None
+
+
+def test_cross_fills_at_maker_price():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 5)
+    r = b.submit(2, BUY, LIMIT, 10100, 5)  # willing to pay more
+    assert r.status == FILLED and r.filled == 5
+    assert r.fills[0].price_q4 == 10000  # maker's price
+    assert r.fills[0].maker_oid == 1
+    assert b.best_ask() is None
+
+
+def test_price_priority():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10200, 5)
+    b.submit(2, SELL, LIMIT, 10000, 5)  # better ask
+    r = b.submit(3, BUY, MARKET, 0, 5)
+    assert [f.maker_oid for f in r.fills] == [2]
+
+
+def test_time_priority_within_level():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 5)
+    b.submit(2, SELL, LIMIT, 10000, 5)
+    r = b.submit(3, BUY, LIMIT, 10000, 7)
+    assert [(f.maker_oid, f.quantity) for f in r.fills] == [(1, 5), (2, 2)]
+    assert b.best_ask() == (10000, 3)
+
+
+def test_partial_fill_rests_remainder():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 3)
+    r = b.submit(2, BUY, LIMIT, 10000, 10)
+    assert r.status == PARTIALLY_FILLED and r.filled == 3 and r.remaining == 7
+    assert r.rested
+    assert b.best_bid() == (10000, 7)
+
+
+def test_market_remainder_cancels():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 3)
+    r = b.submit(2, BUY, MARKET, 0, 10)
+    assert r.status == CANCELED and r.filled == 3 and r.remaining == 7
+    assert not r.rested
+    assert b.best_bid() is None
+
+
+def test_market_sweeps_multiple_levels():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 2)
+    b.submit(2, SELL, LIMIT, 10100, 2)
+    b.submit(3, SELL, LIMIT, 10200, 2)
+    r = b.submit(4, BUY, MARKET, 0, 5)
+    assert r.status == FILLED
+    assert [(f.maker_oid, f.quantity, f.price_q4) for f in r.fills] == [
+        (1, 2, 10000),
+        (2, 2, 10100),
+        (3, 1, 10200),
+    ]
+
+
+def test_limit_respects_price_bound():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 2)
+    b.submit(2, SELL, LIMIT, 10200, 2)
+    r = b.submit(3, BUY, LIMIT, 10100, 5)
+    assert r.filled == 2  # only the 10000 ask is eligible
+    assert r.status == PARTIALLY_FILLED and r.remaining == 3
+    assert b.best_ask() == (10200, 2)
+    assert b.best_bid() == (10100, 3)
+
+
+def test_cancel_resting():
+    b = OracleBook()
+    b.submit(1, BUY, LIMIT, 10000, 5)
+    r = b.cancel(1)
+    assert r.status == CANCELED and r.remaining == 5
+    assert b.best_bid() is None
+    # cancel of unknown id rejects
+    assert b.cancel(99).status == REJECTED
+
+
+def test_capacity_reject_after_fills():
+    b = OracleBook(capacity=2)
+    b.submit(1, BUY, LIMIT, 9000, 1)
+    b.submit(2, BUY, LIMIT, 9100, 1)
+    b.submit(3, SELL, LIMIT, 10000, 2)
+    # Crosses for 2, remainder 3 wants to rest on the (full? no — asks) side.
+    b2 = OracleBook(capacity=2)
+    b2.submit(1, SELL, LIMIT, 10000, 1)
+    b2.submit(2, SELL, LIMIT, 10100, 1)
+    r = b2.submit(3, SELL, LIMIT, 10200, 1)
+    assert r.status == REJECTED and not r.rested
+    # fills before the reject are still honored
+    b3 = OracleBook(capacity=1)
+    b3.submit(1, BUY, LIMIT, 10000, 2)
+    r = b3.submit(2, SELL, LIMIT, 9000, 5)  # fills 2, remainder 3 can't rest? bids side
+    # own side (asks) is empty, so it rests fine
+    assert r.rested and r.filled == 2
+
+
+def test_sequence_is_fifo_across_partial_cancels():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10000, 5)
+    b.submit(2, SELL, LIMIT, 10000, 5)
+    b.cancel(1)
+    b.submit(3, SELL, LIMIT, 10000, 5)
+    r = b.submit(4, BUY, MARKET, 0, 8)
+    assert [(f.maker_oid, f.quantity) for f in r.fills] == [(2, 5), (3, 3)]

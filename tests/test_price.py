@@ -1,0 +1,94 @@
+"""Q4 normalizer goldens.
+
+Semantics oracle: the reference's normalizer (include/domain/price.hpp:15-29)
+and its unit tables (tests/test_price.cpp:6-20) — up/downscale, truncation
+toward zero, scale range, int64 overflow.
+"""
+
+import pytest
+
+from matching_engine_tpu.domain import (
+    K_TARGET_SCALE,
+    PriceError,
+    normalize_to_q4,
+    normalize_to_q4_jax,
+)
+
+
+@pytest.mark.parametrize(
+    "price,scale,expected",
+    [
+        # identity at Q4
+        (12345, 4, 12345),
+        (0, 4, 0),
+        # upscale (scale < 4): multiply by 10^(4-scale)
+        (1, 0, 10000),          # 1 unit -> 1.0000
+        (5, 2, 500),            # 0.05 -> 0.0500
+        (123, 3, 1230),
+        # downscale (scale > 4): divide, truncate toward zero
+        (100500000, 8, 10050),  # 1.005 @ scale 8 -> 1.0050
+        (10000, 8, 1),          # 0.0001 @ scale 8 -> Q4 1 (integration oracle:
+                                #  ref tests/test_submit_order.cpp stores price=1)
+        (10050, 9, 0),          # truncates to zero (ref test_price.cpp case)
+        (19999, 5, 1999),       # truncation, not rounding
+        (-19999, 5, -1999),     # toward zero for negatives too
+        (123456789, 6, 1234567),
+        # max scale
+        (10**18, 18, 10**4),
+    ],
+)
+def test_normalize_examples(price, scale, expected):
+    assert normalize_to_q4(price, scale) == expected
+
+
+def test_scale_out_of_range():
+    with pytest.raises(PriceError):
+        normalize_to_q4(1, -1)
+    with pytest.raises(PriceError):
+        normalize_to_q4(1, 19)
+
+
+def test_overflow_rejects():
+    # 2^62 at scale 0 would need *10^4 -> overflows int64
+    with pytest.raises(PriceError):
+        normalize_to_q4(2**62, 0)
+    # just under the edge is fine
+    assert normalize_to_q4((2**63 - 1) // 10**4, 0) == ((2**63 - 1) // 10**4) * 10**4
+
+
+def test_target_scale_is_q4():
+    assert K_TARGET_SCALE == 4
+
+
+@pytest.mark.parametrize(
+    "price,scale,expected",
+    [(12345, 4, 12345), (5, 2, 500), (100500000, 8, 10050), (10050, 9, 0), (-19999, 5, -1999)],
+)
+def test_jax_mirror_matches_host(price, scale, expected):
+    out, ok = normalize_to_q4_jax(price, scale)
+    assert bool(ok)
+    assert int(out) == expected
+
+
+def test_jax_mirror_flags_bad_scale():
+    _, ok = normalize_to_q4_jax(1, 19)
+    assert not bool(ok)
+
+
+def test_jax_mirror_deep_downscale_no_lane_wrap():
+    # 10^shift for shift > 9 wraps int32; the two-step divide must not.
+    out, ok = normalize_to_q4_jax(2_000_000_000, 17)  # shift 13
+    assert bool(ok) and int(out) == normalize_to_q4(2_000_000_000, 17) == 0
+    out, ok = normalize_to_q4_jax(2_000_000_000, 13)  # shift 9
+    assert bool(ok) and int(out) == normalize_to_q4(2_000_000_000, 13) == 2
+    out, ok = normalize_to_q4_jax(1_999_999_999, 18)
+    assert bool(ok) and int(out) == 0
+
+
+def test_jax_mirror_flags_upscale_overflow():
+    # 10^6 at scale 0 -> 10^10 overflows int32 lanes: must flag, not wrap.
+    out, ok = normalize_to_q4_jax(1_000_000, 0)
+    assert not bool(ok) and int(out) == 0
+    # At the int32 edge: 214748 * 10^4 = 2147480000 fits.
+    out, ok = normalize_to_q4_jax(214748, 0)
+    assert bool(ok) and int(out) == 2_147_480_000
