@@ -1,0 +1,156 @@
+"""CLI client, argv-compatible with the reference's one-shot submitter.
+
+Reference contract (src/client/client.cpp:10-29,49-56): positional args
+`<addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> <price> <scale>
+<quantity>`, prints `[client] accepted order_id=...` on success or the
+rejection reason, exit codes: 1 usage, 2 RPC failure, 3 rejected.
+
+Extended subcommands (new surface): `book`, `cancel`, `watch-md`,
+`watch-orders`, `metrics` — invoked as
+`python -m matching_engine_tpu.client.cli <sub> ...`; the bare 8-arg form
+stays the submit path.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import grpc
+
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+
+USAGE = (
+    "usage: client <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> "
+    "<price> <scale> <quantity>\n"
+    "   or: client book <addr> <symbol>\n"
+    "   or: client cancel <addr> <client_id> <order_id>\n"
+    "   or: client watch-md <addr> <symbol>\n"
+    "   or: client watch-orders <addr> <client_id>\n"
+    "   or: client metrics <addr>"
+)
+
+
+def _stub(addr: str) -> MatchingEngineStub:
+    return MatchingEngineStub(grpc.insecure_channel(addr))
+
+
+def _submit(argv: list[str]) -> int:
+    addr, client_id, symbol, side_s, type_s, price_s, scale_s, qty_s = argv
+    side = {"BUY": pb2.BUY, "SELL": pb2.SELL}.get(side_s.upper())
+    otype = {"LIMIT": pb2.LIMIT, "MARKET": pb2.MARKET}.get(type_s.upper())
+    if side is None or otype is None:
+        print(USAGE, file=sys.stderr)
+        return 1
+    req = pb2.OrderRequest(
+        client_id=client_id, symbol=symbol, order_type=otype, side=side,
+        price=int(price_s), scale=int(scale_s), quantity=int(qty_s),
+    )
+    try:
+        resp = _stub(addr).SubmitOrder(req, timeout=30)
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}: {e.details()}", file=sys.stderr)
+        return 2
+    if resp.success:
+        print(f"[client] accepted order_id={resp.order_id}")
+        return 0
+    print(f"[client] rejected: {resp.error_message}")
+    return 3
+
+
+def _book(addr: str, symbol: str) -> int:
+    try:
+        resp = _stub(addr).GetOrderBook(pb2.OrderBookRequest(symbol=symbol), timeout=10)
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}", file=sys.stderr)
+        return 2
+    print(f"[client] book {symbol}: {len(resp.bids)} bids / {len(resp.asks)} asks")
+    for label, side in (("bid", resp.bids), ("ask", resp.asks)):
+        for o in side:
+            print(f"  {label} {o.price}@Q{o.scale} x{o.quantity} {o.order_id} ({o.client_id})")
+    return 0
+
+
+def _cancel(addr: str, client_id: str, order_id: str) -> int:
+    try:
+        resp = _stub(addr).CancelOrder(
+            pb2.CancelRequest(client_id=client_id, order_id=order_id), timeout=10
+        )
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}", file=sys.stderr)
+        return 2
+    if resp.success:
+        print(f"[client] canceled order_id={resp.order_id}")
+        return 0
+    print(f"[client] cancel rejected: {resp.error_message}")
+    return 3
+
+
+def _watch_md(addr: str, symbol: str) -> int:
+    # flush per event: watchers are typically piped/redirected, and buffered
+    # stream output looks like silence.
+    for u in _stub(addr).StreamMarketData(pb2.MarketDataRequest(symbol=symbol)):
+        print(f"[client] md {u.symbol} bid={u.best_bid}x{u.bid_size} "
+              f"ask={u.best_ask}x{u.ask_size} (Q{u.scale})", flush=True)
+    return 0
+
+
+def _watch_orders(addr: str, client_id: str) -> int:
+    for u in _stub(addr).StreamOrderUpdates(pb2.OrderUpdatesRequest(client_id=client_id)):
+        print(f"[client] update {u.order_id} {pb2.OrderUpdate.Status.Name(u.status)} "
+              f"fill={u.fill_quantity}@{u.fill_price} remaining={u.remaining_quantity}",
+              flush=True)
+    return 0
+
+
+def _metrics(addr: str) -> int:
+    resp = _stub(addr).GetMetrics(pb2.MetricsRequest(), timeout=10)
+    for k in sorted(resp.counters):
+        print(f"[client] counter {k} = {resp.counters[k]}")
+    for k in sorted(resp.gauges):
+        print(f"[client] gauge {k} = {resp.gauges[k]:.1f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        return _dispatch(argv)
+    except grpc.RpcError as e:
+        # Streams/metrics surface RPC failures here; unary subcommands catch
+        # their own. Same message/exit contract either way.
+        print(f"[client] rpc failed: {e.code().name}: {e.details()}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) went away; not an error.
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+def _dispatch(argv: list[str]) -> int:
+    try:
+        if len(argv) == 8:
+            return _submit(argv)
+        if len(argv) == 3 and argv[0] == "book":
+            return _book(argv[1], argv[2])
+        if len(argv) == 4 and argv[0] == "cancel":
+            return _cancel(argv[1], argv[2], argv[3])
+        if len(argv) == 3 and argv[0] == "watch-md":
+            return _watch_md(argv[1], argv[2])
+        if len(argv) == 3 and argv[0] == "watch-orders":
+            return _watch_orders(argv[1], argv[2])
+        if len(argv) == 2 and argv[0] == "metrics":
+            return _metrics(argv[1])
+    except (ValueError, IndexError):
+        pass
+    print(USAGE, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,9 @@ def validate_submit(request: pb2.OrderRequest) -> str | None:
         )
     if request.side not in (pb2.BUY, pb2.SELL):
         return "side must be BUY or SELL"
+    if request.order_type not in (pb2.LIMIT, pb2.MARKET):
+        # proto3 open enums preserve unknown values; reject, don't guess.
+        return "order_type must be LIMIT or MARKET"
     if request.order_type == pb2.LIMIT:
         if request.price <= 0:
             return "limit orders require a positive price"

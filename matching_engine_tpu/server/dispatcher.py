@@ -1,0 +1,117 @@
+"""BatchDispatcher: the host-side throughput/latency knob.
+
+The north-star architecture (BASELINE.json): the gRPC handlers don't touch
+the device — they enqueue validated ops and wait on a per-op future. One
+dispatcher thread drains the queue on a time/size trigger (whichever comes
+first), ships a dense dispatch through the EngineRunner, completes futures,
+hands storage events to the async sink, and fans stream events out to the
+hubs. This replaces the reference's global `write_mu` serialization point
+(matching_engine_service.cpp:102) with pipelined batches: RPC threads block
+only on their own op's completion, and a whole batch costs one kernel launch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner
+from matching_engine_tpu.utils.metrics import Metrics
+
+
+class BatchDispatcher:
+    def __init__(
+        self,
+        runner: EngineRunner,
+        sink=None,          # AsyncStorageSink | None
+        hub=None,           # StreamHub | None
+        window_ms: float = 2.0,
+        max_batch: int | None = None,
+        metrics: Metrics | None = None,
+    ):
+        self.runner = runner
+        self.sink = sink
+        self.hub = hub
+        self.window_s = window_ms / 1e3
+        # Default: fill at most one full device dispatch per drain.
+        self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
+        self.metrics = metrics or runner.metrics
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="dispatcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, op: EngineOp) -> Future:
+        """Enqueue one validated op; the future resolves to its OpOutcome."""
+        fut: Future = Future()
+        self._q.put((op, fut))
+        return fut
+
+    def close(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+    # -- the drain loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            first = self._q.get()
+            if first is None:
+                return
+            batch = [first]
+            deadline = time.perf_counter() + self.window_s
+            while len(batch) < self.max_batch:
+                timeout = deadline - time.perf_counter()
+                if timeout <= 0:
+                    break
+                try:
+                    item = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if item is None:
+                    self._drain(batch)
+                    return
+                batch.append(item)
+            self._drain(batch)
+
+    def _drain(self, batch) -> None:
+        t0 = time.perf_counter()
+        ops = [op for op, _ in batch]
+        futs = {id(op): fut for op, fut in batch}
+        try:
+            result = self.runner.run_dispatch(ops)
+        except Exception as e:  # noqa: BLE001 — fail the futures, not the loop
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            self.metrics.inc("dispatch_errors")
+            return
+
+        for outcome in result.outcomes:
+            fut = futs.get(id(outcome.op))
+            if fut is not None and not fut.done():
+                fut.set_result(outcome)
+        # Any op the decode somehow missed: fail loudly rather than hang.
+        for op, fut in batch:
+            if not fut.done():
+                fut.set_exception(RuntimeError("op produced no outcome"))
+
+        if self.sink is not None:
+            # Non-blocking: a stalled SQLite must not backpressure the match
+            # loop (we prefer losing durable-log tail to stalling matching;
+            # the sink counts drops and the book checkpoint reconciles).
+            if not self.sink.submit(
+                orders=result.storage_orders,
+                updates=result.storage_updates,
+                fills=result.storage_fills,
+                block=False,
+            ):
+                self.metrics.inc("storage_batches_dropped")
+        if self.hub is not None:
+            self.hub.publish_order_updates(result.order_updates)
+            self.hub.publish_market_data(result.market_data)
+        self.metrics.ema_gauge("dispatch_us", (time.perf_counter() - t0) * 1e6)
+        self.metrics.ema_gauge("dispatch_ops", len(batch))

@@ -1,0 +1,335 @@
+"""EngineRunner: the single owner of device book state and host directories.
+
+Bridges the host order world (string symbols, "OID-n" ids, client ids,
+statuses) and the device world (symbol slots, int oids, [S, B] dispatches).
+One runner instance is driven by exactly one dispatcher thread, so device
+state and the directories need no locking on the hot path; read-only RPC
+views (book snapshots) take the snapshot lock.
+
+Responsibilities per dispatch:
+- group validated ops into dense OrderBatches (order-preserving per symbol),
+- run the jit'd engine step (book state stays on device, donated),
+- decode results/fills into: per-op outcomes, maker bookkeeping, storage
+  events, per-client order updates, and top-of-book market data.
+
+Reference parity notes: order ids are "OID-<monotonic>" resumed from storage
+(matching_engine_service.cpp:29-32, storage.cpp:254-268); statuses are the
+proto OrderUpdate.Status machine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from matching_engine_tpu.engine.book import EngineConfig, OrderBatch, init_book
+from matching_engine_tpu.engine.harness import HostOrder, build_batches, decode_step
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    FILLED,
+    NEW,
+    OP_CANCEL,
+    OP_SUBMIT,
+    PARTIALLY_FILLED,
+    REJECTED,
+    engine_step,
+)
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.storage.storage import FillRow
+from matching_engine_tpu.utils.metrics import Metrics
+
+
+@dataclasses.dataclass
+class OrderInfo:
+    """Host directory entry for one accepted order."""
+
+    oid: int
+    order_id: str
+    client_id: str
+    symbol: str
+    side: int
+    otype: int
+    price_q4: int
+    quantity: int
+    remaining: int
+    status: int
+
+
+@dataclasses.dataclass
+class EngineOp:
+    """One validated operation headed for the device."""
+
+    op: int                      # OP_SUBMIT / OP_CANCEL
+    info: OrderInfo              # the order (submit) or the target (cancel)
+    cancel_requester: str = ""   # client asking for the cancel
+
+
+@dataclasses.dataclass
+class OpOutcome:
+    op: EngineOp
+    status: int
+    filled: int
+    remaining: int
+    error: str = ""
+
+
+@dataclasses.dataclass
+class DispatchResult:
+    outcomes: list[OpOutcome]
+    order_updates: list[pb2.OrderUpdate]
+    market_data: list[pb2.MarketDataUpdate]
+    storage_orders: list[tuple]
+    storage_updates: list[tuple]
+    storage_fills: list[FillRow]
+    fill_count: int
+
+
+class EngineRunner:
+    def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None):
+        self.cfg = cfg
+        self.metrics = metrics or Metrics()
+        self._snapshot_lock = threading.Lock()
+        self._id_lock = threading.Lock()  # oid/symbol assignment from RPC threads
+        self.book = init_book(cfg)
+        # Directories (host truth mirroring device state).
+        self.symbols: dict[str, int] = {}           # symbol -> slot
+        self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
+        self.orders_by_num: dict[int, OrderInfo] = {}
+        self.orders_by_id: dict[str, OrderInfo] = {}
+        self.next_oid_num = 1
+
+    # -- id/symbol management ---------------------------------------------
+
+    def assign_oid(self) -> tuple[int, str]:
+        with self._id_lock:
+            n = self.next_oid_num
+            self.next_oid_num += 1
+        return n, f"OID-{n}"
+
+    def seed_oid_sequence(self, next_n: int) -> None:
+        with self._id_lock:
+            self.next_oid_num = max(self.next_oid_num, next_n)
+
+    def symbol_slot(self, symbol: str) -> int | None:
+        """Existing slot, or allocate one; None when the symbol axis is full."""
+        with self._id_lock:
+            slot = self.symbols.get(symbol)
+            if slot is not None:
+                return slot
+            if len(self.symbols) >= self.cfg.num_symbols:
+                return None
+            slot = len(self.symbols)
+            self.symbols[symbol] = slot
+            self.slot_symbols[slot] = symbol
+            return slot
+
+    # -- the dispatch ------------------------------------------------------
+
+    def run_dispatch(self, ops: list[EngineOp]) -> DispatchResult:
+        """Apply ops to the device books and decode all consequences."""
+        host_orders = []
+        by_oid: dict[int, EngineOp] = {}
+        for e in ops:
+            i = e.info
+            slot = self.symbols[i.symbol]  # caller guarantees allocation
+            host_orders.append(
+                HostOrder(
+                    sym=slot,
+                    op=e.op,
+                    side=i.side,
+                    otype=i.otype,
+                    price=i.price_q4,
+                    qty=i.remaining if e.op == OP_SUBMIT else 0,
+                    oid=i.oid,
+                )
+            )
+            by_oid[i.oid] = e
+
+        res = DispatchResult([], [], [], [], [], [], 0)
+        touched_syms: set[int] = set()
+        last_out = None
+        for batch in build_batches(self.cfg, host_orders):
+            with self._snapshot_lock:
+                self.book, out = engine_step(self.cfg, self.book, batch)
+            last_out = out
+            results, fills, overflow = decode_step(self.cfg, batch, out)
+            if overflow:
+                self.metrics.inc("fill_buffer_overflows")
+            self._decode_batch(results, fills, by_oid, res)
+            touched_syms.update(r.sym for r in results)
+            res.fill_count += len(fills)
+
+        if last_out is not None and touched_syms:
+            self._market_data(last_out, touched_syms, res)
+
+        # Evict terminal orders from the directories: once FILLED / CANCELED /
+        # REJECTED an order can never be referenced by a later fill, book
+        # snapshot, or legitimate cancel ("unknown order id" and "order not
+        # open" are equivalent rejects). Without this the directories grow
+        # one entry per order for process lifetime.
+        for e in ops:
+            i = e.info
+            if i.status in (FILLED, CANCELED, REJECTED) and e.op == OP_SUBMIT:
+                self.orders_by_num.pop(i.oid, None)
+                self.orders_by_id.pop(i.order_id, None)
+            elif e.op == OP_CANCEL and i.status == CANCELED:
+                self.orders_by_num.pop(i.oid, None)
+                self.orders_by_id.pop(i.order_id, None)
+        # Makers that just went terminal via fills.
+        for oid in [
+            o for o, i in self.orders_by_num.items()
+            if i.status in (FILLED, CANCELED, REJECTED)
+        ]:
+            info = self.orders_by_num.pop(oid)
+            self.orders_by_id.pop(info.order_id, None)
+
+        self.metrics.inc("dispatches")
+        self.metrics.inc("engine_ops", len(ops))
+        self.metrics.inc("fills", res.fill_count)
+        return res
+
+    # -- decoding helpers --------------------------------------------------
+
+    def _decode_batch(self, results, fills, by_oid, res: DispatchResult) -> None:
+        # Pass 1 — taker outcomes: register fresh orders in the directories
+        # and pin their post-step remaining, BEFORE maker bookkeeping (an
+        # order can rest and be hit as maker within the same batch; maker
+        # decrements must land on the post-taker remaining).
+        for r in results:
+            e = by_oid.get(r.oid)
+            if e is None:
+                continue
+            info = e.info
+            if e.op == OP_SUBMIT:
+                info.status = r.status
+                info.remaining = r.remaining
+                if r.status == REJECTED:
+                    # Book-capacity reject after any fills were honored.
+                    res.outcomes.append(
+                        OpOutcome(e, r.status, r.filled, r.remaining,
+                                  "book side at capacity" if r.filled == 0 else
+                                  "partially filled; remainder rejected (book side at capacity)")
+                    )
+                else:
+                    res.outcomes.append(OpOutcome(e, r.status, r.filled, r.remaining))
+                price_col = None if info.otype == pb2.MARKET else info.price_q4
+                res.storage_orders.append(
+                    (info.order_id, info.client_id, info.symbol, info.side,
+                     info.otype, price_col, info.quantity, info.remaining,
+                     info.status)
+                )
+                self.orders_by_num[info.oid] = info
+                self.orders_by_id[info.order_id] = info
+                # Taker's own updates: one per fill + terminal/new status.
+                rem = info.quantity
+                for f in fills:
+                    if f.taker_oid != info.oid:
+                        continue
+                    rem -= f.quantity
+                    st = FILLED if (rem == 0 and info.remaining == 0) else PARTIALLY_FILLED
+                    res.order_updates.append(
+                        self._update(info, st, f.price_q4, f.quantity, rem)
+                    )
+                if r.status in (NEW, CANCELED, REJECTED):
+                    res.order_updates.append(self._update(info, r.status, 0, 0, r.remaining))
+            else:  # cancel
+                if r.status == CANCELED:
+                    info.status = CANCELED
+                    info.remaining = 0
+                    res.outcomes.append(OpOutcome(e, CANCELED, 0, r.remaining))
+                    res.storage_updates.append((info.order_id, CANCELED, 0))
+                    res.order_updates.append(self._update(info, CANCELED, 0, 0, 0))
+                else:
+                    res.outcomes.append(
+                        OpOutcome(e, REJECTED, 0, 0, "order not open")
+                    )
+
+        # Pass 2 — maker consequences. One storage row per execution
+        # (order_id = aggressor/taker, counter_order_id = maker); the
+        # maker's remaining/status is carried by an orders-table update.
+        for f in fills:
+            maker = self.orders_by_num.get(f.maker_oid)
+            taker = self.orders_by_num.get(f.taker_oid)
+            if maker is None or taker is None:
+                continue  # unreachable if directories are consistent
+            maker.remaining -= f.quantity
+            maker.status = FILLED if maker.remaining == 0 else PARTIALLY_FILLED
+            res.storage_fills.append(
+                FillRow(taker.order_id, maker.order_id, f.price_q4, f.quantity)
+            )
+            res.storage_updates.append((maker.order_id, maker.status, maker.remaining))
+            res.order_updates.append(self._fill_update(maker, f.price_q4, f.quantity))
+
+    def _update(self, info: OrderInfo, status, fprice, fqty, remaining) -> pb2.OrderUpdate:
+        return pb2.OrderUpdate(
+            order_id=info.order_id,
+            client_id=info.client_id,
+            symbol=info.symbol,
+            status=status,
+            fill_price=fprice,
+            scale=4,
+            fill_quantity=fqty,
+            remaining_quantity=remaining,
+        )
+
+    def _fill_update(self, maker: OrderInfo, price, qty) -> pb2.OrderUpdate:
+        return self._update(maker, maker.status, price, qty, maker.remaining)
+
+    def _market_data(self, out, touched_syms, res: DispatchResult) -> None:
+        bb = np.asarray(out.best_bid)
+        bs = np.asarray(out.bid_size)
+        ba = np.asarray(out.best_ask)
+        asz = np.asarray(out.ask_size)
+        for s in touched_syms:
+            sym = self.slot_symbols[s]
+            if sym is None:
+                continue
+            res.market_data.append(
+                pb2.MarketDataUpdate(
+                    symbol=sym,
+                    best_bid=int(bb[s]),
+                    best_ask=int(ba[s]),
+                    scale=4,
+                    bid_size=int(bs[s]),
+                    ask_size=int(asz[s]),
+                )
+            )
+
+    # -- read-only views ---------------------------------------------------
+
+    def book_snapshot(self, symbol: str) -> tuple[list, list]:
+        """Priority-sorted (OrderInfo, qty) lists (bids, asks) for one symbol.
+
+        Fetches the one symbol's lanes from the device (tiny transfer) and
+        joins against the host order directory.
+        """
+        slot = self.symbols.get(symbol)
+        if slot is None:
+            return [], []
+        with self._snapshot_lock:
+            arrs = [
+                np.asarray(x[slot])
+                for x in (
+                    self.book.bid_price, self.book.bid_qty, self.book.bid_oid,
+                    self.book.bid_seq, self.book.ask_price, self.book.ask_qty,
+                    self.book.ask_oid, self.book.ask_seq,
+                )
+            ]
+        bp, bq, bo, bs_, ap, aq, ao, as_ = arrs
+
+        def side(price, qty, oid, seq, desc):
+            rows = [
+                (int(oid[j]), int(price[j]), int(qty[j]), int(seq[j]))
+                for j in np.nonzero(qty > 0)[0]
+            ]
+            rows.sort(key=lambda r: (-r[1] if desc else r[1], r[3]))
+            out = []
+            for o, p, q, _ in rows:
+                info = self.orders_by_num.get(o)
+                if info is not None:
+                    out.append((info, q))
+            return out
+
+        return side(bp, bq, bo, bs_, True), side(ap, aq, ao, as_, False)

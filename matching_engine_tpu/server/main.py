@@ -1,0 +1,147 @@
+"""Server bootstrap: `python -m matching_engine_tpu.server.main --addr ...`.
+
+Process shape mirrors the reference's main (src/server/main.cpp:17-70):
+--addr flag (default 0.0.0.0:50051), db directory creation, insecure creds,
+port-bind failure check, SIGINT/SIGTERM -> graceful shutdown with a 2s
+deadline, typed exit codes (1 = storage init failure, 2 = bind failure,
+3 = fatal). Extended with engine/dispatcher flags and crash recovery: on
+boot, open orders (status NEW/PARTIALLY_FILLED) are replayed from SQLite
+into the device books in created_ts order, and the OID sequence resumes
+from MAX(order_id).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from concurrent import futures as cf
+
+import grpc
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.proto.rpc import add_matching_engine_servicer
+from matching_engine_tpu.server.dispatcher import BatchDispatcher
+from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
+from matching_engine_tpu.server.service import MatchingEngineService
+from matching_engine_tpu.server.streams import StreamHub
+from matching_engine_tpu.storage import AsyncStorageSink, Storage
+from matching_engine_tpu.utils.metrics import Metrics
+
+
+def recover_books(runner: EngineRunner, storage: Storage) -> int:
+    """Rebuild device books from the durable store after a restart.
+
+    The reference sketches this (best_bid/best_ask over status IN (0,1)) but
+    never performs it (SURVEY.md §5.4). Replays open LIMIT orders, oldest
+    first, with their *remaining* quantity, as a direct engine dispatch —
+    no persistence or stream side effects.
+    """
+    runner.seed_oid_sequence(storage.load_next_oid_seq())
+    rows = storage.open_orders()
+    ops = []
+    for (order_id, client_id, symbol, side, otype, price, qty, remaining, status) in rows:
+        if runner.symbol_slot(symbol) is None:
+            print(f"[SERVER] recovery: symbol axis full, dropping {order_id}")
+            continue
+        num = int(order_id.split("-", 1)[1]) if order_id.startswith("OID-") else 0
+        info = OrderInfo(
+            oid=num, order_id=order_id, client_id=client_id, symbol=symbol,
+            side=side, otype=otype, price_q4=price, quantity=qty,
+            remaining=remaining, status=status,
+        )
+        runner.orders_by_num[num] = info
+        runner.orders_by_id[order_id] = info
+        ops.append(EngineOp(OP_SUBMIT, info))
+    if ops:
+        runner.run_dispatch(ops)
+    return len(ops)
+
+
+def build_server(
+    addr: str,
+    db_path: str,
+    cfg: EngineConfig,
+    window_ms: float = 2.0,
+    rpc_workers: int = 32,
+    log: bool = True,
+):
+    """Wire the full stack; returns (grpc server, bound port, parts dict)."""
+    storage = Storage(db_path)
+    if not storage.init():
+        raise SystemExit(1)
+
+    metrics = Metrics()
+    runner = EngineRunner(cfg, metrics)
+    recovered = recover_books(runner, storage)
+    if recovered and log:
+        print(f"[SERVER] recovered {recovered} open orders into device books")
+
+    sink = AsyncStorageSink(storage)
+    hub = StreamHub()
+    dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
+    service = MatchingEngineService(runner, dispatcher, hub, metrics, log=log)
+
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
+    add_matching_engine_servicer(service, server)
+    port = server.add_insecure_port(addr)
+    if port == 0:
+        print(f"[SERVER] failed to bind {addr}", file=sys.stderr)
+        raise SystemExit(2)
+    parts = {
+        "storage": storage, "sink": sink, "hub": hub,
+        "dispatcher": dispatcher, "runner": runner, "service": service,
+        "metrics": metrics,
+    }
+    return server, port, parts
+
+
+def shutdown(server, parts, grace_s: float = 2.0) -> None:
+    """Graceful drain: stop RPCs (2s deadline, as the reference's stopper
+    thread does), close the dispatcher, flush the storage sink."""
+    server.stop(grace_s).wait()
+    parts["hub"].close_all()
+    parts["dispatcher"].close()
+    parts["sink"].close()
+    parts["storage"].close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="TPU-native matching engine server")
+    p.add_argument("--addr", default="0.0.0.0:50051")
+    p.add_argument("--db", default="db/matching_engine.db")
+    p.add_argument("--symbols", type=int, default=1024, help="symbol-axis size")
+    p.add_argument("--capacity", type=int, default=128, help="resting orders per side")
+    p.add_argument("--batch", type=int, default=8, help="orders per symbol per dispatch")
+    p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
+    p.add_argument("--rpc-workers", type=int, default=32)
+    args = p.parse_args(argv)
+
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
+    try:
+        server, port, parts = build_server(
+            args.addr, args.db, cfg, window_ms=args.window_ms,
+            rpc_workers=args.rpc_workers,
+        )
+    except SystemExit as e:
+        return int(e.code or 3)
+
+    stop_evt = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop_evt.set())
+
+    server.start()
+    print(f"[SERVER] listening on port {port} "
+          f"(symbols={cfg.num_symbols} capacity={cfg.capacity} batch={cfg.batch})")
+    try:
+        stop_evt.wait()
+    finally:
+        print("[SERVER] shutting down")
+        shutdown(server, parts)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Fan-out hubs for the two streaming RPCs.
+
+The reference declares StreamMarketData and StreamOrderUpdates but never
+overrides them — clients get UNIMPLEMENTED (SURVEY.md §3.4). Here they are
+real: the dispatcher publishes each dispatch's market-data and order-update
+events into per-subscriber bounded queues; stream handlers drain their queue
+until the client hangs up. Slow consumers lose oldest events (bounded queue,
+drop-oldest) rather than stalling the engine.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from matching_engine_tpu.proto import pb2
+
+_SENTINEL = object()
+
+
+class _Subscription:
+    def __init__(self, maxsize: int):
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def offer(self, item) -> None:
+        while True:
+            try:
+                self.q.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    self.q.get_nowait()  # drop oldest
+                except queue.Empty:
+                    pass
+
+    def stream(self, alive=lambda: True):
+        """Yield events until closed; `alive` is polled between events."""
+        while alive():
+            try:
+                item = self.q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if item is _SENTINEL:
+                return
+            yield item
+
+    def close(self) -> None:
+        self.offer(_SENTINEL)
+
+
+class StreamHub:
+    def __init__(self, maxsize: int = 1024):
+        self._lock = threading.Lock()
+        self._maxsize = maxsize
+        self._md_subs: dict[str, list[_Subscription]] = {}      # symbol ->
+        self._ou_subs: dict[str, list[_Subscription]] = {}      # client_id ->
+
+    # -- subscription management ------------------------------------------
+
+    def subscribe_market_data(self, symbol: str) -> _Subscription:
+        sub = _Subscription(self._maxsize)
+        with self._lock:
+            self._md_subs.setdefault(symbol, []).append(sub)
+        return sub
+
+    def subscribe_order_updates(self, client_id: str) -> _Subscription:
+        sub = _Subscription(self._maxsize)
+        with self._lock:
+            self._ou_subs.setdefault(client_id, []).append(sub)
+        return sub
+
+    def unsubscribe(self, sub: _Subscription) -> None:
+        with self._lock:
+            for table in (self._md_subs, self._ou_subs):
+                for key, subs in list(table.items()):
+                    if sub in subs:
+                        subs.remove(sub)
+                        if not subs:
+                            del table[key]
+        sub.close()
+
+    # -- publication (called from the dispatcher thread) -------------------
+
+    def publish_market_data(self, updates: list[pb2.MarketDataUpdate]) -> None:
+        if not updates:
+            return
+        with self._lock:
+            for u in updates:
+                for sub in self._md_subs.get(u.symbol, ()):
+                    sub.offer(u)
+
+    def publish_order_updates(self, updates: list[pb2.OrderUpdate]) -> None:
+        if not updates:
+            return
+        with self._lock:
+            for u in updates:
+                for sub in self._ou_subs.get(u.client_id, ()):
+                    sub.offer(u)
+
+    def close_all(self) -> None:
+        with self._lock:
+            subs = [s for v in self._md_subs.values() for s in v]
+            subs += [s for v in self._ou_subs.values() for s in v]
+            self._md_subs.clear()
+            self._ou_subs.clear()
+        for s in subs:
+            s.close()

@@ -1,0 +1,4 @@
+from matching_engine_tpu.storage.storage import FillRow, Storage
+from matching_engine_tpu.storage.async_sink import AsyncStorageSink
+
+__all__ = ["FillRow", "Storage", "AsyncStorageSink"]

@@ -1,0 +1,93 @@
+"""Asynchronous storage sink: the durable tail of the TPU fill stream.
+
+The reference's single biggest structural flaw is that its only hot path is a
+synchronous SQLite INSERT inside the RPC handler under a global mutex
+(SURVEY.md §3.2). Here persistence is decoupled: the engine runner emits
+(order-insert, status-update, fill) events per dispatch onto a queue; one
+background thread drains the queue and writes each dispatch as a single WAL
+transaction (`Storage.apply_batch`). The match path never blocks on disk.
+
+Durability model: same as the reference (WAL + synchronous=NORMAL) but
+batched — on crash, the tail of the fill stream since the last drained batch
+is lost from SQLite while the device book retains it; recovery reconciles
+from the book checkpoint (utils/checkpoint.py). `flush()` gives callers a
+barrier when they need read-your-writes (tests, shutdown drain).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from matching_engine_tpu.storage.storage import FillRow, Storage
+
+
+class AsyncStorageSink:
+    def __init__(self, storage: Storage, max_queue: int = 4096):
+        self._storage = storage
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="storage-sink", daemon=True)
+        self.dropped = 0  # batches dropped on a full queue (backpressure signal)
+        self._thread.start()
+
+    def submit(
+        self,
+        orders: list[tuple] | None = None,
+        updates: list[tuple] | None = None,
+        fills: list[FillRow] | None = None,
+        block: bool = True,
+    ) -> bool:
+        """Enqueue one dispatch's worth of writes. With block=False, a full
+        queue drops the batch and counts it (callers that prefer losing log
+        tail over stalling the match loop)."""
+        item = (orders or [], updates or [], fills or [])
+        if not any(item):
+            return True
+        try:
+            self._q.put(item, block=block, timeout=None if block else 0)
+            return True
+        except queue.Full:
+            self.dropped += 1
+            return False
+
+    def flush(self) -> None:
+        """Barrier: returns once everything enqueued so far is in SQLite."""
+        done = threading.Event()
+        self._q.put(("FLUSH", done))
+        done.wait()
+
+    def close(self) -> None:
+        self.flush()
+        self._stop.set()
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] == "FLUSH":
+                item[1].set()
+                continue
+            orders, updates, fills = item
+            # Coalesce whatever else is already queued into the same txn.
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._storage.apply_batch(orders, updates, fills)
+                    return
+                if isinstance(nxt, tuple) and len(nxt) == 2 and nxt[0] == "FLUSH":
+                    self._storage.apply_batch(orders, updates, fills)
+                    orders, updates, fills = [], [], []
+                    nxt[1].set()
+                    continue
+                orders.extend(nxt[0])
+                updates.extend(nxt[1])
+                fills.extend(nxt[2])
+            if orders or updates or fills:
+                self._storage.apply_batch(orders, updates, fills)

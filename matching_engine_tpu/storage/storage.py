@@ -1,0 +1,289 @@
+"""Durable SQLite store for orders and fills.
+
+Mirrors the reference storage layer's contract (include/storage/storage.hpp,
+src/storage/storage.cpp): WAL journal, synchronous=NORMAL, foreign keys, 5s
+busy timeout, an `orders` table carrying the full status lifecycle plus
+`remaining_quantity`, a `fills` table FK'd to orders, the same indexes, a
+never-throw bool-returning method surface, and order-id sequence recovery
+(MAX over `OID-<n>`).
+
+The reference's dormant-code bugs are fixed, not inherited (SURVEY.md §2.9):
+(a) best_bid/best_ask filter on side=1/2 (the stored encoding), not 0/1;
+(b) add_fill binds every placeholder;
+(c) insert_new_order stores the order's actual type, and MARKET orders store
+    a NULL price (the column is nullable for exactly this reason).
+
+Unlike the reference — where a synchronous insert under the service's global
+mutex IS the engine hot path (SURVEY.md §3.2) — this store sits behind
+AsyncStorageSink off the match path; the device never waits on SQLite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sqlite3
+import threading
+import time
+
+# proto OrderUpdate.Status values (side.py pins the enum layout).
+STATUS_NEW = 0
+STATUS_PARTIALLY_FILLED = 1
+STATUS_FILLED = 2
+STATUS_CANCELED = 3
+STATUS_REJECTED = 4
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS orders (
+    order_id            TEXT PRIMARY KEY,
+    client_id           TEXT NOT NULL,
+    symbol              TEXT NOT NULL,
+    side                INTEGER NOT NULL CHECK (side IN (1, 2)),
+    order_type          INTEGER NOT NULL CHECK (order_type IN (0, 1)),
+    price               INTEGER,            -- Q4; NULL for MARKET orders
+    quantity            INTEGER NOT NULL CHECK (quantity > 0),
+    remaining_quantity  INTEGER NOT NULL CHECK (remaining_quantity >= 0),
+    status              INTEGER NOT NULL CHECK (status BETWEEN 0 AND 4),
+    created_ts          INTEGER NOT NULL,
+    updated_ts          INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_orders_symbol_status ON orders (symbol, status);
+CREATE INDEX IF NOT EXISTS idx_orders_client ON orders (client_id);
+CREATE TABLE IF NOT EXISTS fills (
+    fill_id           INTEGER PRIMARY KEY AUTOINCREMENT,
+    order_id          TEXT NOT NULL REFERENCES orders (order_id),
+    counter_order_id  TEXT NOT NULL,
+    price             INTEGER NOT NULL,   -- Q4 execution (maker) price
+    quantity          INTEGER NOT NULL CHECK (quantity > 0),
+    ts                INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_fills_order ON fills (order_id);
+"""
+
+
+@dataclasses.dataclass(frozen=True)
+class FillRow:
+    order_id: str
+    counter_order_id: str
+    price_q4: int
+    quantity: int
+    ts: int = 0
+
+
+def _now_us() -> int:
+    return time.time_ns() // 1_000
+
+
+class Storage:
+    """Thread-safe (single connection + lock) durable store.
+
+    Write methods catch everything and return bool — a storage failure must
+    degrade to an order reject upstream, never a crash (reference
+    storage.hpp:22 contract).
+    """
+
+    def __init__(self, db_path: str):
+        self.db_path = db_path
+        d = os.path.dirname(db_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._conn = sqlite3.connect(
+            db_path, timeout=5.0, check_same_thread=False, isolation_level=None
+        )
+        self._lock = threading.Lock()
+
+    def init(self) -> bool:
+        try:
+            with self._lock:
+                cur = self._conn
+                cur.execute("PRAGMA journal_mode=WAL")
+                cur.execute("PRAGMA synchronous=NORMAL")
+                cur.execute("PRAGMA foreign_keys=ON")
+                cur.executescript(_SCHEMA)
+            return True
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] init failed: {e}")
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- writes ------------------------------------------------------------
+
+    def insert_new_order(
+        self,
+        order_id: str,
+        client_id: str,
+        symbol: str,
+        side: int,
+        order_type: int,
+        price_q4: int | None,
+        quantity: int,
+        status: int = STATUS_NEW,
+        remaining: int | None = None,
+    ) -> bool:
+        """Insert an accepted order. MARKET orders pass price_q4=None."""
+        ts = _now_us()
+        rem = quantity if remaining is None else remaining
+        try:
+            with self._lock:
+                self._conn.execute(
+                    "INSERT INTO orders (order_id, client_id, symbol, side, "
+                    "order_type, price, quantity, remaining_quantity, status, "
+                    "created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    (order_id, client_id, symbol, side, order_type, price_q4,
+                     quantity, rem, status, ts, ts),
+                )
+            return True
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] insert_new_order({order_id}) failed: {e}")
+            return False
+
+    def update_order_status(self, order_id: str, status: int, remaining: int) -> bool:
+        try:
+            with self._lock:
+                self._conn.execute(
+                    "UPDATE orders SET status = ?, remaining_quantity = ?, "
+                    "updated_ts = ? WHERE order_id = ?",
+                    (status, remaining, _now_us(), order_id),
+                )
+            return True
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] update_order_status({order_id}) failed: {e}")
+            return False
+
+    def add_fill(self, fill: FillRow) -> bool:
+        try:
+            with self._lock:
+                self._conn.execute(
+                    "INSERT INTO fills (order_id, counter_order_id, price, "
+                    "quantity, ts) VALUES (?,?,?,?,?)",
+                    (fill.order_id, fill.counter_order_id, fill.price_q4,
+                     fill.quantity, fill.ts or _now_us()),
+                )
+            return True
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] add_fill({fill.order_id}) failed: {e}")
+            return False
+
+    def apply_batch(self, orders: list[tuple], updates: list[tuple], fills: list[FillRow]) -> bool:
+        """One transaction for a whole engine dispatch (the async sink's unit).
+
+        orders: insert_new_order arg tuples; updates: (order_id, status,
+        remaining) tuples; fills: FillRows.
+        """
+        ts = _now_us()
+        try:
+            with self._lock:
+                self._conn.execute("BEGIN")
+                try:
+                    self._conn.executemany(
+                        "INSERT INTO orders (order_id, client_id, symbol, side, "
+                        "order_type, price, quantity, remaining_quantity, status, "
+                        "created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                        [(*o, ts, ts) for o in orders],
+                    )
+                    self._conn.executemany(
+                        "UPDATE orders SET status = ?, remaining_quantity = ?, "
+                        "updated_ts = ? WHERE order_id = ?",
+                        [(st, rem, ts, oid) for (oid, st, rem) in updates],
+                    )
+                    self._conn.executemany(
+                        "INSERT INTO fills (order_id, counter_order_id, price, "
+                        "quantity, ts) VALUES (?,?,?,?,?)",
+                        [(f.order_id, f.counter_order_id, f.price_q4, f.quantity,
+                          f.ts or ts) for f in fills],
+                    )
+                    self._conn.execute("COMMIT")
+                except Exception:
+                    self._conn.execute("ROLLBACK")
+                    raise
+            return True
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] apply_batch failed: {e}")
+            return False
+
+    # -- reads -------------------------------------------------------------
+
+    def get_order(self, order_id: str):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT order_id, client_id, symbol, side, order_type, price, "
+                "quantity, remaining_quantity, status FROM orders WHERE order_id = ?",
+                (order_id,),
+            ).fetchone()
+        return row
+
+    def open_orders(self, symbol: str | None = None):
+        """Orders with live book presence (NEW / PARTIALLY_FILLED) — the
+        recovery set for book reconstruction after restart."""
+        q = (
+            "SELECT order_id, client_id, symbol, side, order_type, price, "
+            "quantity, remaining_quantity, status FROM orders "
+            "WHERE status IN (?, ?) AND order_type = 0"
+        )
+        args: list = [STATUS_NEW, STATUS_PARTIALLY_FILLED]
+        if symbol is not None:
+            q += " AND symbol = ?"
+            args.append(symbol)
+        # Numeric tiebreak on the OID sequence: ids are TEXT, and coalesced
+        # sink transactions stamp one created_ts for a whole dispatch, so a
+        # lexicographic tiebreak would replay OID-10 before OID-9 and invert
+        # time priority after restart.
+        q += " ORDER BY created_ts, CAST(SUBSTR(order_id, 5) AS INTEGER)"
+        with self._lock:
+            return self._conn.execute(q, args).fetchall()
+
+    def best_bid(self, symbol: str):
+        """(price_q4, total remaining) of the best bid, or None.
+
+        side=1 (BUY) — the stored encoding, fixing the reference's
+        side=0 filter bug (storage.cpp:218)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT price, SUM(remaining_quantity) FROM orders "
+                "WHERE symbol = ? AND side = 1 AND status IN (0, 1) "
+                "AND price IS NOT NULL GROUP BY price "
+                "ORDER BY price DESC LIMIT 1",
+                (symbol,),
+            ).fetchone()
+        return None if row is None or row[0] is None else (row[0], row[1])
+
+    def best_ask(self, symbol: str):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT price, SUM(remaining_quantity) FROM orders "
+                "WHERE symbol = ? AND side = 2 AND status IN (0, 1) "
+                "AND price IS NOT NULL GROUP BY price "
+                "ORDER BY price ASC LIMIT 1",
+                (symbol,),
+            ).fetchone()
+        return None if row is None or row[0] is None else (row[0], row[1])
+
+    def load_next_oid_seq(self) -> int:
+        """Resume the OID-<n> sequence: 1 + MAX(n) over stored ids
+        (reference storage.cpp:254-268)."""
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT MAX(CAST(SUBSTR(order_id, 5) AS INTEGER)) "
+                    "FROM orders WHERE order_id LIKE 'OID-%'"
+                ).fetchone()
+            return 1 if row is None or row[0] is None else int(row[0]) + 1
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] load_next_oid_seq failed: {e}")
+            return 1
+
+    def fills_for_order(self, order_id: str):
+        with self._lock:
+            return self._conn.execute(
+                "SELECT order_id, counter_order_id, price, quantity, ts "
+                "FROM fills WHERE order_id = ? ORDER BY fill_id",
+                (order_id,),
+            ).fetchall()
+
+    def count(self, table: str) -> int:
+        assert table in ("orders", "fills")
+        with self._lock:
+            return self._conn.execute(f"SELECT COUNT(*) FROM {table}").fetchone()[0]

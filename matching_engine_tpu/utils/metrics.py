@@ -1,0 +1,52 @@
+"""Minimal thread-safe metrics registry.
+
+The reference's only observability is per-RPC wall-clock prints
+(matching_engine_service.cpp:46,116-118; SURVEY.md §5.1/5.5). This registry
+backs the GetMetrics RPC and periodic log lines: monotonic counters
+(orders_accepted, fills, ...) and gauges (batch latency EMA, queue depth).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def ema_gauge(self, name: str, value: float, alpha: float = 0.1) -> None:
+        with self._lock:
+            prev = self._gauges.get(name)
+            self._gauges[name] = value if prev is None else alpha * value + (1 - alpha) * prev
+
+    def snapshot(self) -> tuple[dict[str, int], dict[str, float]]:
+        with self._lock:
+            return dict(self._counters), dict(self._gauges)
+
+
+class Timer:
+    """Context manager feeding a microsecond EMA gauge."""
+
+    def __init__(self, metrics: Metrics, gauge: str):
+        self._m = metrics
+        self._g = gauge
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._m.ema_gauge(self._g, (time.perf_counter() - self._t0) * 1e6)
+        return False

@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# End-to-end smoke: spawn the server, fire orders through the real client,
+# pattern-match the output. Bash port of the reference's scripts/smoke.ps1
+# (4 LIMIT BUY submissions at scales 8/9/2/0, grep `accepted order_id=`,
+# kill server) extended with a crossing SELL, a MARKET order, a book query,
+# and a cancel.
+#
+# Usage: scripts/smoke.sh [--tpu]   (default runs on CPU for hermeticity)
+set -u
+
+cd "$(dirname "$0")/.."
+export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
+if [ "${1:-}" != "--tpu" ]; then
+  export JAX_PLATFORMS=cpu
+fi
+
+DB=$(mktemp -d)/smoke.db
+PORT=$(( ( RANDOM % 10000 ) + 40000 ))
+ADDR="127.0.0.1:$PORT"
+
+python -m matching_engine_tpu.server.main --addr "$ADDR" --db "$DB" \
+  --symbols 16 --capacity 32 --batch 4 --window-ms 1 &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null' EXIT
+
+# wait for the port (the reference sleeps 800ms; jit warmup needs longer)
+for i in $(seq 1 120); do
+  python - "$ADDR" <<'EOF' 2>/dev/null && break
+import socket, sys
+host, port = sys.argv[1].rsplit(":", 1)
+s = socket.create_connection((host, int(port)), timeout=0.5); s.close()
+EOF
+  sleep 0.5
+done
+
+PASS=0; FAIL=0
+run_case() {
+  local desc="$1"; shift
+  local want="$1"; shift
+  out=$(python -m matching_engine_tpu.client.cli "$@" 2>&1)
+  if echo "$out" | grep -q "$want"; then
+    echo "PASS: $desc"
+    PASS=$((PASS+1))
+  else
+    echo "FAIL: $desc"
+    echo "  want: $want"
+    echo "  got:  $out"
+    FAIL=$((FAIL+1))
+  fi
+}
+
+# The reference's four scale cases (smoke.ps1:24-27): LIMIT BUYs at scales 8/9/2/0.
+run_case "LIMIT BUY scale 8" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 100500000 8 10
+run_case "LIMIT BUY scale 9" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 1005000000 9 10
+run_case "LIMIT BUY scale 2" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 1005 2 10
+run_case "LIMIT BUY scale 0" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 10 0 10
+
+# Beyond the reference: real matching.
+run_case "crossing SELL fills" "accepted order_id=" "$ADDR" c2 SYM SELL LIMIT 1005 2 15
+run_case "MARKET SELL" "accepted order_id=" "$ADDR" c2 SYM SELL MARKET 0 0 5
+run_case "book query" "book SYM" book "$ADDR" SYM
+run_case "reject bad qty" "rejected" "$ADDR" c1 SYM BUY LIMIT 1005 2 0
+run_case "cancel unknown" "cancel rejected" cancel "$ADDR" c1 OID-999
+
+# Out-of-band DB assert (the reference pattern, scripted).
+sleep 0.5
+ORDERS=$(python -c "
+import sqlite3
+c = sqlite3.connect('$DB')
+print(c.execute('SELECT COUNT(*) FROM orders').fetchone()[0])
+")
+FILLS=$(python -c "
+import sqlite3
+c = sqlite3.connect('$DB')
+print(c.execute('SELECT COUNT(*) FROM fills').fetchone()[0])
+")
+if [ "$ORDERS" -eq 6 ] && [ "$FILLS" -ge 2 ]; then
+  echo "PASS: DB has $ORDERS orders, $FILLS fills"
+  PASS=$((PASS+1))
+else
+  echo "FAIL: DB has $ORDERS orders (want 6), $FILLS fills (want >=2)"
+  FAIL=$((FAIL+1))
+fi
+
+kill $SERVER_PID 2>/dev/null
+wait $SERVER_PID 2>/dev/null
+trap - EXIT
+
+echo "smoke: $PASS passed, $FAIL failed"
+[ "$FAIL" -eq 0 ]

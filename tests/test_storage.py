@@ -1,0 +1,122 @@
+"""Storage layer: schema semantics, id recovery, async sink, fixed ref bugs."""
+
+import os
+
+import pytest
+
+from matching_engine_tpu.storage import AsyncStorageSink, FillRow, Storage
+from matching_engine_tpu.storage.storage import (
+    STATUS_FILLED,
+    STATUS_NEW,
+    STATUS_PARTIALLY_FILLED,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = Storage(str(tmp_path / "me.db"))
+    assert s.init()
+    yield s
+    s.close()
+
+
+def test_insert_and_get(store):
+    assert store.insert_new_order("OID-1", "c1", "SYM", 1, 0, 10050, 5)
+    row = store.get_order("OID-1")
+    assert row == ("OID-1", "c1", "SYM", 1, 0, 10050, 5, 5, STATUS_NEW)
+
+
+def test_market_order_stores_null_price(store):
+    # Fixes reference bug (c): MARKET price is NULL, and the actual
+    # order_type is stored (storage.cpp:106-107 hardcoded type, kept price).
+    assert store.insert_new_order("OID-1", "c1", "SYM", 2, 1, None, 5)
+    row = store.get_order("OID-1")
+    assert row[4] == 1 and row[5] is None
+
+
+def test_best_bid_ask_use_stored_side_encoding(store):
+    # Fixes reference bug (a): side filters are 1/2, matching what inserts
+    # store (storage.cpp:218,239 filtered 0/1 and always returned empty).
+    store.insert_new_order("OID-1", "c1", "SYM", 1, 0, 10000, 5)
+    store.insert_new_order("OID-2", "c1", "SYM", 1, 0, 10100, 3)
+    store.insert_new_order("OID-3", "c1", "SYM", 2, 0, 10200, 2)
+    store.insert_new_order("OID-4", "c2", "SYM", 1, 0, 10100, 4)
+    assert store.best_bid("SYM") == (10100, 7)
+    assert store.best_ask("SYM") == (10200, 2)
+    assert store.best_bid("OTHER") is None
+
+
+def test_add_fill_and_read_back(store):
+    # Fixes reference bug (b): add_fill binds all placeholders
+    # (storage.cpp:189-196 skipped index 4 and always threw).
+    store.insert_new_order("OID-1", "c1", "SYM", 1, 0, 10000, 5)
+    assert store.add_fill(FillRow("OID-1", "OID-9", 10000, 5))
+    rows = store.fills_for_order("OID-1")
+    assert len(rows) == 1 and rows[0][:4] == ("OID-1", "OID-9", 10000, 5)
+
+
+def test_fill_requires_existing_order(store):
+    # FK enforcement: a fill for an unknown order is refused, not crashed.
+    assert not store.add_fill(FillRow("OID-404", "OID-9", 10000, 5))
+
+
+def test_status_update(store):
+    store.insert_new_order("OID-1", "c1", "SYM", 1, 0, 10000, 5)
+    assert store.update_order_status("OID-1", STATUS_PARTIALLY_FILLED, 2)
+    row = store.get_order("OID-1")
+    assert row[7] == 2 and row[8] == STATUS_PARTIALLY_FILLED
+
+
+def test_oid_sequence_recovery(tmp_path):
+    path = str(tmp_path / "me.db")
+    s = Storage(path)
+    s.init()
+    assert s.load_next_oid_seq() == 1
+    s.insert_new_order("OID-41", "c", "S", 1, 0, 1, 1)
+    s.insert_new_order("OID-7", "c", "S", 1, 0, 1, 1)
+    s.close()
+    # Fresh process: sequence resumes from MAX.
+    s2 = Storage(path)
+    s2.init()
+    assert s2.load_next_oid_seq() == 42
+    s2.close()
+
+
+def test_open_orders_recovery_set(store):
+    store.insert_new_order("OID-1", "c", "S", 1, 0, 100, 5)                      # NEW
+    store.insert_new_order("OID-2", "c", "S", 1, 0, 100, 5, status=STATUS_FILLED, remaining=0)
+    store.insert_new_order("OID-3", "c", "S", 2, 0, 100, 5, status=STATUS_PARTIALLY_FILLED, remaining=2)
+    store.insert_new_order("OID-4", "c", "S", 1, 1, None, 5, status=STATUS_FILLED, remaining=0)
+    rows = store.open_orders("S")
+    assert [r[0] for r in rows] == ["OID-1", "OID-3"]
+
+
+def test_duplicate_order_id_rejected(store):
+    assert store.insert_new_order("OID-1", "c", "S", 1, 0, 100, 5)
+    assert not store.insert_new_order("OID-1", "c", "S", 1, 0, 100, 5)
+
+
+def test_async_sink_batches_and_flushes(store):
+    sink = AsyncStorageSink(store)
+    for i in range(50):
+        sink.submit(
+            orders=[(f"OID-{i}", "c", "S", 1, 0, 100, 5, 5, STATUS_NEW)],
+            fills=[FillRow(f"OID-{i}", "OID-X", 100, 5)] if i % 2 == 0 else [],
+        )
+    sink.flush()
+    assert store.count("orders") == 50
+    assert store.count("fills") == 25
+    sink.close()
+
+
+def test_async_sink_transaction_per_batch(store):
+    sink = AsyncStorageSink(store)
+    sink.submit(
+        orders=[("OID-1", "c", "S", 1, 0, 100, 5, 5, STATUS_NEW)],
+        updates=[("OID-1", STATUS_FILLED, 0)],
+        fills=[FillRow("OID-1", "OID-2", 100, 5)],
+    )
+    sink.flush()
+    assert store.get_order("OID-1")[8] == STATUS_FILLED
+    assert len(store.fills_for_order("OID-1")) == 1
+    sink.close()
