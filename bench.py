@@ -1,0 +1,96 @@
+"""Benchmark: sustained match-engine throughput on the attached accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md — its matching core
+is an empty file and its hot path is one SQLite INSERT under a global mutex),
+so vs_baseline is measured against this repo's north-star target of 10M
+orders/sec (BASELINE.json) rather than a reference figure.
+
+Method: steady-state device throughput of the jit'd engine step — a realistic
+mixed stream (limit adds that rest, crossing limits, markets, cancels) is
+pre-built into [S, B] dispatches, then K steps run back-to-back with the book
+donated in HBM (no host round-trip of book state), timed end to end with
+block_until_ready. orders/sec counts real (non-padding) ops.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import jax
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import HostOrder, build_batches
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT, engine_step
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+NORTH_STAR = 10_000_000  # orders/sec, BASELINE.json
+
+
+def _mixed_stream(cfg: EngineConfig, n: int, seed: int = 0) -> list[HostOrder]:
+    rng = random.Random(seed)
+    orders = []
+    live: list[tuple[int, int, int]] = []
+    for oid in range(1, n + 1):
+        sym = rng.randrange(cfg.num_symbols)
+        if live and rng.random() < 0.10:
+            s, side, target = live.pop(rng.randrange(len(live)))
+            orders.append(HostOrder(sym=s, op=OP_CANCEL, side=side, oid=target))
+            continue
+        side = rng.choice((BUY, SELL))
+        otype = MARKET if rng.random() < 0.15 else LIMIT
+        price = 0 if otype == MARKET else rng.randrange(9_950, 10_050)
+        orders.append(HostOrder(
+            sym=sym, op=OP_SUBMIT, side=side, otype=otype,
+            price=price, qty=rng.randrange(1, 100), oid=oid,
+        ))
+        if otype == LIMIT and rng.random() < 0.6:
+            live.append((sym, side, oid))
+    return orders
+
+
+def main() -> None:
+    cfg = EngineConfig(num_symbols=1024, capacity=128, batch=16, max_fills=1 << 17)
+    n_orders_per_wave = cfg.num_symbols * cfg.batch
+
+    # Build a handful of full dispatches; cycle them during the timed loop.
+    # (Each wave is dense: every [S, B] slot is a real op.)
+    waves = []
+    for w in range(4):
+        stream = _mixed_stream(cfg, 4 * n_orders_per_wave, seed=w)
+        batches = build_batches(cfg, stream)
+        # Keep only dense-enough leading dispatches.
+        waves.extend(jax.device_put(b) for b in batches[:2])
+
+    book = init_book(cfg)
+    # Warmup: compile + one pass over every wave shape.
+    book, out = engine_step(cfg, book, waves[0])
+    jax.block_until_ready(out)
+
+    iters = 60
+    t0 = time.perf_counter()
+    for i in range(iters):
+        book, out = engine_step(cfg, book, waves[i % len(waves)])
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    import numpy as np
+
+    real_ops = sum(
+        int(np.count_nonzero(np.asarray(waves[i % len(waves)].op)))
+        for i in range(iters)
+    )
+    value = real_ops / dt
+    print(json.dumps({
+        "metric": "match_throughput",
+        "value": round(value, 1),
+        "unit": "orders/sec",
+        "vs_baseline": round(value / NORTH_STAR, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

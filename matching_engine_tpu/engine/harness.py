@@ -80,13 +80,11 @@ def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch
     return out
 
 
-def decode_step(
-    cfg: EngineConfig, batch: OrderBatch, out: StepOutput
-) -> tuple[list[HostResult], list[HostFill], bool]:
-    """Decode one StepOutput into per-order results + the fill log."""
-    status = np.asarray(out.status)
-    filled = np.asarray(out.filled)
-    remaining = np.asarray(out.remaining)
+def decode_results(batch: OrderBatch, status, filled, remaining) -> list[HostResult]:
+    """Per-order outcomes for the real (non-padding) rows of one dispatch."""
+    status = np.asarray(status)
+    filled = np.asarray(filled)
+    remaining = np.asarray(remaining)
     op = np.asarray(batch.op)
     oid = np.asarray(batch.oid)
 
@@ -102,6 +100,14 @@ def decode_step(
                 remaining=int(remaining[s_i, b_i]),
             )
         )
+    return results
+
+
+def decode_step(
+    cfg: EngineConfig, batch: OrderBatch, out: StepOutput
+) -> tuple[list[HostResult], list[HostFill], bool]:
+    """Decode one StepOutput into per-order results + the fill log."""
+    results = decode_results(batch, out.status, out.filled, out.remaining)
 
     # One bulk device->host transfer per array; per-element indexing of jax
     # arrays would dispatch a device gather per int.

@@ -31,7 +31,6 @@ docstring); statuses use proto OrderUpdate.Status values.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -216,13 +215,10 @@ def _top_of_book(price, qty, best_is_max):
     return best.astype(I32), size.astype(I32)
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=1)
-def engine_step(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
-    """Apply one [S, B] order dispatch to all books. Returns (book', StepOutput).
-
-    The book argument is donated: the update is in-place in HBM, the book
-    never round-trips to host (SURVEY.md §7 "Host<->device pipeline").
-    """
+def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
+    """Un-jitted engine step body (shared by the jit'd single-device entry
+    point below and the shard_map-wrapped multi-chip step in
+    parallel/sharding.py, where each shard runs this on its symbol slice)."""
     sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
     # vmap over the symbol axis; scan over the batch axis inside.
     new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(
@@ -265,3 +261,9 @@ def engine_step(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
         ask_size=ask_size,
     )
     return new_book, out
+
+
+# Single-device entry point. The book argument is donated: the update is
+# in-place in HBM, the book never round-trips to host (SURVEY.md §7
+# "Host<->device pipeline").
+engine_step = jax.jit(engine_step_impl, static_argnums=0, donate_argnums=1)

@@ -1,0 +1,17 @@
+"""Multi-chip scale-out: symbol-sharded engine over a jax.sharding.Mesh.
+
+The reference has no distributed plane at all (SURVEY.md §2 "Parallelism /
+distributed-communication components: NONE"); its scaling ceiling is a global
+mutex around SQLite. This package is the TPU-native equivalent the survey
+specifies (§5.7-5.8): books sharded over the symbol axis of a device mesh,
+the match step run per-shard under shard_map, and top-of-book published
+across chips with XLA collectives over ICI.
+"""
+
+from matching_engine_tpu.parallel.sharding import (
+    ShardedEngine,
+    ShardedStepOutput,
+    make_mesh,
+)
+
+__all__ = ["ShardedEngine", "ShardedStepOutput", "make_mesh"]

@@ -1,0 +1,225 @@
+"""Symbol-sharded engine step: shard_map over a device mesh.
+
+Design (SURVEY.md §5.7-5.8): the symbol axis is this domain's scaling axis —
+books are independent per symbol, so the natural mesh layout shards every
+[S, ...] array on axis 0 over a 1-D mesh axis ``"sym"``. Each chip runs the
+*same* jit'd match step (engine/kernel.py:engine_step_impl) on its local
+symbol slice; no collective is needed inside the match itself (books never
+interact), which is exactly why this maps perfectly onto SPMD. Collectives
+only appear at the edges:
+
+- fill logs and top-of-book stay device-sharded; the host reads per-shard
+  segments directly (one transfer per array, already compacted per shard),
+- ``all_top_of_book`` demonstrates the ICI publication path: an
+  ``all_gather`` over the mesh axis so *every* chip holds the full market
+  picture (what a cross-symbol risk check or market-data fanout would read).
+
+The reference's analogous layer simply does not exist — its only
+"communication backend" is client-facing gRPC (SURVEY.md §5.8); there is no
+server-to-server plane to port, so this module is designed TPU-first from
+the north star rather than translated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+    init_book,
+)
+from matching_engine_tpu.engine.harness import HostFill, HostResult, decode_results
+from matching_engine_tpu.engine.kernel import engine_step_impl
+
+AXIS = "sym"
+
+
+class ShardedStepOutput(NamedTuple):
+    """Per-step results with fill logs kept per-shard.
+
+    Identical to engine.book.StepOutput except the fill log is the
+    concatenation of each shard's compacted buffer: fill arrays are
+    [n_shards * max_fills], and fill_count / fill_overflow are [n_shards]
+    (shard i's valid rows are [i * max_fills, i * max_fills + count[i])).
+    fill_sym is already globalized (local slot + shard offset).
+    """
+
+    status: jax.Array
+    filled: jax.Array
+    remaining: jax.Array
+    fill_sym: jax.Array
+    fill_taker_oid: jax.Array
+    fill_maker_oid: jax.Array
+    fill_price: jax.Array
+    fill_qty: jax.Array
+    fill_count: jax.Array
+    fill_overflow: jax.Array
+    best_bid: jax.Array
+    bid_size: jax.Array
+    best_ask: jax.Array
+    ask_size: jax.Array
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the symbol axis. Defaults to every visible device."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested {n_devices} devices, only {len(devices)} visible"
+                )
+            devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices).reshape(-1), (AXIS,))
+
+
+def _book_specs() -> BookBatch:
+    lane = P(AXIS, None)
+    return BookBatch(
+        bid_price=lane, bid_qty=lane, bid_oid=lane, bid_seq=lane,
+        ask_price=lane, ask_qty=lane, ask_oid=lane, ask_seq=lane,
+        next_seq=P(AXIS),
+    )
+
+
+def _order_specs() -> OrderBatch:
+    lane = P(AXIS, None)
+    return OrderBatch(op=lane, side=lane, otype=lane, price=lane, qty=lane, oid=lane)
+
+
+def _out_specs() -> ShardedStepOutput:
+    return ShardedStepOutput(
+        status=P(AXIS, None), filled=P(AXIS, None), remaining=P(AXIS, None),
+        fill_sym=P(AXIS), fill_taker_oid=P(AXIS), fill_maker_oid=P(AXIS),
+        fill_price=P(AXIS), fill_qty=P(AXIS),
+        fill_count=P(AXIS), fill_overflow=P(AXIS),
+        best_bid=P(AXIS), bid_size=P(AXIS), best_ask=P(AXIS), ask_size=P(AXIS),
+    )
+
+
+class ShardedEngine:
+    """Owns the sharded step function + sharded book placement for one mesh.
+
+    Usage:
+        eng = ShardedEngine(cfg, mesh)
+        book = eng.init_book()                 # device-sharded
+        book, out = eng.step(book, orders)     # donated, stays sharded
+        results, fills, overflow = eng.decode(orders, out)
+    """
+
+    def __init__(self, cfg: EngineConfig, mesh: Mesh):
+        n = mesh.devices.size
+        if cfg.num_symbols % n != 0:
+            raise ValueError(
+                f"num_symbols={cfg.num_symbols} not divisible by mesh size {n}"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_shards = n
+        self.local_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // n)
+        self.book_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), _book_specs()
+        )
+        self.order_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), _order_specs()
+        )
+
+        local_cfg = self.local_cfg
+        local_s = local_cfg.num_symbols
+
+        def local_step(book: BookBatch, orders: OrderBatch):
+            new_book, out = engine_step_impl(local_cfg, book, orders)
+            # Globalize fill symbol slots: local index + this shard's offset.
+            off = jax.lax.axis_index(AXIS).astype(I32) * local_s
+            fill_sym = jnp.where(out.fill_qty > 0, out.fill_sym + off, 0)
+            return new_book, ShardedStepOutput(
+                status=out.status, filled=out.filled, remaining=out.remaining,
+                fill_sym=fill_sym,
+                fill_taker_oid=out.fill_taker_oid,
+                fill_maker_oid=out.fill_maker_oid,
+                fill_price=out.fill_price, fill_qty=out.fill_qty,
+                fill_count=out.fill_count.reshape(1),
+                fill_overflow=out.fill_overflow.reshape(1),
+                best_bid=out.best_bid, bid_size=out.bid_size,
+                best_ask=out.best_ask, ask_size=out.ask_size,
+            )
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(_book_specs(), _order_specs()),
+            out_specs=(_book_specs(), _out_specs()),
+        )
+        self.step = jax.jit(mapped, donate_argnums=0)
+
+        def gather_tob(bb, bs, ba, as_):
+            f = jax.shard_map(
+                lambda *xs: tuple(
+                    jax.lax.all_gather(x, AXIS, tiled=True) for x in xs
+                ),
+                mesh=mesh,
+                in_specs=(P(AXIS),) * 4,
+                out_specs=(P(),) * 4,
+                # all_gather output is identical on every shard by
+                # construction; VMA inference can't see that through the
+                # tiled gather, so assert it manually.
+                check_vma=False,
+            )
+            return f(bb, bs, ba, as_)
+
+        # ICI publication path: every chip ends up with the full [S] arrays.
+        self.all_top_of_book = jax.jit(gather_tob)
+
+    def init_book(self) -> BookBatch:
+        return jax.device_put(init_book(self.cfg), self.book_sharding)
+
+    def place_orders(self, orders: OrderBatch) -> OrderBatch:
+        return jax.device_put(orders, self.order_sharding)
+
+    def decode(
+        self, batch: OrderBatch, out: ShardedStepOutput
+    ) -> tuple[list[HostResult], list[HostFill], bool]:
+        """Decode per-order results + the per-shard fill segments."""
+        import numpy as np
+
+        results = decode_results(batch, out.status, out.filled, out.remaining)
+
+        # Slice each shard's valid segment on device, then transfer — the
+        # device->host cost is O(actual fills), not O(n_shards * max_fills).
+        counts = np.asarray(out.fill_count)
+        per = self.cfg.max_fills
+        fills = []
+        for shard in range(self.n_shards):
+            base = shard * per
+            n = int(counts[shard])
+            if n == 0:
+                continue
+            f_sym = np.asarray(out.fill_sym[base:base + n])
+            f_taker = np.asarray(out.fill_taker_oid[base:base + n])
+            f_maker = np.asarray(out.fill_maker_oid[base:base + n])
+            f_price = np.asarray(out.fill_price[base:base + n])
+            f_qty = np.asarray(out.fill_qty[base:base + n])
+            for i in range(n):
+                fills.append(
+                    HostFill(
+                        sym=int(f_sym[i]),
+                        taker_oid=int(f_taker[i]),
+                        maker_oid=int(f_maker[i]),
+                        price_q4=int(f_price[i]),
+                        quantity=int(f_qty[i]),
+                    )
+                )
+        overflow = bool(np.asarray(out.fill_overflow).any())
+        return results, fills, overflow

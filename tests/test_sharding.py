@@ -1,0 +1,130 @@
+"""Multi-chip parity: the shard_map'd engine vs single-device vs oracle.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py). The symbol-sharded
+step must produce bit-identical statuses, fills, and resting books to the
+single-device kernel — sharding is a layout choice, never a semantics choice.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    build_batches,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT
+from matching_engine_tpu.parallel import ShardedEngine, make_mesh
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+
+def _random_stream(cfg, n, seed=0):
+    rng = random.Random(seed)
+    orders = []
+    live = []  # (sym, side, oid) of possibly-resting orders
+    for oid in range(1, n + 1):
+        sym = rng.randrange(cfg.num_symbols)
+        if live and rng.random() < 0.15:
+            s, side, target = live.pop(rng.randrange(len(live)))
+            orders.append(HostOrder(sym=s, op=OP_CANCEL, side=side, oid=target))
+            continue
+        side = rng.choice((BUY, SELL))
+        otype = MARKET if rng.random() < 0.2 else LIMIT
+        price = 0 if otype == MARKET else rng.randrange(9_900, 10_100)
+        orders.append(
+            HostOrder(
+                sym=sym, op=OP_SUBMIT, side=side, otype=otype,
+                price=price, qty=rng.randrange(1, 50), oid=oid,
+            )
+        )
+        if otype == LIMIT:
+            live.append((sym, side, oid))
+    return orders
+
+
+def _run_sharded(cfg, mesh, host_orders):
+    eng = ShardedEngine(cfg, mesh)
+    book = eng.init_book()
+    results, fills = [], []
+    for batch in build_batches(cfg, host_orders):
+        batch = eng.place_orders(batch)
+        book, out = eng.step(book, batch)
+        r, f, overflow = eng.decode(batch, out)
+        assert not overflow
+        results.extend(r)
+        fills.extend(f)
+    # Pull the sharded book back to host for snapshot comparison.
+    host_book = jax.tree.map(np.asarray, book)
+    return results, fills, snapshot_books(host_book), out
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def test_sharded_matches_single_device(mesh8):
+    cfg = EngineConfig(num_symbols=16, capacity=32, batch=4, max_fills=256)
+    orders = _random_stream(cfg, 400, seed=7)
+
+    book = init_book(cfg)
+    book, s_results, s_fills = apply_orders(cfg, book, orders)
+    s_snaps = snapshot_books(book)
+
+    d_results, d_fills, d_snaps, _ = _run_sharded(cfg, mesh8, orders)
+
+    key = lambda r: (r.oid, r.sym, r.status, r.filled, r.remaining)
+    assert sorted(map(key, d_results)) == sorted(map(key, s_results))
+    fkey = lambda f: (f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+    # Per symbol, fills must match exactly in order.
+    for s in range(cfg.num_symbols):
+        assert [fkey(f) for f in d_fills if f.sym == s] == [
+            fkey(f) for f in s_fills if f.sym == s
+        ], f"fill mismatch sym {s}"
+    assert d_snaps == s_snaps
+
+
+def test_sharded_top_of_book_gather(mesh8):
+    cfg = EngineConfig(num_symbols=8, capacity=8, batch=2, max_fills=64)
+    eng = ShardedEngine(cfg, mesh8)
+    book = eng.init_book()
+    orders = [
+        HostOrder(sym=s, op=OP_SUBMIT, side=BUY, otype=LIMIT,
+                  price=1000 + s, qty=5, oid=s + 1)
+        for s in range(cfg.num_symbols)
+    ]
+    for batch in build_batches(cfg, orders):
+        book, out = eng.step(book, eng.place_orders(batch))
+    bb, bs, ba, as_ = eng.all_top_of_book(
+        out.best_bid, out.bid_size, out.best_ask, out.ask_size
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bb), np.arange(1000, 1000 + cfg.num_symbols, dtype=np.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(bs), np.full(cfg.num_symbols, 5))
+    np.testing.assert_array_equal(np.asarray(ba), np.zeros(cfg.num_symbols))
+
+
+def test_sharded_book_stays_sharded(mesh8):
+    cfg = EngineConfig(num_symbols=8, capacity=8, batch=2, max_fills=64)
+    eng = ShardedEngine(cfg, mesh8)
+    book = eng.init_book()
+    batch = eng.place_orders(build_batches(
+        cfg, [HostOrder(sym=0, op=OP_SUBMIT, side=BUY, otype=LIMIT,
+                        price=100, qty=1, oid=1)]
+    )[0])
+    book, _ = eng.step(book, batch)
+    # The updated book must still live sharded across all 8 devices.
+    shards = book.bid_qty.sharding.device_set
+    assert len(shards) == 8
+
+
+def test_mesh_size_must_divide_symbols(mesh8):
+    with pytest.raises(ValueError):
+        ShardedEngine(EngineConfig(num_symbols=12), mesh8)
