@@ -17,39 +17,15 @@ block_until_ready. orders/sec counts real (non-padding) ops.
 from __future__ import annotations
 
 import json
-import random
 import time
 
 import jax
 
 from matching_engine_tpu.engine.book import EngineConfig, init_book
-from matching_engine_tpu.engine.harness import HostOrder, build_batches
-from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT, engine_step
-from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+from matching_engine_tpu.engine.harness import build_batches, random_order_stream
+from matching_engine_tpu.engine.kernel import engine_step
 
 NORTH_STAR = 10_000_000  # orders/sec, BASELINE.json
-
-
-def _mixed_stream(cfg: EngineConfig, n: int, seed: int = 0) -> list[HostOrder]:
-    rng = random.Random(seed)
-    orders = []
-    live: list[tuple[int, int, int]] = []
-    for oid in range(1, n + 1):
-        sym = rng.randrange(cfg.num_symbols)
-        if live and rng.random() < 0.10:
-            s, side, target = live.pop(rng.randrange(len(live)))
-            orders.append(HostOrder(sym=s, op=OP_CANCEL, side=side, oid=target))
-            continue
-        side = rng.choice((BUY, SELL))
-        otype = MARKET if rng.random() < 0.15 else LIMIT
-        price = 0 if otype == MARKET else rng.randrange(9_950, 10_050)
-        orders.append(HostOrder(
-            sym=sym, op=OP_SUBMIT, side=side, otype=otype,
-            price=price, qty=rng.randrange(1, 100), oid=oid,
-        ))
-        if otype == LIMIT and rng.random() < 0.6:
-            live.append((sym, side, oid))
-    return orders
 
 
 def main() -> None:
@@ -60,7 +36,11 @@ def main() -> None:
     # (Each wave is dense: every [S, B] slot is a real op.)
     waves = []
     for w in range(4):
-        stream = _mixed_stream(cfg, 4 * n_orders_per_wave, seed=w)
+        stream = random_order_stream(
+            cfg.num_symbols, 4 * n_orders_per_wave, seed=w, cancel_p=0.10,
+            market_p=0.15, price_base=9_950, price_levels=100, price_step=1,
+            qty_max=100,
+        )
         batches = build_batches(cfg, stream)
         # Keep only dense-enough leading dispatches.
         waves.extend(jax.device_put(b) for b in batches[:2])

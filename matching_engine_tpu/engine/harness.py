@@ -145,6 +145,62 @@ def apply_orders(
     return book, results, fills
 
 
+def random_order_stream(
+    num_symbols: int,
+    n_ops: int,
+    seed: int = 0,
+    *,
+    cancel_p: float = 0.15,
+    market_p: float = 0.2,
+    price_base: int = 10_000,
+    price_levels: int = 12,
+    price_step: int = 100,
+    qty_max: int = 20,
+) -> list[HostOrder]:
+    """Deterministic mixed op stream (limit/market submits + cancels).
+
+    The one generator behind the parity tests, the sharding tests, and the
+    benchmark, so they all exercise the same op mix. Cancels target
+    previously submitted LIMIT orders (which may or may not still rest —
+    canceling a filled order is a REJECTED cancel on both sides of every
+    parity check). Oids are 1-based and assigned to submits only.
+    """
+    import random
+
+    from matching_engine_tpu.engine.kernel import (
+        BUY,
+        LIMIT,
+        MARKET,
+        OP_CANCEL,
+        OP_SUBMIT,
+        SELL,
+    )
+
+    rng = random.Random(seed)
+    orders: list[HostOrder] = []
+    live_by_sym: list[dict[int, int]] = [dict() for _ in range(num_symbols)]
+    oid = 0
+    for _ in range(n_ops):
+        sym = rng.randrange(num_symbols)
+        if live_by_sym[sym] and rng.random() < cancel_p:
+            target = rng.choice(list(live_by_sym[sym]))
+            side = live_by_sym[sym].pop(target)
+            orders.append(HostOrder(sym, OP_CANCEL, side, oid=target))
+            continue
+        oid += 1
+        side = rng.choice((BUY, SELL))
+        otype = MARKET if rng.random() < market_p else LIMIT
+        price = (
+            0 if otype == MARKET
+            else price_base + price_step * rng.randrange(price_levels)
+        )
+        qty = rng.randrange(1, qty_max)
+        orders.append(HostOrder(sym, OP_SUBMIT, side, otype, price, qty, oid=oid))
+        if otype == LIMIT:
+            live_by_sym[sym][oid] = side
+    return orders
+
+
 def snapshot_books(book: BookBatch):
     """Decode device books to the oracle's snapshot format.
 

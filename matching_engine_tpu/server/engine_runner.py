@@ -91,6 +91,9 @@ class EngineRunner:
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
+        # Held for a FULL dispatch (device step + host directory mutation);
+        # checkpointing acquires it to get an untorn book+directory snapshot.
+        self._dispatch_lock = threading.Lock()
         self._id_lock = threading.Lock()  # oid/symbol assignment from RPC threads
         self.book = init_book(cfg)
         # Directories (host truth mirroring device state).
@@ -129,6 +132,10 @@ class EngineRunner:
 
     def run_dispatch(self, ops: list[EngineOp]) -> DispatchResult:
         """Apply ops to the device books and decode all consequences."""
+        with self._dispatch_lock:
+            return self._run_dispatch_locked(ops)
+
+    def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
         host_orders = []
         by_oid: dict[int, EngineOp] = {}
         for e in ops:

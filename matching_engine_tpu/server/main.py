@@ -28,6 +28,11 @@ from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, Ord
 from matching_engine_tpu.server.service import MatchingEngineService
 from matching_engine_tpu.server.streams import StreamHub
 from matching_engine_tpu.storage import AsyncStorageSink, Storage
+from matching_engine_tpu.utils.checkpoint import (
+    CheckpointDaemon,
+    latest_checkpoint,
+    restore_runner,
+)
 from matching_engine_tpu.utils.metrics import Metrics
 
 
@@ -67,6 +72,8 @@ def build_server(
     window_ms: float = 2.0,
     rpc_workers: int = 32,
     log: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict)."""
     storage = Storage(db_path)
@@ -75,11 +82,30 @@ def build_server(
 
     metrics = Metrics()
     runner = EngineRunner(cfg, metrics)
-    recovered = recover_books(runner, storage)
-    if recovered and log:
-        print(f"[SERVER] recovered {recovered} open orders into device books")
+    # Fast path: restore the newest device-book snapshot and replay only the
+    # post-snapshot delta from SQLite; fall back to full replay.
+    ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
+    if ckpt is not None:
+        try:
+            replayed = restore_runner(runner, ckpt, storage)
+            if log:
+                print(f"[SERVER] restored {ckpt} (+{replayed} reconcile ops)")
+        except Exception as e:  # any corrupt/skewed checkpoint -> full replay
+            print(f"[SERVER] checkpoint restore failed "
+                  f"({type(e).__name__}: {e}); full replay")
+            runner = EngineRunner(cfg, metrics)
+            ckpt = None
+    if ckpt is None:
+        recovered = recover_books(runner, storage)
+        if recovered and log:
+            print(f"[SERVER] recovered {recovered} open orders into device books")
 
     sink = AsyncStorageSink(storage)
+    checkpointer = None
+    if checkpoint_dir:
+        checkpointer = CheckpointDaemon(
+            runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s
+        ).start()
     hub = StreamHub()
     dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
     service = MatchingEngineService(runner, dispatcher, hub, metrics, log=log)
@@ -93,7 +119,7 @@ def build_server(
     parts = {
         "storage": storage, "sink": sink, "hub": hub,
         "dispatcher": dispatcher, "runner": runner, "service": service,
-        "metrics": metrics,
+        "metrics": metrics, "checkpointer": checkpointer,
     }
     return server, port, parts
 
@@ -104,6 +130,12 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     server.stop(grace_s).wait()
     parts["hub"].close_all()
     parts["dispatcher"].close()
+    if parts.get("checkpointer") is not None:
+        try:
+            parts["checkpointer"].checkpoint_now()
+        except Exception as e:  # a failed final snapshot must not block drain
+            print(f"[SERVER] final checkpoint failed: {type(e).__name__}: {e}")
+        parts["checkpointer"].close()
     parts["sink"].close()
     parts["storage"].close()
 
@@ -117,6 +149,9 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8, help="orders per symbol per dispatch")
     p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
     p.add_argument("--rpc-workers", type=int, default=32)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable periodic device-book checkpoints here")
+    p.add_argument("--checkpoint-interval-s", type=float, default=30.0)
     args = p.parse_args(argv)
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
@@ -124,6 +159,8 @@ def main(argv=None) -> int:
         server, port, parts = build_server(
             args.addr, args.db, cfg, window_ms=args.window_ms,
             rpc_workers=args.rpc_workers,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_interval_s=args.checkpoint_interval_s,
         )
     except SystemExit as e:
         return int(e.code or 3)

@@ -1,0 +1,268 @@
+"""Checkpoint/restore of device book state + host directories.
+
+The reference's only "checkpoint" is SQLite itself: restart reseeds the OID
+sequence and (in intent, never in code) the book would be rebuilt from
+`orders WHERE status IN (0,1)` (SURVEY.md §5.4). This framework keeps that
+full-replay recovery path (server/main.py:recover_books) and adds what the
+survey's TPU plan specifies on top: periodic snapshots of the device book so
+restart cost is O(book size), not O(order history).
+
+Format: one directory per checkpoint, written atomically (tmp dir + rename):
+    book.npz   — the BookBatch arrays (host copies)
+    meta.json  — engine config, symbol directory, open-order directory,
+                 next OID, wall timestamp
+
+Consistency: `snapshot()` must be called at a dispatch boundary with the
+storage sink flushed (CheckpointDaemon does both), so the snapshot and
+SQLite describe the same engine time. On restore, `reconcile` replays
+anything SQLite knows that the snapshot predates:
+
+- DB-open orders missing from the snapshot -> submitted (they arrived after
+  the snapshot; back-of-queue priority is their true priority),
+- snapshot orders the DB has since closed or partially filled -> canceled on
+  device, and resubmitted with the DB's remaining quantity when still open.
+  A post-snapshot partial fill therefore costs that order its queue position
+  on recovery — documented recovery semantics, bounded by checkpoint cadence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import time
+
+import jax
+import numpy as np
+
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    FILLED,
+    NEW,
+    OP_CANCEL,
+    OP_SUBMIT,
+    PARTIALLY_FILLED,
+    REJECTED,
+)
+
+_BOOK_FIELDS = BookBatch._fields
+
+
+def save_checkpoint(path: str, runner) -> None:
+    """Atomically write one checkpoint of `runner` (an EngineRunner).
+
+    Caller is responsible for quiescence (no concurrent dispatch) — use
+    CheckpointDaemon or hold the runner's snapshot lock externally.
+    """
+    book_host = {f: np.asarray(getattr(runner.book, f)) for f in _BOOK_FIELDS}
+    meta = {
+        "version": 1,
+        "ts": time.time(),
+        "cfg": dataclasses.asdict(runner.cfg),
+        "symbols": runner.symbols,
+        "next_oid_num": runner.next_oid_num,
+        "orders": [dataclasses.asdict(i) for i in runner.orders_by_num.values()],
+    }
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
+    try:
+        np.savez(os.path.join(tmp, "book.npz"), **book_host)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(path):
+            old = path + ".old"
+            os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
+    """Read a checkpoint directory -> (cfg, host-side book, meta)."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    cfg = EngineConfig(**meta["cfg"])
+    with np.load(os.path.join(path, "book.npz")) as z:
+        book = BookBatch(**{f: z[f] for f in _BOOK_FIELDS})
+    return cfg, book, meta
+
+
+def restore_runner(runner, path: str, storage=None) -> int:
+    """Load a checkpoint into `runner`, then reconcile against storage.
+
+    Returns the number of reconciliation ops replayed (0 when the snapshot
+    was already current). Raises ValueError on config mismatch.
+    """
+    from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+
+    cfg, host_book, meta = load_checkpoint(path)
+    if cfg != runner.cfg:
+        raise ValueError(
+            f"checkpoint config {cfg} does not match runner config {runner.cfg}"
+        )
+    runner.book = jax.device_put(host_book)
+    runner.symbols = dict(meta["symbols"])
+    runner.slot_symbols = [None] * cfg.num_symbols
+    for sym, slot in runner.symbols.items():
+        runner.slot_symbols[slot] = sym
+    runner.orders_by_num = {}
+    runner.orders_by_id = {}
+    for d in meta["orders"]:
+        info = OrderInfo(**d)
+        runner.orders_by_num[info.oid] = info
+        runner.orders_by_id[info.order_id] = info
+    runner.seed_oid_sequence(int(meta["next_oid_num"]))
+
+    if storage is None:
+        return 0
+
+    runner.seed_oid_sequence(storage.load_next_oid_seq())
+
+    # --- reconcile: replay what SQLite saw after the snapshot -------------
+    db_open: dict[str, tuple] = {}
+    for row in storage.open_orders():
+        # (order_id, client_id, symbol, side, otype, price, qty, remaining, status)
+        db_open[row[0]] = row
+
+    ops: list[EngineOp] = []
+    # 1) snapshot orders the DB has since closed or changed: cancel stale
+    #    device entries (and resubmit below with the DB remaining).
+    resubmit: list[OrderInfo] = []
+    for order_id, info in list(runner.orders_by_id.items()):
+        row = db_open.get(order_id)
+        if row is not None and row[7] == info.remaining:
+            continue  # snapshot is current for this order
+        ops.append(EngineOp(OP_CANCEL, info, cancel_requester="__recovery__"))
+        del runner.orders_by_id[order_id]
+        del runner.orders_by_num[info.oid]
+        if row is not None and row[7] > 0:
+            resubmit.append(OrderInfo(
+                oid=info.oid, order_id=order_id, client_id=row[1],
+                symbol=row[2], side=row[3], otype=row[4], price_q4=row[5],
+                quantity=row[6], remaining=row[7], status=row[8],
+            ))
+    # 2) DB-open orders the snapshot has never seen: submit them.
+    for order_id, row in db_open.items():
+        if order_id in runner.orders_by_id:
+            continue
+        if any(i.order_id == order_id for i in resubmit):
+            continue
+        num = int(order_id.split("-", 1)[1]) if order_id.startswith("OID-") else 0
+        if runner.symbol_slot(row[2]) is None:
+            continue  # symbol axis full; mirrors recover_books' drop policy
+        resubmit.append(OrderInfo(
+            oid=num, order_id=order_id, client_id=row[1], symbol=row[2],
+            side=row[3], otype=row[4], price_q4=row[5], quantity=row[6],
+            remaining=row[7], status=row[8],
+        ))
+
+    if ops:
+        runner.run_dispatch(ops)  # cancels first: frees capacity + removes stale
+    sub_ops = []
+    for info in sorted(resubmit, key=lambda i: i.oid):
+        if runner.symbol_slot(info.symbol) is None:
+            continue
+        runner.orders_by_num[info.oid] = info
+        runner.orders_by_id[info.order_id] = info
+        sub_ops.append(EngineOp(OP_SUBMIT, info))
+    if sub_ops:
+        runner.run_dispatch(sub_ops)
+    return len(ops) + len(sub_ops)
+
+
+def latest_checkpoint(root: str) -> str | None:
+    """Newest checkpoint directory under `root` (by embedded timestamp)."""
+    if not os.path.isdir(root):
+        return None
+    best, best_ts = None, -1.0
+    for name in os.listdir(root):
+        p = os.path.join(root, name)
+        mp = os.path.join(p, "meta.json")
+        if not os.path.isfile(mp):
+            continue
+        try:
+            with open(mp) as f:
+                ts = float(json.load(f).get("ts", 0))
+        except (ValueError, OSError):
+            continue
+        if ts > best_ts:
+            best, best_ts = p, ts
+    return best
+
+
+class CheckpointDaemon:
+    """Periodic checkpointer: flush the sink, quiesce the runner, snapshot.
+
+    `keep` bounds retained checkpoints (oldest pruned). The flush barrier
+    before the snapshot is what makes snapshot time == SQLite time (see
+    module docstring).
+    """
+
+    def __init__(self, runner, sink, root: str, interval_s: float = 30.0, keep: int = 3):
+        import threading
+
+        self.runner = runner
+        self.sink = sink
+        self.root = root
+        self.interval_s = interval_s
+        self.keep = keep
+        # Resume numbering past any checkpoints a previous process left, so
+        # _prune's name-sort never deletes a fresh snapshot as "oldest".
+        self.saved = 1 + max(
+            (int(n[5:]) for n in self._existing()), default=-1
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="checkpointer", daemon=True
+        )
+
+    def _existing(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            n for n in os.listdir(self.root)
+            if n.startswith("ckpt-") and n[5:].isdigit()
+            and os.path.isdir(os.path.join(self.root, n))
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def checkpoint_now(self) -> str:
+        path = os.path.join(self.root, f"ckpt-{self.saved:08d}")
+        # Quiesce: no dispatch may run between the sink flush (which equalizes
+        # SQLite with engine time) and the snapshot, and the book+directories
+        # must not be mid-mutation (torn snapshots could double-apply orders
+        # on restore).
+        with self.runner._dispatch_lock:
+            self.sink.flush()
+            save_checkpoint(path, self.runner)
+        self.saved += 1
+        self._prune()
+        return path
+
+    def _prune(self):
+        cks = self._existing()
+        for name in cks[: max(0, len(cks) - self.keep)]:
+            shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)
+
+    def close(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.checkpoint_now()
+            except Exception as e:  # keep the daemon alive; surface the error
+                print(f"[checkpoint] snapshot failed: {type(e).__name__}: {e}")

@@ -11,7 +11,12 @@ import random
 import pytest
 
 from matching_engine_tpu.engine.book import EngineConfig, init_book
-from matching_engine_tpu.engine.harness import HostOrder, apply_orders, snapshot_books
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    random_order_stream,
+    snapshot_books,
+)
 from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT
 from matching_engine_tpu.engine.oracle import OracleBook
 from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
@@ -96,41 +101,14 @@ def test_cancel_semantics():
     assert_parity(cfg, orders)
 
 
-def _random_stream(rng, num_symbols, n_orders, price_levels=12):
-    orders = []
-    live_by_sym = [dict() for _ in range(num_symbols)]  # oid -> side
-    oid = 0
-    for _ in range(n_orders):
-        sym = rng.randrange(num_symbols)
-        if live_by_sym[sym] and rng.random() < 0.15:
-            target = rng.choice(list(live_by_sym[sym]))
-            side = live_by_sym[sym].pop(target)
-            orders.append(HostOrder(sym, OP_CANCEL, side, oid=target))
-            continue
-        oid += 1
-        side = rng.choice((BUY, SELL))
-        otype = MARKET if rng.random() < 0.2 else LIMIT
-        price = 0 if otype == MARKET else 10000 + 100 * rng.randrange(price_levels)
-        qty = rng.randrange(1, 20)
-        orders.append(HostOrder(sym, OP_SUBMIT, side, otype, price, qty, oid=oid))
-        if otype == LIMIT:
-            # may or may not rest; tracking it as cancelable is fine either
-            # way (canceling a filled order is a REJECTED cancel on both
-            # sides of the parity check).
-            live_by_sym[sym][oid] = side
-    return orders
-
-
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_randomized_parity(seed):
-    rng = random.Random(seed)
     cfg = EngineConfig(num_symbols=4, capacity=16, batch=8)
-    orders = _random_stream(rng, cfg.num_symbols, 150)
+    orders = random_order_stream(cfg.num_symbols, 150, seed=seed)
     assert_parity(cfg, orders)
 
 
 def test_randomized_parity_deep_books():
-    rng = random.Random(99)
     cfg = EngineConfig(num_symbols=2, capacity=64, batch=8)
-    orders = _random_stream(rng, cfg.num_symbols, 400, price_levels=5)
+    orders = random_order_stream(cfg.num_symbols, 400, seed=99, price_levels=5)
     assert_parity(cfg, orders)

@@ -5,8 +5,6 @@ step must produce bit-identical statuses, fills, and resting books to the
 single-device kernel — sharding is a layout choice, never a semantics choice.
 """
 
-import random
-
 import jax
 import numpy as np
 import pytest
@@ -16,35 +14,12 @@ from matching_engine_tpu.engine.harness import (
     HostOrder,
     apply_orders,
     build_batches,
+    random_order_stream,
     snapshot_books,
 )
 from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT
 from matching_engine_tpu.parallel import ShardedEngine, make_mesh
 from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
-
-
-def _random_stream(cfg, n, seed=0):
-    rng = random.Random(seed)
-    orders = []
-    live = []  # (sym, side, oid) of possibly-resting orders
-    for oid in range(1, n + 1):
-        sym = rng.randrange(cfg.num_symbols)
-        if live and rng.random() < 0.15:
-            s, side, target = live.pop(rng.randrange(len(live)))
-            orders.append(HostOrder(sym=s, op=OP_CANCEL, side=side, oid=target))
-            continue
-        side = rng.choice((BUY, SELL))
-        otype = MARKET if rng.random() < 0.2 else LIMIT
-        price = 0 if otype == MARKET else rng.randrange(9_900, 10_100)
-        orders.append(
-            HostOrder(
-                sym=sym, op=OP_SUBMIT, side=side, otype=otype,
-                price=price, qty=rng.randrange(1, 50), oid=oid,
-            )
-        )
-        if otype == LIMIT:
-            live.append((sym, side, oid))
-    return orders
 
 
 def _run_sharded(cfg, mesh, host_orders):
@@ -71,7 +46,10 @@ def mesh8():
 
 def test_sharded_matches_single_device(mesh8):
     cfg = EngineConfig(num_symbols=16, capacity=32, batch=4, max_fills=256)
-    orders = _random_stream(cfg, 400, seed=7)
+    orders = random_order_stream(
+        cfg.num_symbols, 400, seed=7, price_base=9_900, price_levels=200,
+        price_step=1, qty_max=50,
+    )
 
     book = init_book(cfg)
     book, s_results, s_fills = apply_orders(cfg, book, orders)
