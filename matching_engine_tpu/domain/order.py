@@ -29,6 +29,12 @@ from matching_engine_tpu.proto import pb2
 # (engine/kernel.py), so capacity * MAX_QUANTITY must not wrap.
 MAX_QUANTITY = 2_000_000
 
+# Identifier byte-length ceilings. Both bound host-side memory per order and
+# keep every string representable in the native sink's u16 length-prefixed
+# wire format (native/me_native.cpp §3).
+MAX_SYMBOL_BYTES = 64
+MAX_CLIENT_ID_BYTES = 256
+
 
 class ValidationError(ValueError):
     """Submit-time rejection; `.message` is the client-visible error text."""
@@ -88,6 +94,10 @@ def validate_submit(request: pb2.OrderRequest) -> str | None:
     """
     if not request.symbol:
         return "symbol is required"
+    if len(request.symbol.encode()) > MAX_SYMBOL_BYTES:
+        return f"symbol exceeds {MAX_SYMBOL_BYTES} bytes"
+    if len(request.client_id.encode()) > MAX_CLIENT_ID_BYTES:
+        return f"client_id exceeds {MAX_CLIENT_ID_BYTES} bytes"
     if request.quantity <= 0:
         return "quantity must be positive"
     if request.quantity > MAX_QUANTITY:

@@ -1,0 +1,319 @@
+"""ctypes bindings for the C++ runtime layer (native/me_native.cpp).
+
+The reference is an all-C++ gateway; this package is where the new
+framework's host runtime stays native: Q4 price arithmetic, the MPSC
+op ring behind the batch dispatcher, and the async SQLite sink. Each
+binding has a pure-Python twin (domain/price.py, server/dispatcher.py,
+storage/async_sink.py) — the native path is selected when the library is
+present, and parity between the two is enforced by tests/test_native.py.
+
+`ensure_built()` compiles the library on demand (g++ + system libsqlite3;
+nothing to pip-install). `available()` gates call sites.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+_PKG_DIR = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_PKG_DIR, "libme_native.so")
+_SRC_DIR = os.path.normpath(os.path.join(_PKG_DIR, "..", "..", "native"))
+_SRC = os.path.join(_SRC_DIR, "me_native.cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+# me_validate_submit codes -> the service's reject messages
+# (reference matching_engine_service.cpp:66-83 wording preserved upstream).
+VALIDATE_MESSAGES = {
+    1: "symbol is required",
+    2: "quantity must be positive",
+    3: "price must be positive for LIMIT orders",
+    4: "scale out of range [0, 18]",
+    5: "price overflows the engine's Q4 range",
+    6: "quantity exceeds the engine maximum",
+    7: "side must be BUY or SELL",
+    8: "order_type must be LIMIT or MARKET",
+    9: "symbol too long",
+    10: "client_id too long",
+}
+
+
+class MeOp(ctypes.Structure):
+    _fields_ = [
+        ("tag", ctypes.c_uint64),
+        ("sym", ctypes.c_int32),
+        ("op", ctypes.c_int32),
+        ("side", ctypes.c_int32),
+        ("otype", ctypes.c_int32),
+        ("price", ctypes.c_int32),
+        ("qty", ctypes.c_int32),
+        ("oid", ctypes.c_int32),
+        ("pad", ctypes.c_int32),
+    ]
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Build libme_native.so if missing or stale. Returns availability."""
+    if os.path.exists(_LIB_PATH) and not force:
+        if not os.path.exists(_SRC) or (
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+        ):
+            return True
+    if not os.path.exists(_SRC):
+        return os.path.exists(_LIB_PATH)
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_SRC_DIR, check=True, capture_output=True
+        )
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        out = getattr(e, "stderr", b"") or b""
+        print(f"[native] build failed: {out.decode(errors='replace')[-500:]}")
+        return os.path.exists(_LIB_PATH)
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not ensure_built():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.me_normalize_to_q4.argtypes = [
+            ctypes.c_longlong, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong)
+        ]
+        lib.me_normalize_to_q4.restype = ctypes.c_int
+        lib.me_validate_submit.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_int, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.me_validate_submit.restype = ctypes.c_int
+
+        lib.me_ring_create.argtypes = [ctypes.c_uint32]
+        lib.me_ring_create.restype = ctypes.c_void_p
+        lib.me_ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.me_ring_push.argtypes = [ctypes.c_void_p, ctypes.POINTER(MeOp)]
+        lib.me_ring_push.restype = ctypes.c_int
+        lib.me_ring_pop_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MeOp), ctypes.c_uint32,
+            ctypes.c_uint64,
+        ]
+        lib.me_ring_pop_batch.restype = ctypes.c_int
+        lib.me_ring_close.argtypes = [ctypes.c_void_p]
+        lib.me_ring_dropped.argtypes = [ctypes.c_void_p]
+        lib.me_ring_dropped.restype = ctypes.c_uint64
+        lib.me_ring_size.argtypes = [ctypes.c_void_p]
+        lib.me_ring_size.restype = ctypes.c_uint64
+
+        lib.me_sink_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.me_sink_open.restype = ctypes.c_void_p
+        lib.me_sink_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int
+        ]
+        lib.me_sink_submit.restype = ctypes.c_int
+        lib.me_sink_flush.argtypes = [ctypes.c_void_p]
+        lib.me_sink_stats.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_uint64)
+        ] * 4
+        lib.me_sink_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    try:
+        return _load() is not None
+    except OSError:
+        return False
+
+
+# -- domain -----------------------------------------------------------------
+
+def normalize_to_q4(price: int, raw_scale: int) -> int:
+    """Native twin of domain.price.normalize_to_q4 (same raise behavior)."""
+    from matching_engine_tpu.domain.price import PriceError
+
+    lib = _load()
+    out = ctypes.c_longlong()
+    rc = lib.me_normalize_to_q4(price, raw_scale, ctypes.byref(out))
+    if rc == 1:
+        raise PriceError(f"scale {raw_scale} out of range [0, 18]")
+    if rc == 2:
+        raise PriceError(
+            f"price {price} at scale {raw_scale} overflows int64 when "
+            f"normalized to Q4"
+        )
+    return out.value
+
+
+def validate_submit_code(
+    symbol_len: int, client_id_len: int, quantity: int, side: int,
+    order_type: int, price: int, scale: int,
+) -> int:
+    """0 = valid; else a VALIDATE_MESSAGES key. Bounds come from the domain
+    constants so native and Python validation can never drift."""
+    from matching_engine_tpu.domain.order import (
+        MAX_CLIENT_ID_BYTES,
+        MAX_QUANTITY,
+        MAX_SYMBOL_BYTES,
+    )
+    from matching_engine_tpu.domain.price import MAX_DEVICE_PRICE_Q4
+
+    return _load().me_validate_submit(
+        symbol_len, client_id_len, quantity, side, order_type, price, scale,
+        MAX_DEVICE_PRICE_Q4, MAX_QUANTITY, MAX_SYMBOL_BYTES,
+        MAX_CLIENT_ID_BYTES,
+    )
+
+
+# -- ring -------------------------------------------------------------------
+
+class NativeRing:
+    """Bounded MPSC op ring; the batching window runs in C++ off the GIL."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self._lib = _load()
+        self._h = self._lib.me_ring_create(capacity)
+        if not self._h:
+            raise RuntimeError("me_ring_create failed")
+        self._buf = None  # reused pop buffer (single consumer)
+
+    def push(self, tag: int, sym: int, op: int, side: int, otype: int,
+             price: int, qty: int, oid: int) -> bool:
+        if self._h is None:  # destroyed ring: behave as closed, never segv
+            return False
+        rec = MeOp(tag=tag, sym=sym, op=op, side=side, otype=otype,
+                   price=price, qty=qty, oid=oid, pad=0)
+        return bool(self._lib.me_ring_push(self._h, ctypes.byref(rec)))
+
+    def pop_batch(self, max_ops: int, window_us: int):
+        """Blocks for the first op, then drains up to (max_ops, window_us).
+        Returns a list of MeOp field tuples, or None when closed+empty.
+
+        The output buffer is allocated once and reused — the ring has a
+        single consumer, and max_ops can be thousands of 40-byte records per
+        ~2ms drain window."""
+        if self._h is None:
+            return None
+        buf = self._buf
+        if buf is None or len(buf) < max_ops:
+            buf = self._buf = (MeOp * max_ops)()
+        n = self._lib.me_ring_pop_batch(self._h, buf, max_ops, window_us)
+        if n < 0:
+            return None
+        return [
+            (r.tag, r.sym, r.op, r.side, r.otype, r.price, r.qty, r.oid)
+            for r in buf[:n]
+        ]
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.me_ring_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.me_ring_destroy(self._h)
+            self._h = None
+
+    @property
+    def dropped(self) -> int:
+        return 0 if self._h is None else self._lib.me_ring_dropped(self._h)
+
+    def __len__(self) -> int:
+        return 0 if self._h is None else self._lib.me_ring_size(self._h)
+
+
+# -- sink -------------------------------------------------------------------
+
+def _pack_str(out: bytearray, s: str) -> None:
+    b = s.encode()
+    out += struct.pack("<H", len(b))
+    out += b
+
+
+def pack_batch(orders, updates, fills) -> bytes:
+    """Serialize one dispatch for MeSink (format in me_native.cpp §3).
+
+    orders: Storage.insert_new_order arg tuples
+            (order_id, client_id, symbol, side, otype, price|None, qty,
+             remaining, status);
+    updates: (order_id, status, remaining); fills: FillRow.
+    """
+    out = bytearray()
+    out += struct.pack("<I", len(orders))
+    for (oid, cid, sym, side, otype, price, qty, remaining, status) in orders:
+        _pack_str(out, oid)
+        _pack_str(out, cid)
+        _pack_str(out, sym)
+        out += struct.pack(
+            "<BBBqqqB", side, otype, 0 if price is None else 1,
+            price or 0, qty, remaining, status,
+        )
+    out += struct.pack("<I", len(updates))
+    for (oid, status, remaining) in updates:
+        _pack_str(out, oid)
+        out += struct.pack("<Bq", status, remaining)
+    out += struct.pack("<I", len(fills))
+    for f in fills:
+        _pack_str(out, f.order_id)
+        _pack_str(out, f.counter_order_id)
+        out += struct.pack("<qqq", f.price_q4, f.quantity, f.ts)
+    return bytes(out)
+
+
+class NativeStorageSink:
+    """Drop-in for storage.AsyncStorageSink backed by the C++ worker.
+
+    Row-for-row identical SQLite output (enforced by tests/test_native.py);
+    serialization happens on the caller's thread, SQLite work on the C++
+    thread — the GIL is held only while packing bytes.
+    """
+
+    def __init__(self, db_path: str, max_queue: int = 4096):
+        d = os.path.dirname(db_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lib = _load()
+        self._h = self._lib.me_sink_open(db_path.encode(), max_queue)
+        if not self._h:
+            raise RuntimeError(f"me_sink_open({db_path}) failed")
+        self.dropped = 0
+
+    def submit(self, orders=None, updates=None, fills=None, block=True) -> bool:
+        if self._h is None:
+            return False
+        buf = pack_batch(orders or [], updates or [], fills or [])
+        if len(buf) <= 12:  # three zero counts — nothing to write
+            return True
+        ok = bool(self._lib.me_sink_submit(
+            self._h, buf, len(buf), 1 if block else 0
+        ))
+        if not ok:
+            self.dropped += 1
+        return ok
+
+    def flush(self) -> None:
+        if self._h is not None:
+            self._lib.me_sink_flush(self._h)
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        if self._h is None:
+            return {"batches": 0, "rows": 0, "dropped": 0, "errors": 0}
+        self._lib.me_sink_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {
+            "batches": vals[0].value, "rows": vals[1].value,
+            "dropped": vals[2].value, "errors": vals[3].value,
+        }
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.me_sink_close(self._h)
+            self._h = None

@@ -12,6 +12,7 @@ only on their own op's completion, and a whole batch costs one kernel launch.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -82,7 +83,15 @@ class BatchDispatcher:
         ops = [op for op, _ in batch]
         futs = {id(op): fut for op, fut in batch}
         try:
-            result = self.runner.run_dispatch(ops)
+            # The dispatch lock is held across BOTH the device step and the
+            # sink/hub enqueue: CheckpointDaemon.checkpoint_now acquires the
+            # same lock, then flushes the sink, then snapshots — so a batch
+            # can never be applied to the book yet invisible to the flush
+            # barrier (the snapshot would be ahead of SQLite and restore
+            # could resurrect canceled orders).
+            with self.runner._dispatch_lock:
+                result = self.runner._run_dispatch_locked(ops)
+                self._publish(result)
         except Exception as e:  # noqa: BLE001 — fail the futures, not the loop
             for _, fut in batch:
                 if not fut.done():
@@ -90,6 +99,9 @@ class BatchDispatcher:
             self.metrics.inc("dispatch_errors")
             return
 
+        # Futures resolve only after the storage batch is enqueued, so a
+        # client that sees its response and then calls sink.flush() is
+        # guaranteed the flush barrier covers its batch (read-your-writes).
         for outcome in result.outcomes:
             fut = futs.get(id(outcome.op))
             if fut is not None and not fut.done():
@@ -98,20 +110,114 @@ class BatchDispatcher:
         for op, fut in batch:
             if not fut.done():
                 fut.set_exception(RuntimeError("op produced no outcome"))
-
-        if self.sink is not None:
-            # Non-blocking: a stalled SQLite must not backpressure the match
-            # loop (we prefer losing durable-log tail to stalling matching;
-            # the sink counts drops and the book checkpoint reconciles).
-            if not self.sink.submit(
-                orders=result.storage_orders,
-                updates=result.storage_updates,
-                fills=result.storage_fills,
-                block=False,
-            ):
-                self.metrics.inc("storage_batches_dropped")
-        if self.hub is not None:
-            self.hub.publish_order_updates(result.order_updates)
-            self.hub.publish_market_data(result.market_data)
         self.metrics.ema_gauge("dispatch_us", (time.perf_counter() - t0) * 1e6)
         self.metrics.ema_gauge("dispatch_ops", len(batch))
+
+    def _publish(self, result) -> None:
+        """Enqueue storage/stream events. A sink/hub failure must never
+        strand the batch's futures or kill the drain loop — the match result
+        already exists in the book."""
+        try:
+            if self.sink is not None:
+                # Non-blocking: a stalled SQLite must not backpressure the
+                # match loop (we prefer losing durable-log tail to stalling
+                # matching; the sink counts drops and the book checkpoint
+                # reconciles).
+                if not self.sink.submit(
+                    orders=result.storage_orders,
+                    updates=result.storage_updates,
+                    fills=result.storage_fills,
+                    block=False,
+                ):
+                    self.metrics.inc("storage_batches_dropped")
+            if self.hub is not None:
+                self.hub.publish_order_updates(result.order_updates)
+                self.hub.publish_market_data(result.market_data)
+        except Exception as e:  # noqa: BLE001
+            self.metrics.inc("sink_publish_errors")
+            print(f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
+
+
+class NativeRingDispatcher(BatchDispatcher):
+    """BatchDispatcher whose queue + batching window run in C++ (native
+    MeRing, native/me_native.cpp §2). RPC threads push fixed-size op records
+    into the ring without contending the drain loop's GIL time; the
+    size/time-window batching decision itself executes native. The host-side
+    op metadata (OrderInfo, futures) stays in a tag map on this side.
+
+    Requires the native library (matching_engine_tpu.native.available());
+    construction raises otherwise — callers fall back to BatchDispatcher.
+    """
+
+    def __init__(
+        self,
+        runner: EngineRunner,
+        sink=None,
+        hub=None,
+        window_ms: float = 2.0,
+        max_batch: int | None = None,
+        metrics: Metrics | None = None,
+        ring_capacity: int = 1 << 16,
+    ):
+        from matching_engine_tpu import native as me_native
+
+        if not me_native.available():
+            raise RuntimeError("native library unavailable")
+        self._ring = me_native.NativeRing(ring_capacity)
+        self._tags: dict[int, tuple[EngineOp, Future]] = {}
+        self._tag_lock = threading.Lock()
+        self._tag_seq = itertools.count(1)
+        super().__init__(runner, sink, hub, window_ms, max_batch, metrics)
+
+    def submit(self, op: EngineOp) -> Future:
+        fut: Future = Future()
+        tag = next(self._tag_seq)
+        with self._tag_lock:
+            self._tags[tag] = (op, fut)
+        info = op.info
+        # The payload fields mirror the op for native producers (the C++
+        # front end pushes full records); the Python drain path keys off the
+        # tag alone. sym=-1: host directory owns the symbol->slot mapping.
+        ok = self._ring.push(
+            tag, -1, op.op, info.side, info.otype, info.price_q4,
+            info.remaining, info.oid,
+        )
+        if not ok:
+            with self._tag_lock:
+                self._tags.pop(tag, None)
+            self.metrics.inc("ring_rejects")
+            fut.set_exception(RuntimeError("op ring full"))
+        return fut
+
+    def close(self) -> None:
+        self._stop.set()
+        self._ring.close()
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # Drain thread still inside a device step: leak the ring rather
+            # than free memory under a live consumer.
+            print("[dispatcher] drain thread busy at close; leaking ring")
+        else:
+            self._ring.destroy()
+        # Fail anything still parked in the tag map.
+        with self._tag_lock:
+            leftovers = list(self._tags.values())
+            self._tags.clear()
+        for _, fut in leftovers:
+            if not fut.done():
+                fut.set_exception(RuntimeError("dispatcher closed"))
+
+    def _run(self) -> None:
+        window_us = max(1, int(self.window_s * 1e6))
+        while not self._stop.is_set():
+            recs = self._ring.pop_batch(self.max_batch, window_us)
+            if recs is None:
+                return
+            batch = []
+            with self._tag_lock:
+                for rec in recs:
+                    ent = self._tags.pop(rec[0], None)
+                    if ent is not None:
+                        batch.append(ent)
+            if batch:
+                self._drain(batch)

@@ -23,7 +23,7 @@ import grpc
 from matching_engine_tpu.engine.book import EngineConfig
 from matching_engine_tpu.engine.kernel import OP_SUBMIT
 from matching_engine_tpu.proto.rpc import add_matching_engine_servicer
-from matching_engine_tpu.server.dispatcher import BatchDispatcher
+from matching_engine_tpu.server.dispatcher import BatchDispatcher, NativeRingDispatcher
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
 from matching_engine_tpu.server.service import MatchingEngineService
 from matching_engine_tpu.server.streams import StreamHub
@@ -74,8 +74,15 @@ def build_server(
     log: bool = True,
     checkpoint_dir: str | None = None,
     checkpoint_interval_s: float = 30.0,
+    native: bool = True,
 ):
-    """Wire the full stack; returns (grpc server, bound port, parts dict)."""
+    """Wire the full stack; returns (grpc server, bound port, parts dict).
+
+    With native=True (the default) and the C++ runtime built, the op ring /
+    batching window and the SQLite writer run in native code
+    (native/me_native.cpp); otherwise the pure-Python twins serve. Reads
+    (recovery, book queries, OID reseed) always go through Storage.
+    """
     storage = Storage(db_path)
     if not storage.init():
         raise SystemExit(1)
@@ -100,14 +107,27 @@ def build_server(
         if recovered and log:
             print(f"[SERVER] recovered {recovered} open orders into device books")
 
-    sink = AsyncStorageSink(storage)
+    from matching_engine_tpu import native as me_native
+
+    use_native = native and me_native.available()
+    if use_native:
+        sink = me_native.NativeStorageSink(db_path)
+    else:
+        sink = AsyncStorageSink(storage)
     checkpointer = None
     if checkpoint_dir:
         checkpointer = CheckpointDaemon(
             runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s
         ).start()
     hub = StreamHub()
-    dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
+    if use_native:
+        dispatcher = NativeRingDispatcher(
+            runner, sink=sink, hub=hub, window_ms=window_ms
+        )
+    else:
+        dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
+    if log:
+        print(f"[SERVER] runtime layer: {'native (C++)' if use_native else 'python'}")
     service = MatchingEngineService(runner, dispatcher, hub, metrics, log=log)
 
     server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
@@ -152,6 +172,8 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable periodic device-book checkpoints here")
     p.add_argument("--checkpoint-interval-s", type=float, default=30.0)
+    p.add_argument("--no-native", action="store_true",
+                   help="force the pure-Python runtime layer")
     args = p.parse_args(argv)
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
@@ -161,6 +183,7 @@ def main(argv=None) -> int:
             rpc_workers=args.rpc_workers,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_interval_s=args.checkpoint_interval_s,
+            native=not args.no_native,
         )
     except SystemExit as e:
         return int(e.code or 3)

@@ -58,13 +58,19 @@ def save_checkpoint(path: str, runner) -> None:
     CheckpointDaemon or hold the runner's snapshot lock externally.
     """
     book_host = {f: np.asarray(getattr(runner.book, f)) for f in _BOOK_FIELDS}
+    # The dispatch lock (held by the caller) quiesces the book and order
+    # directories, but RPC threads allocate symbols/OIDs outside it — copy
+    # those under the id lock so json.dump never walks a mutating dict.
+    with runner._id_lock:
+        symbols = dict(runner.symbols)
+        next_oid_num = runner.next_oid_num
     meta = {
         "version": 1,
         "ts": time.time(),
         "cfg": dataclasses.asdict(runner.cfg),
-        "symbols": runner.symbols,
-        "next_oid_num": runner.next_oid_num,
-        "orders": [dataclasses.asdict(i) for i in runner.orders_by_num.values()],
+        "symbols": symbols,
+        "next_oid_num": next_oid_num,
+        "orders": [dataclasses.asdict(i) for i in list(runner.orders_by_num.values())],
     }
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
@@ -150,10 +156,11 @@ def restore_runner(runner, path: str, storage=None) -> int:
                 quantity=row[6], remaining=row[7], status=row[8],
             ))
     # 2) DB-open orders the snapshot has never seen: submit them.
+    resubmit_ids = {i.order_id for i in resubmit}
     for order_id, row in db_open.items():
         if order_id in runner.orders_by_id:
             continue
-        if any(i.order_id == order_id for i in resubmit):
+        if order_id in resubmit_ids:
             continue
         num = int(order_id.split("-", 1)[1]) if order_id.startswith("OID-") else 0
         if runner.symbol_slot(row[2]) is None:

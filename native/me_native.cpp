@@ -1,0 +1,672 @@
+// me_native: the C++ runtime layer of the TPU-native matching engine.
+//
+// The reference (/root/reference) is an all-C++20 gRPC order gateway; this
+// library is the native counterpart of its host-side runtime, redesigned for
+// the batched-TPU architecture:
+//
+//   1. Domain arithmetic — Q4 price normalization with the exact semantics of
+//      the reference's normalize_to_q4 (include/domain/price.hpp:15-29):
+//      scale in [0,18], truncation toward zero on downscale, int64 overflow
+//      detection on upscale — plus the submit-validation predicate of
+//      src/server/matching_engine_service.cpp:66-83.
+//
+//   2. MeRing — a bounded MPSC ring that replaces the reference's global
+//      `write_mu` serialization point (matching_engine_service.cpp:102).
+//      Producer RPC threads enqueue fixed-size ops; one consumer drains
+//      time/size-windowed batches destined for a dense [S, B] device
+//      dispatch. The batching window logic (first-item deadline) lives here,
+//      in C++, off the GIL.
+//
+//   3. MeSink — the asynchronous durable tail: a worker thread applying
+//      whole engine dispatches to SQLite as single WAL transactions
+//      (reference schema, src/storage/storage.cpp:28-68, with its dormant
+//      bugs fixed — see SURVEY.md §2.9). Links directly against the system
+//      libsqlite3; the header subset used is declared below (the SQLite C
+//      ABI is stable and versioned).
+//
+// Exposed as a C ABI consumed by ctypes (matching_engine_tpu/native).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// SQLite C API subset (system header not installed in this image; these are
+// the stable documented prototypes of libsqlite3.so.0).
+// ---------------------------------------------------------------------------
+extern "C" {
+typedef struct sqlite3 sqlite3;
+typedef struct sqlite3_stmt sqlite3_stmt;
+int sqlite3_open_v2(const char*, sqlite3**, int, const char*);
+int sqlite3_close_v2(sqlite3*);
+int sqlite3_exec(sqlite3*, const char*, int (*)(void*, int, char**, char**),
+                 void*, char**);
+int sqlite3_prepare_v2(sqlite3*, const char*, int, sqlite3_stmt**,
+                       const char**);
+int sqlite3_bind_int64(sqlite3_stmt*, int, long long);
+int sqlite3_bind_null(sqlite3_stmt*, int);
+int sqlite3_bind_text(sqlite3_stmt*, int, const char*, int, void (*)(void*));
+int sqlite3_step(sqlite3_stmt*);
+int sqlite3_reset(sqlite3_stmt*);
+int sqlite3_finalize(sqlite3_stmt*);
+int sqlite3_busy_timeout(sqlite3*, int);
+const char* sqlite3_errmsg(sqlite3*);
+void sqlite3_free(void*);
+#define SQLITE_OK 0
+#define SQLITE_ROW 100
+#define SQLITE_DONE 101
+#define SQLITE_OPEN_READWRITE 0x00000002
+#define SQLITE_OPEN_CREATE 0x00000004
+#define SQLITE_OPEN_FULLMUTEX 0x00010000
+#define SQLITE_TRANSIENT ((void (*)(void*))-1)
+}
+
+// ===========================================================================
+// 1. Domain: Q4 normalization + submit validation
+// ===========================================================================
+
+namespace {
+constexpr int kTargetScale = 4;
+constexpr long long kPow10[19] = {
+    1LL,
+    10LL,
+    100LL,
+    1000LL,
+    10000LL,
+    100000LL,
+    1000000LL,
+    10000000LL,
+    100000000LL,
+    1000000000LL,
+    10000000000LL,
+    100000000000LL,
+    1000000000000LL,
+    10000000000000LL,
+    100000000000000LL,
+    1000000000000000LL,
+    10000000000000000LL,
+    100000000000000000LL,
+    1000000000000000000LL,
+};
+}  // namespace
+
+extern "C" {
+
+// Error codes shared with the Python binding.
+enum MeErr {
+  ME_OK = 0,
+  ME_ERR_SCALE = 1,     // scale outside [0, 18]
+  ME_ERR_OVERFLOW = 2,  // int64 overflow on upscale
+};
+
+// Reference include/domain/price.hpp:15-29: rescale `price` quoted with
+// `raw_scale` decimals onto the Q4 grid. Downscale truncates toward zero
+// (C++ integer division semantics — the reference relies on the same).
+int me_normalize_to_q4(long long price, int raw_scale, long long* out) {
+  if (raw_scale < 0 || raw_scale > 18) return ME_ERR_SCALE;
+  if (raw_scale == kTargetScale) {
+    *out = price;
+    return ME_OK;
+  }
+  if (raw_scale < kTargetScale) {
+    long long mul = kPow10[kTargetScale - raw_scale];
+    long long scaled;
+    if (__builtin_mul_overflow(price, mul, &scaled)) return ME_ERR_OVERFLOW;
+    *out = scaled;
+    return ME_OK;
+  }
+  *out = price / kPow10[raw_scale - kTargetScale];  // truncates toward zero
+  return ME_OK;
+}
+
+// Submit validation predicate — full parity with domain/order.py's
+// validate_submit (itself the reference's rules at
+// matching_engine_service.cpp:66-83 plus this framework's device bounds).
+enum MeValidate {
+  ME_V_OK = 0,
+  ME_V_EMPTY_SYMBOL = 1,
+  ME_V_BAD_QTY = 2,
+  ME_V_BAD_PRICE = 3,   // LIMIT with price <= 0 (or truncating to 0 at Q4)
+  ME_V_BAD_SCALE = 4,
+  ME_V_PRICE_OVERFLOW = 5,  // int64 on rescale, or > int32 device lane
+  ME_V_QTY_TOO_LARGE = 6,   // > max_quantity (int32 book-sum safety bound)
+  ME_V_BAD_SIDE = 7,        // not BUY(1)/SELL(2)
+  ME_V_BAD_TYPE = 8,        // not LIMIT(0)/MARKET(1)
+  ME_V_SYMBOL_TOO_LONG = 9,
+  ME_V_CLIENT_ID_TOO_LONG = 10,
+};
+
+int me_validate_submit(int symbol_len, int client_id_len, long long quantity,
+                       int side, int order_type, long long price, int scale,
+                       long long max_price_q4, long long max_quantity,
+                       int max_symbol_len, int max_client_id_len) {
+  if (symbol_len <= 0) return ME_V_EMPTY_SYMBOL;
+  if (symbol_len > max_symbol_len) return ME_V_SYMBOL_TOO_LONG;
+  if (client_id_len > max_client_id_len) return ME_V_CLIENT_ID_TOO_LONG;
+  if (quantity <= 0) return ME_V_BAD_QTY;
+  if (quantity > max_quantity) return ME_V_QTY_TOO_LARGE;
+  if (side != 1 && side != 2) return ME_V_BAD_SIDE;
+  if (order_type != 0 && order_type != 1) return ME_V_BAD_TYPE;
+  if (order_type == 0) {  // LIMIT
+    if (price <= 0) return ME_V_BAD_PRICE;
+    long long q4;
+    int rc = me_normalize_to_q4(price, scale, &q4);
+    if (rc == ME_ERR_SCALE) return ME_V_BAD_SCALE;
+    if (rc == ME_ERR_OVERFLOW) return ME_V_PRICE_OVERFLOW;
+    if (q4 > max_price_q4) return ME_V_PRICE_OVERFLOW;
+    if (q4 <= 0) return ME_V_BAD_PRICE;  // truncated to zero at Q4
+  } else {
+    if (scale < 0 || scale > 18) return ME_V_BAD_SCALE;
+  }
+  return ME_V_OK;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// 2. MeRing: bounded MPSC op ring with timed batch drain
+// ===========================================================================
+
+extern "C" {
+
+// Fixed-size op record; `tag` is an opaque producer cookie (the Python side
+// maps it back to the op's future + host metadata).
+struct MeOp {
+  uint64_t tag;
+  int32_t sym;
+  int32_t op;     // 0 noop / 1 submit / 2 cancel (engine/kernel.py opcodes)
+  int32_t side;   // BUY=1 / SELL=2
+  int32_t otype;  // LIMIT=0 / MARKET=1
+  int32_t price;  // Q4, int32 device lane
+  int32_t qty;
+  int32_t oid;
+  int32_t pad;
+};
+
+}  // extern "C"
+
+namespace {
+
+class MeRing {
+ public:
+  explicit MeRing(uint32_t capacity) : cap_(capacity) {}
+
+  bool push(const MeOp& op) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (closed_ || q_.size() >= cap_) {
+      dropped_.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    q_.push_back(op);
+    cv_.notify_one();
+    return true;
+  }
+
+  // Blocks until at least one op is available (or the ring closes), then
+  // drains until `max` ops are taken or `window_us` elapses from the first
+  // op — the dispatcher's latency/throughput knob, in native code.
+  // Returns the count, or -1 when closed and empty.
+  int pop_batch(MeOp* out, uint32_t max, uint64_t window_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return -1;  // closed and drained
+    uint32_t n = 0;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::microseconds(window_us);
+    for (;;) {
+      while (n < max && !q_.empty()) {
+        out[n++] = q_.front();
+        q_.pop_front();
+      }
+      if (n >= max || closed_) break;
+      if (cv_.wait_until(lk, deadline,
+                         [&] { return closed_ || !q_.empty(); })) {
+        if (q_.empty()) break;  // woke on close
+        continue;
+      }
+      break;  // window elapsed
+    }
+    return static_cast<int>(n);
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    cv_.notify_all();
+  }
+
+  uint64_t dropped() const { return dropped_.load(std::memory_order_relaxed); }
+  size_t size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  const uint32_t cap_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<MeOp> q_;
+  bool closed_ = false;
+  std::atomic<uint64_t> dropped_{0};
+};
+
+}  // namespace
+
+extern "C" {
+
+// All entry points tolerate a null handle (a destroyed ring behaves as
+// closed) — a use-after-close from a binding must degrade, not segfault.
+void* me_ring_create(uint32_t capacity) { return new MeRing(capacity); }
+void me_ring_destroy(void* r) { delete static_cast<MeRing*>(r); }
+int me_ring_push(void* r, const MeOp* op) {
+  if (!r || !op) return 0;
+  return static_cast<MeRing*>(r)->push(*op) ? 1 : 0;
+}
+int me_ring_pop_batch(void* r, MeOp* out, uint32_t max, uint64_t window_us) {
+  if (!r || !out) return -1;
+  return static_cast<MeRing*>(r)->pop_batch(out, max, window_us);
+}
+void me_ring_close(void* r) {
+  if (r) static_cast<MeRing*>(r)->close();
+}
+uint64_t me_ring_dropped(void* r) {
+  return r ? static_cast<MeRing*>(r)->dropped() : 0;
+}
+uint64_t me_ring_size(void* r) {
+  return r ? static_cast<MeRing*>(r)->size() : 0;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// 3. MeSink: async batched SQLite writer
+// ===========================================================================
+//
+// Batch wire format (little-endian, packed by the Python binding):
+//   u32 n_orders   then per order:
+//     str order_id, str client_id, str symbol        (str = u16 len + bytes)
+//     u8 side, u8 otype, u8 has_price, i64 price, i64 qty, i64 remaining,
+//     u8 status
+//   u32 n_updates  then per update: str order_id, u8 status, i64 remaining
+//   u32 n_fills    then per fill:
+//     str order_id, str counter_order_id, i64 price, i64 qty, i64 ts
+//
+// Schema matches matching_engine_tpu/storage/storage.py (which itself is the
+// reference schema at src/storage/storage.cpp:28-68 with SURVEY §2.9 bug
+// fixes); the two sinks are interchangeable and row-for-row identical.
+
+namespace {
+
+const char kSchema[] =
+    "CREATE TABLE IF NOT EXISTS orders ("
+    "  order_id            TEXT PRIMARY KEY,"
+    "  client_id           TEXT NOT NULL,"
+    "  symbol              TEXT NOT NULL,"
+    "  side                INTEGER NOT NULL CHECK (side IN (1, 2)),"
+    "  order_type          INTEGER NOT NULL CHECK (order_type IN (0, 1)),"
+    "  price               INTEGER,"
+    "  quantity            INTEGER NOT NULL CHECK (quantity > 0),"
+    "  remaining_quantity  INTEGER NOT NULL CHECK (remaining_quantity >= 0),"
+    "  status              INTEGER NOT NULL CHECK (status BETWEEN 0 AND 4),"
+    "  created_ts          INTEGER NOT NULL,"
+    "  updated_ts          INTEGER NOT NULL);"
+    "CREATE INDEX IF NOT EXISTS idx_orders_symbol_status"
+    "  ON orders (symbol, status);"
+    "CREATE INDEX IF NOT EXISTS idx_orders_client ON orders (client_id);"
+    "CREATE TABLE IF NOT EXISTS fills ("
+    "  fill_id           INTEGER PRIMARY KEY AUTOINCREMENT,"
+    "  order_id          TEXT NOT NULL REFERENCES orders (order_id),"
+    "  counter_order_id  TEXT NOT NULL,"
+    "  price             INTEGER NOT NULL,"
+    "  quantity          INTEGER NOT NULL CHECK (quantity > 0),"
+    "  ts                INTEGER NOT NULL);"
+    "CREATE INDEX IF NOT EXISTS idx_fills_order ON fills (order_id);";
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), end_(p + n) {}
+  bool u8(uint8_t* v) {
+    if (p_ + 1 > end_) return false;
+    *v = *p_++;
+    return true;
+  }
+  bool u32(uint32_t* v) {
+    if (p_ + 4 > end_) return false;
+    std::memcpy(v, p_, 4);
+    p_ += 4;
+    return true;
+  }
+  bool i64(long long* v) {
+    if (p_ + 8 > end_) return false;
+    std::memcpy(v, p_, 8);
+    p_ += 8;
+    return true;
+  }
+  bool str(std::string* s) {
+    uint16_t len;
+    if (p_ + 2 > end_) return false;
+    std::memcpy(&len, p_, 2);
+    p_ += 2;
+    if (p_ + len > end_) return false;
+    s->assign(reinterpret_cast<const char*>(p_), len);
+    p_ += len;
+    return true;
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+long long now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+class MeSink {
+ public:
+  // path_ must be fully constructed before worker_ launches run() — members
+  // initialize in declaration order and worker_ is declared last.
+  MeSink(const char* path, uint32_t max_queue)
+      : path_(path), max_queue_(max_queue), worker_([this] { run(); }) {}
+
+  ~MeSink() {
+    close();
+    if (worker_.joinable()) worker_.join();
+  }
+
+  bool open_ok() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_opened_.wait(lk, [&] { return opened_; });
+    return open_ok_;
+  }
+
+  void flush() {
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t target = seq_in_;
+    cv_flushed_.wait(lk, [&] { return seq_done_ >= target || closed_; });
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (closed_) return;
+      closing_ = true;
+      cv_.notify_all();
+    }
+    // run() drains the queue, then observes closing_ and exits; it sets
+    // closed_ last so flush()/submit() waiters wake correctly.
+  }
+
+  void stats(uint64_t* batches, uint64_t* rows, uint64_t* dropped,
+             uint64_t* errors) {
+    *batches = batches_.load(std::memory_order_relaxed);
+    *rows = rows_.load(std::memory_order_relaxed);
+    *dropped = dropped_.load(std::memory_order_relaxed);
+    *errors = errors_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  void run() {
+    // The worker owns the connection end to end (SQLite connections are not
+    // meant to hop threads); open/schema happen here, open_ok() rendezvouses.
+    bool ok = open_db();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      opened_ = true;
+      open_ok_ = ok;
+      cv_opened_.notify_all();
+    }
+    if (!ok) {
+      std::lock_guard<std::mutex> lk(mu_);
+      closed_ = true;
+      cv_flushed_.notify_all();
+      cv_space_.notify_all();
+      return;
+    }
+    for (;;) {
+      std::vector<std::vector<uint8_t>> work;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return closing_ || !q_.empty(); });
+        if (q_.empty() && closing_) break;
+        // Coalesce everything queued into one transaction (async_sink.py
+        // does the same): fewer fsyncs, same durability model.
+        work.swap(q_);
+        cv_space_.notify_all();
+      }
+      apply(work);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        seq_done_ += work.size();
+        cv_flushed_.notify_all();
+      }
+    }
+    if (db_) {
+      for (auto* s : {ins_order_, upd_order_, ins_fill_})
+        if (s) sqlite3_finalize(s);
+      sqlite3_close_v2(db_);
+      db_ = nullptr;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    cv_flushed_.notify_all();
+    cv_space_.notify_all();
+  }
+
+  bool open_db() {
+    if (sqlite3_open_v2(path_.c_str(), &db_,
+                        SQLITE_OPEN_READWRITE | SQLITE_OPEN_CREATE |
+                            SQLITE_OPEN_FULLMUTEX,
+                        nullptr) != SQLITE_OK)
+      return false;
+    sqlite3_busy_timeout(db_, 5000);  // reference storage.cpp:14
+    // Reference storage.cpp:17-24 pragmas.
+    if (sqlite3_exec(db_,
+                     "PRAGMA journal_mode=WAL;"
+                     "PRAGMA synchronous=NORMAL;"
+                     "PRAGMA foreign_keys=ON;",
+                     nullptr, nullptr, nullptr) != SQLITE_OK)
+      return false;
+    if (sqlite3_exec(db_, kSchema, nullptr, nullptr, nullptr) != SQLITE_OK)
+      return false;
+    auto prep = [&](const char* sql, sqlite3_stmt** st) {
+      return sqlite3_prepare_v2(db_, sql, -1, st, nullptr) == SQLITE_OK;
+    };
+    return prep(
+               "INSERT INTO orders (order_id, client_id, symbol, side,"
+               " order_type, price, quantity, remaining_quantity, status,"
+               " created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+               &ins_order_) &&
+           prep(
+               "UPDATE orders SET status = ?, remaining_quantity = ?,"
+               " updated_ts = ? WHERE order_id = ?",
+               &upd_order_) &&
+           prep(
+               "INSERT INTO fills (order_id, counter_order_id, price,"
+               " quantity, ts) VALUES (?,?,?,?,?)",
+               &ins_fill_);
+  }
+
+  void apply(const std::vector<std::vector<uint8_t>>& work) {
+    long long ts = now_us();
+    if (sqlite3_exec(db_, "BEGIN", nullptr, nullptr, nullptr) != SQLITE_OK) {
+      errors_.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+    bool ok = true;
+    uint64_t nrows = 0;
+    for (const auto& buf : work) {
+      if (!apply_one(buf, ts, &nrows)) {
+        ok = false;
+        break;
+      }
+    }
+    if (ok && sqlite3_exec(db_, "COMMIT", nullptr, nullptr, nullptr) ==
+                  SQLITE_OK) {
+      batches_.fetch_add(work.size(), std::memory_order_relaxed);
+      rows_.fetch_add(nrows, std::memory_order_relaxed);
+    } else {
+      sqlite3_exec(db_, "ROLLBACK", nullptr, nullptr, nullptr);
+      errors_.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+
+  bool step_reset(sqlite3_stmt* st) {
+    bool ok = sqlite3_step(st) == SQLITE_DONE;
+    sqlite3_reset(st);
+    return ok;
+  }
+
+  bool apply_one(const std::vector<uint8_t>& buf, long long ts,
+                 uint64_t* nrows) {
+    Reader r(buf.data(), buf.size());
+    uint32_t n;
+    if (!r.u32(&n)) return false;
+    for (uint32_t i = 0; i < n; i++) {
+      std::string oid, cid, sym;
+      uint8_t side, otype, has_price, status;
+      long long price, qty, remaining;
+      if (!(r.str(&oid) && r.str(&cid) && r.str(&sym) && r.u8(&side) &&
+            r.u8(&otype) && r.u8(&has_price) && r.i64(&price) &&
+            r.i64(&qty) && r.i64(&remaining) && r.u8(&status)))
+        return false;
+      sqlite3_bind_text(ins_order_, 1, oid.c_str(), -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins_order_, 2, cid.c_str(), -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins_order_, 3, sym.c_str(), -1, SQLITE_TRANSIENT);
+      sqlite3_bind_int64(ins_order_, 4, side);
+      sqlite3_bind_int64(ins_order_, 5, otype);
+      // MARKET orders persist NULL price — fixing the reference's dormant
+      // bug of storing a bogus as-is price (SURVEY §2.9c).
+      if (has_price)
+        sqlite3_bind_int64(ins_order_, 6, price);
+      else
+        sqlite3_bind_null(ins_order_, 6);
+      sqlite3_bind_int64(ins_order_, 7, qty);
+      sqlite3_bind_int64(ins_order_, 8, remaining);
+      sqlite3_bind_int64(ins_order_, 9, status);
+      sqlite3_bind_int64(ins_order_, 10, ts);
+      sqlite3_bind_int64(ins_order_, 11, ts);
+      if (!step_reset(ins_order_)) return false;
+      (*nrows)++;
+    }
+    if (!r.u32(&n)) return false;
+    for (uint32_t i = 0; i < n; i++) {
+      std::string oid;
+      uint8_t status;
+      long long remaining;
+      if (!(r.str(&oid) && r.u8(&status) && r.i64(&remaining))) return false;
+      sqlite3_bind_int64(upd_order_, 1, status);
+      sqlite3_bind_int64(upd_order_, 2, remaining);
+      sqlite3_bind_int64(upd_order_, 3, ts);
+      sqlite3_bind_text(upd_order_, 4, oid.c_str(), -1, SQLITE_TRANSIENT);
+      if (!step_reset(upd_order_)) return false;
+      (*nrows)++;
+    }
+    if (!r.u32(&n)) return false;
+    for (uint32_t i = 0; i < n; i++) {
+      std::string oid, coid;
+      long long price, qty, fts;
+      if (!(r.str(&oid) && r.str(&coid) && r.i64(&price) && r.i64(&qty) &&
+            r.i64(&fts)))
+        return false;
+      // All six placeholders bound — the reference's dormant add_fill binds
+      // 5 of 6 and can never execute (SURVEY §2.9b).
+      sqlite3_bind_text(ins_fill_, 1, oid.c_str(), -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins_fill_, 2, coid.c_str(), -1, SQLITE_TRANSIENT);
+      sqlite3_bind_int64(ins_fill_, 3, price);
+      sqlite3_bind_int64(ins_fill_, 4, qty);
+      sqlite3_bind_int64(ins_fill_, 5, fts ? fts : ts);
+      if (!step_reset(ins_fill_)) return false;
+      (*nrows)++;
+    }
+    return true;
+  }
+
+  std::string path_;
+  const uint32_t max_queue_;
+  sqlite3* db_ = nullptr;
+  sqlite3_stmt* ins_order_ = nullptr;
+  sqlite3_stmt* upd_order_ = nullptr;
+  sqlite3_stmt* ins_fill_ = nullptr;
+
+  std::mutex mu_;
+  std::condition_variable cv_, cv_space_, cv_flushed_, cv_opened_;
+  std::vector<std::vector<uint8_t>> q_;
+  bool closing_ = false;
+  bool closed_ = false;
+  bool opened_ = false;
+  bool open_ok_ = false;
+  uint64_t seq_in_ = 0;   // guarded by mu_ (incremented in me_sink_submit)
+  uint64_t seq_done_ = 0;
+  std::atomic<uint64_t> batches_{0}, rows_{0}, dropped_{0}, errors_{0};
+  std::thread worker_;
+
+  friend bool sink_submit_counted(MeSink*, const uint8_t*, size_t, bool);
+};
+
+bool sink_submit_counted(MeSink* s, const uint8_t* buf, size_t len,
+                         bool block) {
+  // seq_in_ must advance under mu_ together with the queue push so flush()
+  // targets are exact; wrap submit to do both.
+  std::vector<uint8_t> copy(buf, buf + len);
+  std::unique_lock<std::mutex> lk(s->mu_);
+  if (block) {
+    s->cv_space_.wait(
+        lk, [&] { return s->closed_ || s->closing_ ||
+                         s->q_.size() < s->max_queue_; });
+  }
+  if (s->closed_ || s->closing_ || s->q_.size() >= s->max_queue_) {
+    s->dropped_.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  s->q_.push_back(std::move(copy));
+  s->seq_in_++;
+  s->cv_.notify_one();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* me_sink_open(const char* path, uint32_t max_queue) {
+  auto* s = new MeSink(path, max_queue ? max_queue : 4096);
+  if (!s->open_ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int me_sink_submit(void* h, const uint8_t* buf, uint64_t len, int block) {
+  if (!h || !buf) return 0;
+  return sink_submit_counted(static_cast<MeSink*>(h), buf, len, block != 0)
+             ? 1
+             : 0;
+}
+
+void me_sink_flush(void* h) {
+  if (h) static_cast<MeSink*>(h)->flush();
+}
+
+void me_sink_stats(void* h, uint64_t* batches, uint64_t* rows,
+                   uint64_t* dropped, uint64_t* errors) {
+  if (!h) {
+    *batches = *rows = *dropped = *errors = 0;
+    return;
+  }
+  static_cast<MeSink*>(h)->stats(batches, rows, dropped, errors);
+}
+
+void me_sink_close(void* h) { delete static_cast<MeSink*>(h); }
+
+}  // extern "C"

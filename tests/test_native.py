@@ -1,0 +1,293 @@
+"""Native (C++) runtime layer: parity with the pure-Python twins.
+
+Covers native/me_native.cpp via the ctypes bindings:
+- Q4 normalization bit-parity with domain.price.normalize_to_q4, including
+  the reference's oracle values (tests/test_price.cpp) and error paths;
+- submit-validation codes vs the service's reject rules;
+- MeRing FIFO / multi-producer / windowed-batch semantics;
+- MeSink SQLite output row-for-row identical to Storage.apply_batch;
+- full server stack on the native runtime with fills persisting.
+"""
+
+import threading
+
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.domain.order import (
+    MAX_CLIENT_ID_BYTES,
+    MAX_QUANTITY,
+    MAX_SYMBOL_BYTES,
+    validate_submit,
+)
+from matching_engine_tpu.domain.price import (
+    MAX_DEVICE_PRICE_Q4,
+    PriceError,
+    normalize_to_q4,
+)
+from matching_engine_tpu.storage import FillRow, Storage
+
+pytestmark = pytest.mark.skipif(
+    not me_native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+# -- domain -----------------------------------------------------------------
+
+CASES = [
+    # (price, scale) — reference oracle rows (test_price.cpp:6-14) + extremes
+    (10000, 8), (10050, 9), (123, 2), (7, 0), (1, 4), (0, 0),
+    (-10050, 9), (-123, 2), (99999999999999, 10), (2**62, 18),
+    (-(2**62), 18), (10**14, 0),
+]
+
+
+@pytest.mark.parametrize("price,scale", CASES)
+def test_normalize_parity(price, scale):
+    try:
+        expect = normalize_to_q4(price, scale)
+    except PriceError:
+        with pytest.raises(PriceError):
+            me_native.normalize_to_q4(price, scale)
+        return
+    assert me_native.normalize_to_q4(price, scale) == expect
+
+
+@pytest.mark.parametrize("scale", [-1, 19, 100])
+def test_normalize_bad_scale(scale):
+    with pytest.raises(PriceError):
+        me_native.normalize_to_q4(1, scale)
+
+
+def test_normalize_overflow():
+    with pytest.raises(PriceError):
+        me_native.normalize_to_q4(2**62, 0)  # *10^4 overflows int64
+
+
+def test_validate_codes():
+    # v(symbol_len, client_id_len, qty, side, otype, price, scale)
+    v = me_native.validate_submit_code
+    m = MAX_DEVICE_PRICE_Q4
+    assert v(3, 2, 5, 1, 0, 10000, 4) == 0
+    assert v(0, 2, 5, 1, 0, 10000, 4) == 1          # empty symbol
+    assert v(3, 2, 0, 1, 0, 10000, 4) == 2          # qty <= 0
+    assert v(3, 2, 5, 1, 0, 0, 4) == 3              # LIMIT price <= 0
+    assert v(3, 2, 5, 1, 0, 10000, 42) == 4         # scale out of range
+    assert v(3, 2, 5, 1, 0, 2**62, 0) == 5          # int64 overflow upscale
+    assert v(3, 2, 5, 1, 0, m + 1, 4) == 5          # over device lane ceiling
+    assert v(3, 2, 5, 1, 0, 10050, 9) == 3          # truncates to 0 at Q4
+    assert v(3, 2, 5, 1, 1, 0, 4) == 0              # MARKET: no price checks
+    assert v(3, 2, 5, 1, 1, 0, 42) == 4             # ...but scale still ranged
+    assert v(3, 2, MAX_QUANTITY + 1, 1, 0, 10000, 4) == 6
+    assert v(3, 2, 5, 0, 0, 10000, 4) == 7          # bad side
+    assert v(3, 2, 5, 1, 7, 10000, 4) == 8          # bad order type
+    assert v(MAX_SYMBOL_BYTES + 1, 2, 5, 1, 0, 10000, 4) == 9
+    assert v(3, MAX_CLIENT_ID_BYTES + 1, 5, 1, 0, 10000, 4) == 10
+
+
+def test_validate_parity_with_python(tmp_path):
+    """The native predicate accepts/rejects exactly like validate_submit."""
+    import itertools
+
+    from matching_engine_tpu.proto import pb2
+
+    symbols = ["", "S", "X" * MAX_SYMBOL_BYTES, "X" * (MAX_SYMBOL_BYTES + 1)]
+    clients = ["c", "c" * (MAX_CLIENT_ID_BYTES + 1)]
+    qtys = [0, 1, MAX_QUANTITY, MAX_QUANTITY + 1]
+    sides = [0, 1, 2, 3]
+    otypes = [0, 1, 5]
+    prices = [(0, 4), (10000, 4), (10050, 9), (2**62, 0),
+              (MAX_DEVICE_PRICE_Q4 + 1, 4), (100, 19)]
+    for sym, cid, qty, side, otype, (price, scale) in itertools.product(
+        symbols, clients, qtys, sides, otypes, prices
+    ):
+        req = pb2.OrderRequest(
+            client_id=cid, symbol=sym, side=side, order_type=otype,
+            price=price, scale=scale, quantity=qty,
+        )
+        py_err = validate_submit(req)
+        code = me_native.validate_submit_code(
+            len(sym.encode()), len(cid.encode()), qty, side, otype, price,
+            scale,
+        )
+        assert (py_err is None) == (code == 0), (
+            f"divergence for {req}: py={py_err!r} native={code}"
+        )
+
+
+# -- ring -------------------------------------------------------------------
+
+def test_ring_fifo_and_close():
+    r = me_native.NativeRing(64)
+    for i in range(10):
+        assert r.push(i + 1, i, 1, 1, 0, 100 + i, 5, i)
+    got = r.pop_batch(max_ops=16, window_us=1000)
+    assert [g[0] for g in got] == list(range(1, 11))
+    assert got[3][5] == 103  # price carried through
+    r.close()
+    assert r.pop_batch(16, 1000) is None  # closed + empty
+    r.destroy()
+
+
+def test_ring_window_caps_batch():
+    r = me_native.NativeRing(64)
+    for i in range(8):
+        r.push(i + 1, 0, 1, 1, 0, 1, 1, i)
+    got = r.pop_batch(max_ops=3, window_us=10_000)
+    assert len(got) == 3  # max_ops is a hard cap
+    got = r.pop_batch(max_ops=100, window_us=1)
+    assert len(got) == 5  # drains the rest, window expires
+    r.close()
+    r.destroy()
+
+
+def test_ring_capacity_drops():
+    r = me_native.NativeRing(4)
+    assert all(r.push(i, 0, 1, 1, 0, 1, 1, 0) for i in range(1, 5))
+    assert not r.push(9, 0, 1, 1, 0, 1, 1, 0)  # full
+    assert r.dropped == 1
+    r.close()
+    r.destroy()
+
+
+def test_ring_multi_producer():
+    r = me_native.NativeRing(1 << 12)
+    n_threads, per = 8, 200
+
+    def produce(t):
+        for i in range(per):
+            tag = t * 1000 + i
+            while not r.push(tag, t, 1, 1, 0, 1, 1, 0):
+                pass
+
+    threads = [threading.Thread(target=produce, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    got = []
+    while len(got) < n_threads * per:
+        batch = r.pop_batch(max_ops=128, window_us=500)
+        assert batch is not None
+        got.extend(batch)
+    for t in threads:
+        t.join()
+    tags = [g[0] for g in got]
+    assert sorted(tags) == sorted(t * 1000 + i for t in range(n_threads) for i in range(per))
+    # Per-producer order preserved (the ring is globally FIFO).
+    for t in range(n_threads):
+        mine = [x for x in tags if x // 1000 == t]
+        assert mine == sorted(mine)
+    r.close()
+    r.destroy()
+
+
+# -- sink -------------------------------------------------------------------
+
+ORDERS = [
+    ("OID-1", "cA", "AAPL", 1, 0, 101_0000, 10, 10, 0),
+    ("OID-2", "cB", "AAPL", 2, 0, 100_0000, 4, 0, 2),
+    ("OID-3", "cB", "MSFT", 2, 1, None, 7, 0, 3),   # MARKET: NULL price
+]
+UPDATES = [("OID-1", 1, 6), ("OID-2", 2, 0)]
+FILLS = [
+    FillRow("OID-2", "OID-1", 101_0000, 4, 0),
+    FillRow("OID-1", "OID-2", 101_0000, 4, 1234567),
+]
+
+
+def _rows(db_path):
+    st = Storage(db_path)
+    orders = st._conn.execute(
+        "SELECT order_id, client_id, symbol, side, order_type, price, "
+        "quantity, remaining_quantity, status FROM orders ORDER BY order_id"
+    ).fetchall()
+    fills = st._conn.execute(
+        "SELECT order_id, counter_order_id, price, quantity FROM fills "
+        "ORDER BY fill_id"
+    ).fetchall()
+    st.close()
+    return orders, fills
+
+
+def test_sink_row_parity_with_python_storage(tmp_path):
+    py_db = str(tmp_path / "py.db")
+    st = Storage(py_db)
+    assert st.init()
+    assert st.apply_batch(list(ORDERS), list(UPDATES), list(FILLS))
+    st.close()
+
+    nat_db = str(tmp_path / "nat.db")
+    sink = me_native.NativeStorageSink(nat_db)
+    assert sink.submit(orders=list(ORDERS), updates=list(UPDATES), fills=list(FILLS))
+    sink.flush()
+    stats = sink.stats()
+    sink.close()
+
+    assert stats["errors"] == 0 and stats["rows"] == len(ORDERS) + len(UPDATES) + len(FILLS)
+    assert _rows(py_db) == _rows(nat_db)
+
+
+def test_sink_multiple_batches_and_reread(tmp_path):
+    db = str(tmp_path / "s.db")
+    sink = me_native.NativeStorageSink(db)
+    for k in range(20):
+        oid = f"OID-{k + 10}"
+        assert sink.submit(orders=[(oid, "c", "S", 1, 0, 1000 + k, 5, 5, 0)])
+    sink.flush()
+    sink.close()
+    st = Storage(db)
+    assert st.count("orders") == 20
+    assert st.load_next_oid_seq() == 30  # OID sequence recovery over native rows
+    assert st.best_bid("S") == (1019, 5)
+    st.close()
+
+
+def test_sink_empty_submit_is_noop(tmp_path):
+    sink = me_native.NativeStorageSink(str(tmp_path / "e.db"))
+    assert sink.submit()  # nothing to write
+    sink.flush()
+    assert sink.stats()["batches"] == 0
+    sink.close()
+
+
+# -- full stack on the native runtime --------------------------------------
+
+def test_server_native_runtime_end_to_end(tmp_path):
+    import grpc
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.dispatcher import NativeRingDispatcher
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    db = str(tmp_path / "nat_e2e.db")
+    cfg = EngineConfig(num_symbols=4, capacity=8, batch=4)
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1.0, log=False, native=True
+    )
+    assert isinstance(parts["dispatcher"], NativeRingDispatcher)
+    assert isinstance(parts["sink"], me_native.NativeStorageSink)
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(channel)
+        r1 = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="a", symbol="S", order_type=pb2.LIMIT, side=pb2.BUY,
+            price=10000, scale=4, quantity=5), timeout=10)
+        r2 = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="b", symbol="S", order_type=pb2.LIMIT, side=pb2.SELL,
+            price=10000, scale=4, quantity=3), timeout=10)
+        assert r1.success and r2.success
+        parts["sink"].flush()
+        st = Storage(db)
+        assert st.count("fills") == 1  # one row per match, taker-keyed
+        f = st.fills_for_order(r2.order_id)[0]
+        assert f[1] == r1.order_id and f[2] == 10000 and f[3] == 3
+        row = st.get_order(r1.order_id)
+        assert row[7] == 2 and row[8] == 1  # remaining 2, PARTIALLY_FILLED
+        row2 = st.get_order(r2.order_id)
+        assert row2[7] == 0 and row2[8] == 2  # FILLED
+        st.close()
+        channel.close()
+    finally:
+        shutdown(server, parts)
