@@ -218,7 +218,16 @@ def _top_of_book(price, qty, best_is_max):
 def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     """Un-jitted engine step body (shared by the jit'd single-device entry
     point below and the shard_map-wrapped multi-chip step in
-    parallel/sharding.py, where each shard runs this on its symbol slice)."""
+    parallel/sharding.py, where each shard runs this on its symbol slice).
+
+    With cfg.pallas=True the match loop runs as a Pallas TPU kernel
+    (engine/pallas_kernel.py) — same algorithm, books pinned in VMEM across
+    the whole batch; results are bit-identical (tests/test_pallas.py)."""
+    if cfg.pallas:
+        from matching_engine_tpu.engine.pallas_kernel import match_batch_pallas
+
+        new_book, per_order = match_batch_pallas(cfg, book, orders)
+        return new_book, finalize_step(cfg, new_book, orders, *per_order)
     sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
     # vmap over the symbol axis; scan over the batch axis inside.
     new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(
@@ -226,8 +235,24 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     )(sym_book, orders)
 
     new_book = BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq)
+    return new_book, finalize_step(
+        cfg, new_book, orders, status, filled, remaining, f_oid, f_qty, f_price
+    )
 
-    # ---- global fill compaction -----------------------------------------
+
+def finalize_step(
+    cfg: EngineConfig,
+    new_book: BookBatch,
+    orders: OrderBatch,
+    status,
+    filled,
+    remaining,
+    f_oid,
+    f_qty,
+    f_price,
+) -> StepOutput:
+    """Shared epilogue: compact the [S, B, CAP] potential-fill tensor into
+    the bounded global fill log and compute post-step top-of-book."""
     # [S, B, CAP] -> flat, ordered (symbol, batch position, priority rank).
     s, b, cap = f_qty.shape
     flat_qty = f_qty.reshape(-1)
@@ -244,7 +269,7 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     taker = jnp.broadcast_to(orders.oid[:, :, None], (s, b, cap))
     best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty, True)
     best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
-    out = StepOutput(
+    return StepOutput(
         status=status,
         filled=filled,
         remaining=remaining,
@@ -260,7 +285,6 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
         best_ask=best_ask,
         ask_size=ask_size,
     )
-    return new_book, out
 
 
 # Single-device entry point. The book argument is donated: the update is

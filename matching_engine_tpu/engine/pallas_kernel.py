@@ -1,0 +1,325 @@
+"""Pallas TPU kernel for the match loop: books pinned in VMEM per batch.
+
+The XLA path (engine/kernel.py) expresses the per-symbol order scan as
+`vmap(lax.scan)`; XLA schedules each scan step as its own fused loop body
+with the book carried through HBM-visible buffers. This kernel instead
+grids over symbol blocks and runs the whole B-order loop inside one
+program, with the block's book slices resident in VMEM end to end — one
+HBM read and one HBM write per book field per engine step, regardless of B
+(SURVEY.md §7 step 5: "Pallas kernel for the match inner loop").
+
+Algorithm parity: this is the same masked priority-matrix allocation as
+kernel._match_one, vectorized over a [SB] symbol-block axis, with the two
+scatter sites (fill-by-rank, global compaction) replaced by one-hot
+reductions and left to the shared epilogue respectively. All math is int32;
+outputs are bit-identical to the XLA path and the host oracle
+(tests/test_pallas.py asserts both, in interpret mode; the compiled kernel
+was verified bit-identical on TPU hardware as well).
+
+STATUS — correct but not yet competitive. Measured on a single TPU chip at
+the bench config (S=1024, CAP=128, B=16): XLA scan path ~215M orders/s,
+this kernel ~0.3M orders/s. The [SB, CAP, CAP] priority-matrix broadcasts
+(`key[:, :, None]` — a lane->sublane transpose per order per field) relayout
+poorly under Mosaic, and per-symbol 2D blocks are not an option (block
+sublane dims must be multiples of 8). The XLA formulation is HBM-bound on
+the scan carry and already 20x the north-star target, so this path stays
+flag-gated (EngineConfig.pallas=False by default) as the seed for future
+kernel work, not the production path.
+
+TPU notes (per /opt/skills/guides/pallas_guide.md):
+- iota is 2D (`broadcasted_iota`); all blocks carry an [SB, ...] leading
+  axis so every intermediate is >= 2D.
+- Mosaic rejects vector i1/i8 masks (arith.trunci to i1 fails to lower),
+  so all masks are int32 0/1 tensors and selection is arithmetic (_sel).
+- book blocks are [SB, CAP] int32 — CAP is the lane dim (128-friendly);
+  the [SB, CAP, CAP] priority matrix at SB=8, CAP=128 is 512 KiB of VMEM.
+- input_output_aliases donate the nine book buffers in place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+)
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    FILLED,
+    MARKET,
+    NEW,
+    NOOP_STATUS,
+    OP_CANCEL,
+    OP_SUBMIT,
+    PARTIALLY_FILLED,
+    REJECTED,
+    BUY,
+)
+
+
+def _symbol_block(num_symbols: int) -> int:
+    """Largest power-of-two block <= 8 dividing the symbol axis."""
+    for sb in (8, 4, 2, 1):
+        if num_symbols % sb == 0:
+            return sb
+    return 1
+
+
+def _match_kernel(
+    # book refs [SB, CAP] (+ next_seq [SB, 1])
+    bid_price_ref, bid_qty_ref, bid_oid_ref, bid_seq_ref,
+    ask_price_ref, ask_qty_ref, ask_oid_ref, ask_seq_ref, next_seq_ref,
+    # order refs [SB, B]
+    op_ref, side_ref, otype_ref, price_ref, qty_ref, oid_ref,
+    # outputs: aliased book refs, then per-order outputs
+    o_bid_price_ref, o_bid_qty_ref, o_bid_oid_ref, o_bid_seq_ref,
+    o_ask_price_ref, o_ask_qty_ref, o_ask_oid_ref, o_ask_seq_ref,
+    o_next_seq_ref,
+    status_ref, filled_ref, remaining_ref,      # [SB, B]
+    f_oid_ref, f_qty_ref, f_price_ref,          # [SB, B, CAP]
+    *, batch: int,
+):
+    cap = bid_price_ref.shape[1]
+    sb = bid_price_ref.shape[0]
+    idx = jax.lax.broadcasted_iota(I32, (sb, cap), 1)
+
+    # Mosaic note: boolean vectors (i1/i8) do not lower reliably on TPU, so
+    # every mask here is an int32 0/1 tensor (comparisons are cast
+    # immediately) and selection is arithmetic. `_sel` is exact even when
+    # (a - b) wraps: int32 is two's-complement mod-2^32, so b + (a-b)*1 == a
+    # regardless of intermediate overflow.
+    def m(cond):
+        return cond.astype(I32)
+
+    def _sel(mask, a, b):
+        return b + (a - b) * mask
+
+    book0 = (
+        bid_price_ref[:], bid_qty_ref[:], bid_oid_ref[:], bid_seq_ref[:],
+        ask_price_ref[:], ask_qty_ref[:], ask_oid_ref[:], ask_seq_ref[:],
+        next_seq_ref[:, 0],
+    )
+
+    def body(b, book):
+        (bid_price, bid_qty, bid_oid, bid_seq,
+         ask_price, ask_qty, ask_oid, ask_seq, next_seq) = book
+        op = op_ref[:, b]
+        side = side_ref[:, b]
+        otype = otype_ref[:, b]
+        price = price_ref[:, b]
+        qty = qty_ref[:, b]
+        oid = oid_ref[:, b]
+
+        m_submit = m(op == OP_SUBMIT)           # [SB]
+        m_cancel = m(op == OP_CANCEL)
+        m_buy = m(side == BUY)[:, None]         # [SB, 1]
+        m_market = m(otype == MARKET)
+
+        # ---- opposite side (maker candidates) ---------------------------
+        opp_price = _sel(m_buy, ask_price, bid_price)
+        opp_qty = _sel(m_buy, ask_qty, bid_qty)
+        opp_oid = _sel(m_buy, ask_oid, bid_oid)
+        opp_seq = _sel(m_buy, ask_seq, bid_seq)
+
+        key = _sel(m_buy, opp_price, -opp_price)
+        m_price_ok = _sel(
+            m_buy,
+            m(opp_price <= price[:, None]),
+            m(opp_price >= price[:, None]),
+        )
+        m_elig = (
+            m(opp_qty > 0)
+            * jnp.maximum(m_market[:, None], m_price_ok)
+            * m_submit[:, None]
+        )
+
+        # better[s, k, j]: maker k strictly ahead of maker j.
+        m_better = jnp.maximum(
+            m(key[:, :, None] < key[:, None, :]),
+            m(key[:, :, None] == key[:, None, :])
+            * m(opp_seq[:, :, None] < opp_seq[:, None, :]),
+        )
+        elig_qty = m_elig * opp_qty
+        ahead = jnp.sum(m_better * elig_qty[:, :, None], axis=1)
+
+        take_q = m_submit * qty
+        fill = m_elig * jnp.clip(take_q[:, None] - ahead, 0, opp_qty)
+        filled_total = jnp.sum(fill, axis=1)
+        remaining = take_q - filled_total
+        new_opp_qty = opp_qty - fill
+
+        # Priority rank of each eligible maker; filled slots are a priority
+        # prefix, so rank doubles as the fill-log slot. The XLA path
+        # scatters by rank; here a one-hot reduction produces the same
+        # rank-indexed rows without a scatter.
+        rank = jnp.sum(
+            m_better * m_elig[:, :, None] * m_elig[:, None, :], axis=1
+        )
+        m_has_fill = m(fill > 0)
+        onehot = m_has_fill[:, :, None] * m(rank[:, :, None] == idx[:, None, :])
+        f_oid_b = jnp.sum(onehot * opp_oid[:, :, None], axis=1)
+        f_qty_b = jnp.sum(onehot * fill[:, :, None], axis=1)
+        f_price_b = jnp.sum(onehot * opp_price[:, :, None], axis=1)
+
+        # ---- own side: rest a LIMIT remainder / cancel ------------------
+        own_price = _sel(m_buy, bid_price, ask_price)
+        own_qty = _sel(m_buy, bid_qty, ask_qty)
+        own_oid = _sel(m_buy, bid_oid, ask_oid)
+        own_seq = _sel(m_buy, bid_seq, ask_seq)
+
+        m_do_rest = m_submit * (1 - m_market) * m(remaining > 0)
+        m_free = m(own_qty == 0)
+        m_has_free = jnp.max(m_free, axis=1)
+        slot_idx = jnp.min(_sel(m_free, idx, cap), axis=1)
+        m_rested = m_do_rest * m_has_free
+
+        at_slot = m_rested[:, None] * m(idx == slot_idx[:, None])
+        own_price = _sel(at_slot, jnp.broadcast_to(price[:, None], own_price.shape), own_price)
+        own_qty = _sel(at_slot, jnp.broadcast_to(remaining[:, None], own_qty.shape), own_qty)
+        own_oid = _sel(at_slot, jnp.broadcast_to(oid[:, None], own_oid.shape), own_oid)
+        own_seq = _sel(at_slot, jnp.broadcast_to(next_seq[:, None], own_seq.shape), own_seq)
+        next_seq = next_seq + m_rested
+
+        cancel_mask = (
+            m_cancel[:, None] * m(own_oid == oid[:, None]) * m(own_qty > 0)
+        )
+        cancel_qty = jnp.sum(cancel_mask * own_qty, axis=1)
+        m_cancel_ok = jnp.max(cancel_mask, axis=1)
+        own_qty = own_qty * (1 - cancel_mask)
+
+        # ---- write back -------------------------------------------------
+        new_book = (
+            _sel(m_buy, own_price, opp_price),
+            _sel(m_buy, own_qty, new_opp_qty),
+            _sel(m_buy, own_oid, opp_oid),
+            _sel(m_buy, own_seq, opp_seq),
+            _sel(m_buy, opp_price, own_price),
+            _sel(m_buy, new_opp_qty, own_qty),
+            _sel(m_buy, opp_oid, own_oid),
+            _sel(m_buy, opp_seq, own_seq),
+            next_seq,
+        )
+
+        # ---- status -----------------------------------------------------
+        submit_status = _sel(
+            m(remaining == 0),
+            jnp.full_like(op, FILLED),
+            _sel(
+                m_market,
+                jnp.full_like(op, CANCELED),
+                _sel(
+                    m_rested,
+                    _sel(m(filled_total > 0),
+                         jnp.full_like(op, PARTIALLY_FILLED),
+                         jnp.full_like(op, NEW)),
+                    jnp.full_like(op, REJECTED),
+                ),
+            ),
+        )
+        cancel_status = _sel(
+            m_cancel_ok, jnp.full_like(op, CANCELED), jnp.full_like(op, REJECTED)
+        )
+        status = _sel(
+            m_submit,
+            submit_status,
+            _sel(m_cancel, cancel_status, jnp.full_like(op, NOOP_STATUS)),
+        ).astype(I32)
+        out_remaining = _sel(
+            m_submit, remaining, m_cancel * cancel_qty
+        ).astype(I32)
+
+        status_ref[:, pl.ds(b, 1)] = status[:, None]
+        filled_ref[:, pl.ds(b, 1)] = filled_total.astype(I32)[:, None]
+        remaining_ref[:, pl.ds(b, 1)] = out_remaining[:, None]
+        f_oid_ref[:, pl.ds(b, 1), :] = f_oid_b.astype(I32)[:, None, :]
+        f_qty_ref[:, pl.ds(b, 1), :] = f_qty_b.astype(I32)[:, None, :]
+        f_price_ref[:, pl.ds(b, 1), :] = f_price_b.astype(I32)[:, None, :]
+        return new_book
+
+    # B is static — a Python loop fully unrolls the order sequence (no
+    # data-dependent trip count; the scheduler pipelines across iterations).
+    book = book0
+    for b in range(batch):
+        book = body(b, book)
+    (o_bid_price_ref[:], o_bid_qty_ref[:], o_bid_oid_ref[:],
+     o_bid_seq_ref[:], o_ask_price_ref[:], o_ask_qty_ref[:],
+     o_ask_oid_ref[:], o_ask_seq_ref[:]) = book[:8]
+    o_next_seq_ref[:, 0] = book[8]
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def match_batch_pallas(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
+    """Run the match loop as a Pallas kernel.
+
+    Returns (new_book, (status, filled, remaining, f_oid, f_qty, f_price))
+    with the same shapes/semantics as the XLA scan path; callers feed the
+    per-order tuple to kernel.finalize_step.
+    """
+    s, cap, b = cfg.num_symbols, cfg.capacity, cfg.batch
+    sb = _symbol_block(s)
+    grid = (s // sb,)
+
+    interpret = cfg.pallas_interpret
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def row_spec():
+        return pl.BlockSpec((sb, cap), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    def seq_spec():
+        return pl.BlockSpec((sb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    def ord_spec():
+        return pl.BlockSpec((sb, b), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    def fill_spec():
+        return pl.BlockSpec(
+            (sb, b, cap), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        )
+
+    sds = jax.ShapeDtypeStruct
+    out_shape = (
+        *(sds((s, cap), I32) for _ in range(8)),   # book sides
+        sds((s, 1), I32),                          # next_seq
+        sds((s, b), I32), sds((s, b), I32), sds((s, b), I32),
+        sds((s, b, cap), I32), sds((s, b, cap), I32), sds((s, b, cap), I32),
+    )
+    out_specs = (
+        *(row_spec() for _ in range(8)),
+        seq_spec(),
+        ord_spec(), ord_spec(), ord_spec(),
+        fill_spec(), fill_spec(), fill_spec(),
+    )
+    in_specs = [
+        *(row_spec() for _ in range(8)),
+        seq_spec(),
+        *(ord_spec() for _ in range(6)),
+    ]
+
+    outs = pl.pallas_call(
+        functools.partial(_match_kernel, batch=b),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        # Donate the nine book buffers in place (input i -> output i).
+        input_output_aliases={i: i for i in range(9)},
+        interpret=interpret,
+    )(
+        book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
+        book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq,
+        book.next_seq[:, None],
+        orders.op, orders.side, orders.otype, orders.price, orders.qty,
+        orders.oid,
+    )
+    new_book = BookBatch(*outs[:8], next_seq=outs[8][:, 0])
+    status, filled, remaining, f_oid, f_qty, f_price = outs[9:]
+    return new_book, (status, filled, remaining, f_oid, f_qty, f_price)

@@ -110,7 +110,7 @@ def restore_runner(runner, path: str, storage=None) -> int:
     from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
 
     cfg, host_book, meta = load_checkpoint(path)
-    if cfg != runner.cfg:
+    if cfg.semantic_key() != runner.cfg.semantic_key():
         raise ValueError(
             f"checkpoint config {cfg} does not match runner config {runner.cfg}"
         )

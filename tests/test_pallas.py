@@ -1,0 +1,72 @@
+"""Pallas match-kernel parity: bit-identical to the XLA scan path.
+
+Runs in interpret mode on the CPU test platform (conftest forces cpu); the
+same kernel compiled on TPU hardware was verified bit-identical against the
+XLA path as part of the perf evaluation (see pallas_kernel.py docstring).
+The oracle chain is transitive: XLA path == oracle (test_kernel_parity),
+pallas == XLA path (here) => pallas == oracle.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import build_batches, random_order_stream
+from matching_engine_tpu.engine.kernel import engine_step
+
+
+def _run_parity(cfg, n_orders, seed, **stream_kw):
+    cfgp = dataclasses.replace(cfg, pallas=True)
+    stream = random_order_stream(cfg.num_symbols, n_orders, seed=seed, **stream_kw)
+    batches = build_batches(cfg, stream)
+    book_x, book_p = init_book(cfg), init_book(cfgp)
+    for i, ob in enumerate(batches):
+        book_x, out_x = engine_step(cfg, book_x, ob)
+        book_p, out_p = engine_step(cfgp, book_p, ob)
+        for f in out_x._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out_x, f)), np.asarray(getattr(out_p, f)),
+                err_msg=f"step {i} output field {f}",
+            )
+        for f in book_x._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(book_x, f)), np.asarray(getattr(book_p, f)),
+                err_msg=f"step {i} book field {f}",
+            )
+    return len(batches)
+
+
+def test_pallas_parity_mixed_stream():
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=1024)
+    n = _run_parity(
+        cfg, 400, seed=7, cancel_p=0.12, market_p=0.2,
+        price_base=9_950, price_levels=30, price_step=1, qty_max=40,
+    )
+    assert n > 5
+
+
+def test_pallas_parity_deep_books_and_sweeps():
+    # Market sweeps across many levels; books deep enough to overflow a side.
+    cfg = EngineConfig(num_symbols=4, capacity=8, batch=8, max_fills=512)
+    _run_parity(
+        cfg, 600, seed=11, cancel_p=0.05, market_p=0.35,
+        price_base=10_000, price_levels=10, price_step=3, qty_max=25,
+    )
+
+
+def test_pallas_parity_odd_symbol_axis():
+    # num_symbols not divisible by 8 exercises the smaller symbol blocks.
+    cfg = EngineConfig(num_symbols=6, capacity=16, batch=4, max_fills=512)
+    _run_parity(
+        cfg, 300, seed=13, cancel_p=0.1, market_p=0.1,
+        price_base=5_000, price_levels=20, price_step=2, qty_max=30,
+    )
+
+
+@pytest.mark.parametrize("s,expected", [(8, 8), (12, 4), (6, 2), (7, 1), (1024, 8)])
+def test_symbol_block_choice(s, expected):
+    from matching_engine_tpu.engine.pallas_kernel import _symbol_block
+
+    assert _symbol_block(s) == expected
