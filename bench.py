@@ -29,12 +29,20 @@ NORTH_STAR = 10_000_000  # orders/sec, BASELINE.json
 
 
 def main() -> None:
-    cfg = EngineConfig(num_symbols=1024, capacity=128, batch=16, max_fills=1 << 17)
+    # North-star condition (BASELINE.json): 4k symbols. batch=32 amortizes the
+    # per-step dispatch overhead over a longer in-kernel scan.
+    cfg = EngineConfig(num_symbols=4096, capacity=128, batch=32, max_fills=1 << 17)
     n_orders_per_wave = cfg.num_symbols * cfg.batch
 
     # Build a handful of full dispatches; cycle them during the timed loop.
-    # (Each wave is dense: every [S, B] slot is a real op.)
+    # (Each wave is dense: every [S, B] slot is a real op.)  Count real ops
+    # from the host-side batches BEFORE device_put: reading a device array
+    # back (np.asarray) mid-bench collapses the axon tunnel's async dispatch
+    # pipeline and slows every subsequent step by ~1000x.
+    import numpy as np
+
     waves = []
+    wave_ops = []
     for w in range(4):
         stream = random_order_stream(
             cfg.num_symbols, 4 * n_orders_per_wave, seed=w, cancel_p=0.10,
@@ -43,27 +51,30 @@ def main() -> None:
         )
         batches = build_batches(cfg, stream)
         # Keep only dense-enough leading dispatches.
-        waves.extend(jax.device_put(b) for b in batches[:2])
+        for b in batches[:2]:
+            wave_ops.append(int(np.count_nonzero(np.asarray(b.op))))
+            waves.append(jax.device_put(b))
 
     book = init_book(cfg)
     # Warmup: compile + one pass over every wave shape.
     book, out = engine_step(cfg, book, waves[0])
     jax.block_until_ready(out)
 
-    iters = 60
-    t0 = time.perf_counter()
-    for i in range(iters):
-        book, out = engine_step(cfg, book, waves[i % len(waves)])
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-
-    import numpy as np
-
-    real_ops = sum(
-        int(np.count_nonzero(np.asarray(waves[i % len(waves)].op)))
-        for i in range(iters)
-    )
-    value = real_ops / dt
+    # The tunneled device shows large run-to-run scheduling variance and a
+    # slow first-window ramp; discard one warm-up window, then report the
+    # median of the remaining fully-synced windows as the sustained figure.
+    iters = 20
+    real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
+    rates = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            book, out = engine_step(cfg, book, waves[i % len(waves)])
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rates.append(real_ops / dt)
+    post_warm = sorted(rates[1:])
+    value = post_warm[len(post_warm) // 2]
     print(json.dumps({
         "metric": "match_throughput",
         "value": round(value, 1),

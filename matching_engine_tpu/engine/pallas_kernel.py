@@ -270,6 +270,14 @@ def match_batch_pallas(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     interpret = cfg.pallas_interpret
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
+    if not interpret and sb != 8:
+        # Mosaic requires sublane-dim blocks in multiples of 8 on real TPU
+        # (module docstring); sub-8 blocks only exist when the symbol axis
+        # isn't divisible by 8. Reject loudly rather than fail inside Mosaic.
+        raise ValueError(
+            f"pallas=True on a TPU backend needs num_symbols % 8 == 0 "
+            f"(got {s}); pad the symbol axis or use the XLA path"
+        )
 
     def row_spec():
         return pl.BlockSpec((sb, cap), lambda i: (i, 0), memory_space=pltpu.VMEM)
