@@ -7,23 +7,21 @@ is an empty file and its hot path is one SQLite INSERT under a global mutex),
 so vs_baseline is measured against this repo's north-star target of 10M
 orders/sec (BASELINE.json) rather than a reference figure.
 
-Method: steady-state device throughput of the jit'd engine step — a realistic
-mixed stream (limit adds that rest, crossing limits, markets, cancels) is
-pre-built into [S, B] dispatches, then K steps run back-to-back with the book
-donated in HBM (no host round-trip of book state), timed end to end with
-block_until_ready. orders/sec counts real (non-padding) ops.
+Method (utils/measure.py, shared with benchmarks/run_all.py): steady-state
+device throughput of the jit'd engine step at the north-star condition — a
+realistic mixed 4096-symbol stream (limit adds that rest, crossing limits,
+markets, cancels) pre-built into [S, B] dispatches, run back-to-back with the
+book donated in HBM; the median of post-warm-up fully-synced timing windows
+is reported. orders/sec counts real (non-padding) ops.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
-import jax
-
-from matching_engine_tpu.engine.book import EngineConfig, init_book
-from matching_engine_tpu.engine.harness import build_batches, random_order_stream
-from matching_engine_tpu.engine.kernel import engine_step
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.harness import random_order_stream
+from matching_engine_tpu.utils.measure import measure_device_throughput
 
 NORTH_STAR = 10_000_000  # orders/sec, BASELINE.json
 
@@ -32,49 +30,15 @@ def main() -> None:
     # North-star condition (BASELINE.json): 4k symbols. batch=32 amortizes the
     # per-step dispatch overhead over a longer in-kernel scan.
     cfg = EngineConfig(num_symbols=4096, capacity=128, batch=32, max_fills=1 << 17)
-    n_orders_per_wave = cfg.num_symbols * cfg.batch
-
-    # Build a handful of full dispatches; cycle them during the timed loop.
-    # (Each wave is dense: every [S, B] slot is a real op.)  Count real ops
-    # from the host-side batches BEFORE device_put: reading a device array
-    # back (np.asarray) mid-bench collapses the axon tunnel's async dispatch
-    # pipeline and slows every subsequent step by ~1000x.
-    import numpy as np
-
-    waves = []
-    wave_ops = []
-    for w in range(4):
-        stream = random_order_stream(
-            cfg.num_symbols, 4 * n_orders_per_wave, seed=w, cancel_p=0.10,
+    streams = [
+        random_order_stream(
+            cfg.num_symbols, 4 * cfg.num_symbols * cfg.batch, seed=w, cancel_p=0.10,
             market_p=0.15, price_base=9_950, price_levels=100, price_step=1,
             qty_max=100,
         )
-        batches = build_batches(cfg, stream)
-        # Keep only dense-enough leading dispatches.
-        for b in batches[:2]:
-            wave_ops.append(int(np.count_nonzero(np.asarray(b.op))))
-            waves.append(jax.device_put(b))
-
-    book = init_book(cfg)
-    # Warmup: compile + one pass over every wave shape.
-    book, out = engine_step(cfg, book, waves[0])
-    jax.block_until_ready(out)
-
-    # The tunneled device shows large run-to-run scheduling variance and a
-    # slow first-window ramp; discard one warm-up window, then report the
-    # median of the remaining fully-synced windows as the sustained figure.
-    iters = 20
-    real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
-    rates = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        for i in range(iters):
-            book, out = engine_step(cfg, book, waves[i % len(waves)])
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-        rates.append(real_ops / dt)
-    post_warm = sorted(rates[1:])
-    value = post_warm[len(post_warm) // 2]
+        for w in range(4)
+    ]
+    value, _lat_us = measure_device_throughput(cfg, streams)
     print(json.dumps({
         "metric": "match_throughput",
         "value": round(value, 1),

@@ -1,0 +1,231 @@
+"""Benchmark suite: the five BASELINE.json configs, one JSON line each.
+
+The reference publishes no numbers (BASELINE.md), so every figure here is
+measured against this repo's north-star target. `bench.py` at the repo root
+stays the driver's single headline metric (config 3); this suite covers the
+full matrix:
+
+  1 smoke-replay fill parity (functional gate, not perf)
+  2 64-symbol Poisson LIMIT-only flow, depth-10 books
+  3 4k-symbol L3-style replay, LIMIT+CANCEL+MARKET  (same as bench.py)
+  4 gRPC client fan-in through the full server stack (end-to-end, p99)
+  5 agent-based market sim, closed loop on device
+
+Usage: python benchmarks/run_all.py [--full] [--configs 2,3,5]
+--full uses north-star scale (4k symbols, 256 agents, 1k clients); the
+default is sized to finish in ~a minute on one chip (or CPU, for CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    random_order_stream,
+)
+from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.proto import BUY, LIMIT, SELL
+from matching_engine_tpu.utils.measure import measure_device_throughput
+
+NORTH_STAR = 10_000_000
+
+
+def emit(config: int, name: str, value: float, unit: str, extra: dict | None = None):
+    line = {"config": config, "metric": name, "value": round(value, 1), "unit": unit,
+            "vs_baseline": round(value / NORTH_STAR, 4) if unit == "orders/sec" else None}
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+# -- config 1: smoke-replay parity -----------------------------------------
+
+def config1_parity():
+    """The reference smoke script's flow (scales 8/9/2/0, crossing + MARKET),
+    replayed through kernel and oracle; value = 1.0 iff fills identical."""
+    cfg = EngineConfig(num_symbols=1, capacity=32, batch=4, max_fills=1024)
+    # The reference smoke submits the same displayed price at scales 8/9/2/0
+    # (Q4: 1, 0->rejected pre-kernel, 100500, 10050*10^4); extended like
+    # scripts/smoke.sh with a crossing SELL and a MARKET order.
+    stream = [
+        HostOrder(sym=0, op=OP_SUBMIT, side=BUY, otype=LIMIT, price=1, qty=10, oid=1),
+        HostOrder(sym=0, op=OP_SUBMIT, side=BUY, otype=LIMIT, price=100500, qty=10, oid=2),
+        HostOrder(sym=0, op=OP_SUBMIT, side=BUY, otype=LIMIT, price=10050 * 10000, qty=10, oid=3),
+        HostOrder(sym=0, op=OP_SUBMIT, side=SELL, otype=LIMIT, price=100500, qty=15, oid=4),
+        HostOrder(sym=0, op=OP_SUBMIT, side=SELL, otype=1, price=0, qty=5, oid=5),
+    ]
+    book = init_book(cfg)
+    book, _, d_fills = apply_orders(cfg, book, stream)
+    oracle = OracleBook(capacity=cfg.capacity)
+    o_fills = []
+    for o in stream:
+        r = oracle.submit(o.oid, o.side, o.otype, o.price, o.qty)
+        o_fills.extend((f.taker_oid, f.maker_oid, f.price_q4, f.quantity) for f in r.fills)
+    d = [(f.taker_oid, f.maker_oid, f.price_q4, f.quantity) for f in d_fills]
+    emit(1, "smoke_replay_fill_parity", float(d == o_fills), "bool",
+         {"fills": len(d)})
+
+
+# -- config 2: Poisson LIMIT-only flow ---------------------------------------
+
+def config2_poisson(full: bool):
+    s = 64
+    cfg = EngineConfig(num_symbols=s, capacity=64, batch=32 if full else 16,
+                       max_fills=1 << 15)
+    rng = np.random.default_rng(0)
+    streams = []
+    for w in range(2):
+        # Poisson arrivals across symbols; LIMIT-only around a depth-10 ladder.
+        n = 4 * s * cfg.batch
+        syms = rng.poisson(lam=s / 2, size=n) % s
+        stream = []
+        for i, sym in enumerate(syms):
+            side = BUY if rng.random() < 0.5 else SELL
+            level = int(rng.integers(0, 10))
+            price = 10_000 + (level if side == SELL else -level)
+            stream.append(HostOrder(sym=int(sym), op=OP_SUBMIT, side=side,
+                                    otype=LIMIT, price=price,
+                                    qty=int(rng.integers(1, 100)),
+                                    oid=w * n + i + 1))
+        streams.append(stream)
+    rate, lat_us = measure_device_throughput(cfg, streams)
+    emit(2, "poisson_limit_throughput", rate, "orders/sec",
+         {"dispatch_latency_us": round(lat_us, 1), "symbols": s})
+
+
+# -- config 3: L3-style replay (bench.py's configuration) --------------------
+
+def config3_l3(full: bool):
+    s = 4096 if full else 512
+    cfg = EngineConfig(num_symbols=s, capacity=128, batch=32, max_fills=1 << 17)
+    streams = [
+        random_order_stream(s, 4 * s * cfg.batch, seed=w, cancel_p=0.10,
+                            market_p=0.15, price_base=9_950, price_levels=100,
+                            price_step=1, qty_max=100)
+        for w in range(2)
+    ]
+    rate, lat_us = measure_device_throughput(cfg, streams)
+    emit(3, "l3_replay_throughput", rate, "orders/sec",
+         {"dispatch_latency_us": round(lat_us, 1), "symbols": s})
+
+
+# -- config 4: gRPC fan-in through the full server stack ---------------------
+
+def config4_grpc(full: bool):
+    import tempfile
+    import threading
+
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    clients = 64 if full else 16
+    per_client = 200 if full else 50
+    cfg = EngineConfig(num_symbols=64, capacity=64, batch=16, max_fills=1 << 15)
+    db = tempfile.mkdtemp() + "/bench.db"
+    server, port, parts = build_server("127.0.0.1:0", db, cfg, window_ms=2.0, log=False)
+    server.start()
+    addr = f"127.0.0.1:{port}"
+
+    # Warm the jit before timing.
+    ch = grpc.insecure_channel(addr)
+    MatchingEngineStub(ch).SubmitOrder(pb2.OrderRequest(
+        client_id="warm", symbol="S0", order_type=pb2.LIMIT, side=pb2.BUY,
+        price=1, scale=0, quantity=1), timeout=60)
+
+    lat_all: list[list[float]] = [[] for _ in range(clients)]
+
+    def worker(w: int):
+        chan = grpc.insecure_channel(addr)
+        stub = MatchingEngineStub(chan)
+        rng = np.random.default_rng(w)
+        for i in range(per_client):
+            side = pb2.BUY if rng.random() < 0.5 else pb2.SELL
+            req = pb2.OrderRequest(
+                client_id=f"c{w}", symbol=f"S{int(rng.integers(0, 64))}",
+                order_type=pb2.LIMIT, side=side,
+                price=int(10_000 + rng.integers(-20, 20)), scale=4,
+                quantity=int(rng.integers(1, 50)))
+            t0 = time.perf_counter()
+            stub.SubmitOrder(req, timeout=30)
+            lat_all[w].append(time.perf_counter() - t0)
+        chan.close()
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    ch.close()
+    shutdown(server, parts)
+
+    lats = np.array(sorted(x for per in lat_all for x in per))
+    emit(4, "grpc_end_to_end_throughput", clients * per_client / dt, "orders/sec",
+         {"clients": clients,
+          "p50_ms": round(float(lats[len(lats) // 2] * 1e3), 2),
+          "p99_ms": round(float(lats[int(len(lats) * 0.99)] * 1e3), 2)})
+
+
+# -- config 5: agent-based market sim ----------------------------------------
+
+def config5_sim(full: bool):
+    from matching_engine_tpu.sim import SimConfig, run_sim
+
+    s = 4096 if full else 256
+    scfg = SimConfig(agents=256 if full else 32, refresh=8, markets=4)
+    # Capacity must hold every agent's bid+ask per side, or the books
+    # saturate and the sim measures a mostly-rejecting engine.
+    cfg = EngineConfig(num_symbols=s, capacity=512 if full else 64,
+                       batch=scfg.batch_for(), max_fills=1 << 17)
+    steps = 50
+    # Warmup: same static (cfg, scfg, steps) hits the module-level jit cache.
+    _, _, stats, _ = run_sim(cfg, scfg, steps=steps, seed=0)
+    jax.block_until_ready(stats)
+    t0 = time.perf_counter()
+    book, state, stats, _ = run_sim(cfg, scfg, steps=steps, seed=1)
+    jax.block_until_ready(stats)
+    dt = time.perf_counter() - t0
+    # Count real (non-padding) ops, same convention as configs 2/3.
+    ops = int(np.sum(np.asarray(stats.real_ops)))
+    emit(5, "agent_sim_throughput", ops / dt, "orders/sec",
+         {"symbols": s, "agents": scfg.agents,
+          "traded_volume": int(np.sum(np.asarray(stats.volume)))})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--full", action="store_true", help="north-star scale")
+    p.add_argument("--configs", default="1,2,3,4,5")
+    args = p.parse_args()
+    picked = {int(c) for c in args.configs.split(",")}
+    if 1 in picked:
+        config1_parity()
+    if 2 in picked:
+        config2_poisson(args.full)
+    if 3 in picked:
+        config3_l3(args.full)
+    if 4 in picked:
+        config4_grpc(args.full)
+    if 5 in picked:
+        config5_sim(args.full)
+
+
+if __name__ == "__main__":
+    main()

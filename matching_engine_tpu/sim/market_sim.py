@@ -1,0 +1,224 @@
+"""Agent-based market simulation, closed-loop on device.
+
+BASELINE.json config 5 ("agent-based market sim: 4k symbols x 256
+market-maker agents"): a population of market-maker agents per symbol quotes
+around a random-walking fair value; their order flow feeds straight into the
+match kernel *inside the same jit'd scan* — order generation, matching, and
+agent-state updates never leave the device. The reference has no simulation
+subsystem at all (SURVEY.md §6: it publishes no benchmarks and its engine
+file is empty); this module is the TPU-native load generator its intended
+capability surface implies.
+
+Per step and symbol (batch layout, `4*refresh + markets` slots):
+  [cancel old bid]*K  [cancel old ask]*K  [new bid]*K  [new ask]*K  [market]*M
+Agents are refreshed round-robin (step-rotated), so every agent's quotes are
+re-priced every `agents/refresh` steps. Cancels precede the replacement
+quotes in batch order, and the kernel applies batch positions sequentially
+per symbol, so a refresh is atomic within a step.
+
+Everything is int32 and PRNG-driven (`jax.random` with a threaded key): the
+same seed reproduces the same market bit-for-bit, and the generated flow can
+be replayed through the host oracle for parity (tests/test_sim.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig, OrderBatch, init_book
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT, engine_step_impl
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static sim configuration. `batch_for()` gives the EngineConfig.batch
+    the order layout requires."""
+
+    agents: int = 256          # market makers per symbol
+    refresh: int = 8           # agents re-quoted per step (round-robin)
+    markets: int = 4           # noise market orders per symbol per step
+    half_spread: int = 5       # Q4 ticks each side of fair value
+    spread_jitter: int = 8     # extra per-quote price noise in [0, jitter)
+    qty_max: int = 100         # quote/market size drawn from [1, qty_max]
+    fair_vol: int = 3          # fair-value random-walk step in [-vol, vol]
+    fair_init: int = 10_000    # initial Q4 fair value, all symbols
+    fair_min: int = 100        # random-walk clamp (keeps prices positive)
+    fair_max: int = 1 << 24
+
+    def batch_for(self) -> int:
+        return 4 * self.refresh + self.markets
+
+    def __post_init__(self):
+        assert 0 < self.refresh <= self.agents
+        assert self.half_spread >= 1, "quotes must not self-cross"
+
+
+class SimState(NamedTuple):
+    """Device-resident agent state. Shapes [S] / [S, A]."""
+
+    key: jax.Array        # PRNG key
+    step: jax.Array       # scalar int32 step counter (drives round-robin)
+    fair: jax.Array       # [S] fair-value random walk (Q4)
+    mm_bid_oid: jax.Array  # [S, A] each agent's resting bid oid (0 = none)
+    mm_ask_oid: jax.Array  # [S, A]
+    next_oid: jax.Array   # [S] per-symbol oid counter (oids unique per symbol)
+
+
+class StepStats(NamedTuple):
+    """Per-step scalars, cheap to stack over a scan."""
+
+    real_ops: jax.Array   # non-padding ops dispatched (cancel slots with no
+                          # resting quote are OP_NOOP; throughput counts real)
+    fills: jax.Array      # number of fill records
+    volume: jax.Array     # total traded quantity
+    spread: jax.Array     # mean top-of-book spread over two-sided symbols
+    resting: jax.Array    # live resting orders across all books
+
+
+def init_sim(cfg: EngineConfig, scfg: SimConfig, seed: int = 0) -> SimState:
+    s, a = cfg.num_symbols, scfg.agents
+    return SimState(
+        key=jax.random.PRNGKey(seed),
+        step=jnp.zeros((), I32),
+        fair=jnp.full((s,), scfg.fair_init, I32),
+        mm_bid_oid=jnp.zeros((s, a), I32),
+        mm_ask_oid=jnp.zeros((s, a), I32),
+        next_oid=jnp.ones((s,), I32),
+    )
+
+
+def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
+    """One step of agent decisions -> (new_state, OrderBatch)."""
+    s, k, m = cfg.num_symbols, scfg.refresh, scfg.markets
+    key, k_fair, k_jb, k_ja, k_qty, k_mside, k_mqty = jax.random.split(state.key, 7)
+
+    # Fair value random walk, clamped.
+    fair = jnp.clip(
+        state.fair + jax.random.randint(k_fair, (s,), -scfg.fair_vol, scfg.fair_vol + 1, I32),
+        scfg.fair_min, scfg.fair_max,
+    )
+
+    # Round-robin refresh set (same agent indices across symbols).
+    idx = (state.step * k + jnp.arange(k, dtype=I32)) % scfg.agents  # [K]
+
+    old_bid = state.mm_bid_oid[:, idx]  # [S, K]
+    old_ask = state.mm_ask_oid[:, idx]
+
+    # New quotes around fair value.
+    jb = jax.random.randint(k_jb, (s, k), 0, scfg.spread_jitter, I32)
+    ja = jax.random.randint(k_ja, (s, k), 0, scfg.spread_jitter, I32)
+    bid_px = jnp.maximum(fair[:, None] - scfg.half_spread - jb, 1)
+    ask_px = fair[:, None] + scfg.half_spread + ja
+    qty = jax.random.randint(k_qty, (s, 2 * k), 1, scfg.qty_max + 1, I32)
+
+    # Oid assignment: submits in batch order get consecutive per-symbol oids.
+    base = state.next_oid[:, None]  # [S, 1]
+    bid_oid = base + jnp.arange(k, dtype=I32)[None, :]
+    ask_oid = base + k + jnp.arange(k, dtype=I32)[None, :]
+    mkt_oid = base + 2 * k + jnp.arange(m, dtype=I32)[None, :]
+
+    # Noise market orders.
+    mside = jax.random.randint(k_mside, (s, m), 0, 2, I32) + BUY  # BUY/SELL
+    mqty = jax.random.randint(k_mqty, (s, m), 1, scfg.qty_max + 1, I32)
+
+    def seg(op, side, otype, price, q, oid):
+        return (op, side, otype, price, q, oid)
+
+    zeros_k = jnp.zeros((s, k), I32)
+    zeros_m = jnp.zeros((s, m), I32)
+    segs = [
+        # Cancel the refreshed agents' old quotes (no-op where none rests).
+        seg(jnp.where(old_bid > 0, OP_CANCEL, 0), jnp.full((s, k), BUY, I32),
+            zeros_k, zeros_k, zeros_k, old_bid),
+        seg(jnp.where(old_ask > 0, OP_CANCEL, 0), jnp.full((s, k), SELL, I32),
+            zeros_k, zeros_k, zeros_k, old_ask),
+        # Replacement quotes.
+        seg(jnp.full((s, k), OP_SUBMIT, I32), jnp.full((s, k), BUY, I32),
+            jnp.full((s, k), LIMIT, I32), bid_px, qty[:, :k], bid_oid),
+        seg(jnp.full((s, k), OP_SUBMIT, I32), jnp.full((s, k), SELL, I32),
+            jnp.full((s, k), LIMIT, I32), ask_px, qty[:, k:], ask_oid),
+        # Noise takers.
+        seg(jnp.full((s, m), OP_SUBMIT, I32), mside,
+            jnp.full((s, m), MARKET, I32), zeros_m, mqty, mkt_oid),
+    ]
+    orders = OrderBatch(*(jnp.concatenate(parts, axis=1) for parts in zip(*segs)))
+
+    new_state = SimState(
+        key=key,
+        step=state.step + 1,
+        fair=fair,
+        mm_bid_oid=state.mm_bid_oid.at[:, idx].set(bid_oid),
+        mm_ask_oid=state.mm_ask_oid.at[:, idx].set(ask_oid),
+        next_oid=state.next_oid + 2 * k + m,
+    )
+    return new_state, orders
+
+
+def sim_step_impl(cfg: EngineConfig, scfg: SimConfig, book: BookBatch, state: SimState):
+    """One closed-loop step: agents -> orders -> match -> stats.
+
+    Returns (book, state, orders, stats); compose under jit/scan.
+    """
+    state, orders = _gen_orders(cfg, scfg, state)
+    book, out = engine_step_impl(cfg, book, orders)
+
+    both = (out.best_bid > 0) & (out.best_ask > 0)
+    spread = jnp.where(
+        jnp.any(both),
+        jnp.sum(jnp.where(both, out.best_ask - out.best_bid, 0)) // jnp.maximum(jnp.sum(both), 1),
+        0,
+    )
+    stats = StepStats(
+        real_ops=jnp.sum(orders.op != 0).astype(I32),
+        fills=out.fill_count,
+        volume=jnp.sum(out.fill_qty),
+        spread=spread.astype(I32),
+        resting=(jnp.sum(book.bid_qty > 0) + jnp.sum(book.ask_qty > 0)).astype(I32),
+    )
+    return book, state, orders, stats
+
+
+def _run_impl(cfg: EngineConfig, scfg: SimConfig, steps: int, collect_orders: bool,
+              book: BookBatch, state: SimState):
+    def scan_body(carry, _):
+        book, state = carry
+        book, state, orders, stats = sim_step_impl(cfg, scfg, book, state)
+        return (book, state), (stats, orders if collect_orders else None)
+
+    (book, state), (stats, orders) = jax.lax.scan(
+        scan_body, (book, state), None, length=steps
+    )
+    return book, state, stats, orders
+
+
+# Module-level jit so repeated run_sim calls with the same static config hit
+# the compile cache (a per-call @jax.jit closure would re-trace every time).
+_run_jit = jax.jit(_run_impl, static_argnums=(0, 1, 2, 3))
+
+
+def run_sim(
+    cfg: EngineConfig,
+    scfg: SimConfig,
+    steps: int,
+    seed: int = 0,
+    collect_orders: bool = False,
+):
+    """Run `steps` closed-loop steps under one jit'd lax.scan.
+
+    Returns (book, state, stats[T], orders[T] | None). With
+    collect_orders=True the per-step OrderBatches are stacked and returned
+    (host replay / parity testing; memory scales with T*S*B — keep small).
+    """
+    assert cfg.batch == scfg.batch_for(), (
+        f"EngineConfig.batch must be {scfg.batch_for()} for this SimConfig"
+    )
+    book = init_book(cfg)
+    state = init_sim(cfg, scfg, seed)
+    return _run_jit(cfg, scfg, steps, collect_orders, book, state)

@@ -1,0 +1,62 @@
+"""Shared device-throughput measurement methodology.
+
+Used by the headline bench (bench.py) and the benchmark suite
+(benchmarks/run_all.py) so the two can't silently diverge. Contract:
+
+- real ops are counted from the HOST-side batches before device_put —
+  reading a device array back mid-measurement collapses the axon tunnel's
+  async dispatch pipeline and slows every subsequent step ~1000x;
+- one un-timed warm pass compiles and primes the pipeline;
+- several independent fully-synced windows are timed; the first is
+  discarded (ramp) and the median of the rest is the sustained figure.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import build_batches
+from matching_engine_tpu.engine.kernel import engine_step
+
+
+def measure_device_throughput(
+    cfg: EngineConfig,
+    streams,
+    *,
+    windows: int = 5,
+    iters: int = 20,
+    waves_per_stream: int = 2,
+):
+    """Returns (sustained orders/sec, median per-dispatch latency in µs).
+
+    `streams` is a list of HostOrder lists; the leading `waves_per_stream`
+    dispatches of each are cycled during the timed loop.
+    """
+    waves, wave_ops = [], []
+    for stream in streams:
+        for b in build_batches(cfg, stream)[:waves_per_stream]:
+            wave_ops.append(int(np.count_nonzero(np.asarray(b.op))))
+            waves.append(jax.device_put(b))
+
+    book = init_book(cfg)
+    book, out = engine_step(cfg, book, waves[0])
+    jax.block_until_ready(out)
+
+    real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
+    rates, lats = [], []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            book, out = engine_step(cfg, book, waves[i % len(waves)])
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rates.append(real_ops / dt)
+        lats.append(dt / iters * 1e6)
+
+    post_rates = sorted(rates[1:])
+    post_lats = sorted(lats[1:])
+    return post_rates[len(post_rates) // 2], post_lats[len(post_lats) // 2]

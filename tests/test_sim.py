@@ -1,0 +1,87 @@
+"""Agent-based market sim: determinism, invariants, and oracle parity.
+
+The strongest check replays the sim's own device-generated order flow
+through the host oracle CLOB and asserts the final resting books are
+bit-identical — closing the loop on SURVEY.md §4's parity-oracle pattern
+for flow the framework generated itself.
+"""
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.harness import snapshot_books
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.sim import SimConfig, run_sim
+
+SCFG = SimConfig(
+    agents=4, refresh=2, markets=2, half_spread=2, spread_jitter=4,
+    qty_max=50, fair_vol=2, fair_init=1_000,
+)
+CFG = EngineConfig(num_symbols=4, capacity=32, batch=SCFG.batch_for(), max_fills=4096)
+
+
+def test_sim_runs_and_is_deterministic():
+    _, _, stats_a, _ = run_sim(CFG, SCFG, steps=20, seed=7)
+    _, _, stats_b, _ = run_sim(CFG, SCFG, steps=20, seed=7)
+    _, _, stats_c, _ = run_sim(CFG, SCFG, steps=20, seed=8)
+    for a, b in zip(stats_a, stats_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(c))
+        for a, c in zip(stats_a, stats_c)
+    ), "different seeds produced an identical market"
+    # The market actually trades.
+    assert int(np.sum(np.asarray(stats_a.volume))) > 0
+
+
+def test_sim_books_stay_uncrossed_and_stats_consistent():
+    book, _, stats, _ = run_sim(CFG, SCFG, steps=30, seed=3)
+    snaps = snapshot_books(book)
+    resting = 0
+    for bids, asks in snaps:
+        resting += len(bids) + len(asks)
+        if bids and asks:
+            best_bid = bids[0][1]
+            best_ask = asks[0][1]
+            assert best_bid < best_ask, "resting book is crossed"
+    assert resting == int(np.asarray(stats.resting)[-1])
+
+
+def test_sim_batch_shape_contract():
+    with pytest.raises(AssertionError):
+        run_sim(EngineConfig(num_symbols=4, capacity=32, batch=SCFG.batch_for() + 1),
+                SCFG, steps=1)
+
+
+def test_sim_flow_oracle_parity():
+    book, _, stats, orders = run_sim(CFG, SCFG, steps=25, seed=11, collect_orders=True)
+
+    op = np.asarray(orders.op)        # [T, S, B]
+    side = np.asarray(orders.side)
+    otype = np.asarray(orders.otype)
+    price = np.asarray(orders.price)
+    qty = np.asarray(orders.qty)
+    oid = np.asarray(orders.oid)
+    t_steps, s_syms, b = op.shape
+
+    oracles = [OracleBook(capacity=CFG.capacity) for _ in range(s_syms)]
+    o_volume = 0
+    for t in range(t_steps):
+        for s in range(s_syms):
+            for j in range(b):
+                if op[t, s, j] == OP_SUBMIT:
+                    r = oracles[s].submit(
+                        int(oid[t, s, j]), int(side[t, s, j]), int(otype[t, s, j]),
+                        int(price[t, s, j]), int(qty[t, s, j]))
+                    o_volume += sum(f.quantity for f in r.fills)
+                elif op[t, s, j] == OP_CANCEL:
+                    oracles[s].cancel(int(oid[t, s, j]))
+
+    snaps = snapshot_books(book)
+    for s in range(s_syms):
+        ob = oracles[s].snapshot()
+        assert snaps[s][0] == ob[0], f"bid book mismatch sym {s}"
+        assert snaps[s][1] == ob[1], f"ask book mismatch sym {s}"
+    assert o_volume == int(np.sum(np.asarray(stats.volume)))
