@@ -1,0 +1,119 @@
+"""Multi-host scale-out: DCN-aware meshes + per-host symbol ownership.
+
+The reference has no server-to-server plane at all (SURVEY.md §5.8 — its
+only communication backend is client-facing gRPC), so this layer is designed
+TPU-first: `jax.distributed` for process bootstrap, one global Mesh whose
+device order is host-major so the symbol axis lands ICI-contiguous on each
+host, and XLA collectives that decompose hierarchically (intra-host legs on
+ICI, the single cross-host leg on DCN).
+
+Deployment model (matching the symbol-sharded design in sharding.py):
+
+- every host runs the same program and calls `initialize()` (a gated wrapper
+  over `jax.distributed.initialize`);
+- `make_multihost_mesh()` builds the 1-D symbol mesh over ALL processes'
+  devices (host-major order, via mesh_utils on real topologies);
+- each host's gRPC gateway accepts orders only for the symbol range
+  `local_symbol_slice()` assigns it (a front-end router or client-side
+  hashing keeps symbols home); the engine step itself is pure SPMD — no
+  cross-host traffic during matching, DCN is touched only by the
+  `all_top_of_book` publication gather and by checkpoint collection.
+
+Single-process multi-device (the test/dev case, and the driver's virtual
+8-device CPU mesh) uses the same code path: `initialize()` no-ops, the mesh
+covers the local devices, and `local_symbol_slice()` returns the full range.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from matching_engine_tpu.parallel.sharding import AXIS
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Bootstrap the JAX distributed runtime; returns True if initialized.
+
+    No-ops (returns False) when single-process: coordinator unset and the
+    environment carries no cluster autodetection hints. Safe to call
+    unconditionally at server start.
+    """
+    import os
+
+    explicit = (coordinator_address, num_processes, process_id) != (None, None, None)
+    if not explicit and not any(
+        v in os.environ for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    ):
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_multihost_mesh(devices=None) -> Mesh:
+    """1-D symbol mesh over every device of every process, host-major.
+
+    Host-major order means a contiguous block of the symbol axis maps onto
+    each host's local chips: the intra-block legs of any collective ride
+    ICI, and only one boundary per host pair crosses DCN. On real TPU
+    topologies `mesh_utils.create_device_mesh` additionally picks an
+    ICI-friendly order within each host.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_procs = max(d.process_index for d in devices) + 1
+    if n_procs == 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            dm = mesh_utils.create_device_mesh((len(devices),), devices=devices)
+        except Exception:  # CPU/virtual platforms lack topology info
+            dm = np.array(devices)
+        return Mesh(dm.reshape(-1), (AXIS,))
+    # Multi-process: let mesh_utils pick an ICI-friendly per-host order and
+    # keep hosts on the (DCN) outer axis, then flatten host-major; fall back
+    # to plain (process, id) order off real hardware.
+    try:
+        from jax.experimental import mesh_utils
+
+        per_host = len(devices) // n_procs
+        dm = mesh_utils.create_hybrid_device_mesh(
+            (per_host,), (n_procs,), devices=devices
+        )
+        return Mesh(dm.reshape(-1), (AXIS,))
+    except Exception:
+        ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+        return Mesh(np.array(ordered), (AXIS,))
+
+
+def local_symbol_slice(mesh: Mesh, num_symbols: int) -> slice:
+    """The global symbol range whose books live on THIS process's devices.
+
+    A host's gateway only accepts (or is only routed) symbols in its slice;
+    everything else about the engine step is global SPMD.
+    """
+    devs = mesh.devices.reshape(-1)
+    n = devs.size
+    if num_symbols % n != 0:
+        raise ValueError(f"num_symbols={num_symbols} not divisible by mesh size {n}")
+    per = num_symbols // n
+    pid = jax.process_index()
+    mine = [i for i, d in enumerate(devs) if d.process_index == pid]
+    if not mine:
+        return slice(0, 0)
+    lo, hi = min(mine), max(mine)
+    if mine != list(range(lo, hi + 1)):
+        raise ValueError(
+            "mesh device order is not host-contiguous; build it with "
+            "make_multihost_mesh() so symbol ownership is a single range"
+        )
+    return slice(lo * per, (hi + 1) * per)

@@ -38,7 +38,8 @@ from matching_engine_tpu.engine.kernel import (
 )
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.storage.storage import FillRow
-from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.metrics import Metrics, Timer
+from matching_engine_tpu.utils.tracing import step_annotation
 
 
 @dataclasses.dataclass
@@ -95,6 +96,7 @@ class EngineRunner:
         # checkpointing acquires it to get an untorn book+directory snapshot.
         self._dispatch_lock = threading.Lock()
         self._id_lock = threading.Lock()  # oid/symbol assignment from RPC threads
+        self._step_num = 0  # device-trace step annotation counter
         self.book = init_book(cfg)
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
@@ -132,7 +134,7 @@ class EngineRunner:
 
     def run_dispatch(self, ops: list[EngineOp]) -> DispatchResult:
         """Apply ops to the device books and decode all consequences."""
-        with self._dispatch_lock:
+        with self._dispatch_lock, Timer(self.metrics, "engine_dispatch_us"):
             return self._run_dispatch_locked(ops)
 
     def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
@@ -158,7 +160,8 @@ class EngineRunner:
         touched_syms: set[int] = set()
         last_out = None
         for batch in build_batches(self.cfg, host_orders):
-            with self._snapshot_lock:
+            self._step_num += 1
+            with self._snapshot_lock, step_annotation("engine_step", self._step_num):
                 self.book, out = engine_step(self.cfg, self.book, batch)
             last_out = out
             results, fills, overflow = decode_step(self.cfg, batch, out)

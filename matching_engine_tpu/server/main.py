@@ -13,6 +13,7 @@ from MAX(order_id).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import signal
 import sys
 import threading
@@ -34,6 +35,7 @@ from matching_engine_tpu.utils.checkpoint import (
     restore_runner,
 )
 from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.tracing import trace
 
 
 def recover_books(runner: EngineRunner, storage: Storage) -> int:
@@ -174,6 +176,9 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-interval-s", type=float, default=30.0)
     p.add_argument("--no-native", action="store_true",
                    help="force the pure-Python runtime layer")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler device trace of the whole "
+                        "serving session into this directory (TensorBoard)")
     args = p.parse_args(argv)
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
@@ -196,7 +201,8 @@ def main(argv=None) -> int:
     print(f"[SERVER] listening on port {port} "
           f"(symbols={cfg.num_symbols} capacity={cfg.capacity} batch={cfg.batch})")
     try:
-        stop_evt.wait()
+        with trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext():
+            stop_evt.wait()
     finally:
         print("[SERVER] shutting down")
         shutdown(server, parts)

@@ -84,15 +84,23 @@ class Storage:
 
     def __init__(self, db_path: str):
         self.db_path = db_path
-        d = os.path.dirname(db_path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        self._conn = sqlite3.connect(
-            db_path, timeout=5.0, check_same_thread=False, isolation_level=None
-        )
         self._lock = threading.Lock()
+        self._conn = None
+        try:
+            d = os.path.dirname(db_path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._conn = sqlite3.connect(
+                db_path, timeout=5.0, check_same_thread=False, isolation_level=None
+            )
+        except Exception as e:  # noqa: BLE001 — never-throw surface; init()
+            # reports False and the server exits with the storage code (1),
+            # mirroring the reference's ctor-throw -> exit-1 path (main.cpp:63-69).
+            print(f"[storage] open failed: {e}")
 
     def init(self) -> bool:
+        if self._conn is None:
+            return False
         try:
             with self._lock:
                 cur = self._conn
@@ -107,7 +115,8 @@ class Storage:
 
     def close(self) -> None:
         with self._lock:
-            self._conn.close()
+            if self._conn is not None:
+                self._conn.close()
 
     # -- writes ------------------------------------------------------------
 
@@ -207,13 +216,17 @@ class Storage:
     # -- reads -------------------------------------------------------------
 
     def get_order(self, order_id: str):
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT order_id, client_id, symbol, side, order_type, price, "
-                "quantity, remaining_quantity, status FROM orders WHERE order_id = ?",
-                (order_id,),
-            ).fetchone()
-        return row
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT order_id, client_id, symbol, side, order_type, price, "
+                    "quantity, remaining_quantity, status FROM orders WHERE order_id = ?",
+                    (order_id,),
+                ).fetchone()
+            return row
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] get_order failed: {e}")
+            return None
 
     def open_orders(self, symbol: str | None = None):
         """Orders with live book presence (NEW / PARTIALLY_FILLED) — the
@@ -232,33 +245,45 @@ class Storage:
         # lexicographic tiebreak would replay OID-10 before OID-9 and invert
         # time priority after restart.
         q += " ORDER BY created_ts, CAST(SUBSTR(order_id, 5) AS INTEGER)"
-        with self._lock:
-            return self._conn.execute(q, args).fetchall()
+        try:
+            with self._lock:
+                return self._conn.execute(q, args).fetchall()
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] open_orders failed: {e}")
+            return []
 
     def best_bid(self, symbol: str):
         """(price_q4, total remaining) of the best bid, or None.
 
         side=1 (BUY) — the stored encoding, fixing the reference's
         side=0 filter bug (storage.cpp:218)."""
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT price, SUM(remaining_quantity) FROM orders "
-                "WHERE symbol = ? AND side = 1 AND status IN (0, 1) "
-                "AND price IS NOT NULL GROUP BY price "
-                "ORDER BY price DESC LIMIT 1",
-                (symbol,),
-            ).fetchone()
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT price, SUM(remaining_quantity) FROM orders "
+                    "WHERE symbol = ? AND side = 1 AND status IN (0, 1) "
+                    "AND price IS NOT NULL GROUP BY price "
+                    "ORDER BY price DESC LIMIT 1",
+                    (symbol,),
+                ).fetchone()
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] best_bid failed: {e}")
+            return None
         return None if row is None or row[0] is None else (row[0], row[1])
 
     def best_ask(self, symbol: str):
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT price, SUM(remaining_quantity) FROM orders "
-                "WHERE symbol = ? AND side = 2 AND status IN (0, 1) "
-                "AND price IS NOT NULL GROUP BY price "
-                "ORDER BY price ASC LIMIT 1",
-                (symbol,),
-            ).fetchone()
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT price, SUM(remaining_quantity) FROM orders "
+                    "WHERE symbol = ? AND side = 2 AND status IN (0, 1) "
+                    "AND price IS NOT NULL GROUP BY price "
+                    "ORDER BY price ASC LIMIT 1",
+                    (symbol,),
+                ).fetchone()
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] best_ask failed: {e}")
+            return None
         return None if row is None or row[0] is None else (row[0], row[1])
 
     def load_next_oid_seq(self) -> int:
@@ -276,14 +301,22 @@ class Storage:
             return 1
 
     def fills_for_order(self, order_id: str):
-        with self._lock:
-            return self._conn.execute(
-                "SELECT order_id, counter_order_id, price, quantity, ts "
-                "FROM fills WHERE order_id = ? ORDER BY fill_id",
-                (order_id,),
-            ).fetchall()
+        try:
+            with self._lock:
+                return self._conn.execute(
+                    "SELECT order_id, counter_order_id, price, quantity, ts "
+                    "FROM fills WHERE order_id = ? ORDER BY fill_id",
+                    (order_id,),
+                ).fetchall()
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] fills_for_order failed: {e}")
+            return []
 
     def count(self, table: str) -> int:
         assert table in ("orders", "fills")
-        with self._lock:
-            return self._conn.execute(f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        try:
+            with self._lock:
+                return self._conn.execute(f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] count failed: {e}")
+            return 0

@@ -1,0 +1,35 @@
+"""Profiling/tracing: jax.profiler integration + per-step annotations.
+
+The reference's entire observability story is a per-RPC microsecond print
+(src/server/matching_engine_service.cpp:46,116-118; SURVEY.md §5.1). The
+TPU equivalent this module provides:
+
+- `trace(dir)`: capture a full XLA device trace (TensorBoard-loadable) of
+  everything dispatched inside the block;
+- `step_annotation(name, n)`: label each engine dispatch so device traces
+  show per-batch boundaries;
+Host-side wall-clock timing of arbitrary sections feeds the GetMetrics
+registry via utils/metrics.py's Timer. The server enables tracing with
+--profile-dir; bench/benchmark runs can wrap their loops directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler device trace into `log_dir`."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(name: str, step: int):
+    """Annotate one engine dispatch in the device trace."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

@@ -1,0 +1,117 @@
+"""Multi-host layer: mesh construction, symbol ownership, gated bootstrap.
+
+True multi-process DCN runs need a cluster; these tests exercise the logic
+on the virtual 8-device CPU platform (tests/conftest.py) — mesh device
+order, ownership slices, divisibility errors, and that the single-process
+path of initialize() never touches jax.distributed.
+"""
+
+import jax
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.parallel import ShardedEngine
+from matching_engine_tpu.parallel.multihost import (
+    initialize,
+    local_symbol_slice,
+    make_multihost_mesh,
+)
+
+
+def test_initialize_noops_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    called = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.append(kw))
+    assert initialize() is False
+    assert called == []
+
+
+def test_initialize_dispatches_when_configured(monkeypatch):
+    called = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: called.append(kw))
+    assert initialize("coord:1234", num_processes=4, process_id=1) is True
+    assert called == [dict(coordinator_address="coord:1234",
+                           num_processes=4, process_id=1)]
+
+
+def test_multihost_mesh_covers_all_devices_and_runs_engine():
+    mesh = make_multihost_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    cfg = EngineConfig(num_symbols=16, capacity=16, batch=4)
+    eng = ShardedEngine(cfg, mesh)
+    book = eng.init_book()
+    assert book.bid_qty.shape == (16, 16)
+
+
+def test_local_symbol_slice_single_process_owns_everything():
+    mesh = make_multihost_mesh()
+    sl = local_symbol_slice(mesh, 64)
+    assert (sl.start, sl.stop) == (0, 64)
+
+
+def test_local_symbol_slice_divisibility():
+    mesh = make_multihost_mesh()
+    with pytest.raises(ValueError, match="not divisible"):
+        local_symbol_slice(mesh, 10)
+
+
+def test_local_symbol_slice_host_major_ranges():
+    """Simulate 2 hosts x 4 devices by faking process indices."""
+
+    class FakeDev:
+        def __init__(self, pid, did):
+            self.process_index = pid
+            self.id = did
+
+        def __repr__(self):
+            return f"d{self.process_index}.{self.id}"
+
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    devs = [FakeDev(p, d) for p in range(2) for d in range(4)]
+
+    class FakeMesh:
+        devices = np.array(devs)
+
+    # Host 0 owns symbols [0, 32), host 1 owns [32, 64) for 64 symbols.
+    import matching_engine_tpu.parallel.multihost as mh
+
+    orig = jax.process_index
+    try:
+        jax.process_index = lambda: 0
+        sl0 = mh.local_symbol_slice(FakeMesh, 64)
+        jax.process_index = lambda: 1
+        sl1 = mh.local_symbol_slice(FakeMesh, 64)
+    finally:
+        jax.process_index = orig
+    assert (sl0.start, sl0.stop) == (0, 32)
+    assert (sl1.start, sl1.stop) == (32, 64)
+
+
+def test_local_symbol_slice_rejects_interleaved_order():
+    class FakeDev:
+        def __init__(self, pid, did):
+            self.process_index = pid
+            self.id = did
+
+    import numpy as np
+
+    devs = [FakeDev(d % 2, d) for d in range(4)]  # interleaved hosts
+
+    class FakeMesh:
+        devices = np.array(devs)
+
+    import matching_engine_tpu.parallel.multihost as mh
+
+    orig = jax.process_index
+    try:
+        jax.process_index = lambda: 0
+        with pytest.raises(ValueError, match="host-contiguous"):
+            mh.local_symbol_slice(FakeMesh, 64)
+    finally:
+        jax.process_index = orig
