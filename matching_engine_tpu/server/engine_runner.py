@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+import jax
 import numpy as np
 
 from matching_engine_tpu.engine.book import EngineConfig, OrderBatch, init_book
@@ -88,7 +89,16 @@ class DispatchResult:
 
 
 class EngineRunner:
-    def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None):
+    """Owns the device books + host order directories.
+
+    With `mesh` set, the books are symbol-sharded over the device mesh and
+    every step runs through the shard_map'd path (parallel/sharding.py) —
+    the serving stack above (dispatcher, service, storage, streams,
+    checkpoints) is identical either way, because all host-side reads go
+    through np.asarray on logical arrays.
+    """
+
+    def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None, mesh=None):
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
@@ -97,13 +107,28 @@ class EngineRunner:
         self._dispatch_lock = threading.Lock()
         self._id_lock = threading.Lock()  # oid/symbol assignment from RPC threads
         self._step_num = 0  # device-trace step annotation counter
-        self.book = init_book(cfg)
+        if mesh is not None:
+            from matching_engine_tpu.parallel.sharding import ShardedEngine
+
+            self._sharded = ShardedEngine(cfg, mesh)
+            self.book = self._sharded.init_book()
+        else:
+            self._sharded = None
+            self.book = init_book(cfg)
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
         self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
         self.orders_by_num: dict[int, OrderInfo] = {}
         self.orders_by_id: dict[str, OrderInfo] = {}
         self.next_oid_num = 1
+
+    def place_book(self, host_book) -> None:
+        """Install a host-side BookBatch as the live device book, honoring
+        the runner's sharding (checkpoint restore path)."""
+        if self._sharded is not None:
+            self.book = jax.device_put(host_book, self._sharded.book_sharding)
+        else:
+            self.book = jax.device_put(host_book)
 
     # -- id/symbol management ---------------------------------------------
 
@@ -161,10 +186,19 @@ class EngineRunner:
         last_out = None
         for batch in build_batches(self.cfg, host_orders):
             self._step_num += 1
-            with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                self.book, out = engine_step(self.cfg, self.book, batch)
+            if self._sharded is not None:
+                dev_batch = self._sharded.place_orders(batch)
+                with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                    self.book, out = self._sharded.step(self.book, dev_batch)
+                # Decode from the HOST batch: its op/oid arrays are what
+                # decode reads, and pulling the device copy back would cost
+                # two cross-shard gathers per step for unchanged data.
+                results, fills, overflow = self._sharded.decode(batch, out)
+            else:
+                with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                    self.book, out = engine_step(self.cfg, self.book, batch)
+                results, fills, overflow = decode_step(self.cfg, batch, out)
             last_out = out
-            results, fills, overflow = decode_step(self.cfg, batch, out)
             if overflow:
                 self.metrics.inc("fill_buffer_overflows")
             self._decode_batch(results, fills, by_oid, res)

@@ -77,6 +77,7 @@ def build_server(
     checkpoint_dir: str | None = None,
     checkpoint_interval_s: float = 30.0,
     native: bool = True,
+    mesh=None,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -90,7 +91,7 @@ def build_server(
         raise SystemExit(1)
 
     metrics = Metrics()
-    runner = EngineRunner(cfg, metrics)
+    runner = EngineRunner(cfg, metrics, mesh=mesh)
     # Fast path: restore the newest device-book snapshot and replay only the
     # post-snapshot delta from SQLite; fall back to full replay.
     ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
@@ -102,7 +103,7 @@ def build_server(
         except Exception as e:  # any corrupt/skewed checkpoint -> full replay
             print(f"[SERVER] checkpoint restore failed "
                   f"({type(e).__name__}: {e}); full replay")
-            runner = EngineRunner(cfg, metrics)
+            runner = EngineRunner(cfg, metrics, mesh=mesh)
             ckpt = None
     if ckpt is None:
         recovered = recover_books(runner, storage)
@@ -162,6 +163,38 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     parts["storage"].close()
 
 
+def resolve_mesh(n: int, num_symbols: int):
+    """Resolve --mesh N into a device mesh (None when N == 0).
+
+    N counts TOTAL devices across all processes. Multi-process runs must
+    use exactly the global mesh (every process has to build the same SPMD
+    program over the same devices); single-process runs may take a leading
+    slice of the local devices. Raises ValueError with a clean message on
+    any misconfiguration — main() turns that into exit code 3.
+    """
+    if not n:
+        return None
+    if num_symbols % n != 0:
+        raise ValueError(f"--symbols {num_symbols} not divisible by --mesh {n}")
+
+    import jax
+
+    from matching_engine_tpu.parallel.multihost import initialize, make_multihost_mesh
+
+    initialize()  # no-op single-process; bootstraps DCN when configured
+    mesh = make_multihost_mesh()
+    if mesh.devices.size == n:
+        return mesh
+    if jax.process_count() > 1:
+        raise ValueError(
+            f"--mesh {n} != the {mesh.devices.size} devices of this "
+            f"{jax.process_count()}-process cluster (N counts ALL devices)"
+        )
+    from matching_engine_tpu.parallel.sharding import make_mesh
+
+    return make_mesh(n)  # raises ValueError if > visible devices
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="TPU-native matching engine server")
     p.add_argument("--addr", default="0.0.0.0:50051")
@@ -179,7 +212,16 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler device trace of the whole "
                         "serving session into this directory (TensorBoard)")
+    p.add_argument("--mesh", type=int, default=0, metavar="N",
+                   help="shard the symbol axis over an N-device mesh "
+                        "(0 = single device); N must divide --symbols")
     args = p.parse_args(argv)
+
+    try:
+        mesh = resolve_mesh(args.mesh, args.symbols)
+    except ValueError as e:
+        print(f"[SERVER] bad --mesh: {e}", file=sys.stderr)
+        return 3
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
     try:
@@ -189,6 +231,7 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_interval_s=args.checkpoint_interval_s,
             native=not args.no_native,
+            mesh=mesh,
         )
     except SystemExit as e:
         return int(e.code or 3)

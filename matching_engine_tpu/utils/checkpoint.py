@@ -114,7 +114,7 @@ def restore_runner(runner, path: str, storage=None) -> int:
         raise ValueError(
             f"checkpoint config {cfg} does not match runner config {runner.cfg}"
         )
-    runner.book = jax.device_put(host_book)
+    runner.place_book(host_book)
     runner.symbols = dict(meta["symbols"])
     runner.slot_symbols = [None] * cfg.num_symbols
     for sym, slot in runner.symbols.items():

@@ -1,0 +1,118 @@
+"""The full serving stack over a device mesh: RPC in, sharded books inside.
+
+Boots the real gRPC server with an 8-device symbol-sharded EngineRunner
+(tests/conftest.py provides the virtual CPU mesh) and checks the black-box
+RPC / white-box DB oracle still holds — sharding must be invisible to every
+layer above the runner, including checkpoints.
+"""
+
+import grpc
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.parallel import make_mesh
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.storage import Storage
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+
+
+@pytest.fixture
+def hs(tmp_path):
+    mesh = make_mesh(8)
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "sh.db"), CFG,
+        window_ms=1.0, log=False, mesh=mesh,
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_interval_s=3600.0,
+    )
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield {
+        "stub": MatchingEngineStub(channel),
+        "parts": parts,
+        "db": str(tmp_path / "sh.db"),
+        "tmp": tmp_path,
+        "server": server,
+        "channel": channel,
+    }
+    channel.close()
+    shutdown(server, parts)
+
+
+def submit(stub, client="c1", symbol="SYM", otype=pb2.LIMIT, side=pb2.BUY,
+           price=10000, scale=4, qty=5):
+    return stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol=symbol, order_type=otype,
+                         side=side, price=price, scale=scale, quantity=qty),
+        timeout=30,
+    )
+
+
+def test_sharded_server_matches_and_persists(hs):
+    stub = hs["stub"]
+    # Spread symbols over several shards (8 symbols over 8 devices).
+    for i in range(6):
+        r = submit(stub, symbol=f"S{i}", side=pb2.BUY, price=1000 + i, qty=10)
+        assert r.success, r.error_message
+    r = submit(stub, symbol="S3", side=pb2.SELL, price=900, qty=4)
+    assert r.success
+    hs["parts"]["sink"].flush()
+
+    store = Storage(hs["db"])
+    assert store.init()
+    assert store.count("orders") == 7
+    assert store.count("fills") == 1
+    bb = store.best_bid("S3")
+    assert bb == (1003, 6)  # 10 - 4 filled
+    store.close()
+
+    # Book snapshot over RPC still works on the sharded book.
+    book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="S3"), timeout=30)
+    assert len(book.bids) == 1 and book.bids[0].quantity == 6
+    assert len(book.asks) == 0
+
+
+def test_resolve_mesh_paths():
+    from matching_engine_tpu.server.main import resolve_mesh
+
+    assert resolve_mesh(0, 1024) is None
+    mesh = resolve_mesh(8, 64)
+    assert mesh is not None and mesh.devices.size == 8
+    with pytest.raises(ValueError, match="not divisible"):
+        resolve_mesh(8, 10)
+    with pytest.raises(ValueError, match="visible"):
+        resolve_mesh(999, 999 * 4)
+
+
+def test_main_bad_mesh_exits_cleanly(tmp_path, capsys):
+    from matching_engine_tpu.server.main import main
+
+    rc = main(["--addr", "127.0.0.1:0", "--db", str(tmp_path / "m.db"),
+               "--symbols", "10", "--mesh", "8"])
+    assert rc == 3
+    assert "bad --mesh" in capsys.readouterr().err
+
+
+def test_sharded_checkpoint_roundtrip(hs):
+    stub = hs["stub"]
+    for i in range(4):
+        assert submit(stub, symbol=f"S{i}", price=2000 + i, qty=3).success
+    ck = hs["parts"]["checkpointer"]
+    path = ck.checkpoint_now()
+    assert path is not None
+
+    # Restore into a FRESH sharded runner and compare a book snapshot.
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+    from matching_engine_tpu.utils.checkpoint import restore_runner
+
+    runner2 = EngineRunner(CFG, mesh=make_mesh(8))
+    store = Storage(hs["db"])
+    assert store.init()
+    restore_runner(runner2, path, store)
+    store.close()
+    bids, asks = runner2.book_snapshot("S2")
+    assert len(bids) == 1
+    info, qty = bids[0]
+    assert qty == 3 and info.price_q4 == 2002
