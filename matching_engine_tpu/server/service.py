@@ -106,6 +106,7 @@ class MatchingEngineService(MatchingEngineServicer):
 
         dur_us = (time.perf_counter() - t0) * 1e6
         self.metrics.ema_gauge("submit_rpc_us", dur_us)
+        self.metrics.observe("submit_rpc_us", dur_us)  # -> submit_rpc_us_p50/p99
         if outcome.status == REJECTED and outcome.error:
             self.metrics.inc("orders_rejected")
             self._log(f"rejected {order_id}: {outcome.error} ({dur_us:.0f}us)")

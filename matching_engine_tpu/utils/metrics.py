@@ -12,11 +12,21 @@ import threading
 import time
 
 
+_HIST_CAP = 4096  # ring-buffer samples per histogram
+
+
+def _rank(sorted_ring: list, q: float) -> float:
+    """Nearest-rank percentile over a sorted, non-empty sample list."""
+    return sorted_ring[min(int(q * len(sorted_ring)), len(sorted_ring) - 1)]
+
+
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, float] = {}
+        # name -> (ring list, next write index)
+        self._hists: dict[str, tuple[list, int]] = {}
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -31,13 +41,48 @@ class Metrics:
             prev = self._gauges.get(name)
             self._gauges[name] = value if prev is None else alpha * value + (1 - alpha) * prev
 
-    def snapshot(self) -> tuple[dict[str, int], dict[str, float]]:
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into `name`'s sliding-window histogram.
+
+        The BASELINE metric is "orders/sec + p99 match latency": percentiles
+        need a sample window, not an EMA. A fixed ring bounds memory; the
+        window covers the last _HIST_CAP dispatches.
+        """
         with self._lock:
-            return dict(self._counters), dict(self._gauges)
+            ring, idx = self._hists.get(name, ([], 0))
+            if len(ring) < _HIST_CAP:
+                ring.append(float(value))
+            else:
+                ring[idx] = float(value)
+            self._hists[name] = (ring, (idx + 1) % _HIST_CAP)
+
+    def percentile(self, name: str, q: float) -> float | None:
+        """q in [0, 1] over the sliding window; None with no samples."""
+        with self._lock:
+            ring, _ = self._hists.get(name, ([], 0))
+            ring = list(ring)  # sort OUTSIDE the lock: observe() is hot-path
+        if not ring:
+            return None
+        ring.sort()
+        return _rank(ring, q)
+
+    def snapshot(self) -> tuple[dict[str, int], dict[str, float]]:
+        """Counters + gauges, with p50/p99 derived gauges per histogram."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            rings = {n: list(r) for n, (r, _) in self._hists.items()}
+        for name, ring in rings.items():
+            ring.sort()
+            if ring:
+                gauges[f"{name}_p50"] = _rank(ring, 0.50)
+                gauges[f"{name}_p99"] = _rank(ring, 0.99)
+        return counters, gauges
 
 
 class Timer:
-    """Context manager feeding a microsecond EMA gauge."""
+    """Context manager feeding a microsecond EMA gauge plus the same-named
+    sliding-window histogram (surfaced as <name>_p50/_p99 in snapshot())."""
 
     def __init__(self, metrics: Metrics, gauge: str):
         self._m = metrics
@@ -48,5 +93,7 @@ class Timer:
         return self
 
     def __exit__(self, *exc):
-        self._m.ema_gauge(self._g, (time.perf_counter() - self._t0) * 1e6)
+        us = (time.perf_counter() - self._t0) * 1e6
+        self._m.ema_gauge(self._g, us)
+        self._m.observe(self._g, us)
         return False

@@ -1,0 +1,102 @@
+"""Durable-store integrity audit: cross-check the orders and fills tables.
+
+The reference treats SQLite as the system of record but ships nothing that
+validates it (SURVEY.md §5.4 — even book reconstruction is only sketched).
+This tool checks the arithmetic the schema implies, per order:
+
+  filled_as_taker + filled_as_maker == quantity - remaining_quantity
+  status consistent with remaining (FILLED <=> remaining 0 with fills,
+  CANCELED/REJECTED orders hold no remainder liability, NEW/PARTIAL rest)
+  every fill references two known orders on opposite sides
+
+Exit 0 and a JSON summary line when clean; exit 1 with per-order violation
+lines otherwise.
+
+Usage: python scripts/audit.py <db_path>
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import sys
+
+NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = range(5)
+
+
+def audit(db_path: str) -> list[str]:
+    conn = sqlite3.connect(db_path)
+    orders = {
+        row[0]: {"client": row[1], "symbol": row[2], "side": row[3],
+                 "otype": row[4], "qty": row[5], "remaining": row[6],
+                 "status": row[7]}
+        for row in conn.execute(
+            "SELECT order_id, client_id, symbol, side, order_type, quantity, "
+            "remaining_quantity, status FROM orders")
+    }
+    fills = conn.execute(
+        "SELECT order_id, counter_order_id, price, quantity FROM fills").fetchall()
+    conn.close()
+
+    problems: list[str] = []
+    filled_total: dict[str, int] = {oid: 0 for oid in orders}
+
+    for taker_id, maker_id, price, qty in fills:
+        t, m = orders.get(taker_id), orders.get(maker_id)
+        if t is None or m is None:
+            problems.append(f"fill references unknown order: {taker_id}/{maker_id}")
+            continue
+        if t["side"] == m["side"]:
+            problems.append(f"fill pairs same-side orders: {taker_id}/{maker_id}")
+        if t["symbol"] != m["symbol"]:
+            problems.append(f"fill crosses symbols: {taker_id}/{maker_id}")
+        if qty <= 0:
+            problems.append(f"non-positive fill quantity: {taker_id}/{maker_id}")
+        for pid, p in ((taker_id, t), (maker_id, m)):
+            if p["status"] == REJECTED:
+                problems.append(f"fill references REJECTED order: {pid}")
+        filled_total[taker_id] += qty
+        filled_total[maker_id] += qty
+
+    for oid, o in orders.items():
+        filled = filled_total[oid]
+        if o["status"] == REJECTED:
+            continue  # never touched the book; remaining is informational
+        if o["status"] == CANCELED:
+            # Canceled orders may have partial fills, but hold no liability.
+            if filled > o["qty"]:
+                problems.append(f"{oid}: overfilled ({filled} > {o['qty']})")
+            continue
+        if filled != o["qty"] - o["remaining"]:
+            problems.append(
+                f"{oid}: fills {filled} != quantity {o['qty']} - "
+                f"remaining {o['remaining']}")
+        if o["status"] == FILLED and o["remaining"] != 0:
+            problems.append(f"{oid}: FILLED but remaining={o['remaining']}")
+        if o["status"] == NEW and filled != 0:
+            problems.append(f"{oid}: NEW but has fills")
+        if o["status"] == PARTIALLY_FILLED and (filled == 0 or o["remaining"] == 0):
+            problems.append(f"{oid}: PARTIALLY_FILLED but filled={filled} "
+                            f"remaining={o['remaining']}")
+
+    summary = {
+        "orders": len(orders),
+        "fills": len(fills),
+        "violations": len(problems),
+    }
+    print(json.dumps(summary))
+    return problems
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: audit.py <db_path>", file=sys.stderr)
+        return 2
+    problems = audit(sys.argv[1])
+    for p in problems:
+        print(f"[audit] {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

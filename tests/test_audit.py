@@ -1,0 +1,64 @@
+"""scripts/audit.py: clean on a server-produced DB, loud on corruption."""
+
+import importlib.util
+import pathlib
+import sqlite3
+
+import grpc
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+
+_spec = importlib.util.spec_from_file_location(
+    "audit", pathlib.Path(__file__).resolve().parent.parent / "scripts" / "audit.py")
+audit_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(audit_mod)
+
+
+@pytest.fixture
+def traded_db(tmp_path):
+    db = str(tmp_path / "a.db")
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, EngineConfig(num_symbols=4, capacity=16, batch=4),
+        window_ms=1.0, log=False)
+    server.start()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = MatchingEngineStub(ch)
+
+    def sub(side, qty, price=10_000, otype=pb2.LIMIT):
+        r = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="c", symbol="S", order_type=otype, side=side,
+            price=price, scale=4, quantity=qty), timeout=30)
+        assert r.success
+        return r.order_id
+
+    sub(pb2.BUY, 10)
+    sub(pb2.SELL, 4)                      # partial fill
+    oid = sub(pb2.BUY, 3, price=9_000)    # rests
+    stub.CancelOrder(pb2.CancelRequest(client_id="c", order_id=oid), timeout=30)
+    parts["sink"].flush()
+    ch.close()
+    shutdown(server, parts)
+    return db
+
+
+def test_audit_clean_on_real_db(traded_db, capsys):
+    problems = audit_mod.audit(traded_db)
+    assert problems == []
+    assert '"violations": 0' in capsys.readouterr().out
+
+
+def test_audit_flags_corruption(traded_db, capsys):
+    conn = sqlite3.connect(traded_db)
+    conn.execute("UPDATE orders SET remaining_quantity = 99 "
+                 "WHERE status IN (1, 2) AND remaining_quantity != 99")
+    conn.execute("INSERT INTO fills (order_id, counter_order_id, price, quantity, ts)"
+                 " VALUES ('OID-404', 'OID-405', 1, 1, 0)")
+    conn.commit()
+    conn.close()
+    problems = audit_mod.audit(traded_db)
+    assert any("unknown order" in p for p in problems)
+    assert any("!=" in p for p in problems)

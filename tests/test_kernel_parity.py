@@ -112,3 +112,23 @@ def test_randomized_parity_deep_books():
     cfg = EngineConfig(num_symbols=2, capacity=64, batch=8)
     orders = random_order_stream(cfg.num_symbols, 400, seed=99, price_levels=5)
     assert_parity(cfg, orders)
+
+
+@pytest.mark.parametrize("seed", [7, 11, 13])
+def test_fuzz_parity_tight_capacity(seed):
+    """Stress the hard paths together: capacity-overflow rejects, heavy
+    cancel traffic, and deep market sweeps, all under one tiny book."""
+    cfg = EngineConfig(num_symbols=3, capacity=6, batch=5)
+    orders = random_order_stream(
+        cfg.num_symbols, 300, seed=seed, cancel_p=0.30, market_p=0.25,
+        price_levels=4, qty_max=20)
+    assert_parity(cfg, orders)
+
+
+def test_fuzz_parity_single_price_level_fifo():
+    """Everything at one price: pure FIFO ordering is the whole game."""
+    cfg = EngineConfig(num_symbols=2, capacity=32, batch=8)
+    orders = random_order_stream(
+        cfg.num_symbols, 300, seed=21, cancel_p=0.2, market_p=0.2,
+        price_levels=1, qty_max=10)
+    assert_parity(cfg, orders)

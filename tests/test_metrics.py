@@ -1,0 +1,37 @@
+"""Metrics registry: counters, EMA gauges, and p50/p99 histograms.
+
+BASELINE.json's metric is "orders/sec + p99 match latency" — the p99 comes
+from a sliding-window histogram surfaced as derived gauges in snapshot()
+(and therefore over the GetMetrics RPC, tests/test_server.py)."""
+
+from matching_engine_tpu.utils.metrics import _HIST_CAP, Metrics, Timer
+
+
+def test_percentiles_over_window():
+    m = Metrics()
+    for v in range(1, 101):  # 1..100
+        m.observe("lat_us", float(v))
+    assert m.percentile("lat_us", 0.5) == 51.0
+    assert m.percentile("lat_us", 0.99) == 100.0
+    assert m.percentile("absent", 0.99) is None
+    _, gauges = m.snapshot()
+    assert gauges["lat_us_p50"] == 51.0
+    assert gauges["lat_us_p99"] == 100.0
+
+
+def test_ring_is_sliding_window():
+    m = Metrics()
+    for v in range(_HIST_CAP + 100):
+        m.observe("x", float(v))
+    # The first 100 samples were overwritten; min of the window is 100.
+    assert m.percentile("x", 0.0) == 100.0
+
+
+def test_timer_feeds_both_ema_and_histogram():
+    m = Metrics()
+    for _ in range(3):
+        with Timer(m, "t_us"):
+            pass
+    _, gauges = m.snapshot()
+    assert "t_us" in gauges
+    assert "t_us_p50" in gauges and "t_us_p99" in gauges
