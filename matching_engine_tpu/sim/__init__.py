@@ -1,3 +1,11 @@
-from matching_engine_tpu.sim.market_sim import SimConfig, SimState, init_sim, run_sim, sim_step_impl
+from matching_engine_tpu.sim.market_sim import (
+    SimConfig,
+    SimState,
+    init_sim,
+    run_sim,
+    run_sim_sharded,
+    sim_step_impl,
+)
 
-__all__ = ["SimConfig", "SimState", "init_sim", "run_sim", "sim_step_impl"]
+__all__ = ["SimConfig", "SimState", "init_sim", "run_sim", "run_sim_sharded",
+           "sim_step_impl"]

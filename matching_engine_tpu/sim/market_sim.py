@@ -61,9 +61,14 @@ class SimConfig:
 
 
 class SimState(NamedTuple):
-    """Device-resident agent state. Shapes [S] / [S, A]."""
+    """Device-resident agent state. Shapes [S] / [S, A].
 
-    key: jax.Array        # PRNG key
+    The PRNG key is PER SYMBOL ([S, 2]): each symbol's market is an
+    independent stochastic process, which makes the whole sim pure SPMD —
+    symbol-sharding it over a mesh changes nothing about any symbol's
+    stream (tests/test_sim.py asserts sharded == single-device)."""
+
+    keys: jax.Array       # [S, 2] per-symbol PRNG keys
     step: jax.Array       # scalar int32 step counter (drives round-robin)
     fair: jax.Array       # [S] fair-value random walk (Q4)
     mm_bid_oid: jax.Array  # [S, A] each agent's resting bid oid (0 = none)
@@ -84,8 +89,13 @@ class StepStats(NamedTuple):
 
 def init_sim(cfg: EngineConfig, scfg: SimConfig, seed: int = 0) -> SimState:
     s, a = cfg.num_symbols, scfg.agents
+    base = jax.random.PRNGKey(seed)
+    # Per-symbol independent streams, derived from the GLOBAL symbol index —
+    # a sharded run folds in the same indices, so symbol i's market is
+    # identical at any mesh size.
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(s))
     return SimState(
-        key=jax.random.PRNGKey(seed),
+        keys=keys,
         step=jnp.zeros((), I32),
         fair=jnp.full((s,), scfg.fair_init, I32),
         mm_bid_oid=jnp.zeros((s, a), I32),
@@ -97,11 +107,18 @@ def init_sim(cfg: EngineConfig, scfg: SimConfig, seed: int = 0) -> SimState:
 def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     """One step of agent decisions -> (new_state, OrderBatch)."""
     s, k, m = cfg.num_symbols, scfg.refresh, scfg.markets
-    key, k_fair, k_jb, k_ja, k_qty, k_mside, k_mqty = jax.random.split(state.key, 7)
+
+    # Per-symbol key fan-out: 7 subkeys per symbol, all draws vmapped.
+    subs = jax.vmap(lambda kk: jax.random.split(kk, 7))(state.keys)  # [S, 7, 2]
+    keys = subs[:, 0]
+
+    def draw(col, fn):
+        return jax.vmap(fn)(subs[:, col])
 
     # Fair value random walk, clamped.
     fair = jnp.clip(
-        state.fair + jax.random.randint(k_fair, (s,), -scfg.fair_vol, scfg.fair_vol + 1, I32),
+        state.fair + draw(1, lambda kk: jax.random.randint(
+            kk, (), -scfg.fair_vol, scfg.fair_vol + 1, I32)),
         scfg.fair_min, scfg.fair_max,
     )
 
@@ -112,11 +129,11 @@ def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     old_ask = state.mm_ask_oid[:, idx]
 
     # New quotes around fair value.
-    jb = jax.random.randint(k_jb, (s, k), 0, scfg.spread_jitter, I32)
-    ja = jax.random.randint(k_ja, (s, k), 0, scfg.spread_jitter, I32)
+    jb = draw(2, lambda kk: jax.random.randint(kk, (k,), 0, scfg.spread_jitter, I32))
+    ja = draw(3, lambda kk: jax.random.randint(kk, (k,), 0, scfg.spread_jitter, I32))
     bid_px = jnp.maximum(fair[:, None] - scfg.half_spread - jb, 1)
     ask_px = fair[:, None] + scfg.half_spread + ja
-    qty = jax.random.randint(k_qty, (s, 2 * k), 1, scfg.qty_max + 1, I32)
+    qty = draw(4, lambda kk: jax.random.randint(kk, (2 * k,), 1, scfg.qty_max + 1, I32))
 
     # Oid assignment: submits in batch order get consecutive per-symbol oids.
     base = state.next_oid[:, None]  # [S, 1]
@@ -125,8 +142,8 @@ def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     mkt_oid = base + 2 * k + jnp.arange(m, dtype=I32)[None, :]
 
     # Noise market orders.
-    mside = jax.random.randint(k_mside, (s, m), 0, 2, I32) + BUY  # BUY/SELL
-    mqty = jax.random.randint(k_mqty, (s, m), 1, scfg.qty_max + 1, I32)
+    mside = draw(5, lambda kk: jax.random.randint(kk, (m,), 0, 2, I32)) + BUY
+    mqty = draw(6, lambda kk: jax.random.randint(kk, (m,), 1, scfg.qty_max + 1, I32))
 
     def seg(op, side, otype, price, q, oid):
         return (op, side, otype, price, q, oid)
@@ -151,7 +168,7 @@ def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     orders = OrderBatch(*(jnp.concatenate(parts, axis=1) for parts in zip(*segs)))
 
     new_state = SimState(
-        key=key,
+        keys=keys,
         step=state.step + 1,
         fair=fair,
         mm_bid_oid=state.mm_bid_oid.at[:, idx].set(bid_oid),
@@ -161,35 +178,47 @@ def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     return new_state, orders
 
 
-def sim_step_impl(cfg: EngineConfig, scfg: SimConfig, book: BookBatch, state: SimState):
+def sim_step_impl(cfg: EngineConfig, scfg: SimConfig, book: BookBatch, state: SimState,
+                  axis: str | None = None):
     """One closed-loop step: agents -> orders -> match -> stats.
 
-    Returns (book, state, orders, stats); compose under jit/scan.
+    Returns (book, state, orders, stats); compose under jit/scan. With
+    `axis` set (inside shard_map over that mesh axis), stats are psum'd so
+    every shard reports the GLOBAL market totals.
     """
     state, orders = _gen_orders(cfg, scfg, state)
     book, out = engine_step_impl(cfg, book, orders)
 
     both = (out.best_bid > 0) & (out.best_ask > 0)
-    spread = jnp.where(
-        jnp.any(both),
-        jnp.sum(jnp.where(both, out.best_ask - out.best_bid, 0)) // jnp.maximum(jnp.sum(both), 1),
-        0,
-    )
-    stats = StepStats(
-        real_ops=jnp.sum(orders.op != 0).astype(I32),
+    sums = dict(
+        real_ops=jnp.sum(orders.op != 0),
         fills=out.fill_count,
         volume=jnp.sum(out.fill_qty),
-        spread=spread.astype(I32),
-        resting=(jnp.sum(book.bid_qty > 0) + jnp.sum(book.ask_qty > 0)).astype(I32),
+        spread_sum=jnp.sum(jnp.where(both, out.best_ask - out.best_bid, 0)),
+        both_n=jnp.sum(both),
+        resting=jnp.sum(book.bid_qty > 0) + jnp.sum(book.ask_qty > 0),
+    )
+    if axis is not None:
+        sums = {name: jax.lax.psum(v, axis) for name, v in sums.items()}
+    stats = StepStats(
+        real_ops=sums["real_ops"].astype(I32),
+        fills=sums["fills"].astype(I32),
+        volume=sums["volume"].astype(I32),
+        spread=jnp.where(
+            sums["both_n"] > 0,
+            sums["spread_sum"] // jnp.maximum(sums["both_n"], 1),
+            0,
+        ).astype(I32),
+        resting=sums["resting"].astype(I32),
     )
     return book, state, orders, stats
 
 
 def _run_impl(cfg: EngineConfig, scfg: SimConfig, steps: int, collect_orders: bool,
-              book: BookBatch, state: SimState):
+              book: BookBatch, state: SimState, axis: str | None = None):
     def scan_body(carry, _):
         book, state = carry
-        book, state, orders, stats = sim_step_impl(cfg, scfg, book, state)
+        book, state, orders, stats = sim_step_impl(cfg, scfg, book, state, axis=axis)
         return (book, state), (stats, orders if collect_orders else None)
 
     (book, state), (stats, orders) = jax.lax.scan(
@@ -222,3 +251,61 @@ def run_sim(
     book = init_book(cfg)
     state = init_sim(cfg, scfg, seed)
     return _run_jit(cfg, scfg, steps, collect_orders, book, state)
+
+
+def run_sim_sharded(
+    cfg: EngineConfig,
+    scfg: SimConfig,
+    mesh,
+    steps: int,
+    seed: int = 0,
+):
+    """run_sim over a symbol-sharded mesh (BASELINE config 5's "pmap'd
+    across v4-8" form).
+
+    Pure SPMD: each shard runs its symbol slice's independent markets; the
+    only collectives are the per-step stat psums. Because PRNG streams are
+    per-symbol (folded from GLOBAL symbol indices), results are bit-identical
+    to the single-device run at any mesh size (tests/test_sim.py).
+
+    Returns (book, state, stats[T]) — book/state remain device-sharded.
+    """
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from matching_engine_tpu.parallel.sharding import AXIS, _book_specs
+
+    assert cfg.batch == scfg.batch_for(), (
+        f"EngineConfig.batch must be {scfg.batch_for()} for this SimConfig"
+    )
+    n = mesh.devices.size
+    if cfg.num_symbols % n != 0:
+        raise ValueError(f"num_symbols={cfg.num_symbols} not divisible by mesh size {n}")
+    local_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // n)
+
+    state_specs = SimState(
+        keys=P(AXIS, None), step=P(), fair=P(AXIS),
+        mm_bid_oid=P(AXIS, None), mm_ask_oid=P(AXIS, None), next_oid=P(AXIS),
+    )
+    stats_specs = StepStats(*(P(),) * len(StepStats._fields))  # psum'd -> replicated
+
+    def local_run(book, state):
+        book, state, stats, _ = _run_impl(
+            local_cfg, scfg, steps, False, book, state, axis=AXIS)
+        return book, state, stats
+
+    mapped = jax.jit(jax.shard_map(
+        local_run,
+        mesh=mesh,
+        in_specs=(_book_specs(), state_specs),
+        out_specs=(_book_specs(), state_specs, stats_specs),
+    ))
+
+    book = jax.device_put(
+        init_book(cfg), jax.tree.map(lambda s: NamedSharding(mesh, s), _book_specs()))
+    state = jax.device_put(
+        init_sim(cfg, scfg, seed),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), state_specs))
+    return mapped(book, state)
