@@ -1,51 +1,168 @@
 """Benchmark: sustained match-engine throughput on the attached accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
+and always exits 0 — a wedged TPU tunnel must degrade the number, never the
+driver run (round 1's bench died rc=1 on backend init and hung >9 min on a
+rerun; this orchestrator is the fix).
 
-The reference publishes no benchmark numbers (BASELINE.md — its matching core
-is an empty file and its hot path is one SQLite INSERT under a global mutex),
-so vs_baseline is measured against this repo's north-star target of 10M
-orders/sec (BASELINE.json) rather than a reference figure.
+Structure: this process never imports jax. The measurement runs in a child
+(benchmarks/bench_child.py) whose wall-clock is bounded here:
 
-Method (utils/measure.py, shared with benchmarks/run_all.py): steady-state
-device throughput of the jit'd engine step at the north-star condition — a
-realistic mixed 4096-symbol stream (limit adds that rest, crossing limits,
-markets, cancels) pre-built into [S, B] dispatches, run back-to-back with the
-book donated in HBM; the median of post-warm-up fully-synced timing windows
-is reported. orders/sec counts real (non-padding) ops.
+  1. preflight + measure on the default backend (TPU via the axon tunnel),
+     bounded retries with backoff — each attempt SIGTERM'd then SIGKILL'd on
+     timeout (a wedged backend ignores SIGTERM);
+  2. on failure, a CPU fallback at a reduced, clearly-labeled config
+     (JAX_PLATFORMS=cpu with the axon relay env stripped, so a wedged tunnel
+     can't hang interpreter start);
+  3. if even that fails, a value-0 line with the error — still rc=0.
+
+The reference publishes no benchmark numbers (BASELINE.md — its matching
+core is an empty file and its hot path is one SQLite INSERT under a global
+mutex), so vs_baseline is measured against this repo's north-star target of
+10M orders/sec (BASELINE.json). Method + checked-in artifacts:
+docs/BENCH_METHOD.md.
 """
 
 from __future__ import annotations
 
 import json
-
-from matching_engine_tpu.engine.book import EngineConfig
-from matching_engine_tpu.engine.harness import random_order_stream
-from matching_engine_tpu.utils.measure import measure_device_throughput
+import os
+import subprocess
+import sys
+import tempfile
+import time
 
 NORTH_STAR = 10_000_000  # orders/sec, BASELINE.json
+REPO = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(REPO, "benchmarks", "bench_child.py")
+
+WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", 480))
+TPU_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT_S", 300))
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
+CPU_RESERVE_S = 150.0  # wall-clock kept aside for the CPU fallback
+RETRY_BACKOFF_S = 10.0
+
+# North-star config (BASELINE.json): 4k symbols; batch 32 amortizes dispatch
+# overhead over a longer in-kernel scan. The CPU fallback runs the same
+# kernel at the suite's reduced config-3 size so it finishes inside budget.
+TPU_ARGS = ["--symbols", "4096", "--capacity", "128", "--batch", "32"]
+CPU_ARGS = ["--symbols", "512", "--capacity", "128", "--batch", "32",
+            "--windows", "3", "--iters", "5"]
 
 
-def main() -> None:
-    # North-star condition (BASELINE.json): 4k symbols. batch=32 amortizes the
-    # per-step dispatch overhead over a longer in-kernel scan.
-    cfg = EngineConfig(num_symbols=4096, capacity=128, batch=32, max_fills=1 << 17)
-    streams = [
-        random_order_stream(
-            cfg.num_symbols, 4 * cfg.num_symbols * cfg.batch, seed=w, cancel_p=0.10,
-            market_p=0.15, price_base=9_950, price_levels=100, price_step=1,
-            qty_max=100,
-        )
-        for w in range(4)
-    ]
-    value, _lat_us = measure_device_throughput(cfg, streams)
-    print(json.dumps({
+def run_child(extra_env: dict, args: list, timeout_s: float):
+    """Run one bench_child with a hard kill deadline.
+
+    Returns (result_dict | None, error | None). Timeout escalates
+    SIGTERM -> SIGKILL: a child stuck in a wedged backend init never
+    handles SIGTERM.
+    """
+    fd, out_path = tempfile.mkstemp(suffix=".json", prefix="bench_")
+    os.close(fd)
+    env = dict(os.environ)
+    env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, "--json-out", out_path, *args],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        try:
+            _, stderr = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                try:
+                    proc.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (wedged in D-state): abandon it
+            # The wedge can strike in backend TEARDOWN, after the
+            # measurement was written — salvage it rather than fall back.
+            try:
+                with open(out_path) as f:
+                    return json.load(f), None
+            except (OSError, ValueError):
+                pass
+            return None, f"timeout after {timeout_s:.0f}s"
+        if proc.returncode != 0:
+            tail = " | ".join((stderr or "").strip().splitlines()[-3:])
+            return None, f"rc={proc.returncode}: {tail[-500:]}"
+        try:
+            with open(out_path) as f:
+                return json.load(f), None
+        except (OSError, ValueError) as e:
+            return None, f"child wrote no result: {e}"
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
+def emit(value: float, extra: dict) -> None:
+    line = {
         "metric": "match_throughput",
         "value": round(value, 1),
         "unit": "orders/sec",
         "vs_baseline": round(value / NORTH_STAR, 4),
-    }))
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def main() -> None:
+    deadline = time.monotonic() + WALL_BUDGET_S
+    errors: list[str] = []
+
+    for attempt in range(TPU_ATTEMPTS):
+        # Split the remaining pre-reserve wall across the attempts still
+        # owed, so a full attempt-1 timeout leaves attempt 2 a real budget.
+        attempts_left = TPU_ATTEMPTS - attempt
+        budget = min(TPU_ATTEMPT_TIMEOUT_S,
+                     (deadline - time.monotonic() - CPU_RESERVE_S) / attempts_left)
+        if budget < min(60, TPU_ATTEMPT_TIMEOUT_S):
+            errors.append("tpu attempts stopped: wall budget exhausted")
+            break
+        if attempt:
+            time.sleep(min(RETRY_BACKOFF_S, max(0, deadline - time.monotonic() - CPU_RESERVE_S - 60)))
+        result, err = run_child({}, TPU_ARGS, budget)
+        if result is not None:
+            emit(result.pop("value"), result)
+            return
+        errors.append(f"attempt {attempt + 1}: {err}")
+
+    # CPU fallback — labeled, reduced config, axon relay env stripped so a
+    # wedged tunnel can't hang interpreter start (sitecustomize registers
+    # with the relay when PALLAS_AXON_POOL_IPS is set).
+    env = {"JAX_PLATFORMS": "cpu"}
+    budget = max(30.0, deadline - time.monotonic() - 5)
+    saved = os.environ.get("PALLAS_AXON_POOL_IPS")
+    if saved is not None:
+        del os.environ["PALLAS_AXON_POOL_IPS"]
+    try:
+        result, err = run_child(env, CPU_ARGS, min(budget, 240.0))
+    finally:
+        if saved is not None:
+            os.environ["PALLAS_AXON_POOL_IPS"] = saved
+    tpu_error = "; ".join(errors) or "unknown"
+    if result is not None:
+        emit(result.pop("value"), {
+            **result,
+            "error": f"tpu unavailable, CPU-fallback figure: {tpu_error}",
+        })
+        return
+    emit(0.0, {"error": f"tpu: {tpu_error}; cpu fallback: {err}"})
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — one JSON line, rc 0, no matter what
+        print(json.dumps({
+            "metric": "match_throughput", "value": 0.0, "unit": "orders/sec",
+            "vs_baseline": 0.0, "error": f"bench orchestrator: {type(e).__name__}: {e}",
+        }))
+    sys.exit(0)

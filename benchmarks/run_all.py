@@ -103,7 +103,7 @@ def config2_poisson(full: bool):
         streams.append(stream)
     rate, lat_us = measure_device_throughput(cfg, streams)
     emit(2, "poisson_limit_throughput", rate, "orders/sec",
-         {"dispatch_latency_us": round(lat_us, 1), "symbols": s})
+         {"mean_dispatch_latency_us": round(lat_us, 1), "symbols": s})
 
 
 # -- config 3: L3-style replay (bench.py's configuration) --------------------
@@ -119,7 +119,7 @@ def config3_l3(full: bool):
     ]
     rate, lat_us = measure_device_throughput(cfg, streams)
     emit(3, "l3_replay_throughput", rate, "orders/sec",
-         {"dispatch_latency_us": round(lat_us, 1), "symbols": s})
+         {"mean_dispatch_latency_us": round(lat_us, 1), "symbols": s})
 
 
 # -- config 4: gRPC fan-in through the full server stack ---------------------

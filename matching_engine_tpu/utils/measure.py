@@ -31,7 +31,10 @@ def measure_device_throughput(
     iters: int = 20,
     waves_per_stream: int = 2,
 ):
-    """Returns (sustained orders/sec, median per-dispatch latency in µs).
+    """Returns (sustained orders/sec, mean dispatch latency in µs — the
+    median across windows of each window's MEAN step latency dt/iters; a
+    mean, not a percentile — real p50/p99 come from the serving-stack
+    benchmark, see docs/BENCH_METHOD.md).
 
     `streams` is a list of HostOrder lists; the leading `waves_per_stream`
     dispatches of each are cycled during the timed loop.
