@@ -63,6 +63,11 @@ def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch
     counts = np.zeros((s,), dtype=np.int64)  # orders seen per symbol so far
 
     for o in orders:
+        if not (-(1 << 31) <= o.oid < (1 << 31)):
+            # Device oid lanes are int32 by design; unbounded host OIDs map
+            # onto recycled int32 handles in the EngineRunner. Reaching here
+            # with a wider value is a caller bug — fail, never wrap.
+            raise ValueError(f"oid {o.oid} exceeds the int32 device lane")
         i, row = divmod(int(counts[o.sym]), b)
         while i >= len(batches):
             batches.append(np.zeros((s, b, 6), dtype=np.int32))

@@ -45,7 +45,14 @@ from matching_engine_tpu.utils.tracing import step_annotation
 
 @dataclasses.dataclass
 class OrderInfo:
-    """Host directory entry for one accepted order."""
+    """Host directory entry for one accepted order.
+
+    `oid` is the unbounded host order number ("OID-<oid>" — a Python int,
+    int64+ safe). `handle` is the order's *device* identity: a recycled
+    int32 drawn from the runner's allocator, unique among live orders only.
+    The device book/fill lanes stay int32 (TPU-native lane width) no matter
+    how many orders the server has ever seen; the host maps handle->info.
+    """
 
     oid: int
     order_id: str
@@ -57,6 +64,7 @@ class OrderInfo:
     quantity: int
     remaining: int
     status: int
+    handle: int = 0
 
 
 @dataclasses.dataclass
@@ -118,9 +126,20 @@ class EngineRunner:
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
         self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
-        self.orders_by_num: dict[int, OrderInfo] = {}
+        self.orders_by_handle: dict[int, OrderInfo] = {}
         self.orders_by_id: dict[str, OrderInfo] = {}
         self.next_oid_num = 1
+        # Device-handle allocator: handles recycle when orders go terminal,
+        # so the int32 lane space can never wrap no matter the order count
+        # (live handles are bounded by open + in-flight orders).
+        self._next_handle = 1            # 0 = empty lane, never allocated
+        self._free_handles: list[int] = []
+        # Per-slot live (open or in-flight) order counts; a slot whose count
+        # returns to 0 is recycled, so the symbol axis bounds *concurrent*
+        # symbols, not lifetime-distinct ones.
+        self._slot_live = [0] * cfg.num_symbols
+        self._free_slots: list[int] = []
+        self._next_slot = 0
 
     def place_book(self, host_book) -> None:
         """Install a host-side BookBatch as the live device book, honoring
@@ -142,18 +161,70 @@ class EngineRunner:
         with self._id_lock:
             self.next_oid_num = max(self.next_oid_num, next_n)
 
-    def symbol_slot(self, symbol: str) -> int | None:
-        """Existing slot, or allocate one; None when the symbol axis is full."""
+    def assign_handle(self) -> int:
+        """A device handle unique among live orders (recycled int32)."""
         with self._id_lock:
-            slot = self.symbols.get(symbol)
-            if slot is not None:
-                return slot
-            if len(self.symbols) >= self.cfg.num_symbols:
-                return None
-            slot = len(self.symbols)
-            self.symbols[symbol] = slot
-            self.slot_symbols[slot] = symbol
+            if self._free_handles:
+                return self._free_handles.pop()
+            h = self._next_handle
+            if h >= 2**31:
+                # Unreachable in practice: reached only if >2^31 handles
+                # leak without recycling. Fail loudly, never wrap the lane.
+                raise RuntimeError("device handle space exhausted")
+            self._next_handle += 1
+            return h
+
+    def _release_handle(self, h: int) -> None:
+        if h:
+            with self._id_lock:
+                self._free_handles.append(h)
+
+    def symbol_slot(self, symbol: str) -> int | None:
+        """Existing slot, or allocate one; None when the symbol axis is full
+        of symbols that still have live orders (empty slots are recycled)."""
+        with self._id_lock:
+            return self._slot_locked(symbol)
+
+    def _slot_locked(self, symbol: str) -> int | None:
+        slot = self.symbols.get(symbol)
+        if slot is not None:
             return slot
+        if self._free_slots:
+            slot = self._free_slots.pop()
+        elif self._next_slot < self.cfg.num_symbols:
+            slot = self._next_slot
+            self._next_slot += 1
+        else:
+            return None
+        self.symbols[symbol] = slot
+        self.slot_symbols[slot] = symbol
+        return slot
+
+    def slot_acquire(self, symbol: str) -> int | None:
+        """Allocate/find the symbol's slot AND count one live order on it.
+
+        The submit path must use this (not symbol_slot) so a slot can never
+        be recycled between RPC validation and dispatch. Paired with the
+        release in the dispatch's terminal-eviction pass.
+        """
+        with self._id_lock:
+            slot = self._slot_locked(symbol)
+            if slot is not None:
+                self._slot_live[slot] += 1
+            return slot
+
+    def _slot_release(self, slot: int) -> None:
+        """One live order on `slot` went terminal; recycle the slot when its
+        book is empty (count 0 == no resting or in-flight orders — the
+        device lanes for it are all qty==0 by the masking invariant)."""
+        with self._id_lock:
+            self._slot_live[slot] -= 1
+            if self._slot_live[slot] == 0:
+                sym = self.slot_symbols[slot]
+                if sym is not None:
+                    del self.symbols[sym]
+                    self.slot_symbols[slot] = None
+                    self._free_slots.append(slot)
 
     # -- the dispatch ------------------------------------------------------
 
@@ -163,10 +234,18 @@ class EngineRunner:
             return self._run_dispatch_locked(ops)
 
     def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
+        res = DispatchResult([], [], [], [], [], [], 0)
         host_orders = []
-        by_oid: dict[int, EngineOp] = {}
+        by_handle: dict[int, EngineOp] = {}
         for e in ops:
             i = e.info
+            if e.op == OP_CANCEL and i.status in (FILLED, CANCELED, REJECTED):
+                # The target went terminal (and its handle was recycled)
+                # after this cancel was enqueued — a device cancel now could
+                # hit an unrelated order reusing the handle. Reject on the
+                # host; the device never sees a stale handle.
+                res.outcomes.append(OpOutcome(e, REJECTED, 0, 0, "order not open"))
+                continue
             slot = self.symbols[i.symbol]  # caller guarantees allocation
             host_orders.append(
                 HostOrder(
@@ -176,13 +255,13 @@ class EngineRunner:
                     otype=i.otype,
                     price=i.price_q4,
                     qty=i.remaining if e.op == OP_SUBMIT else 0,
-                    oid=i.oid,
+                    oid=i.handle,
                 )
             )
-            by_oid[i.oid] = e
+            by_handle[i.handle] = e
 
-        res = DispatchResult([], [], [], [], [], [], 0)
         touched_syms: set[int] = set()
+        terminal_makers: set[int] = set()
         last_out = None
         for batch in build_batches(self.cfg, host_orders):
             self._step_num += 1
@@ -201,7 +280,7 @@ class EngineRunner:
             last_out = out
             if overflow:
                 self.metrics.inc("fill_buffer_overflows")
-            self._decode_batch(results, fills, by_oid, res)
+            self._decode_batch(results, fills, by_handle, res, terminal_makers)
             touched_syms.update(r.sym for r in results)
             res.fill_count += len(fills)
 
@@ -211,38 +290,51 @@ class EngineRunner:
         # Evict terminal orders from the directories: once FILLED / CANCELED /
         # REJECTED an order can never be referenced by a later fill, book
         # snapshot, or legitimate cancel ("unknown order id" and "order not
-        # open" are equivalent rejects). Without this the directories grow
-        # one entry per order for process lifetime.
+        # open" are equivalent rejects); eviction recycles the handle and,
+        # when the symbol goes quiet, the slot. Cost is O(batch + fills):
+        # terminal makers were collected in decode pass 2 — never by
+        # sweeping the whole directory of resting orders.
         for e in ops:
             i = e.info
-            if i.status in (FILLED, CANCELED, REJECTED) and e.op == OP_SUBMIT:
-                self.orders_by_num.pop(i.oid, None)
-                self.orders_by_id.pop(i.order_id, None)
+            if e.op == OP_SUBMIT and i.status in (FILLED, CANCELED, REJECTED):
+                self._evict(i)
             elif e.op == OP_CANCEL and i.status == CANCELED:
-                self.orders_by_num.pop(i.oid, None)
-                self.orders_by_id.pop(i.order_id, None)
-        # Makers that just went terminal via fills.
-        for oid in [
-            o for o, i in self.orders_by_num.items()
-            if i.status in (FILLED, CANCELED, REJECTED)
-        ]:
-            info = self.orders_by_num.pop(oid)
-            self.orders_by_id.pop(info.order_id, None)
+                self._evict(i)
+        for h in terminal_makers:
+            info = self.orders_by_handle.get(h)
+            if info is not None and info.status in (FILLED, CANCELED, REJECTED):
+                self._evict(info)
 
         self.metrics.inc("dispatches")
         self.metrics.inc("engine_ops", len(ops))
         self.metrics.inc("fills", res.fill_count)
         return res
 
+    def _evict(self, info: OrderInfo) -> None:
+        """Drop a terminal order from the directories; recycle its handle
+        and (via the live count) possibly its symbol slot. Idempotent — an
+        order can go terminal as taker and be collected as maker within the
+        same dispatch."""
+        if self.orders_by_handle.pop(info.handle, None) is None:
+            return
+        self.orders_by_id.pop(info.order_id, None)
+        self._release_handle(info.handle)
+        slot = self.symbols.get(info.symbol)
+        if slot is not None:
+            self._slot_release(slot)
+
     # -- decoding helpers --------------------------------------------------
 
-    def _decode_batch(self, results, fills, by_oid, res: DispatchResult) -> None:
+    def _decode_batch(
+        self, results, fills, by_handle, res: DispatchResult,
+        terminal_makers: set[int],
+    ) -> None:
         # Pass 1 — taker outcomes: register fresh orders in the directories
         # and pin their post-step remaining, BEFORE maker bookkeeping (an
         # order can rest and be hit as maker within the same batch; maker
         # decrements must land on the post-taker remaining).
         for r in results:
-            e = by_oid.get(r.oid)
+            e = by_handle.get(r.oid)
             if e is None:
                 continue
             info = e.info
@@ -264,12 +356,12 @@ class EngineRunner:
                      info.otype, price_col, info.quantity, info.remaining,
                      info.status)
                 )
-                self.orders_by_num[info.oid] = info
+                self.orders_by_handle[info.handle] = info
                 self.orders_by_id[info.order_id] = info
                 # Taker's own updates: one per fill + terminal/new status.
                 rem = info.quantity
                 for f in fills:
-                    if f.taker_oid != info.oid:
+                    if f.taker_oid != info.handle:
                         continue
                     rem -= f.quantity
                     st = FILLED if (rem == 0 and info.remaining == 0) else PARTIALLY_FILLED
@@ -294,12 +386,14 @@ class EngineRunner:
         # (order_id = aggressor/taker, counter_order_id = maker); the
         # maker's remaining/status is carried by an orders-table update.
         for f in fills:
-            maker = self.orders_by_num.get(f.maker_oid)
-            taker = self.orders_by_num.get(f.taker_oid)
+            maker = self.orders_by_handle.get(f.maker_oid)
+            taker = self.orders_by_handle.get(f.taker_oid)
             if maker is None or taker is None:
                 continue  # unreachable if directories are consistent
             maker.remaining -= f.quantity
             maker.status = FILLED if maker.remaining == 0 else PARTIALLY_FILLED
+            if maker.remaining == 0:
+                terminal_makers.add(f.maker_oid)
             res.storage_fills.append(
                 FillRow(taker.order_id, maker.order_id, f.price_q4, f.quantity)
             )
@@ -371,7 +465,7 @@ class EngineRunner:
             rows.sort(key=lambda r: (-r[1] if desc else r[1], r[3]))
             out = []
             for o, p, q, _ in rows:
-                info = self.orders_by_num.get(o)
+                info = self.orders_by_handle.get(o)
                 if info is not None:
                     out.append((info, q))
             return out

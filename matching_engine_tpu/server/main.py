@@ -50,16 +50,16 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
     rows = storage.open_orders()
     ops = []
     for (order_id, client_id, symbol, side, otype, price, qty, remaining, status) in rows:
-        if runner.symbol_slot(symbol) is None:
+        if runner.slot_acquire(symbol) is None:
             print(f"[SERVER] recovery: symbol axis full, dropping {order_id}")
             continue
         num = int(order_id.split("-", 1)[1]) if order_id.startswith("OID-") else 0
         info = OrderInfo(
             oid=num, order_id=order_id, client_id=client_id, symbol=symbol,
             side=side, otype=otype, price_q4=price, quantity=qty,
-            remaining=remaining, status=status,
+            remaining=remaining, status=status, handle=runner.assign_handle(),
         )
-        runner.orders_by_num[num] = info
+        runner.orders_by_handle[info.handle] = info
         runner.orders_by_id[order_id] = info
         ops.append(EngineOp(OP_SUBMIT, info))
     if ops:

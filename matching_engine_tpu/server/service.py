@@ -77,7 +77,9 @@ class MatchingEngineService(MatchingEngineServicer):
         )
 
         err = validate_submit(request)
-        if err is None and self.runner.symbol_slot(request.symbol) is None:
+        # slot_acquire also counts one live order on the slot, so the slot
+        # cannot be recycled between this validation and the dispatch.
+        if err is None and self.runner.slot_acquire(request.symbol) is None:
             err = "symbol capacity exhausted (engine symbol axis is full)"
         if err is not None:
             self.metrics.inc("orders_rejected")
@@ -94,10 +96,14 @@ class MatchingEngineService(MatchingEngineServicer):
             symbol=request.symbol, side=request.side,
             otype=request.order_type, price_q4=price_q4,
             quantity=request.quantity, remaining=request.quantity, status=0,
+            handle=self.runner.assign_handle(),
         )
         try:
             outcome = self.dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
         except Exception as e:  # noqa: BLE001 — engine failure => app-level reject
+            # The op may still be queued (timeout) or half-applied (dispatch
+            # error), so the handle/slot must NOT be recycled here — a rare
+            # bounded leak beats handle reuse against a possibly-live order.
             self.metrics.inc("orders_errored")
             self._log(f"engine error for {order_id}: {e}")
             return pb2.OrderResponse(

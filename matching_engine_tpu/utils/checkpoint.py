@@ -65,12 +65,12 @@ def save_checkpoint(path: str, runner) -> None:
         symbols = dict(runner.symbols)
         next_oid_num = runner.next_oid_num
     meta = {
-        "version": 1,
+        "version": 2,  # v2: orders carry device handles (recycled int32 ids)
         "ts": time.time(),
         "cfg": dataclasses.asdict(runner.cfg),
         "symbols": symbols,
         "next_oid_num": next_oid_num,
-        "orders": [dataclasses.asdict(i) for i in list(runner.orders_by_num.values())],
+        "orders": [dataclasses.asdict(i) for i in list(runner.orders_by_handle.values())],
     }
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
@@ -110,6 +110,11 @@ def restore_runner(runner, path: str, storage=None) -> int:
     from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
 
     cfg, host_book, meta = load_checkpoint(path)
+    if meta.get("version") != 2:
+        raise ValueError(
+            f"unsupported checkpoint version {meta.get('version')} "
+            "(pre-handle formats restore via full replay)"
+        )
     if cfg.semantic_key() != runner.cfg.semantic_key():
         raise ValueError(
             f"checkpoint config {cfg} does not match runner config {runner.cfg}"
@@ -119,13 +124,33 @@ def restore_runner(runner, path: str, storage=None) -> int:
     runner.slot_symbols = [None] * cfg.num_symbols
     for sym, slot in runner.symbols.items():
         runner.slot_symbols[slot] = sym
-    runner.orders_by_num = {}
+    runner.orders_by_handle = {}
     runner.orders_by_id = {}
     for d in meta["orders"]:
         info = OrderInfo(**d)
-        runner.orders_by_num[info.oid] = info
+        runner.orders_by_handle[info.handle] = info
         runner.orders_by_id[info.order_id] = info
     runner.seed_oid_sequence(int(meta["next_oid_num"]))
+    # Rebuild allocator + slot-liveness state from the restored directory.
+    # Handles of orders that died between this snapshot's birth process and
+    # now are simply never reissued (next_handle continues past the max).
+    runner._next_handle = 1 + max(
+        (i.handle for i in runner.orders_by_handle.values()), default=0
+    )
+    runner._free_handles = []
+    runner._slot_live = [0] * cfg.num_symbols
+    for info in runner.orders_by_handle.values():
+        runner._slot_live[runner.symbols[info.symbol]] += 1
+    # Symbols snapshotted with zero live orders (their submits were queued
+    # but never dispatched in the dead process) have no claim on a slot.
+    for sym, slot in list(runner.symbols.items()):
+        if runner._slot_live[slot] == 0:
+            del runner.symbols[sym]
+            runner.slot_symbols[slot] = None
+    runner._next_slot = 1 + max(runner.symbols.values(), default=-1)
+    runner._free_slots = [
+        s for s in range(runner._next_slot) if runner.slot_symbols[s] is None
+    ]
 
     if storage is None:
         return 0
@@ -140,15 +165,17 @@ def restore_runner(runner, path: str, storage=None) -> int:
 
     ops: list[EngineOp] = []
     # 1) snapshot orders the DB has since closed or changed: cancel stale
-    #    device entries (and resubmit below with the DB remaining).
+    #    device entries (and resubmit below with the DB remaining). The
+    #    cancel dispatch itself evicts them — recycling handle and slot —
+    #    so nothing is deleted from the directories by hand here.
     resubmit: list[OrderInfo] = []
+    stale_ids: set[str] = set()
     for order_id, info in list(runner.orders_by_id.items()):
         row = db_open.get(order_id)
         if row is not None and row[7] == info.remaining:
             continue  # snapshot is current for this order
         ops.append(EngineOp(OP_CANCEL, info, cancel_requester="__recovery__"))
-        del runner.orders_by_id[order_id]
-        del runner.orders_by_num[info.oid]
+        stale_ids.add(order_id)
         if row is not None and row[7] > 0:
             resubmit.append(OrderInfo(
                 oid=info.oid, order_id=order_id, client_id=row[1],
@@ -158,13 +185,11 @@ def restore_runner(runner, path: str, storage=None) -> int:
     # 2) DB-open orders the snapshot has never seen: submit them.
     resubmit_ids = {i.order_id for i in resubmit}
     for order_id, row in db_open.items():
-        if order_id in runner.orders_by_id:
+        if order_id in runner.orders_by_id and order_id not in stale_ids:
             continue
-        if order_id in resubmit_ids:
+        if order_id in resubmit_ids or order_id in stale_ids:
             continue
         num = int(order_id.split("-", 1)[1]) if order_id.startswith("OID-") else 0
-        if runner.symbol_slot(row[2]) is None:
-            continue  # symbol axis full; mirrors recover_books' drop policy
         resubmit.append(OrderInfo(
             oid=num, order_id=order_id, client_id=row[1], symbol=row[2],
             side=row[3], otype=row[4], price_q4=row[5], quantity=row[6],
@@ -173,12 +198,14 @@ def restore_runner(runner, path: str, storage=None) -> int:
 
     if ops:
         runner.run_dispatch(ops)  # cancels first: frees capacity + removes stale
+    # Handles/slots are assigned only now, after the cancel dispatch has
+    # recycled the stale entries' — the allocator can't collide with a
+    # handle that is still live on the device.
     sub_ops = []
     for info in sorted(resubmit, key=lambda i: i.oid):
-        if runner.symbol_slot(info.symbol) is None:
-            continue
-        runner.orders_by_num[info.oid] = info
-        runner.orders_by_id[info.order_id] = info
+        if runner.slot_acquire(info.symbol) is None:
+            continue  # symbol axis full; mirrors recover_books' drop policy
+        info.handle = runner.assign_handle()
         sub_ops.append(EngineOp(OP_SUBMIT, info))
     if sub_ops:
         runner.run_dispatch(sub_ops)

@@ -80,12 +80,11 @@ def test_dispatch_survives_sink_death(tmp_path):
 
     def submit(side):
         oid_num, order_id = runner.assign_oid()
-        assert runner.symbol_slot("SYM") is not None
+        assert runner.slot_acquire("SYM") is not None
         info = OrderInfo(
             oid=oid_num, order_id=order_id, client_id="c1", symbol="SYM",
-            side=side, otype=0, price_q4=100, quantity=5, remaining=5, status=0)
-        runner.orders_by_num[oid_num] = info
-        runner.orders_by_id[order_id] = info
+            side=side, otype=0, price_q4=100, quantity=5, remaining=5, status=0,
+            handle=runner.assign_handle())
         return disp.submit(EngineOp(OP_SUBMIT, info)).result(timeout=10)
 
     try:
