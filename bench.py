@@ -118,11 +118,15 @@ def main() -> None:
     errors: list[str] = []
 
     for attempt in range(TPU_ATTEMPTS):
-        # Split the remaining pre-reserve wall across the attempts still
-        # owed, so a full attempt-1 timeout leaves attempt 2 a real budget.
-        attempts_left = TPU_ATTEMPTS - attempt
-        budget = min(TPU_ATTEMPT_TIMEOUT_S,
-                     (deadline - time.monotonic() - CPU_RESERVE_S) / attempts_left)
+        # Attempt 1 gets the full attempt timeout: killing the child mid
+        # cold-compile is what wedges the axon tunnel, so the orchestrator
+        # must never convert a slow compile into a wedge. Only retries split
+        # the remaining pre-reserve wall (a wedged init fails fast anyway).
+        remaining = deadline - time.monotonic() - CPU_RESERVE_S
+        if attempt == 0:
+            budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining)
+        else:
+            budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining / (TPU_ATTEMPTS - attempt))
         if budget < min(60, TPU_ATTEMPT_TIMEOUT_S):
             errors.append("tpu attempts stopped: wall budget exhausted")
             break

@@ -93,19 +93,20 @@ def decode_results(batch: OrderBatch, status, filled, remaining) -> list[HostRes
     op = np.asarray(batch.op)
     oid = np.asarray(batch.oid)
 
-    results = []
+    # np.nonzero is row-major, so results keep (symbol, batch-row) device
+    # order — engine_runner's decode relies on that to replay the scan's
+    # event order. Bulk fancy-index + tolist: no per-element boxing.
     sym_idx, row_idx = np.nonzero(op != OP_NOOP)
-    for s_i, b_i in zip(sym_idx.tolist(), row_idx.tolist()):
-        results.append(
-            HostResult(
-                oid=int(oid[s_i, b_i]),
-                sym=s_i,
-                status=int(status[s_i, b_i]),
-                filled=int(filled[s_i, b_i]),
-                remaining=int(remaining[s_i, b_i]),
-            )
+    return [
+        HostResult(*t)
+        for t in zip(
+            oid[sym_idx, row_idx].tolist(),
+            sym_idx.tolist(),
+            status[sym_idx, row_idx].tolist(),
+            filled[sym_idx, row_idx].tolist(),
+            remaining[sym_idx, row_idx].tolist(),
         )
-    return results
+    ]
 
 
 def decode_step(
@@ -114,23 +115,19 @@ def decode_step(
     """Decode one StepOutput into per-order results + the fill log."""
     results = decode_results(batch, out.status, out.filled, out.remaining)
 
-    # One bulk device->host transfer per array; per-element indexing of jax
-    # arrays would dispatch a device gather per int.
+    # One bulk device->host transfer per array, then one bulk tolist() per
+    # column: per-element indexing of jax/numpy arrays would cost a device
+    # gather (jax) or a boxed scalar conversion (numpy) per int.
     n = int(out.fill_count)
-    f_sym = np.asarray(out.fill_sym[:n])
-    f_taker = np.asarray(out.fill_taker_oid[:n])
-    f_maker = np.asarray(out.fill_maker_oid[:n])
-    f_price = np.asarray(out.fill_price[:n])
-    f_qty = np.asarray(out.fill_qty[:n])
     fills = [
-        HostFill(
-            sym=int(f_sym[i]),
-            taker_oid=int(f_taker[i]),
-            maker_oid=int(f_maker[i]),
-            price_q4=int(f_price[i]),
-            quantity=int(f_qty[i]),
+        HostFill(*t)
+        for t in zip(
+            np.asarray(out.fill_sym[:n]).tolist(),
+            np.asarray(out.fill_taker_oid[:n]).tolist(),
+            np.asarray(out.fill_maker_oid[:n]).tolist(),
+            np.asarray(out.fill_price[:n]).tolist(),
+            np.asarray(out.fill_qty[:n]).tolist(),
         )
-        for i in range(n)
     ]
     return results, fills, bool(out.fill_overflow)
 

@@ -22,6 +22,15 @@ from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner
 from matching_engine_tpu.utils.metrics import Metrics
 
 
+class RingFull(RuntimeError):
+    """Op rejected before entering the dispatch queue (native ring full).
+
+    Distinct from generic dispatch failures because the op is KNOWN to have
+    never been enqueued: the caller may safely recycle the op's handle/slot
+    (EngineRunner.release_unqueued) — for a maybe-enqueued failure that
+    would risk handle reuse against a possibly-live order."""
+
+
 class BatchDispatcher:
     def __init__(
         self,
@@ -188,7 +197,7 @@ class NativeRingDispatcher(BatchDispatcher):
             with self._tag_lock:
                 self._tags.pop(tag, None)
             self.metrics.inc("ring_rejects")
-            fut.set_exception(RuntimeError("op ring full"))
+            fut.set_exception(RingFull("op ring full"))
         return fut
 
     def close(self) -> None:

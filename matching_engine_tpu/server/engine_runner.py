@@ -28,6 +28,7 @@ import numpy as np
 from matching_engine_tpu.engine.book import EngineConfig, OrderBatch, init_book
 from matching_engine_tpu.engine.harness import HostOrder, build_batches, decode_step
 from matching_engine_tpu.engine.kernel import (
+    BUY,
     CANCELED,
     FILLED,
     NEW,
@@ -35,6 +36,7 @@ from matching_engine_tpu.engine.kernel import (
     OP_SUBMIT,
     PARTIALLY_FILLED,
     REJECTED,
+    SELL,
     engine_step,
 )
 from matching_engine_tpu.proto import pb2
@@ -178,6 +180,18 @@ class EngineRunner:
         if h:
             with self._id_lock:
                 self._free_handles.append(h)
+
+    def release_unqueued(self, info: OrderInfo) -> None:
+        """Recycle the handle + slot live-count of a submit that is KNOWN to
+        have never entered the dispatch queue (RingFull reject). The device
+        never saw the handle and no directory entry exists, so recycling is
+        safe; without this, sustained ring-full overload leaks one handle
+        and one slot live-count per reject (ADVICE r2)."""
+        self._release_handle(info.handle)
+        # Our un-dropped live count pins the symbol->slot mapping.
+        slot = self.symbols.get(info.symbol)
+        if slot is not None:
+            self._slot_release(slot)
 
     def symbol_slot(self, symbol: str) -> int | None:
         """Existing slot, or allocate one; None when the symbol axis is full
@@ -329,10 +343,19 @@ class EngineRunner:
         self, results, fills, by_handle, res: DispatchResult,
         terminal_makers: set[int],
     ) -> None:
-        # Pass 1 — taker outcomes: register fresh orders in the directories
-        # and pin their post-step remaining, BEFORE maker bookkeeping (an
-        # order can rest and be hit as maker within the same batch; maker
-        # decrements must land on the post-taker remaining).
+        # Decode in DEVICE order: results arrive (symbol, batch-row)-sorted,
+        # and each fill belongs to exactly one taker row, so applying a
+        # taker's maker-consequences at its own row replays the scan's true
+        # event order. This matters when one batch partially fills an order
+        # and then cancels it: the fills happened before the cancel, so the
+        # maker decrements must land before the cancel zeroes remaining
+        # (processing them afterwards drove remaining negative — a CHECK
+        # violation in the durable store). Grouping fills by taker up front
+        # also makes the whole decode O(results + fills), not O(R*F).
+        fills_by_taker: dict[int, list] = {}
+        for f in fills:
+            fills_by_taker.setdefault(f.taker_oid, []).append(f)
+
         for r in results:
             e = by_handle.get(r.oid)
             if e is None:
@@ -358,15 +381,32 @@ class EngineRunner:
                 )
                 self.orders_by_handle[info.handle] = info
                 self.orders_by_id[info.order_id] = info
-                # Taker's own updates: one per fill + terminal/new status.
+                # This row's executions: taker-side updates + maker
+                # bookkeeping, in priority order. One storage row per
+                # execution (order_id = aggressor, counter_order_id = maker);
+                # the maker's remaining/status is an orders-table update.
                 rem = info.quantity
-                for f in fills:
-                    if f.taker_oid != info.handle:
-                        continue
+                for f in fills_by_taker.get(info.handle, ()):
                     rem -= f.quantity
                     st = FILLED if (rem == 0 and info.remaining == 0) else PARTIALLY_FILLED
                     res.order_updates.append(
                         self._update(info, st, f.price_q4, f.quantity, rem)
+                    )
+                    maker = self.orders_by_handle.get(f.maker_oid)
+                    if maker is None:
+                        continue  # unreachable if directories are consistent
+                    maker.remaining -= f.quantity
+                    maker.status = FILLED if maker.remaining == 0 else PARTIALLY_FILLED
+                    if maker.remaining == 0:
+                        terminal_makers.add(f.maker_oid)
+                    res.storage_fills.append(
+                        FillRow(info.order_id, maker.order_id, f.price_q4, f.quantity)
+                    )
+                    res.storage_updates.append(
+                        (maker.order_id, maker.status, maker.remaining)
+                    )
+                    res.order_updates.append(
+                        self._fill_update(maker, f.price_q4, f.quantity)
                     )
                 if r.status in (NEW, CANCELED, REJECTED):
                     res.order_updates.append(self._update(info, r.status, 0, 0, r.remaining))
@@ -381,24 +421,6 @@ class EngineRunner:
                     res.outcomes.append(
                         OpOutcome(e, REJECTED, 0, 0, "order not open")
                     )
-
-        # Pass 2 — maker consequences. One storage row per execution
-        # (order_id = aggressor/taker, counter_order_id = maker); the
-        # maker's remaining/status is carried by an orders-table update.
-        for f in fills:
-            maker = self.orders_by_handle.get(f.maker_oid)
-            taker = self.orders_by_handle.get(f.taker_oid)
-            if maker is None or taker is None:
-                continue  # unreachable if directories are consistent
-            maker.remaining -= f.quantity
-            maker.status = FILLED if maker.remaining == 0 else PARTIALLY_FILLED
-            if maker.remaining == 0:
-                terminal_makers.add(f.maker_oid)
-            res.storage_fills.append(
-                FillRow(taker.order_id, maker.order_id, f.price_q4, f.quantity)
-            )
-            res.storage_updates.append((maker.order_id, maker.status, maker.remaining))
-            res.order_updates.append(self._fill_update(maker, f.price_q4, f.quantity))
 
     def _update(self, info: OrderInfo, status, fprice, fqty, remaining) -> pb2.OrderUpdate:
         return pb2.OrderUpdate(
@@ -457,7 +479,7 @@ class EngineRunner:
             ]
         bp, bq, bo, bs_, ap, aq, ao, as_ = arrs
 
-        def side(price, qty, oid, seq, desc):
+        def side(price, qty, oid, seq, desc, want_side):
             rows = [
                 (int(oid[j]), int(price[j]), int(qty[j]), int(seq[j]))
                 for j in np.nonzero(qty > 0)[0]
@@ -466,8 +488,22 @@ class EngineRunner:
             out = []
             for o, p, q, _ in rows:
                 info = self.orders_by_handle.get(o)
-                if info is not None:
+                # The join runs without the dispatch lock, so a lane's handle
+                # can go terminal and be reassigned to an unrelated order
+                # between the lane copy and this lookup. A recycled handle
+                # can't collide on (symbol, side, price) with the lane it
+                # vacated unless it is a legitimately equivalent resting
+                # order, so a consistency guard keeps stale joins out.
+                if (
+                    info is not None
+                    and info.symbol == symbol
+                    and info.side == want_side
+                    and info.price_q4 == p
+                ):
                     out.append((info, q))
             return out
 
-        return side(bp, bq, bo, bs_, True), side(ap, aq, ao, as_, False)
+        return (
+            side(bp, bq, bo, bs_, True, BUY),
+            side(ap, aq, ao, as_, False, SELL),
+        )

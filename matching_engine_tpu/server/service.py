@@ -33,7 +33,7 @@ from matching_engine_tpu.engine.kernel import (
 )
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.proto.rpc import MatchingEngineServicer
-from matching_engine_tpu.server.dispatcher import BatchDispatcher
+from matching_engine_tpu.server.dispatcher import BatchDispatcher, RingFull
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
 from matching_engine_tpu.server.streams import StreamHub
 from matching_engine_tpu.utils.metrics import Metrics
@@ -100,6 +100,14 @@ class MatchingEngineService(MatchingEngineServicer):
         )
         try:
             outcome = self.dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
+        except RingFull:
+            # Known-unqueued: the device never saw this op, recycle now.
+            self.runner.release_unqueued(info)
+            self.metrics.inc("orders_rejected")
+            self._log(f"reject {order_id}: op ring full")
+            return pb2.OrderResponse(
+                order_id=order_id, success=False, error_message="server overloaded"
+            )
         except Exception as e:  # noqa: BLE001 — engine failure => app-level reject
             # The op may still be queued (timeout) or half-applied (dispatch
             # error), so the handle/slot must NOT be recycled here — a rare
@@ -150,6 +158,12 @@ class MatchingEngineService(MatchingEngineServicer):
             outcome = self.dispatcher.submit(
                 EngineOp(OP_CANCEL, info, cancel_requester=request.client_id)
             ).result(timeout=30)
+        except RingFull:
+            # Cancels hold no handle/slot — only the message differs.
+            return pb2.CancelResponse(
+                order_id=request.order_id, success=False,
+                error_message="server overloaded",
+            )
         except Exception:  # noqa: BLE001
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False, error_message="engine error"

@@ -499,22 +499,43 @@ class MeSink {
   void apply(const std::vector<std::vector<uint8_t>>& work) {
     long long ts = now_us();
     if (sqlite3_exec(db_, "BEGIN", nullptr, nullptr, nullptr) != SQLITE_OK) {
+      std::fprintf(stderr, "[me_sink] BEGIN failed: %s\n",
+                   sqlite3_errmsg(db_));
       errors_.fetch_add(1, std::memory_order_relaxed);
       return;
     }
-    bool ok = true;
-    uint64_t nrows = 0;
+    // Each queued batch lands in its own savepoint: one bad batch (the
+    // failure mode the stress test hit — a whole coalesced transaction
+    // rolled back, silently orphaning later fills/updates) costs exactly
+    // that batch, loudly, never its neighbors.
+    uint64_t nrows = 0, nbatches = 0;
     for (const auto& buf : work) {
-      if (!apply_one(buf, ts, &nrows)) {
-        ok = false;
-        break;
+      if (sqlite3_exec(db_, "SAVEPOINT b", nullptr, nullptr, nullptr) !=
+          SQLITE_OK) {
+        std::fprintf(stderr, "[me_sink] SAVEPOINT failed: %s\n",
+                     sqlite3_errmsg(db_));
+        errors_.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      uint64_t batch_rows = 0;
+      if (apply_one(buf, ts, &batch_rows)) {
+        sqlite3_exec(db_, "RELEASE b", nullptr, nullptr, nullptr);
+        nrows += batch_rows;
+        nbatches++;
+      } else {
+        std::fprintf(stderr, "[me_sink] batch dropped (%s)\n",
+                     sqlite3_errmsg(db_));
+        sqlite3_exec(db_, "ROLLBACK TO b", nullptr, nullptr, nullptr);
+        sqlite3_exec(db_, "RELEASE b", nullptr, nullptr, nullptr);
+        errors_.fetch_add(1, std::memory_order_relaxed);
       }
     }
-    if (ok && sqlite3_exec(db_, "COMMIT", nullptr, nullptr, nullptr) ==
-                  SQLITE_OK) {
-      batches_.fetch_add(work.size(), std::memory_order_relaxed);
+    if (sqlite3_exec(db_, "COMMIT", nullptr, nullptr, nullptr) == SQLITE_OK) {
+      batches_.fetch_add(nbatches, std::memory_order_relaxed);
       rows_.fetch_add(nrows, std::memory_order_relaxed);
     } else {
+      std::fprintf(stderr, "[me_sink] COMMIT failed: %s\n",
+                   sqlite3_errmsg(db_));
       sqlite3_exec(db_, "ROLLBACK", nullptr, nullptr, nullptr);
       errors_.fetch_add(1, std::memory_order_relaxed);
     }
@@ -555,7 +576,11 @@ class MeSink {
       sqlite3_bind_int64(ins_order_, 9, status);
       sqlite3_bind_int64(ins_order_, 10, ts);
       sqlite3_bind_int64(ins_order_, 11, ts);
-      if (!step_reset(ins_order_)) return false;
+      if (!step_reset(ins_order_)) {
+        std::fprintf(stderr, "[me_sink] order insert %s: %s\n", oid.c_str(),
+                     sqlite3_errmsg(db_));
+        return false;
+      }
       (*nrows)++;
     }
     if (!r.u32(&n)) return false;
@@ -568,7 +593,11 @@ class MeSink {
       sqlite3_bind_int64(upd_order_, 2, remaining);
       sqlite3_bind_int64(upd_order_, 3, ts);
       sqlite3_bind_text(upd_order_, 4, oid.c_str(), -1, SQLITE_TRANSIENT);
-      if (!step_reset(upd_order_)) return false;
+      if (!step_reset(upd_order_)) {
+        std::fprintf(stderr, "[me_sink] order update %s: %s\n", oid.c_str(),
+                     sqlite3_errmsg(db_));
+        return false;
+      }
       (*nrows)++;
     }
     if (!r.u32(&n)) return false;
@@ -585,7 +614,11 @@ class MeSink {
       sqlite3_bind_int64(ins_fill_, 3, price);
       sqlite3_bind_int64(ins_fill_, 4, qty);
       sqlite3_bind_int64(ins_fill_, 5, fts ? fts : ts);
-      if (!step_reset(ins_fill_)) return false;
+      if (!step_reset(ins_fill_)) {
+        std::fprintf(stderr, "[me_sink] fill insert %s/%s: %s\n", oid.c_str(),
+                     coid.c_str(), sqlite3_errmsg(db_));
+        return false;
+      }
       (*nrows)++;
     }
     return true;

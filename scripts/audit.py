@@ -52,16 +52,25 @@ def audit(db_path: str) -> list[str]:
             problems.append(f"fill crosses symbols: {taker_id}/{maker_id}")
         if qty <= 0:
             problems.append(f"non-positive fill quantity: {taker_id}/{maker_id}")
-        for pid, p in ((taker_id, t), (maker_id, m)):
-            if p["status"] == REJECTED:
-                problems.append(f"fill references REJECTED order: {pid}")
+        if m["status"] == REJECTED:
+            # Only a TAKER can end REJECTED with fills (crossing LIMIT whose
+            # remainder found the book side full). A rejected order never
+            # rests, so it can never be a fill's maker.
+            problems.append(f"fill has REJECTED maker: {taker_id}/{maker_id}")
         filled_total[taker_id] += qty
         filled_total[maker_id] += qty
 
     for oid, o in orders.items():
         filled = filled_total[oid]
         if o["status"] == REJECTED:
-            continue  # never touched the book; remaining is informational
+            # May carry taker fills (partial-fill-then-capacity-reject,
+            # engine/kernel.py submit_status); storage persists the true
+            # rejected remainder, so the fill arithmetic still must hold.
+            if filled != o["qty"] - o["remaining"]:
+                problems.append(
+                    f"{oid}: REJECTED fills {filled} != quantity {o['qty']} "
+                    f"- remaining {o['remaining']}")
+            continue
         if o["status"] == CANCELED:
             # Canceled orders may have partial fills, but hold no liability.
             if filled > o["qty"]:

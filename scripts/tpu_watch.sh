@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# TPU-tunnel watcher: probe backend init cheaply on an interval; on the
+# first healthy probe, run the north-star 4k-symbol bench once and leave
+# the artifact in benchmarks/results/ (docs/BENCH_METHOD.md artifact row).
+#
+# Rationale: the axon tunnel wedges at jax.devices() for long stretches
+# (BENCH_r02.json, VERDICT r2 weak #1). A cheap bounded probe loop catches
+# the healthy windows a fixed end-of-round bench misses. The bench child is
+# given a long timeout because killing it mid-compile is itself what wedges
+# the tunnel; the persistent compile cache (benchmarks/bench_child.py)
+# shrinks that window on reruns.
+#
+# Usage: scripts/tpu_watch.sh [&]   (env knobs below)
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT_DIR="$REPO/benchmarks/results"
+LOG="$OUT_DIR/tpu_watch.log"
+mkdir -p "$OUT_DIR"
+
+INTERVAL="${TPU_WATCH_INTERVAL_S:-300}"
+PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT_S:-75}"
+BENCH_TIMEOUT="${TPU_WATCH_BENCH_TIMEOUT_S:-1500}"
+MAX_LOOPS="${TPU_WATCH_MAX_LOOPS:-200}"
+
+log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] $*" >>"$LOG"; }
+
+log "watcher start (interval=${INTERVAL}s probe_timeout=${PROBE_TIMEOUT}s)"
+for _ in $(seq 1 "$MAX_LOOPS"); do
+  if timeout "$PROBE_TIMEOUT" python -c \
+      "import jax; d=jax.devices(); assert d; print(d)" >>"$LOG" 2>&1; then
+    ts=$(date -u +%Y%m%dT%H%M%SZ)
+    log "probe healthy; running 4k-symbol bench"
+    out="$OUT_DIR/tpu_${ts}.json"
+    if timeout "$BENCH_TIMEOUT" python "$REPO/benchmarks/bench_child.py" \
+        --json-out "$out" --symbols 4096 --capacity 128 --batch 32 \
+        >>"$LOG" 2>&1; then
+      log "bench ok: $(cat "$out")"
+      exit 0
+    fi
+    log "bench failed rc=$? (artifact removed; will retry next interval)"
+    rm -f "$out"
+  else
+    log "probe unhealthy (rc=$?)"
+  fi
+  sleep "$INTERVAL"
+done
+log "watcher gave up after $MAX_LOOPS loops"
+exit 1

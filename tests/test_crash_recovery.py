@@ -49,7 +49,11 @@ def _wait_port(port: int, proc, stderr_path, timeout_s: float = 90.0) -> None:
 
 def test_sigkill_midload_then_restart_audits_clean(tmp_path):
     db = str(tmp_path / "crash.db")
-    port = 47910 + os.getpid() % 50
+    # OS-assigned free port (the subprocess boundary forbids :0 directly;
+    # a fixed port would collide spuriously under parallel test runs).
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU; never touch the TPU tunnel
     env["JAX_PLATFORMS"] = "cpu"

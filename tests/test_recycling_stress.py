@@ -1,0 +1,226 @@
+"""Recycling machinery + serving-stack stress (ADVICE r2, VERDICT r2 #8).
+
+Unit level: the handle/slot allocators recycle safely (slot reuse after a
+symbol empties, stale cancels never reach a recycled handle, checkpoint v2
+restores rebuild the allocators). Stress level: concurrent
+submit+cancel+GetOrderBook+checkpoint_now against the real stack with a
+deterministic seed, then invariant asserts (every RPC answered, audit-clean
+DB, consistent final books).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import random
+import threading
+
+import grpc
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    FILLED,
+    NEW,
+    OP_CANCEL,
+    OP_SUBMIT,
+    REJECTED,
+)
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.utils.checkpoint import restore_runner, save_checkpoint
+
+_spec = importlib.util.spec_from_file_location(
+    "audit", pathlib.Path(__file__).resolve().parent.parent / "scripts" / "audit.py")
+audit_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(audit_mod)
+
+
+def _submit(runner: EngineRunner, symbol: str, side: int, qty: int,
+            price: int, otype: int = pb2.LIMIT) -> OrderInfo:
+    """Drive the service's submit flow at the runner level."""
+    assert runner.slot_acquire(symbol) is not None
+    num, order_id = runner.assign_oid()
+    info = OrderInfo(
+        oid=num, order_id=order_id, client_id="c", symbol=symbol, side=side,
+        otype=otype, price_q4=price, quantity=qty, remaining=qty, status=0,
+        handle=runner.assign_handle(),
+    )
+    runner.run_dispatch([EngineOp(OP_SUBMIT, info)])
+    return info
+
+
+def test_slot_recycles_after_symbol_empties():
+    runner = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4))
+    a = _submit(runner, "A", pb2.BUY, 5, 10_000)
+    _submit(runner, "B", pb2.BUY, 1, 10_000)
+    slot_a = runner.symbols["A"]
+    assert a.status == NEW
+    # Fill A's only order -> both sides terminal -> slot must recycle.
+    b = _submit(runner, "A", pb2.SELL, 5, 10_000)
+    assert a.status == FILLED and b.status == FILLED
+    assert "A" not in runner.symbols and slot_a in runner._free_slots
+    # The freed slot is reusable by a brand-new symbol (axis size is 2 and
+    # B still holds the other slot, so this allocation NEEDS the recycle).
+    c = _submit(runner, "C", pb2.BUY, 1, 10_000)
+    assert c.status == NEW and runner.symbols["C"] == slot_a
+
+
+def test_stale_cancel_never_hits_recycled_handle():
+    runner = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4))
+    o1 = _submit(runner, "A", pb2.BUY, 5, 10_000)
+    h1 = o1.handle
+    _submit(runner, "A", pb2.SELL, 5, 10_000)  # fills o1 -> handle freed
+    assert o1.status == FILLED
+    # New order reuses o1's device handle.
+    o3 = _submit(runner, "A", pb2.BUY, 3, 9_000)
+    assert o3.handle == h1 and o3.status == NEW
+    # A cancel captured against o1 BEFORE it went terminal now dispatches:
+    # must be host-rejected (o1 is terminal) and must not touch o3.
+    res = runner.run_dispatch([EngineOp(OP_CANCEL, o1, cancel_requester="c")])
+    assert res.outcomes[0].status == REJECTED
+    assert res.outcomes[0].error == "order not open"
+    assert o3.status == NEW and runner.orders_by_id[o3.order_id] is o3
+    bids, _ = runner.book_snapshot("A")
+    assert [(i.order_id, q) for i, q in bids] == [(o3.order_id, 3)]
+    # And a legitimate cancel of o3 still works.
+    res = runner.run_dispatch([EngineOp(OP_CANCEL, o3, cancel_requester="c")])
+    assert res.outcomes[0].status == CANCELED
+
+
+def test_fill_then_cancel_same_batch_keeps_remaining_nonnegative():
+    """Regression: one batch partially fills a resting order AND cancels it.
+
+    The fills happen before the cancel in the device scan; host decode must
+    replay that order. The old two-pass decode applied the cancel first
+    (remaining -> 0) and then the maker decrements (remaining -> -3), which
+    the storage CHECK (remaining_quantity >= 0) rejected — silently dropping
+    the whole storage batch (caught by the stress test below)."""
+    runner = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4))
+    m = _submit(runner, "A", pb2.BUY, 5, 10_000)  # rests, remaining 5
+    num, order_id = runner.assign_oid()
+    assert runner.slot_acquire("A") is not None
+    taker = OrderInfo(
+        oid=num, order_id=order_id, client_id="c", symbol="A", side=pb2.SELL,
+        otype=pb2.LIMIT, price_q4=10_000, quantity=3, remaining=3, status=0,
+        handle=runner.assign_handle(),
+    )
+    res = runner.run_dispatch([
+        EngineOp(OP_SUBMIT, taker),
+        EngineOp(OP_CANCEL, m, cancel_requester="c"),
+    ])
+    assert taker.status == FILLED and taker.remaining == 0
+    assert m.status == CANCELED and m.remaining == 0
+    # Cancel outcome reports the 2 units actually canceled (post-fill).
+    cancel_outcome = next(o for o in res.outcomes if o.op.op == OP_CANCEL)
+    assert cancel_outcome.status == CANCELED and cancel_outcome.remaining == 2
+    # Storage updates replay device order and never go negative.
+    maker_updates = [u for u in res.storage_updates if u[0] == m.order_id]
+    assert maker_updates == [(m.order_id, 1, 2), (m.order_id, CANCELED, 0)]
+    assert all(u[2] >= 0 for u in res.storage_updates)
+    assert len(res.storage_fills) == 1 and res.storage_fills[0].quantity == 3
+
+
+def test_checkpoint_v2_roundtrip_rebuilds_allocators(tmp_path):
+    cfg = EngineConfig(num_symbols=4, capacity=8, batch=4)
+    runner = EngineRunner(cfg)
+    live = _submit(runner, "A", pb2.BUY, 5, 10_000)
+    gone = _submit(runner, "B", pb2.BUY, 2, 10_000)
+    _submit(runner, "B", pb2.SELL, 2, 10_000)  # empties B -> slot recycled
+    live2 = _submit(runner, "C", pb2.SELL, 4, 11_000)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, runner)
+
+    fresh = EngineRunner(cfg)
+    assert restore_runner(fresh, path) == 0
+    # Directory restored.
+    assert set(fresh.orders_by_id) == {live.order_id, live2.order_id}
+    assert gone.order_id not in fresh.orders_by_id
+    # Allocators rebuilt: next_handle past every live handle; B's old slot
+    # free again; live counts match the open orders.
+    assert fresh._next_handle == 1 + max(live.handle, live2.handle)
+    assert fresh.assign_handle() not in {live.handle, live2.handle}
+    assert sorted(fresh.symbols) == ["A", "C"]
+    for sym in ("A", "C"):
+        assert fresh._slot_live[fresh.symbols[sym]] == 1
+    # The restored engine keeps matching correctly against restored state.
+    taker = _submit(fresh, "A", pb2.SELL, 5, 10_000)
+    assert taker.status == FILLED and live.order_id not in fresh.orders_by_id
+    # B's recycled slot is allocatable for a new symbol.
+    assert fresh.slot_acquire("D") is not None
+
+
+def test_stress_concurrent_submit_cancel_book_checkpoint(tmp_path):
+    db = str(tmp_path / "stress.db")
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, EngineConfig(num_symbols=8, capacity=32, batch=8),
+        window_ms=1.0, log=False,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        checkpoint_interval_s=3600.0,  # only explicit checkpoint_now calls
+    )
+    server.start()
+    errors: list[str] = []
+    stop = threading.Event()
+
+    def client_thread(tid: int):
+        rng = random.Random(1000 + tid)
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(ch)
+        my_open: list[str] = []
+        try:
+            for i in range(60):
+                sym = f"S{rng.randrange(6)}"
+                if my_open and rng.random() < 0.3:
+                    oid = my_open.pop(rng.randrange(len(my_open)))
+                    r = stub.CancelOrder(pb2.CancelRequest(
+                        client_id=f"c{tid}", order_id=oid), timeout=60)
+                    # success or a clean reject; must always answer.
+                    assert r.order_id == oid
+                elif rng.random() < 0.2:
+                    stub.GetOrderBook(
+                        pb2.OrderBookRequest(symbol=sym), timeout=60)
+                else:
+                    r = stub.SubmitOrder(pb2.OrderRequest(
+                        client_id=f"c{tid}", symbol=sym,
+                        order_type=pb2.LIMIT if rng.random() < 0.8 else pb2.MARKET,
+                        side=pb2.BUY if rng.random() < 0.5 else pb2.SELL,
+                        price=10_000 + rng.randrange(8), scale=4,
+                        quantity=1 + rng.randrange(9)), timeout=60)
+                    if r.success:
+                        my_open.append(r.order_id)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"client {tid}: {type(e).__name__}: {e}")
+        finally:
+            ch.close()
+
+    def checkpoint_thread():
+        try:
+            while not stop.is_set():
+                parts["checkpointer"].checkpoint_now()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"checkpointer: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client_thread, args=(t,)) for t in range(4)]
+    ck = threading.Thread(target=checkpoint_thread)
+    for t in threads:
+        t.start()
+    ck.start()
+    for t in threads:
+        t.join(timeout=240)
+        assert not t.is_alive(), "client thread hung"
+    stop.set()
+    ck.join(timeout=60)
+    assert not ck.is_alive(), "checkpoint thread hung"
+    assert errors == []
+
+    parts["sink"].flush()
+    m = parts["metrics"].snapshot()[0]
+    assert m.get("orders_errored", 0) == 0
+    assert m.get("dispatch_errors", 0) == 0
+    # Final invariant: whatever the interleaving, the durable store must be
+    # internally consistent.
+    shutdown(server, parts)
+    assert audit_mod.audit(db) == []
