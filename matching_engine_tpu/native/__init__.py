@@ -230,6 +230,227 @@ class NativeRing:
         return 0 if self._h is None else self._lib.me_ring_size(self._h)
 
 
+# -- gateway ----------------------------------------------------------------
+
+_GW_LIB_PATH = os.path.join(_PKG_DIR, "libme_gateway.so")
+_CLIENT_PATH = os.path.join(_PKG_DIR, "me_client")
+_gw_lib = None
+
+# Python mirror of MeGwOp (native/me_gateway.cpp) — keep layouts identical.
+# Strings are length-prefixed (embedded NULs round-trip like the grpcio edge).
+class MeGwOp(ctypes.Structure):
+    _fields_ = [
+        ("tag", ctypes.c_uint64),
+        ("op", ctypes.c_int32),        # 1 submit / 2 cancel
+        ("side", ctypes.c_int32),
+        ("otype", ctypes.c_int32),
+        ("price_q4", ctypes.c_int32),
+        ("quantity", ctypes.c_int64),
+        ("symbol_len", ctypes.c_int32),
+        ("client_id_len", ctypes.c_int32),
+        ("order_id_len", ctypes.c_int32),
+        ("symbol", ctypes.c_char * 68),
+        ("client_id", ctypes.c_char * 260),
+        ("order_id", ctypes.c_char * 36),
+    ]
+
+
+GW_CALLBACK = ctypes.CFUNCTYPE(
+    None, ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_uint64,
+)
+
+# Forwarded-method ids (me_gateway.cpp Method enum).
+GW_SUBMIT, GW_CANCEL, GW_BOOK, GW_METRICS, GW_STREAM_MD, GW_STREAM_OU = range(1, 7)
+
+
+def _load_gateway():
+    global _gw_lib
+    with _lib_lock:
+        if _gw_lib is not None:
+            return _gw_lib
+        if not ensure_built():
+            return None
+        if not os.path.exists(_GW_LIB_PATH):
+            return None
+        lib = ctypes.CDLL(_GW_LIB_PATH)
+        lib.me_gateway_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.me_gateway_create.restype = ctypes.c_void_p
+        lib.me_gateway_start.argtypes = [ctypes.c_void_p]
+        lib.me_gateway_start.restype = ctypes.c_int
+        lib.me_gateway_port.argtypes = [ctypes.c_void_p]
+        lib.me_gateway_port.restype = ctypes.c_int
+        lib.me_gateway_set_callback.argtypes = [ctypes.c_void_p, GW_CALLBACK]
+        lib.me_gw_pop_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MeGwOp), ctypes.c_uint32,
+            ctypes.c_uint64,
+        ]
+        lib.me_gw_pop_batch.restype = ctypes.c_int
+        lib.me_gateway_complete_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.me_gateway_complete_cancel.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.me_gateway_respond.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.me_gateway_respond.restype = ctypes.c_int
+        lib.me_gateway_stream_alive.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.me_gateway_stream_alive.restype = ctypes.c_int
+        lib.me_gateway_stats.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_uint64)
+        ] * 3
+        lib.me_gateway_shutdown.argtypes = [ctypes.c_void_p]
+        lib.me_gateway_destroy.argtypes = [ctypes.c_void_p]
+        _gw_lib = lib
+        return _gw_lib
+
+
+def gateway_available() -> bool:
+    try:
+        return _load_gateway() is not None
+    except OSError:
+        return False
+
+
+def client_binary() -> str | None:
+    """Path to the native CLI client, if built."""
+    ensure_built()
+    return _CLIENT_PATH if os.path.exists(_CLIENT_PATH) else None
+
+
+class NativeGateway:
+    """The C++ gRPC serving edge (native/me_gateway.cpp).
+
+    Hot-path ops (submit/cancel) surface through `pop_batch` as wide
+    records and are answered with `complete_*`; forwarded methods
+    (book/metrics/streams) arrive via the registered callback and are
+    answered with `respond`.
+    """
+
+    def __init__(self, addr: str = "0.0.0.0:0", ring_capacity: int = 1 << 15):
+        from matching_engine_tpu.domain.order import (
+            MAX_CLIENT_ID_BYTES,
+            MAX_QUANTITY,
+            MAX_SYMBOL_BYTES,
+        )
+        from matching_engine_tpu.domain.price import MAX_DEVICE_PRICE_Q4
+
+        lib = _load_gateway()
+        if lib is None:
+            raise RuntimeError("native gateway library unavailable")
+        self._lib = lib
+        self._h = lib.me_gateway_create(
+            addr.encode(), ring_capacity, MAX_DEVICE_PRICE_Q4, MAX_QUANTITY,
+            MAX_SYMBOL_BYTES, MAX_CLIENT_ID_BYTES,
+        )
+        if not self._h:
+            raise RuntimeError("me_gateway_create failed")
+        self._cb_ref = None  # keep the CFUNCTYPE object alive
+        self._buf = None
+        self.port = -1
+
+    def start(self) -> int:
+        port = self._lib.me_gateway_start(self._h)
+        if port < 0:
+            raise RuntimeError("native gateway failed to bind")
+        self.port = port
+        return port
+
+    def set_callback(self, fn) -> None:
+        """fn(tag: int, method: int, payload: bytes); runs on a C++
+        connection thread (ctypes acquires the GIL) — must not block."""
+
+        def _trampoline(tag, method, data, length):
+            try:
+                payload = ctypes.string_at(data, length) if length else b""
+                fn(tag, method, payload)
+            except Exception as e:  # noqa: BLE001 — never unwind into C++
+                print(f"[gateway] callback error: {type(e).__name__}: {e}")
+
+        self._cb_ref = GW_CALLBACK(_trampoline)
+        self._lib.me_gateway_set_callback(self._h, self._cb_ref)
+
+    def pop_batch(self, max_ops: int, window_us: int):
+        """Blocks for the first op, drains to (max_ops, window_us).
+        Returns a list of (tag, op, side, otype, price_q4, quantity,
+        symbol, client_id, order_id) or None when shut down."""
+        if self._h is None:
+            return None
+        buf = self._buf
+        if buf is None or len(buf) < max_ops:
+            buf = self._buf = (MeGwOp * max_ops)()
+        n = self._lib.me_gw_pop_batch(self._h, buf, max_ops, window_us)
+        if n < 0:
+            return None
+        return [
+            (r.tag, r.op, r.side, r.otype, r.price_q4, r.quantity,
+             bytes(r.symbol[:r.symbol_len]).decode(),
+             bytes(r.client_id[:r.client_id_len]).decode(),
+             bytes(r.order_id[:r.order_id_len]).decode())
+            for r in buf[:n]
+        ]
+
+    def complete_submit(self, tag: int, success: bool, order_id: str,
+                        error: str = "") -> None:
+        if self._h is None:
+            return
+        self._lib.me_gateway_complete_submit(
+            self._h, tag, 1 if success else 0, order_id.encode(),
+            error.encode(),
+        )
+
+    def complete_cancel(self, tag: int, success: bool, order_id: str,
+                        error: str = "") -> None:
+        if self._h is None:
+            return
+        self._lib.me_gateway_complete_cancel(
+            self._h, tag, 1 if success else 0, order_id.encode(),
+            error.encode(),
+        )
+
+    def respond(self, tag: int, msg: bytes | None, end_stream: bool,
+                grpc_status: int = 0, grpc_message: str = "") -> bool:
+        if self._h is None:
+            return False
+        return bool(self._lib.me_gateway_respond(
+            self._h, tag, msg, len(msg) if msg else 0,
+            1 if end_stream else 0, grpc_status, grpc_message.encode(),
+        ))
+
+    def stream_alive(self, tag: int) -> bool:
+        if self._h is None:
+            return False
+        return bool(self._lib.me_gateway_stream_alive(self._h, tag))
+
+    def stats(self) -> dict:
+        if self._h is None:
+            return {"requests": 0, "ring_rejects": 0, "conns": 0}
+        vals = [ctypes.c_uint64() for _ in range(3)]
+        self._lib.me_gateway_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {
+            "requests": vals[0].value,
+            "ring_rejects": vals[1].value,
+            "conns": vals[2].value,
+        }
+
+    def shutdown(self) -> None:
+        if self._h is not None:
+            self._lib.me_gateway_shutdown(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.me_gateway_destroy(self._h)
+            self._h = None
+
+
 # -- sink -------------------------------------------------------------------
 
 def _pack_str(out: bytearray, s: str) -> None:

@@ -31,6 +31,32 @@ class RingFull(RuntimeError):
     would risk handle reuse against a possibly-live order."""
 
 
+def publish_result(result, sink, hub, metrics) -> None:
+    """Enqueue one dispatch's storage/stream events. Shared by every drain
+    loop (BatchDispatcher and GatewayBridge): a sink/hub failure must never
+    strand the batch's completions or kill the loop — the match result
+    already exists in the book."""
+    try:
+        if sink is not None:
+            # Non-blocking: a stalled SQLite must not backpressure the
+            # match loop (we prefer losing durable-log tail to stalling
+            # matching; the sink counts drops and the book checkpoint
+            # reconciles).
+            if not sink.submit(
+                orders=result.storage_orders,
+                updates=result.storage_updates,
+                fills=result.storage_fills,
+                block=False,
+            ):
+                metrics.inc("storage_batches_dropped")
+        if hub is not None:
+            hub.publish_order_updates(result.order_updates)
+            hub.publish_market_data(result.market_data)
+    except Exception as e:  # noqa: BLE001
+        metrics.inc("sink_publish_errors")
+        print(f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
+
+
 class BatchDispatcher:
     def __init__(
         self,
@@ -125,28 +151,7 @@ class BatchDispatcher:
         self.metrics.ema_gauge("dispatch_ops", len(batch))
 
     def _publish(self, result) -> None:
-        """Enqueue storage/stream events. A sink/hub failure must never
-        strand the batch's futures or kill the drain loop — the match result
-        already exists in the book."""
-        try:
-            if self.sink is not None:
-                # Non-blocking: a stalled SQLite must not backpressure the
-                # match loop (we prefer losing durable-log tail to stalling
-                # matching; the sink counts drops and the book checkpoint
-                # reconciles).
-                if not self.sink.submit(
-                    orders=result.storage_orders,
-                    updates=result.storage_updates,
-                    fills=result.storage_fills,
-                    block=False,
-                ):
-                    self.metrics.inc("storage_batches_dropped")
-            if self.hub is not None:
-                self.hub.publish_order_updates(result.order_updates)
-                self.hub.publish_market_data(result.market_data)
-        except Exception as e:  # noqa: BLE001
-            self.metrics.inc("sink_publish_errors")
-            print(f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
+        publish_result(result, self.sink, self.hub, self.metrics)
 
 
 class NativeRingDispatcher(BatchDispatcher):

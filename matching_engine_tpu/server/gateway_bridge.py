@@ -1,0 +1,302 @@
+"""GatewayBridge: glue between the C++ serving edge and the JAX engine.
+
+With the native gateway (native/me_gateway.cpp) terminating gRPC, an
+order's path is: C++ conn thread parses + validates + pushes a wide op
+record into the gateway ring; THIS bridge thread drains time/size-windowed
+batches, assigns ids/handles, runs the dense device dispatch, hands the
+storage/stream events to the sink/hub, and completes each op back through
+the gateway, which serializes and writes the response frames. Python code
+runs only per-batch (directory bookkeeping + decode), never per-RPC — the
+north-star serving shape (BASELINE.json: "host gRPC front end in C++,
+batch dispatcher, JAX engine").
+
+Forwarded methods (GetOrderBook / GetMetrics / the two server-streaming
+RPCs) arrive on the gateway callback and are answered by the SAME
+MatchingEngineService methods the grpcio edge uses — one implementation of
+book snapshots, metrics, and stream fan-out, two transports.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from matching_engine_tpu.engine.kernel import CANCELED, OP_CANCEL, OP_SUBMIT, REJECTED
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.server.dispatcher import publish_result
+from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+
+
+class _StreamContext:
+    """Duck-typed grpc context for service stream handlers: `is_active`
+    polls the native stream's liveness."""
+
+    def __init__(self, gateway, tag: int):
+        self._gateway = gateway
+        self._tag = tag
+
+    def is_active(self) -> bool:
+        return self._gateway.stream_alive(self._tag)
+
+    def peer(self) -> str:
+        return "native-gateway"
+
+
+class GatewayBridge:
+    def __init__(
+        self,
+        gateway,              # native.NativeGateway (created, not started)
+        runner,
+        service,              # MatchingEngineService (forwarded methods)
+        sink=None,
+        hub=None,
+        window_ms: float = 2.0,
+        max_batch: int | None = None,
+        workers: int = 8,
+    ):
+        self.gateway = gateway
+        self.runner = runner
+        self.service = service
+        self.sink = sink
+        self.hub = hub
+        self.metrics = runner.metrics
+        self.window_us = max(1, int(window_ms * 1e3))
+        self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
+        self._stop = threading.Event()
+        self._stream_threads: set[threading.Thread] = set()
+        self._stream_lock = threading.Lock()
+        self._fwd_q: queue.Queue = queue.Queue()
+        self.gateway.set_callback(self._on_forwarded)
+        self._drain_thread = threading.Thread(
+            target=self._run, name="gw-bridge", daemon=True
+        )
+        self._workers = [
+            threading.Thread(target=self._worker, name=f"gw-fwd-{i}", daemon=True)
+            for i in range(workers)
+        ]
+
+    def start(self) -> int:
+        port = self.gateway.start()
+        self._drain_thread.start()
+        for w in self._workers:
+            w.start()
+        return port
+
+    def close(self) -> None:
+        self._stop.set()
+        self.gateway.shutdown()  # closes the ring -> drain thread exits
+        self._drain_thread.join(timeout=10)
+        for _ in self._workers:
+            self._fwd_q.put(None)
+        for w in self._workers:
+            w.join(timeout=5)
+        # Stream threads observe the dead connections (stream_alive -> False,
+        # sub.stream polls at 250ms) and exit; they MUST be joined before the
+        # C++ gateway is freed or a late respond() would touch freed memory.
+        with self._stream_lock:
+            streams = list(self._stream_threads)
+        for t in streams:
+            t.join(timeout=5)
+        # A join timeout means a thread may still call into the gateway
+        # (e.g. the drain thread mid-compile on a new batch shape): leak the
+        # native object rather than free memory under a live thread — the
+        # same policy as NativeRingDispatcher.close.
+        stragglers = [t for t in [self._drain_thread, *streams] if t.is_alive()]
+        if stragglers:
+            print(f"[gw-bridge] {len(stragglers)} thread(s) busy at close; "
+                  f"leaking native gateway")
+            return
+        self.gateway.destroy()
+
+    # -- hot path: the ring drain loop -------------------------------------
+
+    def _run(self) -> None:
+        runner = self.runner
+        while not self._stop.is_set():
+            recs = self.gateway.pop_batch(self.max_batch, self.window_us)
+            if recs is None:
+                return
+            t0 = time.perf_counter()
+            ops: list[EngineOp] = []
+            tags: dict[int, int] = {}  # id(EngineOp) -> gateway tag
+            for (tag, op, side, otype, price_q4, qty, symbol, client_id,
+                 order_id) in recs:
+                if op == 1:  # submit (already validated in C++)
+                    if runner.slot_acquire(symbol) is None:
+                        self.metrics.inc("orders_rejected")
+                        self.gateway.complete_submit(
+                            tag, False, "",
+                            "symbol capacity exhausted (engine symbol axis is full)",
+                        )
+                        continue
+                    oid_num, oid_str = runner.assign_oid()
+                    info = OrderInfo(
+                        oid=oid_num, order_id=oid_str, client_id=client_id,
+                        symbol=symbol, side=side, otype=otype,
+                        price_q4=price_q4, quantity=qty, remaining=qty,
+                        status=0, handle=runner.assign_handle(),
+                    )
+                    e = EngineOp(OP_SUBMIT, info)
+                else:  # cancel — host-side directory checks, as the service does
+                    info = runner.orders_by_id.get(order_id)
+                    if info is None:
+                        self.gateway.complete_cancel(
+                            tag, False, order_id, "unknown order id"
+                        )
+                        continue
+                    if info.client_id != client_id:
+                        self.gateway.complete_cancel(
+                            tag, False, order_id,
+                            "order belongs to a different client",
+                        )
+                        continue
+                    e = EngineOp(OP_CANCEL, info, cancel_requester=client_id)
+                ops.append(e)
+                tags[id(e)] = tag
+
+            if not ops:
+                continue
+            try:
+                # Same lock discipline as BatchDispatcher._drain: device step
+                # + sink/hub enqueue under the dispatch lock so checkpoints
+                # see an untorn (book, SQLite, snapshot) state.
+                with runner._dispatch_lock:
+                    result = runner._run_dispatch_locked(ops)
+                    self._publish(result)
+            except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+                self.metrics.inc("dispatch_errors")
+                print(f"[gw-bridge] dispatch error: {type(e).__name__}: {e}")
+                for op in ops:
+                    tag = tags.get(id(op))
+                    if tag is None:
+                        continue
+                    if op.op == OP_SUBMIT:
+                        self.gateway.complete_submit(
+                            tag, False, op.info.order_id, "engine error"
+                        )
+                    else:
+                        self.gateway.complete_cancel(
+                            tag, False, op.info.order_id, "engine error"
+                        )
+                continue
+
+            for outcome in result.outcomes:
+                tag = tags.pop(id(outcome.op), None)
+                if tag is None:
+                    continue
+                info = outcome.op.info
+                if outcome.op.op == OP_SUBMIT:
+                    if outcome.status == REJECTED and outcome.error:
+                        self.metrics.inc("orders_rejected")
+                        self.gateway.complete_submit(
+                            tag, False, info.order_id, outcome.error
+                        )
+                    else:
+                        self.metrics.inc("orders_accepted")
+                        self.gateway.complete_submit(tag, True, info.order_id)
+                else:
+                    if outcome.status == CANCELED:
+                        self.metrics.inc("orders_canceled")
+                        self.gateway.complete_cancel(tag, True, info.order_id)
+                    else:
+                        self.gateway.complete_cancel(
+                            tag, False, info.order_id,
+                            outcome.error or "order not open",
+                        )
+            # Any op that produced no outcome: fail loudly rather than hang
+            # the client until its deadline.
+            for op in ops:
+                tag = tags.pop(id(op), None)
+                if tag is None:
+                    continue
+                if op.op == OP_SUBMIT:
+                    self.gateway.complete_submit(
+                        tag, False, op.info.order_id, "op produced no outcome"
+                    )
+                else:
+                    self.gateway.complete_cancel(
+                        tag, False, op.info.order_id, "op produced no outcome"
+                    )
+            dur_us = (time.perf_counter() - t0) * 1e6
+            self.metrics.ema_gauge("dispatch_us", dur_us)
+            self.metrics.observe("dispatch_us", dur_us)
+            self.metrics.ema_gauge("dispatch_ops", len(recs))
+
+    def _publish(self, result) -> None:
+        publish_result(result, self.sink, self.hub, self.metrics)
+
+    # -- forwarded methods (book / metrics / streams) ----------------------
+
+    def _on_forwarded(self, tag: int, method: int, payload: bytes) -> None:
+        # Runs on a C++ connection thread: enqueue and return immediately.
+        self._fwd_q.put((tag, method, payload))
+
+    def _worker(self) -> None:
+        from matching_engine_tpu import native as me_native
+
+        while True:
+            item = self._fwd_q.get()
+            if item is None:
+                return
+            tag, method, payload = item
+            try:
+                if method == me_native.GW_BOOK:
+                    req = pb2.OrderBookRequest.FromString(payload)
+                    resp = self.service.GetOrderBook(req, None)
+                    self.gateway.respond(tag, resp.SerializeToString(), True)
+                elif method == me_native.GW_METRICS:
+                    req = pb2.MetricsRequest.FromString(payload)
+                    resp = self.service.GetMetrics(req, None)
+                    self.gateway.respond(tag, resp.SerializeToString(), True)
+                elif method in (me_native.GW_STREAM_MD, me_native.GW_STREAM_OU):
+                    # Streams hold a worker for their lifetime; run each on
+                    # its own thread so they can't starve unary forwards.
+                    t = threading.Thread(
+                        target=self._stream, args=(tag, method, payload),
+                        name=f"gw-stream-{tag}", daemon=True,
+                    )
+                    with self._stream_lock:
+                        self._stream_threads.add(t)
+                    t.start()
+                else:
+                    self.gateway.respond(
+                        tag, None, True, grpc_status=12,
+                        grpc_message="unknown forwarded method",
+                    )
+            except Exception as e:  # noqa: BLE001
+                self.gateway.respond(
+                    tag, None, True, grpc_status=13,
+                    grpc_message=f"{type(e).__name__}: {e}",
+                )
+
+    def _stream(self, tag: int, method: int, payload: bytes) -> None:
+        try:
+            self._stream_impl(tag, method, payload)
+        finally:
+            with self._stream_lock:
+                self._stream_threads.discard(threading.current_thread())
+
+    def _stream_impl(self, tag: int, method: int, payload: bytes) -> None:
+        from matching_engine_tpu import native as me_native
+
+        ctx = _StreamContext(self.gateway, tag)
+        try:
+            if method == me_native.GW_STREAM_MD:
+                req = pb2.MarketDataRequest.FromString(payload)
+                it = self.service.StreamMarketData(req, ctx)
+            else:
+                req = pb2.OrderUpdatesRequest.FromString(payload)
+                it = self.service.StreamOrderUpdates(req, ctx)
+            try:
+                for msg in it:
+                    if not self.gateway.respond(tag, msg.SerializeToString(), False):
+                        return  # stream gone
+                self.gateway.respond(tag, None, True)  # server-side close
+            finally:
+                it.close()  # run the service generator's unsubscribe now
+        except Exception as e:  # noqa: BLE001
+            self.gateway.respond(
+                tag, None, True, grpc_status=13,
+                grpc_message=f"{type(e).__name__}: {e}",
+            )

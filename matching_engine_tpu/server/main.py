@@ -78,6 +78,7 @@ def build_server(
     checkpoint_interval_s: float = 30.0,
     native: bool = True,
     mesh=None,
+    gateway_addr: str | None = None,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -139,10 +140,33 @@ def build_server(
     if port == 0:
         print(f"[SERVER] failed to bind {addr}", file=sys.stderr)
         raise SystemExit(2)
+
+    # The C++ serving edge (native/me_gateway.cpp): same wire contract on a
+    # second port, hot path parsed/validated/answered in C++ around a dense
+    # batch dispatch. Shares runner/sink/hub/service with the grpcio edge —
+    # the dispatch lock serializes the two drain loops.
+    bridge = None
+    gateway_port = None
+    if gateway_addr is not None:
+        if not me_native.gateway_available():
+            print("[SERVER] native gateway requested but library unavailable",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        from matching_engine_tpu.server.gateway_bridge import GatewayBridge
+
+        gateway = me_native.NativeGateway(gateway_addr)
+        bridge = GatewayBridge(
+            gateway, runner, service, sink=sink, hub=hub, window_ms=window_ms
+        )
+        gateway_port = bridge.start()
+        if log:
+            print(f"[SERVER] native gateway on port {gateway_port}")
+
     parts = {
         "storage": storage, "sink": sink, "hub": hub,
         "dispatcher": dispatcher, "runner": runner, "service": service,
         "metrics": metrics, "checkpointer": checkpointer,
+        "bridge": bridge, "gateway_port": gateway_port,
     }
     return server, port, parts
 
@@ -151,6 +175,8 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     """Graceful drain: stop RPCs (2s deadline, as the reference's stopper
     thread does), close the dispatcher, flush the storage sink."""
     server.stop(grace_s).wait()
+    if parts.get("bridge") is not None:
+        parts["bridge"].close()
     parts["hub"].close_all()
     parts["dispatcher"].close()
     if parts.get("checkpointer") is not None:
@@ -215,6 +241,9 @@ def main(argv=None) -> int:
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard the symbol axis over an N-device mesh "
                         "(0 = single device); N must divide --symbols")
+    p.add_argument("--gateway-addr", default=None, metavar="HOST:PORT",
+                   help="also serve through the C++ gRPC gateway on this "
+                        "address (port 0 = OS-assigned)")
     args = p.parse_args(argv)
 
     try:
@@ -232,6 +261,7 @@ def main(argv=None) -> int:
             checkpoint_interval_s=args.checkpoint_interval_s,
             native=not args.no_native,
             mesh=mesh,
+            gateway_addr=args.gateway_addr,
         )
     except SystemExit as e:
         return int(e.code or 3)

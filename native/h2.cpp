@@ -1,0 +1,344 @@
+// HTTP/2 + HPACK codec implementation. See h2.h for scope and rationale.
+
+#include "h2.h"
+
+#include <array>
+#include <cstring>
+
+namespace h2 {
+
+// ---------------------------------------------------------------------------
+// Huffman decoding (RFC 7541 §5.2, Appendix B)
+// ---------------------------------------------------------------------------
+
+#include "hpack_huffman.inc"
+
+namespace {
+
+// Binary decode tree built once from the canonical code table. Each node is a
+// pair of child indices; leaves store the decoded symbol. ~500 internal nodes.
+struct HuffTree {
+  struct Node {
+    int32_t child[2] = {-1, -1};
+    int32_t sym = -1;
+  };
+  std::vector<Node> nodes;
+
+  HuffTree() {
+    nodes.emplace_back();  // root
+    for (int s = 0; s < 257; ++s) {
+      uint32_t code = kHuffTable[s].code;
+      int bits = kHuffTable[s].bits;
+      size_t at = 0;
+      for (int b = bits - 1; b >= 0; --b) {
+        int bit = (code >> b) & 1;
+        if (nodes[at].child[bit] < 0) {
+          nodes[at].child[bit] = static_cast<int32_t>(nodes.size());
+          nodes.emplace_back();
+        }
+        at = static_cast<size_t>(nodes[at].child[bit]);
+      }
+      nodes[at].sym = s;
+    }
+  }
+};
+
+const HuffTree& huff_tree() {
+  static const HuffTree tree;
+  return tree;
+}
+
+}  // namespace
+
+bool huffman_decode(const uint8_t* p, size_t n, std::string* out) {
+  const HuffTree& t = huff_tree();
+  size_t at = 0;
+  int depth = 0;  // bits consumed since last emitted symbol
+  for (size_t i = 0; i < n; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      int bit = (p[i] >> b) & 1;
+      int32_t next = t.nodes[at].child[bit];
+      if (next < 0) return false;  // invalid code
+      at = static_cast<size_t>(next);
+      ++depth;
+      int sym = t.nodes[at].sym;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // EOS inside the string is an error
+        out->push_back(static_cast<char>(sym));
+        at = 0;
+        depth = 0;
+      }
+    }
+  }
+  // Remaining bits must be a prefix of EOS (all 1s) and < 8 bits: verify by
+  // checking every consumed-but-unfinished edge took the '1' branch. We track
+  // this cheaply: walk from root along 1s `depth` steps and compare.
+  if (depth >= 8) return false;
+  size_t check = 0;
+  for (int i = 0; i < depth; ++i) {
+    int32_t next = t.nodes[check].child[1];
+    if (next < 0) return false;
+    check = static_cast<size_t>(next);
+  }
+  return check == at;
+}
+
+// ---------------------------------------------------------------------------
+// HPACK static table (RFC 7541 Appendix A — canonical standard data)
+// ---------------------------------------------------------------------------
+
+namespace {
+const std::array<Header, 62> kStaticTable = {{
+    {"", ""},  // index 0 unused
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+}};
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// HPACK decoder
+// ---------------------------------------------------------------------------
+
+bool HpackDecoder::read_int(const uint8_t*& p, const uint8_t* end,
+                            int prefix_bits, uint64_t* out) {
+  if (p >= end) return false;
+  uint64_t mask = (1u << prefix_bits) - 1;
+  uint64_t v = *p++ & mask;
+  if (v < mask) {
+    *out = v;
+    return true;
+  }
+  int shift = 0;
+  for (;;) {
+    if (p >= end || shift > 56) return false;
+    uint8_t b = *p++;
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    shift += 7;
+    if (!(b & 0x80)) break;
+  }
+  *out = v;
+  return true;
+}
+
+bool HpackDecoder::read_string(const uint8_t*& p, const uint8_t* end,
+                               std::string* out) {
+  if (p >= end) return false;
+  bool huff = (*p & 0x80) != 0;
+  uint64_t len;
+  if (!read_int(p, end, 7, &len)) return false;
+  if (len > static_cast<uint64_t>(end - p)) return false;
+  if (huff) {
+    if (!huffman_decode(p, len, out)) return false;
+  } else {
+    out->append(reinterpret_cast<const char*>(p), len);
+  }
+  p += len;
+  return true;
+}
+
+bool HpackDecoder::table_lookup(uint64_t index, Header* out) const {
+  if (index == 0) return false;
+  if (index < kStaticTable.size()) {
+    *out = kStaticTable[index];
+    return true;
+  }
+  size_t di = index - kStaticTable.size();  // 0-based into dynamic table
+  if (di >= dyn_.size()) return false;
+  *out = dyn_[di];
+  return true;
+}
+
+void HpackDecoder::table_insert(const Header& h) {
+  size_t sz = h.name.size() + h.value.size() + 32;
+  while (!dyn_.empty() && dyn_size_ + sz > cap_) {
+    dyn_size_ -= dyn_.back().name.size() + dyn_.back().value.size() + 32;
+    dyn_.pop_back();
+  }
+  if (sz <= cap_) {
+    dyn_.push_front(h);
+    dyn_size_ += sz;
+  }
+  // else: an entry larger than the table empties it (handled above) and is
+  // itself not inserted — RFC 7541 §4.4.
+}
+
+bool HpackDecoder::decode(const uint8_t* p, size_t n,
+                          std::vector<Header>* out) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint8_t b = *p;
+    if (b & 0x80) {  // §6.1 indexed header field
+      uint64_t idx;
+      if (!read_int(p, end, 7, &idx)) return false;
+      Header h;
+      if (!table_lookup(idx, &h)) return false;
+      out->push_back(std::move(h));
+    } else if (b & 0x40) {  // §6.2.1 literal with incremental indexing
+      uint64_t idx;
+      if (!read_int(p, end, 6, &idx)) return false;
+      Header h;
+      if (idx) {
+        if (!table_lookup(idx, &h)) return false;
+        h.value.clear();
+      } else if (!read_string(p, end, &h.name)) {
+        return false;
+      }
+      if (!read_string(p, end, &h.value)) return false;
+      table_insert(h);
+      out->push_back(std::move(h));
+    } else if (b & 0x20) {  // §6.3 dynamic table size update
+      uint64_t cap;
+      if (!read_int(p, end, 5, &cap)) return false;
+      if (cap > cap_limit_) return false;
+      cap_ = cap;
+      while (dyn_size_ > cap_ && !dyn_.empty()) {
+        dyn_size_ -= dyn_.back().name.size() + dyn_.back().value.size() + 32;
+        dyn_.pop_back();
+      }
+    } else {  // §6.2.2/§6.2.3 literal without indexing / never indexed
+      uint64_t idx;
+      if (!read_int(p, end, 4, &idx)) return false;
+      Header h;
+      if (idx) {
+        if (!table_lookup(idx, &h)) return false;
+        h.value.clear();
+      } else if (!read_string(p, end, &h.name)) {
+        return false;
+      }
+      if (!read_string(p, end, &h.value)) return false;
+      out->push_back(std::move(h));
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// HPACK encoder (literal-without-indexing, raw strings only)
+// ---------------------------------------------------------------------------
+
+namespace {
+void encode_int(uint64_t v, int prefix_bits, uint8_t first_byte_flags,
+                std::string* out) {
+  uint64_t mask = (1u << prefix_bits) - 1;
+  if (v < mask) {
+    out->push_back(static_cast<char>(first_byte_flags | v));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | mask));
+  v -= mask;
+  while (v >= 128) {
+    out->push_back(static_cast<char>(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+}  // namespace
+
+void hpack_encode(std::string_view name, std::string_view value,
+                  std::string* out) {
+  out->push_back(0x00);  // literal without indexing, new name
+  encode_int(name.size(), 7, 0x00, out);  // H=0 (raw)
+  out->append(name);
+  encode_int(value.size(), 7, 0x00, out);
+  out->append(value);
+}
+
+// ---------------------------------------------------------------------------
+// Framing
+// ---------------------------------------------------------------------------
+
+void write_frame_header(uint8_t type, uint8_t flags, uint32_t stream_id,
+                        size_t length, std::string* out) {
+  out->push_back(static_cast<char>((length >> 16) & 0xff));
+  out->push_back(static_cast<char>((length >> 8) & 0xff));
+  out->push_back(static_cast<char>(length & 0xff));
+  out->push_back(static_cast<char>(type));
+  out->push_back(static_cast<char>(flags));
+  out->push_back(static_cast<char>((stream_id >> 24) & 0x7f));
+  out->push_back(static_cast<char>((stream_id >> 16) & 0xff));
+  out->push_back(static_cast<char>((stream_id >> 8) & 0xff));
+  out->push_back(static_cast<char>(stream_id & 0xff));
+}
+
+FrameHeader parse_frame_header(const uint8_t p[9]) {
+  FrameHeader h;
+  h.length = (static_cast<uint32_t>(p[0]) << 16) |
+             (static_cast<uint32_t>(p[1]) << 8) | p[2];
+  h.type = p[3];
+  h.flags = p[4];
+  h.stream_id = ((static_cast<uint32_t>(p[5]) & 0x7f) << 24) |
+                (static_cast<uint32_t>(p[6]) << 16) |
+                (static_cast<uint32_t>(p[7]) << 8) | p[8];
+  return h;
+}
+
+void grpc_frame(std::string_view message, std::string* out) {
+  out->push_back(0);  // uncompressed
+  uint32_t n = static_cast<uint32_t>(message.size());
+  out->push_back(static_cast<char>((n >> 24) & 0xff));
+  out->push_back(static_cast<char>((n >> 16) & 0xff));
+  out->push_back(static_cast<char>((n >> 8) & 0xff));
+  out->push_back(static_cast<char>(n & 0xff));
+  out->append(message);
+}
+
+}  // namespace h2

@@ -1,0 +1,112 @@
+// Minimal HTTP/2 + HPACK codec for the native gRPC edge.
+//
+// The reference's serving edge is grpc++ (src/server/main.cpp:34-38,
+// src/client/client.cpp:32); this image has libprotobuf but no grpc++/nghttp2
+// development files, so the framework carries its own purpose-built HTTP/2
+// server/client transport: enough of RFC 7540 (framing, flow control,
+// settings, streams) and RFC 7541 (full HPACK decode incl. Huffman and the
+// dynamic table; simple literal encode) to interoperate with gRPC
+// implementations over cleartext h2c with prior knowledge — which is exactly
+// what insecure-creds gRPC speaks. Interop is enforced end-to-end by
+// tests/test_gateway.py (grpc C-core client -> this server) and
+// tests/test_native_client.py (this client -> grpcio server).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace h2 {
+
+// ---------------------------------------------------------------------------
+// HPACK (RFC 7541)
+// ---------------------------------------------------------------------------
+
+struct Header {
+  std::string name;
+  std::string value;
+};
+
+// Decodes a Huffman-coded string (RFC 7541 §5.2 + Appendix B table).
+// Returns false on invalid padding / EOS in stream.
+bool huffman_decode(const uint8_t* p, size_t n, std::string* out);
+
+class HpackDecoder {
+ public:
+  // Decode one complete header block fragment sequence. Appends to `out`.
+  // Returns false on any decoding error (connection error per RFC).
+  bool decode(const uint8_t* p, size_t n, std::vector<Header>* out);
+
+  // The cap we advertise via SETTINGS_HEADER_TABLE_SIZE (default 4096).
+  void set_capacity_limit(size_t cap) { cap_limit_ = cap; }
+
+ private:
+  bool read_int(const uint8_t*& p, const uint8_t* end, int prefix_bits,
+                uint64_t* out);
+  bool read_string(const uint8_t*& p, const uint8_t* end, std::string* out);
+  bool table_lookup(uint64_t index, Header* out) const;
+  void table_insert(const Header& h);
+
+  std::deque<Header> dyn_;   // front() = most recent = index 62
+  size_t dyn_size_ = 0;      // sum of (name+value+32) per RFC §4.1
+  size_t cap_ = 4096;        // current dynamic-table max (peer-controlled)
+  size_t cap_limit_ = 4096;  // protocol max we advertised
+};
+
+// Encoder: emits every header as "literal without indexing, raw strings" —
+// always valid for any peer decoder and keeps the encoder stateless (no
+// dynamic-table sync to get wrong). Responses/requests here are tiny; the
+// hot-path cost is on the engine, not header bytes.
+void hpack_encode(std::string_view name, std::string_view value,
+                  std::string* out);
+
+// ---------------------------------------------------------------------------
+// HTTP/2 framing (RFC 7540 §4)
+// ---------------------------------------------------------------------------
+
+enum FrameType : uint8_t {
+  F_DATA = 0x0,
+  F_HEADERS = 0x1,
+  F_PRIORITY = 0x2,
+  F_RST_STREAM = 0x3,
+  F_SETTINGS = 0x4,
+  F_PUSH_PROMISE = 0x5,
+  F_PING = 0x6,
+  F_GOAWAY = 0x7,
+  F_WINDOW_UPDATE = 0x8,
+  F_CONTINUATION = 0x9,
+};
+
+enum FrameFlags : uint8_t {
+  FLAG_END_STREAM = 0x1,   // DATA, HEADERS
+  FLAG_ACK = 0x1,          // SETTINGS, PING
+  FLAG_END_HEADERS = 0x4,  // HEADERS, CONTINUATION
+  FLAG_PADDED = 0x8,       // DATA, HEADERS
+  FLAG_PRIORITY = 0x20,    // HEADERS
+};
+
+struct FrameHeader {
+  uint32_t length;
+  uint8_t type;
+  uint8_t flags;
+  uint32_t stream_id;  // high bit masked off
+};
+
+inline constexpr const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+inline constexpr size_t kPrefaceLen = 24;
+inline constexpr uint32_t kDefaultWindow = 65535;
+inline constexpr uint32_t kMaxFrameSize = 16384;  // we advertise the default
+
+// Serializes a 9-byte frame header.
+void write_frame_header(uint8_t type, uint8_t flags, uint32_t stream_id,
+                        size_t length, std::string* out);
+// Parses a 9-byte frame header.
+FrameHeader parse_frame_header(const uint8_t p[9]);
+
+// gRPC message framing (5-byte prefix: compressed flag + u32 length).
+void grpc_frame(std::string_view message, std::string* out);
+
+}  // namespace h2

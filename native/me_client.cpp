@@ -1,0 +1,346 @@
+// me_client: the native CLI order submitter.
+//
+// Argv/exit-code/output parity with the reference client
+// (src/client/client.cpp:10-29,49-56) and with the Python CLI
+// (matching_engine_tpu/client/cli.py): positional args
+//   <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> <price> <scale> <qty>
+// plus a `cancel <addr> <client_id> <order_id>` subcommand; prints
+// `[client] accepted order_id=...` / `[client] rejected: ...`;
+// exit codes: 0 accepted, 1 usage, 2 RPC failure, 3 rejected.
+//
+// The transport is the framework's own HTTP/2 client (native/h2.cpp) — this
+// image has no grpc++ — speaking cleartext h2c with prior knowledge, which
+// is what insecure-creds gRPC servers accept. Interop with grpcio servers is
+// tested in tests/test_native_client.py.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "gen/matching_engine.pb.h"
+#include "h2.h"
+
+namespace pb = matching_engine::v1;
+
+namespace {
+
+const char kUsage[] =
+    "usage: me_client <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> "
+    "<price> <scale> <quantity>\n"
+    "   or: me_client cancel <addr> <client_id> <order_id>";
+
+int dial(const std::string& addr) {
+  std::string host = addr;
+  std::string port = "50051";
+  auto colon = addr.rfind(':');
+  if (colon != std::string::npos) {
+    host = addr.substr(0, colon);
+    port = addr.substr(colon + 1);
+  }
+  if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (::getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Same 30s deadline the Python CLI passes per call — a silent server
+    // must fail the RPC, not hang the client forever.
+    timeval tv{30, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  return fd;
+}
+
+bool send_all(int fd, const std::string& buf) {
+  const char* p = buf.data();
+  size_t left = buf.size();
+  while (left > 0) {
+    ssize_t n = ::send(fd, p, left, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    left -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool read_exact(int fd, uint8_t* dst, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, dst + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// One unary gRPC call over a fresh h2c connection. Returns 0 and fills
+// `response_payload` on success (any grpc-status, including errors, is
+// reported via *grpc_status/*grpc_message).
+int unary_call(const std::string& addr, const std::string& path,
+               const std::string& request_bytes, std::string* response_payload,
+               int* grpc_status, std::string* grpc_message) {
+  int fd = dial(addr);
+  if (fd < 0) {
+    std::fprintf(stderr, "[client] rpc failed: UNAVAILABLE: connect %s\n",
+                 addr.c_str());
+    return -1;
+  }
+  std::string out(h2::kPreface, h2::kPrefaceLen);
+  h2::write_frame_header(h2::F_SETTINGS, 0, 0, 0, &out);  // empty SETTINGS
+  // Request headers (stream 1).
+  std::string block;
+  h2::hpack_encode(":method", "POST", &block);
+  h2::hpack_encode(":scheme", "http", &block);
+  h2::hpack_encode(":path", path, &block);
+  h2::hpack_encode(":authority", addr, &block);
+  h2::hpack_encode("te", "trailers", &block);
+  h2::hpack_encode("content-type", "application/grpc", &block);
+  h2::write_frame_header(h2::F_HEADERS, h2::FLAG_END_HEADERS, 1, block.size(),
+                         &out);
+  out += block;
+  std::string data;
+  h2::grpc_frame(request_bytes, &data);
+  h2::write_frame_header(h2::F_DATA, h2::FLAG_END_STREAM, 1, data.size(),
+                         &out);
+  out += data;
+  if (!send_all(fd, out)) {
+    std::fprintf(stderr, "[client] rpc failed: UNAVAILABLE: send\n");
+    ::close(fd);
+    return -1;
+  }
+
+  // Read until our stream ends.
+  h2::HpackDecoder hpack;
+  std::string body;
+  std::string header_block;
+  bool stream_done = false;
+  *grpc_status = -1;
+  std::vector<uint8_t> payload;
+  while (!stream_done) {
+    uint8_t raw[9];
+    if (!read_exact(fd, raw, 9)) break;
+    h2::FrameHeader fh = h2::parse_frame_header(raw);
+    if (fh.length > (1u << 24)) break;
+    payload.resize(fh.length);
+    if (fh.length && !read_exact(fd, payload.data(), fh.length)) break;
+    switch (fh.type) {
+      case h2::F_SETTINGS:
+        if (!(fh.flags & h2::FLAG_ACK)) {
+          std::string ack;
+          h2::write_frame_header(h2::F_SETTINGS, h2::FLAG_ACK, 0, 0, &ack);
+          send_all(fd, ack);
+        }
+        break;
+      case h2::F_PING:
+        if (!(fh.flags & h2::FLAG_ACK) && fh.length == 8) {
+          std::string pong;
+          h2::write_frame_header(h2::F_PING, h2::FLAG_ACK, 0, 8, &pong);
+          pong.append(reinterpret_cast<char*>(payload.data()), 8);
+          send_all(fd, pong);
+        }
+        break;
+      case h2::F_HEADERS: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (fh.flags & h2::FLAG_PADDED) {
+          if (n < 1) break;
+          uint8_t pad = p[0];
+          p += 1;
+          n -= 1;
+          if (pad <= n) n -= pad;
+        }
+        if (fh.flags & h2::FLAG_PRIORITY) {
+          if (n < 5) break;
+          p += 5;
+          n -= 5;
+        }
+        header_block.assign(reinterpret_cast<const char*>(p), n);
+        if (fh.flags & h2::FLAG_END_HEADERS) {
+          std::vector<h2::Header> hs;
+          if (!hpack.decode(
+                  reinterpret_cast<const uint8_t*>(header_block.data()),
+                  header_block.size(), &hs)) {
+            ::close(fd);
+            std::fprintf(stderr, "[client] rpc failed: INTERNAL: hpack\n");
+            return -1;
+          }
+          header_block.clear();
+          for (auto& h : hs) {
+            if (h.name == "grpc-status") *grpc_status = std::atoi(h.value.c_str());
+            if (h.name == "grpc-message") *grpc_message = h.value;
+          }
+          if (fh.flags & h2::FLAG_END_STREAM) stream_done = true;
+        }
+        break;
+      }
+      case h2::F_CONTINUATION: {
+        header_block.append(reinterpret_cast<const char*>(payload.data()),
+                            payload.size());
+        if (fh.flags & h2::FLAG_END_HEADERS) {
+          std::vector<h2::Header> hs;
+          if (!hpack.decode(
+                  reinterpret_cast<const uint8_t*>(header_block.data()),
+                  header_block.size(), &hs)) {
+            ::close(fd);
+            return -1;
+          }
+          header_block.clear();
+          for (auto& h : hs) {
+            if (h.name == "grpc-status") *grpc_status = std::atoi(h.value.c_str());
+            if (h.name == "grpc-message") *grpc_message = h.value;
+          }
+        }
+        break;
+      }
+      case h2::F_DATA: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (fh.flags & h2::FLAG_PADDED) {
+          if (n < 1) break;
+          uint8_t pad = p[0];
+          p += 1;
+          n -= 1;
+          if (pad <= n) n -= pad;
+        }
+        body.append(reinterpret_cast<const char*>(p), n);
+        if (fh.flags & h2::FLAG_END_STREAM) stream_done = true;
+        break;
+      }
+      case h2::F_RST_STREAM:
+      case h2::F_GOAWAY:
+        stream_done = true;
+        break;
+      default:
+        break;
+    }
+  }
+  ::close(fd);
+  if (*grpc_status < 0) {
+    std::fprintf(stderr, "[client] rpc failed: UNAVAILABLE: no trailers\n");
+    return -1;
+  }
+  if (body.size() >= 5) {
+    uint32_t mlen = (static_cast<uint8_t>(body[1]) << 24) |
+                    (static_cast<uint8_t>(body[2]) << 16) |
+                    (static_cast<uint8_t>(body[3]) << 8) |
+                    static_cast<uint8_t>(body[4]);
+    if (body.size() >= 5 + mlen) *response_payload = body.substr(5, mlen);
+  }
+  return 0;
+}
+
+int do_cancel(const std::string& addr, const std::string& client_id,
+              const std::string& order_id) {
+  pb::CancelRequest req;
+  req.set_client_id(client_id);
+  req.set_order_id(order_id);
+  std::string bytes;
+  req.SerializeToString(&bytes);
+  std::string resp_bytes, grpc_message;
+  int grpc_status;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/CancelOrder",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0) {
+    return 2;
+  }
+  if (grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::CancelResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  if (resp.success()) {
+    std::printf("[client] canceled order_id=%s\n", resp.order_id().c_str());
+    return 0;
+  }
+  std::printf("[client] cancel rejected: %s\n", resp.error_message().c_str());
+  return 3;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  GOOGLE_PROTOBUF_VERIFY_VERSION;
+  if (argc == 5 && std::strcmp(argv[1], "cancel") == 0) {
+    return do_cancel(argv[2], argv[3], argv[4]);
+  }
+  if (argc != 9) {
+    std::fprintf(stderr, "%s\n", kUsage);
+    return 1;
+  }
+  const std::string addr = argv[1];
+  pb::OrderRequest req;
+  req.set_client_id(argv[2]);
+  req.set_symbol(argv[3]);
+  std::string side = argv[4];
+  std::string otype = argv[5];
+  for (auto& c : side) c = static_cast<char>(::toupper(c));
+  for (auto& c : otype) c = static_cast<char>(::toupper(c));
+  if (side == "BUY") {
+    req.set_side(pb::BUY);
+  } else if (side == "SELL") {
+    req.set_side(pb::SELL);
+  } else {
+    std::fprintf(stderr, "%s\n", kUsage);
+    return 1;
+  }
+  if (otype == "LIMIT") {
+    req.set_order_type(pb::LIMIT);
+  } else if (otype == "MARKET") {
+    req.set_order_type(pb::MARKET);
+  } else {
+    std::fprintf(stderr, "%s\n", kUsage);
+    return 1;
+  }
+  req.set_price(std::atoll(argv[6]));
+  req.set_scale(std::atoi(argv[7]));
+  req.set_quantity(std::atoll(argv[8]));
+
+  std::string bytes;
+  req.SerializeToString(&bytes);
+  std::string resp_bytes, grpc_message;
+  int grpc_status;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/SubmitOrder",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0) {
+    return 2;
+  }
+  if (grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::OrderResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  if (resp.success()) {
+    std::printf("[client] accepted order_id=%s\n", resp.order_id().c_str());
+    return 0;
+  }
+  std::printf("[client] rejected: %s\n", resp.error_message().c_str());
+  return 3;
+}

@@ -1,0 +1,1259 @@
+// me_gateway: the native gRPC serving edge.
+//
+// The reference's front end is a C++ grpc++ server
+// (src/server/main.cpp:34-38, src/server/matching_engine_service.cpp:41-120).
+// This is its counterpart in the TPU-native architecture: a C++ HTTP/2
+// gateway (transport in native/h2.cpp — no grpc++/nghttp2 dev files exist in
+// this image) that terminates gRPC, parses + validates the hot-path RPCs
+// with the generated protobuf classes, and pushes fixed-size op records into
+// a wide MPSC ring. The Python/JAX side owns the engine: a bridge thread
+// drains the ring in time/size-windowed batches, runs the device dispatch,
+// and completes each op back through `me_gateway_complete_*`, which builds
+// and writes the protobuf response frames — so an order's bytes touch Python
+// only as part of a dense batch, never per-RPC.
+//
+// Non-hot RPCs (GetOrderBook, GetMetrics, the two server-streaming RPCs)
+// are forwarded to a registered Python callback and answered through
+// `me_gateway_respond`, keeping exactly one implementation of book
+// snapshots/metrics/stream hubs.
+//
+// Threading: one acceptor thread + one reader thread per connection.
+// Responses are written by whichever thread completes them (bridge thread on
+// the hot path) under a per-connection write mutex.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "gen/matching_engine.pb.h"
+#include "h2.h"
+
+namespace pb = matching_engine::v1;
+
+// Domain validation lives in libme_native.so (same directory; linked via
+// -l:libme_native.so + rpath $ORIGIN).
+extern "C" {
+int me_normalize_to_q4(long long price, int raw_scale, long long* out);
+int me_validate_submit(int symbol_len, int client_id_len, long long quantity,
+                       int side, int order_type, long long price, int scale,
+                       long long max_price_q4, long long max_quantity,
+                       int max_symbol_len, int max_client_id_len);
+}
+
+namespace {
+
+// Submit validation with byte-identical reject messages to the Python
+// service's domain.validate_submit (matching_engine_tpu/domain/order.py:85-129
+// — itself the reference's rules at matching_engine_service.cpp:66-83 plus
+// this framework's device bounds). Parity is enforced by
+// tests/test_gateway.py::test_validate_message_parity, which replays the
+// same invalid requests through both edges.
+bool validate_submit_msg(const matching_engine::v1::OrderRequest& req,
+                         long long max_price_q4, long long max_quantity,
+                         int max_symbol_len, int max_client_id_len,
+                         long long* price_q4_out, std::string* msg) {
+  char buf[192];
+  if (req.symbol().empty()) {
+    *msg = "symbol is required";
+    return false;
+  }
+  if (static_cast<int>(req.symbol().size()) > max_symbol_len) {
+    std::snprintf(buf, sizeof(buf), "symbol exceeds %d bytes", max_symbol_len);
+    *msg = buf;
+    return false;
+  }
+  if (static_cast<int>(req.client_id().size()) > max_client_id_len) {
+    std::snprintf(buf, sizeof(buf), "client_id exceeds %d bytes",
+                  max_client_id_len);
+    *msg = buf;
+    return false;
+  }
+  if (req.quantity() <= 0) {
+    *msg = "quantity must be positive";
+    return false;
+  }
+  if (req.quantity() > max_quantity) {
+    std::snprintf(buf, sizeof(buf),
+                  "quantity %lld exceeds the engine maximum %lld "
+                  "(int32 book-sum safety bound)",
+                  static_cast<long long>(req.quantity()), max_quantity);
+    *msg = buf;
+    return false;
+  }
+  if (req.side() != 1 && req.side() != 2) {
+    *msg = "side must be BUY or SELL";
+    return false;
+  }
+  int otype = static_cast<int>(req.order_type());
+  if (otype != 0 && otype != 1) {
+    *msg = "order_type must be LIMIT or MARKET";
+    return false;
+  }
+  *price_q4_out = 0;
+  if (otype == 0) {  // LIMIT
+    if (req.price() <= 0) {
+      *msg = "limit orders require a positive price";
+      return false;
+    }
+    long long q4 = 0;
+    int rc = me_normalize_to_q4(req.price(), req.scale(), &q4);
+    if (rc == 1) {
+      std::snprintf(buf, sizeof(buf), "scale %d out of range [0, 18]",
+                    req.scale());
+      *msg = buf;
+      return false;
+    }
+    if (rc == 2) {
+      std::snprintf(buf, sizeof(buf),
+                    "price %lld at scale %d overflows int64 when normalized "
+                    "to Q4",
+                    static_cast<long long>(req.price()), req.scale());
+      *msg = buf;
+      return false;
+    }
+    if (q4 <= 0) {
+      *msg = "limit price normalizes to zero at Q4 resolution";
+      return false;
+    }
+    if (q4 > max_price_q4) {
+      std::snprintf(buf, sizeof(buf),
+                    "normalized Q4 price %lld exceeds the engine's int32 "
+                    "price lane (max %lld)",
+                    q4, max_price_q4);
+      *msg = buf;
+      return false;
+    }
+    *price_q4_out = q4;
+  } else {  // MARKET
+    if (req.scale() < 0 || req.scale() > 18) {
+      std::snprintf(buf, sizeof(buf), "scale %d out of range [0, 18]",
+                    req.scale());
+      *msg = buf;
+      return false;
+    }
+  }
+  return true;
+}
+
+enum Method {
+  M_UNKNOWN = 0,
+  M_SUBMIT = 1,
+  M_CANCEL = 2,
+  M_BOOK = 3,
+  M_METRICS = 4,
+  M_STREAM_MD = 5,
+  M_STREAM_OU = 6,
+};
+
+int route(const std::string& path) {
+  static const char kPrefix[] = "/matching_engine.v1.MatchingEngine/";
+  if (path.rfind(kPrefix, 0) != 0) return M_UNKNOWN;
+  const std::string m = path.substr(sizeof(kPrefix) - 1);
+  if (m == "SubmitOrder") return M_SUBMIT;
+  if (m == "CancelOrder") return M_CANCEL;
+  if (m == "GetOrderBook") return M_BOOK;
+  if (m == "GetMetrics") return M_METRICS;
+  if (m == "StreamMarketData") return M_STREAM_MD;
+  if (m == "StreamOrderUpdates") return M_STREAM_OU;
+  return M_UNKNOWN;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Wide op record popped by the Python bridge (ctypes mirror in
+// matching_engine_tpu/native/__init__.py — keep layouts identical).
+struct MeGwOp {
+  uint64_t tag;
+  int32_t op;        // 1 = submit, 2 = cancel
+  int32_t side;      // BUY=1 / SELL=2
+  int32_t otype;     // LIMIT=0 / MARKET=1
+  int32_t price_q4;  // normalized; 0 for MARKET
+  int64_t quantity;
+  // Explicit lengths: proto3 strings may contain embedded NULs, which must
+  // round-trip identically to the grpcio edge (no c-string truncation).
+  int32_t symbol_len;
+  int32_t client_id_len;
+  int32_t order_id_len;
+  char symbol[68];      // MAX_SYMBOL_BYTES=64
+  char client_id[260];  // MAX_CLIENT_ID_BYTES=256
+  char order_id[36];    // cancel target "OID-<n>"
+};
+
+typedef void (*MeGwCallback)(uint64_t tag, int method, const uint8_t* data,
+                             uint64_t len);
+
+}  // extern "C"
+
+namespace {
+
+class Gateway;
+
+// ---------------------------------------------------------------------------
+// Connection
+// ---------------------------------------------------------------------------
+
+struct Stream {
+  int method = M_UNKNOWN;
+  std::string path;
+  std::string header_block;  // accumulating HEADERS+CONTINUATION fragments
+  bool headers_done = false;
+  std::string body;
+  bool request_done = false;
+  bool closed = false;  // final response written or client RST
+};
+// Stream lifecycle: created by HEADERS (reader thread). Responder threads
+// only ever FLAG an entry closed — the READER is the sole thread that
+// erases map entries (tombstone sweep in the HEADERS handler), so the
+// `Stream&` the reader holds across a frame can never dangle while a
+// responder completes the same stream concurrently.
+
+class Conn : public std::enable_shared_from_this<Conn> {
+ public:
+  Conn(int fd, Gateway* gw) : fd_(fd), gw_(gw) {}
+  ~Conn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  void run();  // reader loop (owns the thread)
+
+  // Serialized frame write; false once the connection is dead.
+  bool write_all(const std::string& buf) {
+    std::lock_guard<std::mutex> lk(write_mu_);
+    return write_locked(buf);
+  }
+
+  void hard_close() {
+    dead_.store(true, std::memory_order_relaxed);
+    ::shutdown(fd_, SHUT_RDWR);
+    fc_cv_.notify_all();  // unblock senders waiting for window
+  }
+
+  bool dead() const { return dead_.load(std::memory_order_relaxed); }
+
+  // Response writers ------------------------------------------------------
+
+  // Unary: HEADERS + DATA + trailers.
+  bool write_unary(uint32_t stream_id, const std::string& message,
+                   int grpc_status, const char* grpc_message);
+  // Streaming: headers (once) + one DATA frame.
+  bool write_message(uint32_t stream_id, const std::string& message,
+                     bool* headers_sent);
+  // Trailers only (ends the stream; also used for trailers-only errors).
+  bool write_trailers(uint32_t stream_id, int grpc_status,
+                      const char* grpc_message, bool headers_already_sent);
+
+  // Marks a stream finished from the responder side (reader sweeps later).
+  void mark_closed(uint32_t stream_id) {
+    {
+      std::lock_guard<std::mutex> lk(streams_mu);
+      auto it = streams.find(stream_id);
+      if (it != streams.end()) it->second.closed = true;
+    }
+    std::lock_guard<std::mutex> lk(fc_mu_);
+    stream_send_wnd_.erase(stream_id);
+  }
+
+  std::mutex streams_mu;  // guards streams map (reader + responders)
+  std::unordered_map<uint32_t, Stream> streams;
+
+ private:
+  bool write_locked(const std::string& buf) {
+    if (dead()) return false;
+    const char* p = buf.data();
+    size_t left = buf.size();
+    while (left > 0) {
+      ssize_t n = ::send(fd_, p, left, MSG_NOSIGNAL);
+      if (n <= 0) {
+        dead_.store(true, std::memory_order_relaxed);
+        return false;
+      }
+      p += n;
+      left -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool read_exact(uint8_t* dst, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t r = ::recv(fd_, dst + got, n - got, 0);
+      if (r <= 0) return false;
+      got += static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  // -- send-side flow control (RFC 7540 §5.2) ----------------------------
+  // DATA writes reserve window under fc_mu_ first (blocking, bounded),
+  // then serialize bytes under write_mu_ — so a window-starved response
+  // can't stall control frames (pings, acks) from the reader thread.
+
+  int64_t stream_wnd_locked(uint32_t sid) {
+    auto it = stream_send_wnd_.find(sid);
+    if (it == stream_send_wnd_.end()) {
+      it = stream_send_wnd_.emplace(sid, peer_initial_wnd_).first;
+    }
+    return it->second;
+  }
+
+  // Sends `data` as DATA frames honoring conn+stream windows. Fails after
+  // 15s without window (slow/stalled consumer) — callers treat it as a
+  // dead stream.
+  bool send_data(uint32_t sid, const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      size_t want = std::min(data.size() - off, size_t{h2::kMaxFrameSize});
+      size_t grant = 0;
+      {
+        std::unique_lock<std::mutex> lk(fc_mu_);
+        auto deadline =
+            std::chrono::steady_clock::now() + std::chrono::seconds(15);
+        for (;;) {
+          if (dead()) return false;
+          int64_t avail = std::min<int64_t>(conn_send_wnd_,
+                                            stream_wnd_locked(sid));
+          if (avail > 0) {
+            grant = std::min<size_t>(want, static_cast<size_t>(avail));
+            conn_send_wnd_ -= static_cast<int64_t>(grant);
+            stream_send_wnd_[sid] -= static_cast<int64_t>(grant);
+            break;
+          }
+          if (fc_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+            return false;
+          }
+        }
+      }
+      std::string out;
+      h2::write_frame_header(h2::F_DATA, 0, sid, grant, &out);
+      out.append(data, off, grant);
+      if (!write_all(out)) return false;
+      off += grant;
+    }
+    return true;
+  }
+
+  void window_update(uint32_t sid, uint32_t incr) {
+    std::lock_guard<std::mutex> lk(fc_mu_);
+    if (sid == 0) {
+      conn_send_wnd_ += incr;
+    } else {
+      stream_wnd_locked(sid);  // materialize at peer initial
+      stream_send_wnd_[sid] += incr;
+    }
+    fc_cv_.notify_all();
+  }
+
+  void apply_peer_initial_window(int32_t new_initial) {
+    std::lock_guard<std::mutex> lk(fc_mu_);
+    int64_t delta = static_cast<int64_t>(new_initial) - peer_initial_wnd_;
+    peer_initial_wnd_ = new_initial;
+    for (auto& [sid, wnd] : stream_send_wnd_) wnd += delta;  // RFC §6.9.2
+    fc_cv_.notify_all();
+  }
+
+  void run_frames();  // the frame loop; run() wraps it with hard_close()
+  void handle_headers_complete(uint32_t stream_id, Stream& st, bool end_stream);
+  void handle_request(uint32_t stream_id, Stream& st);
+  void handle_submit(uint32_t stream_id, const std::string& payload);
+  void handle_cancel(uint32_t stream_id, const std::string& payload);
+  void reject_submit(uint32_t stream_id, const std::string& order_id,
+                     const std::string& error);
+  void reject_cancel(uint32_t stream_id, const std::string& order_id,
+                     const std::string& error);
+
+  int fd_;
+  Gateway* gw_;
+  std::mutex write_mu_;
+  std::atomic<bool> dead_{false};
+  h2::HpackDecoder hpack_;
+  uint32_t continuation_stream_ = 0;  // nonzero while awaiting CONTINUATION
+
+  std::mutex fc_mu_;
+  std::condition_variable fc_cv_;
+  int64_t conn_send_wnd_ = 65535;
+  int32_t peer_initial_wnd_ = 65535;
+  std::unordered_map<uint32_t, int64_t> stream_send_wnd_;
+};
+
+// ---------------------------------------------------------------------------
+// Gateway
+// ---------------------------------------------------------------------------
+
+struct Pending {
+  std::weak_ptr<Conn> conn;
+  uint32_t stream_id = 0;
+  bool streaming = false;
+  bool headers_sent = false;
+};
+
+class Gateway {
+ public:
+  Gateway(std::string addr, uint32_t ring_cap, long long max_price_q4,
+          long long max_quantity, int max_symbol_len, int max_client_id_len)
+      : addr_(std::move(addr)),
+        ring_cap_(ring_cap),
+        max_price_q4_(max_price_q4),
+        max_quantity_(max_quantity),
+        max_symbol_len_(max_symbol_len),
+        max_client_id_len_(max_client_id_len) {}
+
+  ~Gateway() { shutdown(); }
+
+  int start() {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    std::string host = addr_;
+    int port = 0;
+    auto colon = addr_.rfind(':');
+    if (colon != std::string::npos) {
+      host = addr_.substr(0, colon);
+      port = std::atoi(addr_.c_str() + colon + 1);
+    }
+    if (host.empty() || host == "0.0.0.0" || host == "[::]") {
+      sa.sin_addr.s_addr = INADDR_ANY;
+    } else if (::inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+      if (host == "localhost") {
+        ::inet_pton(AF_INET, "127.0.0.1", &sa.sin_addr);
+      } else {
+        ::close(fd);
+        return -1;
+      }
+    }
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+        ::listen(fd, 256) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    socklen_t len = sizeof(sa);
+    ::getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
+    port_ = ntohs(sa.sin_port);
+    listen_fd_ = fd;
+    acceptor_ = std::thread([this] { accept_loop(); });
+    return port_;
+  }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (acceptor_.joinable()) acceptor_.join();
+    if (listen_fd_ >= 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> lk(conns_mu_);
+      for (auto& c : conns_) c->hard_close();
+    }
+    // Connection threads are detached; hard_close wakes their recv() and
+    // they exit. Wait (bounded) for the last one before the ring closes.
+    {
+      std::unique_lock<std::mutex> lk(active_mu_);
+      active_cv_.wait_for(lk, std::chrono::seconds(10),
+                          [&] { return active_conns_ == 0; });
+    }
+    ring_close();
+  }
+
+  bool idle() {
+    std::lock_guard<std::mutex> lk(active_mu_);
+    return active_conns_ == 0;
+  }
+
+  void conn_started() {
+    std::lock_guard<std::mutex> lk(active_mu_);
+    ++active_conns_;
+  }
+
+  void conn_finished() {
+    std::lock_guard<std::mutex> lk(active_mu_);
+    --active_conns_;
+    active_cv_.notify_all();
+  }
+
+  // -- op ring -----------------------------------------------------------
+
+  bool ring_push(const MeGwOp& op) {
+    std::unique_lock<std::mutex> lk(ring_mu_);
+    if (ring_closed_ || ring_.size() >= ring_cap_) {
+      ring_rejects_.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    ring_.push_back(op);
+    ring_cv_.notify_one();
+    return true;
+  }
+
+  int ring_pop_batch(MeGwOp* out, uint32_t max, uint64_t window_us) {
+    std::unique_lock<std::mutex> lk(ring_mu_);
+    ring_cv_.wait(lk, [&] { return ring_closed_ || !ring_.empty(); });
+    if (ring_.empty()) return -1;
+    uint32_t n = 0;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::microseconds(window_us);
+    for (;;) {
+      while (n < max && !ring_.empty()) {
+        out[n++] = ring_.front();
+        ring_.pop_front();
+      }
+      if (n >= max || ring_closed_) break;
+      if (ring_cv_.wait_until(lk, deadline, [&] {
+            return ring_closed_ || !ring_.empty();
+          })) {
+        if (ring_.empty()) break;
+        continue;
+      }
+      break;
+    }
+    return static_cast<int>(n);
+  }
+
+  void ring_close() {
+    std::lock_guard<std::mutex> lk(ring_mu_);
+    ring_closed_ = true;
+    ring_cv_.notify_all();
+  }
+
+  // -- pending tag registry ----------------------------------------------
+
+  uint64_t register_pending(const std::shared_ptr<Conn>& c, uint32_t stream_id,
+                            bool streaming) {
+    uint64_t tag = next_tag_.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    pending_[tag] = Pending{c, stream_id, streaming, false};
+    return tag;
+  }
+
+  bool take_pending(uint64_t tag, Pending* out) {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    auto it = pending_.find(tag);
+    if (it == pending_.end()) return false;
+    *out = it->second;
+    pending_.erase(it);
+    return true;
+  }
+
+  // Peek without erasing (streaming intermediate messages).
+  bool peek_pending(uint64_t tag, Pending* out) {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    auto it = pending_.find(tag);
+    if (it == pending_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  void mark_headers_sent(uint64_t tag) {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    auto it = pending_.find(tag);
+    if (it != pending_.end()) it->second.headers_sent = true;
+  }
+
+  void drop_pending(uint64_t tag) {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    pending_.erase(tag);
+  }
+
+  MeGwCallback callback() const { return callback_; }
+  void set_callback(MeGwCallback cb) { callback_ = cb; }
+
+  long long max_price_q4() const { return max_price_q4_; }
+  long long max_quantity() const { return max_quantity_; }
+  int max_symbol_len() const { return max_symbol_len_; }
+  int max_client_id_len() const { return max_client_id_len_; }
+
+  uint64_t requests() const { return requests_.load(); }
+  uint64_t ring_rejects() const { return ring_rejects_.load(); }
+  uint64_t conns_accepted() const { return conns_accepted_.load(); }
+  void count_request() { requests_.fetch_add(1, std::memory_order_relaxed); }
+
+  int port() const { return port_; }
+
+ private:
+  void accept_loop() {
+    for (;;) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) {
+        if (stopping_.load()) return;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto conn = std::make_shared<Conn>(cfd, this);
+      conns_accepted_.fetch_add(1, std::memory_order_relaxed);
+      {
+        std::lock_guard<std::mutex> lk(conns_mu_);
+        conns_.push_back(conn);
+        // Opportunistic cleanup of finished connections.
+        if (conns_.size() > 64) {
+          std::vector<std::shared_ptr<Conn>> live;
+          for (auto& c : conns_) {
+            if (!c->dead()) live.push_back(c);
+          }
+          conns_.swap(live);
+        }
+      }
+      conn_started();
+      std::thread([this, conn] {
+        conn->run();
+        conn_finished();
+      }).detach();
+    }
+  }
+
+  std::string addr_;
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::thread acceptor_;
+  std::atomic<bool> stopping_{false};
+
+  std::mutex conns_mu_;
+  std::vector<std::shared_ptr<Conn>> conns_;
+  std::mutex active_mu_;
+  std::condition_variable active_cv_;
+  int active_conns_ = 0;
+
+  const uint32_t ring_cap_;
+  std::mutex ring_mu_;
+  std::condition_variable ring_cv_;
+  std::deque<MeGwOp> ring_;
+  bool ring_closed_ = false;
+
+  std::mutex pending_mu_;
+  std::unordered_map<uint64_t, Pending> pending_;
+  std::atomic<uint64_t> next_tag_{1};
+
+  MeGwCallback callback_ = nullptr;
+
+  const long long max_price_q4_;
+  const long long max_quantity_;
+  const int max_symbol_len_;
+  const int max_client_id_len_;
+
+  std::atomic<uint64_t> requests_{0};
+  std::atomic<uint64_t> ring_rejects_{0};
+  std::atomic<uint64_t> conns_accepted_{0};
+};
+
+// ---------------------------------------------------------------------------
+// Conn implementation
+// ---------------------------------------------------------------------------
+
+bool Conn::write_unary(uint32_t stream_id, const std::string& message,
+                       int grpc_status, const char* grpc_message) {
+  std::string hdr_block;
+  h2::hpack_encode(":status", "200", &hdr_block);
+  h2::hpack_encode("content-type", "application/grpc", &hdr_block);
+  std::string hdrs;
+  h2::write_frame_header(h2::F_HEADERS, h2::FLAG_END_HEADERS, stream_id,
+                         hdr_block.size(), &hdrs);
+  hdrs += hdr_block;
+  std::string data;
+  h2::grpc_frame(message, &data);
+  std::string trailer_block;
+  h2::hpack_encode("grpc-status", std::to_string(grpc_status), &trailer_block);
+  if (grpc_message && *grpc_message) {
+    h2::hpack_encode("grpc-message", grpc_message, &trailer_block);
+  }
+  std::string trailers;
+  h2::write_frame_header(h2::F_HEADERS,
+                         h2::FLAG_END_HEADERS | h2::FLAG_END_STREAM, stream_id,
+                         trailer_block.size(), &trailers);
+  trailers += trailer_block;
+  bool ok = write_all(hdrs) && send_data(stream_id, data) &&
+            write_all(trailers);
+  mark_closed(stream_id);
+  return ok;
+}
+
+bool Conn::write_message(uint32_t stream_id, const std::string& message,
+                         bool* headers_sent) {
+  if (!*headers_sent) {
+    std::string hdr_block;
+    h2::hpack_encode(":status", "200", &hdr_block);
+    h2::hpack_encode("content-type", "application/grpc", &hdr_block);
+    std::string hdrs;
+    h2::write_frame_header(h2::F_HEADERS, h2::FLAG_END_HEADERS, stream_id,
+                           hdr_block.size(), &hdrs);
+    hdrs += hdr_block;
+    if (!write_all(hdrs)) return false;
+    *headers_sent = true;
+  }
+  std::string data;
+  h2::grpc_frame(message, &data);
+  return send_data(stream_id, data);
+}
+
+bool Conn::write_trailers(uint32_t stream_id, int grpc_status,
+                          const char* grpc_message,
+                          bool headers_already_sent) {
+  std::string out;
+  std::string block;
+  if (!headers_already_sent) {
+    // Trailers-only response (gRPC over HTTP/2 spec allows it).
+    h2::hpack_encode(":status", "200", &block);
+    h2::hpack_encode("content-type", "application/grpc", &block);
+  }
+  h2::hpack_encode("grpc-status", std::to_string(grpc_status), &block);
+  if (grpc_message && *grpc_message) {
+    h2::hpack_encode("grpc-message", grpc_message, &block);
+  }
+  h2::write_frame_header(h2::F_HEADERS,
+                         h2::FLAG_END_HEADERS | h2::FLAG_END_STREAM, stream_id,
+                         block.size(), &out);
+  out += block;
+  bool ok = write_all(out);
+  mark_closed(stream_id);
+  return ok;
+}
+
+void Conn::run() {
+  run_frames();
+  // EVERY exit path must release the socket promptly — a malformed frame
+  // that merely returned would otherwise leave the fd open (and the client
+  // hanging) until shutdown.
+  hard_close();
+}
+
+void Conn::run_frames() {
+  // 1. Client preface.
+  uint8_t preface[h2::kPrefaceLen];
+  if (!read_exact(preface, sizeof(preface)) ||
+      std::memcmp(preface, h2::kPreface, sizeof(preface)) != 0) {
+    return;
+  }
+  // 2. Our SETTINGS + a large connection window.
+  {
+    std::string out;
+    // SETTINGS: MAX_CONCURRENT_STREAMS=4096, INITIAL_WINDOW_SIZE=1MiB.
+    std::string payload;
+    auto put_setting = [&payload](uint16_t id, uint32_t val) {
+      payload.push_back(static_cast<char>(id >> 8));
+      payload.push_back(static_cast<char>(id & 0xff));
+      payload.push_back(static_cast<char>((val >> 24) & 0xff));
+      payload.push_back(static_cast<char>((val >> 16) & 0xff));
+      payload.push_back(static_cast<char>((val >> 8) & 0xff));
+      payload.push_back(static_cast<char>(val & 0xff));
+    };
+    put_setting(0x3, 4096);      // MAX_CONCURRENT_STREAMS
+    put_setting(0x4, 1 << 20);   // INITIAL_WINDOW_SIZE
+    h2::write_frame_header(h2::F_SETTINGS, 0, 0, payload.size(), &out);
+    out += payload;
+    // Grow the connection-level receive window by 16MiB.
+    h2::write_frame_header(h2::F_WINDOW_UPDATE, 0, 0, 4, &out);
+    uint32_t incr = (16u << 20);
+    out.push_back(static_cast<char>((incr >> 24) & 0xff));
+    out.push_back(static_cast<char>((incr >> 16) & 0xff));
+    out.push_back(static_cast<char>((incr >> 8) & 0xff));
+    out.push_back(static_cast<char>(incr & 0xff));
+    if (!write_all(out)) return;
+  }
+
+  // 3. Frame loop.
+  std::vector<uint8_t> payload;
+  for (;;) {
+    uint8_t raw[9];
+    if (!read_exact(raw, 9)) return;
+    h2::FrameHeader fh = h2::parse_frame_header(raw);
+    if (fh.length > (1u << 24)) return;  // sanity cap
+    payload.resize(fh.length);
+    if (fh.length && !read_exact(payload.data(), fh.length)) return;
+
+    // A CONTINUATION sequence must be contiguous on one stream.
+    if (continuation_stream_ != 0 &&
+        (fh.type != h2::F_CONTINUATION || fh.stream_id != continuation_stream_)) {
+      return;  // connection error per RFC 7540 §6.10
+    }
+
+    switch (fh.type) {
+      case h2::F_SETTINGS: {
+        if (!(fh.flags & h2::FLAG_ACK)) {
+          // Honor the peer's INITIAL_WINDOW_SIZE for our DATA sends.
+          for (size_t off = 0; off + 6 <= payload.size(); off += 6) {
+            uint16_t id = static_cast<uint16_t>((payload[off] << 8) |
+                                                payload[off + 1]);
+            uint32_t val = (static_cast<uint32_t>(payload[off + 2]) << 24) |
+                           (static_cast<uint32_t>(payload[off + 3]) << 16) |
+                           (static_cast<uint32_t>(payload[off + 4]) << 8) |
+                           payload[off + 5];
+            if (id == 0x4 && val <= 0x7fffffffu) {
+              apply_peer_initial_window(static_cast<int32_t>(val));
+            }
+          }
+          std::string ack;
+          h2::write_frame_header(h2::F_SETTINGS, h2::FLAG_ACK, 0, 0, &ack);
+          if (!write_all(ack)) return;
+        }
+        break;
+      }
+      case h2::F_PING: {
+        if (!(fh.flags & h2::FLAG_ACK) && fh.length == 8) {
+          std::string pong;
+          h2::write_frame_header(h2::F_PING, h2::FLAG_ACK, 0, 8, &pong);
+          pong.append(reinterpret_cast<char*>(payload.data()), 8);
+          if (!write_all(pong)) return;
+        }
+        break;
+      }
+      case h2::F_WINDOW_UPDATE: {
+        if (fh.length == 4) {
+          uint32_t incr = ((static_cast<uint32_t>(payload[0]) & 0x7f) << 24) |
+                          (static_cast<uint32_t>(payload[1]) << 16) |
+                          (static_cast<uint32_t>(payload[2]) << 8) |
+                          payload[3];
+          if (incr) window_update(fh.stream_id, incr);
+        }
+        break;
+      }
+      case h2::F_PRIORITY:
+        break;
+      case h2::F_GOAWAY:
+        return;
+      case h2::F_RST_STREAM: {
+        // Reader-side close: safe to erase directly (no live Stream& here).
+        {
+          std::lock_guard<std::mutex> lk(streams_mu);
+          streams.erase(fh.stream_id);
+        }
+        std::lock_guard<std::mutex> lk(fc_mu_);
+        stream_send_wnd_.erase(fh.stream_id);
+        break;
+      }
+      case h2::F_HEADERS: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (fh.flags & h2::FLAG_PADDED) {
+          if (n < 1) return;
+          uint8_t pad = p[0];
+          p += 1;
+          n -= 1;
+          if (pad > n) return;
+          n -= pad;
+        }
+        if (fh.flags & h2::FLAG_PRIORITY) {
+          if (n < 5) return;
+          p += 5;
+          n -= 5;
+        }
+        Stream* st;
+        {
+          std::lock_guard<std::mutex> lk(streams_mu);
+          // Sweep tombstones (responder-closed streams) while no Stream&
+          // is held — the reader is the only thread that erases, so
+          // references it takes below stay valid across the request.
+          if (streams.size() > 64) {
+            for (auto it = streams.begin(); it != streams.end();) {
+              it = it->second.closed ? streams.erase(it) : std::next(it);
+            }
+          }
+          Stream& ref = streams[fh.stream_id];
+          if (ref.closed) break;  // late frames on a finished stream: drop
+          st = &ref;
+        }
+        st->header_block.append(reinterpret_cast<const char*>(p), n);
+        bool end_stream = (fh.flags & h2::FLAG_END_STREAM) != 0;
+        if (fh.flags & h2::FLAG_END_HEADERS) {
+          continuation_stream_ = 0;
+          handle_headers_complete(fh.stream_id, *st, end_stream);
+        } else {
+          continuation_stream_ = fh.stream_id;
+          if (end_stream) st->request_done = true;  // applies when complete
+        }
+        break;
+      }
+      case h2::F_CONTINUATION: {
+        Stream* st;
+        {
+          std::lock_guard<std::mutex> lk(streams_mu);
+          auto it = streams.find(fh.stream_id);
+          if (it == streams.end()) return;
+          if (it->second.closed) break;
+          st = &it->second;
+        }
+        st->header_block.append(reinterpret_cast<const char*>(payload.data()),
+                                payload.size());
+        if (fh.flags & h2::FLAG_END_HEADERS) {
+          continuation_stream_ = 0;
+          handle_headers_complete(fh.stream_id, *st, st->request_done);
+        }
+        break;
+      }
+      case h2::F_DATA: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (fh.flags & h2::FLAG_PADDED) {
+          if (n < 1) return;
+          uint8_t pad = p[0];
+          p += 1;
+          n -= 1;
+          if (pad > n) return;
+          n -= pad;
+        }
+        Stream* st = nullptr;
+        {
+          std::lock_guard<std::mutex> lk(streams_mu);
+          auto it = streams.find(fh.stream_id);
+          if (it != streams.end() && !it->second.closed) st = &it->second;
+        }
+        if (st != nullptr) {
+          st->body.append(reinterpret_cast<const char*>(p), n);
+        }
+        // Replenish both flow-control windows for what we just consumed
+        // (even for dropped frames on closed streams — the bytes arrived).
+        if (payload.size() > 0) {
+          std::string wu;
+          uint32_t incr = static_cast<uint32_t>(payload.size());
+          for (uint32_t sid : {0u, fh.stream_id}) {
+            h2::write_frame_header(h2::F_WINDOW_UPDATE, 0, sid, 4, &wu);
+            wu.push_back(static_cast<char>((incr >> 24) & 0xff));
+            wu.push_back(static_cast<char>((incr >> 16) & 0xff));
+            wu.push_back(static_cast<char>((incr >> 8) & 0xff));
+            wu.push_back(static_cast<char>(incr & 0xff));
+          }
+          if (!write_all(wu)) return;
+        }
+        if (st != nullptr && (fh.flags & h2::FLAG_END_STREAM)) {
+          st->request_done = true;
+          handle_request(fh.stream_id, *st);
+        }
+        break;
+      }
+      default:
+        break;  // PUSH_PROMISE from a client is invalid; ignore others
+    }
+  }
+}
+
+void Conn::handle_headers_complete(uint32_t stream_id, Stream& st,
+                                   bool end_stream) {
+  if (st.headers_done) {
+    // Trailers from the client: nothing to read in them for our methods.
+    st.header_block.clear();
+    if (end_stream && !st.request_done) {
+      st.request_done = true;
+      handle_request(stream_id, st);
+    }
+    return;
+  }
+  std::vector<h2::Header> headers;
+  if (!hpack_.decode(
+          reinterpret_cast<const uint8_t*>(st.header_block.data()),
+          st.header_block.size(), &headers)) {
+    hard_close();  // HPACK failure is a connection error
+    return;
+  }
+  st.header_block.clear();
+  st.headers_done = true;
+  for (auto& h : headers) {
+    if (h.name == ":path") st.path = h.value;
+  }
+  st.method = route(st.path);
+  if (end_stream) {
+    st.request_done = true;
+    handle_request(stream_id, st);
+  }
+}
+
+void Conn::handle_request(uint32_t stream_id, Stream& st) {
+  gw_->count_request();
+  if (st.method == M_UNKNOWN) {
+    write_trailers(stream_id, 12, "unknown method", false);  // UNIMPLEMENTED
+    return;
+  }
+  // Extract the first gRPC message from the body.
+  if (st.body.size() < 5) {
+    write_trailers(stream_id, 13, "malformed request body", false);  // INTERNAL
+    return;
+  }
+  uint8_t compressed = static_cast<uint8_t>(st.body[0]);
+  uint32_t mlen = (static_cast<uint8_t>(st.body[1]) << 24) |
+                  (static_cast<uint8_t>(st.body[2]) << 16) |
+                  (static_cast<uint8_t>(st.body[3]) << 8) |
+                  static_cast<uint8_t>(st.body[4]);
+  if (compressed != 0) {
+    write_trailers(stream_id, 12, "compression not supported", false);
+    return;
+  }
+  if (st.body.size() < 5 + static_cast<size_t>(mlen)) {
+    write_trailers(stream_id, 13, "truncated request body", false);
+    return;
+  }
+  std::string payload = st.body.substr(5, mlen);
+  st.body.clear();
+
+  switch (st.method) {
+    case M_SUBMIT:
+      handle_submit(stream_id, payload);
+      return;
+    case M_CANCEL:
+      handle_cancel(stream_id, payload);
+      return;
+    default: {
+      // Forwarded methods (book/metrics/streams) go through the Python
+      // callback; the response arrives via me_gateway_respond.
+      MeGwCallback cb = gw_->callback();
+      if (cb == nullptr) {
+        write_trailers(stream_id, 14, "service not ready", false);  // UNAVAILABLE
+        return;
+      }
+      bool streaming =
+          st.method == M_STREAM_MD || st.method == M_STREAM_OU;
+      uint64_t tag =
+          gw_->register_pending(shared_from_this(), stream_id, streaming);
+      cb(tag, st.method, reinterpret_cast<const uint8_t*>(payload.data()),
+         payload.size());
+      return;
+    }
+  }
+}
+
+void Conn::reject_submit(uint32_t stream_id, const std::string& order_id,
+                         const std::string& error) {
+  pb::OrderResponse resp;
+  resp.set_order_id(order_id);
+  resp.set_success(false);
+  resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  write_unary(stream_id, bytes, 0, nullptr);
+}
+
+void Conn::reject_cancel(uint32_t stream_id, const std::string& order_id,
+                         const std::string& error) {
+  pb::CancelResponse resp;
+  resp.set_order_id(order_id);
+  resp.set_success(false);
+  resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  write_unary(stream_id, bytes, 0, nullptr);
+}
+
+void Conn::handle_submit(uint32_t stream_id, const std::string& payload) {
+  pb::OrderRequest req;
+  if (!req.ParseFromString(payload)) {
+    write_trailers(stream_id, 13, "unparsable OrderRequest", false);
+    return;
+  }
+  // Validation parity with the Python service: app-level reject, gRPC OK
+  // (reference matching_engine_service.cpp:66-83 semantics).
+  long long price_q4 = 0;
+  std::string err;
+  if (!validate_submit_msg(req, gw_->max_price_q4(), gw_->max_quantity(),
+                           gw_->max_symbol_len(), gw_->max_client_id_len(),
+                           &price_q4, &err)) {
+    reject_submit(stream_id, "", err);
+    return;
+  }
+  MeGwOp op{};
+  op.op = 1;
+  op.side = req.side();
+  op.otype = req.order_type();
+  op.price_q4 = static_cast<int32_t>(price_q4);
+  op.quantity = req.quantity();
+  // Length-prefixed copies: proto3 strings may hold embedded NULs and must
+  // book identically to the grpcio edge (lengths were validated above).
+  op.symbol_len = static_cast<int32_t>(req.symbol().size());
+  std::memcpy(op.symbol, req.symbol().data(), req.symbol().size());
+  op.client_id_len = static_cast<int32_t>(req.client_id().size());
+  std::memcpy(op.client_id, req.client_id().data(), req.client_id().size());
+  op.tag = gw_->register_pending(shared_from_this(), stream_id, false);
+  if (!gw_->ring_push(op)) {
+    gw_->drop_pending(op.tag);
+    reject_submit(stream_id, "", "server overloaded");
+    return;
+  }
+}
+
+void Conn::handle_cancel(uint32_t stream_id, const std::string& payload) {
+  pb::CancelRequest req;
+  if (!req.ParseFromString(payload)) {
+    write_trailers(stream_id, 13, "unparsable CancelRequest", false);
+    return;
+  }
+  if (req.client_id().empty()) {
+    reject_cancel(stream_id, req.order_id(), "client_id is required");
+    return;
+  }
+  if (req.order_id().size() > sizeof(MeGwOp::order_id)) {
+    reject_cancel(stream_id, req.order_id(), "unknown order id");
+    return;
+  }
+  MeGwOp op{};
+  op.op = 2;
+  op.order_id_len = static_cast<int32_t>(req.order_id().size());
+  std::memcpy(op.order_id, req.order_id().data(), req.order_id().size());
+  // An over-long requester id is clamped to the record capacity: every
+  // real owner id is <= 256 bytes (submit validation), so the clamped
+  // 260-byte value still compares unequal to all of them and the bridge
+  // resolves unknown-order vs wrong-owner exactly as the grpcio edge does.
+  size_t cid = std::min(req.client_id().size(), sizeof(MeGwOp::client_id));
+  op.client_id_len = static_cast<int32_t>(cid);
+  std::memcpy(op.client_id, req.client_id().data(), cid);
+  op.tag = gw_->register_pending(shared_from_this(), stream_id, false);
+  if (!gw_->ring_push(op)) {
+    gw_->drop_pending(op.tag);
+    reject_cancel(stream_id, req.order_id(), "server overloaded");
+    return;
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI (consumed by matching_engine_tpu/native via ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* me_gateway_create(const char* addr, uint32_t ring_capacity,
+                        long long max_price_q4, long long max_quantity,
+                        int max_symbol_len, int max_client_id_len) {
+  return new Gateway(addr ? addr : "0.0.0.0:0", ring_capacity, max_price_q4,
+                     max_quantity, max_symbol_len, max_client_id_len);
+}
+
+int me_gateway_start(void* g) { return static_cast<Gateway*>(g)->start(); }
+
+int me_gateway_port(void* g) { return static_cast<Gateway*>(g)->port(); }
+
+void me_gateway_set_callback(void* g, MeGwCallback cb) {
+  static_cast<Gateway*>(g)->set_callback(cb);
+}
+
+int me_gw_pop_batch(void* g, MeGwOp* out, uint32_t max, uint64_t window_us) {
+  return static_cast<Gateway*>(g)->ring_pop_batch(out, max, window_us);
+}
+
+// Hot-path completions: build the protobuf response and write all frames.
+void me_gateway_complete_submit(void* g, uint64_t tag, int success,
+                                const char* order_id, const char* error) {
+  auto* gw = static_cast<Gateway*>(g);
+  Pending p;
+  if (!gw->take_pending(tag, &p)) return;
+  auto conn = p.conn.lock();
+  if (!conn || conn->dead()) return;
+  pb::OrderResponse resp;
+  resp.set_order_id(order_id ? order_id : "");
+  resp.set_success(success != 0);
+  if (error && *error) resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  conn->write_unary(p.stream_id, bytes, 0, nullptr);
+}
+
+void me_gateway_complete_cancel(void* g, uint64_t tag, int success,
+                                const char* order_id, const char* error) {
+  auto* gw = static_cast<Gateway*>(g);
+  Pending p;
+  if (!gw->take_pending(tag, &p)) return;
+  auto conn = p.conn.lock();
+  if (!conn || conn->dead()) return;
+  pb::CancelResponse resp;
+  resp.set_order_id(order_id ? order_id : "");
+  resp.set_success(success != 0);
+  if (error && *error) resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  conn->write_unary(p.stream_id, bytes, 0, nullptr);
+}
+
+// Generic response path for forwarded methods. end_stream=1 finishes the
+// RPC with trailers; msg may be NULL for a trailers-only finish.
+// Returns 1 on success, 0 when the stream/connection is gone.
+int me_gateway_respond(void* g, uint64_t tag, const uint8_t* msg,
+                       uint64_t len, int end_stream, int grpc_status,
+                       const char* grpc_message) {
+  auto* gw = static_cast<Gateway*>(g);
+  Pending p;
+  if (end_stream) {
+    if (!gw->take_pending(tag, &p)) return 0;
+  } else {
+    if (!gw->peek_pending(tag, &p)) return 0;
+  }
+  auto conn = p.conn.lock();
+  if (!conn || conn->dead()) {
+    if (!end_stream) gw->drop_pending(tag);
+    return 0;
+  }
+  {
+    // A client RST erases the stream entry; stop the producer.
+    std::lock_guard<std::mutex> lk(conn->streams_mu);
+    auto it = conn->streams.find(p.stream_id);
+    if (it == conn->streams.end() || it->second.closed) {
+      if (!end_stream) gw->drop_pending(tag);
+      return 0;
+    }
+  }
+  bool ok = true;
+  bool headers_sent = p.headers_sent;
+  if (msg != nullptr && len > 0) {
+    std::string m(reinterpret_cast<const char*>(msg), len);
+    ok = conn->write_message(p.stream_id, m, &headers_sent);
+    if (ok && !p.headers_sent) gw->mark_headers_sent(tag);
+  }
+  if (ok && end_stream) {
+    ok = conn->write_trailers(p.stream_id, grpc_status,
+                              grpc_message ? grpc_message : "", headers_sent);
+  }
+  if (!ok && !end_stream) gw->drop_pending(tag);
+  return ok ? 1 : 0;
+}
+
+// 1 while the stream can still accept messages (connection + stream alive).
+int me_gateway_stream_alive(void* g, uint64_t tag) {
+  auto* gw = static_cast<Gateway*>(g);
+  Pending p;
+  if (!gw->peek_pending(tag, &p)) return 0;
+  auto conn = p.conn.lock();
+  if (!conn || conn->dead()) return 0;
+  std::lock_guard<std::mutex> lk(conn->streams_mu);
+  auto it = conn->streams.find(p.stream_id);
+  return (it == conn->streams.end() || it->second.closed) ? 0 : 1;
+}
+
+void me_gateway_stats(void* g, uint64_t* requests, uint64_t* ring_rejects,
+                      uint64_t* conns) {
+  auto* gw = static_cast<Gateway*>(g);
+  if (requests) *requests = gw->requests();
+  if (ring_rejects) *ring_rejects = gw->ring_rejects();
+  if (conns) *conns = gw->conns_accepted();
+}
+
+void me_gateway_shutdown(void* g) { static_cast<Gateway*>(g)->shutdown(); }
+
+void me_gateway_destroy(void* g) {
+  auto* gw = static_cast<Gateway*>(g);
+  gw->shutdown();
+  if (!gw->idle()) {
+    // A connection thread outlived the shutdown timeout (e.g. wedged in a
+    // blocking send): leak the gateway rather than free memory under a
+    // live thread. Same policy as NativeRingDispatcher.close.
+    std::fprintf(stderr, "[gateway] destroy with live connections; leaking\n");
+    return;
+  }
+  delete gw;
+}
+
+}  // extern "C"

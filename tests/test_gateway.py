@@ -1,0 +1,291 @@
+"""Native (C++) gRPC gateway tests.
+
+The serving edge under test is native/me_gateway.cpp + native/h2.cpp: a
+hand-rolled HTTP/2 + HPACK gRPC server (no grpc++/nghttp2 in this image).
+Interop is the point — every test here drives the C++ gateway with the
+grpc C-core client (grpcio), the strictest HTTP/2 peer available, plus the
+native CLI client. The reference's oracle pattern (SURVEY.md §4: black-box
+RPC in, white-box SQLite assert out) carries over: behavior must be
+indistinguishable from the grpcio edge bar the port.
+"""
+
+import subprocess
+import threading
+import time
+
+import grpc
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.storage import Storage
+
+pytestmark = pytest.mark.skipif(
+    not me_native.gateway_available(), reason="native gateway not built"
+)
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+
+
+class GwHarness:
+    """Full stack with BOTH edges: grpcio on .port, C++ gateway on .gw_port."""
+
+    def __init__(self, db_path, cfg=CFG):
+        self.db_path = db_path
+        self.server, self.port, self.parts = build_server(
+            "127.0.0.1:0", db_path, cfg, window_ms=1.0, log=False,
+            gateway_addr="127.0.0.1:0",
+        )
+        self.gw_port = self.parts["gateway_port"]
+        self.server.start()
+        self.gw_channel = grpc.insecure_channel(f"127.0.0.1:{self.gw_port}")
+        self.stub = MatchingEngineStub(self.gw_channel)     # native edge
+        self.py_channel = grpc.insecure_channel(f"127.0.0.1:{self.port}")
+        self.py_stub = MatchingEngineStub(self.py_channel)  # grpcio edge
+
+    def flush(self):
+        self.parts["sink"].flush()
+
+    def close(self):
+        self.gw_channel.close()
+        self.py_channel.close()
+        shutdown(self.server, self.parts)
+
+
+@pytest.fixture(scope="module")
+def hs(tmp_path_factory):
+    h = GwHarness(str(tmp_path_factory.mktemp("gw") / "gw.db"))
+    yield h
+    h.close()
+
+
+def submit(stub, client="c1", symbol="SYM", otype=pb2.LIMIT, side=pb2.BUY,
+           price=10000, scale=4, qty=5):
+    return stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol=symbol, order_type=otype,
+                         side=side, price=price, scale=scale, quantity=qty),
+        timeout=10,
+    )
+
+
+def test_hpack_vectors():
+    """The transport's HPACK codec passes the RFC 7541 Appendix C vectors."""
+    import os
+    native_dir = os.path.join(os.path.dirname(me_native.__file__), "..", "..",
+                              "native")
+    subprocess.run(["make", "-s", "h2_test"], cwd=native_dir, check=True)
+    out = subprocess.run([os.path.join(native_dir, "h2_test")],
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_submit_normalizes_and_persists(hs):
+    resp = submit(hs.stub, symbol="NORM", price=10000, scale=8, qty=3)
+    assert resp.success and resp.order_id.startswith("OID-")
+    hs.flush()
+    row = Storage(hs.db_path).get_order(resp.order_id)
+    assert row is not None
+    assert row[5] == 1   # Q4-normalized price
+    assert row[8] == 0   # NEW
+
+
+def test_match_through_gateway(hs):
+    r1 = submit(hs.stub, client="a", symbol="MTCH", side=pb2.BUY,
+                price=50000, qty=10)
+    r2 = submit(hs.stub, client="b", symbol="MTCH", side=pb2.SELL,
+                price=50000, qty=4)
+    assert r1.success and r2.success
+    hs.flush()
+    st = Storage(hs.db_path)
+    maker = st.get_order(r1.order_id)
+    taker = st.get_order(r2.order_id)
+    assert maker[7] == 6    # remaining 10-4
+    assert maker[8] == 1    # PARTIALLY_FILLED
+    assert taker[7] == 0 and taker[8] == 2  # FILLED
+    fills = st.fills_for_order(r2.order_id)
+    assert len(fills) == 1 and fills[0][3] == 4
+
+
+def test_cross_edge_visibility(hs):
+    """An order submitted on the grpcio edge matches one from the native
+    edge — both edges drive the same books."""
+    r1 = submit(hs.py_stub, client="py", symbol="XEDG", side=pb2.BUY,
+                price=70000, qty=5)
+    r2 = submit(hs.stub, client="cc", symbol="XEDG", side=pb2.SELL,
+                price=70000, qty=5)
+    assert r1.success and r2.success
+    hs.flush()
+    st = Storage(hs.db_path)
+    assert st.get_order(r1.order_id)[8] == 2  # FILLED
+    assert st.get_order(r2.order_id)[8] == 2
+
+
+def test_book_query(hs):
+    submit(hs.stub, client="bk", symbol="BOOK", side=pb2.BUY, price=11000, qty=7)
+    submit(hs.stub, client="bk", symbol="BOOK", side=pb2.BUY, price=12000, qty=2)
+    book = hs.stub.GetOrderBook(pb2.OrderBookRequest(symbol="BOOK"), timeout=10)
+    assert [(o.price, o.quantity) for o in book.bids] == [(12000, 2), (11000, 7)]
+    assert book.asks == []
+
+
+def test_cancel_lifecycle(hs):
+    r = submit(hs.stub, client="cx", symbol="CNCL", side=pb2.BUY,
+               price=30000, qty=9)
+    wrong = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="other", order_id=r.order_id), timeout=10)
+    assert not wrong.success and "different client" in wrong.error_message
+    ok = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="cx", order_id=r.order_id), timeout=10)
+    assert ok.success
+    again = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="cx", order_id=r.order_id), timeout=10)
+    assert not again.success
+    missing = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="cx", order_id="OID-424242"), timeout=10)
+    assert not missing.success and missing.error_message == "unknown order id"
+    empty = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="", order_id=r.order_id), timeout=10)
+    assert not empty.success and empty.error_message == "client_id is required"
+
+
+def test_validate_message_parity(hs):
+    """Both edges must produce byte-identical app-level reject messages
+    (C++ validate_submit_msg vs domain.validate_submit)."""
+    bad_requests = [
+        dict(client="v", symbol="", price=1, qty=1),
+        dict(client="v", symbol="V" * 65, price=1, qty=1),
+        dict(client="v" * 257, symbol="VAL", price=1, qty=1),
+        dict(client="v", symbol="VAL", price=1, qty=0),
+        dict(client="v", symbol="VAL", price=1, qty=-3),
+        dict(client="v", symbol="VAL", price=1, qty=3_000_000),
+        dict(client="v", symbol="VAL", side=5, price=1, qty=1),
+        dict(client="v", symbol="VAL", otype=7, price=1, qty=1),
+        dict(client="v", symbol="VAL", price=0, qty=1),
+        dict(client="v", symbol="VAL", price=-10, qty=1),
+        dict(client="v", symbol="VAL", price=10, scale=19, qty=1),
+        dict(client="v", symbol="VAL", price=10, scale=-1, qty=1),
+        dict(client="v", symbol="VAL", price=10**18, scale=0, qty=1),
+        dict(client="v", symbol="VAL", price=5, scale=9, qty=1),     # ->0 at Q4
+        dict(client="v", symbol="VAL", price=10**12, scale=2, qty=1),  # > int32 lane
+        dict(client="v", symbol="VAL", otype=pb2.MARKET, price=0, scale=19, qty=1),
+    ]
+    for kw in bad_requests:
+        via_gw = submit(hs.stub, **kw)
+        via_py = submit(hs.py_stub, **kw)
+        assert not via_gw.success and not via_py.success, kw
+        assert via_gw.error_message == via_py.error_message, (
+            kw, via_gw.error_message, via_py.error_message)
+
+
+def test_market_data_stream(hs):
+    got = []
+    done = threading.Event()
+
+    def watch():
+        try:
+            for upd in hs.stub.StreamMarketData(
+                    pb2.MarketDataRequest(symbol="STRM"), timeout=8):
+                got.append((upd.best_bid, upd.best_ask))
+                if len(got) >= 2:
+                    break
+        except grpc.RpcError:
+            pass
+        done.set()
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.4)
+    submit(hs.stub, client="s1", symbol="STRM", side=pb2.BUY, price=40000, qty=1)
+    time.sleep(0.2)
+    submit(hs.stub, client="s2", symbol="STRM", side=pb2.SELL, price=41000, qty=2)
+    assert done.wait(10)
+    assert got[0] == (40000, 0)
+    assert got[-1] == (40000, 41000)
+
+
+def test_order_updates_stream(hs):
+    got = []
+    done = threading.Event()
+
+    def watch():
+        try:
+            for upd in hs.stub.StreamOrderUpdates(
+                    pb2.OrderUpdatesRequest(client_id="flw"), timeout=8):
+                got.append((upd.status, upd.fill_quantity, upd.remaining_quantity))
+                if len(got) >= 2:
+                    break
+        except grpc.RpcError:
+            pass
+        done.set()
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.4)
+    r = submit(hs.stub, client="flw", symbol="UPDS", side=pb2.BUY,
+               price=60000, qty=5)
+    assert r.success
+    submit(hs.stub, client="ctr", symbol="UPDS", side=pb2.SELL,
+           price=60000, qty=5)
+    assert done.wait(10)
+    # NEW ack then the FILLED execution report.
+    assert got[0][0] == 0
+    assert got[-1] == (2, 5, 0)
+
+
+def test_metrics_through_gateway(hs):
+    m = hs.stub.GetMetrics(pb2.MetricsRequest(), timeout=10)
+    assert m.counters.get("orders_accepted", 0) > 0
+    assert m.counters.get("dispatches", 0) > 0
+
+
+def test_unknown_method_unimplemented(hs):
+    ch = grpc.insecure_channel(f"127.0.0.1:{hs.gw_port}")
+    call = ch.unary_unary(
+        "/matching_engine.v1.MatchingEngine/NoSuchMethod",
+        request_serializer=lambda m: m,
+        response_deserializer=lambda b: b,
+    )
+    with pytest.raises(grpc.RpcError) as e:
+        call(b"", timeout=10)
+    assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    ch.close()
+
+
+def test_native_client_binary(hs):
+    cli = me_native.client_binary()
+    assert cli is not None
+    addr = f"127.0.0.1:{hs.gw_port}"
+    r = subprocess.run([cli, addr, "ncli", "NCLI", "BUY", "LIMIT", "10050",
+                        "2", "5"], capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0 and "accepted order_id=" in r.stdout
+    oid = r.stdout.strip().rsplit("=", 1)[1]
+    rc = subprocess.run([cli, "cancel", addr, "ncli", oid],
+                        capture_output=True, text=True, timeout=30)
+    assert rc.returncode == 0 and "canceled" in rc.stdout
+    # rejected submit -> exit 3 (reference client.cpp exit contract)
+    r3 = subprocess.run([cli, addr, "ncli", "NCLI", "BUY", "LIMIT", "0",
+                        "2", "5"], capture_output=True, text=True, timeout=30)
+    assert r3.returncode == 3 and "rejected" in r3.stdout
+    # usage -> exit 1
+    r4 = subprocess.run([cli], capture_output=True, text=True, timeout=30)
+    assert r4.returncode == 1
+
+
+def test_native_client_against_grpcio_server(hs):
+    """Interop in the other direction: our HTTP/2 client against the
+    grpc C-core server edge."""
+    cli = me_native.client_binary()
+    addr = f"127.0.0.1:{hs.port}"
+    r = subprocess.run([cli, addr, "nc2", "NC2", "SELL", "LIMIT", "777",
+                        "4", "2"], capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0 and "accepted order_id=" in r.stdout
+
+
+def test_gateway_stats(hs):
+    bridge = hs.parts["bridge"]
+    stats = bridge.gateway.stats()
+    assert stats["requests"] > 0
+    assert stats["conns"] > 0
