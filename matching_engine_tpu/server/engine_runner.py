@@ -142,6 +142,16 @@ class EngineRunner:
         self._slot_live = [0] * cfg.num_symbols
         self._free_slots: list[int] = []
         self._next_slot = 0
+        # Durability-gap ledger: (order_id, kind, lost_qty) tuples recorded
+        # when fill RECORDS are lost (kernel max_fills overflow) while the
+        # book state applied them. Drained into the durable store's `recon`
+        # table at the next checkpoint (utils/checkpoint.py) so the audit
+        # can hold exact arithmetic even across an acknowledged loss.
+        # Bounded: without a checkpoint daemon nothing drains it, and a
+        # sustained-overflow server must not leak memory — the overflow of
+        # the ledger itself is counted and the tail dropped.
+        self.pending_recon: list[tuple[str, str, int]] = []
+        self._recon_cap = 100_000
 
     def place_book(self, host_book) -> None:
         """Install a host-side BookBatch as the live device book, honoring
@@ -385,6 +395,16 @@ class EngineRunner:
                 # bookkeeping, in priority order. One storage row per
                 # execution (order_id = aggressor, counter_order_id = maker);
                 # the maker's remaining/status is an orders-table update.
+                # Fill-record overflow leaves the taker's decoded fill list
+                # short of its true executed quantity (r.filled comes from
+                # the results lane, which never overflows). Ledger the gap:
+                # the fills table will be missing exactly this much.
+                decoded_fill_qty = sum(
+                    f.quantity for f in fills_by_taker.get(info.handle, ())
+                )
+                if decoded_fill_qty < r.filled:
+                    self._ledger_lost(info.order_id,
+                                      r.filled - decoded_fill_qty)
                 rem = info.quantity
                 for f in fills_by_taker.get(info.handle, ()):
                     rem -= f.quantity
@@ -456,6 +476,68 @@ class EngineRunner:
                     ask_size=int(asz[s]),
                 )
             )
+
+    # -- durability reconciliation -----------------------------------------
+
+    def _ledger_lost(self, order_id: str, qty: int) -> None:
+        if len(self.pending_recon) >= self._recon_cap:
+            self.metrics.inc("recon_ledger_dropped")
+            return
+        self.pending_recon.append((order_id, "fills_lost", qty))
+
+    def reconcile_fill_overflow(self) -> list[tuple]:
+        """Repair the host directory against the device book after fill-
+        record overflow (kernel max_fills). Caller must hold the dispatch
+        lock (quiesced engine).
+
+        Takers self-report their true filled/remaining through the results
+        lane, but MAKER decrements are decoded from fill records — when
+        those overflow, host maker state (and therefore SQLite) runs ahead
+        of reality. The device book is the truth: every open order is a
+        resting lane, so join directory handles against the lanes and adopt
+        the device remaining. Returns [(order_id, remaining, status,
+        lost_qty)] repair rows for the durable store; matching
+        ("fills_lost") entries are appended to pending_recon.
+        """
+        lanes: dict[int, int] = {}
+        with self._snapshot_lock:
+            arrs = [
+                np.asarray(x)
+                for x in (self.book.bid_oid, self.book.bid_qty,
+                          self.book.ask_oid, self.book.ask_qty)
+            ]
+        for oid_arr, qty_arr in ((arrs[0], arrs[1]), (arrs[2], arrs[3])):
+            mask = qty_arr > 0
+            for h, q in zip(oid_arr[mask].tolist(), qty_arr[mask].tolist()):
+                lanes[int(h)] = int(q)
+
+        repairs: list[tuple] = []
+        for handle, info in list(self.orders_by_handle.items()):
+            dev_rem = lanes.get(handle)
+            if dev_rem is None:
+                # Open on the host, gone from the book: fully consumed by
+                # fills whose records overflowed (cancels/rejects always
+                # surface through the results lane, so this is a fill).
+                lost = info.remaining
+                info.remaining = 0
+                info.status = FILLED
+                repairs.append((info.order_id, 0, FILLED, lost))
+                self._ledger_lost(info.order_id, lost)
+                self._evict(info)
+            elif dev_rem != info.remaining:
+                lost = info.remaining - dev_rem
+                info.remaining = dev_rem
+                info.status = PARTIALLY_FILLED
+                repairs.append(
+                    (info.order_id, dev_rem, PARTIALLY_FILLED, lost))
+                self._ledger_lost(info.order_id, lost)
+        return repairs
+
+    def drain_recon(self) -> list[tuple[str, str, int]]:
+        """Take (and clear) the pending durability-gap ledger entries."""
+        out = self.pending_recon
+        self.pending_recon = []
+        return out
 
     # -- read-only views ---------------------------------------------------
 

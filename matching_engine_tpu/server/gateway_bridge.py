@@ -102,7 +102,10 @@ class GatewayBridge:
         # (e.g. the drain thread mid-compile on a new batch shape): leak the
         # native object rather than free memory under a live thread — the
         # same policy as NativeRingDispatcher.close.
-        stragglers = [t for t in [self._drain_thread, *streams] if t.is_alive()]
+        stragglers = [
+            t for t in [self._drain_thread, *self._workers, *streams]
+            if t.is_alive()
+        ]
         if stragglers:
             print(f"[gw-bridge] {len(stragglers)} thread(s) busy at close; "
                   f"leaking native gateway")

@@ -118,10 +118,16 @@ def build_server(
         sink = me_native.NativeStorageSink(db_path)
     else:
         sink = AsyncStorageSink(storage)
+    # Order-preserving overflow buffer: a full sink queue defers batches
+    # instead of dropping them; the checkpoint flush barrier drains it.
+    from matching_engine_tpu.storage.async_sink import SpillingSink
+
+    sink = SpillingSink(sink, metrics)
     checkpointer = None
     if checkpoint_dir:
         checkpointer = CheckpointDaemon(
-            runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s
+            runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s,
+            storage=storage,
         ).start()
     hub = StreamHub()
     if use_native:

@@ -58,6 +58,19 @@ CREATE TABLE IF NOT EXISTS fills (
     ts                INTEGER NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_fills_order ON fills (order_id);
+-- Durability-gap ledger: explicit, quantified acknowledgements of data the
+-- durable log is known to be missing (fill records lost to kernel
+-- max_fills overflow, zombie rows closed after a spill overflow). The
+-- audit (scripts/audit.py) uses these to keep EXACT per-order arithmetic
+-- across an acknowledged loss; unexplained mismatches stay violations.
+CREATE TABLE IF NOT EXISTS recon (
+    recon_id   INTEGER PRIMARY KEY AUTOINCREMENT,
+    order_id   TEXT NOT NULL,
+    kind       TEXT NOT NULL,
+    lost_quantity INTEGER NOT NULL,
+    ts         INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_recon_order ON recon (order_id);
 """
 
 
@@ -214,6 +227,35 @@ class Storage:
             return False
 
     # -- reads -------------------------------------------------------------
+
+    def apply_repairs(self, repairs: list[tuple],
+                      recon: list[tuple[str, str, int]]) -> bool:
+        """One transaction applying checkpoint-time durability repairs.
+
+        repairs: (order_id, remaining, status, lost_qty) — adopt the device
+        book's remaining/status for orders whose fill records were lost.
+        recon:   (order_id, kind, lost_qty) ledger rows (see _SCHEMA).
+        """
+        if not repairs and not recon:
+            return True
+        ts = _now_us()
+        try:
+            with self._lock, self._conn:
+                for (order_id, remaining, status, _lost) in repairs:
+                    self._conn.execute(
+                        "UPDATE orders SET status = ?, remaining_quantity = ?, "
+                        "updated_ts = ? WHERE order_id = ?",
+                        (status, remaining, ts, order_id),
+                    )
+                self._conn.executemany(
+                    "INSERT INTO recon (order_id, kind, lost_quantity, ts) "
+                    "VALUES (?,?,?,?)",
+                    [(oid, kind, lost, ts) for (oid, kind, lost) in recon],
+                )
+            return True
+        except Exception as e:  # noqa: BLE001 — never-throw surface
+            print(f"[storage] apply_repairs failed: {e}")
+            return False
 
     def get_order(self, order_id: str):
         try:

@@ -240,7 +240,8 @@ class CheckpointDaemon:
     module docstring).
     """
 
-    def __init__(self, runner, sink, root: str, interval_s: float = 30.0, keep: int = 3):
+    def __init__(self, runner, sink, root: str, interval_s: float = 30.0,
+                 keep: int = 3, storage=None):
         import threading
 
         self.runner = runner
@@ -248,6 +249,14 @@ class CheckpointDaemon:
         self.root = root
         self.interval_s = interval_s
         self.keep = keep
+        self.storage = storage  # enables checkpoint-time durability repairs
+        self._overflows_seen = 0
+        # Repairs/ledger rows that failed to persist (e.g. SQLITE_BUSY):
+        # carried to the next checkpoint rather than lost — the host
+        # directory was already mutated, so dropping them would leave
+        # SQLite diverged with no acknowledgement.
+        self._carry_repairs: list[tuple] = []
+        self._carry_recon: list[tuple] = []
         # Resume numbering past any checkpoints a previous process left, so
         # _prune's name-sort never deletes a fresh snapshot as "oldest".
         self.saved = 1 + max(
@@ -279,10 +288,38 @@ class CheckpointDaemon:
         # on restore).
         with self.runner._dispatch_lock:
             self.sink.flush()
+            self._reconcile_durability_locked()
             save_checkpoint(path, self.runner)
         self.saved += 1
         self._prune()
         return path
+
+    def _reconcile_durability_locked(self) -> None:
+        """Repair SQLite from the (authoritative) device book when fill
+        records were lost to kernel max_fills overflow (VERDICT r2 weak #7).
+        Runs under the dispatch lock, after the flush barrier, BEFORE the
+        snapshot — so the snapshot captures the repaired directory and the
+        recon ledger explains the missing fill rows to scripts/audit.py."""
+        if self.storage is None:
+            return
+        overflows = self.runner.metrics.snapshot()[0].get(
+            "fill_buffer_overflows", 0)
+        repairs = self._carry_repairs
+        recon = self._carry_recon
+        self._carry_repairs, self._carry_recon = [], []
+        if overflows > self._overflows_seen:
+            self._overflows_seen = overflows
+            repairs = repairs + self.runner.reconcile_fill_overflow()
+        recon = recon + self.runner.drain_recon()
+        if repairs or recon:
+            if self.storage.apply_repairs(repairs, recon):
+                print(f"[checkpoint] durability repair: {len(repairs)} "
+                      f"orders, {len(recon)} recon rows")
+            else:
+                self._carry_repairs = repairs
+                self._carry_recon = recon
+                print(f"[checkpoint] durability repair failed; carrying "
+                      f"{len(repairs)}/{len(recon)} rows to next checkpoint")
 
     def _prune(self):
         cks = self._existing()

@@ -60,6 +60,11 @@ def measure_device_throughput(
         rates.append(real_ops / dt)
         lats.append(dt / iters * 1e6)
 
-    post_rates = sorted(rates[1:])
-    post_lats = sorted(lats[1:])
-    return post_rates[len(post_rates) // 2], post_lats[len(post_lats) // 2]
+    # Report BOTH stats from the same (median-by-rate) window: sorting the
+    # two lists independently can pair a fast window's rate with a slow
+    # window's latency when inter-window variance is high (observed on the
+    # axon tunnel: adjacent windows 3x apart), yielding a self-inconsistent
+    # (rate, latency) pair — rate * latency must equal ops-per-step.
+    pairs = sorted(zip(rates[1:], lats[1:]))
+    mid_rate, mid_lat = pairs[len(pairs) // 2]
+    return mid_rate, mid_lat

@@ -20,9 +20,14 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "gen/matching_engine.pb.h"
@@ -167,7 +172,8 @@ int unary_call(const std::string& addr, const std::string& path,
           uint8_t pad = p[0];
           p += 1;
           n -= 1;
-          if (pad <= n) n -= pad;
+          if (pad > n) break;  // malformed padding: drop the frame
+          n -= pad;
         }
         if (fh.flags & h2::FLAG_PRIORITY) {
           if (n < 5) break;
@@ -220,7 +226,8 @@ int unary_call(const std::string& addr, const std::string& path,
           uint8_t pad = p[0];
           p += 1;
           n -= 1;
-          if (pad <= n) n -= pad;
+          if (pad > n) break;  // malformed padding: drop the frame
+          n -= pad;
         }
         body.append(reinterpret_cast<const char*>(p), n);
         if (fh.flags & h2::FLAG_END_STREAM) stream_done = true;
@@ -247,6 +254,306 @@ int unary_call(const std::string& addr, const std::string& path,
     if (body.size() >= 5 + mlen) *response_payload = body.substr(5, mlen);
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// bench mode: persistent-connection load generator
+// ---------------------------------------------------------------------------
+//
+// `me_client bench <addr> <clients> <per_client> [symbols]` — N worker
+// threads, each holding ONE HTTP/2 connection and issuing sequential unary
+// SubmitOrder calls on ascending stream ids; prints a single JSON line with
+// sustained orders/sec and p50/p99 latency. This is the native counterpart
+// of benchmarks/run_all.py config 4's Python thread workers: a GIL-free
+// load source so an e2e comparison measures the SERVER edge, not the
+// client.
+class BenchConn {
+ public:
+  bool open(const std::string& addr) {
+    authority_ = addr;
+    fd_ = dial(addr);
+    if (fd_ < 0) return false;
+    std::string out(h2::kPreface, h2::kPrefaceLen);
+    h2::write_frame_header(h2::F_SETTINGS, 0, 0, 0, &out);
+    return send_all(fd_, out);
+  }
+
+  ~BenchConn() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  // Sends one unary request on a fresh stream id (non-blocking wrt the
+  // response); returns the stream id, or 0 on transport failure. Multiple
+  // streams may be in flight — HTTP/2 multiplexing is the whole point.
+  uint32_t issue(const std::string& path, const std::string& request_bytes) {
+    uint32_t sid = next_stream_;
+    next_stream_ += 2;
+    std::string out;
+    std::string block;
+    h2::hpack_encode(":method", "POST", &block);
+    h2::hpack_encode(":scheme", "http", &block);
+    h2::hpack_encode(":path", path, &block);
+    h2::hpack_encode(":authority", authority_, &block);  // grpc servers require it
+    h2::hpack_encode("te", "trailers", &block);
+    h2::hpack_encode("content-type", "application/grpc", &block);
+    h2::write_frame_header(h2::F_HEADERS, h2::FLAG_END_HEADERS, sid,
+                           block.size(), &out);
+    out += block;
+    std::string data;
+    h2::grpc_frame(request_bytes, &data);
+    h2::write_frame_header(h2::F_DATA, h2::FLAG_END_STREAM, sid, data.size(),
+                           &out);
+    out += data;
+    if (!send_all(fd_, out)) return 0;
+    inflight_.emplace(sid, StreamState{});
+    return sid;
+  }
+
+  struct Completion {
+    uint32_t sid = 0;
+    int grpc_status = -1;
+    std::string payload;
+  };
+
+  // Blocks until any in-flight stream completes. Returns false on
+  // transport failure.
+  bool reap(Completion* out) {
+    std::vector<uint8_t> payload;
+    for (;;) {
+      uint8_t raw[9];
+      if (!read_exact(fd_, raw, 9)) return false;
+      h2::FrameHeader fh = h2::parse_frame_header(raw);
+      if (fh.length > (1u << 24)) return false;
+      payload.resize(fh.length);
+      if (fh.length && !read_exact(fd_, payload.data(), fh.length)) return false;
+      switch (fh.type) {
+        case h2::F_SETTINGS:
+          if (!(fh.flags & h2::FLAG_ACK)) {
+            std::string ack;
+            h2::write_frame_header(h2::F_SETTINGS, h2::FLAG_ACK, 0, 0, &ack);
+            if (!send_all(fd_, ack)) return false;
+          }
+          break;
+        case h2::F_PING:
+          if (!(fh.flags & h2::FLAG_ACK) && fh.length == 8) {
+            std::string pong;
+            h2::write_frame_header(h2::F_PING, h2::FLAG_ACK, 0, 8, &pong);
+            pong.append(reinterpret_cast<char*>(payload.data()), 8);
+            if (!send_all(fd_, pong)) return false;
+          }
+          break;
+        case h2::F_HEADERS:
+        case h2::F_CONTINUATION: {
+          const uint8_t* p = payload.data();
+          size_t n = payload.size();
+          if (fh.type == h2::F_HEADERS) {
+            if (fh.flags & h2::FLAG_PADDED) {
+              if (n < 1) return false;
+              uint8_t pad = p[0];
+              p += 1;
+              n -= 1;
+              if (pad > n) return false;
+              n -= pad;
+            }
+            if (fh.flags & h2::FLAG_PRIORITY) {
+              if (n < 5) return false;
+              p += 5;
+              n -= 5;
+            }
+          }
+          header_block_.append(reinterpret_cast<const char*>(p), n);
+          if (fh.flags & h2::FLAG_END_HEADERS) {
+            std::vector<h2::Header> hs;
+            if (!hpack_.decode(
+                    reinterpret_cast<const uint8_t*>(header_block_.data()),
+                    header_block_.size(), &hs)) {
+              return false;
+            }
+            header_block_.clear();
+            auto it = inflight_.find(fh.stream_id);
+            if (it != inflight_.end()) {
+              for (auto& h : hs) {
+                if (h.name == "grpc-status")
+                  it->second.grpc_status = std::atoi(h.value.c_str());
+              }
+              if (fh.flags & h2::FLAG_END_STREAM) {
+                fill_completion(it, out);
+                return true;
+              }
+            }
+          }
+          break;
+        }
+        case h2::F_DATA: {
+          const uint8_t* p = payload.data();
+          size_t n = payload.size();
+          if (fh.flags & h2::FLAG_PADDED) {
+            if (n < 1) return false;
+            uint8_t pad = p[0];
+            p += 1;
+            n -= 1;
+            if (pad > n) return false;
+            n -= pad;
+          }
+          auto it = inflight_.find(fh.stream_id);
+          if (it != inflight_.end()) {
+            it->second.body.append(reinterpret_cast<const char*>(p), n);
+            if (fh.flags & h2::FLAG_END_STREAM) {
+              fill_completion(it, out);
+              return true;
+            }
+          }
+          break;
+        }
+        case h2::F_RST_STREAM:
+        case h2::F_GOAWAY:
+          return false;
+        default:
+          break;
+      }
+    }
+  }
+
+  size_t inflight() const { return inflight_.size(); }
+
+ private:
+  struct StreamState {
+    std::string body;
+    int grpc_status = -1;
+  };
+
+  void fill_completion(std::unordered_map<uint32_t, StreamState>::iterator it,
+                       Completion* out) {
+    out->sid = it->first;
+    out->grpc_status = it->second.grpc_status;
+    const std::string& body = it->second.body;
+    if (body.size() >= 5) {
+      uint32_t mlen = (static_cast<uint8_t>(body[1]) << 24) |
+                      (static_cast<uint8_t>(body[2]) << 16) |
+                      (static_cast<uint8_t>(body[3]) << 8) |
+                      static_cast<uint8_t>(body[4]);
+      if (body.size() >= 5 + mlen) out->payload = body.substr(5, mlen);
+    }
+    inflight_.erase(it);
+  }
+
+  int fd_ = -1;
+  uint32_t next_stream_ = 1;
+  std::string authority_;
+  std::string header_block_;
+  h2::HpackDecoder hpack_;
+  std::unordered_map<uint32_t, StreamState> inflight_;
+};
+
+int do_bench(const std::string& addr, int clients, int per_client,
+             int symbols, int inflight) {
+  const std::string path = "/matching_engine.v1.MatchingEngine/SubmitOrder";
+  std::vector<std::vector<double>> lat(clients);
+  std::vector<int> ok_count(clients, 0), rejected(clients, 0);
+  std::atomic<int> transport_errors{0};
+
+  // Warm the server's jit before timing.
+  {
+    BenchConn warm;
+    if (!warm.open(addr)) {
+      std::fprintf(stderr, "[bench] connect failed\n");
+      return 2;
+    }
+    pb::OrderRequest req;
+    req.set_client_id("warm");
+    req.set_symbol("S0");
+    req.set_side(pb::BUY);
+    req.set_order_type(pb::LIMIT);
+    req.set_price(1);
+    req.set_scale(0);
+    req.set_quantity(1);
+    std::string bytes;
+    req.SerializeToString(&bytes);
+    BenchConn::Completion c;
+    if (!warm.issue(path, bytes) || !warm.reap(&c)) {
+      std::fprintf(stderr, "[bench] warm call failed\n");
+      return 2;
+    }
+  }
+
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<std::thread> threads;
+  for (int w = 0; w < clients; ++w) {
+    threads.emplace_back([&, w] {
+      BenchConn conn;
+      if (!conn.open(addr)) {
+        transport_errors.fetch_add(per_client);
+        return;
+      }
+      unsigned seed = 0x9e3779b9u * static_cast<unsigned>(w + 1);
+      lat[w].reserve(per_client);
+      std::unordered_map<uint32_t, std::chrono::steady_clock::time_point> t0s;
+      int sent = 0;
+      while (sent < per_client || !t0s.empty()) {
+        // Keep up to `inflight` streams open on this connection.
+        while (sent < per_client &&
+               static_cast<int>(t0s.size()) < inflight) {
+          pb::OrderRequest req;
+          req.set_client_id("b" + std::to_string(w));
+          req.set_symbol("S" + std::to_string(rand_r(&seed) % symbols));
+          req.set_side((rand_r(&seed) & 1) ? pb::BUY : pb::SELL);
+          req.set_order_type(pb::LIMIT);
+          req.set_price(10000 + static_cast<int>(rand_r(&seed) % 40) - 20);
+          req.set_scale(4);
+          req.set_quantity(1 + static_cast<int>(rand_r(&seed) % 49));
+          std::string bytes;
+          req.SerializeToString(&bytes);
+          uint32_t sid = conn.issue(path, bytes);
+          if (sid == 0) {
+            transport_errors.fetch_add(per_client - sent);
+            return;
+          }
+          t0s[sid] = std::chrono::steady_clock::now();
+          ++sent;
+        }
+        BenchConn::Completion c;
+        if (!conn.reap(&c)) {
+          transport_errors.fetch_add(static_cast<int>(t0s.size()) +
+                                     per_client - sent);
+          return;
+        }
+        auto it = t0s.find(c.sid);
+        if (it == t0s.end()) continue;
+        lat[w].push_back(std::chrono::duration<double>(
+            std::chrono::steady_clock::now() - it->second).count());
+        t0s.erase(it);
+        pb::OrderResponse resp;
+        if (c.grpc_status == 0 && resp.ParseFromString(c.payload) &&
+            resp.success()) {
+          ++ok_count[w];
+        } else {
+          ++rejected[w];
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  double dt = std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                            t0).count();
+
+  std::vector<double> all;
+  int ok = 0, rej = 0;
+  for (int w = 0; w < clients; ++w) {
+    all.insert(all.end(), lat[w].begin(), lat[w].end());
+    ok += ok_count[w];
+    rej += rejected[w];
+  }
+  std::sort(all.begin(), all.end());
+  double p50 = all.empty() ? 0 : all[all.size() / 2] * 1e3;
+  double p99 = all.empty() ? 0 : all[static_cast<size_t>(all.size() * 0.99)] * 1e3;
+  std::printf(
+      "{\"metric\": \"native_client_e2e\", \"value\": %.1f, "
+      "\"unit\": \"orders/sec\", \"clients\": %d, \"per_client\": %d, "
+      "\"inflight\": %d, \"ok\": %d, \"rejected\": %d, "
+      "\"transport_errors\": %d, \"p50_ms\": %.2f, \"p99_ms\": %.2f}\n",
+      all.size() / dt, clients, per_client, inflight, ok, rej,
+      transport_errors.load(), p50, p99);
+  return transport_errors.load() ? 2 : 0;
 }
 
 int do_cancel(const std::string& addr, const std::string& client_id,
@@ -286,6 +593,11 @@ int main(int argc, char** argv) {
   GOOGLE_PROTOBUF_VERIFY_VERSION;
   if (argc == 5 && std::strcmp(argv[1], "cancel") == 0) {
     return do_cancel(argv[2], argv[3], argv[4]);
+  }
+  if ((argc >= 5 && argc <= 7) && std::strcmp(argv[1], "bench") == 0) {
+    return do_bench(argv[2], std::atoi(argv[3]), std::atoi(argv[4]),
+                    argc >= 6 ? std::atoi(argv[5]) : 64,
+                    argc >= 7 ? std::atoi(argv[6]) : 1);
   }
   if (argc != 9) {
     std::fprintf(stderr, "%s\n", kUsage);

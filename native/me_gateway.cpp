@@ -313,9 +313,14 @@ class Conn : public std::enable_shared_from_this<Conn> {
     return it->second;
   }
 
-  // Sends `data` as DATA frames honoring conn+stream windows. Fails after
-  // 15s without window (slow/stalled consumer) — callers treat it as a
-  // dead stream.
+  // Sends `data` as DATA frames honoring conn+stream windows. Responses
+  // are tiny against the 64KB default window, so the fast path never
+  // waits; a client that grants no window for 3s while responses pend is
+  // effectively dead and gets the connection closed — the wait is bounded
+  // SHORT because completions run on the shared bridge drain thread, and
+  // one stalled client must not head-of-line-block every other
+  // connection's completions (nor, on the reader-thread reject path,
+  // deadlock against the thread that would process its WINDOW_UPDATE).
   bool send_data(uint32_t sid, const std::string& data) {
     size_t off = 0;
     while (off < data.size()) {
@@ -324,7 +329,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
       {
         std::unique_lock<std::mutex> lk(fc_mu_);
         auto deadline =
-            std::chrono::steady_clock::now() + std::chrono::seconds(15);
+            std::chrono::steady_clock::now() + std::chrono::seconds(3);
         for (;;) {
           if (dead()) return false;
           int64_t avail = std::min<int64_t>(conn_send_wnd_,
@@ -336,6 +341,8 @@ class Conn : public std::enable_shared_from_this<Conn> {
             break;
           }
           if (fc_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+            lk.unlock();
+            hard_close();  // window-starved peer: fail fast, free the thread
             return false;
           }
         }

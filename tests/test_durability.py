@@ -1,0 +1,203 @@
+"""Durability-gap closure tests (VERDICT r2 weak #7 / round-1 task #5).
+
+Two loss paths used to leave SQLite silently behind the device book:
+  1. a full sink queue dropped whole storage batches (dispatcher submit
+     with block=False) — now deferred through SpillingSink and drained at
+     the flush barrier;
+  2. kernel fill-record overflow (max_fills) dropped fill rows and maker
+     updates — now detected at decode (taker side), repaired from the
+     device book at checkpoint time (maker side), and acknowledged in the
+     `recon` ledger that scripts/audit.py folds into exact arithmetic.
+"""
+
+import sys
+
+import grpc
+import pytest
+
+sys.path.insert(0, "scripts")
+from audit import audit  # noqa: E402
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.storage import Storage
+from matching_engine_tpu.storage.async_sink import AsyncStorageSink, SpillingSink
+
+
+class RecordingSink:
+    """Scripted inner sink: refuses while `accept` is False, records batch
+    arrival order otherwise."""
+
+    def __init__(self):
+        self.accept = True
+        self.batches = []
+        self.flushes = 0
+
+    def submit(self, orders=None, updates=None, fills=None, block=True):
+        if not self.accept and not block:
+            return False
+        self.batches.append((list(orders or []), list(updates or []),
+                             list(fills or [])))
+        return True
+
+    def flush(self):
+        self.flushes += 1
+
+    def close(self):
+        pass
+
+
+def _batch(i):
+    return dict(orders=[(f"OID-{i}", "c", "S", 1, 0, 1, 1, 1, 0)],
+                updates=[], fills=[])
+
+
+def test_spilling_sink_defers_and_preserves_order():
+    inner = RecordingSink()
+    sink = SpillingSink(inner)
+    assert sink.submit(**_batch(1), block=False)
+    inner.accept = False
+    # These would have been DROPPED pre-spill; now they defer.
+    for i in (2, 3, 4):
+        assert sink.submit(**_batch(i), block=False)
+    assert sink.spilled == 3 and sink.lost == 0
+    assert [b[0][0][0] for b in inner.batches] == ["OID-1"]
+    inner.accept = True
+    # The next submit drains the spill FIRST — FIFO across the boundary.
+    assert sink.submit(**_batch(5), block=False)
+    assert [b[0][0][0] for b in inner.batches] == [
+        "OID-1", "OID-2", "OID-3", "OID-4", "OID-5"]
+
+
+def test_spilling_sink_flush_drains_blocking():
+    inner = RecordingSink()
+    sink = SpillingSink(inner)
+    inner.accept = False
+    sink.submit(**_batch(1), block=False)
+    sink.submit(**_batch(2), block=False)
+    inner.accept = True
+    sink.flush()
+    assert [b[0][0][0] for b in inner.batches] == ["OID-1", "OID-2"]
+    assert inner.flushes == 1
+
+
+def test_spilling_sink_bounded_loss():
+    inner = RecordingSink()
+    sink = SpillingSink(inner, max_spill=2)
+    inner.accept = False
+    assert sink.submit(**_batch(1), block=False)
+    assert sink.submit(**_batch(2), block=False)
+    assert not sink.submit(**_batch(3), block=False)  # true loss, counted
+    assert sink.lost == 1 and sink.dropped == 1
+
+
+def test_stalled_storage_spills_then_recovers(tmp_path):
+    """Real path: SQLite writer wedged -> queue fills -> spill -> barrier
+    drains -> audit-clean database with every batch present."""
+    db = str(tmp_path / "stall.db")
+    storage = Storage(db)
+    assert storage.init()
+    inner = AsyncStorageSink(storage, max_queue=1)
+    sink = SpillingSink(inner, max_spill=64)
+    # Wedge the writer: hold the storage lock so apply_batch blocks.
+    storage._lock.acquire()
+    try:
+        accepted = 0
+        for i in range(1, 9):
+            assert sink.submit(**_batch(i), block=False)
+            accepted += 1
+        assert sink.spilled > 0  # the queue really did fill
+    finally:
+        storage._lock.release()
+    sink.flush()
+    check = Storage(db)
+    assert check.count("orders") == 8
+    assert audit(db) == []
+    sink.close()
+    storage.close()
+    check.close()
+
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=16, max_fills=2)
+
+
+def submit(stub, client, symbol, side, price, qty):
+    return stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol=symbol, order_type=pb2.LIMIT,
+                         side=side, price=price, scale=4, quantity=qty),
+        timeout=10,
+    )
+
+
+@pytest.fixture
+def overflow_stack(tmp_path):
+    db = str(tmp_path / "ovf.db")
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, CFG, window_ms=1.0, log=False,
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_interval_s=3600,
+    )
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield db, MatchingEngineStub(channel), parts, tmp_path
+    channel.close()
+    shutdown(server, parts)
+
+
+def test_fill_overflow_repaired_at_checkpoint(overflow_stack):
+    db, stub, parts, tmp_path = overflow_stack
+    runner = parts["runner"]
+    # 8 resting makers, then one taker sweeping all 8 in a single dispatch:
+    # 8 fill records against max_fills=2 -> 6 records lost on device.
+    makers = [submit(stub, f"m{i}", "OVF", pb2.SELL, 10_000, 1).order_id
+              for i in range(8)]
+    taker = submit(stub, "t", "OVF", pb2.BUY, 10_000, 8)
+    assert taker.success
+    counters = runner.metrics.snapshot()[0]
+    assert counters.get("fill_buffer_overflows", 0) >= 1
+    assert runner.pending_recon  # taker-side loss detected at decode
+
+    # Before the repair, SQLite is inconsistent (missing fills + stale
+    # makers); the checkpoint repairs and acknowledges the gap.
+    parts["checkpointer"].checkpoint_now()
+    parts["sink"].flush()
+    assert audit(db) == []
+
+    st = Storage(db)
+    taker_row = st.get_order(taker.order_id)
+    assert taker_row[7] == 0 and taker_row[8] == 2  # FILLED, remaining 0
+    maker_rows = [st.get_order(m) for m in makers]
+    assert all(r[7] == 0 and r[8] == 2 for r in maker_rows)
+    # The ledger quantifies exactly the six lost records (both sides).
+    import sqlite3
+    conn = sqlite3.connect(db)
+    taker_lost = conn.execute(
+        "SELECT SUM(lost_quantity) FROM recon WHERE order_id = ?",
+        (taker.order_id,)).fetchone()[0]
+    assert taker_lost == 8 - st.count("fills")
+    conn.close()
+    st.close()
+    # Host directory evicted the silently-consumed makers.
+    assert not runner.orders_by_id
+
+
+def test_fill_overflow_survives_restart(overflow_stack):
+    db, stub, parts, tmp_path = overflow_stack
+    for i in range(8):
+        submit(stub, f"m{i}", "RST", pb2.SELL, 10_000, 1)
+    assert submit(stub, "t", "RST", pb2.BUY, 10_000, 8).success
+    parts["checkpointer"].checkpoint_now()
+
+    server2, port2, parts2 = build_server(
+        "127.0.0.1:0", db, CFG, window_ms=1.0, log=False,
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_interval_s=3600,
+    )
+    server2.start()
+    try:
+        # Restored book must hold nothing: everything matched out.
+        assert not parts2["runner"].orders_by_id
+        parts2["sink"].flush()
+        assert audit(db) == []
+    finally:
+        shutdown(server2, parts2)

@@ -265,8 +265,12 @@ def test_server_native_runtime_end_to_end(tmp_path):
     server, port, parts = build_server(
         "127.0.0.1:0", db, cfg, window_ms=1.0, log=False, native=True
     )
+    from matching_engine_tpu.storage.async_sink import SpillingSink
+
     assert isinstance(parts["dispatcher"], NativeRingDispatcher)
-    assert isinstance(parts["sink"], me_native.NativeStorageSink)
+    # The native sink now sits behind the order-preserving spill buffer.
+    assert isinstance(parts["sink"], SpillingSink)
+    assert isinstance(parts["sink"]._inner, me_native.NativeStorageSink)
     server.start()
     try:
         channel = grpc.insecure_channel(f"127.0.0.1:{port}")
