@@ -85,8 +85,12 @@ def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch
     return out
 
 
-def decode_results(batch: OrderBatch, status, filled, remaining) -> list[HostResult]:
-    """Per-order outcomes for the real (non-padding) rows of one dispatch."""
+def decode_results(batch: OrderBatch, status, filled, remaining,
+                   sym_offset: int = 0) -> list[HostResult]:
+    """Per-order outcomes for the real (non-padding) rows of one dispatch.
+
+    `sym_offset` globalizes symbol indices when `batch` is a process-local
+    row block of a sharded dispatch (parallel/hostlocal.py)."""
     status = np.asarray(status)
     filled = np.asarray(filled)
     remaining = np.asarray(remaining)
@@ -101,7 +105,7 @@ def decode_results(batch: OrderBatch, status, filled, remaining) -> list[HostRes
         HostResult(*t)
         for t in zip(
             oid[sym_idx, row_idx].tolist(),
-            sym_idx.tolist(),
+            (sym_idx + sym_offset).tolist(),
             status[sym_idx, row_idx].tolist(),
             filled[sym_idx, row_idx].tolist(),
             remaining[sym_idx, row_idx].tolist(),

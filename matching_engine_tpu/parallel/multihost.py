@@ -22,6 +22,16 @@ Deployment model (matching the symbol-sharded design in sharding.py):
 Single-process multi-device (the test/dev case, and the driver's virtual
 8-device CPU mesh) uses the same code path: `initialize()` no-ops, the mesh
 covers the local devices, and `local_symbol_slice()` returns the full range.
+
+Independence note: the engine step contains NO collectives (books never
+interact), so hosts drain their dispatch queues at their own pace — no
+cross-host lockstep. Only `all_top_of_book` and any future cross-symbol
+collective require every process to participate in the same call.
+Order-id scope: each host's runner issues "OID-<n>" within its own gateway
+and SQLite (symbols are routed home), so ids are unique per home host;
+an aggregator joining multiple hosts' stores must namespace by host.
+Proven end to end by tests/test_multiprocess.py (two real processes,
+localhost coordinator, 4+4 virtual CPU devices).
 """
 
 from __future__ import annotations
@@ -33,6 +43,32 @@ from jax.sharding import Mesh
 from matching_engine_tpu.parallel.sharding import AXIS
 
 
+def _cluster_detected(env) -> bool:
+    """True when a standard launcher exposes a MULTI-process world this
+    process is a rank of — the signals jax.distributed's cluster plugins
+    resolve. Presence of a batch allocation alone (e.g. an interactive
+    `salloc` shell, SLURM_JOB_ID set but no task rank) is NOT a cluster:
+    auto-initializing there would block boot waiting for ranks that never
+    connect. ME_NO_AUTO_DISTRIBUTED=1 disables detection entirely."""
+    if env.get("ME_NO_AUTO_DISTRIBUTED"):
+        return False
+    if any(v in env for v in (
+        "JAX_COORDINATOR_ADDRESS",   # jax's own env bootstrap
+        "COORDINATOR_ADDRESS",       # common wrapper convention
+        "MEGASCALE_COORDINATOR_ADDRESS",  # multislice
+    )):
+        return True
+    try:
+        if int(env.get("SLURM_NTASKS", "1")) > 1 and "SLURM_PROCID" in env:
+            return True  # srun-launched rank of a >1-task step
+        if int(env.get("OMPI_COMM_WORLD_SIZE", "1")) > 1:
+            return True  # mpirun-launched rank
+    except ValueError:
+        pass
+    # Cloud TPU pod: the worker metadata lists every host.
+    return len(env.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
+
+
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -40,22 +76,42 @@ def initialize(
 ) -> bool:
     """Bootstrap the JAX distributed runtime; returns True if initialized.
 
-    No-ops (returns False) when single-process: coordinator unset and the
-    environment carries no cluster autodetection hints. Safe to call
-    unconditionally at server start.
+    Explicit args always initialize. Otherwise a detected multi-process
+    launcher world (srun task ranks, mpirun ranks, Cloud TPU pods,
+    megascale — plus JAX_COORDINATOR_ADDRESS-style env bootstrap, see
+    _cluster_detected) triggers a no-arg initialize(), which resolves
+    coordinator/rank from jax's cluster plugins. Single-process runs with
+    none of those markers no-op (returns False); ME_NO_AUTO_DISTRIBUTED=1
+    force-disables detection. Safe to call unconditionally at server
+    start; a second call (already-initialized) also no-ops.
     """
     import os
 
     explicit = (coordinator_address, num_processes, process_id) != (None, None, None)
-    if not explicit and not any(
-        v in os.environ for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
-    ):
+    if not explicit and not _cluster_detected(os.environ):
         return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return True  # already initialized
+    except ImportError:
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        if explicit:
+            raise
+        # A hint fired but the cluster plugin could not resolve a
+        # coordinator (e.g. single-host dev boxes carrying TPU env vars):
+        # stay single-process rather than dying at boot.
+        print(f"[multihost] cluster hint present but initialize failed "
+              f"({e}); continuing single-process")
+        return False
     return True
 
 

@@ -40,6 +40,7 @@ from matching_engine_tpu.engine.book import (
 )
 from matching_engine_tpu.engine.harness import HostFill, HostResult, decode_results
 from matching_engine_tpu.engine.kernel import engine_step_impl
+from matching_engine_tpu.parallel import hostlocal
 
 AXIS = "sym"
 
@@ -183,34 +184,71 @@ class ShardedEngine:
         self.all_top_of_book = jax.jit(gather_tob)
 
     def init_book(self) -> BookBatch:
-        return jax.device_put(init_book(self.cfg), self.book_sharding)
+        if jax.process_count() == 1:
+            return jax.device_put(init_book(self.cfg), self.book_sharding)
+        # Multi-process: every host holds the same full-shape init value;
+        # make_array assembles the global array from local shards.
+        host = init_book(self.cfg)
+        return jax.tree.map(
+            lambda arr, sh: hostlocal.make_global(arr, sh),
+            host, self.book_sharding,
+        )
 
     def place_orders(self, orders: OrderBatch) -> OrderBatch:
-        return jax.device_put(orders, self.order_sharding)
+        if jax.process_count() == 1:
+            # Hot path (once per dispatch): plain placement.
+            return jax.device_put(orders, self.order_sharding)
+        # Multi-process: each host contributes its addressable symbol rows
+        # (remote rows are OP_NOOP padding in this host's batch — the real
+        # ops for those symbols come from their home host's batch).
+        return jax.tree.map(
+            lambda arr, sh: hostlocal.make_global(arr, sh),
+            orders, self.order_sharding,
+        )
 
     def decode(
         self, batch: OrderBatch, out: ShardedStepOutput
     ) -> tuple[list[HostResult], list[HostFill], bool]:
-        """Decode per-order results + the per-shard fill segments."""
+        """Decode per-order results + per-shard fill segments — reading ONLY
+        this process's addressable shards, so the same code serves single-
+        controller and multi-host deployments (each host decodes exactly the
+        symbols it owns; remote symbols are decoded by their home host)."""
         import numpy as np
 
-        results = decode_results(batch, out.status, out.filled, out.remaining)
+        # Results: the local [lo, hi) symbol rows.
+        status, lo, hi = hostlocal.local_block(out.status)
+        filled = hostlocal.local_rows(out.filled, lo, hi)
+        remaining = hostlocal.local_rows(out.remaining, lo, hi)
+        local_batch = OrderBatch(*(np.asarray(a)[lo:hi] for a in batch))
+        results = decode_results(
+            local_batch, status, filled, remaining, sym_offset=lo
+        )
 
-        # Slice each shard's valid segment on device, then transfer — the
-        # device->host cost is O(actual fills), not O(n_shards * max_fills).
-        counts = np.asarray(out.fill_count)
+        # Fills: slice each ADDRESSABLE shard's valid segment on its own
+        # device, then transfer — O(actual local fills), never a global read.
         per = self.cfg.max_fills
+        count_by_shard = {
+            (s.index[0].start or 0): int(np.asarray(s.data)[0])
+            for s in out.fill_count.addressable_shards
+        }
+        fill_shards = {
+            name: {
+                (s.index[0].start or 0) // per: s.data
+                for s in getattr(out, name).addressable_shards
+            }
+            for name in ("fill_sym", "fill_taker_oid", "fill_maker_oid",
+                         "fill_price", "fill_qty")
+        }
         fills = []
-        for shard in range(self.n_shards):
-            base = shard * per
-            n = int(counts[shard])
+        for shard in sorted(count_by_shard):
+            n = count_by_shard[shard]
             if n == 0:
                 continue
-            f_sym = np.asarray(out.fill_sym[base:base + n])
-            f_taker = np.asarray(out.fill_taker_oid[base:base + n])
-            f_maker = np.asarray(out.fill_maker_oid[base:base + n])
-            f_price = np.asarray(out.fill_price[base:base + n])
-            f_qty = np.asarray(out.fill_qty[base:base + n])
+            f_sym = np.asarray(fill_shards["fill_sym"][shard][:n])
+            f_taker = np.asarray(fill_shards["fill_taker_oid"][shard][:n])
+            f_maker = np.asarray(fill_shards["fill_maker_oid"][shard][:n])
+            f_price = np.asarray(fill_shards["fill_price"][shard][:n])
+            f_qty = np.asarray(fill_shards["fill_qty"][shard][:n])
             for i in range(n):
                 fills.append(
                     HostFill(
@@ -221,5 +259,8 @@ class ShardedEngine:
                         quantity=int(f_qty[i]),
                     )
                 )
-        overflow = bool(np.asarray(out.fill_overflow).any())
+        overflow = any(
+            bool(np.asarray(s.data).any())
+            for s in out.fill_overflow.addressable_shards
+        )
         return results, fills, overflow

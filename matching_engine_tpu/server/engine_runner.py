@@ -118,13 +118,19 @@ class EngineRunner:
         self._id_lock = threading.Lock()  # oid/symbol assignment from RPC threads
         self._step_num = 0  # device-trace step annotation counter
         if mesh is not None:
+            from matching_engine_tpu.parallel.multihost import local_symbol_slice
             from matching_engine_tpu.parallel.sharding import ShardedEngine
 
             self._sharded = ShardedEngine(cfg, mesh)
             self.book = self._sharded.init_book()
+            # This host may only book symbols whose shard rows live on its
+            # own devices (multi-process: the gateway routes by this range).
+            sl = local_symbol_slice(mesh, cfg.num_symbols)
+            self._slot_lo, self._slot_hi = sl.start, sl.stop
         else:
             self._sharded = None
             self.book = init_book(cfg)
+            self._slot_lo, self._slot_hi = 0, cfg.num_symbols
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
         self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
@@ -141,7 +147,7 @@ class EngineRunner:
         # symbols, not lifetime-distinct ones.
         self._slot_live = [0] * cfg.num_symbols
         self._free_slots: list[int] = []
-        self._next_slot = 0
+        self._next_slot = self._slot_lo
         # Durability-gap ledger: (order_id, kind, lost_qty) tuples recorded
         # when fill RECORDS are lost (kernel max_fills overflow) while the
         # book state applied them. Drained into the durable store's `recon`
@@ -157,7 +163,16 @@ class EngineRunner:
         """Install a host-side BookBatch as the live device book, honoring
         the runner's sharding (checkpoint restore path)."""
         if self._sharded is not None:
-            self.book = jax.device_put(host_book, self._sharded.book_sharding)
+            if jax.process_count() > 1:
+                from matching_engine_tpu.parallel import hostlocal
+
+                self.book = jax.tree.map(
+                    lambda arr, sh: hostlocal.make_global(arr, sh),
+                    host_book, self._sharded.book_sharding,
+                )
+            else:
+                self.book = jax.device_put(
+                    host_book, self._sharded.book_sharding)
         else:
             self.book = jax.device_put(host_book)
 
@@ -215,7 +230,7 @@ class EngineRunner:
             return slot
         if self._free_slots:
             slot = self._free_slots.pop()
-        elif self._next_slot < self.cfg.num_symbols:
+        elif self._next_slot < self._slot_hi:
             slot = self._next_slot
             self._next_slot += 1
         else:
@@ -458,22 +473,34 @@ class EngineRunner:
         return self._update(maker, maker.status, price, qty, maker.remaining)
 
     def _market_data(self, out, touched_syms, res: DispatchResult) -> None:
-        bb = np.asarray(out.best_bid)
-        bs = np.asarray(out.bid_size)
-        ba = np.asarray(out.best_ask)
-        asz = np.asarray(out.ask_size)
+        # Top-of-book arrays may be globally sharded (mesh mode): read the
+        # process-local block only — every touched symbol is local, since
+        # this host only dispatched ops for symbols it owns.
+        from matching_engine_tpu.parallel import hostlocal
+
+        if self._sharded is not None:
+            bb, lo, _ = hostlocal.local_block(out.best_bid)
+            bs = hostlocal.local_block(out.bid_size)[0]
+            ba = hostlocal.local_block(out.best_ask)[0]
+            asz = hostlocal.local_block(out.ask_size)[0]
+        else:
+            bb = np.asarray(out.best_bid)
+            bs = np.asarray(out.bid_size)
+            ba = np.asarray(out.best_ask)
+            asz = np.asarray(out.ask_size)
+            lo = 0
         for s in touched_syms:
             sym = self.slot_symbols[s]
-            if sym is None:
+            if sym is None or not (lo <= s < lo + bb.shape[0]):
                 continue
             res.market_data.append(
                 pb2.MarketDataUpdate(
                     symbol=sym,
-                    best_bid=int(bb[s]),
-                    best_ask=int(ba[s]),
+                    best_bid=int(bb[s - lo]),
+                    best_ask=int(ba[s - lo]),
                     scale=4,
-                    bid_size=int(bs[s]),
-                    ask_size=int(asz[s]),
+                    bid_size=int(bs[s - lo]),
+                    ask_size=int(asz[s - lo]),
                 )
             )
 
@@ -499,10 +526,14 @@ class EngineRunner:
         lost_qty)] repair rows for the durable store; matching
         ("fills_lost") entries are appended to pending_recon.
         """
+        from matching_engine_tpu.parallel import hostlocal
+
         lanes: dict[int, int] = {}
         with self._snapshot_lock:
+            # Local block only: this host's directory can only reference
+            # handles resting in its own symbol rows.
             arrs = [
-                np.asarray(x)
+                hostlocal.local_block(x)[0]
                 for x in (self.book.bid_oid, self.book.bid_qty,
                           self.book.ask_oid, self.book.ask_qty)
             ]
@@ -551,8 +582,12 @@ class EngineRunner:
         if slot is None:
             return [], []
         with self._snapshot_lock:
+            # read_row touches only the shard holding this symbol's lanes —
+            # valid on a multi-process mesh, where a whole-array read isn't.
+            from matching_engine_tpu.parallel import hostlocal
+
             arrs = [
-                np.asarray(x[slot])
+                hostlocal.read_row(x, slot)
                 for x in (
                     self.book.bid_price, self.book.bid_qty, self.book.bid_oid,
                     self.book.bid_seq, self.book.ask_price, self.book.ask_qty,

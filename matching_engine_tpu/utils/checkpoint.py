@@ -56,7 +56,15 @@ def save_checkpoint(path: str, runner) -> None:
 
     Caller is responsible for quiescence (no concurrent dispatch) — use
     CheckpointDaemon or hold the runner's snapshot lock externally.
+
+    Multi-process: each host writes `path/host-<pid>/` atomically with ITS
+    addressable book rows and ITS order directory (a host only ever books
+    the symbols it owns); a whole-array read does not exist on a
+    multi-process mesh. Single-process keeps the flat layout.
     """
+    if jax.process_count() > 1:
+        _save_checkpoint_hostlocal(path, runner)
+        return
     book_host = {f: np.asarray(getattr(runner.book, f)) for f in _BOOK_FIELDS}
     # The dispatch lock (held by the caller) quiesces the book and order
     # directories, but RPC threads allocate symbols/OIDs outside it — copy
@@ -91,8 +99,89 @@ def save_checkpoint(path: str, runner) -> None:
         raise
 
 
+def _save_checkpoint_hostlocal(path: str, runner) -> None:
+    from matching_engine_tpu.parallel import hostlocal
+
+    blocks = {}
+    lo = hi = 0
+    for f in _BOOK_FIELDS:
+        data, lo, hi = hostlocal.local_block(getattr(runner.book, f))
+        blocks[f] = data
+    with runner._id_lock:
+        symbols = dict(runner.symbols)
+        next_oid_num = runner.next_oid_num
+    meta = {
+        "version": 2,
+        "ts": time.time(),
+        "cfg": dataclasses.asdict(runner.cfg),
+        "symbols": symbols,
+        "next_oid_num": next_oid_num,
+        "orders": [dataclasses.asdict(i)
+                   for i in list(runner.orders_by_handle.values())],
+        "slice": [lo, hi],
+        "process": jax.process_index(),
+        "num_processes": jax.process_count(),
+    }
+    os.makedirs(path, exist_ok=True)
+    final = os.path.join(path, f"host-{jax.process_index():04d}")
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=path)
+    try:
+        np.savez(os.path.join(tmp, "book.npz"), **blocks)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(final):
+            old = final + ".old"
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
 def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
-    """Read a checkpoint directory -> (cfg, host-side book, meta)."""
+    """Read a checkpoint directory -> (cfg, host-side book, meta).
+
+    For a multi-host checkpoint (host-<pid>/ layout), loads THIS process's
+    shard and zero-pads the remote symbol rows: place_book reassembles the
+    global array from every host's local rows, so the padding never lands
+    on a device. meta carries the ["slice"] this host owns.
+    """
+    mine = os.path.join(path, f"host-{jax.process_index():04d}")
+    if os.path.isdir(mine):
+        with open(os.path.join(mine, "meta.json")) as f:
+            meta = json.load(f)
+        nproc = int(meta.get("num_processes", 1))
+        # Every rank's shard must exist as a live (not .old leftover) dir
+        # with its meta — a crash mid-rename must read as partial, loudly.
+        missing = [
+            r for r in range(nproc)
+            if not os.path.isfile(
+                os.path.join(path, f"host-{r:04d}", "meta.json"))
+        ]
+        if missing:
+            raise ValueError(
+                f"partial multi-host checkpoint: missing shard(s) for "
+                f"rank(s) {missing} of {nproc}"
+            )
+        if int(meta.get("num_processes", 1)) != jax.process_count():
+            raise ValueError(
+                f"checkpoint written by {meta['num_processes']} processes, "
+                f"restoring with {jax.process_count()}"
+            )
+        cfg = EngineConfig(**meta["cfg"])
+        lo, hi = meta["slice"]
+        fields = {}
+        with np.load(os.path.join(mine, "book.npz")) as z:
+            for f in _BOOK_FIELDS:
+                block = z[f]
+                full = np.zeros((cfg.num_symbols,) + block.shape[1:],
+                                dtype=block.dtype)
+                full[lo:hi] = block
+                fields[f] = full
+        return cfg, BookBatch(**fields), meta
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     cfg = EngineConfig(**meta["cfg"])
@@ -147,9 +236,11 @@ def restore_runner(runner, path: str, storage=None) -> int:
         if runner._slot_live[slot] == 0:
             del runner.symbols[sym]
             runner.slot_symbols[slot] = None
-    runner._next_slot = 1 + max(runner.symbols.values(), default=-1)
+    runner._next_slot = max(
+        runner._slot_lo, 1 + max(runner.symbols.values(), default=-1))
     runner._free_slots = [
-        s for s in range(runner._next_slot) if runner.slot_symbols[s] is None
+        s for s in range(runner._slot_lo, runner._next_slot)
+        if runner.slot_symbols[s] is None
     ]
 
     if storage is None:
@@ -213,7 +304,13 @@ def restore_runner(runner, path: str, storage=None) -> int:
 
 
 def latest_checkpoint(root: str) -> str | None:
-    """Newest checkpoint directory under `root` (by embedded timestamp)."""
+    """Newest COMPLETE checkpoint directory under `root`.
+
+    Multi-host layout: daemons tick independently (the engine step has no
+    collectives to pace them), so the newest ckpt-N may hold only the
+    faster hosts' shards at any instant — such partials are skipped here,
+    and restore falls back to the newest checkpoint every rank finished.
+    """
     if not os.path.isdir(root):
         return None
     best, best_ts = None, -1.0
@@ -221,10 +318,21 @@ def latest_checkpoint(root: str) -> str | None:
         p = os.path.join(root, name)
         mp = os.path.join(p, "meta.json")
         if not os.path.isfile(mp):
-            continue
+            # Multi-host layout: meta lives in the per-process subdirs.
+            mp = os.path.join(p, f"host-{jax.process_index():04d}", "meta.json")
+            if not os.path.isfile(mp):
+                continue
         try:
             with open(mp) as f:
-                ts = float(json.load(f).get("ts", 0))
+                meta = json.load(f)
+            ts = float(meta.get("ts", 0))
+            nproc = int(meta.get("num_processes", 1))
+            if nproc > 1 and any(
+                not os.path.isfile(
+                    os.path.join(p, f"host-{r:04d}", "meta.json"))
+                for r in range(nproc)
+            ):
+                continue  # partial (a rank hasn't written this one yet)
         except (ValueError, OSError):
             continue
         if ts > best_ts:
@@ -322,6 +430,10 @@ class CheckpointDaemon:
                       f"{len(repairs)}/{len(recon)} rows to next checkpoint")
 
     def _prune(self):
+        # Multi-host: daemons tick independently, but `saved` resumes from
+        # the dirs on (shared) disk, so inter-host numbering skew is bounded
+        # by one in-flight tick — keep >= 2 guarantees pruning never touches
+        # a checkpoint another rank still considers newest.
         cks = self._existing()
         for name in cks[: max(0, len(cks) - self.keep)]:
             shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)

@@ -1,0 +1,133 @@
+"""Worker for the REAL 2-process multihost test (tests/test_multiprocess.py).
+
+Launched twice (process_id 0 and 1), each with 4 virtual CPU devices, a
+localhost coordinator, and an independent EngineRunner over the SAME global
+8-device mesh. Exercises the whole multi-process serving contract:
+
+- jax.distributed bootstrap through parallel.multihost.initialize,
+- host-major mesh + local_symbol_slice ownership,
+- slot allocation confined to the local symbol range,
+- per-host dispatches (DIFFERENT counts per process — no cross-host
+  lockstep is required because the engine step has no collectives),
+- decode from addressable shards only (parallel/hostlocal.py),
+- book snapshots served from the local shard,
+- the host-sharded checkpoint save/restore round trip.
+
+Writes ok-<pid>.json on success; any assertion kills the process (the
+parent asserts both exit codes).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    port, pid_s, outdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    pid = int(pid_s)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from matching_engine_tpu.parallel.multihost import (
+        initialize,
+        local_symbol_slice,
+        make_multihost_mesh,
+    )
+
+    assert initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid,
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8
+    assert len(jax.local_devices()) == 4
+
+    mesh = make_multihost_mesh()
+    S = 8
+    sl = local_symbol_slice(mesh, S)
+    assert sl.stop - sl.start == 4
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.engine.kernel import FILLED, OP_SUBMIT
+    from matching_engine_tpu.server.engine_runner import (
+        EngineOp,
+        EngineRunner,
+        OrderInfo,
+    )
+
+    cfg = EngineConfig(num_symbols=S, capacity=16, batch=4, max_fills=256)
+    runner = EngineRunner(cfg, mesh=mesh)
+    assert (runner._slot_lo, runner._slot_hi) == (sl.start, sl.stop)
+
+    mysyms = [f"S{g}" for g in range(sl.start, sl.stop)]
+
+    def submit(sym, side, price, qty):
+        slot = runner.slot_acquire(sym)
+        assert slot is not None and sl.start <= slot < sl.stop, (sym, slot)
+        n, oid_s = runner.assign_oid()
+        info = OrderInfo(
+            oid=n, order_id=oid_s, client_id=f"c{pid}", symbol=sym,
+            side=side, otype=0, price_q4=price, quantity=qty, remaining=qty,
+            status=0, handle=runner.assign_handle(),
+        )
+        return EngineOp(OP_SUBMIT, info)
+
+    # DIFFERENT dispatch counts per process: the step has no collectives,
+    # so hosts drain their queues independently — prove it.
+    total_fills = 0
+    ndisp = 2 + pid
+    for d in range(ndisp):
+        ops = []
+        for sym in mysyms:
+            ops.append(submit(sym, 1, 10_000 + d, 5))
+            ops.append(submit(sym, 2, 10_000 + d, 5))
+        res = runner.run_dispatch(ops)
+        assert res.fill_count == len(mysyms), (d, res.fill_count)
+        # The SELL takers fill; the BUY makers' own submit outcome is NEW
+        # (they rested first, then matched within the same dispatch), and
+        # the maker bookkeeping marks their directory entries FILLED.
+        takers = [oc for oc in res.outcomes if oc.op.info.side == 2]
+        assert takers and all(oc.status == FILLED for oc in takers)
+        assert all(i.status == FILLED
+                   for oc in res.outcomes for i in [oc.op.info])
+        # Market data decoded from the local top-of-book block only.
+        assert {m.symbol for m in res.market_data} == set(mysyms)
+        total_fills += res.fill_count
+
+    # A resting order: snapshot must come from the local shard.
+    runner.run_dispatch([submit(mysyms[0], 1, 9_000, 3)])
+    bids, asks = runner.book_snapshot(mysyms[0])
+    assert [q for _, q in bids] == [3] and asks == []
+
+    # Host-sharded checkpoint round trip (barrier so both shards exist).
+    from jax.experimental import multihost_utils
+
+    from matching_engine_tpu.utils.checkpoint import (
+        restore_runner,
+        save_checkpoint,
+    )
+
+    ck = os.path.join(outdir, "ckpt")
+    with runner._dispatch_lock:
+        save_checkpoint(ck, runner)
+    multihost_utils.sync_global_devices("ckpt-written")
+    assert os.path.isdir(os.path.join(ck, f"host-{pid:04d}"))
+
+    r2 = EngineRunner(cfg, mesh=mesh)
+    restore_runner(r2, ck, storage=None)
+    bids2, asks2 = r2.book_snapshot(mysyms[0])
+    assert [q for _, q in bids2] == [3] and asks2 == []
+    assert set(r2.orders_by_id) == set(runner.orders_by_id)
+
+    with open(os.path.join(outdir, f"ok-{pid}.json"), "w") as f:
+        json.dump({"pid": pid, "fills": total_fills,
+                   "slice": [sl.start, sl.stop]}, f)
+
+
+if __name__ == "__main__":
+    main()

