@@ -41,17 +41,14 @@ class EngineConfig:
     capacity: int = 128          # resting orders per side per book
     batch: int = 8               # orders per symbol per engine step
     max_fills: int = 1 << 15     # global fill-buffer slots per engine step
-    pallas: bool = False         # run the match loop as a Pallas TPU kernel
-    pallas_interpret: bool | None = None  # None = auto (real on TPU backends)
 
     def __post_init__(self):
         assert self.capacity <= 1024, "capacity beyond 1024 breaks int32 qty sums"
 
     def semantic_key(self) -> tuple:
         """The fields that define book/kernel SEMANTICS (shapes, buffer
-        sizes) as opposed to execution strategy (pallas*). Checkpoint
-        compatibility compares this — the Pallas path is bit-identical, so
-        flipping backends must not invalidate existing snapshots."""
+        sizes) as opposed to any execution-strategy knobs that may be added
+        later. Checkpoint compatibility compares this."""
         return (self.num_symbols, self.capacity, self.batch, self.max_fills)
 
 

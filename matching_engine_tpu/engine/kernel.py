@@ -220,14 +220,11 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     point below and the shard_map-wrapped multi-chip step in
     parallel/sharding.py, where each shard runs this on its symbol slice).
 
-    With cfg.pallas=True the match loop runs as a Pallas TPU kernel
-    (engine/pallas_kernel.py) — same algorithm, books pinned in VMEM across
-    the whole batch; results are bit-identical (tests/test_pallas.py)."""
-    if cfg.pallas:
-        from matching_engine_tpu.engine.pallas_kernel import match_batch_pallas
-
-        new_book, per_order = match_batch_pallas(cfg, book, orders)
-        return new_book, finalize_step(cfg, new_book, orders, *per_order)
+    A hand-written Pallas variant of the match loop was built, proven
+    bit-identical, measured ~700x SLOWER than this XLA formulation, and
+    retired — see docs/DESIGN.md §6 for the analysis (integer control-flow
+    over VPU lanes is exactly what XLA already schedules well; the
+    priority-matrix broadcasts relayout poorly under Mosaic)."""
     sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
     # vmap over the symbol axis; scan over the batch axis inside.
     new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(

@@ -75,6 +75,23 @@ def read_row(x, row: int) -> np.ndarray:
     raise IndexError(f"row {row} is not addressable by this process")
 
 
+def put_tree(tree, sharding_tree):
+    """Place a host pytree onto (possibly multi-process) shardings.
+
+    THE placement discipline for the whole stack (books, order batches,
+    restores): single-process takes the plain device_put fast path;
+    multi-process builds each global array from the local index ranges of
+    this host's full-shape value via make_global.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding_tree)
+    return jax.tree.map(
+        lambda arr, sh: make_global(arr, sh), tree, sharding_tree
+    )
+
+
 def make_global(host_full: np.ndarray, sharding):
     """A (possibly multi-process) global array from a full-shape host array.
 

@@ -95,8 +95,10 @@ def initialize(
 
         if getattr(_dist.global_state, "client", None) is not None:
             return True  # already initialized
-    except ImportError:
-        pass
+    except (ImportError, AttributeError):
+        pass  # private probe unavailable on this jax; initialize() below
+        # raises RuntimeError if actually double-initialized, which the
+        # except arm treats as non-fatal for detected (non-explicit) runs.
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -184,27 +184,13 @@ class ShardedEngine:
         self.all_top_of_book = jax.jit(gather_tob)
 
     def init_book(self) -> BookBatch:
-        if jax.process_count() == 1:
-            return jax.device_put(init_book(self.cfg), self.book_sharding)
-        # Multi-process: every host holds the same full-shape init value;
-        # make_array assembles the global array from local shards.
-        host = init_book(self.cfg)
-        return jax.tree.map(
-            lambda arr, sh: hostlocal.make_global(arr, sh),
-            host, self.book_sharding,
-        )
+        return hostlocal.put_tree(init_book(self.cfg), self.book_sharding)
 
     def place_orders(self, orders: OrderBatch) -> OrderBatch:
-        if jax.process_count() == 1:
-            # Hot path (once per dispatch): plain placement.
-            return jax.device_put(orders, self.order_sharding)
-        # Multi-process: each host contributes its addressable symbol rows
-        # (remote rows are OP_NOOP padding in this host's batch — the real
-        # ops for those symbols come from their home host's batch).
-        return jax.tree.map(
-            lambda arr, sh: hostlocal.make_global(arr, sh),
-            orders, self.order_sharding,
-        )
+        # Hot path (once per dispatch). Multi-process: each host contributes
+        # its addressable symbol rows (remote rows are OP_NOOP padding in
+        # this host's batch — the real ops come from their home host).
+        return hostlocal.put_tree(orders, self.order_sharding)
 
     def decode(
         self, batch: OrderBatch, out: ShardedStepOutput

@@ -163,16 +163,10 @@ class EngineRunner:
         """Install a host-side BookBatch as the live device book, honoring
         the runner's sharding (checkpoint restore path)."""
         if self._sharded is not None:
-            if jax.process_count() > 1:
-                from matching_engine_tpu.parallel import hostlocal
+            from matching_engine_tpu.parallel import hostlocal
 
-                self.book = jax.tree.map(
-                    lambda arr, sh: hostlocal.make_global(arr, sh),
-                    host_book, self._sharded.book_sharding,
-                )
-            else:
-                self.book = jax.device_put(
-                    host_book, self._sharded.book_sharding)
+            self.book = hostlocal.put_tree(
+                host_book, self._sharded.book_sharding)
         else:
             self.book = jax.device_put(host_book)
 

@@ -51,6 +51,43 @@ from matching_engine_tpu.engine.kernel import (
 _BOOK_FIELDS = BookBatch._fields
 
 
+def _cfg_from_meta(meta: dict) -> EngineConfig:
+    """EngineConfig from checkpoint meta, dropping keys of retired fields.
+
+    Snapshots written before an execution-strategy knob was removed (e.g.
+    the round-1 `pallas`/`pallas_interpret` flags retired in round 3) must
+    keep loading: semantic compatibility is judged by semantic_key(), never
+    by the config dataclass's full field list."""
+    import dataclasses as _dc
+
+    known = {f.name for f in _dc.fields(EngineConfig)}
+    return EngineConfig(**{k: v for k, v in meta["cfg"].items() if k in known})
+
+
+def _atomic_checkpoint_write(final: str, blocks: dict, meta: dict) -> None:
+    """Write {book.npz, meta.json} into `final` via tmp dir + rename swap.
+
+    The single atomic-swap implementation for both layouts (flat and
+    per-host shard dirs) — a durability fix here covers both."""
+    parent = os.path.dirname(os.path.abspath(final)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
+    try:
+        np.savez(os.path.join(tmp, "book.npz"), **blocks)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(final):
+            old = final + ".old"
+            os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
 def save_checkpoint(path: str, runner) -> None:
     """Atomically write one checkpoint of `runner` (an EngineRunner).
 
@@ -80,23 +117,7 @@ def save_checkpoint(path: str, runner) -> None:
         "next_oid_num": next_oid_num,
         "orders": [dataclasses.asdict(i) for i in list(runner.orders_by_handle.values())],
     }
-    parent = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(parent, exist_ok=True)
-    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
-    try:
-        np.savez(os.path.join(tmp, "book.npz"), **book_host)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        if os.path.isdir(path):
-            old = path + ".old"
-            os.rename(path, old)
-            os.rename(tmp, path)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, path)
-    except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
+    _atomic_checkpoint_write(path, book_host, meta)
 
 
 def _save_checkpoint_hostlocal(path: str, runner) -> None:
@@ -122,23 +143,8 @@ def _save_checkpoint_hostlocal(path: str, runner) -> None:
         "process": jax.process_index(),
         "num_processes": jax.process_count(),
     }
-    os.makedirs(path, exist_ok=True)
-    final = os.path.join(path, f"host-{jax.process_index():04d}")
-    tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=path)
-    try:
-        np.savez(os.path.join(tmp, "book.npz"), **blocks)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        if os.path.isdir(final):
-            old = final + ".old"
-            os.rename(final, old)
-            os.rename(tmp, final)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, final)
-    except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
+    _atomic_checkpoint_write(
+        os.path.join(path, f"host-{jax.process_index():04d}"), blocks, meta)
 
 
 def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
@@ -171,7 +177,7 @@ def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
                 f"checkpoint written by {meta['num_processes']} processes, "
                 f"restoring with {jax.process_count()}"
             )
-        cfg = EngineConfig(**meta["cfg"])
+        cfg = _cfg_from_meta(meta)
         lo, hi = meta["slice"]
         fields = {}
         with np.load(os.path.join(mine, "book.npz")) as z:
@@ -184,7 +190,7 @@ def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
         return cfg, BookBatch(**fields), meta
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
-    cfg = EngineConfig(**meta["cfg"])
+    cfg = _cfg_from_meta(meta)
     with np.load(os.path.join(path, "book.npz")) as z:
         book = BookBatch(**{f: z[f] for f in _BOOK_FIELDS})
     return cfg, book, meta
@@ -207,6 +213,16 @@ def restore_runner(runner, path: str, storage=None) -> int:
     if cfg.semantic_key() != runner.cfg.semantic_key():
         raise ValueError(
             f"checkpoint config {cfg} does not match runner config {runner.cfg}"
+        )
+    if "slice" in meta and list(meta["slice"]) != [runner._slot_lo,
+                                                  runner._slot_hi]:
+        # Same process count, different per-host device split: this rank's
+        # shard no longer covers the rows it saved — restoring would
+        # silently zero the difference. Fail loudly; callers fall back to
+        # full replay from SQLite.
+        raise ValueError(
+            f"checkpoint shard covers symbols {meta['slice']} but this "
+            f"rank now owns [{runner._slot_lo}, {runner._slot_hi})"
         )
     runner.place_book(host_book)
     runner.symbols = dict(meta["symbols"])
@@ -430,12 +446,18 @@ class CheckpointDaemon:
                       f"{len(repairs)}/{len(recon)} rows to next checkpoint")
 
     def _prune(self):
-        # Multi-host: daemons tick independently, but `saved` resumes from
-        # the dirs on (shared) disk, so inter-host numbering skew is bounded
-        # by one in-flight tick — keep >= 2 guarantees pruning never touches
-        # a checkpoint another rank still considers newest.
+        # Never delete the newest COMPLETE checkpoint (or anything newer):
+        # with independently-ticking multi-host daemons, a stalled rank can
+        # leave the only restorable state several names behind the fastest
+        # rank's latest — pruning is bounded by restorability, not count.
         cks = self._existing()
+        newest_complete = latest_checkpoint(self.root)
+        protect_from = (
+            os.path.basename(newest_complete) if newest_complete else None
+        )
         for name in cks[: max(0, len(cks) - self.keep)]:
+            if protect_from is not None and name >= protect_from:
+                break
             shutil.rmtree(os.path.join(self.root, name), ignore_errors=True)
 
     def close(self):

@@ -133,3 +133,15 @@ def test_daemon_prunes_old_checkpoints(tmp_path):
     kept = [n for n in os.listdir(tmp_path / "ck") if n.startswith("ckpt-")]
     assert len(kept) <= daemon.keep
     h.close(checkpoint=False)
+
+
+def test_cfg_from_meta_tolerates_retired_fields():
+    """Snapshots written when EngineConfig still had execution-strategy
+    knobs (round-1 pallas flags, retired round 3) must keep loading."""
+    from matching_engine_tpu.utils.checkpoint import _cfg_from_meta
+
+    cfg = _cfg_from_meta({"cfg": {
+        "num_symbols": 8, "capacity": 16, "batch": 4, "max_fills": 256,
+        "pallas": False, "pallas_interpret": None,
+    }})
+    assert cfg.semantic_key() == (8, 16, 4, 256)
