@@ -5,21 +5,39 @@
 # kill server) extended with a crossing SELL, a MARKET order, a book query,
 # and a cancel.
 #
-# Usage: scripts/smoke.sh [--tpu]   (default runs on CPU for hermeticity)
+# Usage: scripts/smoke.sh [--tpu] [--native]
+#   default: CPU platform, Python grpcio edge + Python CLI client
+#   --native: same flow through the C++ gateway (native/me_gateway.cpp)
+#             driven by the C++ client (native/me_client.cpp)
 set -u
 
 cd "$(dirname "$0")/.."
 export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
-if [ "${1:-}" != "--tpu" ]; then
+NATIVE=0
+for arg in "$@"; do
+  case "$arg" in
+    --native) NATIVE=1 ;;
+    --tpu) TPU=1 ;;
+  esac
+done
+if [ "${TPU:-0}" != "1" ]; then
   export JAX_PLATFORMS=cpu
 fi
 
 DB=$(mktemp -d)/smoke.db
 PORT=$(( ( RANDOM % 10000 ) + 40000 ))
 ADDR="127.0.0.1:$PORT"
+GW_FLAGS=""
+CLIENT=(python -m matching_engine_tpu.client.cli)
+if [ "$NATIVE" = "1" ]; then
+  make -s -C native   # builds gateway lib + me_client
+  GW_PORT=$(( ( RANDOM % 10000 ) + 30000 ))
+  GW_FLAGS="--gateway-addr 127.0.0.1:$GW_PORT"
+fi
 
+# shellcheck disable=SC2086
 python -m matching_engine_tpu.server.main --addr "$ADDR" --db "$DB" \
-  --symbols 16 --capacity 32 --batch 4 --window-ms 1 &
+  --symbols 16 --capacity 32 --batch 4 --window-ms 1 $GW_FLAGS &
 SERVER_PID=$!
 trap 'kill $SERVER_PID 2>/dev/null' EXIT
 
@@ -32,12 +50,23 @@ s = socket.create_connection((host, int(port)), timeout=0.5); s.close()
 EOF
   sleep 0.5
 done
+if [ "$NATIVE" = "1" ]; then
+  # Submit/cancel flow through the C++ edge with the C++ client; the
+  # book/metrics queries stay on the Python CLI (same server, both edges).
+  ADDR="127.0.0.1:$GW_PORT"
+  CLIENT=(matching_engine_tpu/native/me_client)
+fi
 
 PASS=0; FAIL=0
 run_case() {
   local desc="$1"; shift
   local want="$1"; shift
-  out=$(python -m matching_engine_tpu.client.cli "$@" 2>&1)
+  case "${1:-}" in
+    book|metrics|watch-*)  # query subcommands: Python CLI on either edge
+      out=$(python -m matching_engine_tpu.client.cli "$@" 2>&1) ;;
+    *)
+      out=$("${CLIENT[@]}" "$@" 2>&1) ;;
+  esac
   if echo "$out" | grep -q "$want"; then
     echo "PASS: $desc"
     PASS=$((PASS+1))
@@ -58,7 +87,7 @@ run_case "LIMIT BUY scale 0" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 10 0 
 # Beyond the reference: real matching.
 run_case "crossing SELL fills" "accepted order_id=" "$ADDR" c2 SYM SELL LIMIT 1005 2 15
 run_case "MARKET SELL" "accepted order_id=" "$ADDR" c2 SYM SELL MARKET 0 0 5
-run_case "book query" "book SYM" book "$ADDR" SYM
+run_case "book query" "book SYM" book "127.0.0.1:$PORT" SYM
 run_case "reject bad qty" "rejected" "$ADDR" c1 SYM BUY LIMIT 1005 2 0
 run_case "cancel unknown" "cancel rejected" cancel "$ADDR" c1 OID-999
 
