@@ -113,26 +113,32 @@ def decode_results(batch: OrderBatch, status, filled, remaining,
     ]
 
 
+def decode_fills(sym, taker, maker, price, qty, n: int) -> list[HostFill]:
+    """Bulk fill decode: one device->host transfer per column, one tolist()
+    each — per-element indexing would cost a device gather (jax) or boxed
+    scalar conversion (numpy) per int. THE fill-column order lives here
+    (and only here; the sharded decoder shares this helper)."""
+    return [
+        HostFill(*t)
+        for t in zip(
+            np.asarray(sym[:n]).tolist(),
+            np.asarray(taker[:n]).tolist(),
+            np.asarray(maker[:n]).tolist(),
+            np.asarray(price[:n]).tolist(),
+            np.asarray(qty[:n]).tolist(),
+        )
+    ]
+
+
 def decode_step(
     cfg: EngineConfig, batch: OrderBatch, out: StepOutput
 ) -> tuple[list[HostResult], list[HostFill], bool]:
     """Decode one StepOutput into per-order results + the fill log."""
     results = decode_results(batch, out.status, out.filled, out.remaining)
-
-    # One bulk device->host transfer per array, then one bulk tolist() per
-    # column: per-element indexing of jax/numpy arrays would cost a device
-    # gather (jax) or a boxed scalar conversion (numpy) per int.
-    n = int(out.fill_count)
-    fills = [
-        HostFill(*t)
-        for t in zip(
-            np.asarray(out.fill_sym[:n]).tolist(),
-            np.asarray(out.fill_taker_oid[:n]).tolist(),
-            np.asarray(out.fill_maker_oid[:n]).tolist(),
-            np.asarray(out.fill_price[:n]).tolist(),
-            np.asarray(out.fill_qty[:n]).tolist(),
-        )
-    ]
+    fills = decode_fills(
+        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
+        out.fill_price, out.fill_qty, int(out.fill_count),
+    )
     return results, fills, bool(out.fill_overflow)
 
 

@@ -38,7 +38,12 @@ from matching_engine_tpu.engine.book import (
     OrderBatch,
     init_book,
 )
-from matching_engine_tpu.engine.harness import HostFill, HostResult, decode_results
+from matching_engine_tpu.engine.harness import (
+    HostFill,
+    HostResult,
+    decode_fills,
+    decode_results,
+)
 from matching_engine_tpu.engine.kernel import engine_step_impl
 from matching_engine_tpu.parallel import hostlocal
 
@@ -230,21 +235,14 @@ class ShardedEngine:
             n = count_by_shard[shard]
             if n == 0:
                 continue
-            f_sym = np.asarray(fill_shards["fill_sym"][shard][:n])
-            f_taker = np.asarray(fill_shards["fill_taker_oid"][shard][:n])
-            f_maker = np.asarray(fill_shards["fill_maker_oid"][shard][:n])
-            f_price = np.asarray(fill_shards["fill_price"][shard][:n])
-            f_qty = np.asarray(fill_shards["fill_qty"][shard][:n])
-            for i in range(n):
-                fills.append(
-                    HostFill(
-                        sym=int(f_sym[i]),
-                        taker_oid=int(f_taker[i]),
-                        maker_oid=int(f_maker[i]),
-                        price_q4=int(f_price[i]),
-                        quantity=int(f_qty[i]),
-                    )
-                )
+            fills.extend(decode_fills(
+                fill_shards["fill_sym"][shard],
+                fill_shards["fill_taker_oid"][shard],
+                fill_shards["fill_maker_oid"][shard],
+                fill_shards["fill_price"][shard],
+                fill_shards["fill_qty"][shard],
+                n,
+            ))
         overflow = any(
             bool(np.asarray(s.data).any())
             for s in out.fill_overflow.addressable_shards

@@ -51,6 +51,16 @@ EOF
   sleep 0.5
 done
 if [ "$NATIVE" = "1" ]; then
+  # The grpcio port binds before the gateway thread starts: wait for the
+  # gateway port too before pointing the client at it.
+  for i in $(seq 1 120); do
+    python - "127.0.0.1:$GW_PORT" <<'EOF2' 2>/dev/null && break
+import socket, sys
+host, port = sys.argv[1].rsplit(":", 1)
+s = socket.create_connection((host, int(port)), timeout=0.5); s.close()
+EOF2
+    sleep 0.5
+  done
   # Submit/cancel flow through the C++ edge with the C++ client; the
   # book/metrics queries stay on the Python CLI (same server, both edges).
   ADDR="127.0.0.1:$GW_PORT"
