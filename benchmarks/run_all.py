@@ -183,6 +183,55 @@ def config4_grpc(full: bool):
           "p99_ms": round(float(lats[int(len(lats) * 0.99)] * 1e3), 2)})
 
 
+def config4_native_gateway(full: bool):
+    """Config 4 through the C++ serving edge, driven by the native
+    pipelined load generator (me_client bench) — a GIL-free client, so the
+    figure measures the server, not the loadgen. Emits one line per edge
+    (native gateway, then grpcio for the same-process comparison)."""
+    import subprocess
+    import tempfile
+
+    from matching_engine_tpu import native as me_native
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    cli = me_native.client_binary()
+    if cli is None or not me_native.gateway_available():
+        emit(4, "native_edge_skipped", 0.0, "bool",
+             {"reason": "native gateway/client not built"})
+        return
+    clients = 32 if full else 8
+    per_client = 2000 if full else 250
+    inflight = 8
+    cfg = EngineConfig(num_symbols=64, capacity=256, batch=16, max_fills=1 << 15)
+    db = tempfile.mkdtemp() + "/bench_native.db"
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=2.0, log=False,
+        gateway_addr="127.0.0.1:0",
+    )
+    server.start()
+    try:
+        for edge, eport in (("native_gateway", parts["gateway_port"]),
+                            ("grpcio", port)):
+            out = subprocess.run(
+                [cli, "bench", f"127.0.0.1:{eport}", str(clients),
+                 str(per_client), "64", str(inflight)],
+                capture_output=True, text=True, timeout=900,
+            )
+            try:
+                row = json.loads(out.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                emit(4, f"e2e_{edge}_failed", 0.0, "bool",
+                     {"stderr": out.stderr[-200:]})
+                continue
+            emit(4, f"e2e_{edge}", row["value"], "orders/sec",
+                 {"clients": clients, "per_client": per_client,
+                  "inflight": inflight, "p50_ms": row["p50_ms"],
+                  "p99_ms": row["p99_ms"], "ok": row["ok"],
+                  "rejected": row["rejected"]})
+    finally:
+        shutdown(server, parts)
+
+
 # -- config 5: agent-based market sim ----------------------------------------
 
 def config5_sim(full: bool):
@@ -223,6 +272,7 @@ def main():
         config3_l3(args.full)
     if 4 in picked:
         config4_grpc(args.full)
+        config4_native_gateway(args.full)
     if 5 in picked:
         config5_sim(args.full)
 
