@@ -212,22 +212,31 @@ def config4_native_gateway(full: bool):
     try:
         for edge, eport in (("native_gateway", parts["gateway_port"]),
                             ("grpcio", port)):
-            out = subprocess.run(
-                [cli, "bench", f"127.0.0.1:{eport}", str(clients),
-                 str(per_client), "64", str(inflight)],
-                capture_output=True, text=True, timeout=900,
-            )
+            try:
+                out = subprocess.run(
+                    [cli, "bench", f"127.0.0.1:{eport}", str(clients),
+                     str(per_client), "64", str(inflight)],
+                    capture_output=True, text=True, timeout=900,
+                )
+            except subprocess.TimeoutExpired:
+                emit(4, f"e2e_{edge}_failed", 0.0, "bool",
+                     {"reason": "bench client timed out (900s)"})
+                continue
             try:
                 row = json.loads(out.stdout.strip().splitlines()[-1])
             except (ValueError, IndexError):
                 emit(4, f"e2e_{edge}_failed", 0.0, "bool",
                      {"stderr": out.stderr[-200:]})
                 continue
+            # A run with dropped connections is NOT a clean figure: surface
+            # the error count and the client's exit code alongside it.
             emit(4, f"e2e_{edge}", row["value"], "orders/sec",
                  {"clients": clients, "per_client": per_client,
                   "inflight": inflight, "p50_ms": row["p50_ms"],
                   "p99_ms": row["p99_ms"], "ok": row["ok"],
-                  "rejected": row["rejected"]})
+                  "rejected": row["rejected"],
+                  "transport_errors": row.get("transport_errors", 0),
+                  "degraded": out.returncode != 0})
     finally:
         shutdown(server, parts)
 

@@ -289,3 +289,69 @@ def test_gateway_stats(hs):
     stats = bridge.gateway.stats()
     assert stats["requests"] > 0
     assert stats["conns"] > 0
+
+
+def test_dual_edge_stress(hs):
+    """Concurrency stress across BOTH serving edges at once: submits,
+    cancels, and book reads race through the native gateway and grpcio
+    against the same runner, with checkpoint-style quiesces (dispatch-lock
+    + sink flush) hammering in between. Invariants: every RPC completes,
+    no torn responses, directories stay consistent, DB audits clean."""
+    import random
+    import sys
+
+    sys.path.insert(0, "scripts")
+    from audit import audit
+
+    errors = []
+    done = threading.Event()
+
+    def trader(stub, tag):
+        rng = random.Random(tag)
+        live = []
+        try:
+            for i in range(60):
+                sym = f"ST{rng.randrange(3)}"
+                side = pb2.BUY if rng.random() < 0.5 else pb2.SELL
+                r = stub.SubmitOrder(
+                    pb2.OrderRequest(
+                        client_id=f"s{tag}", symbol=sym, order_type=pb2.LIMIT,
+                        side=side, price=10_000 + rng.randrange(-5, 5),
+                        scale=4, quantity=rng.randrange(1, 9)),
+                    timeout=30)
+                if r.success:
+                    live.append(r.order_id)
+                if live and rng.random() < 0.4:
+                    stub.CancelOrder(
+                        pb2.CancelRequest(client_id=f"s{tag}",
+                                          order_id=live.pop(0)), timeout=30)
+                if rng.random() < 0.2:
+                    stub.GetOrderBook(
+                        pb2.OrderBookRequest(symbol=sym), timeout=30)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"trader {tag}: {type(e).__name__}: {e}")
+
+    def quiescer():
+        runner = hs.parts["runner"]
+        while not done.is_set():
+            with runner._dispatch_lock:
+                hs.parts["sink"].flush()
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=trader, args=(hs.stub, i))
+               for i in range(4)]
+    threads += [threading.Thread(target=trader, args=(hs.py_stub, 10 + i))
+                for i in range(4)]
+    q = threading.Thread(target=quiescer)
+    q.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    done.set()
+    q.join(timeout=10)
+    stuck = [t.name for t in threads + [q] if t.is_alive()]
+    assert not stuck, f"threads still running: {stuck}"
+    assert not errors, errors
+    hs.flush()
+    assert audit(hs.db_path) == []
