@@ -108,7 +108,8 @@ class EngineRunner:
     through np.asarray on logical arrays.
     """
 
-    def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None, mesh=None):
+    def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None,
+                 mesh=None, hub=None):
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
@@ -158,6 +159,12 @@ class EngineRunner:
         # the ledger itself is counted and the tail dropped.
         self.pending_recon: list[tuple[str, str, int]] = []
         self._recon_cap = 100_000
+        # Constructor-wired (build_server passes the StreamHub the
+        # dispatchers publish to): lets the decode skip CONSTRUCTING stream
+        # protos (per-fill OrderUpdates, per-symbol MarketDataUpdates) when
+        # no subscriber exists — the common serving case. None = always
+        # build (library/test use reads DispatchResult directly).
+        self.hub = hub
 
     def place_book(self, host_book) -> None:
         """Install a host-side BookBatch as the live device book, honoring
@@ -268,6 +275,10 @@ class EngineRunner:
 
     def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
         res = DispatchResult([], [], [], [], [], [], 0)
+        # Sampled once per dispatch: a subscriber attaching mid-dispatch
+        # just misses this dispatch (same as attaching a moment later).
+        self._build_ou = self.hub is None or self.hub.has_order_update_subs()
+        self._build_md = self.hub is None or self.hub.has_market_data_subs()
         host_orders = []
         by_handle: dict[int, EngineOp] = {}
         for e in ops:
@@ -317,7 +328,7 @@ class EngineRunner:
             touched_syms.update(r.sym for r in results)
             res.fill_count += len(fills)
 
-        if last_out is not None and touched_syms:
+        if last_out is not None and touched_syms and self._build_md:
             self._market_data(last_out, touched_syms, res)
 
         # Evict terminal orders from the directories: once FILLED / CANCELED /
@@ -417,10 +428,12 @@ class EngineRunner:
                 rem = info.quantity
                 for f in fills_by_taker.get(info.handle, ()):
                     rem -= f.quantity
-                    st = FILLED if (rem == 0 and info.remaining == 0) else PARTIALLY_FILLED
-                    res.order_updates.append(
-                        self._update(info, st, f.price_q4, f.quantity, rem)
-                    )
+                    if self._build_ou:
+                        st = (FILLED if (rem == 0 and info.remaining == 0)
+                              else PARTIALLY_FILLED)
+                        res.order_updates.append(
+                            self._update(info, st, f.price_q4, f.quantity, rem)
+                        )
                     maker = self.orders_by_handle.get(f.maker_oid)
                     if maker is None:
                         continue  # unreachable if directories are consistent
@@ -434,18 +447,22 @@ class EngineRunner:
                     res.storage_updates.append(
                         (maker.order_id, maker.status, maker.remaining)
                     )
+                    if self._build_ou:
+                        res.order_updates.append(
+                            self._fill_update(maker, f.price_q4, f.quantity)
+                        )
+                if self._build_ou and r.status in (NEW, CANCELED, REJECTED):
                     res.order_updates.append(
-                        self._fill_update(maker, f.price_q4, f.quantity)
-                    )
-                if r.status in (NEW, CANCELED, REJECTED):
-                    res.order_updates.append(self._update(info, r.status, 0, 0, r.remaining))
+                        self._update(info, r.status, 0, 0, r.remaining))
             else:  # cancel
                 if r.status == CANCELED:
                     info.status = CANCELED
                     info.remaining = 0
                     res.outcomes.append(OpOutcome(e, CANCELED, 0, r.remaining))
                     res.storage_updates.append((info.order_id, CANCELED, 0))
-                    res.order_updates.append(self._update(info, CANCELED, 0, 0, 0))
+                    if self._build_ou:
+                        res.order_updates.append(
+                            self._update(info, CANCELED, 0, 0, 0))
                 else:
                     res.outcomes.append(
                         OpOutcome(e, REJECTED, 0, 0, "order not open")

@@ -92,7 +92,8 @@ def build_server(
         raise SystemExit(1)
 
     metrics = Metrics()
-    runner = EngineRunner(cfg, metrics, mesh=mesh)
+    hub = StreamHub()
+    runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub)
     # Fast path: restore the newest device-book snapshot and replay only the
     # post-snapshot delta from SQLite; fall back to full replay.
     ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
@@ -104,7 +105,7 @@ def build_server(
         except Exception as e:  # any corrupt/skewed checkpoint -> full replay
             print(f"[SERVER] checkpoint restore failed "
                   f"({type(e).__name__}: {e}); full replay")
-            runner = EngineRunner(cfg, metrics, mesh=mesh)
+            runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub)
             ckpt = None
     if ckpt is None:
         recovered = recover_books(runner, storage)
@@ -129,7 +130,6 @@ def build_server(
             runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s,
             storage=storage,
         ).start()
-    hub = StreamHub()
     if use_native:
         dispatcher = NativeRingDispatcher(
             runner, sink=sink, hub=hub, window_ms=window_ms

@@ -57,6 +57,16 @@ class StreamHub:
 
     # -- subscription management ------------------------------------------
 
+    def has_market_data_subs(self) -> bool:
+        """Lock-free peek: the decode path skips BUILDING MarketDataUpdate
+        protos entirely when nobody is listening (the common serving case).
+        A subscriber attaching mid-dispatch just misses that dispatch —
+        same semantics as attaching a moment later."""
+        return bool(self._md_subs)
+
+    def has_order_update_subs(self) -> bool:
+        return bool(self._ou_subs)
+
     def subscribe_market_data(self, symbol: str) -> _Subscription:
         sub = _Subscription(self._maxsize)
         with self._lock:

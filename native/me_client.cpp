@@ -40,7 +40,10 @@ namespace {
 const char kUsage[] =
     "usage: me_client <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> "
     "<price> <scale> <quantity>\n"
-    "   or: me_client cancel <addr> <client_id> <order_id>";
+    "   or: me_client cancel <addr> <client_id> <order_id>\n"
+    "   or: me_client book <addr> <symbol>\n"
+    "   or: me_client metrics <addr>\n"
+    "   or: me_client bench <addr> <clients> <per_client> [symbols] [inflight]";
 
 int dial(const std::string& addr) {
   std::string host = addr;
@@ -564,7 +567,7 @@ int do_cancel(const std::string& addr, const std::string& client_id,
   std::string bytes;
   req.SerializeToString(&bytes);
   std::string resp_bytes, grpc_message;
-  int grpc_status;
+  int grpc_status = -1;
   if (unary_call(addr, "/matching_engine.v1.MatchingEngine/CancelOrder",
                  bytes, &resp_bytes, &grpc_status, &grpc_message) != 0) {
     return 2;
@@ -589,10 +592,89 @@ int do_cancel(const std::string& addr, const std::string& client_id,
 
 }  // namespace
 
+namespace {
+
+// Output format parity with the Python CLI's `book` / `metrics`
+// subcommands (matching_engine_tpu/client/cli.py).
+int do_book(const std::string& addr, const std::string& symbol) {
+  pb::OrderBookRequest req;
+  req.set_symbol(symbol);
+  std::string bytes, resp_bytes, grpc_message;
+  req.SerializeToString(&bytes);
+  int grpc_status = -1;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/GetOrderBook",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0 ||
+      grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::OrderBookResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  std::printf("[client] book %s: %d bids / %d asks\n", symbol.c_str(),
+              resp.bids_size(), resp.asks_size());
+  for (const auto& o : resp.bids()) {
+    std::printf("  bid %lld@Q%d x%lld %s (%s)\n",
+                static_cast<long long>(o.price()), o.scale(),
+                static_cast<long long>(o.quantity()), o.order_id().c_str(),
+                o.client_id().c_str());
+  }
+  for (const auto& o : resp.asks()) {
+    std::printf("  ask %lld@Q%d x%lld %s (%s)\n",
+                static_cast<long long>(o.price()), o.scale(),
+                static_cast<long long>(o.quantity()), o.order_id().c_str(),
+                o.client_id().c_str());
+  }
+  return 0;
+}
+
+int do_metrics(const std::string& addr) {
+  pb::MetricsRequest req;
+  std::string bytes, resp_bytes, grpc_message;
+  req.SerializeToString(&bytes);
+  int grpc_status = -1;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/GetMetrics",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0 ||
+      grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::MetricsResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  std::vector<std::pair<std::string, long long>> counters(
+      resp.counters().begin(), resp.counters().end());
+  std::sort(counters.begin(), counters.end());
+  for (const auto& [k, v] : counters) {
+    std::printf("counter %s %lld\n", k.c_str(), v);
+  }
+  std::vector<std::pair<std::string, double>> gauges(
+      resp.gauges().begin(), resp.gauges().end());
+  std::sort(gauges.begin(), gauges.end());
+  for (const auto& [k, v] : gauges) {
+    std::printf("gauge %s %.1f\n", k.c_str(), v);
+  }
+  return 0;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   GOOGLE_PROTOBUF_VERIFY_VERSION;
   if (argc == 5 && std::strcmp(argv[1], "cancel") == 0) {
     return do_cancel(argv[2], argv[3], argv[4]);
+  }
+  if (argc == 4 && std::strcmp(argv[1], "book") == 0) {
+    return do_book(argv[2], argv[3]);
+  }
+  if (argc == 3 && std::strcmp(argv[1], "metrics") == 0) {
+    return do_metrics(argv[2]);
   }
   if ((argc >= 5 && argc <= 7) && std::strcmp(argv[1], "bench") == 0) {
     return do_bench(argv[2], std::atoi(argv[3]), std::atoi(argv[4]),
@@ -634,7 +716,7 @@ int main(int argc, char** argv) {
   std::string bytes;
   req.SerializeToString(&bytes);
   std::string resp_bytes, grpc_message;
-  int grpc_status;
+  int grpc_status = -1;
   if (unary_call(addr, "/matching_engine.v1.MatchingEngine/SubmitOrder",
                  bytes, &resp_bytes, &grpc_status, &grpc_message) != 0) {
     return 2;

@@ -27,7 +27,9 @@ pytestmark = pytest.mark.skipif(
     not me_native.gateway_available(), reason="native gateway not built"
 )
 
-CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+# Symbol axis sized for the whole module: tests use distinct symbols and
+# several leave resting orders that pin their slots.
+CFG = EngineConfig(num_symbols=16, capacity=16, batch=4)
 
 
 class GwHarness:
@@ -355,3 +357,19 @@ def test_dual_edge_stress(hs):
     assert not errors, errors
     hs.flush()
     assert audit(hs.db_path) == []
+
+
+def test_native_client_book_and_metrics(hs):
+    cli = me_native.client_binary()
+    addr = f"127.0.0.1:{hs.gw_port}"
+    r = subprocess.run([cli, addr, "qb", "QBOOK", "BUY", "LIMIT", "4200",
+                        "4", "7"], capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0
+    b = subprocess.run([cli, "book", addr, "QBOOK"],
+                       capture_output=True, text=True, timeout=30)
+    assert b.returncode == 0
+    assert "book QBOOK: 1 bids / 0 asks" in b.stdout
+    assert "bid 4200@Q4 x7" in b.stdout
+    m = subprocess.run([cli, "metrics", addr],
+                       capture_output=True, text=True, timeout=30)
+    assert m.returncode == 0 and "counter orders_accepted" in m.stdout
