@@ -31,6 +31,10 @@ pytestmark = pytest.mark.skipif(
 # several leave resting orders that pin their slots.
 CFG = EngineConfig(num_symbols=16, capacity=16, batch=4)
 
+# Mirror of h2::kMaxFrameSize (native/h2.h) — the gateway splits DATA at
+# this size; test_large_book_response asserts it crosses the boundary.
+H2_MAX_FRAME = 16384
+
 
 class GwHarness:
     """Full stack with BOTH edges: grpcio on .port, C++ gateway on .gw_port."""
@@ -373,3 +377,40 @@ def test_native_client_book_and_metrics(hs):
     m = subprocess.run([cli, "metrics", addr],
                        capture_output=True, text=True, timeout=30)
     assert m.returncode == 0 and "counter orders_accepted" in m.stdout
+
+
+def test_unicode_round_trip(hs):
+    """Non-ASCII client ids / symbols through the C++ edge: UTF-8 bytes in
+    the protobuf payload must round-trip through the C++ parser, the wide
+    ring record, and the directory identically to the grpcio edge."""
+    r = submit(hs.stub, client="客户-θ", symbol="SÝM€", price=31000, qty=2)
+    assert r.success
+    hs.flush()
+    row = Storage(hs.db_path).get_order(r.order_id)
+    assert row[1] == "客户-θ" and row[2] == "SÝM€"
+    book = hs.stub.GetOrderBook(pb2.OrderBookRequest(symbol="SÝM€"), timeout=10)
+    assert [o.client_id for o in book.bids] == ["客户-θ"]
+    ok = hs.stub.CancelOrder(
+        pb2.CancelRequest(client_id="客户-θ", order_id=r.order_id), timeout=10)
+    assert ok.success
+
+
+def test_large_book_response(tmp_path_factory):
+    """A book response bigger than one HTTP/2 frame (16KB) must arrive
+    intact through the gateway's DATA splitting + send-window accounting."""
+    cfg = EngineConfig(num_symbols=4, capacity=512, batch=16, max_fills=1 << 14)
+    h = GwHarness(str(tmp_path_factory.mktemp("big") / "big.db"), cfg=cfg)
+    try:
+        for i in range(480):
+            r = submit(h.stub, client=f"deep-client-{i:04d}", symbol="DEEP",
+                       side=pb2.BUY, price=10_000 - i, qty=1 + i % 7)
+            assert r.success, i
+        book = h.stub.GetOrderBook(pb2.OrderBookRequest(symbol="DEEP"),
+                                   timeout=30)
+        assert len(book.bids) == 480
+        assert book.ByteSize() > H2_MAX_FRAME
+        # Priority order preserved end to end.
+        prices = [o.price for o in book.bids]
+        assert prices == sorted(prices, reverse=True)
+    finally:
+        h.close()
