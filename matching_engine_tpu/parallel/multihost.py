@@ -13,11 +13,13 @@ Deployment model (matching the symbol-sharded design in sharding.py):
   over `jax.distributed.initialize`);
 - `make_multihost_mesh()` builds the 1-D symbol mesh over ALL processes'
   devices (host-major order, via mesh_utils on real topologies);
-- each host's gRPC gateway accepts orders only for the symbol range
-  `local_symbol_slice()` assigns it (a front-end router or client-side
-  hashing keeps symbols home); the engine step itself is pure SPMD — no
-  cross-host traffic during matching, DCN is touched only by the
-  `all_top_of_book` publication gather and by checkpoint collection.
+- each host's serving edges accept orders only for symbols HOMED on it
+  (`symbol_home()` — a stable name hash every host computes identically;
+  slot indices recycle, so ownership must be by name, and foreign-homed
+  submits reject at admission). A front-end router or client-side hashing
+  uses the same function to keep symbols home. The engine step itself is
+  pure SPMD — no cross-host traffic during matching, DCN is touched only
+  by the `all_top_of_book` publication gather and by checkpoint collection.
 
 Single-process multi-device (the test/dev case, and the driver's virtual
 8-device CPU mesh) uses the same code path: `initialize()` no-ops, the mesh
@@ -35,6 +37,8 @@ localhost coordinator, 4+4 virtual CPU devices).
 """
 
 from __future__ import annotations
+
+import zlib
 
 import jax
 import numpy as np
@@ -151,6 +155,19 @@ def make_multihost_mesh(devices=None) -> Mesh:
     except Exception:
         ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
         return Mesh(np.array(ordered), (AXIS,))
+
+
+def symbol_home(symbol: str, n_hosts: int) -> int:
+    """Deterministic symbol -> home-host mapping (stable CRC32 hash).
+
+    Slot indices are DYNAMIC (recycled when books empty), so slot ranges
+    cannot define ownership by name — without a name-based home, two hosts
+    whose slots freed up could each accept the same symbol and maintain
+    divergent books for it. Every host computes the same mapping; the
+    serving edges reject foreign-homed symbols at admission
+    (EngineRunner.owns_symbol), and front-end routers/client hashing use
+    the same function to send orders to the right host."""
+    return zlib.crc32(symbol.encode()) % n_hosts
 
 
 def local_symbol_slice(mesh: Mesh, num_symbols: int) -> slice:

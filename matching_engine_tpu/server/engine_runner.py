@@ -124,14 +124,18 @@ class EngineRunner:
 
             self._sharded = ShardedEngine(cfg, mesh)
             self.book = self._sharded.init_book()
-            # This host may only book symbols whose shard rows live on its
-            # own devices (multi-process: the gateway routes by this range).
+            # Slot ALLOCATION is confined to the rows on this host's own
+            # devices; symbol OWNERSHIP (which host may book a name) is the
+            # separate owns_symbol() hash check — slots recycle, names don't.
             sl = local_symbol_slice(mesh, cfg.num_symbols)
             self._slot_lo, self._slot_hi = sl.start, sl.stop
+            self._n_hosts = jax.process_count()
+            self._host = jax.process_index()
         else:
             self._sharded = None
             self.book = init_book(cfg)
             self._slot_lo, self._slot_hi = 0, cfg.num_symbols
+            self._n_hosts, self._host = 1, 0
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
         self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
@@ -239,6 +243,17 @@ class EngineRunner:
         self.symbols[symbol] = slot
         self.slot_symbols[slot] = symbol
         return slot
+
+    def owns_symbol(self, symbol: str) -> bool:
+        """True when this host is the symbol's home (multi-process routing
+        invariant). Slots are recycled, so ownership must be decided by
+        NAME, not slot availability — otherwise two hosts could each book
+        the same symbol and diverge. Always True single-process."""
+        if self._n_hosts == 1:
+            return True
+        from matching_engine_tpu.parallel.multihost import symbol_home
+
+        return symbol_home(symbol, self._n_hosts) == self._host
 
     def slot_acquire(self, symbol: str) -> int | None:
         """Allocate/find the symbol's slot AND count one live order on it.

@@ -126,6 +126,13 @@ class GatewayBridge:
             for (tag, op, side, otype, price_q4, qty, symbol, client_id,
                  order_id) in recs:
                 if op == 1:  # submit (already validated in C++)
+                    if not runner.owns_symbol(symbol):
+                        self.metrics.inc("orders_rejected")
+                        self.gateway.complete_submit(
+                            tag, False, "",
+                            f"symbol {symbol} is homed on another host",
+                        )
+                        continue
                     if runner.slot_acquire(symbol) is None:
                         self.metrics.inc("orders_rejected")
                         self.gateway.complete_submit(

@@ -49,7 +49,14 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
     runner.seed_oid_sequence(storage.load_next_oid_seq())
     rows = storage.open_orders()
     ops = []
+    skipped_foreign = 0
     for (order_id, client_id, symbol, side, otype, price, qty, remaining, status) in rows:
+        if not runner.owns_symbol(symbol):
+            # Cluster resize moved this symbol's home: do NOT rebook it
+            # here (two hosts would diverge on one name). Its rows stay in
+            # this host's durable store for an operator-driven migration.
+            skipped_foreign += 1
+            continue
         if runner.slot_acquire(symbol) is None:
             print(f"[SERVER] recovery: symbol axis full, dropping {order_id}")
             continue
@@ -62,6 +69,9 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
         runner.orders_by_handle[info.handle] = info
         runner.orders_by_id[order_id] = info
         ops.append(EngineOp(OP_SUBMIT, info))
+    if skipped_foreign:
+        print(f"[SERVER] recovery: {skipped_foreign} open orders belong to "
+              f"symbols homed on other hosts; left in SQLite for migration")
     if ops:
         runner.run_dispatch(ops)
     return len(ops)

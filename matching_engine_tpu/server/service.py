@@ -77,6 +77,10 @@ class MatchingEngineService(MatchingEngineServicer):
         )
 
         err = validate_submit(request)
+        if err is None and not self.runner.owns_symbol(request.symbol):
+            # Multi-process routing invariant: the client (or front-end
+            # router) must send this symbol to its home host.
+            err = f"symbol {request.symbol} is homed on another host"
         # slot_acquire also counts one live order on the slot, so the slot
         # cannot be recycled between this validation and the dispatch.
         if err is None and self.runner.slot_acquire(request.symbol) is None:

@@ -310,6 +310,8 @@ def restore_runner(runner, path: str, storage=None) -> int:
     # handle that is still live on the device.
     sub_ops = []
     for info in sorted(resubmit, key=lambda i: i.oid):
+        if not runner.owns_symbol(info.symbol):
+            continue  # re-homed by a resize; rows stay in SQLite (main.py)
         if runner.slot_acquire(info.symbol) is None:
             continue  # symbol axis full; mirrors recover_books' drop policy
         info.handle = runner.assign_handle()

@@ -1,0 +1,110 @@
+"""Worker for the 2-process FULL-SERVER multihost test.
+
+Each of the two processes boots the complete serving stack
+(build_server: grpcio edge, dispatcher, SQLite sink, streams) over the
+SAME global 8-device mesh, with its own database — the deployment model
+parallel/multihost.py documents. Asserts:
+
+- orders for the host's own symbol range flow end to end (RPC -> sharded
+  dispatch -> fills -> own SQLite),
+- orders for symbols HOMED on the other host are rejected at admission
+  (symbol_home name hash — slot recycling must never let two hosts book
+  the same name),
+- the per-host database audits clean.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    port, pid_s, outdir = sys.argv[1], sys.argv[2], sys.argv[3]
+    pid = int(pid_s)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from matching_engine_tpu.parallel.multihost import (
+        initialize,
+        local_symbol_slice,
+        make_multihost_mesh,
+        symbol_home,
+    )
+
+    assert initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid,
+    )
+    mesh = make_multihost_mesh()
+
+    import grpc
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    S = 8
+    cfg = EngineConfig(num_symbols=S, capacity=16, batch=4, max_fills=256)
+    sl = local_symbol_slice(mesh, S)
+    db = os.path.join(outdir, f"host{pid}.db")
+    server, sport, parts = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1.0, log=False, mesh=mesh,
+    )
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{sport}"))
+
+    def submit(sym, side, qty):
+        return stub.SubmitOrder(
+            pb2.OrderRequest(client_id=f"h{pid}", symbol=sym,
+                             order_type=pb2.LIMIT, side=side, price=10_000,
+                             scale=4, quantity=qty),
+            timeout=60)
+
+    # Ownership is by symbol NAME (stable hash), not slot index — slots
+    # recycle, names don't. Serve the first 4 symbols homed here; pick one
+    # homed on the other host for the rejection probe.
+    candidates = [f"SYM{i}" for i in range(64)]
+    mine = [s for s in candidates if symbol_home(s, 2) == pid][:4]
+    theirs = next(s for s in candidates if symbol_home(s, 2) != pid)
+    assert len(mine) == 4
+
+    fills = 0
+    for sym in mine:
+        r1 = submit(sym, pb2.BUY, 5)
+        r2 = submit(sym, pb2.SELL, 5)
+        assert r1.success and r2.success, (sym, r1.error_message)
+        fills += 1
+    # Foreign-homed symbol: admission must reject — slot recycling must
+    # NOT let this host book a symbol the other host owns.
+    rr = submit(theirs, pb2.BUY, 1)
+    assert not rr.success and "homed on another host" in rr.error_message, rr
+
+    parts["sink"].flush()
+    import sqlite3
+
+    conn = sqlite3.connect(db)
+    n_orders = conn.execute("SELECT COUNT(*) FROM orders").fetchone()[0]
+    n_fills = conn.execute("SELECT COUNT(*) FROM fills").fetchone()[0]
+    conn.close()
+    assert n_orders == 2 * len(mine), n_orders
+    assert n_fills == fills, (n_fills, fills)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    from audit import audit
+
+    assert audit(db) == []
+
+    shutdown(server, parts)
+    with open(os.path.join(outdir, f"srv-ok-{pid}.json"), "w") as f:
+        json.dump({"pid": pid, "orders": n_orders, "fills": n_fills,
+                   "slice": [sl.start, sl.stop]}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,8 @@ import sys
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
+_SERVER_WORKER = os.path.join(os.path.dirname(__file__),
+                              "multiprocess_server_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -65,3 +67,40 @@ def test_two_process_distributed(tmp_path):
     assert results[1]["slice"] == [4, 8]
     assert results[0]["fills"] == 8    # 2 dispatches x 4 symbols
     assert results[1]["fills"] == 12   # 3 dispatches x 4 symbols
+
+
+def test_two_process_full_servers(tmp_path):
+    """The deployment model end to end: two complete serving stacks
+    (grpcio edge, dispatcher, sink, own SQLite each) over ONE distributed
+    mesh — local symbols flow, remote symbols reject at admission, both
+    databases audit clean. See tests/multiprocess_server_worker.py."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _SERVER_WORKER, str(port), str(pid),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("server worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"server worker {pid} failed:\n{out[-4000:]}"
+    for pid in (0, 1):
+        with open(tmp_path / f"srv-ok-{pid}.json") as f:
+            r = json.load(f)
+        assert r["orders"] == 8 and r["fills"] == 4
