@@ -1,0 +1,216 @@
+"""Raw-socket HTTP/2 robustness tests against the C++ gateway.
+
+grpc C-core exercises the happy path (tests/test_gateway.py); these drive
+the frame handling the RFC requires but well-behaved clients rarely send:
+padded frames, CONTINUATION-split header blocks, unknown frame types,
+malformed padding, HPACK garbage, oversized frames. Contract: valid-but-
+unusual frames still serve the RPC; malformed input closes THAT connection
+cleanly while the server keeps serving new ones. The gateway must never
+crash — every test ends by proving the server is still alive.
+"""
+
+import socket
+import struct
+
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+
+from tests.test_gateway import GwHarness
+
+pytestmark = pytest.mark.skipif(
+    not me_native.gateway_available(), reason="native gateway not built"
+)
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+SUBMIT_PATH = "/matching_engine.v1.MatchingEngine/SubmitOrder"
+
+
+@pytest.fixture(scope="module")
+def hs(tmp_path_factory):
+    h = GwHarness(str(tmp_path_factory.mktemp("h2raw") / "h2raw.db"),
+                  cfg=EngineConfig(num_symbols=8, capacity=16, batch=4))
+    yield h
+    h.close()
+
+
+def frame(ftype: int, flags: int, stream: int, payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload))[1:] + bytes([ftype, flags]) + \
+        struct.pack(">I", stream & 0x7FFFFFFF) + payload
+
+
+def hpack_literal(name: bytes, value: bytes) -> bytes:
+    assert len(name) < 127 and len(value) < 127
+    return b"\x00" + bytes([len(name)]) + name + bytes([len(value)]) + value
+
+
+def request_headers() -> bytes:
+    return (hpack_literal(b":method", b"POST")
+            + hpack_literal(b":scheme", b"http")
+            + hpack_literal(b":path", SUBMIT_PATH.encode())
+            + hpack_literal(b"te", b"trailers")
+            + hpack_literal(b"content-type", b"application/grpc"))
+
+
+def grpc_body(symbol=b"RAW", client=b"raw", qty=3) -> bytes:
+    req = pb2.OrderRequest(client_id=client.decode(), symbol=symbol.decode(),
+                           order_type=pb2.LIMIT, side=pb2.BUY, price=10_000,
+                           scale=4, quantity=qty)
+    msg = req.SerializeToString()
+    return b"\x00" + struct.pack(">I", len(msg)) + msg
+
+
+def connect(port: int) -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.settimeout(10)
+    s.sendall(PREFACE + frame(0x4, 0, 0, b""))  # empty SETTINGS
+    return s
+
+
+def read_until_stream_end(s: socket.socket, stream_id: int = 1) -> bytes:
+    """Collects frame payloads until `stream_id` sees END_STREAM; returns
+    every byte received (headers blocks + data) for loose content asserts."""
+    got = b""
+    while True:
+        hdr = b""
+        while len(hdr) < 9:
+            chunk = s.recv(9 - len(hdr))
+            if not chunk:
+                raise ConnectionError("closed before stream end")
+            hdr += chunk
+        length = int.from_bytes(hdr[:3], "big")
+        ftype, flags = hdr[3], hdr[4]
+        sid = int.from_bytes(hdr[5:9], "big") & 0x7FFFFFFF
+        payload = b""
+        while len(payload) < length:
+            chunk = s.recv(length - len(payload))
+            if not chunk:
+                raise ConnectionError("closed mid-frame")
+            payload += chunk
+        got += payload
+        if ftype == 0x4 and not flags & 0x1:
+            s.sendall(frame(0x4, 0x1, 0, b""))  # SETTINGS ack
+        if sid == stream_id and ftype in (0x0, 0x1) and flags & 0x1:
+            return got
+
+
+def assert_server_alive(hs):
+    r = hs.stub.SubmitOrder(
+        pb2.OrderRequest(client_id="alive", symbol="LIVE",
+                         order_type=pb2.LIMIT, side=pb2.BUY, price=10_000,
+                         scale=4, quantity=1), timeout=10)
+    assert r.success
+
+
+def test_plain_raw_request(hs):
+    s = connect(hs.gw_port)
+    hb = request_headers()
+    s.sendall(frame(0x1, 0x4, 1, hb))                       # END_HEADERS
+    s.sendall(frame(0x0, 0x1, 1, grpc_body()))              # END_STREAM
+    got = read_until_stream_end(s)
+    assert b"OID-" in got and b"grpc-status" in got
+    s.close()
+
+
+def test_padded_frames_and_priority(hs):
+    s = connect(hs.gw_port)
+    hb = request_headers()
+    # HEADERS: PADDED(0x8) + PRIORITY(0x20) + END_HEADERS(0x4).
+    pad = 5
+    payload = bytes([pad]) + b"\x00\x00\x00\x02\x10" + hb + b"\x00" * pad
+    s.sendall(frame(0x1, 0x4 | 0x8 | 0x20, 1, payload))
+    body = grpc_body(symbol=b"PADD")
+    s.sendall(frame(0x0, 0x1 | 0x8, 1, bytes([pad]) + body + b"\x00" * pad))
+    got = read_until_stream_end(s)
+    assert b"OID-" in got
+    s.close()
+
+
+def test_continuation_split_headers(hs):
+    s = connect(hs.gw_port)
+    hb = request_headers()
+    third = len(hb) // 3
+    s.sendall(frame(0x1, 0x0, 1, hb[:third]))               # no END_HEADERS
+    s.sendall(frame(0x9, 0x0, 1, hb[third:2 * third]))      # CONTINUATION
+    s.sendall(frame(0x9, 0x4, 1, hb[2 * third:]))           # END_HEADERS
+    s.sendall(frame(0x0, 0x1, 1, grpc_body(symbol=b"CONT")))
+    got = read_until_stream_end(s)
+    assert b"OID-" in got
+    s.close()
+
+
+def test_unknown_frame_type_ignored(hs):
+    s = connect(hs.gw_port)
+    s.sendall(frame(0xBB, 0x7, 0, b"junk-payload"))
+    s.sendall(frame(0x1, 0x4, 1, request_headers()))
+    s.sendall(frame(0x0, 0x1, 1, grpc_body(symbol=b"UNKF")))
+    got = read_until_stream_end(s)
+    assert b"OID-" in got
+    s.close()
+
+
+def recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("closed mid-read")
+        buf += chunk
+    return buf
+
+
+def test_ping_gets_acked(hs):
+    s = connect(hs.gw_port)
+    s.sendall(frame(0x6, 0x0, 0, b"12345678"))
+    while True:
+        hdr = recv_exact(s, 9)
+        length = int.from_bytes(hdr[:3], "big")
+        payload = recv_exact(s, length) if length else b""
+        if hdr[3] == 0x4 and not hdr[4] & 0x1:
+            s.sendall(frame(0x4, 0x1, 0, b""))
+            continue
+        if hdr[3] == 0x6:
+            assert hdr[4] & 0x1 and payload == b"12345678"
+            break
+    s.close()
+
+
+def test_malformed_padding_closes_connection(hs):
+    s = connect(hs.gw_port)
+    # pad length (200) > payload: connection error, clean close.
+    s.sendall(frame(0x1, 0x4 | 0x8, 1, bytes([200]) + b"xx"))
+    with pytest.raises((ConnectionError, socket.timeout, OSError)):
+        read_until_stream_end(s)
+    s.close()
+    assert_server_alive(hs)
+
+
+def test_hpack_garbage_closes_connection(hs):
+    s = connect(hs.gw_port)
+    # 0x80 = indexed field, index 0 — always an HPACK decode error.
+    s.sendall(frame(0x1, 0x4, 1, b"\x80\xff\xff\xff\xff"))
+    with pytest.raises((ConnectionError, socket.timeout, OSError)):
+        read_until_stream_end(s)
+    s.close()
+    assert_server_alive(hs)
+
+
+def test_oversized_frame_closes_connection(hs):
+    s = connect(hs.gw_port)
+    # Declared length 0xFFFFFF (16MB-1) exceeds our sanity cap? The cap is
+    # 1<<24; 0xFFFFFF == (1<<24)-1 passes the cap but the peer never sends
+    # the body — the gateway must not block other connections meanwhile.
+    s.sendall(frame(0x1, 0x4, 1, b"")[:3].replace(b"\x00\x00\x00", b"\xff\xff\xff")
+              + bytes([0x1, 0x4]) + struct.pack(">I", 1))
+    assert_server_alive(hs)  # other connections unaffected
+    s.close()
+    assert_server_alive(hs)
+
+
+def test_immediate_disconnect_mid_frame(hs):
+    s = connect(hs.gw_port)
+    s.sendall(frame(0x1, 0x4, 1, request_headers())[:7])  # truncated header
+    s.close()
+    assert_server_alive(hs)
