@@ -232,6 +232,11 @@ class GatewayBridge:
             self.metrics.ema_gauge("dispatch_us", dur_us)
             self.metrics.observe("dispatch_us", dur_us)
             self.metrics.ema_gauge("dispatch_ops", len(recs))
+            # Surface the C++ edge's counters through GetMetrics.
+            stats = self.gateway.stats()
+            self.metrics.set_gauge("gateway_requests", stats["requests"])
+            self.metrics.set_gauge("gateway_ring_rejects", stats["ring_rejects"])
+            self.metrics.set_gauge("gateway_connections", stats["conns"])
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)

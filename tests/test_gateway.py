@@ -414,3 +414,10 @@ def test_large_book_response(tmp_path_factory):
         assert prices == sorted(prices, reverse=True)
     finally:
         h.close()
+
+
+def test_gateway_metrics_surfaced(hs):
+    submit(hs.stub, client="gm", symbol="GMTR", price=15000, qty=1)
+    m = hs.stub.GetMetrics(pb2.MetricsRequest(), timeout=10)
+    assert m.gauges.get("gateway_requests", 0) > 0
+    assert m.gauges.get("gateway_connections", 0) > 0
