@@ -43,6 +43,8 @@ const char kUsage[] =
     "   or: me_client cancel <addr> <client_id> <order_id>\n"
     "   or: me_client book <addr> <symbol>\n"
     "   or: me_client metrics <addr>\n"
+    "   or: me_client watch-md <addr> <symbol> [max_events]\n"
+    "   or: me_client watch-orders <addr> <client_id> [max_events]\n"
     "   or: me_client bench <addr> <clients> <per_client> [symbols] [inflight]";
 
 int dial(const std::string& addr) {
@@ -285,7 +287,7 @@ class BenchConn {
     if (fd_ >= 0) ::close(fd_);
   }
 
-  // Sends one unary request on a fresh stream id (non-blocking wrt the
+  // Sends one request on a fresh stream id (non-blocking wrt the
   // response); returns the stream id, or 0 on transport failure. Multiple
   // streams may be in flight — HTTP/2 multiplexing is the whole point.
   uint32_t issue(const std::string& path, const std::string& request_bytes) {
@@ -321,109 +323,176 @@ class BenchConn {
   // Blocks until any in-flight stream completes. Returns false on
   // transport failure.
   bool reap(Completion* out) {
-    std::vector<uint8_t> payload;
     for (;;) {
-      uint8_t raw[9];
-      if (!read_exact(fd_, raw, 9)) return false;
-      h2::FrameHeader fh = h2::parse_frame_header(raw);
-      if (fh.length > (1u << 24)) return false;
-      payload.resize(fh.length);
-      if (fh.length && !read_exact(fd_, payload.data(), fh.length)) return false;
-      switch (fh.type) {
-        case h2::F_SETTINGS:
-          if (!(fh.flags & h2::FLAG_ACK)) {
-            std::string ack;
-            h2::write_frame_header(h2::F_SETTINGS, h2::FLAG_ACK, 0, 0, &ack);
-            if (!send_all(fd_, ack)) return false;
-          }
-          break;
-        case h2::F_PING:
-          if (!(fh.flags & h2::FLAG_ACK) && fh.length == 8) {
-            std::string pong;
-            h2::write_frame_header(h2::F_PING, h2::FLAG_ACK, 0, 8, &pong);
-            pong.append(reinterpret_cast<char*>(payload.data()), 8);
-            if (!send_all(fd_, pong)) return false;
-          }
-          break;
-        case h2::F_HEADERS:
-        case h2::F_CONTINUATION: {
-          const uint8_t* p = payload.data();
-          size_t n = payload.size();
-          if (fh.type == h2::F_HEADERS) {
-            if (fh.flags & h2::FLAG_PADDED) {
-              if (n < 1) return false;
-              uint8_t pad = p[0];
-              p += 1;
-              n -= 1;
-              if (pad > n) return false;
-              n -= pad;
-            }
-            if (fh.flags & h2::FLAG_PRIORITY) {
-              if (n < 5) return false;
-              p += 5;
-              n -= 5;
-            }
-          }
-          header_block_.append(reinterpret_cast<const char*>(p), n);
-          if (fh.flags & h2::FLAG_END_HEADERS) {
-            std::vector<h2::Header> hs;
-            if (!hpack_.decode(
-                    reinterpret_cast<const uint8_t*>(header_block_.data()),
-                    header_block_.size(), &hs)) {
-              return false;
-            }
-            header_block_.clear();
-            auto it = inflight_.find(fh.stream_id);
-            if (it != inflight_.end()) {
-              for (auto& h : hs) {
-                if (h.name == "grpc-status")
-                  it->second.grpc_status = std::atoi(h.value.c_str());
-              }
-              if (fh.flags & h2::FLAG_END_STREAM) {
-                fill_completion(it, out);
-                return true;
-              }
-            }
-          }
-          break;
+      for (auto it = inflight_.begin(); it != inflight_.end(); ++it) {
+        if (it->second.ended) {
+          fill_completion(it, out);
+          return true;
         }
-        case h2::F_DATA: {
-          const uint8_t* p = payload.data();
-          size_t n = payload.size();
-          if (fh.flags & h2::FLAG_PADDED) {
-            if (n < 1) return false;
-            uint8_t pad = p[0];
-            p += 1;
-            n -= 1;
-            if (pad > n) return false;
-            n -= pad;
-          }
-          auto it = inflight_.find(fh.stream_id);
-          if (it != inflight_.end()) {
-            it->second.body.append(reinterpret_cast<const char*>(p), n);
-            if (fh.flags & h2::FLAG_END_STREAM) {
-              fill_completion(it, out);
-              return true;
-            }
-          }
-          break;
-        }
-        case h2::F_RST_STREAM:
-        case h2::F_GOAWAY:
-          return false;
-        default:
-          break;
       }
+      if (!pump()) return false;
     }
   }
 
   size_t inflight() const { return inflight_.size(); }
 
+  // Server-streaming reader for stream `sid`: returns 1 and one gRPC
+  // message as it arrives, 0 on end-of-stream (check stream_status()),
+  // -1 on transport error. Unlike reap(), messages surface incrementally.
+  int next_message(uint32_t sid, std::string* out) {
+    for (;;) {
+      auto it = inflight_.find(sid);
+      if (it == inflight_.end()) return -1;
+      std::string& body = it->second.body;
+      if (body.size() >= 5) {
+        uint32_t mlen = (static_cast<uint8_t>(body[1]) << 24) |
+                        (static_cast<uint8_t>(body[2]) << 16) |
+                        (static_cast<uint8_t>(body[3]) << 8) |
+                        static_cast<uint8_t>(body[4]);
+        if (body.size() >= 5 + mlen) {
+          *out = body.substr(5, mlen);
+          body.erase(0, 5 + static_cast<size_t>(mlen));
+          return 1;
+        }
+      }
+      if (it->second.ended) {
+        stream_status_ = it->second.grpc_status;
+        inflight_.erase(it);
+        return 0;
+      }
+      if (!pump()) return -1;
+    }
+  }
+
+  // Trailer grpc-status of the last stream next_message() finished
+  // (0 = OK; >0 = server error the caller must surface).
+  int stream_status() const { return stream_status_; }
+
+  // Watch streams are legitimately idle for minutes: drop the 30s recv
+  // deadline dial() installs for request/response commands.
+  void clear_timeout() {
+    timeval tv{0, 0};
+    ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+
  private:
   struct StreamState {
     std::string body;
     int grpc_status = -1;
+    bool ended = false;   // END_STREAM observed (possibly via trailers)
   };
+
+  // Strips PADDED (+ PRIORITY for HEADERS) per RFC 7540; false = malformed.
+  static bool strip_pad(const h2::FrameHeader& fh, const uint8_t*& p,
+                        size_t& n, bool headers) {
+    if (fh.flags & h2::FLAG_PADDED) {
+      if (n < 1) return false;
+      uint8_t pad = p[0];
+      p += 1;
+      n -= 1;
+      if (pad > n) return false;
+      n -= pad;
+    }
+    if (headers && (fh.flags & h2::FLAG_PRIORITY)) {
+      if (n < 5) return false;
+      p += 5;
+      n -= 5;
+    }
+    return true;
+  }
+
+  bool credit_window(uint32_t sid, size_t nbytes) {
+    // Replenish both receive windows for consumed DATA — without this a
+    // long-lived connection stalls after 64KB of responses and the server
+    // fail-fast-closes it as window-starved.
+    if (nbytes == 0) return true;
+    std::string wu;
+    uint32_t incr = static_cast<uint32_t>(nbytes);
+    for (uint32_t target : {0u, sid}) {
+      h2::write_frame_header(h2::F_WINDOW_UPDATE, 0, target, 4, &wu);
+      wu.push_back(static_cast<char>((incr >> 24) & 0xff));
+      wu.push_back(static_cast<char>((incr >> 16) & 0xff));
+      wu.push_back(static_cast<char>((incr >> 8) & 0xff));
+      wu.push_back(static_cast<char>(incr & 0xff));
+    }
+    return send_all(fd_, wu);
+  }
+
+  // Reads and processes exactly ONE frame (the single demux both reap()
+  // and next_message() drive). Returns false on transport error.
+  bool pump() {
+    uint8_t raw[9];
+    if (!read_exact(fd_, raw, 9)) return false;
+    h2::FrameHeader fh = h2::parse_frame_header(raw);
+    if (fh.length > (1u << 24)) return false;
+    std::vector<uint8_t> payload(fh.length);
+    if (fh.length && !read_exact(fd_, payload.data(), fh.length)) return false;
+    switch (fh.type) {
+      case h2::F_SETTINGS:
+        if (!(fh.flags & h2::FLAG_ACK)) {
+          std::string ack;
+          h2::write_frame_header(h2::F_SETTINGS, h2::FLAG_ACK, 0, 0, &ack);
+          return send_all(fd_, ack);
+        }
+        return true;
+      case h2::F_PING:
+        if (!(fh.flags & h2::FLAG_ACK) && fh.length == 8) {
+          std::string pong;
+          h2::write_frame_header(h2::F_PING, h2::FLAG_ACK, 0, 8, &pong);
+          pong.append(reinterpret_cast<char*>(payload.data()), 8);
+          return send_all(fd_, pong);
+        }
+        return true;
+      case h2::F_HEADERS:
+      case h2::F_CONTINUATION: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (!strip_pad(fh, p, n, fh.type == h2::F_HEADERS)) return false;
+        header_block_.append(reinterpret_cast<const char*>(p), n);
+        if (fh.type == h2::F_HEADERS) {
+          header_sid_ = fh.stream_id;
+          // END_STREAM may ride a HEADERS whose block continues in
+          // CONTINUATION frames — remember it until END_HEADERS.
+          header_es_ = (fh.flags & h2::FLAG_END_STREAM) != 0;
+        }
+        if (fh.flags & h2::FLAG_END_HEADERS) {
+          std::vector<h2::Header> hs;
+          if (!hpack_.decode(
+                  reinterpret_cast<const uint8_t*>(header_block_.data()),
+                  header_block_.size(), &hs)) {
+            return false;
+          }
+          header_block_.clear();
+          auto it = inflight_.find(header_sid_);
+          if (it != inflight_.end()) {
+            for (auto& h : hs) {
+              if (h.name == "grpc-status")
+                it->second.grpc_status = std::atoi(h.value.c_str());
+            }
+            if (header_es_) it->second.ended = true;
+          }
+          header_es_ = false;
+        }
+        return true;
+      }
+      case h2::F_DATA: {
+        const uint8_t* p = payload.data();
+        size_t n = payload.size();
+        if (!strip_pad(fh, p, n, false)) return false;
+        auto it = inflight_.find(fh.stream_id);
+        if (it != inflight_.end()) {
+          it->second.body.append(reinterpret_cast<const char*>(p), n);
+          if (fh.flags & h2::FLAG_END_STREAM) it->second.ended = true;
+        }
+        return credit_window(fh.stream_id, payload.size());
+      }
+      case h2::F_RST_STREAM:
+      case h2::F_GOAWAY:
+        return false;
+      default:
+        return true;  // WINDOW_UPDATE / PRIORITY / unknown: ignore
+    }
+  }
 
   void fill_completion(std::unordered_map<uint32_t, StreamState>::iterator it,
                        Completion* out) {
@@ -444,6 +513,9 @@ class BenchConn {
   uint32_t next_stream_ = 1;
   std::string authority_;
   std::string header_block_;
+  uint32_t header_sid_ = 0;   // stream of the in-progress header block
+  bool header_es_ = false;    // that block's HEADERS carried END_STREAM
+  int stream_status_ = -1;
   h2::HpackDecoder hpack_;
   std::unordered_map<uint32_t, StreamState> inflight_;
 };
@@ -665,6 +737,81 @@ int do_metrics(const std::string& addr) {
 
 }  // namespace
 
+namespace {
+
+// Server-streaming watcher: prints one line per message until the server
+// closes the stream, the connection drops, or max_events arrive
+// (max_events <= 0 = unbounded). Output parity with the Python CLI's
+// watch-md / watch-orders loops.
+int do_watch(const std::string& addr, bool market_data,
+             const std::string& key, long max_events) {
+  std::string request_bytes;
+  std::string path;
+  if (market_data) {
+    pb::MarketDataRequest req;
+    req.set_symbol(key);
+    req.SerializeToString(&request_bytes);
+    path = "/matching_engine.v1.MatchingEngine/StreamMarketData";
+  } else {
+    pb::OrderUpdatesRequest req;
+    req.set_client_id(key);
+    req.SerializeToString(&request_bytes);
+    path = "/matching_engine.v1.MatchingEngine/StreamOrderUpdates";
+  }
+  BenchConn conn;
+  if (!conn.open(addr)) {
+    std::fprintf(stderr, "[client] rpc failed: UNAVAILABLE: connect\n");
+    return 2;
+  }
+  conn.clear_timeout();
+  uint32_t sid = conn.issue(path, request_bytes);
+  if (sid == 0) {
+    std::fprintf(stderr, "[client] rpc failed: send\n");
+    return 2;
+  }
+  long seen = 0;
+  for (;;) {
+    std::string msg;
+    int rc = conn.next_message(sid, &msg);
+    if (rc < 0) {
+      std::fprintf(stderr, "[client] stream closed\n");
+      return 2;
+    }
+    if (rc == 0) {
+      if (conn.stream_status() > 0) {
+        std::fprintf(stderr, "[client] rpc failed: grpc-status=%d\n",
+                     conn.stream_status());
+        return 2;
+      }
+      return 0;  // clean end of stream (trailers)
+    }
+    if (market_data) {
+      pb::MarketDataUpdate u;
+      if (u.ParseFromString(msg)) {
+        std::printf("[md] %s bid=%lld x%lld ask=%lld x%lld (Q%d)\n",
+                    u.symbol().c_str(),
+                    static_cast<long long>(u.best_bid()),
+                    static_cast<long long>(u.bid_size()),
+                    static_cast<long long>(u.best_ask()),
+                    static_cast<long long>(u.ask_size()), u.scale());
+      }
+    } else {
+      pb::OrderUpdate u;
+      if (u.ParseFromString(msg)) {
+        std::printf("[order] %s status=%d fill=%lld@%lld remaining=%lld\n",
+                    u.order_id().c_str(), u.status(),
+                    static_cast<long long>(u.fill_quantity()),
+                    static_cast<long long>(u.fill_price()),
+                    static_cast<long long>(u.remaining_quantity()));
+      }
+    }
+    std::fflush(stdout);
+    if (max_events > 0 && ++seen >= max_events) return 0;
+  }
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   GOOGLE_PROTOBUF_VERIFY_VERSION;
   if (argc == 5 && std::strcmp(argv[1], "cancel") == 0) {
@@ -675,6 +822,12 @@ int main(int argc, char** argv) {
   }
   if (argc == 3 && std::strcmp(argv[1], "metrics") == 0) {
     return do_metrics(argv[2]);
+  }
+  if ((argc == 4 || argc == 5) &&
+      (std::strcmp(argv[1], "watch-md") == 0 ||
+       std::strcmp(argv[1], "watch-orders") == 0)) {
+    return do_watch(argv[2], std::strcmp(argv[1], "watch-md") == 0, argv[3],
+                    argc == 5 ? std::atol(argv[4]) : 0);
   }
   if ((argc >= 5 && argc <= 7) && std::strcmp(argv[1], "bench") == 0) {
     return do_bench(argv[2], std::atoi(argv[3]), std::atoi(argv[4]),

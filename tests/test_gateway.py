@@ -421,3 +421,28 @@ def test_gateway_metrics_surfaced(hs):
     m = hs.stub.GetMetrics(pb2.MetricsRequest(), timeout=10)
     assert m.gauges.get("gateway_requests", 0) > 0
     assert m.gauges.get("gateway_connections", 0) > 0
+
+
+def test_native_client_watch_md(hs):
+    """The C++ client's server-streaming watcher against the C++ gateway."""
+    cli = me_native.client_binary()
+    addr = f"127.0.0.1:{hs.gw_port}"
+    proc = subprocess.Popen([cli, "watch-md", addr, "WTCH", "2"],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    try:
+        time.sleep(0.5)
+        submit(hs.stub, client="w1", symbol="WTCH", side=pb2.BUY,
+               price=21000, qty=3)
+        time.sleep(0.3)
+        submit(hs.stub, client="w2", symbol="WTCH", side=pb2.SELL,
+               price=22000, qty=4)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()  # a missed update must not leak a blocked watcher
+    assert proc.returncode == 0, out
+    lines = [ln for ln in out.splitlines() if ln.startswith("[md]")]
+    assert len(lines) == 2
+    assert "WTCH bid=21000 x3" in lines[0]
+    assert "ask=22000 x4" in lines[1]
