@@ -214,3 +214,25 @@ def test_immediate_disconnect_mid_frame(hs):
     s.sendall(frame(0x1, 0x4, 1, request_headers())[:7])  # truncated header
     s.close()
     assert_server_alive(hs)
+
+
+def test_zero_window_client_fail_fast(hs):
+    """A client advertising INITIAL_WINDOW_SIZE=0 blocks the server's
+    response DATA; the gateway must fail fast (bounded ~3s wait, then
+    close THAT connection) rather than head-of-line-block the shared
+    drain thread forever."""
+    import time as _time
+
+    s = socket.create_connection(("127.0.0.1", hs.gw_port), timeout=30)
+    s.settimeout(30)
+    # SETTINGS: INITIAL_WINDOW_SIZE (0x4) = 0.
+    s.sendall(PREFACE + frame(0x4, 0, 0, b"\x00\x04\x00\x00\x00\x00"))
+    s.sendall(frame(0x1, 0x4, 1, request_headers()))
+    s.sendall(frame(0x0, 0x1, 1, grpc_body(symbol=b"ZWIN")))
+    t0 = _time.monotonic()
+    with pytest.raises((ConnectionError, socket.timeout, OSError)):
+        read_until_stream_end(s)
+    dt = _time.monotonic() - t0
+    assert dt < 15, f"fail-fast took {dt:.1f}s"
+    s.close()
+    assert_server_alive(hs)
