@@ -1,0 +1,190 @@
+"""Sparse dispatch: O(actual ops) host<->device transfer per engine step.
+
+The dense serving step ships a full [S, B] OrderBatch (6 int32 planes) and
+reads back [S, B] result planes even when a dispatch carries a handful of
+orders — at 4096 symbols x batch 32 that is ~3MB up and ~1.5MB down per
+step, pure overhead on the host<->device boundary SURVEY.md §7 calls the
+latency-critical one (and doubly so over the tunneled single-chip setup,
+where that transfer dominates serving latency).
+
+This path ships only the K real ops: [K] coordinate + payload lanes are
+scattered onto the zero [S, B] grid ON DEVICE (padding rows target slot=S
+and are dropped by the scatter), the unchanged dense kernel runs, and the
+per-op results plus each op's symbol top-of-book are GATHERED back at the
+same [K] coordinates. Fills were already compact. K is bucketed to powers
+of two so the jit cache holds ~log2(S*B) programs instead of one per batch
+size.
+
+Semantics are identical to the dense path by construction (same
+engine_step_impl); tests/test_sparse.py asserts bit-equal books, results,
+and fills on randomized streams. The EngineRunner uses this path for
+single-device serving whenever a dispatch is sparse enough to profit
+(engine_runner._run_dispatch_locked); the mesh path keeps dense batches
+(a sharded scatter would need per-shard coordinate routing for no win —
+multi-chip serving amortizes transfers over much larger dispatches).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+)
+from matching_engine_tpu.engine.kernel import engine_step_impl
+
+
+class SparseBatch(NamedTuple):
+    """[K] lanes; padding entries carry slot == num_symbols (scatter-drop)."""
+
+    slot: jax.Array
+    row: jax.Array
+    op: jax.Array
+    side: jax.Array
+    otype: jax.Array
+    price: jax.Array
+    qty: jax.Array
+    oid: jax.Array
+
+
+class SparseStepOutput(NamedTuple):
+    """Per-op results gathered at the op coordinates, [K] each; fills and
+    top-of-book as in StepOutput (fills are already compact). tob_* are the
+    post-step top-of-book of each op's OWN symbol (duplicates when several
+    ops share a symbol — the decoder dedups by slot)."""
+
+    status: jax.Array
+    filled: jax.Array
+    remaining: jax.Array
+    fill_sym: jax.Array
+    fill_taker_oid: jax.Array
+    fill_maker_oid: jax.Array
+    fill_price: jax.Array
+    fill_qty: jax.Array
+    fill_count: jax.Array
+    fill_overflow: jax.Array
+    tob_best_bid: jax.Array
+    tob_bid_size: jax.Array
+    tob_best_ask: jax.Array
+    tob_ask_size: jax.Array
+
+
+def bucket(n: int, floor: int = 64) -> int:
+    """Smallest power-of-two >= n (>= floor) — the static K of the jit."""
+    k = floor
+    while k < n:
+        k <<= 1
+    return k
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def engine_step_sparse(cfg: EngineConfig, book: BookBatch,
+                       sparse: SparseBatch):
+    s, b = cfg.num_symbols, cfg.batch
+    zeros = jnp.zeros((s, b), I32)
+
+    def scatter(vals):
+        # Padding lanes carry slot == s: out-of-bounds -> dropped.
+        return zeros.at[sparse.slot, sparse.row].set(vals, mode="drop")
+
+    dense = OrderBatch(
+        op=scatter(sparse.op), side=scatter(sparse.side),
+        otype=scatter(sparse.otype), price=scatter(sparse.price),
+        qty=scatter(sparse.qty), oid=scatter(sparse.oid),
+    )
+    new_book, out = engine_step_impl(cfg, book, dense)
+
+    gslot = jnp.clip(sparse.slot, 0, s - 1)
+    grow = jnp.clip(sparse.row, 0, b - 1)
+    real = sparse.op != 0
+
+    def gather(plane, pad):
+        return jnp.where(real, plane[gslot, grow], pad)
+
+    def gather_sym(vec):
+        return jnp.where(real, vec[gslot], 0)
+
+    return new_book, SparseStepOutput(
+        status=gather(out.status, -1),
+        filled=gather(out.filled, 0),
+        remaining=gather(out.remaining, 0),
+        fill_sym=out.fill_sym,
+        fill_taker_oid=out.fill_taker_oid,
+        fill_maker_oid=out.fill_maker_oid,
+        fill_price=out.fill_price,
+        fill_qty=out.fill_qty,
+        fill_count=out.fill_count,
+        fill_overflow=out.fill_overflow,
+        tob_best_bid=gather_sym(out.best_bid),
+        tob_bid_size=gather_sym(out.bid_size),
+        tob_best_ask=gather_sym(out.best_ask),
+        tob_ask_size=gather_sym(out.ask_size),
+    )
+
+
+def decode_sparse_step(sparse: SparseBatch, n: int, out: SparseStepOutput):
+    """(results, fills, overflow) — mirror of harness.decode_step, but from
+    [K] lanes: results come back in lane order, which build_sparse already
+    emitted as device (symbol, row) event order."""
+    from matching_engine_tpu.engine.harness import HostResult, decode_fills
+
+    results = [
+        HostResult(*t)
+        for t in zip(
+            np.asarray(sparse.oid[:n]).tolist(),
+            np.asarray(sparse.slot[:n]).tolist(),
+            np.asarray(out.status[:n]).tolist(),
+            np.asarray(out.filled[:n]).tolist(),
+            np.asarray(out.remaining[:n]).tolist(),
+        )
+    ]
+    fills = decode_fills(
+        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
+        out.fill_price, out.fill_qty, int(out.fill_count),
+    )
+    return results, fills, bool(out.fill_overflow)
+
+
+def build_sparse(cfg: EngineConfig, orders) -> list[tuple[SparseBatch, int]]:
+    """Group a chronological HostOrder list into [K]-lane sparse dispatches.
+
+    Same wave semantics as harness.build_batches: orders of one symbol keep
+    arrival order in ascending rows; a symbol's (B+1)-th op overflows into
+    the next wave. Lanes within a wave are emitted in (slot, row) order —
+    the device event order the runner's decode replays — so the gathered
+    results line up 1:1 with the lane index. Returns [(batch, n_real)].
+    """
+    s, b = cfg.num_symbols, cfg.batch
+    waves: list[list] = []
+    counts = np.zeros((s,), dtype=np.int64)
+    for o in orders:
+        if not (-(1 << 31) <= o.oid < (1 << 31)):
+            raise ValueError(f"oid {o.oid} exceeds the int32 device lane")
+        i, row = divmod(int(counts[o.sym]), b)
+        while i >= len(waves):
+            waves.append([])
+        waves[i].append((o.sym, row, o.op, o.side, o.otype, o.price, o.qty,
+                         o.oid))
+        counts[o.sym] += 1
+
+    out = []
+    for wave in waves:
+        wave.sort(key=lambda t: (t[0], t[1]))  # device (symbol, row) order
+        n = len(wave)
+        k = bucket(n)
+        arr = np.zeros((k, 8), dtype=np.int32)
+        arr[:n] = np.asarray(wave, dtype=np.int32)
+        arr[n:, 0] = s  # padding -> scatter-drop coordinate
+        out.append((SparseBatch(
+            slot=arr[:, 0], row=arr[:, 1], op=arr[:, 2], side=arr[:, 3],
+            otype=arr[:, 4], price=arr[:, 5], qty=arr[:, 6], oid=arr[:, 7],
+        ), n))
+    return out

@@ -319,32 +319,83 @@ class EngineRunner:
             )
             by_handle[i.handle] = e
 
-        touched_syms: set[int] = set()
         terminal_makers: set[int] = set()
-        last_out = None
-        for batch in build_batches(self.cfg, host_orders):
-            self._step_num += 1
-            if self._sharded is not None:
-                dev_batch = self._sharded.place_orders(batch)
-                with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                    self.book, out = self._sharded.step(self.book, dev_batch)
-                # Decode from the HOST batch: its op/oid arrays are what
-                # decode reads, and pulling the device copy back would cost
-                # two cross-shard gathers per step for unchanged data.
-                results, fills, overflow = self._sharded.decode(batch, out)
-            else:
-                with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                    self.book, out = engine_step(self.cfg, self.book, batch)
-                results, fills, overflow = decode_step(self.cfg, batch, out)
-            last_out = out
-            if overflow:
-                self.metrics.inc("fill_buffer_overflows")
-            self._decode_batch(results, fills, by_handle, res, terminal_makers)
-            touched_syms.update(r.sym for r in results)
-            res.fill_count += len(fills)
+        # Sparse dispatch: when the batch is far below grid capacity (the
+        # common serving case), ship O(ops) lanes instead of the dense
+        # [S, B] planes — the host<->device transfer is the serving path's
+        # latency-critical boundary (engine/sparse.py). Bit-identical to
+        # the dense step (tests/test_sparse.py).
+        use_sparse = (
+            self._sharded is None
+            and host_orders
+            and len(host_orders) * 4 <= self.cfg.num_symbols * self.cfg.batch
+        )
+        if use_sparse:
+            from matching_engine_tpu.engine.sparse import (
+                build_sparse,
+                decode_sparse_step,
+                engine_step_sparse,
+            )
 
-        if last_out is not None and touched_syms and self._build_md:
-            self._market_data(last_out, touched_syms, res)
+            tob: dict[int, tuple] = {}
+            for sparse, nreal in build_sparse(self.cfg, host_orders):
+                self._step_num += 1
+                with self._snapshot_lock, step_annotation(
+                        "engine_step_sparse", self._step_num):
+                    self.book, out = engine_step_sparse(
+                        self.cfg, self.book, sparse)
+                results, fills, overflow = decode_sparse_step(
+                    sparse, nreal, out)
+                if overflow:
+                    self.metrics.inc("fill_buffer_overflows")
+                self._decode_batch(results, fills, by_handle, res,
+                                   terminal_makers)
+                res.fill_count += len(fills)
+                if self._build_md:
+                    # Later waves overwrite: a symbol untouched by the last
+                    # wave keeps its (still-current) earlier top-of-book.
+                    sl = np.asarray(sparse.slot[:nreal]).tolist()
+                    bb = np.asarray(out.tob_best_bid[:nreal]).tolist()
+                    bs = np.asarray(out.tob_bid_size[:nreal]).tolist()
+                    ba = np.asarray(out.tob_best_ask[:nreal]).tolist()
+                    asz = np.asarray(out.tob_ask_size[:nreal]).tolist()
+                    for i in range(nreal):
+                        tob[sl[i]] = (bb[i], bs[i], ba[i], asz[i])
+            if self._build_md:
+                for s, (b_, bs_, a_, as_) in tob.items():
+                    sym = self.slot_symbols[s]
+                    if sym is None:
+                        continue
+                    res.market_data.append(pb2.MarketDataUpdate(
+                        symbol=sym, best_bid=b_, best_ask=a_, scale=4,
+                        bid_size=bs_, ask_size=as_,
+                    ))
+        else:
+            touched_syms: set[int] = set()
+            last_out = None
+            for batch in build_batches(self.cfg, host_orders):
+                self._step_num += 1
+                if self._sharded is not None:
+                    dev_batch = self._sharded.place_orders(batch)
+                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                        self.book, out = self._sharded.step(self.book, dev_batch)
+                    # Decode from the HOST batch: its op/oid arrays are what
+                    # decode reads, and pulling the device copy back would cost
+                    # two cross-shard gathers per step for unchanged data.
+                    results, fills, overflow = self._sharded.decode(batch, out)
+                else:
+                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                        self.book, out = engine_step(self.cfg, self.book, batch)
+                    results, fills, overflow = decode_step(self.cfg, batch, out)
+                last_out = out
+                if overflow:
+                    self.metrics.inc("fill_buffer_overflows")
+                self._decode_batch(results, fills, by_handle, res, terminal_makers)
+                touched_syms.update(r.sym for r in results)
+                res.fill_count += len(fills)
+
+            if last_out is not None and touched_syms and self._build_md:
+                self._market_data(last_out, touched_syms, res)
 
         # Evict terminal orders from the directories: once FILLED / CANCELED /
         # REJECTED an order can never be referenced by a later fill, book
