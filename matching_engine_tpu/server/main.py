@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import os
 import signal
 import sys
 import threading
@@ -261,6 +262,24 @@ def main(argv=None) -> int:
                    help="also serve through the C++ gRPC gateway on this "
                         "address (port 0 = OS-assigned)")
     args = p.parse_args(argv)
+
+    # Persistent compile cache (same default as benchmarks/bench_child.py):
+    # over the tunneled backend a cold compile costs tens of seconds per
+    # (config, bucket) — a restarted or re-benched server must not pay it
+    # twice. ME_JAX_CACHE overrides; empty disables.
+    cache_dir = os.environ.get(
+        "ME_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+    )
+    if cache_dir:
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:  # noqa: BLE001 — older jax: run uncached
+            pass
 
     try:
         mesh = resolve_mesh(args.mesh, args.symbols)
