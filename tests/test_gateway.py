@@ -446,3 +446,27 @@ def test_native_client_watch_md(hs):
     assert len(lines) == 2
     assert "WTCH bid=21000 x3" in lines[0]
     assert "ask=22000 x4" in lines[1]
+
+
+def test_native_client_watch_orders(hs):
+    cli = me_native.client_binary()
+    addr = f"127.0.0.1:{hs.gw_port}"
+    proc = subprocess.Popen([cli, "watch-orders", addr, "flw2", "2"],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+    try:
+        time.sleep(0.5)
+        r = submit(hs.stub, client="flw2", symbol="WORD", side=pb2.BUY,
+                   price=33000, qty=6)
+        assert r.success
+        submit(hs.stub, client="ctr2", symbol="WORD", side=pb2.SELL,
+               price=33000, qty=6)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out
+    lines = [ln for ln in out.splitlines() if ln.startswith("[order]")]
+    assert len(lines) == 2
+    assert f"{r.order_id} status=0" in lines[0]          # NEW ack
+    assert "status=2" in lines[1] and "remaining=0" in lines[1]  # FILLED
