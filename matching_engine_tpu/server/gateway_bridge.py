@@ -115,128 +115,147 @@ class GatewayBridge:
     # -- hot path: the ring drain loop -------------------------------------
 
     def _run(self) -> None:
-        runner = self.runner
         while not self._stop.is_set():
             recs = self.gateway.pop_batch(self.max_batch, self.window_us)
             if recs is None:
                 return
-            t0 = time.perf_counter()
-            ops: list[EngineOp] = []
-            tags: dict[int, int] = {}  # id(EngineOp) -> gateway tag
-            for (tag, op, side, otype, price_q4, qty, symbol, client_id,
-                 order_id) in recs:
-                if op == 1:  # submit (already validated in C++)
-                    if not runner.owns_symbol(symbol):
-                        self.metrics.inc("orders_rejected")
-                        self.gateway.complete_submit(
-                            tag, False, "",
-                            f"symbol {symbol} is homed on another host",
-                        )
-                        continue
-                    if runner.slot_acquire(symbol) is None:
-                        self.metrics.inc("orders_rejected")
-                        self.gateway.complete_submit(
-                            tag, False, "",
-                            "symbol capacity exhausted (engine symbol axis is full)",
-                        )
-                        continue
-                    oid_num, oid_str = runner.assign_oid()
-                    info = OrderInfo(
-                        oid=oid_num, order_id=oid_str, client_id=client_id,
-                        symbol=symbol, side=side, otype=otype,
-                        price_q4=price_q4, quantity=qty, remaining=qty,
-                        status=0, handle=runner.assign_handle(),
-                    )
-                    e = EngineOp(OP_SUBMIT, info)
-                else:  # cancel — host-side directory checks, as the service does
-                    info = runner.orders_by_id.get(order_id)
-                    if info is None:
-                        self.gateway.complete_cancel(
-                            tag, False, order_id, "unknown order id"
-                        )
-                        continue
-                    if info.client_id != client_id:
-                        self.gateway.complete_cancel(
-                            tag, False, order_id,
-                            "order belongs to a different client",
-                        )
-                        continue
-                    e = EngineOp(OP_CANCEL, info, cancel_requester=client_id)
-                ops.append(e)
-                tags[id(e)] = tag
-
-            if not ops:
-                continue
             try:
-                # Same lock discipline as BatchDispatcher._drain: device step
-                # + sink/hub enqueue under the dispatch lock so checkpoints
-                # see an untorn (book, SQLite, snapshot) state.
-                with runner._dispatch_lock:
-                    result = runner._run_dispatch_locked(ops)
-                    self._publish(result)
-            except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+                self._drain_batch(recs)
+            except Exception as e:  # noqa: BLE001 — the drain thread must
+                # survive ANY per-batch failure (e.g. handle-space
+                # exhaustion raising in the op-build loop): a dead drain
+                # thread strands every gateway client until its deadline.
                 self.metrics.inc("dispatch_errors")
-                print(f"[gw-bridge] dispatch error: {type(e).__name__}: {e}")
-                for op in ops:
-                    tag = tags.get(id(op))
-                    if tag is None:
-                        continue
-                    if op.op == OP_SUBMIT:
+                print(f"[gw-bridge] batch failed: {type(e).__name__}: {e}")
+                for rec in recs:
+                    # Best effort: fail every op in the batch (completing a
+                    # tag twice is a no-op — take_pending already removed it).
+                    if rec[1] == 1:
                         self.gateway.complete_submit(
-                            tag, False, op.info.order_id, "engine error"
-                        )
+                            rec[0], False, "", "engine error")
                     else:
                         self.gateway.complete_cancel(
-                            tag, False, op.info.order_id, "engine error"
-                        )
-                continue
+                            rec[0], False, rec[8], "engine error")
 
-            for outcome in result.outcomes:
-                tag = tags.pop(id(outcome.op), None)
-                if tag is None:
+    def _drain_batch(self, recs) -> None:
+        runner = self.runner
+        t0 = time.perf_counter()
+        ops: list[EngineOp] = []
+        tags: dict[int, int] = {}  # id(EngineOp) -> gateway tag
+        for (tag, op, side, otype, price_q4, qty, symbol, client_id,
+             order_id) in recs:
+            if op == 1:  # submit (already validated in C++)
+                if not runner.owns_symbol(symbol):
+                    self.metrics.inc("orders_rejected")
+                    self.gateway.complete_submit(
+                        tag, False, "",
+                        f"symbol {symbol} is homed on another host",
+                    )
                     continue
-                info = outcome.op.info
-                if outcome.op.op == OP_SUBMIT:
-                    if outcome.status == REJECTED and outcome.error:
-                        self.metrics.inc("orders_rejected")
-                        self.gateway.complete_submit(
-                            tag, False, info.order_id, outcome.error
-                        )
-                    else:
-                        self.metrics.inc("orders_accepted")
-                        self.gateway.complete_submit(tag, True, info.order_id)
-                else:
-                    if outcome.status == CANCELED:
-                        self.metrics.inc("orders_canceled")
-                        self.gateway.complete_cancel(tag, True, info.order_id)
-                    else:
-                        self.gateway.complete_cancel(
-                            tag, False, info.order_id,
-                            outcome.error or "order not open",
-                        )
-            # Any op that produced no outcome: fail loudly rather than hang
-            # the client until its deadline.
+                if runner.slot_acquire(symbol) is None:
+                    self.metrics.inc("orders_rejected")
+                    self.gateway.complete_submit(
+                        tag, False, "",
+                        "symbol capacity exhausted (engine symbol axis is full)",
+                    )
+                    continue
+                oid_num, oid_str = runner.assign_oid()
+                info = OrderInfo(
+                    oid=oid_num, order_id=oid_str, client_id=client_id,
+                    symbol=symbol, side=side, otype=otype,
+                    price_q4=price_q4, quantity=qty, remaining=qty,
+                    status=0, handle=runner.assign_handle(),
+                )
+                e = EngineOp(OP_SUBMIT, info)
+            else:  # cancel — host-side directory checks, as the service does
+                info = runner.orders_by_id.get(order_id)
+                if info is None:
+                    self.gateway.complete_cancel(
+                        tag, False, order_id, "unknown order id"
+                    )
+                    continue
+                if info.client_id != client_id:
+                    self.gateway.complete_cancel(
+                        tag, False, order_id,
+                        "order belongs to a different client",
+                    )
+                    continue
+                e = EngineOp(OP_CANCEL, info, cancel_requester=client_id)
+            ops.append(e)
+            tags[id(e)] = tag
+
+        if not ops:
+            return
+        try:
+            # Same lock discipline as BatchDispatcher._drain: device step
+            # + sink/hub enqueue under the dispatch lock so checkpoints
+            # see an untorn (book, SQLite, snapshot) state.
+            with runner._dispatch_lock:
+                result = runner._run_dispatch_locked(ops)
+                self._publish(result)
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            self.metrics.inc("dispatch_errors")
+            print(f"[gw-bridge] dispatch error: {type(e).__name__}: {e}")
             for op in ops:
-                tag = tags.pop(id(op), None)
+                tag = tags.get(id(op))
                 if tag is None:
                     continue
                 if op.op == OP_SUBMIT:
                     self.gateway.complete_submit(
-                        tag, False, op.info.order_id, "op produced no outcome"
+                        tag, False, op.info.order_id, "engine error"
                     )
                 else:
                     self.gateway.complete_cancel(
-                        tag, False, op.info.order_id, "op produced no outcome"
+                        tag, False, op.info.order_id, "engine error"
                     )
-            dur_us = (time.perf_counter() - t0) * 1e6
-            self.metrics.ema_gauge("dispatch_us", dur_us)
-            self.metrics.observe("dispatch_us", dur_us)
-            self.metrics.ema_gauge("dispatch_ops", len(recs))
-            # Surface the C++ edge's counters through GetMetrics.
-            stats = self.gateway.stats()
-            self.metrics.set_gauge("gateway_requests", stats["requests"])
-            self.metrics.set_gauge("gateway_ring_rejects", stats["ring_rejects"])
-            self.metrics.set_gauge("gateway_connections", stats["conns"])
+            return
+
+        for outcome in result.outcomes:
+            tag = tags.pop(id(outcome.op), None)
+            if tag is None:
+                continue
+            info = outcome.op.info
+            if outcome.op.op == OP_SUBMIT:
+                if outcome.status == REJECTED and outcome.error:
+                    self.metrics.inc("orders_rejected")
+                    self.gateway.complete_submit(
+                        tag, False, info.order_id, outcome.error
+                    )
+                else:
+                    self.metrics.inc("orders_accepted")
+                    self.gateway.complete_submit(tag, True, info.order_id)
+            else:
+                if outcome.status == CANCELED:
+                    self.metrics.inc("orders_canceled")
+                    self.gateway.complete_cancel(tag, True, info.order_id)
+                else:
+                    self.gateway.complete_cancel(
+                        tag, False, info.order_id,
+                        outcome.error or "order not open",
+                    )
+        # Any op that produced no outcome: fail loudly rather than hang
+        # the client until its deadline.
+        for op in ops:
+            tag = tags.pop(id(op), None)
+            if tag is None:
+                continue
+            if op.op == OP_SUBMIT:
+                self.gateway.complete_submit(
+                    tag, False, op.info.order_id, "op produced no outcome"
+                )
+            else:
+                self.gateway.complete_cancel(
+                    tag, False, op.info.order_id, "op produced no outcome"
+                )
+        dur_us = (time.perf_counter() - t0) * 1e6
+        self.metrics.ema_gauge("dispatch_us", dur_us)
+        self.metrics.observe("dispatch_us", dur_us)
+        self.metrics.ema_gauge("dispatch_ops", len(recs))
+        # Surface the C++ edge's counters through GetMetrics.
+        stats = self.gateway.stats()
+        self.metrics.set_gauge("gateway_requests", stats["requests"])
+        self.metrics.set_gauge("gateway_ring_rejects", stats["ring_rejects"])
+        self.metrics.set_gauge("gateway_connections", stats["conns"])
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)
