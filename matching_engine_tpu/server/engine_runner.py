@@ -337,6 +337,7 @@ class EngineRunner:
                 engine_step_sparse,
             )
 
+            self.metrics.inc("sparse_dispatches")
             tob: dict[int, tuple] = {}
             for sparse, nreal in build_sparse(self.cfg, host_orders):
                 self._step_num += 1
@@ -371,6 +372,8 @@ class EngineRunner:
                         bid_size=bs_, ask_size=as_,
                     ))
         else:
+            if host_orders:
+                self.metrics.inc("dense_dispatches")
             touched_syms: set[int] = set()
             last_out = None
             for batch in build_batches(self.cfg, host_orders):

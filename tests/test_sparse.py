@@ -119,3 +119,35 @@ def test_padding_cannot_clobber_slot_zero():
     book = init_book(cfg)
     book, out = engine_step_sparse(cfg, book, sparse)
     assert int(out.status[0]) != -1  # the real op was processed
+
+
+def test_runner_path_selection():
+    """The serving runner uses sparse lanes for small dispatches and the
+    dense grid once a dispatch nears capacity."""
+    from matching_engine_tpu.engine.kernel import OP_SUBMIT
+    from matching_engine_tpu.server.engine_runner import (
+        EngineOp,
+        EngineRunner,
+        OrderInfo,
+    )
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+    runner = EngineRunner(cfg)
+
+    def op(sym, price, n):
+        assert runner.slot_acquire(sym) is not None
+        num, oid = runner.assign_oid()
+        return EngineOp(OP_SUBMIT, OrderInfo(
+            oid=num, order_id=oid, client_id="c", symbol=sym, side=1,
+            otype=0, price_q4=price, quantity=1, remaining=1, status=0,
+            handle=runner.assign_handle()))
+
+    runner.run_dispatch([op("A", 100, 0)])  # 1 op <= 16/4 -> sparse
+    counters = runner.metrics.snapshot()[0]
+    assert counters.get("sparse_dispatches") == 1
+    assert counters.get("dense_dispatches") is None
+
+    ops = [op("B", 100 + i, i) for i in range(8)]  # 8 > 16/4 -> dense
+    runner.run_dispatch(ops)
+    counters = runner.metrics.snapshot()[0]
+    assert counters.get("dense_dispatches") == 1
