@@ -470,3 +470,20 @@ def test_native_client_watch_orders(hs):
     assert len(lines) == 2
     assert f"{r.order_id} status=0" in lines[0]          # NEW ack
     assert "status=2" in lines[1] and "remaining=0" in lines[1]  # FILLED
+
+
+def test_native_client_queries_against_grpcio_server(hs):
+    """book/metrics via our HTTP/2 client against the grpc C-core server —
+    its HPACK encoder Huffman-codes response headers, exercising the
+    client-side decoder the gateway tests don't."""
+    cli = me_native.client_binary()
+    addr = f"127.0.0.1:{hs.port}"
+    r = subprocess.run([cli, addr, "qg", "QGRP", "BUY", "LIMIT", "5150",
+                        "4", "9"], capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0
+    b = subprocess.run([cli, "book", addr, "QGRP"],
+                       capture_output=True, text=True, timeout=30)
+    assert b.returncode == 0 and "bid 5150@Q4 x9" in b.stdout
+    m = subprocess.run([cli, "metrics", addr],
+                       capture_output=True, text=True, timeout=30)
+    assert m.returncode == 0 and "counter orders_accepted" in m.stdout
