@@ -487,3 +487,19 @@ def test_native_client_queries_against_grpcio_server(hs):
     m = subprocess.run([cli, "metrics", addr],
                        capture_output=True, text=True, timeout=30)
     assert m.returncode == 0 and "counter orders_accepted" in m.stdout
+
+
+def test_concurrent_streams_one_channel(hs):
+    """64 in-flight unary calls multiplexed on ONE grpc C-core channel:
+    interleaved HEADERS/DATA frames and concurrent C++-side completions on
+    a single connection must all resolve correctly."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(i):
+        r = submit(hs.stub, client=f"mx{i}", symbol="MUXD",
+                   side=pb2.BUY if i % 2 else pb2.SELL,
+                   price=10_000, qty=1)
+        return r.success
+
+    with ThreadPoolExecutor(max_workers=64) as ex:
+        assert all(ex.map(one, range(64)))
