@@ -152,13 +152,47 @@ def main() -> None:
         if saved is not None:
             os.environ["PALLAS_AXON_POOL_IPS"] = saved
     tpu_error = "; ".join(errors) or "unknown"
+    extra = {}
+    artifact = latest_tpu_artifact()
+    if artifact is not None:
+        # The tunnel wedges for hours at a stretch; a watcher captured a
+        # real-TPU figure during a healthy window earlier (BENCH_METHOD.md
+        # artifact row). Point at it so this fallback line still carries
+        # the hardware evidence.
+        extra["last_tpu_artifact"] = artifact
     if result is not None:
         emit(result.pop("value"), {
-            **result,
+            **result, **extra,
             "error": f"tpu unavailable, CPU-fallback figure: {tpu_error}",
         })
         return
-    emit(0.0, {"error": f"tpu: {tpu_error}; cpu fallback: {err}"})
+    emit(0.0, {**extra, "error": f"tpu: {tpu_error}; cpu fallback: {err}"})
+
+
+def latest_tpu_artifact():
+    """Newest builder-captured real-TPU result under benchmarks/results/
+    (filename + its headline fields), or None."""
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results")
+    best, best_name = None, None
+    try:
+        for name in sorted(os.listdir(root)):
+            if not (name.startswith("tpu_") and name.endswith(".json")):
+                continue
+            with open(os.path.join(root, name)) as f:
+                data = json.load(f)
+            if data.get("platform") in ("tpu", "axon"):
+                best, best_name = data, name
+    except (OSError, ValueError):
+        return None
+    if best is None:
+        return None
+    return {
+        "file": f"benchmarks/results/{best_name}",
+        "value": best.get("value"),
+        "symbols": best.get("symbols"),
+        "mean_dispatch_latency_us": best.get("mean_dispatch_latency_us"),
+    }
 
 
 if __name__ == "__main__":
