@@ -172,19 +172,22 @@ def main() -> None:
 def latest_tpu_artifact():
     """Newest builder-captured real-TPU result under benchmarks/results/
     (filename + its headline fields), or None."""
-    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "results")
+    root = os.path.join(REPO, "benchmarks", "results")
     best, best_name = None, None
     try:
-        for name in sorted(os.listdir(root)):
-            if not (name.startswith("tpu_") and name.endswith(".json")):
-                continue
+        names = sorted(os.listdir(root))
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("tpu_") and name.endswith(".json")):
+            continue
+        try:
             with open(os.path.join(root, name)) as f:
                 data = json.load(f)
-            if data.get("platform") in ("tpu", "axon"):
-                best, best_name = data, name
-    except (OSError, ValueError):
-        return None
+        except (OSError, ValueError):
+            continue  # in-progress/corrupt capture: skip, keep older evidence
+        if isinstance(data, dict) and data.get("platform") in ("tpu", "axon"):
+            best, best_name = data, name
     if best is None:
         return None
     return {
