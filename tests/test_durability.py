@@ -253,3 +253,42 @@ def test_spilling_sink_concurrent_submitters(tmp_path):
         seen[client] = n
     sink.close()
     storage.close()
+
+
+def test_failed_repair_carries_to_next_checkpoint(tmp_path):
+    """A failed apply_repairs (e.g. SQLITE_BUSY) must not lose the drained
+    ledger rows: they carry to the next checkpoint_now and persist then."""
+    from matching_engine_tpu.engine.book import EngineConfig as _Cfg
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+    from matching_engine_tpu.utils.checkpoint import CheckpointDaemon
+
+    runner = EngineRunner(_Cfg(num_symbols=4, capacity=8, batch=4,
+                               max_fills=64))
+    runner.pending_recon.append(("OID-7", "fills_lost", 3))
+
+    class FlakyStorage:
+        def __init__(self):
+            self.calls = []
+            self.fail_first = True
+
+        def apply_repairs(self, repairs, recon):
+            self.calls.append((list(repairs), list(recon)))
+            if self.fail_first:
+                self.fail_first = False
+                return False
+            return True
+
+    class NullSink:
+        def flush(self):
+            pass
+
+    storage = FlakyStorage()
+    daemon = CheckpointDaemon(runner, NullSink(), str(tmp_path / "ck"),
+                              interval_s=3600, storage=storage)
+    daemon.checkpoint_now()   # repair write fails -> carried
+    assert storage.calls[0][1] == [("OID-7", "fills_lost", 3)]
+    assert not runner.pending_recon          # drained from the runner...
+    assert daemon._carry_recon               # ...but held by the daemon
+    daemon.checkpoint_now()   # retried and persisted
+    assert storage.calls[1][1] == [("OID-7", "fills_lost", 3)]
+    assert not daemon._carry_recon
