@@ -7,21 +7,27 @@ step, pure overhead on the host<->device boundary SURVEY.md §7 calls the
 latency-critical one (and doubly so over the tunneled single-chip setup,
 where that transfer dominates serving latency).
 
-This path ships only the K real ops: [K] coordinate + payload lanes are
-scattered onto the zero [S, B] grid ON DEVICE (padding rows target slot=S
-and are dropped by the scatter), the unchanged dense kernel runs, and the
-per-op results plus each op's symbol top-of-book are GATHERED back at the
-same [K] coordinates. Fills were already compact. K is bucketed to powers
-of two so the jit cache holds ~log2(S*B) programs instead of one per batch
-size.
+This path ships only the K real ops, and in as few transfers as possible —
+on the tunneled TPU every host<->device hop is a round trip, so transfer
+COUNT matters as much as bytes:
 
-Semantics are identical to the dense path by construction (same
-engine_step_impl); tests/test_sparse.py asserts bit-equal books, results,
-and fills on randomized streams. The EngineRunner uses this path for
-single-device serving whenever a dispatch is sparse enough to profit
-(engine_runner._run_dispatch_locked); the mesh path keeps dense batches
-(a sharded scatter would need per-shard coordinate routing for no win —
-multi-chip serving amortizes transfers over much larger dispatches).
+- up: ONE [K, 8] int32 lane array (coordinates + payload). The jit unpacks
+  columns on device and scatters them onto the zero [S, B] grid (padding
+  rows target slot=S and are dropped by the scatter).
+- down: ONE packed [7K+2] int32 vector (per-op status/filled/remaining,
+  each op's symbol top-of-book, fill_count, fill_overflow), plus ONE
+  [5, max_fills] slice read only when fills exist (sliced to the actual
+  fill count, so its cost tracks the fills, not the buffer).
+
+The unchanged dense kernel runs in between, so semantics are identical to
+the dense path by construction; tests/test_sparse.py asserts bit-equal
+books, results, and fills on randomized streams. K is bucketed to powers
+of two so the jit cache holds ~log2(S*B) programs instead of one per batch
+size. The EngineRunner uses this path for single-device serving whenever a
+dispatch is sparse enough to profit (engine_runner._run_dispatch_locked);
+the mesh path keeps dense batches (a sharded scatter would need per-shard
+coordinate routing for no win — multi-chip serving amortizes transfers
+over much larger dispatches).
 """
 
 from __future__ import annotations
@@ -41,40 +47,79 @@ from matching_engine_tpu.engine.book import (
 )
 from matching_engine_tpu.engine.kernel import engine_step_impl
 
+# Column layout of the [K, 8] lane array (the ONE upload per sparse step).
+LANE_SLOT, LANE_ROW, LANE_OP, LANE_SIDE = 0, 1, 2, 3
+LANE_OTYPE, LANE_PRICE, LANE_QTY, LANE_OID = 4, 5, 6, 7
+
 
 class SparseBatch(NamedTuple):
-    """[K] lanes; padding entries carry slot == num_symbols (scatter-drop)."""
+    """One sparse dispatch: `lanes` is the packed [K, 8] int32 array;
+    padding rows carry slot == num_symbols (scatter-drop coordinate).
+    Column views are host-side numpy (free — `lanes` is built on host)."""
 
-    slot: jax.Array
-    row: jax.Array
-    op: jax.Array
-    side: jax.Array
-    otype: jax.Array
-    price: jax.Array
-    qty: jax.Array
-    oid: jax.Array
+    lanes: np.ndarray
+
+    @property
+    def slot(self) -> np.ndarray:
+        return self.lanes[:, LANE_SLOT]
+
+    @property
+    def row(self) -> np.ndarray:
+        return self.lanes[:, LANE_ROW]
+
+    @property
+    def op(self) -> np.ndarray:
+        return self.lanes[:, LANE_OP]
+
+    @property
+    def side(self) -> np.ndarray:
+        return self.lanes[:, LANE_SIDE]
+
+    @property
+    def otype(self) -> np.ndarray:
+        return self.lanes[:, LANE_OTYPE]
+
+    @property
+    def price(self) -> np.ndarray:
+        return self.lanes[:, LANE_PRICE]
+
+    @property
+    def qty(self) -> np.ndarray:
+        return self.lanes[:, LANE_QTY]
+
+    @property
+    def oid(self) -> np.ndarray:
+        return self.lanes[:, LANE_OID]
 
 
 class SparseStepOutput(NamedTuple):
-    """Per-op results gathered at the op coordinates, [K] each; fills and
-    top-of-book as in StepOutput (fills are already compact). tob_* are the
-    post-step top-of-book of each op's OWN symbol (duplicates when several
-    ops share a symbol — the decoder dedups by slot)."""
+    """Device-side packed step output — TWO arrays so the host pays at most
+    two read round-trips per step (one when no fills occurred):
 
-    status: jax.Array
-    filled: jax.Array
-    remaining: jax.Array
-    fill_sym: jax.Array
-    fill_taker_oid: jax.Array
-    fill_maker_oid: jax.Array
-    fill_price: jax.Array
-    fill_qty: jax.Array
-    fill_count: jax.Array
-    fill_overflow: jax.Array
-    tob_best_bid: jax.Array
-    tob_bid_size: jax.Array
-    tob_best_ask: jax.Array
-    tob_ask_size: jax.Array
+    small: [7K+2] int32 = status | filled | remaining | tob_best_bid |
+           tob_bid_size | tob_best_ask | tob_ask_size (each [K], gathered
+           at the op coordinates; tob_* duplicate when ops share a symbol)
+           ++ [fill_count, fill_overflow].
+    fills: [5, max_fills] int32, rows in decode_fills column order
+           (sym, taker_oid, maker_oid, price, qty).
+    """
+
+    small: jax.Array
+    fills: jax.Array
+
+
+class SparseDecoded(NamedTuple):
+    """Host view of one sparse step (all numpy, no further transfers)."""
+
+    status: np.ndarray
+    filled: np.ndarray
+    remaining: np.ndarray
+    tob_best_bid: np.ndarray
+    tob_bid_size: np.ndarray
+    tob_best_ask: np.ndarray
+    tob_ask_size: np.ndarray
+    fill_count: int
+    fill_overflow: bool
 
 
 def bucket(n: int, floor: int = 64) -> int:
@@ -86,25 +131,30 @@ def bucket(n: int, floor: int = 64) -> int:
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=1)
-def engine_step_sparse(cfg: EngineConfig, book: BookBatch,
-                       sparse: SparseBatch):
+def _step_sparse_jit(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
     s, b = cfg.num_symbols, cfg.batch
+    slot = lanes[:, LANE_SLOT]
+    row = lanes[:, LANE_ROW]
+    op = lanes[:, LANE_OP]
     zeros = jnp.zeros((s, b), I32)
 
     def scatter(vals):
         # Padding lanes carry slot == s: out-of-bounds -> dropped.
-        return zeros.at[sparse.slot, sparse.row].set(vals, mode="drop")
+        return zeros.at[slot, row].set(vals, mode="drop")
 
     dense = OrderBatch(
-        op=scatter(sparse.op), side=scatter(sparse.side),
-        otype=scatter(sparse.otype), price=scatter(sparse.price),
-        qty=scatter(sparse.qty), oid=scatter(sparse.oid),
+        op=scatter(op),
+        side=scatter(lanes[:, LANE_SIDE]),
+        otype=scatter(lanes[:, LANE_OTYPE]),
+        price=scatter(lanes[:, LANE_PRICE]),
+        qty=scatter(lanes[:, LANE_QTY]),
+        oid=scatter(lanes[:, LANE_OID]),
     )
     new_book, out = engine_step_impl(cfg, book, dense)
 
-    gslot = jnp.clip(sparse.slot, 0, s - 1)
-    grow = jnp.clip(sparse.row, 0, b - 1)
-    real = sparse.op != 0
+    gslot = jnp.clip(slot, 0, s - 1)
+    grow = jnp.clip(row, 0, b - 1)
+    real = op != 0
 
     def gather(plane, pad):
         return jnp.where(real, plane[gslot, grow], pad)
@@ -112,45 +162,75 @@ def engine_step_sparse(cfg: EngineConfig, book: BookBatch,
     def gather_sym(vec):
         return jnp.where(real, vec[gslot], 0)
 
-    return new_book, SparseStepOutput(
-        status=gather(out.status, -1),
-        filled=gather(out.filled, 0),
-        remaining=gather(out.remaining, 0),
-        fill_sym=out.fill_sym,
-        fill_taker_oid=out.fill_taker_oid,
-        fill_maker_oid=out.fill_maker_oid,
-        fill_price=out.fill_price,
-        fill_qty=out.fill_qty,
-        fill_count=out.fill_count,
-        fill_overflow=out.fill_overflow,
-        tob_best_bid=gather_sym(out.best_bid),
-        tob_bid_size=gather_sym(out.bid_size),
-        tob_best_ask=gather_sym(out.best_ask),
-        tob_ask_size=gather_sym(out.ask_size),
+    small = jnp.concatenate([
+        gather(out.status, -1),
+        gather(out.filled, 0),
+        gather(out.remaining, 0),
+        gather_sym(out.best_bid),
+        gather_sym(out.bid_size),
+        gather_sym(out.best_ask),
+        gather_sym(out.ask_size),
+        jnp.stack([
+            out.fill_count.astype(I32),
+            out.fill_overflow.astype(I32),
+        ]),
+    ])
+    fills = jnp.stack([
+        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
+        out.fill_price, out.fill_qty,
+    ])
+    return new_book, SparseStepOutput(small=small, fills=fills)
+
+
+def engine_step_sparse(cfg: EngineConfig, book: BookBatch,
+                       sparse: SparseBatch):
+    return _step_sparse_jit(cfg, book, sparse.lanes)
+
+
+def unpack_sparse_output(out: SparseStepOutput, k: int) -> SparseDecoded:
+    """ONE device->host transfer for everything except the fill log."""
+    small = np.asarray(out.small)
+    return SparseDecoded(
+        status=small[0:k],
+        filled=small[k:2 * k],
+        remaining=small[2 * k:3 * k],
+        tob_best_bid=small[3 * k:4 * k],
+        tob_bid_size=small[4 * k:5 * k],
+        tob_best_ask=small[5 * k:6 * k],
+        tob_ask_size=small[6 * k:7 * k],
+        fill_count=int(small[7 * k]),
+        fill_overflow=bool(small[7 * k + 1]),
     )
 
 
 def decode_sparse_step(sparse: SparseBatch, n: int, out: SparseStepOutput):
-    """(results, fills, overflow) — mirror of harness.decode_step, but from
-    [K] lanes: results come back in lane order, which build_sparse already
-    emitted as device (symbol, row) event order."""
+    """(results, fills, overflow, decoded) — mirror of harness.decode_step,
+    but from [K] lanes: results come back in lane order, which build_sparse
+    already emitted as device (symbol, row) event order. Two transfers max:
+    the packed small vector, and (only when fills occurred) the [5, :n]
+    fill slice."""
     from matching_engine_tpu.engine.harness import HostResult, decode_fills
 
+    k = sparse.lanes.shape[0]
+    dec = unpack_sparse_output(out, k)
     results = [
         HostResult(*t)
         for t in zip(
-            np.asarray(sparse.oid[:n]).tolist(),
-            np.asarray(sparse.slot[:n]).tolist(),
-            np.asarray(out.status[:n]).tolist(),
-            np.asarray(out.filled[:n]).tolist(),
-            np.asarray(out.remaining[:n]).tolist(),
+            sparse.oid[:n].tolist(),
+            sparse.slot[:n].tolist(),
+            dec.status[:n].tolist(),
+            dec.filled[:n].tolist(),
+            dec.remaining[:n].tolist(),
         )
     ]
-    fills = decode_fills(
-        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
-        out.fill_price, out.fill_qty, int(out.fill_count),
-    )
-    return results, fills, bool(out.fill_overflow)
+    fn = dec.fill_count
+    if fn:
+        packed = np.asarray(out.fills[:, :fn])
+        fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
+                             packed[4], fn)
+    else:
+        fills = []
+    return results, fills, dec.fill_overflow, dec
 
 
 def build_sparse(cfg: EngineConfig, orders) -> list[tuple[SparseBatch, int]]:
@@ -182,9 +262,6 @@ def build_sparse(cfg: EngineConfig, orders) -> list[tuple[SparseBatch, int]]:
         k = bucket(n)
         arr = np.zeros((k, 8), dtype=np.int32)
         arr[:n] = np.asarray(wave, dtype=np.int32)
-        arr[n:, 0] = s  # padding -> scatter-drop coordinate
-        out.append((SparseBatch(
-            slot=arr[:, 0], row=arr[:, 1], op=arr[:, 2], side=arr[:, 3],
-            otype=arr[:, 4], price=arr[:, 5], qty=arr[:, 6], oid=arr[:, 7],
-        ), n))
+        arr[n:, LANE_SLOT] = s  # padding -> scatter-drop coordinate
+        out.append((SparseBatch(lanes=arr), n))
     return out

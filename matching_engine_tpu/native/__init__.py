@@ -390,13 +390,22 @@ class NativeGateway:
         n = self._lib.me_gw_pop_batch(self._h, buf, max_ops, window_us)
         if n < 0:
             return None
-        return [
-            (r.tag, r.op, r.side, r.otype, r.price_q4, r.quantity,
-             bytes(r.symbol[:r.symbol_len]).decode(),
-             bytes(r.client_id[:r.client_id_len]).decode(),
-             bytes(r.order_id[:r.order_id_len]).decode())
-            for r in buf[:n]
-        ]
+        out = []
+        for r in buf[:n]:
+            try:
+                out.append(
+                    (r.tag, r.op, r.side, r.otype, r.price_q4, r.quantity,
+                     bytes(r.symbol[:r.symbol_len]).decode(),
+                     bytes(r.client_id[:r.client_id_len]).decode(),
+                     bytes(r.order_id[:r.order_id_len]).decode())
+                )
+            except UnicodeDecodeError:
+                # Per-record failure: a hostile payload surviving the C++
+                # parse must poison only ITS op, never the batch — the
+                # bridge rejects string-fields-None records individually.
+                out.append((r.tag, r.op, r.side, r.otype, r.price_q4,
+                            r.quantity, None, None, None))
+        return out
 
     def complete_submit(self, tag: int, success: bool, order_id: str,
                         error: str = "") -> None:

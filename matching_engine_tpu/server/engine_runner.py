@@ -345,7 +345,7 @@ class EngineRunner:
                         "engine_step_sparse", self._step_num):
                     self.book, out = engine_step_sparse(
                         self.cfg, self.book, sparse)
-                results, fills, overflow = decode_sparse_step(
+                results, fills, overflow, dec = decode_sparse_step(
                     sparse, nreal, out)
                 if overflow:
                     self.metrics.inc("fill_buffer_overflows")
@@ -355,11 +355,12 @@ class EngineRunner:
                 if self._build_md:
                     # Later waves overwrite: a symbol untouched by the last
                     # wave keeps its (still-current) earlier top-of-book.
-                    sl = np.asarray(sparse.slot[:nreal]).tolist()
-                    bb = np.asarray(out.tob_best_bid[:nreal]).tolist()
-                    bs = np.asarray(out.tob_bid_size[:nreal]).tolist()
-                    ba = np.asarray(out.tob_best_ask[:nreal]).tolist()
-                    asz = np.asarray(out.tob_ask_size[:nreal]).tolist()
+                    # All host numpy (decoded from the one packed read).
+                    sl = sparse.slot[:nreal].tolist()
+                    bb = dec.tob_best_bid[:nreal].tolist()
+                    bs = dec.tob_bid_size[:nreal].tolist()
+                    ba = dec.tob_best_ask[:nreal].tolist()
+                    asz = dec.tob_ask_size[:nreal].tolist()
                     for i in range(nreal):
                         tob[sl[i]] = (bb[i], bs[i], ba[i], asz[i])
             if self._build_md:

@@ -116,7 +116,15 @@ class GatewayBridge:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            recs = self.gateway.pop_batch(self.max_batch, self.window_us)
+            try:
+                recs = self.gateway.pop_batch(self.max_batch, self.window_us)
+            except Exception as e:  # noqa: BLE001 — a record that fails
+                # host-side decode (e.g. a non-UTF-8 field surviving the C++
+                # proto parse) must not kill the drain thread; its op is
+                # dropped (client times out) but the edge stays up.
+                self.metrics.inc("dispatch_errors")
+                print(f"[gw-bridge] pop_batch failed: {type(e).__name__}: {e}")
+                continue
             if recs is None:
                 return
             try:
@@ -134,8 +142,10 @@ class GatewayBridge:
                         self.gateway.complete_submit(
                             rec[0], False, "", "engine error")
                     else:
+                        # rec[8] is None for records that failed string
+                        # decode — this fallback must never raise.
                         self.gateway.complete_cancel(
-                            rec[0], False, rec[8], "engine error")
+                            rec[0], False, rec[8] or "", "engine error")
 
     def _drain_batch(self, recs) -> None:
         runner = self.runner
@@ -144,6 +154,15 @@ class GatewayBridge:
         tags: dict[int, int] = {}  # id(EngineOp) -> gateway tag
         for (tag, op, side, otype, price_q4, qty, symbol, client_id,
              order_id) in recs:
+            if symbol is None:  # failed host-side string decode (pop_batch)
+                self.metrics.inc("orders_rejected")
+                if op == 1:
+                    self.gateway.complete_submit(
+                        tag, False, "", "invalid request encoding")
+                else:
+                    self.gateway.complete_cancel(
+                        tag, False, "", "invalid request encoding")
+                continue
             if op == 1:  # submit (already validated in C++)
                 if not runner.owns_symbol(symbol):
                     self.metrics.inc("orders_rejected")

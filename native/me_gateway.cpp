@@ -357,6 +357,18 @@ class Conn : public std::enable_shared_from_this<Conn> {
   }
 
   void window_update(uint32_t sid, uint32_t incr) {
+    // Only track windows for streams that still exist: a peer spraying
+    // WINDOW_UPDATE across arbitrary ids must not grow stream_send_wnd_
+    // without bound. streams_mu is HELD across the fc_mu_ update so a
+    // responder's mark_closed (which erases the entry) cannot interleave
+    // between the open-check and the re-materialization. Nesting order is
+    // streams_mu -> fc_mu_ everywhere; nothing takes them reversed.
+    std::unique_lock<std::mutex> slk(streams_mu, std::defer_lock);
+    if (sid != 0) {
+      slk.lock();
+      auto it = streams.find(sid);
+      if (it == streams.end() || it->second.closed) return;
+    }
     std::lock_guard<std::mutex> lk(fc_mu_);
     if (sid == 0) {
       conn_send_wnd_ += incr;
@@ -418,8 +430,12 @@ class Gateway {
         ring_cap_(ring_cap),
         max_price_q4_(max_price_q4),
         max_quantity_(max_quantity),
-        max_symbol_len_(max_symbol_len),
-        max_client_id_len_(max_client_id_len) {}
+        // Clamp to the MeGwOp record capacity: the validated lengths bound
+        // the memcpy in handle_submit, so a caller passing larger limits
+        // must not be able to turn that into a buffer overflow.
+        max_symbol_len_(std::min<int>(max_symbol_len, sizeof(MeGwOp::symbol))),
+        max_client_id_len_(
+            std::min<int>(max_client_id_len, sizeof(MeGwOp::client_id))) {}
 
   ~Gateway() { shutdown(); }
 

@@ -20,6 +20,7 @@ mkdir -p "$OUT_DIR"
 INTERVAL="${TPU_WATCH_INTERVAL_S:-300}"
 PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT_S:-75}"
 BENCH_TIMEOUT="${TPU_WATCH_BENCH_TIMEOUT_S:-1500}"
+SUITE_TIMEOUT="${TPU_WATCH_SUITE_TIMEOUT_S:-900}"
 MAX_LOOPS="${TPU_WATCH_MAX_LOOPS:-200}"
 
 log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] $*" >>"$LOG"; }
@@ -35,6 +36,19 @@ for _ in $(seq 1 "$MAX_LOOPS"); do
         --json-out "$out" --symbols 4096 --capacity 128 --batch 32 \
         >>"$LOG" 2>&1; then
       log "bench ok: $(cat "$out")"
+      # Same healthy window: capture the suite (configs 1/2/3/5 — parity
+      # gate + device-side rows; config 4 is tpu_e2e_watch.sh's job) so
+      # the round has more than the single headline number on hardware.
+      suite="$OUT_DIR/tpu_suite_${ts}.jsonl"
+      log "running benchmark suite (configs 1,2,3,5)"
+      if timeout "$SUITE_TIMEOUT" python "$REPO/benchmarks/run_all.py" \
+          --configs 1,2,3,5 >"$suite.tmp" 2>>"$LOG"; then
+        mv "$suite.tmp" "$suite"
+        log "suite ok: $(wc -l <"$suite") rows"
+      else
+        log "suite failed rc=$? (suite tmp removed; bench artifact $out kept)"
+        rm -f "$suite.tmp"
+      fi
       exit 0
     fi
     log "bench failed rc=$? (artifact removed; will retry next interval)"

@@ -119,3 +119,42 @@ def test_timer_feeds_gauge():
         pass
     _, gauges = m.snapshot()
     assert "x_us" in gauges and gauges["x_us"] >= 0
+
+
+def test_gateway_bridge_rejects_undecodable_records():
+    """A record whose strings failed host-side decode (pop_batch emits
+    None fields) is rejected individually — the batch's other ops
+    dispatch normally and nothing raises into the drain loop."""
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+    from matching_engine_tpu.server.gateway_bridge import GatewayBridge
+
+    class FakeGateway:
+        def __init__(self):
+            self.completed = []
+
+        def set_callback(self, cb):
+            pass
+
+        def complete_submit(self, tag, ok, oid, err=""):
+            self.completed.append(("submit", tag, ok, err))
+
+        def complete_cancel(self, tag, ok, oid, err=""):
+            self.completed.append(("cancel", tag, ok, err))
+
+        def stats(self):
+            return {"requests": 0, "ring_rejects": 0, "conns": 0}
+
+    gw = FakeGateway()
+    runner = EngineRunner(EngineConfig(num_symbols=4, capacity=16, batch=4,
+                                       max_fills=256))
+    bridge = GatewayBridge(gw, runner, service=None)
+    bridge._drain_batch([
+        (1, 1, 1, 0, 100, 5, None, None, None),     # poisoned submit
+        (2, 2, 0, 0, 0, 0, None, None, None),       # poisoned cancel
+        (3, 1, 1, 0, 100, 5, "OK", "alice", ""),    # healthy submit
+    ])
+    by_tag = {t: (kind, ok, err) for kind, t, ok, err in gw.completed}
+    assert by_tag[1] == ("submit", False, "invalid request encoding")
+    assert by_tag[2] == ("cancel", False, "invalid request encoding")
+    assert by_tag[3][0] == "submit" and by_tag[3][1] is True

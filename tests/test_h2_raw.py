@@ -236,3 +236,22 @@ def test_zero_window_client_fail_fast(hs):
     assert dt < 15, f"fail-fast took {dt:.1f}s"
     s.close()
     assert_server_alive(hs)
+
+
+def test_window_update_spray_is_bounded(hs):
+    """WINDOW_UPDATE frames for streams that were never opened must not
+    accumulate server-side state (me_gateway.cpp window_update ignores
+    unknown/closed streams); the connection keeps serving afterwards."""
+    s = connect(hs.gw_port)
+    spray = b"".join(
+        frame(0x8, 0, sid, struct.pack(">I", 1 << 16))
+        for sid in range(3, 4099, 2)  # 2048 idle client-stream ids
+    )
+    s.sendall(spray)
+    hb = request_headers()
+    s.sendall(frame(0x1, 0x4, 1, hb))
+    s.sendall(frame(0x0, 0x1, 1, grpc_body(symbol=b"WUSP")))
+    got = read_until_stream_end(s)
+    assert b"OID-" in got
+    s.close()
+    assert_server_alive(hs)

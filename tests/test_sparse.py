@@ -18,10 +18,10 @@ from matching_engine_tpu.engine.harness import (
 )
 from matching_engine_tpu.engine.kernel import engine_step
 from matching_engine_tpu.engine.sparse import (
-    SparseBatch,
     bucket,
     build_sparse,
     engine_step_sparse,
+    unpack_sparse_output,
 )
 
 CFG = EngineConfig(num_symbols=16, capacity=32, batch=8, max_fills=1 << 12)
@@ -53,21 +53,19 @@ def run_sparse(cfg, stream):
     results, fills = [], []
     for sparse, n in build_sparse(cfg, stream):
         book, out = engine_step_sparse(cfg, book, sparse)
-        status = np.asarray(out.status[:n])
-        filled = np.asarray(out.filled[:n])
-        remaining = np.asarray(out.remaining[:n])
+        dec = unpack_sparse_output(out, sparse.lanes.shape[0])
         results.extend(zip(
-            np.asarray(sparse.oid[:n]).tolist(),
-            np.asarray(sparse.slot[:n]).tolist(),
-            status.tolist(), filled.tolist(), remaining.tolist(),
+            sparse.oid[:n].tolist(),
+            sparse.slot[:n].tolist(),
+            dec.status[:n].tolist(),
+            dec.filled[:n].tolist(),
+            dec.remaining[:n].tolist(),
         ))
-        fn = int(out.fill_count)
+        fn = dec.fill_count
+        packed = np.asarray(out.fills[:, :fn])
         fills.extend(zip(
-            np.asarray(out.fill_sym[:fn]).tolist(),
-            np.asarray(out.fill_taker_oid[:fn]).tolist(),
-            np.asarray(out.fill_maker_oid[:fn]).tolist(),
-            np.asarray(out.fill_price[:fn]).tolist(),
-            np.asarray(out.fill_qty[:fn]).tolist(),
+            packed[0].tolist(), packed[1].tolist(), packed[2].tolist(),
+            packed[3].tolist(), packed[4].tolist(),
         ))
     return book, results, fills
 
@@ -118,7 +116,8 @@ def test_padding_cannot_clobber_slot_zero():
     assert all(int(x) == cfg.num_symbols for x in np.asarray(sparse.slot[1:]))
     book = init_book(cfg)
     book, out = engine_step_sparse(cfg, book, sparse)
-    assert int(out.status[0]) != -1  # the real op was processed
+    dec = unpack_sparse_output(out, sparse.lanes.shape[0])
+    assert int(dec.status[0]) != -1  # the real op was processed
 
 
 def test_runner_path_selection():
