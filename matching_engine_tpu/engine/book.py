@@ -86,6 +86,18 @@ class OrderBatch(NamedTuple):
     oid: jax.Array
 
 
+def batch_from_lanes(lanes) -> OrderBatch:
+    """THE [..., 6] lane-column layout, shared by the host batch builder
+    (harness.build_batch_arrays writes it), host-side column views
+    (harness.batch_view), and the device-side unpack inside
+    kernel.engine_step_packed — one definition so the three can't drift.
+    Works on numpy (views) and traced jax arrays alike."""
+    return OrderBatch(
+        op=lanes[..., 0], side=lanes[..., 1], otype=lanes[..., 2],
+        price=lanes[..., 3], qty=lanes[..., 4], oid=lanes[..., 5],
+    )
+
+
 class StepOutput(NamedTuple):
     """Engine-step results, sized for a cheap device->host transfer.
 

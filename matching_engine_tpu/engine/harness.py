@@ -14,10 +14,17 @@ import numpy as np
 from matching_engine_tpu.engine.book import (
     BookBatch,
     EngineConfig,
+    batch_from_lanes,
     OrderBatch,
     StepOutput,
 )
-from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_NOOP, OP_SUBMIT, engine_step
+from matching_engine_tpu.engine.kernel import (
+    OP_CANCEL,
+    OP_NOOP,
+    OP_SUBMIT,
+    engine_step_packed,
+    fill_inline_count,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,8 +58,10 @@ class HostResult:
     remaining: int
 
 
-def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch]:
-    """Group a chronological order list into dense [S, B] dispatches.
+def build_batch_arrays(cfg: EngineConfig,
+                       orders: list[HostOrder]) -> list[np.ndarray]:
+    """Group a chronological order list into dense [S, B, 6] dispatch
+    arrays (the packed single-upload form engine_step_packed consumes).
 
     Orders for the same symbol keep their relative order (placed in
     successive batch rows of the same dispatch, overflowing into further
@@ -73,16 +82,19 @@ def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch
             batches.append(np.zeros((s, b, 6), dtype=np.int32))
         batches[i][o.sym, row] = (o.op, o.side, o.otype, o.price, o.qty, o.oid)
         counts[o.sym] += 1
+    return batches
 
-    out = []
-    for arr in batches:
-        out.append(
-            OrderBatch(
-                op=arr[:, :, 0], side=arr[:, :, 1], otype=arr[:, :, 2],
-                price=arr[:, :, 3], qty=arr[:, :, 4], oid=arr[:, :, 5],
-            )
-        )
-    return out
+
+def batch_view(arr: np.ndarray) -> OrderBatch:
+    """Host-side OrderBatch column views of one [S, B, 6] dispatch array
+    (free — numpy views; decode reads op/oid from these)."""
+    return batch_from_lanes(arr)
+
+
+def build_batches(cfg: EngineConfig, orders: list[HostOrder]) -> list[OrderBatch]:
+    """build_batch_arrays, as OrderBatch views (the 6-plane dispatch form
+    engine_step and the sharded path consume)."""
+    return [batch_view(arr) for arr in build_batch_arrays(cfg, orders)]
 
 
 def decode_results(batch: OrderBatch, status, filled, remaining,
@@ -142,15 +154,74 @@ def decode_step(
     return results, fills, bool(out.fill_overflow)
 
 
+class DenseDecoded:
+    """Host view of one packed dense step (all numpy, decoded from the ONE
+    small-vector readback). Attribute names mirror StepOutput."""
+
+    __slots__ = ("status", "filled", "remaining", "best_bid", "bid_size",
+                 "best_ask", "ask_size", "fill_count", "fill_overflow",
+                 "fills_inline")
+
+    def __init__(self, cfg: EngineConfig, small: np.ndarray):
+        s, b = cfg.num_symbols, cfg.batch
+        sb = s * b
+        self.status = small[0:sb].reshape(s, b)
+        self.filled = small[sb:2 * sb].reshape(s, b)
+        self.remaining = small[2 * sb:3 * sb].reshape(s, b)
+        base = 3 * sb
+        self.best_bid = small[base:base + s]
+        self.bid_size = small[base + s:base + 2 * s]
+        self.best_ask = small[base + 2 * s:base + 3 * s]
+        self.ask_size = small[base + 3 * s:base + 4 * s]
+        self.fill_count = int(small[base + 4 * s])
+        self.fill_overflow = bool(small[base + 4 * s + 1])
+        lo = fill_inline_count(cfg)
+        tail = base + 4 * s + 2
+        self.fills_inline = small[tail:tail + 5 * lo].reshape(5, lo)
+
+
+def decode_step_packed(cfg: EngineConfig, batch: OrderBatch, pout):
+    """decode_step for a PackedStepOutput: at most two device->host
+    transfers, both of ALREADY-COMPUTED fixed-shape buffers. Never slice
+    the fill log on device: `fills[:, :n]` is a fresh XLA program per
+    distinct n — on a tunneled chip that is a compile plus an execution
+    round trip per step, ~1000x the cost of fetching the whole buffer and
+    slicing on host."""
+    dec = DenseDecoded(cfg, np.asarray(pout.small))
+    results = decode_results(batch, dec.status, dec.filled, dec.remaining)
+    if dec.fill_count == 0:
+        fills = []
+    else:
+        # Common case: the fill log fit the inline segment — decoded from
+        # the same readback. Only an over-FILL_INLINE dispatch pays the
+        # second (whole-buffer, fixed-shape) fetch.
+        packed = (dec.fills_inline
+                  if dec.fill_count <= dec.fills_inline.shape[1]
+                  else np.asarray(pout.fills))
+        fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
+                             packed[4], dec.fill_count)
+    return results, fills, dec.fill_overflow, dec
+
+
 def apply_orders(
     cfg: EngineConfig, book: BookBatch, orders: list[HostOrder]
 ) -> tuple[BookBatch, list[HostResult], list[HostFill]]:
-    """Run a chronological order list through the kernel; decode everything."""
+    """Run a chronological order list through the kernel; decode everything.
+
+    Dispatch-then-decode: ALL steps are enqueued first (async jit
+    dispatch; the donated book chains them on device), then outputs are
+    decoded in order. The host never synchronizes per step, so the
+    device-side pipeline runs back-to-back — over a tunneled chip a
+    per-step sync costs a full network round trip (~64ms measured), which
+    would otherwise dominate this loop ~100x over the actual compute."""
+    staged: list[tuple[np.ndarray, object]] = []
+    for arr in build_batch_arrays(cfg, orders):
+        book, pout = engine_step_packed(cfg, book, arr)
+        staged.append((arr, pout))
     results: list[HostResult] = []
     fills: list[HostFill] = []
-    for batch in build_batches(cfg, orders):
-        book, out = engine_step(cfg, book, batch)
-        r, f, overflow = decode_step(cfg, batch, out)
+    for arr, pout in staged:
+        r, f, overflow, _ = decode_step_packed(cfg, batch_view(arr), pout)
         assert not overflow, "fill buffer overflow in test harness"
         results.extend(r)
         fills.extend(f)

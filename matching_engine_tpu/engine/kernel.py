@@ -31,6 +31,7 @@ docstring); statuses use proto OrderUpdate.Status values.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -40,6 +41,7 @@ from matching_engine_tpu.engine.book import (
     I32,
     BookBatch,
     EngineConfig,
+    batch_from_lanes,
     OrderBatch,
     StepOutput,
 )
@@ -288,3 +290,63 @@ def finalize_step(
 # in-place in HBM, the book never round-trips to host (SURVEY.md §7
 # "Host<->device pipeline").
 engine_step = jax.jit(engine_step_impl, static_argnums=0, donate_argnums=1)
+
+
+# Leading fill rows inlined into the packed small vector: a dispatch whose
+# fill count fits is decoded from ONE readback (the second, full fill-log
+# fetch costs another network round trip on a tunneled chip — ~64ms
+# measured, independent of size).
+FILL_INLINE = 256
+
+
+def fill_inline_count(cfg: EngineConfig) -> int:
+    return min(cfg.max_fills, FILL_INLINE)
+
+
+class PackedStepOutput(NamedTuple):
+    """StepOutput packed for minimal host readback round-trips (the dense
+    analog of sparse.SparseStepOutput — on a tunneled chip every transfer
+    is a network round trip, so reading ~14 arrays per step costs ~14 RTTs
+    where these cost ONE for any dispatch with <= FILL_INLINE fills, two
+    otherwise):
+
+    small: [3*S*B + 4*S + 2 + 5*L] int32 (L = fill_inline_count(cfg)) =
+           status | filled | remaining (each [S, B], ravelled) ++
+           best_bid | bid_size | best_ask | ask_size (each [S]) ++
+           [fill_count, fill_overflow] ++ fills[:, :L] ravelled.
+    fills: [5, max_fills] int32, rows in harness.decode_fills column order
+           (sym, taker_oid, maker_oid, price, qty) — fetched only when
+           fill_count > L.
+    """
+
+    small: jax.Array
+    fills: jax.Array
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def engine_step_packed(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
+    """engine_step with ONE [S, B, 6] upload (harness.build_batch_arrays
+    layout, unpacked on device) and the output packed into two arrays;
+    decode with harness.decode_step_packed. Semantics identical by
+    construction (same engine_step_impl)."""
+    orders = batch_from_lanes(lanes)
+    new_book, out = engine_step_impl(cfg, book, orders)
+    fills = jnp.stack([
+        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
+        out.fill_price, out.fill_qty,
+    ])
+    small = jnp.concatenate([
+        out.status.reshape(-1),
+        out.filled.reshape(-1),
+        out.remaining.reshape(-1),
+        out.best_bid,
+        out.bid_size,
+        out.best_ask,
+        out.ask_size,
+        jnp.stack([
+            out.fill_count.astype(I32),
+            out.fill_overflow.astype(I32),
+        ]),
+        fills[:, :fill_inline_count(cfg)].reshape(-1),  # static slice
+    ])
+    return new_book, PackedStepOutput(small=small, fills=fills)

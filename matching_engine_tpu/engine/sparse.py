@@ -45,7 +45,10 @@ from matching_engine_tpu.engine.book import (
     EngineConfig,
     OrderBatch,
 )
-from matching_engine_tpu.engine.kernel import engine_step_impl
+from matching_engine_tpu.engine.kernel import (
+    engine_step_impl,
+    fill_inline_count,
+)
 
 # Column layout of the [K, 8] lane array (the ONE upload per sparse step).
 LANE_SLOT, LANE_ROW, LANE_OP, LANE_SIDE = 0, 1, 2, 3
@@ -93,15 +96,17 @@ class SparseBatch(NamedTuple):
 
 
 class SparseStepOutput(NamedTuple):
-    """Device-side packed step output — TWO arrays so the host pays at most
-    two read round-trips per step (one when no fills occurred):
+    """Device-side packed step output — ONE read round-trip per step for
+    any dispatch whose fill count fits the inline segment, two otherwise:
 
-    small: [7K+2] int32 = status | filled | remaining | tob_best_bid |
-           tob_bid_size | tob_best_ask | tob_ask_size (each [K], gathered
-           at the op coordinates; tob_* duplicate when ops share a symbol)
-           ++ [fill_count, fill_overflow].
+    small: [7K + 2 + 5L] int32 (L = fill_inline_count(cfg)) = status |
+           filled | remaining | tob_best_bid | tob_bid_size |
+           tob_best_ask | tob_ask_size (each [K], gathered at the op
+           coordinates; tob_* duplicate when ops share a symbol) ++
+           [fill_count, fill_overflow] ++ fills[:, :L] ravelled.
     fills: [5, max_fills] int32, rows in decode_fills column order
-           (sym, taker_oid, maker_oid, price, qty).
+           (sym, taker_oid, maker_oid, price, qty) — fetched only when
+           fill_count > L.
     """
 
     small: jax.Array
@@ -120,6 +125,7 @@ class SparseDecoded(NamedTuple):
     tob_ask_size: np.ndarray
     fill_count: int
     fill_overflow: bool
+    fills_inline: np.ndarray  # [5, L]
 
 
 def bucket(n: int, floor: int = 64) -> int:
@@ -162,6 +168,10 @@ def _step_sparse_jit(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
     def gather_sym(vec):
         return jnp.where(real, vec[gslot], 0)
 
+    fills = jnp.stack([
+        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
+        out.fill_price, out.fill_qty,
+    ])
     small = jnp.concatenate([
         gather(out.status, -1),
         gather(out.filled, 0),
@@ -174,10 +184,7 @@ def _step_sparse_jit(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
             out.fill_count.astype(I32),
             out.fill_overflow.astype(I32),
         ]),
-    ])
-    fills = jnp.stack([
-        out.fill_sym, out.fill_taker_oid, out.fill_maker_oid,
-        out.fill_price, out.fill_qty,
+        fills[:, :fill_inline_count(cfg)].reshape(-1),  # static slice
     ])
     return new_book, SparseStepOutput(small=small, fills=fills)
 
@@ -188,8 +195,11 @@ def engine_step_sparse(cfg: EngineConfig, book: BookBatch,
 
 
 def unpack_sparse_output(out: SparseStepOutput, k: int) -> SparseDecoded:
-    """ONE device->host transfer for everything except the fill log."""
+    """ONE device->host transfer for everything except an over-inline
+    fill log."""
     small = np.asarray(out.small)
+    lo = (small.shape[0] - 7 * k - 2) // 5
+    tail = 7 * k + 2
     return SparseDecoded(
         status=small[0:k],
         filled=small[k:2 * k],
@@ -200,6 +210,7 @@ def unpack_sparse_output(out: SparseStepOutput, k: int) -> SparseDecoded:
         tob_ask_size=small[6 * k:7 * k],
         fill_count=int(small[7 * k]),
         fill_overflow=bool(small[7 * k + 1]),
+        fills_inline=small[tail:tail + 5 * lo].reshape(5, lo),
     )
 
 
@@ -224,12 +235,18 @@ def decode_sparse_step(sparse: SparseBatch, n: int, out: SparseStepOutput):
         )
     ]
     fn = dec.fill_count
-    if fn:
-        packed = np.asarray(out.fills[:, :fn])
+    if fn == 0:
+        fills = []
+    else:
+        # Common case: fills fit the inline segment of the one small-vector
+        # readback. Otherwise fetch the WHOLE fill buffer and slice on
+        # host — a device-side `fills[:, :fn]` would be a fresh XLA
+        # program per distinct fn (a compile + execution round trip per
+        # dispatch over a tunneled chip).
+        packed = (dec.fills_inline if fn <= dec.fills_inline.shape[1]
+                  else np.asarray(out.fills))
         fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
                              packed[4], fn)
-    else:
-        fills = []
     return results, fills, dec.fill_overflow, dec
 
 

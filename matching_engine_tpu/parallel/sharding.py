@@ -215,8 +215,10 @@ class ShardedEngine:
             local_batch, status, filled, remaining, sym_offset=lo
         )
 
-        # Fills: slice each ADDRESSABLE shard's valid segment on its own
-        # device, then transfer — O(actual local fills), never a global read.
+        # Fills: fetch each ADDRESSABLE shard's buffer whole and slice on
+        # host — never a global read (multi-host), and never a device-side
+        # `[:n]` slice, which is a fresh XLA program per distinct count
+        # (a compile + execution round trip per step on a tunneled chip).
         per = self.cfg.max_fills
         count_by_shard = {
             (s.index[0].start or 0): int(np.asarray(s.data)[0])
@@ -234,13 +236,13 @@ class ShardedEngine:
         for shard in sorted(count_by_shard):
             n = count_by_shard[shard]
             if n == 0:
-                continue
+                continue  # zero-fill shards are never fetched
             fills.extend(decode_fills(
-                fill_shards["fill_sym"][shard],
-                fill_shards["fill_taker_oid"][shard],
-                fill_shards["fill_maker_oid"][shard],
-                fill_shards["fill_price"][shard],
-                fill_shards["fill_qty"][shard],
+                np.asarray(fill_shards["fill_sym"][shard]),
+                np.asarray(fill_shards["fill_taker_oid"][shard]),
+                np.asarray(fill_shards["fill_maker_oid"][shard]),
+                np.asarray(fill_shards["fill_price"][shard]),
+                np.asarray(fill_shards["fill_qty"][shard]),
                 n,
             ))
         overflow = any(

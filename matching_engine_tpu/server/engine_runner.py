@@ -26,7 +26,12 @@ import jax
 import numpy as np
 
 from matching_engine_tpu.engine.book import EngineConfig, OrderBatch, init_book
-from matching_engine_tpu.engine.harness import HostOrder, build_batches, decode_step
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    batch_view,
+    build_batch_arrays,
+    decode_step_packed,
+)
 from matching_engine_tpu.engine.kernel import (
     BUY,
     CANCELED,
@@ -37,7 +42,7 @@ from matching_engine_tpu.engine.kernel import (
     PARTIALLY_FILLED,
     REJECTED,
     SELL,
-    engine_step,
+    engine_step_packed,
 )
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.storage.storage import FillRow
@@ -376,9 +381,10 @@ class EngineRunner:
             if host_orders:
                 self.metrics.inc("dense_dispatches")
             touched_syms: set[int] = set()
-            last_out = None
-            for batch in build_batches(self.cfg, host_orders):
+            last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
+            for arr in build_batch_arrays(self.cfg, host_orders):
                 self._step_num += 1
+                batch = batch_view(arr)
                 if self._sharded is not None:
                     dev_batch = self._sharded.place_orders(batch)
                     with self._snapshot_lock, step_annotation("engine_step", self._step_num):
@@ -388,9 +394,15 @@ class EngineRunner:
                     # two cross-shard gathers per step for unchanged data.
                     results, fills, overflow = self._sharded.decode(batch, out)
                 else:
+                    # Packed single-device step: one [S, B, 6] upload, one
+                    # small-vector readback (+ a fill slice when fills
+                    # occurred) — transfer ROUND TRIPS, not just bytes,
+                    # bound tunneled serving latency.
                     with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                        self.book, out = engine_step(self.cfg, self.book, batch)
-                    results, fills, overflow = decode_step(self.cfg, batch, out)
+                        self.book, pout = engine_step_packed(
+                            self.cfg, self.book, arr)
+                    results, fills, overflow, out = decode_step_packed(
+                        self.cfg, batch, pout)
                 last_out = out
                 if overflow:
                     self.metrics.inc("fill_buffer_overflows")

@@ -49,24 +49,19 @@ def run_dense(cfg, stream):
 
 
 def run_sparse(cfg, stream):
+    from matching_engine_tpu.engine.sparse import decode_sparse_step
+
     book = init_book(cfg)
     results, fills = [], []
     for sparse, n in build_sparse(cfg, stream):
         book, out = engine_step_sparse(cfg, book, sparse)
-        dec = unpack_sparse_output(out, sparse.lanes.shape[0])
-        results.extend(zip(
-            sparse.oid[:n].tolist(),
-            sparse.slot[:n].tolist(),
-            dec.status[:n].tolist(),
-            dec.filled[:n].tolist(),
-            dec.remaining[:n].tolist(),
-        ))
-        fn = dec.fill_count
-        packed = np.asarray(out.fills[:, :fn])
-        fills.extend(zip(
-            packed[0].tolist(), packed[1].tolist(), packed[2].tolist(),
-            packed[3].tolist(), packed[4].tolist(),
-        ))
+        # The real serving decode: exercises both the inline-fill fast
+        # path and the over-inline full-buffer fetch.
+        r, f, _overflow, _dec = decode_sparse_step(sparse, n, out)
+        results.extend((x.oid, x.sym, x.status, x.filled, x.remaining)
+                       for x in r)
+        fills.extend((x.sym, x.taker_oid, x.maker_oid, x.price_q4,
+                      x.quantity) for x in f)
     return book, results, fills
 
 
@@ -150,3 +145,28 @@ def test_runner_path_selection():
     runner.run_dispatch(ops)
     counters = runner.metrics.snapshot()[0]
     assert counters.get("dense_dispatches") == 1
+
+
+def test_over_inline_fill_log_parity():
+    """A single step producing more fills than the inline segment
+    (kernel.FILL_INLINE) must fall back to the full fill-buffer fetch and
+    still decode identically to the dense path."""
+    from matching_engine_tpu.engine.harness import HostOrder
+    from matching_engine_tpu.engine.kernel import FILL_INLINE, OP_SUBMIT
+    from matching_engine_tpu.proto import BUY, LIMIT, SELL
+
+    n_makers = FILL_INLINE + 44
+    cfg = EngineConfig(num_symbols=2, capacity=n_makers + 8, batch=4,
+                       max_fills=2 * n_makers)
+    stream = [
+        HostOrder(sym=0, op=OP_SUBMIT, side=SELL, otype=LIMIT,
+                  price=100, qty=1, oid=i + 1)
+        for i in range(n_makers)
+    ]
+    stream.append(HostOrder(sym=0, op=OP_SUBMIT, side=BUY, otype=LIMIT,
+                            price=100, qty=n_makers, oid=10_000))
+    sbook, sres, sfills = run_sparse(cfg, stream)
+    dbook, dres, dfills = run_dense(cfg, stream)
+    assert len(sfills) == n_makers
+    assert sfills == dfills
+    assert sres == dres
