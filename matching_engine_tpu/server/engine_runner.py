@@ -31,6 +31,7 @@ from matching_engine_tpu.engine.harness import (
     batch_view,
     build_batch_arrays,
     decode_step_packed,
+    run_pipelined,
 )
 from matching_engine_tpu.engine.kernel import (
     BUY,
@@ -301,30 +302,68 @@ class EngineRunner:
         self._build_md = self.hub is None or self.hub.has_market_data_subs()
         host_orders = []
         by_handle: dict[int, EngineOp] = {}
-        for e in ops:
-            i = e.info
-            if e.op == OP_CANCEL and i.status in (FILLED, CANCELED, REJECTED):
-                # The target went terminal (and its handle was recycled)
-                # after this cancel was enqueued — a device cancel now could
-                # hit an unrelated order reusing the handle. Reject on the
-                # host; the device never sees a stale handle.
-                res.outcomes.append(OpOutcome(e, REJECTED, 0, 0, "order not open"))
-                continue
-            slot = self.symbols[i.symbol]  # caller guarantees allocation
-            host_orders.append(
-                HostOrder(
-                    sym=slot,
-                    op=e.op,
-                    side=i.side,
-                    otype=i.otype,
-                    price=i.price_q4,
-                    qty=i.remaining if e.op == OP_SUBMIT else 0,
-                    oid=i.handle,
-                )
-            )
-            by_handle[i.handle] = e
-
         terminal_makers: set[int] = set()
+        try:
+            for e in ops:
+                i = e.info
+                if e.op == OP_CANCEL and i.status in (FILLED, CANCELED,
+                                                      REJECTED):
+                    # The target went terminal (and its handle was recycled)
+                    # after this cancel was enqueued — a device cancel now
+                    # could hit an unrelated order reusing the handle.
+                    # Reject on the host; the device never sees a stale
+                    # handle.
+                    res.outcomes.append(
+                        OpOutcome(e, REJECTED, 0, 0, "order not open"))
+                    continue
+                slot = self.symbols[i.symbol]  # caller guarantees allocation
+                host_orders.append(
+                    HostOrder(
+                        sym=slot,
+                        op=e.op,
+                        side=i.side,
+                        otype=i.otype,
+                        price=i.price_q4,
+                        qty=i.remaining if e.op == OP_SUBMIT else 0,
+                        oid=i.handle,
+                    )
+                )
+                by_handle[i.handle] = e
+                if e.op == OP_SUBMIT:
+                    # Register BEFORE dispatch: with up to PIPELINE_DEPTH
+                    # waves dispatched ahead of the decode cursor, a
+                    # concurrent book_snapshot can see device lanes whose
+                    # wave hasn't decoded yet — any lane visible on device
+                    # must already have a directory entry or the snapshot
+                    # would silently omit acked resting orders.
+                    # (_decode_batch's re-insert of the same OrderInfo
+                    # object is a no-op.)
+                    self.orders_by_handle[i.handle] = i
+                    self.orders_by_id[i.order_id] = i
+
+            self._dispatch_and_decode(ops, host_orders, by_handle, res,
+                                      terminal_makers)
+        except BaseException:
+            # A prep/dispatch/decode failure leaves undecoded ops
+            # maybe-applied on device. Their handles are NOT recycled
+            # (service-layer policy for maybe-enqueued ops) — but the eager
+            # directory entries must go, restoring the pre-registration
+            # state: no outcome => no directory row.
+            done = {id(o.op) for o in res.outcomes}
+            for e in ops:
+                if e.op == OP_SUBMIT and id(e) not in done:
+                    self.orders_by_handle.pop(e.info.handle, None)
+                    self.orders_by_id.pop(e.info.order_id, None)
+            raise
+        self._evict_terminal(ops, res, by_handle, terminal_makers)
+        self.metrics.inc("dispatches")
+        self.metrics.inc("engine_ops", len(ops))
+        self.metrics.inc("fills", res.fill_count)
+        return res
+
+    def _dispatch_and_decode(self, ops, host_orders, by_handle,
+                             res: DispatchResult,
+                             terminal_makers: set[int]) -> None:
         # Sparse dispatch: when the batch is far below grid capacity (the
         # common serving case), ship O(ops) lanes instead of the dense
         # [S, B] planes — the host<->device transfer is the serving path's
@@ -344,19 +383,13 @@ class EngineRunner:
 
             self.metrics.inc("sparse_dispatches")
             tob: dict[int, tuple] = {}
-            for sparse, nreal in build_sparse(self.cfg, host_orders):
-                self._step_num += 1
-                with self._snapshot_lock, step_annotation(
-                        "engine_step_sparse", self._step_num):
-                    self.book, out = engine_step_sparse(
-                        self.cfg, self.book, sparse)
+
+            def decode_sparse(item):
+                sparse, nreal, out = item
                 results, fills, overflow, dec = decode_sparse_step(
                     sparse, nreal, out)
-                if overflow:
-                    self.metrics.inc("fill_buffer_overflows")
-                self._decode_batch(results, fills, by_handle, res,
-                                   terminal_makers)
-                res.fill_count += len(fills)
+                self._account(results, fills, overflow, by_handle, res,
+                              terminal_makers)
                 if self._build_md:
                     # Later waves overwrite: a symbol untouched by the last
                     # wave keeps its (still-current) earlier top-of-book.
@@ -368,6 +401,22 @@ class EngineRunner:
                     asz = dec.tob_ask_size[:nreal].tolist()
                     for i in range(nreal):
                         tob[sl[i]] = (bb[i], bs[i], ba[i], asz[i])
+
+            # Dispatch waves ahead of the decode cursor (the donated book
+            # chains them on device), bounded at PIPELINE_DEPTH so staged
+            # outputs can't pin O(waves) HBM: an inline decode between
+            # dispatches would cost a full sync round trip per extra wave
+            # on a tunneled chip.
+            def dispatch_sparse():
+                for sparse, nreal in build_sparse(self.cfg, host_orders):
+                    self._step_num += 1
+                    with self._snapshot_lock, step_annotation(
+                            "engine_step_sparse", self._step_num):
+                        self.book, out = engine_step_sparse(
+                            self.cfg, self.book, sparse)
+                    yield sparse, nreal, out
+
+            run_pipelined(dispatch_sparse(), decode_sparse)
             if self._build_md:
                 for s, (b_, bs_, a_, as_) in tob.items():
                     sym = self.slot_symbols[s]
@@ -382,37 +431,63 @@ class EngineRunner:
                 self.metrics.inc("dense_dispatches")
             touched_syms: set[int] = set()
             last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
-            for arr in build_batch_arrays(self.cfg, host_orders):
-                self._step_num += 1
-                batch = batch_view(arr)
-                if self._sharded is not None:
-                    dev_batch = self._sharded.place_orders(batch)
-                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                        self.book, out = self._sharded.step(self.book, dev_batch)
-                    # Decode from the HOST batch: its op/oid arrays are what
-                    # decode reads, and pulling the device copy back would cost
-                    # two cross-shard gathers per step for unchanged data.
-                    results, fills, overflow = self._sharded.decode(batch, out)
-                else:
-                    # Packed single-device step: one [S, B, 6] upload, one
-                    # small-vector readback (+ a fill slice when fills
-                    # occurred) — transfer ROUND TRIPS, not just bytes,
-                    # bound tunneled serving latency.
-                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                        self.book, pout = engine_step_packed(
-                            self.cfg, self.book, arr)
-                    results, fills, overflow, out = decode_step_packed(
-                        self.cfg, batch, pout)
+            arrays = build_batch_arrays(self.cfg, host_orders)
+
+            def account_dense(results, fills, overflow, out):
+                nonlocal last_out
                 last_out = out
-                if overflow:
-                    self.metrics.inc("fill_buffer_overflows")
-                self._decode_batch(results, fills, by_handle, res, terminal_makers)
+                self._account(results, fills, overflow, by_handle, res,
+                              terminal_makers)
                 touched_syms.update(r.sym for r in results)
-                res.fill_count += len(fills)
+
+            # Same bounded dispatch-ahead window as the sparse path; only
+            # the dispatch/decode pair differs per deployment shape.
+            if self._sharded is not None:
+
+                def dispatch_dense():
+                    for arr in arrays:
+                        self._step_num += 1
+                        batch = batch_view(arr)
+                        dev_batch = self._sharded.place_orders(batch)
+                        with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                            self.book, out = self._sharded.step(
+                                self.book, dev_batch)
+                        yield batch, out
+
+                def decode_dense(item):
+                    # Decode from the HOST batch: its op/oid arrays are
+                    # what decode reads, and pulling the device copy back
+                    # would cost two cross-shard gathers per step for
+                    # unchanged data.
+                    batch, out = item
+                    account_dense(*self._sharded.decode(batch, out), out)
+            else:
+                # Packed single-device steps: one [S, B, 6] upload and one
+                # small-vector readback each (+ a fill fetch only past the
+                # inline segment) — transfer ROUND TRIPS, not just bytes,
+                # bound tunneled serving latency.
+
+                def dispatch_dense():
+                    for arr in arrays:
+                        self._step_num += 1
+                        with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                            self.book, pout = engine_step_packed(
+                                self.cfg, self.book, arr)
+                        yield arr, pout
+
+                def decode_dense(item):
+                    arr, pout = item
+                    results, fills, overflow, out = decode_step_packed(
+                        self.cfg, batch_view(arr), pout)
+                    account_dense(results, fills, overflow, out)
+
+            run_pipelined(dispatch_dense(), decode_dense)
 
             if last_out is not None and touched_syms and self._build_md:
                 self._market_data(last_out, touched_syms, res)
 
+    def _evict_terminal(self, ops, res: DispatchResult, by_handle,
+                        terminal_makers: set[int]) -> None:
         # Evict terminal orders from the directories: once FILLED / CANCELED /
         # REJECTED an order can never be referenced by a later fill, book
         # snapshot, or legitimate cancel ("unknown order id" and "order not
@@ -431,11 +506,6 @@ class EngineRunner:
             if info is not None and info.status in (FILLED, CANCELED, REJECTED):
                 self._evict(info)
 
-        self.metrics.inc("dispatches")
-        self.metrics.inc("engine_ops", len(ops))
-        self.metrics.inc("fills", res.fill_count)
-        return res
-
     def _evict(self, info: OrderInfo) -> None:
         """Drop a terminal order from the directories; recycle its handle
         and (via the live count) possibly its symbol slot. Idempotent — an
@@ -450,6 +520,16 @@ class EngineRunner:
             self._slot_release(slot)
 
     # -- decoding helpers --------------------------------------------------
+
+    def _account(self, results, fills, overflow, by_handle,
+                 res: DispatchResult, terminal_makers: set[int]) -> None:
+        """The per-wave post-decode tail shared by every dispatch shape
+        (sparse / dense / mesh): overflow metric, directory+event decode,
+        fill accounting."""
+        if overflow:
+            self.metrics.inc("fill_buffer_overflows")
+        self._decode_batch(results, fills, by_handle, res, terminal_makers)
+        res.fill_count += len(fills)
 
     def _decode_batch(
         self, results, fills, by_handle, res: DispatchResult,

@@ -10,6 +10,9 @@ parallel/multihost.py documents. Asserts:
 - orders for symbols HOMED on the other host are rejected at admission
   (symbol_home name hash — slot recycling must never let two hosts book
   the same name),
+- the SAME contract holds through the C++ gateway edge (when the native
+  library is built): a grpcio stub pointed at each host's gateway port
+  books an owned symbol and gets the foreign-symbol reject,
 - the per-host database audits clean.
 """
 
@@ -49,12 +52,16 @@ def main() -> None:
     from matching_engine_tpu.proto.rpc import MatchingEngineStub
     from matching_engine_tpu.server.main import build_server, shutdown
 
+    from matching_engine_tpu import native as me_native
+
     S = 8
     cfg = EngineConfig(num_symbols=S, capacity=16, batch=4, max_fills=256)
     sl = local_symbol_slice(mesh, S)
     db = os.path.join(outdir, f"host{pid}.db")
+    gw_addr = "127.0.0.1:0" if me_native.gateway_available() else None
     server, sport, parts = build_server(
         "127.0.0.1:0", db, cfg, window_ms=1.0, log=False, mesh=mesh,
+        gateway_addr=gw_addr,
     )
     server.start()
     stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{sport}"))
@@ -85,6 +92,27 @@ def main() -> None:
     rr = submit(theirs, pb2.BUY, 1)
     assert not rr.success and "homed on another host" in rr.error_message, rr
 
+    # Same contract through the C++ gateway edge: the bridge enforces
+    # symbol_home ownership before the sharded dispatch ever sees the op.
+    gw_orders = 0
+    if parts.get("gateway_port"):
+        gw = MatchingEngineStub(
+            grpc.insecure_channel(f"127.0.0.1:{parts['gateway_port']}"))
+        g1 = gw.SubmitOrder(
+            pb2.OrderRequest(client_id=f"gw{pid}", symbol=mine[0],
+                             order_type=pb2.LIMIT, side=pb2.BUY,
+                             price=9_000, scale=4, quantity=1),
+            timeout=60)
+        assert g1.success, g1.error_message
+        gw_orders = 1
+        g2 = gw.SubmitOrder(
+            pb2.OrderRequest(client_id=f"gw{pid}", symbol=theirs,
+                             order_type=pb2.LIMIT, side=pb2.BUY,
+                             price=9_000, scale=4, quantity=1),
+            timeout=60)
+        assert not g2.success, g2
+        assert "homed on another host" in g2.error_message, g2.error_message
+
     parts["sink"].flush()
     import sqlite3
 
@@ -92,7 +120,7 @@ def main() -> None:
     n_orders = conn.execute("SELECT COUNT(*) FROM orders").fetchone()[0]
     n_fills = conn.execute("SELECT COUNT(*) FROM fills").fetchone()[0]
     conn.close()
-    assert n_orders == 2 * len(mine), n_orders
+    assert n_orders == 2 * len(mine) + gw_orders, n_orders
     assert n_fills == fills, (n_fills, fills)
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
@@ -103,6 +131,7 @@ def main() -> None:
     shutdown(server, parts)
     with open(os.path.join(outdir, f"srv-ok-{pid}.json"), "w") as f:
         json.dump({"pid": pid, "orders": n_orders, "fills": n_fills,
+                   "gateway_ran": gw_orders > 0,
                    "slice": [sl.start, sl.stop]}, f)
 
 

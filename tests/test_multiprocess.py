@@ -103,4 +103,12 @@ def test_two_process_full_servers(tmp_path):
     for pid in (0, 1):
         with open(tmp_path / f"srv-ok-{pid}.json") as f:
             r = json.load(f)
-        assert r["orders"] == 8 and r["fills"] == 4
+        # 8 grpcio-edge orders, +1 via the C++ gateway edge when it ran —
+        # the worker reports which, so a silently-skipped gateway leg on a
+        # machine where the library IS built cannot masquerade as a pass.
+        from matching_engine_tpu import native as me_native
+
+        expected = 9 if r["gateway_ran"] else 8
+        assert r["orders"] == expected and r["fills"] == 4
+        if me_native.gateway_available():
+            assert r["gateway_ran"], "native gateway built but leg skipped"
