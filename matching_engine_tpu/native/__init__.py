@@ -106,6 +106,11 @@ def _load():
             ctypes.c_uint64,
         ]
         lib.me_ring_pop_batch.restype = ctypes.c_int
+        lib.me_ring_pop_batch_timed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MeOp), ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_int64,
+        ]
+        lib.me_ring_pop_batch_timed.restype = ctypes.c_int
         lib.me_ring_close.argtypes = [ctypes.c_void_p]
         lib.me_ring_dropped.argtypes = [ctypes.c_void_p]
         lib.me_ring_dropped.restype = ctypes.c_uint64
@@ -193,9 +198,11 @@ class NativeRing:
                    price=price, qty=qty, oid=oid, pad=0)
         return bool(self._lib.me_ring_push(self._h, ctypes.byref(rec)))
 
-    def pop_batch(self, max_ops: int, window_us: int):
-        """Blocks for the first op, then drains up to (max_ops, window_us).
-        Returns a list of MeOp field tuples, or None when closed+empty.
+    def pop_batch(self, max_ops: int, window_us: int,
+                  first_wait_us: int = -1):
+        """Blocks for the first op (bounded when first_wait_us >= 0), then
+        drains up to (max_ops, window_us). Returns a list of MeOp field
+        tuples, [] on first-wait timeout, or None when closed+empty.
 
         The output buffer is allocated once and reused — the ring has a
         single consumer, and max_ops can be thousands of 40-byte records per
@@ -205,7 +212,8 @@ class NativeRing:
         buf = self._buf
         if buf is None or len(buf) < max_ops:
             buf = self._buf = (MeOp * max_ops)()
-        n = self._lib.me_ring_pop_batch(self._h, buf, max_ops, window_us)
+        n = self._lib.me_ring_pop_batch_timed(self._h, buf, max_ops,
+                                              window_us, first_wait_us)
         if n < 0:
             return None
         return [
@@ -284,6 +292,11 @@ def _load_gateway():
         lib.me_gateway_port.argtypes = [ctypes.c_void_p]
         lib.me_gateway_port.restype = ctypes.c_int
         lib.me_gateway_set_callback.argtypes = [ctypes.c_void_p, GW_CALLBACK]
+        lib.me_gw_pop_batch_timed.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(MeGwOp), ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_int64,
+        ]
+        lib.me_gw_pop_batch_timed.restype = ctypes.c_int
         lib.me_gw_pop_batch.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(MeGwOp), ctypes.c_uint32,
             ctypes.c_uint64,
@@ -378,16 +391,19 @@ class NativeGateway:
         self._cb_ref = GW_CALLBACK(_trampoline)
         self._lib.me_gateway_set_callback(self._h, self._cb_ref)
 
-    def pop_batch(self, max_ops: int, window_us: int):
-        """Blocks for the first op, drains to (max_ops, window_us).
-        Returns a list of (tag, op, side, otype, price_q4, quantity,
-        symbol, client_id, order_id) or None when shut down."""
+    def pop_batch(self, max_ops: int, window_us: int,
+                  first_wait_us: int = -1):
+        """Blocks for the first op (bounded when first_wait_us >= 0),
+        drains to (max_ops, window_us). Returns a list of (tag, op, side,
+        otype, price_q4, quantity, symbol, client_id, order_id), [] on
+        first-wait timeout, or None when shut down."""
         if self._h is None:
             return None
         buf = self._buf
         if buf is None or len(buf) < max_ops:
             buf = self._buf = (MeGwOp * max_ops)()
-        n = self._lib.me_gw_pop_batch(self._h, buf, max_ops, window_us)
+        n = self._lib.me_gw_pop_batch_timed(self._h, buf, max_ops,
+                                            window_us, first_wait_us)
         if n < 0:
             return None
         out = []

@@ -94,8 +94,19 @@ class BatchDispatcher:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            first = self._q.get()
+            try:
+                # While a staged dispatch is pending on the runner, wake at
+                # window granularity so an idle lull finishes (decodes +
+                # completes) it instead of stranding its clients until the
+                # next op arrives.
+                first = self._q.get(
+                    timeout=self.window_s if self.runner.has_pending else None
+                )
+            except queue.Empty:
+                self.runner.finish_pending()
+                continue
             if first is None:
+                self.runner.finish_pending()
                 return
             batch = [first]
             deadline = time.perf_counter() + self.window_s
@@ -109,46 +120,62 @@ class BatchDispatcher:
                     break
                 if item is None:
                     self._drain(batch)
+                    self.runner.finish_pending()
                     return
                 batch.append(item)
             self._drain(batch)
+        self.runner.finish_pending()
 
     def _drain(self, batch) -> None:
         t0 = time.perf_counter()
         ops = [op for op, _ in batch]
         futs = {id(op): fut for op, fut in batch}
-        try:
-            # The dispatch lock is held across BOTH the device step and the
-            # sink/hub enqueue: CheckpointDaemon.checkpoint_now acquires the
-            # same lock, then flushes the sink, then snapshots — so a batch
-            # can never be applied to the book yet invisible to the flush
-            # barrier (the snapshot would be ahead of SQLite and restore
-            # could resurrect canceled orders).
-            with self.runner._dispatch_lock:
-                result = self.runner._run_dispatch_locked(ops)
-                self._publish(result)
-        except Exception as e:  # noqa: BLE001 — fail the futures, not the loop
-            for _, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
-            self.metrics.inc("dispatch_errors")
-            return
 
-        # Futures resolve only after the storage batch is enqueued, so a
-        # client that sees its response and then calls sink.flush() is
-        # guaranteed the flush barrier covers its batch (read-your-writes).
-        for outcome in result.outcomes:
-            fut = futs.get(id(outcome.op))
-            if fut is not None and not fut.done():
-                fut.set_result(outcome)
-        # Any op the decode somehow missed: fail loudly rather than hang.
-        for op, fut in batch:
-            if not fut.done():
-                fut.set_exception(RuntimeError("op produced no outcome"))
-        dur_us = (time.perf_counter() - t0) * 1e6
-        self.metrics.ema_gauge("dispatch_us", dur_us)
-        self.metrics.observe("dispatch_us", dur_us)  # -> dispatch_us_p50/p99
-        self.metrics.ema_gauge("dispatch_ops", len(batch))
+        def on_finish(result, error):
+            # Runs under the dispatch lock when this batch's results are
+            # decoded (possibly a later drain iteration, an idle wakeup, a
+            # checkpoint quiesce, or shutdown). The lock is held across
+            # BOTH the device decode and the sink/hub enqueue:
+            # CheckpointDaemon.checkpoint_now acquires the same lock, then
+            # flushes the sink, then snapshots — so a batch can never be
+            # applied to the book yet invisible to the flush barrier (the
+            # snapshot would be ahead of SQLite and restore could
+            # resurrect canceled orders). The returned thunk (future
+            # completions) runs after the lock is released.
+            if error is not None:
+                def fail():
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(error)
+                    self.metrics.inc("dispatch_errors")
+                return fail
+            self._publish(result)
+
+            def complete():
+                # Futures resolve only after the storage batch is
+                # enqueued, so a client that sees its response and then
+                # calls sink.flush() is guaranteed the flush barrier
+                # covers its batch (read-your-writes).
+                for outcome in result.outcomes:
+                    fut = futs.get(id(outcome.op))
+                    if fut is not None and not fut.done():
+                        fut.set_result(outcome)
+                # Any op the decode missed: fail loudly rather than hang.
+                for op, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError("op produced no outcome"))
+                # dispatch_us = batch TURNAROUND (drain start ->
+                # completion), which under pipelining includes up to one
+                # batching window of pipeline residency — the client-felt
+                # figure. Pure engine time is engine_dispatch_us.
+                dur_us = (time.perf_counter() - t0) * 1e6
+                self.metrics.ema_gauge("dispatch_us", dur_us)
+                self.metrics.observe("dispatch_us", dur_us)  # -> p50/p99
+                self.metrics.ema_gauge("dispatch_ops", len(batch))
+            return complete
+
+        self.runner.dispatch_pipelined(ops, on_finish)
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)
@@ -226,9 +253,15 @@ class NativeRingDispatcher(BatchDispatcher):
     def _run(self) -> None:
         window_us = max(1, int(self.window_s * 1e6))
         while not self._stop.is_set():
-            recs = self._ring.pop_batch(self.max_batch, window_us)
+            recs = self._ring.pop_batch(
+                self.max_batch, window_us,
+                window_us if self.runner.has_pending else -1,
+            )
             if recs is None:
-                return
+                break
+            if not recs:  # idle lull with a staged dispatch: finish it
+                self.runner.finish_pending()
+                continue
             batch = []
             with self._tag_lock:
                 for rec in recs:
@@ -237,3 +270,4 @@ class NativeRingDispatcher(BatchDispatcher):
                         batch.append(ent)
             if batch:
                 self._drain(batch)
+        self.runner.finish_pending()

@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from collections import deque
 
 import jax
 import numpy as np
 
 from matching_engine_tpu.engine.book import EngineConfig, OrderBatch, init_book
 from matching_engine_tpu.engine.harness import (
+    PIPELINE_DEPTH,
     HostOrder,
     batch_view,
     build_batch_arrays,
@@ -104,6 +106,28 @@ class DispatchResult:
     fill_count: int
 
 
+class _Staged:
+    """One dispatch's in-flight state between stage (device waves issued)
+    and finish (decode + publish + eviction). `deferred` means every wave
+    is already dispatched and `items` holds their undecoded outputs."""
+
+    __slots__ = ("ops", "by_handle", "res", "terminal_makers",
+                 "dispatch_iter", "decode_fn", "finalize_fn", "items",
+                 "deferred")
+
+    def __init__(self, ops, by_handle, res, terminal_makers, dispatch_iter,
+                 decode_fn, finalize_fn, deferred):
+        self.ops = ops
+        self.by_handle = by_handle
+        self.res = res
+        self.terminal_makers = terminal_makers
+        self.dispatch_iter = dispatch_iter
+        self.decode_fn = decode_fn
+        self.finalize_fn = finalize_fn
+        self.items: deque = deque()
+        self.deferred = deferred
+
+
 class EngineRunner:
     """Owns the device books + host order directories.
 
@@ -169,6 +193,9 @@ class EngineRunner:
         # the ledger itself is counted and the tail dropped.
         self.pending_recon: list[tuple[str, str, int]] = []
         self._recon_cap = 100_000
+        # Cross-dispatch pipelining: the one staged-but-undecoded dispatch
+        # (see dispatch_pipelined) with its finish callback.
+        self._pending: tuple[_Staged, object] | None = None
         # Constructor-wired (build_server passes the StreamHub the
         # dispatchers publish to): lets the decode skip CONSTRUCTING stream
         # protos (per-fill OrderUpdates, per-symbol MarketDataUpdates) when
@@ -291,10 +318,117 @@ class EngineRunner:
 
     def run_dispatch(self, ops: list[EngineOp]) -> DispatchResult:
         """Apply ops to the device books and decode all consequences."""
+        posts: list = []
         with self._dispatch_lock, Timer(self.metrics, "engine_dispatch_us"):
-            return self._run_dispatch_locked(ops)
+            self._finish_pending_locked(posts)
+            result = self._run_dispatch_locked(ops)
+        for p in posts:
+            p()
+        return result
+
+    # -- cross-dispatch pipelining ----------------------------------------
+    #
+    # The serving drain loops overlap consecutive dispatches: the NEW
+    # batch's device waves are dispatched first (they chain after the
+    # previous batch's waves on the donated book), THEN the previous batch
+    # is decoded — its outputs completed on device while the host was
+    # batching, so the decode sync costs the residual, not a full round
+    # trip. Decode/publish order stays strictly FIFO (previous batch fully
+    # decoded and published before the new batch's decode begins), so
+    # directory mutations, storage rows, and stream events are identical
+    # to the serial schedule. At most ONE dispatch is pending; it is
+    # finished by the next dispatch, by the drain loop's idle wakeup, by
+    # checkpoint quiesce, or at shutdown.
+
+    @property
+    def has_pending(self) -> bool:
+        return self._pending is not None
+
+    def finish_pending(self) -> None:
+        """Decode+publish the pending dispatch, if any (idle wakeup /
+        shutdown path)."""
+        posts: list = []
+        with self._dispatch_lock:
+            self._finish_pending_locked(posts)
+        for p in posts:
+            p()
+
+    def _finish_pending_locked(self, posts: list) -> None:
+        """Lock held. Finishes the pending dispatch through its callback;
+        the callback publishes under the lock and may return a thunk
+        (future/tag completions) the caller must run AFTER release."""
+        if self._pending is None:
+            return
+        staged, cb = self._pending
+        self._pending = None
+        try:
+            result = self._finish_locked(staged)
+            err = None
+        except BaseException as e:  # noqa: BLE001 — the failed batch must
+            # not poison the CURRENT caller (it belongs to a previous drain
+            # iteration); _finish_locked already rolled back registrations.
+            # (dispatch_errors is counted ONCE, by the edge callback.)
+            print(f"[runner] pending dispatch failed: {type(e).__name__}: {e}")
+            result, err = None, e
+        post = cb(result, err)
+        if post is not None:
+            posts.append(post)
+
+    def dispatch_pipelined(self, ops: list[EngineOp], on_finish) -> None:
+        """Serving-loop entry: dispatch `ops`, overlapping with the
+        previous batch's decode. `on_finish(result, error)` runs under the
+        dispatch lock when this batch's results are decoded (publish to
+        sink/hub there); its return value, if not None, is a thunk the
+        runner invokes after releasing the lock (client completions)."""
+        posts: list = []
+        with self._dispatch_lock, Timer(self.metrics, "engine_dispatch_us"):
+            try:
+                staged = self._stage_locked(ops)
+            except BaseException as e:  # noqa: BLE001 — fail THIS batch,
+                # keep the loop; the previous batch is still finished below.
+                self._finish_pending_locked(posts)
+                post = on_finish(None, e)
+                if post is not None:
+                    posts.append(post)
+                for p in posts:
+                    p()
+                return
+            self._finish_pending_locked(posts)
+            if staged.deferred:
+                self._pending = (staged, on_finish)
+            else:
+                # Ineligible for deferral (mesh decode, or more waves than
+                # the HBM-bounded window): finish now, same as the serial
+                # schedule.
+                try:
+                    result = self._finish_locked(staged)
+                    err = None
+                except BaseException as e:  # noqa: BLE001
+                    result, err = None, e
+                post = on_finish(result, err)
+                if post is not None:
+                    posts.append(post)
+        for p in posts:
+            p()
+
+    def _rollback_registrations(self, ops, res: DispatchResult) -> None:
+        # A prep/dispatch/decode failure leaves undecoded ops maybe-applied
+        # on device. Their handles are NOT recycled (service-layer policy
+        # for maybe-enqueued ops) — but the eager directory entries must
+        # go, restoring the pre-registration state: no outcome => no
+        # directory row.
+        done = {id(o.op) for o in res.outcomes}
+        for e in ops:
+            if e.op == OP_SUBMIT and id(e) not in done:
+                self.orders_by_handle.pop(e.info.handle, None)
+                self.orders_by_id.pop(e.info.order_id, None)
 
     def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
+        return self._finish_locked(self._stage_locked(ops, defer=False))
+
+    def _stage_locked(self, ops: list[EngineOp], defer: bool = True):
+        """Build + register + (when deferrable) dispatch all device waves
+        WITHOUT decoding. Returns a _Staged; _finish_locked completes it."""
         res = DispatchResult([], [], [], [], [], [], 0)
         # Sampled once per dispatch: a subscriber attaching mid-dispatch
         # just misses this dispatch (same as attaching a moment later).
@@ -330,40 +464,57 @@ class EngineRunner:
                 )
                 by_handle[i.handle] = e
                 if e.op == OP_SUBMIT:
-                    # Register BEFORE dispatch: with up to PIPELINE_DEPTH
-                    # waves dispatched ahead of the decode cursor, a
-                    # concurrent book_snapshot can see device lanes whose
-                    # wave hasn't decoded yet — any lane visible on device
-                    # must already have a directory entry or the snapshot
-                    # would silently omit acked resting orders.
-                    # (_decode_batch's re-insert of the same OrderInfo
-                    # object is a no-op.)
+                    # Register BEFORE dispatch: with waves dispatched ahead
+                    # of the decode cursor, a concurrent book_snapshot can
+                    # see device lanes whose wave hasn't decoded yet — any
+                    # lane visible on device must already have a directory
+                    # entry or the snapshot would silently omit acked
+                    # resting orders. (_decode_batch's re-insert of the
+                    # same OrderInfo object is a no-op.)
                     self.orders_by_handle[i.handle] = i
                     self.orders_by_id[i.order_id] = i
 
-            self._dispatch_and_decode(ops, host_orders, by_handle, res,
-                                      terminal_makers)
+            n_waves, dispatch_iter, decode_fn, finalize_fn = self._prepare(
+                ops, host_orders, by_handle, res, terminal_makers)
+            staged = _Staged(ops, by_handle, res, terminal_makers,
+                             dispatch_iter, decode_fn, finalize_fn,
+                             deferred=False)
+            if (defer and self._sharded is None
+                    and n_waves <= PIPELINE_DEPTH):
+                # Dispatch every wave now, decode later: the staged
+                # outputs are HBM-bounded by the wave-count cap.
+                for item in dispatch_iter:
+                    staged.items.append(item)
+                staged.deferred = True
+            return staged
         except BaseException:
-            # A prep/dispatch/decode failure leaves undecoded ops
-            # maybe-applied on device. Their handles are NOT recycled
-            # (service-layer policy for maybe-enqueued ops) — but the eager
-            # directory entries must go, restoring the pre-registration
-            # state: no outcome => no directory row.
-            done = {id(o.op) for o in res.outcomes}
-            for e in ops:
-                if e.op == OP_SUBMIT and id(e) not in done:
-                    self.orders_by_handle.pop(e.info.handle, None)
-                    self.orders_by_id.pop(e.info.order_id, None)
+            self._rollback_registrations(ops, res)
             raise
-        self._evict_terminal(ops, res, by_handle, terminal_makers)
-        self.metrics.inc("dispatches")
-        self.metrics.inc("engine_ops", len(ops))
-        self.metrics.inc("fills", res.fill_count)
-        return res
 
-    def _dispatch_and_decode(self, ops, host_orders, by_handle,
-                             res: DispatchResult,
-                             terminal_makers: set[int]) -> None:
+    def _finish_locked(self, staged) -> DispatchResult:
+        try:
+            if staged.deferred:
+                while staged.items:
+                    staged.decode_fn(staged.items.popleft())
+            else:
+                run_pipelined(staged.dispatch_iter, staged.decode_fn)
+            staged.finalize_fn()
+        except BaseException:
+            self._rollback_registrations(staged.ops, staged.res)
+            raise
+        self._evict_terminal(staged.ops, staged.res, staged.by_handle,
+                             staged.terminal_makers)
+        self.metrics.inc("dispatches")
+        self.metrics.inc("engine_ops", len(staged.ops))
+        self.metrics.inc("fills", staged.res.fill_count)
+        return staged.res
+
+    def _prepare(self, ops, host_orders, by_handle,
+                 res: DispatchResult, terminal_makers: set[int]):
+        """Build the (n_waves, dispatch_iter, decode_fn, finalize_fn)
+        quadruple for this dispatch's shape. Nothing executes until the
+        dispatch iterator is pulled; finalize_fn runs after the last wave
+        decodes (market-data publication)."""
         # Sparse dispatch: when the batch is far below grid capacity (the
         # common serving case), ship O(ops) lanes instead of the dense
         # [S, B] planes — the host<->device transfer is the serving path's
@@ -383,6 +534,7 @@ class EngineRunner:
 
             self.metrics.inc("sparse_dispatches")
             tob: dict[int, tuple] = {}
+            built = build_sparse(self.cfg, host_orders)
 
             def decode_sparse(item):
                 sparse, nreal, out = item
@@ -402,13 +554,8 @@ class EngineRunner:
                     for i in range(nreal):
                         tob[sl[i]] = (bb[i], bs[i], ba[i], asz[i])
 
-            # Dispatch waves ahead of the decode cursor (the donated book
-            # chains them on device), bounded at PIPELINE_DEPTH so staged
-            # outputs can't pin O(waves) HBM: an inline decode between
-            # dispatches would cost a full sync round trip per extra wave
-            # on a tunneled chip.
             def dispatch_sparse():
-                for sparse, nreal in build_sparse(self.cfg, host_orders):
+                for sparse, nreal in built:
                     self._step_num += 1
                     with self._snapshot_lock, step_annotation(
                             "engine_step_sparse", self._step_num):
@@ -416,75 +563,76 @@ class EngineRunner:
                             self.cfg, self.book, sparse)
                     yield sparse, nreal, out
 
-            run_pipelined(dispatch_sparse(), decode_sparse)
-            if self._build_md:
-                for s, (b_, bs_, a_, as_) in tob.items():
-                    sym = self.slot_symbols[s]
-                    if sym is None:
-                        continue
-                    res.market_data.append(pb2.MarketDataUpdate(
-                        symbol=sym, best_bid=b_, best_ask=a_, scale=4,
-                        bid_size=bs_, ask_size=as_,
-                    ))
+            def finalize_sparse():
+                if self._build_md:
+                    for s, (b_, bs_, a_, as_) in tob.items():
+                        sym = self.slot_symbols[s]
+                        if sym is None:
+                            continue
+                        res.market_data.append(pb2.MarketDataUpdate(
+                            symbol=sym, best_bid=b_, best_ask=a_, scale=4,
+                            bid_size=bs_, ask_size=as_,
+                        ))
+
+            return len(built), dispatch_sparse(), decode_sparse, finalize_sparse
+
+        if host_orders:
+            self.metrics.inc("dense_dispatches")
+        touched_syms: set[int] = set()
+        last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
+        arrays = build_batch_arrays(self.cfg, host_orders)
+
+        def account_dense(results, fills, overflow, out):
+            nonlocal last_out
+            last_out = out
+            self._account(results, fills, overflow, by_handle, res,
+                          terminal_makers)
+            touched_syms.update(r.sym for r in results)
+
+        if self._sharded is not None:
+
+            def dispatch_dense():
+                for arr in arrays:
+                    self._step_num += 1
+                    batch = batch_view(arr)
+                    dev_batch = self._sharded.place_orders(batch)
+                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                        self.book, out = self._sharded.step(
+                            self.book, dev_batch)
+                    yield batch, out
+
+            def decode_dense(item):
+                # Decode from the HOST batch: its op/oid arrays are what
+                # decode reads, and pulling the device copy back would
+                # cost two cross-shard gathers per step for unchanged
+                # data.
+                batch, out = item
+                account_dense(*self._sharded.decode(batch, out), out)
         else:
-            if host_orders:
-                self.metrics.inc("dense_dispatches")
-            touched_syms: set[int] = set()
-            last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
-            arrays = build_batch_arrays(self.cfg, host_orders)
+            # Packed single-device steps: one [S, B, 6] upload and one
+            # small-vector readback each (+ a fill fetch only past the
+            # inline segment) — transfer ROUND TRIPS, not just bytes,
+            # bound tunneled serving latency.
 
-            def account_dense(results, fills, overflow, out):
-                nonlocal last_out
-                last_out = out
-                self._account(results, fills, overflow, by_handle, res,
-                              terminal_makers)
-                touched_syms.update(r.sym for r in results)
+            def dispatch_dense():
+                for arr in arrays:
+                    self._step_num += 1
+                    with self._snapshot_lock, step_annotation("engine_step", self._step_num):
+                        self.book, pout = engine_step_packed(
+                            self.cfg, self.book, arr)
+                    yield arr, pout
 
-            # Same bounded dispatch-ahead window as the sparse path; only
-            # the dispatch/decode pair differs per deployment shape.
-            if self._sharded is not None:
+            def decode_dense(item):
+                arr, pout = item
+                results, fills, overflow, out = decode_step_packed(
+                    self.cfg, batch_view(arr), pout)
+                account_dense(results, fills, overflow, out)
 
-                def dispatch_dense():
-                    for arr in arrays:
-                        self._step_num += 1
-                        batch = batch_view(arr)
-                        dev_batch = self._sharded.place_orders(batch)
-                        with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                            self.book, out = self._sharded.step(
-                                self.book, dev_batch)
-                        yield batch, out
-
-                def decode_dense(item):
-                    # Decode from the HOST batch: its op/oid arrays are
-                    # what decode reads, and pulling the device copy back
-                    # would cost two cross-shard gathers per step for
-                    # unchanged data.
-                    batch, out = item
-                    account_dense(*self._sharded.decode(batch, out), out)
-            else:
-                # Packed single-device steps: one [S, B, 6] upload and one
-                # small-vector readback each (+ a fill fetch only past the
-                # inline segment) — transfer ROUND TRIPS, not just bytes,
-                # bound tunneled serving latency.
-
-                def dispatch_dense():
-                    for arr in arrays:
-                        self._step_num += 1
-                        with self._snapshot_lock, step_annotation("engine_step", self._step_num):
-                            self.book, pout = engine_step_packed(
-                                self.cfg, self.book, arr)
-                        yield arr, pout
-
-                def decode_dense(item):
-                    arr, pout = item
-                    results, fills, overflow, out = decode_step_packed(
-                        self.cfg, batch_view(arr), pout)
-                    account_dense(results, fills, overflow, out)
-
-            run_pipelined(dispatch_dense(), decode_dense)
-
+        def finalize_dense():
             if last_out is not None and touched_syms and self._build_md:
                 self._market_data(last_out, touched_syms, res)
+
+        return len(arrays), dispatch_dense(), decode_dense, finalize_dense
 
     def _evict_terminal(self, ops, res: DispatchResult, by_handle,
                         terminal_makers: set[int]) -> None:

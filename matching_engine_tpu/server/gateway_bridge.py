@@ -117,7 +117,10 @@ class GatewayBridge:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                recs = self.gateway.pop_batch(self.max_batch, self.window_us)
+                recs = self.gateway.pop_batch(
+                    self.max_batch, self.window_us,
+                    self.window_us if self.runner.has_pending else -1,
+                )
             except Exception as e:  # noqa: BLE001 — a record that fails
                 # host-side decode (e.g. a non-UTF-8 field surviving the C++
                 # proto parse) must not kill the drain thread; its op is
@@ -126,7 +129,10 @@ class GatewayBridge:
                 print(f"[gw-bridge] pop_batch failed: {type(e).__name__}: {e}")
                 continue
             if recs is None:
-                return
+                break
+            if not recs:  # idle lull with a staged dispatch: finish it
+                self.runner.finish_pending()
+                continue
             try:
                 self._drain_batch(recs)
             except Exception as e:  # noqa: BLE001 — the drain thread must
@@ -146,6 +152,7 @@ class GatewayBridge:
                         # decode — this fallback must never raise.
                         self.gateway.complete_cancel(
                             rec[0], False, rec[8] or "", "engine error")
+        self.runner.finish_pending()
 
     def _drain_batch(self, recs) -> None:
         runner = self.runner
@@ -205,76 +212,93 @@ class GatewayBridge:
 
         if not ops:
             return
-        try:
-            # Same lock discipline as BatchDispatcher._drain: device step
-            # + sink/hub enqueue under the dispatch lock so checkpoints
-            # see an untorn (book, SQLite, snapshot) state.
-            with runner._dispatch_lock:
-                result = runner._run_dispatch_locked(ops)
-                self._publish(result)
-        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
-            self.metrics.inc("dispatch_errors")
-            print(f"[gw-bridge] dispatch error: {type(e).__name__}: {e}")
-            for op in ops:
-                tag = tags.get(id(op))
-                if tag is None:
-                    continue
-                if op.op == OP_SUBMIT:
-                    self.gateway.complete_submit(
-                        tag, False, op.info.order_id, "engine error"
-                    )
-                else:
-                    self.gateway.complete_cancel(
-                        tag, False, op.info.order_id, "engine error"
-                    )
-            return
 
-        for outcome in result.outcomes:
-            tag = tags.pop(id(outcome.op), None)
-            if tag is None:
-                continue
-            info = outcome.op.info
-            if outcome.op.op == OP_SUBMIT:
-                if outcome.status == REJECTED and outcome.error:
-                    self.metrics.inc("orders_rejected")
-                    self.gateway.complete_submit(
-                        tag, False, info.order_id, outcome.error
-                    )
-                else:
-                    self.metrics.inc("orders_accepted")
-                    self.gateway.complete_submit(tag, True, info.order_id)
-            else:
-                if outcome.status == CANCELED:
-                    self.metrics.inc("orders_canceled")
-                    self.gateway.complete_cancel(tag, True, info.order_id)
-                else:
-                    self.gateway.complete_cancel(
-                        tag, False, info.order_id,
-                        outcome.error or "order not open",
-                    )
-        # Any op that produced no outcome: fail loudly rather than hang
-        # the client until its deadline.
-        for op in ops:
-            tag = tags.pop(id(op), None)
-            if tag is None:
-                continue
-            if op.op == OP_SUBMIT:
-                self.gateway.complete_submit(
-                    tag, False, op.info.order_id, "op produced no outcome"
-                )
-            else:
-                self.gateway.complete_cancel(
-                    tag, False, op.info.order_id, "op produced no outcome"
-                )
-        dur_us = (time.perf_counter() - t0) * 1e6
-        self.metrics.ema_gauge("dispatch_us", dur_us)
-        self.metrics.observe("dispatch_us", dur_us)
-        self.metrics.ema_gauge("dispatch_ops", len(recs))
-        # Surface the C++ edge's counters through GetMetrics.
-        stats = self.gateway.stats()
-        self.metrics.set_gauge("gateway_requests", stats["requests"])
-        self.metrics.set_gauge("gateway_ring_rejects", stats["ring_rejects"])
-        self.metrics.set_gauge("gateway_connections", stats["conns"])
+        def on_finish(result, error):
+            # Runs under the dispatch lock when this batch decodes (same
+            # lock discipline as BatchDispatcher: sink/hub enqueue under
+            # the lock so checkpoints see an untorn (book, SQLite,
+            # snapshot) state). The returned thunk runs after release —
+            # gateway completions write sockets and must not hold the
+            # engine lock against a window-starved client.
+            if error is not None:
+                self.metrics.inc("dispatch_errors")
+                print(f"[gw-bridge] dispatch error: "
+                      f"{type(error).__name__}: {error}")
+
+                def fail():
+                    for op in ops:
+                        tag = tags.get(id(op))
+                        if tag is None:
+                            continue
+                        if op.op == OP_SUBMIT:
+                            self.gateway.complete_submit(
+                                tag, False, op.info.order_id, "engine error"
+                            )
+                        else:
+                            self.gateway.complete_cancel(
+                                tag, False, op.info.order_id, "engine error"
+                            )
+                return fail
+            self._publish(result)
+
+            def complete():
+                for outcome in result.outcomes:
+                    tag = tags.pop(id(outcome.op), None)
+                    if tag is None:
+                        continue
+                    info = outcome.op.info
+                    if outcome.op.op == OP_SUBMIT:
+                        if outcome.status == REJECTED and outcome.error:
+                            self.metrics.inc("orders_rejected")
+                            self.gateway.complete_submit(
+                                tag, False, info.order_id, outcome.error
+                            )
+                        else:
+                            self.metrics.inc("orders_accepted")
+                            self.gateway.complete_submit(
+                                tag, True, info.order_id)
+                    else:
+                        if outcome.status == CANCELED:
+                            self.metrics.inc("orders_canceled")
+                            self.gateway.complete_cancel(
+                                tag, True, info.order_id)
+                        else:
+                            self.gateway.complete_cancel(
+                                tag, False, info.order_id,
+                                outcome.error or "order not open",
+                            )
+                # Any op that produced no outcome: fail loudly rather than
+                # hang the client until its deadline.
+                for op in ops:
+                    tag = tags.pop(id(op), None)
+                    if tag is None:
+                        continue
+                    if op.op == OP_SUBMIT:
+                        self.gateway.complete_submit(
+                            tag, False, op.info.order_id,
+                            "op produced no outcome"
+                        )
+                    else:
+                        self.gateway.complete_cancel(
+                            tag, False, op.info.order_id,
+                            "op produced no outcome"
+                        )
+                # Batch TURNAROUND incl. pipeline residency (see
+                # dispatcher.py) — engine time is engine_dispatch_us.
+                dur_us = (time.perf_counter() - t0) * 1e6
+                self.metrics.ema_gauge("dispatch_us", dur_us)
+                self.metrics.observe("dispatch_us", dur_us)
+                self.metrics.ema_gauge("dispatch_ops", len(recs))
+                # Surface the C++ edge's counters through GetMetrics.
+                stats = self.gateway.stats()
+                self.metrics.set_gauge("gateway_requests", stats["requests"])
+                self.metrics.set_gauge(
+                    "gateway_ring_rejects", stats["ring_rejects"])
+                self.metrics.set_gauge(
+                    "gateway_connections", stats["conns"])
+            return complete
+
+        self.runner.dispatch_pipelined(ops, on_finish)
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)

@@ -411,11 +411,18 @@ class CheckpointDaemon:
         # Quiesce: no dispatch may run between the sink flush (which equalizes
         # SQLite with engine time) and the snapshot, and the book+directories
         # must not be mid-mutation (torn snapshots could double-apply orders
-        # on restore).
+        # on restore). A pipelined dispatch staged-but-undecoded is part of
+        # that invariant: its device waves are applied to the book, so it
+        # MUST be decoded + published before the flush barrier, or the
+        # snapshot would be ahead of SQLite.
+        posts: list = []
         with self.runner._dispatch_lock:
+            self.runner._finish_pending_locked(posts)
             self.sink.flush()
             self._reconcile_durability_locked()
             save_checkpoint(path, self.runner)
+        for p in posts:  # client completions, outside the engine lock
+            p()
         self.saved += 1
         self._prune()
         return path

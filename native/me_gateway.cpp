@@ -529,9 +529,19 @@ class Gateway {
     return true;
   }
 
-  int ring_pop_batch(MeGwOp* out, uint32_t max, uint64_t window_us) {
+  // first_wait_us < 0 waits indefinitely for the first op; >= 0 bounds it
+  // (0 records = timeout) so the pipelined bridge can finish a staged
+  // dispatch during idle lulls.
+  int ring_pop_batch(MeGwOp* out, uint32_t max, uint64_t window_us,
+                     int64_t first_wait_us = -1) {
     std::unique_lock<std::mutex> lk(ring_mu_);
-    ring_cv_.wait(lk, [&] { return ring_closed_ || !ring_.empty(); });
+    if (first_wait_us < 0) {
+      ring_cv_.wait(lk, [&] { return ring_closed_ || !ring_.empty(); });
+    } else if (!ring_cv_.wait_for(
+                   lk, std::chrono::microseconds(first_wait_us),
+                   [&] { return ring_closed_ || !ring_.empty(); })) {
+      return 0;
+    }
     if (ring_.empty()) return -1;
     uint32_t n = 0;
     auto deadline = std::chrono::steady_clock::now() +
@@ -1167,6 +1177,12 @@ void me_gateway_set_callback(void* g, MeGwCallback cb) {
 
 int me_gw_pop_batch(void* g, MeGwOp* out, uint32_t max, uint64_t window_us) {
   return static_cast<Gateway*>(g)->ring_pop_batch(out, max, window_us);
+}
+
+int me_gw_pop_batch_timed(void* g, MeGwOp* out, uint32_t max,
+                          uint64_t window_us, int64_t first_wait_us) {
+  return static_cast<Gateway*>(g)->ring_pop_batch(out, max, window_us,
+                                                  first_wait_us);
 }
 
 // Hot-path completions: build the protobuf response and write all frames.

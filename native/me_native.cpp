@@ -212,10 +212,19 @@ class MeRing {
   // Blocks until at least one op is available (or the ring closes), then
   // drains until `max` ops are taken or `window_us` elapses from the first
   // op — the dispatcher's latency/throughput knob, in native code.
-  // Returns the count, or -1 when closed and empty.
-  int pop_batch(MeOp* out, uint32_t max, uint64_t window_us) {
+  // first_wait_us < 0 waits indefinitely for the first op; >= 0 bounds
+  // that wait (the pipelined drain loop polls so an idle lull finishes a
+  // staged dispatch instead of stranding its clients). Returns the count
+  // (0 = first-wait timeout), or -1 when closed and empty.
+  int pop_batch(MeOp* out, uint32_t max, uint64_t window_us,
+                int64_t first_wait_us = -1) {
     std::unique_lock<std::mutex> lk(mu_);
-    cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (first_wait_us < 0) {
+      cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    } else if (!cv_.wait_for(lk, std::chrono::microseconds(first_wait_us),
+                             [&] { return closed_ || !q_.empty(); })) {
+      return 0;  // first-wait timeout, nothing arrived
+    }
     if (q_.empty()) return -1;  // closed and drained
     uint32_t n = 0;
     auto deadline =
@@ -272,6 +281,12 @@ int me_ring_push(void* r, const MeOp* op) {
 int me_ring_pop_batch(void* r, MeOp* out, uint32_t max, uint64_t window_us) {
   if (!r || !out) return -1;
   return static_cast<MeRing*>(r)->pop_batch(out, max, window_us);
+}
+int me_ring_pop_batch_timed(void* r, MeOp* out, uint32_t max,
+                            uint64_t window_us, int64_t first_wait_us) {
+  if (!r || !out) return -1;
+  return static_cast<MeRing*>(r)->pop_batch(out, max, window_us,
+                                            first_wait_us);
 }
 void me_ring_close(void* r) {
   if (r) static_cast<MeRing*>(r)->close();

@@ -154,6 +154,7 @@ def test_gateway_bridge_rejects_undecodable_records():
         (2, 2, 0, 0, 0, 0, None, None, None),       # poisoned cancel
         (3, 1, 1, 0, 100, 5, "OK", "alice", ""),    # healthy submit
     ])
+    runner.finish_pending()  # the healthy op's dispatch is pipelined
     by_tag = {t: (kind, ok, err) for kind, t, ok, err in gw.completed}
     assert by_tag[1] == ("submit", False, "invalid request encoding")
     assert by_tag[2] == ("cancel", False, "invalid request encoding")

@@ -340,8 +340,15 @@ def test_dual_edge_stress(hs):
     def quiescer():
         runner = hs.parts["runner"]
         while not done.is_set():
+            # Checkpoint-style quiesce: a pipelined staged dispatch is
+            # book-applied but not yet published — it must decode before
+            # the flush barrier (mirrors CheckpointDaemon.checkpoint_now).
+            posts = []
             with runner._dispatch_lock:
+                runner._finish_pending_locked(posts)
                 hs.parts["sink"].flush()
+            for p in posts:
+                p()
             time.sleep(0.02)
 
     threads = [threading.Thread(target=trader, args=(hs.stub, i))

@@ -1,0 +1,140 @@
+"""Cross-dispatch pipelining semantics (engine_runner.dispatch_pipelined).
+
+The serving loops overlap consecutive dispatches: a new batch's device
+waves are issued before the previous batch decodes. These tests pin the
+contract: strict FIFO finish order, identical outcomes to the serial
+schedule, completion via every finisher (next dispatch, idle wakeup,
+checkpoint quiesce, shutdown), and directory consistency while a
+dispatch is pending.
+"""
+
+import threading
+import time
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import FILLED, NEW, OP_SUBMIT
+from matching_engine_tpu.server.dispatcher import BatchDispatcher
+from matching_engine_tpu.server.engine_runner import (
+    EngineOp,
+    EngineRunner,
+    OrderInfo,
+)
+
+CFG = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+
+
+def _submit(runner, symbol, side, price, qty):
+    assert runner.slot_acquire(symbol) is not None
+    num, oid = runner.assign_oid()
+    return EngineOp(OP_SUBMIT, OrderInfo(
+        oid=num, order_id=oid, client_id="c", symbol=symbol, side=side,
+        otype=0, price_q4=price, quantity=qty, remaining=qty, status=0,
+        handle=runner.assign_handle()))
+
+
+def _collector(log, label):
+    def on_finish(result, error):
+        assert error is None, error
+        def post():
+            log.append((label, [(o.op.info.order_id, o.status)
+                                for o in result.outcomes]))
+        return post
+    return on_finish
+
+
+def test_fifo_finish_order_and_outcomes():
+    """Batch A stays pending while B is staged; finish order is A then B,
+    and the cross-batch match (B's SELL hits A's resting BUY) decodes with
+    the same outcomes as the serial schedule."""
+    r = EngineRunner(CFG)
+    log: list = []
+    a = _submit(r, "X", 1, 100, 5)
+    r.dispatch_pipelined([a], _collector(log, "A"))
+    assert r.has_pending
+    # A is already visible in the directories while pending (book lanes
+    # are applied on device; a snapshot must be able to join them).
+    assert a.info.order_id in r.orders_by_id
+    b = _submit(r, "X", 2, 100, 5)
+    r.dispatch_pipelined([b], _collector(log, "B"))
+    assert r.has_pending          # now B is the pending one
+    r.finish_pending()
+    assert not r.has_pending
+    assert [entry[0] for entry in log] == ["A", "B"]
+    assert log[0][1] == [(a.info.order_id, NEW)]
+    assert log[1][1] == [(b.info.order_id, FILLED)]
+    assert a.info.remaining == 0 and a.info.status == FILLED
+
+
+def test_checkpoint_style_quiesce_finishes_pending():
+    """The checkpoint quiesce pattern (finish pending under the dispatch
+    lock, run completions after) publishes the staged batch."""
+    r = EngineRunner(CFG)
+    log: list = []
+    r.dispatch_pipelined([_submit(r, "Q", 1, 50, 1)], _collector(log, "A"))
+    assert r.has_pending
+    posts: list = []
+    with r._dispatch_lock:
+        r._finish_pending_locked(posts)
+    for p in posts:
+        p()
+    assert not r.has_pending and [entry[0] for entry in log] == ["A"]
+
+
+def test_lone_submit_completes_via_idle_wakeup():
+    """With no follow-up traffic, the drain loop's idle wakeup finishes the
+    pending dispatch — a lone client must never hang on its future."""
+    r = EngineRunner(CFG)
+    d = BatchDispatcher(r, window_ms=5.0)
+    try:
+        fut = d.submit(_submit(r, "Z", 1, 10, 1))
+        outcome = fut.result(timeout=10)
+        assert outcome.status == NEW
+    finally:
+        d.close()
+    assert not r.has_pending
+
+
+def test_concurrent_edges_share_one_pending():
+    """Two drain threads (the dual-edge shape) interleave pipelined
+    dispatches against one runner; every dispatch's completion runs
+    exactly once and nothing is left pending."""
+    r = EngineRunner(CFG)
+    done: list = []
+    lock = threading.Lock()
+
+    def on_finish(result, error):
+        assert error is None, error
+        def post():
+            with lock:
+                done.extend(o.op.info.order_id for o in result.outcomes)
+        return post
+
+    def edge(label, n):
+        for i in range(n):
+            r.dispatch_pipelined(
+                [_submit(r, f"S{label}", 1, 100 + i, 1)], on_finish)
+        r.finish_pending()
+
+    threads = [threading.Thread(target=edge, args=(t, 20)) for t in "ab"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    r.finish_pending()
+    time.sleep(0.05)
+    assert not r.has_pending
+    assert len(done) == 40 and len(set(done)) == 40
+
+
+def test_book_snapshot_sees_pending_orders():
+    """A resting order whose dispatch is still pending appears in the
+    book snapshot (eager directory registration + device lanes applied)."""
+    r = EngineRunner(CFG)
+    op = _submit(r, "SNAP", 1, 77, 3)
+    r.dispatch_pipelined([op], lambda result, error: None)
+    assert r.has_pending
+    bids, asks = r.book_snapshot("SNAP")
+    assert len(bids) == 1 and len(asks) == 0
+    info, qty = bids[0]
+    assert info.order_id == op.info.order_id and qty == 3
+    r.finish_pending()
