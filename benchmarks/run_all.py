@@ -10,6 +10,8 @@ full matrix:
   3 4k-symbol L3-style replay, LIMIT+CANCEL+MARKET  (same as bench.py)
   4 gRPC client fan-in through the full server stack (end-to-end, p99)
   5 agent-based market sim, closed loop on device
+  6 call-auction uncross: every book cleared at its clearing price in
+    one device step (engine/auction.py; beyond the BASELINE five)
 
 Usage: python benchmarks/run_all.py [--full] [--configs 2,3,5]
 --full uses north-star scale (4k symbols, 256 agents, 1k clients); the
@@ -268,6 +270,61 @@ def config5_sim(full: bool):
           "traded_volume": int(np.sum(np.asarray(stats.volume)))})
 
 
+def config6_auction(full: bool):
+    """Call-auction uncross throughput (engine/auction.py): every book
+    pre-filled CROSSED to full depth (the worst-case pre-open state), one
+    device step clears all of them at per-symbol clearing prices. K
+    auctions are timed pipelined (fresh books placed per iteration, one
+    sync at the end); fills stay on device during timing."""
+    from matching_engine_tpu.engine.auction import auction_step, decode_auction
+
+    s = 4096 if full else 512
+    cap = 128
+    # Bilateral records bound: <= S * (2*cap - 1); size the log to fit.
+    cfg = EngineConfig(num_symbols=s, capacity=cap, batch=32,
+                       max_fills=1 << 20)
+    rng = np.random.default_rng(0)
+
+    def host_book():
+        shape = (s, cap)
+        return {
+            "bid_price": rng.integers(9_990, 10_051, shape, dtype=np.int32),
+            "bid_qty": rng.integers(1, 100, shape, dtype=np.int32),
+            "bid_oid": np.arange(1, s * cap + 1, dtype=np.int32).reshape(shape),
+            "bid_seq": np.tile(np.arange(cap, dtype=np.int32), (s, 1)),
+            "ask_price": rng.integers(9_950, 10_011, shape, dtype=np.int32),
+            "ask_qty": rng.integers(1, 100, shape, dtype=np.int32),
+            "ask_oid": np.arange(s * cap + 1, 2 * s * cap + 1,
+                                 dtype=np.int32).reshape(shape),
+            "ask_seq": np.tile(np.arange(cap, dtype=np.int32), (s, 1)),
+            "next_seq": np.full((s,), cap, dtype=np.int32),
+        }
+
+    from matching_engine_tpu.engine.book import BookBatch
+
+    mask = np.ones((s,), dtype=bool)
+    books = [BookBatch(**{k: jax.device_put(v) for k, v in host_book().items()})
+             for _ in range(4)]
+    # Warm compile.
+    _, out = auction_step(cfg, books[0], mask)
+    jax.block_until_ready(out.small)
+
+    k = 3
+    t0 = time.perf_counter()
+    outs = [auction_step(cfg, books[1 + i], mask)[1] for i in range(k)]
+    jax.block_until_ready([o.small for o in outs])
+    dt = time.perf_counter() - t0
+
+    dec, fills = decode_auction(cfg, outs[-1])
+    executed = int(np.sum(dec.executed))
+    crossed = int(np.sum(dec.executed > 0))
+    assert not dec.aborted
+    emit(6, "auction_uncross_throughput", k * s / dt, "symbols/sec",
+         {"symbols": s, "capacity": cap, "uncross_ms": round(dt / k * 1e3, 2),
+          "symbols_crossed": crossed, "executed_qty": executed,
+          "records": dec.fill_count})
+
+
 def run_one(config: int, full: bool) -> None:
     if config == 1:
         config1_parity()
@@ -278,6 +335,8 @@ def run_one(config: int, full: bool) -> None:
     elif config == 4:
         config4_grpc(full)
         config4_native_gateway(full)
+    elif config == 6:
+        config6_auction(full)
     elif config == 5:
         config5_sim(full)
 
@@ -285,7 +344,7 @@ def run_one(config: int, full: bool) -> None:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--full", action="store_true", help="north-star scale")
-    p.add_argument("--configs", default="1,2,3,4,5")
+    p.add_argument("--configs", default="1,2,3,4,5,6")
     p.add_argument("--no-fork", action="store_true",
                    help="run all configs in THIS process (debug only)")
     args = p.parse_args()

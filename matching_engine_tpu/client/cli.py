@@ -6,7 +6,7 @@ Reference contract (src/client/client.cpp:10-29,49-56): positional args
 rejection reason, exit codes: 1 usage, 2 RPC failure, 3 rejected.
 
 Extended subcommands (new surface): `book`, `cancel`, `watch-md`,
-`watch-orders`, `metrics` — invoked as
+`watch-orders`, `metrics`, `auction` — invoked as
 `python -m matching_engine_tpu.client.cli <sub> ...`; the bare 8-arg form
 stays the submit path.
 """
@@ -27,7 +27,8 @@ USAGE = (
     "   or: client cancel <addr> <client_id> <order_id>\n"
     "   or: client watch-md <addr> <symbol>\n"
     "   or: client watch-orders <addr> <client_id>\n"
-    "   or: client metrics <addr>"
+    "   or: client metrics <addr>\n"
+    "   or: client auction <addr> [symbol]"
 )
 
 
@@ -68,6 +69,21 @@ def _book(addr: str, symbol: str) -> int:
     for label, side in (("bid", resp.bids), ("ask", resp.asks)):
         for o in side:
             print(f"  {label} {o.price}@Q{o.scale} x{o.quantity} {o.order_id} ({o.client_id})")
+    return 0
+
+
+def _auction(addr: str, symbol: str) -> int:
+    resp = _stub(addr).RunAuction(pb2.AuctionRequest(symbol=symbol),
+                                  timeout=60)
+    if not resp.success:
+        print(f"[client] auction rejected: {resp.error_message}")
+        return 3
+    if symbol:
+        print(f"[client] auction {symbol}: cleared "
+              f"{resp.clearing_price}@Q4 x{resp.executed_quantity}")
+    else:
+        print(f"[client] auction: {resp.symbols_crossed} symbol(s) crossed, "
+              f"{resp.executed_quantity} executed")
     return 0
 
 
@@ -140,6 +156,8 @@ def _dispatch(argv: list[str]) -> int:
             return _book(argv[1], argv[2])
         if len(argv) == 4 and argv[0] == "cancel":
             return _cancel(argv[1], argv[2], argv[3])
+        if len(argv) in (2, 3) and argv[0] == "auction":
+            return _auction(argv[1], argv[2] if len(argv) == 3 else "")
         if len(argv) == 3 and argv[0] == "watch-md":
             return _watch_md(argv[1], argv[2])
         if len(argv) == 3 and argv[0] == "watch-orders":

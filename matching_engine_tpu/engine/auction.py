@@ -1,0 +1,261 @@
+"""Call-auction (batch uncross) kernel: clear every book at one price.
+
+A second market mechanism beside the continuous price-time-priority match
+(engine/kernel.py): collect the resting limit orders of each book, find
+the single clearing price that maximizes executable volume, and execute
+both sides at that price — the open/close/volatility-auction mechanism of
+real exchanges. The reference has no analog (its engine file is empty;
+SURVEY.md §2 row 5); this is a framework extension the TPU design makes
+nearly free: one `vmap` uncrosses every symbol's book in a single
+fixed-shape device step.
+
+Mechanism (per symbol, all int32):
+
+1. Candidate prices are the live resting prices (both sides, [2C] lanes).
+   demand(p) = total bid quantity with limit >= p; supply(p) = total ask
+   quantity with limit <= p; executable(p) = min(demand, supply).
+2. The clearing price p* maximizes executable volume; ties minimize the
+   order imbalance |demand - supply|; remaining ties take the LOWEST such
+   price (deterministic; documented).
+3. Allocation at p*: the eligible orders of each side fill in price-time
+   priority (better price first, then earlier seq) up to the executed
+   volume Q — exactly the `ahead_of_me` prefix-sum rule the continuous
+   kernel uses, so the marginal order is partially filled and everything
+   with strictly better priority fills fully.
+4. Trade records are bilateral: each bid's fill occupies the interval
+   [ahead_b, ahead_b + fill_b) of the executed-volume line, each ask's
+   likewise; every overlapping (bid, ask) interval pair is one trade of
+   the overlap length at p*. Both sides' records sum to Q, and record
+   count per symbol is at most (#bid fills + #ask fills - 1).
+5. All symbols' records compact into one [max_fills] log (the continuous
+   kernel's cumsum-scatter). If the total would overflow the buffer the
+   WHOLE auction aborts untouched (overflow flag set, books unchanged) —
+   an uncross must be all-or-nothing per invocation, never half-logged.
+
+Parity: engine/oracle.py `OracleBook.auction` implements the same rules
+on Python lists; tests/test_auction.py fuzzes book states through both.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import I32, BookBatch, EngineConfig
+from matching_engine_tpu.engine.kernel import _top_of_book
+
+IMAX = jnp.iinfo(jnp.int32).max
+
+
+class AuctionOutput(NamedTuple):
+    """Packed device output — ONE small readback + the fill log:
+
+    small: [6S + 2] int32 = clear_price | executed (each [S]; 0 when the
+           symbol did not cross) ++ best_bid | bid_size | best_ask |
+           ask_size (each [S], POST-auction) ++ [fill_count, aborted].
+    fills: [5, max_fills] int32, harness.decode_fills column order —
+           (sym, taker_oid = bid, maker_oid = ask, price = p*, qty).
+    """
+
+    small: jax.Array
+    fills: jax.Array
+
+
+def _uncross_one(bid_price, bid_qty, bid_oid, bid_seq,
+                 ask_price, ask_qty, ask_oid, ask_seq, mask):
+    """One symbol's uncross: returns (fill_b[C], fill_a[C], p_star, q_exec,
+    start_b[C], start_a[C]) — fills are the per-lane executed quantities,
+    start_* the interval offsets used for bilateral record pairing."""
+    live_b = bid_qty > 0
+    live_a = ask_qty > 0
+
+    cand = jnp.concatenate([bid_price, ask_price])          # [2C]
+    cand_valid = jnp.concatenate([live_b, live_a]) & mask
+
+    # demand/supply at every candidate price: [2C, C] masked matvecs.
+    d = jnp.sum(jnp.where(live_b[None, :] & (bid_price[None, :] >= cand[:, None]),
+                          bid_qty[None, :], 0), axis=1)
+    s = jnp.sum(jnp.where(live_a[None, :] & (ask_price[None, :] <= cand[:, None]),
+                          ask_qty[None, :], 0), axis=1)
+    ex = jnp.where(cand_valid, jnp.minimum(d, s), -1)
+    imb = jnp.abs(d - s)
+
+    # Lexicographic pick: max executable, then min imbalance, then min price.
+    m1 = jnp.max(ex)
+    c1 = cand_valid & (ex == m1)
+    m2 = jnp.min(jnp.where(c1, imb, IMAX))
+    c2 = c1 & (imb == m2)
+    p_star = jnp.min(jnp.where(c2, cand, IMAX))
+    q_exec = jnp.maximum(m1, 0)
+
+    crossed = mask & (q_exec > 0) & (p_star < IMAX)
+    q = jnp.where(crossed, q_exec, 0)
+
+    elig_b = live_b & (bid_price >= p_star) & crossed
+    elig_a = live_a & (ask_price <= p_star) & crossed
+
+    # Price-time priority prefix sums (the continuous kernel's ahead rule).
+    better_b = (bid_price[:, None] > bid_price[None, :]) | (
+        (bid_price[:, None] == bid_price[None, :])
+        & (bid_seq[:, None] < bid_seq[None, :])
+    )
+    ahead_b = jnp.sum(
+        jnp.where(better_b & elig_b[:, None], bid_qty[:, None], 0), axis=0)
+    fill_b = jnp.where(elig_b, jnp.clip(q - ahead_b, 0, bid_qty), 0)
+
+    better_a = (ask_price[:, None] < ask_price[None, :]) | (
+        (ask_price[:, None] == ask_price[None, :])
+        & (ask_seq[:, None] < ask_seq[None, :])
+    )
+    ahead_a = jnp.sum(
+        jnp.where(better_a & elig_a[:, None], ask_qty[:, None], 0), axis=0)
+    fill_a = jnp.where(elig_a, jnp.clip(q - ahead_a, 0, ask_qty), 0)
+
+    return (fill_b, fill_a, jnp.where(crossed, p_star, 0).astype(I32),
+            q.astype(I32), ahead_b.astype(I32), ahead_a.astype(I32))
+
+
+def _records_one(fill_b, fill_a, start_b, start_a, bid_oid, ask_oid):
+    """One symbol's bilateral records, compacted to [2C-1] lanes.
+
+    Record count per symbol is bounded by (#bid fills + #ask fills - 1)
+    <= 2C-1, so compacting PER SYMBOL first keeps the later global
+    compaction at [S, 2C-1] instead of [S, C, C] — a 64x smaller scatter
+    at the 4k x 128 configuration.
+    """
+    cap = fill_b.shape[0]
+    r = 2 * cap - 1
+    b_lo = start_b[:, None]
+    b_hi = (start_b + fill_b)[:, None]
+    a_lo = start_a[None, :]
+    a_hi = (start_a + fill_a)[None, :]
+    ov = jnp.clip(jnp.minimum(b_hi, a_hi) - jnp.maximum(b_lo, a_lo), 0, None)
+    ov = jnp.where((fill_b[:, None] > 0) & (fill_a[None, :] > 0), ov, 0)
+    flat = ov.reshape(-1).astype(I32)
+    m = flat > 0
+    pos = jnp.cumsum(m) - 1
+    dest = jnp.where(m, pos, r)  # count <= r by construction; r = trash
+    taker = jnp.broadcast_to(bid_oid[:, None], (cap, cap)).reshape(-1)
+    maker = jnp.broadcast_to(ask_oid[None, :], (cap, cap)).reshape(-1)
+
+    def compact(vals):
+        return jnp.zeros((r + 1,), I32).at[dest].set(vals)[:r]
+
+    return compact(taker), compact(maker), compact(flat), jnp.sum(m)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
+    """Uncross every masked symbol's book at its clearing price.
+
+    mask: [S] bool — which symbols participate. Returns (new_book,
+    AuctionOutput). All-or-nothing: if the bilateral record log would
+    overflow cfg.max_fills, NOTHING is applied and `aborted` is set.
+    """
+    s_dim, cap = cfg.num_symbols, cfg.capacity
+    fill_b, fill_a, p_star, q_exec, start_b, start_a = jax.vmap(_uncross_one)(
+        book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
+        book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq, mask,
+    )
+
+    # Stage 1: per-symbol record compaction, [S, 2C-1] lanes.
+    rec_taker, rec_maker, rec_qty, rec_counts = jax.vmap(_records_one)(
+        fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
+
+    total = jnp.sum(rec_counts)
+    n = cfg.max_fills
+    aborted = total > n
+
+    # All-or-nothing: an overflow leaves every book untouched.
+    apply = mask & ~aborted
+    new_book = BookBatch(
+        bid_price=book.bid_price,
+        bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
+        bid_oid=book.bid_oid,
+        bid_seq=book.bid_seq,
+        ask_price=book.ask_price,
+        ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
+        ask_oid=book.ask_oid,
+        ask_seq=book.ask_seq,
+        next_seq=book.next_seq,
+    )
+
+    # Stage 2: global compaction over the [S, 2C-1] lanes (row-major, so
+    # records stay symbol-major in per-symbol rank order).
+    r = 2 * cap - 1
+    flat_qty = rec_qty.reshape(-1)
+    rec_mask = flat_qty > 0
+    pos = jnp.cumsum(rec_mask) - 1
+    dest = jnp.where(rec_mask & (pos < n) & ~aborted, pos, n)  # n = trash
+
+    def compact(flat_vals):
+        return jnp.zeros((n + 1,), I32).at[dest].set(flat_vals)[:n]
+
+    sym_ids = jnp.broadcast_to(
+        jnp.arange(s_dim, dtype=I32)[:, None], (s_dim, r))
+    price = jnp.broadcast_to(p_star[:, None], (s_dim, r))
+    fills = jnp.stack([
+        compact(sym_ids.reshape(-1)),
+        compact(rec_taker.reshape(-1)),
+        compact(rec_maker.reshape(-1)),
+        compact(price.reshape(-1)),
+        compact(flat_qty),
+    ])
+
+    best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty, True)
+    best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
+    zero_if_aborted = jnp.where(aborted, 0, 1).astype(I32)
+    small = jnp.concatenate([
+        p_star * zero_if_aborted,
+        q_exec * zero_if_aborted,
+        best_bid, bid_size, best_ask, ask_size,
+        jnp.stack([
+            jnp.where(aborted, 0, jnp.minimum(total, n)).astype(I32),
+            aborted.astype(I32),
+        ]),
+    ])
+    return new_book, AuctionOutput(small=small, fills=fills)
+
+
+class AuctionDecoded(NamedTuple):
+    """Host view (numpy, from the one small readback)."""
+
+    clear_price: object
+    executed: object
+    best_bid: object
+    bid_size: object
+    best_ask: object
+    ask_size: object
+    fill_count: int
+    aborted: bool
+
+
+def decode_auction(cfg: EngineConfig, out: AuctionOutput):
+    """(decoded, fills) — one readback + the fill slice (host-sliced from
+    the whole fixed-shape buffer; see decode_step_packed's rationale)."""
+    import numpy as np
+
+    from matching_engine_tpu.engine.harness import decode_fills
+
+    small = np.asarray(out.small)
+    s = cfg.num_symbols
+    dec = AuctionDecoded(
+        clear_price=small[0:s],
+        executed=small[s:2 * s],
+        best_bid=small[2 * s:3 * s],
+        bid_size=small[3 * s:4 * s],
+        best_ask=small[4 * s:5 * s],
+        ask_size=small[5 * s:6 * s],
+        fill_count=int(small[6 * s]),
+        aborted=bool(small[6 * s + 1]),
+    )
+    if dec.fill_count:
+        packed = np.asarray(out.fills)
+        fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
+                             packed[4], dec.fill_count)
+    else:
+        fills = []
+    return dec, fills

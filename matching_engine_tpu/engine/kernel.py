@@ -50,7 +50,10 @@ from matching_engine_tpu.engine.book import (
 NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = 0, 1, 2, 3, 4
 NOOP_STATUS = -1
 
-OP_NOOP, OP_SUBMIT, OP_CANCEL = 0, 1, 2
+# OP_REST: rest WITHOUT matching — the call-auction accumulation op
+# (engine/auction.py): books may stand crossed until an uncross clears
+# them. Identical to OP_SUBMIT except the maker scan never runs.
+OP_NOOP, OP_SUBMIT, OP_CANCEL, OP_REST = 0, 1, 2, 3
 LIMIT, MARKET = 0, 1
 BUY, SELL = 1, 2
 
@@ -81,6 +84,8 @@ def _match_one(book: _SymBook, order):
     )
     is_submit = op == OP_SUBMIT
     is_cancel = op == OP_CANCEL
+    is_rest = op == OP_REST          # auction accumulation: never matches
+    is_submit_like = is_submit | is_rest
     is_buy = side == BUY
     is_market = otype == MARKET
 
@@ -105,7 +110,7 @@ def _match_one(book: _SymBook, order):
     elig_qty = jnp.where(elig, opp_qty, 0)
     ahead = jnp.sum(jnp.where(better, elig_qty[:, None], 0), axis=0)
 
-    take_q = jnp.where(is_submit, qty, 0)
+    take_q = jnp.where(is_submit_like, qty, 0)
     fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
     filled_total = jnp.sum(fill)
     remaining = take_q - filled_total
@@ -128,7 +133,7 @@ def _match_one(book: _SymBook, order):
     own_oid = jnp.where(is_buy, book.bid_oid, book.ask_oid)
     own_seq = jnp.where(is_buy, book.bid_seq, book.ask_seq)
 
-    do_rest = is_submit & (~is_market) & (remaining > 0)
+    do_rest = is_submit_like & (~is_market) & (remaining > 0)
     free = own_qty == 0
     has_free = jnp.any(free)
     slot_idx = jnp.argmax(free)  # first free slot
@@ -176,12 +181,12 @@ def _match_one(book: _SymBook, order):
     )
     cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
     status = jnp.where(
-        is_submit,
+        is_submit_like,
         submit_status,
         jnp.where(is_cancel, cancel_status, NOOP_STATUS),
     ).astype(I32)
     out_remaining = jnp.where(
-        is_submit, remaining, jnp.where(is_cancel, cancel_qty, 0)
+        is_submit_like, remaining, jnp.where(is_cancel, cancel_qty, 0)
     ).astype(I32)
 
     return new_book, (

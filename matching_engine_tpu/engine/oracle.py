@@ -131,6 +131,68 @@ class OracleBook:
         status = PARTIALLY_FILLED if filled > 0 else NEW
         return OrderResult(oid, status, filled, remaining, True, tuple(fills))
 
+    def rest(self, oid: int, side: int, price_q4: int, qty: int) -> OrderResult:
+        """OP_REST twin: rest without matching (auction accumulation —
+        the book may stand crossed afterwards). NEW on success, REJECTED
+        when the side is at capacity."""
+        assert qty > 0
+        own = self._own(side)
+        if len(own) >= self.capacity:
+            return OrderResult(oid, REJECTED, 0, qty, False, ())
+        own.append(_Resting(oid, price_q4, qty, self.next_seq))
+        self.next_seq += 1
+        return OrderResult(oid, NEW, 0, qty, True, ())
+
+    def auction(self) -> tuple[int, int, list[Fill]]:
+        """Call-auction uncross (oracle twin of engine/auction.py).
+
+        Returns (clearing_price_q4, executed_qty, fills); (0, 0, []) when
+        the book cannot cross. Rules: p* maximizes executable volume
+        min(demand, supply) over the resting prices, ties minimize the
+        imbalance |demand - supply|, remaining ties take the LOWEST price;
+        each side allocates in price-time priority up to the executed
+        volume; bilateral records pair the two sides' fill intervals on
+        the executed-volume line (taker = bid, maker = ask, price = p*)."""
+        cands = sorted({r.price_q4 for r in self.bids}
+                       | {r.price_q4 for r in self.asks})
+        best = None  # (executed, imbalance, price)
+        for p in cands:
+            d = sum(r.qty for r in self.bids if r.price_q4 >= p)
+            s = sum(r.qty for r in self.asks if r.price_q4 <= p)
+            key = (-min(d, s), abs(d - s), p)
+            if best is None or key < best:
+                best = key
+        if best is None or -best[0] <= 0:
+            return 0, 0, []
+        q, p_star = -best[0], best[2]
+
+        def allocate(resting, sorted_side):
+            out, taken = [], 0
+            for r in self._priority_sorted(sorted_side, resting):
+                if taken >= q:
+                    break
+                take = min(r.qty, q - taken)
+                out.append((r, taken, take))  # (order, interval start, qty)
+                taken += take
+            return out
+
+        bid_alloc = allocate(
+            [r for r in self.bids if r.price_q4 >= p_star], pb2.BUY)
+        ask_alloc = allocate(
+            [r for r in self.asks if r.price_q4 <= p_star], pb2.SELL)
+
+        fills: list[Fill] = []
+        for b, b_lo, b_q in bid_alloc:
+            for a, a_lo, a_q in ask_alloc:
+                ov = min(b_lo + b_q, a_lo + a_q) - max(b_lo, a_lo)
+                if ov > 0:
+                    fills.append(Fill(b.oid, a.oid, p_star, ov))
+        for r, _, take in bid_alloc + ask_alloc:
+            r.qty -= take
+        self.bids = [r for r in self.bids if r.qty > 0]
+        self.asks = [r for r in self.asks if r.qty > 0]
+        return p_star, q, fills
+
     def cancel(self, oid: int) -> OrderResult:
         for side_list in (self.bids, self.asks):
             for r in side_list:

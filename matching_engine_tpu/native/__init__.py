@@ -269,7 +269,8 @@ GW_CALLBACK = ctypes.CFUNCTYPE(
 )
 
 # Forwarded-method ids (me_gateway.cpp Method enum).
-GW_SUBMIT, GW_CANCEL, GW_BOOK, GW_METRICS, GW_STREAM_MD, GW_STREAM_OU = range(1, 7)
+(GW_SUBMIT, GW_CANCEL, GW_BOOK, GW_METRICS, GW_STREAM_MD, GW_STREAM_OU,
+ GW_AUCTION) = range(1, 8)
 
 
 def _load_gateway():

@@ -27,6 +27,7 @@ _METHODS = {
     "StreamOrderUpdates": ("unary_stream", pb2.OrderUpdatesRequest, pb2.OrderUpdate),
     "CancelOrder": ("unary_unary", pb2.CancelRequest, pb2.CancelResponse),
     "GetMetrics": ("unary_unary", pb2.MetricsRequest, pb2.MetricsResponse),
+    "RunAuction": ("unary_unary", pb2.AuctionRequest, pb2.AuctionResponse),
 }
 
 
@@ -52,6 +53,9 @@ class MatchingEngineServicer:
 
     def GetMetrics(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetMetrics not implemented")
+
+    def RunAuction(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "RunAuction not implemented")
 
 
 def add_matching_engine_servicer(servicer: MatchingEngineServicer, server: grpc.Server) -> None:

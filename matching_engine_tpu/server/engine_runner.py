@@ -41,6 +41,7 @@ from matching_engine_tpu.engine.kernel import (
     FILLED,
     NEW,
     OP_CANCEL,
+    OP_REST,
     OP_SUBMIT,
     PARTIALLY_FILLED,
     REJECTED,
@@ -81,7 +82,7 @@ class OrderInfo:
 class EngineOp:
     """One validated operation headed for the device."""
 
-    op: int                      # OP_SUBMIT / OP_CANCEL
+    op: int                      # OP_SUBMIT / OP_REST / OP_CANCEL
     info: OrderInfo              # the order (submit) or the target (cancel)
     cancel_requester: str = ""   # client asking for the cancel
 
@@ -193,6 +194,12 @@ class EngineRunner:
         # the ledger itself is counted and the tail dropped.
         self.pending_recon: list[tuple[str, str, int]] = []
         self._recon_cap = 100_000
+        # Call-auction accumulation mode: while True, both serving edges
+        # submit orders as OP_REST (rest without matching — books may
+        # stand crossed) and MARKET orders are rejected; a RunAuction
+        # uncross clears the flag (the opening cross). Toggled at boot
+        # (--auction-open) or left False for pure continuous trading.
+        self.auction_mode = False
         # Cross-dispatch pipelining: the one staged-but-undecoded dispatch
         # (see dispatch_pipelined) with its finish callback.
         self._pending: tuple[_Staged, object] | None = None
@@ -419,7 +426,7 @@ class EngineRunner:
         # directory row.
         done = {id(o.op) for o in res.outcomes}
         for e in ops:
-            if e.op == OP_SUBMIT and id(e) not in done:
+            if e.op in (OP_SUBMIT, OP_REST) and id(e) not in done:
                 self.orders_by_handle.pop(e.info.handle, None)
                 self.orders_by_id.pop(e.info.order_id, None)
 
@@ -451,19 +458,31 @@ class EngineRunner:
                         OpOutcome(e, REJECTED, 0, 0, "order not open"))
                     continue
                 slot = self.symbols[i.symbol]  # caller guarantees allocation
+                # Auction-mode classification happens HERE, under the
+                # dispatch lock — never at the RPC edge. RunAuction holds
+                # the same lock when it flips auction_mode off, so a queued
+                # submit can never dispatch as OP_REST after the uncross
+                # opened continuous trading (or vice versa). In the call
+                # period MARKET submits also rest-classify: the kernel
+                # cancels their remainder (no maker scan runs), which is
+                # the correct no-liquidity-view outcome for one that slips
+                # past the edge validation in the mode-flip race window.
+                dev_op = e.op
+                if dev_op == OP_SUBMIT and self.auction_mode:
+                    dev_op = OP_REST
                 host_orders.append(
                     HostOrder(
                         sym=slot,
-                        op=e.op,
+                        op=dev_op,
                         side=i.side,
                         otype=i.otype,
                         price=i.price_q4,
-                        qty=i.remaining if e.op == OP_SUBMIT else 0,
+                        qty=i.remaining if e.op != OP_CANCEL else 0,
                         oid=i.handle,
                     )
                 )
                 by_handle[i.handle] = e
-                if e.op == OP_SUBMIT:
+                if e.op in (OP_SUBMIT, OP_REST):
                     # Register BEFORE dispatch: with waves dispatched ahead
                     # of the decode cursor, a concurrent book_snapshot can
                     # see device lanes whose wave hasn't decoded yet — any
@@ -634,6 +653,120 @@ class EngineRunner:
 
         return len(arrays), dispatch_dense(), decode_dense, finalize_dense
 
+    # -- call auction ------------------------------------------------------
+
+    def run_auction(self, symbols=None, sink=None) -> dict:
+        """Call-auction uncross (engine/auction.py) over `symbols` (names;
+        None/empty = every symbol currently allocated on this host).
+
+        Serialized with dispatches on the dispatch lock (finishing any
+        pipelined pending batch first — the auction must see fully-decoded
+        directories); storage/stream events publish under the lock, same
+        checkpoint invariant as a dispatch. Returns a summary dict:
+        {"crossed": [(symbol, clearing_price_q4, executed)], "aborted",
+        "error"}."""
+        if self._sharded is not None:
+            return {"crossed": [], "aborted": False,
+                    "error": "auction requires single-device serving "
+                             "(mesh uncross not yet supported)"}
+        posts: list = []
+        try:
+            with self._dispatch_lock, Timer(self.metrics,
+                                            "engine_dispatch_us"):
+                self._finish_pending_locked(posts)
+                summary = self._run_auction_locked(symbols, sink)
+        finally:
+            for p in posts:
+                p()
+        return summary
+
+    def _run_auction_locked(self, symbols, sink) -> dict:
+        from matching_engine_tpu.engine.auction import (
+            auction_step,
+            decode_auction,
+        )
+        from matching_engine_tpu.server.dispatcher import publish_result
+
+        mask = np.zeros((self.cfg.num_symbols,), dtype=bool)
+        with self._id_lock:
+            allocated = list(self.symbols.items())
+        wanted = set(symbols) if symbols else None
+        for name, slot in allocated:
+            if wanted is None or name in wanted:
+                mask[slot] = True
+        self._build_ou = self.hub is None or self.hub.has_order_update_subs()
+        self._build_md = self.hub is None or self.hub.has_market_data_subs()
+
+        self._step_num += 1
+        with self._snapshot_lock, step_annotation("auction_step",
+                                                  self._step_num):
+            new_book, out = auction_step(self.cfg, self.book, mask)
+        dec, fills = decode_auction(self.cfg, out)
+        if dec.aborted:
+            # All-or-nothing: the kernel left every book untouched; keep
+            # the new (identical) buffers and report the abort.
+            self.book = new_book
+            self.metrics.inc("auction_aborts")
+            return {"crossed": [], "aborted": True,
+                    "error": "fill buffer too small for the uncross "
+                             "(raise max_fills)"}
+        self.book = new_book
+
+        res = DispatchResult([], [], [], [], [], [], len(fills))
+        touched: dict[int, OrderInfo] = {}
+        for f in fills:
+            bid = self.orders_by_handle.get(f.taker_oid)
+            ask = self.orders_by_handle.get(f.maker_oid)
+            for info in (bid, ask):
+                if info is None:
+                    continue  # unreachable if directories are consistent
+                info.remaining -= f.quantity
+                info.status = (FILLED if info.remaining == 0
+                               else PARTIALLY_FILLED)
+                touched[info.handle] = info
+                if self._build_ou:
+                    res.order_updates.append(
+                        self._fill_update(info, f.price_q4, f.quantity))
+            if bid is not None and ask is not None:
+                res.storage_fills.append(
+                    FillRow(bid.order_id, ask.order_id, f.price_q4,
+                            f.quantity))
+        # One final-state storage update per touched order (records within
+        # one auction all execute at the same engine time).
+        for info in touched.values():
+            res.storage_updates.append(
+                (info.order_id, info.status, info.remaining))
+
+        crossed = []
+        exec_arr = dec.executed
+        for slot in np.nonzero(exec_arr > 0)[0]:
+            sym = self.slot_symbols[slot]
+            if sym is None:
+                continue
+            crossed.append((sym, int(dec.clear_price[slot]),
+                            int(exec_arr[slot])))
+            if self._build_md:
+                res.market_data.append(pb2.MarketDataUpdate(
+                    symbol=sym,
+                    best_bid=int(dec.best_bid[slot]),
+                    best_ask=int(dec.best_ask[slot]),
+                    scale=4,
+                    bid_size=int(dec.bid_size[slot]),
+                    ask_size=int(dec.ask_size[slot]),
+                ))
+        for info in list(touched.values()):
+            if info.remaining == 0:
+                self._evict(info)
+        publish_result(res, sink, self.hub, self.metrics)
+        self.metrics.inc("auctions")
+        self.metrics.inc("auction_fills", len(fills))
+        if symbols is None:
+            # Only an ALL-symbols uncross ends the call period — a
+            # per-symbol auction must not open continuous trading for
+            # symbols whose books still stand crossed and unopened.
+            self.auction_mode = False
+        return {"crossed": crossed, "aborted": False, "error": ""}
+
     def _evict_terminal(self, ops, res: DispatchResult, by_handle,
                         terminal_makers: set[int]) -> None:
         # Evict terminal orders from the directories: once FILLED / CANCELED /
@@ -645,7 +778,7 @@ class EngineRunner:
         # sweeping the whole directory of resting orders.
         for e in ops:
             i = e.info
-            if e.op == OP_SUBMIT and i.status in (FILLED, CANCELED, REJECTED):
+            if e.op in (OP_SUBMIT, OP_REST) and i.status in (FILLED, CANCELED, REJECTED):
                 self._evict(i)
             elif e.op == OP_CANCEL and i.status == CANCELED:
                 self._evict(i)
@@ -701,7 +834,7 @@ class EngineRunner:
             if e is None:
                 continue
             info = e.info
-            if e.op == OP_SUBMIT:
+            if e.op in (OP_SUBMIT, OP_REST):
                 info.status = r.status
                 info.remaining = r.remaining
                 if r.status == REJECTED:

@@ -22,7 +22,12 @@ import queue
 import threading
 import time
 
-from matching_engine_tpu.engine.kernel import CANCELED, OP_CANCEL, OP_SUBMIT, REJECTED
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    OP_CANCEL,
+    OP_SUBMIT,
+    REJECTED,
+)
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.server.dispatcher import publish_result
 from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
@@ -171,6 +176,14 @@ class GatewayBridge:
                         tag, False, "", "invalid request encoding")
                 continue
             if op == 1:  # submit (already validated in C++)
+                if runner.auction_mode and otype == 1:  # MARKET
+                    self.metrics.inc("orders_rejected")
+                    self.gateway.complete_submit(
+                        tag, False, "",
+                        "MARKET orders are not accepted during an auction "
+                        "call period",
+                    )
+                    continue
                 if not runner.owns_symbol(symbol):
                     self.metrics.inc("orders_rejected")
                     self.gateway.complete_submit(
@@ -192,6 +205,9 @@ class GatewayBridge:
                     price_q4=price_q4, quantity=qty, remaining=qty,
                     status=0, handle=runner.assign_handle(),
                 )
+                # Always OP_SUBMIT: the runner classifies auction-mode
+                # rests under the dispatch lock (edge reads would race
+                # the RunAuction mode flip).
                 e = EngineOp(OP_SUBMIT, info)
             else:  # cancel — host-side directory checks, as the service does
                 info = runner.orders_by_id.get(order_id)
@@ -230,7 +246,7 @@ class GatewayBridge:
                         tag = tags.get(id(op))
                         if tag is None:
                             continue
-                        if op.op == OP_SUBMIT:
+                        if op.op != OP_CANCEL:
                             self.gateway.complete_submit(
                                 tag, False, op.info.order_id, "engine error"
                             )
@@ -247,7 +263,7 @@ class GatewayBridge:
                     if tag is None:
                         continue
                     info = outcome.op.info
-                    if outcome.op.op == OP_SUBMIT:
+                    if outcome.op.op != OP_CANCEL:
                         if outcome.status == REJECTED and outcome.error:
                             self.metrics.inc("orders_rejected")
                             self.gateway.complete_submit(
@@ -273,7 +289,7 @@ class GatewayBridge:
                     tag = tags.pop(id(op), None)
                     if tag is None:
                         continue
-                    if op.op == OP_SUBMIT:
+                    if op.op != OP_CANCEL:
                         self.gateway.complete_submit(
                             tag, False, op.info.order_id,
                             "op produced no outcome"
@@ -325,6 +341,10 @@ class GatewayBridge:
                 elif method == me_native.GW_METRICS:
                     req = pb2.MetricsRequest.FromString(payload)
                     resp = self.service.GetMetrics(req, None)
+                    self.gateway.respond(tag, resp.SerializeToString(), True)
+                elif method == me_native.GW_AUCTION:
+                    req = pb2.AuctionRequest.FromString(payload)
+                    resp = self.service.RunAuction(req, None)
                     self.gateway.respond(tag, resp.SerializeToString(), True)
                 elif method in (me_native.GW_STREAM_MD, me_native.GW_STREAM_OU):
                     # Streams hold a worker for their lifetime; run each on

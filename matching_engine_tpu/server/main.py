@@ -261,6 +261,10 @@ def main(argv=None) -> int:
     p.add_argument("--gateway-addr", default=None, metavar="HOST:PORT",
                    help="also serve through the C++ gRPC gateway on this "
                         "address (port 0 = OS-assigned)")
+    p.add_argument("--auction-open", action="store_true",
+                   help="boot in call-auction accumulation: submits REST "
+                        "without matching until a RunAuction uncross opens "
+                        "continuous trading (engine/auction.py)")
     args = p.parse_args(argv)
 
     # Persistent compile cache (same default as benchmarks/bench_child.py):
@@ -300,6 +304,11 @@ def main(argv=None) -> int:
         )
     except SystemExit as e:
         return int(e.code or 3)
+
+    if args.auction_open:
+        parts["runner"].auction_mode = True
+        print("[SERVER] auction call period OPEN (submits rest unmatched "
+              "until RunAuction)")
 
     stop_evt = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -77,6 +77,9 @@ class MatchingEngineService(MatchingEngineServicer):
         )
 
         err = validate_submit(request)
+        if (err is None and self.runner.auction_mode
+                and request.order_type == pb2.MARKET):
+            err = "MARKET orders are not accepted during an auction call period"
         if err is None and not self.runner.owns_symbol(request.symbol):
             # Multi-process routing invariant: the client (or front-end
             # router) must send this symbol to its home host.
@@ -103,6 +106,9 @@ class MatchingEngineService(MatchingEngineServicer):
             handle=self.runner.assign_handle(),
         )
         try:
+            # Always OP_SUBMIT here: auction-mode classification happens
+            # in the runner under the dispatch lock (atomic with the
+            # RunAuction mode flip; the edge read would race).
             outcome = self.dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
         except RingFull:
             # Known-unqueued: the device never saw this op, recycle now.
@@ -220,3 +226,32 @@ class MatchingEngineService(MatchingEngineServicer):
     def GetMetrics(self, request, context):
         counters, gauges = self.metrics.snapshot()
         return pb2.MetricsResponse(gauges=gauges, counters=counters)
+
+    # -- call auction ------------------------------------------------------
+
+    def RunAuction(self, request, context):
+        """Batch uncross (engine/auction.py): one symbol, or every symbol
+        this host serves when request.symbol is empty. Failures are
+        application-level (success=false + message, gRPC OK) — the
+        SubmitOrder reject convention."""
+        symbol = request.symbol or None
+        if symbol is not None and not self.runner.owns_symbol(symbol):
+            return pb2.AuctionResponse(
+                success=False,
+                error_message=f"symbol {symbol} is homed on another host",
+            )
+        self._log(f"auction {'ALL' if symbol is None else symbol}")
+        summary = self.runner.run_auction(
+            [symbol] if symbol else None, sink=self.dispatcher.sink)
+        if summary["error"]:
+            return pb2.AuctionResponse(success=False,
+                                       error_message=summary["error"])
+        crossed = summary["crossed"]
+        total = sum(q for _, _, q in crossed)
+        price = crossed[0][1] if symbol is not None and crossed else 0
+        return pb2.AuctionResponse(
+            success=True,
+            clearing_price=price,
+            executed_quantity=total,
+            symbols_crossed=len(crossed),
+        )

@@ -45,6 +45,7 @@ const char kUsage[] =
     "   or: me_client metrics <addr>\n"
     "   or: me_client watch-md <addr> <symbol> [max_events]\n"
     "   or: me_client watch-orders <addr> <client_id> [max_events]\n"
+    "   or: me_client auction <addr> [symbol]\n"
     "   or: me_client bench <addr> <clients> <per_client> [symbols] [inflight]";
 
 int dial(const std::string& addr) {
@@ -703,6 +704,42 @@ int do_book(const std::string& addr, const std::string& symbol) {
   return 0;
 }
 
+int do_auction(const std::string& addr, const std::string& symbol) {
+  pb::AuctionRequest req;
+  req.set_symbol(symbol);
+  std::string bytes, resp_bytes, grpc_message;
+  req.SerializeToString(&bytes);
+  int grpc_status = -1;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/RunAuction",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0 ||
+      grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::AuctionResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  if (!resp.success()) {
+    std::printf("[client] auction rejected: %s\n",
+                resp.error_message().c_str());
+    return 3;
+  }
+  if (symbol.empty()) {
+    std::printf("[client] auction: %d symbol(s) crossed, %lld executed\n",
+                resp.symbols_crossed(),
+                static_cast<long long>(resp.executed_quantity()));
+  } else {
+    std::printf("[client] auction %s: cleared %lld@Q4 x%lld\n",
+                symbol.c_str(),
+                static_cast<long long>(resp.clearing_price()),
+                static_cast<long long>(resp.executed_quantity()));
+  }
+  return 0;
+}
+
 int do_metrics(const std::string& addr) {
   pb::MetricsRequest req;
   std::string bytes, resp_bytes, grpc_message;
@@ -822,6 +859,9 @@ int main(int argc, char** argv) {
   }
   if (argc == 3 && std::strcmp(argv[1], "metrics") == 0) {
     return do_metrics(argv[2]);
+  }
+  if ((argc == 3 || argc == 4) && std::strcmp(argv[1], "auction") == 0) {
+    return do_auction(argv[2], argc == 4 ? argv[3] : "");
   }
   if ((argc == 4 || argc == 5) &&
       (std::strcmp(argv[1], "watch-md") == 0 ||

@@ -158,6 +158,7 @@ enum Method {
   M_METRICS = 4,
   M_STREAM_MD = 5,
   M_STREAM_OU = 6,
+  M_AUCTION = 7,
 };
 
 int route(const std::string& path) {
@@ -170,6 +171,7 @@ int route(const std::string& path) {
   if (m == "GetMetrics") return M_METRICS;
   if (m == "StreamMarketData") return M_STREAM_MD;
   if (m == "StreamOrderUpdates") return M_STREAM_OU;
+  if (m == "RunAuction") return M_AUCTION;  // forwarded (service-side)
   return M_UNKNOWN;
 }
 

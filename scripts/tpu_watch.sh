@@ -36,19 +36,33 @@ for _ in $(seq 1 "$MAX_LOOPS"); do
         --json-out "$out" --symbols 4096 --capacity 128 --batch 32 \
         >>"$LOG" 2>&1; then
       log "bench ok: $(cat "$out")"
-      # Same healthy window: capture the suite (configs 1/2/3/5 — parity
+      # Same healthy window: capture the suite (configs 1/2/3/5/6 — parity
       # gate + device-side rows; config 4 is tpu_e2e_watch.sh's job) so
       # the round has more than the single headline number on hardware.
       suite="$OUT_DIR/tpu_suite_${ts}.jsonl"
-      log "running benchmark suite (configs 1,2,3,5)"
+      log "running benchmark suite (configs 1,2,3,5,6)"
       if timeout "$SUITE_TIMEOUT" python "$REPO/benchmarks/run_all.py" \
-          --configs 1,2,3,5 >"$suite.tmp" 2>>"$LOG"; then
+          --configs 1,2,3,5,6 >"$suite.tmp" 2>>"$LOG"; then
         mv "$suite.tmp" "$suite"
         log "suite ok: $(wc -l <"$suite") rows"
       else
         log "suite failed rc=$? (suite tmp removed; bench artifact $out kept)"
         rm -f "$suite.tmp"
       fi
+      # Batch-axis scaling evidence: the step is HBM-bound on the book
+      # arrays, so doubling the batch amortizes the same traffic over 2x
+      # the ops — capture batch 64/128 at the headline symbol count.
+      for b in 64 128; do
+        bout="$OUT_DIR/tpu_batch${b}_${ts}.json"
+        if timeout "$BENCH_TIMEOUT" python "$REPO/benchmarks/bench_child.py" \
+            --json-out "$bout" --symbols 4096 --capacity 128 --batch "$b" \
+            >>"$LOG" 2>&1; then
+          log "batch$b ok: $(cat "$bout")"
+        else
+          log "batch$b bench failed rc=$? (artifact removed)"
+          rm -f "$bout"
+        fi
+      done
       exit 0
     fi
     log "bench failed rc=$? (artifact removed; will retry next interval)"

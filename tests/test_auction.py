@@ -1,0 +1,326 @@
+"""Call-auction kernel parity and invariants (engine/auction.py).
+
+Books are built in AUCTION-MODE accumulation: orders rest directly
+without continuous matching (the pre-open state call auctions exist for —
+a continuously-matched book never stands crossed). Each state replays
+through the device uncross and the oracle's `auction()`; clearing price,
+executed volume, bilateral records, and the post-auction books must agree
+exactly. Plus mechanism invariants: volume conservation, all-or-nothing
+overflow abort, and mask scoping.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.auction import auction_step, decode_auction
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig, init_book
+from matching_engine_tpu.engine.harness import snapshot_books
+from matching_engine_tpu.engine.oracle import OracleBook, _Resting
+
+CFG = EngineConfig(num_symbols=8, capacity=32, batch=8, max_fills=1 << 12)
+
+
+def build_crossed_books(cfg, seed, levels=12):
+    """Device books + oracle twins holding the SAME un-matched resting
+    state, with overlapping bid/ask bands so auctions usually cross."""
+    rng = np.random.default_rng(seed)
+    s, c = cfg.num_symbols, cfg.capacity
+    arr = {f: np.zeros((s, c), dtype=np.int32)
+           for f in ("bid_price", "bid_qty", "bid_oid", "bid_seq",
+                     "ask_price", "ask_qty", "ask_oid", "ask_seq")}
+    next_seq = np.zeros((s,), dtype=np.int32)
+    oracles = {i: OracleBook(c) for i in range(s)}
+    oid = 1
+    for i in range(s):
+        seq = 0
+        nb, na = int(rng.integers(0, c)), int(rng.integers(0, c))
+        for side, n in (("bid", nb), ("ask", na)):
+            for k in range(n):
+                price = int(10_000 + rng.integers(-levels, levels + 1))
+                qty = int(rng.integers(1, 50))
+                arr[f"{side}_price"][i, k] = price
+                arr[f"{side}_qty"][i, k] = qty
+                arr[f"{side}_oid"][i, k] = oid
+                arr[f"{side}_seq"][i, k] = seq
+                rest = _Resting(oid, price, qty, seq)
+                (oracles[i].bids if side == "bid" else
+                 oracles[i].asks).append(rest)
+                oid += 1
+                seq += 1
+        next_seq[i] = seq
+        oracles[i].next_seq = seq
+    book = BookBatch(**{k: jnp.asarray(v) for k, v in arr.items()},
+                     next_seq=jnp.asarray(next_seq))
+    return book, oracles
+
+
+def canon(fills):
+    return sorted((f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+                  for f in fills)
+
+
+def canon_oracle(sym, fills):
+    return sorted((sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+                  for f in fills)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_auction_matches_oracle(seed):
+    book, oracles = build_crossed_books(CFG, seed)
+    mask = np.ones((CFG.num_symbols,), dtype=bool)
+    new_book, out = auction_step(CFG, book, mask)
+    dec, fills = decode_auction(CFG, out)
+    assert not dec.aborted
+
+    expected = []
+    crossed = 0
+    for s, ob in oracles.items():
+        p, q, ofills = ob.auction()
+        assert int(dec.clear_price[s]) == p, f"symbol {s} price"
+        assert int(dec.executed[s]) == q, f"symbol {s} volume"
+        crossed += q > 0
+        expected.extend(canon_oracle(s, ofills))
+    assert crossed > 0, "fuzz produced no crossing book — weak seed"
+    assert canon(fills) == sorted(expected)
+
+    # Post-auction books match the oracle twins exactly.
+    snaps = snapshot_books(new_book)
+    for s, ob in oracles.items():
+        assert snaps[s] == ob.snapshot(), f"symbol {s} post-auction book"
+
+    # Conservation: per symbol the bilateral records sum to the volume.
+    for s in range(CFG.num_symbols):
+        vol = sum(f.quantity for f in fills if f.sym == s)
+        assert vol == int(dec.executed[s])
+
+
+def test_auction_mask_scopes_the_uncross():
+    book, oracles = build_crossed_books(CFG, seed=7)
+    mask = np.zeros((CFG.num_symbols,), dtype=bool)
+    mask[3] = True
+    before = snapshot_books(book)
+    new_book, out = auction_step(CFG, book, mask)
+    dec, fills = decode_auction(CFG, out)
+    after = snapshot_books(new_book)
+    for s in range(CFG.num_symbols):
+        if s == 3:
+            continue
+        assert after[s] == before[s], f"unmasked symbol {s} changed"
+        assert int(dec.executed[s]) == 0
+    assert all(f.sym == 3 for f in fills)
+    p, q, ofills = oracles[3].auction()
+    assert int(dec.clear_price[3]) == p and int(dec.executed[3]) == q
+    assert canon(fills) == sorted(canon_oracle(3, ofills))
+
+
+def test_auction_empty_and_uncrossable_books():
+    cfg = EngineConfig(num_symbols=2, capacity=8, batch=4, max_fills=128)
+    book, _ = build_crossed_books(cfg, seed=1, levels=0)
+    # Symbol books at a single price CAN cross; rebuild uncrossable:
+    book = init_book(cfg)
+    book = book._replace(
+        bid_price=book.bid_price.at[1, 0].set(90),
+        bid_qty=book.bid_qty.at[1, 0].set(5),
+        bid_oid=book.bid_oid.at[1, 0].set(1),
+        ask_price=book.ask_price.at[1, 0].set(110),
+        ask_qty=book.ask_qty.at[1, 0].set(5),
+        ask_oid=book.ask_oid.at[1, 0].set(2),
+    )
+    before = snapshot_books(book)
+    new_book, out = auction_step(cfg, book, np.ones((2,), dtype=bool))
+    dec, fills = decode_auction(cfg, out)
+    assert not dec.aborted and dec.fill_count == 0 and fills == []
+    assert int(dec.executed[0]) == 0 and int(dec.executed[1]) == 0
+    assert snapshot_books(new_book) == before
+
+
+def test_auction_overflow_aborts_untouched():
+    """A fill log too small for the bilateral records must abort the WHOLE
+    auction with books unchanged — never a half-logged uncross."""
+    cfg = EngineConfig(num_symbols=1, capacity=16, batch=4, max_fills=4)
+    book = init_book(cfg)
+    # 8 one-lot bids at 105 vs 8 one-lot asks at 100: 8 records > 4 slots.
+    for k in range(8):
+        book = book._replace(
+            bid_price=book.bid_price.at[0, k].set(105),
+            bid_qty=book.bid_qty.at[0, k].set(1),
+            bid_oid=book.bid_oid.at[0, k].set(100 + k),
+            bid_seq=book.bid_seq.at[0, k].set(k),
+            ask_price=book.ask_price.at[0, k].set(100),
+            ask_qty=book.ask_qty.at[0, k].set(1),
+            ask_oid=book.ask_oid.at[0, k].set(200 + k),
+            ask_seq=book.ask_seq.at[0, k].set(k),
+        )
+    before = snapshot_books(book)
+    new_book, out = auction_step(cfg, book, np.ones((1,), dtype=bool))
+    dec, fills = decode_auction(cfg, out)
+    assert dec.aborted and dec.fill_count == 0 and fills == []
+    assert int(dec.executed[0]) == 0 and int(dec.clear_price[0]) == 0
+    assert snapshot_books(new_book) == before
+
+
+def test_auction_priority_rationing():
+    """The long side rations by price-time priority: better-priced bids
+    fill fully, the marginal (time-latest at the marginal price) order
+    gets the remainder."""
+    cfg = EngineConfig(num_symbols=1, capacity=8, batch=4, max_fills=64)
+    book = init_book(cfg)
+
+    def lane(side, k, price, qty, oid, seq):
+        return {
+            f"{side}_price": getattr(book, f"{side}_price").at[0, k].set(price),
+            f"{side}_qty": getattr(book, f"{side}_qty").at[0, k].set(qty),
+            f"{side}_oid": getattr(book, f"{side}_oid").at[0, k].set(oid),
+            f"{side}_seq": getattr(book, f"{side}_seq").at[0, k].set(seq),
+        }
+
+    # Bids: 10@102 (seq 0), 10@101 (seq 1), 10@101 (seq 2) — demand 30.
+    # Asks: 15@100 (seq 0) — supply 15. p* = 101 region; executed 15.
+    book = book._replace(**lane("bid", 0, 102, 10, 11, 0))
+    book = book._replace(**lane("bid", 1, 101, 10, 12, 1))
+    book = book._replace(**lane("bid", 2, 101, 10, 13, 2))
+    book = book._replace(**lane("ask", 0, 100, 15, 21, 0))
+    new_book, out = auction_step(cfg, book, np.ones((1,), dtype=bool))
+    dec, fills = decode_auction(cfg, out)
+    assert int(dec.executed[0]) == 15
+    by_taker = {f.taker_oid: f.quantity for f in fills}
+    # 102-bid fills fully (10); first 101-bid gets 5; second gets nothing.
+    assert by_taker == {11: 10, 12: 5}
+    assert all(f.maker_oid == 21 and f.quantity > 0 for f in fills)
+    bq = np.asarray(new_book.bid_qty)[0]
+    assert bq[0] == 0 and bq[1] == 5 and bq[2] == 10
+    assert int(np.asarray(new_book.ask_qty)[0, 0]) == 0
+
+
+# -- OP_REST (auction accumulation) parity ----------------------------------
+
+def test_op_rest_accumulates_crossed_books():
+    """OP_REST rests without matching — crossing orders stand; oracle.rest
+    twin agrees on book state and statuses."""
+    from matching_engine_tpu.engine.harness import apply_orders
+    from matching_engine_tpu.engine.kernel import NEW, OP_REST, REJECTED
+
+    cfg = EngineConfig(num_symbols=2, capacity=4, batch=4, max_fills=64)
+    from matching_engine_tpu.engine.harness import HostOrder
+    from matching_engine_tpu.proto import BUY, LIMIT, SELL
+
+    ob = OracleBook(cfg.capacity)
+    stream = []
+    expected = []
+    for oid, (side, price, qty) in enumerate([
+        (BUY, 105, 5), (SELL, 100, 3),   # would cross under OP_SUBMIT
+        (BUY, 104, 2), (SELL, 99, 1),
+        (BUY, 103, 1), (BUY, 106, 2),    # 4th bid fills the side (cap 4)
+    ], start=1):
+        stream.append(HostOrder(sym=0, op=OP_REST, side=side, otype=LIMIT,
+                                price=price, qty=qty, oid=oid))
+        expected.append(ob.rest(oid, side, price, qty).status)
+    book = init_book(cfg)
+    book, results, fills = apply_orders(cfg, book, stream)
+    assert fills == []                       # NOTHING matched
+    assert [r.status for r in results] == expected
+    assert all(st == NEW for st in expected)
+    assert snapshot_books(book)[0] == ob.snapshot()
+
+    # Capacity reject parity: a 5th bid on a 4-lane side.
+    extra = HostOrder(sym=0, op=OP_REST, side=BUY, otype=LIMIT,
+                      price=102, qty=1, oid=99)
+    book, results, fills = apply_orders(cfg, book, [extra])
+    assert results[0].status == REJECTED == ob.rest(99, BUY, 102, 1).status
+
+
+# -- full serving flow: open auction -> uncross -> continuous ---------------
+
+def test_auction_server_flow(tmp_path):
+    """Boot in auction mode: submits rest (even crossing), MARKET rejected,
+    RunAuction uncrosses at one price (fills in SQLite, audit clean), and
+    continuous matching resumes afterwards."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "auction.db"), cfg, window_ms=1.0,
+        log=False)
+    parts["runner"].auction_mode = True
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+
+    def sub(client, side, price, qty, otype=pb2.LIMIT, symbol="AU"):
+        return stub.SubmitOrder(
+            pb2.OrderRequest(client_id=client, symbol=symbol, side=side,
+                             order_type=otype, price=price, scale=4,
+                             quantity=qty), timeout=15)
+
+    try:
+        # Crossing flow RESTS: bids 102x5, 101x5; asks 100x4, 101x3.
+        oids = {}
+        for who, side, price, qty in [
+            ("b1", pb2.BUY, 102, 5), ("b2", pb2.BUY, 101, 5),
+            ("a1", pb2.SELL, 100, 4), ("a2", pb2.SELL, 101, 3),
+        ]:
+            r = sub(who, side, price, qty)
+            assert r.success, r.error_message
+            oids[who] = r.order_id
+        # MARKET rejected during the call period.
+        rm = sub("m", pb2.BUY, 0, 1, otype=pb2.MARKET)
+        assert not rm.success and "auction call period" in rm.error_message
+
+        # Book stands CROSSED (best bid >= best ask) — impossible under
+        # continuous matching, the defining auction-mode state.
+        book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="AU"),
+                                 timeout=10)
+        assert len(book.bids) == 2 and len(book.asks) == 2
+
+        # Uncross: demand(101)=10 vs supply(101)=7 -> p*=101, 7 executed.
+        resp = stub.RunAuction(pb2.AuctionRequest(symbol="AU"), timeout=30)
+        assert resp.success, resp.error_message
+        assert resp.clearing_price == 101 and resp.executed_quantity == 7
+        assert resp.symbols_crossed == 1
+        # A per-symbol uncross does NOT end the call period (other symbols
+        # may still stand crossed); the ALL-symbols uncross does.
+        assert parts["runner"].auction_mode
+        resp_all = stub.RunAuction(pb2.AuctionRequest(), timeout=30)
+        assert resp_all.success
+        assert not parts["runner"].auction_mode
+
+        parts["sink"].flush()
+        import sqlite3
+        db = sqlite3.connect(str(tmp_path / "auction.db"))
+        fills = db.execute(
+            "select order_id, counter_order_id, price, quantity from fills"
+        ).fetchall()
+        assert sum(q for *_, q in fills) == 7
+        assert all(p == 101 for _, _, p, _ in fills)
+        # b1 fully filled (priority), b2 partial (2 of 5).
+        rows = dict(
+            (oid, (st, rem)) for oid, st, rem in db.execute(
+                "select order_id, status, remaining_quantity from orders"))
+        assert rows[oids["b1"]] == (2, 0)       # FILLED
+        assert rows[oids["b2"]] == (1, 3)       # PARTIAL, 3 left
+        assert rows[oids["a1"]] == (2, 0)
+        assert rows[oids["a2"]] == (2, 0)
+        db.close()
+
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "scripts"))
+        from audit import audit
+        parts["sink"].flush()
+        assert audit(str(tmp_path / "auction.db")) == []
+
+        # Continuous trading resumed: a crossing submit now MATCHES.
+        r1 = sub("c1", pb2.SELL, 101, 2)        # hits b2's resting 3@101
+        assert r1.success
+        parts["sink"].flush()
+        db = sqlite3.connect(str(tmp_path / "auction.db"))
+        n_fills = db.execute("select count(*) from fills").fetchone()[0]
+        db.close()
+        assert n_fills > len(fills)             # new continuous fill rows
+    finally:
+        shutdown(server, parts)

@@ -510,3 +510,51 @@ def test_concurrent_streams_one_channel(hs):
 
     with ThreadPoolExecutor(max_workers=64) as ex:
         assert all(ex.map(one, range(64)))
+
+
+def test_auction_through_native_edge(tmp_path):
+    """The full open-auction flow entirely through the C++ gateway: rests
+    accumulate a crossed book, RunAuction (forwarded method M_AUCTION)
+    uncrosses, continuous matching resumes — one implementation, both
+    transports."""
+    h = GwHarness(str(tmp_path / "gw-auction.db"),
+                  cfg=EngineConfig(num_symbols=4, capacity=16, batch=4))
+    try:
+        h.parts["runner"].auction_mode = True
+
+        def sub(client, side, price, qty):
+            return h.stub.SubmitOrder(
+                pb2.OrderRequest(client_id=client, symbol="GAU", side=side,
+                                 order_type=pb2.LIMIT, price=price, scale=4,
+                                 quantity=qty), timeout=15)
+
+        assert sub("b", pb2.BUY, 102, 5).success
+        assert sub("a", pb2.SELL, 100, 3).success
+        # MARKET rejected during the call period — via the C++ edge.
+        rm = h.stub.SubmitOrder(
+            pb2.OrderRequest(client_id="m", symbol="GAU", side=pb2.BUY,
+                             order_type=pb2.MARKET, quantity=1), timeout=15)
+        assert not rm.success and "auction call period" in rm.error_message
+
+        resp = h.stub.RunAuction(pb2.AuctionRequest(symbol="GAU"),
+                                 timeout=30)
+        assert resp.success, resp.error_message
+        assert resp.executed_quantity == 3 and resp.symbols_crossed == 1
+        # p* = 100: executable is 3 at both 100 and 102, imbalance |5-3|=2
+        # at both -> tie-break takes the LOWEST price: 100.
+        assert resp.clearing_price == 100
+
+        # Per-symbol uncross keeps the call period; the all-symbols
+        # uncross (still via the C++ edge) opens continuous trading.
+        assert h.parts["runner"].auction_mode
+        assert h.stub.RunAuction(pb2.AuctionRequest(), timeout=30).success
+        assert not h.parts["runner"].auction_mode
+        r = sub("c", pb2.SELL, 102, 2)   # crosses the remaining 2@102 bid
+        assert r.success
+        h.flush()
+        import sqlite3
+        db = sqlite3.connect(h.db_path)
+        assert db.execute("select count(*) from fills").fetchone()[0] >= 2
+        db.close()
+    finally:
+        h.close()
