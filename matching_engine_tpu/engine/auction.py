@@ -147,6 +147,44 @@ def _records_one(fill_b, fill_a, start_b, start_a, bid_oid, ask_oid):
     return compact(taker), compact(maker), compact(flat), jnp.sum(m)
 
 
+def apply_uncross(book: BookBatch, fill_b, fill_a, apply) -> BookBatch:
+    """Decrement both sides' executed quantities where `apply` ([S] bool)
+    holds — THE one book-update rule for single-device and mesh uncross."""
+    return BookBatch(
+        bid_price=book.bid_price,
+        bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
+        bid_oid=book.bid_oid,
+        bid_seq=book.bid_seq,
+        ask_price=book.ask_price,
+        ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
+        ask_oid=book.ask_oid,
+        ask_seq=book.ask_seq,
+        next_seq=book.next_seq,
+    )
+
+
+def compact_records(sym_ids, rec_taker, rec_maker, price, rec_qty, n,
+                    aborted):
+    """Stage-2 global compaction of the per-symbol record lanes into one
+    [n] log (5 columns) — shared by the single-device and shard-local
+    paths; `aborted` routes every record to the trash lane."""
+    flat_qty = rec_qty.reshape(-1)
+    m = flat_qty > 0
+    pos = jnp.cumsum(m) - 1
+    dest = jnp.where(m & (pos < n) & ~aborted, pos, n)  # n = trash
+
+    def compact(vals):
+        return jnp.zeros((n + 1,), I32).at[dest].set(vals.reshape(-1))[:n]
+
+    return (compact(sym_ids), compact(rec_taker), compact(rec_maker),
+            compact(price), compact(flat_qty))
+
+
+def zero_unless(x, ok):
+    """x where ok else 0 (the aborted-output masking rule)."""
+    return x * jnp.where(ok, 1, 0).astype(I32)
+
+
 @partial(jax.jit, static_argnums=0, donate_argnums=1)
 def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     """Uncross every masked symbol's book at its clearing price.
@@ -170,47 +208,22 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     aborted = total > n
 
     # All-or-nothing: an overflow leaves every book untouched.
-    apply = mask & ~aborted
-    new_book = BookBatch(
-        bid_price=book.bid_price,
-        bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
-        bid_oid=book.bid_oid,
-        bid_seq=book.bid_seq,
-        ask_price=book.ask_price,
-        ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
-        ask_oid=book.ask_oid,
-        ask_seq=book.ask_seq,
-        next_seq=book.next_seq,
-    )
+    new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted)
 
     # Stage 2: global compaction over the [S, 2C-1] lanes (row-major, so
     # records stay symbol-major in per-symbol rank order).
     r = 2 * cap - 1
-    flat_qty = rec_qty.reshape(-1)
-    rec_mask = flat_qty > 0
-    pos = jnp.cumsum(rec_mask) - 1
-    dest = jnp.where(rec_mask & (pos < n) & ~aborted, pos, n)  # n = trash
-
-    def compact(flat_vals):
-        return jnp.zeros((n + 1,), I32).at[dest].set(flat_vals)[:n]
-
     sym_ids = jnp.broadcast_to(
         jnp.arange(s_dim, dtype=I32)[:, None], (s_dim, r))
     price = jnp.broadcast_to(p_star[:, None], (s_dim, r))
-    fills = jnp.stack([
-        compact(sym_ids.reshape(-1)),
-        compact(rec_taker.reshape(-1)),
-        compact(rec_maker.reshape(-1)),
-        compact(price.reshape(-1)),
-        compact(flat_qty),
-    ])
+    fills = jnp.stack(list(compact_records(
+        sym_ids, rec_taker, rec_maker, price, rec_qty, n, aborted)))
 
     best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty, True)
     best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
-    zero_if_aborted = jnp.where(aborted, 0, 1).astype(I32)
     small = jnp.concatenate([
-        p_star * zero_if_aborted,
-        q_exec * zero_if_aborted,
+        zero_unless(p_star, ~aborted),
+        zero_unless(q_exec, ~aborted),
         best_bid, bid_size, best_ask, ask_size,
         jnp.stack([
             jnp.where(aborted, 0, jnp.minimum(total, n)).astype(I32),

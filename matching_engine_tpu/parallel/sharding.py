@@ -188,6 +188,155 @@ class ShardedEngine:
         # ICI publication path: every chip ends up with the full [S] arrays.
         self.all_top_of_book = jax.jit(gather_tob)
 
+    def _build_auction(self) -> None:
+        """Sharded call auction (engine/auction.py on a mesh): symbols are
+        independent, so the uncross is pure SPMD; the ONLY collective is the
+        global all-or-nothing abort (a pmax over per-shard record-log
+        overflow). Fill logs stay per shard ([n_shards * max_fills],
+        shard i's valid rows [i*max_fills, i*max_fills + count[i])), same
+        as the continuous step — decode reads addressable shards only, so
+        the path works multi-process."""
+        from matching_engine_tpu.engine.auction import (
+            _records_one,
+            _uncross_one,
+            apply_uncross,
+            compact_records,
+            zero_unless,
+        )
+
+        local_cfg = self.local_cfg
+        local_s = local_cfg.num_symbols
+        cap = local_cfg.capacity
+        n = local_cfg.max_fills
+        mesh = self.mesh
+
+        def local_auction(book: BookBatch, mask):
+            fill_b, fill_a, p_star, q_exec, start_b, start_a = jax.vmap(
+                _uncross_one)(
+                book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
+                book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq,
+                mask,
+            )
+            rec_taker, rec_maker, rec_qty, rec_counts = jax.vmap(
+                _records_one)(
+                fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
+            local_total = jnp.sum(rec_counts)
+            # Global all-or-nothing: ANY shard's overflow aborts every shard.
+            aborted = jax.lax.pmax(
+                (local_total > n).astype(I32), AXIS) > 0
+            new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted)
+            r = 2 * cap - 1
+            off = jax.lax.axis_index(AXIS).astype(I32) * local_s
+            sym_ids = jnp.broadcast_to(
+                jnp.arange(local_s, dtype=I32)[:, None], (local_s, r)) + off
+            price = jnp.broadcast_to(p_star[:, None], (local_s, r))
+            f_sym, f_taker, f_maker, f_price, f_qty = compact_records(
+                sym_ids, rec_taker, rec_maker, price, rec_qty, n, aborted)
+            from matching_engine_tpu.engine.kernel import _top_of_book
+
+            best_bid, bid_size = _top_of_book(
+                new_book.bid_price, new_book.bid_qty, True)
+            best_ask, ask_size = _top_of_book(
+                new_book.ask_price, new_book.ask_qty, False)
+            return new_book, (
+                zero_unless(p_star, ~aborted),
+                zero_unless(q_exec, ~aborted),
+                best_bid, bid_size, best_ask, ask_size,
+                f_sym, f_taker, f_maker, f_price, f_qty,
+                jnp.where(aborted, 0, jnp.minimum(local_total, n))
+                .astype(I32).reshape(1),
+                aborted.astype(I32).reshape(1),
+            )
+
+        out_specs = (
+            _book_specs(),
+            (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+             P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        )
+        mapped = jax.shard_map(
+            local_auction,
+            mesh=mesh,
+            in_specs=(_book_specs(), P(AXIS)),
+            out_specs=out_specs,
+        )
+        self._auction_step = jax.jit(mapped, donate_argnums=0)
+
+    def auction(self, book: BookBatch, mask_host):
+        """Run the sharded uncross. mask_host: [S] bool numpy. Returns
+        (new_book, out_tuple) — decode with decode_auction."""
+        if not hasattr(self, "_auction_step"):
+            self._build_auction()
+        mask = hostlocal.put_tree(
+            mask_host, NamedSharding(self.mesh, P(AXIS)))
+        return self._auction_step(book, mask)
+
+    def _decode_shard_fills(self, counts, cols: dict) -> list[HostFill]:
+        """Per-shard fill-log decode from ADDRESSABLE shards only: fetch
+        each shard's buffer whole, slice on host (never a device-side
+        [:n] — a fresh XLA program per count), skip zero-count shards.
+        `cols` maps the decode_fills column names (sym/taker/maker/price/
+        qty) to the [n_shards * max_fills] arrays. Shared by the
+        continuous decode and decode_auction."""
+        import numpy as np
+
+        per = self.cfg.max_fills
+        count_by_shard = {
+            (s.index[0].start or 0): int(np.asarray(s.data)[0])
+            for s in counts.addressable_shards
+        }
+        buf = {
+            name: {
+                (s.index[0].start or 0) // per: s.data
+                for s in arr.addressable_shards
+            }
+            for name, arr in cols.items()
+        }
+        fills: list[HostFill] = []
+        for shard in sorted(count_by_shard):
+            c = count_by_shard[shard]
+            if c == 0:
+                continue  # zero-fill shards are never fetched
+            fills.extend(decode_fills(
+                np.asarray(buf["sym"][shard]),
+                np.asarray(buf["taker"][shard]),
+                np.asarray(buf["maker"][shard]),
+                np.asarray(buf["price"][shard]),
+                np.asarray(buf["qty"][shard]),
+                c,
+            ))
+        return fills
+
+    def decode_auction(self, out):
+        """Host view from addressable shards only (multi-process safe).
+
+        Returns (view, fills, aborted): `view` is a dict of THIS process's
+        contiguous symbol block (lo, clear_price, executed, best_bid,
+        bid_size, best_ask, ask_size); `fills` the local shards' bilateral
+        records as HostFill (sym already globalized)."""
+        (clear_p, executed, bb, bs, ba, asz,
+         f_sym, f_taker, f_maker, f_price, f_qty, counts, aborted) = out
+        clear_local, lo, _ = hostlocal.local_block(clear_p)
+        view = {
+            "lo": lo,
+            "clear_price": clear_local,
+            "executed": hostlocal.local_block(executed)[0],
+            "best_bid": hostlocal.local_block(bb)[0],
+            "bid_size": hostlocal.local_block(bs)[0],
+            "best_ask": hostlocal.local_block(ba)[0],
+            "ask_size": hostlocal.local_block(asz)[0],
+        }
+        import numpy as np
+
+        fills = self._decode_shard_fills(counts, {
+            "sym": f_sym, "taker": f_taker, "maker": f_maker,
+            "price": f_price, "qty": f_qty,
+        })
+        any_aborted = any(
+            bool(np.asarray(s.data).any())
+            for s in aborted.addressable_shards
+        )
+        return view, fills, any_aborted
+
     def init_book(self) -> BookBatch:
         return hostlocal.put_tree(init_book(self.cfg), self.book_sharding)
 
@@ -215,36 +364,13 @@ class ShardedEngine:
             local_batch, status, filled, remaining, sym_offset=lo
         )
 
-        # Fills: fetch each ADDRESSABLE shard's buffer whole and slice on
-        # host — never a global read (multi-host), and never a device-side
-        # `[:n]` slice, which is a fresh XLA program per distinct count
-        # (a compile + execution round trip per step on a tunneled chip).
-        per = self.cfg.max_fills
-        count_by_shard = {
-            (s.index[0].start or 0): int(np.asarray(s.data)[0])
-            for s in out.fill_count.addressable_shards
-        }
-        fill_shards = {
-            name: {
-                (s.index[0].start or 0) // per: s.data
-                for s in getattr(out, name).addressable_shards
-            }
-            for name in ("fill_sym", "fill_taker_oid", "fill_maker_oid",
-                         "fill_price", "fill_qty")
-        }
-        fills = []
-        for shard in sorted(count_by_shard):
-            n = count_by_shard[shard]
-            if n == 0:
-                continue  # zero-fill shards are never fetched
-            fills.extend(decode_fills(
-                np.asarray(fill_shards["fill_sym"][shard]),
-                np.asarray(fill_shards["fill_taker_oid"][shard]),
-                np.asarray(fill_shards["fill_maker_oid"][shard]),
-                np.asarray(fill_shards["fill_price"][shard]),
-                np.asarray(fill_shards["fill_qty"][shard]),
-                n,
-            ))
+        # Fills: the shared per-shard decode (_decode_shard_fills) — never
+        # a global read (multi-host), never a device-side [:n] slice.
+        fills = self._decode_shard_fills(out.fill_count, {
+            "sym": out.fill_sym, "taker": out.fill_taker_oid,
+            "maker": out.fill_maker_oid, "price": out.fill_price,
+            "qty": out.fill_qty,
+        })
         overflow = any(
             bool(np.asarray(s.data).any())
             for s in out.fill_overflow.addressable_shards

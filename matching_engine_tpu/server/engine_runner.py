@@ -665,10 +665,6 @@ class EngineRunner:
         checkpoint invariant as a dispatch. Returns a summary dict:
         {"crossed": [(symbol, clearing_price_q4, executed)], "aborted",
         "error"}."""
-        if self._sharded is not None:
-            return {"crossed": [], "aborted": False,
-                    "error": "auction requires single-device serving "
-                             "(mesh uncross not yet supported)"}
         posts: list = []
         try:
             with self._dispatch_lock, Timer(self.metrics,
@@ -681,10 +677,6 @@ class EngineRunner:
         return summary
 
     def _run_auction_locked(self, symbols, sink) -> dict:
-        from matching_engine_tpu.engine.auction import (
-            auction_step,
-            decode_auction,
-        )
         from matching_engine_tpu.server.dispatcher import publish_result
 
         mask = np.zeros((self.cfg.num_symbols,), dtype=bool)
@@ -698,19 +690,41 @@ class EngineRunner:
         self._build_md = self.hub is None or self.hub.has_market_data_subs()
 
         self._step_num += 1
-        with self._snapshot_lock, step_annotation("auction_step",
-                                                  self._step_num):
-            new_book, out = auction_step(self.cfg, self.book, mask)
-        dec, fills = decode_auction(self.cfg, out)
-        if dec.aborted:
-            # All-or-nothing: the kernel left every book untouched; keep
-            # the new (identical) buffers and report the abort.
-            self.book = new_book
+        if self._sharded is not None:
+            with self._snapshot_lock, step_annotation("auction_step",
+                                                      self._step_num):
+                # Assign under the snapshot lock: the input book was
+                # DONATED, so a concurrent snapshot reader between the
+                # step and the assignment would touch deleted buffers.
+                self.book, out = self._sharded.auction(self.book, mask)
+            view, fills, aborted = self._sharded.decode_auction(out)
+            lo = view["lo"]
+            clear_price, executed = view["clear_price"], view["executed"]
+            best_bid, bid_size = view["best_bid"], view["bid_size"]
+            best_ask, ask_size = view["best_ask"], view["ask_size"]
+        else:
+            from matching_engine_tpu.engine.auction import (
+                auction_step,
+                decode_auction,
+            )
+
+            with self._snapshot_lock, step_annotation("auction_step",
+                                                      self._step_num):
+                # Same donation rule as the mesh branch: assign in-lock.
+                self.book, out = auction_step(self.cfg, self.book, mask)
+            dec, fills = decode_auction(self.cfg, out)
+            aborted = dec.aborted
+            lo = 0
+            clear_price, executed = dec.clear_price, dec.executed
+            best_bid, bid_size = dec.best_bid, dec.bid_size
+            best_ask, ask_size = dec.best_ask, dec.ask_size
+        if aborted:
+            # All-or-nothing: the kernel left every book untouched (the
+            # identical new buffers were installed in-lock above).
             self.metrics.inc("auction_aborts")
             return {"crossed": [], "aborted": True,
                     "error": "fill buffer too small for the uncross "
                              "(raise max_fills)"}
-        self.book = new_book
 
         res = DispatchResult([], [], [], [], [], [], len(fills))
         touched: dict[int, OrderInfo] = {}
@@ -738,21 +752,20 @@ class EngineRunner:
                 (info.order_id, info.status, info.remaining))
 
         crossed = []
-        exec_arr = dec.executed
-        for slot in np.nonzero(exec_arr > 0)[0]:
+        for i in np.nonzero(executed > 0)[0]:
+            slot = lo + int(i)  # local block row -> global slot
             sym = self.slot_symbols[slot]
             if sym is None:
                 continue
-            crossed.append((sym, int(dec.clear_price[slot]),
-                            int(exec_arr[slot])))
+            crossed.append((sym, int(clear_price[i]), int(executed[i])))
             if self._build_md:
                 res.market_data.append(pb2.MarketDataUpdate(
                     symbol=sym,
-                    best_bid=int(dec.best_bid[slot]),
-                    best_ask=int(dec.best_ask[slot]),
+                    best_bid=int(best_bid[i]),
+                    best_ask=int(best_ask[i]),
                     scale=4,
-                    bid_size=int(dec.bid_size[slot]),
-                    ask_size=int(dec.ask_size[slot]),
+                    bid_size=int(bid_size[i]),
+                    ask_size=int(ask_size[i]),
                 ))
         for info in list(touched.values()):
             if info.remaining == 0:
