@@ -84,6 +84,8 @@ def _auction(addr: str, symbol: str) -> int:
     else:
         print(f"[client] auction: {resp.symbols_crossed} symbol(s) crossed, "
               f"{resp.executed_quantity} executed")
+    if resp.error_message:  # partial-abort warning (success=true channel)
+        print(f"[client] warning: {resp.error_message}")
     return 0
 
 

@@ -190,12 +190,16 @@ class ShardedEngine:
 
     def _build_auction(self) -> None:
         """Sharded call auction (engine/auction.py on a mesh): symbols are
-        independent, so the uncross is pure SPMD; the ONLY collective is the
-        global all-or-nothing abort (a pmax over per-shard record-log
-        overflow). Fill logs stay per shard ([n_shards * max_fills],
-        shard i's valid rows [i*max_fills, i*max_fills + count[i])), same
-        as the continuous step — decode reads addressable shards only, so
-        the path works multi-process."""
+        independent, so the uncross is pure SPMD with ZERO collectives —
+        the same invariant that lets multi-process hosts run at
+        independent rates (a collective here would make a lone host's
+        RunAuction hang waiting for peers). All-or-nothing is therefore
+        PER SHARD: a shard whose record log would overflow aborts its own
+        symbols untouched while other shards uncross normally (books are
+        independent, so cross-shard atomicity buys nothing). Fill logs
+        stay per shard ([n_shards * max_fills], shard i's valid rows
+        [i*max_fills, i*max_fills + count[i])), same as the continuous
+        step — decode reads addressable shards only."""
         from matching_engine_tpu.engine.auction import (
             _records_one,
             _uncross_one,
@@ -221,9 +225,8 @@ class ShardedEngine:
                 _records_one)(
                 fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
             local_total = jnp.sum(rec_counts)
-            # Global all-or-nothing: ANY shard's overflow aborts every shard.
-            aborted = jax.lax.pmax(
-                (local_total > n).astype(I32), AXIS) > 0
+            # PER-SHARD all-or-nothing (no collective — see docstring).
+            aborted = local_total > n
             new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted)
             r = 2 * cap - 1
             off = jax.lax.axis_index(AXIS).astype(I32) * local_s
@@ -309,10 +312,16 @@ class ShardedEngine:
     def decode_auction(self, out):
         """Host view from addressable shards only (multi-process safe).
 
-        Returns (view, fills, aborted): `view` is a dict of THIS process's
-        contiguous symbol block (lo, clear_price, executed, best_bid,
-        bid_size, best_ask, ask_size); `fills` the local shards' bilateral
-        records as HostFill (sym already globalized)."""
+        Returns (view, fills, aborted_shards): `view` is a dict of THIS
+        process's contiguous symbol block (lo, clear_price, executed,
+        best_bid, bid_size, best_ask, ask_size); `fills` the local
+        shards' bilateral records as HostFill (sym already globalized);
+        `aborted_shards` how many LOCAL shards hit the per-shard
+        all-or-nothing abort (their symbols are untouched and report
+        executed=0; other shards' results are valid). `view` also carries
+        `aborted_flags` (this host's per-shard abort booleans) and
+        `shard_lo` (its first shard index) so callers can resolve WHICH
+        symbols were hit: symbol slot // local_symbols -> shard."""
         (clear_p, executed, bb, bs, ba, asz,
          f_sym, f_taker, f_maker, f_price, f_qty, counts, aborted) = out
         clear_local, lo, _ = hostlocal.local_block(clear_p)
@@ -331,11 +340,11 @@ class ShardedEngine:
             "sym": f_sym, "taker": f_taker, "maker": f_maker,
             "price": f_price, "qty": f_qty,
         })
-        any_aborted = any(
-            bool(np.asarray(s.data).any())
-            for s in aborted.addressable_shards
-        )
-        return view, fills, any_aborted
+        flags_local, shard_lo, _ = hostlocal.local_block(aborted)
+        flags_local = np.asarray(flags_local).astype(bool)
+        view["aborted_flags"] = flags_local
+        view["shard_lo"] = shard_lo
+        return view, fills, int(flags_local.sum())
 
     def init_book(self) -> BookBatch:
         return hostlocal.put_tree(init_book(self.cfg), self.book_sharding)

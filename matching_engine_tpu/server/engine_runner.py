@@ -662,9 +662,13 @@ class EngineRunner:
         Serialized with dispatches on the dispatch lock (finishing any
         pipelined pending batch first — the auction must see fully-decoded
         directories); storage/stream events publish under the lock, same
-        checkpoint invariant as a dispatch. Returns a summary dict:
-        {"crossed": [(symbol, clearing_price_q4, executed)], "aborted",
-        "error"}."""
+        checkpoint invariant as a dispatch. Returns a summary dict with
+        ALL of: "crossed" [(symbol, clearing_price_q4, executed)],
+        "aborted" (any shard hit the all-or-nothing overflow), "error"
+        (non-empty => the REQUEST failed: every requested symbol sat on
+        an aborted shard; success=false at the RPC), "warning" (partial
+        mesh abort: some shards uncrossed, the aborted shards' symbols
+        are untouched and the call period, if open, stays open)."""
         posts: list = []
         try:
             with self._dispatch_lock, Timer(self.metrics,
@@ -697,11 +701,14 @@ class EngineRunner:
                 # DONATED, so a concurrent snapshot reader between the
                 # step and the assignment would touch deleted buffers.
                 self.book, out = self._sharded.auction(self.book, mask)
-            view, fills, aborted = self._sharded.decode_auction(out)
+            view, fills, aborted_shards = self._sharded.decode_auction(out)
             lo = view["lo"]
             clear_price, executed = view["clear_price"], view["executed"]
             best_bid, bid_size = view["best_bid"], view["bid_size"]
             best_ask, ask_size = view["best_ask"], view["ask_size"]
+            aborted_flags = view["aborted_flags"]
+            shard_lo = view["shard_lo"]
+            local_syms = self._sharded.local_cfg.num_symbols
         else:
             from matching_engine_tpu.engine.auction import (
                 auction_step,
@@ -713,18 +720,30 @@ class EngineRunner:
                 # Same donation rule as the mesh branch: assign in-lock.
                 self.book, out = auction_step(self.cfg, self.book, mask)
             dec, fills = decode_auction(self.cfg, out)
-            aborted = dec.aborted
+            aborted_shards = 1 if dec.aborted else 0
             lo = 0
             clear_price, executed = dec.clear_price, dec.executed
             best_bid, bid_size = dec.best_bid, dec.bid_size
             best_ask, ask_size = dec.best_ask, dec.ask_size
-        if aborted:
-            # All-or-nothing: the kernel left every book untouched (the
-            # identical new buffers were installed in-lock above).
-            self.metrics.inc("auction_aborts")
-            return {"crossed": [], "aborted": True,
-                    "error": "fill buffer too small for the uncross "
-                             "(raise max_fills)"}
+            aborted_flags = np.array([dec.aborted])
+            shard_lo = 0
+            local_syms = self.cfg.num_symbols
+
+        def slot_aborted(slot: int) -> bool:
+            i = slot // local_syms - shard_lo
+            return bool(0 <= i < len(aborted_flags) and aborted_flags[i])
+
+        if aborted_shards:
+            self.metrics.inc("auction_aborts", aborted_shards)
+            # The REQUEST fails outright when every requested symbol sat
+            # on an aborted shard — the caller's uncross did nothing.
+            requested_slots = [s for n, s in allocated
+                               if wanted is None or n in wanted]
+            if requested_slots and all(
+                    slot_aborted(s) for s in requested_slots):
+                return {"crossed": [], "aborted": True,
+                        "error": "fill buffer too small for the uncross "
+                                 "(raise max_fills)", "warning": ""}
 
         res = DispatchResult([], [], [], [], [], [], len(fills))
         touched: dict[int, OrderInfo] = {}
@@ -773,12 +792,25 @@ class EngineRunner:
         publish_result(res, sink, self.hub, self.metrics)
         self.metrics.inc("auctions")
         self.metrics.inc("auction_fills", len(fills))
-        if symbols is None:
-            # Only an ALL-symbols uncross ends the call period — a
-            # per-symbol auction must not open continuous trading for
-            # symbols whose books still stand crossed and unopened.
+        if symbols is None and aborted_shards == 0:
+            # Only a FULLY-successful all-symbols uncross ends the call
+            # period: a per-symbol auction — or an all-symbols one where
+            # any shard aborted — must not open continuous trading while
+            # books somewhere still stand crossed and unopened.
             self.auction_mode = False
-        return {"crossed": crossed, "aborted": False, "error": ""}
+        warning = ""
+        if aborted_shards:
+            # Mesh partial abort: the overflowing shard(s) kept their
+            # symbols untouched (per-shard all-or-nothing); the rest
+            # uncrossed normally — success with a warning, and the call
+            # period (if open) stays open for the untouched books.
+            warning = (f"{aborted_shards} shard(s) aborted the uncross "
+                       f"(fill log too small; raise max_fills) — their "
+                       f"symbols are untouched"
+                       + ("; auction call period stays OPEN"
+                          if self.auction_mode else ""))
+        return {"crossed": crossed, "aborted": aborted_shards > 0,
+                "error": "", "warning": warning}
 
     def _evict_terminal(self, ops, res: DispatchResult, by_handle,
                         terminal_makers: set[int]) -> None:

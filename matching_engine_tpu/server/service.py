@@ -251,6 +251,9 @@ class MatchingEngineService(MatchingEngineServicer):
         price = crossed[0][1] if symbol is not None and crossed else 0
         return pb2.AuctionResponse(
             success=True,
+            # A mesh partial abort is a success with a warning: the
+            # overflowing shard's symbols are untouched, the rest cleared.
+            error_message=summary.get("warning", ""),
             clearing_price=price,
             executed_quantity=total,
             symbols_crossed=len(crossed),

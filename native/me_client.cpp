@@ -737,6 +737,9 @@ int do_auction(const std::string& addr, const std::string& symbol) {
                 static_cast<long long>(resp.clearing_price()),
                 static_cast<long long>(resp.executed_quantity()));
   }
+  if (!resp.error_message().empty()) {  // partial-abort warning channel
+    std::printf("[client] warning: %s\n", resp.error_message().c_str());
+  }
   return 0;
 }
 

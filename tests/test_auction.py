@@ -402,3 +402,99 @@ def test_auction_on_sharded_server(tmp_path):
         db.close()
     finally:
         shutdown(server, parts)
+
+
+def test_sharded_auction_per_shard_abort():
+    """Mesh all-or-nothing is PER SHARD (no collectives — a lone host's
+    RunAuction must not hang on peers): an overflowing shard keeps its
+    symbols untouched while other shards uncross normally."""
+    from matching_engine_tpu.parallel import ShardedEngine, hostlocal, make_mesh
+
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=4)
+    host = BookBatch(**{f: np.zeros((8, 16), dtype=np.int32)
+                        for f in BookBatch._fields if f != "next_seq"},
+                     next_seq=np.zeros((8,), dtype=np.int32))
+    arr = {f: np.asarray(getattr(host, f)).copy() for f in BookBatch._fields}
+    # Symbol 0 (shard 0): 8 one-lot pairs -> 8 records > max_fills=4.
+    for k in range(8):
+        arr["bid_price"][0, k] = 105
+        arr["bid_qty"][0, k] = 1
+        arr["bid_oid"][0, k] = 100 + k
+        arr["bid_seq"][0, k] = k
+        arr["ask_price"][0, k] = 100
+        arr["ask_qty"][0, k] = 1
+        arr["ask_oid"][0, k] = 200 + k
+        arr["ask_seq"][0, k] = k
+    # Symbol 4 (shard 4): one clean cross.
+    arr["bid_price"][4, 0] = 50
+    arr["bid_qty"][4, 0] = 2
+    arr["bid_oid"][4, 0] = 300
+    arr["ask_price"][4, 0] = 50
+    arr["ask_qty"][4, 0] = 2
+    arr["ask_oid"][4, 0] = 400
+    book = BookBatch(**{k: jnp.asarray(v) for k, v in arr.items()})
+
+    mesh = make_mesh(8)
+    eng = ShardedEngine(cfg, mesh)
+    sbook = hostlocal.put_tree(book, eng.book_sharding)
+    nb, out = eng.auction(sbook, np.ones((8,), dtype=bool))
+    view, fills, aborted_shards = eng.decode_auction(out)
+    assert aborted_shards == 1
+    assert int(view["executed"][0]) == 0          # aborted shard untouched
+    assert int(view["executed"][4]) == 2          # healthy shard cleared
+    assert sorted((f.sym, f.quantity) for f in fills) == [(4, 2)]
+    np.testing.assert_array_equal(                # shard 0 books unchanged
+        np.asarray(nb.bid_qty)[0], arr["bid_qty"][0])
+
+
+def test_mesh_runner_partial_abort_semantics(tmp_path):
+    """Runner-level per-shard abort contract on a mesh: an all-symbols
+    uncross with one overflowing shard succeeds WITH a warning, keeps the
+    auction call period open, and a request targeting only the aborted
+    shard's symbol fails outright."""
+    from matching_engine_tpu.parallel import make_mesh
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=4)
+    runner = EngineRunner(cfg, mesh=make_mesh(8))
+    runner.auction_mode = True
+    # Allocate one symbol per target slot (names hash-agnostic here:
+    # single process owns everything; slots assigned in order).
+    assert runner.slot_acquire("OVER") == 0
+    for _ in range(4):
+        runner.slot_acquire("FINE")  # slots assigned in order: FINE -> 1
+    # Build the crossed state directly on the runner's book sharding.
+    from matching_engine_tpu.parallel import hostlocal
+
+    arr = {f: np.zeros((8, 16), dtype=np.int32)
+           for f in BookBatch._fields if f != "next_seq"}
+    arr["next_seq"] = np.zeros((8,), dtype=np.int32)
+    slot_over, slot_fine = runner.symbols["OVER"], runner.symbols["FINE"]
+    for k in range(8):   # 8 one-lot records > max_fills=4 on OVER's shard
+        arr["bid_price"][slot_over, k] = 105
+        arr["bid_qty"][slot_over, k] = 1
+        arr["bid_oid"][slot_over, k] = 100 + k
+        arr["bid_seq"][slot_over, k] = k
+        arr["ask_price"][slot_over, k] = 100
+        arr["ask_qty"][slot_over, k] = 1
+        arr["ask_oid"][slot_over, k] = 200 + k
+        arr["ask_seq"][slot_over, k] = k
+    arr["bid_price"][slot_fine, 0] = 50
+    arr["bid_qty"][slot_fine, 0] = 2
+    arr["bid_oid"][slot_fine, 0] = 300
+    arr["ask_price"][slot_fine, 0] = 50
+    arr["ask_qty"][slot_fine, 0] = 2
+    arr["ask_oid"][slot_fine, 0] = 400
+    runner.place_book(BookBatch(**{k: np.asarray(v)
+                                   for k, v in arr.items()}))
+
+    # Target only the aborted shard's symbol: outright failure.
+    s1 = runner.run_auction(["OVER"])
+    assert s1["error"] and s1["aborted"] and s1["crossed"] == []
+    assert runner.auction_mode
+
+    # All symbols: success + warning, FINE cleared, call period stays open.
+    s2 = runner.run_auction(None)
+    assert not s2["error"] and s2["warning"], s2
+    assert s2["aborted"] and [c[0] for c in s2["crossed"]] == ["FINE"]
+    assert runner.auction_mode  # NOT opened: OVER still stands crossed
