@@ -22,6 +22,10 @@ for arg in "$@"; do
 done
 if [ "${TPU:-0}" != "1" ]; then
   export JAX_PLATFORMS=cpu
+  # JAX_PLATFORMS alone is not enough in the axon container: sitecustomize
+  # registers the relay + forces the axon platform whenever the pool IPs
+  # are set, and a wedged tunnel then hangs interpreter start.
+  unset PALLAS_AXON_POOL_IPS
 fi
 
 DB=$(mktemp -d)/smoke.db
@@ -37,7 +41,8 @@ fi
 
 # shellcheck disable=SC2086
 python -m matching_engine_tpu.server.main --addr "$ADDR" --db "$DB" \
-  --symbols 16 --capacity 32 --batch 4 --window-ms 1 $GW_FLAGS &
+  --symbols 16 --capacity 32 --batch 4 --window-ms 1 --auction-open \
+  $GW_FLAGS &
 SERVER_PID=$!
 trap 'kill $SERVER_PID 2>/dev/null' EXIT
 
@@ -88,6 +93,17 @@ run_case() {
   fi
 }
 
+# Opening call auction (engine/auction.py): the server booted with
+# --auction-open, so crossing submits REST (continuous matching would
+# fill them instantly), MARKET is rejected, and the all-symbols uncross
+# clears the book at one price and opens continuous trading for the
+# reference cases below.
+run_case "call period: bid rests" "accepted order_id=" "$ADDR" a1 AUC BUY LIMIT 1020 2 4
+run_case "call period: crossing ask rests" "accepted order_id=" "$ADDR" a2 AUC SELL LIMIT 1000 2 4
+run_case "call period: MARKET rejected" "auction call period" "$ADDR" a3 AUC BUY MARKET 0 0 1
+run_case "opening uncross" "cleared 100000@Q4 x4" auction "$ADDR" AUC
+run_case "all-symbols uncross opens trading" "0 symbol(s) crossed" auction "$ADDR"
+
 # The reference's four scale cases (smoke.ps1:24-27): LIMIT BUYs at scales 8/9/2/0.
 run_case "LIMIT BUY scale 8" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 100500000 8 10
 run_case "LIMIT BUY scale 9" "accepted order_id=" "$ADDR" c1 SYM BUY LIMIT 1005000000 9 10
@@ -113,11 +129,11 @@ import sqlite3
 c = sqlite3.connect('$DB')
 print(c.execute('SELECT COUNT(*) FROM fills').fetchone()[0])
 ")
-if [ "$ORDERS" -eq 6 ] && [ "$FILLS" -ge 2 ]; then
+if [ "$ORDERS" -eq 8 ] && [ "$FILLS" -ge 3 ]; then
   echo "PASS: DB has $ORDERS orders, $FILLS fills"
   PASS=$((PASS+1))
 else
-  echo "FAIL: DB has $ORDERS orders (want 6), $FILLS fills (want >=2)"
+  echo "FAIL: DB has $ORDERS orders (want 8), $FILLS fills (want >=3)"
   FAIL=$((FAIL+1))
 fi
 

@@ -113,6 +113,22 @@ def main() -> None:
         assert not g2.success, g2
         assert "homed on another host" in g2.error_message, g2.error_message
 
+    # Call auction on the 2-process mesh: the uncross has ZERO collectives
+    # (per-shard all-or-nothing), so each host runs RunAuction
+    # independently — no cross-host coordination, same as dispatches.
+    # The probe symbol is the 5th name HOMED on this host, so the leg
+    # runs unconditionally on BOTH workers.
+    parts["runner"].auction_mode = True
+    au_sym = [s for s in candidates if symbol_home(s, 2) == pid][4]
+    r1 = submit(au_sym, pb2.BUY, 4)     # rests (auction mode)
+    r2 = submit(au_sym, pb2.SELL, 4)    # rests CROSSED at one price
+    assert r1.success and r2.success, (r1.error_message, r2.error_message)
+    au_orders, au_fills = 2, 1
+    resp = stub.RunAuction(pb2.AuctionRequest(), timeout=60)
+    assert resp.success, resp.error_message
+    assert resp.executed_quantity == 4 and resp.symbols_crossed == 1
+    assert not parts["runner"].auction_mode
+
     parts["sink"].flush()
     import sqlite3
 
@@ -120,8 +136,8 @@ def main() -> None:
     n_orders = conn.execute("SELECT COUNT(*) FROM orders").fetchone()[0]
     n_fills = conn.execute("SELECT COUNT(*) FROM fills").fetchone()[0]
     conn.close()
-    assert n_orders == 2 * len(mine) + gw_orders, n_orders
-    assert n_fills == fills, (n_fills, fills)
+    assert n_orders == 2 * len(mine) + gw_orders + au_orders, n_orders
+    assert n_fills == fills + au_fills, (n_fills, fills, au_fills)
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
     from audit import audit
@@ -132,6 +148,7 @@ def main() -> None:
     with open(os.path.join(outdir, f"srv-ok-{pid}.json"), "w") as f:
         json.dump({"pid": pid, "orders": n_orders, "fills": n_fills,
                    "gateway_ran": gw_orders > 0,
+                   "auction_orders": au_orders,
                    "slice": [sl.start, sl.stop]}, f)
 
 
