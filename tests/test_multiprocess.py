@@ -103,14 +103,14 @@ def test_two_process_full_servers(tmp_path):
     for pid in (0, 1):
         with open(tmp_path / f"srv-ok-{pid}.json") as f:
             r = json.load(f)
-        # 8 grpcio-edge orders, +1 via the C++ gateway edge when it ran,
-        # +2 when the auction probe's symbol hashed to this host — the
-        # worker reports both, so a silently-skipped leg on a machine
-        # where it SHOULD run cannot masquerade as a pass.
+        # 8 grpcio-edge orders, +2 from the auction leg (which runs on
+        # BOTH workers unconditionally — its probe symbol is chosen homed
+        # on each host), +1 via the C++ gateway edge when the library is
+        # built. Back-checks keep either leg from silently skipping.
         from matching_engine_tpu import native as me_native
 
-        expected = 8 + (1 if r["gateway_ran"] else 0) + r["auction_orders"]
-        expected_fills = 4 + (1 if r["auction_orders"] else 0)
-        assert r["orders"] == expected and r["fills"] == expected_fills
+        assert r["auction_orders"] == 2, "auction leg skipped"
+        expected = 8 + 2 + (1 if r["gateway_ran"] else 0)
+        assert r["orders"] == expected and r["fills"] == 5
         if me_native.gateway_available():
             assert r["gateway_ran"], "native gateway built but leg skipped"

@@ -153,6 +153,65 @@ def test_checkpoint_v2_roundtrip_rebuilds_allocators(tmp_path):
     assert fresh.slot_acquire("D") is not None
 
 
+
+def _stress_client(port: int, tid: int, errors: list, *, seed: int,
+                   sym_prefix: str, n_syms: int, n_ops: int,
+                   cancel_p: float, book_p: float, limit_only: bool):
+    """THE shared stress-client behavior (one definition for every stress
+    variant): random submit/cancel/book traffic; every RPC must answer."""
+    rng = random.Random(seed + tid)
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = MatchingEngineStub(ch)
+    my_open: list[str] = []
+    try:
+        for _ in range(n_ops):
+            sym = f"{sym_prefix}{rng.randrange(n_syms)}"
+            roll = rng.random()
+            if my_open and roll < cancel_p:
+                oid = my_open.pop(rng.randrange(len(my_open)))
+                r = stub.CancelOrder(pb2.CancelRequest(
+                    client_id=f"c{tid}", order_id=oid), timeout=60)
+                # success or a clean reject; must always answer.
+                assert r.order_id == oid
+            elif roll < cancel_p + book_p:
+                stub.GetOrderBook(pb2.OrderBookRequest(symbol=sym), timeout=60)
+            else:
+                otype = (pb2.LIMIT if limit_only or rng.random() < 0.8
+                         else pb2.MARKET)
+                r = stub.SubmitOrder(pb2.OrderRequest(
+                    client_id=f"c{tid}", symbol=sym, order_type=otype,
+                    side=pb2.BUY if rng.random() < 0.5 else pb2.SELL,
+                    price=10_000 + rng.randrange(8), scale=4,
+                    quantity=1 + rng.randrange(9)), timeout=60)
+                if r.success:
+                    my_open.append(r.order_id)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"client {tid}: {type(e).__name__}: {e}")
+    finally:
+        ch.close()
+
+
+def _checkpoint_loop(parts, stop, errors):
+    try:
+        while not stop.is_set():
+            parts["checkpointer"].checkpoint_now()
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"checkpointer: {type(e).__name__}: {e}")
+
+
+def _join_all(clients, aux, stop, errors):
+    for t in clients + aux:
+        t.start()
+    for t in clients:
+        t.join(timeout=240)
+        assert not t.is_alive(), "client thread hung"
+    stop.set()
+    for t in aux:
+        t.join(timeout=60)
+        assert not t.is_alive(), "aux thread hung"
+    assert errors == []
+
+
 def test_stress_concurrent_submit_cancel_book_checkpoint(tmp_path):
     db = str(tmp_path / "stress.db")
     server, port, parts = build_server(
@@ -165,56 +224,16 @@ def test_stress_concurrent_submit_cancel_book_checkpoint(tmp_path):
     errors: list[str] = []
     stop = threading.Event()
 
-    def client_thread(tid: int):
-        rng = random.Random(1000 + tid)
-        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
-        stub = MatchingEngineStub(ch)
-        my_open: list[str] = []
-        try:
-            for i in range(60):
-                sym = f"S{rng.randrange(6)}"
-                if my_open and rng.random() < 0.3:
-                    oid = my_open.pop(rng.randrange(len(my_open)))
-                    r = stub.CancelOrder(pb2.CancelRequest(
-                        client_id=f"c{tid}", order_id=oid), timeout=60)
-                    # success or a clean reject; must always answer.
-                    assert r.order_id == oid
-                elif rng.random() < 0.2:
-                    stub.GetOrderBook(
-                        pb2.OrderBookRequest(symbol=sym), timeout=60)
-                else:
-                    r = stub.SubmitOrder(pb2.OrderRequest(
-                        client_id=f"c{tid}", symbol=sym,
-                        order_type=pb2.LIMIT if rng.random() < 0.8 else pb2.MARKET,
-                        side=pb2.BUY if rng.random() < 0.5 else pb2.SELL,
-                        price=10_000 + rng.randrange(8), scale=4,
-                        quantity=1 + rng.randrange(9)), timeout=60)
-                    if r.success:
-                        my_open.append(r.order_id)
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"client {tid}: {type(e).__name__}: {e}")
-        finally:
-            ch.close()
-
-    def checkpoint_thread():
-        try:
-            while not stop.is_set():
-                parts["checkpointer"].checkpoint_now()
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"checkpointer: {type(e).__name__}: {e}")
-
-    threads = [threading.Thread(target=client_thread, args=(t,)) for t in range(4)]
-    ck = threading.Thread(target=checkpoint_thread)
-    for t in threads:
-        t.start()
-    ck.start()
-    for t in threads:
-        t.join(timeout=240)
-        assert not t.is_alive(), "client thread hung"
-    stop.set()
-    ck.join(timeout=60)
-    assert not ck.is_alive(), "checkpoint thread hung"
-    assert errors == []
+    clients = [threading.Thread(target=_stress_client,
+                                args=(port, t, errors),
+                                kwargs=dict(seed=1000, sym_prefix="S",
+                                            n_syms=6, n_ops=60,
+                                            cancel_p=0.3, book_p=0.2,
+                                            limit_only=False))
+               for t in range(4)]
+    aux = [threading.Thread(target=_checkpoint_loop,
+                            args=(parts, stop, errors))]
+    _join_all(clients, aux, stop, errors)
 
     parts["sink"].flush()
     m = parts["metrics"].snapshot()[0]
@@ -222,5 +241,64 @@ def test_stress_concurrent_submit_cancel_book_checkpoint(tmp_path):
     assert m.get("dispatch_errors", 0) == 0
     # Final invariant: whatever the interleaving, the durable store must be
     # internally consistent.
+    shutdown(server, parts)
+    assert audit_mod.audit(db) == []
+
+
+def test_stress_auction_interleaved(tmp_path):
+    """Concurrent submits/cancels/books/checkpoints WITH periodic call
+    periods: a toggler thread flips auction_mode on, lets crossing flow
+    accumulate, then uncrosses — while clients and the checkpointer keep
+    hammering. Invariants: every RPC answers, no engine/dispatch errors,
+    audit-clean durable store (auction fills reference real orders)."""
+    db = str(tmp_path / "austress.db")
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, EngineConfig(num_symbols=8, capacity=64, batch=8,
+                                        max_fills=1 << 12),
+        window_ms=1.0, log=False,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        checkpoint_interval_s=3600.0,
+    )
+    server.start()
+    errors: list[str] = []
+    stop = threading.Event()
+    runner = parts["runner"]
+
+    def auction_thread():
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(ch)
+        try:
+            while not stop.is_set():
+                runner.auction_mode = True   # open a call period
+                stop.wait(0.05)              # let crossing flow accumulate
+                r = stub.RunAuction(pb2.AuctionRequest(), timeout=60)
+                assert r.success, r.error_message
+                stop.wait(0.02)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"auctioneer: {type(e).__name__}: {e}")
+        finally:
+            ch.close()
+
+    # LIMIT-only clients: MARKETs legitimately reject in a call period and
+    # this test wants every submit answerable in both modes.
+    clients = [threading.Thread(target=_stress_client,
+                                args=(port, t, errors),
+                                kwargs=dict(seed=7000, sym_prefix="A",
+                                            n_syms=4, n_ops=50,
+                                            cancel_p=0.25, book_p=0.15,
+                                            limit_only=True))
+               for t in range(4)]
+    aux = [threading.Thread(target=auction_thread),
+           threading.Thread(target=_checkpoint_loop,
+                            args=(parts, stop, errors))]
+    _join_all(clients, aux, stop, errors)
+
+    # Leave continuous mode and flush before the final audit.
+    runner.auction_mode = False
+    parts["sink"].flush()
+    m = parts["metrics"].snapshot()[0]
+    assert m.get("orders_errored", 0) == 0
+    assert m.get("dispatch_errors", 0) == 0
+    assert m.get("auctions", 0) > 0, "auction leg never ran"
     shutdown(server, parts)
     assert audit_mod.audit(db) == []
