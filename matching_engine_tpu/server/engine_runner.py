@@ -198,8 +198,15 @@ class EngineRunner:
         # submit orders as OP_REST (rest without matching — books may
         # stand crossed) and MARKET orders are rejected; a RunAuction
         # uncross clears the flag (the opening cross). Toggled at boot
-        # (--auction-open) or left False for pure continuous trading.
+        # (--auction-open), restored from the durable store on restart,
+        # or left False for pure continuous trading. Change the flag via
+        # set_auction_mode so the serving stack's persistence callback
+        # (build_server wires storage.set_meta) records it — a restart
+        # must resume an open call period even when no book happens to
+        # stand crossed.
         self.auction_mode = False
+        self.persist_auction_mode = None  # callable(bool) -> bool | None
+        self._mode_dirty = False
         # Cross-dispatch pipelining: the one staged-but-undecoded dispatch
         # (see dispatch_pipelined) with its finish callback.
         self._pending: tuple[_Staged, object] | None = None
@@ -678,6 +685,10 @@ class EngineRunner:
         finally:
             for p in posts:
                 p()
+            # Durable mode write OUTSIDE the dispatch lock (see
+            # flush_auction_mode): a sqlite busy-wait here must not stall
+            # order dispatch.
+            self.flush_auction_mode()
         return summary
 
     def _run_auction_locked(self, symbols, sink) -> dict:
@@ -797,7 +808,7 @@ class EngineRunner:
             # period: a per-symbol auction — or an all-symbols one where
             # any shard aborted — must not open continuous trading while
             # books somewhere still stand crossed and unopened.
-            self.auction_mode = False
+            self.set_auction_mode(False)
         warning = ""
         if aborted_shards:
             # Mesh partial abort: the overflowing shard(s) kept their
@@ -1070,6 +1081,59 @@ class EngineRunner:
         return out
 
     # -- read-only views ---------------------------------------------------
+
+    def set_auction_mode(self, value: bool) -> None:
+        """Flip the call-period flag and mark it dirty; the durable write
+        happens in flush_auction_mode, OUTSIDE the dispatch lock — a
+        SQLite busy-wait must never sit on the dispatch critical path."""
+        self.auction_mode = value
+        self._mode_dirty = True
+
+    def flush_auction_mode(self) -> None:
+        """Persist a dirty call-period flag (call with no engine locks
+        held). A failed write is WARNED and counted — the next boot could
+        otherwise resume the wrong trading mode (the crossed-book safety
+        net only covers the stale-continuous direction)."""
+        if not self._mode_dirty or self.persist_auction_mode is None:
+            return
+        self._mode_dirty = False
+        try:
+            ok = self.persist_auction_mode(self.auction_mode)
+        except Exception as e:  # noqa: BLE001 — never unwind into callers
+            print(f"[runner] auction_mode persist raised: "
+                  f"{type(e).__name__}: {e}")
+            ok = False
+        if ok is False:
+            self.metrics.inc("meta_persist_failures")
+            print(f"[runner] WARNING: failed to persist "
+                  f"auction_mode={self.auction_mode}; a restart may resume "
+                  f"the wrong trading mode")
+
+    def crossed_symbols(self) -> list[str]:
+        """Symbols (this host's) whose books stand CROSSED (best bid >=
+        best ask). A continuously-matched book can never stand crossed, so
+        a crossed book after recovery means the durable state was written
+        during an auction call period — the caller must resume it
+        (auction_mode) rather than expose the book to continuous matching.
+        Reads addressable shards only (multi-process safe)."""
+        from matching_engine_tpu.parallel import hostlocal
+
+        with self._snapshot_lock:
+            bp, lo, _ = hostlocal.local_block(self.book.bid_price)
+            bq = hostlocal.local_block(self.book.bid_qty)[0]
+            ap = hostlocal.local_block(self.book.ask_price)[0]
+            aq = hostlocal.local_block(self.book.ask_qty)[0]
+        imin, imax = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        best_bid = np.where(bq > 0, bp, imin).max(axis=1)
+        best_ask = np.where(aq > 0, ap, imax).min(axis=1)
+        crossed = ((bq > 0).any(axis=1) & (aq > 0).any(axis=1)
+                   & (best_bid >= best_ask))
+        out = []
+        for i in np.nonzero(crossed)[0]:
+            sym = self.slot_symbols[lo + int(i)]
+            if sym is not None:
+                out.append(sym)
+        return out
 
     def book_snapshot(self, symbol: str) -> tuple[list, list]:
         """Priority-sorted (OrderInfo, qty) lists (bids, asks) for one symbol.

@@ -23,7 +23,7 @@ from concurrent import futures as cf
 import grpc
 
 from matching_engine_tpu.engine.book import EngineConfig
-from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.engine.kernel import OP_REST
 from matching_engine_tpu.proto.rpc import add_matching_engine_servicer
 from matching_engine_tpu.server.dispatcher import BatchDispatcher, NativeRingDispatcher
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
@@ -44,8 +44,11 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
 
     The reference sketches this (best_bid/best_ask over status IN (0,1)) but
     never performs it (SURVEY.md §5.4). Replays open LIMIT orders, oldest
-    first, with their *remaining* quantity, as a direct engine dispatch —
-    no persistence or stream side effects.
+    first, with their *remaining* quantity, as OP_REST dispatches — open
+    orders by definition RESTED, so re-resting reproduces the book exactly
+    in both trading modes (a continuous book never stands crossed, and a
+    call-period book persisted crossed MUST NOT match itself on replay).
+    No persistence or stream side effects.
     """
     runner.seed_oid_sequence(storage.load_next_oid_seq())
     rows = storage.open_orders()
@@ -69,7 +72,7 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
         )
         runner.orders_by_handle[info.handle] = info
         runner.orders_by_id[order_id] = info
-        ops.append(EngineOp(OP_SUBMIT, info))
+        ops.append(EngineOp(OP_REST, info))
     if skipped_foreign:
         print(f"[SERVER] recovery: {skipped_foreign} open orders belong to "
               f"symbols homed on other hosts; left in SQLite for migration")
@@ -122,6 +125,31 @@ def build_server(
         recovered = recover_books(runner, storage)
         if recovered and log:
             print(f"[SERVER] recovered {recovered} open orders into device books")
+    # Restore a persisted call period (each host records its own flag in
+    # its durable store — crossedness alone can't prove the ABSENCE of a
+    # call period, e.g. non-crossing rests only).
+    if storage.get_meta("auction_mode") == "1":
+        runner.auction_mode = True
+        if log:
+            print("[SERVER] durable store records an OPEN auction call "
+                  "period: resuming it")
+    # Safety net: a crossed book after recovery can only come from state
+    # persisted during a call period (continuous matching never leaves
+    # one standing) — resume rather than expose those books to the
+    # continuous maker scan.
+    crossed = runner.crossed_symbols()
+    if crossed and not runner.auction_mode:
+        runner.auction_mode = True
+        print(f"[SERVER] {len(crossed)} recovered book(s) stand crossed "
+              f"(e.g. {crossed[0]}): resuming the auction call period")
+    if runner.auction_mode:
+        print("[SERVER] auction call period OPEN — an ALL-symbols "
+              "RunAuction (empty symbol) reopens continuous trading")
+    # Wire persistence AFTER restore (the restore read, not wrote) and
+    # record the current state so a pre-meta database gains the row.
+    runner.persist_auction_mode = (
+        lambda v: storage.set_meta("auction_mode", "1" if v else "0"))
+    runner.persist_auction_mode(runner.auction_mode)
 
     from matching_engine_tpu import native as me_native
 
@@ -306,9 +334,10 @@ def main(argv=None) -> int:
         return int(e.code or 3)
 
     if args.auction_open:
-        parts["runner"].auction_mode = True
+        parts["runner"].set_auction_mode(True)
+        parts["runner"].flush_auction_mode()
         print("[SERVER] auction call period OPEN (submits rest unmatched "
-              "until RunAuction)")
+              "until an all-symbols RunAuction)")
 
     stop_evt = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -63,6 +63,11 @@ CREATE INDEX IF NOT EXISTS idx_fills_order ON fills (order_id);
 -- max_fills overflow, zombie rows closed after a spill overflow). The
 -- audit (scripts/audit.py) uses these to keep EXACT per-order arithmetic
 -- across an acknowledged loss; unexplained mismatches stay violations.
+CREATE TABLE IF NOT EXISTS server_meta (
+    key   TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+
 CREATE TABLE IF NOT EXISTS recon (
     recon_id   INTEGER PRIMARY KEY AUTOINCREMENT,
     order_id   TEXT NOT NULL,
@@ -110,6 +115,37 @@ class Storage:
             # reports False and the server exits with the storage code (1),
             # mirroring the reference's ctor-throw -> exit-1 path (main.cpp:63-69).
             print(f"[storage] open failed: {e}")
+
+    def get_meta(self, key: str) -> str | None:
+        """server_meta lookup (e.g. the persisted auction_mode). Never
+        throws (the storage contract)."""
+        if self._conn is None:
+            return None
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT value FROM server_meta WHERE key = ?", (key,)
+                ).fetchone()
+            return row[0] if row else None
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] get_meta failed: {e}")
+            return None
+
+    def set_meta(self, key: str, value: str) -> bool:
+        if self._conn is None:
+            return False
+        try:
+            with self._lock:
+                self._conn.execute(
+                    "INSERT INTO server_meta(key, value) VALUES(?, ?) "
+                    "ON CONFLICT(key) DO UPDATE SET value = excluded.value",
+                    (key, value),
+                )
+                self._conn.commit()
+            return True
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] set_meta failed: {e}")
+            return False
 
     def init(self) -> bool:
         if self._conn is None:

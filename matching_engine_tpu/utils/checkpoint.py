@@ -43,7 +43,7 @@ from matching_engine_tpu.engine.kernel import (
     FILLED,
     NEW,
     OP_CANCEL,
-    OP_SUBMIT,
+    OP_REST,
     PARTIALLY_FILLED,
     REJECTED,
 )
@@ -315,7 +315,7 @@ def restore_runner(runner, path: str, storage=None) -> int:
         if runner.slot_acquire(info.symbol) is None:
             continue  # symbol axis full; mirrors recover_books' drop policy
         info.handle = runner.assign_handle()
-        sub_ops.append(EngineOp(OP_SUBMIT, info))
+        sub_ops.append(EngineOp(OP_REST, info))
     if sub_ops:
         runner.run_dispatch(sub_ops)
     return len(ops) + len(sub_ops)

@@ -498,3 +498,123 @@ def test_mesh_runner_partial_abort_semantics(tmp_path):
     assert not s2["error"] and s2["warning"], s2
     assert s2["aborted"] and [c[0] for c in s2["crossed"]] == ["FINE"]
     assert runner.auction_mode  # NOT opened: OVER still stands crossed
+
+
+def test_call_period_survives_restart(tmp_path):
+    """Open orders persisted during a call period replay as OP_REST (they
+    rested without matching, so replay must not match them either); a
+    crossed recovered book auto-resumes the call period, and the uncross
+    then clears at the same price it would have pre-restart."""
+    import grpc
+    import sqlite3
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    db = str(tmp_path / "resume.db")
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+
+    server, port, parts = build_server("127.0.0.1:0", db, cfg,
+                                       window_ms=1.0, log=False)
+    parts["runner"].auction_mode = True
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    for who, side, price, qty in [("b", pb2.BUY, 102, 5),
+                                  ("a", pb2.SELL, 100, 3)]:
+        r = stub.SubmitOrder(
+            pb2.OrderRequest(client_id=who, symbol="RST", side=side,
+                             order_type=pb2.LIMIT, price=price, scale=4,
+                             quantity=qty), timeout=15)
+        assert r.success, r.error_message
+    parts["sink"].flush()
+    shutdown(server, parts)
+
+    # Restart WITHOUT --auction-open: the crossed book must be detected.
+    server2, port2, parts2 = build_server("127.0.0.1:0", db, cfg,
+                                          window_ms=1.0, log=False)
+    assert parts2["runner"].auction_mode, "call period not resumed"
+    server2.start()
+    stub2 = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port2}"))
+    try:
+        # Replay did NOT match the crossed pair: zero fills in the store.
+        conn = sqlite3.connect(db)
+        assert conn.execute("select count(*) from fills").fetchone()[0] == 0
+        conn.close()
+        book = stub2.GetOrderBook(pb2.OrderBookRequest(symbol="RST"),
+                                  timeout=10)
+        assert len(book.bids) == 1 and len(book.asks) == 1  # still crossed
+
+        resp = stub2.RunAuction(pb2.AuctionRequest(symbol="RST"), timeout=30)
+        assert resp.success, resp.error_message
+        assert resp.clearing_price == 100 and resp.executed_quantity == 3
+        parts2["sink"].flush()
+        conn = sqlite3.connect(db)
+        fills = conn.execute(
+            "select order_id, counter_order_id, price, quantity from fills"
+        ).fetchall()
+        conn.close()
+        assert fills == [("OID-1", "OID-2", 100, 3)]
+
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "scripts"))
+        from audit import audit
+        assert audit(db) == []
+    finally:
+        shutdown(server2, parts2)
+
+
+def test_non_crossed_call_period_survives_restart(tmp_path):
+    """The call period is PERSISTED (server_meta), not inferred: a restart
+    during a call period whose books happen not to stand crossed must
+    still resume it — and after the opening cross, the next restart boots
+    continuous."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    db = str(tmp_path / "meta.db")
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+
+    server, port, parts = build_server("127.0.0.1:0", db, cfg,
+                                       window_ms=1.0, log=False)
+    parts["runner"].set_auction_mode(True)
+    parts["runner"].flush_auction_mode()
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    # NON-crossing rests: bid 100 < ask 101.
+    for who, side, price in [("b", pb2.BUY, 100), ("a", pb2.SELL, 101)]:
+        r = stub.SubmitOrder(
+            pb2.OrderRequest(client_id=who, symbol="NC", side=side,
+                             order_type=pb2.LIMIT, price=price, scale=4,
+                             quantity=2), timeout=15)
+        assert r.success, r.error_message
+    parts["sink"].flush()
+    shutdown(server, parts)
+
+    server2, port2, parts2 = build_server("127.0.0.1:0", db, cfg,
+                                          window_ms=1.0, log=False)
+    assert parts2["runner"].auction_mode, "persisted call period lost"
+    server2.start()
+    stub2 = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port2}"))
+    # Still a call period: a crossing submit RESTS instead of matching.
+    r = stub2.SubmitOrder(
+        pb2.OrderRequest(client_id="c", symbol="NC", side=pb2.BUY,
+                         order_type=pb2.LIMIT, price=101, scale=4,
+                         quantity=1), timeout=15)
+    assert r.success
+    resp = stub2.RunAuction(pb2.AuctionRequest(), timeout=30)
+    assert resp.success and resp.executed_quantity == 1
+    assert not parts2["runner"].auction_mode
+    parts2["sink"].flush()
+    shutdown(server2, parts2)
+
+    # Third boot: the CLEARED flag also persisted — continuous from boot.
+    server3, port3, parts3 = build_server("127.0.0.1:0", db, cfg,
+                                          window_ms=1.0, log=False)
+    assert not parts3["runner"].auction_mode
+    shutdown(server3, parts3)
