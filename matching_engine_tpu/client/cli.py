@@ -69,6 +69,13 @@ def _book(addr: str, symbol: str) -> int:
     for label, side in (("bid", resp.bids), ("ask", resp.asks)):
         for o in side:
             print(f"  {label} {o.price}@Q{o.scale} x{o.quantity} {o.order_id} ({o.client_id})")
+    if resp.bid_levels or resp.ask_levels:
+        print("  L2:")
+        for label, side in (("bid", resp.bid_levels),
+                            ("ask", resp.ask_levels)):
+            for lv in side:
+                print(f"    {label} {lv.price}@Q4 x{lv.quantity} "
+                      f"({lv.order_count} order(s))")
     return 0
 
 

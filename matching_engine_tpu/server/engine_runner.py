@@ -1096,7 +1096,6 @@ class EngineRunner:
         net only covers the stale-continuous direction)."""
         if not self._mode_dirty or self.persist_auction_mode is None:
             return
-        self._mode_dirty = False
         try:
             ok = self.persist_auction_mode(self.auction_mode)
         except Exception as e:  # noqa: BLE001 — never unwind into callers
@@ -1104,10 +1103,15 @@ class EngineRunner:
                   f"{type(e).__name__}: {e}")
             ok = False
         if ok is False:
+            # Stay dirty: the write self-heals at the next flush point
+            # (e.g. the next RunAuction) instead of depending on an
+            # operator noticing the warning.
             self.metrics.inc("meta_persist_failures")
             print(f"[runner] WARNING: failed to persist "
                   f"auction_mode={self.auction_mode}; a restart may resume "
                   f"the wrong trading mode")
+        else:
+            self._mode_dirty = False
 
     def crossed_symbols(self) -> list[str]:
         """Symbols (this host's) whose books stand CROSSED (best bid >=

@@ -198,9 +198,24 @@ class MatchingEngineService(MatchingEngineServicer):
                 price=info.price_q4, scale=4, quantity=qty, side=info.side,
             )
 
+        def levels(rows):
+            # rows arrive priority-sorted, so equal prices are adjacent —
+            # one linear pass aggregates the L2 view in book order.
+            out: list[pb2.Level] = []
+            for info, qty in rows:
+                if out and out[-1].price == info.price_q4:
+                    out[-1].quantity += qty
+                    out[-1].order_count += 1
+                else:
+                    out.append(pb2.Level(price=info.price_q4, quantity=qty,
+                                         order_count=1))
+            return out
+
         return pb2.OrderBookResponse(
             bids=[msg(i, q) for i, q in bids],
             asks=[msg(i, q) for i, q in asks],
+            bid_levels=levels(bids),
+            ask_levels=levels(asks),
         )
 
     # -- streams -----------------------------------------------------------

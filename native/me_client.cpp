@@ -701,6 +701,19 @@ int do_book(const std::string& addr, const std::string& symbol) {
                 static_cast<long long>(o.quantity()), o.order_id().c_str(),
                 o.client_id().c_str());
   }
+  if (resp.bid_levels_size() || resp.ask_levels_size()) {
+    std::printf("  L2:\n");
+    for (const auto& lv : resp.bid_levels()) {
+      std::printf("    bid %lld@Q4 x%lld (%d order(s))\n",
+                  static_cast<long long>(lv.price()),
+                  static_cast<long long>(lv.quantity()), lv.order_count());
+    }
+    for (const auto& lv : resp.ask_levels()) {
+      std::printf("    ask %lld@Q4 x%lld (%d order(s))\n",
+                  static_cast<long long>(lv.price()),
+                  static_cast<long long>(lv.quantity()), lv.order_count());
+    }
+  }
   return 0;
 }
 

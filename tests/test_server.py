@@ -221,3 +221,20 @@ def test_metrics_rpc(hs):
     m = hs.stub.GetMetrics(pb2.MetricsRequest(), timeout=10)
     assert m.counters["rpc_submit"] >= 1
     assert m.counters["orders_accepted"] >= 1
+
+
+def test_book_l2_levels(hs):
+    """The additive L2 view aggregates per price in book order."""
+    for price, qty in [(12000, 3), (12000, 2), (11000, 7)]:
+        assert submit(hs.stub, client="lv", symbol="LVLS", side=pb2.BUY,
+                      price=price, qty=qty).success
+    assert submit(hs.stub, client="lv", symbol="LVLS", side=pb2.SELL,
+                  price=13000, qty=4).success
+    book = hs.stub.GetOrderBook(pb2.OrderBookRequest(symbol="LVLS"),
+                                timeout=10)
+    assert [(lv.price, lv.quantity, lv.order_count)
+            for lv in book.bid_levels] == [(12000, 5, 2), (11000, 7, 1)]
+    assert [(lv.price, lv.quantity, lv.order_count)
+            for lv in book.ask_levels] == [(13000, 4, 1)]
+    # Per-order rows unchanged (L2 is additive).
+    assert len(book.bids) == 3 and len(book.asks) == 1
