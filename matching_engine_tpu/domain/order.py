@@ -129,3 +129,17 @@ def validate_submit(request: pb2.OrderRequest) -> str | None:
         if not 0 <= request.scale <= 18:
             return f"scale {request.scale} out of range [0, 18]"
     return None
+
+
+def owner_hash(client_id: str) -> int:
+    """Stable int32 self-trade-prevention identity for a client id.
+
+    Nonzero for every real client (0 is the kernel's "no owner" sentinel,
+    which never suppresses a match); crc32 keeps it stable across runs and
+    processes — the hash lives in device book lanes and checkpoints."""
+    if not client_id:
+        return 0
+    import zlib
+
+    h = zlib.crc32(client_id.encode()) & 0x7FFFFFFF
+    return h or 1

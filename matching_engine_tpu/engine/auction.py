@@ -150,16 +150,9 @@ def _records_one(fill_b, fill_a, start_b, start_a, bid_oid, ask_oid):
 def apply_uncross(book: BookBatch, fill_b, fill_a, apply) -> BookBatch:
     """Decrement both sides' executed quantities where `apply` ([S] bool)
     holds — THE one book-update rule for single-device and mesh uncross."""
-    return BookBatch(
-        bid_price=book.bid_price,
+    return book._replace(
         bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
-        bid_oid=book.bid_oid,
-        bid_seq=book.bid_seq,
-        ask_price=book.ask_price,
         ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
-        ask_oid=book.ask_oid,
-        ask_seq=book.ask_seq,
-        next_seq=book.next_seq,
     )
 
 

@@ -53,16 +53,23 @@ class EngineConfig:
 
 
 class BookBatch(NamedTuple):
-    """All books, batched on the leading symbol axis. Shapes [S, CAP] / [S]."""
+    """All books, batched on the leading symbol axis. Shapes [S, CAP] / [S].
+
+    `*_owner` is the resting order's self-trade-prevention identity: a
+    stable int32 hash of the submitting client_id (0 = none). The
+    continuous match kernel never crosses a taker with a maker of the
+    same nonzero owner (see kernel._match_one)."""
 
     bid_price: jax.Array
     bid_qty: jax.Array
     bid_oid: jax.Array
     bid_seq: jax.Array
+    bid_owner: jax.Array
     ask_price: jax.Array
     ask_qty: jax.Array
     ask_oid: jax.Array
     ask_seq: jax.Array
+    ask_owner: jax.Array
     next_seq: jax.Array  # [S] per-book arrival counter
 
 
@@ -84,10 +91,15 @@ class OrderBatch(NamedTuple):
     price: jax.Array
     qty: jax.Array
     oid: jax.Array
+    owner: jax.Array  # self-trade-prevention identity (0 = none)
+
+
+# Columns of the packed [..., 7] dispatch lane array.
+BATCH_COLS = 7
 
 
 def batch_from_lanes(lanes) -> OrderBatch:
-    """THE [..., 6] lane-column layout, shared by the host batch builder
+    """THE [..., 7] lane-column layout, shared by the host batch builder
     (harness.build_batch_arrays writes it), host-side column views
     (harness.batch_view), and the device-side unpack inside
     kernel.engine_step_packed — one definition so the three can't drift.
@@ -95,6 +107,7 @@ def batch_from_lanes(lanes) -> OrderBatch:
     return OrderBatch(
         op=lanes[..., 0], side=lanes[..., 1], otype=lanes[..., 2],
         price=lanes[..., 3], qty=lanes[..., 4], oid=lanes[..., 5],
+        owner=lanes[..., 6],
     )
 
 
@@ -139,12 +152,12 @@ def init_book(cfg: EngineConfig) -> BookBatch:
         return jnp.zeros((s, c), dtype=I32)
 
     return BookBatch(
-        bid_price=z(), bid_qty=z(), bid_oid=z(), bid_seq=z(),
-        ask_price=z(), ask_qty=z(), ask_oid=z(), ask_seq=z(),
+        bid_price=z(), bid_qty=z(), bid_oid=z(), bid_seq=z(), bid_owner=z(),
+        ask_price=z(), ask_qty=z(), ask_oid=z(), ask_seq=z(), ask_owner=z(),
         next_seq=jnp.zeros((s,), dtype=I32),
     )
 
 
 def noop_orders(cfg: EngineConfig) -> OrderBatch:
     z = jnp.zeros((cfg.num_symbols, cfg.batch), dtype=I32)
-    return OrderBatch(op=z, side=z, otype=z, price=z, qty=z, oid=z)
+    return OrderBatch(op=z, side=z, otype=z, price=z, qty=z, oid=z, owner=z)

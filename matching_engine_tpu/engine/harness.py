@@ -13,6 +13,7 @@ from collections import deque
 import numpy as np
 
 from matching_engine_tpu.engine.book import (
+    BATCH_COLS,
     BookBatch,
     EngineConfig,
     batch_from_lanes,
@@ -33,12 +34,13 @@ class HostOrder:
     """One host-side engine op (already validated + Q4-normalized)."""
 
     sym: int          # symbol slot in [0, num_symbols)
-    op: int           # OP_SUBMIT / OP_CANCEL
+    op: int           # OP_SUBMIT / OP_REST / OP_CANCEL
     side: int         # BUY / SELL (for cancel: side the target rests on)
     otype: int = 0    # LIMIT / MARKET
     price: int = 0    # Q4
     qty: int = 0
     oid: int = 0
+    owner: int = 0    # self-trade-prevention identity (0 = none)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,7 +63,7 @@ class HostResult:
 
 def build_batch_arrays(cfg: EngineConfig,
                        orders: list[HostOrder]) -> list[np.ndarray]:
-    """Group a chronological order list into dense [S, B, 6] dispatch
+    """Group a chronological order list into dense [S, B, 7] dispatch
     arrays (the packed single-upload form engine_step_packed consumes).
 
     Orders for the same symbol keep their relative order (placed in
@@ -69,7 +71,7 @@ def build_batch_arrays(cfg: EngineConfig,
     dispatches); unused rows are OP_NOOP padding the kernel ignores.
     """
     s, b = cfg.num_symbols, cfg.batch
-    batches: list[np.ndarray] = []  # each [S, B, 6]
+    batches: list[np.ndarray] = []  # each [S, B, BATCH_COLS]
     counts = np.zeros((s,), dtype=np.int64)  # orders seen per symbol so far
 
     for o in orders:
@@ -80,14 +82,15 @@ def build_batch_arrays(cfg: EngineConfig,
             raise ValueError(f"oid {o.oid} exceeds the int32 device lane")
         i, row = divmod(int(counts[o.sym]), b)
         while i >= len(batches):
-            batches.append(np.zeros((s, b, 6), dtype=np.int32))
-        batches[i][o.sym, row] = (o.op, o.side, o.otype, o.price, o.qty, o.oid)
+            batches.append(np.zeros((s, b, BATCH_COLS), dtype=np.int32))
+        batches[i][o.sym, row] = (o.op, o.side, o.otype, o.price, o.qty,
+                                  o.oid, o.owner)
         counts[o.sym] += 1
     return batches
 
 
 def batch_view(arr: np.ndarray) -> OrderBatch:
-    """Host-side OrderBatch column views of one [S, B, 6] dispatch array
+    """Host-side OrderBatch column views of one [S, B, 7] dispatch array
     (free — numpy views; decode reads op/oid from these)."""
     return batch_from_lanes(arr)
 

@@ -59,16 +59,19 @@ BUY, SELL = 1, 2
 
 
 class _SymBook(NamedTuple):
-    """One symbol's book slices inside the vmap'd scan body."""
+    """One symbol's book slices inside the vmap'd scan body (field order
+    mirrors BookBatch so `_SymBook(*book[:-1], ...)` stays valid)."""
 
     bid_price: jax.Array
     bid_qty: jax.Array
     bid_oid: jax.Array
     bid_seq: jax.Array
+    bid_owner: jax.Array
     ask_price: jax.Array
     ask_qty: jax.Array
     ask_oid: jax.Array
     ask_seq: jax.Array
+    ask_owner: jax.Array
     next_seq: jax.Array
 
 
@@ -79,8 +82,9 @@ def _match_one(book: _SymBook, order):
     fill_price[CAP])) where fill arrays are priority-rank-indexed (slot r =
     r-th best maker touched; zeros past the last fill).
     """
-    op, side, otype, price, qty, oid = (
-        order.op, order.side, order.otype, order.price, order.qty, order.oid
+    op, side, otype, price, qty, oid, owner = (
+        order.op, order.side, order.otype, order.price, order.qty,
+        order.oid, order.owner,
     )
     is_submit = op == OP_SUBMIT
     is_cancel = op == OP_CANCEL
@@ -94,6 +98,7 @@ def _match_one(book: _SymBook, order):
     opp_qty = jnp.where(is_buy, book.ask_qty, book.bid_qty)
     opp_oid = jnp.where(is_buy, book.ask_oid, book.bid_oid)
     opp_seq = jnp.where(is_buy, book.ask_seq, book.bid_seq)
+    opp_owner = jnp.where(is_buy, book.ask_owner, book.bid_owner)
 
     # Direction-normalized price key: smaller = better priority for the
     # maker. Buying consumes asks (low price good); selling consumes bids
@@ -101,7 +106,17 @@ def _match_one(book: _SymBook, order):
     key = jnp.where(is_buy, opp_price, -opp_price)
 
     price_ok = jnp.where(is_buy, opp_price <= price, opp_price >= price)
-    elig = (opp_qty > 0) & (is_market | price_ok) & is_submit
+    # Self-trade prevention (skip-then-cancel): a taker never crosses a
+    # maker of the same nonzero owner — the skipped maker keeps its place
+    # for other takers — and a LIMIT remainder that would REST crossing
+    # the client's own opposite order is canceled instead (resting it
+    # would stand the book crossed in continuous trading, which the
+    # recovery safety net relies on never happening). OP_REST bypasses
+    # both (auction accumulation crosses deliberately).
+    not_self = (owner == 0) | (opp_owner != owner)
+    elig = (opp_qty > 0) & (is_market | price_ok) & is_submit & not_self
+    self_blocked = is_submit & (~is_market) & jnp.any(
+        (opp_qty > 0) & price_ok & (owner != 0) & (opp_owner == owner))
 
     # better[k, j]: maker k strictly ahead of maker j in price-time priority.
     better = (key[:, None] < key[None, :]) | (
@@ -132,8 +147,9 @@ def _match_one(book: _SymBook, order):
     own_qty = jnp.where(is_buy, book.bid_qty, book.ask_qty)
     own_oid = jnp.where(is_buy, book.bid_oid, book.ask_oid)
     own_seq = jnp.where(is_buy, book.bid_seq, book.ask_seq)
+    own_owner = jnp.where(is_buy, book.bid_owner, book.ask_owner)
 
-    do_rest = is_submit_like & (~is_market) & (remaining > 0)
+    do_rest = is_submit_like & (~is_market) & (remaining > 0) & ~self_blocked
     free = own_qty == 0
     has_free = jnp.any(free)
     slot_idx = jnp.argmax(free)  # first free slot
@@ -145,6 +161,7 @@ def _match_one(book: _SymBook, order):
     own_qty = jnp.where(at_slot, remaining, own_qty)
     own_oid = jnp.where(at_slot, oid, own_oid)
     own_seq = jnp.where(at_slot, book.next_seq, own_seq)
+    own_owner = jnp.where(at_slot, owner, own_owner)
     next_seq = book.next_seq + jnp.where(rested, 1, 0).astype(I32)
 
     cancel_mask = is_cancel & (own_oid == oid) & (own_qty > 0)
@@ -158,10 +175,12 @@ def _match_one(book: _SymBook, order):
         bid_qty=jnp.where(is_buy, own_qty, new_opp_qty),
         bid_oid=jnp.where(is_buy, own_oid, opp_oid),
         bid_seq=jnp.where(is_buy, own_seq, opp_seq),
+        bid_owner=jnp.where(is_buy, own_owner, opp_owner),
         ask_price=jnp.where(is_buy, opp_price, own_price),
         ask_qty=jnp.where(is_buy, new_opp_qty, own_qty),
         ask_oid=jnp.where(is_buy, opp_oid, own_oid),
         ask_seq=jnp.where(is_buy, opp_seq, own_seq),
+        ask_owner=jnp.where(is_buy, opp_owner, own_owner),
         next_seq=next_seq,
     )
 
@@ -170,8 +189,10 @@ def _match_one(book: _SymBook, order):
         remaining == 0,
         FILLED,
         jnp.where(
-            is_market,
-            CANCELED,  # market remainder is immediate-or-cancel
+            # Immediate-or-cancel remainders: MARKET always; a LIMIT whose
+            # rest would self-cross (STP skip-then-cancel).
+            is_market | self_blocked,
+            CANCELED,
             jnp.where(
                 rested,
                 jnp.where(filled_total > 0, PARTIALLY_FILLED, NEW),
@@ -330,7 +351,7 @@ class PackedStepOutput(NamedTuple):
 
 @partial(jax.jit, static_argnums=0, donate_argnums=1)
 def engine_step_packed(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
-    """engine_step with ONE [S, B, 6] upload (harness.build_batch_arrays
+    """engine_step with ONE [S, B, 7] upload (harness.build_batch_arrays
     layout, unpacked on device) and the output packed into two arrays;
     decode with harness.decode_step_packed. Semantics identical by
     construction (same engine_step_impl)."""

@@ -61,6 +61,7 @@ class _Resting:
     price_q4: int
     qty: int
     seq: int
+    owner: int = 0  # self-trade-prevention identity (0 = none)
 
 
 class OracleBook:
@@ -89,7 +90,8 @@ class OracleBook:
     # -- operations --------------------------------------------------------
 
     def submit(
-        self, oid: int, side: int, order_type: int, price_q4: int, qty: int
+        self, oid: int, side: int, order_type: int, price_q4: int, qty: int,
+        owner: int = 0,
     ) -> OrderResult:
         assert qty > 0
         opp_side = pb2.SELL if side == pb2.BUY else pb2.BUY
@@ -102,6 +104,8 @@ class OracleBook:
                 break
             if maker.qty == 0:
                 continue
+            if owner and maker.owner == owner:
+                continue  # self-trade prevention: skip own resting orders
             if order_type == pb2.LIMIT:
                 if side == pb2.BUY and maker.price_q4 > price_q4:
                     break
@@ -123,15 +127,29 @@ class OracleBook:
         if order_type == pb2.MARKET:
             return OrderResult(oid, CANCELED, filled, remaining, False, tuple(fills))
 
+        # STP skip-then-cancel: a remainder whose rest would cross the
+        # client's OWN opposite order cancels instead of standing the
+        # book crossed (kernel._match_one's self_blocked twin).
+        if owner:
+            crosses_self = any(
+                r.owner == owner and (
+                    r.price_q4 <= price_q4 if side == pb2.BUY
+                    else r.price_q4 >= price_q4)
+                for r in self._opposite(side))
+            if crosses_self:
+                return OrderResult(oid, CANCELED, filled, remaining, False,
+                                   tuple(fills))
+
         own = self._own(side)
         if len(own) >= self.capacity:
             return OrderResult(oid, REJECTED, filled, remaining, False, tuple(fills))
-        own.append(_Resting(oid, price_q4, remaining, self.next_seq))
+        own.append(_Resting(oid, price_q4, remaining, self.next_seq, owner))
         self.next_seq += 1
         status = PARTIALLY_FILLED if filled > 0 else NEW
         return OrderResult(oid, status, filled, remaining, True, tuple(fills))
 
-    def rest(self, oid: int, side: int, price_q4: int, qty: int) -> OrderResult:
+    def rest(self, oid: int, side: int, price_q4: int, qty: int,
+             owner: int = 0) -> OrderResult:
         """OP_REST twin: rest without matching (auction accumulation —
         the book may stand crossed afterwards). NEW on success, REJECTED
         when the side is at capacity."""
@@ -139,7 +157,7 @@ class OracleBook:
         own = self._own(side)
         if len(own) >= self.capacity:
             return OrderResult(oid, REJECTED, 0, qty, False, ())
-        own.append(_Resting(oid, price_q4, qty, self.next_seq))
+        own.append(_Resting(oid, price_q4, qty, self.next_seq, owner))
         self.next_seq += 1
         return OrderResult(oid, NEW, 0, qty, True, ())
 

@@ -50,13 +50,14 @@ from matching_engine_tpu.engine.kernel import (
     fill_inline_count,
 )
 
-# Column layout of the [K, 8] lane array (the ONE upload per sparse step).
+# Column layout of the [K, 9] lane array (the ONE upload per sparse step).
 LANE_SLOT, LANE_ROW, LANE_OP, LANE_SIDE = 0, 1, 2, 3
-LANE_OTYPE, LANE_PRICE, LANE_QTY, LANE_OID = 4, 5, 6, 7
+LANE_OTYPE, LANE_PRICE, LANE_QTY, LANE_OID, LANE_OWNER = 4, 5, 6, 7, 8
+LANE_COLS = 9
 
 
 class SparseBatch(NamedTuple):
-    """One sparse dispatch: `lanes` is the packed [K, 8] int32 array;
+    """One sparse dispatch: `lanes` is the packed [K, 9] int32 array;
     padding rows carry slot == num_symbols (scatter-drop coordinate).
     Column views are host-side numpy (free — `lanes` is built on host)."""
 
@@ -93,6 +94,10 @@ class SparseBatch(NamedTuple):
     @property
     def oid(self) -> np.ndarray:
         return self.lanes[:, LANE_OID]
+
+    @property
+    def owner(self) -> np.ndarray:
+        return self.lanes[:, LANE_OWNER]
 
 
 class SparseStepOutput(NamedTuple):
@@ -155,6 +160,7 @@ def _step_sparse_jit(cfg: EngineConfig, book: BookBatch, lanes: jax.Array):
         price=scatter(lanes[:, LANE_PRICE]),
         qty=scatter(lanes[:, LANE_QTY]),
         oid=scatter(lanes[:, LANE_OID]),
+        owner=scatter(lanes[:, LANE_OWNER]),
     )
     new_book, out = engine_step_impl(cfg, book, dense)
 
@@ -269,7 +275,7 @@ def build_sparse(cfg: EngineConfig, orders) -> list[tuple[SparseBatch, int]]:
         while i >= len(waves):
             waves.append([])
         waves[i].append((o.sym, row, o.op, o.side, o.otype, o.price, o.qty,
-                         o.oid))
+                         o.oid, o.owner))
         counts[o.sym] += 1
 
     out = []
@@ -277,7 +283,7 @@ def build_sparse(cfg: EngineConfig, orders) -> list[tuple[SparseBatch, int]]:
         wave.sort(key=lambda t: (t[0], t[1]))  # device (symbol, row) order
         n = len(wave)
         k = bucket(n)
-        arr = np.zeros((k, 8), dtype=np.int32)
+        arr = np.zeros((k, LANE_COLS), dtype=np.int32)
         arr[:n] = np.asarray(wave, dtype=np.int32)
         arr[n:, LANE_SLOT] = s  # padding -> scatter-drop coordinate
         out.append((SparseBatch(lanes=arr), n))

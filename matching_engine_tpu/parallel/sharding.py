@@ -95,14 +95,17 @@ def _book_specs() -> BookBatch:
     lane = P(AXIS, None)
     return BookBatch(
         bid_price=lane, bid_qty=lane, bid_oid=lane, bid_seq=lane,
+        bid_owner=lane,
         ask_price=lane, ask_qty=lane, ask_oid=lane, ask_seq=lane,
+        ask_owner=lane,
         next_seq=P(AXIS),
     )
 
 
 def _order_specs() -> OrderBatch:
     lane = P(AXIS, None)
-    return OrderBatch(op=lane, side=lane, otype=lane, price=lane, qty=lane, oid=lane)
+    return OrderBatch(op=lane, side=lane, otype=lane, price=lane, qty=lane,
+                      oid=lane, owner=lane)
 
 
 def _out_specs() -> ShardedStepOutput:

@@ -48,6 +48,7 @@ from matching_engine_tpu.engine.kernel import (
     SELL,
     engine_step_packed,
 )
+from matching_engine_tpu.domain.order import owner_hash
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.storage.storage import FillRow
 from matching_engine_tpu.utils.metrics import Metrics, Timer
@@ -194,6 +195,10 @@ class EngineRunner:
         # the ledger itself is counted and the tail dropped.
         self.pending_recon: list[tuple[str, str, int]] = []
         self._recon_cap = 100_000
+        # owner_hash collision watch: hash -> first client id seen. A
+        # collision silently extends self-trade prevention across two
+        # unrelated clients, so it is counted and logged (bounded map).
+        self._owner_ids: dict[int, str] = {}
         # Call-auction accumulation mode: while True, both serving edges
         # submit orders as OP_REST (rest without matching — books may
         # stand crossed) and MARKET orders are rejected; a RunAuction
@@ -486,6 +491,9 @@ class EngineRunner:
                         price=i.price_q4,
                         qty=i.remaining if e.op != OP_CANCEL else 0,
                         oid=i.handle,
+                        # Self-trade prevention identity travels to the
+                        # device book lanes with every submit/rest.
+                        owner=self._owner_for(i.client_id),
                     )
                 )
                 by_handle[i.handle] = e
@@ -635,7 +643,7 @@ class EngineRunner:
                 batch, out = item
                 account_dense(*self._sharded.decode(batch, out), out)
         else:
-            # Packed single-device steps: one [S, B, 6] upload and one
+            # Packed single-device steps: one [S, B, 7] upload and one
             # small-vector readback each (+ a fill fetch only past the
             # inline segment) — transfer ROUND TRIPS, not just bytes,
             # bound tunneled serving latency.
@@ -1081,6 +1089,16 @@ class EngineRunner:
         return out
 
     # -- read-only views ---------------------------------------------------
+
+    def _owner_for(self, client_id: str) -> int:
+        h = owner_hash(client_id)
+        if len(self._owner_ids) < 1_000_000:
+            prev = self._owner_ids.setdefault(h, client_id)
+            if prev != client_id:
+                self.metrics.inc("owner_hash_collisions")
+                print(f"[runner] WARNING: owner_hash collision: "
+                      f"{client_id!r} vs {prev!r} share STP identity {h}")
+        return h
 
     def set_auction_mode(self, value: bool) -> None:
         """Flip the call-period flag and mark it dirty; the durable write

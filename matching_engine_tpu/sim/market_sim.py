@@ -146,7 +146,9 @@ def _gen_orders(cfg: EngineConfig, scfg: SimConfig, state: SimState):
     mqty = draw(6, lambda kk: jax.random.randint(kk, (m,), 1, scfg.qty_max + 1, I32))
 
     def seg(op, side, otype, price, q, oid):
-        return (op, side, otype, price, q, oid)
+        # owner 0: sim agents opt out of self-trade prevention (makers
+        # cancel-then-requote, so self-crossing is already structural).
+        return (op, side, otype, price, q, oid, jnp.zeros_like(op))
 
     zeros_k = jnp.zeros((s, k), I32)
     zeros_m = jnp.zeros((s, m), I32)

@@ -182,18 +182,73 @@ def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
         fields = {}
         with np.load(os.path.join(mine, "book.npz")) as z:
             for f in _BOOK_FIELDS:
-                block = z[f]
+                block = _field_or_default(z, f, cfg)
                 full = np.zeros((cfg.num_symbols,) + block.shape[1:],
                                 dtype=block.dtype)
-                full[lo:hi] = block
+                full[lo:hi] = block[lo:hi] if block.shape[0] == cfg.num_symbols else block
                 fields[f] = full
         return cfg, BookBatch(**fields), meta
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     cfg = _cfg_from_meta(meta)
     with np.load(os.path.join(path, "book.npz")) as z:
-        book = BookBatch(**{f: z[f] for f in _BOOK_FIELDS})
+        book = BookBatch(
+            **{f: _field_or_default(z, f, cfg) for f in _BOOK_FIELDS})
     return cfg, book, meta
+
+
+def _field_or_default(z, field: str, cfg: EngineConfig):
+    """Forward compatibility for fields added to BookBatch after a
+    snapshot was written (e.g. the round-3 self-trade-prevention owner
+    lanes): a missing array loads as zeros of the field's shape;
+    restore_runner rebuilds owner lanes from the order directory so old
+    snapshots keep full STP semantics."""
+    if field in z.files:
+        return z[field]
+    shape = ((cfg.num_symbols,) if field == "next_seq"
+             else (cfg.num_symbols, cfg.capacity))
+    return np.zeros(shape, dtype=np.int32)
+
+
+def _rebuild_owner_lanes(runner) -> None:
+    """Rebuild the owner lanes of a pre-owner snapshot from the order
+    directory (handle -> client-id hash). Single-process only: on a
+    multi-process mesh this RAISES before touching anything, and the
+    caller (build_server) falls back to full SQLite replay — which
+    reconstructs owners naturally from the persisted client ids."""
+    import jax
+
+    from matching_engine_tpu.domain.order import owner_hash
+    from matching_engine_tpu.parallel import hostlocal
+
+    book = runner.book
+    has_owners = (np.asarray(hostlocal.local_block(book.bid_owner)[0]).any()
+                  or np.asarray(
+                      hostlocal.local_block(book.ask_owner)[0]).any())
+    if has_owners:
+        return  # snapshot already carried owners
+    owners = {h: owner_hash(i.client_id)
+              for h, i in runner.orders_by_handle.items()}
+    if not owners:
+        return
+    if jax.process_count() > 1:
+        raise ValueError(
+            "pre-owner-lane snapshot on a multi-process mesh: restore via "
+            "full replay (owners rebuild from the persisted client ids)")
+    bid_owner = np.asarray(book.bid_owner).copy()
+    ask_owner = np.asarray(book.ask_owner).copy()
+    bid_oid = np.asarray(book.bid_oid)
+    bid_qty = np.asarray(book.bid_qty)
+    ask_oid = np.asarray(book.ask_oid)
+    ask_qty = np.asarray(book.ask_qty)
+    for oid_arr, qty_arr, owner_arr in ((bid_oid, bid_qty, bid_owner),
+                                        (ask_oid, ask_qty, ask_owner)):
+        live = qty_arr > 0
+        for r, c in zip(*np.nonzero(live)):
+            owner_arr[r, c] = owners.get(int(oid_arr[r, c]), 0)
+    host_book = BookBatch(*(np.asarray(x) for x in book))._replace(
+        bid_owner=bid_owner, ask_owner=ask_owner)
+    runner.place_book(host_book)
 
 
 def restore_runner(runner, path: str, storage=None) -> int:
@@ -236,6 +291,10 @@ def restore_runner(runner, path: str, storage=None) -> int:
         runner.orders_by_handle[info.handle] = info
         runner.orders_by_id[info.order_id] = info
     runner.seed_oid_sequence(int(meta["next_oid_num"]))
+    # Snapshots written before the owner lanes existed load them as zeros;
+    # rebuild from the directory (handle -> client hash) so restored books
+    # keep self-trade prevention for their resting orders.
+    _rebuild_owner_lanes(runner)
     # Rebuild allocator + slot-liveness state from the restored directory.
     # Handles of orders that died between this snapshot's birth process and
     # now are simply never reissued (next_handle continues past the max).

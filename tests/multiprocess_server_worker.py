@@ -67,8 +67,10 @@ def main() -> None:
     stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{sport}"))
 
     def submit(sym, side, qty):
+        # Client id differs per side: self-trade prevention (always on)
+        # would otherwise suppress the crossing fills this test asserts.
         return stub.SubmitOrder(
-            pb2.OrderRequest(client_id=f"h{pid}", symbol=sym,
+            pb2.OrderRequest(client_id=f"h{pid}-s{side}", symbol=sym,
                              order_type=pb2.LIMIT, side=side, price=10_000,
                              scale=4, quantity=qty),
             timeout=60)

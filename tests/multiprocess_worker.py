@@ -71,7 +71,7 @@ def main() -> None:
         assert slot is not None and sl.start <= slot < sl.stop, (sym, slot)
         n, oid_s = runner.assign_oid()
         info = OrderInfo(
-            oid=n, order_id=oid_s, client_id=f"c{pid}", symbol=sym,
+            oid=n, order_id=oid_s, client_id=f"c{pid}-s{side}", symbol=sym,
             side=side, otype=0, price_q4=price, quantity=qty, remaining=qty,
             status=0, handle=runner.assign_handle(),
         )

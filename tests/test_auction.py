@@ -27,8 +27,7 @@ def build_crossed_books(cfg, seed, levels=12):
     rng = np.random.default_rng(seed)
     s, c = cfg.num_symbols, cfg.capacity
     arr = {f: np.zeros((s, c), dtype=np.int32)
-           for f in ("bid_price", "bid_qty", "bid_oid", "bid_seq",
-                     "ask_price", "ask_qty", "ask_oid", "ask_seq")}
+           for f in BookBatch._fields if f != "next_seq"}
     next_seq = np.zeros((s,), dtype=np.int32)
     oracles = {i: OracleBook(c) for i in range(s)}
     oid = 1
@@ -411,10 +410,9 @@ def test_sharded_auction_per_shard_abort():
     from matching_engine_tpu.parallel import ShardedEngine, hostlocal, make_mesh
 
     cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=4)
-    host = BookBatch(**{f: np.zeros((8, 16), dtype=np.int32)
-                        for f in BookBatch._fields if f != "next_seq"},
-                     next_seq=np.zeros((8,), dtype=np.int32))
-    arr = {f: np.asarray(getattr(host, f)).copy() for f in BookBatch._fields}
+    arr = {f: (np.zeros((8,), dtype=np.int32) if f == "next_seq"
+               else np.zeros((8, 16), dtype=np.int32))
+           for f in BookBatch._fields}
     # Symbol 0 (shard 0): 8 one-lot pairs -> 8 records > max_fills=4.
     for k in range(8):
         arr["bid_price"][0, k] = 105

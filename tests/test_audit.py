@@ -29,8 +29,10 @@ def traded_db(tmp_path):
     stub = MatchingEngineStub(ch)
 
     def sub(side, qty, price=10_000, otype=pb2.LIMIT):
+        # Per-side clients: self-trade prevention (always on) would
+        # otherwise suppress the crossing fills this fixture builds.
         r = stub.SubmitOrder(pb2.OrderRequest(
-            client_id="c", symbol="S", order_type=otype, side=side,
+            client_id=f"c-s{side}", symbol="S", order_type=otype, side=side,
             price=price, scale=4, quantity=qty), timeout=30)
         assert r.success
         return r.order_id
@@ -38,7 +40,8 @@ def traded_db(tmp_path):
     sub(pb2.BUY, 10)
     sub(pb2.SELL, 4)                      # partial fill
     oid = sub(pb2.BUY, 3, price=9_000)    # rests
-    stub.CancelOrder(pb2.CancelRequest(client_id="c", order_id=oid), timeout=30)
+    stub.CancelOrder(pb2.CancelRequest(client_id=f"c-s{pb2.BUY}",
+                                       order_id=oid), timeout=30)
     parts["sink"].flush()
     ch.close()
     shutdown(server, parts)
@@ -66,8 +69,8 @@ def test_audit_clean_on_partial_fill_then_capacity_reject(tmp_path, capsys):
 
     def sub(side, qty, price):
         return stub.SubmitOrder(pb2.OrderRequest(
-            client_id="c", symbol="S", order_type=pb2.LIMIT, side=side,
-            price=price, scale=4, quantity=qty), timeout=30)
+            client_id=f"c-s{side}", symbol="S", order_type=pb2.LIMIT,
+            side=side, price=price, scale=4, quantity=qty), timeout=30)
 
     assert sub(pb2.SELL, 3, 10_000).success          # rests on asks
     assert sub(pb2.BUY, 1, 9_000).success            # bid side slot 1

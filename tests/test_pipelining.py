@@ -27,7 +27,8 @@ def _submit(runner, symbol, side, price, qty):
     assert runner.slot_acquire(symbol) is not None
     num, oid = runner.assign_oid()
     return EngineOp(OP_SUBMIT, OrderInfo(
-        oid=num, order_id=oid, client_id="c", symbol=symbol, side=side,
+        oid=num, order_id=oid, client_id=f"c-side{side}", symbol=symbol,
+        side=side,
         otype=0, price_q4=price, quantity=qty, remaining=qty, status=0,
         handle=runner.assign_handle()))
 

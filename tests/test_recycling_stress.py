@@ -40,12 +40,16 @@ _spec.loader.exec_module(audit_mod)
 
 
 def _submit(runner: EngineRunner, symbol: str, side: int, qty: int,
-            price: int, otype: int = pb2.LIMIT) -> OrderInfo:
-    """Drive the service's submit flow at the runner level."""
+            price: int, otype: int = pb2.LIMIT,
+            client: str | None = None) -> OrderInfo:
+    """Drive the service's submit flow at the runner level. The client id
+    defaults to the SIDE (distinct per side): self-trade prevention is
+    always on, so a test that wants a cross must use different clients."""
     assert runner.slot_acquire(symbol) is not None
     num, order_id = runner.assign_oid()
     info = OrderInfo(
-        oid=num, order_id=order_id, client_id="c", symbol=symbol, side=side,
+        oid=num, order_id=order_id, client_id=client or f"c-side{side}",
+        symbol=symbol, side=side,
         otype=otype, price_q4=price, quantity=qty, remaining=qty, status=0,
         handle=runner.assign_handle(),
     )

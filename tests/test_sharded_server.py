@@ -56,7 +56,10 @@ def test_sharded_server_matches_and_persists(hs):
     for i in range(6):
         r = submit(stub, symbol=f"S{i}", side=pb2.BUY, price=1000 + i, qty=10)
         assert r.success, r.error_message
-    r = submit(stub, symbol="S3", side=pb2.SELL, price=900, qty=4)
+    # Different client: the crossing SELL must not be suppressed by
+    # self-trade prevention (always on).
+    r = submit(stub, client="c2", symbol="S3", side=pb2.SELL, price=900,
+               qty=4)
     assert r.success
     hs["parts"]["sink"].flush()
 
