@@ -11,13 +11,16 @@ This path ships only the K real ops, and in as few transfers as possible —
 on the tunneled TPU every host<->device hop is a round trip, so transfer
 COUNT matters as much as bytes:
 
-- up: ONE [K, 8] int32 lane array (coordinates + payload). The jit unpacks
-  columns on device and scatters them onto the zero [S, B] grid (padding
-  rows target slot=S and are dropped by the scatter).
-- down: ONE packed [7K+2] int32 vector (per-op status/filled/remaining,
-  each op's symbol top-of-book, fill_count, fill_overflow), plus ONE
-  [5, max_fills] slice read only when fills exist (sliced to the actual
-  fill count, so its cost tracks the fills, not the buffer).
+- up: ONE [K, 9] int32 lane array (coordinates + payload + STP owner).
+  The jit unpacks columns on device and scatters them onto the zero
+  [S, B] grid (padding rows target slot=S and are dropped by the
+  scatter).
+- down: ONE packed [7K+2+5L] int32 vector (per-op status/filled/
+  remaining, each op's symbol top-of-book, fill_count, fill_overflow,
+  and the leading L=fill_inline_count fill rows), plus ONE full-buffer
+  [5, max_fills] fetch only when the fill count exceeds the inline
+  segment (fetched whole and sliced on host — a device-side dynamic
+  slice is a fresh program per count).
 
 The unchanged dense kernel runs in between, so semantics are identical to
 the dense path by construction; tests/test_sparse.py asserts bit-equal
