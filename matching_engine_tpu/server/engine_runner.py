@@ -416,8 +416,8 @@ class EngineRunner:
             if staged.deferred:
                 self._pending = (staged, on_finish)
             else:
-                # Ineligible for deferral (mesh decode, or more waves than
-                # the HBM-bounded window): finish now, same as the serial
+                # Ineligible for deferral (more waves than the
+                # HBM-bounded window): finish now, same as the serial
                 # schedule.
                 try:
                     result = self._finish_locked(staged)
@@ -513,9 +513,10 @@ class EngineRunner:
             staged = _Staged(ops, by_handle, res, terminal_makers,
                              dispatch_iter, decode_fn, finalize_fn,
                              deferred=False)
-            if (defer and self._sharded is None
-                    and n_waves <= PIPELINE_DEPTH):
-                # Dispatch every wave now, decode later: the staged
+            if defer and n_waves <= PIPELINE_DEPTH:
+                # Dispatch every wave now, decode later (all deployment
+                # shapes — the mesh decode reads addressable shards, so
+                # deferral is as safe as on a single device): the staged
                 # outputs are HBM-bounded by the wave-count cap.
                 for item in dispatch_iter:
                     staged.items.append(item)

@@ -139,3 +139,27 @@ def test_book_snapshot_sees_pending_orders():
     info, qty = bids[0]
     assert info.order_id == op.info.order_id and qty == 3
     r.finish_pending()
+
+
+def test_mesh_deferral_fifo_and_outcomes():
+    """Cross-dispatch deferral on a sharded runner (8-device virtual
+    mesh): FIFO finish, cross-batch match outcomes identical to serial —
+    the mesh decode reads addressable shards, so deferral is as safe as
+    single-device."""
+    from matching_engine_tpu.parallel import make_mesh
+
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=256)
+    r = EngineRunner(cfg, mesh=make_mesh(8))
+    log: list = []
+    a = _submit(r, "MX", 1, 100, 5)
+    r.dispatch_pipelined([a], _collector(log, "A"))
+    assert r.has_pending            # mesh dispatches DO defer now
+    assert a.info.order_id in r.orders_by_id
+    b = _submit(r, "MX", 2, 100, 5)
+    r.dispatch_pipelined([b], _collector(log, "B"))
+    r.finish_pending()
+    assert not r.has_pending
+    assert [entry[0] for entry in log] == ["A", "B"]
+    assert log[0][1] == [(a.info.order_id, NEW)]
+    assert log[1][1] == [(b.info.order_id, FILLED)]
+    assert a.info.remaining == 0 and a.info.status == FILLED
