@@ -616,3 +616,27 @@ def test_non_crossed_call_period_survives_restart(tmp_path):
                                           window_ms=1.0, log=False)
     assert not parts3["runner"].auction_mode
     shutdown(server3, parts3)
+
+
+def test_auction_mode_persist_failure_self_heals():
+    """A failed durable write keeps the dirty bit, so the next flush point
+    retries instead of stranding the mode transition."""
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    r = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
+                                  max_fills=64))
+    calls = []
+
+    def flaky(value):
+        calls.append(value)
+        return len(calls) > 1  # first write fails, second succeeds
+
+    r.persist_auction_mode = flaky
+    r.set_auction_mode(True)
+    r.flush_auction_mode()            # fails -> stays dirty, warns
+    assert calls == [True]
+    assert r.metrics.snapshot()[0].get("meta_persist_failures") == 1
+    r.flush_auction_mode()            # retries and succeeds
+    assert calls == [True, True]
+    r.flush_auction_mode()            # clean: no further writes
+    assert calls == [True, True]
