@@ -1,6 +1,6 @@
 """Sparse dispatch: O(actual ops) host<->device transfer per engine step.
 
-The dense serving step ships a full [S, B] OrderBatch (6 int32 planes) and
+The dense serving step ships a full [S, B] OrderBatch (7 int32 planes) and
 reads back [S, B] result planes even when a dispatch carries a handful of
 orders — at 4096 symbols x batch 32 that is ~3MB up and ~1.5MB down per
 step, pure overhead on the host<->device boundary SURVEY.md §7 calls the
