@@ -73,6 +73,15 @@ def main() -> None:
     value, mean_lat_us = measure_device_throughput(
         cfg, streams, windows=args.windows, iters=args.iters
     )
+    try:
+        import subprocess
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        rev = "unknown"
     result = {
         "value": value,
         "platform": platform,
@@ -82,6 +91,7 @@ def main() -> None:
         "batch": args.batch,
         "backend_init_s": round(backend_init_s, 1),
         "mean_dispatch_latency_us": round(mean_lat_us, 1),
+        "git_rev": rev,
     }
     with open(args.json_out, "w") as f:
         json.dump(result, f)

@@ -45,10 +45,25 @@ from matching_engine_tpu.utils.measure import measure_device_throughput
 NORTH_STAR = 10_000_000
 
 
+def _git_rev() -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+_GIT_REV = _git_rev()
+
+
 def emit(config: int, name: str, value: float, unit: str, extra: dict | None = None):
     line = {"config": config, "metric": name, "value": round(value, 1), "unit": unit,
             "vs_baseline": round(value / NORTH_STAR, 4) if unit == "orders/sec" else None,
-            "platform": jax.devices()[0].platform}
+            "platform": jax.devices()[0].platform, "git_rev": _GIT_REV}
     if extra:
         line.update(extra)
     print(json.dumps(line), flush=True)
