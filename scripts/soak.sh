@@ -1,0 +1,106 @@
+#!/usr/bin/env bash
+# Sustained-serving soak: boot the full dual-edge stack in an auction call
+# period (checkpoint daemon on a short interval), perform a real opening
+# cross, then hammer BOTH edges with the native load generator in a loop,
+# interleaving cancel traffic and RunAuction quiesces (under continuous
+# load these are usually no-op clears — books rarely stand crossed — but
+# each one exercises the dispatch-lock + pending-pipeline + checkpoint
+# interplay). Ends by asserting real throughput happened, the server is
+# still alive, and the durable store audits clean; writes one JSON
+# artifact to benchmarks/results/soak_<ts>.json.
+#
+# Usage: scripts/soak.sh [minutes]   (default 3; CPU platform)
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS   # a wedged axon tunnel must not hang the soak
+
+MINUTES="${1:-3}"
+WORK=$(mktemp -d)
+DB="$WORK/soak.db"
+OUT_DIR="$PWD/benchmarks/results"
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+mkdir -p "$OUT_DIR"
+make -s -C native || { echo "FAIL: native build"; exit 1; }
+
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --gateway-addr 127.0.0.1:0 --auction-open \
+  --checkpoint-dir "$WORK/ckpts" --checkpoint-interval-s 5 \
+  > "$WORK/server.log" 2>&1 &
+SRV=$!
+trap 'kill $SRV 2>/dev/null' EXIT
+
+PY_PORT=""; GW_PORT=""
+for i in $(seq 1 120); do
+  PY_PORT=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
+  GW_PORT=$(sed -n 's/.*native gateway on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
+  [ -n "$PY_PORT" ] && [ -n "$GW_PORT" ] && break
+  kill -0 $SRV 2>/dev/null || { echo "FAIL: server died at boot"; tail -5 "$WORK/server.log"; exit 1; }
+  sleep 1
+done
+if [ -z "$PY_PORT" ] || [ -z "$GW_PORT" ]; then
+  echo "FAIL: server ports never appeared"; tail -5 "$WORK/server.log"; exit 1
+fi
+CLI=matching_engine_tpu/native/me_client
+GW="127.0.0.1:$GW_PORT"; PY="127.0.0.1:$PY_PORT"
+
+# Real opening cross: crossing flow RESTS in the call period, a per-symbol
+# uncross clears it (call period holds), then all-symbols opens trading.
+"$CLI" "$GW" soak-b SOAK BUY LIMIT 1020000 4 5 >/dev/null || { echo "FAIL: call-period submit"; exit 1; }
+"$CLI" "$GW" soak-a SOAK SELL LIMIT 1000000 4 3 >/dev/null || { echo "FAIL: call-period submit"; exit 1; }
+"$CLI" auction "$GW" SOAK | grep -q "cleared 1000000@Q4 x3" || { echo "FAIL: opening cross"; exit 1; }
+"$CLI" auction "$GW" >/dev/null || { echo "FAIL: all-symbols uncross"; exit 1; }
+
+DEADLINE=$(( $(date +%s) + MINUTES * 60 ))
+ROUNDS=0; OK_TOTAL=0; CANCELS=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  kill -0 $SRV 2>/dev/null || { echo "FAIL: server died mid-soak"; exit 1; }
+  for ADDR in "$GW" "$PY"; do
+    LINE=$("$CLI" bench "$ADDR" 8 100 12 4 2>/dev/null) || true
+    OK=$(echo "$LINE" | python -c "import json,sys
+try: print(json.loads(sys.stdin.read())['ok'])
+except Exception: print(0)")
+    OK_TOTAL=$((OK_TOTAL + OK))
+  done
+  # Cancel traffic: rest far from the market, then cancel.
+  OID=$("$CLI" "$GW" soak-c SOAK BUY LIMIT 10000 4 1 2>/dev/null \
+        | sed -n 's/.*order_id=\(OID-[0-9]*\).*/\1/p')
+  if [ -n "$OID" ] && "$CLI" cancel "$GW" soak-c "$OID" >/dev/null 2>&1; then
+    CANCELS=$((CANCELS + 1))
+  fi
+  # Auction quiesce under load (usually a no-op clear; exercises the
+  # dispatch-lock/pending/checkpoint interplay concurrently with traffic).
+  "$CLI" auction "$GW" >/dev/null 2>&1 || true
+  ROUNDS=$((ROUNDS + 1))
+done
+[ "$OK_TOTAL" -gt 0 ] || { echo "FAIL: no orders succeeded"; exit 1; }
+[ "$CANCELS" -gt 0 ] || { echo "FAIL: no cancels succeeded"; exit 1; }
+
+sleep 2
+AUDIT=$(python - "$DB" <<'EOF'
+import sys
+sys.path.insert(0, "scripts")
+from audit import audit
+problems = audit(sys.argv[1])
+print(len(problems))
+EOF
+)
+AUDIT=$(echo "$AUDIT" | tail -1)
+kill $SRV 2>/dev/null; wait $SRV 2>/dev/null; trap - EXIT
+
+python - "$OUT_DIR/soak_${TS}.json" <<EOF
+import json, subprocess, sys
+rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                     capture_output=True, text=True).stdout.strip()
+artifact = {
+    "metric": "soak", "minutes": $MINUTES, "rounds": $ROUNDS,
+    "orders_ok": $OK_TOTAL, "cancels": $CANCELS,
+    "audit_violations": int("$AUDIT".strip() or -1),
+    "platform": "cpu", "git_rev": rev,
+}
+json.dump(artifact, open(sys.argv[1], "w"))
+print(json.dumps(artifact))
+EOF
+[ "$(echo "$AUDIT" | tr -d '[:space:]')" = "0" ]
