@@ -640,3 +640,40 @@ def test_auction_mode_persist_failure_self_heals():
     assert calls == [True, True]
     r.flush_auction_mode()            # clean: no further writes
     assert calls == [True, True]
+
+
+def test_auction_rpc_full_abort_maps_to_failure(tmp_path):
+    """An uncross whose record log cannot fit fails the RPC (success=false
+    + raise-max_fills message) and leaves the books untouched."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=4)
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "abort.db"), cfg, window_ms=1.0,
+        log=False)
+    parts["runner"].auction_mode = True
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    try:
+        for k in range(6):  # 6 one-lot pairs -> 6 records > max_fills=4
+            for who, side, price in [(f"b{k}", pb2.BUY, 105),
+                                     (f"a{k}", pb2.SELL, 100)]:
+                r = stub.SubmitOrder(
+                    pb2.OrderRequest(client_id=who, symbol="AB", side=side,
+                                     order_type=pb2.LIMIT, price=price,
+                                     scale=4, quantity=1), timeout=15)
+                assert r.success, r.error_message
+        resp = stub.RunAuction(pb2.AuctionRequest(symbol="AB"), timeout=30)
+        assert not resp.success
+        assert "max_fills" in resp.error_message
+        # Books untouched; the call period stays open.
+        book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="AB"),
+                                 timeout=10)
+        assert len(book.bids) == 6 and len(book.asks) == 6
+        assert parts["runner"].auction_mode
+    finally:
+        shutdown(server, parts)
