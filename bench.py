@@ -8,13 +8,20 @@ rerun; this orchestrator is the fix).
 Structure: this process never imports jax. The measurement runs in a child
 (benchmarks/bench_child.py) whose wall-clock is bounded here:
 
-  1. preflight + measure on the default backend (TPU via the axon tunnel),
-     bounded retries with backoff — each attempt SIGTERM'd then SIGKILL'd on
-     timeout (a wedged backend ignores SIGTERM);
-  2. on failure, a CPU fallback at a reduced, clearly-labeled config
+  0. if a watcher-kept warm resident (benchmarks/resident.py) is alive with
+     a fresh heartbeat, signal it — a compiled-engine measurement lands in
+     seconds instead of paying init+compile inside the wall budget;
+  1. a CHEAP backend probe (~25s subprocess doing jax.devices(); healthy
+     init is sub-second, r3 artifacts) decides whether to spend the budget
+     on a real attempt at all — round 3 burned its whole 300s on one
+     wedged attempt (VERDICT r3 weak #1);
+  2. on a healthy probe, a STAGED measurement child: a small config writes
+     a salvageable real-TPU figure before the full 4k config overwrites it,
+     so a timeout mid-full-run still yields hardware evidence;
+  3. on failure, a CPU fallback at a reduced, clearly-labeled config
      (JAX_PLATFORMS=cpu with the axon relay env stripped, so a wedged tunnel
      can't hang interpreter start);
-  3. if even that fails, a value-0 line with the error — still rc=0.
+  4. if even that fails, a value-0 line with the error — still rc=0.
 
 The reference publishes no benchmark numbers (BASELINE.md — its matching
 core is an empty file and its hot path is one SQLite INSERT under a global
@@ -39,13 +46,20 @@ CHILD = os.path.join(REPO, "benchmarks", "bench_child.py")
 WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", 480))
 TPU_ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_TPU_TIMEOUT_S", 300))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
-CPU_RESERVE_S = 150.0  # wall-clock kept aside for the CPU fallback
+CPU_RESERVE_S = 120.0  # wall-clock kept aside for the CPU fallback
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 25))
+PROBE_TRIES = 3
 RETRY_BACKOFF_S = 10.0
+RESIDENT_WAIT_S = float(os.environ.get("BENCH_RESIDENT_WAIT_S", 90))
+RESIDENT_HEARTBEAT_FRESH_S = 120.0
+RESIDENT_DIR = os.path.join(REPO, "benchmarks", ".resident")
 
 # North-star config (BASELINE.json): 4k symbols; batch 32 amortizes dispatch
-# overhead over a longer in-kernel scan. The CPU fallback runs the same
-# kernel at the suite's reduced config-3 size so it finishes inside budget.
-TPU_ARGS = ["--symbols", "4096", "--capacity", "128", "--batch", "32"]
+# overhead over a longer in-kernel scan. --stage-symbols writes a salvageable
+# small-config TPU figure first. The CPU fallback runs the same kernel at
+# the suite's reduced config-3 size so it finishes inside budget.
+TPU_ARGS = ["--symbols", "4096", "--capacity", "128", "--batch", "32",
+            "--stage-symbols", "512"]
 CPU_ARGS = ["--symbols", "512", "--capacity", "128", "--batch", "32",
             "--windows", "3", "--iters", "5"]
 
@@ -81,15 +95,31 @@ def run_child(extra_env: dict, args: list, timeout_s: float):
                     pass  # unkillable (wedged in D-state): abandon it
             # The wedge can strike in backend TEARDOWN, after the
             # measurement was written — salvage it rather than fall back.
+            # Annotated like the crash path: a salvaged small-stage row
+            # must carry the signal that the full-config attempt died.
             try:
                 with open(out_path) as f:
-                    return json.load(f), None
+                    result = json.load(f)
+                result["child_error"] = f"timeout after {timeout_s:.0f}s"
+                return result, None
             except (OSError, ValueError):
                 pass
             return None, f"timeout after {timeout_s:.0f}s"
         if proc.returncode != 0:
+            # Same salvage as the timeout path: a staged child that crashed
+            # in the FULL config already wrote its small-config real-TPU
+            # row atomically — a crash must not discard it for a CPU
+            # fallback.
             tail = " | ".join((stderr or "").strip().splitlines()[-3:])
-            return None, f"rc={proc.returncode}: {tail[-500:]}"
+            err = f"rc={proc.returncode}: {tail[-500:]}"
+            try:
+                with open(out_path) as f:
+                    result = json.load(f)
+                result["child_error"] = err
+                return result, None
+            except (OSError, ValueError):
+                pass
+            return None, err
         try:
             with open(out_path) as f:
                 return json.load(f), None
@@ -113,30 +143,123 @@ def emit(value: float, extra: dict) -> None:
     print(json.dumps(line), flush=True)
 
 
+def probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Cheap tunnel-health probe: a subprocess that just inits the backend.
+    Healthy init is sub-second (r3 artifacts: backend_init_s 0.1-0.4);
+    wedged it hangs until killed. SIGKILL directly — a wedged init never
+    handles SIGTERM, and the probe has no state worth draining."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; assert jax.devices()"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return False, f"probe timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = " | ".join((stderr or "").strip().splitlines()[-2:])
+        return False, f"probe rc={proc.returncode}: {tail[-200:]}"
+    return True, ""
+
+
+def try_resident(deadline: float, errors: list[str]):
+    """Phase 0: a warm resident with a fresh heartbeat serves a measured
+    row in seconds. Returns the row dict or None (reason appended)."""
+    state_path = os.path.join(RESIDENT_DIR, "state.json")
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return None  # no resident — normal when no watcher ran; not an error
+    age = time.time() - state.get("heartbeat_ts", 0)
+    if age > RESIDENT_HEARTBEAT_FRESH_S:
+        errors.append(f"resident heartbeat stale ({age:.0f}s)")
+        return None
+    try:
+        os.kill(int(state["pid"]), 0)
+    except (OSError, KeyError, ValueError):
+        errors.append("resident pid dead")
+        return None
+    nonce = f"{os.getpid()}-{int(time.time())}"
+    out_path = os.path.join(RESIDENT_DIR, f"out-{nonce}.json")
+    try:
+        with open(os.path.join(RESIDENT_DIR, f"req-{nonce}"), "w") as f:
+            f.write("")
+    except OSError as e:
+        errors.append(f"resident request write failed: {e}")
+        return None
+    wait_until = min(time.monotonic() + RESIDENT_WAIT_S,
+                     deadline - CPU_RESERVE_S)
+    while time.monotonic() < wait_until:
+        if os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    row = json.load(f)
+            except (OSError, ValueError):
+                row = None  # mid-write; next poll reads the atomic replace
+            if row is not None:
+                try:
+                    os.unlink(out_path)
+                except OSError:
+                    pass
+                if "error" in row or "value" not in row:
+                    errors.append(
+                        f"resident measure failed: {row.get('error')}")
+                    return None
+                return row
+        time.sleep(0.5)
+    errors.append(f"resident did not answer within {RESIDENT_WAIT_S:.0f}s")
+    return None
+
+
 def main() -> None:
     deadline = time.monotonic() + WALL_BUDGET_S
     errors: list[str] = []
 
-    for attempt in range(TPU_ATTEMPTS):
-        # Attempt 1 gets the full attempt timeout: killing the child mid
-        # cold-compile is what wedges the axon tunnel, so the orchestrator
-        # must never convert a slow compile into a wedge. Only retries split
-        # the remaining pre-reserve wall (a wedged init fails fast anyway).
+    # Phase 0: warm resident (watcher-kept compiled engine).
+    result = try_resident(deadline, errors)
+    if result is not None:
+        emit(result.pop("value"), result)
+        return
+
+    # Phases 1+2: probe, then a staged measurement child per healthy probe.
+    # A wedged tunnel now costs ~3 cheap probes (~75s) instead of one
+    # 300s attempt; a healthy one gets the whole pre-reserve budget.
+    probes_left = PROBE_TRIES
+    attempts_left = TPU_ATTEMPTS
+    while probes_left > 0 and attempts_left > 0:
         remaining = deadline - time.monotonic() - CPU_RESERVE_S
-        if attempt == 0:
-            budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining)
-        else:
-            budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining / (TPU_ATTEMPTS - attempt))
+        if remaining < PROBE_TIMEOUT_S + 30:
+            errors.append("tpu attempts stopped: wall budget exhausted")
+            break
+        ok, perr = probe_backend(min(PROBE_TIMEOUT_S, remaining - 10))
+        if not ok:
+            probes_left -= 1
+            errors.append(perr)
+            if probes_left > 0:
+                # A fast-failing probe (relay restarting: connection
+                # refused in ~2s) must not burn all tries in seconds —
+                # ride out the transient, bounded by the budget.
+                time.sleep(min(RETRY_BACKOFF_S, max(
+                    0, deadline - time.monotonic() - CPU_RESERVE_S - 60)))
+            continue
+        remaining = deadline - time.monotonic() - CPU_RESERVE_S
+        budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining)
         if budget < min(60, TPU_ATTEMPT_TIMEOUT_S):
             errors.append("tpu attempts stopped: wall budget exhausted")
             break
-        if attempt:
-            time.sleep(min(RETRY_BACKOFF_S, max(0, deadline - time.monotonic() - CPU_RESERVE_S - 60)))
+        attempts_left -= 1
         result, err = run_child({}, TPU_ARGS, budget)
         if result is not None:
             emit(result.pop("value"), result)
             return
-        errors.append(f"attempt {attempt + 1}: {err}")
+        errors.append(f"attempt {TPU_ATTEMPTS - attempts_left}: {err}")
 
     # CPU fallback — labeled, reduced config, axon relay env stripped so a
     # wedged tunnel can't hang interpreter start (sitecustomize registers
@@ -194,9 +317,18 @@ def latest_tpu_artifact():
             elif name.startswith("tpu_suite") and name.endswith(".jsonl"):
                 with open(path) as f:
                     rows = [json.loads(line) for line in f if line.strip()]
+            elif name == "tpu_resident_log.jsonl":
+                # The warm resident's measurement log: headline-config
+                # real-TPU rows, often the freshest evidence on disk.
+                with open(path) as f:
+                    rows = [json.loads(line) for line in f if line.strip()]
         except (OSError, ValueError):
             continue  # in-progress/corrupt capture: skip, keep older evidence
-        for row in rows:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        for i, row in enumerate(rows):
             if not (isinstance(row, dict)
                     and row.get("platform") in ("tpu", "axon")):
                 continue
@@ -204,12 +336,15 @@ def latest_tpu_artifact():
                 continue  # suite rows: only config 3 measures the headline
             if not isinstance(row.get("value"), (int, float)):
                 continue
-            candidates.append((row.get("symbols"), row["value"], row, name))
+            candidates.append(
+                ((mtime, i), row.get("symbols"), row["value"], row, name))
     if not candidates:
         return None
-    headline = [c for c in candidates if c[0] == 4096]
-    # Directory listing is ts-sorted, so the last candidate is the newest.
-    _, value, row, name = (headline or candidates)[-1]
+    headline = [c for c in candidates if c[1] == 4096]
+    # Newest by file mtime (append-logs keep getting fresher rows without
+    # a fresher NAME, so listing order alone is not recency), then by
+    # in-file position.
+    _, _, value, row, name = max(headline or candidates, key=lambda c: c[0])
     out = {
         "file": f"benchmarks/results/{name}",
         "value": value,
@@ -217,7 +352,7 @@ def latest_tpu_artifact():
         "mean_dispatch_latency_us": row.get("mean_dispatch_latency_us"),
     }
     if headline:
-        _, best_value, _, best_name = max(headline, key=lambda c: c[1])
+        _, _, best_value, _, best_name = max(headline, key=lambda c: c[2])
         out["best_value"] = best_value
         out["best_file"] = f"benchmarks/results/{best_name}"
     return out

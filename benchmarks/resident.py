@@ -1,0 +1,201 @@
+"""Warm resident bench process: keeps a compiled engine + device-resident
+waves alive so an end-of-round `bench.py` run can obtain a real-TPU figure
+in seconds instead of paying backend init + stream build + compile inside
+the driver's wall budget (VERDICT r3 next-step 1: "a watcher-kept warm
+resident process bench.py can signal").
+
+Protocol (file-based, under benchmarks/.resident/):
+  state.json      — {"pid", "heartbeat_ts", "platform", "symbols", ...};
+                    heartbeat_ts is refreshed ONLY after a successful tiny
+                    device op, so a wedged tunnel makes it stale and
+                    bench.py knows not to wait on us.
+  req-<nonce>     — written by bench.py; we run a fresh measurement and
+                    write out-<nonce>.json, then delete the request.
+  out-<nonce>.json— {"value", "platform", "measured_at", ...} (the same
+                    row shape bench_child.py writes).
+
+Every measurement (requested or periodic self-measure) is also appended to
+benchmarks/results/tpu_resident_log.jsonl for provenance.
+
+Run by scripts/tpu_r4_watch.sh once the round's capture list completes;
+exits on its own after MAX_LIFETIME_S or when the state dir is deleted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+STATE_DIR = os.path.join(REPO, "benchmarks", ".resident")
+RESULTS_LOG = os.path.join(REPO, "benchmarks", "results",
+                           "tpu_resident_log.jsonl")
+HEARTBEAT_EVERY_S = 30.0
+SELF_MEASURE_EVERY_S = 1800.0
+MAX_LIFETIME_S = float(os.environ.get("RESIDENT_MAX_LIFETIME_S", 12 * 3600))
+
+
+def _git_rev() -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5, cwd=REPO,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def _write_state(state: dict) -> None:
+    tmp = os.path.join(STATE_DIR, "state.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, os.path.join(STATE_DIR, "state.json"))
+
+
+def main() -> None:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    symbols = int(os.environ.get("RESIDENT_SYMBOLS", 4096))
+    capacity = int(os.environ.get("RESIDENT_CAPACITY", 128))
+    batch = int(os.environ.get("RESIDENT_BATCH", 32))
+
+    import jax
+
+    cache_dir = os.environ.get("ME_JAX_CACHE", os.path.join(REPO, ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    platform = devices[0].platform
+    init_s = time.perf_counter() - t0
+
+    from matching_engine_tpu.engine.book import EngineConfig, init_book
+    from matching_engine_tpu.engine.kernel import engine_step
+    from matching_engine_tpu.utils.measure import (
+        headline_streams,
+        measure_windows,
+        prepare_waves,
+        result_row,
+    )
+
+    # Purge protocol residue from previous/abandoned runs: an orphaned
+    # req-* would make this fresh resident burn its first seconds serving
+    # a request nobody reads; stale out-* just accumulate.
+    for name in os.listdir(STATE_DIR):
+        if name.startswith(("req-", "out-")):
+            try:
+                os.unlink(os.path.join(STATE_DIR, name))
+            except OSError:
+                pass
+
+    cfg = EngineConfig(num_symbols=symbols, capacity=capacity, batch=batch,
+                       max_fills=1 << 17)
+    waves, wave_ops = prepare_waves(cfg, headline_streams(cfg))
+    book = init_book(cfg)
+    book, out = engine_step(cfg, book, waves[0])
+    jax.block_until_ready(out)
+    rev = _git_rev()
+
+    state = {
+        "pid": os.getpid(),
+        "platform": platform,
+        "symbols": symbols,
+        "capacity": capacity,
+        "batch": batch,
+        "backend_init_s": round(init_s, 1),
+        "started_ts": time.time(),
+        "heartbeat_ts": time.time(),
+        "git_rev": rev,
+    }
+    _write_state(state)
+    print(f"[resident] up: platform={platform} init={init_s:.1f}s "
+          f"cfg={symbols}/{capacity}/{batch}", flush=True)
+
+    def measure(windows: int, iters: int) -> dict:
+        nonlocal book
+        value, lat_us, book = measure_windows(
+            cfg, book, waves, wave_ops, windows=windows, iters=iters)
+        row = result_row(cfg, round(value, 1), lat_us, platform=platform,
+                         n_devices=len(devices), backend_init_s=0.0,
+                         git_rev=rev)
+        row["via"] = "resident"
+        row["measured_at"] = time.time()
+        with open(RESULTS_LOG, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        return row
+
+    # First self-measurement doubles as proof the warm path works.
+    row = measure(windows=3, iters=10)
+    state["last_value"] = row["value"]
+    state["heartbeat_ts"] = time.time()
+    _write_state(state)
+    print(f"[resident] warm figure: {row['value']:.0f} orders/s", flush=True)
+
+    deadline = time.monotonic() + MAX_LIFETIME_S
+    next_heartbeat = 0.0
+    next_self_measure = time.monotonic() + SELF_MEASURE_EVERY_S
+    while time.monotonic() < deadline:
+        if not os.path.isdir(STATE_DIR):
+            print("[resident] state dir removed; exiting", flush=True)
+            return
+        # Requests first: a driver-side bench.py is on a wall budget.
+        reqs = sorted(n for n in os.listdir(STATE_DIR) if n.startswith("req-"))
+        for name in reqs:
+            nonce = name[4:]
+            try:
+                try:
+                    row = measure(windows=4, iters=12)
+                except Exception as e:  # noqa: BLE001 — requester on a
+                    # wall budget: fail it in seconds (an error out-file),
+                    # never leave it polling its full timeout for a reply
+                    # a dead resident can't write.
+                    row = {"error": f"{type(e).__name__}: {e}"}
+                out_tmp = os.path.join(STATE_DIR, f"out-{nonce}.tmp")
+                with open(out_tmp, "w") as f:
+                    json.dump(row, f)
+                os.replace(out_tmp,
+                           os.path.join(STATE_DIR, f"out-{nonce}.json"))
+                if "error" in row:
+                    print(f"[resident] req {nonce} failed: {row['error']}",
+                          flush=True)
+                    raise RuntimeError(row["error"])  # die; watcher restarts
+                state["last_value"] = row["value"]
+                state["heartbeat_ts"] = time.time()
+                _write_state(state)
+                print(f"[resident] served req {nonce}: "
+                      f"{row['value']:.0f} orders/s", flush=True)
+            finally:
+                try:
+                    os.unlink(os.path.join(STATE_DIR, name))
+                except OSError:
+                    pass
+        now = time.monotonic()
+        if now >= next_heartbeat:
+            # Tiny device op; only a completed sync refreshes the
+            # heartbeat (a wedged tunnel hangs here and the heartbeat
+            # goes stale — the correct signal).
+            book, out = engine_step(cfg, book, waves[0])
+            jax.block_until_ready(out)
+            state["heartbeat_ts"] = time.time()
+            _write_state(state)
+            next_heartbeat = time.monotonic() + HEARTBEAT_EVERY_S
+        if now >= next_self_measure:
+            row = measure(windows=3, iters=10)
+            state["last_value"] = row["value"]
+            state["heartbeat_ts"] = time.time()
+            _write_state(state)
+            next_self_measure = time.monotonic() + SELF_MEASURE_EVERY_S
+        time.sleep(1.0)
+    print("[resident] lifetime reached; exiting", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,32 +23,61 @@ from matching_engine_tpu.engine.harness import build_batches
 from matching_engine_tpu.engine.kernel import engine_step
 
 
-def measure_device_throughput(
-    cfg: EngineConfig,
-    streams,
-    *,
-    windows: int = 5,
-    iters: int = 20,
-    waves_per_stream: int = 2,
-):
-    """Returns (sustained orders/sec, mean dispatch latency in µs — the
-    median across windows of each window's MEAN step latency dt/iters; a
-    mean, not a percentile — real p50/p99 come from the serving-stack
-    benchmark, see docs/BENCH_METHOD.md).
+def headline_streams(cfg: EngineConfig, n_streams: int = 4):
+    """THE headline-bench flow (bench_child, the resident, and the watch
+    captures all call this): L3-style mixed op stream at the config's
+    shape. One definition so the resident's phase-0 figure and the child's
+    figure stay comparable rows of the same metric."""
+    from matching_engine_tpu.engine.harness import random_order_stream
 
-    `streams` is a list of HostOrder lists; the leading `waves_per_stream`
-    dispatches of each are cycled during the timed loop.
-    """
+    return [
+        random_order_stream(
+            cfg.num_symbols, 4 * cfg.num_symbols * cfg.batch, seed=w,
+            cancel_p=0.10, market_p=0.15, price_base=9_950,
+            price_levels=100, price_step=1, qty_max=100,
+        )
+        for w in range(n_streams)
+    ]
+
+
+def result_row(cfg: EngineConfig, value: float, lat_us: float, *,
+               platform: str, n_devices: int, backend_init_s: float,
+               git_rev: str) -> dict:
+    """The benchmark artifact row shape (shared by bench_child and the
+    resident so a schema tweak can't silently fork the two)."""
+    return {
+        "value": value,
+        "platform": platform,
+        "n_devices": n_devices,
+        "symbols": cfg.num_symbols,
+        "capacity": cfg.capacity,
+        "batch": cfg.batch,
+        "backend_init_s": round(backend_init_s, 1),
+        "mean_dispatch_latency_us": round(lat_us, 1),
+        "git_rev": git_rev,
+    }
+
+
+def prepare_waves(cfg: EngineConfig, streams, waves_per_stream: int = 2):
+    """Device-put the leading `waves_per_stream` dispatches of each stream.
+    Returns (waves, wave_ops) — the reusable device-resident inputs for
+    measure_windows (the warm resident keeps these alive across requests
+    so a measurement request costs windows, not stream building)."""
     waves, wave_ops = [], []
     for stream in streams:
         for b in build_batches(cfg, stream)[:waves_per_stream]:
             wave_ops.append(int(np.count_nonzero(np.asarray(b.op))))
             waves.append(jax.device_put(b))
+    return waves, wave_ops
 
-    book = init_book(cfg)
-    book, out = engine_step(cfg, book, waves[0])
-    jax.block_until_ready(out)
 
+def measure_windows(cfg: EngineConfig, book, waves, wave_ops, *,
+                    windows: int = 5, iters: int = 20):
+    """The timed core: `windows` fully-synced windows of `iters` steps over
+    pre-device-put waves; first window discarded (ramp). Returns
+    (sustained orders/sec, mean step latency µs, book') — book' so a
+    long-lived caller (benchmarks/resident.py) can thread state through
+    repeated measurements without re-initializing."""
     real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
     rates, lats = [], []
     for _ in range(windows):
@@ -67,4 +96,31 @@ def measure_device_throughput(
     # (rate, latency) pair — rate * latency must equal ops-per-step.
     pairs = sorted(zip(rates[1:], lats[1:]))
     mid_rate, mid_lat = pairs[len(pairs) // 2]
-    return mid_rate, mid_lat
+    return mid_rate, mid_lat, book
+
+
+def measure_device_throughput(
+    cfg: EngineConfig,
+    streams,
+    *,
+    windows: int = 5,
+    iters: int = 20,
+    waves_per_stream: int = 2,
+):
+    """Returns (sustained orders/sec, mean dispatch latency in µs — the
+    median across windows of each window's MEAN step latency dt/iters; a
+    mean, not a percentile — real p50/p99 come from the serving-stack
+    benchmark, see docs/BENCH_METHOD.md).
+
+    `streams` is a list of HostOrder lists; the leading `waves_per_stream`
+    dispatches of each are cycled during the timed loop.
+    """
+    waves, wave_ops = prepare_waves(cfg, streams, waves_per_stream)
+
+    book = init_book(cfg)
+    book, out = engine_step(cfg, book, waves[0])
+    jax.block_until_ready(out)
+
+    rate, lat, _ = measure_windows(
+        cfg, book, waves, wave_ops, windows=windows, iters=iters)
+    return rate, lat

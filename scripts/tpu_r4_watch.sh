@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Round-4 TPU watcher: probe the axon tunnel cheaply on an interval; on a
+# healthy probe, run the round-4 capture list (benchmarks/capture_r4.py —
+# resumable, artifact-existence-checked), and once the list completes,
+# keep a warm resident bench process (benchmarks/resident.py) alive so the
+# driver's end-of-round bench.py lands a real-TPU figure in seconds
+# (VERDICT r3 next-step 1).
+#
+# Usage: scripts/tpu_r4_watch.sh [&]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT_DIR="$REPO/benchmarks/results"
+LOG="$OUT_DIR/tpu_watch.log"
+mkdir -p "$OUT_DIR"
+
+INTERVAL="${TPU_WATCH_INTERVAL_S:-180}"
+PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT_S:-60}"
+MAX_LOOPS="${TPU_WATCH_MAX_LOOPS:-400}"
+RESIDENT_LOG="$OUT_DIR/resident.log"
+
+log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] $*" >>"$LOG"; }
+
+resident_healthy() {
+  # Alive AND fresh: a resident wedged inside a device sync stays
+  # pid-alive forever with a stale heartbeat — it must be killed and
+  # replaced once the tunnel recovers, or the warm phase-0 path is
+  # permanently lost to the first wedge event.
+  python - "$REPO" <<'EOF'
+import json, os, sys, time
+state = os.path.join(sys.argv[1], "benchmarks", ".resident", "state.json")
+try:
+    s = json.load(open(state))
+    os.kill(int(s["pid"]), 0)
+except Exception:
+    sys.exit(1)
+age = time.time() - s.get("heartbeat_ts", 0)
+if age > 180:
+    try:
+        os.kill(int(s["pid"]), 9)
+    except OSError:
+        pass
+    sys.exit(1)
+sys.exit(0)
+EOF
+}
+
+log "r4 watcher start (interval=${INTERVAL}s probe_timeout=${PROBE_TIMEOUT}s)"
+for _ in $(seq 1 "$MAX_LOOPS"); do
+  if timeout -s KILL "$PROBE_TIMEOUT" python -c \
+      "import jax; assert jax.devices()" >>"$LOG" 2>&1; then
+    log "probe healthy"
+    if python "$REPO/benchmarks/capture_r4.py" >>"$LOG" 2>&1; then
+      log "capture list complete"
+      if ! resident_healthy; then
+        log "starting warm resident"
+        nohup python "$REPO/benchmarks/resident.py" >>"$RESIDENT_LOG" 2>&1 &
+        sleep 5
+      fi
+    else
+      log "capture list incomplete (rc=$?); retry next window"
+    fi
+  else
+    log "probe unhealthy (rc=$?)"
+  fi
+  sleep "$INTERVAL"
+done
+log "r4 watcher exhausted $MAX_LOOPS loops"
