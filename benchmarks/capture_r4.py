@@ -92,6 +92,21 @@ STEPS: list[dict] = [
     {"name": "cap1024", "artifact": "tpu_r4_cap1024.json", "timeout": 1200,
      "cmd": bench_child("tpu_r4_cap1024.json", "--symbols", "256",
                         "--capacity", "1024", "--batch", "32")},
+    # Serving-stack rows (VERDICT r3 next-step 2): the RPC-less
+    # EngineRunner inflight sweep, then full-stack e2e at pipeline
+    # inflight 2 and 4 (r3's artifacts measured the old single-slot
+    # pipeline = inflight 1).
+    {"name": "runner_sweep", "artifact": "tpu_r4_runner.json",
+     "timeout": 1200,
+     "cmd": [PY, os.path.join(REPO, "benchmarks", "runner_bench.py"),
+             "--json-out", os.path.join(RESULTS, "tpu_r4_runner.json"),
+             "--inflight", "1,2,4,8"]},
+    {"name": "e2e_pi2", "artifact": "tpu_e2e_r4_native_pi2.json",
+     "timeout": 1500,
+     "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "2"]},
+    {"name": "e2e_pi4", "artifact": "tpu_e2e_r4_native_pi4.json",
+     "timeout": 1500,
+     "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"]},
 ]
 
 # Later steps (profile, runner-level, l3flow, e2e) are appended to STEPS
@@ -103,14 +118,23 @@ def _run_bounded(cmd: list[str], timeout: float, stdout_f) -> tuple:
     most 10s to reap — a child wedged in D-state inside the axon tunnel
     is abandoned, never waited on unboundedly (subprocess.run's
     post-timeout cleanup blocks forever on exactly that; the watcher must
-    keep looping). Returns (rc | None on timeout, stderr_tail)."""
+    keep looping). Kills the whole process GROUP: the e2e steps are bash
+    wrappers whose backgrounded server would otherwise survive a wrapper
+    SIGKILL holding the device and its ports (the EXIT trap never fires
+    on SIGKILL). Returns (rc | None on timeout, stderr_tail)."""
+    import signal
+
     proc = subprocess.Popen(cmd, cwd=REPO, stdout=stdout_f,
-                            stderr=subprocess.PIPE, text=True)
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
     try:
         _, stderr = proc.communicate(timeout=timeout)
         return proc.returncode, (stderr or "")
     except subprocess.TimeoutExpired:
-        proc.kill()
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
         try:
             proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:

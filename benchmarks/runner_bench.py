@@ -1,0 +1,164 @@
+"""EngineRunner-level serving bench: the dispatch pipeline WITHOUT any RPC
+edge or load generator (VERDICT r3 next-step 2: separate the serving
+stack's own ceiling from tunnel RTT and loadgen artifacts).
+
+Drives EngineRunner.dispatch_pipelined directly with pre-built EngineOp
+batches at a serving-like shape (sparse dispatches, small batches), sweeping
+the pipeline_inflight depth. Per sweep point it reports sustained orders/s
+plus per-batch turnaround p50/p99 (stage -> finish callback), which is the
+client-felt latency floor of the whole serving stack minus transport.
+
+The serving-ceiling model this measures (docs/BENCH_METHOD.md):
+  orders/s  ~=  batch_ops / max(host_batch_cost, sync_cost / inflight)
+where sync_cost is the per-decode device round trip (~64ms tunneled, ~0
+co-located with the async host-copy prefetch landing in time).
+
+Usage: python benchmarks/runner_bench.py --json-out out.json
+       [--symbols 64] [--capacity 256] [--batch 16]
+       [--batch-ops 64] [--n-batches 60] [--inflight 1,2,4,8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--symbols", type=int, default=64)
+    p.add_argument("--capacity", type=int, default=256)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--batch-ops", type=int, default=64,
+                   help="ops per dispatched batch (the dispatcher's drain "
+                        "size under load)")
+    p.add_argument("--n-batches", type=int, default=60)
+    p.add_argument("--inflight", default="1,2,4,8")
+    p.add_argument("--json-out", required=True)
+    args = p.parse_args()
+
+    import random
+
+    import jax
+    import numpy as np
+
+    cache_dir = os.environ.get(
+        "ME_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    platform = jax.devices()[0].platform
+    backend_init_s = time.perf_counter() - t0
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.engine.kernel import BUY, OP_SUBMIT, SELL
+    from matching_engine_tpu.server.engine_runner import (
+        EngineOp,
+        EngineRunner,
+        OrderInfo,
+    )
+
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
+                       batch=args.batch, max_fills=1 << 15)
+
+    def build_batches(runner: EngineRunner, seed: int,
+                      n_batches: int) -> list[list[EngineOp]]:
+        rng = random.Random(seed)
+        batches = []
+        for _ in range(n_batches):
+            ops = []
+            for _ in range(args.batch_ops):
+                sym = f"S{rng.randrange(args.symbols)}"
+                assert runner.slot_acquire(sym) is not None
+                num, oid = runner.assign_oid()
+                side = BUY if rng.random() < 0.5 else SELL
+                price = 10_000 + rng.randrange(-20, 21)
+                qty = rng.randrange(1, 50)
+                ops.append(EngineOp(OP_SUBMIT, OrderInfo(
+                    oid=num, order_id=oid, client_id=f"c{num % 97}",
+                    symbol=sym, side=side, otype=0, price_q4=price,
+                    quantity=qty, remaining=qty, status=0,
+                    handle=runner.assign_handle())))
+            batches.append(ops)
+        return batches
+
+    def sweep_point(inflight: int) -> dict:
+        runner = EngineRunner(cfg, pipeline_inflight=inflight)
+        batches = build_batches(runner, seed=inflight,
+                                n_batches=args.n_batches)
+        lat: list[float] = []
+        done = [0]
+
+        def make_cb(t_start: float):
+            def on_finish(result, error):
+                assert error is None, error
+                lat.append(time.perf_counter() - t_start)
+                done[0] += 1
+                return None
+            return on_finish
+
+        # Warm pass (compile both sparse bucket shapes this flow uses).
+        warm = build_batches(runner, seed=999, n_batches=3)
+        for b in warm:
+            runner.dispatch_pipelined(b, lambda r, e: None)
+        runner.finish_pending()
+
+        t_begin = time.perf_counter()
+        for b in batches:
+            runner.dispatch_pipelined(b, make_cb(time.perf_counter()))
+        runner.finish_pending()
+        dt = time.perf_counter() - t_begin
+        assert done[0] == len(batches)
+        lats = np.array(sorted(lat))
+        n_ops = sum(len(b) for b in batches)
+        return {
+            "inflight": inflight,
+            "orders_per_s": round(n_ops / dt, 1),
+            "batch_ops": args.batch_ops,
+            "n_batches": args.n_batches,
+            "p50_ms": round(float(lats[len(lats) // 2]) * 1e3, 3),
+            "p99_ms": round(float(lats[int(len(lats) * 0.99)]) * 1e3, 3),
+            "mean_batch_ms": round(dt / len(batches) * 1e3, 3),
+        }
+
+    rows = [sweep_point(int(k)) for k in args.inflight.split(",")]
+
+    try:
+        import subprocess
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        rev = "unknown"
+    out = {
+        "metric": "runner_dispatch_throughput",
+        "platform": platform,
+        "symbols": args.symbols,
+        "capacity": args.capacity,
+        "batch": args.batch,
+        "backend_init_s": round(backend_init_s, 1),
+        "sweep": rows,
+        "git_rev": rev,
+    }
+    tmp = args.json_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, args.json_out)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

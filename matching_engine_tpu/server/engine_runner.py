@@ -108,6 +108,24 @@ class DispatchResult:
     fill_count: int
 
 
+def _prefetch_host(item) -> None:
+    """Start the decode readback's device->host copy NOW (async).
+
+    A staged wave's output is read back as np.asarray(out.small) at decode
+    time — on a tunneled chip that sync bills a full network round trip.
+    Issuing copy_to_host_async at STAGE time overlaps the transfer with
+    the host's batching of newer work, so a pipelined decode finds the
+    bytes already landed. Items are (..., out) for the packed dense and
+    sparse shapes (both expose .small); the mesh StepOutput has no packed
+    vector and decodes from addressable shards — skipped."""
+    small = getattr(item[-1], "small", None)
+    if small is not None:
+        try:
+            small.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # backend without async host copies: decode pays the sync
+
+
 class _Staged:
     """One dispatch's in-flight state between stage (device waves issued)
     and finish (decode + publish + eviction). `deferred` means every wave
@@ -141,7 +159,7 @@ class EngineRunner:
     """
 
     def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None,
-                 mesh=None, hub=None):
+                 mesh=None, hub=None, pipeline_inflight: int = 2):
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
@@ -212,9 +230,14 @@ class EngineRunner:
         self.auction_mode = False
         self.persist_auction_mode = None  # callable(bool) -> bool | None
         self._mode_dirty = False
-        # Cross-dispatch pipelining: the one staged-but-undecoded dispatch
-        # (see dispatch_pipelined) with its finish callback.
-        self._pending: tuple[_Staged, object] | None = None
+        # Cross-dispatch pipelining: a bounded FIFO of staged-but-undecoded
+        # dispatches with their finish callbacks (see dispatch_pipelined).
+        # Depth >1 lets the drain loop accept several batches between
+        # decode syncs — on a tunneled chip each decode sync bills a
+        # network round trip, and ONE pending max meant every second batch
+        # ate a full RTT head-of-line (r3's 40x p50->p99 serving tail).
+        self._pending: deque[tuple[_Staged, object]] = deque()
+        self._pipeline_inflight = max(1, int(pipeline_inflight))
         # Constructor-wired (build_server passes the StreamHub the
         # dispatchers publish to): lets the decode skip CONSTRUCTING stream
         # protos (per-fill OrderUpdates, per-symbol MarketDataUpdates) when
@@ -347,25 +370,28 @@ class EngineRunner:
 
     # -- cross-dispatch pipelining ----------------------------------------
     #
-    # The serving drain loops overlap consecutive dispatches: the NEW
-    # batch's device waves are dispatched first (they chain after the
-    # previous batch's waves on the donated book), THEN the previous batch
-    # is decoded — its outputs completed on device while the host was
-    # batching, so the decode sync costs the residual, not a full round
-    # trip. Decode/publish order stays strictly FIFO (previous batch fully
-    # decoded and published before the new batch's decode begins), so
-    # directory mutations, storage rows, and stream events are identical
-    # to the serial schedule. At most ONE dispatch is pending; it is
-    # finished by the next dispatch, by the drain loop's idle wakeup, by
-    # checkpoint quiesce, or at shutdown.
+    # The serving drain loops overlap consecutive dispatches: a NEW
+    # batch's device waves are dispatched first (they chain after older
+    # staged waves on the donated book), and decodes happen later — each
+    # staged output completed on device (and its host copy landed, via
+    # _prefetch_host) while the host was batching newer work, so the
+    # decode sync costs the residual, not a full round trip. Up to
+    # `pipeline_inflight` dispatches stay staged, each pinning its wave
+    # outputs in HBM (bounded by PIPELINE_DEPTH waves apiece); a new
+    # dispatch finishes only the overflow beyond that window. Decode/
+    # publish order stays strictly FIFO (older batches fully decoded and
+    # published before newer ones), so directory mutations, storage rows,
+    # and stream events are identical to the serial schedule. Idle
+    # wakeup, checkpoint quiesce, auctions, run_dispatch, and shutdown
+    # drain the WHOLE queue.
 
     @property
     def has_pending(self) -> bool:
-        return self._pending is not None
+        return bool(self._pending)
 
     def finish_pending(self) -> None:
-        """Decode+publish the pending dispatch, if any (idle wakeup /
-        shutdown path)."""
+        """Decode+publish ALL pending dispatches, oldest first (idle
+        wakeup / shutdown path)."""
         posts: list = []
         with self._dispatch_lock:
             self._finish_pending_locked(posts)
@@ -373,13 +399,22 @@ class EngineRunner:
             p()
 
     def _finish_pending_locked(self, posts: list) -> None:
-        """Lock held. Finishes the pending dispatch through its callback;
-        the callback publishes under the lock and may return a thunk
-        (future/tag completions) the caller must run AFTER release."""
-        if self._pending is None:
+        """Lock held. Drains the WHOLE pending FIFO (quiesce semantics:
+        auction, checkpoint, run_dispatch, shutdown, idle wakeup all need
+        fully-decoded directories). Each callback publishes under the lock
+        and may return a thunk (future/tag completions) the caller must
+        run AFTER release."""
+        while self._pending:
+            self._finish_oldest_locked(posts)
+
+    def _finish_oldest_locked(self, posts: list) -> None:
+        """Lock held. Finishes the OLDEST pending dispatch only — the
+        pipelined serving path's per-batch finisher (FIFO decode order;
+        newer batches stay staged so their device waves keep overlapping
+        host work)."""
+        if not self._pending:
             return
-        staged, cb = self._pending
-        self._pending = None
+        staged, cb = self._pending.popleft()
         try:
             result = self._finish_locked(staged)
             err = None
@@ -412,13 +447,20 @@ class EngineRunner:
                 for p in posts:
                     p()
                 return
-            self._finish_pending_locked(posts)
             if staged.deferred:
-                self._pending = (staged, on_finish)
+                self._pending.append((staged, on_finish))
+                # Finish only the overflow beyond the inflight window:
+                # batches decode strictly FIFO, but up to
+                # `pipeline_inflight` stay staged so their (already
+                # host-copy-prefetched) outputs land while the host
+                # batches newer work.
+                while len(self._pending) > self._pipeline_inflight:
+                    self._finish_oldest_locked(posts)
             else:
                 # Ineligible for deferral (more waves than the
-                # HBM-bounded window): finish now, same as the serial
-                # schedule.
+                # HBM-bounded window): drain everything pending, then
+                # finish this batch too — same as the serial schedule.
+                self._finish_pending_locked(posts)
                 try:
                     result = self._finish_locked(staged)
                     err = None
@@ -520,6 +562,7 @@ class EngineRunner:
                 # outputs are HBM-bounded by the wave-count cap.
                 for item in dispatch_iter:
                     staged.items.append(item)
+                    _prefetch_host(item)
                 staged.deferred = True
             return staged
         except BaseException:

@@ -93,6 +93,7 @@ def build_server(
     native: bool = True,
     mesh=None,
     gateway_addr: str | None = None,
+    pipeline_inflight: int = 2,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -107,7 +108,8 @@ def build_server(
 
     metrics = Metrics()
     hub = StreamHub()
-    runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub)
+    runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
+                          pipeline_inflight=pipeline_inflight)
     # Fast path: restore the newest device-book snapshot and replay only the
     # post-snapshot delta from SQLite; fall back to full replay.
     ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
@@ -119,7 +121,8 @@ def build_server(
         except Exception as e:  # any corrupt/skewed checkpoint -> full replay
             print(f"[SERVER] checkpoint restore failed "
                   f"({type(e).__name__}: {e}); full replay")
-            runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub)
+            runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
+                                  pipeline_inflight=pipeline_inflight)
             ckpt = None
     if ckpt is None:
         recovered = recover_books(runner, storage)
@@ -274,6 +277,10 @@ def main(argv=None) -> int:
     p.add_argument("--capacity", type=int, default=128, help="resting orders per side")
     p.add_argument("--batch", type=int, default=8, help="orders per symbol per dispatch")
     p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
+    p.add_argument("--pipeline-inflight", type=int, default=2,
+                   help="staged-but-undecoded dispatches kept in flight "
+                        "(decode stays FIFO; >1 hides the per-batch decode "
+                        "sync round trip on a tunneled chip)")
     p.add_argument("--rpc-workers", type=int, default=32)
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable periodic device-book checkpoints here")
@@ -329,6 +336,7 @@ def main(argv=None) -> int:
             native=not args.no_native,
             mesh=mesh,
             gateway_addr=args.gateway_addr,
+            pipeline_inflight=args.pipeline_inflight,
         )
     except SystemExit as e:
         return int(e.code or 3)

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# One round-4 e2e serving capture: boot the full server (both edges) on the
+# default backend with a given --pipeline-inflight, drive the native C++
+# pipelined load generator against both edges, leave
+#   benchmarks/results/tpu_e2e_r4_native_pi<K>.json
+#   benchmarks/results/tpu_e2e_r4_grpcio_pi<K>.json
+# Called by benchmarks/capture_r4.py (which bounds our runtime); exits
+# nonzero if the native-edge artifact wasn't produced.
+#
+# Usage: scripts/tpu_e2e_r4.sh <pipeline_inflight>
+set -u
+K="${1:?pipeline_inflight}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT_DIR="$REPO/benchmarks/results"
+CLI="$REPO/matching_engine_tpu/native/me_client"
+LOG="$OUT_DIR/r4_capture.log"
+CLIENTS="${TPU_E2E_CLIENTS:-32}"
+PER_CLIENT="${TPU_E2E_PER_CLIENT:-2000}"
+INFLIGHT="${TPU_E2E_INFLIGHT:-8}"
+BOOT_TIMEOUT="${TPU_E2E_BOOT_TIMEOUT_S:-300}"
+
+log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] [e2e pi$K] $*" >>"$LOG"; }
+
+work=$(mktemp -d)
+PYTHONUNBUFFERED=1 PYTHONPATH="${PYTHONPATH:-}:$REPO" \
+  python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 64 --capacity 256 \
+  --batch 16 --pipeline-inflight "$K" --gateway-addr 127.0.0.1:0 \
+  >"$work/server.log" 2>&1 &
+srv=$!
+cleanup() {
+  kill -TERM "$srv" 2>/dev/null
+  sleep 5
+  kill -9 "$srv" 2>/dev/null
+}
+trap cleanup EXIT
+
+waited=0 py_port="" gw_port=""
+while [ "$waited" -lt "$BOOT_TIMEOUT" ]; do
+  py_port=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$work/server.log" | head -1)
+  gw_port=$(sed -n 's/.*native gateway on port \([0-9]*\).*/\1/p' "$work/server.log" | head -1)
+  if [ -n "$py_port" ] && [ -n "$gw_port" ]; then break; fi
+  if ! kill -0 "$srv" 2>/dev/null; then
+    log "server died during boot: $(tail -3 "$work/server.log" | tr '\n' ' ')"
+    exit 1
+  fi
+  sleep 5
+  waited=$((waited + 5))
+done
+if [ -z "$py_port" ] || [ -z "$gw_port" ]; then
+  log "server boot timed out (${BOOT_TIMEOUT}s)"
+  exit 1
+fi
+log "server up: grpcio :$py_port native :$gw_port"
+
+ok=0
+for edge_port in "native:$gw_port" "grpcio:$py_port"; do
+  edge="${edge_port%%:*}"
+  port="${edge_port##*:}"
+  out="$OUT_DIR/tpu_e2e_r4_${edge}_pi${K}.json"
+  if timeout 600 "$CLI" bench "127.0.0.1:$port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" \
+      >"$out.tmp" 2>>"$LOG"; then
+    mv "$out.tmp" "$out"
+    log "$edge edge: $(cat "$out")"
+  else
+    log "$edge edge bench failed"
+    rm -f "$out.tmp"
+    [ "$edge" = native ] && ok=1
+  fi
+done
+exit "$ok"

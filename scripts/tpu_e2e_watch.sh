@@ -1,88 +1,31 @@
 #!/usr/bin/env bash
-# E2E-edge watcher: when the axon tunnel is healthy, boot the full server on
-# the real TPU with BOTH serving edges (grpcio + C++ gateway), drive each
-# with the native pipelined load generator (me_client bench), and leave the
-# two artifacts in benchmarks/results/. Companion to scripts/tpu_watch.sh
-# (device-throughput artifact); this one captures the serving-stack
-# comparison VERDICT r2 asked for (e2e orders/sec + p50/p99 per edge).
+# E2E-edge watcher: when the axon tunnel is healthy, run one full-stack
+# serving capture (both edges) and exit. The experiment body lives in
+# scripts/tpu_e2e_r4.sh (one copy of the boot/port-discovery/bench
+# protocol); this wrapper only adds the probe loop. Superseded for
+# round-4 captures by scripts/tpu_r4_watch.sh + benchmarks/capture_r4.py,
+# which include the same experiment as steps e2e_pi2/e2e_pi4 — kept for
+# ad-hoc single runs.
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-OUT_DIR="$REPO/benchmarks/results"
-LOG="$OUT_DIR/tpu_e2e_watch.log"
-CLI="$REPO/matching_engine_tpu/native/me_client"
-mkdir -p "$OUT_DIR"
-
+LOG="$REPO/benchmarks/results/tpu_e2e_watch.log"
 INTERVAL="${TPU_WATCH_INTERVAL_S:-300}"
 PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT_S:-75}"
-BOOT_TIMEOUT="${TPU_E2E_BOOT_TIMEOUT_S:-300}"
-CLIENTS="${TPU_E2E_CLIENTS:-32}"
-PER_CLIENT="${TPU_E2E_PER_CLIENT:-2000}"
-INFLIGHT="${TPU_E2E_INFLIGHT:-8}"
 MAX_LOOPS="${TPU_WATCH_MAX_LOOPS:-200}"
+PIPELINE_INFLIGHT="${TPU_E2E_PIPELINE_INFLIGHT:-2}"
 
 log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] $*" >>"$LOG"; }
 
-run_experiment() {
-  local ts work
-  ts=$(date -u +%Y%m%dT%H%M%SZ)
-  work=$(mktemp -d)
-  # PYTHONUNBUFFERED: the port-discovery loop below greps the log; without
-  # it the '[SERVER] listening' lines sit in the stdio buffer forever.
-  PYTHONUNBUFFERED=1 PYTHONPATH="${PYTHONPATH:-}:$REPO" \
-    python -m matching_engine_tpu.server.main \
-    --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 64 --capacity 256 \
-    --batch 16 --gateway-addr 127.0.0.1:0 >"$work/server.log" 2>&1 &
-  local srv=$!
-  local waited=0 py_port="" gw_port=""
-  while [ "$waited" -lt "$BOOT_TIMEOUT" ]; do
-    py_port=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$work/server.log" | head -1)
-    gw_port=$(sed -n 's/.*native gateway on port \([0-9]*\).*/\1/p' "$work/server.log" | head -1)
-    if [ -n "$py_port" ] && [ -n "$gw_port" ]; then break; fi
-    if ! kill -0 "$srv" 2>/dev/null; then
-      log "server died during boot: $(tail -3 "$work/server.log" | tr '\n' ' ')"
-      return 1
-    fi
-    sleep 5
-    waited=$((waited + 5))
-  done
-  if [ -z "$py_port" ] || [ -z "$gw_port" ]; then
-    log "server boot timed out (${BOOT_TIMEOUT}s) — tunnel likely re-wedged"
-    kill -9 "$srv" 2>/dev/null
-    return 1
-  fi
-  log "server up: grpcio :$py_port native :$gw_port — benching"
-  local ok=0
-  if timeout 600 "$CLI" bench "127.0.0.1:$gw_port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" \
-      >"$OUT_DIR/tpu_e2e_native_${ts}.json" 2>>"$LOG"; then
-    log "native edge: $(cat "$OUT_DIR/tpu_e2e_native_${ts}.json")"
-  else
-    log "native edge bench failed"
-    rm -f "$OUT_DIR/tpu_e2e_native_${ts}.json"
-    ok=1
-  fi
-  if timeout 600 "$CLI" bench "127.0.0.1:$py_port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" \
-      >"$OUT_DIR/tpu_e2e_grpcio_${ts}.json" 2>>"$LOG"; then
-    log "grpcio edge: $(cat "$OUT_DIR/tpu_e2e_grpcio_${ts}.json")"
-  else
-    log "grpcio edge bench failed"
-    rm -f "$OUT_DIR/tpu_e2e_grpcio_${ts}.json"
-    ok=1
-  fi
-  kill -TERM "$srv" 2>/dev/null
-  sleep 5
-  kill -9 "$srv" 2>/dev/null
-  return "$ok"
-}
-
-log "e2e watcher start (interval=${INTERVAL}s clients=$CLIENTS per_client=$PER_CLIENT inflight=$INFLIGHT)"
+log "e2e watcher start (interval=${INTERVAL}s pi=$PIPELINE_INFLIGHT)"
 for _ in $(seq 1 "$MAX_LOOPS"); do
-  if timeout "$PROBE_TIMEOUT" python -c \
-      "import jax; d=jax.devices(); assert d" >>"$LOG" 2>&1; then
+  if timeout -s KILL "$PROBE_TIMEOUT" python -c \
+      "import jax; assert jax.devices()" >>"$LOG" 2>&1; then
     log "probe healthy; running e2e experiment"
-    if run_experiment; then
+    if bash "$REPO/scripts/tpu_e2e_r4.sh" "$PIPELINE_INFLIGHT" >>"$LOG" 2>&1; then
       log "e2e experiment complete"
       exit 0
     fi
+    log "e2e experiment failed; retry next interval"
   else
     log "probe unhealthy (rc=$?)"
   fi

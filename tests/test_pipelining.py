@@ -141,6 +141,55 @@ def test_book_snapshot_sees_pending_orders():
     r.finish_pending()
 
 
+def test_inflight_window_depth():
+    """pipeline_inflight=2 (default): two dispatches stay staged; the
+    third's stage finishes only the OLDEST (FIFO), not both."""
+    r = EngineRunner(CFG)
+    log: list = []
+    for label in "ABC":
+        r.dispatch_pipelined(
+            [_submit(r, f"W{label}", 1, 100, 1)], _collector(log, label))
+    # A finished when C was staged (window is 2 deep); B and C pending.
+    assert [entry[0] for entry in log] == ["A"]
+    assert len(r._pending) == 2
+    r.finish_pending()
+    assert [entry[0] for entry in log] == ["A", "B", "C"]
+    assert not r.has_pending
+
+
+def test_inflight_one_matches_old_single_slot():
+    """pipeline_inflight=1 reproduces the round-3 behavior: each dispatch
+    finishes the previous one."""
+    r = EngineRunner(CFG, pipeline_inflight=1)
+    log: list = []
+    r.dispatch_pipelined([_submit(r, "P", 1, 10, 1)], _collector(log, "A"))
+    assert log == [] and len(r._pending) == 1
+    r.dispatch_pipelined([_submit(r, "P", 1, 11, 1)], _collector(log, "B"))
+    assert [entry[0] for entry in log] == ["A"] and len(r._pending) == 1
+    r.finish_pending()
+    assert [entry[0] for entry in log] == ["A", "B"]
+
+
+def test_deep_window_cross_batch_match_stays_serial():
+    """Orders split across three staged-at-once dispatches still match as
+    the serial schedule would (device waves chain on the donated book even
+    though none has decoded)."""
+    r = EngineRunner(EngineConfig(num_symbols=4, capacity=16, batch=4,
+                                  max_fills=256), pipeline_inflight=4)
+    log: list = []
+    a = _submit(r, "D", 1, 100, 5)   # resting BUY
+    b = _submit(r, "D", 2, 100, 3)   # SELL hits it
+    c = _submit(r, "D", 2, 100, 2)   # SELL finishes it
+    for op, label in ((a, "A"), (b, "B"), (c, "C")):
+        r.dispatch_pipelined([op], _collector(log, label))
+    assert log == []                 # all three staged
+    r.finish_pending()
+    assert [entry[0] for entry in log] == ["A", "B", "C"]
+    assert log[1][1] == [(b.info.order_id, FILLED)]
+    assert log[2][1] == [(c.info.order_id, FILLED)]
+    assert a.info.status == FILLED and a.info.remaining == 0
+
+
 def test_mesh_deferral_fifo_and_outcomes():
     """Cross-dispatch deferral on a sharded runner (8-device virtual
     mesh): FIFO finish, cross-batch match outcomes identical to serial —
