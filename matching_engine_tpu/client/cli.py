@@ -86,8 +86,11 @@ def _auction(addr: str, symbol: str) -> int:
         print(f"[client] auction rejected: {resp.error_message}")
         return 3
     if symbol:
-        print(f"[client] auction {symbol}: cleared "
-              f"{resp.clearing_price}@Q4 x{resp.executed_quantity}")
+        if resp.symbols_crossed == 0:
+            print(f"[client] auction {symbol}: did not cross")
+        else:
+            print(f"[client] auction {symbol}: cleared "
+                  f"{resp.clearing_price}@Q4 x{resp.executed_quantity}")
     else:
         print(f"[client] auction: {resp.symbols_crossed} symbol(s) crossed, "
               f"{resp.executed_quantity} executed")

@@ -213,10 +213,20 @@ class EngineRunner:
         # the ledger itself is counted and the tail dropped.
         self.pending_recon: list[tuple[str, str, int]] = []
         self._recon_cap = 100_000
-        # owner_hash collision watch: hash -> first client id seen. A
-        # collision silently extends self-trade prevention across two
-        # unrelated clients, so it is counted and logged (bounded map).
-        self._owner_ids: dict[int, str] = {}
+        # Self-trade-prevention identity registry (ADVICE r3): every
+        # client id gets a COLLISION-FREE int32 owner id — owner_hash is
+        # only the first candidate; a clash probes to the next free id.
+        # Assignments persist at first sight (pending_owner_ids drains to
+        # the durable owner_ids table via flush_owner_ids, outside the
+        # dispatch lock) so identities are stable across restarts — a
+        # hash-colliding pair must not swap identities depending on
+        # post-restart arrival order while checkpointed book lanes still
+        # carry the old ints.
+        self._owner_by_client: dict[str, int] = {}
+        self._owner_claimed: dict[int, str] = {}
+        self._owner_registry_cap = 1_000_000
+        self.pending_owner_ids: list[tuple[str, int]] = []
+        self.persist_owner_ids = None  # callable(list) -> bool | None
         # Call-auction accumulation mode: while True, both serving edges
         # submit orders as OP_REST (rest without matching — books may
         # stand crossed) and MARKET orders are rejected; a RunAuction
@@ -366,6 +376,7 @@ class EngineRunner:
             result = self._run_dispatch_locked(ops)
         for p in posts:
             p()
+        self.flush_owner_ids()
         return result
 
     # -- cross-dispatch pipelining ----------------------------------------
@@ -397,6 +408,7 @@ class EngineRunner:
             self._finish_pending_locked(posts)
         for p in posts:
             p()
+        self.flush_owner_ids()
 
     def _finish_pending_locked(self, posts: list) -> None:
         """Lock held. Drains the WHOLE pending FIFO (quiesce semantics:
@@ -471,6 +483,7 @@ class EngineRunner:
                     posts.append(post)
         for p in posts:
             p()
+        self.flush_owner_ids()
 
     def _rollback_registrations(self, ops, res: DispatchResult) -> None:
         # A prep/dispatch/decode failure leaves undecoded ops maybe-applied
@@ -741,6 +754,7 @@ class EngineRunner:
             # flush_auction_mode): a sqlite busy-wait here must not stall
             # order dispatch.
             self.flush_auction_mode()
+            self.flush_owner_ids()
         return summary
 
     def _run_auction_locked(self, symbols, sink) -> dict:
@@ -1135,14 +1149,60 @@ class EngineRunner:
     # -- read-only views ---------------------------------------------------
 
     def _owner_for(self, client_id: str) -> int:
-        h = owner_hash(client_id)
-        if len(self._owner_ids) < 1_000_000:
-            prev = self._owner_ids.setdefault(h, client_id)
-            if prev != client_id:
-                self.metrics.inc("owner_hash_collisions")
-                print(f"[runner] WARNING: owner_hash collision: "
-                      f"{client_id!r} vs {prev!r} share STP identity {h}")
-        return h
+        """Collision-free STP identity for a client (called under the
+        dispatch lock). First sight assigns owner_hash when free, else
+        linear-probes to the next unclaimed id (counted + logged), and
+        queues the assignment for durable persistence."""
+        if not client_id:
+            return 0
+        owner = self._owner_by_client.get(client_id)
+        if owner is not None:
+            return owner
+        if len(self._owner_by_client) >= self._owner_registry_cap:
+            # Bounded like the pre-registry watch map: past the cap (a
+            # client-id churn attack / misconfigured id-per-order client)
+            # new ids fall back to the raw hash UNREGISTERED — collision
+            # risk returns for the overflow tail only, counted, and the
+            # registry/db stop growing.
+            self.metrics.inc("owner_registry_overflow")
+            return owner_hash(client_id)
+        owner = owner_hash(client_id)
+        if owner in self._owner_claimed:
+            self.metrics.inc("owner_hash_collisions")
+            first = self._owner_claimed[owner]
+            while owner in self._owner_claimed or owner == 0:
+                owner = (owner + 1) & 0x7FFFFFFF
+            print(f"[runner] owner_hash collision: {client_id!r} vs "
+                  f"{first!r}; remapped to {owner}")
+        self._owner_by_client[client_id] = owner
+        self._owner_claimed[owner] = client_id
+        self.pending_owner_ids.append((client_id, owner))
+        self.metrics.inc("owner_ids_assigned")  # == registry size (gauge)
+        return owner
+
+    def load_owner_ids(self, rows: list[tuple[str, int]]) -> None:
+        """Install persisted STP assignments (boot path, before any
+        dispatch/replay derives identities)."""
+        for client_id, owner in rows:
+            self._owner_by_client[client_id] = owner
+            self._owner_claimed[owner] = client_id
+
+    def flush_owner_ids(self) -> None:
+        """Drain pending first-sight assignments to the durable registry
+        (call with no engine locks held). A failed write stays queued and
+        self-heals at the next flush point, like flush_auction_mode."""
+        if not self.pending_owner_ids or self.persist_owner_ids is None:
+            return
+        batch, self.pending_owner_ids = self.pending_owner_ids, []
+        try:
+            ok = self.persist_owner_ids(batch)
+        except Exception as e:  # noqa: BLE001 — never unwind into callers
+            print(f"[runner] owner_ids persist raised: "
+                  f"{type(e).__name__}: {e}")
+            ok = False
+        if ok is False:
+            self.metrics.inc("meta_persist_failures")
+            self.pending_owner_ids = batch + self.pending_owner_ids
 
     def set_auction_mode(self, value: bool) -> None:
         """Flip the call-period flag and mark it dirty; the durable write

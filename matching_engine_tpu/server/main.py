@@ -107,9 +107,19 @@ def build_server(
         raise SystemExit(1)
 
     metrics = Metrics()
-    hub = StreamHub()
+    hub = StreamHub(metrics=metrics)
     runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
                           pipeline_inflight=pipeline_inflight)
+    # STP identity registry loads BEFORE any restore/recovery replay — the
+    # replay derives owner lanes via _owner_for, and a hash-colliding
+    # client must resolve to its persisted id, not first-arrival order.
+    owner_rows = storage.load_owner_ids()
+    if owner_rows is None:
+        print("[SERVER] WARNING: owner_ids registry unreadable — STP "
+              "identities re-derive from hashes; collision remaps may "
+              "differ from previously persisted assignments")
+        owner_rows = []
+    runner.load_owner_ids(owner_rows)
     # Fast path: restore the newest device-book snapshot and replay only the
     # post-snapshot delta from SQLite; fall back to full replay.
     ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
@@ -123,6 +133,7 @@ def build_server(
                   f"({type(e).__name__}: {e}); full replay")
             runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
                                   pipeline_inflight=pipeline_inflight)
+            runner.load_owner_ids(owner_rows)
             ckpt = None
     if ckpt is None:
         recovered = recover_books(runner, storage)
@@ -153,6 +164,8 @@ def build_server(
     runner.persist_auction_mode = (
         lambda v: storage.set_meta("auction_mode", "1" if v else "0"))
     runner.persist_auction_mode(runner.auction_mode)
+    runner.persist_owner_ids = storage.insert_owner_ids
+    runner.flush_owner_ids()  # assignments derived during recovery replay
 
     from matching_engine_tpu import native as me_native
 

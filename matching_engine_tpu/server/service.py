@@ -220,11 +220,25 @@ class MatchingEngineService(MatchingEngineServicer):
 
     # -- streams -----------------------------------------------------------
 
+    def _stream_alive(self, context, sub):
+        """Event-driven termination when the transport supports it: the
+        gRPC context callback fires on client hangup and unsubscribe's
+        sentinel wakes the blocked generator — no aliveness polling (idle
+        subscriber threads sleep in get() instead of waking 4x/s).
+        Returns the `alive` argument for sub.stream(): None (block) when
+        the callback registered, else the context's poll (the native
+        gateway's duck-typed context has no add_callback)."""
+        register = getattr(context, "add_callback", None)
+        if register is not None and register(
+                lambda: self.hub.unsubscribe(sub)):
+            return None
+        return context.is_active
+
     def StreamMarketData(self, request, context):
         self.metrics.inc("rpc_stream_md")
         sub = self.hub.subscribe_market_data(request.symbol)
         try:
-            yield from sub.stream(alive=context.is_active)
+            yield from sub.stream(alive=self._stream_alive(context, sub))
         finally:
             self.hub.unsubscribe(sub)
 
@@ -232,7 +246,7 @@ class MatchingEngineService(MatchingEngineServicer):
         self.metrics.inc("rpc_stream_ou")
         sub = self.hub.subscribe_order_updates(request.client_id)
         try:
-            yield from sub.stream(alive=context.is_active)
+            yield from sub.stream(alive=self._stream_alive(context, sub))
         finally:
             self.hub.unsubscribe(sub)
 
@@ -264,11 +278,17 @@ class MatchingEngineService(MatchingEngineServicer):
         crossed = summary["crossed"]
         total = sum(q for _, _, q in crossed)
         price = crossed[0][1] if symbol is not None and crossed else 0
+        note = summary.get("warning", "")
+        if symbol is not None and not crossed and not note:
+            # Explicit no-cross signal (ADVICE r3): success=true with
+            # clearing_price=0 x0 was indistinguishable from a
+            # tiny-but-real clear; say so on the success channel.
+            note = f"book for {symbol} did not cross; nothing executed"
         return pb2.AuctionResponse(
             success=True,
             # A mesh partial abort is a success with a warning: the
             # overflowing shard's symbols are untouched, the rest cleared.
-            error_message=summary.get("warning", ""),
+            error_message=note,
             clearing_price=price,
             executed_quantity=total,
             symbols_crossed=len(crossed),

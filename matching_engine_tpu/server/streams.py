@@ -6,12 +6,24 @@ real: the dispatcher publishes each dispatch's market-data and order-update
 events into per-subscriber bounded queues; stream handlers drain their queue
 until the client hangs up. Slow consumers lose oldest events (bounded queue,
 drop-oldest) rather than stalling the engine.
+
+Delivery is event-driven end to end: queue.Queue wakes a blocked get() from
+put() via its condition variable (sub-ms publish->yield, pinned by
+tests/test_metrics.py::test_stream_latency_metric_and_wakeup), and stream
+termination rides the gRPC context callback (service.py add_callback ->
+unsubscribe -> sentinel) rather than an aliveness poll — an idle subscriber
+thread sleeps in get() indefinitely instead of waking 4x/s. The optional
+`alive` polling path remains for callers without a termination callback.
+
+Every published event is stamped at offer() and measured at yield:
+stream_latency_us_p50/_p99 in GetMetrics is the publish->yield figure.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from matching_engine_tpu.proto import pb2
 
@@ -19,13 +31,15 @@ _SENTINEL = object()
 
 
 class _Subscription:
-    def __init__(self, maxsize: int):
+    def __init__(self, maxsize: int, metrics=None):
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._metrics = metrics
 
     def offer(self, item) -> None:
+        entry = (time.perf_counter(), item)
         while True:
             try:
-                self.q.put_nowait(item)
+                self.q.put_nowait(entry)
                 return
             except queue.Full:
                 try:
@@ -33,15 +47,25 @@ class _Subscription:
                 except queue.Empty:
                     pass
 
-    def stream(self, alive=lambda: True):
-        """Yield events until closed; `alive` is polled between events."""
-        while alive():
+    def stream(self, alive=None):
+        """Yield events until closed.
+
+        With `alive=None` (the gRPC path) the generator blocks in get()
+        until an event or the close() sentinel arrives — termination is
+        the service layer's context callback calling unsubscribe(). A
+        callable `alive` is polled every 0.25s instead, for callers with
+        no termination hook."""
+        while alive is None or alive():
             try:
-                item = self.q.get(timeout=0.25)
+                t_pub, item = self.q.get(
+                    timeout=None if alive is None else 0.25)
             except queue.Empty:
                 continue
             if item is _SENTINEL:
                 return
+            if self._metrics is not None:
+                self._metrics.observe(
+                    "stream_latency_us", (time.perf_counter() - t_pub) * 1e6)
             yield item
 
     def close(self) -> None:
@@ -49,9 +73,10 @@ class _Subscription:
 
 
 class StreamHub:
-    def __init__(self, maxsize: int = 1024):
+    def __init__(self, maxsize: int = 1024, metrics=None):
         self._lock = threading.Lock()
         self._maxsize = maxsize
+        self._metrics = metrics
         self._md_subs: dict[str, list[_Subscription]] = {}      # symbol ->
         self._ou_subs: dict[str, list[_Subscription]] = {}      # client_id ->
 
@@ -68,13 +93,13 @@ class StreamHub:
         return bool(self._ou_subs)
 
     def subscribe_market_data(self, symbol: str) -> _Subscription:
-        sub = _Subscription(self._maxsize)
+        sub = _Subscription(self._maxsize, self._metrics)
         with self._lock:
             self._md_subs.setdefault(symbol, []).append(sub)
         return sub
 
     def subscribe_order_updates(self, client_id: str) -> _Subscription:
-        sub = _Subscription(self._maxsize)
+        sub = _Subscription(self._maxsize, self._metrics)
         with self._lock:
             self._ou_subs.setdefault(client_id, []).append(sub)
         return sub

@@ -76,6 +76,15 @@ CREATE TABLE IF NOT EXISTS recon (
     ts         INTEGER NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_recon_order ON recon (order_id);
+-- Self-trade-prevention identity registry: every client id's assigned
+-- int32 owner id, persisted at first sight so the assignment is stable
+-- across restarts (collision-free by the UNIQUE constraint — a crc32
+-- hash collision gets a probed, remapped id; ADVICE r3). The device book
+-- lanes and checkpoints carry these ints.
+CREATE TABLE IF NOT EXISTS owner_ids (
+    client_id TEXT PRIMARY KEY,
+    owner     INTEGER NOT NULL UNIQUE CHECK (owner > 0)
+);
 """
 
 
@@ -130,6 +139,61 @@ class Storage:
         except Exception as e:  # noqa: BLE001
             print(f"[storage] get_meta failed: {e}")
             return None
+
+    def load_owner_ids(self) -> list[tuple[str, int]] | None:
+        """All persisted (client_id, owner) STP assignments. Never throws;
+        a read FAILURE returns None (distinct from an empty registry) so
+        the caller can warn that identities will re-derive."""
+        if self._conn is None:
+            return None
+        try:
+            with self._lock:
+                rows = self._conn.execute(
+                    "SELECT client_id, owner FROM owner_ids").fetchall()
+            return [(r[0], int(r[1])) for r in rows]
+        except Exception as e:  # noqa: BLE001
+            print(f"[storage] load_owner_ids failed: {e}")
+            return None
+
+    def insert_owner_ids(self, rows: list[tuple[str, int]]) -> bool:
+        """Persist first-sight STP assignments (one txn). OR IGNORE makes
+        a replayed assignment after crash-and-restore a no-op, but each
+        row is then READ BACK: an ignored insert that left a DIFFERENT
+        owner for the client (or the owner claimed by another client —
+        UNIQUE(owner)) is in-memory/durable divergence, warned loudly.
+        Returns True when every row landed or already matched (divergence
+        warns but returns True — a retry cannot heal it); False only on a
+        write failure worth retrying."""
+        if self._conn is None or not rows:
+            return self._conn is not None
+        conflicts = []
+        try:
+            with self._lock:
+                self._conn.execute("BEGIN")
+                for client_id, owner in rows:
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO owner_ids(client_id, owner) "
+                        "VALUES(?, ?)", (client_id, owner))
+                    got = self._conn.execute(
+                        "SELECT owner FROM owner_ids WHERE client_id = ?",
+                        (client_id,)).fetchone()
+                    if got is None or int(got[0]) != owner:
+                        conflicts.append(
+                            (client_id, owner,
+                             None if got is None else int(got[0])))
+                self._conn.commit()
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._conn.rollback()
+            except Exception:  # noqa: BLE001
+                pass
+            print(f"[storage] insert_owner_ids failed: {e}")
+            return False
+        for client_id, owner, durable in conflicts:
+            print(f"[storage] WARNING: owner_ids divergence for "
+                  f"{client_id!r}: in-memory {owner} vs durable {durable} "
+                  f"— restart will use the durable id")
+        return True
 
     def set_meta(self, key: str, value: str) -> bool:
         if self._conn is None:

@@ -218,7 +218,6 @@ def _rebuild_owner_lanes(runner) -> None:
     reconstructs owners naturally from the persisted client ids."""
     import jax
 
-    from matching_engine_tpu.domain.order import owner_hash
     from matching_engine_tpu.parallel import hostlocal
 
     book = runner.book
@@ -227,7 +226,11 @@ def _rebuild_owner_lanes(runner) -> None:
                       hostlocal.local_block(book.ask_owner)[0]).any())
     if has_owners:
         return  # snapshot already carried owners
-    owners = {h: owner_hash(i.client_id)
+    # Identities via the runner's registry, NOT raw owner_hash: a
+    # hash-collision-remapped client must get its persisted id here too,
+    # or its rebuilt lane would alias the colliding client's STP identity
+    # (the registry loads before restore — build_server ordering).
+    owners = {h: runner._owner_for(i.client_id)
               for h, i in runner.orders_by_handle.items()}
     if not owners:
         return
@@ -478,6 +481,12 @@ class CheckpointDaemon:
         with self.runner._dispatch_lock:
             self.runner._finish_pending_locked(posts)
             self.sink.flush()
+            # Owner registry joins the durability barrier: the snapshot's
+            # book lanes carry assigned owner ints, so any assignment still
+            # queued (e.g. an earlier sqlite-busy flush failure) must be
+            # durable BEFORE the snapshot that freezes those ints — a
+            # restore would otherwise re-derive different ids.
+            self.runner.flush_owner_ids()
             self._reconcile_durability_locked()
             save_checkpoint(path, self.runner)
         for p in posts:  # client completions, outside the engine lock

@@ -744,6 +744,8 @@ int do_auction(const std::string& addr, const std::string& symbol) {
     std::printf("[client] auction: %d symbol(s) crossed, %lld executed\n",
                 resp.symbols_crossed(),
                 static_cast<long long>(resp.executed_quantity()));
+  } else if (resp.symbols_crossed() == 0) {
+    std::printf("[client] auction %s: did not cross\n", symbol.c_str());
   } else {
     std::printf("[client] auction %s: cleared %lld@Q4 x%lld\n",
                 symbol.c_str(),

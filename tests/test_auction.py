@@ -677,3 +677,48 @@ def test_auction_rpc_full_abort_maps_to_failure(tmp_path):
         assert parts["runner"].auction_mode
     finally:
         shutdown(server, parts)
+
+
+def test_auction_no_cross_is_signaled(tmp_path):
+    """A single-symbol RunAuction whose book cannot cross returns
+    success=true with an explicit note (ADVICE r3: '0@Q4 x0' alone was
+    indistinguishable from a tiny real clear)."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "nocross.db"), cfg, window_ms=1.0,
+        log=False)
+    # Call period open: submits REST (a crossing pair must stand crossed
+    # until the uncross, not match continuously at submit time).
+    parts["runner"].auction_mode = True
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    try:
+        # Non-crossing book: bid 100 < ask 105.
+        for who, side, price in (("b", pb2.BUY, 100), ("a", pb2.SELL, 105)):
+            r = stub.SubmitOrder(
+                pb2.OrderRequest(client_id=who, symbol="NC", side=side,
+                                 order_type=pb2.LIMIT, price=price, scale=4,
+                                 quantity=5), timeout=15)
+            assert r.success, r.error_message
+        resp = stub.RunAuction(pb2.AuctionRequest(symbol="NC"), timeout=30)
+        assert resp.success
+        assert resp.symbols_crossed == 0 and resp.executed_quantity == 0
+        assert "did not cross" in resp.error_message
+        # A crossing book clears WITHOUT the note.
+        for who, side, price in (("b2", pb2.BUY, 106), ("a2", pb2.SELL, 104)):
+            r = stub.SubmitOrder(
+                pb2.OrderRequest(client_id=who, symbol="NC2", side=side,
+                                 order_type=pb2.LIMIT, price=price, scale=4,
+                                 quantity=5), timeout=15)
+            assert r.success, r.error_message
+        resp2 = stub.RunAuction(pb2.AuctionRequest(symbol="NC2"), timeout=30)
+        assert resp2.success and resp2.symbols_crossed == 1
+        assert "did not cross" not in resp2.error_message
+    finally:
+        shutdown(server, parts)

@@ -35,3 +35,48 @@ def test_timer_feeds_both_ema_and_histogram():
     _, gauges = m.snapshot()
     assert "t_us" in gauges
     assert "t_us_p50" in gauges and "t_us_p99" in gauges
+
+
+def test_stream_latency_metric_and_wakeup():
+    """Event-driven fanout (VERDICT r3 next-step 8): an IDLE subscriber
+    wakes on publish without an aliveness poll, the publish->yield
+    latency lands in stream_latency_us_p50/_p99, and the close sentinel
+    terminates a blocked generator promptly."""
+    import threading
+    import time
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.server.streams import StreamHub
+
+    m = Metrics()
+    hub = StreamHub(metrics=m)
+    sub = hub.subscribe_market_data("X")
+    got: list[tuple[float, object]] = []
+    done = threading.Event()
+
+    def consume():
+        for item in sub.stream():           # alive=None: blocking get
+            got.append((time.perf_counter(), item))
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)                          # subscriber genuinely idle
+    t_pub = time.perf_counter()
+    hub.publish_market_data([pb2.MarketDataUpdate(symbol="X", best_bid=1)])
+    for _ in range(200):
+        if got:
+            break
+        time.sleep(0.005)
+    assert got, "idle subscriber never woke on publish"
+    wake_ms = (got[0][0] - t_pub) * 1e3
+    # Sub-ms in practice; 100ms bound keeps CI immune to scheduler noise
+    # while still far below the old 250ms poll quantum.
+    assert wake_ms < 100, f"wakeup took {wake_ms:.1f}ms"
+    _, gauges = m.snapshot()
+    assert "stream_latency_us_p50" in gauges
+    assert gauges["stream_latency_us_p50"] < 100_000
+    t_close = time.perf_counter()
+    hub.unsubscribe(sub)
+    assert done.wait(timeout=1.0), "close sentinel did not wake the stream"
+    assert (time.perf_counter() - t_close) < 0.5

@@ -208,16 +208,94 @@ def test_stp_through_server_and_recovery(tmp_path):
         shutdown(server2, parts2)
 
 
-def test_owner_hash_collision_is_detected():
-    """Two client ids forced onto one hash: the runner counts and logs the
-    collision (STP would otherwise silently couple unrelated clients)."""
+def test_owner_hash_collision_remaps_to_distinct_id():
+    """Two client ids forced onto one hash get DISTINCT STP identities
+    (ADVICE r3: a collision must not silently couple unrelated clients —
+    the newer client is remapped to the next free id, counted, and the
+    assignment queued for persistence)."""
     from matching_engine_tpu.server.engine_runner import EngineRunner
 
     r = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
                                   max_fills=64))
     h = r._owner_for("alice")
-    # Simulate a colliding id by priming the watch map directly.
-    r._owner_ids[owner_hash("mallory")] = "someone-else"
-    r._owner_for("mallory")
+    # Simulate a colliding id by priming the registry directly.
+    r._owner_claimed[owner_hash("mallory")] = "someone-else"
+    m = r._owner_for("mallory")
     assert r.metrics.snapshot()[0].get("owner_hash_collisions", 0) == 1
     assert h == owner_hash("alice")
+    assert m != owner_hash("mallory") and m != h and m > 0
+    # Stable on re-lookup, and both assignments queued for the registry.
+    assert r._owner_for("mallory") == m
+    assert ("alice", h) in r.pending_owner_ids
+    assert ("mallory", m) in r.pending_owner_ids
+
+
+def test_owner_registry_survives_restart(tmp_path):
+    """Persisted assignments win over arrival order: a client remapped in
+    one process keeps its id in the next, even when the colliding client
+    arrives first after the restart."""
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+    from matching_engine_tpu.storage.storage import Storage
+
+    db = str(tmp_path / "owners.db")
+    st = Storage(db)
+    assert st.init()
+
+    r1 = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
+                                   max_fills=64))
+    r1.persist_owner_ids = st.insert_owner_ids
+    a = r1._owner_for("alice")
+    r1._owner_claimed[owner_hash("mallory")] = "alice-colliding-sim"
+    m = r1._owner_for("mallory")
+    r1.flush_owner_ids()
+    assert r1.pending_owner_ids == []
+
+    # "Restart": fresh runner, registry loaded from the durable store;
+    # mallory arrives FIRST this time but must keep the remapped id.
+    r2 = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
+                                   max_fills=64))
+    r2.load_owner_ids(st.load_owner_ids())
+    assert r2._owner_for("mallory") == m
+    assert r2._owner_for("alice") == a
+    st.close()
+
+
+def test_rebuild_owner_lanes_uses_registry_not_raw_hash():
+    """Pre-owner-snapshot migration (checkpoint._rebuild_owner_lanes) must
+    derive lanes through the runner's registry: a hash-collision-remapped
+    client's rebuilt lane carries the REMAPPED id, not owner_hash (which
+    would alias the colliding client's STP identity)."""
+    import numpy as np
+
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+    from matching_engine_tpu.utils.checkpoint import _rebuild_owner_lanes
+
+    r = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
+                                  max_fills=64))
+    # Force mallory onto a remapped id before any order exists.
+    r._owner_claimed[owner_hash("mallory")] = "other-client"
+    remapped = r._owner_for("mallory")
+    assert remapped != owner_hash("mallory")
+
+    assert r.slot_acquire("RB") is not None
+    num, oid = r.assign_oid()
+    from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+    op = EngineOp(0 + 1, OrderInfo(  # OP_SUBMIT
+        oid=num, order_id=oid, client_id="mallory", symbol="RB", side=1,
+        otype=0, price_q4=100, quantity=5, remaining=5, status=0,
+        handle=r.assign_handle()))
+    r.run_dispatch([op])
+
+    # Simulate a pre-owner snapshot: zero the owner lanes.
+    import jax
+
+    book = jax.tree.map(lambda x: np.asarray(x).copy(), r.book)
+    book = book._replace(bid_owner=np.zeros_like(book.bid_owner),
+                         ask_owner=np.zeros_like(book.ask_owner))
+    r.place_book(book)
+    _rebuild_owner_lanes(r)
+
+    bid_owner = np.asarray(r.book.bid_owner)
+    bid_qty = np.asarray(r.book.bid_qty)
+    lanes = bid_owner[bid_qty > 0]
+    assert lanes.tolist() == [remapped]
