@@ -13,8 +13,10 @@ Steps (priority order — most valuable first when the window is short):
   syms64/256/1024 symbol-count sweep (next-step 7; 4096 = headline)
   cap256/512/1024 capacity sweep at S=256 (next-step 4; cap128 row too,
                   so the curve is same-S end to end)
-  ...later steps appended as their code lands (profile, runner-level,
-  l3flow, e2e sweep).
+  runner_sweep    RPC-less EngineRunner inflight sweep (next-step 2)
+  e2e_pi2/pi4     full-stack dual-edge serving at pipeline inflight 2/4
+  l3flow          config-3b realistic flow + reject/depth stats (step 6)
+  profile         kernel phase breakdown + roofline + device trace (3)
 
 Exit codes: 0 = all steps done, 10 = some steps still missing (watcher
 retries next window), 1 = unexpected driver error.
@@ -107,10 +109,18 @@ STEPS: list[dict] = [
     {"name": "e2e_pi4", "artifact": "tpu_e2e_r4_native_pi4.json",
      "timeout": 1500,
      "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"]},
+    # Config-3b: realistic L3 flow (power-law/bursts/deep books) with
+    # reject + overflow + depth statistics (VERDICT r3 next-step 6).
+    {"name": "l3flow", "artifact": "tpu_r4_l3flow.json", "timeout": 1500,
+     "cmd": [PY, os.path.join(REPO, "benchmarks", "flow_bench.py"),
+             "--json-out", os.path.join(RESULTS, "tpu_r4_l3flow.json")]},
+    # Kernel efficiency story: phase breakdown + cost-analysis roofline +
+    # device trace (VERDICT r3 next-step 3).
+    {"name": "profile", "artifact": "tpu_r4_profile.json", "timeout": 1500,
+     "cmd": [PY, os.path.join(REPO, "benchmarks", "profile_kernel.py"),
+             "--json-out", os.path.join(RESULTS, "tpu_r4_profile.json"),
+             "--trace-dir", os.path.join(RESULTS, "profile_r4")]},
 ]
-
-# Later steps (profile, runner-level, l3flow, e2e) are appended to STEPS
-# directly as their code lands; the watcher picks them up next window.
 
 
 def _run_bounded(cmd: list[str], timeout: float, stdout_f) -> tuple:

@@ -1,0 +1,118 @@
+"""Config-3b: L3-REALISTIC flow benchmark (VERDICT r3 next-step 6).
+
+Same measurement methodology as the headline config 3 (utils/measure.py —
+host-side op counting, synced median windows) but over engine/flow.py's
+power-law/burst/deep-book streams, PLUS a separate decoded statistics pass
+(apply_orders replay — never inside the timed windows, a decode readback
+collapses the tunnel pipeline) reporting the flow-health figures the
+uniform benchmark can't see: side-full reject rate, fill-overflow, fills
+per op, and resting depth at end of replay.
+
+Usage: python benchmarks/flow_bench.py --json-out out.json
+       [--symbols 4096] [--capacity 128] [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--symbols", type=int, default=4096)
+    p.add_argument("--capacity", type=int, default=128)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--windows", type=int, default=5)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--json-out", required=True)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    cache_dir = os.environ.get(
+        "ME_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    platform = devices[0].platform
+    backend_init_s = time.perf_counter() - t0
+
+    from matching_engine_tpu.engine.book import EngineConfig, init_book
+    from matching_engine_tpu.engine.flow import realistic_order_stream
+    from matching_engine_tpu.engine.harness import apply_orders, snapshot_books
+    from matching_engine_tpu.engine.kernel import OP_SUBMIT, REJECTED
+    from matching_engine_tpu.utils.measure import measure_device_throughput
+
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
+                       batch=args.batch, max_fills=1 << 17)
+    streams = [
+        realistic_order_stream(args.symbols, 4 * args.symbols * args.batch,
+                               seed=w)
+        for w in range(4)
+    ]
+    value, lat_us = measure_device_throughput(
+        cfg, streams, windows=args.windows, iters=args.iters)
+
+    # Decoded statistics pass — OUTSIDE the timed windows, fresh book.
+    stats_stream = streams[0]
+    book = init_book(cfg)
+    book, results, fills = apply_orders(cfg, book, stats_stream)
+    submits = sum(1 for o in stats_stream if o.op == OP_SUBMIT)
+    rejects = sum(1 for r in results if r.status == REJECTED
+                  and r.filled == 0 and r.remaining > 0)
+    snaps = snapshot_books(book)
+    depths = [len(b) + len(a) for b, a in snaps]
+    depths.sort()
+
+    try:
+        import subprocess
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        rev = "unknown"
+    out = {
+        "metric": "l3_realistic_throughput",
+        "value": round(value, 1),
+        "unit": "orders/sec",
+        "vs_baseline": round(value / 10_000_000, 4),
+        "platform": platform,
+        "n_devices": len(devices),
+        "symbols": args.symbols,
+        "capacity": args.capacity,
+        "batch": args.batch,
+        "backend_init_s": round(backend_init_s, 1),
+        "mean_dispatch_latency_us": round(lat_us, 1),
+        "flow": "power-law+bursts+deep-books (engine/flow.py defaults)",
+        "stats_ops": len(stats_stream),
+        "side_full_reject_rate": round(rejects / max(1, submits), 5),
+        "fills_per_op": round(len(fills) / len(stats_stream), 4),
+        "resting_depth_p50": depths[len(depths) // 2],
+        "resting_depth_max": depths[-1],
+        "git_rev": rev,
+    }
+    tmp = args.json_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, args.json_out)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,222 @@
+"""Kernel efficiency story (VERDICT r3 next-step 3): device trace +
+per-phase device-time breakdown + bytes/op roofline for the headline
+config, so the measured orders/sec is EXPLAINED, not just measured.
+
+Three independent evidence sources, all in one artifact:
+
+1. **Per-phase timing**: the full engine step vs its two phases jitted
+   separately — the vmap×scan match loop (the O(CAP^2) priority matrix)
+   and the finalize epilogue (fill compaction + top-of-book). Synced
+   median windows, same methodology as every other bench here.
+2. **XLA cost analysis** of the compiled full step: flops + bytes
+   accessed per step, giving bytes/op and achieved HBM bandwidth at the
+   measured step latency — the roofline coordinate. (v5e reference peak:
+   ~819 GB/s HBM per chip, the usual bound for int32 vector work; the
+   MXU plays no part in this integer kernel by design.)
+3. **jax.profiler device trace** of a short annotated run (TensorBoard-
+   loadable, checked in under profile_r4/) — best-effort: a tunneled
+   backend may refuse tracing; the breakdown above stands alone.
+
+Usage: python benchmarks/profile_kernel.py --json-out out.json
+       [--symbols 4096] [--capacity 128] [--batch 32] [--trace-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_HBM_PEAK_GBPS = 819.0  # public v5e spec: ~819 GB/s HBM BW per chip
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--symbols", type=int, default=4096)
+    p.add_argument("--capacity", type=int, default=128)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--windows", type=int, default=4)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--trace-dir", default=None)
+    p.add_argument("--json-out", required=True)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = os.environ.get(
+        "ME_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    platform = devices[0].platform
+    backend_init_s = time.perf_counter() - t0
+
+    from matching_engine_tpu.engine.book import (
+        BookBatch,
+        EngineConfig,
+        init_book,
+    )
+    from matching_engine_tpu.engine.kernel import (
+        _SymBook,
+        _sym_scan,
+        engine_step,
+        finalize_step,
+    )
+    from matching_engine_tpu.utils.measure import (
+        headline_streams,
+        prepare_waves,
+    )
+
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
+                       batch=args.batch, max_fills=1 << 17)
+    waves, wave_ops = prepare_waves(cfg, headline_streams(cfg, n_streams=2))
+    ops_per_step = wave_ops[0]
+
+    def timed(fn, *a, n_args_donated=0):
+        """Median synced per-call latency (µs) over windows of iters."""
+        out = fn(*a)
+        jax.block_until_ready(out)
+        lats = []
+        for _ in range(args.windows):
+            t1 = time.perf_counter()
+            for _ in range(args.iters):
+                out = fn(*a)
+            jax.block_until_ready(out)
+            lats.append((time.perf_counter() - t1) / args.iters * 1e6)
+        lats.sort()
+        return lats[len(lats) // 2], out
+
+    # -- phase 1: the vmap x scan match loop only (no epilogue) ------------
+    def scan_only(book: BookBatch, orders):
+        sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+        new_sym_book, outs = jax.vmap(_sym_scan)(sym_book, orders)
+        new_book = BookBatch(*new_sym_book[:-1],
+                             next_seq=new_sym_book.next_seq)
+        return new_book, outs
+
+    scan_jit = jax.jit(scan_only)
+    book = init_book(cfg)
+    scan_us, (scanned_book, scan_outs) = timed(scan_jit, book, waves[0])
+
+    # -- phase 2: finalize epilogue (fill compaction + top-of-book) --------
+    finalize_jit = jax.jit(finalize_step, static_argnums=0)
+    status, filled, remaining, f_oid, f_qty, f_price = scan_outs
+    fin_us, _ = timed(finalize_jit, cfg, scanned_book, waves[0], status,
+                      filled, remaining, f_oid, f_qty, f_price)
+
+    # -- full step (the real entry point, donated book) --------------------
+    full_book = init_book(cfg)
+    full = None
+    full_lats = []
+    b = full_book
+    out = None
+    b, out = engine_step(cfg, b, waves[0])
+    jax.block_until_ready(out)
+    for _ in range(args.windows):
+        t1 = time.perf_counter()
+        for i in range(args.iters):
+            b, out = engine_step(cfg, b, waves[i % len(waves)])
+        jax.block_until_ready(out)
+        full_lats.append((time.perf_counter() - t1) / args.iters * 1e6)
+    full_lats.sort()
+    full_us = full_lats[len(full_lats) // 2]
+
+    # -- XLA cost analysis -------------------------------------------------
+    cost: dict = {}
+    try:
+        lowered = jax.jit(
+            lambda bb, oo: engine_step.__wrapped__(cfg, bb, oo)
+        ).lower(init_book(cfg), waves[0])
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        cost = {k: float(v) for k, v in ca.items()
+                if k in ("flops", "bytes accessed")}
+    except Exception as e:  # noqa: BLE001 — cost analysis is optional
+        cost = {"error": f"{type(e).__name__}: {e}"}
+
+    bytes_per_step = cost.get("bytes accessed")
+    roofline = {}
+    if bytes_per_step:
+        achieved_gbps = bytes_per_step / (full_us / 1e6) / 1e9
+        roofline = {
+            "bytes_per_step": bytes_per_step,
+            "bytes_per_op": round(bytes_per_step / ops_per_step, 1),
+            "achieved_hbm_gbps": round(achieved_gbps, 1),
+            "hbm_peak_gbps": V5E_HBM_PEAK_GBPS,
+            "fraction_of_hbm_peak": round(
+                achieved_gbps / V5E_HBM_PEAK_GBPS, 3),
+        }
+
+    # -- best-effort device trace -----------------------------------------
+    trace_note = "skipped (no --trace-dir)"
+    if args.trace_dir:
+        try:
+            from matching_engine_tpu.utils.tracing import (
+                step_annotation,
+                trace,
+            )
+
+            os.makedirs(args.trace_dir, exist_ok=True)
+            with trace(args.trace_dir):
+                for i in range(5):
+                    with step_annotation("engine_step", i):
+                        b, out = engine_step(cfg, b, waves[i % len(waves)])
+                jax.block_until_ready(out)
+            names = []
+            for root, _, files in os.walk(args.trace_dir):
+                names += [os.path.join(os.path.relpath(root, args.trace_dir),
+                                       f) for f in files]
+            trace_note = f"captured {len(names)} file(s)"
+        except Exception as e:  # noqa: BLE001
+            trace_note = f"trace failed: {type(e).__name__}: {e}"
+
+    try:
+        import subprocess
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        rev = "unknown"
+
+    out_row = {
+        "metric": "kernel_profile",
+        "platform": platform,
+        "symbols": args.symbols,
+        "capacity": args.capacity,
+        "batch": args.batch,
+        "backend_init_s": round(backend_init_s, 1),
+        "ops_per_step": ops_per_step,
+        "full_step_us": round(full_us, 1),
+        "orders_per_s": round(ops_per_step / (full_us / 1e6), 1),
+        "phase_scan_us": round(scan_us, 1),
+        "phase_finalize_us": round(fin_us, 1),
+        "phase_sum_vs_full": round((scan_us + fin_us) / full_us, 3),
+        "cost_analysis": cost,
+        "roofline": roofline,
+        "device_trace": trace_note,
+        "git_rev": rev,
+    }
+    tmp = args.json_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out_row, f, indent=1)
+    os.replace(tmp, args.json_out)
+    print(json.dumps(out_row))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ import importlib.util
 import os
 import pathlib
 import socket
+import signal
 import subprocess
 import sys
 import time
@@ -47,10 +48,11 @@ def _wait_port(port: int, proc, stderr_path, timeout_s: float = 90.0) -> None:
         f"server on :{port} never came up:\n" + stderr_path.read_text()[-2000:])
 
 
-def test_sigkill_midload_then_restart_audits_clean(tmp_path):
-    db = str(tmp_path / "crash.db")
-    # OS-assigned free port (the subprocess boundary forbids :0 directly;
-    # a fixed port would collide spuriously under parallel test runs).
+def _spawn_server(tmp_path, db: str, *extra_args: str):
+    """One copy of the CPU server-subprocess spawn recipe (OS-assigned
+    free port — the subprocess boundary forbids :0 directly; env scrubbed
+    of the TPU tunnel so the test can never touch it). Returns
+    (proc, port, stderr_path); callers own waiting and cleanup."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -63,10 +65,16 @@ def test_sigkill_midload_then_restart_audits_clean(tmp_path):
         [sys.executable, "-m", "matching_engine_tpu.server.main",
          "--addr", f"127.0.0.1:{port}", "--db", db,
          "--symbols", "8", "--capacity", "16", "--batch", "4",
-         "--window-ms", "1"],
+         "--window-ms", "1", *extra_args],
         env=env, cwd=REPO,
         stdout=subprocess.DEVNULL, stderr=stderr_path.open("w"),
     )
+    return proc, port, stderr_path
+
+
+def test_sigkill_midload_then_restart_audits_clean(tmp_path):
+    db = str(tmp_path / "crash.db")
+    proc, port, stderr_path = _spawn_server(tmp_path, db)
     try:
         _wait_port(port, proc, stderr_path)
         ch = grpc.insecure_channel(f"127.0.0.1:{port}")
@@ -141,3 +149,34 @@ def test_sigkill_midload_then_restart_audits_clean(tmp_path):
     finally:
         shutdown(server, parts)
         store.close()
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    """--profile-dir produces a non-empty jax.profiler trace for a real
+    serving run (VERDICT r3 next-step 9: tracing was mechanism-only — no
+    test ever exercised the flag)."""
+    db = str(tmp_path / "prof.db")
+    trace_dir = tmp_path / "trace"
+    proc, port, stderr_path = _spawn_server(
+        tmp_path, db, "--profile-dir", str(trace_dir))
+    try:
+        _wait_port(port, proc, stderr_path)
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(ch)
+        for i in range(5):
+            r = stub.SubmitOrder(pb2.OrderRequest(
+                client_id="p", symbol="PRF", order_type=pb2.LIMIT,
+                side=pb2.BUY, price=10_000 + i, scale=4, quantity=1),
+                timeout=60)
+            assert r.success
+        ch.close()
+        # Graceful drain: stop_trace runs on the shutdown path.
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0, stderr_path.read_text()[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    files = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
+    assert files, "trace directory is empty"
+    assert sum(os.path.getsize(f) for f in files) > 0

@@ -1,0 +1,77 @@
+"""Realistic L3 flow generator (engine/flow.py): shape properties + the
+kernel/oracle parity gate on its output (the config-3b benchmark flow must
+match the oracle exactly, same as the uniform flow)."""
+
+from collections import Counter
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.flow import realistic_order_stream
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_SUBMIT
+
+from tests.test_kernel_parity import assert_parity
+
+
+def test_deterministic_per_seed():
+    a = realistic_order_stream(64, 2000, seed=7)
+    b = realistic_order_stream(64, 2000, seed=7)
+    c = realistic_order_stream(64, 2000, seed=8)
+    assert a == b
+    assert a != c
+
+
+def test_power_law_concentration():
+    """Zipf head dominance: the top 10% of symbols carry well over half
+    the flow (uniform flow would give them ~10%)."""
+    stream = realistic_order_stream(128, 20_000, seed=1)
+    counts = Counter(o.sym for o in stream)
+    top = sum(c for _, c in counts.most_common(13))
+    assert top / len(stream) > 0.5
+    # ... and the tail still participates (not a degenerate single symbol).
+    assert len(counts) > 64
+
+
+def test_bursts_cluster_symbol_runs():
+    """With bursts enabled, long same-burst-pool runs exist: count windows
+    of 30 consecutive ops hitting <= 5 distinct symbols (vanishingly rare
+    under independent Zipf draws at S=512 head-spread, common in bursts)."""
+    stream = realistic_order_stream(512, 30_000, seed=3, burst_p=0.01)
+    syms = [o.sym for o in stream]
+    clustered = sum(
+        1 for i in range(0, len(syms) - 30, 30)
+        if len(set(syms[i:i + 30])) <= 5
+    )
+    no_burst = realistic_order_stream(512, 30_000, seed=3, burst_p=0.0)
+    syms0 = [o.sym for o in no_burst]
+    clustered0 = sum(
+        1 for i in range(0, len(syms0) - 30, 30)
+        if len(set(syms0[i:i + 30])) <= 5
+    )
+    assert clustered > clustered0 + 5
+
+
+def test_contract_matches_uniform_generator():
+    """Same stream contract as random_order_stream: submits get 1-based
+    sequential oids, cancels reference previously-submitted LIMIT oids,
+    MARKET price is 0, prices are positive ints."""
+    stream = realistic_order_stream(32, 5000, seed=2)
+    submits = [o for o in stream if o.op == OP_SUBMIT]
+    assert [o.oid for o in submits] == list(range(1, len(submits) + 1))
+    seen = set()
+    for o in stream:
+        if o.op == OP_SUBMIT:
+            seen.add(o.oid)
+            if o.otype == 1:
+                assert o.price == 0
+            else:
+                assert o.price >= 1
+            assert 1 <= o.qty < 100
+        else:
+            assert o.op == OP_CANCEL and o.oid in seen
+
+
+def test_parity_on_realistic_flow():
+    """The parity gate holds on deep/burst/power-law flow, including the
+    side-full REJECTED regime a small capacity forces."""
+    cfg = EngineConfig(num_symbols=16, capacity=16, batch=8, max_fills=1 << 14)
+    stream = realistic_order_stream(16, 1500, seed=5, deep_fraction=0.25)
+    assert_parity(cfg, stream)
