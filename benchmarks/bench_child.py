@@ -30,6 +30,12 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--windows", type=int, default=5)
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--kernel", choices=("matrix", "sorted"),
+                   default="matrix",
+                   help="match formulation: the production [CAP,CAP] "
+                        "priority matrix, or the O(CAP) sorted-book "
+                        "prototype (engine/kernel_sorted.py) — the "
+                        "capacity sweep compares them")
     p.add_argument("--stage-symbols", type=int, default=0,
                    help="staged mode: measure this (small) symbol count "
                         "first and WRITE that result before the full "
@@ -67,6 +73,14 @@ def main() -> None:
         result_row,
     )
 
+    step_fn = None
+    if args.kernel == "sorted":
+        from matching_engine_tpu.engine.kernel_sorted import (
+            engine_step_sorted,
+        )
+
+        step_fn = engine_step_sorted
+
     try:
         import subprocess
         rev = subprocess.run(
@@ -84,11 +98,13 @@ def main() -> None:
             max_fills=1 << 17,
         )
         value, mean_lat_us = measure_device_throughput(
-            cfg, headline_streams(cfg), windows=windows, iters=iters
+            cfg, headline_streams(cfg), windows=windows, iters=iters,
+            step_fn=step_fn,
         )
         return result_row(cfg, value, mean_lat_us, platform=platform,
                           n_devices=len(devices),
-                          backend_init_s=backend_init_s, git_rev=rev)
+                          backend_init_s=backend_init_s, git_rev=rev,
+                          kernel=args.kernel)
 
     small = None
     if args.stage_symbols and args.stage_symbols < args.symbols:

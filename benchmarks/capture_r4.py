@@ -94,6 +94,29 @@ STEPS: list[dict] = [
     {"name": "cap1024", "artifact": "tpu_r4_cap1024.json", "timeout": 1200,
      "cmd": bench_child("tpu_r4_cap1024.json", "--symbols", "256",
                         "--capacity", "1024", "--batch", "32")},
+    # Sorted-book kernel (engine/kernel_sorted.py, O(CAP) per order) at
+    # the same sweep points — the head-to-head that decides which
+    # formulation serves at which capacity (VERDICT r3 next-step 4).
+    {"name": "cap128s", "artifact": "tpu_r4_cap128_sorted.json",
+     "timeout": 900,
+     "cmd": bench_child("tpu_r4_cap128_sorted.json", "--symbols", "256",
+                        "--capacity", "128", "--batch", "32",
+                        "--kernel", "sorted")},
+    {"name": "cap512s", "artifact": "tpu_r4_cap512_sorted.json",
+     "timeout": 900,
+     "cmd": bench_child("tpu_r4_cap512_sorted.json", "--symbols", "256",
+                        "--capacity", "512", "--batch", "32",
+                        "--kernel", "sorted")},
+    {"name": "cap1024s", "artifact": "tpu_r4_cap1024_sorted.json",
+     "timeout": 1200,
+     "cmd": bench_child("tpu_r4_cap1024_sorted.json", "--symbols", "256",
+                        "--capacity", "1024", "--batch", "32",
+                        "--kernel", "sorted")},
+    {"name": "headline_sorted", "artifact": "tpu_r4_headline_sorted.json",
+     "timeout": 1200,
+     "cmd": bench_child("tpu_r4_headline_sorted.json", "--symbols", "4096",
+                        "--capacity", "128", "--batch", "32",
+                        "--kernel", "sorted", "--stage-symbols", "512")},
     # Serving-stack rows (VERDICT r3 next-step 2): the RPC-less
     # EngineRunner inflight sweep, then full-stack e2e at pipeline
     # inflight 2 and 4 (r3's artifacts measured the old single-slot

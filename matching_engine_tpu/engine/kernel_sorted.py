@@ -1,0 +1,237 @@
+"""Sorted-book match kernel: O(CAP) per order instead of O(CAP^2).
+
+The production kernel (engine/kernel.py) allocates fills with a [CAP, CAP]
+priority comparison matrix — per-order work and intermediates quadratic in
+book capacity, which is exactly where a venue-depth book (VERDICT r3 weak
+#3 / next-step 4) gets expensive. This module is the alternative
+formulation that answers it: maintain each book side as a **dense sorted
+prefix** — live entries (qty > 0) occupy slots [0, n) ordered by
+price-time priority (key ascending; key = price for asks, -price for
+bids; ties impossible: seqs are unique and insertion places equal-price
+orders behind existing ones) — and the whole matrix collapses to vector
+ops:
+
+- quantity resting ahead of maker j  = exclusive cumsum of eligible qty,
+- fill_j = clip(Q - ahead_j, 0, qty_j)   (identical allocation),
+- priority rank = exclusive cumsum of the eligibility mask,
+- resting inserts by shift (one O(CAP) gather), cancels compact the side
+  (one cumsum-scatter), matched-out makers compact the same way.
+
+Everything else — eligibility, self-trade prevention, statuses, MARKET
+IOC, OP_REST auction accumulation, the fill-log contract, finalize_step —
+is shared with or identical to kernel.py, and bit-parity with the host
+oracle AND the matrix kernel is pinned by tests/test_kernel_sorted.py.
+
+Books produced by the two kernels are NOT interchangeable mid-stream (the
+matrix kernel leaves holes and arbitrary slot order); pick one kernel per
+book lifetime. `bench_child.py --kernel sorted` benches this one; the
+capacity sweep decides which formulation serves at which CAP
+(docs/BENCH_METHOD.md round-4: capacity sweep).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+)
+from matching_engine_tpu.engine.kernel import (
+    BUY,
+    CANCELED,
+    FILLED,
+    MARKET,
+    NEW,
+    NOOP_STATUS,
+    OP_CANCEL,
+    OP_REST,
+    OP_SUBMIT,
+    PARTIALLY_FILLED,
+    REJECTED,
+    _SymBook,
+    finalize_step,
+)
+
+
+def _compact(qty, *arrays):
+    """Pack live entries (qty > 0) into a dense prefix, preserving order;
+    freed tail slots zero. Returns (new_qty, *new_arrays)."""
+    cap = qty.shape[0]
+    keep = qty > 0
+    dest = jnp.where(keep, jnp.cumsum(keep) - 1, cap)  # cap = trash slot
+
+    def scatter(x):
+        return jnp.zeros((cap + 1,), I32).at[dest].set(
+            jnp.where(keep, x, 0))[:cap]
+
+    return (scatter(qty), *(scatter(x) for x in arrays))
+
+
+def _match_one_sorted(book: _SymBook, order):
+    """Apply one order to one SORTED book (see module docstring invariant).
+    Same return contract as kernel._match_one."""
+    op, side, otype, price, qty, oid, owner = (
+        order.op, order.side, order.otype, order.price, order.qty,
+        order.oid, order.owner,
+    )
+    is_submit = op == OP_SUBMIT
+    is_cancel = op == OP_CANCEL
+    is_rest = op == OP_REST
+    is_submit_like = is_submit | is_rest
+    is_buy = side == BUY
+    is_market = otype == MARKET
+    cap = book.bid_qty.shape[0]
+    idx = jnp.arange(cap)
+
+    # ---- opposite side (maker candidates), sorted best-first -------------
+    opp_price = jnp.where(is_buy, book.ask_price, book.bid_price)
+    opp_qty = jnp.where(is_buy, book.ask_qty, book.bid_qty)
+    opp_oid = jnp.where(is_buy, book.ask_oid, book.bid_oid)
+    opp_seq = jnp.where(is_buy, book.ask_seq, book.bid_seq)
+    opp_owner = jnp.where(is_buy, book.ask_owner, book.bid_owner)
+
+    live = opp_qty > 0
+    price_ok = jnp.where(is_buy, opp_price <= price, opp_price >= price)
+    not_self = (owner == 0) | (opp_owner != owner)
+    elig = live & (is_market | price_ok) & is_submit & not_self
+    self_blocked = is_submit & (~is_market) & jnp.any(
+        live & price_ok & (owner != 0) & (opp_owner == owner))
+
+    # Priority order IS slot order: ahead-of-j is an exclusive prefix sum.
+    elig_qty = jnp.where(elig, opp_qty, 0)
+    cum = jnp.cumsum(elig_qty)
+    ahead = cum - elig_qty
+
+    take_q = jnp.where(is_submit_like, qty, 0)
+    fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
+    filled_total = jnp.sum(fill)
+    remaining = take_q - filled_total
+
+    # Rank among eligible makers = exclusive prefix count (same slots the
+    # matrix kernel's pairwise rank produces — sorted order is priority
+    # order).
+    rank = jnp.cumsum(elig.astype(I32)) - elig.astype(I32)
+    has_fill = fill > 0
+    slot = jnp.where(has_fill, rank, cap)
+    fill_oid = jnp.zeros((cap + 1,), I32).at[slot].set(
+        jnp.where(has_fill, opp_oid, 0))[:cap]
+    fill_qty_out = jnp.zeros((cap + 1,), I32).at[slot].set(fill)[:cap]
+    fill_price = jnp.zeros((cap + 1,), I32).at[slot].set(
+        jnp.where(has_fill, opp_price, 0))[:cap]
+
+    # Matched-out makers leave holes: re-pack the prefix.
+    new_opp_qty, opp_price, opp_oid, opp_seq, opp_owner = _compact(
+        opp_qty - fill, opp_price, opp_oid, opp_seq, opp_owner)
+
+    # ---- own side: sorted insert of a LIMIT remainder, or cancel ---------
+    own_price = jnp.where(is_buy, book.bid_price, book.ask_price)
+    own_qty = jnp.where(is_buy, book.bid_qty, book.ask_qty)
+    own_oid = jnp.where(is_buy, book.bid_oid, book.ask_oid)
+    own_seq = jnp.where(is_buy, book.bid_seq, book.ask_seq)
+    own_owner = jnp.where(is_buy, book.bid_owner, book.ask_owner)
+
+    own_live = own_qty > 0
+    n_live = jnp.sum(own_live.astype(I32))
+    do_rest = is_submit_like & (~is_market) & (remaining > 0) & ~self_blocked
+    rested = do_rest & (n_live < cap)
+
+    # Insertion position: behind every live entry with key <= new key
+    # (equal price = earlier seq = higher priority than the newcomer).
+    own_key = jnp.where(is_buy, -own_price, own_price)
+    new_key = jnp.where(is_buy, -price, price)
+    pos = jnp.sum((own_live & (own_key <= new_key)).astype(I32))
+
+    gather_src = jnp.clip(idx - 1, 0, cap - 1)
+
+    def insert(x, new_val):
+        shifted = jnp.where(idx > pos, x[gather_src], x)
+        return jnp.where(rested & (idx == pos), new_val,
+                         jnp.where(rested, shifted, x))
+
+    ins_price = insert(own_price, price)
+    ins_qty = insert(own_qty, remaining)
+    ins_oid = insert(own_oid, oid)
+    ins_seq = insert(own_seq, book.next_seq)
+    ins_owner = insert(own_owner, owner)
+    next_seq = book.next_seq + jnp.where(rested, 1, 0).astype(I32)
+
+    cancel_mask = is_cancel & (own_oid == oid) & own_live
+    cancel_qty = jnp.sum(jnp.where(cancel_mask, own_qty, 0))
+    cancel_ok = jnp.any(cancel_mask)
+    # Cancel zeroes its slot; the unconditional compact below re-packs
+    # (identity when nothing was zeroed — inserts keep density).
+    c_qty = jnp.where(cancel_mask, 0, ins_qty)
+    own_qty2, own_price2, own_oid2, own_seq2, own_owner2 = _compact(
+        c_qty, ins_price, ins_oid, ins_seq, ins_owner)
+
+    new_book = _SymBook(
+        bid_price=jnp.where(is_buy, own_price2, opp_price),
+        bid_qty=jnp.where(is_buy, own_qty2, new_opp_qty),
+        bid_oid=jnp.where(is_buy, own_oid2, opp_oid),
+        bid_seq=jnp.where(is_buy, own_seq2, opp_seq),
+        bid_owner=jnp.where(is_buy, own_owner2, opp_owner),
+        ask_price=jnp.where(is_buy, opp_price, own_price2),
+        ask_qty=jnp.where(is_buy, new_opp_qty, own_qty2),
+        ask_oid=jnp.where(is_buy, opp_oid, own_oid2),
+        ask_seq=jnp.where(is_buy, opp_seq, own_seq2),
+        ask_owner=jnp.where(is_buy, opp_owner, own_owner2),
+        next_seq=next_seq,
+    )
+
+    # ---- status (identical decision tree to kernel._match_one) -----------
+    submit_status = jnp.where(
+        remaining == 0,
+        FILLED,
+        jnp.where(
+            is_market | self_blocked,
+            CANCELED,
+            jnp.where(
+                rested,
+                jnp.where(filled_total > 0, PARTIALLY_FILLED, NEW),
+                REJECTED,
+            ),
+        ),
+    )
+    cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
+    status = jnp.where(
+        is_submit_like,
+        submit_status,
+        jnp.where(is_cancel, cancel_status, NOOP_STATUS),
+    ).astype(I32)
+    out_remaining = jnp.where(
+        is_submit_like, remaining, jnp.where(is_cancel, cancel_qty, 0)
+    ).astype(I32)
+
+    return new_book, (
+        status,
+        filled_total.astype(I32),
+        out_remaining,
+        fill_oid,
+        fill_qty_out,
+        fill_price,
+    )
+
+
+def _sym_scan_sorted(book: _SymBook, orders):
+    return jax.lax.scan(lambda b, o: _match_one_sorted(b, o), book, orders)
+
+
+def engine_step_sorted_impl(cfg: EngineConfig, book: BookBatch,
+                            orders: OrderBatch):
+    """Un-jitted sorted-formulation step (same contract as
+    kernel.engine_step_impl; shares finalize_step)."""
+    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+    new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = (
+        jax.vmap(_sym_scan_sorted)(sym_book, orders))
+    new_book = BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq)
+    return new_book, finalize_step(
+        cfg, new_book, orders, status, filled, remaining, f_oid, f_qty,
+        f_price)
+
+
+engine_step_sorted = jax.jit(engine_step_sorted_impl, static_argnums=0,
+                             donate_argnums=1)

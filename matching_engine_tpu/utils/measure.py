@@ -42,9 +42,11 @@ def headline_streams(cfg: EngineConfig, n_streams: int = 4):
 
 def result_row(cfg: EngineConfig, value: float, lat_us: float, *,
                platform: str, n_devices: int, backend_init_s: float,
-               git_rev: str) -> dict:
+               git_rev: str, kernel: str = "matrix") -> dict:
     """The benchmark artifact row shape (shared by bench_child and the
-    resident so a schema tweak can't silently fork the two)."""
+    resident so a schema tweak can't silently fork the two). `kernel`
+    labels the match formulation (engine/kernel.py matrix vs
+    engine/kernel_sorted.py sorted) explicitly in every row."""
     return {
         "value": value,
         "platform": platform,
@@ -54,6 +56,7 @@ def result_row(cfg: EngineConfig, value: float, lat_us: float, *,
         "batch": cfg.batch,
         "backend_init_s": round(backend_init_s, 1),
         "mean_dispatch_latency_us": round(lat_us, 1),
+        "kernel": kernel,
         "git_rev": git_rev,
     }
 
@@ -72,18 +75,21 @@ def prepare_waves(cfg: EngineConfig, streams, waves_per_stream: int = 2):
 
 
 def measure_windows(cfg: EngineConfig, book, waves, wave_ops, *,
-                    windows: int = 5, iters: int = 20):
+                    windows: int = 5, iters: int = 20, step_fn=None):
     """The timed core: `windows` fully-synced windows of `iters` steps over
     pre-device-put waves; first window discarded (ramp). Returns
     (sustained orders/sec, mean step latency µs, book') — book' so a
     long-lived caller (benchmarks/resident.py) can thread state through
-    repeated measurements without re-initializing."""
+    repeated measurements without re-initializing. `step_fn` defaults to
+    the production matrix kernel; pass kernel_sorted.engine_step_sorted to
+    measure the O(CAP) formulation on the same flow."""
+    step = step_fn or engine_step
     real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
     rates, lats = [], []
     for _ in range(windows):
         t0 = time.perf_counter()
         for i in range(iters):
-            book, out = engine_step(cfg, book, waves[i % len(waves)])
+            book, out = step(cfg, book, waves[i % len(waves)])
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         rates.append(real_ops / dt)
@@ -106,6 +112,7 @@ def measure_device_throughput(
     windows: int = 5,
     iters: int = 20,
     waves_per_stream: int = 2,
+    step_fn=None,
 ):
     """Returns (sustained orders/sec, mean dispatch latency in µs — the
     median across windows of each window's MEAN step latency dt/iters; a
@@ -115,12 +122,14 @@ def measure_device_throughput(
     `streams` is a list of HostOrder lists; the leading `waves_per_stream`
     dispatches of each are cycled during the timed loop.
     """
+    step = step_fn or engine_step
     waves, wave_ops = prepare_waves(cfg, streams, waves_per_stream)
 
     book = init_book(cfg)
-    book, out = engine_step(cfg, book, waves[0])
+    book, out = step(cfg, book, waves[0])
     jax.block_until_ready(out)
 
     rate, lat, _ = measure_windows(
-        cfg, book, waves, wave_ops, windows=windows, iters=iters)
+        cfg, book, waves, wave_ops, windows=windows, iters=iters,
+        step_fn=step)
     return rate, lat

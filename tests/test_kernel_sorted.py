@@ -1,0 +1,172 @@
+"""Sorted-book kernel (engine/kernel_sorted.py): bit-parity with the host
+oracle AND the production matrix kernel, plus the dense-sorted-prefix
+invariant the O(CAP)-per-order formulation depends on."""
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.flow import realistic_order_stream
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    build_batches,
+    decode_step,
+    random_order_stream,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.engine.kernel_sorted import engine_step_sorted
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+
+def apply_sorted(cfg, book, orders):
+    """apply_orders for the sorted kernel (per-step decode; test-only)."""
+    results, fills = [], []
+    for b in build_batches(cfg, orders):
+        book, out = engine_step_sorted(cfg, book, b)
+        r, f, overflow = decode_step(cfg, b, out)
+        assert not overflow
+        results.extend(r)
+        fills.extend(f)
+    return book, results, fills
+
+
+def run_oracle(cfg, orders):
+    oracles = [OracleBook(capacity=cfg.capacity)
+               for _ in range(cfg.num_symbols)]
+    res, fills = [], []
+    for o in orders:
+        if o.op == OP_SUBMIT:
+            r = oracles[o.sym].submit(o.oid, o.side, o.otype, o.price, o.qty,
+                                      owner=o.owner)
+        else:
+            r = oracles[o.sym].cancel(o.oid)
+        res.append((o.oid, o.sym, r.status, r.filled, r.remaining))
+        fills.extend((o.sym, f.taker_oid, f.maker_oid, f.price_q4,
+                      f.quantity) for f in r.fills)
+    return res, fills, [o.snapshot() for o in oracles]
+
+
+def assert_sorted_parity(cfg, orders):
+    book, d_res, d_fills = apply_sorted(cfg, init_book(cfg), orders)
+    o_res, o_fills, o_snaps = run_oracle(cfg, orders)
+    assert sorted((r.oid, r.sym, r.status, r.filled, r.remaining)
+                  for r in d_res) == sorted(o_res)
+    for s in range(cfg.num_symbols):
+        dev = [(f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+               for f in d_fills if f.sym == s]
+        orc = [f[1:] for f in o_fills if f[0] == s]
+        assert dev == orc, f"fill mismatch sym {s}"
+    d_snaps = snapshot_books(book)
+    for s in range(cfg.num_symbols):
+        assert d_snaps[s][0] == o_snaps[s][0], f"bid book mismatch sym {s}"
+        assert d_snaps[s][1] == o_snaps[s][1], f"ask book mismatch sym {s}"
+    assert_sorted_invariant(book)
+
+
+def assert_sorted_invariant(book):
+    """Live entries are a dense prefix, priority-sorted (key asc, seq asc
+    within equal price), freed slots zeroed."""
+    for side, price, qty, seq, sign in (
+        ("bid", book.bid_price, book.bid_qty, book.bid_seq, -1),
+        ("ask", book.ask_price, book.ask_qty, book.ask_seq, +1),
+    ):
+        p, q, sq = (np.asarray(price), np.asarray(qty), np.asarray(seq))
+        for s in range(p.shape[0]):
+            live = q[s] > 0
+            n = int(live.sum())
+            assert live[:n].all() and not live[n:].any(), \
+                f"{side} sym {s}: live entries not a dense prefix"
+            keys = list(zip((sign * p[s][:n]).tolist(), sq[s][:n].tolist()))
+            assert keys == sorted(keys), f"{side} sym {s}: not sorted"
+            assert not q[s][n:].any() and not p[s][n:].any(), \
+                f"{side} sym {s}: freed slots not zeroed"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzz_parity_uniform(seed):
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=8, max_fills=1 << 14)
+    stream = random_order_stream(8, 800, seed=seed, cancel_p=0.2,
+                                 market_p=0.2, price_levels=6)
+    assert_sorted_parity(cfg, stream)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_parity_realistic_flow(seed):
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=8, max_fills=1 << 14)
+    stream = realistic_order_stream(8, 1200, seed=seed, deep_fraction=0.3)
+    assert_sorted_parity(cfg, stream)
+
+
+def test_capacity_reject_and_refill():
+    """Side-full REJECTED, then a cancel frees a slot and the next rest
+    lands sorted."""
+    cfg = EngineConfig(num_symbols=1, capacity=4, batch=4, max_fills=256)
+    orders = [HostOrder(0, OP_SUBMIT, BUY, LIMIT, 100 + i, 1, oid=i + 1)
+              for i in range(5)]                       # 5th: side full
+    from matching_engine_tpu.engine.kernel import OP_CANCEL
+
+    orders.append(HostOrder(0, OP_CANCEL, BUY, oid=2))
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 99, 1, oid=6))
+    assert_sorted_parity(cfg, orders)
+
+
+def test_stp_and_market_through_sorted_kernel():
+    cfg = EngineConfig(num_symbols=1, capacity=16, batch=8, max_fills=256)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 100, 3, oid=1, owner=7),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 101, 3, oid=2, owner=8),
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT, 101, 3, oid=3, owner=7),  # skips own
+        HostOrder(0, OP_SUBMIT, BUY, MARKET, 0, 5, oid=4, owner=9),
+    ]
+    assert_sorted_parity(cfg, orders)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sorted_matches_matrix_kernel(seed):
+    """The two formulations produce identical statuses, fills, and books
+    on the same stream (snapshot_books canonicalizes slot order)."""
+    cfg = EngineConfig(num_symbols=4, capacity=32, batch=8, max_fills=1 << 14)
+    stream = random_order_stream(4, 600, seed=seed, cancel_p=0.15,
+                                 market_p=0.15)
+    mb, m_res, m_fills = apply_orders(cfg, init_book(cfg), stream)
+    sb, s_res, s_fills = apply_sorted(cfg, init_book(cfg), stream)
+    assert [(r.oid, r.status, r.filled, r.remaining) for r in m_res] == \
+           [(r.oid, r.status, r.filled, r.remaining) for r in s_res]
+    assert [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+            for f in m_fills] == \
+           [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+            for f in s_fills]
+    assert snapshot_books(mb) == snapshot_books(sb)
+
+
+def test_op_rest_crossing_accumulation_matches_matrix():
+    """OP_REST (auction accumulation) through the sorted kernel: crossing
+    orders REST without matching — the book stands crossed, sorted, and
+    identical to the matrix kernel's book content on the same stream."""
+    from matching_engine_tpu.engine.kernel import OP_REST
+
+    cfg = EngineConfig(num_symbols=2, capacity=16, batch=4, max_fills=256)
+    stream = [
+        HostOrder(0, OP_REST, BUY, LIMIT, 105, 5, oid=1),
+        HostOrder(0, OP_REST, SELL, LIMIT, 100, 4, oid=2),   # crosses: rests
+        HostOrder(0, OP_REST, BUY, LIMIT, 103, 2, oid=3),
+        HostOrder(0, OP_REST, SELL, LIMIT, 101, 3, oid=4),
+        HostOrder(1, OP_REST, BUY, LIMIT, 50, 1, oid=5),
+        # Same price as oid 1 — FIFO: must sort BEHIND it.
+        HostOrder(0, OP_REST, BUY, LIMIT, 105, 7, oid=6),
+    ]
+    mb, m_res, m_fills = apply_orders(cfg, init_book(cfg), stream)
+    sb, s_res, s_fills = apply_sorted(cfg, init_book(cfg), stream)
+    assert m_fills == [] and s_fills == []          # nothing matches
+    assert [(r.oid, r.status) for r in m_res] == \
+           [(r.oid, r.status) for r in s_res]
+    assert snapshot_books(mb) == snapshot_books(sb)
+    assert_sorted_invariant(sb)
+    # The book really stands crossed (best bid 105 >= best ask 100).
+    bids, asks = snapshot_books(sb)[0]
+    assert bids[0][1] == 105 and asks[0][1] == 100
+    # FIFO at equal price: oid 1 ahead of oid 6.
+    assert [r[0] for r in bids if r[1] == 105] == [1, 6]
