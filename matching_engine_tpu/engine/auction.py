@@ -147,12 +147,30 @@ def _records_one(fill_b, fill_a, start_b, start_a, bid_oid, ask_oid):
     return compact(taker), compact(maker), compact(flat), jnp.sum(m)
 
 
-def apply_uncross(book: BookBatch, fill_b, fill_a, apply) -> BookBatch:
+def apply_uncross(book: BookBatch, fill_b, fill_a, apply,
+                  kernel: str = "matrix") -> BookBatch:
     """Decrement both sides' executed quantities where `apply` ([S] bool)
-    holds — THE one book-update rule for single-device and mesh uncross."""
-    return book._replace(
+    holds — THE one book-update rule for single-device and mesh uncross.
+
+    Under the sorted-book kernel (EngineConfig.kernel == "sorted") the
+    fully-filled makers' holes are re-packed so the dense-sorted-prefix
+    invariant survives the auction: decrements never change relative
+    priority order, so an order-preserving compact restores it exactly."""
+    out = book._replace(
         bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
         ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
+    )
+    if kernel != "sorted":
+        return out
+    from matching_engine_tpu.engine.kernel_sorted import _compact
+
+    bq, bp, bo, bs, bw = jax.vmap(_compact)(
+        out.bid_qty, out.bid_price, out.bid_oid, out.bid_seq, out.bid_owner)
+    aq, ap, ao, as_, aw = jax.vmap(_compact)(
+        out.ask_qty, out.ask_price, out.ask_oid, out.ask_seq, out.ask_owner)
+    return out._replace(
+        bid_qty=bq, bid_price=bp, bid_oid=bo, bid_seq=bs, bid_owner=bw,
+        ask_qty=aq, ask_price=ap, ask_oid=ao, ask_seq=as_, ask_owner=aw,
     )
 
 
@@ -201,7 +219,8 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     aborted = total > n
 
     # All-or-nothing: an overflow leaves every book untouched.
-    new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted)
+    new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
+                             kernel=cfg.kernel)
 
     # Stage 2: global compaction over the [S, 2C-1] lanes (row-major, so
     # records stay symbol-major in per-symbol rank order).

@@ -41,15 +41,26 @@ class EngineConfig:
     capacity: int = 128          # resting orders per side per book
     batch: int = 8               # orders per symbol per engine step
     max_fills: int = 1 << 15     # global fill-buffer slots per engine step
+    # Match formulation: "matrix" = the [CAP, CAP] priority-matrix kernel
+    # (engine/kernel.py), "sorted" = the O(CAP) dense-sorted-prefix kernel
+    # (engine/kernel_sorted.py). Both bit-match the oracle; books are NOT
+    # interchangeable between them mid-lifetime (the matrix kernel leaves
+    # holes; the sorted kernel requires its invariant), so the choice is
+    # part of semantic_key and a checkpoint from the other kernel restores
+    # via full replay.
+    kernel: str = "matrix"
 
     def __post_init__(self):
         assert self.capacity <= 1024, "capacity beyond 1024 breaks int32 qty sums"
+        assert self.kernel in ("matrix", "sorted"), self.kernel
 
     def semantic_key(self) -> tuple:
         """The fields that define book/kernel SEMANTICS (shapes, buffer
-        sizes) as opposed to any execution-strategy knobs that may be added
-        later. Checkpoint compatibility compares this."""
-        return (self.num_symbols, self.capacity, self.batch, self.max_fills)
+        sizes, book-layout invariants) as opposed to any execution-strategy
+        knobs that may be added later. Checkpoint compatibility compares
+        this."""
+        return (self.num_symbols, self.capacity, self.batch, self.max_fills,
+                self.kernel)
 
 
 class BookBatch(NamedTuple):

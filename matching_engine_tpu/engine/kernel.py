@@ -252,7 +252,19 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     bit-identical, measured ~700x SLOWER than this XLA formulation, and
     retired — see docs/DESIGN.md §6 for the analysis (integer control-flow
     over VPU lanes is exactly what XLA already schedules well; the
-    priority-matrix broadcasts relayout poorly under Mosaic)."""
+    priority-matrix broadcasts relayout poorly under Mosaic).
+
+    cfg.kernel selects the formulation at trace time: "matrix" (this
+    file's [CAP, CAP] priority matrix) or "sorted" (kernel_sorted.py's
+    O(CAP) dense-sorted-prefix variant) — every serving path (packed
+    dense, sparse, shard_map mesh) dispatches through here, so the
+    config knob covers them all."""
+    if cfg.kernel == "sorted":
+        from matching_engine_tpu.engine.kernel_sorted import (
+            engine_step_sorted_impl,
+        )
+
+        return engine_step_sorted_impl(cfg, book, orders)
     sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
     # vmap over the symbol axis; scan over the batch axis inside.
     new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(

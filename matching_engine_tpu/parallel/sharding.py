@@ -230,7 +230,8 @@ class ShardedEngine:
             local_total = jnp.sum(rec_counts)
             # PER-SHARD all-or-nothing (no collective — see docstring).
             aborted = local_total > n
-            new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted)
+            new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
+                                     kernel=local_cfg.kernel)
             r = 2 * cap - 1
             off = jax.lax.axis_index(AXIS).astype(I32) * local_s
             sym_ids = jnp.broadcast_to(

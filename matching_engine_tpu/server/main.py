@@ -289,6 +289,12 @@ def main(argv=None) -> int:
     p.add_argument("--symbols", type=int, default=1024, help="symbol-axis size")
     p.add_argument("--capacity", type=int, default=128, help="resting orders per side")
     p.add_argument("--batch", type=int, default=8, help="orders per symbol per dispatch")
+    p.add_argument("--engine-kernel", choices=("matrix", "sorted"),
+                   default="matrix",
+                   help="match formulation (engine/kernel.py matrix vs "
+                        "engine/kernel_sorted.py sorted — both "
+                        "oracle-parity; sorted is O(CAP) per order for "
+                        "deep books)")
     p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
     p.add_argument("--pipeline-inflight", type=int, default=2,
                    help="staged-but-undecoded dispatches kept in flight "
@@ -339,7 +345,8 @@ def main(argv=None) -> int:
         print(f"[SERVER] bad --mesh: {e}", file=sys.stderr)
         return 3
 
-    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity, batch=args.batch)
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
+                       batch=args.batch, kernel=args.engine_kernel)
     try:
         server, port, parts = build_server(
             args.addr, args.db, cfg, window_ms=args.window_ms,

@@ -170,3 +170,73 @@ def test_op_rest_crossing_accumulation_matches_matrix():
     assert bids[0][1] == 105 and asks[0][1] == 100
     # FIFO at equal price: oid 1 ahead of oid 6.
     assert [r[0] for r in bids if r[1] == 105] == [1, 6]
+
+
+def test_sparse_path_with_sorted_kernel():
+    """EngineConfig(kernel='sorted') routes every dispatch shape through
+    the sorted formulation: the sparse path and the dense path stay
+    bit-equal on the same stream (and both carry the sorted invariant)."""
+    from tests.test_sparse import run_dense, run_sparse
+
+    cfg = EngineConfig(num_symbols=16, capacity=32, batch=8,
+                       max_fills=1 << 12, kernel="sorted")
+    stream = random_order_stream(16, 6 * 16 * 8, seed=2, cancel_p=0.15,
+                                 market_p=0.1, price_levels=12)
+    dbook, dres, dfills = run_dense(cfg, stream)
+    sbook, sres, sfills = run_sparse(cfg, stream)
+    for f in dbook._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dbook, f)), np.asarray(getattr(sbook, f)), f)
+    assert dres == sres and dfills == sfills
+    assert_sorted_invariant(dbook)
+
+
+def test_server_with_sorted_kernel(tmp_path):
+    """Full serving stack on the sorted kernel (--engine-kernel sorted):
+    continuous cross, cancel, book query, call auction with uncross — the
+    auction compact keeps the invariant so post-auction continuous
+    matching still works."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256,
+                       kernel="sorted")
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "sorted.db"), cfg, window_ms=1.0,
+        log=False)
+    parts["runner"].auction_mode = True
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+
+    def sub(client, side, price, qty, symbol="SK"):
+        r = stub.SubmitOrder(
+            pb2.OrderRequest(client_id=client, symbol=symbol, side=side,
+                             order_type=pb2.LIMIT, price=price, scale=4,
+                             quantity=qty), timeout=15)
+        assert r.success, r.error_message
+        return r
+
+    try:
+        # Call period: crossing orders REST.
+        sub("b1", pb2.BUY, 102, 5)
+        sub("a1", pb2.SELL, 100, 4)
+        sub("a2", pb2.SELL, 101, 3)
+        resp = stub.RunAuction(pb2.AuctionRequest(symbol=""), timeout=30)
+        assert resp.success and resp.symbols_crossed == 1
+        assert resp.executed_quantity == 5  # bid 5 fills against both asks
+        # Continuous trading resumed on the compacted sorted book: the
+        # leftover ask (2 @ 101) fills a new taker.
+        r = sub("b2", pb2.BUY, 101, 2)
+        book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="SK"),
+                                 timeout=10)
+        assert len(book.asks) == 0 and len(book.bids) == 0
+        # Cancel path: rest an order, cancel it.
+        r3 = sub("c", pb2.BUY, 90, 1)
+        cr = stub.CancelOrder(pb2.CancelRequest(
+            client_id="c", order_id=r3.order_id), timeout=10)
+        assert cr.success
+    finally:
+        shutdown(server, parts)

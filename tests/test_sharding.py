@@ -106,3 +106,30 @@ def test_sharded_book_stays_sharded(mesh8):
 def test_mesh_size_must_divide_symbols(mesh8):
     with pytest.raises(ValueError):
         ShardedEngine(EngineConfig(num_symbols=12), mesh8)
+
+
+def test_sharded_sorted_kernel_matches_single_device(mesh8):
+    """EngineConfig(kernel='sorted') on the mesh: the shard_map path
+    dispatches through the same engine_step_impl switch, so the sorted
+    formulation must match its own single-device run shard-for-shard."""
+    cfg = EngineConfig(num_symbols=16, capacity=32, batch=4, max_fills=256,
+                      kernel="sorted")
+    orders = random_order_stream(
+        cfg.num_symbols, 300, seed=11, price_base=9_900, price_levels=50,
+        price_step=1, qty_max=50,
+    )
+
+    book = init_book(cfg)
+    book, s_results, s_fills = apply_orders(cfg, book, orders)
+    s_snaps = snapshot_books(book)
+
+    d_results, d_fills, d_snaps, _ = _run_sharded(cfg, mesh8, orders)
+
+    key = lambda r: (r.oid, r.sym, r.status, r.filled, r.remaining)
+    assert sorted(map(key, d_results)) == sorted(map(key, s_results))
+    fkey = lambda f: (f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+    for s in range(cfg.num_symbols):
+        assert [fkey(f) for f in d_fills if f.sym == s] == [
+            fkey(f) for f in s_fills if f.sym == s
+        ], f"fill mismatch sym {s}"
+    assert d_snaps == s_snaps
