@@ -266,6 +266,14 @@ def _promote_suite_tmp(path: str) -> None:
         pass
 
 
+def probe_healthy(timeout_s: float = 45) -> bool:
+    """Cheap backend probe (healthy init is sub-second; wedged hangs)."""
+    rc, _ = _run_bounded(
+        [PY, "-c", "import jax; assert jax.devices()"], timeout_s,
+        subprocess.DEVNULL)
+    return rc == 0
+
+
 def main() -> int:
     os.makedirs(RESULTS, exist_ok=True)
     missing = [s for s in STEPS if not os.path.exists(
@@ -275,7 +283,14 @@ def main() -> int:
         return 0
     log(f"{len(missing)} steps to capture: {[s['name'] for s in missing]}")
     for step in STEPS:
-        run_step(step)
+        if not run_step(step) and not probe_healthy():
+            # The step burned its full timeout with nothing to show and
+            # the tunnel is wedged — grinding through every remaining
+            # step's timeout would waste HOURS of window time; bail and
+            # let the watcher retry on the next healthy probe.
+            log("tunnel unhealthy after step failure; bailing until "
+                "the next healthy window")
+            return 10
     still = [s["name"] for s in STEPS if not os.path.exists(
         os.path.join(RESULTS, s["artifact"]))]
     if still:
