@@ -51,8 +51,21 @@ class EngineConfig:
     kernel: str = "matrix"
 
     def __post_init__(self):
-        assert self.capacity <= 1024, "capacity beyond 1024 breaks int32 qty sums"
         assert self.kernel in ("matrix", "sorted"), self.kernel
+        if self.kernel == "matrix":
+            # The matrix kernel accumulates qty sums at int32 lane width
+            # (capacity * MAX_QUANTITY must not wrap) and materializes
+            # [S, CAP, CAP] intermediates — 1024 is both bounds.
+            assert self.capacity <= 1024, \
+                "matrix kernel: capacity beyond 1024 breaks int32 qty sums"
+        else:
+            # The sorted kernel switches its ahead-of-maker accumulator
+            # to a SATURATING int32 prefix sum when capacity *
+            # MAX_QUANTITY could wrap (venue-depth books; exact below
+            # saturation, clamped far past any take quantity above it —
+            # kernel_sorted.py); 8192 bounds the shift/scatter shapes.
+            assert self.capacity <= 8192, \
+                "sorted kernel: capacity beyond 8192 unsupported"
 
     def semantic_key(self) -> tuple:
         """The fields that define book/kernel SEMANTICS (shapes, buffer
@@ -61,6 +74,14 @@ class EngineConfig:
         this."""
         return (self.num_symbols, self.capacity, self.batch, self.max_fills,
                 self.kernel)
+
+
+def auction_capacity_max() -> int:
+    """Largest book capacity at which the call-auction kernel's int32
+    demand/supply volume sums cannot wrap (engine/auction.py accumulates
+    at lane width; continuous matching goes deeper via saturating sums
+    but the uncross does not, yet)."""
+    return (2**31 - 1) // MAX_QUANTITY
 
 
 class BookBatch(NamedTuple):

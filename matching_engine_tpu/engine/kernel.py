@@ -230,7 +230,14 @@ def _sym_scan(book: _SymBook, orders):
 
 
 def _top_of_book(price, qty, best_is_max):
-    """[S] best price + size at best, masked on qty>0; zeros when empty."""
+    """[S] best price + size at best, masked on qty>0; zeros when empty.
+
+    At venue-depth capacities (capacity * MAX_QUANTITY >= 2^31, sorted
+    kernel only) the size sum SATURATES at 2^30-1 instead of wrapping —
+    a price level deeper than a billion units reports the clamp, never a
+    negative size (documented in DESIGN.md 6d)."""
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+
     live = qty > 0
     any_live = jnp.any(live, axis=1)
     if best_is_max:
@@ -238,7 +245,13 @@ def _top_of_book(price, qty, best_is_max):
     else:
         best = jnp.min(jnp.where(live, price, jnp.iinfo(I32).max), axis=1)
     best = jnp.where(any_live, best, 0)
-    size = jnp.sum(jnp.where(live & (price == best[:, None]), qty, 0), axis=1)
+    at_best = jnp.where(live & (price == best[:, None]), qty, 0)
+    if qty.shape[1] * MAX_QUANTITY >= 2**31:
+        sat = jnp.int32((1 << 30) - 1)
+        size = jax.lax.associative_scan(
+            lambda a, b: jnp.minimum(a + b, sat), at_best, axis=1)[:, -1]
+    else:
+        size = jnp.sum(at_best, axis=1)
     size = jnp.where(any_live, size, 0)
     return best.astype(I32), size.astype(I32)
 

@@ -102,8 +102,24 @@ def _match_one_sorted(book: _SymBook, order):
         live & price_ok & (owner != 0) & (opp_owner == owner))
 
     # Priority order IS slot order: ahead-of-j is an exclusive prefix sum.
+    # Venue-depth books (capacity * MAX_QUANTITY >= 2^31) switch to a
+    # SATURATING prefix sum: min(a+b, SAT) over non-negative ints is
+    # associative, SAT = 2^30-1 keeps a+b inside int32, and saturation
+    # is reached only past take_q (<= MAX_QUANTITY << SAT), where the
+    # fill is zero regardless — so the allocation stays EXACT while the
+    # running sum can no longer wrap. (int64 is x64-gated in jax; this
+    # stays in native int32 lanes.) Every other sum (filled_total <= qty,
+    # cancel_qty <= qty, lane counts <= cap) is int32-safe as is. Static
+    # branch: `cap` is a trace-time shape.
+    from matching_engine_tpu.engine.book import MAX_QUANTITY
+
     elig_qty = jnp.where(elig, opp_qty, 0)
-    cum = jnp.cumsum(elig_qty)
+    if cap * MAX_QUANTITY >= 2**31:
+        sat = jnp.int32((1 << 30) - 1)
+        cum = jax.lax.associative_scan(
+            lambda a, b: jnp.minimum(a + b, sat), elig_qty)
+    else:
+        cum = jnp.cumsum(elig_qty)
     ahead = cum - elig_qty
 
     take_q = jnp.where(is_submit_like, qty, 0)

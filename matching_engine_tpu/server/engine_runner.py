@@ -760,6 +760,19 @@ class EngineRunner:
     def _run_auction_locked(self, symbols, sink) -> dict:
         from matching_engine_tpu.server.dispatcher import publish_result
 
+        from matching_engine_tpu.engine.book import auction_capacity_max
+
+        if self.cfg.capacity > auction_capacity_max():
+            # The auction kernel's demand/supply sums accumulate at int32
+            # lane width; a venue-depth (sorted-kernel) capacity could
+            # wrap them. Continuous matching at that depth is supported
+            # (saturating prefix sums, kernel_sorted.py) — the uncross is
+            # not, yet. Reject the REQUEST, never corrupt a clear.
+            return {"crossed": [], "aborted": False, "warning": "",
+                    "error": f"call auction unsupported at capacity "
+                             f"{self.cfg.capacity} (int32 volume sums "
+                             f"could wrap); max supported is "
+                             f"{auction_capacity_max()}"}
         mask = np.zeros((self.cfg.num_symbols,), dtype=bool)
         with self._id_lock:
             allocated = list(self.symbols.items())
@@ -1207,7 +1220,19 @@ class EngineRunner:
     def set_auction_mode(self, value: bool) -> None:
         """Flip the call-period flag and mark it dirty; the durable write
         happens in flush_auction_mode, OUTSIDE the dispatch lock — a
-        SQLite busy-wait must never sit on the dispatch critical path."""
+        SQLite busy-wait must never sit on the dispatch critical path.
+
+        Venue-depth engines (capacity past the auction bound) refuse to
+        OPEN a call period: rested interest could never be uncrossed
+        (run_auction rejects at that depth), so the period could only be
+        ended out-of-band."""
+        from matching_engine_tpu.engine.book import auction_capacity_max
+
+        if value and self.cfg.capacity > auction_capacity_max():
+            raise ValueError(
+                f"call periods unsupported at capacity "
+                f"{self.cfg.capacity} (auction bound "
+                f"{auction_capacity_max()})")
         self.auction_mode = value
         self._mode_dirty = True
 

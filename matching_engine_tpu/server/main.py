@@ -142,20 +142,32 @@ def build_server(
     # Restore a persisted call period (each host records its own flag in
     # its durable store — crossedness alone can't prove the ABSENCE of a
     # call period, e.g. non-crossing rests only).
+    from matching_engine_tpu.engine.book import auction_capacity_max
+
+    auction_ok = cfg.capacity <= auction_capacity_max()
     if storage.get_meta("auction_mode") == "1":
-        runner.auction_mode = True
-        if log:
-            print("[SERVER] durable store records an OPEN auction call "
-                  "period: resuming it")
+        if auction_ok:
+            runner.auction_mode = True
+            if log:
+                print("[SERVER] durable store records an OPEN auction call "
+                      "period: resuming it")
+        else:
+            print("[SERVER] WARNING: durable store records an open call "
+                  "period, but this venue-depth capacity cannot run "
+                  "auctions — resuming CONTINUOUS trading instead")
     # Safety net: a crossed book after recovery can only come from state
     # persisted during a call period (continuous matching never leaves
     # one standing) — resume rather than expose those books to the
     # continuous maker scan.
     crossed = runner.crossed_symbols()
-    if crossed and not runner.auction_mode:
+    if crossed and not runner.auction_mode and auction_ok:
         runner.auction_mode = True
         print(f"[SERVER] {len(crossed)} recovered book(s) stand crossed "
               f"(e.g. {crossed[0]}): resuming the auction call period")
+    elif crossed and not runner.auction_mode:
+        print(f"[SERVER] WARNING: {len(crossed)} recovered book(s) stand "
+              f"crossed at venue-depth capacity (no auctions): continuous "
+              f"matching will uncross them order by order")
     if runner.auction_mode:
         print("[SERVER] auction call period OPEN — an ALL-symbols "
               "RunAuction (empty symbol) reopens continuous trading")
@@ -362,7 +374,12 @@ def main(argv=None) -> int:
         return int(e.code or 3)
 
     if args.auction_open:
-        parts["runner"].set_auction_mode(True)
+        try:
+            parts["runner"].set_auction_mode(True)
+        except ValueError as e:  # venue-depth capacity: no call periods
+            print(f"[SERVER] --auction-open refused: {e}", file=sys.stderr)
+            shutdown(server, parts)
+            return 3
         parts["runner"].flush_auction_mode()
         print("[SERVER] auction call period OPEN (submits rest unmatched "
               "until an all-symbols RunAuction)")

@@ -240,3 +240,80 @@ def test_server_with_sorted_kernel(tmp_path):
         assert cr.success
     finally:
         shutdown(server, parts)
+
+
+def test_venue_depth_capacity_2048():
+    """CAP > 1073 (where capacity * MAX_QUANTITY wraps int32): the sorted
+    kernel's saturating prefix sum keeps allocations exact with
+    near-MAX_QUANTITY makers stacked deep; oracle parity holds."""
+    from matching_engine_tpu.engine.book import MAX_QUANTITY
+
+    cap = 2048
+    cfg = EngineConfig(num_symbols=1, capacity=cap, batch=8,
+                       max_fills=1 << 13, kernel="sorted")
+    orders = []
+    # 1200 max-quantity asks at one price: total resting qty 2.4e9 > 2^31.
+    for i in range(1200):
+        orders.append(HostOrder(0, OP_SUBMIT, SELL, LIMIT, 100,
+                                MAX_QUANTITY, oid=i + 1))
+    # A buy that sweeps the first two makers and part of the third.
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 100,
+                            2 * MAX_QUANTITY + 5, oid=9001))
+    # A buy priced away from the wall: rests.
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 99, 7, oid=9002))
+    book, d_res, d_fills = apply_sorted(cfg, init_book(cfg), orders)
+    o_res, o_fills, o_snaps = run_oracle(cfg, orders)
+    assert sorted((r.oid, r.sym, r.status, r.filled, r.remaining)
+                  for r in d_res) == sorted(o_res)
+    assert [(f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+            for f in d_fills] == [f[1:] for f in o_fills]
+    # FIFO: the sweep hit makers 1, 2, then 5 units of maker 3.
+    assert [(f.maker_oid, f.quantity) for f in d_fills] == [
+        (1, MAX_QUANTITY), (2, MAX_QUANTITY), (3, 5)]
+    assert_sorted_invariant(book)
+    assert snapshot_books(book)[0] == o_snaps[0]
+
+
+def test_matrix_kernel_capacity_gate_unchanged():
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        EngineConfig(num_symbols=1, capacity=2048, batch=4)  # matrix
+    EngineConfig(num_symbols=1, capacity=2048, batch=4, kernel="sorted")
+    with _pytest.raises(AssertionError):
+        EngineConfig(num_symbols=1, capacity=16384, batch=4,
+                     kernel="sorted")
+
+
+def test_auction_guard_at_venue_depth():
+    """RunAuction on a venue-depth config rejects the REQUEST (int32
+    volume sums could wrap) instead of risking a corrupt clear."""
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    cfg = EngineConfig(num_symbols=2, capacity=2048, batch=4,
+                       max_fills=1 << 12, kernel="sorted")
+    r = EngineRunner(cfg)
+    summary = r.run_auction()
+    assert "unsupported at capacity" in summary["error"]
+    assert summary["crossed"] == []
+
+
+def test_top_of_book_size_saturates_at_venue_depth():
+    """A price level holding > 2^31 total quantity reports the saturation
+    clamp (2^30-1), never a wrapped negative size (the pre-fix behavior:
+    finalize_step's int32 sum wrapped and market data published negative
+    sizes)."""
+    from matching_engine_tpu.engine.book import MAX_QUANTITY
+    from matching_engine_tpu.engine.harness import build_batches
+
+    cfg = EngineConfig(num_symbols=1, capacity=2048, batch=8,
+                       max_fills=1 << 12, kernel="sorted")
+    orders = [HostOrder(0, OP_SUBMIT, SELL, LIMIT, 100, MAX_QUANTITY,
+                        oid=i + 1) for i in range(1200)]
+    book = init_book(cfg)
+    out = None
+    for b in build_batches(cfg, orders):
+        book, out = engine_step_sorted(cfg, book, b)
+    ask_size = int(np.asarray(out.ask_size)[0])
+    assert ask_size == (1 << 30) - 1, ask_size
+    assert int(np.asarray(out.best_ask)[0]) == 100
