@@ -73,14 +73,6 @@ def main() -> None:
         result_row,
     )
 
-    step_fn = None
-    if args.kernel == "sorted":
-        from matching_engine_tpu.engine.kernel_sorted import (
-            engine_step_sorted,
-        )
-
-        step_fn = engine_step_sorted
-
     try:
         import subprocess
         rev = subprocess.run(
@@ -95,16 +87,14 @@ def main() -> None:
                    windows: int, iters: int) -> dict:
         cfg = EngineConfig(
             num_symbols=symbols, capacity=capacity, batch=batch,
-            max_fills=1 << 17,
+            max_fills=1 << 17, kernel=args.kernel,
         )
         value, mean_lat_us = measure_device_throughput(
             cfg, headline_streams(cfg), windows=windows, iters=iters,
-            step_fn=step_fn,
         )
         return result_row(cfg, value, mean_lat_us, platform=platform,
                           n_devices=len(devices),
-                          backend_init_s=backend_init_s, git_rev=rev,
-                          kernel=args.kernel)
+                          backend_init_s=backend_init_s, git_rev=rev)
 
     small = None
     if args.stage_symbols and args.stage_symbols < args.symbols:

@@ -12,7 +12,8 @@ Steps (priority order — most valuable first when the window is short):
   batch64/128     batch-axis scaling rows (next-step 5)
   syms64/256/1024 symbol-count sweep (next-step 7; 4096 = headline)
   cap256/512/1024 capacity sweep at S=256 (next-step 4; cap128 row too,
-                  so the curve is same-S end to end)
+                  so the curve is same-S end to end; the sorted-kernel
+                  rows extend it to 4096 at the same S)
   runner_sweep    RPC-less EngineRunner inflight sweep (next-step 2)
   e2e_pi2/pi4     full-stack dual-edge serving at pipeline inflight 2/4
   l3flow          config-3b realistic flow + reject/depth stats (step 6)
@@ -111,6 +112,11 @@ STEPS: list[dict] = [
      "timeout": 1200,
      "cmd": bench_child("tpu_r4_cap1024_sorted.json", "--symbols", "256",
                         "--capacity", "1024", "--batch", "32",
+                        "--kernel", "sorted")},
+    {"name": "cap4096s", "artifact": "tpu_r4_cap4096_sorted.json",
+     "timeout": 1200,
+     "cmd": bench_child("tpu_r4_cap4096_sorted.json", "--symbols", "256",
+                        "--capacity", "4096", "--batch", "32",
                         "--kernel", "sorted")},
     {"name": "headline_sorted", "artifact": "tpu_r4_headline_sorted.json",
      "timeout": 1200,

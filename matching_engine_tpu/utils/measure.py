@@ -42,11 +42,11 @@ def headline_streams(cfg: EngineConfig, n_streams: int = 4):
 
 def result_row(cfg: EngineConfig, value: float, lat_us: float, *,
                platform: str, n_devices: int, backend_init_s: float,
-               git_rev: str, kernel: str = "matrix") -> dict:
+               git_rev: str) -> dict:
     """The benchmark artifact row shape (shared by bench_child and the
-    resident so a schema tweak can't silently fork the two). `kernel`
-    labels the match formulation (engine/kernel.py matrix vs
-    engine/kernel_sorted.py sorted) explicitly in every row."""
+    resident so a schema tweak can't silently fork the two). The kernel
+    label comes from cfg itself — the one thing that actually selected
+    the formulation — so a row can never be mislabeled."""
     return {
         "value": value,
         "platform": platform,
@@ -56,7 +56,7 @@ def result_row(cfg: EngineConfig, value: float, lat_us: float, *,
         "batch": cfg.batch,
         "backend_init_s": round(backend_init_s, 1),
         "mean_dispatch_latency_us": round(lat_us, 1),
-        "kernel": kernel,
+        "kernel": cfg.kernel,
         "git_rev": git_rev,
     }
 
@@ -75,15 +75,14 @@ def prepare_waves(cfg: EngineConfig, streams, waves_per_stream: int = 2):
 
 
 def measure_windows(cfg: EngineConfig, book, waves, wave_ops, *,
-                    windows: int = 5, iters: int = 20, step_fn=None):
+                    windows: int = 5, iters: int = 20):
     """The timed core: `windows` fully-synced windows of `iters` steps over
     pre-device-put waves; first window discarded (ramp). Returns
     (sustained orders/sec, mean step latency µs, book') — book' so a
     long-lived caller (benchmarks/resident.py) can thread state through
-    repeated measurements without re-initializing. `step_fn` defaults to
-    the production matrix kernel; pass kernel_sorted.engine_step_sorted to
-    measure the O(CAP) formulation on the same flow."""
-    step = step_fn or engine_step
+    repeated measurements without re-initializing. The match formulation
+    is cfg.kernel (engine_step_impl dispatches on it at trace time)."""
+    step = engine_step
     real_ops = sum(wave_ops[i % len(waves)] for i in range(iters))
     rates, lats = [], []
     for _ in range(windows):
@@ -112,7 +111,6 @@ def measure_device_throughput(
     windows: int = 5,
     iters: int = 20,
     waves_per_stream: int = 2,
-    step_fn=None,
 ):
     """Returns (sustained orders/sec, mean dispatch latency in µs — the
     median across windows of each window's MEAN step latency dt/iters; a
@@ -122,14 +120,12 @@ def measure_device_throughput(
     `streams` is a list of HostOrder lists; the leading `waves_per_stream`
     dispatches of each are cycled during the timed loop.
     """
-    step = step_fn or engine_step
     waves, wave_ops = prepare_waves(cfg, streams, waves_per_stream)
 
     book = init_book(cfg)
-    book, out = step(cfg, book, waves[0])
+    book, out = engine_step(cfg, book, waves[0])
     jax.block_until_ready(out)
 
     rate, lat, _ = measure_windows(
-        cfg, book, waves, wave_ops, windows=windows, iters=iters,
-        step_fn=step)
+        cfg, book, waves, wave_ops, windows=windows, iters=iters)
     return rate, lat
