@@ -17,6 +17,7 @@ export JAX_PLATFORMS=cpu
 unset PALLAS_AXON_POOL_IPS   # a wedged axon tunnel must not hang the soak
 
 MINUTES="${1:-3}"
+SOAK_SERVER_ARGS="${SOAK_SERVER_ARGS:-}"
 WORK=$(mktemp -d)
 DB="$WORK/soak.db"
 OUT_DIR="$PWD/benchmarks/results"
@@ -27,6 +28,7 @@ make -s -C native || { echo "FAIL: native build"; exit 1; }
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --gateway-addr 127.0.0.1:0 --auction-open \
+  ${SOAK_SERVER_ARGS:-} \
   --checkpoint-dir "$WORK/ckpts" --checkpoint-interval-s 5 \
   > "$WORK/server.log" 2>&1 &
 SRV=$!
@@ -99,6 +101,7 @@ artifact = {
     "orders_ok": $OK_TOTAL, "cancels": $CANCELS,
     "audit_violations": int("$AUDIT".strip() or -1),
     "platform": "cpu", "git_rev": rev,
+    "server_args": "$SOAK_SERVER_ARGS",
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))
