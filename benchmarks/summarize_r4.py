@@ -1,0 +1,136 @@
+"""Render the round-4 capture artifacts into one markdown summary table.
+
+Reads benchmarks/results/tpu_r4_*.json, tpu_suite_full_r4.jsonl,
+tpu_e2e_r4_*.json, and the resident log; prints markdown to stdout
+(written into ROUND4.md / BENCH_METHOD.md once captures land). Missing
+artifacts are listed as pending — safe to run at any point.
+
+Usage: python benchmarks/summarize_r4.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+
+
+def load(name: str):
+    try:
+        with open(os.path.join(RESULTS, name)) as f:
+            if name.endswith(".jsonl"):
+                return [json.loads(ln) for ln in f if ln.strip()]
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def fmt(v):
+    if v is None:
+        return "—"
+    if isinstance(v, float) and v >= 1e6:
+        return f"{v / 1e6:,.1f}M"
+    if isinstance(v, (int, float)) and v >= 1e6:
+        return f"{v / 1e6:,.1f}M"
+    return f"{v:,.0f}" if isinstance(v, (int, float)) else str(v)
+
+
+def row(name, art, *cols):
+    print(f"| {name} | " + " | ".join(fmt(c) for c in cols) + f" | `{art}` |")
+
+
+def main() -> None:
+    print("## Device throughput (orders/sec, single tunneled v5e)\n")
+    print("| config | value | µs/step | kernel | artifact |")
+    print("|---|---|---|---|---|")
+    for name, art in [
+        ("4096 syms (headline)", "tpu_r4_headline.json"),
+        ("4096 syms, sorted", "tpu_r4_headline_sorted.json"),
+        ("batch 64", "tpu_r4_batch64.json"),
+        ("batch 128", "tpu_r4_batch128.json"),
+        ("64 syms", "tpu_r4_syms64.json"),
+        ("256 syms", "tpu_r4_syms256.json"),
+        ("1024 syms", "tpu_r4_syms1024.json"),
+        ("cap 128 (S=256)", "tpu_r4_cap128.json"),
+        ("cap 256", "tpu_r4_cap256.json"),
+        ("cap 512", "tpu_r4_cap512.json"),
+        ("cap 1024", "tpu_r4_cap1024.json"),
+        ("cap 128 sorted", "tpu_r4_cap128_sorted.json"),
+        ("cap 512 sorted", "tpu_r4_cap512_sorted.json"),
+        ("cap 1024 sorted", "tpu_r4_cap1024_sorted.json"),
+        ("cap 4096 sorted", "tpu_r4_cap4096_sorted.json"),
+        ("L3 realistic (3b)", "tpu_r4_l3flow.json"),
+    ]:
+        d = load(art)
+        if d is None:
+            row(name, art, None, None, None)
+        else:
+            row(name, art, d.get("value"),
+                d.get("mean_dispatch_latency_us"),
+                d.get("kernel", "matrix"))
+
+    print("\n## Suite (full scale)\n")
+    suite = load("tpu_suite_full_r4.jsonl") or load(
+        "tpu_suite_full_r4.jsonl.partial") or []
+    if suite:
+        print("| config | metric | value | unit |")
+        print("|---|---|---|---|")
+        for r in suite:
+            print(f"| {r.get('config')} | {r.get('metric')} | "
+                  f"{fmt(r.get('value'))} | {r.get('unit')} |")
+    else:
+        print("pending")
+
+    print("\n## Serving stack\n")
+    rb = load("tpu_r4_runner.json")
+    if rb:
+        print("| inflight | orders/s | p50 ms | p99 ms |")
+        print("|---|---|---|---|")
+        for p in rb.get("sweep", []):
+            print(f"| {p['inflight']} | {fmt(p['orders_per_s'])} | "
+                  f"{p['p50_ms']} | {p['p99_ms']} |")
+    else:
+        print("runner sweep pending")
+    print()
+    print("| edge | pi | orders/s | p50 ms | p99 ms | p99/p50 |")
+    print("|---|---|---|---|---|---|")
+    for edge in ("native", "grpcio"):
+        for pi in (2, 4):
+            d = load(f"tpu_e2e_r4_{edge}_pi{pi}.json")
+            if d is None:
+                print(f"| {edge} | {pi} | — | — | — | — |")
+            else:
+                ratio = (d["p99_ms"] / d["p50_ms"]) if d.get("p50_ms") else 0
+                print(f"| {edge} | {pi} | {fmt(d.get('value'))} | "
+                      f"{d.get('p50_ms')} | {d.get('p99_ms')} | "
+                      f"{ratio:.1f}x |")
+
+    print("\n## Kernel profile\n")
+    pk = load("tpu_r4_profile.json")
+    if pk:
+        print(f"- full step: {pk['full_step_us']}µs "
+              f"({fmt(pk['orders_per_s'])} orders/s at "
+              f"{pk['ops_per_step']} ops/step)")
+        print(f"- phases: scan {pk['phase_scan_us']}µs + finalize "
+              f"{pk['phase_finalize_us']}µs (sum/full = "
+              f"{pk['phase_sum_vs_full']})")
+        rl = pk.get("roofline") or {}
+        if rl:
+            print(f"- roofline: {fmt(rl['bytes_per_step'])} bytes/step, "
+                  f"{rl['bytes_per_op']} bytes/op, "
+                  f"{rl['achieved_hbm_gbps']} GB/s achieved = "
+                  f"{rl['fraction_of_hbm_peak']:.1%} of v5e HBM peak")
+        print(f"- device trace: {pk.get('device_trace')}")
+    else:
+        print("pending")
+
+    res = load("tpu_resident_log.jsonl")
+    if res:
+        best = max(r["value"] for r in res)
+        print(f"\n## Resident: {len(res)} warm measurements, "
+              f"best {fmt(best)} orders/s")
+
+
+if __name__ == "__main__":
+    main()
