@@ -19,7 +19,7 @@ RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
 def load(name: str):
     try:
         with open(os.path.join(RESULTS, name)) as f:
-            if name.endswith(".jsonl"):
+            if ".jsonl" in name:  # incl. .jsonl.partial salvage files
                 return [json.loads(ln) for ln in f if ln.strip()]
             return json.load(f)
     except (OSError, ValueError):
@@ -29,8 +29,6 @@ def load(name: str):
 def fmt(v):
     if v is None:
         return "—"
-    if isinstance(v, float) and v >= 1e6:
-        return f"{v / 1e6:,.1f}M"
     if isinstance(v, (int, float)) and v >= 1e6:
         return f"{v / 1e6:,.1f}M"
     return f"{v:,.0f}" if isinstance(v, (int, float)) else str(v)
