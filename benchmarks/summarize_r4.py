@@ -115,10 +115,13 @@ def main() -> None:
               f"{pk['phase_sum_vs_full']})")
         rl = pk.get("roofline") or {}
         if rl:
+            gbps = rl.get("logical_bytes_gbps",
+                          rl.get("achieved_hbm_gbps", 0.0))
             print(f"- roofline: {fmt(rl['bytes_per_step'])} bytes/step, "
                   f"{rl['bytes_per_op']} bytes/op, "
-                  f"{rl['achieved_hbm_gbps']} GB/s achieved = "
-                  f"{rl['fraction_of_hbm_peak']:.1%} of v5e HBM peak")
+                  f"{gbps} GB/s logical = "
+                  f"{rl['fraction_of_hbm_peak']:.1%} of v5e HBM peak "
+                  f"(>100% => fused on-chip traffic, not HBM-bound)")
         print(f"- device trace: {pk.get('device_trace')}")
     else:
         print("pending")

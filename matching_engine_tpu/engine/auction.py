@@ -53,9 +53,11 @@ IMAX = jnp.iinfo(jnp.int32).max
 class AuctionOutput(NamedTuple):
     """Packed device output — ONE small readback + the fill log:
 
-    small: [6S + 2] int32 = clear_price | executed (each [S]; 0 when the
-           symbol did not cross) ++ best_bid | bid_size | best_ask |
-           ask_size (each [S], POST-auction) ++ [fill_count, aborted].
+    small: [7S + 2] int32 = clear_price | exec_lo | exec_hi (each [S];
+           executed volume = exec_hi * 2^15 + exec_lo, split because a
+           venue-depth uncross can exceed int32; 0 when the symbol did
+           not cross) ++ best_bid | bid_size | best_ask | ask_size
+           (each [S], POST-auction) ++ [fill_count, aborted].
     fills: [5, max_fills] int32, harness.decode_fills column order —
            (sym, taker_oid = bid, maker_oid = ask, price = p*, qty).
     """
@@ -196,6 +198,43 @@ def zero_unless(x, ok):
     return x * jnp.where(ok, 1, 0).astype(I32)
 
 
+def uncross_and_records(cfg: EngineConfig, book: BookBatch, mask):
+    """Formulation dispatch shared by the single-device and sharded
+    paths: returns (fill_b, fill_a [S, C] in lane order, p_star [S],
+    exec_hi, exec_lo [S] — executed volume as base-2^15 limbs,
+    rec_taker, rec_maker, rec_qty [S, R], rec_counts [S]) where R is the
+    formulation's per-symbol record-lane count.
+
+    Matrix-kernel books use the [C, C] formulation above (its int32
+    volume sums are exact at matrix capacities — EngineConfig pins
+    capacity <= 1024 < 2^31 / MAX_QUANTITY); sorted-kernel books use the
+    O(C log C) wide-sum formulation (engine/auction_sorted.py), exact at
+    any supported depth."""
+    if cfg.kernel == "sorted":
+        from matching_engine_tpu.engine.auction_sorted import (
+            _uncross_records_one,
+        )
+
+        (fill_b, fill_a, p_star, exec_hi, exec_lo, rec_taker, rec_maker,
+         rec_qty, rec_counts) = jax.vmap(_uncross_records_one)(
+            book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
+            book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq,
+            mask,
+        )
+    else:
+        fill_b, fill_a, p_star, q_exec, start_b, start_a = jax.vmap(
+            _uncross_one)(
+            book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
+            book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq,
+            mask,
+        )
+        rec_taker, rec_maker, rec_qty, rec_counts = jax.vmap(_records_one)(
+            fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
+        exec_hi, exec_lo = q_exec >> 15, q_exec & 0x7FFF
+    return (fill_b, fill_a, p_star, exec_hi, exec_lo,
+            rec_taker, rec_maker, rec_qty, rec_counts)
+
+
 @partial(jax.jit, static_argnums=0, donate_argnums=1)
 def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     """Uncross every masked symbol's book at its clearing price.
@@ -204,15 +243,9 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     AuctionOutput). All-or-nothing: if the bilateral record log would
     overflow cfg.max_fills, NOTHING is applied and `aborted` is set.
     """
-    s_dim, cap = cfg.num_symbols, cfg.capacity
-    fill_b, fill_a, p_star, q_exec, start_b, start_a = jax.vmap(_uncross_one)(
-        book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
-        book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq, mask,
-    )
-
-    # Stage 1: per-symbol record compaction, [S, 2C-1] lanes.
-    rec_taker, rec_maker, rec_qty, rec_counts = jax.vmap(_records_one)(
-        fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
+    s_dim = cfg.num_symbols
+    (fill_b, fill_a, p_star, exec_hi, exec_lo, rec_taker, rec_maker,
+     rec_qty, rec_counts) = uncross_and_records(cfg, book, mask)
 
     total = jnp.sum(rec_counts)
     n = cfg.max_fills
@@ -222,9 +255,9 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
                              kernel=cfg.kernel)
 
-    # Stage 2: global compaction over the [S, 2C-1] lanes (row-major, so
-    # records stay symbol-major in per-symbol rank order).
-    r = 2 * cap - 1
+    # Stage 2: global compaction over the per-symbol record lanes
+    # (row-major, so records stay symbol-major in per-symbol rank order).
+    r = rec_qty.shape[1]
     sym_ids = jnp.broadcast_to(
         jnp.arange(s_dim, dtype=I32)[:, None], (s_dim, r))
     price = jnp.broadcast_to(p_star[:, None], (s_dim, r))
@@ -235,7 +268,8 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
     best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
     small = jnp.concatenate([
         zero_unless(p_star, ~aborted),
-        zero_unless(q_exec, ~aborted),
+        zero_unless(exec_lo, ~aborted),
+        zero_unless(exec_hi, ~aborted),
         best_bid, bid_size, best_ask, ask_size,
         jnp.stack([
             jnp.where(aborted, 0, jnp.minimum(total, n)).astype(I32),
@@ -267,15 +301,17 @@ def decode_auction(cfg: EngineConfig, out: AuctionOutput):
 
     small = np.asarray(out.small)
     s = cfg.num_symbols
+    executed = (small[2 * s:3 * s].astype(np.int64) << 15) \
+        + small[s:2 * s]
     dec = AuctionDecoded(
         clear_price=small[0:s],
-        executed=small[s:2 * s],
-        best_bid=small[2 * s:3 * s],
-        bid_size=small[3 * s:4 * s],
-        best_ask=small[4 * s:5 * s],
-        ask_size=small[5 * s:6 * s],
-        fill_count=int(small[6 * s]),
-        aborted=bool(small[6 * s + 1]),
+        executed=executed,
+        best_bid=small[3 * s:4 * s],
+        bid_size=small[4 * s:5 * s],
+        best_ask=small[5 * s:6 * s],
+        ask_size=small[6 * s:7 * s],
+        fill_count=int(small[7 * s]),
+        aborted=bool(small[7 * s + 1]),
     )
     if dec.fill_count:
         packed = np.asarray(out.fills)

@@ -28,6 +28,8 @@ measure_device_throughput consume it unchanged (tests/test_flow.py).
 
 from __future__ import annotations
 
+import bisect
+import itertools
 import random
 
 from matching_engine_tpu.engine.harness import HostOrder
@@ -77,11 +79,18 @@ def realistic_order_stream(
     burst_left = 0
     burst_pool: list[int] = []
 
+    # Inverse-CDF sampling: O(log S) per draw via bisect on the
+    # cumulative weights, computed ONCE — rng.choices re-accumulates its
+    # weight list on every call, which made stream generation
+    # O(n_ops * num_symbols) and dominated bench setup at S=4096
+    # (ADVICE r4 low / VERDICT r4 next-step 7).
+    cum_w = list(itertools.accumulate(weights))
+    total_w = cum_w[-1]
+
     def pick_symbol() -> int:
         if burst_left > 0:
             return rng.choice(burst_pool)
-        # rng.choices is O(n) per call with weights; sample in blocks.
-        return perm[rng.choices(range(num_symbols), weights=weights, k=1)[0]]
+        return perm[bisect.bisect_right(cum_w, rng.random() * total_w)]
 
     while len(orders) < n_ops:
         if burst_left > 0:
@@ -93,7 +102,10 @@ def realistic_order_stream(
                           rng.sample(range(min(16, num_symbols)),
                                      k=min(burst_symbols - 1, 16,
                                            num_symbols))]
-            burst_pool.append(perm[rng.randrange(num_symbols)])
+            if num_symbols > 16:  # one tail name, distinct from the head
+                burst_pool.append(perm[rng.randrange(16, num_symbols)])
+            if not burst_pool:  # burst_symbols=1 at tiny S: never empty
+                burst_pool.append(perm[rng.randrange(num_symbols)])
         sym = pick_symbol()
 
         is_deep = sym in deep

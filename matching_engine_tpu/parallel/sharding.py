@@ -204,35 +204,27 @@ class ShardedEngine:
         [i*max_fills, i*max_fills + count[i])), same as the continuous
         step — decode reads addressable shards only."""
         from matching_engine_tpu.engine.auction import (
-            _records_one,
-            _uncross_one,
             apply_uncross,
             compact_records,
+            uncross_and_records,
             zero_unless,
         )
 
         local_cfg = self.local_cfg
         local_s = local_cfg.num_symbols
-        cap = local_cfg.capacity
         n = local_cfg.max_fills
         mesh = self.mesh
 
         def local_auction(book: BookBatch, mask):
-            fill_b, fill_a, p_star, q_exec, start_b, start_a = jax.vmap(
-                _uncross_one)(
-                book.bid_price, book.bid_qty, book.bid_oid, book.bid_seq,
-                book.ask_price, book.ask_qty, book.ask_oid, book.ask_seq,
-                mask,
-            )
-            rec_taker, rec_maker, rec_qty, rec_counts = jax.vmap(
-                _records_one)(
-                fill_b, fill_a, start_b, start_a, book.bid_oid, book.ask_oid)
+            (fill_b, fill_a, p_star, exec_hi, exec_lo, rec_taker,
+             rec_maker, rec_qty, rec_counts) = uncross_and_records(
+                local_cfg, book, mask)
             local_total = jnp.sum(rec_counts)
             # PER-SHARD all-or-nothing (no collective — see docstring).
             aborted = local_total > n
             new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
                                      kernel=local_cfg.kernel)
-            r = 2 * cap - 1
+            r = rec_qty.shape[1]
             off = jax.lax.axis_index(AXIS).astype(I32) * local_s
             sym_ids = jnp.broadcast_to(
                 jnp.arange(local_s, dtype=I32)[:, None], (local_s, r)) + off
@@ -247,7 +239,8 @@ class ShardedEngine:
                 new_book.ask_price, new_book.ask_qty, False)
             return new_book, (
                 zero_unless(p_star, ~aborted),
-                zero_unless(q_exec, ~aborted),
+                zero_unless(exec_lo, ~aborted),
+                zero_unless(exec_hi, ~aborted),
                 best_bid, bid_size, best_ask, ask_size,
                 f_sym, f_taker, f_maker, f_price, f_qty,
                 jnp.where(aborted, 0, jnp.minimum(local_total, n))
@@ -257,8 +250,7 @@ class ShardedEngine:
 
         out_specs = (
             _book_specs(),
-            (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-             P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            (P(AXIS),) * 14,
         )
         mapped = jax.shard_map(
             local_auction,
@@ -326,20 +318,23 @@ class ShardedEngine:
         `aborted_flags` (this host's per-shard abort booleans) and
         `shard_lo` (its first shard index) so callers can resolve WHICH
         symbols were hit: symbol slot // local_symbols -> shard."""
-        (clear_p, executed, bb, bs, ba, asz,
+        import numpy as np
+
+        (clear_p, exec_lo, exec_hi, bb, bs, ba, asz,
          f_sym, f_taker, f_maker, f_price, f_qty, counts, aborted) = out
         clear_local, lo, _ = hostlocal.local_block(clear_p)
+        executed = (
+            np.asarray(hostlocal.local_block(exec_hi)[0]).astype(np.int64)
+            << 15) + np.asarray(hostlocal.local_block(exec_lo)[0])
         view = {
             "lo": lo,
             "clear_price": clear_local,
-            "executed": hostlocal.local_block(executed)[0],
+            "executed": executed,
             "best_bid": hostlocal.local_block(bb)[0],
             "bid_size": hostlocal.local_block(bs)[0],
             "best_ask": hostlocal.local_block(ba)[0],
             "ask_size": hostlocal.local_block(asz)[0],
         }
-        import numpy as np
-
         fills = self._decode_shard_fills(counts, {
             "sym": f_sym, "taker": f_taker, "maker": f_maker,
             "price": f_price, "qty": f_qty,

@@ -226,6 +226,14 @@ class EngineRunner:
         self._owner_claimed: dict[int, str] = {}
         self._owner_registry_cap = 1_000_000
         self.pending_owner_ids: list[tuple[str, int]] = []
+        # Serializes flush_owner_ids callers (drain loop, idle wakeup,
+        # auction, checkpoint daemon, recovery) against each other.
+        # Producers append under the dispatch lock and are NOT required
+        # to hold this one: the flush only ever mutates the list
+        # IN PLACE (del prefix / insert front), so a concurrent append —
+        # atomic under the GIL, always at the tail — can never be lost
+        # the way the old swap-rebind could drop it (ADVICE r4 medium).
+        self._owner_flush_lock = threading.Lock()
         self.persist_owner_ids = None  # callable(list) -> bool | None
         # Call-auction accumulation mode: while True, both serving edges
         # submit orders as OP_REST (rest without matching — books may
@@ -762,17 +770,16 @@ class EngineRunner:
 
         from matching_engine_tpu.engine.book import auction_capacity_max
 
-        if self.cfg.capacity > auction_capacity_max():
-            # The auction kernel's demand/supply sums accumulate at int32
-            # lane width; a venue-depth (sorted-kernel) capacity could
-            # wrap them. Continuous matching at that depth is supported
-            # (saturating prefix sums, kernel_sorted.py) — the uncross is
-            # not, yet. Reject the REQUEST, never corrupt a clear.
+        if self.cfg.capacity > auction_capacity_max(self.cfg.kernel):
+            # Defensive: unreachable for every EngineConfig the
+            # constructor admits (matrix <= 1024 < 1073; sorted <= 8192
+            # with the wide-sum uncross) — kept so a future capacity
+            # bump cannot silently run a wrapping uncross.
             return {"crossed": [], "aborted": False, "warning": "",
                     "error": f"call auction unsupported at capacity "
-                             f"{self.cfg.capacity} (int32 volume sums "
-                             f"could wrap); max supported is "
-                             f"{auction_capacity_max()}"}
+                             f"{self.cfg.capacity} (kernel "
+                             f"{self.cfg.kernel}); max supported is "
+                             f"{auction_capacity_max(self.cfg.kernel)}"}
         mask = np.zeros((self.cfg.num_symbols,), dtype=bool)
         with self._id_lock:
             allocated = list(self.symbols.items())
@@ -1174,11 +1181,18 @@ class EngineRunner:
         if len(self._owner_by_client) >= self._owner_registry_cap:
             # Bounded like the pre-registry watch map: past the cap (a
             # client-id churn attack / misconfigured id-per-order client)
-            # new ids fall back to the raw hash UNREGISTERED — collision
-            # risk returns for the overflow tail only, counted, and the
-            # registry/db stop growing.
+            # new ids probe UNREGISTERED — the registry/db stop growing
+            # and the id is not remembered, so two overflow clients with
+            # the same hash can still merge (counted residual risk). But
+            # the probe MUST still skip claimed ids: returning a raw hash
+            # that a registered client was remapped AWAY from would merge
+            # the overflow client with a client whose id doesn't even
+            # hash-collide (ADVICE r4 low).
             self.metrics.inc("owner_registry_overflow")
-            return owner_hash(client_id)
+            owner = owner_hash(client_id)
+            while owner in self._owner_claimed or owner == 0:
+                owner = (owner + 1) & 0x7FFFFFFF
+            return owner
         owner = owner_hash(client_id)
         if owner in self._owner_claimed:
             self.metrics.inc("owner_hash_collisions")
@@ -1201,12 +1215,26 @@ class EngineRunner:
             self._owner_claimed[owner] = client_id
 
     def flush_owner_ids(self) -> None:
-        """Drain pending first-sight assignments to the durable registry
-        (call with no engine locks held). A failed write stays queued and
-        self-heals at the next flush point, like flush_auction_mode."""
+        """Drain pending first-sight assignments to the durable registry.
+        A failed write stays queued and self-heals at the next flush
+        point, like flush_auction_mode.
+
+        Locking: normally called with no engine locks held (a SQLite
+        busy-wait must stay off the dispatch critical path), with ONE
+        deliberate exception — CheckpointDaemon.checkpoint_now calls this
+        under the dispatch lock as part of the snapshot durability
+        barrier (checkpointed book lanes freeze assigned owner ints, so
+        the assignments must be durable first); that write is bounded by
+        the storage layer's busy_timeout. Concurrent flush callers
+        serialize on _owner_flush_lock; see its init comment for why
+        producers don't need it."""
         if not self.pending_owner_ids or self.persist_owner_ids is None:
             return
-        batch, self.pending_owner_ids = self.pending_owner_ids, []
+        with self._owner_flush_lock:
+            batch = list(self.pending_owner_ids)
+            del self.pending_owner_ids[:len(batch)]
+        if not batch:
+            return
         try:
             ok = self.persist_owner_ids(batch)
         except Exception as e:  # noqa: BLE001 — never unwind into callers
@@ -1215,24 +1243,26 @@ class EngineRunner:
             ok = False
         if ok is False:
             self.metrics.inc("meta_persist_failures")
-            self.pending_owner_ids = batch + self.pending_owner_ids
+            with self._owner_flush_lock:
+                self.pending_owner_ids[:0] = batch
 
     def set_auction_mode(self, value: bool) -> None:
         """Flip the call-period flag and mark it dirty; the durable write
         happens in flush_auction_mode, OUTSIDE the dispatch lock — a
         SQLite busy-wait must never sit on the dispatch critical path.
 
-        Venue-depth engines (capacity past the auction bound) refuse to
-        OPEN a call period: rested interest could never be uncrossed
-        (run_auction rejects at that depth), so the period could only be
-        ended out-of-band."""
+        Every admissible EngineConfig can uncross (wide-sum formulation
+        at sorted venue depth), but the guard stays: a config whose
+        rested interest could never be uncrossed must not OPEN a call
+        period, or the period could only be ended out-of-band."""
         from matching_engine_tpu.engine.book import auction_capacity_max
 
-        if value and self.cfg.capacity > auction_capacity_max():
+        if value and self.cfg.capacity > auction_capacity_max(
+                self.cfg.kernel):
             raise ValueError(
                 f"call periods unsupported at capacity "
                 f"{self.cfg.capacity} (auction bound "
-                f"{auction_capacity_max()})")
+                f"{auction_capacity_max(self.cfg.kernel)})")
         self.auction_mode = value
         self._mode_dirty = True
 

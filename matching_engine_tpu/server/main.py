@@ -86,7 +86,7 @@ def build_server(
     db_path: str,
     cfg: EngineConfig,
     window_ms: float = 2.0,
-    rpc_workers: int = 32,
+    rpc_workers: int = 256,
     log: bool = True,
     checkpoint_dir: str | None = None,
     checkpoint_interval_s: float = 30.0,
@@ -144,7 +144,7 @@ def build_server(
     # call period, e.g. non-crossing rests only).
     from matching_engine_tpu.engine.book import auction_capacity_max
 
-    auction_ok = cfg.capacity <= auction_capacity_max()
+    auction_ok = cfg.capacity <= auction_capacity_max(cfg.kernel)
     if storage.get_meta("auction_mode") == "1":
         if auction_ok:
             runner.auction_mode = True
@@ -153,8 +153,8 @@ def build_server(
                       "period: resuming it")
         else:
             print("[SERVER] WARNING: durable store records an open call "
-                  "period, but this venue-depth capacity cannot run "
-                  "auctions — resuming CONTINUOUS trading instead")
+                  "period, but this capacity cannot run auctions — "
+                  "resuming CONTINUOUS trading instead")
     # Safety net: a crossed book after recovery can only come from state
     # persisted during a call period (continuous matching never leaves
     # one standing) — resume rather than expose those books to the
@@ -165,9 +165,18 @@ def build_server(
         print(f"[SERVER] {len(crossed)} recovered book(s) stand crossed "
               f"(e.g. {crossed[0]}): resuming the auction call period")
     elif crossed and not runner.auction_mode:
-        print(f"[SERVER] WARNING: {len(crossed)} recovered book(s) stand "
-              f"crossed at venue-depth capacity (no auctions): continuous "
-              f"matching will uncross them order by order")
+        # Unreachable for every admissible EngineConfig (auction_ok holds
+        # at all supported capacities since the wide-sum uncross), kept
+        # as a REFUSAL: serving continuous trading over standing
+        # maker-maker crosses breaks the invariant every STP/recovery
+        # argument rests on (ADVICE r4 low) — the operator must restart
+        # at an auction-capable capacity to uncross.
+        print(f"[SERVER] FATAL: {len(crossed)} recovered book(s) stand "
+              f"crossed (e.g. {crossed[0]}) and this capacity cannot run "
+              f"auctions; refusing to serve a crossed book under "
+              f"continuous matching. Restart at an auction-capable "
+              f"capacity to uncross.")
+        raise SystemExit(1)  # same typed exit as an unusable store
     if runner.auction_mode:
         print("[SERVER] auction call period OPEN — an ALL-symbols "
               "RunAuction (empty symbol) reopens continuous trading")
@@ -312,7 +321,7 @@ def main(argv=None) -> int:
                    help="staged-but-undecoded dispatches kept in flight "
                         "(decode stays FIFO; >1 hides the per-batch decode "
                         "sync round trip on a tunneled chip)")
-    p.add_argument("--rpc-workers", type=int, default=32)
+    p.add_argument("--rpc-workers", type=int, default=256)
     p.add_argument("--checkpoint-dir", default=None,
                    help="enable periodic device-book checkpoints here")
     p.add_argument("--checkpoint-interval-s", type=float, default=30.0)

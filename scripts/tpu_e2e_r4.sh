@@ -18,6 +18,8 @@ CLIENTS="${TPU_E2E_CLIENTS:-32}"
 PER_CLIENT="${TPU_E2E_PER_CLIENT:-2000}"
 INFLIGHT="${TPU_E2E_INFLIGHT:-8}"
 BOOT_TIMEOUT="${TPU_E2E_BOOT_TIMEOUT_S:-300}"
+RPC_WORKERS="${TPU_E2E_RPC_WORKERS:-256}"
+SUFFIX="${TPU_E2E_SUFFIX:-}"   # distinguishes artifact variants (e.g. _w256)
 
 log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] [e2e pi$K] $*" >>"$LOG"; }
 
@@ -26,6 +28,7 @@ PYTHONUNBUFFERED=1 PYTHONPATH="${PYTHONPATH:-}:$REPO" \
   python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 64 --capacity 256 \
   --batch 16 --pipeline-inflight "$K" --gateway-addr 127.0.0.1:0 \
+  --rpc-workers "$RPC_WORKERS" \
   >"$work/server.log" 2>&1 &
 srv=$!
 cleanup() {
@@ -57,7 +60,7 @@ ok=0
 for edge_port in "native:$gw_port" "grpcio:$py_port"; do
   edge="${edge_port%%:*}"
   port="${edge_port##*:}"
-  out="$OUT_DIR/tpu_e2e_r4_${edge}_pi${K}.json"
+  out="$OUT_DIR/tpu_e2e_r4_${edge}_pi${K}${SUFFIX}.json"
   if timeout 600 "$CLI" bench "127.0.0.1:$port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" \
       >"$out.tmp" 2>>"$LOG"; then
     mv "$out.tmp" "$out"

@@ -64,12 +64,10 @@ def canon_oracle(sym, fills):
                   for f in fills)
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
-def test_auction_matches_oracle(seed):
-    book, oracles = build_crossed_books(CFG, seed)
-    mask = np.ones((CFG.num_symbols,), dtype=bool)
-    new_book, out = auction_step(CFG, book, mask)
-    dec, fills = decode_auction(CFG, out)
+def _assert_auction_oracle_parity(cfg, book, oracles):
+    mask = np.ones((cfg.num_symbols,), dtype=bool)
+    new_book, out = auction_step(cfg, book, mask)
+    dec, fills = decode_auction(cfg, out)
     assert not dec.aborted
 
     expected = []
@@ -89,9 +87,93 @@ def test_auction_matches_oracle(seed):
         assert snaps[s] == ob.snapshot(), f"symbol {s} post-auction book"
 
     # Conservation: per symbol the bilateral records sum to the volume.
-    for s in range(CFG.num_symbols):
+    for s in range(cfg.num_symbols):
         vol = sum(f.quantity for f in fills if f.sym == s)
         assert vol == int(dec.executed[s])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_auction_matches_oracle(seed):
+    book, oracles = build_crossed_books(CFG, seed)
+    _assert_auction_oracle_parity(CFG, book, oracles)
+
+
+CFG_SORTED = EngineConfig(num_symbols=8, capacity=32, batch=8,
+                          max_fills=1 << 12, kernel="sorted")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_auction_matches_oracle_sorted_formulation(seed):
+    """The O(C log C) wide-sum uncross (engine/auction_sorted.py) pins to
+    the same oracle — including the _compact repack that restores the
+    sorted kernel's dense-prefix invariant after the decrements."""
+    book, oracles = build_crossed_books(CFG_SORTED, seed)
+    _assert_auction_oracle_parity(CFG_SORTED, book, oracles)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sorted_formulation_matches_matrix_formulation(seed):
+    """Formulation cross-check: identical decoded outputs from the
+    [C, C] matrix uncross and the sorted-merge uncross on the same
+    resting state (books rebuilt per run — auction_step donates)."""
+    book_m, _ = build_crossed_books(CFG, seed)
+    book_s, _ = build_crossed_books(CFG_SORTED, seed)
+    mask = np.ones((CFG.num_symbols,), dtype=bool)
+    _, out_m = auction_step(CFG, book_m, mask)
+    _, out_s = auction_step(CFG_SORTED, book_s, mask)
+    dec_m, fills_m = decode_auction(CFG, out_m)
+    dec_s, fills_s = decode_auction(CFG_SORTED, out_s)
+    np.testing.assert_array_equal(dec_m.clear_price, dec_s.clear_price)
+    np.testing.assert_array_equal(dec_m.executed, dec_s.executed)
+    assert canon(fills_m) == canon(fills_s)
+
+
+def test_auction_at_venue_depth_exact_wide_sums():
+    """Capacity 8192 with near-MAX_QUANTITY volumes: the executed volume
+    exceeds int32, the clearing price still needs EXACT demand/supply
+    comparisons, and the uncross must match the oracle's Python-int
+    arithmetic bit for bit (VERDICT r4 missing #4 / next-step 3)."""
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+
+    cap = 8192
+    cfg = EngineConfig(num_symbols=1, capacity=cap, batch=8,
+                       max_fills=1 << 14, kernel="sorted")
+    rng = np.random.default_rng(11)
+    n_side = 1200
+    arr = {f: np.zeros((1, cap), dtype=np.int32)
+           for f in BookBatch._fields if f != "next_seq"}
+    ob = OracleBook(cap)
+    oid = 1
+    seq = 0
+    for side in ("bid", "ask"):
+        for k in range(n_side):
+            # Disjoint bands (every bid above every ask) so both sides
+            # execute ~fully and the volume clears 2^31.
+            price = int(10_002 + rng.integers(0, 4)) if side == "bid" \
+                else int(9_995 + rng.integers(0, 4))
+            qty = int(MAX_QUANTITY - rng.integers(0, 1000))
+            arr[f"{side}_price"][0, k] = price
+            arr[f"{side}_qty"][0, k] = qty
+            arr[f"{side}_oid"][0, k] = oid
+            arr[f"{side}_seq"][0, k] = seq
+            (ob.bids if side == "bid" else ob.asks).append(
+                _Resting(oid, price, qty, seq))
+            oid += 1
+            seq += 1
+    ob.next_seq = seq
+    book = BookBatch(**{k: jnp.asarray(v) for k, v in arr.items()},
+                     next_seq=jnp.asarray(np.array([seq], np.int32)))
+
+    new_book, out = auction_step(cfg, book, np.ones((1,), dtype=bool))
+    dec, fills = decode_auction(cfg, out)
+    assert not dec.aborted
+    p, q, ofills = ob.auction()
+    assert q > 2**31, "fuzz did not reach the wide-sum regime"
+    assert int(dec.clear_price[0]) == p
+    assert int(dec.executed[0]) == q
+    assert canon(fills) == canon_oracle(0, ofills)
+    assert sum(f.quantity for f in fills) == q
+    assert snapshot_books(new_book)[0] == ob.snapshot()
 
 
 def test_auction_mask_scopes_the_uncross():
