@@ -55,12 +55,15 @@ RESIDENT_HEARTBEAT_FRESH_S = 120.0
 RESIDENT_DIR = os.path.join(REPO, "benchmarks", ".resident")
 
 # North-star config (BASELINE.json): 4k symbols; batch 32 amortizes dispatch
-# overhead over a longer in-kernel scan (matrix kernel — the headline
-# formulation). --stage-symbols writes a salvageable small-config TPU
-# figure first. The CPU fallback runs a reduced config sized to finish
-# inside budget.
+# overhead over a longer in-kernel scan. The headline formulation is the
+# SORTED kernel — decided from hardware on 2026-07-31 (round-5 window):
+# 2.21B orders/s vs the matrix kernel's 1.26B at this exact shape
+# (tpu_r4_headline_sorted.json vs tpu_r4_headline.json; analysis in
+# docs/DESIGN.md §6d). --stage-symbols writes a salvageable small-config
+# TPU figure first. The CPU fallback runs a reduced config sized to
+# finish inside budget.
 TPU_ARGS = ["--symbols", "4096", "--capacity", "128", "--batch", "32",
-            "--stage-symbols", "512"]
+            "--kernel", "sorted", "--stage-symbols", "512"]
 # The CPU fallback uses the sorted-book kernel: 3.7x the matrix kernel's
 # throughput on the host backend at this config (63.4k vs 17.1k orders/s
 # measured 2026-07-30) — the row carries its kernel label.

@@ -307,11 +307,13 @@ def config6_auction(full: bool):
             "bid_qty": rng.integers(1, 100, shape, dtype=np.int32),
             "bid_oid": np.arange(1, s * cap + 1, dtype=np.int32).reshape(shape),
             "bid_seq": np.tile(np.arange(cap, dtype=np.int32), (s, 1)),
+            "bid_owner": np.zeros(shape, dtype=np.int32),
             "ask_price": rng.integers(9_950, 10_011, shape, dtype=np.int32),
             "ask_qty": rng.integers(1, 100, shape, dtype=np.int32),
             "ask_oid": np.arange(s * cap + 1, 2 * s * cap + 1,
                                  dtype=np.int32).reshape(shape),
             "ask_seq": np.tile(np.arange(cap, dtype=np.int32), (s, 1)),
+            "ask_owner": np.zeros(shape, dtype=np.int32),
             "next_seq": np.full((s,), cap, dtype=np.int32),
         }
 

@@ -31,7 +31,8 @@ cross-host lockstep. Only `all_top_of_book` and any future cross-symbol
 collective require every process to participate in the same call.
 Order-id scope: each host's runner issues "OID-<n>" within its own gateway
 and SQLite (symbols are routed home), so ids are unique per home host;
-an aggregator joining multiple hosts' stores must namespace by host.
+`aggregate_host_stores` below is the namespacing join an operator uses to
+read several hosts' stores as one venue-wide view.
 Proven end to end by tests/test_multiprocess.py (two real processes,
 localhost coordinator, 4+4 virtual CPU devices).
 """
@@ -192,3 +193,63 @@ def local_symbol_slice(mesh: Mesh, num_symbols: int) -> slice:
             "make_multihost_mesh() so symbol ownership is a single range"
         )
     return slice(lo * per, (hi + 1) * per)
+
+
+def aggregate_host_stores(host_dbs: list[tuple[str, str]]) -> dict:
+    """Join several home-hosts' durable stores into one namespaced view.
+
+    Each host's runner issues "OID-<n>" within its OWN gateway and SQLite
+    (symbols are routed home), so order ids are unique per host but
+    COLLIDE across hosts. This is the aggregator the module docstring's
+    caveat promised (VERDICT r4 next-step 9): ids are namespaced
+    "<host>/<order_id>", fills keep referential integrity inside their
+    host's namespace, and a cross-host home violation (the same SYMBOL
+    served by two stores — the one thing routing must prevent) is
+    reported rather than silently merged.
+
+    host_dbs: [(host_name, sqlite_path)]. Returns {"orders": {nsid: row},
+    "fills": [row], "symbol_conflicts": [(symbol, [hosts])]}.
+    """
+    import sqlite3
+
+    hosts = [h for h, _ in host_dbs]
+    if len(set(hosts)) != len(hosts):
+        raise ValueError(f"duplicate host labels in host_dbs: {hosts} — "
+                         f"each store must join under a distinct namespace")
+    orders: dict[str, dict] = {}
+    fills: list[dict] = []
+    sym_home: dict[str, set] = {}
+    for host, path in host_dbs:
+        conn = sqlite3.connect(path)
+        try:
+            for (oid, client, sym, side, otype, price, qty, rem,
+                 status) in conn.execute(
+                    "SELECT order_id, client_id, symbol, side, order_type,"
+                    " price, quantity, remaining_quantity, status "
+                    "FROM orders"):
+                nsid = f"{host}/{oid}"
+                if nsid in orders:  # impossible: order_id is the PK
+                    raise ValueError(f"duplicate id {nsid} within one host")
+                orders[nsid] = {
+                    "order_id": nsid, "host": host, "client_id": client,
+                    "symbol": sym, "side": side, "order_type": otype,
+                    "price": price, "quantity": qty, "remaining": rem,
+                    "status": status,
+                }
+                sym_home.setdefault(sym, set()).add(host)
+            for oid, cid, price, qty, ts in conn.execute(
+                    "SELECT order_id, counter_order_id, price, quantity, ts"
+                    " FROM fills"):
+                fills.append({
+                    "order_id": f"{host}/{oid}",
+                    "counter_order_id": f"{host}/{cid}",
+                    "price": price, "quantity": qty, "ts": ts,
+                })
+        finally:
+            conn.close()
+    return {
+        "orders": orders,
+        "fills": fills,
+        "symbol_conflicts": sorted(
+            (s, sorted(h)) for s, h in sym_home.items() if len(h) > 1),
+    }

@@ -1228,22 +1228,28 @@ class EngineRunner:
         the storage layer's busy_timeout. Concurrent flush callers
         serialize on _owner_flush_lock; see its init comment for why
         producers don't need it."""
-        if not self.pending_owner_ids or self.persist_owner_ids is None:
+        if self.persist_owner_ids is None:
             return
+        # The lock spans precheck + persist + requeue: a barrier caller
+        # (checkpoint) that sees an empty pending list must be guaranteed
+        # no OTHER flusher still has a drained-but-unpersisted batch in
+        # flight — otherwise the snapshot could freeze owner ints that a
+        # failed persist then re-queues, and a crash before the retry
+        # restores diverged identities. The write inside is bounded by
+        # the storage connection's busy timeout.
         with self._owner_flush_lock:
+            if not self.pending_owner_ids:
+                return
             batch = list(self.pending_owner_ids)
             del self.pending_owner_ids[:len(batch)]
-        if not batch:
-            return
-        try:
-            ok = self.persist_owner_ids(batch)
-        except Exception as e:  # noqa: BLE001 — never unwind into callers
-            print(f"[runner] owner_ids persist raised: "
-                  f"{type(e).__name__}: {e}")
-            ok = False
-        if ok is False:
-            self.metrics.inc("meta_persist_failures")
-            with self._owner_flush_lock:
+            try:
+                ok = self.persist_owner_ids(batch)
+            except Exception as e:  # noqa: BLE001 — never unwind
+                print(f"[runner] owner_ids persist raised: "
+                      f"{type(e).__name__}: {e}")
+                ok = False
+            if ok is False:
+                self.metrics.inc("meta_persist_failures")
                 self.pending_owner_ids[:0] = batch
 
     def set_auction_mode(self, value: bool) -> None:

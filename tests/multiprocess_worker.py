@@ -25,9 +25,12 @@ import sys
 def main() -> None:
     port, pid_s, outdir = sys.argv[1], sys.argv[2], sys.argv[3]
     pid = int(pid_s)
+    # Optional scale knobs (round-5: the 4-process variant drives these).
+    nprocs = int(sys.argv[4]) if len(sys.argv) > 4 else 2
+    dpp = int(sys.argv[5]) if len(sys.argv) > 5 else 4  # devices/process
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
+        + f" --xla_force_host_platform_device_count={dpp}"
     ).strip()
     import jax
 
@@ -40,17 +43,17 @@ def main() -> None:
     )
 
     assert initialize(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        coordinator_address=f"127.0.0.1:{port}", num_processes=nprocs,
         process_id=pid,
     )
-    assert jax.process_count() == 2
-    assert len(jax.devices()) == 8
-    assert len(jax.local_devices()) == 4
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == nprocs * dpp
+    assert len(jax.local_devices()) == dpp
 
     mesh = make_multihost_mesh()
-    S = 8
+    S = nprocs * dpp  # one symbol per device shard
     sl = local_symbol_slice(mesh, S)
-    assert sl.stop - sl.start == 4
+    assert sl.stop - sl.start == dpp
 
     from matching_engine_tpu.engine.book import EngineConfig
     from matching_engine_tpu.engine.kernel import FILLED, OP_SUBMIT

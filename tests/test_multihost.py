@@ -115,3 +115,39 @@ def test_local_symbol_slice_rejects_interleaved_order():
             mh.local_symbol_slice(FakeMesh, 64)
     finally:
         jax.process_index = orig
+
+
+def test_aggregate_host_stores_namespaces_colliding_oids(tmp_path):
+    """Two home hosts independently issue OID-1; the aggregator keeps
+    both under host namespaces, namespaces fill references consistently,
+    and flags a symbol served by two stores (a routing violation) instead
+    of silently merging it (VERDICT r4 next-step 9 — the caveat in
+    parallel/multihost.py is now code, not prose)."""
+    from matching_engine_tpu.parallel.multihost import aggregate_host_stores
+    from matching_engine_tpu.storage import Storage
+    from matching_engine_tpu.storage.storage import FillRow
+
+    paths = []
+    for host, syms in (("h0", ("AAA", "DUP")), ("h1", ("BBB", "DUP"))):
+        db = str(tmp_path / f"{host}.db")
+        st = Storage(db)
+        assert st.init()
+        # Both hosts issue the SAME order ids for different orders.
+        assert st.insert_new_order("OID-1", f"{host}-cli", syms[0], 1, 0,
+                                   10_000, 5, status=2, remaining=0)
+        assert st.insert_new_order("OID-2", f"{host}-cli", syms[1], 2, 0,
+                                   10_000, 5)
+        assert st.add_fill(FillRow("OID-1", "OID-2", 10_000, 5))
+        st.close()
+        paths.append((host, db))
+
+    agg = aggregate_host_stores(paths)
+    assert set(agg["orders"]) == {"h0/OID-1", "h0/OID-2",
+                                  "h1/OID-1", "h1/OID-2"}
+    assert agg["orders"]["h0/OID-1"]["symbol"] == "AAA"
+    assert agg["orders"]["h1/OID-1"]["symbol"] == "BBB"
+    assert len(agg["fills"]) == 2
+    for f in agg["fills"]:
+        assert f["order_id"] in agg["orders"]
+        assert f["counter_order_id"] in agg["orders"]
+    assert agg["symbol_conflicts"] == [("DUP", ["h0", "h1"])]

@@ -114,3 +114,41 @@ def test_two_process_full_servers(tmp_path):
         assert r["orders"] == expected and r["fills"] == 5
         if me_native.gateway_available():
             assert r["gateway_ran"], "native gateway built but leg skipped"
+
+
+def test_four_process_distributed(tmp_path):
+    """Scale the real-process contract past 2 hosts (VERDICT r4 next-step
+    9): four coordinator-joined processes, 2 virtual devices each, over
+    one 8-device mesh — disjoint symbol quarters, per-host dispatch
+    rates, addressable decode, and the host-sharded checkpoint."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path),
+             "4", "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(4)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=360)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("4-process worker timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+    for pid in range(4):
+        with open(tmp_path / f"ok-{pid}.json") as f:
+            r = json.load(f)
+        assert r["slice"] == [pid * 2, pid * 2 + 2]
+        assert r["fills"] == (2 + pid) * 2  # (2+pid) dispatches x 2 syms
