@@ -64,6 +64,16 @@ RESIDENT_DIR = os.path.join(REPO, "benchmarks", ".resident")
 # finish inside budget.
 TPU_ARGS = ["--symbols", "4096", "--capacity", "128", "--batch", "32",
             "--kernel", "sorted", "--stage-symbols", "512"]
+# The headline config as key/value truth (single source for the resident
+# handshake below — a resident warmed on any OTHER shape or formulation
+# must not supply the headline record).
+_TPU_FLAGS = dict(zip(TPU_ARGS[::2], TPU_ARGS[1::2]))
+HEADLINE_CFG = {
+    "symbols": int(_TPU_FLAGS["--symbols"]),
+    "capacity": int(_TPU_FLAGS["--capacity"]),
+    "batch": int(_TPU_FLAGS["--batch"]),
+    "kernel": _TPU_FLAGS.get("--kernel", "matrix"),
+}
 # The CPU fallback uses the sorted-book kernel: 3.7x the matrix kernel's
 # throughput on the host backend at this config (63.4k vs 17.1k orders/s
 # measured 2026-07-30) — the row carries its kernel label.
@@ -192,6 +202,17 @@ def try_resident(deadline: float, errors: list[str]):
         os.kill(int(state["pid"]), 0)
     except (OSError, KeyError, ValueError):
         errors.append("resident pid dead")
+        return None
+    mismatch = {
+        k: (state.get(k, "matrix" if k == "kernel" else None), want)
+        for k, want in HEADLINE_CFG.items()
+        if state.get(k, "matrix" if k == "kernel" else None) != want
+    }
+    if mismatch:
+        # A resident warmed on another shape or formulation must not
+        # supply the headline record; fall through to the staged child
+        # rather than mislabel the row.
+        errors.append(f"resident config mismatch {mismatch}")
         return None
     nonce = f"{os.getpid()}-{int(time.time())}"
     out_path = os.path.join(RESIDENT_DIR, f"out-{nonce}.json")

@@ -219,6 +219,7 @@ def main() -> None:
         "symbols": args.symbols,
         "capacity": args.capacity,
         "batch": args.batch,
+        "kernel": args.kernel,
         "backend_init_s": round(backend_init_s, 1),
         "ops_per_step": ops_per_step,
         "full_step_us": round(full_us, 1),

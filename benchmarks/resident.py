@@ -62,6 +62,11 @@ def main() -> None:
     symbols = int(os.environ.get("RESIDENT_SYMBOLS", 4096))
     capacity = int(os.environ.get("RESIDENT_CAPACITY", 128))
     batch = int(os.environ.get("RESIDENT_BATCH", 32))
+    # Default matches bench.py TPU_ARGS: the sorted kernel is the decided
+    # headline formulation (2.21B/s vs matrix 1.26B measured 2026-07-31;
+    # DESIGN.md 6d) — a resident serving the wrong formulation would hand
+    # the driver a mislabeled record.
+    kernel = os.environ.get("RESIDENT_KERNEL", "sorted")
 
     import jax
 
@@ -97,7 +102,7 @@ def main() -> None:
                 pass
 
     cfg = EngineConfig(num_symbols=symbols, capacity=capacity, batch=batch,
-                       max_fills=1 << 17)
+                       max_fills=1 << 17, kernel=kernel)
     waves, wave_ops = prepare_waves(cfg, headline_streams(cfg))
     book = init_book(cfg)
     book, out = engine_step(cfg, book, waves[0])
@@ -110,6 +115,7 @@ def main() -> None:
         "symbols": symbols,
         "capacity": capacity,
         "batch": batch,
+        "kernel": kernel,
         "backend_init_s": round(init_s, 1),
         "started_ts": time.time(),
         "heartbeat_ts": time.time(),
@@ -117,7 +123,7 @@ def main() -> None:
     }
     _write_state(state)
     print(f"[resident] up: platform={platform} init={init_s:.1f}s "
-          f"cfg={symbols}/{capacity}/{batch}", flush=True)
+          f"cfg={symbols}/{capacity}/{batch}/{kernel}", flush=True)
 
     def measure(windows: int, iters: int) -> dict:
         nonlocal book

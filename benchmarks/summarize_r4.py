@@ -59,6 +59,7 @@ def main() -> None:
         ("cap 1024 sorted", "tpu_r4_cap1024_sorted.json"),
         ("cap 4096 sorted", "tpu_r4_cap4096_sorted.json"),
         ("L3 realistic (3b)", "tpu_r4_l3flow.json"),
+        ("cap 8192 sorted", "tpu_r5_cap8192_sorted.json"),
     ]:
         d = load(art)
         if d is None:
@@ -94,19 +95,26 @@ def main() -> None:
     print("| edge | pi | orders/s | p50 ms | p99 ms | p99/p50 |")
     print("|---|---|---|---|---|---|")
     for edge in ("native", "grpcio"):
-        for pi in (2, 4):
-            d = load(f"tpu_e2e_r4_{edge}_pi{pi}.json")
+        for pi, sfx in ((2, ""), (4, ""), (2, "_w256")):
+            d = load(f"tpu_e2e_r4_{edge}_pi{pi}{sfx}.json")
+            label = f"{pi}{sfx}"
             if d is None:
-                print(f"| {edge} | {pi} | — | — | — | — |")
+                print(f"| {edge} | {label} | — | — | — | — |")
             else:
                 ratio = (d["p99_ms"] / d["p50_ms"]) if d.get("p50_ms") else 0
-                print(f"| {edge} | {pi} | {fmt(d.get('value'))} | "
+                print(f"| {edge} | {label} | {fmt(d.get('value'))} | "
                       f"{d.get('p50_ms')} | {d.get('p99_ms')} | "
                       f"{ratio:.1f}x |")
 
-    print("\n## Kernel profile\n")
-    pk = load("tpu_r4_profile.json")
-    if pk:
+    print("\n## Kernel profiles\n")
+    any_profile = False
+    for label, art in [("matrix", "tpu_r4_profile.json"),
+                       ("sorted", "tpu_r5_profile_sorted.json")]:
+        pk = load(art)
+        if not pk:
+            continue
+        any_profile = True
+        print(f"**{pk.get('kernel', label)}** (`{art}`):")
         print(f"- full step: {pk['full_step_us']}µs "
               f"({fmt(pk['orders_per_s'])} orders/s at "
               f"{pk['ops_per_step']} ops/step)")
@@ -123,7 +131,7 @@ def main() -> None:
                   f"{rl['fraction_of_hbm_peak']:.1%} of v5e HBM peak "
                   f"(>100% => fused on-chip traffic, not HBM-bound)")
         print(f"- device trace: {pk.get('device_trace')}")
-    else:
+    if not any_profile:
         print("pending")
 
     res = load("tpu_resident_log.jsonl")

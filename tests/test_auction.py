@@ -409,13 +409,17 @@ def test_auction_server_flow(tmp_path):
 
 # -- sharded (mesh) auction --------------------------------------------------
 
-def test_sharded_auction_matches_single_device():
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_sharded_auction_matches_single_device(kernel):
     """The shard_map'd uncross produces bit-identical clearing prices,
-    volumes, records, and post-auction books to the single-device step."""
+    volumes, records, and post-auction books to the single-device step —
+    for BOTH formulations (the sorted path's wide-limb volumes and
+    boundary-merge records must survive shard_map unchanged)."""
     from matching_engine_tpu.parallel import ShardedEngine, make_mesh
     from matching_engine_tpu.parallel import hostlocal
 
-    cfg = EngineConfig(num_symbols=8, capacity=32, batch=8, max_fills=1 << 12)
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=8,
+                       max_fills=1 << 12, kernel=kernel)
     mask = np.ones((cfg.num_symbols,), dtype=bool)
 
     book1, _ = build_crossed_books(cfg, seed=11)

@@ -299,3 +299,29 @@ def test_rebuild_owner_lanes_uses_registry_not_raw_hash():
     bid_qty = np.asarray(r.book.bid_qty)
     lanes = bid_owner[bid_qty > 0]
     assert lanes.tolist() == [remapped]
+
+
+def test_owner_registry_overflow_probes_past_claimed_ids():
+    """Past the registry cap, new clients get UNREGISTERED ids — but the
+    probe must still skip claimed ids: returning a raw hash that a
+    registered client was remapped AWAY from would merge STP identities
+    with a client that doesn't even hash-collide (ADVICE r4 low)."""
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=4, max_fills=256)
+    r = EngineRunner(cfg)
+    # "victim" was remapped away from overflowing client's raw hash:
+    # claim that hash for someone else, as a collision remap would.
+    raw = owner_hash("late-client")
+    r._owner_claimed[raw] = "earlier-client"
+    r._owner_registry_cap = len(r._owner_by_client)  # registry is full
+
+    owner = r._owner_for("late-client")
+    assert owner != raw                      # skipped the claimed id
+    assert owner != 0
+    assert "late-client" not in r._owner_by_client   # unregistered
+    assert not r.pending_owner_ids                   # nothing queued
+    snap = r.metrics.snapshot()[0]
+    assert snap.get("owner_registry_overflow") == 1
+    # Deterministic across calls in one process lifetime (same probe).
+    assert r._owner_for("late-client") == owner
