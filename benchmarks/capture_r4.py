@@ -173,6 +173,9 @@ STEPS: list[dict] = [
      "timeout": 1500,
      "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "2"],
      "env": {"TPU_E2E_SUFFIX": "_w256", "TPU_E2E_RPC_WORKERS": "256"}},
+    # Venue-depth auction on hardware (config 7: sorted kernel, cap 2048).
+    {"name": "suite7", "artifact": "tpu_suite7_r5.jsonl", "timeout": 900,
+     **suite("tpu_suite7_r5.jsonl", "7")},
 ]
 
 
@@ -186,7 +189,7 @@ _R5_ORDER = [
     "headline_sorted", "cap128", "cap128s", "cap1024", "cap1024s",
     "cap4096s", "cap256", "e2e_pi2", "e2e_pi4", "suite_full",
     "batch64", "batch128", "syms64", "syms256", "syms1024", "l3flow",
-    "profile_sorted", "cap8192s", "e2e_pi2_w256",
+    "profile_sorted", "cap8192s", "e2e_pi2_w256", "suite7",
 ]
 _RANK = {n: i for i, n in enumerate(_R5_ORDER)}
 STEPS.sort(key=lambda s: _RANK.get(s["name"], len(_R5_ORDER)))

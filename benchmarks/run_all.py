@@ -12,6 +12,8 @@ full matrix:
   5 agent-based market sim, closed loop on device
   6 call-auction uncross: every book cleared at its clearing price in
     one device step (engine/auction.py; beyond the BASELINE five)
+  7 venue-depth uncross: config 6 at capacity 2048 on the sorted kernel
+    (engine/auction_sorted.py wide-limb exact volumes)
 
 Usage: python benchmarks/run_all.py [--full] [--configs 2,3,5]
 --full uses north-star scale (4k symbols, 256 agents, 1k clients); the
@@ -285,19 +287,25 @@ def config5_sim(full: bool):
           "traded_volume": int(np.sum(np.asarray(stats.volume)))})
 
 
-def config6_auction(full: bool):
+def config6_auction(full: bool, config_id: int = 6, kernel: str = "matrix",
+                    cap: int = 128, s_full: int = 4096, s_small: int = 512,
+                    metric: str = "auction_uncross_throughput"):
     """Call-auction uncross throughput (engine/auction.py): every book
     pre-filled CROSSED to full depth (the worst-case pre-open state), one
     device step clears all of them at per-symbol clearing prices. K
     auctions are timed pipelined (fresh books placed per iteration, one
-    sync at the end); fills stay on device during timing."""
+    sync at the end); fills stay on device during timing.
+
+    Config 7 reuses this harness at venue depth (sorted kernel, capacity
+    2048, wide-limb exact volumes — engine/auction_sorted.py): fewer
+    symbols because the bilateral-record count scales with S * 2*cap and
+    must fit the [max_fills] log."""
     from matching_engine_tpu.engine.auction import auction_step, decode_auction
 
-    s = 4096 if full else 512
-    cap = 128
+    s = s_full if full else s_small
     # Bilateral records bound: <= S * (2*cap - 1); size the log to fit.
     cfg = EngineConfig(num_symbols=s, capacity=cap, batch=32,
-                       max_fills=1 << 20)
+                       max_fills=1 << 20, kernel=kernel)
     rng = np.random.default_rng(0)
 
     def host_book():
@@ -336,8 +344,9 @@ def config6_auction(full: bool):
     executed = int(np.sum(dec.executed))
     crossed = int(np.sum(dec.executed > 0))
     assert not dec.aborted
-    emit(6, "auction_uncross_throughput", k * s / dt, "symbols/sec",
-         {"symbols": s, "capacity": cap, "uncross_ms": round(dt / k * 1e3, 2),
+    emit(config_id, metric, k * s / dt, "symbols/sec",
+         {"symbols": s, "capacity": cap, "kernel": kernel,
+          "uncross_ms": round(dt / k * 1e3, 2),
           "symbols_crossed": crossed, "executed_qty": executed,
           "records": dec.fill_count})
 
@@ -354,6 +363,10 @@ def run_one(config: int, full: bool) -> None:
         config4_native_gateway(full)
     elif config == 6:
         config6_auction(full)
+    elif config == 7:
+        config6_auction(full, config_id=7, kernel="sorted", cap=2048,
+                        s_full=64, s_small=16,
+                        metric="auction_uncross_venue_depth")
     elif config == 5:
         config5_sim(full)
 
@@ -361,7 +374,7 @@ def run_one(config: int, full: bool) -> None:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--full", action="store_true", help="north-star scale")
-    p.add_argument("--configs", default="1,2,3,4,5,6")
+    p.add_argument("--configs", default="1,2,3,4,5,6,7")
     p.add_argument("--no-fork", action="store_true",
                    help="run all configs in THIS process (debug only)")
     args = p.parse_args()

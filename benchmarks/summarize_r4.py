@@ -77,6 +77,7 @@ def main() -> None:
     print("\n## Suite (full scale)\n")
     suite = load("tpu_suite_full_r4.jsonl") or load(
         "tpu_suite_full_r4.jsonl.partial") or []
+    suite += load("tpu_suite7_r5.jsonl") or []  # venue-depth auction row
     if suite:
         print("| config | metric | value | unit |")
         print("|---|---|---|---|")

@@ -808,3 +808,60 @@ def test_auction_no_cross_is_signaled(tmp_path):
         assert "did not cross" not in resp2.error_message
     finally:
         shutdown(server, parts)
+
+
+def test_sharded_auction_at_venue_depth():
+    """The deployment combination an operator actually runs for deep
+    books: sorted kernel + capacity 2048 + an 8-device mesh. Wide-limb
+    executed volumes and boundary-merge records must survive shard_map
+    at depth (not just at the toy capacity above)."""
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+    from matching_engine_tpu.parallel import ShardedEngine, hostlocal, make_mesh
+
+    cap = 2048
+    cfg = EngineConfig(num_symbols=8, capacity=cap, batch=8,
+                       max_fills=1 << 14, kernel="sorted")
+    rng = np.random.default_rng(23)
+    s = cfg.num_symbols
+    arr = {f: np.zeros((s, cap), dtype=np.int32)
+           for f in BookBatch._fields if f != "next_seq"}
+    oracles = {i: OracleBook(cap) for i in range(s)}
+    oid = 1
+    n_side = 600  # x ~MAX_QUANTITY: deep into the wide-sum regime
+    for i in range(s):
+        seq = 0
+        for side in ("bid", "ask"):
+            for k in range(n_side):
+                price = int(10_002 + rng.integers(0, 4)) if side == "bid" \
+                    else int(9_995 + rng.integers(0, 4))
+                qty = int(MAX_QUANTITY - rng.integers(0, 1000))
+                arr[f"{side}_price"][i, k] = price
+                arr[f"{side}_qty"][i, k] = qty
+                arr[f"{side}_oid"][i, k] = oid
+                arr[f"{side}_seq"][i, k] = seq
+                (oracles[i].bids if side == "bid" else
+                 oracles[i].asks).append(_Resting(oid, price, qty, seq))
+                oid += 1
+                seq += 1
+        oracles[i].next_seq = seq
+    host = BookBatch(**{k: np.asarray(v) for k, v in arr.items()},
+                     next_seq=np.full((s,), 2 * n_side, np.int32))
+
+    mesh = make_mesh(8)
+    eng = ShardedEngine(cfg, mesh)
+    sbook = hostlocal.put_tree(host, eng.book_sharding)
+    nb, out = eng.auction(sbook, np.ones((s,), dtype=bool))
+    view, fills, aborted = eng.decode_auction(out)
+    assert aborted == 0
+
+    expected = []
+    for i, ob in oracles.items():
+        p, q, ofills = ob.auction()
+        assert q > 2**30  # the wide regime per symbol
+        assert int(view["clear_price"][i]) == p
+        assert int(view["executed"][i]) == q
+        expected.extend(canon_oracle(i, ofills))
+    assert canon(fills) == sorted(expected)
+    snaps = snapshot_books(nb)
+    for i, ob in oracles.items():
+        assert snaps[i] == ob.snapshot(), f"symbol {i}"
