@@ -176,15 +176,17 @@ def test_auction_at_venue_depth_exact_wide_sums():
     assert snapshot_books(new_book)[0] == ob.snapshot()
 
 
-def test_auction_mask_scopes_the_uncross():
-    book, oracles = build_crossed_books(CFG, seed=7)
-    mask = np.zeros((CFG.num_symbols,), dtype=bool)
+@pytest.mark.parametrize("cfg", [CFG, CFG_SORTED],
+                         ids=["matrix", "sorted"])
+def test_auction_mask_scopes_the_uncross(cfg):
+    book, oracles = build_crossed_books(cfg, seed=7)
+    mask = np.zeros((cfg.num_symbols,), dtype=bool)
     mask[3] = True
     before = snapshot_books(book)
-    new_book, out = auction_step(CFG, book, mask)
-    dec, fills = decode_auction(CFG, out)
+    new_book, out = auction_step(cfg, book, mask)
+    dec, fills = decode_auction(cfg, out)
     after = snapshot_books(new_book)
-    for s in range(CFG.num_symbols):
+    for s in range(cfg.num_symbols):
         if s == 3:
             continue
         assert after[s] == before[s], f"unmasked symbol {s} changed"
@@ -216,10 +218,13 @@ def test_auction_empty_and_uncrossable_books():
     assert snapshot_books(new_book) == before
 
 
-def test_auction_overflow_aborts_untouched():
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_auction_overflow_aborts_untouched(kernel):
     """A fill log too small for the bilateral records must abort the WHOLE
-    auction with books unchanged — never a half-logged uncross."""
-    cfg = EngineConfig(num_symbols=1, capacity=16, batch=4, max_fills=4)
+    auction with books unchanged — never a half-logged uncross (both
+    formulations share the all-or-nothing rule)."""
+    cfg = EngineConfig(num_symbols=1, capacity=16, batch=4, max_fills=4,
+                       kernel=kernel)
     book = init_book(cfg)
     # 8 one-lot bids at 105 vs 8 one-lot asks at 100: 8 records > 4 slots.
     for k in range(8):
