@@ -65,15 +65,18 @@ def run_sparse(cfg, stream):
     return book, results, fills
 
 
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
-def test_sparse_matches_dense(seed):
+def test_sparse_matches_dense(seed, kernel):
+    cfg = EngineConfig(num_symbols=16, capacity=32, batch=8,
+                       max_fills=1 << 12, kernel=kernel)
     stream = random_order_stream(
-        CFG.num_symbols, 6 * CFG.num_symbols * CFG.batch, seed=seed,
+        cfg.num_symbols, 6 * cfg.num_symbols * cfg.batch, seed=seed,
         cancel_p=0.15, market_p=0.1, price_base=10_000, price_levels=12,
         price_step=2, qty_max=30,
     )
-    dbook, dres, dfills = run_dense(CFG, stream)
-    sbook, sres, sfills = run_sparse(CFG, stream)
+    dbook, dres, dfills = run_dense(cfg, stream)
+    sbook, sres, sfills = run_sparse(cfg, stream)
     for f in dbook._fields:
         np.testing.assert_array_equal(
             np.asarray(getattr(dbook, f)), np.asarray(getattr(sbook, f)), f)
