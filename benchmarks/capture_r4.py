@@ -176,6 +176,14 @@ STEPS: list[dict] = [
     # Venue-depth auction on hardware (config 7: sorted kernel, cap 2048).
     {"name": "suite7", "artifact": "tpu_suite7_r5.jsonl", "timeout": 900,
      **suite("tpu_suite7_r5.jsonl", "7")},
+    # Saturation ceiling: the r4 runner sweep fixed 64-op dispatches; the
+    # serving ceiling under load is a function of dispatch SIZE (the
+    # window packs up to symbols*batch ops per drain) — sweep it.
+    {"name": "runner_sat", "artifact": "tpu_r5_runner_sat.json",
+     "timeout": 1200,
+     "cmd": [PY, os.path.join(REPO, "benchmarks", "runner_bench.py"),
+             "--json-out", os.path.join(RESULTS, "tpu_r5_runner_sat.json"),
+             "--batch-ops", "64,256,1024", "--inflight", "4"]},
 ]
 
 
@@ -189,7 +197,7 @@ _R5_ORDER = [
     "headline_sorted", "cap128", "cap128s", "cap1024", "cap1024s",
     "cap4096s", "cap256", "e2e_pi2", "e2e_pi4", "suite_full",
     "batch64", "batch128", "syms64", "syms256", "syms1024", "l3flow",
-    "profile_sorted", "cap8192s", "e2e_pi2_w256", "suite7",
+    "profile_sorted", "cap8192s", "e2e_pi2_w256", "suite7", "runner_sat",
 ]
 _RANK = {n: i for i, n in enumerate(_R5_ORDER)}
 STEPS.sort(key=lambda s: _RANK.get(s["name"], len(_R5_ORDER)))

@@ -34,9 +34,12 @@ def main() -> None:
     p.add_argument("--symbols", type=int, default=64)
     p.add_argument("--capacity", type=int, default=256)
     p.add_argument("--batch", type=int, default=16)
-    p.add_argument("--batch-ops", type=int, default=64,
+    p.add_argument("--batch-ops", default="64",
                    help="ops per dispatched batch (the dispatcher's drain "
-                        "size under load)")
+                        "size under load); comma list sweeps dispatch "
+                        "size x inflight — under saturation the window "
+                        "packs up to symbols*batch ops, so the ceiling "
+                        "is a function of dispatch size, not just depth")
     p.add_argument("--n-batches", type=int, default=60)
     p.add_argument("--inflight", default="1,2,4,8")
     p.add_argument("--json-out", required=True)
@@ -73,12 +76,12 @@ def main() -> None:
                        batch=args.batch, max_fills=1 << 15)
 
     def build_batches(runner: EngineRunner, seed: int,
-                      n_batches: int) -> list[list[EngineOp]]:
+                      n_batches: int, batch_ops: int) -> list[list[EngineOp]]:
         rng = random.Random(seed)
         batches = []
         for _ in range(n_batches):
             ops = []
-            for _ in range(args.batch_ops):
+            for _ in range(batch_ops):
                 sym = f"S{rng.randrange(args.symbols)}"
                 assert runner.slot_acquire(sym) is not None
                 num, oid = runner.assign_oid()
@@ -93,10 +96,11 @@ def main() -> None:
             batches.append(ops)
         return batches
 
-    def sweep_point(inflight: int) -> dict:
+    def sweep_point(inflight: int, batch_ops: int) -> dict:
         runner = EngineRunner(cfg, pipeline_inflight=inflight)
         batches = build_batches(runner, seed=inflight,
-                                n_batches=args.n_batches)
+                                n_batches=args.n_batches,
+                                batch_ops=batch_ops)
         lat: list[float] = []
         done = [0]
 
@@ -109,7 +113,8 @@ def main() -> None:
             return on_finish
 
         # Warm pass (compile both sparse bucket shapes this flow uses).
-        warm = build_batches(runner, seed=999, n_batches=3)
+        warm = build_batches(runner, seed=999, n_batches=3,
+                             batch_ops=batch_ops)
         for b in warm:
             runner.dispatch_pipelined(b, lambda r, e: None)
         runner.finish_pending()
@@ -125,14 +130,17 @@ def main() -> None:
         return {
             "inflight": inflight,
             "orders_per_s": round(n_ops / dt, 1),
-            "batch_ops": args.batch_ops,
+            "batch_ops": batch_ops,
             "n_batches": args.n_batches,
             "p50_ms": round(float(lats[len(lats) // 2]) * 1e3, 3),
             "p99_ms": round(float(lats[int(len(lats) * 0.99)]) * 1e3, 3),
             "mean_batch_ms": round(dt / len(batches) * 1e3, 3),
         }
 
-    rows = [sweep_point(int(k)) for k in args.inflight.split(",")]
+    grid_cap = args.symbols * args.batch
+    rows = [sweep_point(int(k), min(int(bo), grid_cap))
+            for bo in str(args.batch_ops).split(",")
+            for k in args.inflight.split(",")]
 
     try:
         import subprocess
