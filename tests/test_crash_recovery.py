@@ -180,3 +180,60 @@ def test_profile_dir_captures_trace(tmp_path):
     files = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir) for f in fs]
     assert files, "trace directory is empty"
     assert sum(os.path.getsize(f) for f in files) > 0
+
+
+def test_sigkill_during_venue_depth_call_period_resumes_auction(tmp_path):
+    """Round-5 behavior: a venue-depth (capacity 2048, sorted kernel)
+    server killed mid call-period must RESUME the call period on restart
+    (crossed books + persisted auction_mode at a capacity where the
+    uncross only now exists — engine/auction_sorted.py), and the resumed
+    server's RunAuction must clear the recovered crossed interest."""
+    db = str(tmp_path / "venue.db")
+    proc, port, stderr_path = _spawn_server(
+        tmp_path, db, "--capacity", "2048", "--engine-kernel", "sorted",
+        "--auction-open")
+    try:
+        _wait_port(port, proc, stderr_path, timeout_s=180)
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(ch)
+        for client, side, price in (("alice", pb2.BUY, 101_0000),
+                                    ("bob", pb2.SELL, 100_0000)):
+            r = stub.SubmitOrder(pb2.OrderRequest(
+                client_id=client, symbol="AU", order_type=pb2.LIMIT,
+                side=side, price=price, scale=4, quantity=7), timeout=120)
+            assert r.success
+        ch.close()
+        import sqlite3
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if sqlite3.connect(db).execute(
+                        "SELECT COUNT(*) FROM orders").fetchone()[0] >= 2:
+                    break
+            except sqlite3.Error:
+                pass
+            time.sleep(0.2)
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+    assert audit_mod.audit(db) == []
+    server, port2, parts = build_server(
+        "127.0.0.1:0", db,
+        EngineConfig(num_symbols=8, capacity=2048, batch=4,
+                     kernel="sorted"),
+        window_ms=1.0, log=False)
+    server.start()
+    try:
+        runner = parts["runner"]
+        assert runner.auction_mode, "call period must resume at venue depth"
+        assert runner.crossed_symbols() == ["AU"]
+        summary = runner.run_auction(sink=parts["sink"])
+        assert summary["error"] == ""
+        assert [c[0] for c in summary["crossed"]] == ["AU"]
+        assert summary["crossed"][0][2] == 7
+        assert not runner.auction_mode  # continuous reopened
+        assert runner.crossed_symbols() == []
+    finally:
+        shutdown(server, parts)
