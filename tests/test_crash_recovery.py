@@ -72,6 +72,29 @@ def _spawn_server(tmp_path, db: str, *extra_args: str):
     return proc, port, stderr_path
 
 
+def _wait_rows(db: str, min_rows: int, timeout_s: float = 60.0) -> int:
+    """Poll until the async sink lands >= min_rows orders in the WAL;
+    returns the observed count (callers assert on it so a timeout fails
+    at the wait, not at a misleading later assertion)."""
+    import sqlite3
+
+    deadline = time.time() + timeout_s
+    n = 0
+    while time.time() < deadline:
+        try:
+            conn = sqlite3.connect(db)
+            try:
+                n = conn.execute("SELECT COUNT(*) FROM orders").fetchone()[0]
+            finally:
+                conn.close()
+            if n >= min_rows:
+                break
+        except sqlite3.Error:
+            pass
+        time.sleep(0.2)
+    return n
+
+
 def test_sigkill_midload_then_restart_audits_clean(tmp_path):
     db = str(tmp_path / "crash.db")
     proc, port, stderr_path = _spawn_server(tmp_path, db)
@@ -93,17 +116,7 @@ def test_sigkill_midload_then_restart_audits_clean(tmp_path):
         # (dispatcher read-your-writes contract is via sink.flush()); wait
         # until the async sink has landed at least one WAL transaction so
         # SIGKILL provably interrupts a server with durable state.
-        import sqlite3
-
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            try:
-                if sqlite3.connect(db).execute(
-                        "SELECT COUNT(*) FROM orders").fetchone()[0] > 0:
-                    break
-            except sqlite3.Error:
-                pass
-            time.sleep(0.2)
+        assert _wait_rows(db, 1) >= 1
     finally:
         proc.kill()  # SIGKILL: no drain, no sink flush, no final checkpoint
         proc.wait(timeout=30)
@@ -203,17 +216,7 @@ def test_sigkill_during_venue_depth_call_period_resumes_auction(tmp_path):
                 side=side, price=price, scale=4, quantity=7), timeout=120)
             assert r.success
         ch.close()
-        import sqlite3
-
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            try:
-                if sqlite3.connect(db).execute(
-                        "SELECT COUNT(*) FROM orders").fetchone()[0] >= 2:
-                    break
-            except sqlite3.Error:
-                pass
-            time.sleep(0.2)
+        assert _wait_rows(db, 2) >= 2, "rests never reached the WAL"
     finally:
         proc.kill()
         proc.wait(timeout=30)

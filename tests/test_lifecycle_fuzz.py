@@ -1,0 +1,128 @@
+"""Full-lifecycle fuzz: continuous trading -> call-period accumulation ->
+uncross -> continuous again, device vs oracle, BOTH kernels.
+
+Every prior parity fuzz exercises one regime at a time (continuous streams
+in test_kernel_parity, pre-built crossed books in test_auction). Real
+venue state flows THROUGH the transitions: books carrying continuous-
+trading residue enter a call period, accumulate crossing rests on top,
+uncross (the sorted kernel additionally re-packs its dense prefix), and
+then serve continuous flow again from the post-auction state. This fuzz
+pins the whole cycle against the oracle, twice around, per kernel —
+statuses, fills (per-symbol exact order for continuous, canonicalized for
+the uncross), and resting books at every phase boundary.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.auction import auction_step, decode_auction
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_REST, OP_SUBMIT
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+S, CAP = 4, 24
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lifecycle_continuous_auction_interleave(kernel, seed):
+    cfg = EngineConfig(num_symbols=S, capacity=CAP, batch=8,
+                       max_fills=1 << 12, kernel=kernel)
+    rng = random.Random(seed)
+    oracles = [OracleBook(CAP) for _ in range(S)]
+    book = init_book(cfg)
+    next_oid = 1
+    # (oid, side) of LIMIT submits/rests per symbol — cancel targets need
+    # the SIDE the order rests on (the host order directory's job in the
+    # serving stack); canceling filled/canceled ids is fair game (both
+    # sides must REJECT identically).
+    cancelable: list[list[tuple[int, int]]] = [[] for _ in range(S)]
+
+    def gen_stream(n_ops: int, op_mode: int) -> list[HostOrder]:
+        nonlocal next_oid
+        out = []
+        for _ in range(n_ops):
+            sym = rng.randrange(S)
+            if (op_mode == OP_SUBMIT and cancelable[sym]
+                    and rng.random() < 0.2):
+                oid, side = rng.choice(cancelable[sym])
+                out.append(HostOrder(sym, OP_CANCEL, side, oid=oid))
+                continue
+            side = BUY if rng.random() < 0.5 else SELL
+            market = op_mode == OP_SUBMIT and rng.random() < 0.1
+            price = 0 if market else 10_000 + rng.randrange(-8, 9)
+            out.append(HostOrder(
+                sym, op_mode, side, MARKET if market else LIMIT,
+                price, rng.randrange(1, 20), oid=next_oid,
+                owner=rng.randrange(0, 3)))  # owner 1/2 collide sometimes
+            if not market:
+                cancelable[sym].append((next_oid, side))
+            next_oid += 1
+        return out
+
+    def apply_phase(book, stream):
+        """Device + oracle application of one chronological stream."""
+        o_results, o_fills = [], []
+        for o in stream:
+            ob = oracles[o.sym]
+            if o.op == OP_CANCEL:
+                r = ob.cancel(o.oid)
+            elif o.op == OP_REST:
+                r = ob.rest(o.oid, o.side, o.price, o.qty, owner=o.owner)
+            else:
+                r = ob.submit(o.oid, o.side, o.otype, o.price, o.qty,
+                              owner=o.owner)
+            o_results.append((o.oid, o.sym, r.status, r.filled, r.remaining))
+            o_fills.extend((o.sym, f.taker_oid, f.maker_oid, f.price_q4,
+                            f.quantity) for f in r.fills)
+        book, d_res, d_fills = apply_orders(cfg, book, stream)
+        d_res = [(r.oid, r.sym, r.status, r.filled, r.remaining)
+                 for r in d_res]
+        d_fills = [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+                   for f in d_fills]
+        assert sorted(d_res) == sorted(o_results)
+        for s in range(S):  # continuous fills: per-symbol EXACT order
+            assert [f for f in d_fills if f[0] == s] == \
+                [f for f in o_fills if f[0] == s], f"phase fills sym {s}"
+        _assert_books(book)
+        return book
+
+    def _assert_books(book):
+        snaps = snapshot_books(book)
+        for s in range(S):
+            assert snaps[s] == oracles[s].snapshot(), f"book sym {s}"
+
+    def uncross(book):
+        book, out = auction_step(cfg, book, np.ones((S,), dtype=bool))
+        dec, fills = decode_auction(cfg, out)
+        assert not dec.aborted
+        got = sorted((f.sym, f.taker_oid, f.maker_oid, f.price_q4,
+                      f.quantity) for f in fills)
+        want = []
+        for s in range(S):
+            p, q, ofills = oracles[s].auction()
+            assert int(dec.clear_price[s]) == p, f"auction price sym {s}"
+            assert int(dec.executed[s]) == q, f"auction volume sym {s}"
+            want.extend((s, f.taker_oid, f.maker_oid, f.price_q4,
+                         f.quantity) for f in ofills)
+        assert got == sorted(want)
+        _assert_books(book)
+        return book
+
+    crossed_total = 0
+    for _cycle in range(2):
+        book = apply_phase(book, gen_stream(120, OP_SUBMIT))  # continuous
+        book = apply_phase(book, gen_stream(60, OP_REST))     # call period
+        pre = snapshot_books(book)
+        book = uncross(book)
+        post = snapshot_books(book)
+        crossed_total += sum(1 for s in range(S) if post[s] != pre[s])
+    assert crossed_total > 0, "fuzz never produced a crossing call period"
