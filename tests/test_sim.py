@@ -74,8 +74,16 @@ def test_sim_sharded_matches_single_device():
     assert snap(book1) == snap(host8)
 
 
-def test_sim_flow_oracle_parity():
-    book, _, stats, orders = run_sim(CFG, SCFG, steps=25, seed=11, collect_orders=True)
+import pytest
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_sim_flow_oracle_parity(kernel):
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, kernel=kernel)
+    book, _, stats, orders = run_sim(cfg, SCFG, steps=25, seed=11,
+                                     collect_orders=True)
 
     op = np.asarray(orders.op)        # [T, S, B]
     side = np.asarray(orders.side)
@@ -85,7 +93,7 @@ def test_sim_flow_oracle_parity():
     oid = np.asarray(orders.oid)
     t_steps, s_syms, b = op.shape
 
-    oracles = [OracleBook(capacity=CFG.capacity) for _ in range(s_syms)]
+    oracles = [OracleBook(capacity=cfg.capacity) for _ in range(s_syms)]
     o_volume = 0
     for t in range(t_steps):
         for s in range(s_syms):
