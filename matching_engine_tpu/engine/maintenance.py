@@ -1,0 +1,68 @@
+"""Book maintenance ops that run OFF the hot path: seq rebasing.
+
+Each book's `next_seq` is a per-symbol int32 arrival counter; price-time
+priority ties break on it, and the sorted kernel's dense-prefix invariant
+is (price, seq)-ordered. Nothing in the hot path bounds it — after 2^31
+arrivals on ONE symbol the counter wraps and new orders silently jump the
+time-priority queue (and a sorted-kernel book's invariant corrupts with
+it). The reference never faced this (its engine file is empty and its one
+counter is the 64-bit OID sequence); a venue-grade engine must.
+
+`rebase_seqs` renumbers every book's live seqs to [0, live_count) in
+priority order — (price, seq) ordering is exactly preserved, so matching
+behavior is bit-identical before/after — and resets `next_seq` to the
+max live count per book. It is a rare, fixed-shape, jitted device op
+(O(C log C) lexsort per side) intended for quiesce points: the
+CheckpointDaemon runs it under the dispatch lock whenever any book's
+counter crosses REBASE_THRESHOLD (headroom of 2^30 before the cliff).
+
+For sorted-kernel books the renumbering is the identity permutation by
+construction (lanes already sit in priority order), so the invariant is
+preserved trivially; for matrix books lanes are unordered and the rank
+comes from the lexsort.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import I32, BookBatch, EngineConfig
+
+IMAX = jnp.iinfo(jnp.int32).max
+
+# Trigger with plenty of headroom: 2^30 arrivals on one book leaves
+# another 2^30 before the wrap even if every check is missed once.
+REBASE_THRESHOLD = 1 << 30
+
+
+def _rank_side(price, qty, seq, best_is_max):
+    """New seq per lane: the lane's price-time priority rank among live
+    lanes (dead lanes keep seq 0 — they are never read, qty==0 masks).
+
+    Liveness is the PRIMARY sort key (lexsort's last key), so dead lanes
+    sort strictly after every live lane no matter what stale price/seq
+    they hold — a sentinel-in-the-key scheme would collide with a legal
+    live ask at price 2^31-1 (validation admits it) and hand it a rank
+    past the live count."""
+    live = qty > 0
+    key = -price if best_is_max else price
+    order = jnp.lexsort((seq, key, (~live).astype(I32)))
+    cap = price.shape[0]
+    rank = jnp.zeros((cap,), I32).at[order].set(jnp.arange(cap, dtype=I32))
+    return jnp.where(live, rank, 0).astype(I32), jnp.sum(live).astype(I32)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=1)
+def rebase_seqs(cfg: EngineConfig, book: BookBatch) -> BookBatch:
+    """Renumber all books' seqs to dense priority ranks; next_seq becomes
+    the max live count per book (strictly above every assigned seq)."""
+    bid_seq, nb = jax.vmap(partial(_rank_side, best_is_max=True))(
+        book.bid_price, book.bid_qty, book.bid_seq)
+    ask_seq, na = jax.vmap(partial(_rank_side, best_is_max=False))(
+        book.ask_price, book.ask_qty, book.ask_seq)
+    return book._replace(
+        bid_seq=bid_seq, ask_seq=ask_seq,
+        next_seq=jnp.maximum(nb, na).astype(I32))

@@ -1296,6 +1296,39 @@ class EngineRunner:
         else:
             self._mode_dirty = False
 
+    def maybe_rebase_seqs(self) -> bool:
+        """Renumber book seqs when any book's arrival counter nears the
+        int32 cliff (engine/maintenance.py). Call at a QUIESCE point:
+        under the dispatch lock with no staged dispatches (the
+        checkpoint daemon's barrier is the intended site). Rare by
+        construction — 2^30 arrivals on one symbol between checks."""
+        from matching_engine_tpu.engine.maintenance import (
+            REBASE_THRESHOLD,
+            rebase_seqs,
+        )
+
+        if self._sharded is not None and jax.process_count() > 1:
+            # checkpoint_now is collective-free by design (each host
+            # saves its addressable shards on its own schedule); an
+            # ad-hoc global reduction or a one-host jitted rebase here
+            # would deadlock the mesh. Multi-host deployments rebase via
+            # restart instead: recovery replay re-rests open orders with
+            # fresh seqs 0..n (the same renumbering, for free).
+            self.metrics.inc("seq_rebase_skipped_multihost")
+            return False
+        mx = int(np.max(np.asarray(self.book.next_seq)))
+        if mx < REBASE_THRESHOLD:
+            return False
+        with self._snapshot_lock:
+            # Donated input: assign under the snapshot lock like every
+            # other book-replacing step.
+            self.book = rebase_seqs(self.cfg, self.book)
+        self.metrics.inc("seq_rebases")
+        print(f"[runner] seq rebase at next_seq={mx} (threshold "
+              f"{REBASE_THRESHOLD}): priority order preserved, counters "
+              f"reset to live counts")
+        return True
+
     def crossed_symbols(self) -> list[str]:
         """Symbols (this host's) whose books stand CROSSED (best bid >=
         best ask). A continuously-matched book can never stand crossed, so

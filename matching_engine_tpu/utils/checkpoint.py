@@ -488,6 +488,10 @@ class CheckpointDaemon:
             # restore would otherwise re-derive different ids.
             self.runner.flush_owner_ids()
             self._reconcile_durability_locked()
+            # Rare maintenance at the quiesce point: renumber seqs before
+            # they can wrap int32 (the snapshot then freezes the rebased
+            # lanes, so a restore inherits the headroom).
+            self.runner.maybe_rebase_seqs()
             save_checkpoint(path, self.runner)
         for p in posts:  # client completions, outside the engine lock
             p()

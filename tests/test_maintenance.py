@@ -1,0 +1,165 @@
+"""Seq rebasing (engine/maintenance.py): the int32 arrival-counter cliff.
+
+Priority ties break on the per-book seq; after 2^31 arrivals on one
+symbol the counter would wrap and new orders would silently jump the
+time-priority queue. `rebase_seqs` renumbers live seqs to dense priority
+ranks at a quiesce point — these tests pin that the renumbering is
+SEMANTICS-PRESERVING (identical matching behavior after), kernel-safe
+(the sorted invariant survives), mesh-safe, and wired into the runner.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.engine.maintenance import (
+    REBASE_THRESHOLD,
+    rebase_seqs,
+)
+from matching_engine_tpu.proto import BUY, LIMIT, SELL
+
+CFG = EngineConfig(num_symbols=2, capacity=16, batch=4, max_fills=1 << 10)
+
+
+def _aged_book(cfg, base_seq=REBASE_THRESHOLD):
+    """Books whose live seqs sit near the cliff, lanes NOT in priority
+    order (the matrix kernel's hole-tolerant layout)."""
+    s, c = cfg.num_symbols, cfg.capacity
+    arr = {f: np.zeros((s, c), dtype=np.int32)
+           for f in BookBatch._fields if f != "next_seq"}
+    rng = np.random.default_rng(5)
+    for i in range(s):
+        for k in range(6):
+            arr["bid_price"][i, k] = 10_000 - int(rng.integers(0, 3))
+            arr["bid_qty"][i, k] = int(rng.integers(1, 9))
+            arr["bid_oid"][i, k] = 100 + i * 20 + k
+            arr["bid_seq"][i, k] = base_seq + k * 1000 + int(rng.integers(0, 999))
+            arr["ask_price"][i, k] = 10_005 + int(rng.integers(0, 3))
+            arr["ask_qty"][i, k] = int(rng.integers(1, 9))
+            arr["ask_oid"][i, k] = 200 + i * 20 + k
+            arr["ask_seq"][i, k] = base_seq + k * 1000 + int(rng.integers(0, 999))
+    next_seq = np.full((s,), base_seq + 5000, np.int32)
+    return BookBatch(**{k: jnp.asarray(v) for k, v in arr.items()},
+                     next_seq=jnp.asarray(next_seq))
+
+
+def _priority_view(snaps):
+    """Snapshots with seq values erased (they legitimately change)."""
+    return [([r[:3] for r in bids], [r[:3] for r in asks])
+            for bids, asks in snaps]
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_rebase_preserves_priority_and_matching(kernel):
+    cfg = dataclasses.replace(CFG, kernel=kernel)
+    before = _aged_book(cfg)
+    control = _aged_book(cfg)  # identical twin, NOT rebased
+    pre = _priority_view(snapshot_books(before))
+
+    book = rebase_seqs(cfg, before)
+    assert _priority_view(snapshot_books(book)) == pre
+    ns = np.asarray(book.next_seq)
+    assert (ns == 6).all()  # live count per side
+    bs = np.asarray(book.bid_seq)
+    assert bs.max() < 6  # dense ranks
+
+    # Identical follow-up flow through rebased and control books must
+    # produce IDENTICAL fills and priority state (the renumbering is
+    # invisible to matching semantics, including FIFO at equal prices).
+    stream = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 9_999, 11, oid=900),
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT, 10_006, 9, oid=901),
+        HostOrder(1, OP_SUBMIT, SELL, LIMIT, 9_998, 25, oid=902),
+    ]
+    b1, r1, f1 = apply_orders(cfg, book, stream)
+    b2, r2, f2 = apply_orders(cfg, control, stream)
+    assert [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+            for f in f1] == \
+        [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+         for f in f2]
+    assert _priority_view(snapshot_books(b1)) == \
+        _priority_view(snapshot_books(b2))
+
+
+def test_rebase_identity_on_fresh_sorted_book():
+    """A dense-sorted-prefix book rebases to its own lane order (the
+    invariant survives trivially)."""
+    cfg = dataclasses.replace(CFG, kernel="sorted")
+    book = init_book(cfg)
+    stream = [HostOrder(0, OP_SUBMIT, BUY, LIMIT, 10_000 - k, 5,
+                        oid=1 + k) for k in range(5)]
+    book, _, _ = apply_orders(cfg, book, stream)
+    before = {f: np.asarray(getattr(book, f)).copy()
+              for f in BookBatch._fields}
+    book = rebase_seqs(cfg, book)
+    for f in ("bid_price", "bid_qty", "bid_oid", "bid_owner",
+              "ask_price", "ask_qty", "ask_oid", "ask_owner"):
+        np.testing.assert_array_equal(np.asarray(getattr(book, f)),
+                                      before[f], f)
+    np.testing.assert_array_equal(
+        np.asarray(book.bid_seq)[0, :5], np.arange(5))
+
+
+def test_runner_maybe_rebase_trigger():
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    cfg = EngineConfig(num_symbols=2, capacity=16, batch=4, max_fills=256)
+    r = EngineRunner(cfg)
+    assert r.maybe_rebase_seqs() is False  # fresh books: far from cliff
+
+    aged = BookBatch(*(np.asarray(x) for x in _aged_book(cfg)))
+    r.place_book(aged)
+    assert r.maybe_rebase_seqs() is True
+    assert int(np.max(np.asarray(r.book.next_seq))) == 6
+    assert r.metrics.snapshot()[0].get("seq_rebases") == 1
+    assert r.maybe_rebase_seqs() is False  # idempotent below threshold
+
+
+def test_rebase_with_max_price_ask():
+    """A live ask at the maximum admissible price (2^31-1) must still
+    rank INSIDE the live prefix — dead lanes sort strictly last via the
+    liveness key, never by a colliding price sentinel."""
+    cfg = CFG
+    s_, c = cfg.num_symbols, cfg.capacity
+    arr = {f: np.zeros((s_, c), dtype=np.int32)
+           for f in BookBatch._fields if f != "next_seq"}
+    arr["ask_price"][0, 0] = 2**31 - 1
+    arr["ask_qty"][0, 0] = 3
+    arr["ask_oid"][0, 0] = 7
+    arr["ask_seq"][0, 0] = REBASE_THRESHOLD + 9
+    arr["ask_price"][0, 1] = 10_000
+    arr["ask_qty"][0, 1] = 2
+    arr["ask_oid"][0, 1] = 8
+    arr["ask_seq"][0, 1] = REBASE_THRESHOLD + 4
+    book = BookBatch(**{k: jnp.asarray(v) for k, v in arr.items()},
+                     next_seq=jnp.asarray(
+                         np.full((s_,), REBASE_THRESHOLD + 10, np.int32)))
+    book = rebase_seqs(cfg, book)
+    aseq = np.asarray(book.ask_seq)
+    assert aseq[0, 1] == 0  # better-priced ask ranks first
+    assert aseq[0, 0] == 1  # max-price ask INSIDE the live prefix
+    assert int(np.asarray(book.next_seq)[0]) == 2
+
+
+def test_rebase_on_sharded_book():
+    """The rebase jit partitions over the symbol axis on a mesh book."""
+    from matching_engine_tpu.parallel import ShardedEngine, hostlocal, make_mesh
+
+    cfg = EngineConfig(num_symbols=8, capacity=16, batch=4, max_fills=256)
+    host = _aged_book(dataclasses.replace(cfg))
+    host = BookBatch(*(np.asarray(x) for x in host))
+    eng = ShardedEngine(cfg, make_mesh(8))
+    sbook = hostlocal.put_tree(host, eng.book_sharding)
+    pre = _priority_view(snapshot_books(sbook))
+    out = rebase_seqs(cfg, sbook)
+    assert _priority_view(snapshot_books(out)) == pre
+    assert int(np.max(np.asarray(out.next_seq))) == 6
