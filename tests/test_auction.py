@@ -870,3 +870,40 @@ def test_sharded_auction_at_venue_depth():
     snaps = snapshot_books(nb)
     for i, ob in oracles.items():
         assert snaps[i] == ob.snapshot(), f"symbol {i}"
+
+
+def test_wide_limb_arithmetic_properties():
+    """Direct property checks of the base-2^15 two-limb helpers
+    (engine/auction_sorted.py) against Python big-int arithmetic over
+    random and extreme values — the primitives every venue-depth
+    clearing-price comparison rests on."""
+    import random
+
+    from matching_engine_tpu.engine import auction_sorted as ws
+
+    rng = random.Random(3)
+
+    def val(hi, lo):
+        return int(hi) * (1 << 15) + int(lo)
+
+    qs = [0, 1, 2_000_000, 1_999_999] + [rng.randrange(0, 2_000_001)
+                                         for _ in range(60)]
+    arr = jnp.asarray(np.array(qs, np.int32))
+    hi, lo = ws._w_cumsum(arr)
+    run = 0
+    for i, q in enumerate(qs):
+        run += q
+        assert val(hi[i], lo[i]) == run
+        assert 0 <= int(lo[i]) < (1 << 15)  # canonical form
+
+    # Subtraction + abs, including negative results, vs Python ints.
+    for _ in range(50):
+        a = rng.randrange(0, 8192 * 2_000_000)
+        b = rng.randrange(0, 8192 * 2_000_000)
+        ah, al = jnp.int32(a >> 15), jnp.int32(a & 0x7FFF)
+        bh, bl = jnp.int32(b >> 15), jnp.int32(b & 0x7FFF)
+        dh, dl = ws._w_sub(ah, al, bh, bl)
+        assert val(dh, dl) == a - b
+        xh, xl = ws._w_abs(dh, dl)
+        assert val(xh, xl) == abs(a - b)
+        assert bool(ws._w_le(ah, al, bh, bl)) == (a <= b)
