@@ -755,6 +755,11 @@ class EngineRunner:
                                             "engine_dispatch_us"):
                 self._finish_pending_locked(posts)
                 summary = self._run_auction_locked(symbols, sink)
+                # Auctions are scheduled venue maintenance points and the
+                # pipeline is drained here — the second rebase hook for
+                # deployments running without a checkpoint daemon (one
+                # [S] readback per auction; no-op below the threshold).
+                self.maybe_rebase_seqs()
         finally:
             for p in posts:
                 p()
