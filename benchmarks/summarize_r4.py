@@ -88,14 +88,22 @@ def main() -> None:
         print("pending")
 
     print("\n## Serving stack\n")
-    rb = load("tpu_r4_runner.json")
-    if rb:
-        print("| inflight | orders/s | p50 ms | p99 ms |")
-        print("|---|---|---|---|")
+    any_rb = False
+    for art in ("tpu_r4_runner.json", "tpu_r5_runner_sat.json"):
+        rb = load(art)
+        if not rb:
+            continue
+        if any_rb:
+            print()
+        any_rb = True
+        print(f"`{art}`:\n")
+        print("| batch_ops | inflight | orders/s | p50 ms | p99 ms |")
+        print("|---|---|---|---|---|")
         for p in rb.get("sweep", []):
-            print(f"| {p['inflight']} | {fmt(p['orders_per_s'])} | "
+            print(f"| {p.get('batch_ops')} | {p['inflight']} | "
+                  f"{fmt(p['orders_per_s'])} | "
                   f"{p['p50_ms']} | {p['p99_ms']} |")
-    else:
+    if not any_rb:
         print("runner sweep pending")
     print()
     print("| edge | pi | orders/s | p50 ms | p99 ms | p99/p50 |")
