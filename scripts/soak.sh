@@ -10,11 +10,22 @@
 # artifact to benchmarks/results/soak_<ts>.json.
 #
 # Usage: scripts/soak.sh [minutes]   (default 3; CPU platform)
+#   SOAK_PLATFORM=tpu scripts/soak.sh 12   — run the server on the real
+#   tunneled chip instead (pre-probes the tunnel so a wedged window fails
+#   fast; boot budget widened for on-device compile).
 set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
-export JAX_PLATFORMS=cpu
-unset PALLAS_AXON_POOL_IPS   # a wedged axon tunnel must not hang the soak
+SOAK_PLATFORM="${SOAK_PLATFORM:-cpu}"
+if [ "$SOAK_PLATFORM" = "tpu" ]; then
+  # Keep the inherited axon env (JAX_PLATFORMS=axon + pool IPs); a dead
+  # tunnel must fail the soak in seconds, not hang the server boot.
+  timeout -s KILL 60 python -c "import jax; assert jax.devices()" \
+    >/dev/null 2>&1 || { echo "FAIL: tpu tunnel probe"; exit 1; }
+else
+  export JAX_PLATFORMS=cpu
+  unset PALLAS_AXON_POOL_IPS  # a wedged axon tunnel must not hang the soak
+fi
 
 MINUTES="${1:-3}"
 SOAK_SERVER_ARGS="${SOAK_SERVER_ARGS:-}"
@@ -35,7 +46,9 @@ SRV=$!
 trap 'kill $SRV 2>/dev/null' EXIT
 
 PY_PORT=""; GW_PORT=""
-for i in $(seq 1 120); do
+BOOT_WAIT=120
+[ "$SOAK_PLATFORM" = "tpu" ] && BOOT_WAIT=240   # on-device compile at boot
+for i in $(seq 1 "$BOOT_WAIT"); do
   PY_PORT=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
   GW_PORT=$(sed -n 's/.*native gateway on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
   [ -n "$PY_PORT" ] && [ -n "$GW_PORT" ] && break
@@ -100,7 +113,7 @@ artifact = {
     "metric": "soak", "minutes": $MINUTES, "rounds": $ROUNDS,
     "orders_ok": $OK_TOTAL, "cancels": $CANCELS,
     "audit_violations": int("$AUDIT".strip() or -1),
-    "platform": "cpu", "git_rev": rev,
+    "platform": "$SOAK_PLATFORM", "git_rev": rev,
     "server_args": "$SOAK_SERVER_ARGS",
 }
 json.dump(artifact, open(sys.argv[1], "w"))
