@@ -21,8 +21,8 @@ from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.proto.rpc import MatchingEngineStub
 
 USAGE = (
-    "usage: client <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> "
-    "<price> <scale> <quantity>\n"
+    "usage: client <addr> <client_id> <symbol> <BUY|SELL> "
+    "<LIMIT|MARKET[:IOC|:FOK]> <price> <scale> <quantity>\n"
     "   or: client book <addr> <symbol>\n"
     "   or: client cancel <addr> <client_id> <order_id>\n"
     "   or: client watch-md <addr> <symbol>\n"
@@ -39,13 +39,19 @@ def _stub(addr: str) -> MatchingEngineStub:
 def _submit(argv: list[str]) -> int:
     addr, client_id, symbol, side_s, type_s, price_s, scale_s, qty_s = argv
     side = {"BUY": pb2.BUY, "SELL": pb2.SELL}.get(side_s.upper())
-    otype = {"LIMIT": pb2.LIMIT, "MARKET": pb2.MARKET}.get(type_s.upper())
-    if side is None or otype is None:
+    # Optional time-in-force suffix: LIMIT:IOC / LIMIT:FOK / MARKET:FOK
+    # (MARKET:IOC accepted; MARKET is inherently immediate-or-cancel).
+    type_u, _, tif_s = type_s.upper().partition(":")
+    otype = {"LIMIT": pb2.LIMIT, "MARKET": pb2.MARKET}.get(type_u)
+    tif = {"": pb2.TIF_GTC, "GTC": pb2.TIF_GTC, "IOC": pb2.TIF_IOC,
+           "FOK": pb2.TIF_FOK}.get(tif_s)
+    if side is None or otype is None or tif is None:
         print(USAGE, file=sys.stderr)
         return 1
     req = pb2.OrderRequest(
         client_id=client_id, symbol=symbol, order_type=otype, side=side,
         price=int(price_s), scale=int(scale_s), quantity=int(qty_s),
+        tif=tif,
     )
     try:
         resp = _stub(addr).SubmitOrder(req, timeout=30)

@@ -272,8 +272,13 @@ def random_order_stream(
     price_levels: int = 12,
     price_step: int = 100,
     qty_max: int = 20,
+    tif_p: float = 0.0,
 ) -> list[HostOrder]:
     """Deterministic mixed op stream (limit/market submits + cancels).
+
+    tif_p > 0 additionally converts that fraction of submits to a
+    time-in-force variant (LIMIT -> LIMIT_IOC or LIMIT_FOK, MARKET ->
+    MARKET_FOK), exercising the collapsed otype codes end to end.
 
     The one generator behind the parity tests, the sharding tests, and the
     benchmark, so they all exercise the same op mix. Cancels target
@@ -286,7 +291,10 @@ def random_order_stream(
     from matching_engine_tpu.engine.kernel import (
         BUY,
         LIMIT,
+        LIMIT_FOK,
+        LIMIT_IOC,
         MARKET,
+        MARKET_FOK,
         OP_CANCEL,
         OP_SUBMIT,
         SELL,
@@ -306,8 +314,13 @@ def random_order_stream(
         oid += 1
         side = rng.choice((BUY, SELL))
         otype = MARKET if rng.random() < market_p else LIMIT
+        if tif_p and rng.random() < tif_p:
+            if otype == MARKET:
+                otype = MARKET_FOK
+            else:
+                otype = rng.choice((LIMIT_IOC, LIMIT_FOK))
         price = (
-            0 if otype == MARKET
+            0 if otype in (MARKET, MARKET_FOK)
             else price_base + price_step * rng.randrange(price_levels)
         )
         qty = rng.randrange(1, qty_max)

@@ -54,7 +54,15 @@ NOOP_STATUS = -1
 # (engine/auction.py): books may stand crossed until an uncross clears
 # them. Identical to OP_SUBMIT except the maker scan never runs.
 OP_NOOP, OP_SUBMIT, OP_CANCEL, OP_REST = 0, 1, 2, 3
-LIMIT, MARKET = 0, 1
+# Device otype lane: the wire's (order_type, time_in_force) pair collapses
+# to one small code so the dispatch layout stays [S, B, 7] (no extra lane).
+# LIMIT = GTC limit (the only code that RESTS); MARKET is inherently IOC.
+# LIMIT_IOC matches at the limit then cancels the remainder; LIMIT_FOK /
+# MARKET_FOK are all-or-nothing (fill the full quantity immediately or
+# cancel untouched). The service edge maps proto tif -> these codes
+# (server/service.py); the reference's wire contract has no tif field —
+# this is an additive extension (proto field 8).
+LIMIT, MARKET, LIMIT_IOC, LIMIT_FOK, MARKET_FOK = 0, 1, 2, 3, 4
 BUY, SELL = 1, 2
 
 
@@ -91,7 +99,12 @@ def _match_one(book: _SymBook, order):
     is_rest = op == OP_REST          # auction accumulation: never matches
     is_submit_like = is_submit | is_rest
     is_buy = side == BUY
-    is_market = otype == MARKET
+    # px_any: price-indifferent sweep (MARKET-style eligibility); is_fok:
+    # all-or-nothing; never_rests: every code but plain LIMIT cancels its
+    # remainder instead of resting.
+    px_any = (otype == MARKET) | (otype == MARKET_FOK)
+    is_fok = (otype == LIMIT_FOK) | (otype == MARKET_FOK)
+    never_rests = px_any | (otype == LIMIT_IOC) | (otype == LIMIT_FOK)
 
     # ---- opposite side (maker candidates), via where-selects -------------
     opp_price = jnp.where(is_buy, book.ask_price, book.bid_price)
@@ -114,8 +127,8 @@ def _match_one(book: _SymBook, order):
     # recovery safety net relies on never happening). OP_REST bypasses
     # both (auction accumulation crosses deliberately).
     not_self = (owner == 0) | (opp_owner != owner)
-    elig = (opp_qty > 0) & (is_market | price_ok) & is_submit & not_self
-    self_blocked = is_submit & (~is_market) & jnp.any(
+    elig = (opp_qty > 0) & (px_any | price_ok) & is_submit & not_self
+    self_blocked = is_submit & (~never_rests) & jnp.any(
         (opp_qty > 0) & price_ok & (owner != 0) & (opp_owner == owner))
 
     # better[k, j]: maker k strictly ahead of maker j in price-time priority.
@@ -125,10 +138,15 @@ def _match_one(book: _SymBook, order):
     elig_qty = jnp.where(elig, opp_qty, 0)
     ahead = jnp.sum(jnp.where(better, elig_qty[:, None], 0), axis=0)
 
-    take_q = jnp.where(is_submit_like, qty, 0)
+    # Fill-or-kill gate: all-or-nothing — if the eligible liquidity can't
+    # cover the full quantity, no fill happens at all. The sum is exact:
+    # matrix books are capacity <= 1024 < 2^31 / MAX_QUANTITY (book.py).
+    fok_fail = is_fok & (jnp.sum(elig_qty) < qty)
+
+    take_q = jnp.where(is_submit_like & ~fok_fail, qty, 0)
     fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
     filled_total = jnp.sum(fill)
-    remaining = take_q - filled_total
+    remaining = jnp.where(is_submit_like, qty, 0) - filled_total
 
     new_opp_qty = opp_qty - fill
 
@@ -149,7 +167,7 @@ def _match_one(book: _SymBook, order):
     own_seq = jnp.where(is_buy, book.bid_seq, book.ask_seq)
     own_owner = jnp.where(is_buy, book.bid_owner, book.ask_owner)
 
-    do_rest = is_submit_like & (~is_market) & (remaining > 0) & ~self_blocked
+    do_rest = is_submit_like & (~never_rests) & (remaining > 0) & ~self_blocked
     free = own_qty == 0
     has_free = jnp.any(free)
     slot_idx = jnp.argmax(free)  # first free slot
@@ -189,9 +207,10 @@ def _match_one(book: _SymBook, order):
         remaining == 0,
         FILLED,
         jnp.where(
-            # Immediate-or-cancel remainders: MARKET always; a LIMIT whose
-            # rest would self-cross (STP skip-then-cancel).
-            is_market | self_blocked,
+            # Immediate-or-cancel remainders: MARKET/IOC/FOK always (none
+            # of them rest — a failed FOK cancels untouched); a LIMIT
+            # whose rest would self-cross (STP skip-then-cancel).
+            never_rests | self_blocked,
             CANCELED,
             jnp.where(
                 rested,

@@ -44,7 +44,10 @@ from matching_engine_tpu.engine.kernel import (
     BUY,
     CANCELED,
     FILLED,
+    LIMIT_FOK,
+    LIMIT_IOC,
     MARKET,
+    MARKET_FOK,
     NEW,
     NOOP_STATUS,
     OP_CANCEL,
@@ -83,7 +86,11 @@ def _match_one_sorted(book: _SymBook, order):
     is_rest = op == OP_REST
     is_submit_like = is_submit | is_rest
     is_buy = side == BUY
-    is_market = otype == MARKET
+    # Same tif collapse as kernel._match_one: px_any = price-indifferent
+    # sweep, is_fok = all-or-nothing, never_rests = cancels remainder.
+    px_any = (otype == MARKET) | (otype == MARKET_FOK)
+    is_fok = (otype == LIMIT_FOK) | (otype == MARKET_FOK)
+    never_rests = px_any | (otype == LIMIT_IOC) | (otype == LIMIT_FOK)
     cap = book.bid_qty.shape[0]
     idx = jnp.arange(cap)
 
@@ -97,8 +104,8 @@ def _match_one_sorted(book: _SymBook, order):
     live = opp_qty > 0
     price_ok = jnp.where(is_buy, opp_price <= price, opp_price >= price)
     not_self = (owner == 0) | (opp_owner != owner)
-    elig = live & (is_market | price_ok) & is_submit & not_self
-    self_blocked = is_submit & (~is_market) & jnp.any(
+    elig = live & (px_any | price_ok) & is_submit & not_self
+    self_blocked = is_submit & (~never_rests) & jnp.any(
         live & price_ok & (owner != 0) & (opp_owner == owner))
 
     # Priority order IS slot order: ahead-of-j is an exclusive prefix sum.
@@ -122,10 +129,17 @@ def _match_one_sorted(book: _SymBook, order):
         cum = jnp.cumsum(elig_qty)
     ahead = cum - elig_qty
 
-    take_q = jnp.where(is_submit_like, qty, 0)
+    # Fill-or-kill gate: the inclusive cumsum's last element is the total
+    # eligible liquidity. Under the saturating venue-depth scan it clamps
+    # at 2^30-1 > MAX_QUANTITY >= qty, so `avail < qty` is exact whether
+    # or not the running sum saturated.
+    avail = cum[-1] if cap > 0 else jnp.int32(0)
+    fok_fail = is_fok & (avail < qty)
+
+    take_q = jnp.where(is_submit_like & ~fok_fail, qty, 0)
     fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
     filled_total = jnp.sum(fill)
-    remaining = take_q - filled_total
+    remaining = jnp.where(is_submit_like, qty, 0) - filled_total
 
     # Rank among eligible makers = exclusive prefix count (same slots the
     # matrix kernel's pairwise rank produces — sorted order is priority
@@ -152,7 +166,7 @@ def _match_one_sorted(book: _SymBook, order):
 
     own_live = own_qty > 0
     n_live = jnp.sum(own_live.astype(I32))
-    do_rest = is_submit_like & (~is_market) & (remaining > 0) & ~self_blocked
+    do_rest = is_submit_like & (~never_rests) & (remaining > 0) & ~self_blocked
     rested = do_rest & (n_live < cap)
 
     # Insertion position: behind every live entry with key <= new key
@@ -203,7 +217,7 @@ def _match_one_sorted(book: _SymBook, order):
         remaining == 0,
         FILLED,
         jnp.where(
-            is_market | self_blocked,
+            never_rests | self_blocked,
             CANCELED,
             jnp.where(
                 rested,

@@ -13,6 +13,11 @@ framework defines (SURVEY.md §7 "Matching semantics"):
   rests in the book.
 - MARKET: sweeps the opposite side without a price bound; any remainder is
   canceled (immediate-or-cancel remainder — market orders never rest).
+- LIMIT_IOC: matches at the limit like LIMIT, then cancels any remainder
+  instead of resting it.
+- LIMIT_FOK / MARKET_FOK: all-or-nothing — if the eligible liquidity
+  (price-crossing, live, not self-owned) cannot cover the full quantity,
+  the order cancels untouched; otherwise it fills completely.
 - Fills execute at the resting (maker) price.
 - CANCEL removes a resting order by id.
 - Each book side has a fixed capacity (the device kernel's static shape); a
@@ -35,6 +40,11 @@ PARTIALLY_FILLED = pb2.OrderUpdate.Status.PARTIALLY_FILLED
 FILLED = pb2.OrderUpdate.Status.FILLED
 CANCELED = pb2.OrderUpdate.Status.CANCELED
 REJECTED = pb2.OrderUpdate.Status.REJECTED
+
+# Collapsed (order_type, tif) codes — MUST match kernel.py's lane encoding
+# (pinned by tests/test_tif.py); defined here too so the oracle stays
+# importable without jax.
+LIMIT_IOC, LIMIT_FOK, MARKET_FOK = 2, 3, 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,8 +106,28 @@ class OracleBook:
         assert qty > 0
         opp_side = pb2.SELL if side == pb2.BUY else pb2.BUY
         opp = self._opposite(side)
+        px_any = order_type in (pb2.MARKET, MARKET_FOK)
+        is_fok = order_type in (LIMIT_FOK, MARKET_FOK)
+        never_rests = order_type != pb2.LIMIT
         remaining = qty
         fills: list[Fill] = []
+
+        def crosses(maker: _Resting) -> bool:
+            if px_any:
+                return True
+            if side == pb2.BUY:
+                return maker.price_q4 <= price_q4
+            return maker.price_q4 >= price_q4
+
+        # Fill-or-kill: all-or-nothing against the liquidity this taker is
+        # actually eligible for (price-crossing, live, not self-owned).
+        if is_fok:
+            avail = sum(
+                m.qty for m in opp
+                if m.qty > 0 and crosses(m)
+                and not (owner and m.owner == owner))
+            if avail < qty:
+                return OrderResult(oid, CANCELED, 0, qty, False, ())
 
         for maker in self._priority_sorted(opp_side, opp):
             if remaining == 0:
@@ -106,11 +136,8 @@ class OracleBook:
                 continue
             if owner and maker.owner == owner:
                 continue  # self-trade prevention: skip own resting orders
-            if order_type == pb2.LIMIT:
-                if side == pb2.BUY and maker.price_q4 > price_q4:
-                    break
-                if side == pb2.SELL and maker.price_q4 < price_q4:
-                    break
+            if not crosses(maker):
+                break  # priority-sorted: nothing further can cross
             take = min(remaining, maker.qty)
             maker.qty -= take
             remaining -= take
@@ -124,7 +151,9 @@ class OracleBook:
         if remaining == 0:
             return OrderResult(oid, FILLED, filled, 0, False, tuple(fills))
 
-        if order_type == pb2.MARKET:
+        if never_rests:
+            # MARKET and IOC remainders cancel; a FOK that passed the
+            # all-or-nothing gate cannot reach here.
             return OrderResult(oid, CANCELED, filled, remaining, False, tuple(fills))
 
         # STP skip-then-cancel: a remainder whose rest would cross the

@@ -488,9 +488,11 @@ def _pack_str(out: bytearray, s: str) -> None:
 def pack_batch(orders, updates, fills) -> bytes:
     """Serialize one dispatch for MeSink (format in me_native.cpp §3).
 
-    orders: Storage.insert_new_order arg tuples
-            (order_id, client_id, symbol, side, otype, price|None, qty,
-             remaining, status);
+    orders: (order_id, client_id, symbol, side, collapsed_otype,
+             price|None, qty, remaining, status) — field 5 is the engine's
+             collapsed (order_type, tif) lane code (proto.split_otype);
+             MeSink splits it into the order_type column (wire 0/1) and
+             the tif column, mirroring Storage.apply_batch;
     updates: (order_id, status, remaining); fills: FillRow.
     """
     out = bytearray()

@@ -16,5 +16,47 @@ SELL = pb2.SELL
 LIMIT = pb2.LIMIT
 MARKET = pb2.MARKET
 Status = pb2.OrderUpdate.Status
+TimeInForce = pb2.TimeInForce
+TIF_GTC = pb2.TIF_GTC
+TIF_IOC = pb2.TIF_IOC
+TIF_FOK = pb2.TIF_FOK
 
-__all__ = ["pb2", "Side", "OrderType", "BUY", "SELL", "LIMIT", "MARKET", "Status"]
+# Collapsed (order_type, tif) device codes: the engine carries one small
+# int per order (the otype lane) so the dispatch layout stays [S, B, 7].
+# MUST match engine/kernel.py's constants (pinned by tests/test_tif.py).
+LIMIT_IOC, LIMIT_FOK, MARKET_FOK = 2, 3, 4
+
+_COLLAPSE = {
+    (LIMIT, TIF_GTC): LIMIT,
+    (MARKET, TIF_GTC): MARKET,
+    (MARKET, TIF_IOC): MARKET,  # MARKET is inherently immediate-or-cancel
+    (LIMIT, TIF_IOC): LIMIT_IOC,
+    (LIMIT, TIF_FOK): LIMIT_FOK,
+    (MARKET, TIF_FOK): MARKET_FOK,
+}
+_SPLIT = {
+    LIMIT: (LIMIT, TIF_GTC),
+    MARKET: (MARKET, TIF_GTC),
+    LIMIT_IOC: (LIMIT, TIF_IOC),
+    LIMIT_FOK: (LIMIT, TIF_FOK),
+    MARKET_FOK: (MARKET, TIF_FOK),
+}
+
+
+def collapse_otype(order_type: int, tif: int):
+    """(wire order_type, wire tif) -> device otype code, or None for an
+    invalid combination (the edges reject those)."""
+    return _COLLAPSE.get((order_type, tif))
+
+
+def split_otype(code: int) -> tuple[int, int]:
+    """Device otype code -> (wire order_type, wire tif); what storage
+    persists (the orders table keeps the reference's 0/1 order_type CHECK
+    and records tif in its own column)."""
+    return _SPLIT[code]
+
+
+__all__ = ["pb2", "Side", "OrderType", "BUY", "SELL", "LIMIT", "MARKET",
+           "Status", "TimeInForce", "TIF_GTC", "TIF_IOC", "TIF_FOK",
+           "LIMIT_IOC", "LIMIT_FOK", "MARKET_FOK",
+           "collapse_otype", "split_otype"]

@@ -49,7 +49,7 @@ from matching_engine_tpu.engine.kernel import (
     engine_step_packed,
 )
 from matching_engine_tpu.domain.order import owner_hash
-from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto import MARKET_FOK, pb2
 from matching_engine_tpu.storage.storage import FillRow
 from matching_engine_tpu.utils.metrics import Metrics, Timer
 from matching_engine_tpu.utils.tracing import step_annotation
@@ -993,7 +993,8 @@ class EngineRunner:
                     )
                 else:
                     res.outcomes.append(OpOutcome(e, r.status, r.filled, r.remaining))
-                price_col = None if info.otype == pb2.MARKET else info.price_q4
+                price_col = (None if info.otype in (pb2.MARKET, MARKET_FOK)
+                             else info.price_q4)
                 res.storage_orders.append(
                     (info.order_id, info.client_id, info.symbol, info.side,
                      info.otype, price_col, info.quantity, info.remaining,

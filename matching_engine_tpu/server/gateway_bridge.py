@@ -176,12 +176,12 @@ class GatewayBridge:
                         tag, False, "", "invalid request encoding")
                 continue
             if op == 1:  # submit (already validated in C++)
-                if runner.auction_mode and otype == 1:  # MARKET
+                if runner.auction_mode and otype != 0:  # anything but GTC LIMIT
                     self.metrics.inc("orders_rejected")
                     self.gateway.complete_submit(
                         tag, False, "",
-                        "MARKET orders are not accepted during an auction "
-                        "call period",
+                        "only GTC LIMIT orders are accepted during an "
+                        "auction call period",
                     )
                     continue
                 if not runner.owns_symbol(symbol):

@@ -31,7 +31,7 @@ from matching_engine_tpu.engine.kernel import (
     OP_SUBMIT,
     REJECTED,
 )
-from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto import collapse_otype, pb2
 from matching_engine_tpu.proto.rpc import MatchingEngineServicer
 from matching_engine_tpu.server.dispatcher import BatchDispatcher, RingFull
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
@@ -69,6 +69,12 @@ class MatchingEngineService(MatchingEngineServicer):
             if request.order_type in (pb2.LIMIT, pb2.MARKET)
             else str(request.order_type)  # proto3 open enums: log raw, don't crash
         )
+        if request.tif:
+            type_s += "/" + (
+                pb2.TimeInForce.Name(request.tif)
+                if request.tif in (pb2.TIF_IOC, pb2.TIF_FOK)
+                else str(request.tif)
+            )
         self._log(
             f"SubmitOrder client={request.client_id} symbol={request.symbol} "
             f"side={side_s} type={type_s} "
@@ -77,9 +83,15 @@ class MatchingEngineService(MatchingEngineServicer):
         )
 
         err = validate_submit(request)
+        otype = collapse_otype(request.order_type, request.tif)
+        if err is None and otype is None:
+            err = "unsupported (order_type, tif) combination"
         if (err is None and self.runner.auction_mode
-                and request.order_type == pb2.MARKET):
-            err = "MARKET orders are not accepted during an auction call period"
+                and otype != pb2.LIMIT):
+            # MARKET/IOC/FOK all demand immediate execution; a call period
+            # has no continuous matching to execute against.
+            err = ("only GTC LIMIT orders are accepted during an auction "
+                   "call period")
         if err is None and not self.runner.owns_symbol(request.symbol):
             # Multi-process routing invariant: the client (or front-end
             # router) must send this symbol to its home host.
@@ -101,7 +113,7 @@ class MatchingEngineService(MatchingEngineServicer):
         info = OrderInfo(
             oid=oid_num, order_id=order_id, client_id=request.client_id,
             symbol=request.symbol, side=request.side,
-            otype=request.order_type, price_q4=price_q4,
+            otype=otype, price_q4=price_q4,
             quantity=request.quantity, remaining=request.quantity, status=0,
             handle=self.runner.assign_handle(),
         )

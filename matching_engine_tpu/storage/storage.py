@@ -45,7 +45,11 @@ CREATE TABLE IF NOT EXISTS orders (
     remaining_quantity  INTEGER NOT NULL CHECK (remaining_quantity >= 0),
     status              INTEGER NOT NULL CHECK (status BETWEEN 0 AND 4),
     created_ts          INTEGER NOT NULL,
-    updated_ts          INTEGER NOT NULL
+    updated_ts          INTEGER NOT NULL,
+    -- Time-in-force (wire TimeInForce: GTC=0/IOC=1/FOK=2). order_type keeps
+    -- the reference's 0/1 domain; IOC/FOK rows never rest so recovery's
+    -- resting-order replay needs no tif awareness.
+    tif                 INTEGER NOT NULL DEFAULT 0 CHECK (tif IN (0, 1, 2))
 );
 CREATE INDEX IF NOT EXISTS idx_orders_symbol_status ON orders (symbol, status);
 CREATE INDEX IF NOT EXISTS idx_orders_client ON orders (client_id);
@@ -221,6 +225,15 @@ class Storage:
                 cur.execute("PRAGMA synchronous=NORMAL")
                 cur.execute("PRAGMA foreign_keys=ON")
                 cur.executescript(_SCHEMA)
+                # Migration: a database created before the tif column
+                # existed keeps its original orders table (CREATE TABLE IF
+                # NOT EXISTS is a no-op there) — add the column in place.
+                cols = {r[1] for r in cur.execute(
+                    "PRAGMA table_info(orders)").fetchall()}
+                if "tif" not in cols:
+                    cur.execute(
+                        "ALTER TABLE orders ADD COLUMN tif INTEGER NOT NULL "
+                        "DEFAULT 0 CHECK (tif IN (0, 1, 2))")
             return True
         except Exception as e:  # noqa: BLE001 — never-throw surface
             print(f"[storage] init failed: {e}")
@@ -244,6 +257,7 @@ class Storage:
         quantity: int,
         status: int = STATUS_NEW,
         remaining: int | None = None,
+        tif: int = 0,
     ) -> bool:
         """Insert an accepted order. MARKET orders pass price_q4=None."""
         ts = _now_us()
@@ -253,9 +267,10 @@ class Storage:
                 self._conn.execute(
                     "INSERT INTO orders (order_id, client_id, symbol, side, "
                     "order_type, price, quantity, remaining_quantity, status, "
-                    "created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    "created_ts, updated_ts, tif) VALUES "
+                    "(?,?,?,?,?,?,?,?,?,?,?,?)",
                     (order_id, client_id, symbol, side, order_type, price_q4,
-                     quantity, rem, status, ts, ts),
+                     quantity, rem, status, ts, ts, tif),
                 )
             return True
         except Exception as e:  # noqa: BLE001
@@ -292,19 +307,33 @@ class Storage:
     def apply_batch(self, orders: list[tuple], updates: list[tuple], fills: list[FillRow]) -> bool:
         """One transaction for a whole engine dispatch (the async sink's unit).
 
-        orders: insert_new_order arg tuples; updates: (order_id, status,
-        remaining) tuples; fills: FillRows.
+        orders: (order_id, client_id, symbol, side, collapsed_otype,
+        price|None, quantity, remaining, status) tuples — the otype is the
+        engine's collapsed (order_type, tif) lane code, split here so the
+        order_type column keeps the reference's 0/1 domain; updates:
+        (order_id, status, remaining) tuples; fills: FillRows.
         """
+        from matching_engine_tpu.proto import split_otype
+
         ts = _now_us()
         try:
+            # Inside the try: a malformed tuple or unknown collapsed code
+            # must honor this module's never-throw bool contract (the async
+            # sink thread calls with no guard of its own).
+            order_rows = []
+            for (oid, cid, sym, side, code, price, qty, rem, status) in orders:
+                otype, tif = split_otype(code)
+                order_rows.append((oid, cid, sym, side, otype, price, qty,
+                                   rem, status, ts, ts, tif))
             with self._lock:
                 self._conn.execute("BEGIN")
                 try:
                     self._conn.executemany(
                         "INSERT INTO orders (order_id, client_id, symbol, side, "
                         "order_type, price, quantity, remaining_quantity, status, "
-                        "created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
-                        [(*o, ts, ts) for o in orders],
+                        "created_ts, updated_ts, tif) VALUES "
+                        "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                        order_rows,
                     )
                     self._conn.executemany(
                         "UPDATE orders SET status = ?, remaining_quantity = ?, "
@@ -362,7 +391,8 @@ class Storage:
             with self._lock:
                 row = self._conn.execute(
                     "SELECT order_id, client_id, symbol, side, order_type, price, "
-                    "quantity, remaining_quantity, status FROM orders WHERE order_id = ?",
+                    "quantity, remaining_quantity, status, created_ts, "
+                    "updated_ts, tif FROM orders WHERE order_id = ?",
                     (order_id,),
                 ).fetchone()
             return row

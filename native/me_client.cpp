@@ -38,8 +38,8 @@ namespace pb = matching_engine::v1;
 namespace {
 
 const char kUsage[] =
-    "usage: me_client <addr> <client_id> <symbol> <BUY|SELL> <LIMIT|MARKET> "
-    "<price> <scale> <quantity>\n"
+    "usage: me_client <addr> <client_id> <symbol> <BUY|SELL> "
+    "<LIMIT|MARKET[:IOC|:FOK]> <price> <scale> <quantity>\n"
     "   or: me_client cancel <addr> <client_id> <order_id>\n"
     "   or: me_client book <addr> <symbol>\n"
     "   or: me_client metrics <addr>\n"
@@ -912,11 +912,27 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "%s\n", kUsage);
     return 1;
   }
+  // Optional time-in-force suffix: LIMIT:IOC, LIMIT:FOK, MARKET:FOK
+  // (MARKET:IOC is accepted — MARKET is inherently immediate-or-cancel).
+  std::string tif;
+  auto colon = otype.find(':');
+  if (colon != std::string::npos) {
+    tif = otype.substr(colon + 1);
+    otype = otype.substr(0, colon);
+  }
   if (otype == "LIMIT") {
     req.set_order_type(pb::LIMIT);
   } else if (otype == "MARKET") {
     req.set_order_type(pb::MARKET);
   } else {
+    std::fprintf(stderr, "%s\n", kUsage);
+    return 1;
+  }
+  if (tif == "IOC") {
+    req.set_tif(pb::TIF_IOC);
+  } else if (tif == "FOK") {
+    req.set_tif(pb::TIF_FOK);
+  } else if (!tif.empty() && tif != "GTC") {
     std::fprintf(stderr, "%s\n", kUsage);
     return 1;
   }

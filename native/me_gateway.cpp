@@ -66,7 +66,8 @@ namespace {
 bool validate_submit_msg(const matching_engine::v1::OrderRequest& req,
                          long long max_price_q4, long long max_quantity,
                          int max_symbol_len, int max_client_id_len,
-                         long long* price_q4_out, std::string* msg) {
+                         long long* price_q4_out, int* otype_out,
+                         std::string* msg) {
   char buf[192];
   if (req.symbol().empty()) {
     *msg = "symbol is required";
@@ -102,6 +103,21 @@ bool validate_submit_msg(const matching_engine::v1::OrderRequest& req,
   int otype = static_cast<int>(req.order_type());
   if (otype != 0 && otype != 1) {
     *msg = "order_type must be LIMIT or MARKET";
+    return false;
+  }
+  // Collapse (order_type, tif) into the device otype lane code — the
+  // same mapping as matching_engine_tpu/proto/__init__.py collapse_otype
+  // (LIMIT=0, MARKET=1, LIMIT_IOC=2, LIMIT_FOK=3, MARKET_FOK=4; MARKET
+  // is inherently IOC so MARKET+TIF_IOC stays 1).
+  int tif = static_cast<int>(req.tif());
+  if (tif == 0) {
+    *otype_out = otype;
+  } else if (tif == 1) {
+    *otype_out = (otype == 0) ? 2 : 1;
+  } else if (tif == 2) {
+    *otype_out = (otype == 0) ? 3 : 4;
+  } else {
+    *msg = "unsupported (order_type, tif) combination";
     return false;
   }
   *price_q4_out = 0;
@@ -1094,17 +1110,18 @@ void Conn::handle_submit(uint32_t stream_id, const std::string& payload) {
   // Validation parity with the Python service: app-level reject, gRPC OK
   // (reference matching_engine_service.cpp:66-83 semantics).
   long long price_q4 = 0;
+  int otype = 0;
   std::string err;
   if (!validate_submit_msg(req, gw_->max_price_q4(), gw_->max_quantity(),
                            gw_->max_symbol_len(), gw_->max_client_id_len(),
-                           &price_q4, &err)) {
+                           &price_q4, &otype, &err)) {
     reject_submit(stream_id, "", err);
     return;
   }
   MeGwOp op{};
   op.op = 1;
   op.side = req.side();
-  op.otype = req.order_type();
+  op.otype = otype;
   op.price_q4 = static_cast<int32_t>(price_q4);
   op.quantity = req.quantity();
   // Length-prefixed copies: proto3 strings may hold embedded NULs and must

@@ -331,7 +331,8 @@ const char kSchema[] =
     "  remaining_quantity  INTEGER NOT NULL CHECK (remaining_quantity >= 0),"
     "  status              INTEGER NOT NULL CHECK (status BETWEEN 0 AND 4),"
     "  created_ts          INTEGER NOT NULL,"
-    "  updated_ts          INTEGER NOT NULL);"
+    "  updated_ts          INTEGER NOT NULL,"
+    "  tif                 INTEGER NOT NULL DEFAULT 0 CHECK (tif IN (0, 1, 2)));"
     "CREATE INDEX IF NOT EXISTS idx_orders_symbol_status"
     "  ON orders (symbol, status);"
     "CREATE INDEX IF NOT EXISTS idx_orders_client ON orders (client_id);"
@@ -493,13 +494,20 @@ class MeSink {
       return false;
     if (sqlite3_exec(db_, kSchema, nullptr, nullptr, nullptr) != SQLITE_OK)
       return false;
+    // Migration twin of Storage.init(): a pre-tif database keeps its
+    // original orders table; add the column in place (failure = column
+    // already exists, which is the fine case — probe it afterwards).
+    sqlite3_exec(db_,
+                 "ALTER TABLE orders ADD COLUMN tif INTEGER NOT NULL "
+                 "DEFAULT 0 CHECK (tif IN (0, 1, 2))",
+                 nullptr, nullptr, nullptr);
     auto prep = [&](const char* sql, sqlite3_stmt** st) {
       return sqlite3_prepare_v2(db_, sql, -1, st, nullptr) == SQLITE_OK;
     };
     return prep(
                "INSERT INTO orders (order_id, client_id, symbol, side,"
                " order_type, price, quantity, remaining_quantity, status,"
-               " created_ts, updated_ts) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+               " created_ts, updated_ts, tif) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
                &ins_order_) &&
            prep(
                "UPDATE orders SET status = ?, remaining_quantity = ?,"
@@ -575,11 +583,17 @@ class MeSink {
             r.u8(&otype) && r.u8(&has_price) && r.i64(&price) &&
             r.i64(&qty) && r.i64(&remaining) && r.u8(&status)))
         return false;
+      // The wire byte is the engine's collapsed (order_type, tif) lane
+      // code (proto/__init__.py split_otype): 0/1 = LIMIT/MARKET GTC,
+      // 2 = LIMIT IOC, 3 = LIMIT FOK, 4 = MARKET FOK. The order_type
+      // column keeps the reference's 0/1 domain; tif gets its own column.
+      int base_type = (otype == 1 || otype == 4) ? 1 : 0;
+      int tif = (otype == 2) ? 1 : (otype == 3 || otype == 4) ? 2 : 0;
       sqlite3_bind_text(ins_order_, 1, oid.c_str(), -1, SQLITE_TRANSIENT);
       sqlite3_bind_text(ins_order_, 2, cid.c_str(), -1, SQLITE_TRANSIENT);
       sqlite3_bind_text(ins_order_, 3, sym.c_str(), -1, SQLITE_TRANSIENT);
       sqlite3_bind_int64(ins_order_, 4, side);
-      sqlite3_bind_int64(ins_order_, 5, otype);
+      sqlite3_bind_int64(ins_order_, 5, base_type);
       // MARKET orders persist NULL price — fixing the reference's dormant
       // bug of storing a bogus as-is price (SURVEY §2.9c).
       if (has_price)
@@ -591,6 +605,7 @@ class MeSink {
       sqlite3_bind_int64(ins_order_, 9, status);
       sqlite3_bind_int64(ins_order_, 10, ts);
       sqlite3_bind_int64(ins_order_, 11, ts);
+      sqlite3_bind_int64(ins_order_, 12, tif);
       if (!step_reset(ins_order_)) {
         std::fprintf(stderr, "[me_sink] order insert %s: %s\n", oid.c_str(),
                      sqlite3_errmsg(db_));

@@ -117,6 +117,12 @@ run_case "book query" "book SYM" book "127.0.0.1:$PORT" SYM
 run_case "reject bad qty" "rejected" "$ADDR" c1 SYM BUY LIMIT 1005 2 0
 run_case "cancel unknown" "cancel rejected" cancel "$ADDR" c1 OID-999
 
+# Time-in-force (additive extension): an IOC against an empty level
+# cancels instead of resting; a FOK larger than the book cancels
+# untouched. Both are ACCEPTED orders whose outcome is the tif semantics.
+run_case "LIMIT:IOC accepted" "accepted order_id=" "$ADDR" t1 TIF SELL LIMIT:IOC 1005 2 3
+run_case "LIMIT:FOK accepted" "accepted order_id=" "$ADDR" t1 TIF BUY LIMIT:FOK 1005 2 3
+
 # Out-of-band DB assert (the reference pattern, scripted).
 sleep 0.5
 ORDERS=$(python -c "
@@ -129,11 +135,11 @@ import sqlite3
 c = sqlite3.connect('$DB')
 print(c.execute('SELECT COUNT(*) FROM fills').fetchone()[0])
 ")
-if [ "$ORDERS" -eq 8 ] && [ "$FILLS" -ge 3 ]; then
+if [ "$ORDERS" -eq 10 ] && [ "$FILLS" -ge 3 ]; then
   echo "PASS: DB has $ORDERS orders, $FILLS fills"
   PASS=$((PASS+1))
 else
-  echo "FAIL: DB has $ORDERS orders (want 8), $FILLS fills (want >=3)"
+  echo "FAIL: DB has $ORDERS orders (want 10), $FILLS fills (want >=3)"
   FAIL=$((FAIL+1))
 fi
 

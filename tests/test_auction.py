@@ -352,9 +352,16 @@ def test_auction_server_flow(tmp_path):
             r = sub(who, side, price, qty)
             assert r.success, r.error_message
             oids[who] = r.order_id
-        # MARKET rejected during the call period.
+        # MARKET rejected during the call period — and so is every other
+        # immediate-execution tif (IOC/FOK demand continuous matching).
         rm = sub("m", pb2.BUY, 0, 1, otype=pb2.MARKET)
         assert not rm.success and "auction call period" in rm.error_message
+        for tif in (pb2.TIF_IOC, pb2.TIF_FOK):
+            rt = stub.SubmitOrder(
+                pb2.OrderRequest(client_id="m", symbol="AU", side=pb2.BUY,
+                                 order_type=pb2.LIMIT, price=101, scale=4,
+                                 quantity=1, tif=tif), timeout=15)
+            assert not rt.success and "auction call period" in rt.error_message
 
         # Book stands CROSSED (best bid >= best ask) — impossible under
         # continuous matching, the defining auction-mode state.

@@ -69,10 +69,11 @@ def hs(tmp_path_factory):
 
 
 def submit(stub, client="c1", symbol="SYM", otype=pb2.LIMIT, side=pb2.BUY,
-           price=10000, scale=4, qty=5):
+           price=10000, scale=4, qty=5, tif=pb2.TIF_GTC):
     return stub.SubmitOrder(
         pb2.OrderRequest(client_id=client, symbol=symbol, order_type=otype,
-                         side=side, price=price, scale=scale, quantity=qty),
+                         side=side, price=price, scale=scale, quantity=qty,
+                         tif=tif),
         timeout=10,
     )
 
@@ -113,6 +114,35 @@ def test_match_through_gateway(hs):
     assert taker[7] == 0 and taker[8] == 2  # FILLED
     fills = st.fills_for_order(r2.order_id)
     assert len(fills) == 1 and fills[0][3] == 4
+
+
+def test_tif_through_both_edges(hs):
+    """IOC/FOK ride the native edge's collapsed otype byte and the grpcio
+    edge's mapping identically: an IOC remainder cancels (never rests),
+    a failed FOK leaves the maker untouched, and the storage rows keep
+    order_type in the reference's 0/1 domain with tif in its own column."""
+    r1 = submit(hs.stub, client="a", symbol="TIF", side=pb2.BUY,
+                price=50000, qty=10)
+    # FOK for more than the book holds: canceled untouched (native edge).
+    r2 = submit(hs.stub, client="b", symbol="TIF", side=pb2.SELL,
+                price=50000, qty=11, tif=pb2.TIF_FOK)
+    # IOC for more than the book holds: partial fill, remainder canceled
+    # (grpcio edge).
+    r3 = submit(hs.py_stub, client="b", symbol="TIF", side=pb2.SELL,
+                price=50000, qty=12, tif=pb2.TIF_IOC)
+    assert r1.success and r2.success and r3.success
+    hs.flush()
+    st = Storage(hs.db_path)
+    maker = st.get_order(r1.order_id)
+    fok = st.get_order(r2.order_id)
+    ioc = st.get_order(r3.order_id)
+    assert maker[7] == 0 and maker[8] == 2            # fully taken by IOC
+    assert fok[7] == 11 and fok[8] == 3               # CANCELED untouched
+    assert ioc[7] == 2 and ioc[8] == 3                # 10 filled, 2 canceled
+    assert fok[4] == 0 and ioc[4] == 0                # order_type stays LIMIT
+    assert fok[11] == 2 and ioc[11] == 1              # tif column FOK/IOC
+    assert len(st.fills_for_order(r3.order_id)) == 1
+    assert not st.fills_for_order(r2.order_id)
 
 
 def test_cross_edge_visibility(hs):
@@ -177,6 +207,7 @@ def test_validate_message_parity(hs):
         dict(client="v", symbol="VAL", price=5, scale=9, qty=1),     # ->0 at Q4
         dict(client="v", symbol="VAL", price=10**12, scale=2, qty=1),  # > int32 lane
         dict(client="v", symbol="VAL", otype=pb2.MARKET, price=0, scale=19, qty=1),
+        dict(client="v", symbol="VAL", price=1, qty=1, tif=9),  # junk tif
     ]
     for kw in bad_requests:
         via_gw = submit(hs.stub, **kw)

@@ -23,7 +23,8 @@ def store(tmp_path):
 def test_insert_and_get(store):
     assert store.insert_new_order("OID-1", "c1", "SYM", 1, 0, 10050, 5)
     row = store.get_order("OID-1")
-    assert row == ("OID-1", "c1", "SYM", 1, 0, 10050, 5, 5, STATUS_NEW)
+    assert row[:9] == ("OID-1", "c1", "SYM", 1, 0, 10050, 5, 5, STATUS_NEW)
+    assert row[11] == 0  # tif defaults to GTC
 
 
 def test_market_order_stores_null_price(store):

@@ -1,0 +1,236 @@
+"""Time-in-force (IOC / FOK) semantics, every layer.
+
+The reference's wire contract has no tif concept (its OrderType enum stops
+at LIMIT/MARKET, /root/reference/proto/matching_engine.proto:11-14); this is
+an additive venue-parity extension. Covered here:
+
+- the collapsed (order_type, tif) otype codes are pinned identical across
+  proto/__init__.py, engine/kernel.py, and engine/oracle.py;
+- oracle unit semantics: IOC cancels its remainder instead of resting;
+  FOK is all-or-nothing against the liquidity the taker is eligible for
+  (price-crossing, live, not self-owned);
+- device-vs-oracle fill parity on directed cases and randomized mixed
+  streams, over BOTH kernel formulations;
+- venue-depth FOK exactness under the sorted kernel's saturating prefix
+  sums (availability compare stays exact past int32 wrap territory).
+"""
+
+import pytest
+
+from matching_engine_tpu.engine import kernel as K
+from matching_engine_tpu.engine import oracle as O
+from matching_engine_tpu.engine.book import EngineConfig, MAX_QUANTITY
+from matching_engine_tpu.engine.harness import HostOrder, random_order_stream
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.proto import (
+    LIMIT_FOK,
+    LIMIT_IOC,
+    MARKET_FOK,
+    TIF_FOK,
+    TIF_GTC,
+    TIF_IOC,
+    collapse_otype,
+    pb2,
+    split_otype,
+)
+
+from tests.test_kernel_parity import assert_parity
+
+BUY, SELL = K.BUY, K.SELL
+LIMIT, MARKET = K.LIMIT, K.MARKET
+OP_SUBMIT, OP_CANCEL = K.OP_SUBMIT, K.OP_CANCEL
+
+NEW = O.NEW
+FILLED = O.FILLED
+PARTIALLY_FILLED = O.PARTIALLY_FILLED
+CANCELED = O.CANCELED
+
+
+# -- code pinning ------------------------------------------------------------
+
+def test_collapsed_codes_pinned_across_layers():
+    assert (K.LIMIT_IOC, K.LIMIT_FOK, K.MARKET_FOK) == (2, 3, 4)
+    assert (O.LIMIT_IOC, O.LIMIT_FOK, O.MARKET_FOK) == (2, 3, 4)
+    assert (LIMIT_IOC, LIMIT_FOK, MARKET_FOK) == (2, 3, 4)
+    assert (K.LIMIT, K.MARKET) == (pb2.LIMIT, pb2.MARKET)
+
+
+def test_collapse_split_roundtrip():
+    assert collapse_otype(pb2.LIMIT, TIF_GTC) == K.LIMIT
+    assert collapse_otype(pb2.MARKET, TIF_GTC) == K.MARKET
+    assert collapse_otype(pb2.MARKET, TIF_IOC) == K.MARKET  # inherent IOC
+    assert collapse_otype(pb2.LIMIT, TIF_IOC) == LIMIT_IOC
+    assert collapse_otype(pb2.LIMIT, TIF_FOK) == LIMIT_FOK
+    assert collapse_otype(pb2.MARKET, TIF_FOK) == MARKET_FOK
+    assert collapse_otype(pb2.LIMIT, 7) is None  # open-enum junk rejected
+    for code in (K.LIMIT, K.MARKET, LIMIT_IOC, LIMIT_FOK, MARKET_FOK):
+        base, tif = split_otype(code)
+        assert collapse_otype(base, tif) == code
+
+
+# -- oracle unit semantics ---------------------------------------------------
+
+def test_ioc_partial_cancels_remainder():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 5)
+    r = b.submit(2, BUY, LIMIT_IOC, 10_000, 8)
+    assert r.status == CANCELED and r.filled == 5 and r.remaining == 3
+    assert not r.rested and len(r.fills) == 1
+    assert b.snapshot() == ([], [])  # nothing rested anywhere
+
+
+def test_ioc_full_fill_is_filled():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 8)
+    r = b.submit(2, BUY, LIMIT_IOC, 10_000, 8)
+    assert r.status == FILLED and r.filled == 8
+
+
+def test_ioc_no_cross_cancels_untouched():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 5)
+    r = b.submit(2, BUY, LIMIT_IOC, 9_000, 5)  # below best ask
+    assert r.status == CANCELED and r.filled == 0 and r.remaining == 5
+    assert r.fills == ()
+    assert b.best_ask() == (10_000, 5)  # maker untouched
+
+
+def test_ioc_respects_limit_price_across_levels():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 3)
+    b.submit(2, SELL, LIMIT, 10_100, 3)
+    r = b.submit(3, BUY, LIMIT_IOC, 10_000, 6)  # only level 1 eligible
+    assert r.status == CANCELED and r.filled == 3 and r.remaining == 3
+    assert b.best_ask() == (10_100, 3)
+
+
+def test_fok_success_sweeps_levels():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 3)
+    b.submit(2, SELL, LIMIT, 10_100, 4)
+    r = b.submit(3, BUY, LIMIT_FOK, 10_100, 7)
+    assert r.status == FILLED and r.filled == 7
+    assert [f.quantity for f in r.fills] == [3, 4]
+    assert b.snapshot() == ([], [])
+
+
+def test_fok_insufficient_cancels_untouched():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 3)
+    b.submit(2, SELL, LIMIT, 10_100, 4)
+    r = b.submit(3, BUY, LIMIT_FOK, 10_000, 7)  # eligible = 3 < 7
+    assert r.status == CANCELED and r.filled == 0 and r.remaining == 7
+    assert r.fills == ()
+    # Both makers still rest at full size.
+    assert b.best_ask() == (10_000, 3)
+    _, asks = b.snapshot()
+    assert [(p, q) for (_, p, q, _) in asks] == [(10_000, 3), (10_100, 4)]
+
+
+def test_market_fok_all_or_nothing():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 3)
+    b.submit(2, SELL, LIMIT, 99_000, 4)
+    ok = b.submit(3, BUY, MARKET_FOK, 0, 7)
+    assert ok.status == FILLED and ok.filled == 7
+    b2 = OracleBook()
+    b2.submit(1, SELL, LIMIT, 10_000, 3)
+    fail = b2.submit(2, BUY, MARKET_FOK, 0, 7)
+    assert fail.status == CANCELED and fail.filled == 0
+    assert b2.best_ask() == (10_000, 3)
+
+
+def test_fok_excludes_self_owned_liquidity():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 5, owner=7)
+    b.submit(2, SELL, LIMIT, 10_000, 4, owner=9)
+    # Owner 7's own 5 units are ineligible: avail = 4 < 6 -> cancel, and
+    # BOTH makers keep resting (FOK never partially consumes).
+    r = b.submit(3, BUY, LIMIT_FOK, 10_000, 6, owner=7)
+    assert r.status == CANCELED and r.filled == 0
+    assert b.best_ask() == (10_000, 9)
+    # The other owner can take the same quantity fine.
+    r2 = b.submit(4, BUY, LIMIT_FOK, 10_000, 6, owner=3)
+    assert r2.status == FILLED and r2.filled == 6
+
+
+def test_ioc_never_self_trades():
+    b = OracleBook()
+    b.submit(1, SELL, LIMIT, 10_000, 5, owner=7)
+    r = b.submit(2, BUY, LIMIT_IOC, 10_000, 5, owner=7)
+    assert r.status == CANCELED and r.filled == 0
+    assert b.best_ask() == (10_000, 5)
+
+
+# -- device parity (both kernels) --------------------------------------------
+
+KERNELS = ["matrix", "sorted"]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_parity_directed_tif_cases(kernel):
+    cfg = EngineConfig(num_symbols=2, capacity=8, batch=8, kernel=kernel)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 5, oid=1),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_100, 4, oid=2),
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT_IOC, 10_000, 8, oid=3),   # part
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT_FOK, 10_100, 9, oid=4),   # fail
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT_FOK, 10_100, 4, oid=5),   # fill
+        HostOrder(1, OP_SUBMIT, BUY, LIMIT, 9_000, 6, oid=6),
+        HostOrder(1, OP_SUBMIT, SELL, MARKET_FOK, 0, 7, oid=7),      # fail
+        HostOrder(1, OP_SUBMIT, SELL, MARKET_FOK, 0, 6, oid=8),      # fill
+        HostOrder(1, OP_SUBMIT, SELL, LIMIT_IOC, 9_000, 2, oid=9),   # empty
+    ]
+    assert_parity(cfg, orders)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_parity_fuzz_with_tif(kernel, seed):
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=8, kernel=kernel)
+    orders = random_order_stream(
+        cfg.num_symbols, 160, seed=seed, tif_p=0.35, qty_max=12,
+        price_levels=6)
+    assert_parity(cfg, orders)
+
+
+def test_fok_exact_at_venue_depth_saturating_sums():
+    """Sorted kernel, capacity 2048, resting quantities near MAX_QUANTITY:
+    the FOK availability compare must stay exact even though the ahead-
+    prefix accumulator saturates (kernel_sorted.py)."""
+    from matching_engine_tpu.engine.harness import apply_orders
+    from matching_engine_tpu.engine.book import init_book
+
+    cfg = EngineConfig(num_symbols=1, capacity=2048, batch=32,
+                       kernel="sorted", max_fills=1 << 14)
+    n_makers = 1100
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000 + i, MAX_QUANTITY,
+                  oid=1 + i)
+        for i in range(n_makers)
+    ]
+    # Aggregate eligible quantity is far past int32 — the running prefix
+    # sum saturates at 2^30-1 long before the last maker.
+    assert n_makers * MAX_QUANTITY > 2**31
+    # A single maximal-quantity FOK: avail (saturated) >= qty must hold
+    # and the order fills exactly, entirely from the best maker.
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT_FOK,
+                            10_000 + n_makers, MAX_QUANTITY, oid=100_000))
+    book = init_book(cfg)
+    book, results, fills = apply_orders(cfg, book, orders)
+    by_oid = {r.oid: r for r in results}
+    assert by_oid[100_000].status == FILLED
+    assert by_oid[100_000].filled == MAX_QUANTITY
+
+    # And the infeasible twin: empty the book's eligible window by pricing
+    # the FOK below every ask — cancel untouched despite saturated sums.
+    orders2 = orders[:n_makers] + [
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT_FOK, 9_999, MAX_QUANTITY,
+                  oid=100_001)
+    ]
+    book2 = init_book(cfg)
+    book2, results2, fills2 = apply_orders(cfg, book2, orders2)
+    by_oid2 = {r.oid: r for r in results2}
+    assert by_oid2[100_001].status == CANCELED
+    assert by_oid2[100_001].filled == 0
+    assert not [f for f in fills2 if f.taker_oid == 100_001]
