@@ -184,6 +184,35 @@ STEPS: list[dict] = [
      "cmd": [PY, os.path.join(REPO, "benchmarks", "runner_bench.py"),
              "--json-out", os.path.join(RESULTS, "tpu_r5_runner_sat.json"),
              "--batch-ops", "64,256,1024", "--inflight", "4"]},
+    # Full-stack serving at the saturation sweet spot the runner_sat sweep
+    # found (~256-op dispatches): the pi2/pi4 rows above were CLIENT-
+    # concurrency-bound (32 clients x inflight 8 = 256 outstanding ~=
+    # 2.4k/s at ~105ms RTT, Little's law) — quadruple the outstanding
+    # orders so the server, not the loadgen, sets the ceiling.
+    {"name": "e2e_sat", "artifact": "tpu_e2e_r4_native_pi4_sat.json",
+     "timeout": 1500,
+     "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"],
+     "env": {"TPU_E2E_SUFFIX": "_sat", "TPU_E2E_CLIENTS": "64",
+             "TPU_E2E_INFLIGHT": "16", "TPU_E2E_PER_CLIENT": "4000"}},
+    # Lesson from e2e_sat: throughput was WINDOW-bound, not concurrency-
+    # bound — the 2ms default window packs ~5 ops/dispatch at 2.4k/s,
+    # nowhere near the 256-op saturation sweet spot, and every further
+    # client just queues (p50 425ms) or hits book-full rejects. Widen the
+    # window toward the sweep's 24ms optimum so dispatches pack properly.
+    {"name": "e2e_w25", "artifact": "tpu_e2e_r4_native_pi4_w25.json",
+     "timeout": 1500,
+     "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"],
+     "env": {"TPU_E2E_SUFFIX": "_w25", "TPU_E2E_WINDOW_MS": "25",
+             "TPU_E2E_CLIENTS": "64", "TPU_E2E_INFLIGHT": "16",
+             "TPU_E2E_PER_CLIENT": "2000"}},
+    # Second window point: w25 reached 3.5k/s at ~88 ops/dispatch, still
+    # under the 256-op sweet spot — probe the knee from the other side.
+    {"name": "e2e_w60", "artifact": "tpu_e2e_r4_native_pi4_w60.json",
+     "timeout": 1500,
+     "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"],
+     "env": {"TPU_E2E_SUFFIX": "_w60", "TPU_E2E_WINDOW_MS": "60",
+             "TPU_E2E_CLIENTS": "64", "TPU_E2E_INFLIGHT": "16",
+             "TPU_E2E_PER_CLIENT": "2000"}},
 ]
 
 
@@ -198,6 +227,7 @@ _R5_ORDER = [
     "cap4096s", "cap256", "e2e_pi2", "e2e_pi4", "suite_full",
     "batch64", "batch128", "syms64", "syms256", "syms1024", "l3flow",
     "profile_sorted", "cap8192s", "e2e_pi2_w256", "suite7", "runner_sat",
+    "e2e_sat", "e2e_w25", "e2e_w60",
 ]
 _RANK = {n: i for i, n in enumerate(_R5_ORDER)}
 STEPS.sort(key=lambda s: _RANK.get(s["name"], len(_R5_ORDER)))

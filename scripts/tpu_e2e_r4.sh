@@ -19,6 +19,7 @@ PER_CLIENT="${TPU_E2E_PER_CLIENT:-2000}"
 INFLIGHT="${TPU_E2E_INFLIGHT:-8}"
 BOOT_TIMEOUT="${TPU_E2E_BOOT_TIMEOUT_S:-300}"
 RPC_WORKERS="${TPU_E2E_RPC_WORKERS:-256}"
+WINDOW_MS="${TPU_E2E_WINDOW_MS:-2}"   # dispatch batching window
 SUFFIX="${TPU_E2E_SUFFIX:-}"   # distinguishes artifact variants (e.g. _w256)
 
 log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] [e2e pi$K] $*" >>"$LOG"; }
@@ -28,7 +29,7 @@ PYTHONUNBUFFERED=1 PYTHONPATH="${PYTHONPATH:-}:$REPO" \
   python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 64 --capacity 256 \
   --batch 16 --pipeline-inflight "$K" --gateway-addr 127.0.0.1:0 \
-  --rpc-workers "$RPC_WORKERS" \
+  --rpc-workers "$RPC_WORKERS" --window-ms "$WINDOW_MS" \
   >"$work/server.log" 2>&1 &
 srv=$!
 cleanup() {
