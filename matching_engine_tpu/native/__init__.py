@@ -311,6 +311,9 @@ def _load_gateway():
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_char_p,
         ]
+        lib.me_gateway_complete_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
         lib.me_gateway_respond.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
@@ -441,6 +444,30 @@ class NativeGateway:
             self._h, tag, 1 if success else 0, order_id.encode(),
             error.encode(),
         )
+
+    def complete_batch(
+        self, items: list[tuple[int, int, bool, str, str]]
+    ) -> None:
+        """One ctypes crossing for a whole dispatch's completions.
+
+        items: (tag, kind 0=submit/1=cancel, success, order_id, error).
+        The C++ side groups by connection and writes each connection's
+        response frames with a single locked send (me_gateway.cpp
+        me_gateway_complete_batch — the wire format lives there).
+        """
+        if self._h is None or not items:
+            return
+        out = bytearray(struct.pack("<I", len(items)))
+        for (tag, kind, success, order_id, error) in items:
+            oid = order_id.encode()
+            err = error.encode()
+            out += struct.pack("<QBBH", tag, kind, 1 if success else 0,
+                               len(oid))
+            out += oid
+            out += struct.pack("<H", len(err))
+            out += err
+        buf = bytes(out)
+        self._lib.me_gateway_complete_batch(self._h, buf, len(buf))
 
     def respond(self, tag: int, msg: bytes | None, end_stream: bool,
                 grpc_status: int = 0, grpc_message: str = "") -> bool:

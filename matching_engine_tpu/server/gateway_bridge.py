@@ -255,9 +255,18 @@ class GatewayBridge:
                                 tag, False, op.info.order_id, "engine error"
                             )
                 return fail
+            t_pub = time.perf_counter()
             self._publish(result)
+            self.metrics.ema_gauge(
+                "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)
 
             def complete():
+                # One ctypes crossing + one locked socket write per
+                # CONNECTION for the whole dispatch (gateway.complete_batch)
+                # — the per-op fan-out measured ~59us/op, the edge's
+                # dominant cost at saturation (bridge_complete_us gauge).
+                t_comp = time.perf_counter()
+                batch: list[tuple[int, int, bool, str, str]] = []
                 for outcome in result.outcomes:
                     tag = tags.pop(id(outcome.op), None)
                     if tag is None:
@@ -266,39 +275,32 @@ class GatewayBridge:
                     if outcome.op.op != OP_CANCEL:
                         if outcome.status == REJECTED and outcome.error:
                             self.metrics.inc("orders_rejected")
-                            self.gateway.complete_submit(
-                                tag, False, info.order_id, outcome.error
-                            )
+                            batch.append(
+                                (tag, 0, False, info.order_id, outcome.error))
                         else:
                             self.metrics.inc("orders_accepted")
-                            self.gateway.complete_submit(
-                                tag, True, info.order_id)
+                            batch.append((tag, 0, True, info.order_id, ""))
                     else:
                         if outcome.status == CANCELED:
                             self.metrics.inc("orders_canceled")
-                            self.gateway.complete_cancel(
-                                tag, True, info.order_id)
+                            batch.append((tag, 1, True, info.order_id, ""))
                         else:
-                            self.gateway.complete_cancel(
-                                tag, False, info.order_id,
-                                outcome.error or "order not open",
-                            )
+                            batch.append(
+                                (tag, 1, False, info.order_id,
+                                 outcome.error or "order not open"))
                 # Any op that produced no outcome: fail loudly rather than
                 # hang the client until its deadline.
                 for op in ops:
                     tag = tags.pop(id(op), None)
                     if tag is None:
                         continue
-                    if op.op != OP_CANCEL:
-                        self.gateway.complete_submit(
-                            tag, False, op.info.order_id,
-                            "op produced no outcome"
-                        )
-                    else:
-                        self.gateway.complete_cancel(
-                            tag, False, op.info.order_id,
-                            "op produced no outcome"
-                        )
+                    kind = 1 if op.op == OP_CANCEL else 0
+                    batch.append((tag, kind, False, op.info.order_id,
+                                  "op produced no outcome"))
+                self.gateway.complete_batch(batch)
+                self.metrics.ema_gauge(
+                    "bridge_complete_us",
+                    (time.perf_counter() - t_comp) * 1e6)
                 # Batch TURNAROUND incl. pipeline residency (see
                 # dispatcher.py) — engine time is engine_dispatch_us.
                 dur_us = (time.perf_counter() - t0) * 1e6
@@ -314,6 +316,12 @@ class GatewayBridge:
                     "gateway_connections", stats["conns"])
             return complete
 
+        # Per-stage decomposition of the edge tax (BENCH_METHOD.md: the
+        # full-stack gap to the RPC-less ceiling): setup = ring decode +
+        # validation + OrderInfo/id assignment, publish = sink/hub
+        # enqueue, complete = response fan-out through the gateway.
+        self.metrics.ema_gauge(
+            "bridge_setup_us", (time.perf_counter() - t0) * 1e6)
         self.runner.dispatch_pipelined(ops, on_finish)
 
     def _publish(self, result) -> None:

@@ -142,6 +142,11 @@ def test_gateway_bridge_rejects_undecodable_records():
         def complete_cancel(self, tag, ok, oid, err=""):
             self.completed.append(("cancel", tag, ok, err))
 
+        def complete_batch(self, items):
+            for (tag, kind, ok, oid, err) in items:
+                kind_s = "cancel" if kind == 1 else "submit"
+                self.completed.append((kind_s, tag, ok, err))
+
         def stats(self):
             return {"requests": 0, "ring_rejects": 0, "conns": 0}
 
