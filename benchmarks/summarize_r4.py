@@ -109,7 +109,8 @@ def main() -> None:
     print("| edge | pi | orders/s | p50 ms | p99 ms | p99/p50 |")
     print("|---|---|---|---|---|---|")
     for edge in ("native", "grpcio"):
-        for pi, sfx in ((2, ""), (4, ""), (2, "_w256")):
+        for pi, sfx in ((2, ""), (4, ""), (2, "_w256"), (4, "_sat"),
+                        (4, "_w25"), (4, "_w60")):
             d = load(f"tpu_e2e_r4_{edge}_pi{pi}{sfx}.json")
             label = f"{pi}{sfx}"
             if d is None:
@@ -119,6 +120,24 @@ def main() -> None:
                 print(f"| {edge} | {label} | {fmt(d.get('value'))} | "
                       f"{d.get('p50_ms')} | {d.get('p99_ms')} | "
                       f"{ratio:.1f}x |")
+
+    soaks = sorted(
+        f for f in os.listdir(RESULTS)
+        if f.startswith("soak_") and f.endswith(".json"))
+    if soaks:
+        print("\n## Soaks (sustained dual-edge serving, audit-gated)\n")
+        print("| artifact | platform | min | orders ok | cancels | "
+              "auction quiesces | audit violations | server args |")
+        print("|---|---|---|---|---|---|---|---|")
+        for f in soaks:
+            s = load(f)
+            if not s:
+                continue
+            print(f"| `{f}` | {s.get('platform', '—')} | "
+                  f"{s.get('minutes', '—')} | {fmt(s.get('orders_ok'))} | "
+                  f"{s.get('cancels', '—')} | {s.get('rounds', '—')} | "
+                  f"{s.get('audit_violations', '—')} | "
+                  f"`{s.get('server_args', '')}` |")
 
     print("\n## Kernel profiles\n")
     any_profile = False
