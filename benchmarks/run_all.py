@@ -222,7 +222,11 @@ def config4_native_gateway(full: bool):
     clients = 32 if full else 8
     per_client = 2000 if full else 250
     inflight = 8
-    cfg = EngineConfig(num_symbols=64, capacity=256, batch=16, max_fills=1 << 15)
+    # 128 symbol slots / 64 per edge under a disjoint prefix: the second
+    # edge must measure against fresh books, not the first edge's resting
+    # depth (same fix as scripts/tpu_e2e_r4.sh).
+    cfg = EngineConfig(num_symbols=128, capacity=256, batch=16,
+                       max_fills=1 << 15)
     db = tempfile.mkdtemp() + "/bench_native.db"
     server, port, parts = build_server(
         "127.0.0.1:0", db, cfg, window_ms=2.0, log=False,
@@ -230,12 +234,13 @@ def config4_native_gateway(full: bool):
     )
     server.start()
     try:
-        for edge, eport in (("native_gateway", parts["gateway_port"]),
-                            ("grpcio", port)):
+        for edge, eport, prefix in (
+                ("native_gateway", parts["gateway_port"], "N"),
+                ("grpcio", port, "G")):
             try:
                 out = subprocess.run(
                     [cli, "bench", f"127.0.0.1:{eport}", str(clients),
-                     str(per_client), "64", str(inflight)],
+                     str(per_client), "64", str(inflight), prefix],
                     capture_output=True, text=True, timeout=900,
                 )
             except subprocess.TimeoutExpired:

@@ -110,7 +110,9 @@ def main() -> None:
     print("|---|---|---|---|---|---|")
     for edge in ("native", "grpcio"):
         for pi, sfx in ((2, ""), (4, ""), (2, "_w256"), (4, "_sat"),
-                        (4, "_w25"), (4, "_w60")):
+                        (4, "_w25"), (4, "_w60"), (4, "_w60_best")):
+            if sfx == "_w60_best" and edge != "native":
+                continue  # native-only preserved peak; not a pending row
             d = load(f"tpu_e2e_r4_{edge}_pi{pi}{sfx}.json")
             label = f"{pi}{sfx}"
             if d is None:

@@ -46,7 +46,7 @@ const char kUsage[] =
     "   or: me_client watch-md <addr> <symbol> [max_events]\n"
     "   or: me_client watch-orders <addr> <client_id> [max_events]\n"
     "   or: me_client auction <addr> [symbol]\n"
-    "   or: me_client bench <addr> <clients> <per_client> [symbols] [inflight]";
+    "   or: me_client bench <addr> <clients> <per_client> [symbols] [inflight] [prefix]";
 
 int dial(const std::string& addr) {
   std::string host = addr;
@@ -522,7 +522,7 @@ class BenchConn {
 };
 
 int do_bench(const std::string& addr, int clients, int per_client,
-             int symbols, int inflight) {
+             int symbols, int inflight, const std::string& sym_prefix) {
   const std::string path = "/matching_engine.v1.MatchingEngine/SubmitOrder";
   std::vector<std::vector<double>> lat(clients);
   std::vector<int> ok_count(clients, 0), rejected(clients, 0);
@@ -537,7 +537,7 @@ int do_bench(const std::string& addr, int clients, int per_client,
     }
     pb::OrderRequest req;
     req.set_client_id("warm");
-    req.set_symbol("S0");
+    req.set_symbol(sym_prefix + "0");
     req.set_side(pb::BUY);
     req.set_order_type(pb::LIMIT);
     req.set_price(1);
@@ -571,7 +571,8 @@ int do_bench(const std::string& addr, int clients, int per_client,
                static_cast<int>(t0s.size()) < inflight) {
           pb::OrderRequest req;
           req.set_client_id("b" + std::to_string(w));
-          req.set_symbol("S" + std::to_string(rand_r(&seed) % symbols));
+          req.set_symbol(sym_prefix +
+                         std::to_string(rand_r(&seed) % symbols));
           req.set_side((rand_r(&seed) & 1) ? pb::BUY : pb::SELL);
           req.set_order_type(pb::LIMIT);
           req.set_price(10000 + static_cast<int>(rand_r(&seed) % 40) - 20);
@@ -887,10 +888,15 @@ int main(int argc, char** argv) {
     return do_watch(argv[2], std::strcmp(argv[1], "watch-md") == 0, argv[3],
                     argc == 5 ? std::atol(argv[4]) : 0);
   }
-  if ((argc >= 5 && argc <= 7) && std::strcmp(argv[1], "bench") == 0) {
+  if ((argc >= 5 && argc <= 8) && std::strcmp(argv[1], "bench") == 0) {
+    // Optional [prefix]: a disjoint symbol namespace per loadgen run,
+    // so dual-edge captures against one server drive FRESH books on
+    // each edge instead of the second edge inheriting the first
+    // edge's resting depth (which inflated its book-full rejects).
     return do_bench(argv[2], std::atoi(argv[3]), std::atoi(argv[4]),
                     argc >= 6 ? std::atoi(argv[5]) : 64,
-                    argc >= 7 ? std::atoi(argv[6]) : 1);
+                    argc >= 7 ? std::atoi(argv[6]) : 1,
+                    argc >= 8 ? argv[7] : "S");
   }
   if (argc != 9) {
     std::fprintf(stderr, "%s\n", kUsage);

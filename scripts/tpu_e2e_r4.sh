@@ -25,9 +25,13 @@ SUFFIX="${TPU_E2E_SUFFIX:-}"   # distinguishes artifact variants (e.g. _w256)
 log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] [e2e pi$K] $*" >>"$LOG"; }
 
 work=$(mktemp -d)
+# 128 symbol slots: each edge's loadgen drives 64 symbols under its OWN
+# prefix (N*/G*), so the second edge measures against fresh books instead
+# of inheriting the first edge's resting depth (which inflated its
+# book-full rejects in the pre-prefix captures).
 PYTHONUNBUFFERED=1 PYTHONPATH="${PYTHONPATH:-}:$REPO" \
   python -m matching_engine_tpu.server.main \
-  --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 64 --capacity 256 \
+  --addr 127.0.0.1:0 --db "$work/e2e.db" --symbols 128 --capacity 256 \
   --batch 16 --pipeline-inflight "$K" --gateway-addr 127.0.0.1:0 \
   --rpc-workers "$RPC_WORKERS" --window-ms "$WINDOW_MS" \
   >"$work/server.log" 2>&1 &
@@ -58,11 +62,12 @@ fi
 log "server up: grpcio :$py_port native :$gw_port"
 
 ok=0
-for edge_port in "native:$gw_port" "grpcio:$py_port"; do
-  edge="${edge_port%%:*}"
-  port="${edge_port##*:}"
+for edge_port in "native:$gw_port:N" "grpcio:$py_port:G"; do
+  edge="$(echo "$edge_port" | cut -d: -f1)"
+  port="$(echo "$edge_port" | cut -d: -f2)"
+  prefix="$(echo "$edge_port" | cut -d: -f3)"
   out="$OUT_DIR/tpu_e2e_r4_${edge}_pi${K}${SUFFIX}.json"
-  if timeout 600 "$CLI" bench "127.0.0.1:$port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" \
+  if timeout 600 "$CLI" bench "127.0.0.1:$port" "$CLIENTS" "$PER_CLIENT" 64 "$INFLIGHT" "$prefix" \
       >"$out.tmp" 2>>"$LOG"; then
     mv "$out.tmp" "$out"
     log "$edge edge: $(cat "$out")"
