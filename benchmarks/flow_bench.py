@@ -99,7 +99,10 @@ def main() -> None:
         "batch": args.batch,
         "backend_init_s": round(backend_init_s, 1),
         "mean_dispatch_latency_us": round(lat_us, 1),
-        "flow": "power-law+bursts+deep-books (engine/flow.py defaults)",
+        "flow": "power-law+bursts+deep-books+ioc-fok "
+                "(engine/flow.py defaults)",
+        "tif_p": 0.05,  # IOC/FOK share of submits (flow.py default);
+                        # rows with "flow" lacking "+ioc-fok" predate it
         "stats_ops": len(stats_stream),
         "side_full_reject_rate": round(rejects / max(1, submits), 5),
         "fills_per_op": round(len(fills) / len(stats_stream), 4),

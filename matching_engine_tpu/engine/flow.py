@@ -36,7 +36,10 @@ from matching_engine_tpu.engine.harness import HostOrder
 from matching_engine_tpu.engine.kernel import (
     BUY,
     LIMIT,
+    LIMIT_FOK,
+    LIMIT_IOC,
     MARKET,
+    MARKET_FOK,
     OP_CANCEL,
     OP_SUBMIT,
     SELL,
@@ -55,6 +58,7 @@ def realistic_order_stream(
     burst_symbols: int = 4,      # hot symbols sharing one burst
     cancel_p: float = 0.08,
     market_p: float = 0.10,
+    tif_p: float = 0.05,         # fraction of submits carrying IOC/FOK
     price_base: int = 10_000,
     qty_max: int = 100,
 ) -> list[HostOrder]:
@@ -123,7 +127,15 @@ def realistic_order_stream(
         oid += 1
         side = rng.choice((BUY, SELL))
         otype = MARKET if rng.random() < m_p else LIMIT
-        if otype == MARKET:
+        # A slice of real flow is IOC/FOK (aggressive participants who
+        # never rest) — exercises the tif codes under venue-shaped load.
+        is_tif = bool(tif_p) and rng.random() < tif_p
+        if is_tif:
+            if otype == MARKET:
+                otype = MARKET_FOK
+            else:
+                otype = rng.choice((LIMIT_IOC, LIMIT_FOK))
+        if otype in (MARKET, MARKET_FOK):
             price = 0
         else:
             # Geometric offset from the touch: most orders near the mid,
@@ -133,7 +145,13 @@ def realistic_order_stream(
             step_p = 0.55 if is_deep else 0.35
             while rng.random() < step_p and off < 500:
                 off += 1
-            price = mid[sym] + (spread + off) * (1 if side == SELL else -1)
+            # Passive flow prices on its OWN side of the touch; the
+            # IOC/FOK slice prices THROUGH it (aggressors cross or they
+            # are pointless) — reaching the partial-fill-remainder-cancel
+            # and FOK all-or-nothing paths, not just zero-fill cancels.
+            aggress = -1 if is_tif else 1
+            price = mid[sym] + aggress * (spread + off) * (
+                1 if side == SELL else -1)
             if price < 1:
                 price = 1
         qty = rng.randrange(1, qty_max)

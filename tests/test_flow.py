@@ -60,10 +60,10 @@ def test_contract_matches_uniform_generator():
     for o in stream:
         if o.op == OP_SUBMIT:
             seen.add(o.oid)
-            if o.otype == 1:
+            if o.otype in (1, 4):  # MARKET / MARKET_FOK: price-indifferent
                 assert o.price == 0
-            else:
-                assert o.price >= 1
+            else:  # LIMIT / LIMIT_IOC / LIMIT_FOK carry a real limit
+                assert o.otype in (0, 2, 3) and o.price >= 1
             assert 1 <= o.qty < 100
         else:
             assert o.op == OP_CANCEL and o.oid in seen

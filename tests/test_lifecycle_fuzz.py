@@ -26,7 +26,15 @@ from matching_engine_tpu.engine.harness import (
 )
 from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_REST, OP_SUBMIT
 from matching_engine_tpu.engine.oracle import OracleBook
-from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+from matching_engine_tpu.proto import (
+    BUY,
+    LIMIT,
+    LIMIT_FOK,
+    LIMIT_IOC,
+    MARKET,
+    MARKET_FOK,
+    SELL,
+)
 
 S, CAP = 4, 24
 
@@ -58,12 +66,21 @@ def test_lifecycle_continuous_auction_interleave(kernel, seed):
                 continue
             side = BUY if rng.random() < 0.5 else SELL
             market = op_mode == OP_SUBMIT and rng.random() < 0.1
-            price = 0 if market else 10_000 + rng.randrange(-8, 9)
+            otype = MARKET if market else LIMIT
+            # Continuous phases also carry IOC/FOK traffic (call-period
+            # streams stay GTC — the edges reject non-GTC there).
+            if op_mode == OP_SUBMIT and rng.random() < 0.15:
+                if market:
+                    otype = MARKET_FOK
+                else:
+                    otype = rng.choice((LIMIT_IOC, LIMIT_FOK))
+            price = (0 if otype in (MARKET, MARKET_FOK)
+                     else 10_000 + rng.randrange(-8, 9))
             out.append(HostOrder(
-                sym, op_mode, side, MARKET if market else LIMIT,
+                sym, op_mode, side, otype,
                 price, rng.randrange(1, 20), oid=next_oid,
                 owner=rng.randrange(0, 3)))  # owner 1/2 collide sometimes
-            if not market:
+            if otype == LIMIT:
                 cancelable[sym].append((next_oid, side))
             next_oid += 1
         return out
