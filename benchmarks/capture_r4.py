@@ -33,6 +33,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(REPO, "benchmarks", "results")
+LOCK = os.path.join(RESULTS, ".capture.lock")
 LOG = os.path.join(RESULTS, "r4_capture.log")
 PY = sys.executable
 
@@ -207,6 +208,12 @@ STEPS: list[dict] = [
              "TPU_E2E_PER_CLIENT": "2000"}},
     # Second window point: w25 reached 3.5k/s at ~88 ops/dispatch, still
     # under the 256-op sweet spot — probe the knee from the other side.
+    # l3flow re-capture under the ioc-fok flow mix (flow.py tif_p=0.05,
+    # aggressively priced) — rows labeled without "+ioc-fok" predate it.
+    {"name": "l3flow_v2", "artifact": "tpu_r5_l3flow_iocfok.json",
+     "timeout": 2400,
+     "cmd": [PY, os.path.join(REPO, "benchmarks", "flow_bench.py"),
+             "--json-out", os.path.join(RESULTS, "tpu_r5_l3flow_iocfok.json")]},
     {"name": "e2e_w60", "artifact": "tpu_e2e_r4_native_pi4_w60.json",
      "timeout": 1500,
      "cmd": ["bash", os.path.join(REPO, "scripts", "tpu_e2e_r4.sh"), "4"],
@@ -227,7 +234,7 @@ _R5_ORDER = [
     "cap4096s", "cap256", "e2e_pi2", "e2e_pi4", "suite_full",
     "batch64", "batch128", "syms64", "syms256", "syms1024", "l3flow",
     "profile_sorted", "cap8192s", "e2e_pi2_w256", "suite7", "runner_sat",
-    "e2e_sat", "e2e_w25", "e2e_w60",
+    "e2e_sat", "e2e_w25", "e2e_w60", "l3flow_v2",
 ]
 _RANK = {n: i for i, n in enumerate(_R5_ORDER)}
 STEPS.sort(key=lambda s: _RANK.get(s["name"], len(_R5_ORDER)))
@@ -366,6 +373,18 @@ def probe_healthy(timeout_s: float = 45) -> bool:
 
 def main() -> int:
     os.makedirs(RESULTS, exist_ok=True)
+    # Single-instance lock: a manual run racing the watcher's run doubles
+    # up the same TPU bench and can push a step past its timeout (observed
+    # 12:42Z 07-31 — two concurrent l3flow benches both timed out). flock
+    # releases on process exit, crash included.
+    import fcntl
+
+    lock_f = open(LOCK, "w")
+    try:
+        fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        log("another capture run holds the lock; exiting")
+        return 10
     missing = [s for s in STEPS if not os.path.exists(
         os.path.join(RESULTS, s["artifact"]))]
     if not missing:

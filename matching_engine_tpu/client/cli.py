@@ -25,6 +25,7 @@ USAGE = (
     "<LIMIT|MARKET[:IOC|:FOK]> <price> <scale> <quantity>\n"
     "   or: client book <addr> <symbol>\n"
     "   or: client cancel <addr> <client_id> <order_id>\n"
+    "   or: client amend <addr> <client_id> <order_id> <new_qty>\n"
     "   or: client watch-md <addr> <symbol>\n"
     "   or: client watch-orders <addr> <client_id>\n"
     "   or: client metrics <addr>\n"
@@ -120,6 +121,23 @@ def _cancel(addr: str, client_id: str, order_id: str) -> int:
     return 3
 
 
+def _amend(addr: str, client_id: str, order_id: str, new_qty: str) -> int:
+    try:
+        resp = _stub(addr).AmendOrder(
+            pb2.AmendRequest(client_id=client_id, order_id=order_id,
+                             new_quantity=int(new_qty)), timeout=10
+        )
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}", file=sys.stderr)
+        return 2
+    if resp.success:
+        print(f"[client] amended order_id={resp.order_id} "
+              f"remaining={resp.remaining_quantity}")
+        return 0
+    print(f"[client] amend rejected: {resp.error_message}")
+    return 3
+
+
 def _watch_md(addr: str, symbol: str) -> int:
     # flush per event: watchers are typically piped/redirected, and buffered
     # stream output looks like silence.
@@ -174,6 +192,8 @@ def _dispatch(argv: list[str]) -> int:
             return _book(argv[1], argv[2])
         if len(argv) == 4 and argv[0] == "cancel":
             return _cancel(argv[1], argv[2], argv[3])
+        if len(argv) == 5 and argv[0] == "amend":
+            return _amend(argv[1], argv[2], argv[3], argv[4])
         if len(argv) in (2, 3) and argv[0] == "auction":
             return _auction(argv[1], argv[2] if len(argv) == 3 else "")
         if len(argv) == 3 and argv[0] == "watch-md":

@@ -54,6 +54,12 @@ NOOP_STATUS = -1
 # (engine/auction.py): books may stand crossed until an uncross clears
 # them. Identical to OP_SUBMIT except the maker scan never runs.
 OP_NOOP, OP_SUBMIT, OP_CANCEL, OP_REST = 0, 1, 2, 3
+# Priority-preserving quantity reduction (venue "amend down"): the qty
+# lane carries the NEW remaining quantity; the resting order keeps its
+# price, seq, and therefore its place in the time-priority queue. Any
+# other modification (qty up, price change) re-prices priority and is a
+# cancel+submit at the service layer, never an in-place edit.
+OP_AMEND = 4
 # Device otype lane: the wire's (order_type, time_in_force) pair collapses
 # to one small code so the dispatch layout stays [S, B, 7] (no extra lane).
 # LIMIT = GTC limit (the only code that RESTS); MARKET is inherently IOC.
@@ -97,6 +103,7 @@ def _match_one(book: _SymBook, order):
     is_submit = op == OP_SUBMIT
     is_cancel = op == OP_CANCEL
     is_rest = op == OP_REST          # auction accumulation: never matches
+    is_amend = op == OP_AMEND        # qty-down in place: priority kept
     is_submit_like = is_submit | is_rest
     is_buy = side == BUY
     # px_any: price-indifferent sweep (MARKET-style eligibility); is_fok:
@@ -187,6 +194,15 @@ def _match_one(book: _SymBook, order):
     cancel_ok = jnp.any(cancel_mask)
     own_qty = jnp.where(cancel_mask, 0, own_qty)
 
+    # Amend down: reduce the target's quantity in place (price/seq — and
+    # with them time priority — untouched). Only a strict reduction to a
+    # positive quantity is valid; anything else REJECTs (qty up or price
+    # moves lose priority and belong to cancel+submit).
+    amend_mask = is_amend & (own_oid == oid) & (own_qty > 0)
+    amend_feasible = amend_mask & (qty > 0) & (qty < own_qty)
+    amend_ok = jnp.any(amend_feasible)
+    own_qty = jnp.where(amend_feasible, qty, own_qty)
+
     # ---- write back (buy: opp=asks/own=bids; sell: the reverse) ----------
     new_book = _SymBook(
         bid_price=jnp.where(is_buy, own_price, opp_price),
@@ -220,13 +236,18 @@ def _match_one(book: _SymBook, order):
         ),
     )
     cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
+    amend_status = jnp.where(amend_ok, NEW, REJECTED)
     status = jnp.where(
         is_submit_like,
         submit_status,
-        jnp.where(is_cancel, cancel_status, NOOP_STATUS),
+        jnp.where(
+            is_cancel, cancel_status,
+            jnp.where(is_amend, amend_status, NOOP_STATUS)),
     ).astype(I32)
     out_remaining = jnp.where(
-        is_submit_like, remaining, jnp.where(is_cancel, cancel_qty, 0)
+        is_submit_like, remaining,
+        jnp.where(is_cancel, cancel_qty,
+                  jnp.where(is_amend & amend_ok, qty, 0))
     ).astype(I32)
 
     return new_book, (

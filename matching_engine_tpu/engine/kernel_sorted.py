@@ -50,6 +50,7 @@ from matching_engine_tpu.engine.kernel import (
     MARKET_FOK,
     NEW,
     NOOP_STATUS,
+    OP_AMEND,
     OP_CANCEL,
     OP_REST,
     OP_SUBMIT,
@@ -84,6 +85,7 @@ def _match_one_sorted(book: _SymBook, order):
     is_submit = op == OP_SUBMIT
     is_cancel = op == OP_CANCEL
     is_rest = op == OP_REST
+    is_amend = op == OP_AMEND        # qty-down in place: priority kept
     is_submit_like = is_submit | is_rest
     is_buy = side == BUY
     # Same tif collapse as kernel._match_one: px_any = price-indifferent
@@ -192,9 +194,16 @@ def _match_one_sorted(book: _SymBook, order):
     cancel_mask = is_cancel & (own_oid == oid) & own_live
     cancel_qty = jnp.sum(jnp.where(cancel_mask, own_qty, 0))
     cancel_ok = jnp.any(cancel_mask)
+    # Amend down in place: quantity drops, price/seq (and the dense
+    # sorted-prefix position they define) stay put — new qty > 0 keeps
+    # density, so the compact below is still an identity for amends.
+    amend_mask = is_amend & (own_oid == oid) & own_live
+    amend_feasible = amend_mask & (qty > 0) & (qty < own_qty)
+    amend_ok = jnp.any(amend_feasible)
     # Cancel zeroes its slot; the unconditional compact below re-packs
     # (identity when nothing was zeroed — inserts keep density).
-    c_qty = jnp.where(cancel_mask, 0, ins_qty)
+    c_qty = jnp.where(cancel_mask, 0,
+                      jnp.where(amend_feasible, qty, ins_qty))
     own_qty2, own_price2, own_oid2, own_seq2, own_owner2 = _compact(
         c_qty, ins_price, ins_oid, ins_seq, ins_owner)
 
@@ -227,13 +236,18 @@ def _match_one_sorted(book: _SymBook, order):
         ),
     )
     cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
+    amend_status = jnp.where(amend_ok, NEW, REJECTED)
     status = jnp.where(
         is_submit_like,
         submit_status,
-        jnp.where(is_cancel, cancel_status, NOOP_STATUS),
+        jnp.where(
+            is_cancel, cancel_status,
+            jnp.where(is_amend, amend_status, NOOP_STATUS)),
     ).astype(I32)
     out_remaining = jnp.where(
-        is_submit_like, remaining, jnp.where(is_cancel, cancel_qty, 0)
+        is_submit_like, remaining,
+        jnp.where(is_cancel, cancel_qty,
+                  jnp.where(is_amend & amend_ok, qty, 0))
     ).astype(I32)
 
     return new_book, (

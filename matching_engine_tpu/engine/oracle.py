@@ -248,6 +248,20 @@ class OracleBook:
                     return OrderResult(oid, CANCELED, 0, r.qty, False, ())
         return OrderResult(oid, REJECTED, 0, 0, False, ())
 
+    def amend(self, oid: int, new_qty: int) -> OrderResult:
+        """Priority-preserving quantity reduction (kernel OP_AMEND twin):
+        only a strict reduction to a positive quantity succeeds; the
+        order keeps its seq (time priority) and price. Returns NEW with
+        the new remaining on success, REJECTED otherwise."""
+        for side_list in (self.bids, self.asks):
+            for r in side_list:
+                if r.oid == oid:
+                    if 0 < new_qty < r.qty:
+                        r.qty = new_qty
+                        return OrderResult(oid, NEW, 0, new_qty, True, ())
+                    return OrderResult(oid, REJECTED, 0, 0, False, ())
+        return OrderResult(oid, REJECTED, 0, 0, False, ())
+
     # -- views -------------------------------------------------------------
 
     def best_bid(self) -> tuple[int, int] | None:

@@ -314,6 +314,10 @@ def _load_gateway():
         lib.me_gateway_complete_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
         ]
+        lib.me_gateway_complete_amend.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_longlong, ctypes.c_char_p,
+        ]
         lib.me_gateway_respond.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
@@ -445,6 +449,15 @@ class NativeGateway:
             error.encode(),
         )
 
+    def complete_amend(self, tag: int, success: bool, order_id: str,
+                       remaining: int = 0, error: str = "") -> None:
+        if self._h is None:
+            return
+        self._lib.me_gateway_complete_amend(
+            self._h, tag, 1 if success else 0, order_id.encode(),
+            remaining, error.encode(),
+        )
+
     def complete_batch(
         self, items: list[tuple[int, int, bool, str, str]]
     ) -> None:
@@ -533,9 +546,14 @@ def pack_batch(orders, updates, fills) -> bytes:
             price or 0, qty, remaining, status,
         )
     out += struct.pack("<I", len(updates))
-    for (oid, status, remaining) in updates:
-        _pack_str(out, oid)
-        out += struct.pack("<Bq", status, remaining)
+    for u in updates:
+        # 3-tuple: status/remaining update. 4-tuple: amend — also moves
+        # quantity (has_qty flag byte; MeSink binds the amend statement).
+        _pack_str(out, u[0])
+        if len(u) == 3:
+            out += struct.pack("<BqBq", u[1], u[2], 0, 0)
+        else:
+            out += struct.pack("<BqBq", u[1], u[2], 1, u[3])
     out += struct.pack("<I", len(fills))
     for f in fills:
         _pack_str(out, f.order_id)

@@ -26,6 +26,7 @@ _METHODS = {
     "StreamMarketData": ("unary_stream", pb2.MarketDataRequest, pb2.MarketDataUpdate),
     "StreamOrderUpdates": ("unary_stream", pb2.OrderUpdatesRequest, pb2.OrderUpdate),
     "CancelOrder": ("unary_unary", pb2.CancelRequest, pb2.CancelResponse),
+    "AmendOrder": ("unary_unary", pb2.AmendRequest, pb2.AmendResponse),
     "GetMetrics": ("unary_unary", pb2.MetricsRequest, pb2.MetricsResponse),
     "RunAuction": ("unary_unary", pb2.AuctionRequest, pb2.AuctionResponse),
 }
@@ -50,6 +51,9 @@ class MatchingEngineServicer:
 
     def CancelOrder(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "CancelOrder not implemented")
+
+    def AmendOrder(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "AmendOrder not implemented")
 
     def GetMetrics(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetMetrics not implemented")

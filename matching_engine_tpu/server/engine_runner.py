@@ -40,6 +40,7 @@ from matching_engine_tpu.engine.kernel import (
     CANCELED,
     FILLED,
     NEW,
+    OP_AMEND,
     OP_CANCEL,
     OP_REST,
     OP_SUBMIT,
@@ -83,9 +84,10 @@ class OrderInfo:
 class EngineOp:
     """One validated operation headed for the device."""
 
-    op: int                      # OP_SUBMIT / OP_REST / OP_CANCEL
-    info: OrderInfo              # the order (submit) or the target (cancel)
+    op: int                      # OP_SUBMIT / OP_REST / OP_CANCEL / OP_AMEND
+    info: OrderInfo              # the order (submit) or the target (cancel/amend)
     cancel_requester: str = ""   # client asking for the cancel
+    amend_qty: int = 0           # OP_AMEND: the new (reduced) quantity
 
 
 @dataclasses.dataclass
@@ -517,13 +519,18 @@ class EngineRunner:
         self._build_ou = self.hub is None or self.hub.has_order_update_subs()
         self._build_md = self.hub is None or self.hub.has_market_data_subs()
         host_orders = []
-        by_handle: dict[int, EngineOp] = {}
+        # handle -> FIFO of this batch's ops on that handle: several ops
+        # may target one order in one dispatch (amend then cancel is a
+        # routine client sequence), and device result rows for a symbol
+        # arrive in enqueue order — a plain dict would misattribute every
+        # result to the LAST op on the handle.
+        by_handle: dict[int, deque[EngineOp]] = {}
         terminal_makers: set[int] = set()
         try:
             for e in ops:
                 i = e.info
-                if e.op == OP_CANCEL and i.status in (FILLED, CANCELED,
-                                                      REJECTED):
+                if e.op in (OP_CANCEL, OP_AMEND) and i.status in (
+                        FILLED, CANCELED, REJECTED):
                     # The target went terminal (and its handle was recycled)
                     # after this cancel was enqueued — a device cancel now
                     # could hit an unrelated order reusing the handle.
@@ -552,14 +559,15 @@ class EngineRunner:
                         side=i.side,
                         otype=i.otype,
                         price=i.price_q4,
-                        qty=i.remaining if e.op != OP_CANCEL else 0,
+                        qty=(e.amend_qty if e.op == OP_AMEND
+                             else i.remaining if e.op != OP_CANCEL else 0),
                         oid=i.handle,
                         # Self-trade prevention identity travels to the
                         # device book lanes with every submit/rest.
                         owner=self._owner_for(i.client_id),
                     )
                 )
-                by_handle[i.handle] = e
+                by_handle.setdefault(i.handle, deque()).append(e)
                 if e.op in (OP_SUBMIT, OP_REST):
                     # Register BEFORE dispatch: with waves dispatched ahead
                     # of the decode cursor, a concurrent book_snapshot can
@@ -977,9 +985,10 @@ class EngineRunner:
             fills_by_taker.setdefault(f.taker_oid, []).append(f)
 
         for r in results:
-            e = by_handle.get(r.oid)
-            if e is None:
+            q = by_handle.get(r.oid)
+            if not q:
                 continue
+            e = q.popleft()
             info = e.info
             if e.op in (OP_SUBMIT, OP_REST):
                 info.status = r.status
@@ -1045,6 +1054,29 @@ class EngineRunner:
                 if self._build_ou and r.status in (NEW, CANCELED, REJECTED):
                     res.order_updates.append(
                         self._update(info, r.status, 0, 0, r.remaining))
+            elif e.op == OP_AMEND:
+                if r.status == NEW:
+                    # quantity and remaining shrink together by the same
+                    # delta, so filled (= quantity - remaining) and the
+                    # store's CHECK arithmetic are untouched.
+                    filled_so_far = info.quantity - info.remaining
+                    info.remaining = r.remaining
+                    info.quantity = filled_so_far + r.remaining
+                    res.outcomes.append(OpOutcome(e, NEW, 0, r.remaining))
+                    # Amends ride the updates stream as 4-tuples (the
+                    # extra field is the new quantity); both sinks split
+                    # them onto the quantity-updating statement.
+                    res.storage_updates.append(
+                        (info.order_id, info.status, info.remaining,
+                         info.quantity))
+                    if self._build_ou:
+                        res.order_updates.append(self._update(
+                            info, info.status, 0, 0, r.remaining))
+                else:
+                    res.outcomes.append(OpOutcome(
+                        e, REJECTED, 0, 0,
+                        "amend rejected (must strictly reduce an open "
+                        "order's quantity)"))
             else:  # cancel
                 if r.status == CANCELED:
                     info.status = CANCELED

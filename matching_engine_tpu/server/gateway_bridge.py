@@ -24,6 +24,8 @@ import time
 
 from matching_engine_tpu.engine.kernel import (
     CANCELED,
+    NEW,
+    OP_AMEND,
     OP_CANCEL,
     OP_SUBMIT,
     REJECTED,
@@ -152,6 +154,9 @@ class GatewayBridge:
                     if rec[1] == 1:
                         self.gateway.complete_submit(
                             rec[0], False, "", "engine error")
+                    elif rec[1] == 3:
+                        self.gateway.complete_amend(
+                            rec[0], False, rec[8] or "", 0, "engine error")
                     else:
                         # rec[8] is None for records that failed string
                         # decode — this fallback must never raise.
@@ -171,6 +176,9 @@ class GatewayBridge:
                 if op == 1:
                     self.gateway.complete_submit(
                         tag, False, "", "invalid request encoding")
+                elif op == 3:
+                    self.gateway.complete_amend(
+                        tag, False, "", 0, "invalid request encoding")
                 else:
                     self.gateway.complete_cancel(
                         tag, False, "", "invalid request encoding")
@@ -209,6 +217,18 @@ class GatewayBridge:
                 # rests under the dispatch lock (edge reads would race
                 # the RunAuction mode flip).
                 e = EngineOp(OP_SUBMIT, info)
+            elif op == 3:  # amend — same directory checks as the service
+                info = runner.orders_by_id.get(order_id)
+                if info is None:
+                    self.gateway.complete_amend(
+                        tag, False, order_id, 0, "unknown order id")
+                    continue
+                if info.client_id != client_id:
+                    self.gateway.complete_amend(
+                        tag, False, order_id, 0,
+                        "order belongs to a different client")
+                    continue
+                e = EngineOp(OP_AMEND, info, amend_qty=qty)
             else:  # cancel — host-side directory checks, as the service does
                 info = runner.orders_by_id.get(order_id)
                 if info is None:
@@ -246,7 +266,11 @@ class GatewayBridge:
                         tag = tags.get(id(op))
                         if tag is None:
                             continue
-                        if op.op != OP_CANCEL:
+                        if op.op == OP_AMEND:
+                            self.gateway.complete_amend(
+                                tag, False, op.info.order_id, 0,
+                                "engine error")
+                        elif op.op != OP_CANCEL:
                             self.gateway.complete_submit(
                                 tag, False, op.info.order_id, "engine error"
                             )
@@ -272,7 +296,16 @@ class GatewayBridge:
                     if tag is None:
                         continue
                     info = outcome.op.info
-                    if outcome.op.op != OP_CANCEL:
+                    if outcome.op.op == OP_AMEND:
+                        # AmendResponse carries the new remaining: its own
+                        # completion entry, outside the submit/cancel batch.
+                        ok = outcome.status == NEW
+                        if ok:
+                            self.metrics.inc("orders_amended")
+                        self.gateway.complete_amend(
+                            tag, ok, info.order_id, outcome.remaining,
+                            "" if ok else (outcome.error or "amend rejected"))
+                    elif outcome.op.op != OP_CANCEL:
                         if outcome.status == REJECTED and outcome.error:
                             self.metrics.inc("orders_rejected")
                             batch.append(
@@ -293,6 +326,11 @@ class GatewayBridge:
                 for op in ops:
                     tag = tags.pop(id(op), None)
                     if tag is None:
+                        continue
+                    if op.op == OP_AMEND:
+                        self.gateway.complete_amend(
+                            tag, False, op.info.order_id, 0,
+                            "op produced no outcome")
                         continue
                     kind = 1 if op.op == OP_CANCEL else 0
                     batch.append((tag, kind, False, op.info.order_id,

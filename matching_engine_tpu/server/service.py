@@ -27,6 +27,8 @@ import grpc
 from matching_engine_tpu.domain import normalize_to_q4, validate_submit
 from matching_engine_tpu.engine.kernel import (
     CANCELED,
+    NEW,
+    OP_AMEND,
     OP_CANCEL,
     OP_SUBMIT,
     REJECTED,
@@ -196,6 +198,60 @@ class MatchingEngineService(MatchingEngineServicer):
         return pb2.CancelResponse(
             order_id=request.order_id, success=False,
             error_message=outcome.error or "order not open",
+        )
+
+    # -- AmendOrder --------------------------------------------------------
+
+    def AmendOrder(self, request, context):
+        """Priority-preserving quantity reduction (proto AmendOrder): the
+        order keeps its price and time priority; only a strict reduction
+        to a positive quantity succeeds. Allowed in call periods too — an
+        amend-down never crosses anything."""
+        self.metrics.inc("rpc_amend")
+        if not request.client_id:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="client_id is required",
+            )
+        if request.new_quantity <= 0:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="new_quantity must be positive",
+            )
+        info = self.runner.orders_by_id.get(request.order_id)
+        if info is None:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="unknown order id",
+            )
+        if info.client_id != request.client_id:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="order belongs to a different client",
+            )
+        try:
+            outcome = self.dispatcher.submit(
+                EngineOp(OP_AMEND, info, amend_qty=request.new_quantity)
+            ).result(timeout=30)
+        except RingFull:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="server overloaded",
+            )
+        except Exception:  # noqa: BLE001
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="engine error",
+            )
+        if outcome.status == NEW:
+            self.metrics.inc("orders_amended")
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=True,
+                remaining_quantity=outcome.remaining,
+            )
+        return pb2.AmendResponse(
+            order_id=request.order_id, success=False,
+            error_message=outcome.error or "amend rejected",
         )
 
     # -- GetOrderBook ------------------------------------------------------

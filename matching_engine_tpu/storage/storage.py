@@ -335,11 +335,28 @@ class Storage:
                         "(?,?,?,?,?,?,?,?,?,?,?,?)",
                         order_rows,
                     )
-                    self._conn.executemany(
-                        "UPDATE orders SET status = ?, remaining_quantity = ?, "
-                        "updated_ts = ? WHERE order_id = ?",
-                        [(st, rem, ts, oid) for (oid, st, rem) in updates],
-                    )
+                    # 3-tuples update status/remaining (fills, cancels);
+                    # 4-tuples are priority-preserving amends and move
+                    # quantity WITH remaining so filled == quantity -
+                    # remaining stays exact. ONE order-preserving pass —
+                    # an amend and a later fill of the same order can
+                    # share a batch, and the later event must win (the
+                    # native sink applies in stream order too).
+                    for u in updates:
+                        if len(u) == 3:
+                            self._conn.execute(
+                                "UPDATE orders SET status = ?, "
+                                "remaining_quantity = ?, updated_ts = ? "
+                                "WHERE order_id = ?",
+                                (u[1], u[2], ts, u[0]),
+                            )
+                        else:
+                            self._conn.execute(
+                                "UPDATE orders SET status = ?, "
+                                "remaining_quantity = ?, quantity = ?, "
+                                "updated_ts = ? WHERE order_id = ?",
+                                (u[1], u[2], u[3], ts, u[0]),
+                            )
                     self._conn.executemany(
                         "INSERT INTO fills (order_id, counter_order_id, price, "
                         "quantity, ts) VALUES (?,?,?,?,?)",

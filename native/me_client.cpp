@@ -41,6 +41,7 @@ const char kUsage[] =
     "usage: me_client <addr> <client_id> <symbol> <BUY|SELL> "
     "<LIMIT|MARKET[:IOC|:FOK]> <price> <scale> <quantity>\n"
     "   or: me_client cancel <addr> <client_id> <order_id>\n"
+    "   or: me_client amend <addr> <client_id> <order_id> <new_qty>\n"
     "   or: me_client book <addr> <symbol>\n"
     "   or: me_client metrics <addr>\n"
     "   or: me_client watch-md <addr> <symbol> [max_events]\n"
@@ -664,6 +665,39 @@ int do_cancel(const std::string& addr, const std::string& client_id,
   return 3;
 }
 
+int do_amend(const std::string& addr, const std::string& client_id,
+             const std::string& order_id, long long new_qty) {
+  pb::AmendRequest req;
+  req.set_client_id(client_id);
+  req.set_order_id(order_id);
+  req.set_new_quantity(static_cast<int32_t>(new_qty));
+  std::string bytes;
+  req.SerializeToString(&bytes);
+  std::string resp_bytes, grpc_message;
+  int grpc_status = -1;
+  if (unary_call(addr, "/matching_engine.v1.MatchingEngine/AmendOrder",
+                 bytes, &resp_bytes, &grpc_status, &grpc_message) != 0) {
+    return 2;
+  }
+  if (grpc_status != 0) {
+    std::fprintf(stderr, "[client] rpc failed: grpc-status=%d: %s\n",
+                 grpc_status, grpc_message.c_str());
+    return 2;
+  }
+  pb::AmendResponse resp;
+  if (!resp.ParseFromString(resp_bytes)) {
+    std::fprintf(stderr, "[client] rpc failed: bad response\n");
+    return 2;
+  }
+  if (resp.success()) {
+    std::printf("[client] amended order_id=%s remaining=%d\n",
+                resp.order_id().c_str(), resp.remaining_quantity());
+    return 0;
+  }
+  std::printf("[client] amend rejected: %s\n", resp.error_message().c_str());
+  return 3;
+}
+
 }  // namespace
 
 namespace {
@@ -872,6 +906,9 @@ int main(int argc, char** argv) {
   GOOGLE_PROTOBUF_VERIFY_VERSION;
   if (argc == 5 && std::strcmp(argv[1], "cancel") == 0) {
     return do_cancel(argv[2], argv[3], argv[4]);
+  }
+  if (argc == 6 && std::strcmp(argv[1], "amend") == 0) {
+    return do_amend(argv[2], argv[3], argv[4], std::atoll(argv[5]));
   }
   if (argc == 4 && std::strcmp(argv[1], "book") == 0) {
     return do_book(argv[2], argv[3]);

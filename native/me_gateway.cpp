@@ -175,6 +175,7 @@ enum Method {
   M_STREAM_MD = 5,
   M_STREAM_OU = 6,
   M_AUCTION = 7,
+  M_AMEND = 8,
 };
 
 int route(const std::string& path) {
@@ -183,6 +184,7 @@ int route(const std::string& path) {
   const std::string m = path.substr(sizeof(kPrefix) - 1);
   if (m == "SubmitOrder") return M_SUBMIT;
   if (m == "CancelOrder") return M_CANCEL;
+  if (m == "AmendOrder") return M_AMEND;
   if (m == "GetOrderBook") return M_BOOK;
   if (m == "GetMetrics") return M_METRICS;
   if (m == "StreamMarketData") return M_STREAM_MD;
@@ -199,7 +201,7 @@ extern "C" {
 // matching_engine_tpu/native/__init__.py — keep layouts identical).
 struct MeGwOp {
   uint64_t tag;
-  int32_t op;        // 1 = submit, 2 = cancel
+  int32_t op;        // 1 = submit, 2 = cancel, 3 = amend (qty-down)
   int32_t side;      // BUY=1 / SELL=2
   int32_t otype;     // LIMIT=0 / MARKET=1
   int32_t price_q4;  // normalized; 0 for MARKET
@@ -422,8 +424,11 @@ class Conn : public std::enable_shared_from_this<Conn> {
   void handle_request(uint32_t stream_id, Stream& st);
   void handle_submit(uint32_t stream_id, const std::string& payload);
   void handle_cancel(uint32_t stream_id, const std::string& payload);
+  void handle_amend(uint32_t stream_id, const std::string& payload);
   void reject_submit(uint32_t stream_id, const std::string& order_id,
                      const std::string& error);
+  void reject_amend(uint32_t stream_id, const std::string& order_id,
+                    const std::string& error);
   void reject_cancel(uint32_t stream_id, const std::string& order_id,
                      const std::string& error);
 
@@ -1147,6 +1152,9 @@ void Conn::handle_request(uint32_t stream_id, Stream& st) {
     case M_CANCEL:
       handle_cancel(stream_id, payload);
       return;
+    case M_AMEND:
+      handle_amend(stream_id, payload);
+      return;
     default: {
       // Forwarded methods (book/metrics/streams) go through the Python
       // callback; the response arrives via me_gateway_respond.
@@ -1169,6 +1177,17 @@ void Conn::handle_request(uint32_t stream_id, Stream& st) {
 void Conn::reject_submit(uint32_t stream_id, const std::string& order_id,
                          const std::string& error) {
   pb::OrderResponse resp;
+  resp.set_order_id(order_id);
+  resp.set_success(false);
+  resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  write_unary(stream_id, bytes, 0, nullptr);
+}
+
+void Conn::reject_amend(uint32_t stream_id, const std::string& order_id,
+                        const std::string& error) {
+  pb::AmendResponse resp;
   resp.set_order_id(order_id);
   resp.set_success(false);
   resp.set_error_message(error);
@@ -1258,6 +1277,43 @@ void Conn::handle_cancel(uint32_t stream_id, const std::string& payload) {
   }
 }
 
+void Conn::handle_amend(uint32_t stream_id, const std::string& payload) {
+  // Validation parity with service.AmendOrder: client_id required,
+  // new_quantity > 0; directory checks (unknown id / wrong client /
+  // feasibility) happen in the bridge + kernel, as for cancels.
+  pb::AmendRequest req;
+  if (!req.ParseFromString(payload)) {
+    write_trailers(stream_id, 13, "unparsable AmendRequest", false);
+    return;
+  }
+  if (req.client_id().empty()) {
+    reject_amend(stream_id, req.order_id(), "client_id is required");
+    return;
+  }
+  if (req.new_quantity() <= 0) {
+    reject_amend(stream_id, req.order_id(), "new_quantity must be positive");
+    return;
+  }
+  if (req.order_id().size() > sizeof(MeGwOp::order_id)) {
+    reject_amend(stream_id, req.order_id(), "unknown order id");
+    return;
+  }
+  MeGwOp op{};
+  op.op = 3;
+  op.quantity = req.new_quantity();
+  op.order_id_len = static_cast<int32_t>(req.order_id().size());
+  std::memcpy(op.order_id, req.order_id().data(), req.order_id().size());
+  size_t cid = std::min(req.client_id().size(), sizeof(MeGwOp::client_id));
+  op.client_id_len = static_cast<int32_t>(cid);
+  std::memcpy(op.client_id, req.client_id().data(), cid);
+  op.tag = gw_->register_pending(shared_from_this(), stream_id, false);
+  if (!gw_->ring_push(op)) {
+    gw_->drop_pending(op.tag);
+    reject_amend(stream_id, req.order_id(), "server overloaded");
+    return;
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1318,6 +1374,27 @@ void me_gateway_complete_cancel(void* g, uint64_t tag, int success,
   pb::CancelResponse resp;
   resp.set_order_id(order_id ? order_id : "");
   resp.set_success(success != 0);
+  if (error && *error) resp.set_error_message(error);
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  conn->write_unary(p.stream_id, bytes, 0, nullptr);
+}
+
+// Amend completion: AmendResponse carries the post-amend remaining, so it
+// has its own completion entry (amends are rare next to submits — the
+// single-call path is fine; submits/cancels ride complete_batch).
+void me_gateway_complete_amend(void* g, uint64_t tag, int success,
+                               const char* order_id, long long remaining,
+                               const char* error) {
+  auto* gw = static_cast<Gateway*>(g);
+  Pending p;
+  if (!gw->take_pending(tag, &p)) return;
+  auto conn = p.conn.lock();
+  if (!conn || conn->dead()) return;
+  pb::AmendResponse resp;
+  resp.set_order_id(order_id ? order_id : "");
+  resp.set_success(success != 0);
+  if (success) resp.set_remaining_quantity(static_cast<int32_t>(remaining));
   if (error && *error) resp.set_error_message(error);
   std::string bytes;
   resp.SerializeToString(&bytes);

@@ -467,7 +467,7 @@ class MeSink {
       }
     }
     if (db_) {
-      for (auto* s : {ins_order_, upd_order_, ins_fill_})
+      for (auto* s : {ins_order_, upd_order_, upd_amend_, ins_fill_})
         if (s) sqlite3_finalize(s);
       sqlite3_close_v2(db_);
       db_ = nullptr;
@@ -513,6 +513,10 @@ class MeSink {
                "UPDATE orders SET status = ?, remaining_quantity = ?,"
                " updated_ts = ? WHERE order_id = ?",
                &upd_order_) &&
+           prep(
+               "UPDATE orders SET status = ?, remaining_quantity = ?,"
+               " quantity = ?, updated_ts = ? WHERE order_id = ?",
+               &upd_amend_) &&
            prep(
                "INSERT INTO fills (order_id, counter_order_id, price,"
                " quantity, ts) VALUES (?,?,?,?,?)",
@@ -616,14 +620,25 @@ class MeSink {
     if (!r.u32(&n)) return false;
     for (uint32_t i = 0; i < n; i++) {
       std::string oid;
-      uint8_t status;
-      long long remaining;
-      if (!(r.str(&oid) && r.u8(&status) && r.i64(&remaining))) return false;
-      sqlite3_bind_int64(upd_order_, 1, status);
-      sqlite3_bind_int64(upd_order_, 2, remaining);
-      sqlite3_bind_int64(upd_order_, 3, ts);
-      sqlite3_bind_text(upd_order_, 4, oid.c_str(), -1, SQLITE_TRANSIENT);
-      if (!step_reset(upd_order_)) {
+      uint8_t status, has_qty;
+      long long remaining, qty;
+      if (!(r.str(&oid) && r.u8(&status) && r.i64(&remaining) &&
+            r.u8(&has_qty) && r.i64(&qty)))
+        return false;
+      // has_qty marks a priority-preserving amend: quantity moves WITH
+      // remaining so filled == quantity - remaining stays exact.
+      sqlite3_stmt* st = has_qty ? upd_amend_ : upd_order_;
+      sqlite3_bind_int64(st, 1, status);
+      sqlite3_bind_int64(st, 2, remaining);
+      if (has_qty) {
+        sqlite3_bind_int64(st, 3, qty);
+        sqlite3_bind_int64(st, 4, ts);
+        sqlite3_bind_text(st, 5, oid.c_str(), -1, SQLITE_TRANSIENT);
+      } else {
+        sqlite3_bind_int64(st, 3, ts);
+        sqlite3_bind_text(st, 4, oid.c_str(), -1, SQLITE_TRANSIENT);
+      }
+      if (!step_reset(st)) {
         std::fprintf(stderr, "[me_sink] order update %s: %s\n", oid.c_str(),
                      sqlite3_errmsg(db_));
         return false;
@@ -659,6 +674,7 @@ class MeSink {
   sqlite3* db_ = nullptr;
   sqlite3_stmt* ins_order_ = nullptr;
   sqlite3_stmt* upd_order_ = nullptr;
+  sqlite3_stmt* upd_amend_ = nullptr;
   sqlite3_stmt* ins_fill_ = nullptr;
 
   std::mutex mu_;

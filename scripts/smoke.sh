@@ -123,6 +123,13 @@ run_case "cancel unknown" "cancel rejected" cancel "$ADDR" c1 OID-999
 run_case "LIMIT:IOC accepted" "accepted order_id=" "$ADDR" t1 TIF SELL LIMIT:IOC 1005 2 3
 run_case "LIMIT:FOK accepted" "accepted order_id=" "$ADDR" t1 TIF BUY LIMIT:FOK 1005 2 3
 
+# Amend (priority-preserving qty reduction): rest, amend down, reject the
+# infeasible non-reduction.
+AMEND_OID=$("${CLIENT[@]}" "$ADDR" am AMD BUY LIMIT 1000 2 9 2>&1 \
+            | sed -n 's/.*order_id=\(OID-[0-9]*\).*/\1/p')
+run_case "amend down" "remaining=4" amend "$ADDR" am "$AMEND_OID" 4
+run_case "amend up rejected" "amend rejected" amend "$ADDR" am "$AMEND_OID" 50
+
 # Out-of-band DB assert (the reference pattern, scripted).
 sleep 0.5
 ORDERS=$(python -c "
@@ -135,11 +142,11 @@ import sqlite3
 c = sqlite3.connect('$DB')
 print(c.execute('SELECT COUNT(*) FROM fills').fetchone()[0])
 ")
-if [ "$ORDERS" -eq 10 ] && [ "$FILLS" -ge 3 ]; then
+if [ "$ORDERS" -eq 11 ] && [ "$FILLS" -ge 3 ]; then
   echo "PASS: DB has $ORDERS orders, $FILLS fills"
   PASS=$((PASS+1))
 else
-  echo "FAIL: DB has $ORDERS orders (want 10), $FILLS fills (want >=3)"
+  echo "FAIL: DB has $ORDERS orders (want 11), $FILLS fills (want >=3)"
   FAIL=$((FAIL+1))
 fi
 

@@ -1,0 +1,216 @@
+"""OP_AMEND: priority-preserving quantity reduction, device vs oracle.
+
+The venue "amend down" op — reduce a resting order's quantity in place,
+keeping its price and arrival seq (and therefore its spot in the
+price-time queue). Anything else (qty up, price move) is REJECTED: those
+re-price priority and belong to cancel+submit at the service layer. The
+reference has no amend surface at all (its only RPC family is
+SubmitOrder + stubs, /root/reference/proto/matching_engine.proto:29-35);
+this is an additive venue-parity extension like CancelOrder/RunAuction.
+"""
+
+import random
+
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import (
+    BUY,
+    LIMIT,
+    MARKET,
+    NEW,
+    OP_AMEND,
+    OP_CANCEL,
+    OP_SUBMIT,
+    REJECTED,
+    SELL,
+)
+from matching_engine_tpu.engine.oracle import OracleBook
+
+KERNELS = ["matrix", "sorted"]
+
+
+def run_both(cfg, host_orders):
+    """test_kernel_parity.run_both with OP_AMEND dispatch added."""
+    oracles = [OracleBook(capacity=cfg.capacity)
+               for _ in range(cfg.num_symbols)]
+    o_results, o_fills = [], []
+    for o in host_orders:
+        ob = oracles[o.sym]
+        if o.op == OP_SUBMIT:
+            r = ob.submit(o.oid, o.side, o.otype, o.price, o.qty)
+        elif o.op == OP_AMEND:
+            r = ob.amend(o.oid, o.qty)
+        else:
+            r = ob.cancel(o.oid)
+        o_results.append((o.oid, o.sym, r.status, r.filled, r.remaining))
+        o_fills.extend((o.sym, f.taker_oid, f.maker_oid, f.price_q4,
+                        f.quantity) for f in r.fills)
+
+    book = init_book(cfg)
+    book, d_results, d_fills = apply_orders(cfg, book, host_orders)
+    d_results = [(r.oid, r.sym, r.status, r.filled, r.remaining)
+                 for r in d_results]
+    d_fills = [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+               for f in d_fills]
+    d_snaps = snapshot_books(book)
+    o_snaps = [ob.snapshot() for ob in oracles]
+    return book, (d_results, d_fills, d_snaps), (o_results, o_fills, o_snaps)
+
+
+def assert_parity(cfg, host_orders):
+    book, (d_res, d_fills, d_snaps), (o_res, o_fills, o_snaps) = run_both(
+        cfg, host_orders)
+    assert sorted(d_res) == sorted(o_res)
+    for s in range(cfg.num_symbols):
+        dev = [f for f in d_fills if f[0] == s]
+        orc = [f for f in o_fills if f[0] == s]
+        assert dev == orc, f"fills sym {s}:\n dev={dev}\n orc={orc}"
+        assert d_snaps[s][0] == o_snaps[s][0], f"bid book sym {s}"
+        assert d_snaps[s][1] == o_snaps[s][1], f"ask book sym {s}"
+    if cfg.kernel == "sorted":
+        from tests.test_kernel_sorted import assert_sorted_invariant
+        assert_sorted_invariant(book)
+    return d_res
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_amend_reduces_and_keeps_priority(kernel):
+    """Two makers at one price; the first amends DOWN and must still fill
+    first (seq preserved) — the defining property of amend vs
+    cancel+resubmit."""
+    cfg = EngineConfig(num_symbols=1, capacity=8, batch=8, kernel=kernel)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 10, oid=1),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 10, oid=2),
+        HostOrder(0, OP_AMEND, SELL, qty=3, oid=1),
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT, 10_000, 5, oid=3),
+    ]
+    res = assert_parity(cfg, orders)
+    by_oid = {r[0]: r for r in res}
+    assert by_oid[1][2] == NEW and by_oid[1][4] == 3  # amend ack, rem 3
+    # Taker crossed maker 1 FIRST (3 units), then maker 2 (2 units).
+    _, (_, d_fills, _), _ = run_both(cfg, orders)
+    assert [(f[2], f[4]) for f in d_fills] == [(1, 3), (2, 2)]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_amend_rejections(kernel):
+    cfg = EngineConfig(num_symbols=1, capacity=8, batch=8, kernel=kernel)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 10, oid=1),
+        HostOrder(0, OP_AMEND, SELL, qty=10, oid=1),   # not a reduction
+        HostOrder(0, OP_AMEND, SELL, qty=15, oid=1),   # qty up
+        HostOrder(0, OP_AMEND, SELL, qty=0, oid=1),    # to zero
+        HostOrder(0, OP_AMEND, SELL, qty=5, oid=99),   # unknown oid
+    ]
+    res = assert_parity(cfg, orders)
+    statuses = [r[2] for r in sorted(res)][1:]
+    assert statuses == [REJECTED] * 4
+    # Wrong-side amend: device-only probe (the serving stack's host
+    # directory always supplies the true resting side; the oracle, like
+    # its cancel, is side-agnostic) — the device must REJECT and leave
+    # the book untouched.
+    book = init_book(cfg)
+    book, d_res, _ = apply_orders(cfg, book, orders + [
+        HostOrder(0, OP_AMEND, BUY, qty=5, oid=1)])
+    assert d_res[-1].status == REJECTED
+    bids, asks = snapshot_books(book)[0]
+    assert asks == [(1, 10_000, 10, 0)]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_amend_after_partial_fill_then_cancel(kernel):
+    cfg = EngineConfig(num_symbols=1, capacity=8, batch=8, kernel=kernel)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 10, oid=1),
+        HostOrder(0, OP_SUBMIT, BUY, LIMIT, 10_000, 4, oid=2),  # rem 6
+        HostOrder(0, OP_AMEND, SELL, qty=2, oid=1),             # 6 -> 2
+        HostOrder(0, OP_CANCEL, SELL, oid=1),                   # frees 2
+    ]
+    res = assert_parity(cfg, orders)
+    by_oid = {r[0]: r for r in res}
+    assert by_oid[1][4] == 2  # the cancel released the amended remainder
+
+
+def test_amend_then_cancel_same_dispatch_attribution():
+    """Two ops on ONE order in ONE dispatch batch: the runner's per-handle
+    FIFO must attribute the device's two result rows to the right ops —
+    amend acks with the reduced remaining, the cancel then releases it
+    (regression: a plain handle->op dict returned 'order not open' to the
+    cancel and no outcome at all to the amend)."""
+    from matching_engine_tpu.server.engine_runner import (
+        EngineOp,
+        EngineRunner,
+        OrderInfo,
+    )
+    from matching_engine_tpu.engine.kernel import (
+        CANCELED as K_CANCELED,
+        OP_AMEND as K_AMEND,
+        OP_CANCEL as K_CANCEL,
+    )
+
+    cfg = EngineConfig(num_symbols=2, capacity=8, batch=4, max_fills=256)
+    r = EngineRunner(cfg)
+    assert r.slot_acquire("AMC") is not None
+    num, oid = r.assign_oid()
+    info = OrderInfo(oid=num, order_id=oid, client_id="c", symbol="AMC",
+                     side=BUY, otype=0, price_q4=10_000, quantity=9,
+                     remaining=9, status=0, handle=r.assign_handle())
+    out = r.run_dispatch([EngineOp(OP_SUBMIT, info)])
+    assert out.outcomes[0].status == NEW
+
+    res = r.run_dispatch([
+        EngineOp(K_AMEND, info, amend_qty=4),
+        EngineOp(K_CANCEL, info, cancel_requester="c"),
+    ])
+    by_op = {o.op.op: o for o in res.outcomes}
+    assert by_op[K_AMEND].status == NEW
+    assert by_op[K_AMEND].remaining == 4
+    assert by_op[K_CANCEL].status == K_CANCELED
+    assert by_op[K_CANCEL].remaining == 4  # released the amended size
+    # The storage stream carries the amend 4-tuple BEFORE the cancel
+    # update, and a replaying store must end CANCELED (order-preserving
+    # update application).
+    lens = [len(u) for u in res.storage_updates]
+    assert lens == [4, 3]
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_amend_fuzz_parity(kernel, seed):
+    """Random submits/cancels/amends; amends target live and dead oids
+    with quantities spanning reduce/equal/increase."""
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=8, kernel=kernel)
+    rng = random.Random(seed)
+    orders = []
+    live: list[dict[int, int]] = [dict() for _ in range(4)]
+    oid = 0
+    for _ in range(240):
+        sym = rng.randrange(4)
+        roll = rng.random()
+        if live[sym] and roll < 0.15:
+            target = rng.choice(list(live[sym]))
+            side = live[sym].pop(target)
+            orders.append(HostOrder(sym, OP_CANCEL, side, oid=target))
+        elif live[sym] and roll < 0.40:
+            target = rng.choice(list(live[sym]))
+            side = live[sym][target]
+            orders.append(HostOrder(
+                sym, OP_AMEND, side, qty=rng.randrange(0, 25), oid=target))
+        else:
+            oid += 1
+            side = rng.choice((BUY, SELL))
+            market = rng.random() < 0.15
+            price = 0 if market else 10_000 + 100 * rng.randrange(6)
+            orders.append(HostOrder(
+                sym, OP_SUBMIT, side, MARKET if market else LIMIT,
+                price, rng.randrange(1, 20), oid=oid))
+            if not market:
+                live[sym][oid] = side
+    assert_parity(cfg, orders)

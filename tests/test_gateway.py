@@ -145,6 +145,57 @@ def test_tif_through_both_edges(hs):
     assert not st.fills_for_order(r2.order_id)
 
 
+def test_amend_through_both_edges(hs):
+    """AmendOrder (priority-preserving qty reduction) via the native
+    gateway's C++ route AND the grpcio edge: success updates quantity and
+    remaining together in the store; infeasible/foreign amends reject
+    with identical messages on both edges."""
+    r = submit(hs.stub, client="am", symbol="AMD", side=pb2.BUY,
+               price=40000, qty=10)
+    assert r.success
+    ok = hs.stub.AmendOrder(pb2.AmendRequest(
+        client_id="am", order_id=r.order_id, new_quantity=6), timeout=10)
+    assert ok.success and ok.remaining_quantity == 6
+    # qty up / not-a-reduction / foreign client / unknown id — identical
+    # app-level rejects on both edges.
+    cases = [
+        (dict(client_id="am", order_id=r.order_id, new_quantity=6),
+         "amend rejected (must strictly reduce an open order's quantity)"),
+        (dict(client_id="am", order_id=r.order_id, new_quantity=99),
+         "amend rejected (must strictly reduce an open order's quantity)"),
+        (dict(client_id="other", order_id=r.order_id, new_quantity=3),
+         "order belongs to a different client"),
+        (dict(client_id="am", order_id="OID-424242", new_quantity=3),
+         "unknown order id"),
+        (dict(client_id="am", order_id=r.order_id, new_quantity=0),
+         "new_quantity must be positive"),
+        (dict(client_id="", order_id=r.order_id, new_quantity=3),
+         "client_id is required"),
+    ]
+    for kw, want in cases:
+        via_gw = hs.stub.AmendOrder(pb2.AmendRequest(**kw), timeout=10)
+        via_py = hs.py_stub.AmendOrder(pb2.AmendRequest(**kw), timeout=10)
+        assert not via_gw.success and not via_py.success, kw
+        assert via_gw.error_message == want, (kw, via_gw.error_message)
+        assert via_py.error_message == want, (kw, via_py.error_message)
+    # A second reduction through the OTHER edge; then the store shows
+    # quantity moving with remaining (filled == quantity - remaining).
+    ok2 = hs.py_stub.AmendOrder(pb2.AmendRequest(
+        client_id="am", order_id=r.order_id, new_quantity=2), timeout=10)
+    assert ok2.success and ok2.remaining_quantity == 2
+    hs.flush()
+    st = Storage(hs.db_path)
+    row = st.get_order(r.order_id)
+    assert row[6] == 2 and row[7] == 2  # quantity == remaining == 2
+    # Amended order still fills at its original time priority.
+    r2 = submit(hs.stub, client="tk", symbol="AMD", side=pb2.SELL,
+                price=40000, qty=2)
+    assert r2.success
+    hs.flush()
+    st = Storage(hs.db_path)
+    assert st.get_order(r.order_id)[8] == 2  # FILLED
+
+
 def test_cross_edge_visibility(hs):
     """An order submitted on the grpcio edge matches one from the native
     edge — both edges drive the same books."""
