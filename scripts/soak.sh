@@ -79,11 +79,18 @@ try: print(json.loads(sys.stdin.read())['ok'])
 except Exception: print(0)")
     OK_TOTAL=$((OK_TOTAL + OK))
   done
-  # Cancel traffic: rest far from the market, then cancel.
-  OID=$("$CLI" "$GW" soak-c SOAK BUY LIMIT 10000 4 1 2>/dev/null \
+  # Amend + cancel traffic: rest far from the market, amend the quantity
+  # down (priority-preserving), then cancel the amended remainder.
+  OID=$("$CLI" "$GW" soak-c SOAK BUY LIMIT 10000 4 5 2>/dev/null \
         | sed -n 's/.*order_id=\(OID-[0-9]*\).*/\1/p')
-  if [ -n "$OID" ] && "$CLI" cancel "$GW" soak-c "$OID" >/dev/null 2>&1; then
-    CANCELS=$((CANCELS + 1))
+  if [ -n "$OID" ]; then
+    if "$CLI" amend "$GW" soak-c "$OID" 2 2>/dev/null \
+        | grep -q "remaining=2"; then
+      AMENDS=$((AMENDS + 1))
+    fi
+    if "$CLI" cancel "$GW" soak-c "$OID" >/dev/null 2>&1; then
+      CANCELS=$((CANCELS + 1))
+    fi
   fi
   # Auction quiesce under load (usually a no-op clear; exercises the
   # dispatch-lock/pending/checkpoint interplay concurrently with traffic).
