@@ -2,11 +2,18 @@
 edge or load generator (VERDICT r3 next-step 2: separate the serving
 stack's own ceiling from tunnel RTT and loadgen artifacts).
 
-Drives EngineRunner.dispatch_pipelined directly with pre-built EngineOp
-batches at a serving-like shape (sparse dispatches, small batches), sweeping
-the pipeline_inflight depth. Per sweep point it reports sustained orders/s
-plus per-batch turnaround p50/p99 (stage -> finish callback), which is the
-client-felt latency floor of the whole serving stack minus transport.
+Both serving paths start from pre-packed gateway-ring record batches (the
+MeGwOp wire every edge pops) at a serving-like shape, sweeping dispatch
+size x pipeline_inflight. --mode python charges the timed loop with the
+per-op Python serving work (record decode, slot/oid/handle assignment,
+EngineOp construction — what gateway_bridge._drain_batch does) before
+dispatch_pipelined; --mode native hands the raw records to the C++ lane
+engine (server/native_lanes.py). Per sweep point it reports sustained
+orders/s plus per-batch turnaround p50/p99 (stage -> finish callback),
+the client-felt latency floor of the whole serving stack minus transport.
+--host-only additionally removes device compute from the timed region
+(record/replay), isolating the host ceiling the serving numbers are
+bounded by.
 
 The serving-ceiling model this measures (docs/BENCH_METHOD.md):
   orders/s  ~=  batch_ops / max(host_batch_cost, sync_cost / inflight)
@@ -42,6 +49,27 @@ def main() -> None:
                         "is a function of dispatch size, not just depth")
     p.add_argument("--n-batches", type=int, default=60)
     p.add_argument("--inflight", default="1,2,4,8")
+    p.add_argument("--mode", default="python",
+                   help="comma list of serving paths to sweep: 'python' "
+                        "(per-op EngineOp staging/decode — the r5 path) "
+                        "and/or 'native' (C++ lane build + completion "
+                        "decode via server/native_lanes.py; needs the "
+                        "built libme_native.so). Records are pre-packed "
+                        "outside the timed loop, mirroring the gateway "
+                        "edge where C++ fills the ring")
+    p.add_argument("--kernel", choices=("matrix", "sorted"), default="matrix")
+    p.add_argument("--host-only", action="store_true",
+                   help="isolate the serving stack's HOST work (lane "
+                        "build, id/slot assignment, status decode, "
+                        "completion + storage row construction): run each "
+                        "sweep point twice with an identical op stream — "
+                        "an untimed pass records every device step's "
+                        "outputs, the timed pass replays them through a "
+                        "stubbed step. On a CPU backend the real step "
+                        "dominates both paths and hides the host ceiling "
+                        "this repo's serving numbers are bounded by; this "
+                        "mode is how the native-vs-python host ratio is "
+                        "measured off-TPU (docs/BENCH_METHOD.md)")
     p.add_argument("--json-out", required=True)
     args = p.parse_args()
 
@@ -73,34 +101,110 @@ def main() -> None:
     )
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
-                       batch=args.batch, max_fills=1 << 15)
+                       batch=args.batch, max_fills=1 << 15,
+                       kernel=args.kernel)
 
-    def build_batches(runner: EngineRunner, seed: int,
-                      n_batches: int, batch_ops: int) -> list[list[EngineOp]]:
+    def records_to_ops(runner: EngineRunner, recs, n: int) -> list[EngineOp]:
+        """The per-op Python serving work this bench charges the python
+        path with — a faithful transcription of what the serving edges do
+        per popped ring record (gateway_bridge._drain_batch / the
+        SubmitOrder tail): field decode, slot/oid/handle assignment,
+        OrderInfo+EngineOp construction. Runs INSIDE the timed loop; the
+        native path does the equivalent inside lanes.build()."""
+        ops = []
+        for i in range(n):
+            rec = recs[i]
+            sym = bytes(rec.symbol[:rec.symbol_len]).decode()
+            cid = bytes(rec.client_id[:rec.client_id_len]).decode()
+            slot = runner.slot_acquire(sym)  # not inside assert: -O strips
+            assert slot is not None
+            num, oid = runner.assign_oid()
+            qty = rec.quantity
+            ops.append(EngineOp(OP_SUBMIT, OrderInfo(
+                oid=num, order_id=oid, client_id=cid, symbol=sym,
+                side=rec.side, otype=rec.otype, price_q4=rec.price_q4,
+                quantity=qty, remaining=qty, status=0,
+                handle=runner.assign_handle())))
+        return ops
+
+    def build_record_batches(seed: int, n_batches: int,
+                             batch_ops: int) -> list:
+        """The native twin of build_batches: the same rng stream packed as
+        (MeGwOp * n) arrays — the gateway-ring wire the lane engine pops.
+        oid/handle/slot assignment happens INSIDE the timed dispatch (it
+        moved native); packing is the edge's work (C++ on the gateway
+        path) and stays outside the loop, like build_batches' EngineOp
+        construction."""
+        from matching_engine_tpu.server.native_lanes import pack_record_batch
+
         rng = random.Random(seed)
         batches = []
+        tag = 1
         for _ in range(n_batches):
-            ops = []
+            recs = []
             for _ in range(batch_ops):
                 sym = f"S{rng.randrange(args.symbols)}"
-                assert runner.slot_acquire(sym) is not None
-                num, oid = runner.assign_oid()
                 side = BUY if rng.random() < 0.5 else SELL
                 price = 10_000 + rng.randrange(-20, 21)
                 qty = rng.randrange(1, 50)
-                ops.append(EngineOp(OP_SUBMIT, OrderInfo(
-                    oid=num, order_id=oid, client_id=f"c{num % 97}",
-                    symbol=sym, side=side, otype=0, price_q4=price,
-                    quantity=qty, remaining=qty, status=0,
-                    handle=runner.assign_handle())))
-            batches.append(ops)
+                recs.append((tag, 1, side, 0, price, qty, sym,
+                             f"c{tag % 97}", ""))
+                tag += 1
+            batches.append(pack_record_batch(recs))
         return batches
 
-    def sweep_point(inflight: int, batch_ops: int) -> dict:
-        runner = EngineRunner(cfg, pipeline_inflight=inflight)
-        batches = build_batches(runner, seed=inflight,
-                                n_batches=args.n_batches,
-                                batch_ops=batch_ops)
+    import contextlib
+    from collections import deque
+
+    @contextlib.contextmanager
+    def patched_steps(sparse_fn, packed_fn):
+        """Swap the engine step at every site the serving runners call it
+        through: the sparse/kernel modules (imported per call inside the
+        hot paths) and engine_runner's import-time binding."""
+        import matching_engine_tpu.engine.kernel as kmod
+        import matching_engine_tpu.engine.sparse as smod
+        import matching_engine_tpu.server.engine_runner as rmod
+
+        saved = (smod.engine_step_sparse, kmod.engine_step_packed,
+                 rmod.engine_step_packed)
+        smod.engine_step_sparse = sparse_fn
+        kmod.engine_step_packed = packed_fn
+        rmod.engine_step_packed = packed_fn
+        try:
+            yield
+        finally:
+            (smod.engine_step_sparse, kmod.engine_step_packed,
+             rmod.engine_step_packed) = saved
+
+    def make_point(mode: str, inflight: int, batch_ops: int):
+        """Fresh (runner, batches, dispatch) triple for one measured pass —
+        host-only mode runs this twice with an identical op stream. Both
+        runners get a subscriber-less StreamHub (the common serving case:
+        stream protos are gated off, exactly as build_server wires it —
+        hub=None would force per-op proto materialization neither path
+        pays in production)."""
+        from matching_engine_tpu.server.streams import StreamHub
+
+        hub = StreamHub()
+        batches = build_record_batches(seed=inflight,
+                                       n_batches=args.n_batches,
+                                       batch_ops=batch_ops)
+        if mode == "native":
+            from matching_engine_tpu.server.native_lanes import (
+                NativeLanesRunner,
+            )
+
+            runner = NativeLanesRunner(cfg, hub=hub,
+                                       pipeline_inflight=inflight)
+            dispatch = lambda b, cb: runner.dispatch_records(b[0], b[1], cb)  # noqa: E731
+        else:
+            runner = EngineRunner(cfg, hub=hub, pipeline_inflight=inflight)
+
+            def dispatch(b, cb, _r=runner):
+                _r.dispatch_pipelined(records_to_ops(_r, b[0], b[1]), cb)
+        return runner, batches, dispatch
+
+    def sweep_point(mode: str, inflight: int, batch_ops: int) -> dict:
         lat: list[float] = []
         done = [0]
 
@@ -112,22 +216,63 @@ def main() -> None:
                 return None
             return on_finish
 
-        # Warm pass (compile both sparse bucket shapes this flow uses).
-        warm = build_batches(runner, seed=999, n_batches=3,
-                             batch_ops=batch_ops)
-        for b in warm:
-            runner.dispatch_pipelined(b, lambda r, e: None)
-        runner.finish_pending()
+        ctx = contextlib.nullcontext()
+        if args.host_only:
+            # Record pass: the REAL pipeline over the same stream a fresh
+            # runner will see, keeping every device step's (book, out) in
+            # call order. Decode never reads the book and lane build never
+            # reads device state, so replaying `out` through a stubbed
+            # step leaves all host work bit-identical while the timed
+            # region contains no device compute.
+            from matching_engine_tpu.engine.kernel import (
+                engine_step_packed as real_packed,
+            )
+            from matching_engine_tpu.engine.sparse import (
+                engine_step_sparse as real_sparse,
+            )
 
-        t_begin = time.perf_counter()
-        for b in batches:
-            runner.dispatch_pipelined(b, make_cb(time.perf_counter()))
-        runner.finish_pending()
-        dt = time.perf_counter() - t_begin
+            outs: deque = deque()
+
+            def rec_sparse(c, book, sp):
+                book, out = real_sparse(c, book, sp)
+                outs.append(out)
+                return book, out
+
+            def rec_packed(c, book, arr):
+                book, out = real_packed(c, book, arr)
+                outs.append(out)
+                return book, out
+
+            runner, batches, dispatch = make_point(mode, inflight, batch_ops)
+            with patched_steps(rec_sparse, rec_packed):
+                for b in batches:
+                    dispatch(b, lambda r, e: None)
+                runner.finish_pending()
+            ctx = patched_steps(lambda c, book, sp: (book, outs.popleft()),
+                                lambda c, book, arr: (book, outs.popleft()))
+
+        runner, batches, dispatch = make_point(mode, inflight, batch_ops)
+        with ctx:
+            if not args.host_only:
+                # Warm pass (compile both sparse bucket shapes this flow
+                # uses). Host-only replays need no warmup — and would
+                # desync the recorded output queue.
+                warm = build_record_batches(seed=999, n_batches=3,
+                                            batch_ops=batch_ops)
+                for b in warm:
+                    dispatch(b, lambda r, e: None)
+                runner.finish_pending()
+
+            t_begin = time.perf_counter()
+            for b in batches:
+                dispatch(b, make_cb(time.perf_counter()))
+            runner.finish_pending()
+            dt = time.perf_counter() - t_begin
         assert done[0] == len(batches)
         lats = np.array(sorted(lat))
-        n_ops = sum(len(b) for b in batches)
+        n_ops = args.n_batches * batch_ops
         return {
+            "mode": mode + ("-host" if args.host_only else ""),
             "inflight": inflight,
             "orders_per_s": round(n_ops / dt, 1),
             "batch_ops": batch_ops,
@@ -138,7 +283,8 @@ def main() -> None:
         }
 
     grid_cap = args.symbols * args.batch
-    rows = [sweep_point(int(k), min(int(bo), grid_cap))
+    rows = [sweep_point(mode.strip(), int(k), min(int(bo), grid_cap))
+            for mode in args.mode.split(",")
             for bo in str(args.batch_ops).split(",")
             for k in args.inflight.split(",")]
 
@@ -157,6 +303,7 @@ def main() -> None:
         "symbols": args.symbols,
         "capacity": args.capacity,
         "batch": args.batch,
+        "kernel": args.kernel,
         "backend_init_s": round(backend_init_s, 1),
         "sweep": rows,
         "git_rev": rev,

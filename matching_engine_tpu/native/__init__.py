@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import struct
 import subprocess
 import threading
@@ -57,24 +58,49 @@ class MeOp(ctypes.Structure):
     ]
 
 
+_SRCS = [_SRC, os.path.join(_SRC_DIR, "me_lanes.cpp"),
+         os.path.join(_SRC_DIR, "me_gwop.h")]
+
+
 def ensure_built(force: bool = False) -> bool:
-    """Build libme_native.so if missing or stale. Returns availability."""
+    """Build the native layer if missing or stale. Returns availability
+    of libme_native.so (the lane/ring/sink layer).
+
+    The full make (gateway library + CLI client) runs only when protoc is
+    on PATH — it needs the generated pb. Without protoc only the
+    protobuf-free `native-lib` target builds, and a full-make failure
+    falls back to it so a broken protobuf toolchain can never block the
+    lane/ring/sink layer (scripts/build_native.sh is the explicit rebuild
+    entry point)."""
+    have_protoc = shutil.which("protoc") is not None
     if os.path.exists(_LIB_PATH) and not force:
-        if not os.path.exists(_SRC) or (
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
-        ):
+        srcs = [s for s in _SRCS if os.path.exists(s)]
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        # Gateway staleness rides the same check — but only when a
+        # rebuild could actually freshen it (protoc present); otherwise a
+        # stale gateway lib would spawn a futile make on every load.
+        gw_src = os.path.join(_SRC_DIR, "me_gateway.cpp")
+        if (have_protoc and os.path.exists(_GW_LIB_PATH)
+                and os.path.exists(gw_src)):
+            srcs = srcs + [gw_src]
+            lib_mtime = min(lib_mtime, os.path.getmtime(_GW_LIB_PATH))
+        if not srcs or all(lib_mtime >= os.path.getmtime(s) for s in srcs):
             return True
     if not os.path.exists(_SRC):
         return os.path.exists(_LIB_PATH)
-    try:
-        subprocess.run(
-            ["make", "-s"], cwd=_SRC_DIR, check=True, capture_output=True
-        )
-        return True
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        out = getattr(e, "stderr", b"") or b""
-        print(f"[native] build failed: {out.decode(errors='replace')[-500:]}")
-        return os.path.exists(_LIB_PATH)
+    targets = ["all", "native-lib"] if have_protoc else ["native-lib"]
+    for target in targets:
+        try:
+            subprocess.run(
+                ["make", "-s", target], cwd=_SRC_DIR, check=True,
+                capture_output=True,
+            )
+            return True
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            out = getattr(e, "stderr", b"") or b""
+            print(f"[native] build ({target}) failed: "
+                  f"{out.decode(errors='replace')[-500:]}")
+    return os.path.exists(_LIB_PATH)
 
 
 def _load():
@@ -117,6 +143,7 @@ def _load():
         lib.me_ring_size.argtypes = [ctypes.c_void_p]
         lib.me_ring_size.restype = ctypes.c_uint64
 
+        _bind_lanes(lib)
         lib.me_sink_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
         lib.me_sink_open.restype = ctypes.c_void_p
         lib.me_sink_submit.argtypes = [
@@ -431,6 +458,30 @@ class NativeGateway:
                             r.quantity, None, None, None))
         return out
 
+    def pop_batch_raw(self, max_ops: int, window_us: int,
+                      first_wait_us: int = -1):
+        """pop_batch WITHOUT per-record Python decode: returns
+        (records_array, n) for the native lane path (the array is reused
+        across pops — single consumer), n == 0 on first-wait timeout,
+        (None, 0) when shut down."""
+        if self._h is None:
+            return None, 0
+        buf = self._buf
+        if buf is None or len(buf) < max_ops:
+            buf = self._buf = (MeGwOp * max_ops)()
+        n = self._lib.me_gw_pop_batch_timed(self._h, buf, max_ops,
+                                            window_us, first_wait_us)
+        if n < 0:
+            return None, 0
+        return buf, n
+
+    def complete_batch_raw(self, buf: bytes) -> None:
+        """complete_batch for an ALREADY-PACKED completion buffer (the
+        lane engine's comp_buf is emitted in this wire format)."""
+        if self._h is None or len(buf) <= 4:
+            return
+        self._lib.me_gateway_complete_batch(self._h, buf, len(buf))
+
     def complete_submit(self, tag: int, success: bool, order_id: str,
                         error: str = "") -> None:
         if self._h is None:
@@ -593,6 +644,21 @@ class NativeStorageSink:
             self.dropped += 1
         return ok
 
+    def submit_packed(self, buf: bytes, block: bool = True) -> bool:
+        """Submit an ALREADY-PACKED MeSink batch (the lane engine's
+        store_buf is emitted in this wire format — zero Python tuples on
+        the native serving path)."""
+        if self._h is None:
+            return False
+        if len(buf) <= 12:
+            return True
+        ok = bool(self._lib.me_sink_submit(
+            self._h, buf, len(buf), 1 if block else 0
+        ))
+        if not ok:
+            self.dropped += 1
+        return ok
+
     def flush(self) -> None:
         if self._h is not None:
             self._lib.me_sink_flush(self._h)
@@ -611,3 +677,490 @@ class NativeStorageSink:
         if self._h:
             self._lib.me_sink_close(self._h)
             self._h = None
+
+
+# -- lane engine (native/me_lanes.cpp) --------------------------------------
+#
+# The native serving fast path: lane build + completion decode in C++,
+# leaving Python control-plane work per DISPATCH. The Python twin is
+# gateway_bridge._drain_batch + engine_runner._stage_locked/_decode_batch/
+# _evict_terminal; tests/test_native_lanes.py enforces bit-parity.
+
+def _bind_lanes(lib) -> None:
+    P = ctypes.POINTER
+    i32p, i64p, u8p = P(ctypes.c_int32), P(ctypes.c_longlong), P(ctypes.c_uint8)
+    lib.me_lanes_create.argtypes = [ctypes.c_int32] * 4
+    lib.me_lanes_create.restype = ctypes.c_void_p
+    lib.me_lanes_destroy.argtypes = [ctypes.c_void_p]
+    lib.me_lanes_build.argtypes = [
+        ctypes.c_void_p, P(MeGwOp), ctypes.c_uint32, ctypes.c_int,
+        ctypes.c_int, i32p, i32p, i32p, ctypes.c_uint32,
+    ]
+    lib.me_lanes_build.restype = ctypes.c_int
+    lib.me_lanes_wave.argtypes = [ctypes.c_void_p, ctypes.c_uint32, i32p]
+    lib.me_lanes_wave.restype = ctypes.c_int
+    lib.me_lanes_decode_wave.argtypes = [
+        ctypes.c_void_p, i32p, ctypes.c_longlong, i32p, ctypes.c_longlong,
+    ]
+    lib.me_lanes_decode_wave.restype = ctypes.c_longlong
+    lib.me_lanes_finish.argtypes = [ctypes.c_void_p, i64p, i64p, i64p]
+    lib.me_lanes_finish.restype = ctypes.c_int
+    lib.me_lanes_take.argtypes = [ctypes.c_void_p, u8p, u8p, u8p]
+    lib.me_lanes_take.restype = ctypes.c_int
+    lib.me_lanes_abort.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.me_lanes_abort.restype = ctypes.c_int
+    lib.me_lanes_get_order.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, i32p, i64p,
+        ctypes.c_char_p, i32p, ctypes.c_char_p, i32p,
+    ]
+    lib.me_lanes_get_order.restype = ctypes.c_int
+    lib.me_lanes_lookup.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+    ]
+    lib.me_lanes_lookup.restype = ctypes.c_int32
+    lib.me_lanes_adjust.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_longlong, ctypes.c_int32,
+    ]
+    lib.me_lanes_adjust.restype = ctypes.c_int
+    lib.me_lanes_evict.argtypes = [ctypes.c_void_p, ctypes.c_int32, i32p]
+    lib.me_lanes_evict.restype = ctypes.c_int
+    lib.me_lanes_set_auction_mode.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.me_lanes_adopt.argtypes = [ctypes.c_void_p, u8p, ctypes.c_longlong]
+    lib.me_lanes_adopt.restype = ctypes.c_int
+    lib.me_lanes_dump_slots.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_longlong,
+    ]
+    lib.me_lanes_dump_slots.restype = ctypes.c_longlong
+    lib.me_lanes_dump_state.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_longlong,
+    ]
+    lib.me_lanes_dump_state.restype = ctypes.c_longlong
+    lib.me_lanes_stats.argtypes = [ctypes.c_void_p, i64p, i64p, i64p]
+
+    lib.me_gwring_create.argtypes = [ctypes.c_uint32]
+    lib.me_gwring_create.restype = ctypes.c_void_p
+    lib.me_gwring_destroy.argtypes = [ctypes.c_void_p]
+    lib.me_gwring_push.argtypes = [ctypes.c_void_p, P(MeGwOp)]
+    lib.me_gwring_push.restype = ctypes.c_int
+    lib.me_gwring_pop_batch.argtypes = [
+        ctypes.c_void_p, P(MeGwOp), ctypes.c_uint32, ctypes.c_uint64,
+        ctypes.c_int64,
+    ]
+    lib.me_gwring_pop_batch.restype = ctypes.c_int
+    lib.me_gwring_close.argtypes = [ctypes.c_void_p]
+    lib.me_gwring_dropped.argtypes = [ctypes.c_void_p]
+    lib.me_gwring_dropped.restype = ctypes.c_uint64
+
+
+def pack_gwop(rec: MeGwOp, tag: int, op: int, side: int = 0, otype: int = 0,
+              price_q4: int = 0, quantity: int = 0, symbol: bytes = b"",
+              client_id: bytes = b"", order_id: bytes = b"") -> MeGwOp:
+    """Fill one MeGwOp record in place (the ring/lane wire record)."""
+    rec.tag = tag
+    rec.op = op
+    rec.side = side
+    rec.otype = otype
+    rec.price_q4 = price_q4
+    rec.quantity = quantity
+    rec.symbol_len = len(symbol)
+    rec.client_id_len = len(client_id)
+    rec.order_id_len = len(order_id)
+    rec.symbol = symbol
+    rec.client_id = client_id
+    rec.order_id = order_id
+    return rec
+
+
+class _Rd:
+    """Cursor over the little-endian length-prefixed aux/state wire."""
+
+    __slots__ = ("b", "o")
+
+    def __init__(self, b: bytes):
+        self.b = b
+        self.o = 0
+
+    def u8(self) -> int:
+        v = self.b[self.o]
+        self.o += 1
+        return v
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self.b, self.o)
+        self.o += 4
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from("<i", self.b, self.o)
+        self.o += 4
+        return v
+
+    def u64(self) -> int:
+        (v,) = struct.unpack_from("<Q", self.b, self.o)
+        self.o += 8
+        return v
+
+    def i64(self) -> int:
+        (v,) = struct.unpack_from("<q", self.b, self.o)
+        self.o += 8
+        return v
+
+    def s(self) -> bytes:
+        (n,) = struct.unpack_from("<H", self.b, self.o)
+        self.o += 2
+        v = self.b[self.o:self.o + n]
+        self.o += n
+        return v
+
+
+LANE_COUNTER_NAMES = (
+    "engine_ops", "accepted", "rejected", "canceled", "amended",
+    "fill_count", "overflow_waves", "shape", "n_lanes", "n_waves",
+    "owner_overflow", "owner_collisions", "n_recon",
+)
+
+
+def parse_comp_buf(buf: bytes) -> list[tuple[int, int, bool, str, str]]:
+    """comp_buf records as (tag, kind, ok, order_id, error) — the
+    me_gateway_complete_batch wire format (strings losslessly decoded;
+    they were validated UTF-8 on the way in)."""
+    r = _Rd(buf)
+    out = []
+    for _ in range(r.u32()):
+        tag = r.u64()
+        kind = r.u8()
+        ok = r.u8() != 0
+        oid = r.s().decode()
+        err = r.s().decode()
+        out.append((tag, kind, ok, oid, err))
+    return out
+
+
+def parse_lane_aux(buf: bytes) -> dict:
+    """The per-dispatch aux buffer assembled by MeLanes::finish."""
+    r = _Rd(buf)
+    n_counters = r.u32()
+    counters = {}
+    for i in range(n_counters):
+        v = r.i64()
+        if i < len(LANE_COUNTER_NAMES):
+            counters[LANE_COUNTER_NAMES[i]] = v
+    out = {"counters": counters}
+    out["slot_allocs"] = [(r.i32(), r.s().decode()) for _ in range(r.u32())]
+    out["slot_releases"] = [r.i32() for _ in range(r.u32())]
+    out["new_owners"] = [(r.s().decode(), r.i32()) for _ in range(r.u32())]
+    out["recon"] = [(r.s().decode(), r.i64()) for _ in range(r.u32())]
+    out["market_data"] = [
+        (r.i32(), r.i32(), r.i32(), r.i32(), r.i32()) for _ in range(r.u32())
+    ]  # (slot, best_bid, bid_size, best_ask, ask_size)
+    out["amends"] = [
+        (r.u64(), r.u8() != 0, r.i64(), r.s().decode(), r.s().decode())
+        for _ in range(r.u32())
+    ]  # (tag, ok, remaining, order_id, error)
+    out["local"] = [
+        (r.u64(), r.u8(), r.u8() != 0, r.i64(), r.s().decode(),
+         r.s().decode())
+        for _ in range(r.u32())
+    ]  # (tag, kind, ok, remaining, order_id, error)
+    out["order_updates"] = [
+        (r.i32(), r.i64(), r.i64(), r.i64(), r.s().decode(),
+         r.s().decode(), r.s().decode())
+        for _ in range(r.u32())
+    ]  # (status, fill_price, fill_qty, remaining, order_id, client_id, sym)
+    return out
+
+
+def unpack_store_buf(buf: bytes):
+    """store_buf -> the (orders, updates, fills) triple pack_batch packs —
+    the Python-sink fallback and the storage-row parity check."""
+    from matching_engine_tpu.storage.storage import FillRow
+
+    r = _Rd(buf)
+    orders = []
+    for _ in range(r.u32()):
+        oid, cid, sym = r.s().decode(), r.s().decode(), r.s().decode()
+        side, otype, has_price = r.u8(), r.u8(), r.u8()
+        price, qty, remaining = r.i64(), r.i64(), r.i64()
+        status = r.u8()
+        orders.append((oid, cid, sym, side, otype,
+                       price if has_price else None, qty, remaining, status))
+    updates = []
+    for _ in range(r.u32()):
+        oid = r.s().decode()
+        status, remaining, has_qty, qty = r.u8(), r.i64(), r.u8(), r.i64()
+        updates.append((oid, status, remaining, qty) if has_qty
+                       else (oid, status, remaining))
+    fills = []
+    for _ in range(r.u32()):
+        oid, coid = r.s().decode(), r.s().decode()
+        price, qty, ts = r.i64(), r.i64(), r.i64()
+        fills.append(FillRow(oid, coid, price, qty, ts))
+    return orders, updates, fills
+
+
+def pack_lane_state(
+    *, next_oid: int, next_handle: int, free_handles, next_slot: int,
+    free_slots, symbols, owners, orders, auction_mode: bool,
+) -> bytes:
+    """The adopt()/dump_state() blob (version 1).
+
+    symbols: [(slot, live, symbol_str)]; owners: [(client_id, owner)];
+    orders: [(handle, oid_num, client_id, symbol, side, otype, price_q4,
+    quantity, remaining, status)]. Free lists keep their LIFO stack order —
+    future handle/slot assignment depends on it."""
+    out = bytearray(struct.pack("<IqI", 1, next_oid, next_handle & 0xFFFFFFFF))
+    out += struct.pack("<I", len(free_handles))
+    for h in free_handles:
+        out += struct.pack("<i", h)
+    out += struct.pack("<iI", next_slot, len(free_slots))
+    for s in free_slots:
+        out += struct.pack("<i", s)
+    out += struct.pack("<I", len(symbols))
+    for slot, live, sym in symbols:
+        out += struct.pack("<iq", slot, live)
+        _pack_str(out, sym)
+    out += struct.pack("<I", len(owners))
+    for cid, owner in owners:
+        _pack_str(out, cid)
+        out += struct.pack("<i", owner)
+    out += struct.pack("<I", len(orders))
+    for (handle, oid, cid, sym, side, otype, price, qty, rem, st) in orders:
+        out += struct.pack("<iq", handle, oid)
+        _pack_str(out, cid)
+        _pack_str(out, sym)
+        out += struct.pack("<iiiqqi", side, otype, price, qty, rem, st)
+    out += struct.pack("<i", 1 if auction_mode else 0)
+    return bytes(out)
+
+
+def parse_lane_state(buf: bytes) -> dict:
+    """Inverse of pack_lane_state (reads dump_state output)."""
+    r = _Rd(buf)
+    version = r.u32()
+    if version != 1:
+        raise ValueError(f"lane state blob version {version}")
+    out = {"next_oid": r.i64(), "next_handle": r.i32()}
+    out["free_handles"] = [r.i32() for _ in range(r.u32())]
+    out["next_slot"] = r.i32()
+    out["free_slots"] = [r.i32() for _ in range(r.u32())]
+    out["symbols"] = [
+        (r.i32(), r.i64(), r.s().decode()) for _ in range(r.u32())
+    ]
+    out["owners"] = [(r.s().decode(), r.i32()) for _ in range(r.u32())]
+    out["orders"] = [
+        (r.i32(), r.i64(), r.s().decode(), r.s().decode(), r.i32(),
+         r.i32(), r.i32(), r.i64(), r.i64(), r.i32())
+        for _ in range(r.u32())
+    ]
+    out["auction_mode"] = r.i32() != 0
+    return out
+
+
+class NativeLanes:
+    """ctypes driver of the C++ lane engine (one per EngineRunner).
+
+    Protocol per dispatch (caller holds the runner's dispatch lock):
+    build() -> wave() x n_waves (device_put + step each) -> decode_wave()
+    per readback (FIFO over staged dispatches) -> finish() -> take().
+    """
+
+    def __init__(self, num_symbols: int, batch: int, fill_inline: int,
+                 max_fills: int):
+        import numpy as np
+
+        self._np = np
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.me_lanes_create(num_symbols, batch, fill_inline,
+                                            max_fills)
+        if not self._h:
+            raise RuntimeError("me_lanes_create failed")
+        self.S, self.B, self.L = num_symbols, batch, fill_inline
+        self.max_fills = max_fills
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.me_lanes_destroy(self._h)
+            self._h = None
+
+    @staticmethod
+    def _i32p(arr):
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    def build(self, recs, n: int, build_ou: bool, build_md: bool):
+        """Stage one dispatch from `n` MeGwOp records ((MeGwOp * k) array).
+
+        Returns (shape, n_waves, n_lanes, n_ops, wave_k) or raises on a
+        malformed record / allocator exhaustion (the caller fails the
+        batch; eager registrations were already rolled back natively)."""
+        max_waves = n // self.B + 2
+        flags = (ctypes.c_int32 * 4)()
+        wave_n = (ctypes.c_int32 * max_waves)()
+        wave_k = (ctypes.c_int32 * max_waves)()
+        rc = self._lib.me_lanes_build(
+            self._h, recs, n, 1 if build_ou else 0, 1 if build_md else 0,
+            flags, wave_n, wave_k, max_waves,
+        )
+        if rc < 0:
+            raise RuntimeError("me_lanes_build failed (malformed record or "
+                               "allocator exhaustion)")
+        shape, n_waves, n_lanes, n_ops = (flags[0], flags[1], flags[2],
+                                          flags[3])
+        return shape, n_waves, n_lanes, n_ops, list(wave_k[:n_waves])
+
+    def wave(self, w: int, shape: int, k: int):
+        """Materialize wave `w`'s lane buffer: sparse -> [K, 9] int32,
+        dense -> [S, B, 7] int32 (ready for device_put)."""
+        np = self._np
+        if shape == 0:
+            arr = np.empty((k, 9), dtype=np.int32)
+        else:
+            arr = np.empty((self.S, self.B, 7), dtype=np.int32)
+        if self._lib.me_lanes_wave(self._h, w, self._i32p(arr)) != 0:
+            raise RuntimeError("me_lanes_wave failed")
+        return arr
+
+    def decode_wave(self, small, fills_fetch) -> int:
+        """Decode the OLDEST staged dispatch's next wave from its packed
+        small-vector readback (int32 numpy). `fills_fetch()` lazily
+        fetches the full [5, max_fills] buffer when the fill log exceeded
+        the inline segment. Returns the wave's fill count."""
+        np = self._np
+        small = np.ascontiguousarray(small, dtype=np.int32)
+        rc = self._lib.me_lanes_decode_wave(
+            self._h, self._i32p(small), small.size, None, 0)
+        if rc == -2:
+            fills = np.ascontiguousarray(fills_fetch(), dtype=np.int32)
+            rc = self._lib.me_lanes_decode_wave(
+                self._h, self._i32p(small), small.size, self._i32p(fills),
+                fills.size)
+        if rc < 0:
+            raise RuntimeError("me_lanes_decode_wave failed")
+        return int(rc)
+
+    def finish_take(self) -> tuple[bytes, bytes, bytes]:
+        """Assemble + copy out the oldest dispatch's (completions, storage,
+        aux) buffers; pops it from the staged FIFO."""
+        lens = [ctypes.c_longlong() for _ in range(3)]
+        if self._lib.me_lanes_finish(self._h, *[ctypes.byref(v)
+                                                for v in lens]) != 0:
+            raise RuntimeError("me_lanes_finish failed")
+        bufs = [(ctypes.c_uint8 * v.value)() for v in lens]
+        if self._lib.me_lanes_take(self._h, *bufs) != 0:
+            raise RuntimeError("me_lanes_take failed")
+        return tuple(bytes(b) for b in bufs)
+
+    def abort(self, newest: bool) -> None:
+        self._lib.me_lanes_abort(self._h, 1 if newest else 0)
+
+    def get_order(self, handle: int):
+        """(oid_num, side, otype, price_q4, status, quantity, remaining,
+        symbol, client_id) or None."""
+        oid = ctypes.c_longlong()
+        i32s = (ctypes.c_int32 * 5)()
+        i64s = (ctypes.c_longlong * 2)()
+        sym = ctypes.create_string_buffer(68)
+        cid = ctypes.create_string_buffer(260)
+        sym_len = ctypes.c_int32()
+        cid_len = ctypes.c_int32()
+        rc = self._lib.me_lanes_get_order(
+            self._h, handle, ctypes.byref(oid), i32s, i64s, sym,
+            ctypes.byref(sym_len), cid, ctypes.byref(cid_len))
+        if not rc:
+            return None
+        return (oid.value, i32s[0], i32s[1], i32s[2], i32s[3],
+                i64s[0], i64s[1], sym.raw[:sym_len.value].decode(),
+                cid.raw[:cid_len.value].decode())
+
+    def lookup(self, order_id: str) -> int:
+        b = order_id.encode()
+        return int(self._lib.me_lanes_lookup(self._h, b, len(b)))
+
+    def adjust(self, handle: int, remaining: int, status: int) -> bool:
+        return bool(self._lib.me_lanes_adjust(self._h, handle, remaining,
+                                              status))
+
+    def evict(self, handle: int) -> int | None:
+        """Evict a live order; returns the released slot (or None)."""
+        released = ctypes.c_int32(-1)
+        if not self._lib.me_lanes_evict(self._h, handle,
+                                        ctypes.byref(released)):
+            return None
+        return released.value if released.value >= 0 else None
+
+    def set_auction_mode(self, value: bool) -> None:
+        self._lib.me_lanes_set_auction_mode(self._h, 1 if value else 0)
+
+    def adopt(self, blob: bytes) -> None:
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        rc = self._lib.me_lanes_adopt(self._h, buf, len(blob))
+        if rc != 0:
+            raise RuntimeError(
+                "me_lanes_adopt failed"
+                + (" (dispatches still staged)" if rc == -2 else ""))
+
+    def dump_state(self) -> bytes:
+        n = self._lib.me_lanes_dump_state(self._h, None, 0)
+        buf = (ctypes.c_uint8 * n)()
+        if self._lib.me_lanes_dump_state(self._h, buf, n) != n:
+            raise RuntimeError("me_lanes_dump_state failed")
+        return bytes(buf)
+
+    def stats(self) -> dict:
+        live = ctypes.c_longlong()
+        next_oid = ctypes.c_longlong()
+        staged = ctypes.c_longlong()
+        self._lib.me_lanes_stats(self._h, ctypes.byref(live),
+                                 ctypes.byref(next_oid), ctypes.byref(staged))
+        return {"live_orders": live.value, "next_oid": next_oid.value,
+                "staged_dispatches": staged.value}
+
+
+class LaneRing:
+    """Bounded MPSC MeGwOp record ring (native/me_lanes.cpp GwRing): the
+    grpcio edge's record dispatcher pushes wide records here and the drain
+    loop pops RAW batches — the same batching-window semantics as the
+    gateway's internal ring, without per-record Python decode."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.me_gwring_create(capacity)
+        if not self._h:
+            raise RuntimeError("me_gwring_create failed")
+        self._buf = None
+
+    def push(self, rec: MeGwOp) -> bool:
+        if self._h is None:
+            return False
+        return bool(self._lib.me_gwring_push(self._h, ctypes.byref(rec)))
+
+    def pop_batch_raw(self, max_ops: int, window_us: int,
+                      first_wait_us: int = -1):
+        """(records_array, n): n == 0 on first-wait timeout, None when
+        closed+empty. The array is reused across pops (single consumer)."""
+        if self._h is None:
+            return None, 0
+        buf = self._buf
+        if buf is None or len(buf) < max_ops:
+            buf = self._buf = (MeGwOp * max_ops)()
+        n = self._lib.me_gwring_pop_batch(self._h, buf, max_ops, window_us,
+                                          first_wait_us)
+        if n < 0:
+            return None, 0
+        return buf, n
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.me_gwring_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.me_gwring_destroy(self._h)
+            self._h = None
+
+    @property
+    def dropped(self) -> int:
+        return 0 if self._h is None else self._lib.me_gwring_dropped(self._h)

@@ -16,6 +16,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import namedtuple
 from concurrent.futures import Future
 
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner
@@ -179,6 +180,160 @@ class BatchDispatcher:
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)
+
+
+# One native-path op's completion: kind 0=submit / 1=cancel / 2=amend.
+LaneOutcome = namedtuple("LaneOutcome", "kind ok order_id remaining error")
+
+
+class LaneRingDispatcher:
+    """The grpcio edge's dispatcher for the native lane path (server/
+    native_lanes.py): RPC threads pack ONE wide MeGwOp record and push it
+    into a native ring; the drain loop pops RAW record batches and hands
+    them to the C++ lane engine via NativeLanesRunner.dispatch_records.
+    Host checks (directory lookups, ownership, slot capacity) happen
+    natively inside the dispatch — the service keeps only proto
+    validation. Futures resolve to LaneOutcome from the dispatch's
+    local-tag completion section.
+
+    Not an EngineOp dispatcher: exposes submit_record instead of submit
+    (the service branches on `native_lanes`)."""
+
+    native_lanes = True
+
+    def __init__(
+        self,
+        runner,               # NativeLanesRunner
+        sink=None,
+        hub=None,
+        window_ms: float = 2.0,
+        max_batch: int | None = None,
+        metrics: Metrics | None = None,
+        ring_capacity: int = 1 << 16,
+    ):
+        from matching_engine_tpu import native as me_native
+
+        if not getattr(runner, "native_lanes", False):
+            raise RuntimeError("LaneRingDispatcher needs a NativeLanesRunner")
+        self.runner = runner
+        self.sink = sink
+        self.hub = hub
+        self.window_us = max(1, int(window_ms * 1e3))
+        self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
+        self.metrics = metrics or runner.metrics
+        self._ring = me_native.LaneRing(ring_capacity)
+        self._rec = threading.local()  # per-RPC-thread scratch record
+        self._tags: dict[int, Future] = {}
+        self._tag_lock = threading.Lock()
+        self._tag_seq = itertools.count(1)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="lane-dispatcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def submit_record(self, op: int, side: int = 0, otype: int = 0,
+                      price_q4: int = 0, quantity: int = 0,
+                      symbol: bytes = b"", client_id: bytes = b"",
+                      order_id: bytes = b"") -> Future:
+        """Enqueue one validated record; the future resolves to its
+        LaneOutcome. Bit 63 routes the completion through the dispatch's
+        local aux section instead of the gateway batch."""
+        from matching_engine_tpu import native as me_native
+
+        fut: Future = Future()
+        tag = next(self._tag_seq) | (1 << 63)
+        rec = getattr(self._rec, "r", None)
+        if rec is None:
+            rec = self._rec.r = me_native.MeGwOp()
+        me_native.pack_gwop(rec, tag, op, side=side, otype=otype,
+                            price_q4=price_q4, quantity=quantity,
+                            symbol=symbol, client_id=client_id,
+                            order_id=order_id)
+        with self._tag_lock:
+            self._tags[tag] = fut
+        if not self._ring.push(rec):
+            with self._tag_lock:
+                self._tags.pop(tag, None)
+            self.metrics.inc("ring_rejects")
+            fut.set_exception(RingFull("op ring full"))
+        return fut
+
+    def close(self) -> None:
+        self._stop.set()
+        self._ring.close()
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            print("[lane-dispatcher] drain thread busy at close; leaking ring")
+        else:
+            self._ring.destroy()
+        with self._tag_lock:
+            leftovers = list(self._tags.values())
+            self._tags.clear()
+        for fut in leftovers:
+            if not fut.done():
+                fut.set_exception(RuntimeError("dispatcher closed"))
+
+    def _run(self) -> None:
+        from matching_engine_tpu.server.native_lanes import (
+            publish_native_result,
+            snapshot_records,
+        )
+
+        while not self._stop.is_set():
+            buf, n = self._ring.pop_batch_raw(
+                self.max_batch, self.window_us,
+                self.window_us if self.runner.has_pending else -1,
+            )
+            if buf is None:
+                break
+            if n == 0:  # idle lull with a staged dispatch: finish it
+                self.runner.finish_pending()
+                continue
+            recs = snapshot_records(buf, n)
+
+            def on_finish(result, error, recs=recs, n=n):
+                if error is not None:
+                    self.metrics.inc("dispatch_errors")
+
+                    def fail():
+                        for i in range(n):
+                            fut = self._take_tag(recs[i].tag)
+                            if fut is not None and not fut.done():
+                                fut.set_exception(error)
+                    return fail
+                publish_native_result(result, self.sink, self.hub,
+                                      self.metrics)
+
+                def complete():
+                    for (tag, kind, ok, remaining, oid, err) in result.local:
+                        fut = self._take_tag(tag)
+                        if fut is not None and not fut.done():
+                            fut.set_result(
+                                LaneOutcome(kind, ok, oid, remaining, err))
+                    # Any record the dispatch missed: fail loudly rather
+                    # than hang its RPC thread to the timeout.
+                    for i in range(n):
+                        fut = self._take_tag(recs[i].tag)
+                        if fut is not None and not fut.done():
+                            fut.set_exception(
+                                RuntimeError("op produced no outcome"))
+                return complete
+
+            try:
+                self.runner.dispatch_records(recs, n, on_finish)
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                self.metrics.inc("dispatch_errors")
+                print(f"[lane-dispatcher] batch failed: "
+                      f"{type(e).__name__}: {e}")
+                for i in range(n):
+                    fut = self._take_tag(recs[i].tag)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(e)
+        self.runner.finish_pending()
+
+    def _take_tag(self, tag: int):
+        with self._tag_lock:
+            return self._tags.pop(tag, None)
 
 
 class NativeRingDispatcher(BatchDispatcher):

@@ -410,6 +410,12 @@ class EngineRunner:
     def has_pending(self) -> bool:
         return bool(self._pending)
 
+    def sync_directory_for_snapshot_locked(self) -> None:
+        """Quiesce-point hook (dispatch lock held, pending FIFO drained):
+        make the Python directories authoritative before a state snapshot.
+        No-op here — the Python path's directories are always live; the
+        native lane runner refreshes its mirror from the C++ engine."""
+
     def finish_pending(self) -> None:
         """Decode+publish ALL pending dispatches, oldest first (idle
         wakeup / shutdown path)."""
@@ -456,10 +462,18 @@ class EngineRunner:
         dispatch lock when this batch's results are decoded (publish to
         sink/hub there); its return value, if not None, is a thunk the
         runner invokes after releasing the lock (client completions)."""
+        self._dispatch_common(lambda: self._stage_locked(ops), on_finish)
+
+    def _dispatch_common(self, stage, on_finish) -> None:
+        """The serving-dispatch orchestration shared by every entry
+        (EngineOp batches here, raw record batches in the native lane
+        runner): lock discipline, pipeline-FIFO overflow, post-lock
+        completion thunks. `stage()` runs under the dispatch lock and
+        returns the staged batch."""
         posts: list = []
         with self._dispatch_lock, Timer(self.metrics, "engine_dispatch_us"):
             try:
-                staged = self._stage_locked(ops)
+                staged = stage()
             except BaseException as e:  # noqa: BLE001 — fail THIS batch,
                 # keep the loop; the previous batch is still finished below.
                 self._finish_pending_locked(posts)
@@ -937,7 +951,10 @@ class EngineRunner:
                 self._evict(i)
             elif e.op == OP_CANCEL and i.status == CANCELED:
                 self._evict(i)
-        for h in terminal_makers:
+        # Ascending handle order, NOT set-iteration order: recycling order
+        # feeds the handle free list, and the native lane engine
+        # (me_lanes.cpp finish) mirrors this exact sequence for bit-parity.
+        for h in sorted(terminal_makers):
             info = self.orders_by_handle.get(h)
             if info is not None and info.status in (FILLED, CANCELED, REJECTED):
                 self._evict(info)

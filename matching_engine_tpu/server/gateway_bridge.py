@@ -61,6 +61,7 @@ class GatewayBridge:
         window_ms: float = 2.0,
         max_batch: int | None = None,
         workers: int = 8,
+        native_lanes: bool = False,
     ):
         self.gateway = gateway
         self.runner = runner
@@ -70,13 +71,22 @@ class GatewayBridge:
         self.metrics = runner.metrics
         self.window_us = max(1, int(window_ms * 1e3))
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
+        # Native lane mode (server/native_lanes.py): the drain loop pops
+        # RAW MeGwOp buffers and hands them to the C++ lane engine — no
+        # per-record Python decode, no EngineOp construction; completions
+        # come back as one pre-packed complete_batch buffer. Requires a
+        # NativeLanesRunner.
+        self.native_lanes = native_lanes
+        if native_lanes and not getattr(runner, "native_lanes", False):
+            raise ValueError("native_lanes=True needs a NativeLanesRunner")
         self._stop = threading.Event()
         self._stream_threads: set[threading.Thread] = set()
         self._stream_lock = threading.Lock()
         self._fwd_q: queue.Queue = queue.Queue()
         self.gateway.set_callback(self._on_forwarded)
         self._drain_thread = threading.Thread(
-            target=self._run, name="gw-bridge", daemon=True
+            target=self._run_native if native_lanes else self._run,
+            name="gw-bridge", daemon=True
         )
         self._workers = [
             threading.Thread(target=self._worker, name=f"gw-fwd-{i}", daemon=True)
@@ -163,6 +173,101 @@ class GatewayBridge:
                         self.gateway.complete_cancel(
                             rec[0], False, rec[8] or "", "engine error")
         self.runner.finish_pending()
+
+    # -- hot path, native-lane mode ----------------------------------------
+
+    def _run_native(self) -> None:
+        while not self._stop.is_set():
+            buf, n = self.gateway.pop_batch_raw(
+                self.max_batch, self.window_us,
+                self.window_us if self.runner.has_pending else -1,
+            )
+            if buf is None:
+                break
+            if n == 0:  # idle lull with a staged dispatch: finish it
+                self.runner.finish_pending()
+                continue
+            try:
+                self._drain_batch_native(buf, n)
+            except Exception as e:  # noqa: BLE001 — the drain thread must
+                # survive ANY per-batch failure; fail the batch's clients
+                # instead of stranding them until their deadline.
+                self.metrics.inc("dispatch_errors")
+                print(f"[gw-bridge] native batch failed: "
+                      f"{type(e).__name__}: {e}")
+                self._fail_records(buf, n)
+        self.runner.finish_pending()
+
+    def _fail_records(self, recs, n: int) -> None:
+        """Best-effort engine-error completion for every record of a
+        failed batch (completing a tag twice is a no-op)."""
+        for i in range(n):
+            r = recs[i]
+            oid = bytes(r.order_id[:r.order_id_len]).decode(errors="replace")
+            if r.op == 1:
+                self.gateway.complete_submit(r.tag, False, "", "engine error")
+            elif r.op == 3:
+                self.gateway.complete_amend(r.tag, False, oid, 0,
+                                            "engine error")
+            else:
+                self.gateway.complete_cancel(r.tag, False, oid,
+                                             "engine error")
+
+    def _drain_batch_native(self, buf, n: int) -> None:
+        from matching_engine_tpu.server.native_lanes import (
+            publish_native_result,
+            snapshot_records,
+        )
+
+        t0 = time.perf_counter()
+        # Stable copy (ONE memmove, not per-op Python): the pop buffer is
+        # reused while this dispatch may still be staged, and the error
+        # path needs the tags.
+        recs = snapshot_records(buf, n)
+
+        def on_finish(result, error):
+            # Same lock discipline as the Python path: publish under the
+            # dispatch lock, complete clients from the returned thunk
+            # after release.
+            if error is not None:
+                self.metrics.inc("dispatch_errors")
+                print(f"[gw-bridge] native dispatch error: "
+                      f"{type(error).__name__}: {error}")
+
+                def fail():
+                    self._fail_records(recs, n)
+                return fail
+            t_pub = time.perf_counter()
+            publish_native_result(result, self.sink, self.hub, self.metrics)
+            self.metrics.ema_gauge(
+                "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)
+
+            def complete():
+                # ONE ctypes crossing + one locked socket write per
+                # connection for the whole dispatch — the comp buffer is
+                # already in the complete_batch wire format.
+                t_comp = time.perf_counter()
+                self.gateway.complete_batch_raw(result.comp_buf)
+                for (tag, ok, remaining, oid, err) in result.amends:
+                    self.gateway.complete_amend(tag, ok, oid, remaining, err)
+                self.metrics.ema_gauge(
+                    "bridge_complete_us",
+                    (time.perf_counter() - t_comp) * 1e6)
+                dur_us = (time.perf_counter() - t0) * 1e6
+                self.metrics.ema_gauge("dispatch_us", dur_us)
+                self.metrics.observe("dispatch_us", dur_us)
+                self.metrics.ema_gauge("dispatch_ops", n)
+                stats = self.gateway.stats()
+                self.metrics.set_gauge("gateway_requests", stats["requests"])
+                self.metrics.set_gauge(
+                    "gateway_ring_rejects", stats["ring_rejects"])
+                self.metrics.set_gauge(
+                    "gateway_connections", stats["conns"])
+            return complete
+
+        self.metrics.ema_gauge(
+            "bridge_setup_us", (time.perf_counter() - t0) * 1e6)
+        self.runner.dispatch_records(recs, n, on_finish)
 
     def _drain_batch(self, recs) -> None:
         runner = self.runner

@@ -94,6 +94,7 @@ def build_server(
     mesh=None,
     gateway_addr: str | None = None,
     pipeline_inflight: int = 2,
+    native_lanes: bool = False,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -101,15 +102,43 @@ def build_server(
     batching window and the SQLite writer run in native code
     (native/me_native.cpp); otherwise the pure-Python twins serve. Reads
     (recovery, book queries, OID reseed) always go through Storage.
+
+    With native_lanes=True the serving hot path additionally runs through
+    the C++ lane engine (native/me_lanes.cpp via server/native_lanes.py):
+    lane build, host checks, completion/storage decode all happen native,
+    Python works per dispatch. Single-device only; requires the built
+    native runtime.
     """
+    from matching_engine_tpu import native as _me_native
+
+    if native_lanes:
+        if mesh is not None:
+            raise SystemExit(3)  # lane engine is single-device (see runner)
+        if not (native and _me_native.available()):
+            print("[SERVER] --native-lanes needs the built native runtime "
+                  "(libme_native.so); run scripts/build_native.sh",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
     storage = Storage(db_path)
     if not storage.init():
         raise SystemExit(1)
 
     metrics = Metrics()
     hub = StreamHub(metrics=metrics)
-    runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
-                          pipeline_inflight=pipeline_inflight)
+
+    def make_runner():
+        if native_lanes:
+            from matching_engine_tpu.server.native_lanes import (
+                NativeLanesRunner,
+            )
+
+            return NativeLanesRunner(cfg, metrics, hub=hub,
+                                     pipeline_inflight=pipeline_inflight)
+        return EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
+                            pipeline_inflight=pipeline_inflight)
+
+    runner = make_runner()
     # STP identity registry loads BEFORE any restore/recovery replay — the
     # replay derives owner lanes via _owner_for, and a hash-colliding
     # client must resolve to its persisted id, not first-arrival order.
@@ -131,8 +160,7 @@ def build_server(
         except Exception as e:  # any corrupt/skewed checkpoint -> full replay
             print(f"[SERVER] checkpoint restore failed "
                   f"({type(e).__name__}: {e}); full replay")
-            runner = EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
-                                  pipeline_inflight=pipeline_inflight)
+            runner = make_runner()
             runner.load_owner_ids(owner_rows)
             ckpt = None
     if ckpt is None:
@@ -206,14 +234,26 @@ def build_server(
             runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s,
             storage=storage,
         ).start()
-    if use_native:
+    if native_lanes:
+        # All boot-time Python-path mutations (recovery replay, restore,
+        # auction-mode resume) are done: flip directory authority to the
+        # C++ lane engine before any serving loop can dispatch.
+        runner.adopt_from_python()
+        from matching_engine_tpu.server.dispatcher import LaneRingDispatcher
+
+        dispatcher = LaneRingDispatcher(
+            runner, sink=sink, hub=hub, window_ms=window_ms
+        )
+    elif use_native:
         dispatcher = NativeRingDispatcher(
             runner, sink=sink, hub=hub, window_ms=window_ms
         )
     else:
         dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
     if log:
-        print(f"[SERVER] runtime layer: {'native (C++)' if use_native else 'python'}")
+        layer = ("native lanes (C++ build+decode)" if native_lanes
+                 else "native (C++)" if use_native else "python")
+        print(f"[SERVER] runtime layer: {layer}")
     service = MatchingEngineService(runner, dispatcher, hub, metrics, log=log)
 
     server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
@@ -238,7 +278,8 @@ def build_server(
 
         gateway = me_native.NativeGateway(gateway_addr)
         bridge = GatewayBridge(
-            gateway, runner, service, sink=sink, hub=hub, window_ms=window_ms
+            gateway, runner, service, sink=sink, hub=hub, window_ms=window_ms,
+            native_lanes=native_lanes,
         )
         gateway_port = bridge.start()
         if log:
@@ -327,6 +368,12 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-interval-s", type=float, default=30.0)
     p.add_argument("--no-native", action="store_true",
                    help="force the pure-Python runtime layer")
+    p.add_argument("--native-lanes", action="store_true",
+                   help="serve through the C++ lane engine "
+                        "(native/me_lanes.cpp): lane build, host checks "
+                        "and completion/storage decode run natively; "
+                        "Python works per dispatch, not per op. "
+                        "Single-device only (incompatible with --mesh)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler device trace of the whole "
                         "serving session into this directory (TensorBoard)")
@@ -365,6 +412,10 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"[SERVER] bad --mesh: {e}", file=sys.stderr)
         return 3
+    if args.native_lanes and (mesh is not None or args.no_native):
+        print("[SERVER] --native-lanes is single-device and needs the "
+              "native runtime (drop --mesh/--no-native)", file=sys.stderr)
+        return 3
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
                        batch=args.batch, kernel=args.engine_kernel)
@@ -378,6 +429,7 @@ def main(argv=None) -> int:
             mesh=mesh,
             gateway_addr=args.gateway_addr,
             pipeline_inflight=args.pipeline_inflight,
+            native_lanes=args.native_lanes,
         )
     except SystemExit as e:
         return int(e.code or 3)

@@ -1,0 +1,475 @@
+"""NativeLanesRunner: the C++ lane-engine serving fast path.
+
+The r5 serving ceiling (VERDICT weak #1) was per-OP Python in the bridge
+and runner hot loops: ring-record decode, OrderInfo/EngineOp construction,
+directory dict mutation, numpy lane scatter, per-result decode, storage
+tuple packing, completion building. This runner keeps the EngineRunner's
+device machinery (jit'd sparse/dense steps, the pipelined dispatch FIFO,
+the dispatch-lock discipline) but moves ALL of that per-op host work into
+native/me_lanes.cpp — Python runs per DISPATCH:
+
+    build   -> one ctypes call stages the batch (host checks, id/handle/
+               slot assignment, wave placement) straight from the raw
+               MeGwOp records.
+    wave    -> one ready-to-device_put int32 lane buffer per wave.
+    step    -> the unchanged jit'd engine step (sparse [K, 9] or packed
+               dense [S, B, 7]).
+    decode  -> one ctypes call per wave readback updates the native
+               directory and accumulates storage rows + completions.
+    finish  -> three buffers out: completions (the gateway batch wire),
+               storage (the MeSink wire — fed to the native sink without
+               touching Python tuples), and aux (counters, slot/owner
+               deltas, stream events) parsed once per dispatch.
+
+Directory ownership: in this mode the C++ engine owns the hot-path order
+directory and allocators. Python keeps a symbols<->slot mirror (updated
+per dispatch from aux deltas — needed for market-data symbol names and
+book snapshots) and syncs the FULL directory only around rare
+control-plane mutations (recovery replay, auctions, fill-overflow
+reconcile, checkpoint snapshots) via dump_state/adopt. The Python path
+(EngineRunner + gateway_bridge._drain_batch) stays the parity oracle:
+tests/test_native_lanes.py replays lifecycle-fuzz record streams through
+both and asserts identical outcomes, storage rows, and final books.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import deque
+
+import numpy as np
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.harness import PIPELINE_DEPTH, run_pipelined
+from matching_engine_tpu.engine.kernel import BUY, SELL, fill_inline_count
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.server.engine_runner import EngineRunner, OrderInfo
+from matching_engine_tpu.utils.tracing import step_annotation
+
+
+class NativeDispatchResult:
+    """One native dispatch's decoded consequences (the DispatchResult twin
+    for the record path). Buffers stay wire-format; only the aux sections
+    Python must act on are parsed."""
+
+    __slots__ = ("comp_buf", "store_buf", "amends", "local",
+                 "order_updates", "market_data", "counters")
+
+    def __init__(self, comp_buf, store_buf, amends, local, order_updates,
+                 market_data, counters):
+        self.comp_buf = comp_buf            # gateway complete_batch wire
+        self.store_buf = store_buf          # MeSink wire
+        self.amends = amends                # (tag, ok, remaining, oid, err)
+        self.local = local                  # (tag, kind, ok, rem, oid, err)
+        self.order_updates = order_updates  # [pb2.OrderUpdate]
+        self.market_data = market_data      # [pb2.MarketDataUpdate]
+        self.counters = counters
+
+
+class _NativeStaged:
+    """One native dispatch between stage and finish (the _Staged twin).
+    `deferred` means every wave's device step is already issued and
+    `items` holds the undecoded outputs."""
+
+    __slots__ = ("shape", "arrays", "items", "deferred", "issue")
+
+    def __init__(self, shape, arrays, issue):
+        self.shape = shape
+        self.arrays = arrays  # np lane buffers, one per wave
+        self.items = deque()  # issued step outputs awaiting decode
+        self.deferred = False
+        self.issue = issue    # callable(arr) -> step output
+
+
+def publish_native_result(result: NativeDispatchResult, sink, hub,
+                          metrics) -> None:
+    """publish_result for the native path: the storage batch ships as the
+    already-packed MeSink buffer when the sink supports it (one ctypes
+    crossing, no Python tuples); stream events were only materialized when
+    subscribers existed."""
+    try:
+        if sink is not None and len(result.store_buf) > 12:
+            if hasattr(sink, "submit_packed"):
+                ok = sink.submit_packed(result.store_buf, block=False)
+            else:
+                orders, updates, fills = me_native.unpack_store_buf(
+                    result.store_buf)
+                ok = sink.submit(orders=orders, updates=updates, fills=fills,
+                                 block=False)
+            if not ok:
+                metrics.inc("storage_batches_dropped")
+        if hub is not None:
+            hub.publish_order_updates(result.order_updates)
+            hub.publish_market_data(result.market_data)
+    except Exception as e:  # noqa: BLE001 — a sink/hub failure must never
+        # strand the batch's completions or kill the drain loop.
+        metrics.inc("sink_publish_errors")
+        print(f"[native-lanes] sink/hub error: {type(e).__name__}: {e}")
+
+
+class NativeLanesRunner(EngineRunner):
+    """EngineRunner whose serving hot path runs through the C++ lane
+    engine. Single-device only (the mesh path amortizes per-op Python
+    over much larger dispatches and keeps dense batches)."""
+
+    def __init__(self, cfg: EngineConfig, metrics=None, hub=None,
+                 pipeline_inflight: int = 2):
+        super().__init__(cfg, metrics, mesh=None, hub=hub,
+                         pipeline_inflight=pipeline_inflight)
+        self.lanes = me_native.NativeLanes(
+            cfg.num_symbols, cfg.batch, fill_inline_count(cfg), cfg.max_fills)
+        self.native_lanes = True
+        # Until the first adopt, the PYTHON directories are authoritative
+        # (boot recovery/restore mutates them directly, engine_runner
+        # machinery unchanged); mirror refreshes no-op so a boot-time
+        # run_dispatch can't clobber recovered state with the empty
+        # native directory. The first record dispatch (or build_server's
+        # explicit adopt) flips authority to the C++ engine.
+        self._native_authoritative = False
+
+    # -- the native record dispatch ---------------------------------------
+
+    def dispatch_records(self, recs, n: int, on_finish) -> None:
+        """Serving-loop entry for raw MeGwOp record batches — the
+        dispatch_pipelined twin (same _dispatch_common orchestration).
+        `on_finish(result, error)` runs under the dispatch lock when this
+        batch decodes (publish there); its return value, if not None,
+        runs after release (client completions)."""
+
+        def stage():
+            if not self._native_authoritative:
+                # First record dispatch: install whatever boot recovery
+                # left in the Python directories (pending FIFO is empty
+                # before the first dispatch, so adopt cannot refuse).
+                self.adopt_from_python()
+            return self._stage_records_locked(recs, n)
+
+        self._dispatch_common(stage, on_finish)
+
+    def _stage_records_locked(self, recs, n: int) -> _NativeStaged:
+        build_ou = self.hub is None or self.hub.has_order_update_subs()
+        build_md = self.hub is None or self.hub.has_market_data_subs()
+        # One ctypes crossing stages the whole batch: host checks, oid/
+        # handle/slot assignment, wave placement. Raises before any ctx is
+        # staged; native registrations are already rolled back on failure.
+        shape, n_waves, n_lanes, _n_ops, wave_k = self.lanes.build(
+            recs, n, build_ou, build_md)
+        if shape == 0:
+            self.metrics.inc("sparse_dispatches")
+        elif n_lanes:
+            self.metrics.inc("dense_dispatches")
+        issue = self._issue_sparse if shape == 0 else self._issue_dense
+        try:
+            arrays = [self.lanes.wave(w, shape, wave_k[w] if shape == 0
+                                      else 0)
+                      for w in range(n_waves)]
+            staged = _NativeStaged(shape, arrays, issue)
+            if n_waves <= PIPELINE_DEPTH:
+                # Dispatch every wave now, decode later — the staged
+                # outputs are HBM-bounded by the wave-count cap, and the
+                # async host copy lands while the host batches newer work.
+                for arr in arrays:
+                    out = issue(arr)
+                    staged.items.append(out)
+                    try:
+                        out.small.copy_to_host_async()
+                    except (AttributeError, RuntimeError):
+                        pass
+                staged.deferred = True
+            return staged
+        except BaseException:
+            # The ctx staged by build() is the NEWEST; drop it (handles/
+            # slots stay consumed — the maybe-applied-on-device policy).
+            self.lanes.abort(newest=True)
+            raise
+
+    def _issue_sparse(self, arr):
+        from matching_engine_tpu.engine.sparse import (
+            SparseBatch,
+            engine_step_sparse,
+        )
+
+        self._step_num += 1
+        with self._snapshot_lock, step_annotation("engine_step_sparse",
+                                                  self._step_num):
+            self.book, out = engine_step_sparse(
+                self.cfg, self.book, SparseBatch(lanes=arr))
+        return out
+
+    def _issue_dense(self, arr):
+        from matching_engine_tpu.engine.kernel import engine_step_packed
+
+        self._step_num += 1
+        with self._snapshot_lock, step_annotation("engine_step",
+                                                  self._step_num):
+            self.book, out = engine_step_packed(self.cfg, self.book, arr)
+        return out
+
+    def _decode_native(self, out) -> None:
+        self.lanes.decode_wave(np.asarray(out.small),
+                               lambda: np.asarray(out.fills))
+
+    def _finish_locked(self, staged):
+        if not isinstance(staged, _NativeStaged):
+            return super()._finish_locked(staged)
+        try:
+            if staged.deferred:
+                while staged.items:
+                    self._decode_native(staged.items.popleft())
+            else:
+                # Ineligible for deferral (more waves than the HBM-bounded
+                # window): dispatch + decode with the same bounded
+                # dispatch-ahead window as the Python path.
+                def dispatch():
+                    for arr in staged.arrays:
+                        yield staged.issue(arr)
+
+                run_pipelined(dispatch(), self._decode_native)
+            comp_buf, store_buf, aux_buf = self.lanes.finish_take()
+        except BaseException:
+            self.lanes.abort(newest=False)
+            raise
+        aux = me_native.parse_lane_aux(aux_buf)
+        result = self._apply_aux_locked(comp_buf, store_buf, aux)
+        self.metrics.inc("dispatches")
+        self.metrics.inc("engine_ops", aux["counters"].get("engine_ops", 0))
+        self.metrics.inc("fills", aux["counters"].get("fill_count", 0))
+        return result
+
+    def _apply_aux_locked(self, comp_buf, store_buf, aux) -> NativeDispatchResult:
+        c = aux["counters"]
+        m = self.metrics
+        if c.get("overflow_waves"):
+            m.inc("fill_buffer_overflows", c["overflow_waves"])
+        for key, metric in (("accepted", "orders_accepted"),
+                            ("rejected", "orders_rejected"),
+                            ("canceled", "orders_canceled"),
+                            ("amended", "orders_amended"),
+                            ("owner_overflow", "owner_registry_overflow"),
+                            ("owner_collisions", "owner_hash_collisions")):
+            if c.get(key):
+                m.inc(metric, c[key])
+        # Slot mirror deltas FIRST (market data below resolves symbol
+        # names through the mirror), releases LAST (the Python finalize
+        # also publishes before eviction recycles slots).
+        for slot, sym in aux["slot_allocs"]:
+            self.symbols[sym] = slot
+            self.slot_symbols[slot] = sym
+        for cid, owner in aux["new_owners"]:
+            self._owner_by_client[cid] = owner
+            self._owner_claimed[owner] = cid
+            self.pending_owner_ids.append((cid, owner))
+            m.inc("owner_ids_assigned")
+        for oid, qty in aux["recon"]:
+            self._ledger_lost(oid, qty)
+        market_data = []
+        for slot, bb, bs, ba, asz in aux["market_data"]:
+            sym = self.slot_symbols[slot]
+            if sym is None:
+                continue
+            market_data.append(pb2.MarketDataUpdate(
+                symbol=sym, best_bid=bb, best_ask=ba, scale=4,
+                bid_size=bs, ask_size=asz))
+        for slot in aux["slot_releases"]:
+            sym = self.slot_symbols[slot]
+            if sym is not None:
+                del self.symbols[sym]
+                self.slot_symbols[slot] = None
+        order_updates = [
+            pb2.OrderUpdate(
+                order_id=oid, client_id=cid, symbol=sym, status=status,
+                fill_price=fprice, scale=4, fill_quantity=fqty,
+                remaining_quantity=rem)
+            for (status, fprice, fqty, rem, oid, cid, sym)
+            in aux["order_updates"]
+        ]
+        return NativeDispatchResult(comp_buf, store_buf, aux["amends"],
+                                    aux["local"], order_updates, market_data,
+                                    c)
+
+    # -- directory sync with the Python mirror -----------------------------
+    #
+    # Rare control-plane mutations (recovery replay, auctions, overflow
+    # reconcile) run the ORACLE Python machinery over a freshly-synced
+    # mirror, then install the result back natively. Hot-path state never
+    # crosses per op. Callers hold the dispatch lock with the pending FIFO
+    # drained (adopt refuses otherwise).
+
+    def sync_directory_for_snapshot_locked(self) -> None:
+        self.refresh_directory_mirror_locked()
+
+    def refresh_directory_mirror_locked(self) -> None:
+        if not self._native_authoritative:
+            return  # Python state is still authoritative (pre-adopt boot)
+        st = me_native.parse_lane_state(self.lanes.dump_state())
+        cfg = self.cfg
+        self.next_oid_num = st["next_oid"]
+        self._next_handle = st["next_handle"]
+        self._free_handles = list(st["free_handles"])
+        self._next_slot = st["next_slot"]
+        self._free_slots = list(st["free_slots"])
+        self.symbols = {}
+        self.slot_symbols = [None] * cfg.num_symbols
+        self._slot_live = [0] * cfg.num_symbols
+        for slot, live, sym in st["symbols"]:
+            self.symbols[sym] = slot
+            self.slot_symbols[slot] = sym
+            self._slot_live[slot] = live
+        self._owner_by_client = {cid: o for cid, o in st["owners"]}
+        self._owner_claimed = {o: cid for cid, o in st["owners"]}
+        self.orders_by_handle = {}
+        self.orders_by_id = {}
+        for (handle, oid, cid, sym, side, otype, price, qty, rem,
+             status) in st["orders"]:
+            info = OrderInfo(
+                oid=oid, order_id=f"OID-{oid}", client_id=cid, symbol=sym,
+                side=side, otype=otype, price_q4=price, quantity=qty,
+                remaining=rem, status=status, handle=handle)
+            self.orders_by_handle[handle] = info
+            self.orders_by_id[info.order_id] = info
+        self.auction_mode = st["auction_mode"]
+
+    def adopt_from_python(self) -> None:
+        """Install the Python directories/allocators as the native state
+        (after boot recovery/restore or a Python-path mutation)."""
+        blob = me_native.pack_lane_state(
+            next_oid=self.next_oid_num,
+            next_handle=self._next_handle,
+            free_handles=self._free_handles,
+            next_slot=self._next_slot,
+            free_slots=self._free_slots,
+            symbols=[(slot, self._slot_live[slot], sym)
+                     for sym, slot in sorted(self.symbols.items(),
+                                             key=lambda kv: kv[1])],
+            owners=list(self._owner_by_client.items()),
+            orders=[(i.handle, i.oid, i.client_id, i.symbol, i.side,
+                     i.otype, i.price_q4, i.quantity, i.remaining, i.status)
+                    for i in self.orders_by_handle.values()],
+            auction_mode=self.auction_mode,
+        )
+        self.lanes.adopt(blob)
+        self._native_authoritative = True
+
+    # Python-path mutating entry points: sync around them so the oracle
+    # machinery (recovery, auctions, reconcile) stays exactly as-is.
+
+    def _run_dispatch_locked(self, ops):
+        self.refresh_directory_mirror_locked()
+        try:
+            return super()._run_dispatch_locked(ops)
+        finally:
+            self.adopt_from_python()
+
+    def _run_auction_locked(self, symbols, sink):
+        self.refresh_directory_mirror_locked()
+        try:
+            return super()._run_auction_locked(symbols, sink)
+        finally:
+            self.adopt_from_python()
+
+    def reconcile_fill_overflow(self):
+        self.refresh_directory_mirror_locked()
+        try:
+            return super().reconcile_fill_overflow()
+        finally:
+            self.adopt_from_python()
+
+    def dispatch_pipelined(self, ops, on_finish) -> None:
+        raise NotImplementedError(
+            "NativeLanesRunner serves through dispatch_records; the "
+            "EngineOp path would desync the native directory (use "
+            "run_dispatch for boot-time replay)")
+
+    def set_auction_mode(self, value: bool) -> None:
+        super().set_auction_mode(value)
+        self.lanes.set_auction_mode(value)
+
+    # -- read-only views over the native directory -------------------------
+
+    def native_order(self, order_id: str) -> OrderInfo | None:
+        """Directory lookup against the native hot-path state."""
+        handle = self.lanes.lookup(order_id)
+        if not handle:
+            return None
+        rec = self.lanes.get_order(handle)
+        if rec is None:
+            return None
+        (oid, side, otype, price_q4, status, qty, rem, sym, cid) = rec
+        return OrderInfo(oid=oid, order_id=f"OID-{oid}", client_id=cid,
+                         symbol=sym, side=side, otype=otype,
+                         price_q4=price_q4, quantity=qty, remaining=rem,
+                         status=status, handle=handle)
+
+    def book_snapshot(self, symbol: str):
+        """Parent's snapshot with the directory join served natively."""
+        slot = self.symbols.get(symbol)
+        if slot is None:
+            return [], []
+        with self._snapshot_lock:
+            from matching_engine_tpu.parallel import hostlocal
+
+            arrs = [
+                hostlocal.read_row(x, slot)
+                for x in (
+                    self.book.bid_price, self.book.bid_qty,
+                    self.book.bid_oid, self.book.bid_seq,
+                    self.book.ask_price, self.book.ask_qty,
+                    self.book.ask_oid, self.book.ask_seq,
+                )
+            ]
+        bp, bq, bo, bs_, ap, aq, ao, as_ = arrs
+
+        def side(price, qty, oid, seq, desc, want_side):
+            rows = [
+                (int(oid[j]), int(price[j]), int(qty[j]), int(seq[j]))
+                for j in np.nonzero(qty > 0)[0]
+            ]
+            rows.sort(key=lambda r: (-r[1] if desc else r[1], r[3]))
+            out = []
+            for h, p, q, _ in rows:
+                rec = self.lanes.get_order(h)
+                if rec is None:
+                    continue
+                (oid_n, side_, otype, price_q4, status, qty_, rem,
+                 sym, cid) = rec
+                # Same recycled-handle consistency guard as the parent.
+                if sym == symbol and side_ == want_side and price_q4 == p:
+                    out.append((OrderInfo(
+                        oid=oid_n, order_id=f"OID-{oid_n}", client_id=cid,
+                        symbol=sym, side=side_, otype=otype,
+                        price_q4=price_q4, quantity=qty_, remaining=rem,
+                        status=status, handle=h), q))
+            return out
+
+        return (side(bp, bq, bo, bs_, True, BUY),
+                side(ap, aq, ao, as_, False, SELL))
+
+
+def pack_record_batch(records) -> tuple:
+    """Pack an iterable of record tuples into an (MeGwOp * n) array.
+
+    records: (tag, op, side, otype, price_q4, quantity, symbol, client_id,
+    order_id) with str or bytes strings — the pop_batch tuple order.
+    Benches and tests pre-pack streams with this; the serving edges pop
+    raw buffers and never touch it."""
+    recs = list(records)
+    arr = (me_native.MeGwOp * max(1, len(recs)))()
+    for i, (tag, op, side, otype, price, qty, sym, cid, oid) in \
+            enumerate(recs):
+        me_native.pack_gwop(
+            arr[i], tag, op, side=side, otype=otype, price_q4=price,
+            quantity=qty,
+            symbol=sym.encode() if isinstance(sym, str) else sym,
+            client_id=cid.encode() if isinstance(cid, str) else cid,
+            order_id=oid.encode() if isinstance(oid, str) else oid)
+    return arr, len(recs)
+
+
+def snapshot_records(buf, n: int):
+    """Copy the first n records out of a reused pop buffer (one memmove,
+    not per-op Python) — the error path's completion source and the
+    pipelined dispatch's stable reference."""
+    snap = (me_native.MeGwOp * max(1, n))()
+    ctypes.memmove(snap, buf, ctypes.sizeof(me_native.MeGwOp) * n)
+    return snap

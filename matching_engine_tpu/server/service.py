@@ -88,6 +88,21 @@ class MatchingEngineService(MatchingEngineServicer):
         otype = collapse_otype(request.order_type, request.tif)
         if err is None and otype is None:
             err = "unsupported (order_type, tif) combination"
+        native = getattr(self.dispatcher, "native_lanes", False)
+        if err is None and native:
+            # Native lane path: proto validation stays here; the host
+            # checks (auction mode, slot capacity) and id/handle/slot
+            # assignment run inside the C++ dispatch, atomic with the
+            # RunAuction mode flip. One wide record crosses per op.
+            if not self.runner.owns_symbol(request.symbol):
+                err = f"symbol {request.symbol} is homed on another host"
+            else:
+                price_q4 = (
+                    0 if request.order_type == pb2.MARKET
+                    else normalize_to_q4(request.price, request.scale)
+                )
+                return self._finish_submit_native(
+                    request, t0, otype, price_q4)
         if (err is None and self.runner.auction_mode
                 and otype != pb2.LIMIT):
             # MARKET/IOC/FOK all demand immediate execution; a call period
@@ -158,6 +173,39 @@ class MatchingEngineService(MatchingEngineServicer):
         )
         return pb2.OrderResponse(order_id=order_id, success=True)
 
+    def _finish_submit_native(self, request, t0, otype, price_q4):
+        """SubmitOrder tail on the lane path (LaneRingDispatcher): the
+        accept/reject metrics come from the dispatch's aux counters."""
+        from matching_engine_tpu.server.dispatcher import RingFull
+
+        try:
+            outcome = self.dispatcher.submit_record(
+                1, side=request.side, otype=otype, price_q4=price_q4,
+                quantity=request.quantity, symbol=request.symbol.encode(),
+                client_id=request.client_id.encode(),
+            ).result(timeout=30)
+        except RingFull:
+            self.metrics.inc("orders_rejected")
+            self._log("reject: op ring full")
+            return pb2.OrderResponse(
+                success=False, error_message="server overloaded")
+        except Exception as e:  # noqa: BLE001 — engine failure => app reject
+            self.metrics.inc("orders_errored")
+            self._log(f"engine error: {e}")
+            return pb2.OrderResponse(
+                success=False, error_message="engine error")
+        dur_us = (time.perf_counter() - t0) * 1e6
+        self.metrics.ema_gauge("submit_rpc_us", dur_us)
+        self.metrics.observe("submit_rpc_us", dur_us)
+        if not outcome.ok:
+            self._log(f"rejected {outcome.order_id or '(pre-id)'}: "
+                      f"{outcome.error} ({dur_us:.0f}us)")
+            return pb2.OrderResponse(
+                order_id=outcome.order_id, success=False,
+                error_message=outcome.error)
+        self._log(f"accepted {outcome.order_id} ({dur_us:.0f}us)")
+        return pb2.OrderResponse(order_id=outcome.order_id, success=True)
+
     # -- CancelOrder -------------------------------------------------------
 
     def CancelOrder(self, request, context):
@@ -167,6 +215,8 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="client_id is required",
             )
+        if getattr(self.dispatcher, "native_lanes", False):
+            return self._cancel_native(request)
         info = self.runner.orders_by_id.get(request.order_id)
         if info is None:
             return pb2.CancelResponse(
@@ -200,6 +250,54 @@ class MatchingEngineService(MatchingEngineServicer):
             error_message=outcome.error or "order not open",
         )
 
+    @staticmethod
+    def _target_fits_record(request):
+        """Oversized cancel/amend identifiers answered at the edge with
+        the SAME errors the Python path's directory lookup produces —
+        never let them reach pack_gwop, whose fixed record fields would
+        raise and surface as 'engine error' (an id that can't fit the
+        record can't name a live order either)."""
+        from matching_engine_tpu.domain.order import MAX_CLIENT_ID_BYTES
+
+        if len(request.order_id.encode()) > 36:  # MeGwOp.order_id
+            return "unknown order id"
+        if len(request.client_id.encode()) > MAX_CLIENT_ID_BYTES:
+            return "order belongs to a different client"
+        return None
+
+    def _cancel_native(self, request):
+        """CancelOrder tail on the lane path: the directory lookup and
+        ownership check run natively inside the dispatch (accept/cancel
+        metrics come from the dispatch's aux counters, same as the Python
+        finalize — no per-RPC increment here)."""
+        from matching_engine_tpu.server.dispatcher import RingFull
+
+        err = self._target_fits_record(request)
+        if err is not None:
+            return pb2.CancelResponse(
+                order_id=request.order_id, success=False, error_message=err)
+        try:
+            outcome = self.dispatcher.submit_record(
+                2, order_id=request.order_id.encode(),
+                client_id=request.client_id.encode(),
+            ).result(timeout=30)
+        except RingFull:
+            return pb2.CancelResponse(
+                order_id=request.order_id, success=False,
+                error_message="server overloaded",
+            )
+        except Exception:  # noqa: BLE001
+            return pb2.CancelResponse(
+                order_id=request.order_id, success=False,
+                error_message="engine error",
+            )
+        if outcome.ok:
+            return pb2.CancelResponse(order_id=request.order_id, success=True)
+        return pb2.CancelResponse(
+            order_id=request.order_id, success=False,
+            error_message=outcome.error or "order not open",
+        )
+
     # -- AmendOrder --------------------------------------------------------
 
     def AmendOrder(self, request, context):
@@ -218,6 +316,8 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="new_quantity must be positive",
             )
+        if getattr(self.dispatcher, "native_lanes", False):
+            return self._amend_native(request)
         info = self.runner.orders_by_id.get(request.order_id)
         if info is None:
             return pb2.AmendResponse(
@@ -245,6 +345,42 @@ class MatchingEngineService(MatchingEngineServicer):
             )
         if outcome.status == NEW:
             self.metrics.inc("orders_amended")
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=True,
+                remaining_quantity=outcome.remaining,
+            )
+        return pb2.AmendResponse(
+            order_id=request.order_id, success=False,
+            error_message=outcome.error or "amend rejected",
+        )
+
+    def _amend_native(self, request):
+        """AmendOrder tail on the lane path: lookup/ownership/reduction
+        checks run natively; `new_quantity` rides the record's quantity
+        field (me_lanes.cpp kOpAmend)."""
+        from matching_engine_tpu.server.dispatcher import RingFull
+
+        err = self._target_fits_record(request)
+        if err is not None:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False, error_message=err)
+        try:
+            outcome = self.dispatcher.submit_record(
+                3, quantity=request.new_quantity,
+                order_id=request.order_id.encode(),
+                client_id=request.client_id.encode(),
+            ).result(timeout=30)
+        except RingFull:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="server overloaded",
+            )
+        except Exception:  # noqa: BLE001
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message="engine error",
+            )
+        if outcome.ok:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=True,
                 remaining_quantity=outcome.remaining,

@@ -84,6 +84,34 @@ class SpillingSink:
                 self._metrics.inc("storage_batches_spilled")
             return True
 
+    def submit_packed(self, buf: bytes, block: bool = True) -> bool:
+        """Packed fast path (native lane dispatches): forwarded straight to
+        a packed-capable inner sink while no spill is queued; otherwise
+        unpacked onto the spill so writes stay FIFO across the spill
+        boundary. The whole offer-or-spill decision holds ONE lock
+        acquisition — dropping it between the failed direct attempt and
+        the fallback would let a concurrent submit() overtake this batch."""
+        from matching_engine_tpu.native import unpack_store_buf
+
+        if not hasattr(self._inner, "submit_packed"):
+            orders, updates, fills = unpack_store_buf(buf)
+            return self.submit(orders=orders, updates=updates, fills=fills,
+                               block=block)
+        with self._lock:
+            if self._offer_spill_locked():
+                if self._inner.submit_packed(buf, block=block):
+                    return True
+            if len(self._spill) >= self._max_spill:
+                self.lost += 1
+                if self._metrics is not None:
+                    self._metrics.inc("storage_batches_lost")
+                return False
+            self._spill.append(unpack_store_buf(buf))
+            self.spilled += 1
+            if self._metrics is not None:
+                self._metrics.inc("storage_batches_spilled")
+            return True
+
     def flush(self) -> None:
         """Barrier: drains the spill (blocking) then the inner sink."""
         with self._lock:

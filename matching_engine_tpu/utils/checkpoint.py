@@ -492,6 +492,10 @@ class CheckpointDaemon:
             # they can wrap int32 (the snapshot then freezes the rebased
             # lanes, so a restore inherits the headroom).
             self.runner.maybe_rebase_seqs()
+            # Native lane mode keeps the hot-path directory in C++; pull
+            # it into the Python mirror the snapshot reads (no-op on the
+            # Python path).
+            self.runner.sync_directory_for_snapshot_locked()
             save_checkpoint(path, self.runner)
         for p in posts:  # client completions, outside the engine lock
             p()

@@ -195,26 +195,12 @@ int route(const std::string& path) {
 
 }  // namespace
 
-extern "C" {
+// MeGwOp (the wide op record popped by the Python bridge) lives in
+// me_gwop.h — ONE definition shared with the lane engine; the ctypes
+// mirror in matching_engine_tpu/native/__init__.py copies it.
+#include "me_gwop.h"
 
-// Wide op record popped by the Python bridge (ctypes mirror in
-// matching_engine_tpu/native/__init__.py — keep layouts identical).
-struct MeGwOp {
-  uint64_t tag;
-  int32_t op;        // 1 = submit, 2 = cancel, 3 = amend (qty-down)
-  int32_t side;      // BUY=1 / SELL=2
-  int32_t otype;     // LIMIT=0 / MARKET=1
-  int32_t price_q4;  // normalized; 0 for MARKET
-  int64_t quantity;
-  // Explicit lengths: proto3 strings may contain embedded NULs, which must
-  // round-trip identically to the grpcio edge (no c-string truncation).
-  int32_t symbol_len;
-  int32_t client_id_len;
-  int32_t order_id_len;
-  char symbol[68];      // MAX_SYMBOL_BYTES=64
-  char client_id[260];  // MAX_CLIENT_ID_BYTES=256
-  char order_id[36];    // cancel target "OID-<n>"
-};
+extern "C" {
 
 typedef void (*MeGwCallback)(uint64_t tag, int method, const uint8_t* data,
                              uint64_t len);
@@ -641,6 +627,27 @@ class Gateway {
   void drop_pending(uint64_t tag) {
     std::lock_guard<std::mutex> lk(pending_mu_);
     pending_.erase(tag);
+  }
+
+  // Truncation sweep (me_gateway_complete_batch): take EVERY non-streaming
+  // pending entry. A malformed completion buffer leaves the unparsed
+  // tail's tags unknown, and pending_ doesn't record dispatch membership,
+  // so the sweep over-approximates "the current dispatch" with all
+  // in-flight unary tags — each swept client gets an immediate INTERNAL
+  // error instead of hanging to its RPC deadline, and any late completion
+  // for a swept tag is a no-op (take_pending already removed it).
+  std::vector<Pending> sweep_pending_unary() {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    std::vector<Pending> out;
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (!it->second.streaming) {
+        out.push_back(it->second);
+        it = pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    return out;
   }
 
   MeGwCallback callback() const { return callback_; }
@@ -1430,13 +1437,17 @@ void me_gateway_complete_batch(void* g, const uint8_t* buf, uint64_t len) {
   // Group by connection so each conn gets one appended buffer + one write.
   std::vector<std::pair<std::shared_ptr<Conn>, std::vector<Item>>> groups;
   // A truncated/malformed buffer can only mean encoder/parser skew
-  // (NativeGateway.complete_batch is the one in-repo producer): scream,
-  // don't silently strand the unparsed tail's clients at their deadline.
+  // (NativeGateway.complete_batch and the lane engine's comp_buf are the
+  // in-repo producers): scream, then sweep-fail the in-flight unary tags
+  // below — the unparsed tail's clients must get immediate errors, not
+  // hang to their RPC deadline.
+  bool skew = false;
   auto truncated = [&](uint32_t i) {
+    skew = true;
     std::fprintf(stderr,
                  "[me_gw] complete_batch buffer truncated at record %u/%u "
-                 "(off=%zu len=%llu) — encoder/parser skew, remaining "
-                 "completions dropped\n",
+                 "(off=%zu len=%llu) — encoder/parser skew, sweeping "
+                 "pending unary tags\n",
                  i, n, off, static_cast<unsigned long long>(len));
   };
   for (uint32_t i = 0; i < n; i++) {
@@ -1506,6 +1517,21 @@ void me_gateway_complete_batch(void* g, const uint8_t* buf, uint64_t len) {
       conn->write_unary(item.stream_id, item.bytes, 0, nullptr);
     }
     if (!out.empty()) conn->write_all(out);
+  }
+
+  if (skew) {
+    // The well-formed prefix was delivered above; everything still
+    // pending (this dispatch's unparsed tail, possibly plus other
+    // in-flight unary ops — membership isn't tracked, over-sweeping
+    // trades a spurious INTERNAL for a guaranteed deadline hang) fails
+    // now with a trailers-only INTERNAL error.
+    for (const Pending& p : gw->sweep_pending_unary()) {
+      auto conn = p.conn.lock();
+      if (!conn || conn->dead()) continue;
+      conn->write_trailers(p.stream_id, 13,
+                           "completion batch truncated (encoder/parser skew)",
+                           p.headers_sent);
+    }
   }
 }
 

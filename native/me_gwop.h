@@ -1,0 +1,33 @@
+// MeGwOp: the wide op record shared between the serving edge's ring
+// (me_gateway.cpp), the native lane engine (me_lanes.cpp), and the ctypes
+// mirror in matching_engine_tpu/native/__init__.py — keep all three layouts
+// identical.
+#ifndef ME_GWOP_H_
+#define ME_GWOP_H_
+
+#include <cstdint>
+
+extern "C" {
+
+struct MeGwOp {
+  uint64_t tag;
+  int32_t op;        // 1 = submit, 2 = cancel, 3 = amend (qty-down)
+  int32_t side;      // BUY=1 / SELL=2
+  // Collapsed (order_type, tif) device code — proto.collapse_otype:
+  // LIMIT=0, MARKET=1, LIMIT_IOC=2, LIMIT_FOK=3, MARKET_FOK=4.
+  int32_t otype;
+  int32_t price_q4;  // normalized; 0 for MARKET
+  int64_t quantity;
+  // Explicit lengths: proto3 strings may contain embedded NULs, which must
+  // round-trip identically to the grpcio edge (no c-string truncation).
+  int32_t symbol_len;
+  int32_t client_id_len;
+  int32_t order_id_len;
+  char symbol[68];      // MAX_SYMBOL_BYTES=64
+  char client_id[260];  // MAX_CLIENT_ID_BYTES=256
+  char order_id[36];    // cancel/amend target "OID-<n>"
+};
+
+}  // extern "C"
+
+#endif  // ME_GWOP_H_

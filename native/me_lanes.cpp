@@ -1,0 +1,1484 @@
+// me_lanes: the native serving fast path — lane build + completion decode.
+//
+// The r5 bottleneck (VERDICT weak #1): the device kernel matches ~2.0B
+// orders/s but the serving path feeding it tops out at ~10.6k orders/s,
+// because the bridge/runner hot loops run per-OP Python: ring-record
+// tuple conversion, OrderInfo/EngineOp construction, directory dict
+// mutation, numpy lane scatter, per-result decode, storage-tuple packing,
+// completion-list building. This file moves all of that per-op work into
+// C++, leaving Python control-plane work per DISPATCH:
+//
+//   build  — consume a popped MeGwOp batch straight from the gateway ring
+//            buffer: validate encodings, run the host directory checks
+//            (unknown id / wrong client / auction mode / symbol capacity),
+//            assign oids + recycled device handles + symbol slots, place
+//            ops into sparse [K, 9] or dense [S, B, 7] lane waves.
+//   wave   — materialize one wave's ready-to-device_put int32 lane buffer.
+//   decode — consume one wave's packed small-vector readback (the SAME
+//            layout engine/sparse.py and engine/harness.py read): update
+//            the directory, apply maker decrements from the fill log,
+//            accumulate storage rows in the MeSink wire format and
+//            completion records in the gateway batch wire format.
+//   finish — evict terminal orders (recycling handles/slots), assemble the
+//            completion + storage + aux buffers for one ctypes take().
+//
+// Parity: the Python path (gateway_bridge._drain_batch +
+// engine_runner._stage_locked/_decode_batch/_evict_terminal) stays the
+// oracle — tests/test_native_lanes.py replays lifecycle-fuzz streams
+// through both and asserts identical lanes, outcomes, and storage bytes.
+// Every ordering choice here (slot/oid/handle assignment order, decode in
+// device (slot, row) order, eviction in op order then ASCENDING maker
+// handle order, LIFO free lists) mirrors the Python code lines; change
+// either side only in lockstep.
+//
+// Compiled into libme_native.so (no protobuf dependency — the gateway's
+// protobuf edge stays in libme_gateway.so).
+
+#include <algorithm>
+#include <array>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "me_gwop.h"
+
+// zlib's crc32 (system libz; the stable documented prototype) — the same
+// function behind Python's zlib.crc32, so owner_hash is bit-identical to
+// domain/order.py.
+extern "C" {
+unsigned long crc32(unsigned long crc, const unsigned char* buf,
+                    unsigned int len);
+}
+
+namespace {
+
+// engine/kernel.py opcodes + statuses (pinned there; test_native_lanes.py
+// asserts this module and the kernel agree through the parity streams).
+constexpr int kOpSubmit = 1, kOpCancel = 2, kOpRest = 3, kOpAmend = 4;
+constexpr int kNew = 0, kPartiallyFilled = 1, kFilled = 2, kCanceled = 3,
+              kRejected = 4;
+constexpr int kMarket = 1, kMarketFok = 4;  // price column is NULL for these
+
+constexpr long long kOwnerRegistryCap = 1'000'000;
+constexpr int kBucketFloor = 64;  // sparse.bucket floor
+
+int bucket(int n) {
+  int k = kBucketFloor;
+  while (k < n) k <<= 1;
+  return k;
+}
+
+// Strict UTF-8 validation (RFC 3629): rejects overlongs, surrogates and
+// > U+10FFFF — the same inputs CPython's bytes.decode() rejects, so the
+// fast path rejects exactly the records the Python bridge rejects.
+bool utf8_valid(const char* s, int len) {
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(s);
+  const unsigned char* end = p + len;
+  while (p < end) {
+    unsigned char c = *p;
+    if (c < 0x80) {
+      p += 1;
+    } else if ((c & 0xE0) == 0xC0) {
+      if (end - p < 2 || (p[1] & 0xC0) != 0x80 || c < 0xC2) return false;
+      p += 2;
+    } else if ((c & 0xF0) == 0xE0) {
+      if (end - p < 3 || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xE0 && p[1] < 0xA0) return false;            // overlong
+      if (c == 0xED && p[1] >= 0xA0) return false;           // surrogate
+      p += 3;
+    } else if ((c & 0xF8) == 0xF0) {
+      if (end - p < 4 || (p[1] & 0xC0) != 0x80 || (p[2] & 0xC0) != 0x80 ||
+          (p[3] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xF0 && p[1] < 0x90) return false;            // overlong
+      if (c > 0xF4 || (c == 0xF4 && p[1] >= 0x90)) return false;  // >10FFFF
+      p += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// -- little-endian append helpers (the MeSink / gateway wire formats) ------
+
+void put_u8(std::string* out, uint8_t v) { out->push_back(static_cast<char>(v)); }
+void put_u16(std::string* out, uint16_t v) {
+  out->push_back(static_cast<char>(v & 0xff));
+  out->push_back(static_cast<char>(v >> 8));
+}
+void put_u32(std::string* out, uint32_t v) {
+  for (int i = 0; i < 4; i++) out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+void put_u64(std::string* out, uint64_t v) {
+  for (int i = 0; i < 8; i++) out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+void put_i32(std::string* out, int32_t v) { put_u32(out, static_cast<uint32_t>(v)); }
+void put_i64(std::string* out, long long v) { put_u64(out, static_cast<uint64_t>(v)); }
+void put_str(std::string* out, const std::string& s) {
+  put_u16(out, static_cast<uint16_t>(s.size()));
+  out->append(s);
+}
+
+std::string render_oid(long long n) { return "OID-" + std::to_string(n); }
+
+// Canonical "OID-<n>" parse: only the exact string Python's dict key path
+// would match (no leading zeros, digits only) resolves. Returns -1 on
+// non-canonical input (== unknown order id).
+long long parse_oid(const std::string& s) {
+  if (s.size() < 5 || s.size() > 4 + 19 || s.compare(0, 4, "OID-") != 0)
+    return -1;
+  if (s[4] == '0') return -1;  // oids start at 1; canonical has no zeros
+  long long v = 0;
+  for (size_t i = 4; i < s.size(); i++) {
+    char c = s[i];
+    if (c < '0' || c > '9') return -1;
+    if (v > (9223372036854775807LL - (c - '0')) / 10) return -1;
+    v = v * 10 + (c - '0');
+  }
+  return v;
+}
+
+// -- directory entry --------------------------------------------------------
+
+struct LaneOrder {
+  long long oid = 0;      // "OID-<oid>"
+  std::string client_id;  // raw bytes (validated UTF-8)
+  std::string symbol;
+  int32_t side = 0;
+  int32_t otype = 0;
+  int32_t price_q4 = 0;
+  int32_t handle = 0;
+  long long quantity = 0;
+  long long remaining = 0;
+  int32_t status = 0;
+};
+using OrderPtr = std::shared_ptr<LaneOrder>;
+
+// -- per-dispatch context ---------------------------------------------------
+
+struct CtxOp {
+  uint64_t tag = 0;
+  int op = 0;  // engine op: kOpSubmit / kOpCancel / kOpAmend
+  OrderPtr target;
+  // Frozen lane payload (HostOrder fields, engine_runner._stage_locked):
+  int32_t dev_op = 0, side = 0, otype = 0, price = 0;
+  long long qty = 0;
+  int32_t owner = 0;
+  int32_t slot = -1, row = -1, wave = -1;  // wave < 0: not device-bound
+  // Outcome (stage reject or device result):
+  bool has_outcome = false;
+  int32_t status = 0;
+  long long filled = 0, remaining = 0;
+  std::string error;
+};
+
+struct ImmReject {  // host reject completed before any device work
+  uint64_t tag = 0;
+  int kind = 0;  // 0 submit / 1 cancel / 2 amend
+  std::string order_id, error;
+};
+
+struct Ctx {
+  std::vector<CtxOp> ops;        // device-bound EngineOps, record order
+  std::vector<int> outcome_order;  // op indices in res.outcomes order
+  std::vector<ImmReject> imm;
+  bool build_ou = false, build_md = false;
+  int shape = 1;  // 0 sparse / 1 dense
+  int n_waves = 0;
+  int n_lanes = 0;  // host_orders length (device lanes)
+  std::vector<int> wave_n, wave_k;
+  std::vector<std::vector<int>> wave_order;  // per wave, op idx by (slot,row)
+  int decode_cursor = 0;
+
+  // Accumulated outputs (storage sections in MeSink wire order):
+  std::string store_orders, store_updates, store_fills;
+  uint32_t n_store_orders = 0, n_updates = 0, n_fills = 0;
+  std::string aux_ou;
+  uint32_t n_ou = 0;
+  std::vector<std::pair<std::string, int32_t>> new_owners;
+  std::vector<std::pair<std::string, long long>> recon;
+  std::set<int32_t> terminal_makers;  // ascending == Python sorted()
+  // Market data: sparse = first-touch insertion order; dense = sorted set
+  // + the LAST wave's [4, S] top-of-book block.
+  std::vector<int32_t> md_slots;
+  std::unordered_map<int32_t, std::array<int32_t, 4>> md_tob;
+  std::set<int32_t> dense_touched;
+  std::vector<int32_t> dense_tob;  // [4 * S] from the last decoded wave
+  // Slot-directory deltas for the Python mirror:
+  std::vector<std::pair<int32_t, std::string>> slot_allocs;
+  std::vector<int32_t> slot_releases;
+  // Counters (aux layout; indices documented in native/__init__.py):
+  long long fill_count = 0, overflow_waves = 0;
+  long long accepted = 0, rejected = 0, canceled = 0, amended = 0;
+  long long owner_overflow = 0, owner_collisions = 0;
+  // Assembled at finish, copied at take:
+  std::string comp_buf, store_buf, aux_buf;
+  bool finished = false;
+};
+
+// ---------------------------------------------------------------------------
+// MeLanes engine
+// ---------------------------------------------------------------------------
+
+class MeLanes {
+ public:
+  MeLanes(int32_t num_symbols, int32_t batch, int32_t fill_inline,
+          int32_t max_fills)
+      : S_(num_symbols), B_(batch), L_(fill_inline), max_fills_(max_fills) {
+    slot_symbols_.resize(S_);
+    slot_live_.assign(S_, 0);
+  }
+
+  // -- allocators (mirror EngineRunner._id_lock state) ---------------------
+
+  int32_t alloc_handle() {
+    if (!free_handles_.empty()) {
+      int32_t h = free_handles_.back();
+      free_handles_.pop_back();
+      return h;
+    }
+    if (next_handle_ >= 2147483647) return -1;  // runner raises; build fails
+    return next_handle_++;
+  }
+
+  // symbol_slot + live-count acquire (EngineRunner.slot_acquire); records
+  // a fresh allocation into ctx for the Python slot-map mirror.
+  int32_t slot_acquire(const std::string& sym, Ctx* ctx) {
+    auto it = symbols_.find(sym);
+    int32_t slot;
+    if (it != symbols_.end()) {
+      slot = it->second;
+    } else {
+      if (!free_slots_.empty()) {
+        slot = free_slots_.back();
+        free_slots_.pop_back();
+      } else if (next_slot_ < S_) {
+        slot = next_slot_++;
+      } else {
+        return -1;
+      }
+      symbols_[sym] = slot;
+      slot_symbols_[slot] = sym;
+      if (ctx) ctx->slot_allocs.emplace_back(slot, sym);
+    }
+    slot_live_[slot] += 1;
+    return slot;
+  }
+
+  void slot_release(int32_t slot, Ctx* ctx, int32_t* released) {
+    slot_live_[slot] -= 1;
+    if (slot_live_[slot] == 0) {
+      const std::string& sym = slot_symbols_[slot];
+      if (!sym.empty()) {
+        symbols_.erase(sym);
+        slot_symbols_[slot].clear();
+        free_slots_.push_back(slot);
+        if (ctx) ctx->slot_releases.push_back(slot);
+        if (released) *released = slot;
+      }
+    }
+  }
+
+  // EngineRunner._owner_for: crc32 first candidate, linear probe past
+  // claimed ids, registry cap with unregistered probing.
+  int32_t owner_for(const std::string& cid, Ctx* ctx) {
+    if (cid.empty()) return 0;
+    auto it = owner_by_client_.find(cid);
+    if (it != owner_by_client_.end()) return it->second;
+    uint32_t h = static_cast<uint32_t>(
+        crc32(0, reinterpret_cast<const unsigned char*>(cid.data()),
+              static_cast<unsigned int>(cid.size())));
+    int32_t owner = static_cast<int32_t>(h & 0x7FFFFFFF);
+    if (owner == 0) owner = 1;
+    if (static_cast<long long>(owner_by_client_.size()) >= kOwnerRegistryCap) {
+      ctx->owner_overflow++;
+      while (owner_claimed_.count(owner) || owner == 0)
+        owner = (owner + 1) & 0x7FFFFFFF;
+      return owner;  // unregistered past the cap (counted residual risk)
+    }
+    if (owner_claimed_.count(owner)) {
+      ctx->owner_collisions++;
+      const std::string& first = owner_claimed_[owner];
+      int32_t orig = owner;
+      while (owner_claimed_.count(owner) || owner == 0)
+        owner = (owner + 1) & 0x7FFFFFFF;
+      std::fprintf(stderr,
+                   "[me_lanes] owner_hash collision: %.64s vs %.64s; "
+                   "remapped %d -> %d\n",
+                   cid.c_str(), first.c_str(), orig, owner);
+    }
+    owner_by_client_[cid] = owner;
+    owner_claimed_[owner] = cid;
+    ctx->new_owners.emplace_back(cid, owner);
+    return owner;
+  }
+
+  // -- build ---------------------------------------------------------------
+
+  // Returns n_waves (>= 0) and stages a dispatch context, or -1 on a
+  // malformed record / allocator exhaustion (caller fails the batch).
+  int build(const MeGwOp* recs, uint32_t n, int build_ou, int build_md,
+            int32_t* flags, int32_t* wave_n_out, int32_t* wave_k_out,
+            uint32_t max_waves) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto ctx = std::make_unique<Ctx>();
+    ctx->build_ou = build_ou != 0;
+    ctx->build_md = build_md != 0;
+
+    // Pass 1 — the bridge record loop (gateway_bridge._drain_batch):
+    // host checks + id/slot/handle assignment against the PRE-BATCH
+    // directory (a cancel naming a submit from the same drained batch is
+    // "unknown order id", exactly as in Python, where registration
+    // happens after the whole record loop).
+    struct Planned {
+      int op;
+      uint64_t tag;
+      OrderPtr target;
+      long long amend_qty = 0;
+      int32_t slot = -1;  // submit: acquired in this pass
+    };
+    std::vector<Planned> planned;
+    planned.reserve(n);
+    std::vector<OrderPtr> fresh;  // registered in pass 2
+
+    for (uint32_t i = 0; i < n; i++) {
+      const MeGwOp& r = recs[i];
+      if (r.symbol_len < 0 || r.symbol_len > (int)sizeof(r.symbol) ||
+          r.client_id_len < 0 || r.client_id_len > (int)sizeof(r.client_id) ||
+          r.order_id_len < 0 || r.order_id_len > (int)sizeof(r.order_id))
+        return -1;
+      int kind = r.op == 1 ? 0 : (r.op == 3 ? 2 : 1);
+      if (!utf8_valid(r.symbol, r.symbol_len) ||
+          !utf8_valid(r.client_id, r.client_id_len) ||
+          !utf8_valid(r.order_id, r.order_id_len)) {
+        ctx->rejected++;
+        ctx->imm.push_back({r.tag, kind, "", "invalid request encoding"});
+        continue;
+      }
+      std::string client_id(r.client_id, r.client_id_len);
+      if (r.op == 1) {  // submit (already validated at the edge)
+        std::string symbol(r.symbol, r.symbol_len);
+        if (auction_mode_ && r.otype != 0) {
+          ctx->rejected++;
+          ctx->imm.push_back(
+              {r.tag, 0, "",
+               "only GTC LIMIT orders are accepted during an auction call "
+               "period"});
+          continue;
+        }
+        int32_t slot = slot_acquire(symbol, ctx.get());
+        if (slot < 0) {
+          ctx->rejected++;
+          ctx->imm.push_back(
+              {r.tag, 0, "",
+               "symbol capacity exhausted (engine symbol axis is full)"});
+          continue;
+        }
+        long long oidn = next_oid_++;
+        int32_t h = alloc_handle();
+        if (h < 0) return -1;
+        auto info = std::make_shared<LaneOrder>();
+        info->oid = oidn;
+        info->client_id = std::move(client_id);
+        info->symbol = std::move(symbol);
+        info->side = r.side;
+        info->otype = r.otype;
+        info->price_q4 = r.price_q4;
+        info->handle = h;
+        info->quantity = r.quantity;
+        info->remaining = r.quantity;
+        info->status = kNew;
+        fresh.push_back(info);
+        planned.push_back({kOpSubmit, r.tag, std::move(info), 0, slot});
+      } else {  // cancel / amend: directory checks as the bridge does
+        std::string order_id(r.order_id, r.order_id_len);
+        const char* which = r.op == 3 ? "amend" : "cancel";
+        (void)which;
+        long long oidn = parse_oid(order_id);
+        auto dit = oidn >= 0 ? by_oid_.find(oidn) : by_oid_.end();
+        if (dit == by_oid_.end()) {
+          ctx->imm.push_back({r.tag, r.op == 3 ? 2 : 1, order_id,
+                              "unknown order id"});
+          continue;
+        }
+        OrderPtr target = dit->second;
+        if (target->client_id != client_id) {
+          ctx->imm.push_back({r.tag, r.op == 3 ? 2 : 1, order_id,
+                              "order belongs to a different client"});
+          continue;
+        }
+        if (r.op == 3) {
+          planned.push_back({kOpAmend, r.tag, std::move(target), r.quantity, -1});
+        } else {
+          planned.push_back({kOpCancel, r.tag, std::move(target), 0, -1});
+        }
+      }
+    }
+
+    // Pass 2 — the runner stage loop (engine_runner._stage_locked): the
+    // terminal-target guard, auction-mode classification, lane placement,
+    // owner assignment, eager registration. A mid-pass failure unwinds the
+    // eager registrations (the _rollback_registrations policy: directory
+    // entries go, consumed handles/oids stay unrecycled).
+    auto fail_build = [&]() {
+      for (const OrderPtr& f : fresh) {
+        by_handle_.erase(f->handle);
+        by_oid_.erase(f->oid);
+      }
+      return -1;
+    };
+    std::vector<int64_t> counts(S_, 0);
+    int n_waves = 0;
+    for (auto& p : planned) {
+      CtxOp op;
+      op.tag = p.tag;
+      op.op = p.op;
+      op.target = p.target;
+      LaneOrder& info = *p.target;
+      if ((p.op == kOpCancel || p.op == kOpAmend) &&
+          (info.status == kFilled || info.status == kCanceled ||
+           info.status == kRejected)) {
+        // Target went terminal after this op was enqueued: reject on the
+        // host, the device never sees a stale handle.
+        op.has_outcome = true;
+        op.status = kRejected;
+        op.error = "order not open";
+        ctx->ops.push_back(std::move(op));
+        ctx->outcome_order.push_back(static_cast<int>(ctx->ops.size()) - 1);
+        continue;
+      }
+      int32_t slot = p.slot;
+      if (slot < 0) {
+        auto sit = symbols_.find(info.symbol);
+        if (sit == symbols_.end()) return fail_build();  // caller bug
+        slot = sit->second;
+      }
+      op.dev_op = (p.op == kOpSubmit && auction_mode_) ? kOpRest : p.op;
+      op.side = info.side;
+      op.otype = info.otype;
+      op.price = info.price_q4;
+      long long qty = p.op == kOpAmend ? p.amend_qty
+                      : p.op == kOpCancel ? 0
+                                          : info.remaining;
+      if (qty < INT32_MIN || qty > INT32_MAX) return fail_build();  // i32 lane
+      op.qty = qty;
+      op.owner = owner_for(info.client_id, ctx.get());
+      op.slot = slot;
+      op.wave = static_cast<int>(counts[slot] / B_);
+      op.row = static_cast<int>(counts[slot] % B_);
+      counts[slot] += 1;
+      if (op.wave + 1 > n_waves) n_waves = op.wave + 1;
+      ctx->n_lanes += 1;
+      if (p.op == kOpSubmit) {
+        by_handle_[info.handle] = p.target;
+        by_oid_[info.oid] = p.target;
+      }
+      ctx->ops.push_back(std::move(op));
+    }
+
+    if (static_cast<uint32_t>(n_waves) > max_waves) return fail_build();
+    ctx->n_waves = n_waves;
+    ctx->shape =
+        (ctx->n_lanes > 0 && ctx->n_lanes * 4 <= S_ * B_) ? 0 : 1;
+    ctx->wave_n.assign(n_waves, 0);
+    ctx->wave_order.assign(n_waves, {});
+    for (size_t i = 0; i < ctx->ops.size(); i++) {
+      const CtxOp& op = ctx->ops[i];
+      if (op.wave < 0) continue;
+      ctx->wave_n[op.wave] += 1;
+      ctx->wave_order[op.wave].push_back(static_cast<int>(i));
+    }
+    ctx->wave_k.assign(n_waves, 0);
+    for (int w = 0; w < n_waves; w++) {
+      auto& order = ctx->wave_order[w];
+      std::sort(order.begin(), order.end(), [&](int a, int b) {
+        const CtxOp& x = ctx->ops[a];
+        const CtxOp& y = ctx->ops[b];
+        return x.slot != y.slot ? x.slot < y.slot : x.row < y.row;
+      });
+      ctx->wave_k[w] = bucket(ctx->wave_n[w]);
+      wave_n_out[w] = ctx->wave_n[w];
+      wave_k_out[w] = ctx->wave_k[w];
+    }
+    flags[0] = ctx->shape;
+    flags[1] = n_waves;
+    flags[2] = ctx->n_lanes;
+    flags[3] = static_cast<int32_t>(ctx->ops.size());
+    ctxs_.push_back(std::move(ctx));
+    return n_waves;
+  }
+
+  // Materialize one wave's lane buffer (sparse [K, 9] / dense [S, B, 7]).
+  int wave(uint32_t w, int32_t* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty()) return -1;
+    Ctx& ctx = *ctxs_.back();  // waves fetched right after build
+    if (w >= static_cast<uint32_t>(ctx.n_waves)) return -1;
+    if (ctx.shape == 0) {
+      int k = ctx.wave_k[w];
+      std::memset(out, 0, sizeof(int32_t) * k * 9);
+      int i = 0;
+      for (int idx : ctx.wave_order[w]) {
+        const CtxOp& op = ctx.ops[idx];
+        int32_t* lane = out + i * 9;
+        lane[0] = op.slot;
+        lane[1] = op.row;
+        lane[2] = op.dev_op;
+        lane[3] = op.side;
+        lane[4] = op.otype;
+        lane[5] = op.price;
+        lane[6] = static_cast<int32_t>(op.qty);
+        lane[7] = op.target->handle;
+        lane[8] = op.owner;
+        i++;
+      }
+      for (; i < k; i++) out[i * 9 + 0] = S_;  // padding: scatter-drop slot
+    } else {
+      std::memset(out, 0, sizeof(int32_t) * S_ * B_ * 7);
+      for (int idx : ctx.wave_order[w]) {
+        const CtxOp& op = ctx.ops[idx];
+        int32_t* lane = out + (op.slot * B_ + op.row) * 7;
+        lane[0] = op.dev_op;
+        lane[1] = op.side;
+        lane[2] = op.otype;
+        lane[3] = op.price;
+        lane[4] = static_cast<int32_t>(op.qty);
+        lane[5] = op.target->handle;
+        lane[6] = op.owner;
+      }
+    }
+    return 0;
+  }
+
+  // -- decode --------------------------------------------------------------
+
+  // Consumes the OLDEST staged dispatch's next wave. Returns the wave's
+  // fill count, -2 when the fill log exceeded the inline segment and the
+  // caller must re-call with the full [5, max_fills] buffer, -1 on error.
+  long long decode_wave(const int32_t* small, long long small_len,
+                        const int32_t* fills, long long fills_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty()) return -1;
+    Ctx& ctx = *ctxs_.front();
+    if (ctx.decode_cursor >= ctx.n_waves) return -1;
+    int w = ctx.decode_cursor;
+    int k = ctx.shape == 0 ? ctx.wave_k[w] : 0;
+    long long expect = ctx.shape == 0
+                           ? 7LL * k + 2 + 5LL * L_
+                           : 3LL * S_ * B_ + 4LL * S_ + 2 + 5LL * L_;
+    if (small_len != expect) return -1;
+    long long meta = ctx.shape == 0 ? 7LL * k : 3LL * S_ * B_ + 4LL * S_;
+    long long fc = small[meta];
+    bool overflow = small[meta + 1] != 0;
+    const int32_t* frows[5];
+    long long fstride;
+    if (fc <= L_) {
+      for (int r = 0; r < 5; r++) frows[r] = small + meta + 2 + r * L_;
+      fstride = 1;  // rows are contiguous [5, L]
+      (void)fstride;
+    } else {
+      if (fills == nullptr) return -2;  // caller fetches the full buffer
+      if (fills_len != 5LL * max_fills_) return -1;
+      for (int r = 0; r < 5; r++) frows[r] = fills + r * max_fills_;
+    }
+    if (fc < 0 || fc > max_fills_) return -1;
+    if (overflow) ctx.overflow_waves += 1;
+
+    // Group fills by taker handle, preserving order (fills_by_taker).
+    std::unordered_map<int32_t, std::vector<int>> fills_by_taker;
+    for (long long j = 0; j < fc; j++)
+      fills_by_taker[frows[1][j]].push_back(static_cast<int>(j));
+
+    const int32_t* p_status;
+    const int32_t* p_filled;
+    const int32_t* p_remaining;
+    if (ctx.shape == 0) {
+      p_status = small;
+      p_filled = small + k;
+      p_remaining = small + 2 * k;
+    } else {
+      p_status = small;
+      p_filled = small + S_ * B_;
+      p_remaining = small + 2 * S_ * B_;
+    }
+
+    int lane_i = 0;
+    for (int idx : ctx.wave_order[w]) {
+      CtxOp& e = ctx.ops[idx];
+      long long pos = ctx.shape == 0 ? lane_i : e.slot * B_ + e.row;
+      lane_i++;
+      int32_t status = p_status[pos];
+      long long filled = p_filled[pos];
+      long long remaining = p_remaining[pos];
+      LaneOrder& info = *e.target;
+      if (e.op == kOpSubmit) {
+        info.status = status;
+        info.remaining = remaining;
+        e.has_outcome = true;
+        e.status = status;
+        e.filled = filled;
+        e.remaining = remaining;
+        if (status == kRejected) {
+          e.error = filled == 0
+                        ? "book side at capacity"
+                        : "partially filled; remainder rejected (book side "
+                          "at capacity)";
+        }
+        ctx.outcome_order.push_back(idx);
+        // Storage order row (engine_runner storage_orders tuple order).
+        std::string oid_s = render_oid(info.oid);
+        put_str(&ctx.store_orders, oid_s);
+        put_str(&ctx.store_orders, info.client_id);
+        put_str(&ctx.store_orders, info.symbol);
+        bool has_price = !(info.otype == kMarket || info.otype == kMarketFok);
+        put_u8(&ctx.store_orders, static_cast<uint8_t>(info.side));
+        put_u8(&ctx.store_orders, static_cast<uint8_t>(info.otype));
+        put_u8(&ctx.store_orders, has_price ? 1 : 0);
+        put_i64(&ctx.store_orders, has_price ? info.price_q4 : 0);
+        put_i64(&ctx.store_orders, info.quantity);
+        put_i64(&ctx.store_orders, info.remaining);
+        put_u8(&ctx.store_orders, static_cast<uint8_t>(info.status));
+        ctx.n_store_orders++;
+        // Taker fills + maker bookkeeping, in priority order.
+        auto fbt = fills_by_taker.find(info.handle);
+        long long decoded_qty = 0;
+        if (fbt != fills_by_taker.end())
+          for (int j : fbt->second) decoded_qty += frows[4][j];
+        if (decoded_qty < filled)
+          ctx.recon.emplace_back(oid_s, filled - decoded_qty);
+        long long rem = info.quantity;
+        if (fbt != fills_by_taker.end()) {
+          for (int j : fbt->second) {
+            int32_t fprice = frows[3][j];
+            long long fqty = frows[4][j];
+            rem -= fqty;
+            if (ctx.build_ou) {
+              int32_t st = (rem == 0 && info.remaining == 0)
+                               ? kFilled
+                               : kPartiallyFilled;
+              emit_ou(&ctx, info, st, fprice, fqty, rem);
+            }
+            auto mit = by_handle_.find(frows[2][j]);
+            if (mit == by_handle_.end()) continue;
+            LaneOrder& maker = *mit->second;
+            maker.remaining -= fqty;
+            maker.status =
+                maker.remaining == 0 ? kFilled : kPartiallyFilled;
+            if (maker.remaining == 0)
+              ctx.terminal_makers.insert(maker.handle);
+            std::string moid = render_oid(maker.oid);
+            put_str(&ctx.store_fills, oid_s);
+            put_str(&ctx.store_fills, moid);
+            put_i64(&ctx.store_fills, fprice);
+            put_i64(&ctx.store_fills, fqty);
+            put_i64(&ctx.store_fills, 0);  // ts: FillRow default
+            ctx.n_fills++;
+            put_str(&ctx.store_updates, moid);
+            put_u8(&ctx.store_updates, static_cast<uint8_t>(maker.status));
+            put_i64(&ctx.store_updates, maker.remaining);
+            put_u8(&ctx.store_updates, 0);
+            put_i64(&ctx.store_updates, 0);
+            ctx.n_updates++;
+            if (ctx.build_ou)
+              emit_ou(&ctx, maker, maker.status, fprice, fqty,
+                      maker.remaining);
+          }
+        }
+        if (ctx.build_ou &&
+            (status == kNew || status == kCanceled || status == kRejected))
+          emit_ou(&ctx, info, status, 0, 0, remaining);
+      } else if (e.op == kOpAmend) {
+        e.has_outcome = true;
+        if (status == kNew) {
+          long long filled_so_far = info.quantity - info.remaining;
+          info.remaining = remaining;
+          info.quantity = filled_so_far + remaining;
+          e.status = kNew;
+          e.filled = 0;
+          e.remaining = remaining;
+          ctx.outcome_order.push_back(idx);
+          std::string oid_s = render_oid(info.oid);
+          put_str(&ctx.store_updates, oid_s);
+          put_u8(&ctx.store_updates, static_cast<uint8_t>(info.status));
+          put_i64(&ctx.store_updates, info.remaining);
+          put_u8(&ctx.store_updates, 1);  // amend: quantity moves too
+          put_i64(&ctx.store_updates, info.quantity);
+          ctx.n_updates++;
+          if (ctx.build_ou)
+            emit_ou(&ctx, info, info.status, 0, 0, remaining);
+        } else {
+          e.status = kRejected;
+          e.filled = 0;
+          e.remaining = 0;
+          e.error =
+              "amend rejected (must strictly reduce an open order's "
+              "quantity)";
+          ctx.outcome_order.push_back(idx);
+        }
+      } else {  // cancel
+        e.has_outcome = true;
+        if (status == kCanceled) {
+          info.status = kCanceled;
+          info.remaining = 0;
+          e.status = kCanceled;
+          e.filled = 0;
+          e.remaining = remaining;
+          ctx.outcome_order.push_back(idx);
+          std::string oid_s = render_oid(info.oid);
+          put_str(&ctx.store_updates, oid_s);
+          put_u8(&ctx.store_updates, static_cast<uint8_t>(kCanceled));
+          put_i64(&ctx.store_updates, 0);
+          put_u8(&ctx.store_updates, 0);
+          put_i64(&ctx.store_updates, 0);
+          ctx.n_updates++;
+          if (ctx.build_ou) emit_ou(&ctx, info, kCanceled, 0, 0, 0);
+        } else {
+          e.status = kRejected;
+          e.filled = 0;
+          e.remaining = 0;
+          e.error = "order not open";
+          ctx.outcome_order.push_back(idx);
+        }
+      }
+    }
+
+    // Market data accumulation.
+    if (ctx.build_md) {
+      if (ctx.shape == 0) {
+        int i = 0;
+        for (int idx : ctx.wave_order[w]) {
+          const CtxOp& e = ctx.ops[idx];
+          std::array<int32_t, 4> tob = {small[3 * k + i], small[4 * k + i],
+                                        small[5 * k + i], small[6 * k + i]};
+          auto it = ctx.md_tob.find(e.slot);
+          if (it == ctx.md_tob.end()) {
+            ctx.md_slots.push_back(e.slot);  // first-touch insertion order
+            ctx.md_tob[e.slot] = tob;
+          } else {
+            it->second = tob;  // later waves overwrite
+          }
+          i++;
+        }
+      } else {
+        for (int idx : ctx.wave_order[w])
+          ctx.dense_touched.insert(ctx.ops[idx].slot);
+        const int32_t* base = small + 3 * S_ * B_;
+        ctx.dense_tob.assign(base, base + 4 * S_);  // last wave wins
+      }
+    }
+    ctx.fill_count += fc;
+    ctx.decode_cursor += 1;
+    return fc;
+  }
+
+  // -- finish / take -------------------------------------------------------
+
+  int finish(long long* comp_len, long long* store_len, long long* aux_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty()) return -1;
+    Ctx& ctx = *ctxs_.front();
+    if (ctx.decode_cursor != ctx.n_waves || ctx.finished) return -1;
+
+    // Aux: market data FIRST (built pre-eviction, like finalize_fn running
+    // before _evict_terminal), then slot deltas etc.
+    std::string md;
+    uint32_t n_md = 0;
+    if (ctx.build_md) {
+      if (ctx.shape == 0) {
+        for (int32_t slot : ctx.md_slots) {
+          const auto& t = ctx.md_tob[slot];
+          put_i32(&md, slot);
+          for (int v = 0; v < 4; v++) put_i32(&md, t[v]);
+          n_md++;
+        }
+      } else if (!ctx.dense_tob.empty()) {
+        for (int32_t slot : ctx.dense_touched) {  // ascending == sorted()
+          put_i32(&md, slot);
+          put_i32(&md, ctx.dense_tob[slot]);            // best_bid
+          put_i32(&md, ctx.dense_tob[S_ + slot]);       // bid_size
+          put_i32(&md, ctx.dense_tob[2 * S_ + slot]);   // best_ask
+          put_i32(&md, ctx.dense_tob[3 * S_ + slot]);   // ask_size
+          n_md++;
+        }
+      }
+    }
+
+    // Eviction (engine_runner._evict_terminal): ops in record order, then
+    // terminal makers in ascending handle order.
+    for (const CtxOp& e : ctx.ops) {
+      const LaneOrder& info = *e.target;
+      if (e.op == kOpSubmit &&
+          (info.status == kFilled || info.status == kCanceled ||
+           info.status == kRejected)) {
+        evict_locked(info.handle, &ctx);
+      } else if (e.op == kOpCancel && info.status == kCanceled) {
+        evict_locked(info.handle, &ctx);
+      }
+    }
+    for (int32_t h : ctx.terminal_makers) {
+      auto it = by_handle_.find(h);
+      if (it != by_handle_.end() &&
+          (it->second->status == kFilled || it->second->status == kCanceled ||
+           it->second->status == kRejected))
+        evict_locked(h, &ctx);
+    }
+
+    // Completion buffers. The gateway batch (kinds 0/1, low tags) uses the
+    // me_gateway_complete_batch wire format; amend and local (bit-63 tag)
+    // completions ride aux sections the bridge resolves itself.
+    std::string comp, aux_amend, aux_local;
+    uint32_t n_comp = 0, n_amend = 0, n_local = 0;
+    auto emit_comp = [&](uint64_t tag, int kind, bool ok,
+                         const std::string& oid, const std::string& err,
+                         long long remaining) {
+      if (tag & (1ULL << 63)) {
+        put_u64(&aux_local, tag);
+        put_u8(&aux_local, static_cast<uint8_t>(kind));
+        put_u8(&aux_local, ok ? 1 : 0);
+        put_i64(&aux_local, remaining);
+        put_str(&aux_local, oid);
+        put_str(&aux_local, err);
+        n_local++;
+      } else if (kind == 2) {
+        put_u64(&aux_amend, tag);
+        put_u8(&aux_amend, ok ? 1 : 0);
+        put_i64(&aux_amend, remaining);
+        put_str(&aux_amend, oid);
+        put_str(&aux_amend, err);
+        n_amend++;
+      } else {
+        put_u64(&comp, tag);
+        put_u8(&comp, static_cast<uint8_t>(kind));
+        put_u8(&comp, ok ? 1 : 0);
+        put_str(&comp, oid);
+        put_str(&comp, err);
+        n_comp++;
+      }
+    };
+    for (const ImmReject& r : ctx.imm)
+      emit_comp(r.tag, r.kind, false, r.order_id, r.error, 0);
+    for (int idx : ctx.outcome_order) {
+      CtxOp& e = ctx.ops[idx];
+      std::string oid = render_oid(e.target->oid);
+      if (e.op == kOpAmend) {
+        bool ok = e.status == kNew;
+        if (ok) ctx.amended++;
+        emit_comp(e.tag, 2, ok, oid,
+                  ok ? "" : (e.error.empty() ? "amend rejected" : e.error),
+                  e.remaining);
+      } else if (e.op != kOpCancel) {
+        if (e.status == kRejected && !e.error.empty()) {
+          ctx.rejected++;
+          emit_comp(e.tag, 0, false, oid, e.error, 0);
+        } else {
+          ctx.accepted++;
+          emit_comp(e.tag, 0, true, oid, "", 0);
+        }
+      } else {
+        if (e.status == kCanceled) {
+          ctx.canceled++;
+          emit_comp(e.tag, 1, true, oid, "", 0);
+        } else {
+          emit_comp(e.tag, 1, false, oid,
+                    e.error.empty() ? "order not open" : e.error, 0);
+        }
+      }
+      e.has_outcome = true;
+    }
+    for (CtxOp& e : ctx.ops) {  // ops the decode missed: fail loudly
+      if (e.has_outcome) continue;
+      std::string oid = render_oid(e.target->oid);
+      if (e.op == kOpAmend)
+        emit_comp(e.tag, 2, false, oid, "op produced no outcome", 0);
+      else
+        emit_comp(e.tag, e.op == kOpCancel ? 1 : 0, false, oid,
+                  "op produced no outcome", 0);
+    }
+
+    ctx.comp_buf.clear();
+    put_u32(&ctx.comp_buf, n_comp);
+    ctx.comp_buf += comp;
+
+    ctx.store_buf.clear();
+    put_u32(&ctx.store_buf, ctx.n_store_orders);
+    ctx.store_buf += ctx.store_orders;
+    put_u32(&ctx.store_buf, ctx.n_updates);
+    ctx.store_buf += ctx.store_updates;
+    put_u32(&ctx.store_buf, ctx.n_fills);
+    ctx.store_buf += ctx.store_fills;
+
+    // Aux assembly (layout mirrored by native.__init__.parse_lane_aux).
+    std::string& aux = ctx.aux_buf;
+    aux.clear();
+    const long long counters[13] = {
+        static_cast<long long>(ctx.ops.size()),  // engine_ops
+        ctx.accepted, ctx.rejected, ctx.canceled, ctx.amended,
+        ctx.fill_count, ctx.overflow_waves,
+        ctx.shape, ctx.n_lanes, ctx.n_waves,
+        ctx.owner_overflow, ctx.owner_collisions,
+        static_cast<long long>(ctx.recon.size())};
+    put_u32(&aux, 13);
+    for (long long c : counters) put_i64(&aux, c);
+    put_u32(&aux, static_cast<uint32_t>(ctx.slot_allocs.size()));
+    for (auto& [slot, sym] : ctx.slot_allocs) {
+      put_i32(&aux, slot);
+      put_str(&aux, sym);
+    }
+    put_u32(&aux, static_cast<uint32_t>(ctx.slot_releases.size()));
+    for (int32_t slot : ctx.slot_releases) put_i32(&aux, slot);
+    put_u32(&aux, static_cast<uint32_t>(ctx.new_owners.size()));
+    for (auto& [cid, owner] : ctx.new_owners) {
+      put_str(&aux, cid);
+      put_i32(&aux, owner);
+    }
+    put_u32(&aux, static_cast<uint32_t>(ctx.recon.size()));
+    for (auto& [oid, qty] : ctx.recon) {
+      put_str(&aux, oid);
+      put_i64(&aux, qty);
+    }
+    put_u32(&aux, n_md);
+    aux += md;
+    put_u32(&aux, n_amend);
+    aux += aux_amend;
+    put_u32(&aux, n_local);
+    aux += aux_local;
+    put_u32(&aux, ctx.n_ou);
+    aux += ctx.aux_ou;
+
+    ctx.finished = true;
+    *comp_len = static_cast<long long>(ctx.comp_buf.size());
+    *store_len = static_cast<long long>(ctx.store_buf.size());
+    *aux_len = static_cast<long long>(ctx.aux_buf.size());
+    return 0;
+  }
+
+  int take(uint8_t* comp, uint8_t* store, uint8_t* aux) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty() || !ctxs_.front()->finished) return -1;
+    Ctx& ctx = *ctxs_.front();
+    std::memcpy(comp, ctx.comp_buf.data(), ctx.comp_buf.size());
+    std::memcpy(store, ctx.store_buf.data(), ctx.store_buf.size());
+    std::memcpy(aux, ctx.aux_buf.data(), ctx.aux_buf.size());
+    ctxs_.pop_front();
+    return 0;
+  }
+
+  // Rollback for a failed dispatch (mirror of _rollback_registrations):
+  // drop directory entries for submits with no outcome; handles/slots are
+  // NOT recycled (maybe-applied on device). newest=1 pops the just-built
+  // context (stage failure), 0 the oldest (decode failure).
+  int abort(int newest) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty()) return -1;
+    Ctx& ctx = newest ? *ctxs_.back() : *ctxs_.front();
+    for (const CtxOp& e : ctx.ops) {
+      if (e.op == kOpSubmit && !e.has_outcome) {
+        by_handle_.erase(e.target->handle);
+        by_oid_.erase(e.target->oid);
+      }
+    }
+    if (newest)
+      ctxs_.pop_back();
+    else
+      ctxs_.pop_front();
+    return 0;
+  }
+
+  // -- out-of-dispatch directory access (snapshots, auctions, adopt) -------
+
+  int get_order(int32_t handle, long long* oid, int32_t* i32s /* [5] */,
+                long long* i64s /* [2] */, char* symbol, int32_t* sym_len,
+                char* client_id, int32_t* cid_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_handle_.find(handle);
+    if (it == by_handle_.end()) return 0;
+    const LaneOrder& o = *it->second;
+    *oid = o.oid;
+    i32s[0] = o.side;
+    i32s[1] = o.otype;
+    i32s[2] = o.price_q4;
+    i32s[3] = o.status;
+    i32s[4] = o.handle;
+    i64s[0] = o.quantity;
+    i64s[1] = o.remaining;
+    std::memcpy(symbol, o.symbol.data(), o.symbol.size());
+    *sym_len = static_cast<int32_t>(o.symbol.size());
+    std::memcpy(client_id, o.client_id.data(), o.client_id.size());
+    *cid_len = static_cast<int32_t>(o.client_id.size());
+    return 1;
+  }
+
+  int32_t lookup(const char* order_id, int32_t len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    long long oidn = parse_oid(std::string(order_id, len));
+    if (oidn < 0) return 0;
+    auto it = by_oid_.find(oidn);
+    return it == by_oid_.end() ? 0 : it->second->handle;
+  }
+
+  int adjust(int32_t handle, long long remaining, int32_t status) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = by_handle_.find(handle);
+    if (it == by_handle_.end()) return 0;
+    it->second->remaining = remaining;
+    it->second->status = status;
+    return 1;
+  }
+
+  int evict(int32_t handle, int32_t* released_slot) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *released_slot = -1;
+    auto it = by_handle_.find(handle);
+    if (it == by_handle_.end()) return 0;
+    OrderPtr o = it->second;
+    by_handle_.erase(it);
+    by_oid_.erase(o->oid);
+    free_handles_.push_back(handle);
+    auto sit = symbols_.find(o->symbol);
+    if (sit != symbols_.end()) slot_release(sit->second, nullptr, released_slot);
+    return 1;
+  }
+
+  void set_auction_mode(int v) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auction_mode_ = v != 0;
+  }
+
+  // Install the Python runner's state (boot migration, and the resync
+  // after a Python-side control-plane mutation such as an auction).
+  // Blob layout built by native.__init__.pack_lane_state; REPLACES all
+  // directory/allocator state (refuses mid-dispatch: staged ctxs hold
+  // OrderPtrs into the directory being replaced).
+  int adopt(const uint8_t* buf, long long len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!ctxs_.empty()) return -2;
+    by_handle_.clear();
+    by_oid_.clear();
+    free_handles_.clear();
+    symbols_.clear();
+    slot_symbols_.assign(S_, std::string());
+    slot_live_.assign(S_, 0);
+    free_slots_.clear();
+    owner_by_client_.clear();
+    owner_claimed_.clear();
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + len;
+    auto rd_u16 = [&](uint16_t* v) {
+      if (p + 2 > end) return false;
+      std::memcpy(v, p, 2);
+      p += 2;
+      return true;
+    };
+    auto rd_u32 = [&](uint32_t* v) {
+      if (p + 4 > end) return false;
+      std::memcpy(v, p, 4);
+      p += 4;
+      return true;
+    };
+    auto rd_i32 = [&](int32_t* v) { return rd_u32(reinterpret_cast<uint32_t*>(v)); };
+    auto rd_i64 = [&](long long* v) {
+      if (p + 8 > end) return false;
+      std::memcpy(v, p, 8);
+      p += 8;
+      return true;
+    };
+    auto rd_str = [&](std::string* s) {
+      uint16_t n;
+      if (!rd_u16(&n) || p + n > end) return false;
+      s->assign(reinterpret_cast<const char*>(p), n);
+      p += n;
+      return true;
+    };
+    uint32_t version, count;
+    if (!rd_u32(&version) || version != 1) return -1;
+    if (!rd_i64(&next_oid_) || !rd_i32(&next_handle_)) return -1;
+    if (!rd_u32(&count)) return -1;
+    free_handles_.assign(count, 0);
+    for (uint32_t i = 0; i < count; i++)
+      if (!rd_i32(&free_handles_[i])) return -1;
+    if (!rd_i32(&next_slot_) || !rd_u32(&count)) return -1;
+    free_slots_.assign(count, 0);
+    for (uint32_t i = 0; i < count; i++)
+      if (!rd_i32(&free_slots_[i])) return -1;
+    if (!rd_u32(&count)) return -1;
+    for (uint32_t i = 0; i < count; i++) {
+      int32_t slot;
+      long long live;
+      std::string sym;
+      if (!rd_i32(&slot) || !rd_i64(&live) || !rd_str(&sym)) return -1;
+      if (slot < 0 || slot >= S_) return -1;
+      symbols_[sym] = slot;
+      slot_symbols_[slot] = sym;
+      slot_live_[slot] = live;
+    }
+    if (!rd_u32(&count)) return -1;
+    for (uint32_t i = 0; i < count; i++) {
+      std::string cid;
+      int32_t owner;
+      if (!rd_str(&cid) || !rd_i32(&owner)) return -1;
+      owner_by_client_[cid] = owner;
+      owner_claimed_[owner] = cid;
+    }
+    if (!rd_u32(&count)) return -1;
+    for (uint32_t i = 0; i < count; i++) {
+      auto o = std::make_shared<LaneOrder>();
+      if (!rd_i32(&o->handle) || !rd_i64(&o->oid) || !rd_str(&o->client_id) ||
+          !rd_str(&o->symbol) || !rd_i32(&o->side) || !rd_i32(&o->otype) ||
+          !rd_i32(&o->price_q4) || !rd_i64(&o->quantity) ||
+          !rd_i64(&o->remaining) || !rd_i32(&o->status))
+        return -1;
+      by_handle_[o->handle] = o;
+      by_oid_[o->oid] = o;
+    }
+    int32_t amode;
+    if (!rd_i32(&amode)) return -1;
+    auction_mode_ = amode != 0;
+    return 0;
+  }
+
+  // Full state dump in the adopt() blob format (dump -> adopt round-trips
+  // bit-identically; the Python mirror refresh before a control-plane
+  // mutation parses the same layout). Two-call protocol like dump_slots:
+  // nullptr/short cap returns the needed size. Deterministic: orders by
+  // ascending handle, symbols by ascending slot; free lists keep their
+  // LIFO stack order (future handle/slot assignment depends on it).
+  long long dump_state(uint8_t* out, long long cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string buf;
+    put_u32(&buf, 1);  // version
+    put_i64(&buf, next_oid_);
+    put_i32(&buf, next_handle_);
+    put_u32(&buf, static_cast<uint32_t>(free_handles_.size()));
+    for (int32_t h : free_handles_) put_i32(&buf, h);
+    put_i32(&buf, next_slot_);
+    put_u32(&buf, static_cast<uint32_t>(free_slots_.size()));
+    for (int32_t s : free_slots_) put_i32(&buf, s);
+    put_u32(&buf, static_cast<uint32_t>(symbols_.size()));
+    for (int32_t slot = 0; slot < S_; slot++) {
+      if (slot_symbols_[slot].empty()) continue;
+      put_i32(&buf, slot);
+      put_i64(&buf, slot_live_[slot]);
+      put_str(&buf, slot_symbols_[slot]);
+    }
+    put_u32(&buf, static_cast<uint32_t>(owner_by_client_.size()));
+    {
+      std::vector<const std::string*> cids;
+      cids.reserve(owner_by_client_.size());
+      for (auto it = owner_by_client_.begin(); it != owner_by_client_.end();
+           ++it)
+        cids.push_back(&it->first);
+      std::sort(cids.begin(), cids.end(),
+                [](const std::string* a, const std::string* b) {
+                  return *a < *b;
+                });
+      for (const std::string* cid : cids) {
+        put_str(&buf, *cid);
+        put_i32(&buf, owner_by_client_.at(*cid));
+      }
+    }
+    put_u32(&buf, static_cast<uint32_t>(by_handle_.size()));
+    {
+      std::vector<int32_t> handles;
+      handles.reserve(by_handle_.size());
+      for (auto it = by_handle_.begin(); it != by_handle_.end(); ++it)
+        handles.push_back(it->first);
+      std::sort(handles.begin(), handles.end());
+      for (int32_t h : handles) {
+        const LaneOrder& o = *by_handle_.at(h);
+        put_i32(&buf, o.handle);
+        put_i64(&buf, o.oid);
+        put_str(&buf, o.client_id);
+        put_str(&buf, o.symbol);
+        put_i32(&buf, o.side);
+        put_i32(&buf, o.otype);
+        put_i32(&buf, o.price_q4);
+        put_i64(&buf, o.quantity);
+        put_i64(&buf, o.remaining);
+        put_i32(&buf, o.status);
+      }
+    }
+    put_i32(&buf, auction_mode_ ? 1 : 0);
+    if (out == nullptr || static_cast<long long>(buf.size()) > cap)
+      return static_cast<long long>(buf.size());
+    std::memcpy(out, buf.data(), buf.size());
+    return static_cast<long long>(buf.size());
+  }
+
+  // Full slot-table dump (Python mirror refresh after an abort).
+  long long dump_slots(uint8_t* out, long long cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string buf;
+    put_u32(&buf, static_cast<uint32_t>(symbols_.size()));
+    for (int32_t slot = 0; slot < S_; slot++) {
+      if (slot_symbols_[slot].empty()) continue;
+      put_i32(&buf, slot);
+      put_str(&buf, slot_symbols_[slot]);
+    }
+    if (out == nullptr || static_cast<long long>(buf.size()) > cap)
+      return static_cast<long long>(buf.size());
+    std::memcpy(out, buf.data(), buf.size());
+    return static_cast<long long>(buf.size());
+  }
+
+  void stats(long long* live, long long* next_oid, long long* staged) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *live = static_cast<long long>(by_handle_.size());
+    *next_oid = next_oid_;
+    *staged = static_cast<long long>(ctxs_.size());
+  }
+
+ private:
+  void emit_ou(Ctx* ctx, const LaneOrder& o, int32_t status,
+               long long fill_price, long long fill_qty, long long remaining) {
+    std::string& b = ctx->aux_ou;
+    put_i32(&b, status);
+    put_i64(&b, fill_price);
+    put_i64(&b, fill_qty);
+    put_i64(&b, remaining);
+    put_str(&b, render_oid(o.oid));
+    put_str(&b, o.client_id);
+    put_str(&b, o.symbol);
+    ctx->n_ou++;
+  }
+
+  // EngineRunner._evict: idempotent; handle freed BEFORE the slot check.
+  void evict_locked(int32_t handle, Ctx* ctx) {
+    auto it = by_handle_.find(handle);
+    if (it == by_handle_.end()) return;
+    OrderPtr o = it->second;
+    by_handle_.erase(it);
+    by_oid_.erase(o->oid);
+    free_handles_.push_back(handle);
+    auto sit = symbols_.find(o->symbol);
+    if (sit != symbols_.end()) slot_release(sit->second, ctx, nullptr);
+  }
+
+  const int32_t S_, B_, L_, max_fills_;
+  std::mutex mu_;
+  bool auction_mode_ = false;
+
+  // Directory + allocators (the native twin of EngineRunner's _id_lock
+  // state; LIFO free lists, same as the Python list pop/append).
+  std::unordered_map<int32_t, OrderPtr> by_handle_;
+  std::unordered_map<long long, OrderPtr> by_oid_;
+  long long next_oid_ = 1;
+  int32_t next_handle_ = 1;
+  std::vector<int32_t> free_handles_;
+  std::map<std::string, int32_t> symbols_;
+  std::vector<std::string> slot_symbols_;
+  std::vector<long long> slot_live_;
+  std::vector<int32_t> free_slots_;
+  int32_t next_slot_ = 0;
+  std::unordered_map<std::string, int32_t> owner_by_client_;
+  std::unordered_map<int32_t, std::string> owner_claimed_;
+
+  std::deque<std::unique_ptr<Ctx>> ctxs_;  // staged dispatches, FIFO
+};
+
+// ---------------------------------------------------------------------------
+// GwRing: a standalone MeGwOp ring for the grpcio edge's record dispatcher
+// (same batching-window semantics as the gateway's internal ring).
+// ---------------------------------------------------------------------------
+
+class GwRing {
+ public:
+  explicit GwRing(uint32_t capacity) : cap_(capacity) {}
+
+  bool push(const MeGwOp& op) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (closed_ || q_.size() >= cap_) {
+      dropped_++;
+      return false;
+    }
+    q_.push_back(op);
+    cv_.notify_one();
+    return true;
+  }
+
+  int pop_batch(MeGwOp* out, uint32_t max, uint64_t window_us,
+                int64_t first_wait_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (first_wait_us < 0) {
+      cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    } else if (!cv_.wait_for(lk, std::chrono::microseconds(first_wait_us),
+                             [&] { return closed_ || !q_.empty(); })) {
+      return 0;
+    }
+    if (q_.empty()) return -1;
+    uint32_t n = 0;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::microseconds(window_us);
+    for (;;) {
+      while (n < max && !q_.empty()) {
+        out[n++] = q_.front();
+        q_.pop_front();
+      }
+      if (n >= max || closed_) break;
+      if (cv_.wait_until(lk, deadline,
+                         [&] { return closed_ || !q_.empty(); })) {
+        if (q_.empty()) break;
+        continue;
+      }
+      break;
+    }
+    return static_cast<int>(n);
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    cv_.notify_all();
+  }
+
+  uint64_t dropped() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dropped_;
+  }
+
+ private:
+  const uint32_t cap_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<MeGwOp> q_;
+  bool closed_ = false;
+  uint64_t dropped_ = 0;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI (consumed by matching_engine_tpu/native via ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* me_lanes_create(int32_t num_symbols, int32_t batch, int32_t fill_inline,
+                      int32_t max_fills) {
+  return new MeLanes(num_symbols, batch, fill_inline, max_fills);
+}
+
+void me_lanes_destroy(void* h) { delete static_cast<MeLanes*>(h); }
+
+int me_lanes_build(void* h, const MeGwOp* recs, uint32_t n, int build_ou,
+                   int build_md, int32_t* flags, int32_t* wave_n,
+                   int32_t* wave_k, uint32_t max_waves) {
+  if (!h || (!recs && n)) return -1;
+  return static_cast<MeLanes*>(h)->build(recs, n, build_ou, build_md, flags,
+                                         wave_n, wave_k, max_waves);
+}
+
+int me_lanes_wave(void* h, uint32_t wave, int32_t* out) {
+  if (!h || !out) return -1;
+  return static_cast<MeLanes*>(h)->wave(wave, out);
+}
+
+long long me_lanes_decode_wave(void* h, const int32_t* small,
+                               long long small_len, const int32_t* fills,
+                               long long fills_len) {
+  if (!h || !small) return -1;
+  return static_cast<MeLanes*>(h)->decode_wave(small, small_len, fills,
+                                               fills_len);
+}
+
+int me_lanes_finish(void* h, long long* comp_len, long long* store_len,
+                    long long* aux_len) {
+  if (!h) return -1;
+  return static_cast<MeLanes*>(h)->finish(comp_len, store_len, aux_len);
+}
+
+int me_lanes_take(void* h, uint8_t* comp, uint8_t* store, uint8_t* aux) {
+  if (!h) return -1;
+  return static_cast<MeLanes*>(h)->take(comp, store, aux);
+}
+
+int me_lanes_abort(void* h, int newest) {
+  if (!h) return -1;
+  return static_cast<MeLanes*>(h)->abort(newest);
+}
+
+int me_lanes_get_order(void* h, int32_t handle, long long* oid, int32_t* i32s,
+                       long long* i64s, char* symbol, int32_t* sym_len,
+                       char* client_id, int32_t* cid_len) {
+  if (!h) return 0;
+  return static_cast<MeLanes*>(h)->get_order(handle, oid, i32s, i64s, symbol,
+                                             sym_len, client_id, cid_len);
+}
+
+int32_t me_lanes_lookup(void* h, const char* order_id, int32_t len) {
+  if (!h || !order_id) return 0;
+  return static_cast<MeLanes*>(h)->lookup(order_id, len);
+}
+
+int me_lanes_adjust(void* h, int32_t handle, long long remaining,
+                    int32_t status) {
+  if (!h) return 0;
+  return static_cast<MeLanes*>(h)->adjust(handle, remaining, status);
+}
+
+int me_lanes_evict(void* h, int32_t handle, int32_t* released_slot) {
+  if (!h) return 0;
+  return static_cast<MeLanes*>(h)->evict(handle, released_slot);
+}
+
+void me_lanes_set_auction_mode(void* h, int v) {
+  if (h) static_cast<MeLanes*>(h)->set_auction_mode(v);
+}
+
+int me_lanes_adopt(void* h, const uint8_t* buf, long long len) {
+  if (!h || !buf) return -1;
+  return static_cast<MeLanes*>(h)->adopt(buf, len);
+}
+
+long long me_lanes_dump_slots(void* h, uint8_t* out, long long cap) {
+  if (!h) return -1;
+  return static_cast<MeLanes*>(h)->dump_slots(out, cap);
+}
+
+long long me_lanes_dump_state(void* h, uint8_t* out, long long cap) {
+  if (!h) return -1;
+  return static_cast<MeLanes*>(h)->dump_state(out, cap);
+}
+
+void me_lanes_stats(void* h, long long* live, long long* next_oid,
+                    long long* staged) {
+  if (!h) {
+    *live = *next_oid = *staged = 0;
+    return;
+  }
+  static_cast<MeLanes*>(h)->stats(live, next_oid, staged);
+}
+
+// -- GwRing ----------------------------------------------------------------
+
+void* me_gwring_create(uint32_t capacity) { return new GwRing(capacity); }
+void me_gwring_destroy(void* r) { delete static_cast<GwRing*>(r); }
+int me_gwring_push(void* r, const MeGwOp* op) {
+  if (!r || !op) return 0;
+  return static_cast<GwRing*>(r)->push(*op) ? 1 : 0;
+}
+int me_gwring_pop_batch(void* r, MeGwOp* out, uint32_t max,
+                        uint64_t window_us, int64_t first_wait_us) {
+  if (!r || !out) return -1;
+  return static_cast<GwRing*>(r)->pop_batch(out, max, window_us,
+                                            first_wait_us);
+}
+void me_gwring_close(void* r) {
+  if (r) static_cast<GwRing*>(r)->close();
+}
+uint64_t me_gwring_dropped(void* r) {
+  return r ? static_cast<GwRing*>(r)->dropped() : 0;
+}
+
+}  // extern "C"

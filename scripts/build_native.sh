@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Rebuild the native runtime from source with the same flags the
+# checked-in Makefile uses — the .so files are gitignored build
+# artifacts, and this script is the reproducible path to them
+# (matching_engine_tpu/native/ensure_built auto-builds only the
+# protobuf-free native-lib target; this is the full entry point).
+#
+#   scripts/build_native.sh [--lib-only] [--force] [--out-dir DIR]
+#
+# --lib-only   build just libme_native.so (lane engine + ring + sink;
+#              needs only a C++20 compiler, sqlite3 and zlib sonames)
+# --force      rebuild even if targets look fresh (make -B)
+# --out-dir    emit artifacts into DIR instead of the package tree
+#              (the smoke test builds into a scratch dir so a test run
+#              never swaps the .so under a live process)
+#
+# The gateway library + CLI client additionally need protoc and the
+# protobuf C++ headers; when they are absent those targets are skipped
+# with a notice — the grpcio edge still serves, only the C++ edge is
+# unavailable.
+set -euo pipefail
+
+cd "$(dirname "$0")/../native"
+
+LIB_ONLY=0
+FORCE=()
+PKG_OVERRIDE=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --lib-only) LIB_ONLY=1 ;;
+    --force) FORCE=(-B) ;;
+    --out-dir)
+      shift
+      mkdir -p "$1"
+      # Command-line make variables override the Makefile's PKG :=.
+      PKG_OVERRIDE=("PKG=$(cd "$1" && pwd)")
+      ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+  shift
+done
+
+CXX="${CXX:-g++}"
+command -v "$CXX" >/dev/null || { echo "no C++ compiler ($CXX)" >&2; exit 1; }
+
+make "${FORCE[@]}" "${PKG_OVERRIDE[@]}" native-lib
+echo "built: libme_native.so"
+
+if [ "$LIB_ONLY" = 1 ]; then
+  exit 0
+fi
+
+if command -v protoc >/dev/null; then
+  make "${FORCE[@]}" "${PKG_OVERRIDE[@]}"
+  echo "built: libme_gateway.so me_client"
+else
+  echo "protoc not found: skipping libme_gateway.so / me_client" \
+       "(grpcio edge still serves; install protobuf + protoc to" \
+       "build the C++ gateway edge)" >&2
+fi

@@ -69,7 +69,10 @@ GW="127.0.0.1:$GW_PORT"; PY="127.0.0.1:$PY_PORT"
 "$CLI" auction "$GW" >/dev/null || { echo "FAIL: all-symbols uncross"; exit 1; }
 
 DEADLINE=$(( $(date +%s) + MINUTES * 60 ))
-ROUNDS=0; OK_TOTAL=0; CANCELS=0
+# AMENDS must be initialized with its siblings: the loop runs under
+# `set -u`, and the first `AMENDS=$((AMENDS + 1))` on an unset variable
+# would kill the soak with "unbound variable".
+ROUNDS=0; OK_TOTAL=0; CANCELS=0; AMENDS=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   kill -0 $SRV 2>/dev/null || { echo "FAIL: server died mid-soak"; exit 1; }
   for ADDR in "$GW" "$PY"; do
@@ -118,7 +121,7 @@ rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                      capture_output=True, text=True).stdout.strip()
 artifact = {
     "metric": "soak", "minutes": $MINUTES, "rounds": $ROUNDS,
-    "orders_ok": $OK_TOTAL, "cancels": $CANCELS,
+    "orders_ok": $OK_TOTAL, "cancels": $CANCELS, "amends": $AMENDS,
     "audit_violations": int("$AUDIT".strip() or -1),
     "platform": "$SOAK_PLATFORM", "git_rev": rev,
     "server_args": "$SOAK_SERVER_ARGS",

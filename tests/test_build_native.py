@@ -1,0 +1,39 @@
+"""scripts/build_native.sh smoke test: the native runtime must be
+reproducible from source, not an unreproducible checked-in artifact.
+
+Builds into a scratch directory (never swapping the package's .so under
+a live process) and loads the result. Skips cleanly when the image has
+no C++ toolchain — tier-1 must pass on a pure-Python box.
+"""
+
+import ctypes
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "build_native.sh"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="no C++ toolchain in this image",
+)
+
+
+def test_build_native_lib_from_source(tmp_path):
+    r = subprocess.run(
+        ["bash", str(SCRIPT), "--lib-only", "--force",
+         "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    so = tmp_path / "libme_native.so"
+    assert so.exists(), r.stdout + r.stderr
+
+    lib = ctypes.CDLL(str(so))
+    # One symbol from each translation unit: the ring/sink layer
+    # (me_native.cpp) and the lane engine (me_lanes.cpp).
+    assert hasattr(lib, "me_ring_create")
+    assert hasattr(lib, "me_lanes_create")

@@ -640,3 +640,67 @@ def test_auction_through_native_edge(tmp_path):
         db.close()
     finally:
         h.close()
+
+
+def test_complete_batch_truncation_sweeps_pending():
+    """A truncated completion buffer must fail every pending unary RPC
+    immediately (me_gateway_complete_batch's skew sweep) — the unparsed
+    tail's clients get a prompt INTERNAL, never a hang to their RPC
+    deadline. Drives a raw NativeGateway (no bridge: completions are
+    injected by hand), with the well-formed prefix still delivered."""
+    import struct
+    from concurrent.futures import ThreadPoolExecutor
+
+    gw = me_native.NativeGateway("127.0.0.1:0")
+    port = gw.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = MatchingEngineStub(channel)
+    try:
+        def one(i):
+            t0 = time.perf_counter()
+            try:
+                r = stub.SubmitOrder(
+                    pb2.OrderRequest(client_id=f"tr{i}", symbol="TRC",
+                                     order_type=pb2.LIMIT, side=pb2.BUY,
+                                     price=10_000, scale=4, quantity=1),
+                    timeout=30,
+                )
+                return ("ok", r, time.perf_counter() - t0)
+            except grpc.RpcError as e:
+                return ("err", e, time.perf_counter() - t0)
+
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            futs = [ex.submit(one, i) for i in range(3)]
+            # All three ops must be in the ring (and their tags pending)
+            # before the malformed completion goes in.
+            recs = []
+            deadline = time.time() + 10
+            while len(recs) < 3 and time.time() < deadline:
+                recs += gw.pop_batch(8, window_us=1000, first_wait_us=200_000)
+            assert len(recs) == 3
+            by_client = {r[7]: r[0] for r in recs}
+
+            # n claims 3 records: [0] well-formed success for tr0, [1]
+            # truncated mid-oid (oid_len runs past the buffer), [2] never
+            # encoded — the sweep must fail BOTH tr1 and tr2.
+            buf = struct.pack("<I", 3)
+            buf += struct.pack("<QBBH", by_client["tr0"], 0, 1, 5) + b"OID-1"
+            buf += struct.pack("<H", 0)
+            buf += struct.pack("<QBBH", by_client["tr1"], 0, 1, 500) + b"xy"
+            gw.complete_batch_raw(buf)
+
+            res = {f"tr{i}": futs[i].result(timeout=15) for i in range(3)}
+
+        kind, resp, _ = res["tr0"]
+        assert kind == "ok" and resp.success and resp.order_id == "OID-1"
+        for c in ("tr1", "tr2"):
+            kind, err, elapsed = res[c]
+            assert kind == "err", f"{c}: swept op must fail, got {err}"
+            assert err.code() == grpc.StatusCode.INTERNAL
+            assert "truncated" in err.details()
+            # Prompt sweep, not an RPC-deadline hang.
+            assert elapsed < 10, f"{c}: swept after {elapsed:.1f}s"
+    finally:
+        channel.close()
+        gw.shutdown()
+        gw.destroy()

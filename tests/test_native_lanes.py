@@ -1,0 +1,507 @@
+"""Bit-parity of the C++ lane-engine serving path (server/native_lanes.py
++ native/me_lanes.cpp) against the Python serving path it replaces.
+
+The native fast path moves ALL per-op host work native: ring-record
+decode, host checks (auction mode, ownership, slot capacity, directory
+lookups), oid/handle/slot assignment, lane build + wave placement, status
+decode, completion building, storage-row packing. The Python path
+(EngineRunner + the gateway_bridge._drain_batch per-op machinery) stays
+the oracle: this module replays IDENTICAL lifecycle-fuzz record streams
+(submits across all five collapsed (order_type, tif) codes, cancels,
+amends — valid and invalid, auction call periods with an uncross in the
+middle) through both and asserts the native path is indistinguishable:
+
+  - the [K, 9] sparse / [S, B, 7] dense lane buffers each wave device_puts
+    (captured at the engine-step boundary), wave count and order included
+  - per-op completions on the gateway wire (tag, kind, ok, order_id,
+    error) and amend completions (tag, ok, order_id, remaining, error)
+  - storage rows (orders, updates, fills — exact tuples, exact order)
+  - stream protos (OrderUpdate / MarketDataUpdate)
+  - final device books, order directory, and EVERY allocator (next oid/
+    handle/slot, free lists) — so all future behavior stays identical too
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.harness import snapshot_books
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    NEW,
+    OP_AMEND,
+    OP_CANCEL,
+    OP_SUBMIT,
+    REJECTED,
+)
+from matching_engine_tpu.server.engine_runner import (
+    EngineOp,
+    EngineRunner,
+    OrderInfo,
+)
+
+pytestmark = pytest.mark.skipif(
+    not me_native.available(), reason="native runtime not built"
+)
+
+S, CAP, B = 4, 16, 8
+
+
+def make_cfg(kernel: str) -> EngineConfig:
+    return EngineConfig(num_symbols=S, capacity=CAP, batch=B,
+                        max_fills=1 << 12, kernel=kernel)
+
+
+# -- stream generation -------------------------------------------------------
+
+def gen_stream(seed: int, with_auction: bool):
+    """One lifecycle-fuzz record stream as a list of phases; each phase is
+    ('dispatch', [record tuple ...]) or ('auction_mode', bool) or
+    ('uncross',). Record tuples are pack_record_batch's input shape.
+
+    Cancel/amend targets use PREDICTED order ids: ids are consumed by
+    exactly the submits that pass host checks (everything in continuous
+    mode; only GTC LIMIT during a call period) — itself part of the
+    parity surface under test."""
+    rng = random.Random(seed)
+    tag = [0]
+    next_oid = [1]
+    auction = [False]
+    # (order_id, client) of LIMIT submits — cancel/amend targets; stale
+    # (filled/canceled) targets are fair game: both paths must reject
+    # identically.
+    targets: list[tuple[str, str]] = []
+
+    def t() -> int:
+        tag[0] += 1
+        return tag[0]
+
+    def submit(call_period_mix: bool):
+        sym = f"S{rng.randrange(S)}"
+        cid = f"c{rng.randrange(5)}"
+        side = 1 if rng.random() < 0.5 else 2
+        otype = 0
+        if rng.random() < 0.25:
+            otype = rng.choice((1, 2, 3, 4))  # MKT / IOC / FOK / MKT_FOK
+        price = 0 if otype in (1, 4) else 10_000 + rng.randrange(-8, 9)
+        qty = rng.randrange(1, 20)
+        rec = (t(), 1, side, otype, price, qty, sym, cid, "")
+        if not auction[0] or otype == 0:
+            oid = f"OID-{next_oid[0]}"
+            next_oid[0] += 1
+            if otype == 0:
+                targets.append((oid, cid))
+        # else: rejected at the host check, no id consumed
+        if call_period_mix and otype != 0:
+            pass  # non-GTC during a call period: edge-rejected, kept in
+        return rec
+
+    def cancel():
+        if targets and rng.random() < 0.8:
+            oid, cid = rng.choice(targets)
+            if rng.random() < 0.15:
+                cid = "mallory"  # wrong client
+        else:
+            oid, cid = f"OID-{9000 + rng.randrange(100)}", "c0"  # unknown
+        return (t(), 2, 0, 0, 0, 0, "", cid, oid)
+
+    def amend():
+        if targets and rng.random() < 0.8:
+            oid, cid = rng.choice(targets)
+            if rng.random() < 0.15:
+                cid = "mallory"
+        else:
+            oid, cid = f"OID-{9000 + rng.randrange(100)}", "c0"
+        # qty: mostly a plausible reduction, sometimes an invalid raise
+        qty = rng.randrange(1, 25)
+        return (t(), 3, 0, 0, 0, qty, "", cid, oid)
+
+    def batch(n, call_period=False):
+        recs = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.70 or not targets:
+                recs.append(submit(call_period))
+            elif r < 0.88:
+                recs.append(cancel())
+            else:
+                recs.append(amend())
+        return recs
+
+    phases = []
+    # Continuous: small (sparse-shaped) and large (dense-shaped)
+    # dispatches interleaved.
+    for _ in range(3):
+        phases.append(("dispatch", batch(6)))
+        phases.append(("dispatch", batch(20)))
+    if with_auction:
+        phases.append(("auction_mode", True))
+        auction[0] = True
+        phases.append(("dispatch", batch(12, call_period=True)))
+        phases.append(("uncross",))
+        auction[0] = False
+        phases.append(("dispatch", batch(6)))
+        phases.append(("dispatch", batch(20)))
+    return phases
+
+
+# -- lane capture at the engine-step boundary --------------------------------
+
+@contextlib.contextmanager
+def capture_lanes(sink: list):
+    """Record every lane buffer crossing into the device step — the wave
+    split and buffer CONTENT both runs must produce identically."""
+    import matching_engine_tpu.engine.kernel as kmod
+    import matching_engine_tpu.engine.sparse as smod
+    import matching_engine_tpu.server.engine_runner as rmod
+
+    real_sparse, real_packed = smod.engine_step_sparse, kmod.engine_step_packed
+
+    def rec_sparse(cfg, book, sp):
+        sink.append(("sparse", np.asarray(sp.lanes).copy()))
+        return real_sparse(cfg, book, sp)
+
+    def rec_packed(cfg, book, arr):
+        sink.append(("dense", np.asarray(arr).copy()))
+        return real_packed(cfg, book, arr)
+
+    saved = (smod.engine_step_sparse, kmod.engine_step_packed,
+             rmod.engine_step_packed)
+    smod.engine_step_sparse = rec_sparse
+    kmod.engine_step_packed = rec_packed
+    rmod.engine_step_packed = rec_packed
+    try:
+        yield
+    finally:
+        (smod.engine_step_sparse, kmod.engine_step_packed,
+         rmod.engine_step_packed) = saved
+
+
+# -- the Python serving path (the parity oracle) -----------------------------
+
+def py_drain(runner: EngineRunner, recs) -> dict:
+    """One dispatch through the Python path, transcribed from
+    gateway_bridge._drain_batch: per-record decode, host checks with
+    immediate edge completions, OrderInfo/EngineOp construction, pipelined
+    dispatch, then the bridge's completion building from the outcomes.
+    Returns the same observable surface NativeDispatchResult carries."""
+    ops: list[EngineOp] = []
+    tags: dict[int, int] = {}
+    comp: list[tuple] = []   # (tag, kind, ok, order_id, error)
+    amends: list[tuple] = []  # (tag, ok, order_id, remaining, error)
+    for (tag, op, side, otype, price_q4, qty, symbol, client_id,
+         order_id) in recs:
+        if op == 1:
+            if runner.auction_mode and otype != 0:
+                comp.append((tag, 0, False, "",
+                             "only GTC LIMIT orders are accepted during an "
+                             "auction call period"))
+                continue
+            if not runner.owns_symbol(symbol):
+                comp.append((tag, 0, False, "",
+                             f"symbol {symbol} is homed on another host"))
+                continue
+            if runner.slot_acquire(symbol) is None:
+                comp.append((tag, 0, False, "",
+                             "symbol capacity exhausted (engine symbol "
+                             "axis is full)"))
+                continue
+            oid_num, oid_str = runner.assign_oid()
+            info = OrderInfo(
+                oid=oid_num, order_id=oid_str, client_id=client_id,
+                symbol=symbol, side=side, otype=otype, price_q4=price_q4,
+                quantity=qty, remaining=qty, status=0,
+                handle=runner.assign_handle(),
+            )
+            e = EngineOp(OP_SUBMIT, info)
+        elif op == 3:
+            info = runner.orders_by_id.get(order_id)
+            if info is None:
+                amends.append((tag, False, order_id, 0, "unknown order id"))
+                continue
+            if info.client_id != client_id:
+                amends.append((tag, False, order_id, 0,
+                               "order belongs to a different client"))
+                continue
+            e = EngineOp(OP_AMEND, info, amend_qty=qty)
+        else:
+            info = runner.orders_by_id.get(order_id)
+            if info is None:
+                comp.append((tag, 1, False, order_id, "unknown order id"))
+                continue
+            if info.client_id != client_id:
+                comp.append((tag, 1, False, order_id,
+                             "order belongs to a different client"))
+                continue
+            e = EngineOp(OP_CANCEL, info, cancel_requester=client_id)
+        ops.append(e)
+        tags[id(e)] = tag
+
+    box = {}
+
+    def on_finish(result, error):
+        assert error is None, error
+        box["result"] = result
+        return None
+
+    runner.dispatch_pipelined(ops, on_finish)
+    runner.finish_pending()
+    result = box["result"]
+    for outcome in result.outcomes:
+        tag = tags.pop(id(outcome.op), None)
+        if tag is None:
+            continue
+        info = outcome.op.info
+        if outcome.op.op == OP_AMEND:
+            ok = outcome.status == NEW
+            amends.append((tag, ok, info.order_id, outcome.remaining,
+                           "" if ok else (outcome.error or "amend rejected")))
+        elif outcome.op.op != OP_CANCEL:
+            if outcome.status == REJECTED and outcome.error:
+                comp.append((tag, 0, False, info.order_id, outcome.error))
+            else:
+                comp.append((tag, 0, True, info.order_id, ""))
+        else:
+            if outcome.status == CANCELED:
+                comp.append((tag, 1, True, info.order_id, ""))
+            else:
+                comp.append((tag, 1, False, info.order_id,
+                             outcome.error or "order not open"))
+    assert not tags, "op produced no outcome"
+    return {
+        "comp": comp,
+        "amends": amends,
+        "orders": list(result.storage_orders),
+        "updates": list(result.storage_updates),
+        "fills": list(result.storage_fills),
+        "ou": [m.SerializeToString() for m in result.order_updates],
+        "md": [m.SerializeToString() for m in result.market_data],
+    }
+
+
+def native_drain(runner, recs) -> dict:
+    from matching_engine_tpu.server.native_lanes import pack_record_batch
+
+    buf, n = pack_record_batch(recs)
+    box = {}
+
+    def on_finish(result, error):
+        assert error is None, error
+        box["result"] = result
+        return None
+
+    runner.dispatch_records(buf, n, on_finish)
+    runner.finish_pending()
+    r = box["result"]
+    orders, updates, fills = me_native.unpack_store_buf(r.store_buf)
+    return {
+        "comp": me_native.parse_comp_buf(r.comp_buf),
+        "amends": [(tag, ok, oid, rem, err)
+                   for (tag, ok, rem, oid, err) in r.amends],
+        "orders": orders,
+        "updates": updates,
+        "fills": fills,
+        "ou": [m.SerializeToString() for m in r.order_updates],
+        "md": [m.SerializeToString() for m in r.market_data],
+    }
+
+
+def assert_dispatch_parity(i, py: dict, nat: dict):
+    assert sorted(nat["comp"]) == sorted(py["comp"]), f"dispatch {i}: comp"
+    assert sorted(nat["amends"]) == sorted(py["amends"]), \
+        f"dispatch {i}: amends"
+    for key in ("orders", "updates", "fills"):
+        assert nat[key] == py[key], f"dispatch {i}: storage {key}"
+    assert sorted(nat["ou"]) == sorted(py["ou"]), f"dispatch {i}: OU stream"
+    assert sorted(nat["md"]) == sorted(py["md"]), f"dispatch {i}: MD stream"
+
+
+def assert_directory_parity(py_r: EngineRunner, nat_r):
+    """Full hot-path state: directory, symbol table, every allocator."""
+    nat_r.refresh_directory_mirror_locked()
+    key = lambda i: (i.handle, i.oid, i.order_id, i.client_id, i.symbol,  # noqa: E731
+                     i.side, i.otype, i.price_q4, i.quantity, i.remaining,
+                     i.status)
+    assert sorted(map(key, nat_r.orders_by_handle.values())) == \
+        sorted(map(key, py_r.orders_by_handle.values()))
+    assert nat_r.symbols == py_r.symbols
+    assert nat_r.slot_symbols == py_r.slot_symbols
+    assert nat_r.next_oid_num == py_r.next_oid_num
+    assert nat_r._next_handle == py_r._next_handle
+    assert nat_r._free_handles == py_r._free_handles
+    assert nat_r._next_slot == py_r._next_slot
+    assert nat_r._free_slots == py_r._free_slots
+    assert nat_r._owner_by_client == py_r._owner_by_client
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("seed", [0])
+def test_lane_parity_lifecycle_fuzz(kernel, seed):
+    from matching_engine_tpu.server.native_lanes import NativeLanesRunner
+
+    cfg = make_cfg(kernel)
+    py_r = EngineRunner(cfg)
+    nat_r = NativeLanesRunner(cfg)
+    py_lanes: list = []
+    nat_lanes: list = []
+
+    for phases_seen, phase in enumerate(gen_stream(seed, with_auction=True)):
+        if phase[0] == "auction_mode":
+            py_r.set_auction_mode(phase[1])
+            nat_r.set_auction_mode(phase[1])
+            continue
+        if phase[0] == "uncross":
+            ps = py_r.run_auction(None, sink=None)
+            ns = nat_r.run_auction(None, sink=None)
+            assert not ps["error"] and not ns["error"]
+            assert sorted(ps["crossed"]) == sorted(ns["crossed"])
+            py_r.set_auction_mode(False)
+            nat_r.set_auction_mode(False)
+            continue
+        recs = phase[1]
+        with capture_lanes(py_lanes):
+            py = py_drain(py_r, recs)
+        with capture_lanes(nat_lanes):
+            nat = native_drain(nat_r, recs)
+        assert_dispatch_parity(phases_seen, py, nat)
+
+    # Wave-for-wave lane parity: same count, same shape kind, same bytes.
+    assert len(py_lanes) == len(nat_lanes)
+    for w, ((pk, pa), (nk, na)) in enumerate(zip(py_lanes, nat_lanes)):
+        assert pk == nk, f"wave {w}: shape kind"
+        assert pa.shape == na.shape, f"wave {w}: lane shape"
+        assert np.array_equal(pa, na), f"wave {w}: lane content"
+
+    # Books, directory, allocators.
+    assert snapshot_books(py_r.book) == snapshot_books(nat_r.book)
+    assert_directory_parity(py_r, nat_r)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("seed", [1, 2])
+def test_lane_parity_lifecycle_fuzz_more_seeds(kernel, seed):
+    test_lane_parity_lifecycle_fuzz(kernel, seed)
+
+
+# -- full-stack e2e: build_server(native_lanes=True), grpcio edge ------------
+
+def test_native_lanes_full_stack_e2e(tmp_path):
+    """The whole serving stack through the lane engine: grpcio RPCs ->
+    MatchingEngineService native tails -> LaneRingDispatcher ->
+    NativeLanesRunner -> storage, with a restart leg proving recovery
+    replay (Python path) hands the directory to the C++ engine
+    (adopt_from_python) cleanly."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.dispatcher import LaneRingDispatcher
+    from matching_engine_tpu.server.main import build_server, shutdown
+    from matching_engine_tpu.storage import Storage
+
+    db = str(tmp_path / "lanes_e2e.db")
+    cfg = EngineConfig(num_symbols=4, capacity=8, batch=4)
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1.0, log=False,
+        native_lanes=True,
+    )
+    assert isinstance(parts["dispatcher"], LaneRingDispatcher)
+    server.start()
+    channel = None
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = MatchingEngineStub(channel)
+
+        def sub(client, side, qty, price=10000):
+            return stub.SubmitOrder(pb2.OrderRequest(
+                client_id=client, symbol="S", order_type=pb2.LIMIT,
+                side=side, price=price, scale=4, quantity=qty), timeout=10)
+
+        r1 = sub("a", pb2.BUY, 5)
+        r2 = sub("b", pb2.SELL, 3)       # matches 3 of r1
+        assert r1.success and r2.success
+
+        # Amend the partially-filled rest down, then cancel it.
+        am = stub.AmendOrder(pb2.AmendRequest(
+            client_id="a", order_id=r1.order_id, new_quantity=1),
+            timeout=10)
+        assert am.success and am.remaining_quantity == 1
+        # Invalid amend (raise) rejected through the native host checks.
+        bad = stub.AmendOrder(pb2.AmendRequest(
+            client_id="a", order_id=r1.order_id, new_quantity=50),
+            timeout=10)
+        assert not bad.success
+        # Wrong-client cancel rejected; right-client cancel lands.
+        assert not stub.CancelOrder(pb2.CancelRequest(
+            client_id="x", order_id=r1.order_id), timeout=10).success
+        assert stub.CancelOrder(pb2.CancelRequest(
+            client_id="a", order_id=r1.order_id), timeout=10).success
+        # Cancel of a filled order: not open.
+        assert not stub.CancelOrder(pb2.CancelRequest(
+            client_id="b", order_id=r2.order_id), timeout=10).success
+        # Identifiers too big for the wire record answer with the Python
+        # path's lookup errors, not "engine error" (pack_gwop must never
+        # see them).
+        huge = stub.CancelOrder(pb2.CancelRequest(
+            client_id="a", order_id="X" * 64), timeout=10)
+        assert not huge.success and huge.error_message == "unknown order id"
+        huge = stub.AmendOrder(pb2.AmendRequest(
+            client_id="c" * 300, order_id=r1.order_id, new_quantity=1),
+            timeout=10)
+        assert not huge.success
+        assert huge.error_message == "order belongs to a different client"
+
+        parts["sink"].flush()
+        st = Storage(db)
+        assert st.count("fills") == 1
+        f = st.fills_for_order(r2.order_id)[0]
+        assert f[1] == r1.order_id and f[2] == 10000 and f[3] == 3
+        assert st.get_order(r2.order_id)[8] == 2      # FILLED
+        assert st.get_order(r1.order_id)[8] == 3      # CANCELED
+        st.close()
+
+        # A resting book for the restart leg.
+        r3 = sub("c", pb2.BUY, 4, price=9990)
+        assert r3.success
+        book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="S"),
+                                 timeout=10)
+        assert book.bids and book.bids[0].price == 9990
+        parts["sink"].flush()
+    finally:
+        if channel is not None:
+            channel.close()
+        shutdown(server, parts)
+
+    rest_oid = r3.order_id
+
+    # Restart over the same DB: recovery replays through the Python
+    # runner, then authority flips to the lane engine; the rest must be
+    # live (cancelable) and new flow must match against it.
+    server2, port2, parts2 = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1.0, log=False,
+        native_lanes=True,
+    )
+    server2.start()
+    channel2 = None
+    try:
+        channel2 = grpc.insecure_channel(f"127.0.0.1:{port2}")
+        stub2 = MatchingEngineStub(channel2)
+        rs = stub2.SubmitOrder(pb2.OrderRequest(
+            client_id="d", symbol="S", order_type=pb2.MARKET,
+            side=pb2.SELL, quantity=4), timeout=10)
+        assert rs.success
+        parts2["sink"].flush()
+        st = Storage(db)
+        assert st.get_order(rest_oid)[8] == 2  # r3 FILLED post-restart
+        st.close()
+    finally:
+        if channel2 is not None:
+            channel2.close()
+        shutdown(server2, parts2)
