@@ -21,6 +21,7 @@ from concurrent.futures import Future
 
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner
 from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.obs import DispatchTimeline, record_dispatch_error
 
 
 class RingFull(RuntimeError):
@@ -59,6 +60,9 @@ def publish_result(result, sink, hub, metrics) -> None:
 
 
 class BatchDispatcher:
+    # Flight-recorder/ledger label for dispatches drained by this edge.
+    timeline_path = "python"
+
     def __init__(
         self,
         runner: EngineRunner,
@@ -81,10 +85,17 @@ class BatchDispatcher:
         self._thread.start()
 
     def submit(self, op: EngineOp) -> Future:
-        """Enqueue one validated op; the future resolves to its OpOutcome."""
+        """Enqueue one validated op; the future resolves to its OpOutcome.
+        The enqueue stamp is the queue-wait origin of the stage ledger."""
         fut: Future = Future()
-        self._q.put((op, fut))
+        self._q.put((op, fut, time.perf_counter()))
         return fut
+
+    def _queue_depth(self) -> int | None:
+        """Ops still waiting at drain time; None where this edge has no
+        host-visible queue (the native ring subclasses — their backlog
+        proxy is the inflight_ops gauge instead)."""
+        return self._q.qsize()
 
     def close(self) -> None:
         self._stop.set()
@@ -129,8 +140,17 @@ class BatchDispatcher:
 
     def _drain(self, batch) -> None:
         t0 = time.perf_counter()
-        ops = [op for op, _ in batch]
-        futs = {id(op): fut for op, fut in batch}
+        ops = [op for op, _, _ in batch]
+        futs = {id(op): fut for op, fut, _ in batch}
+        # Stage ledger: queue wait measured from the OLDEST op's enqueue
+        # (the client-felt worst case for this dispatch); build/device/
+        # decode boundaries are stamped by the runner.
+        tl = DispatchTimeline(
+            self.timeline_path, len(batch),
+            t_enqueue=min(t for _, _, t in batch), t_pop=t0)
+        depth = self._queue_depth()
+        if depth is not None:
+            self.metrics.set_gauge("queue_depth", depth)
 
         def on_finish(result, error):
             # Runs under the dispatch lock when this batch's results are
@@ -144,13 +164,17 @@ class BatchDispatcher:
             # resurrect canceled orders). The returned thunk (future
             # completions) runs after the lock is released.
             if error is not None:
+                tl.finish(self.metrics, error=error)
+
                 def fail():
-                    for _, fut in batch:
+                    for _, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(error)
                     self.metrics.inc("dispatch_errors")
                 return fail
             self._publish(result)
+            tl.stamp_publish()
+            tl.finish(self.metrics)
 
             def complete():
                 # Futures resolve only after the storage batch is
@@ -162,7 +186,7 @@ class BatchDispatcher:
                     if fut is not None and not fut.done():
                         fut.set_result(outcome)
                 # Any op the decode missed: fail loudly rather than hang.
-                for op, fut in batch:
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError("op produced no outcome"))
@@ -176,7 +200,7 @@ class BatchDispatcher:
                 self.metrics.ema_gauge("dispatch_ops", len(batch))
             return complete
 
-        self.runner.dispatch_pipelined(ops, on_finish)
+        self.runner.dispatch_pipelined(ops, on_finish, timeline=tl)
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)
@@ -250,7 +274,7 @@ class LaneRingDispatcher:
                             symbol=symbol, client_id=client_id,
                             order_id=order_id)
         with self._tag_lock:
-            self._tags[tag] = fut
+            self._tags[tag] = (fut, time.perf_counter())
         if not self._ring.push(rec):
             with self._tag_lock:
                 self._tags.pop(tag, None)
@@ -269,9 +293,20 @@ class LaneRingDispatcher:
         with self._tag_lock:
             leftovers = list(self._tags.values())
             self._tags.clear()
-        for fut in leftovers:
+        for fut, _ in leftovers:
             if not fut.done():
                 fut.set_exception(RuntimeError("dispatcher closed"))
+
+    def _earliest_enqueue(self, recs, n: int) -> float | None:
+        """Enqueue stamp of the batch's OLDEST record (peek, not pop —
+        completion still takes the tag). The ring is FIFO, so recs[0] is
+        the first pushed and its stamp bounds the batch's queue wait to
+        within the push/register race window; O(1) under the tag lock —
+        a per-record scan here would re-add per-op Python work to the
+        path built to avoid it."""
+        with self._tag_lock:
+            ent = self._tags.get(recs[0].tag) if n else None
+        return None if ent is None else ent[1]
 
     def _run(self) -> None:
         from matching_engine_tpu.server.native_lanes import (
@@ -290,19 +325,27 @@ class LaneRingDispatcher:
                 self.runner.finish_pending()
                 continue
             recs = snapshot_records(buf, n)
+            tl = DispatchTimeline("native-lanes", n,
+                                  t_enqueue=self._earliest_enqueue(recs, n))
+            self.metrics.set_gauge("inflight_ops", len(self._tags))
 
-            def on_finish(result, error, recs=recs, n=n):
+            def on_finish(result, error, recs=recs, n=n, tl=tl):
                 if error is not None:
                     self.metrics.inc("dispatch_errors")
+                    tl.finish(self.metrics, error=error)
 
                     def fail():
                         for i in range(n):
                             fut = self._take_tag(recs[i].tag)
                             if fut is not None and not fut.done():
                                 fut.set_exception(error)
+                        self.metrics.set_gauge("inflight_ops",
+                                               len(self._tags))
                     return fail
                 publish_native_result(result, self.sink, self.hub,
                                       self.metrics)
+                tl.stamp_publish()
+                tl.finish(self.metrics)
 
                 def complete():
                     for (tag, kind, ok, remaining, oid, err) in result.local:
@@ -317,12 +360,16 @@ class LaneRingDispatcher:
                         if fut is not None and not fut.done():
                             fut.set_exception(
                                 RuntimeError("op produced no outcome"))
+                    # Taken tags are gone: the gauge returns to 0 on an
+                    # idle server instead of freezing at the last batch.
+                    self.metrics.set_gauge("inflight_ops", len(self._tags))
                 return complete
 
             try:
-                self.runner.dispatch_records(recs, n, on_finish)
+                self.runner.dispatch_records(recs, n, on_finish, timeline=tl)
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 self.metrics.inc("dispatch_errors")
+                record_dispatch_error(self.metrics, "lane-dispatcher", e)
                 print(f"[lane-dispatcher] batch failed: "
                       f"{type(e).__name__}: {e}")
                 for i in range(n):
@@ -333,7 +380,8 @@ class LaneRingDispatcher:
 
     def _take_tag(self, tag: int):
         with self._tag_lock:
-            return self._tags.pop(tag, None)
+            ent = self._tags.pop(tag, None)
+        return None if ent is None else ent[0]
 
 
 class NativeRingDispatcher(BatchDispatcher):
@@ -346,6 +394,8 @@ class NativeRingDispatcher(BatchDispatcher):
     Requires the native library (matching_engine_tpu.native.available());
     construction raises otherwise — callers fall back to BatchDispatcher.
     """
+
+    timeline_path = "python-ring"
 
     def __init__(
         self,
@@ -371,7 +421,7 @@ class NativeRingDispatcher(BatchDispatcher):
         fut: Future = Future()
         tag = next(self._tag_seq)
         with self._tag_lock:
-            self._tags[tag] = (op, fut)
+            self._tags[tag] = (op, fut, time.perf_counter())
         info = op.info
         # The payload fields mirror the op for native producers (the C++
         # front end pushes full records); the Python drain path keys off the
@@ -387,6 +437,9 @@ class NativeRingDispatcher(BatchDispatcher):
             fut.set_exception(RingFull("op ring full"))
         return fut
 
+    def _queue_depth(self) -> int | None:
+        return None  # ops queue in the native ring; see inflight_ops
+
     def close(self) -> None:
         self._stop.set()
         self._ring.close()
@@ -401,7 +454,7 @@ class NativeRingDispatcher(BatchDispatcher):
         with self._tag_lock:
             leftovers = list(self._tags.values())
             self._tags.clear()
-        for _, fut in leftovers:
+        for _, fut, _ in leftovers:
             if not fut.done():
                 fut.set_exception(RuntimeError("dispatcher closed"))
 
@@ -423,6 +476,7 @@ class NativeRingDispatcher(BatchDispatcher):
                     ent = self._tags.pop(rec[0], None)
                     if ent is not None:
                         batch.append(ent)
+                self.metrics.set_gauge("inflight_ops", len(self._tags))
             if batch:
                 self._drain(batch)
         self.runner.finish_pending()

@@ -135,10 +135,10 @@ class _Staged:
 
     __slots__ = ("ops", "by_handle", "res", "terminal_makers",
                  "dispatch_iter", "decode_fn", "finalize_fn", "items",
-                 "deferred")
+                 "deferred", "timeline")
 
     def __init__(self, ops, by_handle, res, terminal_makers, dispatch_iter,
-                 decode_fn, finalize_fn, deferred):
+                 decode_fn, finalize_fn, deferred, timeline=None):
         self.ops = ops
         self.by_handle = by_handle
         self.res = res
@@ -148,6 +148,7 @@ class _Staged:
         self.finalize_fn = finalize_fn
         self.items: deque = deque()
         self.deferred = deferred
+        self.timeline = timeline  # utils/obs.DispatchTimeline | None
 
 
 class EngineRunner:
@@ -443,6 +444,7 @@ class EngineRunner:
         if not self._pending:
             return
         staged, cb = self._pending.popleft()
+        self.metrics.set_gauge("inflight_dispatches", len(self._pending))
         try:
             result = self._finish_locked(staged)
             err = None
@@ -456,13 +458,17 @@ class EngineRunner:
         if post is not None:
             posts.append(post)
 
-    def dispatch_pipelined(self, ops: list[EngineOp], on_finish) -> None:
+    def dispatch_pipelined(self, ops: list[EngineOp], on_finish,
+                           timeline=None) -> None:
         """Serving-loop entry: dispatch `ops`, overlapping with the
         previous batch's decode. `on_finish(result, error)` runs under the
         dispatch lock when this batch's results are decoded (publish to
         sink/hub there); its return value, if not None, is a thunk the
-        runner invokes after releasing the lock (client completions)."""
-        self._dispatch_common(lambda: self._stage_locked(ops), on_finish)
+        runner invokes after releasing the lock (client completions).
+        `timeline` (utils/obs.DispatchTimeline) is stamped at the stage
+        ledger's build/issue/decode boundaries; the edge finishes it."""
+        self._dispatch_common(
+            lambda: self._stage_locked(ops, timeline=timeline), on_finish)
 
     def _dispatch_common(self, stage, on_finish) -> None:
         """The serving-dispatch orchestration shared by every entry
@@ -485,6 +491,8 @@ class EngineRunner:
                 return
             if staged.deferred:
                 self._pending.append((staged, on_finish))
+                self.metrics.set_gauge("inflight_dispatches",
+                                       len(self._pending))
                 # Finish only the overflow beyond the inflight window:
                 # batches decode strictly FIFO, but up to
                 # `pipeline_inflight` stay staged so their (already
@@ -524,7 +532,8 @@ class EngineRunner:
     def _run_dispatch_locked(self, ops: list[EngineOp]) -> DispatchResult:
         return self._finish_locked(self._stage_locked(ops, defer=False))
 
-    def _stage_locked(self, ops: list[EngineOp], defer: bool = True):
+    def _stage_locked(self, ops: list[EngineOp], defer: bool = True,
+                      timeline=None):
         """Build + register + (when deferrable) dispatch all device waves
         WITHOUT decoding. Returns a _Staged; _finish_locked completes it."""
         res = DispatchResult([], [], [], [], [], [], 0)
@@ -594,10 +603,14 @@ class EngineRunner:
                     self.orders_by_id[i.order_id] = i
 
             n_waves, dispatch_iter, decode_fn, finalize_fn = self._prepare(
-                ops, host_orders, by_handle, res, terminal_makers)
+                ops, host_orders, by_handle, res, terminal_makers,
+                timeline=timeline)
+            if timeline is not None:
+                timeline.waves = n_waves
+                timeline.stamp_build()
             staged = _Staged(ops, by_handle, res, terminal_makers,
                              dispatch_iter, decode_fn, finalize_fn,
-                             deferred=False)
+                             deferred=False, timeline=timeline)
             if defer and n_waves <= PIPELINE_DEPTH:
                 # Dispatch every wave now, decode later (all deployment
                 # shapes — the mesh decode reads addressable shards, so
@@ -607,6 +620,8 @@ class EngineRunner:
                     staged.items.append(item)
                     _prefetch_host(item)
                 staged.deferred = True
+                if timeline is not None:
+                    timeline.stamp_issue()
             return staged
         except BaseException:
             self._rollback_registrations(ops, res)
@@ -628,10 +643,20 @@ class EngineRunner:
         self.metrics.inc("dispatches")
         self.metrics.inc("engine_ops", len(staged.ops))
         self.metrics.inc("fills", staged.res.fill_count)
+        if staged.timeline is not None:
+            # Decode boundary: results + fills decoded, directories
+            # updated, terminal orders evicted — the dispatch's host tail.
+            staged.timeline.stamp_decode()
+            staged.timeline.counters = {
+                "ops": len(staged.ops),
+                "fills": staged.res.fill_count,
+                "outcomes": len(staged.res.outcomes),
+            }
         return staged.res
 
     def _prepare(self, ops, host_orders, by_handle,
-                 res: DispatchResult, terminal_makers: set[int]):
+                 res: DispatchResult, terminal_makers: set[int],
+                 timeline=None):
         """Build the (n_waves, dispatch_iter, decode_fn, finalize_fn)
         quadruple for this dispatch's shape. Nothing executes until the
         dispatch iterator is pulled; finalize_fn runs after the last wave
@@ -654,6 +679,8 @@ class EngineRunner:
             )
 
             self.metrics.inc("sparse_dispatches")
+            if timeline is not None:
+                timeline.shape = "sparse"
             tob: dict[int, tuple] = {}
             built = build_sparse(self.cfg, host_orders)
 
@@ -699,6 +726,8 @@ class EngineRunner:
 
         if host_orders:
             self.metrics.inc("dense_dispatches")
+        if timeline is not None:
+            timeline.shape = "mesh" if self._sharded is not None else "dense"
         touched_syms: set[int] = set()
         last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
         arrays = build_batch_arrays(self.cfg, host_orders)

@@ -33,6 +33,7 @@ from matching_engine_tpu.engine.kernel import (
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.server.dispatcher import publish_result
 from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+from matching_engine_tpu.utils.obs import DispatchTimeline, record_dispatch_error
 
 
 class _StreamContext:
@@ -157,6 +158,7 @@ class GatewayBridge:
                 # exhaustion raising in the op-build loop): a dead drain
                 # thread strands every gateway client until its deadline.
                 self.metrics.inc("dispatch_errors")
+                record_dispatch_error(self.metrics, "gw-bridge", e)
                 print(f"[gw-bridge] batch failed: {type(e).__name__}: {e}")
                 for rec in recs:
                     # Best effort: fail every op in the batch (completing a
@@ -193,6 +195,7 @@ class GatewayBridge:
                 # survive ANY per-batch failure; fail the batch's clients
                 # instead of stranding them until their deadline.
                 self.metrics.inc("dispatch_errors")
+                record_dispatch_error(self.metrics, "gw-bridge-native", e)
                 print(f"[gw-bridge] native batch failed: "
                       f"{type(e).__name__}: {e}")
                 self._fail_records(buf, n)
@@ -224,6 +227,10 @@ class GatewayBridge:
         # reused while this dispatch may still be staged, and the error
         # path needs the tags.
         recs = snapshot_records(buf, n)
+        # Stage ledger for the C++-edge lane path. Ingress/ring-wait
+        # happen inside the native gateway, so the ledger starts at the
+        # pop boundary — the documented stamping point for this edge.
+        tl = DispatchTimeline("gateway-lanes", n, t_pop=t0)
 
         def on_finish(result, error):
             # Same lock discipline as the Python path: publish under the
@@ -231,6 +238,7 @@ class GatewayBridge:
             # after release.
             if error is not None:
                 self.metrics.inc("dispatch_errors")
+                tl.finish(self.metrics, error=error)
                 print(f"[gw-bridge] native dispatch error: "
                       f"{type(error).__name__}: {error}")
 
@@ -241,6 +249,8 @@ class GatewayBridge:
             publish_native_result(result, self.sink, self.hub, self.metrics)
             self.metrics.ema_gauge(
                 "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)
+            tl.stamp_publish()
+            tl.finish(self.metrics)
 
             def complete():
                 # ONE ctypes crossing + one locked socket write per
@@ -267,7 +277,7 @@ class GatewayBridge:
 
         self.metrics.ema_gauge(
             "bridge_setup_us", (time.perf_counter() - t0) * 1e6)
-        self.runner.dispatch_records(recs, n, on_finish)
+        self.runner.dispatch_records(recs, n, on_finish, timeline=tl)
 
     def _drain_batch(self, recs) -> None:
         runner = self.runner
@@ -353,6 +363,10 @@ class GatewayBridge:
 
         if not ops:
             return
+        # Stage ledger: ingress/ring-wait live in the C++ gateway, so the
+        # stamping starts at the pop boundary (t0 covers the per-op build
+        # loop above inside the lane-build stage).
+        tl = DispatchTimeline("gateway", len(ops), t_pop=t0)
 
         def on_finish(result, error):
             # Runs under the dispatch lock when this batch decodes (same
@@ -363,6 +377,7 @@ class GatewayBridge:
             # engine lock against a window-starved client.
             if error is not None:
                 self.metrics.inc("dispatch_errors")
+                tl.finish(self.metrics, error=error)
                 print(f"[gw-bridge] dispatch error: "
                       f"{type(error).__name__}: {error}")
 
@@ -388,6 +403,8 @@ class GatewayBridge:
             self._publish(result)
             self.metrics.ema_gauge(
                 "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)
+            tl.stamp_publish()
+            tl.finish(self.metrics)
 
             def complete():
                 # One ctypes crossing + one locked socket write per
@@ -465,7 +482,7 @@ class GatewayBridge:
         # enqueue, complete = response fan-out through the gateway.
         self.metrics.ema_gauge(
             "bridge_setup_us", (time.perf_counter() - t0) * 1e6)
-        self.runner.dispatch_pipelined(ops, on_finish)
+        self.runner.dispatch_pipelined(ops, on_finish, timeline=tl)
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)

@@ -36,6 +36,7 @@ from matching_engine_tpu.utils.checkpoint import (
     restore_runner,
 )
 from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.obs import FlightRecorder, ObsServer
 from matching_engine_tpu.utils.tracing import trace
 
 
@@ -95,6 +96,7 @@ def build_server(
     gateway_addr: str | None = None,
     pipeline_inflight: int = 2,
     native_lanes: bool = False,
+    flight_dir: str | None = None,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -125,6 +127,12 @@ def build_server(
         raise SystemExit(1)
 
     metrics = Metrics()
+    # Flight recorder: always recording (cheap, per dispatch); dumps only
+    # when a dump dir is configured (SIGUSR2 / fatal dispatch error /
+    # clean shutdown). Rides on the registry so every pipeline layer that
+    # holds `metrics` can record without constructor churn.
+    recorder = FlightRecorder(dump_dir=flight_dir)
+    metrics.recorder = recorder
     hub = StreamHub(metrics=metrics)
 
     def make_runner():
@@ -220,9 +228,10 @@ def build_server(
 
     use_native = native and me_native.available()
     if use_native:
+        # C++ writer: stage_sink_commit_us is a python-sink figure only.
         sink = me_native.NativeStorageSink(db_path)
     else:
-        sink = AsyncStorageSink(storage)
+        sink = AsyncStorageSink(storage, metrics=metrics)
     # Order-preserving overflow buffer: a full sink queue defers batches
     # instead of dropping them; the checkpoint flush barrier drains it.
     from matching_engine_tpu.storage.async_sink import SpillingSink
@@ -290,6 +299,7 @@ def build_server(
         "dispatcher": dispatcher, "runner": runner, "service": service,
         "metrics": metrics, "checkpointer": checkpointer,
         "bridge": bridge, "gateway_port": gateway_port,
+        "recorder": recorder,
     }
     return server, port, parts
 
@@ -310,6 +320,9 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
         parts["checkpointer"].close()
     parts["sink"].close()
     parts["storage"].close()
+    if parts.get("recorder") is not None:
+        # Last: the dump captures the fully-drained pipeline's tail.
+        parts["recorder"].dump("shutdown")
 
 
 def resolve_mesh(n: int, num_symbols: int):
@@ -377,6 +390,20 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler device trace of the whole "
                         "serving session into this directory (TensorBoard)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus text-format /metrics (+ /healthz, "
+                        "/readyz, /flightrecorder) on this port from a "
+                        "stdlib-only thread (0 = OS-assigned; omit to "
+                        "disable). docs/OPERATIONS.md lists the metric "
+                        "names")
+    p.add_argument("--metrics-host", default="127.0.0.1", metavar="HOST",
+                   help="bind address for --metrics-port (default loopback; "
+                        "0.0.0.0 to expose to a scrape network)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="flight-recorder dump directory (default: "
+                        "<db dir>/flight). Recent dispatch summaries dump "
+                        "as JSON on SIGUSR2, fatal dispatch error, and "
+                        "clean shutdown")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard the symbol axis over an N-device mesh "
                         "(0 = single device); N must divide --symbols")
@@ -419,6 +446,8 @@ def main(argv=None) -> int:
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
                        batch=args.batch, kernel=args.engine_kernel)
+    flight_dir = args.flight_dir or os.path.join(
+        os.path.dirname(os.path.abspath(args.db)), "flight")
     try:
         server, port, parts = build_server(
             args.addr, args.db, cfg, window_ms=args.window_ms,
@@ -430,6 +459,7 @@ def main(argv=None) -> int:
             gateway_addr=args.gateway_addr,
             pipeline_inflight=args.pipeline_inflight,
             native_lanes=args.native_lanes,
+            flight_dir=flight_dir,
         )
     except SystemExit as e:
         return int(e.code or 3)
@@ -448,17 +478,43 @@ def main(argv=None) -> int:
     stop_evt = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop_evt.set())
+    # SIGUSR2 -> flight-recorder JSON dump (operator post-mortem on a
+    # live server; no drain, no lock acquisition).
+    parts["recorder"].install_sigusr2()
 
     server.start()
     print(f"[SERVER] listening on port {port} "
           f"(symbols={cfg.num_symbols} capacity={cfg.capacity} batch={cfg.batch})")
+    obs = None
     try:
+        if args.metrics_port is not None:
+            try:
+                obs = ObsServer(
+                    parts["metrics"], recorder=parts["recorder"],
+                    ready_fn=lambda: not stop_evt.is_set(),  # 503 in drain
+                    port=args.metrics_port, host=args.metrics_host,
+                )
+            except OSError as e:
+                # Bind failures land AFTER the gRPC edges went live; the
+                # finally below still drains them cleanly. Same typed
+                # exit as a gRPC bind failure.
+                print(f"[SERVER] failed to bind metrics port "
+                      f"{args.metrics_port}: {e}", file=sys.stderr)
+                return 2
+            obs.start()
+            print(f"[SERVER] metrics on port {obs.port} "
+                  f"(/metrics /healthz /readyz /flightrecorder)")
         with trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext():
             stop_evt.wait()
+        return 0
     finally:
         print("[SERVER] shutting down")
+        # Shutdown BEFORE closing the obs endpoint: /readyz answers 503
+        # (and /healthz 200) throughout the grace drain, so a balancer
+        # sees the documented not-ready signal instead of conn-refused.
         shutdown(server, parts)
-    return 0
+        if obs is not None:
+            obs.close()
 
 
 if __name__ == "__main__":

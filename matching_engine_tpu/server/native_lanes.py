@@ -45,7 +45,7 @@ from matching_engine_tpu.engine.harness import PIPELINE_DEPTH, run_pipelined
 from matching_engine_tpu.engine.kernel import BUY, SELL, fill_inline_count
 from matching_engine_tpu.proto import pb2
 from matching_engine_tpu.server.engine_runner import EngineRunner, OrderInfo
-from matching_engine_tpu.utils.tracing import step_annotation
+from matching_engine_tpu.utils.tracing import span, step_annotation
 
 
 class NativeDispatchResult:
@@ -72,14 +72,15 @@ class _NativeStaged:
     `deferred` means every wave's device step is already issued and
     `items` holds the undecoded outputs."""
 
-    __slots__ = ("shape", "arrays", "items", "deferred", "issue")
+    __slots__ = ("shape", "arrays", "items", "deferred", "issue", "timeline")
 
-    def __init__(self, shape, arrays, issue):
+    def __init__(self, shape, arrays, issue, timeline=None):
         self.shape = shape
         self.arrays = arrays  # np lane buffers, one per wave
         self.items = deque()  # issued step outputs awaiting decode
         self.deferred = False
         self.issue = issue    # callable(arr) -> step output
+        self.timeline = timeline  # utils/obs.DispatchTimeline | None
 
 
 def publish_native_result(result: NativeDispatchResult, sink, hub,
@@ -130,12 +131,15 @@ class NativeLanesRunner(EngineRunner):
 
     # -- the native record dispatch ---------------------------------------
 
-    def dispatch_records(self, recs, n: int, on_finish) -> None:
+    def dispatch_records(self, recs, n: int, on_finish,
+                         timeline=None) -> None:
         """Serving-loop entry for raw MeGwOp record batches — the
         dispatch_pipelined twin (same _dispatch_common orchestration).
         `on_finish(result, error)` runs under the dispatch lock when this
         batch decodes (publish there); its return value, if not None,
-        runs after release (client completions)."""
+        runs after release (client completions). `timeline`
+        (utils/obs.DispatchTimeline) regains per-stage visibility on
+        this path: stamped per DISPATCH, never per op."""
 
         def stage():
             if not self._native_authoritative:
@@ -143,28 +147,35 @@ class NativeLanesRunner(EngineRunner):
                 # left in the Python directories (pending FIFO is empty
                 # before the first dispatch, so adopt cannot refuse).
                 self.adopt_from_python()
-            return self._stage_records_locked(recs, n)
+            return self._stage_records_locked(recs, n, timeline=timeline)
 
         self._dispatch_common(stage, on_finish)
 
-    def _stage_records_locked(self, recs, n: int) -> _NativeStaged:
+    def _stage_records_locked(self, recs, n: int,
+                              timeline=None) -> _NativeStaged:
         build_ou = self.hub is None or self.hub.has_order_update_subs()
         build_md = self.hub is None or self.hub.has_market_data_subs()
         # One ctypes crossing stages the whole batch: host checks, oid/
         # handle/slot assignment, wave placement. Raises before any ctx is
         # staged; native registrations are already rolled back on failure.
-        shape, n_waves, n_lanes, _n_ops, wave_k = self.lanes.build(
-            recs, n, build_ou, build_md)
+        with span("lane_build"):
+            shape, n_waves, n_lanes, _n_ops, wave_k = self.lanes.build(
+                recs, n, build_ou, build_md)
         if shape == 0:
             self.metrics.inc("sparse_dispatches")
         elif n_lanes:
             self.metrics.inc("dense_dispatches")
+        if timeline is not None:
+            timeline.shape = "sparse" if shape == 0 else "dense"
+            timeline.waves = n_waves
         issue = self._issue_sparse if shape == 0 else self._issue_dense
         try:
             arrays = [self.lanes.wave(w, shape, wave_k[w] if shape == 0
                                       else 0)
                       for w in range(n_waves)]
-            staged = _NativeStaged(shape, arrays, issue)
+            if timeline is not None:
+                timeline.stamp_build()
+            staged = _NativeStaged(shape, arrays, issue, timeline=timeline)
             if n_waves <= PIPELINE_DEPTH:
                 # Dispatch every wave now, decode later — the staged
                 # outputs are HBM-bounded by the wave-count cap, and the
@@ -177,6 +188,8 @@ class NativeLanesRunner(EngineRunner):
                     except (AttributeError, RuntimeError):
                         pass
                 staged.deferred = True
+                if timeline is not None:
+                    timeline.stamp_issue()
             return staged
         except BaseException:
             # The ctx staged by build() is the NEWEST; drop it (handles/
@@ -214,19 +227,20 @@ class NativeLanesRunner(EngineRunner):
         if not isinstance(staged, _NativeStaged):
             return super()._finish_locked(staged)
         try:
-            if staged.deferred:
-                while staged.items:
-                    self._decode_native(staged.items.popleft())
-            else:
-                # Ineligible for deferral (more waves than the HBM-bounded
-                # window): dispatch + decode with the same bounded
-                # dispatch-ahead window as the Python path.
-                def dispatch():
-                    for arr in staged.arrays:
-                        yield staged.issue(arr)
+            with span("lane_decode"):
+                if staged.deferred:
+                    while staged.items:
+                        self._decode_native(staged.items.popleft())
+                else:
+                    # Ineligible for deferral (more waves than the
+                    # HBM-bounded window): dispatch + decode with the same
+                    # bounded dispatch-ahead window as the Python path.
+                    def dispatch():
+                        for arr in staged.arrays:
+                            yield staged.issue(arr)
 
-                run_pipelined(dispatch(), self._decode_native)
-            comp_buf, store_buf, aux_buf = self.lanes.finish_take()
+                    run_pipelined(dispatch(), self._decode_native)
+                comp_buf, store_buf, aux_buf = self.lanes.finish_take()
         except BaseException:
             self.lanes.abort(newest=False)
             raise
@@ -235,6 +249,9 @@ class NativeLanesRunner(EngineRunner):
         self.metrics.inc("dispatches")
         self.metrics.inc("engine_ops", aux["counters"].get("engine_ops", 0))
         self.metrics.inc("fills", aux["counters"].get("fill_count", 0))
+        if staged.timeline is not None:
+            staged.timeline.stamp_decode()
+            staged.timeline.counters = dict(aux["counters"])
         return result
 
     def _apply_aux_locked(self, comp_buf, store_buf, aux) -> NativeDispatchResult:
@@ -375,7 +392,7 @@ class NativeLanesRunner(EngineRunner):
         finally:
             self.adopt_from_python()
 
-    def dispatch_pipelined(self, ops, on_finish) -> None:
+    def dispatch_pipelined(self, ops, on_finish, timeline=None) -> None:
         raise NotImplementedError(
             "NativeLanesRunner serves through dispatch_records; the "
             "EngineOp path would desync the native directory (use "

@@ -39,6 +39,7 @@ from matching_engine_tpu.server.dispatcher import BatchDispatcher, RingFull
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
 from matching_engine_tpu.server.streams import StreamHub
 from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.obs import STAGE_EDGE_INGRESS
 
 
 class MatchingEngineService(MatchingEngineServicer):
@@ -134,6 +135,11 @@ class MatchingEngineService(MatchingEngineServicer):
             quantity=request.quantity, remaining=request.quantity, status=0,
             handle=self.runner.assign_handle(),
         )
+        # Edge-ingress stage: RPC entry -> queue push (validation, id
+        # assignment, OrderInfo build). The queue-wait stage picks up at
+        # the enqueue stamp the dispatcher records.
+        self.metrics.observe(
+            STAGE_EDGE_INGRESS, (time.perf_counter() - t0) * 1e6)
         try:
             # Always OP_SUBMIT here: auction-mode classification happens
             # in the runner under the dispatch lock (atomic with the
@@ -158,6 +164,8 @@ class MatchingEngineService(MatchingEngineServicer):
             )
 
         dur_us = (time.perf_counter() - t0) * 1e6
+        # Disambiguated registry keys: the EMA lands as submit_rpc_us_ema
+        # (suffix applied inside ema_gauge), the window as _p50/_p99.
         self.metrics.ema_gauge("submit_rpc_us", dur_us)
         self.metrics.observe("submit_rpc_us", dur_us)  # -> submit_rpc_us_p50/p99
         if outcome.status == REJECTED and outcome.error:
@@ -178,6 +186,10 @@ class MatchingEngineService(MatchingEngineServicer):
         accept/reject metrics come from the dispatch's aux counters."""
         from matching_engine_tpu.server.dispatcher import RingFull
 
+        # Same edge-ingress stage as the Python path: RPC entry -> ring
+        # push (proto validation + record pack happen per op either way).
+        self.metrics.observe(
+            STAGE_EDGE_INGRESS, (time.perf_counter() - t0) * 1e6)
         try:
             outcome = self.dispatcher.submit_record(
                 1, side=request.side, otype=otype, price_q4=price_q4,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from matching_engine_tpu.storage.storage import FillRow, Storage
 
@@ -137,8 +138,10 @@ class SpillingSink:
 
 
 class AsyncStorageSink:
-    def __init__(self, storage: Storage, max_queue: int = 4096):
+    def __init__(self, storage: Storage, max_queue: int = 4096,
+                 metrics=None):
         self._storage = storage
+        self._metrics = metrics  # stage_sink_commit_us + sink_queue_depth
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="storage-sink", daemon=True)
@@ -177,6 +180,18 @@ class AsyncStorageSink:
         self._q.put(None)
         self._thread.join(timeout=10)
 
+    def _commit(self, orders, updates, fills) -> None:
+        """One WAL transaction — the stage ledger's sink-commit figure
+        (time actually spent in SQLite per batch, off the match path)."""
+        from matching_engine_tpu.utils.obs import STAGE_SINK_COMMIT
+
+        t0 = time.perf_counter()
+        self._storage.apply_batch(orders, updates, fills)
+        if self._metrics is not None:
+            self._metrics.observe(
+                STAGE_SINK_COMMIT, (time.perf_counter() - t0) * 1e6)
+            self._metrics.set_gauge("sink_queue_depth", self._q.qsize())
+
     def _run(self) -> None:
         while True:
             item = self._q.get()
@@ -193,10 +208,10 @@ class AsyncStorageSink:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._storage.apply_batch(orders, updates, fills)
+                    self._commit(orders, updates, fills)
                     return
                 if isinstance(nxt, tuple) and len(nxt) == 2 and nxt[0] == "FLUSH":
-                    self._storage.apply_batch(orders, updates, fills)
+                    self._commit(orders, updates, fills)
                     orders, updates, fills = [], [], []
                     nxt[1].set()
                     continue
@@ -204,4 +219,4 @@ class AsyncStorageSink:
                 updates.extend(nxt[1])
                 fills.extend(nxt[2])
             if orders or updates or fills:
-                self._storage.apply_batch(orders, updates, fills)
+                self._commit(orders, updates, fills)

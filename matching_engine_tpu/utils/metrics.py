@@ -27,6 +27,10 @@ class Metrics:
         self._gauges: dict[str, float] = {}
         # name -> (ring list, next write index)
         self._hists: dict[str, tuple[list, int]] = {}
+        # Optional utils/obs.py FlightRecorder, attached by build_server.
+        # Riding on the registry keeps the recorder reachable from every
+        # layer that already holds `metrics`, without constructor churn.
+        self.recorder = None
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -37,6 +41,15 @@ class Metrics:
             self._gauges[name] = float(value)
 
     def ema_gauge(self, name: str, value: float, alpha: float = 0.1) -> None:
+        """Exponential moving average, stored under `<name>_ema`.
+
+        The suffix is applied HERE so an EMA can never collide with the
+        same-named histogram's derived percentiles: Timer feeds both
+        `x_us` observe() and `x_us` ema_gauge(), which used to surface
+        as an indistinguishable bare `x_us` gauge next to `x_us_p50`
+        (the submit_rpc_us collision).
+        """
+        name = f"{name}_ema"
         with self._lock:
             prev = self._gauges.get(name)
             self._gauges[name] = value if prev is None else alpha * value + (1 - alpha) * prev
@@ -81,8 +94,9 @@ class Metrics:
 
 
 class Timer:
-    """Context manager feeding a microsecond EMA gauge plus the same-named
-    sliding-window histogram (surfaced as <name>_p50/_p99 in snapshot())."""
+    """Context manager feeding a microsecond EMA gauge (<name>_ema) plus
+    the sliding-window histogram (surfaced as <name>_p50/_p99 in
+    snapshot())."""
 
     def __init__(self, metrics: Metrics, gauge: str):
         self._m = metrics

@@ -1,0 +1,363 @@
+"""Observability subsystem: stage latency ledger, Prometheus exposition,
+and a crash flight recorder.
+
+Before this module the only visibility was the in-process Metrics registry
+behind one gRPC call — and the --native-lanes fast path moved per-op work
+into C++ where those hooks no longer fire, so the fastest configuration
+was the blindest one. Three layers fix that:
+
+1. **Stage latency ledger** (`DispatchTimeline`): every serving dispatch
+   carries monotonic stamps at the pipeline boundaries
+
+       edge ingress -> queue enqueue -> lane build -> device dispatch
+       -> completion decode -> stream publish -> sink commit
+
+   and the deltas land in `stage_<name>_us` sliding-window histograms
+   (p50/p99 via Metrics.snapshot). Stamps are per DISPATCH, not per op —
+   the native-lanes path regains per-stage visibility without re-adding
+   per-op Python work. Queue-depth and in-flight gauges ride along.
+
+2. **Prometheus exposition** (`render_prometheus` + `ObsServer`): a
+   stdlib-only HTTP thread serving `/metrics` (text format 0.0.4),
+   `/healthz`, `/readyz`, and `/flightrecorder` (JSON ring snapshot).
+   Counters export as `me_<name>_total`, gauges as `me_<name>`.
+
+3. **Flight recorder** (`FlightRecorder`): a bounded ring of recent
+   dispatch summaries (shape, counters, per-stage latencies, errors)
+   that dumps JSON on SIGUSR2, fatal dispatch error, and clean shutdown
+   — a soak/e2e failure leaves a post-mortem artifact instead of "it
+   got slow".
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+
+# Stage histogram names, in pipeline order. Each is a Metrics.observe
+# histogram in microseconds, exported with _p50/_p99 derived gauges.
+STAGE_EDGE_INGRESS = "stage_edge_ingress_us"       # RPC entry -> ring/queue push
+STAGE_QUEUE_WAIT = "stage_queue_wait_us"           # enqueue -> drain pop
+STAGE_LANE_BUILD = "stage_lane_build_us"           # pop -> device buffers built
+STAGE_DEVICE_DISPATCH = "stage_device_dispatch_us" # buffers built -> waves issued
+STAGE_COMPLETION_DECODE = "stage_completion_decode_us"  # issue -> decoded (incl. pipeline residency + device wait)
+STAGE_STREAM_PUBLISH = "stage_stream_publish_us"   # decode -> sink/hub enqueued
+STAGE_SINK_COMMIT = "stage_sink_commit_us"         # one storage batch's SQLite txn
+
+STAGES = (
+    STAGE_EDGE_INGRESS, STAGE_QUEUE_WAIT, STAGE_LANE_BUILD,
+    STAGE_DEVICE_DISPATCH, STAGE_COMPLETION_DECODE, STAGE_STREAM_PUBLISH,
+    STAGE_SINK_COMMIT,
+)
+
+
+class DispatchTimeline:
+    """Monotonic stamps for ONE dispatch crossing the serving pipeline.
+
+    Created by a drain loop when it pops a batch (`path` names the edge:
+    "python", "native-lanes", "gateway", "gateway-lanes"); the runner
+    stamps the batch as it crosses each boundary; `finish()` folds the
+    deltas into the stage histograms and appends one flight-recorder
+    entry (when the registry carries one). All stamps are optional —
+    a boundary never crossed simply records nothing.
+    """
+
+    __slots__ = ("path", "n_ops", "t_enqueue", "t_pop", "t_build",
+                 "t_issue", "t_decode", "t_publish", "shape", "waves",
+                 "counters")
+
+    def __init__(self, path: str, n_ops: int, t_enqueue: float | None = None,
+                 t_pop: float | None = None):
+        self.path = path
+        self.n_ops = n_ops
+        self.t_enqueue = t_enqueue   # earliest op enqueue (queue-wait origin)
+        self.t_pop = time.perf_counter() if t_pop is None else t_pop
+        self.t_build = None
+        self.t_issue = None
+        self.t_decode = None
+        self.t_publish = None
+        self.shape = ""              # "sparse" | "dense" | "mesh"
+        self.waves = 0
+        self.counters: dict = {}
+
+    def stamp_build(self) -> None:
+        self.t_build = time.perf_counter()
+
+    def stamp_issue(self) -> None:
+        self.t_issue = time.perf_counter()
+
+    def stamp_decode(self) -> None:
+        self.t_decode = time.perf_counter()
+
+    def stamp_publish(self) -> None:
+        self.t_publish = time.perf_counter()
+
+    def _stages_us(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+
+        def delta(name, a, b):
+            if a is not None and b is not None and b >= a:
+                out[name] = (b - a) * 1e6
+
+        delta(STAGE_QUEUE_WAIT, self.t_enqueue, self.t_pop)
+        delta(STAGE_LANE_BUILD, self.t_pop, self.t_build)
+        delta(STAGE_DEVICE_DISPATCH, self.t_build, self.t_issue)
+        # Decode is stamped when THIS batch's results are decoded, which
+        # under pipelining includes up to pipeline_inflight batches of
+        # residency — the client-felt figure, same convention as
+        # dispatch_us.
+        delta(STAGE_COMPLETION_DECODE, self.t_issue or self.t_build,
+              self.t_decode)
+        delta(STAGE_STREAM_PUBLISH, self.t_decode, self.t_publish)
+        return out
+
+    def finish(self, metrics, error: Exception | None = None) -> None:
+        """Fold the stamped deltas into the stage histograms and the
+        flight-recorder ring. Call exactly once, from the edge's
+        on_finish callback (dispatch lock held there is fine — observe()
+        is the hot-path-safe registry call)."""
+        stages = self._stages_us()
+        for name, us in stages.items():
+            metrics.observe(name, us)
+        recorder = getattr(metrics, "recorder", None)
+        if recorder is None:
+            return
+        entry = {
+            "kind": "dispatch" if error is None else "dispatch_error",
+            "path": self.path,
+            "ops": self.n_ops,
+            "shape": self.shape,
+            "waves": self.waves,
+            "stages_us": {k: round(v, 1) for k, v in stages.items()},
+            "counters": dict(self.counters),
+        }
+        if error is not None:
+            entry["error"] = f"{type(error).__name__}: {error}"
+        recorder.record(entry)
+        if error is not None:
+            recorder.dump_on_error()
+
+
+def record_dispatch_error(metrics, where: str, error: Exception) -> None:
+    """Flight-record a drain-loop failure that never made it to a
+    timeline (pop/stage machinery raised) and dump a post-mortem."""
+    recorder = getattr(metrics, "recorder", None)
+    if recorder is None:
+        return
+    recorder.record({
+        "kind": "error", "where": where,
+        "error": f"{type(error).__name__}: {error}",
+    })
+    recorder.dump_on_error()
+
+
+class FlightRecorder:
+    """Bounded ring of recent dispatch summaries with JSON dumps.
+
+    Recording is cheap (one dict append under a lock, per DISPATCH);
+    the ring overwrites oldest-first. Dumps go to `dump_dir` as
+    `flight_<utc>_<reason>.json`; with no dump_dir the ring still
+    records (snapshot() serves /flightrecorder) but dump() is a no-op
+    returning None. Error-triggered dumps are rate-limited so a
+    persistent fault can't fill the disk with identical post-mortems.
+    """
+
+    def __init__(self, capacity: int = 512, dump_dir: str | None = None,
+                 error_dump_interval_s: float = 30.0):
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_error_dump = 0.0
+        self._prev_sigusr2 = None
+        self.dump_dir = dump_dir
+        self.error_dump_interval_s = error_dump_interval_s
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            e = dict(entry)
+            e["seq"] = self._seq
+            e["wall_ts"] = time.time()
+            self._ring.append(e)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def dump(self, reason: str) -> str | None:
+        """Write the ring to a timestamped JSON file; returns the path
+        (None when no dump_dir is configured or the write failed — a
+        post-mortem must never take the server down with it)."""
+        if not self.dump_dir:
+            return None
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            path = os.path.join(
+                self.dump_dir, f"flight_{ts}_{os.getpid()}_{reason}.json")
+            doc = {
+                "reason": reason,
+                "wall_ts": time.time(),
+                "pid": os.getpid(),
+                "entries": self.snapshot(),
+            }
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1)
+            print(f"[obs] flight recorder dumped {len(doc['entries'])} "
+                  f"entries to {path} ({reason})")
+            return path
+        except OSError as e:
+            print(f"[obs] flight recorder dump failed: "
+                  f"{type(e).__name__}: {e}")
+            return None
+
+    def dump_on_error(self) -> bool:
+        """Rate-limited dump for fatal dispatch errors. The write runs on
+        a background daemon thread: callers sit on serving-critical paths
+        (timeline.finish runs under the dispatch lock), and a slow disk
+        must never stall dispatches for a post-mortem. Returns whether a
+        dump was scheduled."""
+        if not self.dump_dir:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_error_dump < self.error_dump_interval_s:
+                return False
+            self._last_error_dump = now
+        threading.Thread(target=self.dump, args=("dispatch-error",),
+                         name="flight-dump", daemon=True).start()
+        return True
+
+    def install_sigusr2(self) -> bool:
+        """SIGUSR2 -> dump("sigusr2"). Main thread only (signal module
+        restriction); returns False where unavailable (e.g. Windows)."""
+        if not hasattr(signal, "SIGUSR2"):
+            return False
+        try:
+            self._prev_sigusr2 = signal.signal(
+                signal.SIGUSR2, lambda *_: self.dump("sigusr2"))
+            return True
+        except ValueError:  # not the main thread
+            return False
+
+    def uninstall_sigusr2(self) -> None:
+        if self._prev_sigusr2 is not None:
+            signal.signal(signal.SIGUSR2, self._prev_sigusr2)
+            self._prev_sigusr2 = None
+
+
+# -- Prometheus text exposition ---------------------------------------------
+
+_PROM_PREFIX = "me_"
+
+
+def _prom_name(name: str) -> str:
+    """Registry key -> Prometheus metric name (charset is already
+    [a-z0-9_] by construction; prefix namespaces the exporter)."""
+    return _PROM_PREFIX + name
+
+
+def render_prometheus(metrics) -> str:
+    """Render the full registry in Prometheus text format 0.0.4.
+
+    Counters -> `me_<name>_total` (counter); gauges -> `me_<name>`
+    (gauge). Histogram windows surface through snapshot() as the
+    derived `<name>_p50`/`<name>_p99` gauges — quantiles computed
+    server-side over the sliding window, exported as plain gauges
+    (the scraper gets stable names without native histogram buckets).
+    """
+    counters, gauges = metrics.snapshot()
+    lines: list[str] = []
+    for name in sorted(counters):
+        p = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {p} counter")
+        lines.append(f"{p} {int(counters[name])}")
+    for name in sorted(gauges):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} gauge")
+        v = float(gauges[name])
+        lines.append(f"{p} {v:.6g}")
+    return "\n".join(lines) + "\n"
+
+
+class ObsServer:
+    """The `--metrics-port` endpoint: a stdlib-only ThreadingHTTPServer
+    on its own daemon thread.
+
+      GET /metrics         Prometheus text format (full registry)
+      GET /healthz         200 while the process serves requests
+      GET /readyz          200 once serving, 503 during shutdown
+      GET /flightrecorder  JSON snapshot of the flight-recorder ring
+
+    No third-party exporter dependency: the container must not need a
+    pip install to be scrapable.
+    """
+
+    def __init__(self, metrics, recorder: FlightRecorder | None = None,
+                 ready_fn=None, port: int = 0, host: str = "127.0.0.1"):
+        # Loopback by default: /flightrecorder exposes internal dispatch
+        # detail — exporting to a scrape network is an explicit choice
+        # (--metrics-host 0.0.0.0), not a side effect of enabling metrics.
+        self.metrics = metrics
+        self.recorder = recorder
+        self.ready_fn = ready_fn or (lambda: True)
+        obs = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no per-scrape stderr spam
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200, render_prometheus(obs.metrics).encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/healthz":
+                        self._send(200, b"ok\n", "text/plain")
+                    elif path == "/readyz":
+                        if obs.ready_fn():
+                            self._send(200, b"ready\n", "text/plain")
+                        else:
+                            self._send(503, b"shutting down\n", "text/plain")
+                    elif path == "/flightrecorder":
+                        entries = (obs.recorder.snapshot()
+                                   if obs.recorder is not None else [])
+                        self._send(200, json.dumps(entries).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # scraper hung up mid-response
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-http", daemon=True)
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()

@@ -33,3 +33,13 @@ def trace(log_dir: str):
 def step_annotation(name: str, step: int):
     """Annotate one engine dispatch in the device trace."""
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def span(name: str):
+    """Label an arbitrary host-side section in the device trace (the
+    non-step sibling of step_annotation). The native-lanes dispatch loop
+    wraps its C++ lane build and completion decode in these so a
+    --profile-dir trace shows per-batch boundaries in BOTH serving modes
+    — before this, only EngineRunner's device steps were annotated and
+    the native path's host sections were anonymous gaps."""
+    return jax.profiler.TraceAnnotation(name)

@@ -39,25 +39,43 @@ make -s -C native || { echo "FAIL: native build"; exit 1; }
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --gateway-addr 127.0.0.1:0 --auction-open \
+  --metrics-port 0 --flight-dir "$WORK/flight" \
   ${SOAK_SERVER_ARGS:-} \
   --checkpoint-dir "$WORK/ckpts" --checkpoint-interval-s 5 \
   > "$WORK/server.log" 2>&1 &
 SRV=$!
 trap 'kill $SRV 2>/dev/null' EXIT
 
-PY_PORT=""; GW_PORT=""
+PY_PORT=""; GW_PORT=""; OBS_PORT=""
 BOOT_WAIT=120
 [ "$SOAK_PLATFORM" = "tpu" ] && BOOT_WAIT=240   # on-device compile at boot
 for i in $(seq 1 "$BOOT_WAIT"); do
   PY_PORT=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
   GW_PORT=$(sed -n 's/.*native gateway on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
-  [ -n "$PY_PORT" ] && [ -n "$GW_PORT" ] && break
+  OBS_PORT=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server.log" | head -1)
+  [ -n "$PY_PORT" ] && [ -n "$GW_PORT" ] && [ -n "$OBS_PORT" ] && break
   kill -0 $SRV 2>/dev/null || { echo "FAIL: server died at boot"; tail -5 "$WORK/server.log"; exit 1; }
   sleep 1
 done
-if [ -z "$PY_PORT" ] || [ -z "$GW_PORT" ]; then
+if [ -z "$PY_PORT" ] || [ -z "$GW_PORT" ] || [ -z "$OBS_PORT" ]; then
   echo "FAIL: server ports never appeared"; tail -5 "$WORK/server.log"; exit 1
 fi
+
+# Periodic /metrics scrapes accumulate the per-stage latency series next
+# to the soak's JSON artifact (one "# scrape <epoch>" block per round).
+METRICS_OUT="$OUT_DIR/soak_${TS}_metrics.prom"
+scrape_metrics() {
+  python - "$OBS_PORT" >> "$METRICS_OUT" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+}
 CLI=matching_engine_tpu/native/me_client
 GW="127.0.0.1:$GW_PORT"; PY="127.0.0.1:$PY_PORT"
 
@@ -98,10 +116,13 @@ except Exception: print(0)")
   # Auction quiesce under load (usually a no-op clear; exercises the
   # dispatch-lock/pending/checkpoint interplay concurrently with traffic).
   "$CLI" auction "$GW" >/dev/null 2>&1 || true
+  scrape_metrics
   ROUNDS=$((ROUNDS + 1))
 done
 [ "$OK_TOTAL" -gt 0 ] || { echo "FAIL: no orders succeeded"; exit 1; }
 [ "$CANCELS" -gt 0 ] || { echo "FAIL: no cancels succeeded"; exit 1; }
+grep -q "^me_stage_queue_wait_us_p99" "$METRICS_OUT" \
+  || { echo "FAIL: stage ledger absent from /metrics scrapes"; exit 1; }
 
 sleep 2
 AUDIT=$(python - "$DB" <<'EOF'
@@ -114,6 +135,10 @@ EOF
 )
 AUDIT=$(echo "$AUDIT" | tail -1)
 kill $SRV 2>/dev/null; wait $SRV 2>/dev/null; trap - EXIT
+# Clean shutdown dumps the flight recorder; keep the post-mortem with
+# the artifact (ls -t: newest dump wins if an error dumped earlier too).
+FLIGHT=$(ls -t "$WORK"/flight/flight_*.json 2>/dev/null | head -1)
+[ -n "$FLIGHT" ] && cp "$FLIGHT" "$OUT_DIR/soak_${TS}_flight.json"
 
 python - "$OUT_DIR/soak_${TS}.json" <<EOF
 import json, subprocess, sys

@@ -118,7 +118,7 @@ def test_timer_feeds_gauge():
     with Timer(m, "x_us"):
         pass
     _, gauges = m.snapshot()
-    assert "x_us" in gauges and gauges["x_us"] >= 0
+    assert "x_us_ema" in gauges and gauges["x_us_ema"] >= 0
 
 
 def test_gateway_bridge_rejects_undecodable_records():

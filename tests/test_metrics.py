@@ -33,7 +33,10 @@ def test_timer_feeds_both_ema_and_histogram():
         with Timer(m, "t_us"):
             pass
     _, gauges = m.snapshot()
-    assert "t_us" in gauges
+    # The EMA is suffixed _ema so it can never shadow the window's
+    # derived percentiles (the submit_rpc_us collision fix).
+    assert "t_us_ema" in gauges
+    assert "t_us" not in gauges
     assert "t_us_p50" in gauges and "t_us_p99" in gauges
 
 

@@ -1,0 +1,417 @@
+"""Observability subsystem tests (utils/obs.py).
+
+Three layers under test:
+- unit: FlightRecorder ring bounds, SIGUSR2 dump, dump-on-error rate
+  limit; Prometheus rendering; ObsServer endpoints; DispatchTimeline.
+- e2e: a real server (build_server) on BOTH serving paths — pure Python
+  and --native-lanes — scraped over HTTP, asserting the per-stage
+  latency histograms and queue-depth gauges are present and non-zero,
+  and that SIGUSR2 dumps a flight-recorder JSON containing the most
+  recent dispatches.
+- lint: every metric name in docs/OPERATIONS.md's Observability table
+  must be emitted by the code (docs and registry must not drift).
+"""
+
+import json
+import os
+import pathlib
+import re
+import signal
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils import obs as obs_module
+from matching_engine_tpu.utils.obs import (
+    DispatchTimeline,
+    FlightRecorder,
+    ObsServer,
+    record_dispatch_error,
+    render_prometheus,
+)
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+
+
+# -- unit: flight recorder ---------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded():
+    r = FlightRecorder(capacity=4)
+    for i in range(10):
+        r.record({"kind": "dispatch", "i": i})
+    snap = r.snapshot()
+    assert len(r) == 4 and len(snap) == 4
+    # Oldest overwritten: only the newest four survive, in order.
+    assert [e["i"] for e in snap] == [6, 7, 8, 9]
+    assert all("wall_ts" in e and "seq" in e for e in snap)
+
+
+def test_flight_recorder_dump_and_sigusr2(tmp_path):
+    d = str(tmp_path / "flight")
+    r = FlightRecorder(capacity=8, dump_dir=d)
+    r.record({"kind": "dispatch", "ops": 3})
+    assert r.install_sigusr2()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        path = None
+        for _ in range(200):  # handler runs at the next bytecode boundary
+            files = list(pathlib.Path(d).glob("flight_*_sigusr2.json"))
+            if files:
+                path = files[0]
+                break
+            time.sleep(0.01)
+        assert path is not None, "SIGUSR2 produced no dump"
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "sigusr2"
+        assert [e["kind"] for e in doc["entries"]] == ["dispatch"]
+    finally:
+        r.uninstall_sigusr2()
+
+
+def _wait_for_dumps(d, pattern="flight_*.json", timeout_s=3.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        files = list(pathlib.Path(d).glob(pattern))
+        if files:
+            return files
+        time.sleep(0.01)
+    return []
+
+
+def test_flight_recorder_dump_on_error_is_rate_limited(tmp_path):
+    d = str(tmp_path / "flight")
+    m = Metrics()
+    m.recorder = FlightRecorder(dump_dir=d, error_dump_interval_s=1000.0)
+    record_dispatch_error(m, "unit", RuntimeError("boom"))
+    # Error dumps run on a background thread (callers hold the dispatch
+    # lock): wait for the write to land.
+    files = _wait_for_dumps(d, "flight_*_dispatch-error.json")
+    assert len(files) == 1, "first dispatch error must dump"
+    doc = json.loads(files[0].read_text())
+    assert doc["entries"][-1]["kind"] == "error"
+    assert "boom" in doc["entries"][-1]["error"]
+    # Second error inside the rate-limit window: recorded, not dumped
+    # (dump_on_error refuses synchronously — no thread to wait on).
+    assert not m.recorder.dump_on_error()
+    record_dispatch_error(m, "unit", RuntimeError("boom2"))
+    assert len(list(pathlib.Path(d).glob("flight_*.json"))) == 1
+    assert len(m.recorder) == 2
+
+
+def test_flight_recorder_dump_without_dir_is_noop():
+    r = FlightRecorder()
+    r.record({"kind": "dispatch"})
+    assert r.dump("shutdown") is None  # ring still live for /flightrecorder
+    assert len(r) == 1
+
+
+# -- unit: timeline + exposition ---------------------------------------------
+
+
+def test_timeline_feeds_stage_histograms_and_recorder():
+    m = Metrics()
+    m.recorder = FlightRecorder(capacity=4)
+    t0 = time.perf_counter()
+    tl = DispatchTimeline("python", 5, t_enqueue=t0 - 0.001)
+    tl.shape = "sparse"
+    tl.stamp_build()
+    tl.stamp_issue()
+    tl.stamp_decode()
+    tl.stamp_publish()
+    tl.counters = {"fills": 2}
+    tl.finish(m)
+    _, gauges = m.snapshot()
+    for stage in ("stage_queue_wait_us", "stage_lane_build_us",
+                  "stage_device_dispatch_us", "stage_completion_decode_us",
+                  "stage_stream_publish_us"):
+        assert f"{stage}_p50" in gauges, stage
+    assert gauges["stage_queue_wait_us_p50"] >= 1000  # the 1ms enqueue gap
+    (entry,) = m.recorder.snapshot()
+    assert entry["kind"] == "dispatch" and entry["path"] == "python"
+    assert entry["counters"] == {"fills": 2}
+    assert set(entry["stages_us"]) >= {"stage_queue_wait_us",
+                                       "stage_lane_build_us"}
+
+
+def test_timeline_error_records_and_dumps(tmp_path):
+    m = Metrics()
+    m.recorder = FlightRecorder(dump_dir=str(tmp_path / "f"),
+                                error_dump_interval_s=0.0)
+    tl = DispatchTimeline("gateway", 2)
+    tl.finish(m, error=RuntimeError("device fell over"))
+    (entry,) = m.recorder.snapshot()
+    assert entry["kind"] == "dispatch_error"
+    assert "device fell over" in entry["error"]
+    assert _wait_for_dumps(tmp_path / "f"), \
+        "fatal dispatch error must dump a post-mortem"
+
+
+def test_render_prometheus_names_and_types():
+    m = Metrics()
+    m.inc("orders_accepted", 3)
+    m.set_gauge("queue_depth", 7)
+    for v in (1.0, 2.0, 3.0):
+        m.observe("lat_us", v)
+    m.ema_gauge("lat_us", 2.0)
+    text = render_prometheus(m)
+    assert "# TYPE me_orders_accepted_total counter" in text
+    assert "me_orders_accepted_total 3" in text
+    assert "# TYPE me_queue_depth gauge" in text
+    assert "me_queue_depth 7" in text
+    # Window percentiles as derived gauges; the EMA is suffix-separated.
+    assert "me_lat_us_p50" in text and "me_lat_us_p99" in text
+    assert "me_lat_us_ema" in text
+    assert re.search(r"^me_lat_us ", text, re.M) is None  # no bare collision
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _parse_prom(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
+def test_obs_server_endpoints():
+    m = Metrics()
+    m.inc("dispatches", 2)
+    rec = FlightRecorder()
+    rec.record({"kind": "dispatch", "ops": 1})
+    ready = {"v": True}
+    obs = ObsServer(m, recorder=rec, ready_fn=lambda: ready["v"],
+                    port=0, host="127.0.0.1")
+    obs.start()
+    try:
+        assert _get(obs.port, "/healthz")[0] == 200
+        assert _get(obs.port, "/readyz")[0] == 200
+        code, body = _get(obs.port, "/metrics")
+        assert code == 200 and _parse_prom(body)["me_dispatches_total"] == 2
+        code, body = _get(obs.port, "/flightrecorder")
+        assert code == 200 and json.loads(body)[0]["ops"] == 1
+        ready["v"] = False  # drain began: readiness flips, liveness holds
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(obs.port, "/readyz")
+        assert ei.value.code == 503
+        assert _get(obs.port, "/healthz")[0] == 200
+    finally:
+        obs.close()
+
+
+# -- e2e: both serving paths -------------------------------------------------
+
+
+class _Harness:
+    def __init__(self, db_path, flight_dir, **kw):
+        self.server, self.port, self.parts = build_server(
+            "127.0.0.1:0", db_path, CFG, window_ms=1.0, log=False,
+            flight_dir=flight_dir, **kw)
+        self.server.start()
+        self.obs = ObsServer(self.parts["metrics"],
+                             recorder=self.parts["recorder"],
+                             port=0, host="127.0.0.1")
+        self.obs.start()
+        self.channel = grpc.insecure_channel(f"127.0.0.1:{self.port}")
+        self.stub = MatchingEngineStub(self.channel)
+
+    def close(self):
+        self.obs.close()
+        self.channel.close()
+        shutdown(self.server, self.parts)
+
+
+def _submit(stub, client, side, price, qty=5):
+    return stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol="OBS", order_type=pb2.LIMIT,
+                         side=side, price=price, scale=4, quantity=qty),
+        timeout=10)
+
+
+def _drive_and_scrape(hs):
+    for i in range(4):
+        assert _submit(hs.stub, "maker", pb2.SELL, 10000 + i).success
+        assert _submit(hs.stub, "taker", pb2.BUY, 10100 + i).success
+    hs.parts["sink"].flush()
+    code, body = _get(hs.obs.port, "/metrics")
+    assert code == 200
+    assert _get(hs.obs.port, "/healthz")[0] == 200
+    return _parse_prom(body)
+
+
+# Present-and-nonzero on every serving path (acceptance criterion).
+_CORE_STAGES = ("stage_edge_ingress_us", "stage_queue_wait_us",
+                "stage_lane_build_us", "stage_device_dispatch_us",
+                "stage_completion_decode_us")
+
+
+def _assert_stage_ledger(prom, extra_stages=(), gauges=()):
+    for stage in _CORE_STAGES + tuple(extra_stages):
+        assert f"me_{stage}_p50" in prom, f"missing {stage}_p50"
+        assert f"me_{stage}_p99" in prom, f"missing {stage}_p99"
+        assert prom[f"me_{stage}_p50"] > 0, f"{stage} histogram empty"
+    # Publish is stamped even with no subscribers; duration may round to
+    # ~0 on a fast host, so presence is the assertion.
+    assert "me_stage_stream_publish_us_p50" in prom
+    for g in gauges:
+        assert f"me_{g}" in prom, f"missing gauge {g}"
+
+
+def test_e2e_python_path_metrics_and_flight_dump(tmp_path):
+    hs = _Harness(str(tmp_path / "e2e.db"), str(tmp_path / "flight"),
+                  native=False)
+    try:
+        prom = _drive_and_scrape(hs)
+        # Pure-Python sink commits SQLite on its own thread: the commit
+        # stage must have real samples after the flush barrier.
+        _assert_stage_ledger(prom, extra_stages=("stage_sink_commit_us",),
+                             gauges=("queue_depth", "inflight_dispatches",
+                                     "sink_queue_depth"))
+        assert prom["me_dispatches_total"] >= 1
+        # submit_rpc_us collision fixed: EMA and percentiles coexist
+        # under distinct names, no bare submit_rpc_us gauge.
+        assert "me_submit_rpc_us_ema" in prom
+        assert "me_submit_rpc_us_p99" in prom
+        assert "me_submit_rpc_us" not in prom
+        # SIGUSR2 on the serving process dumps the recent dispatches.
+        rec = hs.parts["recorder"]
+        assert rec.install_sigusr2()
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            path = None
+            for _ in range(200):
+                files = list(
+                    (tmp_path / "flight").glob("flight_*_sigusr2.json"))
+                if files:
+                    path = files[0]
+                    break
+                time.sleep(0.01)
+        finally:
+            rec.uninstall_sigusr2()
+        assert path is not None, "SIGUSR2 produced no flight dump"
+        doc = json.loads(path.read_text())
+        dispatches = [e for e in doc["entries"] if e["kind"] == "dispatch"]
+        assert dispatches, "dump holds no dispatch summaries"
+        assert dispatches[-1]["path"] == "python"
+        assert dispatches[-1]["stages_us"].get("stage_lane_build_us", 0) > 0
+    finally:
+        hs.close()
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_e2e_native_lanes_metrics(tmp_path):
+    hs = _Harness(str(tmp_path / "lanes.db"), str(tmp_path / "flight"),
+                  native_lanes=True)
+    try:
+        prom = _drive_and_scrape(hs)
+        _assert_stage_ledger(prom, gauges=("inflight_ops",
+                                           "inflight_dispatches"))
+        assert prom["me_dispatches_total"] >= 1
+        assert prom["me_orders_accepted_total"] >= 8
+        # The fastest path is no longer the blindest: flight entries
+        # carry the native aux counters and per-stage latencies.
+        code, body = _get(hs.obs.port, "/flightrecorder")
+        assert code == 200
+        dispatches = [e for e in json.loads(body)
+                      if e["kind"] == "dispatch"]
+        assert dispatches and dispatches[-1]["path"] == "native-lanes"
+        assert "engine_ops" in dispatches[-1]["counters"]
+    finally:
+        hs.close()
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_native_lanes_profile_annotations_and_stamps(tmp_path):
+    """--profile-dir satellite: the native-lanes dispatch loop runs its
+    lane build/decode inside trace annotations (tracing.span), so a
+    device trace captures per-batch boundaries in this mode too; the
+    stage ledger stamps ride the same dispatch."""
+    from matching_engine_tpu.server.native_lanes import (
+        NativeLanesRunner,
+        pack_record_batch,
+    )
+    from matching_engine_tpu.utils.tracing import trace
+
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=8,
+                       max_fills=1 << 12)
+    r = NativeLanesRunner(cfg)
+    recs, n = pack_record_batch([
+        (1, 1, 1, 0, 10_000, 5, "S0", "c1", ""),
+        (2, 1, 2, 0, 10_000, 5, "S1", "c2", ""),
+    ])
+    got = {}
+
+    def on_finish(result, error):
+        got["result"], got["error"] = result, error
+
+    tl = DispatchTimeline("native-lanes", n)
+    d = tmp_path / "prof"
+    with trace(str(d)):
+        r.dispatch_records(recs, n, on_finish, timeline=tl)
+        r.finish_pending()
+    assert got["error"] is None and got["result"] is not None
+    assert list(d.rglob("*")), "no trace files from the native-lanes loop"
+    tl.finish(r.metrics)  # the edge's job; here: fold stamps for assert
+    _, gauges = r.metrics.snapshot()
+    assert gauges["stage_lane_build_us_p50"] > 0
+    assert gauges["stage_completion_decode_us_p50"] > 0
+    assert tl.shape in ("sparse", "dense") and tl.waves >= 1
+
+
+# -- lint: OPERATIONS.md table <-> registry ----------------------------------
+
+
+def test_operations_doc_metric_table_matches_registry():
+    """Every row of the Observability metric table must name a metric the
+    code actually emits — the drift guard the table's stability promise
+    rests on. Checks the emit call sites (inc/set_gauge/ema_gauge/
+    observe/Timer literals, the obs.py stage constants, and the native
+    aux counter mapping)."""
+    root = pathlib.Path(__file__).resolve().parents[1]
+    doc = (root / "docs" / "OPERATIONS.md").read_text()
+    rows = re.findall(
+        r"^\|\s*`([a-z0-9_]+)`\s*\|\s*(counter|gauge|ema|histogram)\s*\|",
+        doc, re.M)
+    assert len(rows) >= 40, "Observability metric table missing or shrunk"
+    src = "\n".join(p.read_text()
+                    for p in (root / "matching_engine_tpu").rglob("*.py"))
+
+    def emitted(name: str, typ: str) -> bool:
+        if typ == "counter":
+            # Direct inc("...") or the native aux-counter mapping tuples.
+            pats = [rf'inc\(\s*"{name}"', rf'"{name}"\)']
+        elif typ == "gauge":
+            pats = [rf'set_gauge\(\s*"{name}"']
+        elif typ == "ema":
+            assert name.endswith("_ema"), f"{name}: ema rows need _ema"
+            base = name[:-len("_ema")]
+            pats = [rf'ema_gauge\(\s*"{base}"', rf'Timer\([^)]*"{base}"']
+        else:  # histogram (exported as <name>_p50/_p99)
+            pats = [rf'observe\(\s*"{name}"', rf'Timer\([^)]*"{name}"',
+                    rf'STAGE_[A-Z_]+ = "{name}"']
+        return any(re.search(p, src, re.S) for p in pats)
+
+    missing = [f"{n} ({t})" for n, t in rows if not emitted(n, t)]
+    assert not missing, f"documented but never emitted: {missing}"
+    # And the reverse for the stage ledger: every pipeline stage obs.py
+    # defines must be documented as a histogram row.
+    documented = {n for n, t in rows if t == "histogram"}
+    undocumented = [s for s in obs_module.STAGES if s not in documented]
+    assert not undocumented, f"stages missing from the table: {undocumented}"
