@@ -179,10 +179,11 @@ def main() -> None:
     def make_point(mode: str, inflight: int, batch_ops: int):
         """Fresh (runner, batches, dispatch) triple for one measured pass —
         host-only mode runs this twice with an identical op stream. Both
-        runners get a subscriber-less StreamHub (the common serving case:
-        stream protos are gated off, exactly as build_server wires it —
-        hub=None would force per-op proto materialization neither path
-        pays in production)."""
+        runners get a subscriber-less, sequencer-less StreamHub (stream
+        protos gated off — the max-throughput configuration build_server
+        wires under --feed-depth 0; the default sequenced feed always
+        materializes events for its retransmission store, and hub=None
+        would force the same per-op proto materialization)."""
         from matching_engine_tpu.server.streams import StreamHub
 
         hub = StreamHub()

@@ -28,6 +28,10 @@ USAGE = (
     "   or: client amend <addr> <client_id> <order_id> <new_qty>\n"
     "   or: client watch-md <addr> <symbol>\n"
     "   or: client watch-orders <addr> <client_id>\n"
+    "   or: client subscribe <addr> md <symbol> | orders <client_id>\n"
+    "                 [--from-seq N] [--epoch N] [--conflate]\n"
+    "                 [--no-gap-fill] [--max-events N]\n"
+    "                 [--idle-exit SECS] [--summary-json FILE] [--quiet]\n"
     "   or: client metrics <addr>\n"
     "   or: client auction <addr> [symbol]"
 )
@@ -155,6 +159,134 @@ def _watch_orders(addr: str, client_id: str) -> int:
     return 0
 
 
+def _subscribe(argv: list[str]) -> int:
+    """Sequenced-feed subscriber (feed/client.py): prints events, detects
+    sequence gaps LOUDLY on stderr, auto-gap-fills them from the server's
+    retransmission store, and exits non-zero (4) on any unrecovered gap —
+    the soak/CI feed-integrity assertion. `watch-md`/`watch-orders` stay
+    the raw unsequenced taps."""
+    import json
+    import signal
+    import threading
+    import time
+
+    from matching_engine_tpu.feed.client import SequencedSubscriber
+    from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
+
+    addr, kind, key = argv[0], argv[1], argv[2]
+    channel = {"md": CHANNEL_MD, "orders": CHANNEL_OU}.get(kind)
+    if channel is None:
+        print(USAGE, file=sys.stderr)
+        return 1
+    from_seq, epoch, max_events, idle_exit = 0, 0, 0, 0.0
+    conflate, gap_fill, quiet, summary_json = False, True, False, None
+    it = iter(argv[3:])
+    try:
+        for a in it:
+            if a == "--from-seq":
+                from_seq = int(next(it))
+            elif a == "--epoch":
+                epoch = int(next(it))
+            elif a == "--conflate":
+                conflate = True
+            elif a == "--no-gap-fill":
+                gap_fill = False
+            elif a == "--max-events":
+                max_events = int(next(it))
+            elif a == "--idle-exit":
+                idle_exit = float(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            elif a == "--quiet":
+                quiet = True
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except StopIteration:
+        print(USAGE, file=sys.stderr)
+        return 1
+
+    def on_gap(start, end, filled, missing):
+        print(f"[client] FEED GAP {channel}/{key}: seq {start + 1}.."
+              f"{end - 1} missed upstream; {filled} gap-filled, "
+              f"{missing} UNRECOVERED", file=sys.stderr, flush=True)
+
+    def on_rebase(cursor, seq):
+        print(f"[client] FEED EPOCH REBASE {channel}/{key}: server "
+              f"restarted (cursor {cursor} -> live seq {seq}); the old "
+              f"epoch's tail is unknowable", file=sys.stderr, flush=True)
+
+    feed = SequencedSubscriber(
+        _stub(addr), channel, key, from_seq=from_seq, conflate=conflate,
+        gap_fill=gap_fill, on_gap=on_gap, on_rebase=on_rebase, epoch=epoch)
+    last_event = [time.monotonic()]
+    stop_reason: list[str] = []
+
+    def _stop(why: str) -> None:
+        if not stop_reason:
+            stop_reason.append(why)
+        feed.cancel()
+
+    for s in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(s, lambda *_: _stop("signal"))
+        except ValueError:
+            pass  # not the main thread (tests drive main() directly)
+    if idle_exit > 0:
+        # Watchdog instead of an RPC deadline: an idle FEED is healthy,
+        # an idle SUBSCRIBER PROCESS in a soak round is done — cancel
+        # from the side so the stream itself carries no deadline.
+        def watchdog():
+            while not stop_reason:
+                if time.monotonic() - last_event[0] > idle_exit:
+                    _stop("idle")
+                    return
+                time.sleep(min(0.25, idle_exit / 4))
+
+        threading.Thread(target=watchdog, daemon=True).start()
+
+    rc = 0
+    try:
+        for e in feed:
+            last_event[0] = time.monotonic()
+            if not quiet:
+                if channel == CHANNEL_MD:
+                    print(f"[client] md #{e.seq} {e.symbol} "
+                          f"bid={e.best_bid}x{e.bid_size} "
+                          f"ask={e.best_ask}x{e.ask_size} (Q{e.scale})",
+                          flush=True)
+                else:
+                    print(f"[client] update #{e.seq} {e.order_id} "
+                          f"{pb2.OrderUpdate.Status.Name(e.status)} "
+                          f"fill={e.fill_quantity}@{e.fill_price} "
+                          f"remaining={e.remaining_quantity}", flush=True)
+            if max_events and feed.events >= max_events:
+                _stop("max-events")
+                break
+    except grpc.RpcError as err:
+        print(f"[client] rpc failed: {err.code().name}: {err.details()}",
+              file=sys.stderr)
+        rc = 2
+    summary = feed.summary()
+    summary["stop_reason"] = stop_reason[0] if stop_reason else "stream-end"
+    print(f"[client] feed summary: events={summary['events']} "
+          f"last_seq={summary['last_seq']} gaps={summary['gaps_detected']} "
+          f"filled={summary['gap_filled_events']} "
+          f"unrecovered={summary['unrecovered_events']} "
+          f"conflated_jumps={summary['conflated_jumps']} "
+          f"rebases={summary['epoch_rebases']}",
+          file=sys.stderr, flush=True)
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f)
+    if feed.unrecovered_events:
+        print(f"[client] FEED INTEGRITY FAILURE: "
+              f"{feed.unrecovered_events} event(s) unrecoverable",
+              file=sys.stderr, flush=True)
+        return 4
+    return rc
+
+
 def _metrics(addr: str) -> int:
     resp = _stub(addr).GetMetrics(pb2.MetricsRequest(), timeout=10)
     for k in sorted(resp.counters):
@@ -186,6 +318,11 @@ def main(argv=None) -> int:
 
 def _dispatch(argv: list[str]) -> int:
     try:
+        # Before the bare 8-arg submit form: subscribe takes a variable
+        # option tail, and e.g. `subscribe <addr> md SYM --idle-exit 60
+        # --summary-json f` is ALSO 8 args.
+        if len(argv) >= 4 and argv[0] == "subscribe":
+            return _subscribe(argv[1:])
         if len(argv) == 8:
             return _submit(argv)
         if len(argv) == 3 and argv[0] == "book":

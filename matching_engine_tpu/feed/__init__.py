@@ -1,0 +1,27 @@
+"""Sequenced market-data feed (the event-distribution layer).
+
+Between the dispatcher's publish and the streaming RPCs sits this package:
+
+- `sequencer.FeedSequencer` — stamps every market-data / order-update
+  event with a per-(channel, key) monotonic `seq` at dispatch-publish
+  time and retains recent events in a bounded `RetransmissionRing`
+  (optional disk spill) for gap-fill;
+- `client.SequencedSubscriber` — the consumer-side helper: detects
+  sequence gaps, auto-gap-fills them from the retransmission store via
+  `resume_from_seq` replay streams, and accounts for unrecoverable loss.
+
+Seq domains are per (shard, channel, key): each host sequences the
+symbols/clients it homes independently ("md"/symbol, "ou"/client_id), so
+a subscriber's stream is gap-free exactly when no event for ITS key was
+lost — global counters would make every other key's traffic look like a
+gap. See docs/OPERATIONS.md "Sequenced feed".
+"""
+
+from matching_engine_tpu.feed.sequencer import (
+    CHANNEL_MD,
+    CHANNEL_OU,
+    FeedSequencer,
+    RetransmissionRing,
+)
+
+__all__ = ["CHANNEL_MD", "CHANNEL_OU", "FeedSequencer", "RetransmissionRing"]

@@ -1,0 +1,218 @@
+"""Consumer-side feed helper: gap detection + automatic gap-fill.
+
+`SequencedSubscriber` wraps one StreamMarketData / StreamOrderUpdates
+subscription and yields events in sequence order:
+
+- tracks the last seen `seq` for its (channel, key) domain;
+- on a sequence jump (an upstream drop-oldest loss, or events missed
+  while disconnected), opens a SECOND short-lived stream with
+  `resume_from_seq` — the server replays the missed range out of the
+  retransmission store — splices the recovered events in order, cancels
+  the helper stream, and resumes the live one;
+- counts what it could not recover (`unrecovered_events`): the server's
+  store had already evicted those seqs. Loss is then *detected and
+  bounded*, never silent — the property the raw streams lacked.
+
+Conflated subscriptions (`conflate=True`) opt OUT of gap accounting:
+skipping intermediate states is the channel's contract, so seq jumps
+are expected and the subscriber only tracks monotonicity.
+
+Seq domains are per server boot. A restart rebases every domain to 1;
+the subscriber detects the rebase (a below-cursor seq that duplicates
+nothing this connection delivered), resets its cursor, and counts it in
+`epoch_rebases` — the old epoch's unreceived tail is unknowable, so it
+is reported as a rebase, never silently skipped.
+
+Used by `client/cli.py subscribe` (non-zero exit on unrecovered gaps —
+the soak/CI feed-integrity assertion) and by tests/test_feed.py.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
+from matching_engine_tpu.proto import pb2
+
+
+class SequencedSubscriber:
+    """Iterate sequenced events for one (channel, key), auto-gap-filling.
+
+    channel: feed.CHANNEL_MD (key = symbol) or feed.CHANNEL_OU
+    (key = client_id). `from_seq` resumes after a disconnect: the server
+    replays (from_seq, head] before live events. `on_gap(start, end,
+    filled, missing)` fires per detected gap — the CLI prints loudly.
+    """
+
+    def __init__(self, stub, channel: str, key: str, from_seq: int = 0,
+                 conflate: bool = False, gap_fill: bool = True,
+                 fill_timeout_s: float = 10.0, on_gap=None,
+                 on_rebase=None, epoch: int = 0):
+        if channel not in (CHANNEL_MD, CHANNEL_OU):
+            raise ValueError(f"unknown feed channel {channel!r}")
+        if conflate and channel != CHANNEL_MD:
+            raise ValueError("conflation is a market-data channel option")
+        self.stub = stub
+        self.channel = channel
+        self.key = key
+        self.from_seq = from_seq
+        self.conflate = conflate
+        self.gap_fill = gap_fill
+        self.fill_timeout_s = fill_timeout_s
+        self.on_gap = on_gap
+        self.on_rebase = on_rebase
+        # -- integrity accounting (read after/inside iteration) --
+        self.events = 0              # events yielded (live + replay + fill)
+        self.last_seq = from_seq     # highest seq yielded
+        self.gaps_detected = 0
+        self.gap_filled_events = 0
+        self.unrecovered_events = 0  # seqs lost for good (store evicted)
+        self.conflated_jumps = 0     # seq jumps on a conflated channel
+        self.epoch_rebases = 0       # server restarts observed (seqs reset)
+        # Boot epoch the cursor belongs to (echoed on resume requests;
+        # learned/refreshed from events). With it, a cross-restart resume
+        # is detected even when the new boot's head outran the cursor.
+        self.epoch = epoch
+        self._call = None
+        self._fill_call = None
+        self._call_max = 0           # highest seq seen on the live call
+        self._cancelled = False
+
+    # -- stream plumbing ---------------------------------------------------
+
+    def _open(self, from_seq: int, timeout: float | None = None):
+        if self.channel == CHANNEL_MD:
+            return self.stub.StreamMarketData(
+                pb2.MarketDataRequest(symbol=self.key,
+                                      resume_from_seq=from_seq,
+                                      conflate=self.conflate,
+                                      feed_epoch=self.epoch),
+                timeout=timeout)
+        return self.stub.StreamOrderUpdates(
+            pb2.OrderUpdatesRequest(client_id=self.key,
+                                    resume_from_seq=from_seq,
+                                    feed_epoch=self.epoch),
+            timeout=timeout)
+
+    def cancel(self) -> None:
+        """Thread/signal-safe stop: cancels the live call AND any
+        in-flight gap-fill stream; the iterator finishes cleanly
+        (CANCELLED is swallowed). Sticky — a cancel racing ahead of the
+        stream open still takes effect."""
+        self._cancelled = True
+        for call in (self._call, self._fill_call):
+            if call is not None:
+                call.cancel()
+
+    def _fill(self, last: int, upto: int):
+        """Recover (last, upto) via a resume stream against the
+        retransmission store; cancels once the range is covered. Yields
+        recovered events; accounts the rest as unrecovered."""
+        want = upto - last - 1
+        got = 0
+        call = self._fill_call = self._open(last, timeout=self.fill_timeout_s)
+        if self._cancelled:
+            call.cancel()
+        try:
+            for e in call:
+                if e.seq <= last or e.seq >= upto:
+                    # The resume stream goes live after replay; reaching
+                    # (or passing) the gap-closing seq ends the fill.
+                    if e.seq >= upto:
+                        break
+                    continue
+                got += 1
+                self.gap_filled_events += 1
+                yield e
+                if got == want:
+                    break
+        except grpc.RpcError:
+            pass  # timeout/cancel: whatever was missing stays missing
+        finally:
+            # In the finally so an abandoned fill (consumer stopped
+            # mid-splice, GeneratorExit) still books its shortfall —
+            # the exit-4 integrity contract must not under-count.
+            call.cancel()
+            self._fill_call = None
+            self.unrecovered_events += want - got
+
+    # -- the sequenced iterator --------------------------------------------
+
+    def __iter__(self):
+        self._call = self._open(self.from_seq)
+        if self._cancelled:
+            self._call.cancel()
+        self._call_max = 0
+        try:
+            for e in self._call:
+                seq = e.seq
+                if seq == 0:
+                    # Unsequenced server (feed disabled): plain relay.
+                    self.events += 1
+                    yield e
+                    continue
+                if seq <= self._call_max:
+                    continue  # duplicate within this connection
+                ep = e.feed_epoch
+                if ep and self.epoch and ep != self.epoch:
+                    # The authoritative rebase signal: a different boot
+                    # epoch — detected even when the new boot's head has
+                    # outrun the stale cursor (seqs alone can't tell a
+                    # cross-epoch replay from a same-epoch one). Gap
+                    # accounting cannot span epochs; the old epoch's
+                    # unreceived tail is unknowable and reported as the
+                    # rebase, never silently blended.
+                    self.epoch_rebases += 1
+                    if self.on_rebase is not None:
+                        self.on_rebase(self.last_seq, seq)
+                    self.epoch = ep
+                    self.last_seq = seq - 1
+                elif ep and not self.epoch:
+                    self.epoch = ep
+                if seq <= self.last_seq:
+                    # Fallback for epoch-less events: below the cursor
+                    # yet NOT a duplicate of anything this connection
+                    # delivered — the per-boot seq domain was rebased
+                    # (server restarted). Reset the cursor.
+                    self.epoch_rebases += 1
+                    if self.on_rebase is not None:
+                        self.on_rebase(self.last_seq, seq)
+                    self.last_seq = seq - 1
+                if self.last_seq and seq > self.last_seq + 1:
+                    if self.conflate:
+                        self.conflated_jumps += 1  # expected, not a gap
+                    else:
+                        self.gaps_detected += 1
+                        gap_start, filled = self.last_seq, 0
+                        if self.gap_fill:
+                            for g in self._fill(self.last_seq, seq):
+                                filled += 1
+                                self.last_seq = g.seq
+                                self.events += 1
+                                yield g
+                        else:
+                            self.unrecovered_events += seq - self.last_seq - 1
+                        if self.on_gap is not None:
+                            missing = (seq - gap_start - 1) - filled
+                            self.on_gap(gap_start, seq, filled, missing)
+                self._call_max = seq
+                self.last_seq = seq
+                self.events += 1
+                yield e
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.CANCELLED:
+                raise
+        finally:
+            self.cancel()
+
+    def summary(self) -> dict:
+        return {
+            "channel": self.channel, "key": self.key,
+            "events": self.events, "last_seq": self.last_seq,
+            "gaps_detected": self.gaps_detected,
+            "gap_filled_events": self.gap_filled_events,
+            "unrecovered_events": self.unrecovered_events,
+            "conflated_jumps": self.conflated_jumps,
+            "epoch_rebases": self.epoch_rebases,
+            "epoch": self.epoch,
+        }

@@ -1,0 +1,432 @@
+"""Event sequencer + retransmission store for the sequenced feed.
+
+The serving edges used to fan events straight into bounded subscriber
+queues: a slow consumer silently lost the oldest events (streams.py
+drop-oldest) and no sequence number existed anywhere in the wire
+contract, so a client could neither detect a gap nor recover from one.
+Real exchanges solve this with a sequencer + retransmission architecture
+(CoinTossX, arXiv:2102.10925; the cloud-exchange sequencer of
+arXiv:2402.09527): every event carries a monotonic sequence number and
+late/slow consumers recover via replay instead of silent loss.
+
+`FeedSequencer.stamp_*` runs on the dispatch-publish path (under the
+dispatch lock, per BATCH of events — the per-event work is one attribute
+write, one ring append and shared counter increments) and does two
+things atomically per domain:
+
+1. assigns `event.seq = next_seq` for the event's (channel, key) domain
+   — channel "md" keys by symbol, channel "ou" by client_id, so each
+   subscription's event stream is densely sequenced and gap detection
+   needs no filtering;
+2. retains the event in that domain's `RetransmissionRing` — a bounded
+   deque serving `replay(from_seq)` for gap-fill, with optional disk
+   spill of evicted events (atomic segment files, the checkpoint
+   tmp+rename pattern) extending the recoverable window beyond memory.
+
+Seq domains (and the spill) are **per boot**: a restarted server rebases
+every domain to 1. Spill segments are namespaced under an epoch
+directory and stale epochs are purged at init, so a cross-boot replay
+can never serve a previous boot's payloads as the requested range; the
+service layer clamps ahead-of-head resume cursors and feed.client
+detects the rebase (see their docstrings).
+
+Hot-path discipline: the sequencer lock only ever guards dict/deque/list
+operations. Spill WRITES run on a background flusher thread (a full
+segment is detached under the lock, written outside it), and replay's
+disk READS happen after the lock is released — a slow disk degrades the
+recoverable window (feed_spill_dropped_events), never the publish path.
+
+Replay is bit-identical: the ring stores the very message objects that
+were fanned out (never mutated after publish), and spill segments store
+their serialized bytes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+from collections import OrderedDict, deque
+
+from matching_engine_tpu.proto import pb2
+
+CHANNEL_MD = "md"   # keyed by symbol
+CHANNEL_OU = "ou"   # keyed by client_id
+
+_EVENT_CLS = {CHANNEL_MD: pb2.MarketDataUpdate, CHANNEL_OU: pb2.OrderUpdate}
+
+
+class RetransmissionRing:
+    """Bounded in-memory retransmission store for ONE seq domain.
+
+    Ring entries are (seq, message). Evictions go to the spill buffer
+    when one is attached (the FeedSequencer hands full segments to its
+    flusher thread); otherwise the oldest seq simply becomes
+    unrecoverable — the documented bounded-memory contract, surfaced to
+    clients as a detected-but-unfilled gap.
+    """
+
+    __slots__ = ("ring", "next_seq", "spill")
+
+    def __init__(self, depth: int, spill=None):
+        self.ring: deque = deque(maxlen=max(1, depth))
+        self.next_seq = 1
+        self.spill = spill
+
+    def append(self, msg) -> int:
+        seq = self.next_seq
+        self.next_seq = seq + 1
+        if self.spill is not None and len(self.ring) == self.ring.maxlen:
+            old_seq, old_msg = self.ring[0]
+            self.spill.buffer(old_seq, old_msg.SerializeToString())
+        self.ring.append((seq, msg))
+        return seq
+
+    @property
+    def last_seq(self) -> int:
+        return self.next_seq - 1
+
+    def first_available(self) -> int:
+        """Oldest seq still replayable from memory (next_seq if empty)."""
+        return self.ring[0][0] if self.ring else self.next_seq
+
+    def replay(self, from_seq: int, to_seq: int | None = None) -> list:
+        """Events with from_seq < seq <= to_seq (to_seq None = head),
+        oldest first, memory only — FeedSequencer.replay prepends the
+        spilled range."""
+        hi = self.last_seq if to_seq is None else min(to_seq, self.last_seq)
+        return [m for s, m in self.ring if from_seq < s <= hi]
+
+
+class _Spill:
+    """Disk spill for one domain: evicted events buffer under the
+    sequencer lock (list appends only); full segments are written by the
+    sequencer's flusher thread as atomic files (tmp + rename, the
+    checkpoint atomic-write pattern) named seg_<first>_<last>.json.
+    Bounded: oldest segments are deleted past max_segments.
+
+    `_inflight` holds detached-but-unwritten row batches so a replay in
+    the detach→write window still sees them (GIL-atomic list ops; the
+    replay merge dedups by seq against freshly-written segments)."""
+
+    def __init__(self, root: str, segment: int, max_segments: int, metrics):
+        self.root = root
+        self.segment = max(1, segment)
+        self.max_segments = max(1, max_segments)
+        self.metrics = metrics
+        self._pending: list[tuple[int, bytes]] = []
+        self._inflight: list[list[tuple[int, bytes]]] = []
+
+    # -- under the sequencer lock -----------------------------------------
+
+    def buffer(self, seq: int, payload: bytes) -> None:
+        self._pending.append((seq, payload))
+
+    def take_full_segment(self):
+        """Detach a full segment's rows for the flusher (None if the
+        buffer hasn't reached segment size)."""
+        if len(self._pending) < self.segment:
+            return None
+        rows, self._pending = self._pending, []
+        self._inflight.append(rows)
+        return rows
+
+    def detach_pending(self):
+        """Detach whatever is buffered (flush_spill/shutdown)."""
+        if not self._pending:
+            return None
+        rows, self._pending = self._pending, []
+        self._inflight.append(rows)
+        return rows
+
+    # -- flusher thread / flush_spill --------------------------------------
+
+    def write_segment(self, rows) -> None:
+        first, last = rows[0][0], rows[-1][0]
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(prefix=".seg-tmp-", dir=self.root)
+            with os.fdopen(fd, "w") as f:
+                json.dump([[s, base64.b64encode(b).decode()]
+                           for s, b in rows], f)
+            os.rename(tmp, os.path.join(self.root,
+                                        f"seg_{first:016d}_{last:016d}.json"))
+            if self.metrics is not None:
+                self.metrics.inc("feed_spilled_events", len(rows))
+            self._trim()
+        except OSError as e:
+            # Spill loss degrades the recoverable window, never the feed.
+            if self.metrics is not None:
+                self.metrics.inc("feed_spill_dropped_events", len(rows))
+            print(f"[feed] spill write failed: {type(e).__name__}: {e}")
+        finally:
+            try:
+                self._inflight.remove(rows)
+            except ValueError:
+                pass
+
+    def _segments(self) -> list[str]:
+        try:
+            return sorted(n for n in os.listdir(self.root)
+                          if n.startswith("seg_") and n.endswith(".json"))
+        except OSError:
+            return []
+
+    def _trim(self) -> None:
+        segs = self._segments()
+        for name in segs[:max(0, len(segs) - self.max_segments)]:
+            try:
+                os.remove(os.path.join(self.root, name))
+            except OSError:
+                pass
+
+    # -- read path (no sequencer lock held) --------------------------------
+
+    def replay_disk(self, from_seq: int, to_seq: int) -> list[tuple[int, bytes]]:
+        """(seq, serialized) pairs with from_seq < seq <= to_seq from the
+        flushed segments. Renames are atomic, so concurrent flusher
+        writes are either fully visible or not yet."""
+        out: list[tuple[int, bytes]] = []
+        for name in self._segments():
+            try:
+                first, last = (int(x) for x in name[4:-5].split("_"))
+            except ValueError:
+                continue
+            if last <= from_seq or first > to_seq:
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    rows = json.load(f)
+            except (OSError, ValueError):
+                continue
+            out.extend((s, base64.b64decode(b)) for s, b in rows
+                       if from_seq < s <= to_seq)
+        return out
+
+
+class FeedSequencer:
+    """Per-(channel, key) sequencing + retransmission for the feed.
+
+    One instance per shard/host (build_server); the StreamHub calls
+    stamp_* under its publish path, the service layer calls replay() for
+    `resume_from_seq` streams, and feed.client gap-fills through the
+    same RPC surface. The lock guards in-memory state only; all disk IO
+    runs off-lock (writes on the flusher thread, reads on the replaying
+    RPC thread).
+    """
+
+    def __init__(self, metrics=None, depth: int = 1 << 16,
+                 spill_dir: str | None = None, spill_segment: int = 1024,
+                 max_spill_segments: int = 16, epoch: int | None = None,
+                 max_domains: int = 1 << 16):
+        self.metrics = metrics
+        self.depth = depth
+        self.spill_segment = spill_segment
+        self.max_spill_segments = max_spill_segments
+        self.max_domains = max(1, max_domains)
+        # Boot epoch: stamped on every event (feed_epoch) and echoed by
+        # resume requests, so a cursor from a previous boot is always
+        # distinguishable — even when the new boot's head has already
+        # outrun it. Seconds-resolution boot time mixed with the pid;
+        # only inequality between boots matters.
+        self.epoch = epoch if epoch else (
+            (int(time.time()) << 16) | (os.getpid() & 0xFFFF))
+        self._lock = threading.Lock()
+        # Live domains, LRU by last publish. Past max_domains the
+        # least-recently-published domain RETIRES: its ring (and the
+        # replay window) is dropped but its next_seq survives in
+        # _retired, so a revived domain continues the same seq line —
+        # bounding memory at max_domains rings while "millions of
+        # client_id domains" cost one small dict entry each.
+        self._domains: OrderedDict[tuple[str, str], RetransmissionRing] = \
+            OrderedDict()
+        self._retired: dict[tuple[str, str], int] = {}  # -> next_seq
+        self._published = 0  # global publish counter (feed_publish_seq)
+        self._ready: list[tuple[_Spill, list]] = []  # detached, unqueued
+        self._flush_q: queue.Queue = queue.Queue(maxsize=64)
+        self._flusher: threading.Thread | None = None
+        self.spill_root = None
+        if spill_dir:
+            # Seq domains restart at 1 every boot: segments from an older
+            # epoch would satisfy a new boot's seq range with the OLD
+            # boot's payloads. Namespace per boot and purge stale epochs.
+            try:
+                os.makedirs(spill_dir, exist_ok=True)
+                for name in os.listdir(spill_dir):
+                    if name.startswith("epoch-"):
+                        shutil.rmtree(os.path.join(spill_dir, name),
+                                      ignore_errors=True)
+            except OSError:
+                pass
+            self.spill_root = os.path.join(spill_dir, f"epoch-{self.epoch}")
+
+    def _domain(self, channel: str, key: str) -> RetransmissionRing:
+        dom = self._domains.get((channel, key))
+        if dom is None:
+            spill = None
+            if self.spill_root:
+                spill = _Spill(
+                    os.path.join(self.spill_root, channel,
+                                 key.encode().hex() or "_"),
+                    self.spill_segment, self.max_spill_segments, self.metrics)
+            dom = self._domains[(channel, key)] = RetransmissionRing(
+                self.depth, spill=spill)
+            # A revived retired domain continues its seq line (a reused
+            # seq would corrupt client gap accounting); its pre-retire
+            # spill segments are same-epoch and deterministic-path, so
+            # they still serve replay.
+            retired_next = self._retired.pop((channel, key), None)
+            if retired_next is not None:
+                dom.next_seq = retired_next
+        return dom
+
+    # -- publish path (dispatch lock held by the caller's drain loop) ------
+
+    def _stamp(self, channel: str, updates, key_of) -> None:
+        with self._lock:
+            for u in updates:
+                key = key_of(u)
+                dom = self._domain(channel, key)
+                u.seq = dom.append(u)
+                u.feed_epoch = self.epoch
+                self._domains.move_to_end((channel, key))  # LRU touch
+                if dom.spill is not None:
+                    rows = dom.spill.take_full_segment()
+                    if rows is not None:
+                        self._ready.append((dom.spill, rows))
+            while len(self._domains) > self.max_domains:
+                k, old = self._domains.popitem(last=False)
+                self._retired[k] = old.next_seq
+                if old.spill is not None:
+                    rows = old.spill.detach_pending()
+                    if rows is not None:
+                        self._ready.append((old.spill, rows))
+                if self.metrics is not None:
+                    self.metrics.inc("feed_domains_retired")
+            self._published += len(updates)
+            if self.metrics is not None:
+                self.metrics.set_gauge("feed_publish_seq", self._published)
+            ready, self._ready = self._ready, []
+        for spill, rows in ready:  # enqueue outside the lock
+            self._enqueue_segment(spill, rows)
+
+    def stamp_market_data(self, updates) -> None:
+        self._stamp(CHANNEL_MD, updates, lambda u: u.symbol)
+        if self.metrics is not None:
+            self.metrics.inc("feed_md_published", len(updates))
+
+    def stamp_order_updates(self, updates) -> None:
+        self._stamp(CHANNEL_OU, updates, lambda u: u.client_id)
+        if self.metrics is not None:
+            self.metrics.inc("feed_ou_published", len(updates))
+
+    # -- spill flusher -----------------------------------------------------
+
+    def _enqueue_segment(self, spill: _Spill, rows) -> None:
+        if self._flusher is None:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="feed-spill", daemon=True)
+            self._flusher.start()
+        try:
+            self._flush_q.put_nowait((spill, rows))
+        except queue.Full:
+            # A wedged disk must not grow host memory without bound:
+            # drop the segment (the window shrinks, accounted).
+            try:
+                spill._inflight.remove(rows)
+            except ValueError:
+                pass
+            if self.metrics is not None:
+                self.metrics.inc("feed_spill_dropped_events", len(rows))
+
+    def _flush_loop(self) -> None:
+        while True:
+            spill, rows = self._flush_q.get()
+            try:
+                spill.write_segment(rows)
+            finally:
+                self._flush_q.task_done()
+
+    def flush_spill(self) -> None:
+        """Write everything buffered to disk and wait for the flusher to
+        drain (shutdown/tests)."""
+        with self._lock:
+            ready, self._ready = self._ready, []
+            for dom in self._domains.values():
+                if dom.spill is not None:
+                    rows = dom.spill.detach_pending()
+                    if rows is not None:
+                        ready.append((dom.spill, rows))
+        for spill, rows in ready:
+            spill.write_segment(rows)
+        if self._flusher is not None:
+            self._flush_q.join()
+
+    # -- read path ---------------------------------------------------------
+
+    def last_seq(self, channel: str, key: str) -> int:
+        with self._lock:
+            dom = self._domains.get((channel, key))
+            if dom is not None:
+                return dom.last_seq
+            return self._retired.get((channel, key), 1) - 1
+
+    def replay(self, channel: str, key: str, from_seq: int,
+               to_seq: int | None = None) -> tuple[list, int]:
+        """Events with from_seq < seq <= to_seq for one domain, oldest
+        first. Returns (events, missed): `missed` counts requested seqs
+        already evicted past the spill window — the unrecoverable-
+        server-side signal (feed_retransmit_misses). Disk reads happen
+        after the lock is released."""
+        cls = _EVENT_CLS[channel]
+        with self._lock:
+            if self.metrics is not None:
+                self.metrics.inc("feed_retransmit_requests")
+            dom = self._domains.get((channel, key))
+            if dom is None:
+                head = self._retired.get((channel, key), 1) - 1
+                missed = max(0, (head if to_seq is None else
+                                 min(to_seq, head)) - from_seq)
+                if missed and self.metrics is not None:
+                    # Retired domain: the window is gone until it revives.
+                    self.metrics.inc("feed_retransmit_misses", missed)
+                return [], missed
+            hi = dom.last_seq if to_seq is None else min(to_seq, dom.last_seq)
+            mem_first = dom.first_available()
+            mem_events = dom.replay(from_seq, hi)
+            spill = dom.spill
+            pending = list(spill._pending) if spill is not None else []
+            inflight = list(spill._inflight) if spill is not None else []
+        events: list = []
+        if spill is not None and from_seq + 1 < mem_first:
+            lo_hi = min(hi, mem_first - 1)
+            # seg files ∪ in-flight batches ∪ pending buffer, deduped by
+            # seq (a batch can be both on disk and still in _inflight for
+            # an instant) — all strictly below mem_first, disjoint from
+            # the memory slice.
+            rows: dict[int, bytes] = {}
+            for s, b in spill.replay_disk(from_seq, lo_hi):
+                rows[s] = b
+            for batch in inflight:
+                for s, b in batch:
+                    if from_seq < s <= lo_hi:
+                        rows[s] = b
+            for s, b in pending:
+                if from_seq < s <= lo_hi:
+                    rows[s] = b
+            events = [cls.FromString(rows[s]) for s in sorted(rows)]
+        events.extend(mem_events)
+        missed = 0
+        if hi > from_seq:
+            missed = (hi - from_seq) - len(events)
+        if self.metrics is not None:
+            if events:
+                self.metrics.inc("feed_retransmit_events", len(events))
+            if missed > 0:
+                self.metrics.inc("feed_retransmit_misses", missed)
+        return events, max(0, missed)

@@ -1,10 +1,10 @@
 """Wire contract package.
 
 `matching_engine_pb2` is generated from `matching_engine.proto` (checked in so
-no codegen toolchain is needed at runtime; regenerate with
-`scripts/regen_proto.sh`). The service/stub adapters live in `rpc.py` —
-hand-rolled because this environment ships the grpcio runtime but not
-grpcio-tools.
+no codegen toolchain is needed at runtime; additive field changes regenerate
+via descriptor surgery with `scripts/regen_pb2.py` — no protoc in this
+environment). The service/stub adapters live in `rpc.py` — hand-rolled
+because this environment ships the grpcio runtime but not grpcio-tools.
 """
 
 from matching_engine_tpu.proto import matching_engine_pb2 as pb2

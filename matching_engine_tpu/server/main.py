@@ -97,6 +97,9 @@ def build_server(
     pipeline_inflight: int = 2,
     native_lanes: bool = False,
     flight_dir: str | None = None,
+    feed_depth: int = 1 << 16,
+    feed_spill_dir: str | None = None,
+    stream_maxsize: int = 1024,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -133,7 +136,20 @@ def build_server(
     # holds `metrics` can record without constructor churn.
     recorder = FlightRecorder(dump_dir=flight_dir)
     metrics.recorder = recorder
-    hub = StreamHub(metrics=metrics)
+    # Sequenced feed (feed/): every stream event gets a per-(channel, key)
+    # monotonic seq at publish and lands in the retransmission store, so
+    # reconnecting/slow clients recover via resume_from_seq instead of
+    # silent drop-oldest loss. feed_depth 0 restores the legacy
+    # unsequenced feed (and lets the decode path skip event materialization
+    # when nobody subscribes — the max-throughput bench configuration).
+    sequencer = None
+    if feed_depth:
+        from matching_engine_tpu.feed import FeedSequencer
+
+        sequencer = FeedSequencer(metrics=metrics, depth=feed_depth,
+                                  spill_dir=feed_spill_dir)
+    hub = StreamHub(maxsize=stream_maxsize, metrics=metrics,
+                    sequencer=sequencer)
 
     def make_runner():
         if native_lanes:
@@ -299,7 +315,7 @@ def build_server(
         "dispatcher": dispatcher, "runner": runner, "service": service,
         "metrics": metrics, "checkpointer": checkpointer,
         "bridge": bridge, "gateway_port": gateway_port,
-        "recorder": recorder,
+        "recorder": recorder, "sequencer": sequencer,
     }
     return server, port, parts
 
@@ -312,6 +328,13 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
         parts["bridge"].close()
     parts["hub"].close_all()
     parts["dispatcher"].close()
+    if parts.get("sequencer") is not None:
+        # Drain the spill flusher (completes any in-flight gap-fill
+        # window and leaves a forensic record of the tail). The store —
+        # memory AND spill — is per boot: the next boot starts a fresh
+        # epoch dir and purges this one; clients resuming across the
+        # restart observe an epoch rebase, not a replay.
+        parts["sequencer"].flush_spill()
     if parts.get("checkpointer") is not None:
         try:
             parts["checkpointer"].checkpoint_now()
@@ -404,6 +427,21 @@ def main(argv=None) -> int:
                         "<db dir>/flight). Recent dispatch summaries dump "
                         "as JSON on SIGUSR2, fatal dispatch error, and "
                         "clean shutdown")
+    p.add_argument("--feed-depth", type=int, default=1 << 16, metavar="N",
+                   help="sequenced-feed retransmission ring depth per "
+                        "(channel, key) domain — reconnecting stream "
+                        "clients replay up to this many missed events via "
+                        "resume_from_seq (docs/OPERATIONS.md 'Sequenced "
+                        "feed'). 0 disables sequencing (legacy unsequenced "
+                        "streams; max-throughput benches)")
+    p.add_argument("--feed-spill-dir", default=None, metavar="DIR",
+                   help="spill ring-evicted feed events to atomic segment "
+                        "files here, extending the gap-fill window beyond "
+                        "memory (off by default)")
+    p.add_argument("--stream-queue", type=int, default=1024, metavar="N",
+                   help="per-subscriber stream queue depth; overflow drops "
+                        "oldest (counted as stream_dropped_events, "
+                        "recoverable via the sequenced feed)")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard the symbol axis over an N-device mesh "
                         "(0 = single device); N must divide --symbols")
@@ -460,6 +498,9 @@ def main(argv=None) -> int:
             pipeline_inflight=args.pipeline_inflight,
             native_lanes=args.native_lanes,
             flight_dir=flight_dir,
+            feed_depth=args.feed_depth,
+            feed_spill_dir=args.feed_spill_dir,
+            stream_maxsize=args.stream_queue,
         )
     except SystemExit as e:
         return int(e.code or 3)

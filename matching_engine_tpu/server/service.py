@@ -25,6 +25,7 @@ import time
 import grpc
 
 from matching_engine_tpu.domain import normalize_to_q4, validate_submit
+from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
 from matching_engine_tpu.engine.kernel import (
     CANCELED,
     NEW,
@@ -450,11 +451,71 @@ class MatchingEngineService(MatchingEngineServicer):
             return None
         return context.is_active
 
+    # Replay slice per store round-trip: bounds the memory AND metric cost
+    # of a gap-fill stream the client cancels early (feed.client takes
+    # only its gap's range and hangs up — without chunking every fill
+    # would materialize the store's full tail).
+    _REPLAY_CHUNK = 1024
+
+    def _sequenced_stream(self, sub, channel, key, resume_from,
+                          resume_epoch, context):
+        """Replay-then-live for the sequenced feed: the live subscription
+        is already registered (events landing during the replay scan
+        queue up in it), the retransmission store replays
+        (resume_from, head] in chunks, and the live phase drops the
+        overlap by seq. With the feed disabled (no sequencer)
+        resume_from is ignored — the legacy live-only contract."""
+        alive = self._stream_alive(context, sub)
+        sequencer = self.hub.sequencer
+        last = 0
+        if sequencer is not None and resume_from:
+            stale = (resume_epoch and resume_epoch != sequencer.epoch)
+            if stale or resume_from > sequencer.last_seq(channel, key):
+                # Seq domains are per boot: a cursor from another epoch
+                # (or ahead of the current head, for clients that never
+                # learned an epoch) is stale — the server restarted.
+                # Serve live from the new epoch instead of replaying a
+                # DIFFERENT boot's range or filtering everything below
+                # the stale cursor into silence; feed.client detects the
+                # epoch change on the events and reports a rebase.
+                self._log(f"feed resume {channel}/{key}: cursor "
+                          f"{resume_from} is from "
+                          f"{'epoch ' + str(resume_epoch) if stale else 'ahead of this boot'} "
+                          f"(epoch rebase); serving live")
+            else:
+                last, missed_total = resume_from, 0
+                while True:
+                    head = sequencer.last_seq(channel, key)
+                    if last >= head:
+                        break
+                    to = min(head, last + self._REPLAY_CHUNK)
+                    events, missed = sequencer.replay(channel, key, last,
+                                                      to_seq=to)
+                    missed_total += missed
+                    for e in events:
+                        yield e
+                    # Advance past the chunk even when it was fully
+                    # evicted — the client detects the hole and reports
+                    # it unrecovered.
+                    last = to
+                if missed_total:
+                    self._log(
+                        f"feed replay {channel}/{key}: {missed_total} "
+                        f"events past the retransmission window (client "
+                        f"will report an unrecovered gap)")
+        for e in sub.stream(alive=alive):
+            if last and getattr(e, "seq", 0) and e.seq <= last:
+                continue  # replay/live overlap
+            yield e
+
     def StreamMarketData(self, request, context):
         self.metrics.inc("rpc_stream_md")
-        sub = self.hub.subscribe_market_data(request.symbol)
+        sub = self.hub.subscribe_market_data(request.symbol,
+                                             conflate=request.conflate)
         try:
-            yield from sub.stream(alive=self._stream_alive(context, sub))
+            yield from self._sequenced_stream(
+                sub, CHANNEL_MD, request.symbol, request.resume_from_seq,
+                request.feed_epoch, context)
         finally:
             self.hub.unsubscribe(sub)
 
@@ -462,7 +523,9 @@ class MatchingEngineService(MatchingEngineServicer):
         self.metrics.inc("rpc_stream_ou")
         sub = self.hub.subscribe_order_updates(request.client_id)
         try:
-            yield from sub.stream(alive=self._stream_alive(context, sub))
+            yield from self._sequenced_stream(
+                sub, CHANNEL_OU, request.client_id, request.resume_from_seq,
+                request.feed_epoch, context)
         finally:
             self.hub.unsubscribe(sub)
 

@@ -1,11 +1,24 @@
-"""Fan-out hubs for the two streaming RPCs.
+"""Fan-out hubs for the two streaming RPCs, with the sequenced feed.
 
 The reference declares StreamMarketData and StreamOrderUpdates but never
 overrides them — clients get UNIMPLEMENTED (SURVEY.md §3.4). Here they are
 real: the dispatcher publishes each dispatch's market-data and order-update
 events into per-subscriber bounded queues; stream handlers drain their queue
 until the client hangs up. Slow consumers lose oldest events (bounded queue,
-drop-oldest) rather than stalling the engine.
+drop-oldest) rather than stalling the engine — but since the feed layer
+landed that loss is *accounted* (stream_dropped_events) and *recoverable*:
+
+- With a `FeedSequencer` attached (feed/sequencer.py; build_server wires it
+  unless --feed-depth 0), publish_* stamps every event with its
+  per-(channel, key) monotonic `seq` and retains it in the retransmission
+  store BEFORE fan-out, so any dropped event can be replayed via
+  `resume_from_seq` (service.py) and every gap is client-detectable.
+- A sequenced hub reports has_*_subs() = True so both serving paths
+  materialize events even with no live subscriber — the store must cover
+  a reconnecting client's away window.
+- `subscribe_market_data(conflate=True)` returns a conflated latest-state
+  channel: a slow L2 consumer sees the newest snapshot instead of a
+  backlog (feed_conflated_events counts the skipped states).
 
 Delivery is event-driven end to end: queue.Queue wakes a blocked get() from
 put() via its condition variable (sub-ms publish->yield, pinned by
@@ -25,6 +38,7 @@ import queue
 import threading
 import time
 
+from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
 from matching_engine_tpu.proto import pb2
 
 _SENTINEL = object()
@@ -34,6 +48,11 @@ class _Subscription:
     def __init__(self, maxsize: int, metrics=None):
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self._metrics = metrics
+        # Highest seq yielded to this consumer (sequenced hubs); seeded
+        # with the domain head at subscribe so the lag gauge measures
+        # backlog since attach, not since the shard booted.
+        self.last_seq = 0
+        self.drops = 0
 
     def offer(self, item) -> None:
         entry = (time.perf_counter(), item)
@@ -43,9 +62,17 @@ class _Subscription:
                 return
             except queue.Full:
                 try:
-                    self.q.get_nowait()  # drop oldest
+                    _, dropped = self.q.get_nowait()  # drop oldest
                 except queue.Empty:
-                    pass
+                    continue
+                if dropped is not _SENTINEL:
+                    # The previously-invisible loss mode, now a counter:
+                    # a sequenced client recovers the dropped range via
+                    # resume_from_seq; a legacy client at least sees the
+                    # loss in GetMetrics / me_stream_dropped_events_total.
+                    self.drops += 1
+                    if self._metrics is not None:
+                        self._metrics.inc("stream_dropped_events")
 
     def stream(self, alive=None):
         """Yield events until closed.
@@ -66,17 +93,48 @@ class _Subscription:
             if self._metrics is not None:
                 self._metrics.observe(
                     "stream_latency_us", (time.perf_counter() - t_pub) * 1e6)
+            seq = getattr(item, "seq", 0)
+            if seq:
+                self.last_seq = seq
             yield item
 
     def close(self) -> None:
         self.offer(_SENTINEL)
 
 
+class _ConflatedSubscription(_Subscription):
+    """Latest-state channel for slow consumers (MarketDataRequest.conflate):
+    instead of queueing a backlog and dropping its oldest tail, overflow
+    replaces the *pending* states with the newest — the consumer always
+    converges on the current book, skipping intermediates by contract.
+    maxsize 2 = one state possibly mid-read + the newest."""
+
+    def __init__(self, metrics=None):
+        super().__init__(maxsize=2, metrics=metrics)
+
+    def offer(self, item) -> None:
+        entry = (time.perf_counter(), item)
+        while True:
+            try:
+                self.q.put_nowait(entry)
+                return
+            except queue.Full:
+                try:
+                    _, old = self.q.get_nowait()
+                except queue.Empty:
+                    continue
+                if old is not _SENTINEL and self._metrics is not None:
+                    # Conflation, not loss: the skipped state is obsolete
+                    # by definition and the client asked for latest-only.
+                    self._metrics.inc("feed_conflated_events")
+
+
 class StreamHub:
-    def __init__(self, maxsize: int = 1024, metrics=None):
+    def __init__(self, maxsize: int = 1024, metrics=None, sequencer=None):
         self._lock = threading.Lock()
         self._maxsize = maxsize
         self._metrics = metrics
+        self.sequencer = sequencer  # feed.FeedSequencer | None
         self._md_subs: dict[str, list[_Subscription]] = {}      # symbol ->
         self._ou_subs: dict[str, list[_Subscription]] = {}      # client_id ->
 
@@ -84,22 +142,32 @@ class StreamHub:
 
     def has_market_data_subs(self) -> bool:
         """Lock-free peek: the decode path skips BUILDING MarketDataUpdate
-        protos entirely when nobody is listening (the common serving case).
-        A subscriber attaching mid-dispatch just misses that dispatch —
-        same semantics as attaching a moment later."""
-        return bool(self._md_subs)
+        protos entirely when nobody is listening (the common serving case)
+        — unless the sequenced feed is on, whose retransmission store must
+        cover windows with no live subscriber (a reconnecting client
+        replays them). A subscriber attaching mid-dispatch just misses
+        that dispatch — same semantics as attaching a moment later."""
+        return self.sequencer is not None or bool(self._md_subs)
 
     def has_order_update_subs(self) -> bool:
-        return bool(self._ou_subs)
+        return self.sequencer is not None or bool(self._ou_subs)
 
-    def subscribe_market_data(self, symbol: str) -> _Subscription:
-        sub = _Subscription(self._maxsize, self._metrics)
+    def subscribe_market_data(self, symbol: str,
+                              conflate: bool = False) -> _Subscription:
+        if conflate:
+            sub = _ConflatedSubscription(self._metrics)
+        else:
+            sub = _Subscription(self._maxsize, self._metrics)
+        if self.sequencer is not None:
+            sub.last_seq = self.sequencer.last_seq(CHANNEL_MD, symbol)
         with self._lock:
             self._md_subs.setdefault(symbol, []).append(sub)
         return sub
 
     def subscribe_order_updates(self, client_id: str) -> _Subscription:
         sub = _Subscription(self._maxsize, self._metrics)
+        if self.sequencer is not None:
+            sub.last_seq = self.sequencer.last_seq(CHANNEL_OU, client_id)
         with self._lock:
             self._ou_subs.setdefault(client_id, []).append(sub)
         return sub
@@ -119,18 +187,42 @@ class StreamHub:
     def publish_market_data(self, updates: list[pb2.MarketDataUpdate]) -> None:
         if not updates:
             return
+        if self.sequencer is not None:
+            # Stamp + retain BEFORE fan-out: an event is replayable the
+            # instant any subscriber could have seen (or dropped) it.
+            self.sequencer.stamp_market_data(updates)
         with self._lock:
             for u in updates:
                 for sub in self._md_subs.get(u.symbol, ()):
                     sub.offer(u)
+            self._update_lag_locked()
 
     def publish_order_updates(self, updates: list[pb2.OrderUpdate]) -> None:
         if not updates:
             return
+        if self.sequencer is not None:
+            self.sequencer.stamp_order_updates(updates)
         with self._lock:
             for u in updates:
                 for sub in self._ou_subs.get(u.client_id, ()):
                     sub.offer(u)
+            self._update_lag_locked()
+
+    def _update_lag_locked(self) -> None:
+        """feed_subscriber_lag_max: worst (domain head − last yielded seq)
+        across live subscribers — the backpressure signal that says WHICH
+        side is slow before drops/conflation start. O(subscribers) per
+        publish batch; subscriber counts are small by design."""
+        if self.sequencer is None or self._metrics is None:
+            return
+        lag = 0
+        for table, channel in ((self._md_subs, CHANNEL_MD),
+                               (self._ou_subs, CHANNEL_OU)):
+            for key, subs in table.items():
+                head = self.sequencer.last_seq(channel, key)
+                for s in subs:
+                    lag = max(lag, head - s.last_seq)
+        self._metrics.set_gauge("feed_subscriber_lag_max", lag)
 
     def close_all(self) -> None:
         with self._lock:

@@ -91,8 +91,20 @@ DEADLINE=$(( $(date +%s) + MINUTES * 60 ))
 # `set -u`, and the first `AMENDS=$((AMENDS + 1))` on an unset variable
 # would kill the soak with "unbound variable".
 ROUNDS=0; OK_TOTAL=0; CANCELS=0; AMENDS=0
+# Sequenced-feed integrity: one background subscriber per round on the
+# SOAK market-data domain, resuming from the previous round's last seq
+# (exercises reconnect + retransmission-store replay every round). A
+# round FAILS on any unrecovered sequence gap (subscriber exit code 4).
+FEED_DIR="$WORK/feed"; mkdir -p "$FEED_DIR"
+FEED_FROM=0; FEED_EPOCH=0; FEED_EVENTS=0; FEED_GAPS=0; FEED_FILLED=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   kill -0 $SRV 2>/dev/null || { echo "FAIL: server died mid-soak"; exit 1; }
+  FEED_SUMMARY="$FEED_DIR/round_$ROUNDS.json"
+  python -m matching_engine_tpu.client.cli subscribe "127.0.0.1:$PY_PORT" \
+    md SOAK --from-seq "$FEED_FROM" --epoch "$FEED_EPOCH" --idle-exit 60 \
+    --quiet \
+    --summary-json "$FEED_SUMMARY" >/dev/null 2>"$FEED_DIR/round_$ROUNDS.err" &
+  FEED_PID=$!
   for ADDR in "$GW" "$PY"; do
     LINE=$("$CLI" bench "$ADDR" 8 100 12 4 2>/dev/null) || true
     OK=$(echo "$LINE" | python -c "import json,sys
@@ -117,10 +129,34 @@ except Exception: print(0)")
   # dispatch-lock/pending/checkpoint interplay concurrently with traffic).
   "$CLI" auction "$GW" >/dev/null 2>&1 || true
   scrape_metrics
+  # Round verdict from the feed subscriber: SIGINT makes it finalize
+  # (summary JSON + integrity exit code). 4 = unrecovered gap -> fail.
+  kill -INT $FEED_PID 2>/dev/null || true
+  wait $FEED_PID; FEED_RC=$?
+  if [ "$FEED_RC" -eq 4 ]; then
+    echo "FAIL: unrecovered feed sequence gap in round $ROUNDS"
+    cat "$FEED_DIR/round_$ROUNDS.err"; exit 1
+  fi
+  # Any other non-zero exit means the integrity probe itself broke (RPC
+  # failure, usage error) — a soak that "passes" with a dead subscriber
+  # verified nothing.
+  if [ "$FEED_RC" -ne 0 ] || [ ! -s "$FEED_SUMMARY" ]; then
+    echo "FAIL: feed subscriber broke in round $ROUNDS (rc=$FEED_RC)"
+    cat "$FEED_DIR/round_$ROUNDS.err"; exit 1
+  fi
+  FEED_STATE=$(python -c 'import json, sys
+s = json.load(open(sys.argv[1]))
+print(s["last_seq"], s["epoch"], s["events"], s["gaps_detected"],
+      s["gap_filled_events"])' "$FEED_SUMMARY")
+  read -r FEED_FROM FEED_EPOCH FE FG FF <<< "$FEED_STATE"
+  FEED_EVENTS=$((FEED_EVENTS + FE))
+  FEED_GAPS=$((FEED_GAPS + FG))
+  FEED_FILLED=$((FEED_FILLED + FF))
   ROUNDS=$((ROUNDS + 1))
 done
 [ "$OK_TOTAL" -gt 0 ] || { echo "FAIL: no orders succeeded"; exit 1; }
 [ "$CANCELS" -gt 0 ] || { echo "FAIL: no cancels succeeded"; exit 1; }
+[ "$FEED_EVENTS" -gt 0 ] || { echo "FAIL: feed subscribers saw zero events"; exit 1; }
 grep -q "^me_stage_queue_wait_us_p99" "$METRICS_OUT" \
   || { echo "FAIL: stage ledger absent from /metrics scrapes"; exit 1; }
 
@@ -144,12 +180,23 @@ python - "$OUT_DIR/soak_${TS}.json" <<EOF
 import json, subprocess, sys
 rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                      capture_output=True, text=True).stdout.strip()
+# Max subscriber lag over the whole soak, from the per-round scrapes.
+max_lag = 0.0
+try:
+    for line in open("$METRICS_OUT"):
+        if line.startswith("me_feed_subscriber_lag_max "):
+            max_lag = max(max_lag, float(line.split()[1]))
+except OSError:
+    max_lag = -1.0
 artifact = {
     "metric": "soak", "minutes": $MINUTES, "rounds": $ROUNDS,
     "orders_ok": $OK_TOTAL, "cancels": $CANCELS, "amends": $AMENDS,
     "audit_violations": int("$AUDIT".strip() or -1),
     "platform": "$SOAK_PLATFORM", "git_rev": rev,
     "server_args": "$SOAK_SERVER_ARGS",
+    "feed": {"events": $FEED_EVENTS, "gaps_detected": $FEED_GAPS,
+             "gap_filled_events": $FEED_FILLED,
+             "max_subscriber_lag": max_lag},
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))

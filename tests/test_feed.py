@@ -1,0 +1,576 @@
+"""Sequenced feed tests (matching_engine_tpu/feed/).
+
+Layers under test:
+- unit: FeedSequencer seq-domain monotonicity, RetransmissionRing
+  bounds + miss accounting, disk spill (atomic segments) extending the
+  replay window, conflated latest-state subscriptions, and the
+  stream_dropped_events legacy-drop counter.
+- e2e (python path): a real server — sequenced streams, reconnect with
+  resume_from_seq replaying a bit-identical missed range (verified
+  against the retransmission store), fault-injected slow subscriber
+  recovering through client-side gap-fill (zero-gaps-or-all-recovered
+  invariant), conflated snapshots for a slow L2 consumer with the
+  feed counters visible in Prometheus exposition, and the `subscribe`
+  CLI verb's summary/exit contract.
+- e2e (--native-lanes): the same resume/bit-identity assertion through
+  the C++ lane path (skip-guarded on the built native runtime).
+"""
+
+import json
+import threading
+import time
+
+import grpc
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.feed import CHANNEL_MD, CHANNEL_OU, FeedSequencer
+from matching_engine_tpu.feed.client import SequencedSubscriber
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.server.streams import StreamHub
+from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.obs import render_prometheus
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+
+
+def md(symbol="SYM", bid=10000, n=1):
+    return [pb2.MarketDataUpdate(symbol=symbol, best_bid=bid + i, scale=4,
+                                 bid_size=1) for i in range(n)]
+
+
+# -- unit: sequencer + retransmission store ----------------------------------
+
+
+def test_sequencer_stamps_monotonic_per_domain():
+    s = FeedSequencer(depth=64)
+    a, b = md("AAA", n=3), md("BBB", n=2)
+    s.stamp_market_data(a + b)
+    assert [u.seq for u in a] == [1, 2, 3]
+    assert [u.seq for u in b] == [1, 2]   # independent domain per symbol
+    ou = [pb2.OrderUpdate(order_id=f"OID-{i}", client_id="c1")
+          for i in range(2)]
+    s.stamp_order_updates(ou)
+    assert [u.seq for u in ou] == [1, 2]  # ou domain independent of md
+    assert s.last_seq(CHANNEL_MD, "AAA") == 3
+    assert s.last_seq(CHANNEL_OU, "c1") == 2
+    assert s.last_seq(CHANNEL_MD, "NOPE") == 0
+
+
+def test_replay_range_bounds_and_miss_accounting():
+    m = Metrics()
+    s = FeedSequencer(metrics=m, depth=4)
+    updates = md(n=10)
+    s.stamp_market_data(updates)
+    # Window holds the newest 4 (seq 7..10); 1..6 are gone (no spill).
+    events, missed = s.replay(CHANNEL_MD, "SYM", 0)
+    assert [e.seq for e in events] == [7, 8, 9, 10] and missed == 6
+    # Fully-covered range: exact, oldest-first, bit-identical objects.
+    events, missed = s.replay(CHANNEL_MD, "SYM", 7, to_seq=9)
+    assert [e.seq for e in events] == [8, 9] and missed == 0
+    assert [e.SerializeToString() for e in events] == \
+        [u.SerializeToString() for u in updates[7:9]]
+    counters, _ = m.snapshot()
+    assert counters["feed_retransmit_requests"] == 2
+    assert counters["feed_retransmit_misses"] == 6
+    assert counters["feed_retransmit_events"] == 6
+    # Unknown domain: empty, not an error.
+    assert s.replay(CHANNEL_OU, "nobody", 0) == ([], 0)
+
+
+def test_spill_extends_replay_window_bit_identically(tmp_path):
+    m = Metrics()
+    s = FeedSequencer(metrics=m, depth=4, spill_dir=str(tmp_path / "spill"),
+                      spill_segment=3)
+    updates = md(n=12)
+    for u in updates:          # one-by-one: exercises eviction per append
+        s.stamp_market_data([u])
+    s.flush_spill()
+    events, missed = s.replay(CHANNEL_MD, "SYM", 0)
+    assert missed == 0
+    assert [e.seq for e in events] == list(range(1, 13))
+    # Bit-identical across the memory/disk seam.
+    assert [e.SerializeToString() for e in events] == \
+        [u.SerializeToString() for u in updates]
+    segs = list((tmp_path / "spill").rglob("seg_*.json"))
+    assert segs, "evictions produced no spill segments"
+    assert not list((tmp_path / "spill").rglob(".seg-tmp-*")), \
+        "spill left non-atomic temp files"
+    counters, _ = m.snapshot()
+    assert counters["feed_spilled_events"] >= 6
+
+
+def test_spill_epochs_do_not_leak_across_restarts(tmp_path):
+    """Seq domains restart at 1 per boot: a new sequencer on the same
+    spill dir must purge the old epoch's segments, never serve them as
+    the new epoch's seq range."""
+    spill = str(tmp_path / "spill")
+    s1 = FeedSequencer(depth=2, spill_dir=spill, spill_segment=2)
+    s1.stamp_market_data(md(bid=10_000, n=8))
+    s1.flush_spill()
+    assert list((tmp_path / "spill").rglob("seg_*.json"))
+    # "Restart": fresh sequencer, same dir, new epoch with FEWER events.
+    s2 = FeedSequencer(depth=2, spill_dir=spill, spill_segment=2)
+    s2.stamp_market_data(md(bid=20_000, n=4))
+    s2.flush_spill()
+    events, missed = s2.replay(CHANNEL_MD, "SYM", 0)
+    assert [e.seq for e in events] == [1, 2, 3, 4] and missed == 0
+    assert all(e.best_bid >= 20_000 for e in events), \
+        "replay served the previous boot's payloads"
+    epochs = [p.name for p in (tmp_path / "spill").iterdir()
+              if p.name.startswith("epoch-")]
+    assert len(epochs) == 1, f"stale epoch dirs survived: {epochs}"
+
+
+def test_stale_resume_cursor_is_an_epoch_rebase(tmp_path):
+    """A resume_from_seq ahead of the current head (client outlived a
+    server restart) must serve live events from the new epoch — and the
+    client reports a rebase — instead of filtering everything below the
+    stale cursor into silence."""
+    hs = Harness(str(tmp_path / "rebase.db"))
+    try:
+        rebases = []
+        feed = SequencedSubscriber(
+            hs.stub, CHANNEL_MD, "SYM", from_seq=50_000,
+            on_rebase=lambda cur, seq: rebases.append((cur, seq)))
+        seen = []
+
+        def consume():
+            for u in feed:
+                seen.append(u.seq)
+                if len(seen) >= 3:
+                    feed.cancel()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        hs.wait_md_sub()
+        for i in range(3):
+            submit(hs.stub, price=10000 + i)
+        t.join(timeout=15)
+        assert not t.is_alive(), "stale-cursor subscriber got nothing"
+        assert seen == [1, 2, 3]
+        assert feed.epoch_rebases == 1 and rebases == [(50_000, 1)]
+        assert feed.unrecovered_events == 0 and feed.gaps_detected == 0
+    finally:
+        hs.close()
+
+
+def test_domain_lru_retire_preserves_seq_line():
+    """Past max_domains, the least-recently-published domain retires:
+    ring memory is freed, but a revived key CONTINUES its seq line (a
+    reused seq would corrupt client gap accounting)."""
+    m = Metrics()
+    s = FeedSequencer(metrics=m, depth=64, max_domains=2)
+    s.stamp_market_data(md("AAA", n=3))
+    s.stamp_market_data(md("BBB", n=2))
+    s.stamp_market_data(md("CCC", n=1))   # retires AAA (LRU)
+    counters, _ = m.snapshot()
+    assert counters["feed_domains_retired"] == 1
+    assert len(s._domains) == 2
+    # Retired head still reported; its replay window is gone (a miss).
+    assert s.last_seq(CHANNEL_MD, "AAA") == 3
+    events, missed = s.replay(CHANNEL_MD, "AAA", 0)
+    assert events == [] and missed == 3
+    # Revival continues the line at 4 — never back to 1.
+    revived = md("AAA", n=1)
+    s.stamp_market_data(revived)
+    assert revived[0].seq == 4
+    assert s.last_seq(CHANNEL_MD, "AAA") == 4
+
+
+def test_events_carry_boot_epoch_and_mismatch_rebases(tmp_path):
+    """feed_epoch closes the undetectable-rebase hole: a resume whose
+    cursor is WITHIN the new boot's head but from another epoch must be
+    served live (no wrong-epoch replay) and reported as a rebase."""
+    hs = Harness(str(tmp_path / "epoch.db"))
+    try:
+        seqr = hs.parts["sequencer"]
+        for i in range(5):
+            submit(hs.stub, price=10000 + i)
+        events, _ = seqr.replay(CHANNEL_MD, "SYM", 0)
+        assert events and all(e.feed_epoch == seqr.epoch for e in events)
+        # Stale cursor 2 <= head 5, but from a different epoch.
+        rebases = []
+        feed = SequencedSubscriber(
+            hs.stub, CHANNEL_MD, "SYM", from_seq=2, epoch=seqr.epoch + 1,
+            on_rebase=lambda cur, seq: rebases.append((cur, seq)))
+        seen = []
+
+        def consume():
+            for u in feed:
+                seen.append(u.seq)
+                feed.cancel()
+                return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        hs.wait_md_sub()
+        submit(hs.stub, price=10100)
+        t.join(timeout=15)
+        assert not t.is_alive()
+        # Live event 6, NOT a replay of the (would-be wrong-epoch) 3..5.
+        assert seen == [6]
+        assert feed.epoch_rebases == 1 and rebases == [(2, 6)]
+        assert feed.epoch == seqr.epoch  # cursor re-homed to the new boot
+        assert feed.unrecovered_events == 0 and feed.gaps_detected == 0
+    finally:
+        hs.close()
+
+
+def test_hub_counts_legacy_drops_and_sequences_events():
+    m = Metrics()
+    hub = StreamHub(maxsize=4, metrics=m,
+                    sequencer=FeedSequencer(metrics=m, depth=64))
+    sub = hub.subscribe_market_data("SYM")
+    hub.publish_market_data(md(n=10))
+    counters, gauges = m.snapshot()
+    assert counters["stream_dropped_events"] == 6  # drop-oldest, visible
+    assert counters["feed_md_published"] == 10
+    assert gauges["feed_publish_seq"] == 10
+    # The queue retains the NEWEST 4 (the close sentinel evicts one more);
+    # the store still replays everything that was dropped.
+    hub.close_all()
+    got = [u for u in sub.stream()]
+    assert [u.seq for u in got] == [8, 9, 10]
+    counters, _ = m.snapshot()
+    assert counters["stream_dropped_events"] == 7
+    events, missed = hub.sequencer.replay(CHANNEL_MD, "SYM", 0, to_seq=7)
+    assert [e.seq for e in events] == [1, 2, 3, 4, 5, 6, 7] and missed == 0
+
+
+def test_conflated_subscription_yields_latest_state():
+    m = Metrics()
+    hub = StreamHub(maxsize=256, metrics=m,
+                    sequencer=FeedSequencer(metrics=m, depth=64))
+    sub = hub.subscribe_market_data("SYM", conflate=True)
+    hub.publish_market_data(md(n=50))
+    hub.close_all()
+    got = list(sub.stream())
+    assert got, "conflated channel delivered nothing"
+    assert got[-1].seq == 50          # newest state always survives
+    assert len(got) <= 2              # backlog conflated away, not queued
+    counters, _ = m.snapshot()
+    assert counters["feed_conflated_events"] >= 48
+    assert counters.get("stream_dropped_events", 0) == 0  # not drops
+
+
+def test_subscriber_lag_gauge_tracks_worst_consumer():
+    m = Metrics()
+    hub = StreamHub(maxsize=512, metrics=m,
+                    sequencer=FeedSequencer(metrics=m, depth=64))
+    hub.publish_market_data(md(n=5))      # pre-attach history
+    sub = hub.subscribe_market_data("SYM")
+    hub.publish_market_data(md(n=7))
+    _, gauges = m.snapshot()
+    # Attached at seq 5, consumed nothing, head now 12 -> lag 7.
+    assert gauges["feed_subscriber_lag_max"] == 7
+    hub.close_all()
+    list(sub.stream())
+
+
+# -- e2e ---------------------------------------------------------------------
+
+
+class Harness:
+    def __init__(self, db_path, **kw):
+        kw.setdefault("window_ms", 1.0)
+        kw.setdefault("log", False)
+        self.server, self.port, self.parts = build_server(
+            "127.0.0.1:0", db_path, CFG, **kw)
+        self.server.start()
+        self.addr = f"127.0.0.1:{self.port}"
+        self.channel = grpc.insecure_channel(self.addr)
+        self.stub = MatchingEngineStub(self.channel)
+
+    def wait_md_sub(self, timeout=5.0):
+        hub = self.parts["hub"]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if hub._md_subs:
+                return
+            time.sleep(0.01)
+        raise AssertionError("subscription never registered")
+
+    def close(self):
+        self.channel.close()
+        shutdown(self.server, self.parts)
+
+
+def submit(stub, client="c1", symbol="SYM", side=pb2.BUY, price=10000, qty=5):
+    r = stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol=symbol,
+                         order_type=pb2.LIMIT, side=side, price=price,
+                         scale=4, quantity=qty), timeout=10)
+    assert r.success, r.error_message
+    return r
+
+
+def _collect(stub, symbol, n, out, resume_from=0, conflate=False):
+    """Read n MD events on a thread; out gets the call first (cancelable)."""
+    call = stub.StreamMarketData(pb2.MarketDataRequest(
+        symbol=symbol, resume_from_seq=resume_from, conflate=conflate))
+    out.append(call)
+
+    def run():
+        try:
+            for u in call:
+                out.append(u)
+                if len([x for x in out[1:]]) >= n:
+                    return
+        except grpc.RpcError:
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _resume_and_verify(hs, last_seen):
+    """Reconnect with resume_from_seq and assert the replayed range is
+    bit-identical to the retransmission store (acceptance criterion)."""
+    seq = hs.parts["sequencer"]
+    head = seq.last_seq(CHANNEL_MD, "SYM")
+    assert head > last_seen, "no missed traffic to recover"
+    call = hs.stub.StreamMarketData(pb2.MarketDataRequest(
+        symbol="SYM", resume_from_seq=last_seen), timeout=10)
+    got = []
+    try:
+        for u in call:
+            got.append(u)
+            if u.seq >= head:
+                break
+    finally:
+        call.cancel()
+    assert [u.seq for u in got] == list(range(last_seen + 1, head + 1))
+    stored, missed = seq.replay(CHANNEL_MD, "SYM", last_seen, to_seq=head)
+    assert missed == 0
+    assert [u.SerializeToString() for u in got] == \
+        [e.SerializeToString() for e in stored], \
+        "replayed range is not bit-identical to the retransmission store"
+
+
+def test_e2e_sequenced_stream_and_resume_replay(tmp_path):
+    hs = Harness(str(tmp_path / "feed.db"))
+    try:
+        out = []
+        _collect(hs.stub, "SYM", 3, out)
+        hs.wait_md_sub()
+        for i in range(3):
+            submit(hs.stub, price=10000 + i)
+        deadline = time.monotonic() + 10
+        while len(out) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        events = out[1:]
+        assert [u.seq for u in events] == [1, 2, 3], \
+            "live events are not densely sequenced from 1"
+        out[0].cancel()  # subscriber dies mid-traffic
+        for i in range(4):
+            submit(hs.stub, price=10100 + i)
+        # ... and reconnects: the missed range replays exactly.
+        _resume_and_verify(hs, last_seen=3)
+    finally:
+        hs.close()
+
+
+def test_e2e_slow_subscriber_gap_fill_integrity(tmp_path):
+    """Fault injection: the subscriber stalls while the feed bursts far
+    past its queue, then consumes through SequencedSubscriber. The
+    invariant (either zero gaps, or every gap detected AND gap-filled)
+    must hold regardless of how much the transport buffered."""
+    hs = Harness(str(tmp_path / "gap.db"), stream_maxsize=8,
+                 feed_depth=1 << 15)
+    try:
+        hub, metrics = hs.parts["hub"], hs.parts["metrics"]
+        gaps = []
+        feed = SequencedSubscriber(
+            hs.stub, CHANNEL_MD, "SYM",
+            on_gap=lambda s, e, filled, missing: gaps.append(
+                (s, e, filled, missing)))
+        seen = []
+        stall = threading.Event()
+
+        def consume():
+            for u in feed:
+                seen.append(u.seq)
+                stall.wait()  # stalled until the burst is over
+                if u.seq >= 20_000:
+                    feed.cancel()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        hs.wait_md_sub()
+        # Burst 20k tiny events through the hub's publish path (the same
+        # entry the dispatch loops use) — far past the 8-slot queue and
+        # any transport buffering.
+        for base in range(0, 20_000, 500):
+            hub.publish_market_data(md(bid=base, n=500))
+        stall.set()
+        t.join(timeout=60)
+        assert not t.is_alive(), "consumer wedged"
+        assert feed.last_seq == 20_000
+        assert feed.unrecovered_events == 0, \
+            f"lost events for good: {feed.unrecovered_events}"
+        assert seen == sorted(seen) and len(set(seen)) == len(seen)
+        assert seen == list(range(seen[0], 20_001)), \
+            "delivered range is not contiguous after gap-fill"
+        counters, _ = metrics.snapshot()
+        if feed.gaps_detected:  # drops happened: recovery must show up
+            assert counters["stream_dropped_events"] > 0
+            assert counters["feed_retransmit_events"] > 0
+            assert all(missing == 0 for *_x, missing in gaps)
+    finally:
+        hs.close()
+
+
+def test_e2e_conflated_snapshots_for_slow_consumer(tmp_path):
+    hs = Harness(str(tmp_path / "confl.db"), stream_maxsize=64)
+    try:
+        hub, metrics = hs.parts["hub"], hs.parts["metrics"]
+        feed = SequencedSubscriber(hs.stub, CHANNEL_MD, "SYM",
+                                   conflate=True)
+        seen = []
+        stall = threading.Event()
+
+        def consume():
+            for u in feed:
+                seen.append(u)
+                stall.wait()
+                if u.seq >= 5_000:
+                    feed.cancel()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        hs.wait_md_sub()
+        for base in range(0, 5_000, 500):
+            hub.publish_market_data(md(bid=base, n=500))
+        stall.set()
+        t.join(timeout=30)
+        assert not t.is_alive(), "conflated consumer wedged"
+        # Latest state arrived; the backlog did not.
+        assert seen[-1].seq == 5_000
+        assert seen[-1].best_bid == 4_999
+        assert len(seen) < 1_000, "conflation never engaged"
+        assert feed.unrecovered_events == 0 and feed.gaps_detected == 0
+        counters, _ = metrics.snapshot()
+        assert counters["feed_conflated_events"] > 0
+        # The feed counters are on the Prometheus surface (/metrics body).
+        prom = render_prometheus(metrics)
+        for name in ("me_feed_conflated_events_total",
+                     "me_feed_md_published_total",
+                     "me_stream_dropped_events_total",
+                     "me_feed_publish_seq",
+                     "me_feed_subscriber_lag_max"):
+            if name.endswith("_total") and "dropped" in name:
+                continue  # drops may legitimately be zero here
+            assert name in prom, f"{name} missing from /metrics"
+    finally:
+        hs.close()
+
+
+def test_e2e_order_update_channel_sequenced(tmp_path):
+    hs = Harness(str(tmp_path / "ou.db"))
+    try:
+        feed = SequencedSubscriber(hs.stub, CHANNEL_OU, "maker")
+        seen = []
+
+        def consume():
+            for u in feed:
+                seen.append(u)
+                if len(seen) >= 2:
+                    feed.cancel()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not hs.parts["hub"]._ou_subs and time.monotonic() < deadline:
+            time.sleep(0.01)
+        submit(hs.stub, client="maker", side=pb2.SELL, price=10000, qty=5)
+        submit(hs.stub, client="taker", side=pb2.BUY, price=10000, qty=2)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert [u.seq for u in seen] == [1, 2]
+        assert seen[1].status == pb2.OrderUpdate.Status.PARTIALLY_FILLED
+    finally:
+        hs.close()
+
+
+def test_e2e_feed_disabled_serves_unsequenced_streams(tmp_path):
+    """--feed-depth 0: the legacy contract — seq stays 0, resume_from_seq
+    is ignored, streams still deliver."""
+    hs = Harness(str(tmp_path / "off.db"), feed_depth=0)
+    try:
+        assert hs.parts["sequencer"] is None
+        out = []
+        _collect(hs.stub, "SYM", 1, out, resume_from=99)
+        hs.wait_md_sub()
+        submit(hs.stub)
+        deadline = time.monotonic() + 10
+        while len(out) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(out) >= 2 and out[1].seq == 0
+        out[0].cancel()
+    finally:
+        hs.close()
+
+
+def test_cli_subscribe_verb_summary_and_exit(tmp_path, capsys):
+    from matching_engine_tpu.client import cli
+
+    hs = Harness(str(tmp_path / "cli.db"))
+    try:
+        summary_path = tmp_path / "summary.json"
+        rc = {}
+
+        def run():
+            rc["v"] = cli.main([
+                "subscribe", hs.addr, "md", "SYM", "--max-events", "3",
+                "--idle-exit", "30", "--summary-json", str(summary_path)])
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        hs.wait_md_sub()
+        for i in range(3):
+            submit(hs.stub, price=10000 + i)
+        t.join(timeout=20)
+        assert not t.is_alive(), "subscribe verb never exited"
+        assert rc["v"] == 0
+        doc = json.loads(summary_path.read_text())
+        assert doc["events"] == 3 and doc["last_seq"] == 3
+        assert doc["unrecovered_events"] == 0
+    finally:
+        hs.close()
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_e2e_native_lanes_resume_replay(tmp_path):
+    """The acceptance e2e on the C++ lane path: disconnect mid-traffic,
+    reconnect with resume_from_seq, bit-identical replayed range."""
+    hs = Harness(str(tmp_path / "lanes.db"), native_lanes=True)
+    try:
+        out = []
+        _collect(hs.stub, "SYM", 2, out)
+        hs.wait_md_sub()
+        for i in range(2):
+            submit(hs.stub, price=10000 + i)
+        deadline = time.monotonic() + 10
+        while len(out) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        events = out[1:]
+        assert [u.seq for u in events[:2]] == [1, 2]
+        out[0].cancel()
+        for i in range(3):
+            submit(hs.stub, price=10200 + i)
+        _resume_and_verify(hs, last_seen=2)
+        counters, _ = hs.parts["metrics"].snapshot()
+        assert counters["feed_md_published"] >= 5
+        assert counters["feed_retransmit_events"] >= 3
+    finally:
+        hs.close()
