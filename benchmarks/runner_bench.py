@@ -58,6 +58,36 @@ def main() -> None:
                         "outside the timed loop, mirroring the gateway "
                         "edge where C++ fills the ring")
     p.add_argument("--kernel", choices=("matrix", "sorted"), default="matrix")
+    p.add_argument("--serve-shards", default="",
+                   help="comma list of partitioned-lane counts K to sweep "
+                        "(server/shards.py): each point builds K "
+                        "independent (runner + dispatch) lanes over a "
+                        "K-way symbol split — strided OIDs, per-lane "
+                        "device pinning — and drives them from K "
+                        "concurrent threads, measuring aggregate "
+                        "sustained orders/s. K must divide --symbols. "
+                        "Empty = the legacy single-lane sweep. Host "
+                        "scaling saturates at min(K, host cores): the "
+                        "native path's lane build/decode releases the "
+                        "GIL, the python path mostly holds it")
+    p.add_argument("--repeats", type=int, default=1,
+                   help="repetitions per sharded sweep point; the row "
+                        "reports the BEST repetition (uncontended host "
+                        "capability) plus the min/max spread — this "
+                        "container's shared 2-CPU host shows ±40% "
+                        "run-to-run noise from the platform supervisor, "
+                        "which single runs cannot separate from real "
+                        "scaling")
+    p.add_argument("--gil-switch-us", type=int, default=500,
+                   help="sys.setswitchinterval for the sharded sweep, in "
+                        "microseconds. K lanes alternate short GIL-held "
+                        "python sections with GIL-released native calls; "
+                        "at CPython's default 5ms interval a lane "
+                        "returning from C waits out the holder's full "
+                        "quantum (the convoy effect) and scaling goes "
+                        "NEGATIVE. 500us is the measured sweet spot on "
+                        "this stack; server/main.py applies the same "
+                        "tuning under --serve-shards")
     p.add_argument("--host-only", action="store_true",
                    help="isolate the serving stack's HOST work (lane "
                         "build, id/slot assignment, status decode, "
@@ -283,11 +313,215 @@ def main() -> None:
             "mean_batch_ms": round(dt / len(batches) * 1e3, 3),
         }
 
+    # -- partitioned-lane sweep (server/shards.py) -------------------------
+
+    import threading
+
+    _tls = threading.local()
+
+    def _stub_sparse(c, book, sp):
+        return book, _tls.outs.popleft()
+
+    def _stub_packed(c, book, arr):
+        return book, _tls.outs.popleft()
+
+    class _HostOut:
+        """A recorded step output with its packed readbacks ALREADY on
+        host as numpy. The replay must contain zero device interaction:
+        np.asarray on a jax Array re-enters the jax runtime, whose
+        cross-thread serialization dwarfs the host work K lanes are
+        trying to overlap (measured: K=2 collapsed ~4x through it)."""
+
+        __slots__ = ("small", "fills")
+
+        def __init__(self, out):
+            self.small = np.asarray(out.small)
+            self.fills = np.asarray(out.fills)
+
+    def make_shard_lanes(mode: str, inflight: int, batch_ops: int, K: int):
+        """K (runner, batches, dispatch) lanes over a K-way split of the
+        bench config — the build_serving_shards cut minus the dispatcher
+        threads (the bench's worker threads ARE the per-lane drain
+        loops, so the timed region contains exactly the serving host
+        work and no queue hand-off)."""
+        from matching_engine_tpu.server.shards import (
+            ShardRouter,
+            make_lane_runner,
+        )
+        from matching_engine_tpu.server.streams import StreamHub
+
+        router = ShardRouter(K)
+        hub = StreamHub()
+        shard_syms = args.symbols // K
+        lanes = []
+        for i in range(K):
+            runner = make_lane_runner(
+                cfg, router, i, hub=hub, pipeline_inflight=inflight,
+                native_lanes=(mode == "native"))
+            # Lane-local symbol namespace sized to the lane's axis: the
+            # router is exercised by the serving tests; here each lane
+            # is driven directly, as its dispatcher thread would.
+            batches = build_lane_record_batches(
+                seed=1000 * K + i, n_batches=args.n_batches,
+                batch_ops=batch_ops, lane=i, lane_symbols=shard_syms)
+            if mode == "native":
+                dispatch = (lambda b, cb, _r=runner:
+                            _r.dispatch_records(b[0], b[1], cb))
+            else:
+                dispatch = (lambda b, cb, _r=runner:
+                            _r.dispatch_pipelined(
+                                records_to_ops(_r, b[0], b[1]), cb))
+            lanes.append({"runner": runner, "batches": batches,
+                          "dispatch": dispatch})
+        return lanes
+
+    def build_lane_record_batches(seed, n_batches, batch_ops, lane,
+                                  lane_symbols):
+        from matching_engine_tpu.server.native_lanes import pack_record_batch
+
+        rng = random.Random(seed)
+        batches = []
+        tag = 1
+        for _ in range(n_batches):
+            recs = []
+            for _ in range(batch_ops):
+                sym = f"L{lane}S{rng.randrange(lane_symbols)}"
+                side = BUY if rng.random() < 0.5 else SELL
+                price = 10_000 + rng.randrange(-20, 21)
+                qty = rng.randrange(1, 50)
+                recs.append((tag, 1, side, 0, price, qty, sym,
+                             f"c{tag % 97}", ""))
+                tag += 1
+            batches.append(pack_record_batch(recs))
+        return batches
+
+    def sweep_point_sharded(mode: str, inflight: int, batch_ops: int,
+                            K: int) -> dict:
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+
+        def run_lane(lane, barrier):
+            if args.host_only:
+                _tls.outs = lane["outs"]
+            local_lat = []
+            barrier.wait()
+            for b in lane["batches"]:
+                t_start = time.perf_counter()
+
+                def cb(result, error, _t=t_start):
+                    assert error is None, error
+                    local_lat.append(time.perf_counter() - _t)
+                lane["dispatch"](b, cb)
+            lane["runner"].finish_pending()
+            with lat_lock:
+                lat.extend(local_lat)
+
+        ctx = contextlib.nullcontext()
+        if args.host_only:
+            # Record pass: the real pipeline per lane, sequentially; the
+            # timed pass replays each lane's recorded step outputs
+            # through a THREAD-LOCAL stub, so K lanes replay unsynchron-
+            # ized while all host work stays bit-identical.
+            from matching_engine_tpu.engine.kernel import (
+                engine_step_packed as real_packed,
+            )
+            from matching_engine_tpu.engine.sparse import (
+                engine_step_sparse as real_sparse,
+            )
+
+            rec_lanes = make_shard_lanes(mode, inflight, batch_ops, K)
+            per_lane_outs = []
+            for lane in rec_lanes:
+                outs: deque = deque()
+
+                def rec_sparse(c, book, sp, _o=outs):
+                    book, out = real_sparse(c, book, sp)
+                    _o.append(_HostOut(out))
+                    return book, out
+
+                def rec_packed(c, book, arr, _o=outs):
+                    book, out = real_packed(c, book, arr)
+                    _o.append(_HostOut(out))
+                    return book, out
+
+                with patched_steps(rec_sparse, rec_packed):
+                    for b in lane["batches"]:
+                        lane["dispatch"](b, lambda r, e: None)
+                    lane["runner"].finish_pending()
+                per_lane_outs.append(outs)
+            ctx = patched_steps(_stub_sparse, _stub_packed)
+
+        lanes = make_shard_lanes(mode, inflight, batch_ops, K)
+        if args.host_only:
+            for lane, outs in zip(lanes, per_lane_outs):
+                lane["outs"] = outs
+        with ctx:
+            if not args.host_only:
+                # Sequential warm pass: compile the step shapes (and, on
+                # a multi-device host, each lane's device executable)
+                # outside the timed region.
+                for i, lane in enumerate(lanes):
+                    warm = build_lane_record_batches(
+                        seed=555 + i, n_batches=2, batch_ops=batch_ops,
+                        lane=i, lane_symbols=args.symbols // K)
+                    for b in warm:
+                        lane["dispatch"](b, lambda r, e: None)
+                    lane["runner"].finish_pending()
+
+            barrier = threading.Barrier(K + 1)
+            threads = [threading.Thread(target=run_lane,
+                                        args=(lane, barrier), daemon=True)
+                       for lane in lanes]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t_begin = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t_begin
+        assert len(lat) == K * args.n_batches
+        lats = np.array(sorted(lat))
+        n_ops = K * args.n_batches * batch_ops
+        return {
+            "mode": mode + ("-host" if args.host_only else ""),
+            "serve_shards": K,
+            "inflight": inflight,
+            "orders_per_s": round(n_ops / dt, 1),
+            "batch_ops": batch_ops,
+            "n_batches": args.n_batches,
+            "p50_ms": round(float(lats[len(lats) // 2]) * 1e3, 3),
+            "p99_ms": round(float(lats[int(len(lats) * 0.99)]) * 1e3, 3),
+            "mean_batch_ms": round(dt / args.n_batches * 1e3, 3),
+        }
+
     grid_cap = args.symbols * args.batch
-    rows = [sweep_point(mode.strip(), int(k), min(int(bo), grid_cap))
-            for mode in args.mode.split(",")
-            for bo in str(args.batch_ops).split(",")
-            for k in args.inflight.split(",")]
+    shard_list = [int(k) for k in args.serve_shards.split(",")
+                  if k.strip()] if args.serve_shards else []
+    if shard_list:
+        import sys as _sys
+
+        _sys.setswitchinterval(max(1, args.gil_switch_us) / 1e6)
+
+        def best_of(mode, k, bo, K):
+            reps = [sweep_point_sharded(mode, k, bo, K)
+                    for _ in range(max(1, args.repeats))]
+            rates = [r["orders_per_s"] for r in reps]
+            best = max(reps, key=lambda r: r["orders_per_s"])
+            best["repeats"] = len(reps)
+            best["orders_per_s_spread"] = [min(rates), max(rates)]
+            return best
+
+        rows = [best_of(mode.strip(), int(k),
+                        min(int(bo), (args.symbols // K) * args.batch), K)
+                for mode in args.mode.split(",")
+                for bo in str(args.batch_ops).split(",")
+                for k in args.inflight.split(",")
+                for K in shard_list]
+    else:
+        rows = [sweep_point(mode.strip(), int(k), min(int(bo), grid_cap))
+                for mode in args.mode.split(",")
+                for bo in str(args.batch_ops).split(",")
+                for k in args.inflight.split(",")]
 
     try:
         import subprocess
@@ -306,6 +540,9 @@ def main() -> None:
         "batch": args.batch,
         "kernel": args.kernel,
         "backend_init_s": round(backend_init_s, 1),
+        # Lane scaling is bounded by min(K, host cores): record the
+        # ceiling next to the sweep so cross-machine artifacts compare.
+        "host_cpus": os.cpu_count(),
         "sweep": rows,
         "git_rev": rev,
     }

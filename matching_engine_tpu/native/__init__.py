@@ -725,6 +725,8 @@ def _bind_lanes(lib) -> None:
     lib.me_lanes_evict.argtypes = [ctypes.c_void_p, ctypes.c_int32, i32p]
     lib.me_lanes_evict.restype = ctypes.c_int
     lib.me_lanes_set_auction_mode.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.me_lanes_set_oid_stride.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_longlong]
     lib.me_lanes_adopt.argtypes = [ctypes.c_void_p, u8p, ctypes.c_longlong]
     lib.me_lanes_adopt.restype = ctypes.c_int
     lib.me_lanes_dump_slots.argtypes = [
@@ -1091,6 +1093,12 @@ class NativeLanes:
 
     def set_auction_mode(self, value: bool) -> None:
         self._lib.me_lanes_set_auction_mode(self._h, 1 if value else 0)
+
+    def set_oid_stride(self, stride: int) -> None:
+        """Partitioned serving: this lane allocates every `stride`-th OID
+        (adopt()/the runner's seeding put next_oid on the lane's residue
+        class; the stride keeps it there)."""
+        self._lib.me_lanes_set_oid_stride(self._h, stride)
 
     def adopt(self, blob: bytes) -> None:
         buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)

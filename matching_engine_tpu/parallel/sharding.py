@@ -27,6 +27,8 @@ from functools import partial
 from typing import NamedTuple
 
 import jax
+
+from matching_engine_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -165,7 +167,7 @@ class ShardedEngine:
                 best_ask=out.best_ask, ask_size=out.ask_size,
             )
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_step,
             mesh=mesh,
             in_specs=(_book_specs(), _order_specs()),
@@ -174,7 +176,7 @@ class ShardedEngine:
         self.step = jax.jit(mapped, donate_argnums=0)
 
         def gather_tob(bb, bs, ba, as_):
-            f = jax.shard_map(
+            f = shard_map(
                 lambda *xs: tuple(
                     jax.lax.all_gather(x, AXIS, tiled=True) for x in xs
                 ),
@@ -252,7 +254,7 @@ class ShardedEngine:
             _book_specs(),
             (P(AXIS),) * 14,
         )
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_auction,
             mesh=mesh,
             in_specs=(_book_specs(), P(AXIS)),

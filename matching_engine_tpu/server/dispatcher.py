@@ -21,7 +21,11 @@ from concurrent.futures import Future
 
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner
 from matching_engine_tpu.utils.metrics import Metrics
-from matching_engine_tpu.utils.obs import DispatchTimeline, record_dispatch_error
+from matching_engine_tpu.utils.obs import (
+    DispatchTimeline,
+    record_dispatch_error,
+    warn_rate_limited,
+)
 
 
 class RingFull(RuntimeError):
@@ -55,8 +59,13 @@ def publish_result(result, sink, hub, metrics) -> None:
             hub.publish_order_updates(result.order_updates)
             hub.publish_market_data(result.market_data)
     except Exception as e:  # noqa: BLE001
+        # Counted at batch rate (me_sink_publish_errors_total is the alert
+        # signal); logged at human rate — a flapping sink fails every
+        # drain and must not spam stdout at batch frequency.
         metrics.inc("sink_publish_errors")
-        print(f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
+        warn_rate_limited(
+            "dispatcher-sink",
+            f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
 
 
 class BatchDispatcher:

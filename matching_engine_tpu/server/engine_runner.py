@@ -162,7 +162,9 @@ class EngineRunner:
     """
 
     def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None,
-                 mesh=None, hub=None, pipeline_inflight: int = 2):
+                 mesh=None, hub=None, pipeline_inflight: int = 2,
+                 oid_offset: int = 0, oid_stride: int = 1, device=None,
+                 owns_filter=None):
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
@@ -187,14 +189,34 @@ class EngineRunner:
         else:
             self._sharded = None
             self.book = init_book(cfg)
+            if device is not None:
+                # Partitioned serving (server/shards.py): pin this lane's
+                # books to one device. The book is COMMITTED there, so
+                # every jit'd step (whose other inputs are host numpy)
+                # runs on — and donates back to — that device; K lanes on
+                # K chips dispatch with no collectives between them.
+                self.book = jax.device_put(self.book, device)
             self._slot_lo, self._slot_hi = 0, cfg.num_symbols
             self._n_hosts, self._host = 1, 0
+        self.device = device
+        # Symbol-shard ownership override (server/shards.py): when serving
+        # as one of K partitioned lanes, owns_symbol delegates here so the
+        # recovery/restore replay and the edge checks all route by the
+        # same shard cut. None = the multi-host name-hash rule.
+        self._owns_filter = owns_filter
         # Directories (host truth mirroring device state).
         self.symbols: dict[str, int] = {}           # symbol -> slot
         self.slot_symbols: list[str | None] = [None] * cfg.num_symbols
         self.orders_by_handle: dict[int, OrderInfo] = {}
         self.orders_by_id: dict[str, OrderInfo] = {}
-        self.next_oid_num = 1
+        # Order-ID allocation: lane i of K partitioned serving lanes
+        # allocates the strided residue class {offset+1, offset+1+K, ...}
+        # so IDs stay globally unique across lanes with no cross-lane
+        # lock, and (oid-1) % stride recovers the birth lane. The default
+        # (offset 0, stride 1) is the reference's dense "OID-<n>" line.
+        self.oid_offset = oid_offset
+        self.oid_stride = max(1, oid_stride)
+        self.next_oid_num = oid_offset + 1
         # Device-handle allocator: handles recycle when orders go terminal,
         # so the int32 lane space can never wrap no matter the order count
         # (live handles are bounded by open + in-flight orders).
@@ -259,6 +281,11 @@ class EngineRunner:
         # ate a full RTT head-of-line (r3's 40x p50->p99 serving tail).
         self._pending: deque[tuple[_Staged, object]] = deque()
         self._pipeline_inflight = max(1, int(pipeline_inflight))
+        # Per-runner dispatched-op odometer (plain GIL-atomic int): the
+        # partitioned-serving sampler (server/shards.py) attributes rate
+        # and imbalance per lane from it — the shared Metrics registry
+        # aggregates across lanes and can't.
+        self.ops_dispatched = 0
         # Constructor-wired (build_server passes the StreamHub the
         # dispatchers publish to): lets the decode skip CONSTRUCTING stream
         # protos (per-fill OrderUpdates, per-symbol MarketDataUpdates) when
@@ -282,12 +309,18 @@ class EngineRunner:
     def assign_oid(self) -> tuple[int, str]:
         with self._id_lock:
             n = self.next_oid_num
-            self.next_oid_num += 1
+            self.next_oid_num += self.oid_stride
         return n, f"OID-{n}"
 
     def seed_oid_sequence(self, next_n: int) -> None:
+        """Advance the OID line past `next_n` (storage resume). A strided
+        lane additionally rounds UP to its own residue class, so reseeding
+        from a store written at any other shard count (including 1) keeps
+        every future ID unique and lane-attributable."""
         with self._id_lock:
-            self.next_oid_num = max(self.next_oid_num, next_n)
+            n = max(self.next_oid_num, next_n)
+            n += (self.oid_offset - (n - 1)) % self.oid_stride
+            self.next_oid_num = max(self.next_oid_num, n)
 
     def assign_handle(self) -> int:
         """A device handle unique among live orders (recycled int32)."""
@@ -345,6 +378,8 @@ class EngineRunner:
         invariant). Slots are recycled, so ownership must be decided by
         NAME, not slot availability — otherwise two hosts could each book
         the same symbol and diverge. Always True single-process."""
+        if self._owns_filter is not None:
+            return self._owns_filter(symbol)
         if self._n_hosts == 1:
             return True
         from matching_engine_tpu.parallel.multihost import symbol_home
@@ -643,6 +678,7 @@ class EngineRunner:
         self.metrics.inc("dispatches")
         self.metrics.inc("engine_ops", len(staged.ops))
         self.metrics.inc("fills", staged.res.fill_count)
+        self.ops_dispatched += len(staged.ops)
         if staged.timeline is not None:
             # Decode boundary: results + fills decoded, directories
             # updated, terminal orders evicted — the dispatch's host tail.

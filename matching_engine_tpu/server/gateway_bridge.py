@@ -63,6 +63,7 @@ class GatewayBridge:
         max_batch: int | None = None,
         workers: int = 8,
         native_lanes: bool = False,
+        shards=None,  # server/shards.ServingShards | None
     ):
         self.gateway = gateway
         self.runner = runner
@@ -70,6 +71,16 @@ class GatewayBridge:
         self.sink = sink
         self.hub = hub
         self.metrics = runner.metrics
+        # Partitioned serving: the drain loop routes each popped record to
+        # its lane (submits by symbol shard, cancels/amends by the order
+        # id's birth lane) and stages one dispatch per touched lane. Only
+        # the python dispatch route composes with shards — the native-lane
+        # drain hands whole raw buffers to ONE C++ engine.
+        self.shards = shards
+        if shards is not None and native_lanes:
+            raise ValueError(
+                "the gateway's native-lane drain is single-lane; with "
+                "serve-shards use its python dispatch route")
         self.window_us = max(1, int(window_ms * 1e3))
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
         # Native lane mode (server/native_lanes.py): the drain loop pops
@@ -137,7 +148,7 @@ class GatewayBridge:
             try:
                 recs = self.gateway.pop_batch(
                     self.max_batch, self.window_us,
-                    self.window_us if self.runner.has_pending else -1,
+                    self.window_us if self._any_pending() else -1,
                 )
             except Exception as e:  # noqa: BLE001 — a record that fails
                 # host-side decode (e.g. a non-UTF-8 field surviving the C++
@@ -149,7 +160,7 @@ class GatewayBridge:
             if recs is None:
                 break
             if not recs:  # idle lull with a staged dispatch: finish it
-                self.runner.finish_pending()
+                self._finish_all()
                 continue
             try:
                 self._drain_batch(recs)
@@ -174,7 +185,18 @@ class GatewayBridge:
                         # decode — this fallback must never raise.
                         self.gateway.complete_cancel(
                             rec[0], False, rec[8] or "", "engine error")
-        self.runner.finish_pending()
+        self._finish_all()
+
+    def _any_pending(self) -> bool:
+        if self.shards is None:
+            return self.runner.has_pending
+        return any(l.runner.has_pending for l in self.shards.lanes)
+
+    def _finish_all(self) -> None:
+        if self.shards is None:
+            self.runner.finish_pending()
+        else:
+            self.shards.finish_pending()
 
     # -- hot path, native-lane mode ----------------------------------------
 
@@ -280,7 +302,25 @@ class GatewayBridge:
         self.runner.dispatch_records(recs, n, on_finish, timeline=tl)
 
     def _drain_batch(self, recs) -> None:
-        runner = self.runner
+        if self.shards is None:
+            return self._drain_group(self.runner, recs)
+        # Route by record, preserving per-lane arrival order (each group
+        # keeps the ring's FIFO within its lane; cross-lane order was
+        # never observable — different lanes are different books).
+        groups: dict[int, list] = {}
+        for rec in recs:
+            if rec[1] == 1 and rec[6] is not None:
+                lane = self.shards.lane_for_symbol(rec[6])
+            elif rec[8]:
+                lane = self.shards.lane_for_order(rec[8])
+            else:
+                lane = self.shards.lanes[0]  # decode-failed record:
+                # completed with "invalid request encoding" in the group
+            groups.setdefault(lane.shard_id, []).append(rec)
+        for shard_id, group in groups.items():
+            self._drain_group(self.shards.lanes[shard_id].runner, group)
+
+    def _drain_group(self, runner, recs) -> None:
         t0 = time.perf_counter()
         ops: list[EngineOp] = []
         tags: dict[int, int] = {}  # id(EngineOp) -> gateway tag
@@ -482,7 +522,7 @@ class GatewayBridge:
         # enqueue, complete = response fan-out through the gateway.
         self.metrics.ema_gauge(
             "bridge_setup_us", (time.perf_counter() - t0) * 1e6)
-        self.runner.dispatch_pipelined(ops, on_finish, timeline=tl)
+        runner.dispatch_pipelined(ops, on_finish, timeline=tl)
 
     def _publish(self, result) -> None:
         publish_result(result, self.sink, self.hub, self.metrics)

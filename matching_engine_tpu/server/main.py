@@ -82,6 +82,49 @@ def recover_books(runner: EngineRunner, storage: Storage) -> int:
     return len(ops)
 
 
+def _boot_runner(make, storage, owner_rows, ckpt_root, log, tag=""):
+    """Construct + recover one runner: STP owner-registry preload,
+    checkpoint fast-path restore with full-replay fallback, SQLite book
+    recovery. Shared by the single-lane boot and each partitioned
+    serving lane (which passes its own checkpoint subdir and whose
+    owns_symbol filter confines the replay to its shard)."""
+    runner = make()
+    runner.load_owner_ids(owner_rows)
+    ckpt = latest_checkpoint(ckpt_root) if ckpt_root else None
+    if ckpt is not None:
+        try:
+            replayed = restore_runner(runner, ckpt, storage)
+            # Shard-cut identity guard: a reboot that changes --symbols
+            # and --serve-shards PROPORTIONALLY passes restore_runner's
+            # semantic-key and slice checks (both compare per-lane
+            # shapes), yet the snapshot belongs to a DIFFERENT cut of
+            # the symbol space — restoring it would put live books for
+            # symbols this lane no longer owns next to the owning
+            # lane's replayed ones. Foreign symbols => full replay.
+            foreign = [s for s in runner.symbols
+                       if not runner.owns_symbol(s)]
+            if foreign:
+                raise ValueError(
+                    f"checkpoint covers {len(foreign)} symbol(s) outside "
+                    f"this lane's shard cut (e.g. {foreign[0]}) — shard "
+                    f"count/symbol axis changed")
+            if log:
+                print(f"[SERVER] restored{tag} {ckpt} "
+                      f"(+{replayed} reconcile ops)")
+        except Exception as e:  # corrupt/skewed checkpoint -> full replay
+            print(f"[SERVER] checkpoint restore{tag} failed "
+                  f"({type(e).__name__}: {e}); full replay")
+            runner = make()
+            runner.load_owner_ids(owner_rows)
+            ckpt = None
+    if ckpt is None:
+        recovered = recover_books(runner, storage)
+        if recovered and log:
+            print(f"[SERVER] recovered{tag} {recovered} open orders "
+                  f"into device books")
+    return runner
+
+
 def build_server(
     addr: str,
     db_path: str,
@@ -100,6 +143,7 @@ def build_server(
     feed_depth: int = 1 << 16,
     feed_spill_dir: str | None = None,
     stream_maxsize: int = 1024,
+    serve_shards: int = 1,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -113,8 +157,17 @@ def build_server(
     lane build, host checks, completion/storage decode all happen native,
     Python works per dispatch. Single-device only; requires the built
     native runtime.
+
+    With serve_shards=K (> 1) the serving stack partitions into K
+    independent symbol-sharded lanes (server/shards.py): a router at the
+    edge, one (ring → dispatcher thread → runner) column per shard, each
+    pinned to its own device when several are visible. Incompatible with
+    --mesh (the ShardedEngine path keeps the market-wide formulation).
     """
     from matching_engine_tpu import native as _me_native
+
+    if serve_shards > 1 and mesh is not None:
+        raise SystemExit(3)  # partitioned lanes vs mesh: pick one
 
     if native_lanes:
         if mesh is not None:
@@ -162,7 +215,6 @@ def build_server(
         return EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
                             pipeline_inflight=pipeline_inflight)
 
-    runner = make_runner()
     # STP identity registry loads BEFORE any restore/recovery replay — the
     # replay derives owner lanes via _owner_for, and a hash-colliding
     # client must resolve to its persisted id, not first-arrival order.
@@ -172,25 +224,46 @@ def build_server(
               "identities re-derive from hashes; collision remaps may "
               "differ from previously persisted assignments")
         owner_rows = []
-    runner.load_owner_ids(owner_rows)
-    # Fast path: restore the newest device-book snapshot and replay only the
-    # post-snapshot delta from SQLite; fall back to full replay.
-    ckpt = latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
-    if ckpt is not None:
-        try:
-            replayed = restore_runner(runner, ckpt, storage)
-            if log:
-                print(f"[SERVER] restored {ckpt} (+{replayed} reconcile ops)")
-        except Exception as e:  # any corrupt/skewed checkpoint -> full replay
-            print(f"[SERVER] checkpoint restore failed "
-                  f"({type(e).__name__}: {e}); full replay")
-            runner = make_runner()
-            runner.load_owner_ids(owner_rows)
-            ckpt = None
-    if ckpt is None:
-        recovered = recover_books(runner, storage)
-        if recovered and log:
-            print(f"[SERVER] recovered {recovered} open orders into device books")
+    router = None
+    lanes = None
+    if serve_shards > 1:
+        # K lanes alternate short GIL-held python sections with
+        # GIL-released native/device calls; at CPython's default 5ms
+        # switch interval a drain thread returning from C waits out the
+        # GIL holder's whole quantum (the convoy effect) and lane
+        # scaling goes negative. 500us restores the handoff granularity
+        # this architecture needs (measured in BENCH_METHOD.md).
+        sys.setswitchinterval(500 / 1e6)
+        # Partitioned serving boot: K lane runners, each restored from its
+        # own checkpoint subdir (or by replaying only its shard's rows —
+        # owns_symbol routes by the shard cut). The durable store itself
+        # is shard-agnostic, so a db written at any K boots at any other.
+        from matching_engine_tpu.server.shards import (
+            ServingLane,
+            ShardRouter,
+            make_lane_runner,
+        )
+
+        router = ShardRouter(serve_shards)
+        lanes = []
+        for i in range(serve_shards):
+            lanes.append(ServingLane(i, _boot_runner(
+                lambda _i=i: make_lane_runner(
+                    cfg, router, _i, metrics=metrics, hub=hub,
+                    pipeline_inflight=pipeline_inflight,
+                    native_lanes=native_lanes),
+                storage, owner_rows,
+                os.path.join(checkpoint_dir, f"shard-{i}")
+                if checkpoint_dir else None,
+                log, tag=f" lane {i}")))
+        runners = [lane.runner for lane in lanes]
+        runner = runners[0]
+    else:
+        # Fast path: restore the newest device-book snapshot and replay
+        # only the post-snapshot delta from SQLite; else full replay.
+        runner = _boot_runner(make_runner, storage, owner_rows,
+                              checkpoint_dir, log)
+        runners = [runner]
     # Restore a persisted call period (each host records its own flag in
     # its durable store — crossedness alone can't prove the ABSENCE of a
     # call period, e.g. non-crossing rests only).
@@ -199,7 +272,8 @@ def build_server(
     auction_ok = cfg.capacity <= auction_capacity_max(cfg.kernel)
     if storage.get_meta("auction_mode") == "1":
         if auction_ok:
-            runner.auction_mode = True
+            for r in runners:  # a call period is venue-wide: every lane
+                r.auction_mode = True
             if log:
                 print("[SERVER] durable store records an OPEN auction call "
                       "period: resuming it")
@@ -211,9 +285,10 @@ def build_server(
     # persisted during a call period (continuous matching never leaves
     # one standing) — resume rather than expose those books to the
     # continuous maker scan.
-    crossed = runner.crossed_symbols()
+    crossed = [s for r in runners for s in r.crossed_symbols()]
     if crossed and not runner.auction_mode and auction_ok:
-        runner.auction_mode = True
+        for r in runners:
+            r.auction_mode = True
         print(f"[SERVER] {len(crossed)} recovered book(s) stand crossed "
               f"(e.g. {crossed[0]}): resuming the auction call period")
     elif crossed and not runner.auction_mode:
@@ -234,11 +309,16 @@ def build_server(
               "RunAuction (empty symbol) reopens continuous trading")
     # Wire persistence AFTER restore (the restore read, not wrote) and
     # record the current state so a pre-meta database gains the row.
-    runner.persist_auction_mode = (
-        lambda v: storage.set_meta("auction_mode", "1" if v else "0"))
+    # One meta row serves every lane: the persisted flag is the OR across
+    # lanes, so it stays "1" until the LAST lane's call period closes
+    # (any lane with standing rests must resume accumulating on reboot).
+    persist_mode = (lambda v: storage.set_meta(
+        "auction_mode", "1" if any(r.auction_mode for r in runners) else "0"))
+    for r in runners:
+        r.persist_auction_mode = persist_mode
+        r.persist_owner_ids = storage.insert_owner_ids
+        r.flush_owner_ids()  # assignments derived during recovery replay
     runner.persist_auction_mode(runner.auction_mode)
-    runner.persist_owner_ids = storage.insert_owner_ids
-    runner.flush_owner_ids()  # assignments derived during recovery replay
 
     from matching_engine_tpu import native as me_native
 
@@ -254,32 +334,68 @@ def build_server(
 
     sink = SpillingSink(sink, metrics)
     checkpointer = None
-    if checkpoint_dir:
-        checkpointer = CheckpointDaemon(
-            runner, sink, checkpoint_dir, interval_s=checkpoint_interval_s,
-            storage=storage,
-        ).start()
-    if native_lanes:
-        # All boot-time Python-path mutations (recovery replay, restore,
-        # auction-mode resume) are done: flip directory authority to the
-        # C++ lane engine before any serving loop can dispatch.
-        runner.adopt_from_python()
-        from matching_engine_tpu.server.dispatcher import LaneRingDispatcher
+    checkpointers = []
+    shards = None
+    if serve_shards > 1:
+        from matching_engine_tpu.server.shards import (
+            ServingShards,
+            make_lane_dispatcher,
+        )
 
-        dispatcher = LaneRingDispatcher(
-            runner, sink=sink, hub=hub, window_ms=window_ms
-        )
-    elif use_native:
-        dispatcher = NativeRingDispatcher(
-            runner, sink=sink, hub=hub, window_ms=window_ms
-        )
+        for lane in lanes:
+            if checkpoint_dir:
+                lane.checkpointer = CheckpointDaemon(
+                    lane.runner, sink,
+                    os.path.join(checkpoint_dir, f"shard-{lane.shard_id}"),
+                    interval_s=checkpoint_interval_s, storage=storage,
+                ).start()
+                checkpointers.append(lane.checkpointer)
+            if native_lanes:
+                # Boot-time Python-path mutations are done for this lane:
+                # flip directory authority to its C++ engine before any
+                # serving loop can dispatch.
+                lane.runner.adopt_from_python()
+            lane.dispatcher = make_lane_dispatcher(
+                lane.runner, sink=sink, hub=hub, window_ms=window_ms,
+                metrics=metrics, native=use_native,
+                native_lanes=native_lanes)
+        shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
+        dispatcher = lanes[0].dispatcher
     else:
-        dispatcher = BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms)
+        if checkpoint_dir:
+            checkpointer = CheckpointDaemon(
+                runner, sink, checkpoint_dir,
+                interval_s=checkpoint_interval_s, storage=storage,
+            ).start()
+            checkpointers.append(checkpointer)
+        if native_lanes:
+            # All boot-time Python-path mutations (recovery replay,
+            # restore, auction-mode resume) are done: flip directory
+            # authority to the C++ lane engine before any serving loop
+            # can dispatch.
+            runner.adopt_from_python()
+            from matching_engine_tpu.server.dispatcher import (
+                LaneRingDispatcher,
+            )
+
+            dispatcher = LaneRingDispatcher(
+                runner, sink=sink, hub=hub, window_ms=window_ms
+            )
+        elif use_native:
+            dispatcher = NativeRingDispatcher(
+                runner, sink=sink, hub=hub, window_ms=window_ms
+            )
+        else:
+            dispatcher = BatchDispatcher(runner, sink=sink, hub=hub,
+                                         window_ms=window_ms)
     if log:
         layer = ("native lanes (C++ build+decode)" if native_lanes
                  else "native (C++)" if use_native else "python")
+        if serve_shards > 1:
+            layer += f" x {serve_shards} partitioned lanes"
         print(f"[SERVER] runtime layer: {layer}")
-    service = MatchingEngineService(runner, dispatcher, hub, metrics, log=log)
+    service = MatchingEngineService(runner, dispatcher, hub, metrics,
+                                    log=log, shards=shards)
 
     server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
     add_matching_engine_servicer(service, server)
@@ -304,7 +420,11 @@ def build_server(
         gateway = me_native.NativeGateway(gateway_addr)
         bridge = GatewayBridge(
             gateway, runner, service, sink=sink, hub=hub, window_ms=window_ms,
-            native_lanes=native_lanes,
+            # Venue-wide pop cap: with shards, runner is ONE lane whose
+            # cfg is the K-way split — sizing the batch from it would
+            # shrink every gateway pop by K.
+            max_batch=cfg.num_symbols * cfg.batch,
+            native_lanes=native_lanes, shards=shards,
         )
         gateway_port = bridge.start()
         if log:
@@ -314,6 +434,7 @@ def build_server(
         "storage": storage, "sink": sink, "hub": hub,
         "dispatcher": dispatcher, "runner": runner, "service": service,
         "metrics": metrics, "checkpointer": checkpointer,
+        "checkpointers": checkpointers, "shards": shards,
         "bridge": bridge, "gateway_port": gateway_port,
         "recorder": recorder, "sequencer": sequencer,
     }
@@ -327,7 +448,10 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     if parts.get("bridge") is not None:
         parts["bridge"].close()
     parts["hub"].close_all()
-    parts["dispatcher"].close()
+    if parts.get("shards") is not None:
+        parts["shards"].close()  # every lane's dispatcher + the sampler
+    else:
+        parts["dispatcher"].close()
     if parts.get("sequencer") is not None:
         # Drain the spill flusher (completes any in-flight gap-fill
         # window and leaves a forensic record of the tail). The store —
@@ -335,12 +459,14 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
         # epoch dir and purges this one; clients resuming across the
         # restart observe an epoch rebase, not a replay.
         parts["sequencer"].flush_spill()
-    if parts.get("checkpointer") is not None:
+    for ckpt in (parts.get("checkpointers")
+                 or ([parts["checkpointer"]] if parts.get("checkpointer")
+                     else [])):
         try:
-            parts["checkpointer"].checkpoint_now()
+            ckpt.checkpoint_now()
         except Exception as e:  # a failed final snapshot must not block drain
             print(f"[SERVER] final checkpoint failed: {type(e).__name__}: {e}")
-        parts["checkpointer"].close()
+        ckpt.close()
     parts["sink"].close()
     parts["storage"].close()
     if parts.get("recorder") is not None:
@@ -442,6 +568,15 @@ def main(argv=None) -> int:
                    help="per-subscriber stream queue depth; overflow drops "
                         "oldest (counted as stream_dropped_events, "
                         "recoverable via the sequenced feed)")
+    p.add_argument("--serve-shards", type=int, default=1, metavar="K",
+                   help="partition serving into K independent symbol-"
+                        "sharded lanes (server/shards.py): a symbol->shard "
+                        "router at the edge, one ring+dispatcher+runner "
+                        "column per shard (each pinned to its own device "
+                        "when several are visible), strided order-id "
+                        "allocation, per-lane checkpoints under "
+                        "<dir>/shard-<i>. K must divide --symbols; "
+                        "incompatible with --mesh (1 = off)")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard the symbol axis over an N-device mesh "
                         "(0 = single device); N must divide --symbols")
@@ -481,6 +616,22 @@ def main(argv=None) -> int:
         print("[SERVER] --native-lanes is single-device and needs the "
               "native runtime (drop --mesh/--no-native)", file=sys.stderr)
         return 3
+    if args.serve_shards > 1:
+        if mesh is not None:
+            print("[SERVER] --serve-shards partitions host serving; it is "
+                  "incompatible with --mesh (the ShardedEngine path)",
+                  file=sys.stderr)
+            return 3
+        if args.symbols % args.serve_shards != 0:
+            print(f"[SERVER] --symbols {args.symbols} not divisible by "
+                  f"--serve-shards {args.serve_shards}", file=sys.stderr)
+            return 3
+        if args.native_lanes and args.gateway_addr is not None:
+            print("[SERVER] the C++ gateway's native-lane drain is "
+                  "single-lane; with --serve-shards use the gateway's "
+                  "python dispatch route (drop --native-lanes) or the "
+                  "grpcio edge", file=sys.stderr)
+            return 3
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
                        batch=args.batch, kernel=args.engine_kernel)
@@ -501,18 +652,22 @@ def main(argv=None) -> int:
             feed_depth=args.feed_depth,
             feed_spill_dir=args.feed_spill_dir,
             stream_maxsize=args.stream_queue,
+            serve_shards=args.serve_shards,
         )
     except SystemExit as e:
         return int(e.code or 3)
 
     if args.auction_open:
+        # A call period is venue-wide: with partitioned serving it opens
+        # on every lane (ServingShards fans the flip out).
+        target = parts.get("shards") or parts["runner"]
         try:
-            parts["runner"].set_auction_mode(True)
+            target.set_auction_mode(True)
         except ValueError as e:  # venue-depth capacity: no call periods
             print(f"[SERVER] --auction-open refused: {e}", file=sys.stderr)
             shutdown(server, parts)
             return 3
-        parts["runner"].flush_auction_mode()
+        target.flush_auction_mode()
         print("[SERVER] auction call period OPEN (submits rest unmatched "
               "until an all-symbols RunAuction)")
 

@@ -104,9 +104,14 @@ def publish_native_result(result: NativeDispatchResult, sink, hub,
             hub.publish_order_updates(result.order_updates)
             hub.publish_market_data(result.market_data)
     except Exception as e:  # noqa: BLE001 — a sink/hub failure must never
-        # strand the batch's completions or kill the drain loop.
+        # strand the batch's completions or kill the drain loop. Counter
+        # at batch rate, log line rate-limited (see dispatcher twin).
+        from matching_engine_tpu.utils.obs import warn_rate_limited
+
         metrics.inc("sink_publish_errors")
-        print(f"[native-lanes] sink/hub error: {type(e).__name__}: {e}")
+        warn_rate_limited(
+            "native-lanes-sink",
+            f"[native-lanes] sink/hub error: {type(e).__name__}: {e}")
 
 
 class NativeLanesRunner(EngineRunner):
@@ -115,11 +120,19 @@ class NativeLanesRunner(EngineRunner):
     over much larger dispatches and keeps dense batches)."""
 
     def __init__(self, cfg: EngineConfig, metrics=None, hub=None,
-                 pipeline_inflight: int = 2):
+                 pipeline_inflight: int = 2, oid_offset: int = 0,
+                 oid_stride: int = 1, device=None, owns_filter=None):
         super().__init__(cfg, metrics, mesh=None, hub=hub,
-                         pipeline_inflight=pipeline_inflight)
+                         pipeline_inflight=pipeline_inflight,
+                         oid_offset=oid_offset, oid_stride=oid_stride,
+                         device=device, owns_filter=owns_filter)
         self.lanes = me_native.NativeLanes(
             cfg.num_symbols, cfg.batch, fill_inline_count(cfg), cfg.max_fills)
+        if self.oid_stride != 1:
+            # The C++ engine owns hot-path OID allocation in this mode;
+            # adopt() seeds next_oid onto this lane's residue class and
+            # the stride keeps every subsequent allocation on it.
+            self.lanes.set_oid_stride(self.oid_stride)
         self.native_lanes = True
         # Until the first adopt, the PYTHON directories are authoritative
         # (boot recovery/restore mutates them directly, engine_runner
@@ -249,6 +262,7 @@ class NativeLanesRunner(EngineRunner):
         self.metrics.inc("dispatches")
         self.metrics.inc("engine_ops", aux["counters"].get("engine_ops", 0))
         self.metrics.inc("fills", aux["counters"].get("fill_count", 0))
+        self.ops_dispatched += aux["counters"].get("engine_ops", 0)
         if staged.timeline is not None:
             staged.timeline.stamp_decode()
             staged.timeline.counters = dict(aux["counters"])

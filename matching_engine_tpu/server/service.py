@@ -51,16 +51,36 @@ class MatchingEngineService(MatchingEngineServicer):
         hub: StreamHub,
         metrics: Metrics | None = None,
         log: bool = True,
+        shards=None,  # server/shards.ServingShards | None
     ):
         self.runner = runner
         self.dispatcher = dispatcher
         self.hub = hub
         self.metrics = metrics or runner.metrics
         self.log = log
+        # Partitioned serving (server/shards.py): requests route to one of
+        # K independent lanes — submits/books by symbol shard, cancels/
+        # amends by the order id's birth lane. self.runner/self.dispatcher
+        # stay lane 0 for the shard-agnostic surfaces (metrics, streams).
+        self.shards = shards
 
     def _log(self, msg: str) -> None:
         if self.log:
             print(f"[SERVER] {msg}")
+
+    # -- shard routing -----------------------------------------------------
+
+    def _lane_for_symbol(self, symbol: str):
+        if self.shards is None:
+            return self.runner, self.dispatcher
+        lane = self.shards.lane_for_symbol(symbol)
+        return lane.runner, lane.dispatcher
+
+    def _lane_for_order(self, order_id: str):
+        if self.shards is None:
+            return self.runner, self.dispatcher
+        lane = self.shards.lane_for_order(order_id)
+        return lane.runner, lane.dispatcher
 
     # -- SubmitOrder -------------------------------------------------------
 
@@ -86,17 +106,21 @@ class MatchingEngineService(MatchingEngineServicer):
             f"peer={context.peer() if context else '-'}"
         )
 
+        # Symbol-shard routing happens before any state is touched: every
+        # check and allocation below runs against the one lane that owns
+        # this symbol (the single-lane server routes to itself).
+        runner, dispatcher = self._lane_for_symbol(request.symbol)
         err = validate_submit(request)
         otype = collapse_otype(request.order_type, request.tif)
         if err is None and otype is None:
             err = "unsupported (order_type, tif) combination"
-        native = getattr(self.dispatcher, "native_lanes", False)
+        native = getattr(dispatcher, "native_lanes", False)
         if err is None and native:
             # Native lane path: proto validation stays here; the host
             # checks (auction mode, slot capacity) and id/handle/slot
             # assignment run inside the C++ dispatch, atomic with the
             # RunAuction mode flip. One wide record crosses per op.
-            if not self.runner.owns_symbol(request.symbol):
+            if not runner.owns_symbol(request.symbol):
                 err = f"symbol {request.symbol} is homed on another host"
             else:
                 price_q4 = (
@@ -104,20 +128,20 @@ class MatchingEngineService(MatchingEngineServicer):
                     else normalize_to_q4(request.price, request.scale)
                 )
                 return self._finish_submit_native(
-                    request, t0, otype, price_q4)
-        if (err is None and self.runner.auction_mode
+                    request, t0, otype, price_q4, dispatcher)
+        if (err is None and runner.auction_mode
                 and otype != pb2.LIMIT):
             # MARKET/IOC/FOK all demand immediate execution; a call period
             # has no continuous matching to execute against.
             err = ("only GTC LIMIT orders are accepted during an auction "
                    "call period")
-        if err is None and not self.runner.owns_symbol(request.symbol):
+        if err is None and not runner.owns_symbol(request.symbol):
             # Multi-process routing invariant: the client (or front-end
             # router) must send this symbol to its home host.
             err = f"symbol {request.symbol} is homed on another host"
         # slot_acquire also counts one live order on the slot, so the slot
         # cannot be recycled between this validation and the dispatch.
-        if err is None and self.runner.slot_acquire(request.symbol) is None:
+        if err is None and runner.slot_acquire(request.symbol) is None:
             err = "symbol capacity exhausted (engine symbol axis is full)"
         if err is not None:
             self.metrics.inc("orders_rejected")
@@ -128,13 +152,13 @@ class MatchingEngineService(MatchingEngineServicer):
             0 if request.order_type == pb2.MARKET
             else normalize_to_q4(request.price, request.scale)
         )
-        oid_num, order_id = self.runner.assign_oid()
+        oid_num, order_id = runner.assign_oid()
         info = OrderInfo(
             oid=oid_num, order_id=order_id, client_id=request.client_id,
             symbol=request.symbol, side=request.side,
             otype=otype, price_q4=price_q4,
             quantity=request.quantity, remaining=request.quantity, status=0,
-            handle=self.runner.assign_handle(),
+            handle=runner.assign_handle(),
         )
         # Edge-ingress stage: RPC entry -> queue push (validation, id
         # assignment, OrderInfo build). The queue-wait stage picks up at
@@ -145,10 +169,10 @@ class MatchingEngineService(MatchingEngineServicer):
             # Always OP_SUBMIT here: auction-mode classification happens
             # in the runner under the dispatch lock (atomic with the
             # RunAuction mode flip; the edge read would race).
-            outcome = self.dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
+            outcome = dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
         except RingFull:
             # Known-unqueued: the device never saw this op, recycle now.
-            self.runner.release_unqueued(info)
+            runner.release_unqueued(info)
             self.metrics.inc("orders_rejected")
             self._log(f"reject {order_id}: op ring full")
             return pb2.OrderResponse(
@@ -182,17 +206,20 @@ class MatchingEngineService(MatchingEngineServicer):
         )
         return pb2.OrderResponse(order_id=order_id, success=True)
 
-    def _finish_submit_native(self, request, t0, otype, price_q4):
+    def _finish_submit_native(self, request, t0, otype, price_q4,
+                              dispatcher=None):
         """SubmitOrder tail on the lane path (LaneRingDispatcher): the
         accept/reject metrics come from the dispatch's aux counters."""
         from matching_engine_tpu.server.dispatcher import RingFull
 
+        if dispatcher is None:
+            dispatcher = self.dispatcher
         # Same edge-ingress stage as the Python path: RPC entry -> ring
         # push (proto validation + record pack happen per op either way).
         self.metrics.observe(
             STAGE_EDGE_INGRESS, (time.perf_counter() - t0) * 1e6)
         try:
-            outcome = self.dispatcher.submit_record(
+            outcome = dispatcher.submit_record(
                 1, side=request.side, otype=otype, price_q4=price_q4,
                 quantity=request.quantity, symbol=request.symbol.encode(),
                 client_id=request.client_id.encode(),
@@ -228,9 +255,10 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="client_id is required",
             )
-        if getattr(self.dispatcher, "native_lanes", False):
-            return self._cancel_native(request)
-        info = self.runner.orders_by_id.get(request.order_id)
+        runner, dispatcher = self._lane_for_order(request.order_id)
+        if getattr(dispatcher, "native_lanes", False):
+            return self._cancel_native(request, dispatcher)
+        info = runner.orders_by_id.get(request.order_id)
         if info is None:
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False,
@@ -242,7 +270,7 @@ class MatchingEngineService(MatchingEngineServicer):
                 error_message="order belongs to a different client",
             )
         try:
-            outcome = self.dispatcher.submit(
+            outcome = dispatcher.submit(
                 EngineOp(OP_CANCEL, info, cancel_requester=request.client_id)
             ).result(timeout=30)
         except RingFull:
@@ -278,19 +306,21 @@ class MatchingEngineService(MatchingEngineServicer):
             return "order belongs to a different client"
         return None
 
-    def _cancel_native(self, request):
+    def _cancel_native(self, request, dispatcher=None):
         """CancelOrder tail on the lane path: the directory lookup and
         ownership check run natively inside the dispatch (accept/cancel
         metrics come from the dispatch's aux counters, same as the Python
         finalize — no per-RPC increment here)."""
         from matching_engine_tpu.server.dispatcher import RingFull
 
+        if dispatcher is None:
+            dispatcher = self.dispatcher
         err = self._target_fits_record(request)
         if err is not None:
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False, error_message=err)
         try:
-            outcome = self.dispatcher.submit_record(
+            outcome = dispatcher.submit_record(
                 2, order_id=request.order_id.encode(),
                 client_id=request.client_id.encode(),
             ).result(timeout=30)
@@ -329,9 +359,10 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="new_quantity must be positive",
             )
-        if getattr(self.dispatcher, "native_lanes", False):
-            return self._amend_native(request)
-        info = self.runner.orders_by_id.get(request.order_id)
+        runner, dispatcher = self._lane_for_order(request.order_id)
+        if getattr(dispatcher, "native_lanes", False):
+            return self._amend_native(request, dispatcher)
+        info = runner.orders_by_id.get(request.order_id)
         if info is None:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False,
@@ -343,7 +374,7 @@ class MatchingEngineService(MatchingEngineServicer):
                 error_message="order belongs to a different client",
             )
         try:
-            outcome = self.dispatcher.submit(
+            outcome = dispatcher.submit(
                 EngineOp(OP_AMEND, info, amend_qty=request.new_quantity)
             ).result(timeout=30)
         except RingFull:
@@ -367,18 +398,20 @@ class MatchingEngineService(MatchingEngineServicer):
             error_message=outcome.error or "amend rejected",
         )
 
-    def _amend_native(self, request):
+    def _amend_native(self, request, dispatcher=None):
         """AmendOrder tail on the lane path: lookup/ownership/reduction
         checks run natively; `new_quantity` rides the record's quantity
         field (me_lanes.cpp kOpAmend)."""
         from matching_engine_tpu.server.dispatcher import RingFull
 
+        if dispatcher is None:
+            dispatcher = self.dispatcher
         err = self._target_fits_record(request)
         if err is not None:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False, error_message=err)
         try:
-            outcome = self.dispatcher.submit_record(
+            outcome = dispatcher.submit_record(
                 3, quantity=request.new_quantity,
                 order_id=request.order_id.encode(),
                 client_id=request.client_id.encode(),
@@ -407,7 +440,8 @@ class MatchingEngineService(MatchingEngineServicer):
 
     def GetOrderBook(self, request, context):
         self.metrics.inc("rpc_book")
-        bids, asks = self.runner.book_snapshot(request.symbol)
+        runner, _ = self._lane_for_symbol(request.symbol)
+        bids, asks = runner.book_snapshot(request.symbol)
 
         def msg(info, qty):
             return pb2.Order(
@@ -543,14 +577,23 @@ class MatchingEngineService(MatchingEngineServicer):
         application-level (success=false + message, gRPC OK) — the
         SubmitOrder reject convention."""
         symbol = request.symbol or None
-        if symbol is not None and not self.runner.owns_symbol(symbol):
-            return pb2.AuctionResponse(
-                success=False,
-                error_message=f"symbol {symbol} is homed on another host",
-            )
-        self._log(f"auction {'ALL' if symbol is None else symbol}")
-        summary = self.runner.run_auction(
-            [symbol] if symbol else None, sink=self.dispatcher.sink)
+        if self.shards is not None:
+            # Partitioned serving: one symbol touches only its owning
+            # lane; the all-symbols close fans out across every lane and
+            # merges the per-lane all-or-nothing summaries.
+            self._log(f"auction {'ALL' if symbol is None else symbol} "
+                      f"(across {self.shards.num_shards} lanes)")
+            summary = self.shards.run_auction(
+                [symbol] if symbol else None)
+        else:
+            if symbol is not None and not self.runner.owns_symbol(symbol):
+                return pb2.AuctionResponse(
+                    success=False,
+                    error_message=f"symbol {symbol} is homed on another host",
+                )
+            self._log(f"auction {'ALL' if symbol is None else symbol}")
+            summary = self.runner.run_auction(
+                [symbol] if symbol else None, sink=self.dispatcher.sink)
         if summary["error"]:
             return pb2.AuctionResponse(success=False,
                                        error_message=summary["error"])

@@ -1,0 +1,371 @@
+"""Partitioned serving: a symbol→shard router over K independent lanes.
+
+The device kernel matches ~2B orders/s, but one dispatcher thread driving
+one runner caps the serving stack at single-thread Python speed — and
+nothing in the serving path could use more than one chip's dispatch lane
+(MULTICHIP artifacts recorded no serving number at all). Books are
+independent per symbol (the premise of the vmap'd struct-of-array
+design), so the symbol space is cut into K disjoint shards, each owning
+``num_symbols/K`` engine rows, the way CoinTossX shards its matching
+across instruments:
+
+    edge (grpcio / C++ gateway)
+      └─ ShardRouter: symbol ──crc32──▶ shard  (cancels/amends route by
+         the order id's strided residue, falling back to a directory
+         probe for ids recovered from a different shard count)
+            ├─ lane 0: ring → dispatcher thread → EngineRunner → device 0
+            ├─ lane 1: ring → dispatcher thread → EngineRunner → device 1
+            ⋮      (embarrassingly parallel: no locks, no collectives
+            └─ lane K-1     between lanes on the hot path)
+
+Every single-owner assumption in the single-lane stack becomes a
+per-lane invariant; the explicit cross-lane aggregation points are:
+
+- **Order IDs**: lane i allocates the strided residue class
+  {i+1, i+1+K, ...} (EngineRunner.oid_offset/oid_stride; the C++ lane
+  engine mirrors the stride), so "OID-<n>" stays globally unique with no
+  cross-lane lock and ``(n-1) % K`` recovers the birth lane.
+- **Streams/feed**: all lanes publish into ONE StreamHub/FeedSequencer —
+  both are internally locked, and seq domains are per-(channel, key), so
+  a client's order-update stream fans in across lanes with a gapless
+  per-key seq line (tests/test_serve_shards.py proves it under
+  concurrent lane publish).
+- **Storage**: one shared sink; rows from all lanes serialize in its
+  writer. The durable store is shard-agnostic (recovery re-routes rows
+  by symbol), so a store written at any K restores at any other K.
+- **Book views / auctions**: GetOrderBook routes to the one lane owning
+  the symbol; an all-symbols RunAuction fans out to every lane and
+  merges the per-lane summaries (per-lane all-or-nothing, mirroring the
+  mesh path's per-shard abort semantics).
+- **Checkpoints**: one CheckpointDaemon per lane under
+  ``<root>/shard-<i>/`` (wired by build_server), restored per lane.
+
+The ``ShardedEngine`` mesh path (parallel/sharding.py) is unchanged and
+remains the market-wide-view/auction formulation; serving shards are the
+host-parallel cut — with multiple visible devices each lane's books pin
+to its own chip, so host parallelism and multi-chip serving fall out of
+the same partition.
+
+Known residual: STP owner ids are assigned per lane at first sight.
+Deterministic hashing keeps lanes agreed except when two NEW
+hash-colliding client ids first appear on different lanes in the same
+boot — the collision counter fires and the persisted registry reconciles
+at the next boot (all lanes preload it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from matching_engine_tpu.parallel.multihost import symbol_home
+from matching_engine_tpu.utils.metrics import Metrics
+
+
+class ShardRouter:
+    """Deterministic symbol→shard mapping (the same stable CRC32 hash as
+    multi-host symbol homing, so a front-end router can compute it too).
+    """
+
+    __slots__ = ("num_shards",)
+
+    def __init__(self, num_shards: int):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = num_shards
+
+    def shard_of(self, symbol: str) -> int:
+        return symbol_home(symbol, self.num_shards)
+
+    def shard_of_order_id(self, order_id: str) -> int | None:
+        """Birth lane of an id allocated under THIS shard count (strided
+        residue); None for foreign/garbled ids — callers fall back to a
+        directory probe (ids recovered from a store written at another
+        shard count live on their symbol's lane, not their residue's)."""
+        if not order_id.startswith("OID-"):
+            return None
+        try:
+            n = int(order_id[4:])
+        except ValueError:
+            return None
+        if n < 1:
+            return None
+        return (n - 1) % self.num_shards
+
+
+class ServingLane:
+    """One shard's serving column: runner + its dispatcher (+ optional
+    checkpoint daemon, attached by build_server)."""
+
+    __slots__ = ("shard_id", "runner", "dispatcher", "checkpointer")
+
+    def __init__(self, shard_id: int, runner, dispatcher=None):
+        self.shard_id = shard_id
+        self.runner = runner
+        self.dispatcher = dispatcher
+        self.checkpointer = None
+
+    def backlog(self) -> int:
+        """Host-visible queue depth proxy for this lane: the submitted-
+        but-uncompleted tag map on the native ring edges (their queue
+        lives in C++), else the python dispatch queue."""
+        d = self.dispatcher
+        if d is None:
+            return 0
+        tags = getattr(d, "_tags", None)
+        if tags is not None:
+            return len(tags)
+        q = getattr(d, "_q", None)
+        return q.qsize() if q is not None and hasattr(q, "qsize") else 0
+
+
+class ServingShards:
+    """K serving lanes + the router + the cross-lane aggregation points.
+
+    Lanes share ONE Metrics registry (counters aggregate naturally), ONE
+    StreamHub/FeedSequencer (per-key fan-in), and ONE storage sink. The
+    sampler thread publishes the per-lane balance picture:
+
+    - ``lane<i>_queue_depth`` / ``lane<i>_ops_per_s`` — per-shard series
+      (names carry the shard index; documented in OPERATIONS.md prose),
+    - ``lane_queue_depth_max`` — worst backlog across lanes,
+    - ``lane_dispatch_rate`` — summed lane throughput, orders/s,
+    - ``lane_imbalance`` — max/mean of per-lane rates over the sample
+      window (1.0 = perfectly balanced; K = all load on one lane).
+    """
+
+    def __init__(self, lanes: list[ServingLane], router: ShardRouter,
+                 metrics: Metrics | None = None, sink=None,
+                 sample_interval_s: float = 1.0):
+        if len(lanes) != router.num_shards:
+            raise ValueError("lane count != router shard count")
+        self.lanes = lanes
+        self.router = router
+        self.metrics = metrics or lanes[0].runner.metrics
+        self.sink = sink
+        self._stop = threading.Event()
+        self._sampler = None
+        if sample_interval_s and sample_interval_s > 0:
+            self._interval = sample_interval_s
+            self._sampler = threading.Thread(
+                target=self._sample_loop, name="lane-sampler", daemon=True)
+            self._sampler.start()
+
+    # -- routing -----------------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return self.router.num_shards
+
+    def lane_for_symbol(self, symbol: str) -> ServingLane:
+        return self.lanes[self.router.shard_of(symbol)]
+
+    def lane_for_order(self, order_id: str) -> ServingLane:
+        """Lane owning `order_id`: the strided-residue lane when its
+        directory confirms the id, else a probe across the others (covers
+        ids rebooted in from a different shard count — they live with
+        their symbol). Unknown ids resolve to the residue lane (or lane
+        0), whose dispatch answers "unknown order id" exactly as a
+        single-lane server would."""
+        first = self.router.shard_of_order_id(order_id)
+        order = ([first] if first is not None else []) + [
+            i for i in range(len(self.lanes)) if i != first]
+        for i in order:
+            if self._lane_knows(self.lanes[i], order_id):
+                return self.lanes[i]
+        return self.lanes[first if first is not None else 0]
+
+    @staticmethod
+    def _lane_knows(lane: ServingLane, order_id: str) -> bool:
+        r = lane.runner
+        if getattr(r, "native_lanes", False):
+            return bool(r.lanes.lookup(order_id))
+        return order_id in r.orders_by_id
+
+    # -- cross-lane control plane ------------------------------------------
+
+    @property
+    def auction_mode(self) -> bool:
+        return any(l.runner.auction_mode for l in self.lanes)
+
+    def set_auction_mode(self, value: bool) -> None:
+        for lane in self.lanes:
+            lane.runner.set_auction_mode(value)
+
+    def flush_auction_mode(self) -> None:
+        for lane in self.lanes:
+            lane.runner.flush_auction_mode()
+
+    def flush_owner_ids(self) -> None:
+        for lane in self.lanes:
+            lane.runner.flush_owner_ids()
+
+    def crossed_symbols(self) -> list[str]:
+        out: list[str] = []
+        for lane in self.lanes:
+            out.extend(lane.runner.crossed_symbols())
+        return out
+
+    def run_auction(self, symbols=None, sink=None) -> dict:
+        """Auction across lanes. With `symbols` the uncross touches only
+        the lanes owning them; None = every lane (the all-symbols call-
+        period close). Lanes run sequentially — each uncross holds only
+        its own lane's dispatch lock — and the per-lane summaries merge
+        with per-lane all-or-nothing semantics (a lane that aborts keeps
+        its books untouched and, if open, its call period; the merged
+        request fails only when EVERY touched lane failed)."""
+        sink = sink if sink is not None else self.sink
+        if symbols:
+            by_lane: dict[int, list[str]] = {}
+            for s in symbols:
+                by_lane.setdefault(self.router.shard_of(s), []).append(s)
+            work = [(self.lanes[i], syms) for i, syms in by_lane.items()]
+        else:
+            work = [(lane, None) for lane in self.lanes]
+        crossed: list = []
+        warnings: list[str] = []
+        errors: list[str] = []
+        aborted = False
+        for lane, syms in work:
+            summary = lane.runner.run_auction(syms, sink=sink)
+            crossed.extend(summary["crossed"])
+            aborted = aborted or summary["aborted"]
+            if summary["error"]:
+                errors.append(f"lane {lane.shard_id}: {summary['error']}")
+            if summary.get("warning"):
+                warnings.append(f"lane {lane.shard_id}: {summary['warning']}")
+        if errors and len(errors) == len(work) and not crossed:
+            return {"crossed": [], "aborted": aborted,
+                    "error": "; ".join(errors), "warning": ""}
+        warnings.extend(errors)  # partial failure: success with a warning
+        return {"crossed": crossed, "aborted": aborted, "error": "",
+                "warning": "; ".join(w for w in warnings if w)}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def finish_pending(self) -> None:
+        for lane in self.lanes:
+            lane.runner.finish_pending()
+
+    def close(self) -> None:
+        self._stop.set()
+        for lane in self.lanes:
+            if lane.dispatcher is not None:
+                lane.dispatcher.close()
+        if self._sampler is not None:
+            self._sampler.join(timeout=5)
+
+    # -- the balance sampler -----------------------------------------------
+
+    def _sample_loop(self) -> None:
+        last_ops = [lane.runner.ops_dispatched for lane in self.lanes]
+        last_t = time.perf_counter()
+        while not self._stop.wait(self._interval):
+            last_ops, last_t = self._sample_once(last_ops, last_t)
+
+    def _sample_once(self, last_ops, last_t):
+        """One sampler tick (split out for tests): publish per-lane depth
+        and rate plus the cross-lane aggregates."""
+        now = time.perf_counter()
+        dt = max(1e-9, now - last_t)
+        ops = [lane.runner.ops_dispatched for lane in self.lanes]
+        rates = [(o - lo) / dt for o, lo in zip(ops, last_ops)]
+        depths = [lane.backlog() for lane in self.lanes]
+        m = self.metrics
+        for i, (d, r) in enumerate(zip(depths, rates)):
+            m.set_gauge(f"lane{i}_queue_depth", d)
+            m.set_gauge(f"lane{i}_ops_per_s", r)
+        m.set_gauge("lane_queue_depth_max", max(depths))
+        total = sum(rates)
+        m.set_gauge("lane_dispatch_rate", total)
+        mean = total / len(rates)
+        m.set_gauge("lane_imbalance", max(rates) / mean if mean > 0 else 1.0)
+        return ops, now
+
+
+def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
+                     metrics=None, hub=None, pipeline_inflight: int = 2,
+                     native_lanes: bool = False, devices=None):
+    """One lane's runner over a K-way split of `cfg`: the shard gets
+    ``cfg.num_symbols // K`` engine rows, the strided OID residue class
+    `shard_id`, the shard-ownership filter, and — when more than one
+    device is visible — its own device (round-robin)."""
+    import dataclasses
+
+    import jax
+
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    k = router.num_shards
+    if cfg.num_symbols % k != 0:
+        raise ValueError(
+            f"num_symbols {cfg.num_symbols} not divisible by "
+            f"serve-shards {k}")
+    shard_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // k)
+    devices = devices if devices is not None else jax.devices()
+    device = devices[shard_id % len(devices)] if len(devices) > 1 else None
+    owns = (lambda s, _i=shard_id: router.shard_of(s) == _i)
+    cls = EngineRunner
+    if native_lanes:
+        from matching_engine_tpu.server.native_lanes import NativeLanesRunner
+
+        cls = NativeLanesRunner
+    return cls(shard_cfg, metrics, hub=hub,
+               pipeline_inflight=pipeline_inflight,
+               oid_offset=shard_id, oid_stride=k, device=device,
+               owns_filter=owns)
+
+
+def make_lane_dispatcher(runner, *, sink=None, hub=None,
+                         window_ms: float = 2.0, metrics=None,
+                         native: bool = False, native_lanes: bool = False):
+    """One lane's dispatcher (its own ring + drain thread)."""
+    from matching_engine_tpu.server.dispatcher import (
+        BatchDispatcher,
+        LaneRingDispatcher,
+        NativeRingDispatcher,
+    )
+
+    if native_lanes:
+        return LaneRingDispatcher(runner, sink=sink, hub=hub,
+                                  window_ms=window_ms, metrics=metrics)
+    if native:
+        return NativeRingDispatcher(runner, sink=sink, hub=hub,
+                                    window_ms=window_ms, metrics=metrics)
+    return BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms,
+                           metrics=metrics)
+
+
+def build_serving_shards(
+    cfg,
+    num_shards: int,
+    *,
+    metrics: Metrics | None = None,
+    hub=None,
+    sink=None,
+    window_ms: float = 2.0,
+    pipeline_inflight: int = 2,
+    native: bool = False,
+    native_lanes: bool = False,
+    with_dispatchers: bool = True,
+    sample_interval_s: float = 1.0,
+) -> ServingShards:
+    """Wire K (runner → dispatcher) lanes over a K-way split of `cfg`.
+
+    All lanes share `metrics`, `hub` and `sink`. With `with_dispatchers`
+    False the caller drives dispatch itself (benches/tests)."""
+    metrics = metrics or Metrics()
+    router = ShardRouter(num_shards)
+    lanes: list[ServingLane] = []
+    for i in range(num_shards):
+        runner = make_lane_runner(
+            cfg, router, i, metrics=metrics, hub=hub,
+            pipeline_inflight=pipeline_inflight, native_lanes=native_lanes)
+        dispatcher = None
+        if with_dispatchers:
+            dispatcher = make_lane_dispatcher(
+                runner, sink=sink, hub=hub, window_ms=window_ms,
+                metrics=metrics, native=native, native_lanes=native_lanes)
+        lanes.append(ServingLane(i, runner, dispatcher))
+    return ServingShards(lanes, router, metrics=metrics, sink=sink,
+                         sample_interval_s=sample_interval_s)

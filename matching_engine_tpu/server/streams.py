@@ -187,41 +187,60 @@ class StreamHub:
     def publish_market_data(self, updates: list[pb2.MarketDataUpdate]) -> None:
         if not updates:
             return
-        if self.sequencer is not None:
-            # Stamp + retain BEFORE fan-out: an event is replayable the
-            # instant any subscriber could have seen (or dropped) it.
-            self.sequencer.stamp_market_data(updates)
         with self._lock:
+            if self.sequencer is not None:
+                # Stamp + retain BEFORE fan-out: an event is replayable
+                # the instant any subscriber could have seen (or dropped)
+                # it. Stamping happens INSIDE the hub lock so stamp and
+                # fan-out are atomic across publishers: with K serving
+                # lanes publishing concurrently (server/shards.py), a
+                # later-stamped batch must not reach a subscriber queue
+                # before an earlier-stamped one for the same key — the
+                # inversion would read as a gap and trigger spurious
+                # gap-fills (tests/test_serve_shards.py pins delivery
+                # order). The sequencer lock nests inside; nothing takes
+                # them in the other order.
+                self.sequencer.stamp_market_data(updates)
             for u in updates:
                 for sub in self._md_subs.get(u.symbol, ()):
                     sub.offer(u)
-            self._update_lag_locked()
+            self._update_lag_locked(CHANNEL_MD,
+                                    {u.symbol for u in updates})
 
     def publish_order_updates(self, updates: list[pb2.OrderUpdate]) -> None:
         if not updates:
             return
-        if self.sequencer is not None:
-            self.sequencer.stamp_order_updates(updates)
         with self._lock:
+            if self.sequencer is not None:
+                # Same stamp/fan-out atomicity as publish_market_data.
+                self.sequencer.stamp_order_updates(updates)
             for u in updates:
                 for sub in self._ou_subs.get(u.client_id, ()):
                     sub.offer(u)
-            self._update_lag_locked()
+            self._update_lag_locked(CHANNEL_OU,
+                                    {u.client_id for u in updates})
 
-    def _update_lag_locked(self) -> None:
+    def _update_lag_locked(self, channel: str, keys) -> None:
         """feed_subscriber_lag_max: worst (domain head − last yielded seq)
-        across live subscribers — the backpressure signal that says WHICH
-        side is slow before drops/conflation start. O(subscribers) per
-        publish batch; subscriber counts are small by design."""
+        across subscribers of the keys THIS batch touched — the
+        backpressure signal that says WHICH side is slow before drops/
+        conflation start. Scanning every subscribed key here (under the
+        hub lock, per publish batch — the path every serving lane
+        serializes through) would grow per-dispatch cost with subscriber
+        count; an untouched key's head is static, so its lag can only
+        shrink while it goes unsampled — the gauge stays a faithful
+        worst-case at its next publish."""
         if self.sequencer is None or self._metrics is None:
             return
+        table = self._md_subs if channel == CHANNEL_MD else self._ou_subs
         lag = 0
-        for table, channel in ((self._md_subs, CHANNEL_MD),
-                               (self._ou_subs, CHANNEL_OU)):
-            for key, subs in table.items():
-                head = self.sequencer.last_seq(channel, key)
-                for s in subs:
-                    lag = max(lag, head - s.last_seq)
+        for key in keys:
+            subs = table.get(key)
+            if not subs:
+                continue
+            head = self.sequencer.last_seq(channel, key)
+            for s in subs:
+                lag = max(lag, head - s.last_seq)
         self._metrics.set_gauge("feed_subscriber_lag_max", lag)
 
     def close_all(self) -> None:

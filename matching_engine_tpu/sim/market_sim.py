@@ -27,6 +27,8 @@ import dataclasses
 from typing import NamedTuple
 
 import jax
+
+from matching_engine_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 
 from matching_engine_tpu.engine.book import BookBatch, EngineConfig, OrderBatch, init_book
@@ -298,7 +300,7 @@ def run_sim_sharded(
             local_cfg, scfg, steps, False, book, state, axis=AXIS)
         return book, state, stats
 
-    mapped = jax.jit(jax.shard_map(
+    mapped = jax.jit(shard_map(
         local_run,
         mesh=mesh,
         in_specs=(_book_specs(), state_specs),

@@ -1,0 +1,36 @@
+"""Version-skew shims for the JAX APIs this repo relies on.
+
+The mesh path was written against the promoted `jax.shard_map` (jax >=
+0.5, `check_vma=` keyword). Older runtimes (0.4.x, like this
+environment's 0.4.37) only ship `jax.experimental.shard_map.shard_map`
+with the pre-rename `check_rep=` keyword — same semantics, different
+spelling. Every shard_map call site goes through this wrapper so the
+mesh/sharding stack (ShardedEngine, the sharded sim, the multi-process
+servers) runs identically on both families instead of dying with
+AttributeError at ShardedEngine construction.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """`jax.shard_map` with the 0.4.x experimental fallback.
+
+    `check_vma` follows the new spelling; on old JAX it maps onto
+    `check_rep` (the same replication/varying-manual-axes check under its
+    pre-promotion name). None = each version's default.
+    """
+    kwargs = {}
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kwargs)
+    from jax.experimental.shard_map import shard_map as esm
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -143,6 +143,28 @@ class DispatchTimeline:
             recorder.dump_on_error()
 
 
+_warn_lock = threading.Lock()
+_warn_last: dict[str, float] = {}
+_warn_suppressed: dict[str, int] = {}
+
+
+def warn_rate_limited(key: str, msg: str, interval_s: float = 5.0) -> None:
+    """Print `msg` at most once per `interval_s` per `key`, with a count
+    of the lines suppressed in between. A flapping sink/hub fails at
+    BATCH rate — per-failure print() would melt stdout exactly when the
+    operator needs it; the paired `me_` counter carries the true rate."""
+    now = time.monotonic()
+    with _warn_lock:
+        last = _warn_last.get(key, 0.0)
+        if now - last < interval_s:
+            _warn_suppressed[key] = _warn_suppressed.get(key, 0) + 1
+            return
+        suppressed = _warn_suppressed.pop(key, 0)
+        _warn_last[key] = now
+    tail = f" (+{suppressed} suppressed)" if suppressed else ""
+    print(f"{msg}{tail}")
+
+
 def record_dispatch_error(metrics, where: str, error: Exception) -> None:
     """Flight-record a drain-loop failure that never made it to a
     timeline (pop/stage machinery raised) and dump a post-mortem."""

@@ -386,7 +386,8 @@ class MeLanes {
                "symbol capacity exhausted (engine symbol axis is full)"});
           continue;
         }
-        long long oidn = next_oid_++;
+        long long oidn = next_oid_;
+        next_oid_ += oid_stride_;
         int32_t h = alloc_handle();
         if (h < 0) return -1;
         auto info = std::make_shared<LaneOrder>();
@@ -1056,6 +1057,11 @@ class MeLanes {
     auction_mode_ = v != 0;
   }
 
+  void set_oid_stride(long long stride) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stride > 0) oid_stride_ = stride;
+  }
+
   // Install the Python runner's state (boot migration, and the resync
   // after a Python-side control-plane mutation such as an auction).
   // Blob layout built by native.__init__.pack_lane_state; REPLACES all
@@ -1274,6 +1280,10 @@ class MeLanes {
   std::unordered_map<int32_t, OrderPtr> by_handle_;
   std::unordered_map<long long, OrderPtr> by_oid_;
   long long next_oid_ = 1;
+  // Partitioned serving: lane i of K allocates the strided residue class
+  // (adopt() seeds next_oid_ onto it; this keeps it there). Default 1 ==
+  // the dense single-lane line.
+  long long oid_stride_ = 1;
   int32_t next_handle_ = 1;
   std::vector<int32_t> free_handles_;
   std::map<std::string, int32_t> symbols_;
@@ -1434,6 +1444,10 @@ int me_lanes_evict(void* h, int32_t handle, int32_t* released_slot) {
 
 void me_lanes_set_auction_mode(void* h, int v) {
   if (h) static_cast<MeLanes*>(h)->set_auction_mode(v);
+}
+
+void me_lanes_set_oid_stride(void* h, long long stride) {
+  if (h) static_cast<MeLanes*>(h)->set_oid_stride(stride);
 }
 
 int me_lanes_adopt(void* h, const uint8_t* buf, long long len) {

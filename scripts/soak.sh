@@ -160,6 +160,70 @@ done
 grep -q "^me_stage_queue_wait_us_p99" "$METRICS_OUT" \
   || { echo "FAIL: stage ledger absent from /metrics scrapes"; exit 1; }
 
+# ---- sharded round: K=2 partitioned serving lanes -------------------------
+# Boots a second server with --serve-shards 2 on a fresh store, reuses the
+# per-round bench + sequenced subscriber + metrics scrape, then fails the
+# round on ANY cross-lane order-id collision in the durable store (the
+# strided-allocation invariant) or on missing per-lane metrics.
+SH_DB="$WORK/soak_sharded.db"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$SH_DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --serve-shards 2 --metrics-port 0 \
+  ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_sharded.log" 2>&1 &
+SH_SRV=$!
+trap 'kill $SRV $SH_SRV 2>/dev/null' EXIT
+SH_PY=""; SH_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  SH_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_sharded.log" | head -1)
+  SH_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_sharded.log" | head -1)
+  [ -n "$SH_PY" ] && [ -n "$SH_OBS" ] && break
+  kill -0 $SH_SRV 2>/dev/null || { echo "FAIL: sharded server died at boot"; tail -5 "$WORK/server_sharded.log"; exit 1; }
+  sleep 1
+done
+[ -n "$SH_PY" ] && [ -n "$SH_OBS" ] || { echo "FAIL: sharded server ports never appeared"; exit 1; }
+SH_FEED="$FEED_DIR/sharded.json"
+python -m matching_engine_tpu.client.cli subscribe "127.0.0.1:$SH_PY" \
+  md SOAK --idle-exit 60 --quiet \
+  --summary-json "$SH_FEED" >/dev/null 2>"$FEED_DIR/sharded.err" &
+SH_FEED_PID=$!
+SH_OK=$("$CLI" bench "127.0.0.1:$SH_PY" 8 100 12 4 2>/dev/null \
+  | python -c "import json,sys
+try: print(json.loads(sys.stdin.read())['ok'])
+except Exception: print(0)")
+python - "$SH_OBS" >> "$METRICS_OUT" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-sharded {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+kill -INT $SH_FEED_PID 2>/dev/null || true
+wait $SH_FEED_PID; SH_FEED_RC=$?
+if [ "$SH_FEED_RC" -eq 4 ]; then
+  echo "FAIL: unrecovered feed gap in the sharded round"
+  cat "$FEED_DIR/sharded.err"; exit 1
+fi
+kill $SH_SRV 2>/dev/null; wait $SH_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+[ "$SH_OK" -gt 0 ] || { echo "FAIL: sharded round served no orders"; exit 1; }
+grep -q "^me_lane_dispatch_rate" "$METRICS_OUT" \
+  || { echo "FAIL: me_lane_* metrics absent from the sharded scrape"; exit 1; }
+SH_COLLISIONS=$(python - "$SH_DB" <<'EOF'
+import sqlite3, sys
+con = sqlite3.connect(sys.argv[1])
+n = con.execute("SELECT COUNT(*) FROM (SELECT order_id FROM orders "
+                "GROUP BY order_id HAVING COUNT(*) > 1)").fetchone()[0]
+print(n)
+EOF
+)
+SH_COLLISIONS=$(echo "$SH_COLLISIONS" | tail -1 | tr -d '[:space:]')
+[ "$SH_COLLISIONS" = "0" ] \
+  || { echo "FAIL: $SH_COLLISIONS cross-lane order-id collision(s) in the sharded store"; exit 1; }
+
 sleep 2
 AUDIT=$(python - "$DB" <<'EOF'
 import sys
@@ -197,6 +261,8 @@ artifact = {
     "feed": {"events": $FEED_EVENTS, "gaps_detected": $FEED_GAPS,
              "gap_filled_events": $FEED_FILLED,
              "max_subscriber_lag": max_lag},
+    "sharded_round": {"serve_shards": 2, "orders_ok": $SH_OK,
+                      "id_collisions": int("$SH_COLLISIONS" or -1)},
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))

@@ -415,3 +415,23 @@ def test_operations_doc_metric_table_matches_registry():
     documented = {n for n, t in rows if t == "histogram"}
     undocumented = [s for s in obs_module.STAGES if s not in documented]
     assert not undocumented, f"stages missing from the table: {undocumented}"
+
+
+def test_warn_rate_limited_suppresses_and_counts(capsys):
+    """publish_result's sink/hub failure path logs through this: one
+    line per interval per key, with the suppressed count folded into
+    the next emission — a flapping sink fails at batch rate and must
+    not print at batch rate."""
+    from matching_engine_tpu.utils import obs as obs_mod
+
+    key = f"test-key-{os.getpid()}"
+    for _ in range(50):
+        obs_mod.warn_rate_limited(key, "boom", interval_s=3600)
+    out = capsys.readouterr().out
+    assert out.count("boom") == 1
+    # Force the window open: the next emission carries the count.
+    with obs_mod._warn_lock:
+        obs_mod._warn_last[key] = 0.0
+    obs_mod.warn_rate_limited(key, "boom", interval_s=3600)
+    out = capsys.readouterr().out
+    assert "(+49 suppressed)" in out

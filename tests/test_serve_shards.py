@@ -1,0 +1,526 @@
+"""Partitioned serving (server/shards.py): K-lane parity and fan-in.
+
+The shard cut must be INVISIBLE per symbol: a symbol's ops all land on
+one lane in stream order, so matching, statuses, storage rows and the
+final book for that symbol must be bit-identical whether the market runs
+as one lane or K — only order-id NUMBERS differ (strided allocation),
+so every surface is compared after normalizing ids back to the
+generating stream's tags. Proven for the python serving path and the
+C++ lane engine (--native-lanes), mirroring tests/test_native_lanes.py.
+
+Also here: strided-OID allocator unit tests (uniqueness + storage
+reseed rounding), the concurrent-lane feed invariant (per-(channel,key)
+seq lines stay gapless when K dispatcher threads publish into one
+sequenced hub at once), and a full-stack sharded-server e2e including a
+restart at a DIFFERENT shard count over the same durable store.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import (
+    OP_AMEND,
+    OP_CANCEL,
+    OP_SUBMIT,
+)
+from matching_engine_tpu.server.engine_runner import (
+    EngineOp,
+    EngineRunner,
+    OrderInfo,
+)
+from matching_engine_tpu.server.shards import (
+    ShardRouter,
+    make_lane_runner,
+)
+from matching_engine_tpu.server.streams import StreamHub
+
+SYMS = 8          # global symbol namespace of the fuzz market
+CFG = dict(capacity=16, batch=4, max_fills=1 << 12)
+
+
+def make_cfg(kernel: str = "matrix") -> EngineConfig:
+    return EngineConfig(num_symbols=SYMS, kernel=kernel, **CFG)
+
+
+# -- strided OID allocation --------------------------------------------------
+
+
+def test_oid_stride_uniqueness_and_reseed():
+    cfg = make_cfg()
+    router = ShardRouter(4)
+    runners = [make_lane_runner(cfg, router, i) for i in range(4)]
+    seen = set()
+    for r in runners:
+        for _ in range(50):
+            n, oid = r.assign_oid()
+            assert oid == f"OID-{n}"
+            assert (n - 1) % 4 == r.oid_offset
+            assert n not in seen
+            seen.add(n)
+    # Reseed from a store whose max oid belongs to ANY residue class:
+    # each lane rounds up to its own class, past the seed.
+    for r in runners:
+        r.seed_oid_sequence(1000)
+        n, _ = r.assign_oid()
+        assert n >= 1000
+        assert (n - 1) % 4 == r.oid_offset
+        assert n not in seen
+        seen.add(n)
+
+
+def test_router_order_id_residue():
+    router = ShardRouter(4)
+    assert router.shard_of_order_id("OID-1") == 0
+    assert router.shard_of_order_id("OID-6") == 1
+    assert router.shard_of_order_id("OID-999") == (999 - 1) % 4
+    assert router.shard_of_order_id("garbled") is None
+    assert router.shard_of_order_id("OID-x") is None
+    # Symbol routing is the stable multi-host hash: deterministic and
+    # total over arbitrary names.
+    assert all(0 <= router.shard_of(f"S{i}") < 4 for i in range(100))
+    assert router.shard_of("ACME") == router.shard_of("ACME")
+
+
+# -- the fuzz stream ---------------------------------------------------------
+
+
+def gen_stream(seed: int, n_batches: int = 10, batch_n: int = 16):
+    """Batches of tagged ops. Cancel/amend targets reference the TAG of
+    an earlier LIMIT submit (ids differ per shard count; tags don't)."""
+    rng = random.Random(seed)
+    tag = [0]
+    limit_targets: list[tuple[int, str, str]] = []  # (tag, sym, cid)
+    batches = []
+
+    def t():
+        tag[0] += 1
+        return tag[0]
+
+    for _ in range(n_batches):
+        ops = []
+        for _ in range(batch_n):
+            r = rng.random()
+            if r < 0.7 or not limit_targets:
+                sym = f"S{rng.randrange(SYMS)}"
+                cid = f"c{rng.randrange(4)}"
+                side = 1 if rng.random() < 0.5 else 2
+                otype = rng.choice((0, 0, 0, 1, 2, 3)) \
+                    if rng.random() < 0.3 else 0
+                price = 0 if otype == 1 else 10_000 + rng.randrange(-6, 7)
+                qty = rng.randrange(1, 12)
+                mytag = t()
+                ops.append(("submit", mytag, sym, cid, side, otype, price,
+                            qty))
+                if otype == 0:
+                    limit_targets.append((mytag, sym, cid))
+            elif r < 0.88:
+                tt, sym, cid = rng.choice(limit_targets)
+                if rng.random() < 0.15:
+                    cid = "mallory"
+                ops.append(("cancel", t(), tt, cid))
+            else:
+                tt, sym, cid = rng.choice(limit_targets)
+                ops.append(("amend", t(), tt, cid, rng.randrange(1, 15)))
+        batches.append(ops)
+    return batches
+
+
+# -- drains ------------------------------------------------------------------
+
+
+def drive_python(cfg: EngineConfig, K: int, stream) -> dict:
+    """Run the stream through K python lanes; returns the normalized
+    per-symbol surface. Submits route by symbol shard, cancels/amends to
+    their target's lane — each lane sees its ops in stream order, as its
+    dispatcher thread would pop them."""
+    router = ShardRouter(K)
+    hub = StreamHub()
+    runners = [make_lane_runner(cfg, router, i, hub=hub) for i in range(K)]
+    tag_oid: dict[int, str] = {}      # submit tag -> order id
+    oid_tag: dict[str, str] = {}
+    tag_info: dict[int, OrderInfo] = {}
+    statuses: dict[int, tuple] = {}   # submit tag -> (status, remaining)
+    fills = []                        # (taker_tag, maker_tag, price, qty)
+    rejected: dict[int, str] = {}     # op tag -> edge error
+
+    for ops in stream:
+        per_lane: dict[int, list] = {}
+        for op in ops:
+            if op[0] == "submit":
+                _, tg, sym, cid, side, otype, price, qty = op
+                lane = router.shard_of(sym)
+            else:
+                target = tag_oid.get(op[2])
+                if target is None:
+                    rejected[op[1]] = "unknown order id"
+                    continue
+                lane = router.shard_of(tag_info[op[2]].symbol)
+            per_lane.setdefault(lane, []).append(op)
+        for lane, lops in per_lane.items():
+            runner = runners[lane]
+            engine_ops = []
+            for op in lops:
+                if op[0] == "submit":
+                    _, tg, sym, cid, side, otype, price, qty = op
+                    if runner.slot_acquire(sym) is None:
+                        rejected[tg] = "capacity"
+                        continue
+                    num, oid = runner.assign_oid()
+                    info = OrderInfo(
+                        oid=num, order_id=oid, client_id=cid, symbol=sym,
+                        side=side, otype=otype, price_q4=price,
+                        quantity=qty, remaining=qty, status=0,
+                        handle=runner.assign_handle())
+                    tag_oid[tg] = oid
+                    oid_tag[oid] = tg
+                    tag_info[tg] = info
+                    engine_ops.append((tg, EngineOp(OP_SUBMIT, info)))
+                elif op[0] == "cancel":
+                    _, tg, tt, cid = op
+                    info = runner.orders_by_id.get(tag_oid[tt])
+                    if info is None or info.client_id != cid:
+                        rejected[tg] = "unknown/foreign"
+                        continue
+                    engine_ops.append((tg, EngineOp(
+                        OP_CANCEL, info, cancel_requester=cid)))
+                else:
+                    _, tg, tt, cid, qty = op
+                    info = runner.orders_by_id.get(tag_oid[tt])
+                    if info is None or info.client_id != cid:
+                        rejected[tg] = "unknown/foreign"
+                        continue
+                    engine_ops.append((tg, EngineOp(
+                        OP_AMEND, info, amend_qty=qty)))
+            if not engine_ops:
+                continue
+            box = {}
+
+            def on_finish(result, error):
+                assert error is None, error
+                box["r"] = result
+                return None
+
+            runner.dispatch_pipelined([e for _, e in engine_ops], on_finish)
+            runner.finish_pending()
+            res = box["r"]
+            for out in res.outcomes:
+                tg = next(tg for tg, e in engine_ops if e is out.op)
+                statuses[tg] = (out.status, out.remaining)
+            for f in res.storage_fills:
+                fills.append((oid_tag[f.order_id],
+                              oid_tag[f.counter_order_id],
+                              f.price_q4, f.quantity))
+    return _surface(runners, router, oid_tag, statuses, fills, rejected)
+
+
+def drive_native(cfg: EngineConfig, K: int, stream) -> dict:
+    """Same stream through K C++ lane engines (dispatch_records)."""
+    from matching_engine_tpu.server.native_lanes import pack_record_batch
+
+    router = ShardRouter(K)
+    hub = StreamHub()
+    runners = [make_lane_runner(cfg, router, i, hub=hub, native_lanes=True)
+               for i in range(K)]
+    tag_oid: dict[int, str] = {}
+    oid_tag: dict[str, str] = {}
+    tag_sym: dict[int, str] = {}
+    statuses: dict[int, tuple] = {}
+    fills = []
+    rejected: dict[int, str] = {}
+
+    for ops in stream:
+        per_lane: dict[int, list] = {}
+        for op in ops:
+            if op[0] == "submit":
+                lane = router.shard_of(op[2])
+                tag_sym[op[1]] = op[2]
+            else:
+                target = tag_oid.get(op[2])
+                if target is None:
+                    rejected[op[1]] = "unknown order id"
+                    continue
+                lane = router.shard_of(tag_sym[op[2]])
+            per_lane.setdefault(lane, []).append(op)
+        for lane, lops in per_lane.items():
+            runner = runners[lane]
+            recs = []
+            for op in lops:
+                if op[0] == "submit":
+                    _, tg, sym, cid, side, otype, price, qty = op
+                    recs.append((tg, 1, side, otype, price, qty, sym, cid,
+                                 ""))
+                elif op[0] == "cancel":
+                    _, tg, tt, cid = op
+                    recs.append((tg, 2, 0, 0, 0, 0, "", cid, tag_oid[tt]))
+                else:
+                    _, tg, tt, cid, qty = op
+                    recs.append((tg, 3, 0, 0, 0, qty, "", cid, tag_oid[tt]))
+            buf, n = pack_record_batch(recs)
+            box = {}
+
+            def on_finish(result, error):
+                assert error is None, error
+                box["r"] = result
+                return None
+
+            runner.dispatch_records(buf, n, on_finish)
+            runner.finish_pending()
+            r = box["r"]
+            for (tg, kind, ok, oid, err) in me_native.parse_comp_buf(
+                    r.comp_buf):
+                if kind == 0 and ok:
+                    tag_oid[tg] = oid
+                    oid_tag[oid] = tg
+                    statuses[tg] = ("accepted",)
+                elif not ok:
+                    rejected.setdefault(tg, err)
+            _, _, store_fills = me_native.unpack_store_buf(r.store_buf)
+            for f in store_fills:
+                fills.append((oid_tag[f.order_id],
+                              oid_tag[f.counter_order_id],
+                              f.price_q4, f.quantity))
+    return _surface(runners, router, oid_tag, statuses, fills, rejected)
+
+
+def _surface(runners, router, oid_tag, statuses, fills, rejected) -> dict:
+    """The shard-count-invariant observable surface, keyed per symbol:
+    fills in stream order, the final priority-sorted books, and the
+    reject set — ids normalized to tags."""
+    books = {}
+    for s in range(SYMS):
+        sym = f"S{s}"
+        runner = runners[router.shard_of(sym)]
+        bids, asks = runner.book_snapshot(sym)
+        books[sym] = (
+            [(oid_tag[i.order_id], i.price_q4, q) for i, q in bids],
+            [(oid_tag[i.order_id], i.price_q4, q) for i, q in asks],
+        )
+    return {"books": books, "fills": list(fills), "rejected": rejected}
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_shard_parity_python(seed):
+    cfg1 = make_cfg()
+    # K=4 lanes each get SYMS // 4 rows — same global capacity.
+    one = drive_python(cfg1, 1, gen_stream(seed))
+    four = drive_python(make_cfg(), 4, gen_stream(seed))
+    assert one["books"] == four["books"]
+    assert sorted(one["fills"]) == sorted(four["fills"])
+    assert one["rejected"].keys() == four["rejected"].keys()
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+@pytest.mark.parametrize("seed", [3, 11])
+def test_shard_parity_native(seed):
+    one = drive_native(make_cfg(), 1, gen_stream(seed))
+    four = drive_native(make_cfg(), 4, gen_stream(seed))
+    assert one["books"] == four["books"]
+    assert sorted(one["fills"]) == sorted(four["fills"])
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_shard_parity_python_vs_native():
+    """The cross-path diagonal: K=4 native == K=4 python, per symbol."""
+    py = drive_python(make_cfg(), 4, gen_stream(7))
+    nat = drive_native(make_cfg(), 4, gen_stream(7))
+    assert py["books"] == nat["books"]
+    assert sorted(py["fills"]) == sorted(nat["fills"])
+
+
+# -- concurrent-lane feed: per-key seq lines stay gapless --------------------
+
+
+def test_concurrent_lane_publish_keeps_per_key_seq_gapless():
+    """K dispatcher threads publishing into ONE sequenced hub at once:
+    every (channel, key) domain's seq line must come out dense (1..n) —
+    the cross-lane fan-in invariant the sharded feed rests on."""
+    from matching_engine_tpu.feed import FeedSequencer
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    hub = StreamHub(maxsize=100_000, metrics=metrics,
+                    sequencer=FeedSequencer(metrics=metrics))
+    clients = [f"c{i}" for i in range(4)]
+    subs = {c: hub.subscribe_order_updates(c) for c in clients}
+    K, per_lane = 4, 300
+
+    def lane(i):
+        for j in range(per_lane):
+            # Every lane publishes to EVERY client key: order-update
+            # domains are client-keyed and clients trade on all lanes.
+            hub.publish_order_updates([
+                pb2.OrderUpdate(order_id=f"OID-{1 + i + 4 * j}",
+                                client_id=c, symbol=f"S{i}", status=0)
+                for c in clients])
+
+    threads = [threading.Thread(target=lane, args=(i,)) for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hub.close_all()
+    for c in clients:
+        # Drain the subscription queue directly (no consumer thread ran).
+        seqs = []
+        while True:
+            try:
+                _, item = subs[c].q.get_nowait()
+            except Exception:
+                break
+            if hasattr(item, "seq"):
+                seqs.append(item.seq)
+        assert len(seqs) == K * per_lane
+        assert seqs == sorted(seqs), f"{c}: out-of-order seqs"
+        assert seqs == list(range(1, K * per_lane + 1)), \
+            f"{c}: seq line has gaps"
+
+
+# -- full-stack e2e ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sharded_server_e2e_and_recount_restart(tmp_path):
+    """Boot K=4, trade across lanes, restart the SAME store at K=2:
+    resting orders recover onto their symbol's new lane, the OID line
+    stays globally unique across both boots, and cancels route to
+    recovered orders whose id residue no longer matches their lane."""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    db = str(tmp_path / "db.sqlite")
+    cfg = EngineConfig(num_symbols=16, capacity=32, batch=4,
+                       max_fills=1 << 12)
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1, log=False, native=False,
+        serve_shards=4)
+    server.start()
+    stub = MatchingEngineStub(
+        grpc.insecure_channel(f"127.0.0.1:{port}"))
+    oids = []
+    for i in range(24):
+        r = stub.SubmitOrder(pb2.OrderRequest(
+            client_id=f"c{i % 3}", symbol=f"SYM{i % 6}", side=1 + i % 2,
+            order_type=pb2.LIMIT, price=10_000 + 40 * (i % 3) * (1 if i % 2 else -1),
+            scale=4, quantity=5))
+        assert r.success, r.error_message
+        oids.append(r.order_id)
+    assert len(set(oids)) == len(oids)
+    lanes_used = {(int(o[4:]) - 1) % 4 for o in oids}
+    assert len(lanes_used) > 1, "stream never spread across lanes"
+    book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="SYM0"))
+    resting = {o.order_id for o in list(book.bids) + list(book.asks)}
+    shutdown(server, parts)
+
+    # Restart at K=2 over the same store.
+    server2, port2, parts2 = build_server(
+        "127.0.0.1:0", db, cfg, window_ms=1, log=False, native=False,
+        serve_shards=2)
+    server2.start()
+    stub2 = MatchingEngineStub(
+        grpc.insecure_channel(f"127.0.0.1:{port2}"))
+    book2 = stub2.GetOrderBook(pb2.OrderBookRequest(symbol="SYM0"))
+    resting2 = {o.order_id for o in list(book2.bids) + list(book2.asks)}
+    assert resting == resting2, "restart at a new K lost resting orders"
+    # A recovered order cancels through the probe even when its K=4-era
+    # residue points at the wrong K=2 lane.
+    victim = sorted(resting2)[0]
+    owner = next(o for o in list(book2.bids) + list(book2.asks)
+                 if o.order_id == victim).client_id
+    c = stub2.CancelOrder(pb2.CancelRequest(client_id=owner,
+                                            order_id=victim))
+    assert c.success, c.error_message
+    new = stub2.SubmitOrder(pb2.OrderRequest(
+        client_id="cx", symbol="SYM7", side=1, order_type=pb2.LIMIT,
+        price=9_000, scale=4, quantity=1))
+    assert new.success
+    assert new.order_id not in set(oids), "OID line reused across boots"
+    shutdown(server2, parts2)
+
+
+def test_lane_sampler_gauges():
+    """The balance sampler publishes the documented me_lane_* aggregates
+    plus the per-shard series."""
+    from matching_engine_tpu.server.shards import build_serving_shards
+    from matching_engine_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    shards = build_serving_shards(
+        make_cfg(), 2, metrics=metrics, with_dispatchers=False,
+        sample_interval_s=0)  # no thread; tick by hand
+    shards.lanes[0].runner.ops_dispatched = 30
+    shards.lanes[1].runner.ops_dispatched = 10
+    shards._sample_once([0, 0], 0.0)
+    _, gauges = metrics.snapshot()
+    assert "lane_queue_depth_max" in gauges
+    assert gauges["lane_dispatch_rate"] > 0
+    assert gauges["lane_imbalance"] >= 1.0
+    assert "lane0_ops_per_s" in gauges and "lane1_ops_per_s" in gauges
+    shards.close()
+
+
+@pytest.mark.slow
+def test_proportional_recut_restore_guard(tmp_path, capfd):
+    """--symbols 16 --serve-shards 2 → --symbols 32 --serve-shards 4:
+    per-lane checkpoint shapes MATCH (8 symbols each) so restore_runner's
+    semantic-key/slice guards pass, but the K=2 snapshots cover a
+    COARSER cut — K=4 lane 0 would inherit crc32%2==0 symbols including
+    the crc32%4==2 ones that now home on lane 2. The foreign-symbol
+    guard must force full replay instead of restoring another cut's
+    books onto the wrong lane. (The halving direction needs no guard:
+    crc32 residue classes NEST when the new K divides the old, so every
+    restored symbol stays owned.)"""
+    import grpc
+
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    db = str(tmp_path / "db.sqlite")
+    ckpts = str(tmp_path / "ckpts")
+    cfg2 = EngineConfig(num_symbols=16, capacity=16, batch=4,
+                        max_fills=1 << 12)
+    server, port, parts = build_server(
+        "127.0.0.1:0", db, cfg2, window_ms=1, log=False, native=False,
+        serve_shards=2, checkpoint_dir=ckpts, checkpoint_interval_s=3600)
+    server.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    resting = {}
+    for i in range(16):
+        r = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="c0", symbol=f"SYM{i % 8}", side=1,
+            order_type=pb2.LIMIT, price=100 + i, scale=4, quantity=2))
+        assert r.success
+        resting.setdefault(f"SYM{i % 8}", set()).add(r.order_id)
+    shutdown(server, parts)  # final checkpoint per lane
+    # The fuzz namespace must actually straddle the finer cut, or the
+    # guard has nothing to prove.
+    r4 = ShardRouter(4)
+    assert len({r4.shard_of(s) for s in resting}) > 2
+
+    cfg4 = EngineConfig(num_symbols=32, capacity=16, batch=4,
+                        max_fills=1 << 12)
+    server2, port2, parts2 = build_server(
+        "127.0.0.1:0", db, cfg4, window_ms=1, log=False, native=False,
+        serve_shards=4, checkpoint_dir=ckpts)
+    out = capfd.readouterr().out
+    assert "outside this lane's shard cut" in out, \
+        "foreign-symbol restore guard never fired"
+    server2.start()
+    stub2 = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port2}"))
+    for sym, ids in resting.items():
+        book = stub2.GetOrderBook(pb2.OrderBookRequest(symbol=sym))
+        got = {o.order_id for o in list(book.bids) + list(book.asks)}
+        assert got == ids, f"{sym}: {got} != {ids}"
+    shutdown(server2, parts2)
