@@ -88,6 +88,20 @@ def main() -> None:
                         "NEGATIVE. 500us is the measured sweet spot on "
                         "this stack; server/main.py applies the same "
                         "tuning under --serve-shards")
+    p.add_argument("--megadispatch", default="",
+                   help="comma list of megadispatch wave counts M to sweep "
+                        "(python path; server/engine_runner._prepare_mega): "
+                        "each point drives the runner with coalesced "
+                        "dispatches of M x (symbols*batch) ops, symbols "
+                        "assigned round-robin so every dispatch is exactly "
+                        "M full [S, B] waves — M=1 is the serial per-wave "
+                        "baseline, M>1 runs kernel.engine_step_mega's "
+                        "single stacked scan per dispatch. Rows add "
+                        "readback_bytes_per_op (compacted vs full-plane "
+                        "readback) and waves_per_step; best-of --repeats "
+                        "like the shards sweep. Composes with --host-only "
+                        "(the stacked step is recorded/replayed like the "
+                        "serial ones)")
     p.add_argument("--host-only", action="store_true",
                    help="isolate the serving stack's HOST work (lane "
                         "build, id/slot assignment, status decode, "
@@ -187,24 +201,29 @@ def main() -> None:
     from collections import deque
 
     @contextlib.contextmanager
-    def patched_steps(sparse_fn, packed_fn):
+    def patched_steps(sparse_fn, packed_fn, mega_fn=None):
         """Swap the engine step at every site the serving runners call it
         through: the sparse/kernel modules (imported per call inside the
-        hot paths) and engine_runner's import-time binding."""
+        hot paths) and engine_runner's import-time binding. The mega step
+        is reached through the kernel module attribute
+        (engine_runner._prepare_mega imports the module), so patching
+        kmod covers it."""
         import matching_engine_tpu.engine.kernel as kmod
         import matching_engine_tpu.engine.sparse as smod
         import matching_engine_tpu.server.engine_runner as rmod
 
         saved = (smod.engine_step_sparse, kmod.engine_step_packed,
-                 rmod.engine_step_packed)
+                 rmod.engine_step_packed, kmod.engine_step_mega)
         smod.engine_step_sparse = sparse_fn
         kmod.engine_step_packed = packed_fn
         rmod.engine_step_packed = packed_fn
+        if mega_fn is not None:
+            kmod.engine_step_mega = mega_fn
         try:
             yield
         finally:
             (smod.engine_step_sparse, kmod.engine_step_packed,
-             rmod.engine_step_packed) = saved
+             rmod.engine_step_packed, kmod.engine_step_mega) = saved
 
     def make_point(mode: str, inflight: int, batch_ops: int):
         """Fresh (runner, batches, dispatch) triple for one measured pass —
@@ -494,10 +513,156 @@ def main() -> None:
             "mean_batch_ms": round(dt / args.n_batches * 1e3, 3),
         }
 
+    # -- megadispatch sweep (engine_runner._prepare_mega) ------------------
+
+    def build_mega_record_batches(seed: int, n_batches: int, m: int):
+        """Coalesced-dispatch streams: m x (symbols*batch) submits per
+        dispatch, symbols assigned round-robin so the wave builder packs
+        EXACTLY m full [S, B] waves — the deep-queue backlog shape the
+        dispatcher's controller coalesces, with a deterministic wave
+        count so M=1 (the serial per-wave schedule over the same
+        backlog) and M>1 (one stacked scan per m waves) compare like
+        for like."""
+        from matching_engine_tpu.server.native_lanes import pack_record_batch
+
+        rng = random.Random(seed)
+        ops_per = m * args.symbols * args.batch
+        batches = []
+        tag = 1
+        for _ in range(n_batches):
+            recs = []
+            for j in range(ops_per):
+                sym = f"S{j % args.symbols}"
+                side = BUY if rng.random() < 0.5 else SELL
+                price = 10_000 + rng.randrange(-20, 21)
+                qty = rng.randrange(1, 50)
+                recs.append((tag, 1, side, 0, price, qty, sym,
+                             f"c{tag % 97}", ""))
+                tag += 1
+            batches.append(pack_record_batch(recs))
+        return batches
+
+    def sweep_point_mega(m: int, inflight: int) -> dict:
+        from matching_engine_tpu.server.streams import StreamHub
+
+        lat: list[float] = []
+
+        def make():
+            hub = StreamHub()
+            runner = EngineRunner(cfg, hub=hub, pipeline_inflight=inflight,
+                                  megadispatch_max_waves=m)
+            batches = build_mega_record_batches(
+                seed=97 + m, n_batches=args.n_batches, m=m)
+
+            def dispatch(b, cb, _r=runner):
+                _r.dispatch_pipelined(records_to_ops(_r, b[0], b[1]), cb)
+            return runner, batches, dispatch
+
+        ctx = contextlib.nullcontext()
+        if args.host_only:
+            # Same record/replay scheme as the single-lane sweep, with
+            # the stacked mega step recorded too (its outputs converted
+            # to host numpy so the replay touches no device arrays).
+            from matching_engine_tpu.engine.kernel import (
+                engine_step_mega as real_mega,
+            )
+            from matching_engine_tpu.engine.kernel import (
+                engine_step_packed as real_packed,
+            )
+            from matching_engine_tpu.engine.sparse import (
+                engine_step_sparse as real_sparse,
+            )
+
+            outs: deque = deque()
+
+            def rec_sparse(c, book, sp):
+                book, out = real_sparse(c, book, sp)
+                outs.append(out)
+                return book, out
+
+            def rec_packed(c, book, arr):
+                book, out = real_packed(c, book, arr)
+                outs.append(out)
+                return book, out
+
+            def rec_mega(c, book, lanes, rcap):
+                book, out = real_mega(c, book, lanes, rcap)
+                outs.append(_HostOut(out))
+                return book, out
+
+            runner, batches, dispatch = make()
+            with patched_steps(rec_sparse, rec_packed, rec_mega):
+                for b in batches:
+                    dispatch(b, lambda r, e: None)
+                runner.finish_pending()
+            ctx = patched_steps(
+                lambda c, book, sp: (book, outs.popleft()),
+                lambda c, book, arr: (book, outs.popleft()),
+                lambda c, book, lanes, rcap: (book, outs.popleft()))
+
+        runner, batches, dispatch = make()
+        with ctx:
+            if not args.host_only:
+                warm = build_mega_record_batches(seed=7, n_batches=2, m=m)
+                for b in warm:
+                    dispatch(b, lambda r, e: None)
+                runner.finish_pending()
+            c0 = dict(runner.metrics.snapshot()[0])
+            t_begin = time.perf_counter()
+            for b in batches:
+                t0 = time.perf_counter()
+
+                def cb(r, e, _t=t0):
+                    assert e is None, e
+                    lat.append(time.perf_counter() - _t)
+                dispatch(b, cb)
+            runner.finish_pending()
+            dt = time.perf_counter() - t_begin
+        c1 = dict(runner.metrics.snapshot()[0])
+        assert len(lat) == len(batches)
+        lats = np.array(sorted(lat))
+        ops_per = m * args.symbols * args.batch
+        n_ops = args.n_batches * ops_per
+        steps = c1.get("megadispatch_steps", 0) - c0.get(
+            "megadispatch_steps", 0)
+        waves = c1.get("megadispatch_stacked_waves", 0) - c0.get(
+            "megadispatch_stacked_waves", 0)
+        return {
+            "mode": "python-mega" + ("-host" if args.host_only else ""),
+            "megadispatch": m,
+            "inflight": inflight,
+            "orders_per_s": round(n_ops / dt, 1),
+            "ops_per_dispatch": ops_per,
+            "n_batches": args.n_batches,
+            "p50_ms": round(float(lats[len(lats) // 2]) * 1e3, 3),
+            "p99_ms": round(float(lats[int(len(lats) * 0.99)]) * 1e3, 3),
+            "readback_bytes_per_op": round(
+                (c1.get("readback_bytes", 0) - c0.get("readback_bytes", 0))
+                / n_ops, 1),
+            "mega_steps": steps,
+            "waves_per_step": round(waves / steps, 2) if steps else 1.0,
+        }
+
     grid_cap = args.symbols * args.batch
+    mega_list = [int(x) for x in args.megadispatch.split(",")
+                 if x.strip()] if args.megadispatch else []
     shard_list = [int(k) for k in args.serve_shards.split(",")
                   if k.strip()] if args.serve_shards else []
-    if shard_list:
+    if mega_list:
+
+        def best_of_mega(m, k):
+            reps = [sweep_point_mega(m, k)
+                    for _ in range(max(1, args.repeats))]
+            rates = [r["orders_per_s"] for r in reps]
+            best = max(reps, key=lambda r: r["orders_per_s"])
+            best["repeats"] = len(reps)
+            best["orders_per_s_spread"] = [min(rates), max(rates)]
+            return best
+
+        rows = [best_of_mega(m, int(k))
+                for k in args.inflight.split(",")
+                for m in mega_list]
+    elif shard_list:
         import sys as _sys
 
         _sys.setswitchinterval(max(1, args.gil_switch_us) / 1e6)

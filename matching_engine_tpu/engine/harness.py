@@ -207,6 +207,77 @@ def decode_step_packed(cfg: EngineConfig, batch: OrderBatch, pout):
     return results, fills, dec.fill_overflow, dec
 
 
+class MegaDecoded:
+    """Host view of one megadispatch readback (kernel.MegaStepOutput.small
+    layout; all numpy views of the ONE transferred vector). Exposes the
+    final-book top-of-book under the StepOutput attribute names so the
+    runner's market-data publisher reads it like any dense output."""
+
+    __slots__ = ("res_counts", "fill_counts", "overflows", "best_bid",
+                 "bid_size", "best_ask", "ask_size", "res", "fills_inline")
+
+    def __init__(self, cfg: EngineConfig, m: int, rcap: int,
+                 small: np.ndarray):
+        from matching_engine_tpu.engine.kernel import mega_fill_inline
+
+        s = cfg.num_symbols
+        lo = mega_fill_inline(cfg, rcap)
+        self.res_counts = small[0:m]
+        self.fill_counts = small[m:2 * m]
+        self.overflows = small[2 * m:3 * m]
+        base = 3 * m
+        self.best_bid = small[base:base + s]
+        self.bid_size = small[base + s:base + 2 * s]
+        self.best_ask = small[base + 2 * s:base + 3 * s]
+        self.ask_size = small[base + 3 * s:base + 4 * s]
+        base += 4 * s
+        self.res = small[base:base + m * 5 * rcap].reshape(m, 5, rcap)
+        base += m * 5 * rcap
+        self.fills_inline = small[base:base + m * 5 * lo].reshape(m, 5, lo)
+
+
+def decode_step_mega(cfg: EngineConfig, mout, m: int, rcap: int):
+    """Decode one megadispatch output into per-wave (results, fills,
+    overflow) triples — the same triples the serial schedule's per-wave
+    decode_step_packed produces, in the same order, from ONE packed
+    readback. Returns (waves, decoded, fetched_full): a second
+    (whole-buffer, fixed-shape) fills fetch happens only when some wave's
+    fill count exceeds the inline segment, same policy as the packed
+    single step (never a device-side dynamic slice).
+
+    Results decode straight off the compacted rows: the device packed
+    real ops in row-major (symbol, batch-row) order, which is exactly
+    np.nonzero's order over the full planes — so HostResult lists are
+    bit-identical to decode_results on the uncompacted output."""
+    small = np.asarray(mout.small)
+    dec = MegaDecoded(cfg, m, rcap, small)
+    full = None
+    waves = []
+    for i in range(m):
+        rc = int(dec.res_counts[i])
+        r = dec.res[i]
+        results = [
+            HostResult(*t)
+            for t in zip(r[0, :rc].tolist(), r[1, :rc].tolist(),
+                         r[2, :rc].tolist(), r[3, :rc].tolist(),
+                         r[4, :rc].tolist())
+        ]
+        fn = int(dec.fill_counts[i])
+        if fn == 0:
+            fills = []
+        else:
+            if fn <= dec.fills_inline.shape[2]:
+                packed = dec.fills_inline[i]
+            else:
+                if full is None:
+                    full = np.asarray(mout.fills)
+                packed = full[i]
+            fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
+                                 packed[4], fn)
+        waves.append((results, fills, bool(dec.overflows[i])))
+    return waves, dec, full is not None
+
+
 # Max dispatched-but-undecoded steps held in flight. Enough to hide the
 # per-step sync round trip behind the device pipeline (a tunneled chip
 # bills ~64ms per synchronization), small enough that staged outputs

@@ -296,6 +296,25 @@ def _top_of_book(price, qty, best_is_max):
     return best.astype(I32), size.astype(I32)
 
 
+def engine_step_core(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
+    """The raw match pass, WITHOUT the finalize epilogue: (new_book,
+    (status, filled, remaining, f_oid, f_qty, f_price)), fill arrays still
+    the [S, B, CAP] priority-rank tensor. Shared by the single-step entry
+    (which finalizes into a StepOutput) and the megadispatch scan body
+    (which compacts per wave instead — engine_step_mega). Dispatches on
+    cfg.kernel like engine_step_impl."""
+    if cfg.kernel == "sorted":
+        from matching_engine_tpu.engine.kernel_sorted import (
+            engine_step_sorted_core,
+        )
+
+        return engine_step_sorted_core(cfg, book, orders)
+    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+    # vmap over the symbol axis; scan over the batch axis inside.
+    new_sym_book, raw = jax.vmap(_sym_scan)(sym_book, orders)
+    return BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq), raw
+
+
 def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     """Un-jitted engine step body (shared by the jit'd single-device entry
     point below and the shard_map-wrapped multi-chip step in
@@ -312,19 +331,8 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     O(CAP) dense-sorted-prefix variant) — every serving path (packed
     dense, sparse, shard_map mesh) dispatches through here, so the
     config knob covers them all."""
-    if cfg.kernel == "sorted":
-        from matching_engine_tpu.engine.kernel_sorted import (
-            engine_step_sorted_impl,
-        )
-
-        return engine_step_sorted_impl(cfg, book, orders)
-    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
-    # vmap over the symbol axis; scan over the batch axis inside.
-    new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = jax.vmap(
-        _sym_scan
-    )(sym_book, orders)
-
-    new_book = BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq)
+    new_book, (status, filled, remaining, f_oid, f_qty, f_price) = (
+        engine_step_core(cfg, book, orders))
     return new_book, finalize_step(
         cfg, new_book, orders, status, filled, remaining, f_oid, f_qty, f_price
     )
@@ -344,31 +352,34 @@ def finalize_step(
     """Shared epilogue: compact the [S, B, CAP] potential-fill tensor into
     the bounded global fill log and compute post-step top-of-book."""
     # [S, B, CAP] -> flat, ordered (symbol, batch position, priority rank).
+    # ONE compaction definition (compact_rows, shared with the mega scan's
+    # per-wave fill logs) so the serial and stacked fill logs can't drift.
     s, b, cap = f_qty.shape
     flat_qty = f_qty.reshape(-1)
     mask = flat_qty > 0
-    pos = jnp.cumsum(mask) - 1
     total = jnp.sum(mask)
     n = cfg.max_fills
-    dest = jnp.where(mask & (pos < n), pos, n)  # slot n = trash
-
-    def compact(flat_vals):
-        return jnp.zeros((n + 1,), I32).at[dest].set(flat_vals)[:n]
-
     sym_ids = jnp.broadcast_to(jnp.arange(s, dtype=I32)[:, None, None], (s, b, cap))
     taker = jnp.broadcast_to(orders.oid[:, :, None], (s, b, cap))
+    (fill_sym, fill_taker, fill_maker, fill_price, fill_qty), fill_count = (
+        compact_rows(
+            mask,
+            (sym_ids.reshape(-1), taker.reshape(-1), f_oid.reshape(-1),
+             f_price.reshape(-1), flat_qty),
+            n,
+        ))
     best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty, True)
     best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty, False)
     return StepOutput(
         status=status,
         filled=filled,
         remaining=remaining,
-        fill_sym=compact(sym_ids.reshape(-1)),
-        fill_taker_oid=compact(taker.reshape(-1)),
-        fill_maker_oid=compact(f_oid.reshape(-1)),
-        fill_price=compact(f_price.reshape(-1)),
-        fill_qty=compact(flat_qty),
-        fill_count=jnp.minimum(total, n).astype(I32),
+        fill_sym=fill_sym,
+        fill_taker_oid=fill_taker,
+        fill_maker_oid=fill_maker,
+        fill_price=fill_price,
+        fill_qty=fill_qty,
+        fill_count=fill_count,
         fill_overflow=total > n,
         best_bid=best_bid,
         bid_size=bid_size,
@@ -412,6 +423,147 @@ class PackedStepOutput(NamedTuple):
 
     small: jax.Array
     fills: jax.Array
+
+
+def compact_rows(mask, cols, out_len: int):
+    """Prefix-sum gather compaction: pack the masked entries of the 1-D
+    `cols` arrays to the front of [out_len] buffers (device order
+    preserved; zeros past the packed prefix). Returns (packed_cols,
+    count) with count = min(popcount(mask), out_len); entries past
+    out_len land in the trash slot exactly like the fill-log compaction.
+    Pure jnp — safe under vmap and inside scan bodies (the megadispatch
+    wave body uses it for both completions and fills)."""
+    pos = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask & (pos < out_len), pos, out_len)
+    packed = tuple(
+        jnp.zeros((out_len + 1,), I32).at[dest].set(
+            jnp.where(mask, c, 0))[:out_len]
+        for c in cols
+    )
+    return packed, jnp.minimum(jnp.sum(mask), out_len).astype(I32)
+
+
+def mega_result_cap(cfg: EngineConfig, max_ops: int) -> int:
+    """Static compacted-completion capacity (rows per wave) for one mega
+    dispatch: smallest power-of-two >= the deepest wave's real-op count,
+    clamped to the full grid. The host KNOWS every wave's op count (it
+    built the lane arrays), so the buffer never truncates; bucketing
+    keeps the jit cache at ~log2(S*B) programs instead of one per count."""
+    cap = cfg.num_symbols * cfg.batch
+    r = 64
+    while r < max_ops:
+        r <<= 1
+    return min(r, cap)
+
+
+def mega_fill_inline(cfg: EngineConfig, rcap: int) -> int:
+    """Inline fill rows per WAVE in the mega readback. Sized with the
+    dispatch (>= the compacted-result bucket, floor 64) instead of the
+    flat FILL_INLINE: M waves each carry an inline segment, so a fixed
+    256 would dominate the packed vector at small shapes — exactly the
+    padding the compaction exists to cut. A wave filling more than this
+    pays the one full-buffer fetch, same policy as the packed step."""
+    return min(fill_inline_count(cfg), max(64, rcap))
+
+
+class MegaStepOutput(NamedTuple):
+    """One megadispatch scan's packed readback (M waves amortized over a
+    single XLA dispatch). Decode with harness.decode_step_mega.
+
+    small: [3M + 4S + M*5*R + M*5*L] int32 (R = mega_result_cap bucket,
+           L = mega_fill_inline(cfg, R)) =
+           res_counts[M] | fill_counts[M] | fill_overflows[M] ++
+           best_bid | bid_size | best_ask | ask_size (each [S], FINAL
+           book — identical to the last wave's top-of-book) ++
+           compacted completions [M, 5, R] ravelled (rows oid | sym |
+           status | filled | remaining, packed device-order per wave) ++
+           inline fill segments [M, 5, L] ravelled.
+    fills: [M, 5, max_fills] int32 per-wave full fill logs (decode_fills
+           column order) — fetched only when some wave's fill count
+           exceeds the inline segment.
+
+    The completion compaction is the readback-bytes win: the serial
+    packed step reads 3*S*B result planes per wave even when a handful
+    of rows carry real ops; this reads 5*R per wave plus a fixed header.
+    """
+
+    small: jax.Array
+    fills: jax.Array
+
+
+@partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+def engine_step_mega(cfg: EngineConfig, book: BookBatch, lanes: jax.Array,
+                     rcap: int):
+    """Megadispatch: ONE jit'd lax.scan over M stacked [S, B, 7] dispatch
+    waves (`lanes` is [M, S, B, 7]) on the donated book — one XLA
+    dispatch (and one host->device upload) amortized over all M waves,
+    with device-side completion compaction so the readback is O(real
+    ops), not O(M*S*B). Wave semantics are engine_step_packed applied M
+    times in order, bit-identical by construction (same engine_step_core
+    body; tests/test_megadispatch.py pins it on both kernels)."""
+    n = cfg.max_fills
+    lo = mega_fill_inline(cfg, rcap)
+    s, b = cfg.num_symbols, cfg.batch
+
+    def wave(bk, wl):
+        orders = batch_from_lanes(wl)
+        new_bk, (status, filled, remaining, f_oid, f_qty, f_price) = (
+            engine_step_core(cfg, bk, orders))
+        # Completion compaction: pack the real (non-NOOP) rows to the
+        # front in device row-major order — exactly the row order
+        # harness.decode_results emits from the full planes.
+        mask = orders.op.reshape(-1) != OP_NOOP
+        sym_ids = jnp.broadcast_to(
+            jnp.arange(s, dtype=I32)[:, None], (s, b)).reshape(-1)
+        res_cols, res_count = compact_rows(
+            mask,
+            (orders.oid.reshape(-1), sym_ids, status.reshape(-1),
+             filled.reshape(-1), remaining.reshape(-1)),
+            rcap,
+        )
+        # Fill-log compaction: same contract as finalize_step's global
+        # cumsum (flat order = (symbol, batch position, priority rank)).
+        cap = f_qty.shape[2]
+        flat_qty = f_qty.reshape(-1)
+        fmask = flat_qty > 0
+        fsym = jnp.broadcast_to(
+            jnp.arange(s, dtype=I32)[:, None, None], (s, b, cap)).reshape(-1)
+        taker = jnp.broadcast_to(
+            orders.oid[:, :, None], (s, b, cap)).reshape(-1)
+        fill_cols, _ = compact_rows(
+            fmask,
+            (fsym, taker, f_oid.reshape(-1), f_price.reshape(-1), flat_qty),
+            n,
+        )
+        total = jnp.sum(fmask)
+        return new_bk, (
+            jnp.stack(res_cols),            # [5, rcap]
+            res_count,
+            jnp.stack(fill_cols),           # [5, max_fills]
+            jnp.minimum(total, n).astype(I32),
+            (total > n).astype(I32),
+        )
+
+    new_book, (res, res_counts, fills, fill_counts, overflows) = jax.lax.scan(
+        wave, book, lanes)
+    # Top-of-book once, on the FINAL book — identical to the serial
+    # schedule, whose market data publishes from the last wave's output.
+    best_bid, bid_size = _top_of_book(new_book.bid_price, new_book.bid_qty,
+                                      True)
+    best_ask, ask_size = _top_of_book(new_book.ask_price, new_book.ask_qty,
+                                      False)
+    small = jnp.concatenate([
+        res_counts,
+        fill_counts,
+        overflows,
+        best_bid,
+        bid_size,
+        best_ask,
+        ask_size,
+        res.reshape(-1),
+        fills[:, :, :lo].reshape(-1),  # static slice
+    ])
+    return new_book, MegaStepOutput(small=small, fills=fills)
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=1)

@@ -264,14 +264,22 @@ def _sym_scan_sorted(book: _SymBook, orders):
     return jax.lax.scan(lambda b, o: _match_one_sorted(b, o), book, orders)
 
 
+def engine_step_sorted_core(cfg: EngineConfig, book: BookBatch,
+                            orders: OrderBatch):
+    """Raw sorted-formulation match pass (same contract as
+    kernel.engine_step_core): no finalize epilogue, so the megadispatch
+    scan can compact per wave instead."""
+    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+    new_sym_book, raw = jax.vmap(_sym_scan_sorted)(sym_book, orders)
+    return BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq), raw
+
+
 def engine_step_sorted_impl(cfg: EngineConfig, book: BookBatch,
                             orders: OrderBatch):
     """Un-jitted sorted-formulation step (same contract as
     kernel.engine_step_impl; shares finalize_step)."""
-    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
-    new_sym_book, (status, filled, remaining, f_oid, f_qty, f_price) = (
-        jax.vmap(_sym_scan_sorted)(sym_book, orders))
-    new_book = BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq)
+    new_book, (status, filled, remaining, f_oid, f_qty, f_price) = (
+        engine_step_sorted_core(cfg, book, orders))
     return new_book, finalize_step(
         cfg, new_book, orders, status, filled, remaining, f_oid, f_qty,
         f_price)

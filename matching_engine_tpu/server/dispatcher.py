@@ -80,6 +80,8 @@ class BatchDispatcher:
         window_ms: float = 2.0,
         max_batch: int | None = None,
         metrics: Metrics | None = None,
+        mega_max_waves: int = 1,
+        mega_latency_us: float = 5000.0,
     ):
         self.runner = runner
         self.sink = sink
@@ -88,6 +90,26 @@ class BatchDispatcher:
         # Default: fill at most one full device dispatch per drain.
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
         self.metrics = metrics or runner.metrics
+        # Megadispatch coalescing controller (--megadispatch-max-waves):
+        # when the queue is still deep after a full drain, pull up to
+        # (M-1) more max_batch-sized chunks WITHOUT waiting out another
+        # window, so the runner stacks them into one device scan
+        # (engine_runner._prepare_mega). M adapts per cycle: the
+        # queue-depth target, clamped by the latency budget
+        # (--megadispatch-latency-us) over the measured per-wave cost
+        # EMA — deep queues amortize dispatches, light load keeps the
+        # serial single-window schedule exactly (M=1 == today's loop).
+        self.mega_max_waves = max(1, int(mega_max_waves))
+        self.mega_latency_us = float(mega_latency_us)
+        self._wave_cost_us = 0.0  # EMA, per-wave batch turnaround
+        if self.mega_max_waves > 1:
+            # Pre-register the controller's decision metrics so an
+            # enabled-but-idle server still exports the me_megadispatch_*
+            # series (scrapers see zeros, not absent names).
+            self.metrics.set_gauge("megadispatch_m", 1)
+            self.metrics.inc("megadispatch_coalesced", 0)
+            self.metrics.inc("megadispatch_coalesced_ops", 0)
+            self.metrics.inc("megadispatch_latency_clamps", 0)
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="dispatcher", daemon=True)
@@ -144,8 +166,49 @@ class BatchDispatcher:
                     self.runner.finish_pending()
                     return
                 batch.append(item)
+            self._coalesce(batch)
             self._drain(batch)
         self.runner.finish_pending()
+
+    def _coalesce(self, batch) -> int:
+        """The adaptive megadispatch controller: extend `batch` past
+        max_batch (non-blocking — the window was already waited out) when
+        the queue is deep enough to fill further waves, and return the
+        resulting wave target M. Decisions export as me_megadispatch_*:
+        the chosen M (gauge), coalesced-drain and op counters, and how
+        often the latency budget—not queue depth—was the binding
+        constraint."""
+        if self.mega_max_waves <= 1:
+            return 1
+        depth = self._q.qsize()
+        if depth <= 0:
+            self.metrics.set_gauge("megadispatch_m", 1)
+            return 1
+        want = min(self.mega_max_waves,
+                   1 + (depth + self.max_batch - 1) // self.max_batch)
+        if want > 1 and self._wave_cost_us > 0 and self.mega_latency_us > 0:
+            cap = max(1, int(self.mega_latency_us / self._wave_cost_us))
+            if cap < want:
+                self.metrics.inc("megadispatch_latency_clamps")
+                want = cap
+        target = want * self.max_batch
+        while len(batch) < target:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                # Shutdown sentinel mid-coalesce: requeue it so the loop
+                # exits at its next get; this batch still dispatches.
+                self._q.put(None)
+                break
+            batch.append(item)
+        m = (len(batch) + self.max_batch - 1) // self.max_batch
+        self.metrics.set_gauge("megadispatch_m", m)
+        if m > 1:
+            self.metrics.inc("megadispatch_coalesced")
+            self.metrics.inc("megadispatch_coalesced_ops", len(batch))
+        return m
 
     def _drain(self, batch) -> None:
         t0 = time.perf_counter()
@@ -207,6 +270,15 @@ class BatchDispatcher:
                 self.metrics.ema_gauge("dispatch_us", dur_us)
                 self.metrics.observe("dispatch_us", dur_us)  # -> p50/p99
                 self.metrics.ema_gauge("dispatch_ops", len(batch))
+                # Per-wave turnaround EMA feeding the coalescing
+                # controller's latency clamp. Includes pipeline residency
+                # — a deliberately conservative estimate (overstating the
+                # per-wave cost only shrinks M toward the latency-safe
+                # side).
+                cost = dur_us / max(1, tl.waves)
+                self._wave_cost_us = (
+                    cost if self._wave_cost_us == 0
+                    else 0.1 * cost + 0.9 * self._wave_cost_us)
             return complete
 
         self.runner.dispatch_pipelined(ops, on_finish, timeline=tl)
@@ -415,6 +487,8 @@ class NativeRingDispatcher(BatchDispatcher):
         max_batch: int | None = None,
         metrics: Metrics | None = None,
         ring_capacity: int = 1 << 16,
+        mega_max_waves: int = 1,
+        mega_latency_us: float = 5000.0,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -424,7 +498,13 @@ class NativeRingDispatcher(BatchDispatcher):
         self._tags: dict[int, tuple[EngineOp, Future]] = {}
         self._tag_lock = threading.Lock()
         self._tag_seq = itertools.count(1)
-        super().__init__(runner, sink, hub, window_ms, max_batch, metrics)
+        # The queue-extension controller only runs in the python-queue
+        # drain loop (this class's _run pops the native ring at its own
+        # batching window); the RUNNER still stacks whenever one pop
+        # spans multiple waves, so the params pass through for that.
+        super().__init__(runner, sink, hub, window_ms, max_batch, metrics,
+                         mega_max_waves=mega_max_waves,
+                         mega_latency_us=mega_latency_us)
 
     def submit(self, op: EngineOp) -> Future:
         fut: Future = Future()

@@ -53,6 +53,7 @@ from matching_engine_tpu.domain.order import owner_hash
 from matching_engine_tpu.proto import MARKET_FOK, pb2
 from matching_engine_tpu.storage.storage import FillRow
 from matching_engine_tpu.utils.metrics import Metrics, Timer
+from matching_engine_tpu.utils.obs import warn_rate_limited
 from matching_engine_tpu.utils.tracing import step_annotation
 
 
@@ -164,8 +165,16 @@ class EngineRunner:
     def __init__(self, cfg: EngineConfig, metrics: Metrics | None = None,
                  mesh=None, hub=None, pipeline_inflight: int = 2,
                  oid_offset: int = 0, oid_stride: int = 1, device=None,
-                 owns_filter=None):
+                 owns_filter=None, megadispatch_max_waves: int = 1):
         self.cfg = cfg
+        # Megadispatch (single-device dense path only): stack up to this
+        # many [S, B, 7] waves per device call and run ONE jit'd lax.scan
+        # over them (kernel.engine_step_mega) — one XLA dispatch amortized
+        # over the stack, with device-side completion compaction bounding
+        # the readback to O(real ops). 1 (the default) keeps today's
+        # serial per-wave schedule exactly; any value is bit-identical to
+        # it by construction (tests/test_megadispatch.py).
+        self.megadispatch_max_waves = max(1, int(megadispatch_max_waves))
         self.metrics = metrics or Metrics()
         self._snapshot_lock = threading.Lock()
         # Held for a FULL dispatch (device step + host directory mutation);
@@ -486,8 +495,14 @@ class EngineRunner:
         except BaseException as e:  # noqa: BLE001 — the failed batch must
             # not poison the CURRENT caller (it belongs to a previous drain
             # iteration); _finish_locked already rolled back registrations.
-            # (dispatch_errors is counted ONCE, by the edge callback.)
-            print(f"[runner] pending dispatch failed: {type(e).__name__}: {e}")
+            # (dispatch_errors is counted ONCE, by the edge callback —
+            # that counter is the alert signal; the log line is for the
+            # human and rate-limits like every sink/hub failure print: a
+            # persistently-failing device would otherwise spam stdout at
+            # batch frequency exactly when the operator needs it.)
+            warn_rate_limited(
+                "runner-pending",
+                f"[runner] pending dispatch failed: {type(e).__name__}: {e}")
             result, err = None, e
         post = cb(result, err)
         if post is not None:
@@ -724,6 +739,11 @@ class EngineRunner:
                 sparse, nreal, out = item
                 results, fills, overflow, dec = decode_sparse_step(
                     sparse, nreal, out)
+                self.metrics.inc(
+                    "readback_bytes",
+                    out.small.size * 4
+                    + (out.fills.size * 4
+                       if dec.fill_count > dec.fills_inline.shape[1] else 0))
                 self._account(results, fills, overflow, by_handle, res,
                               terminal_makers)
                 if self._build_md:
@@ -762,11 +782,15 @@ class EngineRunner:
 
         if host_orders:
             self.metrics.inc("dense_dispatches")
+        arrays = build_batch_arrays(self.cfg, host_orders)
+        if (self._sharded is None and self.megadispatch_max_waves > 1
+                and len(arrays) > 1):
+            return self._prepare_mega(arrays, by_handle, res,
+                                      terminal_makers, timeline=timeline)
         if timeline is not None:
             timeline.shape = "mesh" if self._sharded is not None else "dense"
         touched_syms: set[int] = set()
         last_out = None  # StepOutput (mesh) or DenseDecoded (1-device)
-        arrays = build_batch_arrays(self.cfg, host_orders)
 
         def account_dense(results, fills, overflow, out):
             nonlocal last_out
@@ -812,6 +836,11 @@ class EngineRunner:
                 arr, pout = item
                 results, fills, overflow, out = decode_step_packed(
                     self.cfg, batch_view(arr), pout)
+                self.metrics.inc(
+                    "readback_bytes",
+                    pout.small.size * 4
+                    + (pout.fills.size * 4
+                       if out.fill_count > out.fills_inline.shape[1] else 0))
                 account_dense(results, fills, overflow, out)
 
         def finalize_dense():
@@ -819,6 +848,71 @@ class EngineRunner:
                 self._market_data(last_out, touched_syms, res)
 
         return len(arrays), dispatch_dense(), decode_dense, finalize_dense
+
+    def _prepare_mega(self, arrays, by_handle, res: DispatchResult,
+                      terminal_makers: set[int], timeline=None):
+        """The megadispatch dispatch shape: chunk the dispatch's waves
+        into stacks of up to megadispatch_max_waves, run each stack
+        through kernel.engine_step_mega's single lax.scan on the donated
+        book, and decode the compacted readback wave-by-wave in order —
+        so every host consequence (directory mutations, storage rows,
+        stream events, eviction order) is bit-identical to the serial
+        per-wave schedule (tests/test_megadispatch.py pins it on both
+        kernels). Each staged item pins one stack's outputs in HBM, the
+        same total as the serial waves it replaces, so the PIPELINE_DEPTH
+        deferral bound keeps its meaning unchanged."""
+        from matching_engine_tpu.engine import kernel as _kernel
+        from matching_engine_tpu.engine.harness import decode_step_mega
+
+        self.metrics.inc("dense_dispatches")
+        m_cap = self.megadispatch_max_waves
+        if timeline is not None:
+            timeline.shape = "mega"
+            timeline.mega_m = min(m_cap, len(arrays))
+        chunks = [arrays[i:i + m_cap] for i in range(0, len(arrays), m_cap)]
+        touched_syms: set[int] = set()
+        last_dec: list = [None]
+
+        def dispatch_mega():
+            for group in chunks:
+                m = len(group)
+                # The host built the lane arrays, so every wave's real-op
+                # count is known exactly: the compacted-completion buffer
+                # (bucketed) can never truncate.
+                rcap = _kernel.mega_result_cap(
+                    self.cfg,
+                    max(int(np.count_nonzero(a[:, :, 0])) for a in group))
+                stacked = np.stack(group)
+                self._step_num += 1
+                with self._snapshot_lock, step_annotation(
+                        "engine_step_mega", self._step_num):
+                    self.book, mout = _kernel.engine_step_mega(
+                        self.cfg, self.book, stacked, rcap)
+                self.metrics.inc("megadispatch_steps")
+                self.metrics.inc("megadispatch_stacked_waves", m)
+                yield m, rcap, mout
+
+        def decode_mega(item):
+            m, rcap, mout = item
+            waves, dec, fetched_full = decode_step_mega(
+                self.cfg, mout, m, rcap)
+            self.metrics.inc(
+                "readback_bytes",
+                mout.small.size * 4
+                + (mout.fills.size * 4 if fetched_full else 0))
+            for results, fills, overflow in waves:
+                self._account(results, fills, overflow, by_handle, res,
+                              terminal_makers)
+                touched_syms.update(r.sym for r in results)
+            last_dec[0] = dec
+
+        def finalize_mega():
+            # MegaDecoded carries the FINAL book's top-of-book — identical
+            # to the serial schedule's last-wave market data.
+            if last_dec[0] is not None and touched_syms and self._build_md:
+                self._market_data(last_dec[0], touched_syms, res)
+
+        return len(arrays), dispatch_mega(), decode_mega, finalize_mega
 
     # -- call auction ------------------------------------------------------
 

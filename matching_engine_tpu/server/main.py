@@ -144,6 +144,8 @@ def build_server(
     feed_spill_dir: str | None = None,
     stream_maxsize: int = 1024,
     serve_shards: int = 1,
+    megadispatch_max_waves: int = 1,
+    megadispatch_latency_us: float = 5000.0,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -163,11 +165,26 @@ def build_server(
     edge, one (ring → dispatcher thread → runner) column per shard, each
     pinned to its own device when several are visible. Incompatible with
     --mesh (the ShardedEngine path keeps the market-wide formulation).
+
+    With megadispatch_max_waves=M (> 1) the Python dispatch path
+    coalesces deep-queue backlogs into stacked device scans (one XLA
+    dispatch per M waves, compacted readback — engine_runner._prepare_mega
+    + the dispatcher's adaptive controller). M=1 (the default) keeps
+    today's serial schedule exactly; output is bit-identical either way.
+    Single-device python-route only: --native-lanes builds its lanes
+    wave-by-wave in C++, and --mesh decodes from shards, so both ignore
+    it (logged at boot).
     """
     from matching_engine_tpu import native as _me_native
 
     if serve_shards > 1 and mesh is not None:
         raise SystemExit(3)  # partitioned lanes vs mesh: pick one
+    if megadispatch_max_waves > 1 and (native_lanes or mesh is not None):
+        # The lane engine stages waves in C++ and the mesh decodes from
+        # addressable shards — neither routes through the stacked scan.
+        print("[SERVER] --megadispatch-max-waves applies to the Python "
+              "dispatch path only; ignoring it on this configuration")
+        megadispatch_max_waves = 1
 
     if native_lanes:
         if mesh is not None:
@@ -213,7 +230,8 @@ def build_server(
             return NativeLanesRunner(cfg, metrics, hub=hub,
                                      pipeline_inflight=pipeline_inflight)
         return EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
-                            pipeline_inflight=pipeline_inflight)
+                            pipeline_inflight=pipeline_inflight,
+                            megadispatch_max_waves=megadispatch_max_waves)
 
     # STP identity registry loads BEFORE any restore/recovery replay — the
     # replay derives owner lanes via _owner_for, and a hash-colliding
@@ -251,7 +269,8 @@ def build_server(
                 lambda _i=i: make_lane_runner(
                     cfg, router, _i, metrics=metrics, hub=hub,
                     pipeline_inflight=pipeline_inflight,
-                    native_lanes=native_lanes),
+                    native_lanes=native_lanes,
+                    megadispatch_max_waves=megadispatch_max_waves),
                 storage, owner_rows,
                 os.path.join(checkpoint_dir, f"shard-{i}")
                 if checkpoint_dir else None,
@@ -358,7 +377,9 @@ def build_server(
             lane.dispatcher = make_lane_dispatcher(
                 lane.runner, sink=sink, hub=hub, window_ms=window_ms,
                 metrics=metrics, native=use_native,
-                native_lanes=native_lanes)
+                native_lanes=native_lanes,
+                mega_max_waves=megadispatch_max_waves,
+                mega_latency_us=megadispatch_latency_us)
         shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
         dispatcher = lanes[0].dispatcher
     else:
@@ -383,11 +404,15 @@ def build_server(
             )
         elif use_native:
             dispatcher = NativeRingDispatcher(
-                runner, sink=sink, hub=hub, window_ms=window_ms
+                runner, sink=sink, hub=hub, window_ms=window_ms,
+                mega_max_waves=megadispatch_max_waves,
+                mega_latency_us=megadispatch_latency_us,
             )
         else:
-            dispatcher = BatchDispatcher(runner, sink=sink, hub=hub,
-                                         window_ms=window_ms)
+            dispatcher = BatchDispatcher(
+                runner, sink=sink, hub=hub, window_ms=window_ms,
+                mega_max_waves=megadispatch_max_waves,
+                mega_latency_us=megadispatch_latency_us)
     if log:
         layer = ("native lanes (C++ build+decode)" if native_lanes
                  else "native (C++)" if use_native else "python")
@@ -520,6 +545,23 @@ def main(argv=None) -> int:
                         "oracle-parity; sorted is O(CAP) per order for "
                         "deep books)")
     p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
+    p.add_argument("--megadispatch-max-waves", type=int, default=1,
+                   metavar="M",
+                   help="coalesce up to M queued dispatch batches into ONE "
+                        "stacked device scan when the queue is deep "
+                        "(engine_runner._prepare_mega + the dispatcher's "
+                        "adaptive controller): one XLA dispatch amortized "
+                        "over M waves, compacted completion readback. 1 "
+                        "(default) = off, exactly today's serial schedule; "
+                        "output is bit-identical at any M. Python dispatch "
+                        "path only (--native-lanes / --mesh ignore it)")
+    p.add_argument("--megadispatch-latency-us", type=float, default=5000.0,
+                   metavar="US",
+                   help="latency budget for the coalescing controller: M "
+                        "is clamped so a stacked dispatch's estimated "
+                        "turnaround (per-wave cost EMA x M) stays under "
+                        "this many microseconds — deep queues amortize "
+                        "dispatches without unbounded batching latency")
     p.add_argument("--pipeline-inflight", type=int, default=2,
                    help="staged-but-undecoded dispatches kept in flight "
                         "(decode stays FIFO; >1 hides the per-batch decode "
@@ -653,6 +695,8 @@ def main(argv=None) -> int:
             feed_spill_dir=args.feed_spill_dir,
             stream_maxsize=args.stream_queue,
             serve_shards=args.serve_shards,
+            megadispatch_max_waves=args.megadispatch_max_waves,
+            megadispatch_latency_us=args.megadispatch_latency_us,
         )
     except SystemExit as e:
         return int(e.code or 3)

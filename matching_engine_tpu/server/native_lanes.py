@@ -121,11 +121,19 @@ class NativeLanesRunner(EngineRunner):
 
     def __init__(self, cfg: EngineConfig, metrics=None, hub=None,
                  pipeline_inflight: int = 2, oid_offset: int = 0,
-                 oid_stride: int = 1, device=None, owns_filter=None):
+                 oid_stride: int = 1, device=None, owns_filter=None,
+                 megadispatch_max_waves: int = 1):
+        # megadispatch_max_waves is accepted for constructor parity with
+        # EngineRunner (shards.make_lane_runner passes it uniformly) but
+        # the native record path stages its own lane buffers wave-by-wave
+        # (me_lanes.cpp mirrors the serial schedule); only the Python
+        # EngineOp path (boot recovery replay) could ever stack — and it
+        # is bit-identical either way.
         super().__init__(cfg, metrics, mesh=None, hub=hub,
                          pipeline_inflight=pipeline_inflight,
                          oid_offset=oid_offset, oid_stride=oid_stride,
-                         device=device, owns_filter=owns_filter)
+                         device=device, owns_filter=owns_filter,
+                         megadispatch_max_waves=megadispatch_max_waves)
         self.lanes = me_native.NativeLanes(
             cfg.num_symbols, cfg.batch, fill_inline_count(cfg), cfg.max_fills)
         if self.oid_stride != 1:

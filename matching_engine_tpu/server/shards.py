@@ -285,7 +285,8 @@ class ServingShards:
 
 def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
                      metrics=None, hub=None, pipeline_inflight: int = 2,
-                     native_lanes: bool = False, devices=None):
+                     native_lanes: bool = False, devices=None,
+                     megadispatch_max_waves: int = 1):
     """One lane's runner over a K-way split of `cfg`: the shard gets
     ``cfg.num_symbols // K`` engine rows, the strided OID residue class
     `shard_id`, the shard-ownership filter, and — when more than one
@@ -313,13 +314,19 @@ def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
     return cls(shard_cfg, metrics, hub=hub,
                pipeline_inflight=pipeline_inflight,
                oid_offset=shard_id, oid_stride=k, device=device,
-               owns_filter=owns)
+               owns_filter=owns,
+               megadispatch_max_waves=megadispatch_max_waves)
 
 
 def make_lane_dispatcher(runner, *, sink=None, hub=None,
                          window_ms: float = 2.0, metrics=None,
-                         native: bool = False, native_lanes: bool = False):
-    """One lane's dispatcher (its own ring + drain thread)."""
+                         native: bool = False, native_lanes: bool = False,
+                         mega_max_waves: int = 1,
+                         mega_latency_us: float = 5000.0):
+    """One lane's dispatcher (its own ring + drain thread). Each lane
+    runs its own megadispatch coalescing controller over its own queue
+    (the decision is a per-lane queue-depth function; a venue-wide M
+    would couple lanes the partition exists to decouple)."""
     from matching_engine_tpu.server.dispatcher import (
         BatchDispatcher,
         LaneRingDispatcher,
@@ -331,9 +338,12 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
                                   window_ms=window_ms, metrics=metrics)
     if native:
         return NativeRingDispatcher(runner, sink=sink, hub=hub,
-                                    window_ms=window_ms, metrics=metrics)
+                                    window_ms=window_ms, metrics=metrics,
+                                    mega_max_waves=mega_max_waves,
+                                    mega_latency_us=mega_latency_us)
     return BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms,
-                           metrics=metrics)
+                           metrics=metrics, mega_max_waves=mega_max_waves,
+                           mega_latency_us=mega_latency_us)
 
 
 def build_serving_shards(
@@ -349,6 +359,8 @@ def build_serving_shards(
     native_lanes: bool = False,
     with_dispatchers: bool = True,
     sample_interval_s: float = 1.0,
+    megadispatch_max_waves: int = 1,
+    megadispatch_latency_us: float = 5000.0,
 ) -> ServingShards:
     """Wire K (runner → dispatcher) lanes over a K-way split of `cfg`.
 
@@ -360,12 +372,15 @@ def build_serving_shards(
     for i in range(num_shards):
         runner = make_lane_runner(
             cfg, router, i, metrics=metrics, hub=hub,
-            pipeline_inflight=pipeline_inflight, native_lanes=native_lanes)
+            pipeline_inflight=pipeline_inflight, native_lanes=native_lanes,
+            megadispatch_max_waves=megadispatch_max_waves)
         dispatcher = None
         if with_dispatchers:
             dispatcher = make_lane_dispatcher(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
-                metrics=metrics, native=native, native_lanes=native_lanes)
+                metrics=metrics, native=native, native_lanes=native_lanes,
+                mega_max_waves=megadispatch_max_waves,
+                mega_latency_us=megadispatch_latency_us)
         lanes.append(ServingLane(i, runner, dispatcher))
     return ServingShards(lanes, router, metrics=metrics, sink=sink,
                          sample_interval_s=sample_interval_s)

@@ -69,7 +69,7 @@ class DispatchTimeline:
 
     __slots__ = ("path", "n_ops", "t_enqueue", "t_pop", "t_build",
                  "t_issue", "t_decode", "t_publish", "shape", "waves",
-                 "counters")
+                 "mega_m", "counters")
 
     def __init__(self, path: str, n_ops: int, t_enqueue: float | None = None,
                  t_pop: float | None = None):
@@ -81,8 +81,9 @@ class DispatchTimeline:
         self.t_issue = None
         self.t_decode = None
         self.t_publish = None
-        self.shape = ""              # "sparse" | "dense" | "mesh"
+        self.shape = ""              # "sparse" | "dense" | "mesh" | "mega"
         self.waves = 0
+        self.mega_m = 1              # waves stacked per device call (mega)
         self.counters: dict = {}
 
     def stamp_build(self) -> None:
@@ -133,6 +134,7 @@ class DispatchTimeline:
             "ops": self.n_ops,
             "shape": self.shape,
             "waves": self.waves,
+            "mega_m": self.mega_m,
             "stages_us": {k: round(v, 1) for k, v in stages.items()},
             "counters": dict(self.counters),
         }

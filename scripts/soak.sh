@@ -224,6 +224,77 @@ SH_COLLISIONS=$(echo "$SH_COLLISIONS" | tail -1 | tr -d '[:space:]')
 [ "$SH_COLLISIONS" = "0" ] \
   || { echo "FAIL: $SH_COLLISIONS cross-lane order-id collision(s) in the sharded store"; exit 1; }
 
+# ---- megadispatch round: coalesced device scans ---------------------------
+# Boots a third server with --megadispatch-max-waves 4 on a fresh store
+# (python dispatch route: the coalescing controller + stacked scan live
+# there), reuses the per-round bench + sequenced subscriber + metrics
+# scrape, then fails the round on a broken subscriber, a store that
+# fails the integrity audit, or missing me_megadispatch_* metrics.
+MD_DB="$WORK/soak_mega.db"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$MD_DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --no-native --megadispatch-max-waves 4 --metrics-port 0 \
+  ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_mega.log" 2>&1 &
+MD_SRV=$!
+trap 'kill $SRV $MD_SRV 2>/dev/null' EXIT
+MD_PY=""; MD_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  MD_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_mega.log" | head -1)
+  MD_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_mega.log" | head -1)
+  [ -n "$MD_PY" ] && [ -n "$MD_OBS" ] && break
+  kill -0 $MD_SRV 2>/dev/null || { echo "FAIL: megadispatch server died at boot"; tail -5 "$WORK/server_mega.log"; exit 1; }
+  sleep 1
+done
+[ -n "$MD_PY" ] && [ -n "$MD_OBS" ] || { echo "FAIL: megadispatch server ports never appeared"; exit 1; }
+MD_FEED="$FEED_DIR/mega.json"
+python -m matching_engine_tpu.client.cli subscribe "127.0.0.1:$MD_PY" \
+  md SOAK --idle-exit 60 --quiet \
+  --summary-json "$MD_FEED" >/dev/null 2>"$FEED_DIR/mega.err" &
+MD_FEED_PID=$!
+MD_OK=$("$CLI" bench "127.0.0.1:$MD_PY" 8 100 12 4 2>/dev/null \
+  | python -c "import json,sys
+try: print(json.loads(sys.stdin.read())['ok'])
+except Exception: print(0)")
+python - "$MD_OBS" >> "$METRICS_OUT" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-megadispatch {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+kill -INT $MD_FEED_PID 2>/dev/null || true
+wait $MD_FEED_PID; MD_FEED_RC=$?
+if [ "$MD_FEED_RC" -eq 4 ]; then
+  echo "FAIL: unrecovered feed gap in the megadispatch round"
+  cat "$FEED_DIR/mega.err"; exit 1
+fi
+# Any other non-zero exit (or a missing summary) means the integrity
+# probe itself broke — a round that "passes" with a dead subscriber
+# verified nothing (same contract as the main loop's rounds).
+if [ "$MD_FEED_RC" -ne 0 ] || [ ! -s "$MD_FEED" ]; then
+  echo "FAIL: feed subscriber broke in the megadispatch round (rc=$MD_FEED_RC)"
+  cat "$FEED_DIR/mega.err"; exit 1
+fi
+kill $MD_SRV 2>/dev/null; wait $MD_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+[ "$MD_OK" -gt 0 ] || { echo "FAIL: megadispatch round served no orders"; exit 1; }
+grep -q "^me_megadispatch_" "$METRICS_OUT" \
+  || { echo "FAIL: me_megadispatch_* metrics absent from the scrape"; exit 1; }
+MD_AUDIT=$(python - "$MD_DB" <<'EOF'
+import sys
+sys.path.insert(0, "scripts")
+from audit import audit
+print(len(audit(sys.argv[1])))
+EOF
+)
+MD_AUDIT=$(echo "$MD_AUDIT" | tail -1 | tr -d '[:space:]')
+[ "$MD_AUDIT" = "0" ] \
+  || { echo "FAIL: $MD_AUDIT store integrity violation(s) in the megadispatch round"; exit 1; }
+
 sleep 2
 AUDIT=$(python - "$DB" <<'EOF'
 import sys
@@ -263,6 +334,8 @@ artifact = {
              "max_subscriber_lag": max_lag},
     "sharded_round": {"serve_shards": 2, "orders_ok": $SH_OK,
                       "id_collisions": int("$SH_COLLISIONS" or -1)},
+    "megadispatch_round": {"max_waves": 4, "orders_ok": $MD_OK,
+                           "audit_violations": int("$MD_AUDIT" or -1)},
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))

@@ -1,0 +1,398 @@
+"""Megadispatch (coalesced multi-batch device scan) parity + compaction.
+
+The megadispatch path (kernel.engine_step_mega via
+engine_runner._prepare_mega, coalesced by the dispatcher's adaptive
+controller) must be INDISTINGUISHABLE from the serial per-wave schedule:
+same fills, statuses, storage rows, stream protos, feed seq lines, books,
+directories, and allocators — `--megadispatch-max-waves 1` (the default)
+IS the serial schedule, so M>1 is pinned bit-identical to it here on both
+kernels. Plus unit coverage for the device-side completion compaction
+(kernel.compact_rows under vmap; zero fills / all-lanes-full / mid-batch
+cancel at the mega-step level) and the pipelined-FIFO interleave
+(a megadispatch staged behind a normal dispatch decodes in order).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    batch_view,
+    build_batch_arrays,
+    decode_step_mega,
+    decode_step_packed,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import (
+    BUY,
+    CANCELED,
+    FILLED,
+    NEW,
+    OP_AMEND,
+    OP_CANCEL,
+    OP_SUBMIT,
+    SELL,
+    compact_rows,
+    engine_step_mega,
+    engine_step_packed,
+    mega_result_cap,
+)
+from matching_engine_tpu.engine.harness import HostOrder
+from matching_engine_tpu.server.dispatcher import BatchDispatcher
+from matching_engine_tpu.server.engine_runner import (
+    EngineOp,
+    EngineRunner,
+    OrderInfo,
+)
+
+S, CAP, B = 4, 16, 4
+
+
+def make_cfg(kernel: str) -> EngineConfig:
+    return EngineConfig(num_symbols=S, capacity=CAP, batch=B,
+                        max_fills=1 << 10, kernel=kernel)
+
+
+# -- unit: the prefix-sum gather compaction ----------------------------------
+
+
+def _ref_compact(mask, cols, out_len):
+    idx = np.nonzero(mask)[0][:out_len]
+    packed = []
+    for c in cols:
+        buf = np.zeros(out_len, dtype=np.int32)
+        buf[:len(idx)] = np.asarray(c)[idx]
+        packed.append(buf)
+    return packed, min(int(mask.sum()), out_len)
+
+
+@pytest.mark.parametrize("case", ["zero", "full", "random", "truncate"])
+def test_compact_rows_under_vmap(case):
+    """compact_rows is the device-side completion/fill packer inside the
+    mega scan's vmap/scan nest: pin it against a numpy reference under
+    jax.vmap for the degenerate shapes the kernel meets — no masked rows
+    (zero fills), every row masked (all lanes full), mixed, and more
+    rows than the output buffer (trash-slot truncation)."""
+    rng = np.random.default_rng(3)
+    n, out_len, batch = 32, 16, 5
+    if case == "zero":
+        masks = np.zeros((batch, n), dtype=bool)
+    elif case == "full":
+        masks = np.ones((batch, n), dtype=bool)
+        out_len = n
+    elif case == "truncate":
+        masks = np.ones((batch, n), dtype=bool)  # 32 rows into 16 slots
+    else:
+        masks = rng.random((batch, n)) < 0.4
+    vals = rng.integers(1, 1000, size=(batch, 2, n)).astype(np.int32)
+
+    packed, counts = jax.vmap(
+        lambda m, v: compact_rows(m, (v[0], v[1]), out_len)
+    )(jnp.asarray(masks), jnp.asarray(vals))
+
+    for i in range(batch):
+        ref_cols, ref_count = _ref_compact(masks[i], vals[i], out_len)
+        assert int(counts[i]) == ref_count
+        for got, ref in zip(packed, ref_cols):
+            assert np.array_equal(np.asarray(got[i]), ref), (case, i)
+
+
+# -- unit: mega step vs serial waves at the kernel boundary ------------------
+
+
+def _serial_waves(cfg, arrays):
+    book = init_book(cfg)
+    out = []
+    for arr in arrays:
+        book, pout = engine_step_packed(cfg, book, arr)
+        out.append(decode_step_packed(cfg, batch_view(arr), pout)[:3])
+    return book, out
+
+
+def _mega_waves(cfg, arrays):
+    book = init_book(cfg)
+    rcap = mega_result_cap(
+        cfg, max(int(np.count_nonzero(a[:, :, 0])) for a in arrays))
+    book, mout = engine_step_mega(cfg, book, np.stack(arrays), rcap)
+    waves, _, _ = decode_step_mega(cfg, mout, len(arrays), rcap)
+    return book, waves
+
+
+def _assert_step_parity(cfg, orders):
+    arrays = build_batch_arrays(cfg, orders)
+    assert len(arrays) > 1, "stream must span multiple waves"
+    book_a, serial = _serial_waves(cfg, arrays)
+    book_b, mega = _mega_waves(cfg, arrays)
+    assert serial == mega
+    assert snapshot_books(book_a) == snapshot_books(book_b)
+    return mega
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_mega_step_zero_fills(kernel):
+    """Non-crossing rests only: every wave's compacted fill log is empty
+    and the completion rows still decode bit-identically."""
+    cfg = make_cfg(kernel)
+    orders = [
+        HostOrder(sym=i % S, op=OP_SUBMIT, side=BUY if i % 2 else SELL,
+                  price=9_000 - 50 * (i % 7) if i % 2 else 11_000 + 50 * (i % 7),
+                  qty=3, oid=i + 1)
+        for i in range(3 * S * B)
+    ]
+    mega = _assert_step_parity(cfg, orders)
+    assert all(not fills for _, fills, _ in mega)
+    assert all(r.filled == 0 for results, _, _ in mega for r in results)
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_mega_step_all_lanes_full(kernel):
+    """Every grid row of every wave carries a real op (the compaction's
+    count == rcap edge) and the crossing flow produces fills in every
+    wave."""
+    cfg = make_cfg(kernel)
+    orders = []
+    oid = 0
+    for w in range(3):
+        for sym in range(S):
+            for row in range(B):
+                oid += 1
+                side = BUY if (row + w) % 2 else SELL
+                orders.append(HostOrder(
+                    sym=sym, op=OP_SUBMIT, side=side, price=10_000,
+                    qty=2, oid=oid))
+    mega = _assert_step_parity(cfg, orders)
+    assert all(len(results) == S * B for results, _, _ in mega)
+    assert any(fills for _, fills, _ in mega)
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_mega_step_mid_batch_cancel(kernel):
+    """A maker partially filled in wave 1 and canceled mid-wave-2 (with
+    more flow behind the cancel in the same wave): the scan's carry must
+    replay the exact serial event order across the stacked waves."""
+    cfg = make_cfg(kernel)
+    orders = []
+    oid = 0
+    for sym in range(S):
+        oid += 1
+        maker = oid
+        orders.append(HostOrder(sym=sym, op=OP_SUBMIT, side=BUY,
+                                price=10_000, qty=10, oid=maker))
+        for _ in range(B - 1):  # pad wave 1
+            oid += 1
+            orders.append(HostOrder(sym=sym, op=OP_SUBMIT, side=BUY,
+                                    price=9_000, qty=1, oid=oid))
+        oid += 1  # wave 2: partial fill of the maker...
+        orders.append(HostOrder(sym=sym, op=OP_SUBMIT, side=SELL,
+                                price=10_000, qty=4, oid=oid))
+        orders.append(HostOrder(sym=sym, op=OP_CANCEL, side=BUY,
+                                oid=maker))  # ...then cancel its remainder
+        oid += 1  # and flow behind the cancel in the same wave
+        orders.append(HostOrder(sym=sym, op=OP_SUBMIT, side=SELL,
+                                price=9_000, qty=2, oid=oid))
+    mega = _assert_step_parity(cfg, orders)
+    # Wave 2 decodes the fill, then the cancel releasing remaining=6.
+    results2 = mega[1][0]
+    cancels = [r for r in results2 if r.status == CANCELED and r.remaining == 6]
+    assert len(cancels) == S
+
+
+# -- the serving-path parity oracle: M=4 vs M=1 over lifecycle fuzz ----------
+
+
+def _lane_setup():
+    from matching_engine_tpu.feed import FeedSequencer
+    from matching_engine_tpu.server.streams import StreamHub
+    from matching_engine_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    # Same fixed epoch on both sides: serialized stream protos (which
+    # carry seq AND feed_epoch after hub publish) must compare bit-equal.
+    hub = StreamHub(maxsize=4096, metrics=m,
+                    sequencer=FeedSequencer(metrics=m, depth=4096,
+                                            epoch=12345))
+    return m, hub
+
+
+def _drive(runner, hub, metrics, seed):
+    """Lifecycle fuzz through the full python serving surface: submits
+    across the collapsed (order_type, tif) codes, cancels and amends
+    (valid + stale + wrong-client), published through the hub (feed seq
+    stamping included) — a transcription of the dispatcher drain's
+    on_finish path."""
+    from matching_engine_tpu.server.dispatcher import publish_result
+
+    rng = random.Random(seed)
+    live: list[OrderInfo] = []
+    out = []
+    for _ in range(6):
+        ops = []
+        for _ in range(36):
+            r = rng.random()
+            if live and r < 0.18:
+                info = rng.choice(live)
+                ops.append(EngineOp(OP_CANCEL, info,
+                                    cancel_requester=info.client_id))
+                continue
+            if live and r < 0.30:
+                info = rng.choice(live)
+                ops.append(EngineOp(OP_AMEND, info,
+                                    amend_qty=rng.randrange(1, 12)))
+                continue
+            sym = f"S{rng.randrange(S)}"
+            otype = rng.choice((0, 0, 0, 1, 2, 3, 4))
+            assert runner.slot_acquire(sym) is not None
+            num, oid = runner.assign_oid()
+            qty = rng.randrange(1, 10)
+            info = OrderInfo(
+                oid=num, order_id=oid, client_id=f"c{num % 5}", symbol=sym,
+                side=rng.choice((BUY, SELL)), otype=otype,
+                price_q4=0 if otype in (1, 4)
+                else 10_000 + rng.randrange(-6, 7),
+                quantity=qty, remaining=qty, status=0,
+                handle=runner.assign_handle())
+            ops.append(EngineOp(OP_SUBMIT, info))
+            if otype == 0:
+                live.append(info)
+        box = {}
+
+        def on_finish(result, error):
+            assert error is None, error
+            publish_result(result, None, hub, metrics)
+            box["r"] = result
+            return None
+
+        runner.dispatch_pipelined(ops, on_finish)
+        runner.finish_pending()
+        r = box["r"]
+        out.append({
+            "outcomes": [(o.op.info.order_id, o.op.op, o.status, o.filled,
+                          o.remaining, o.error) for o in r.outcomes],
+            "orders": list(r.storage_orders),
+            "updates": list(r.storage_updates),
+            "fills": list(r.storage_fills),
+            "ou": [u.SerializeToString() for u in r.order_updates],
+            "md": [u.SerializeToString() for u in r.market_data],
+        })
+        live = [i for i in live if i.status in (NEW, 1)]
+    return out
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_megadispatch_parity_lifecycle_fuzz(kernel):
+    """M=4 serving output is bit-identical to the serial M=1 schedule:
+    completions, storage rows, stream protos INCLUDING the stamped feed
+    seq lines, final books, directories, and every allocator."""
+    cfg = make_cfg(kernel)
+    m1, hub1 = _lane_setup()
+    m4, hub4 = _lane_setup()
+    base = EngineRunner(cfg, m1, hub=hub1)
+    mega = EngineRunner(cfg, m4, hub=hub4, megadispatch_max_waves=4)
+
+    got1 = _drive(base, hub1, m1, seed=11)
+    got4 = _drive(mega, hub4, m4, seed=11)
+    for i, (a, b) in enumerate(zip(got1, got4)):
+        for key in a:
+            assert a[key] == b[key], f"dispatch {i}: {key} diverged"
+
+    assert snapshot_books(base.book) == snapshot_books(mega.book)
+    key = lambda i: (i.handle, i.oid, i.order_id, i.client_id, i.symbol,  # noqa: E731
+                     i.side, i.otype, i.price_q4, i.quantity, i.remaining,
+                     i.status)
+    assert sorted(map(key, mega.orders_by_handle.values())) == \
+        sorted(map(key, base.orders_by_handle.values()))
+    assert mega.symbols == base.symbols
+    assert mega.next_oid_num == base.next_oid_num
+    assert mega._next_handle == base._next_handle
+    assert mega._free_handles == base._free_handles
+    assert mega._free_slots == base._free_slots
+
+    # Feed seq lines: every (channel, key) domain advanced identically.
+    seq1, seq4 = hub1.sequencer, hub4.sequencer
+    doms1 = {k: r.last_seq for k, r in seq1._domains.items()}
+    doms4 = {k: r.last_seq for k, r in seq4._domains.items()}
+    assert doms1 == doms4 and doms1, "feed seq domains diverged"
+    # And the mega run actually exercised the stacked path.
+    counters, _ = m4.snapshot()
+    assert counters.get("megadispatch_steps", 0) > 0
+    assert counters["megadispatch_stacked_waves"] > \
+        counters["megadispatch_steps"]
+
+
+# -- pipelined-FIFO interleave ----------------------------------------------
+
+
+def _submit(runner, symbol, side, price, qty):
+    assert runner.slot_acquire(symbol) is not None
+    num, oid = runner.assign_oid()
+    return EngineOp(OP_SUBMIT, OrderInfo(
+        oid=num, order_id=oid, client_id=f"c-side{side}", symbol=symbol,
+        side=side, otype=0, price_q4=price, quantity=qty, remaining=qty,
+        status=0, handle=runner.assign_handle()))
+
+
+def test_mega_interleave_fifo_behind_normal_dispatch():
+    """A megadispatch staged behind a normal (single-wave) dispatch
+    decodes strictly after it, and the cross-dispatch match (the mega
+    batch's SELLs consuming the first batch's resting BUY) produces the
+    serial schedule's outcomes."""
+    cfg = make_cfg("matrix")
+    r = EngineRunner(cfg, megadispatch_max_waves=4, pipeline_inflight=4)
+    log: list = []
+
+    def collector(label):
+        def on_finish(result, error):
+            assert error is None, error
+
+            def post():
+                log.append((label, [(o.op.info.order_id, o.status)
+                                    for o in result.outcomes]))
+            return post
+        return on_finish
+
+    a = _submit(r, "X", BUY, 100, 2 * S * B)
+    r.dispatch_pipelined([a], collector("normal"))
+    assert r.has_pending
+    # Multi-wave batch: 2*B sells on one symbol -> 2 waves -> mega path.
+    sells = [_submit(r, "X", SELL, 100, 1) for _ in range(2 * B)]
+    r.dispatch_pipelined(sells, collector("mega"))
+    assert r.has_pending
+    r.finish_pending()
+    assert [e[0] for e in log] == ["normal", "mega"]
+    assert log[0][1] == [(a.info.order_id, NEW)]
+    assert all(st == FILLED for _, st in log[1][1])
+    assert a.info.remaining == 2 * S * B - 2 * B
+    c, _ = r.metrics.snapshot()
+    assert c.get("megadispatch_steps", 0) == 1
+    assert c["megadispatch_stacked_waves"] == 2
+
+
+def test_dispatcher_controller_coalesces_deep_queue():
+    """Flood the python dispatch queue past max_batch while megadispatch
+    is enabled: the controller must coalesce (me_megadispatch_* move),
+    the runner must stack waves, and every future still resolves with
+    the serial schedule's outcome."""
+    cfg = EngineConfig(num_symbols=S, capacity=128, batch=B,
+                       max_fills=1 << 10)  # capacity holds all 64 rests
+    r = EngineRunner(cfg, megadispatch_max_waves=4)
+    d = BatchDispatcher(r, window_ms=20.0, max_batch=8,
+                        mega_max_waves=4, mega_latency_us=10_000_000.0)
+    try:
+        # Enqueue before the window closes: one deep backlog on symbol X.
+        futs = [d.submit(_submit(r, "X", BUY, 100 + i, 1))
+                for i in range(64)]
+        outcomes = [f.result(timeout=30) for f in futs]
+        assert all(o.status == NEW for o in outcomes)
+    finally:
+        d.close()
+    c, g = r.metrics.snapshot()
+    assert c.get("megadispatch_coalesced", 0) >= 1
+    assert c["megadispatch_coalesced_ops"] >= 16
+    assert c.get("megadispatch_steps", 0) >= 1
+    assert g.get("megadispatch_m", 1) >= 1
