@@ -37,6 +37,44 @@ class RingFull(RuntimeError):
     would risk handle reuse against a possibly-live order."""
 
 
+def spin_get(q: queue.Queue, timeout_s: float | None, spin_s: float):
+    """queue.Queue.get with a bounded busy-poll before the condvar wait.
+
+    The --busy-poll-us tail lever: a condvar wakeup (producer put ->
+    consumer scheduled) costs tens of microseconds of scheduler latency
+    per drain cycle, which lands squarely in the queue-wait stage's tail.
+    Spinning get_nowait for up to `spin_s` catches an op arriving within
+    the spin window with no syscall; past it, the normal blocking get
+    takes over (deadline preserved), so semantics — and serving output —
+    are bit-identical to spin_s=0. Raises queue.Empty exactly like get().
+    """
+    if spin_s > 0.0:
+        t0 = time.perf_counter()
+        spin_deadline = t0 + (spin_s if timeout_s is None
+                              else min(spin_s, timeout_s))
+        while time.perf_counter() < spin_deadline:
+            try:
+                return q.get_nowait()
+            except queue.Empty:
+                pass
+        if timeout_s is not None:
+            timeout_s = max(0.0, t0 + timeout_s - time.perf_counter())
+    return q.get(timeout=timeout_s)
+
+
+def spin_result(fut: Future, timeout_s: float, spin_s: float):
+    """Future.result with a bounded busy-poll before the condvar wait —
+    the completion side of --busy-poll-us (the RPC thread's wakeup after
+    its op's dispatch decodes is the other condvar round trip on the
+    submit path). Identical result semantics to fut.result(timeout)."""
+    if spin_s > 0.0:
+        deadline = time.perf_counter() + spin_s
+        while time.perf_counter() < deadline:
+            if fut.done():
+                return fut.result(timeout=0)
+    return fut.result(timeout=timeout_s)
+
+
 def publish_result(result, sink, hub, metrics) -> None:
     """Enqueue one dispatch's storage/stream events. Shared by every drain
     loop (BatchDispatcher and GatewayBridge): a sink/hub failure must never
@@ -82,11 +120,17 @@ class BatchDispatcher:
         metrics: Metrics | None = None,
         mega_max_waves: int = 1,
         mega_latency_us: float = 5000.0,
+        busy_poll_us: float = 0.0,
     ):
         self.runner = runner
         self.sink = sink
         self.hub = hub
         self.window_s = window_ms / 1e3
+        # --busy-poll-us: spin this long before every condvar wait on the
+        # drain loop (spin_get) and, via the service reading this attr,
+        # on the RPC thread's completion wait (spin_result). 0 = off,
+        # exactly the historical blocking behavior.
+        self.busy_poll_s = max(0.0, busy_poll_us) / 1e6
         # Default: fill at most one full device dispatch per drain.
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
         self.metrics = metrics or runner.metrics
@@ -115,11 +159,13 @@ class BatchDispatcher:
         self._thread = threading.Thread(target=self._run, name="dispatcher", daemon=True)
         self._thread.start()
 
-    def submit(self, op: EngineOp) -> Future:
+    def submit(self, op: EngineOp, t_ingress: float | None = None) -> Future:
         """Enqueue one validated op; the future resolves to its OpOutcome.
-        The enqueue stamp is the queue-wait origin of the stage ledger."""
+        The enqueue stamp is the queue-wait origin of the stage ledger;
+        `t_ingress` (the RPC entry stamp, when the edge has one) lets a
+        sampled trace export show the edge-ingress span too."""
         fut: Future = Future()
-        self._q.put((op, fut, time.perf_counter()))
+        self._q.put((op, fut, time.perf_counter(), t_ingress))
         return fut
 
     def _queue_depth(self) -> int | None:
@@ -141,9 +187,12 @@ class BatchDispatcher:
                 # While a staged dispatch is pending on the runner, wake at
                 # window granularity so an idle lull finishes (decodes +
                 # completes) it instead of stranding its clients until the
-                # next op arrives.
-                first = self._q.get(
-                    timeout=self.window_s if self.runner.has_pending else None
+                # next op arrives. spin_get busy-polls first when
+                # --busy-poll-us is set (the queue-wait tail lever).
+                first = spin_get(
+                    self._q,
+                    self.window_s if self.runner.has_pending else None,
+                    self.busy_poll_s,
                 )
             except queue.Empty:
                 self.runner.finish_pending()
@@ -158,7 +207,7 @@ class BatchDispatcher:
                 if timeout <= 0:
                     break
                 try:
-                    item = self._q.get(timeout=timeout)
+                    item = spin_get(self._q, timeout, self.busy_poll_s)
                 except queue.Empty:
                     break
                 if item is None:
@@ -212,14 +261,18 @@ class BatchDispatcher:
 
     def _drain(self, batch) -> None:
         t0 = time.perf_counter()
-        ops = [op for op, _, _ in batch]
-        futs = {id(op): fut for op, fut, _ in batch}
+        ops = [op for op, _, _, _ in batch]
+        futs = {id(op): fut for op, fut, _, _ in batch}
         # Stage ledger: queue wait measured from the OLDEST op's enqueue
         # (the client-felt worst case for this dispatch); build/device/
-        # decode boundaries are stamped by the runner.
+        # decode boundaries are stamped by the runner. The ingress stamp
+        # (RPC entry, when the edge recorded one) extends a sampled trace
+        # export to the edge-ingress span.
+        ingresses = [ti for _, _, _, ti in batch if ti is not None]
         tl = DispatchTimeline(
             self.timeline_path, len(batch),
-            t_enqueue=min(t for _, _, t in batch), t_pop=t0)
+            t_enqueue=min(t for _, _, t, _ in batch), t_pop=t0,
+            t_ingress=min(ingresses) if ingresses else None)
         depth = self._queue_depth()
         if depth is not None:
             self.metrics.set_gauge("queue_depth", depth)
@@ -239,7 +292,7 @@ class BatchDispatcher:
                 tl.finish(self.metrics, error=error)
 
                 def fail():
-                    for _, fut, _ in batch:
+                    for _, fut, _, _ in batch:
                         if not fut.done():
                             fut.set_exception(error)
                     self.metrics.inc("dispatch_errors")
@@ -258,7 +311,7 @@ class BatchDispatcher:
                     if fut is not None and not fut.done():
                         fut.set_result(outcome)
                 # Any op the decode missed: fail loudly rather than hang.
-                for _, fut, _ in batch:
+                for _, fut, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError("op produced no outcome"))
@@ -315,6 +368,7 @@ class LaneRingDispatcher:
         max_batch: int | None = None,
         metrics: Metrics | None = None,
         ring_capacity: int = 1 << 16,
+        busy_poll_us: float = 0.0,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -323,12 +377,17 @@ class LaneRingDispatcher:
         self.runner = runner
         self.sink = sink
         self.hub = hub
+        # The drain's batching window runs inside the native ring pop, so
+        # busy-poll on this path covers the RPC threads' completion wait
+        # only (the service reads this attr for spin_result).
+        self.busy_poll_s = max(0.0, busy_poll_us) / 1e6
         self.window_us = max(1, int(window_ms * 1e3))
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
         self.metrics = metrics or runner.metrics
         self._ring = me_native.LaneRing(ring_capacity)
         self._rec = threading.local()  # per-RPC-thread scratch record
-        self._tags: dict[int, Future] = {}
+        # tag -> (future, t_enqueue, t_ingress | None)
+        self._tags: dict[int, tuple[Future, float, float | None]] = {}
         self._tag_lock = threading.Lock()
         self._tag_seq = itertools.count(1)
         self._stop = threading.Event()
@@ -339,7 +398,8 @@ class LaneRingDispatcher:
     def submit_record(self, op: int, side: int = 0, otype: int = 0,
                       price_q4: int = 0, quantity: int = 0,
                       symbol: bytes = b"", client_id: bytes = b"",
-                      order_id: bytes = b"") -> Future:
+                      order_id: bytes = b"",
+                      t_ingress: float | None = None) -> Future:
         """Enqueue one validated record; the future resolves to its
         LaneOutcome. Bit 63 routes the completion through the dispatch's
         local aux section instead of the gateway batch."""
@@ -355,7 +415,7 @@ class LaneRingDispatcher:
                             symbol=symbol, client_id=client_id,
                             order_id=order_id)
         with self._tag_lock:
-            self._tags[tag] = (fut, time.perf_counter())
+            self._tags[tag] = (fut, time.perf_counter(), t_ingress)
         if not self._ring.push(rec):
             with self._tag_lock:
                 self._tags.pop(tag, None)
@@ -374,20 +434,21 @@ class LaneRingDispatcher:
         with self._tag_lock:
             leftovers = list(self._tags.values())
             self._tags.clear()
-        for fut, _ in leftovers:
+        for fut, _, _ in leftovers:
             if not fut.done():
                 fut.set_exception(RuntimeError("dispatcher closed"))
 
-    def _earliest_enqueue(self, recs, n: int) -> float | None:
-        """Enqueue stamp of the batch's OLDEST record (peek, not pop —
-        completion still takes the tag). The ring is FIFO, so recs[0] is
-        the first pushed and its stamp bounds the batch's queue wait to
-        within the push/register race window; O(1) under the tag lock —
-        a per-record scan here would re-add per-op Python work to the
-        path built to avoid it."""
+    def _earliest_stamps(self, recs, n: int) -> tuple[float | None,
+                                                      float | None]:
+        """(enqueue, ingress) stamps of the batch's OLDEST record (peek,
+        not pop — completion still takes the tag). The ring is FIFO, so
+        recs[0] is the first pushed and its stamp bounds the batch's
+        queue wait to within the push/register race window; O(1) under
+        the tag lock — a per-record scan here would re-add per-op Python
+        work to the path built to avoid it."""
         with self._tag_lock:
             ent = self._tags.get(recs[0].tag) if n else None
-        return None if ent is None else ent[1]
+        return (None, None) if ent is None else (ent[1], ent[2])
 
     def _run(self) -> None:
         from matching_engine_tpu.server.native_lanes import (
@@ -406,8 +467,9 @@ class LaneRingDispatcher:
                 self.runner.finish_pending()
                 continue
             recs = snapshot_records(buf, n)
-            tl = DispatchTimeline("native-lanes", n,
-                                  t_enqueue=self._earliest_enqueue(recs, n))
+            t_enq, t_ing = self._earliest_stamps(recs, n)
+            tl = DispatchTimeline("native-lanes", n, t_enqueue=t_enq,
+                                  t_ingress=t_ing)
             self.metrics.set_gauge("inflight_ops", len(self._tags))
 
             def on_finish(result, error, recs=recs, n=n, tl=tl):
@@ -489,28 +551,35 @@ class NativeRingDispatcher(BatchDispatcher):
         ring_capacity: int = 1 << 16,
         mega_max_waves: int = 1,
         mega_latency_us: float = 5000.0,
+        busy_poll_us: float = 0.0,
     ):
         from matching_engine_tpu import native as me_native
 
         if not me_native.available():
             raise RuntimeError("native library unavailable")
         self._ring = me_native.NativeRing(ring_capacity)
-        self._tags: dict[int, tuple[EngineOp, Future]] = {}
+        # tag -> (op, future, t_enqueue, t_ingress | None)
+        self._tags: dict[int, tuple[EngineOp, Future, float,
+                                    float | None]] = {}
         self._tag_lock = threading.Lock()
         self._tag_seq = itertools.count(1)
         # The queue-extension controller only runs in the python-queue
         # drain loop (this class's _run pops the native ring at its own
         # batching window); the RUNNER still stacks whenever one pop
         # spans multiple waves, so the params pass through for that.
+        # busy_poll likewise: the batching window waits inside the
+        # native pop, so the spin only covers the service-side
+        # completion wait (spin_result via the attr).
         super().__init__(runner, sink, hub, window_ms, max_batch, metrics,
                          mega_max_waves=mega_max_waves,
-                         mega_latency_us=mega_latency_us)
+                         mega_latency_us=mega_latency_us,
+                         busy_poll_us=busy_poll_us)
 
-    def submit(self, op: EngineOp) -> Future:
+    def submit(self, op: EngineOp, t_ingress: float | None = None) -> Future:
         fut: Future = Future()
         tag = next(self._tag_seq)
         with self._tag_lock:
-            self._tags[tag] = (op, fut, time.perf_counter())
+            self._tags[tag] = (op, fut, time.perf_counter(), t_ingress)
         info = op.info
         # The payload fields mirror the op for native producers (the C++
         # front end pushes full records); the Python drain path keys off the
@@ -543,7 +612,7 @@ class NativeRingDispatcher(BatchDispatcher):
         with self._tag_lock:
             leftovers = list(self._tags.values())
             self._tags.clear()
-        for _, fut, _ in leftovers:
+        for _, fut, _, _ in leftovers:
             if not fut.done():
                 fut.set_exception(RuntimeError("dispatcher closed"))
 

@@ -36,8 +36,8 @@ from matching_engine_tpu.utils.checkpoint import (
     restore_runner,
 )
 from matching_engine_tpu.utils.metrics import Metrics
-from matching_engine_tpu.utils.obs import FlightRecorder, ObsServer
-from matching_engine_tpu.utils.tracing import trace
+from matching_engine_tpu.utils.obs import FlightRecorder, ObsServer, TraceExporter
+from matching_engine_tpu.utils.tracing import set_host_tracer, trace
 
 
 def recover_books(runner: EngineRunner, storage: Storage) -> int:
@@ -146,6 +146,11 @@ def build_server(
     serve_shards: int = 1,
     megadispatch_max_waves: int = 1,
     megadispatch_latency_us: float = 5000.0,
+    busy_poll_us: float = 0.0,
+    book_cache_ms: float = 0.0,
+    proto_reuse: bool = False,
+    trace_dir: str | None = None,
+    trace_sample_every: int = 64,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -206,6 +211,18 @@ def build_server(
     # holds `metrics` can record without constructor churn.
     recorder = FlightRecorder(dump_dir=flight_dir)
     metrics.recorder = recorder
+    # Back-reference so a dump can capture the megadispatch-controller /
+    # lane-balance gauges the tail spike happened under.
+    recorder.metrics = metrics
+    # Trace exporter (--trace-dir): sampled per-dispatch Chrome traces.
+    # Rides the registry like the recorder; host spans (tracing.span,
+    # sink commits) fold into the same file via the module-global hook.
+    tracer = None
+    if trace_dir:
+        tracer = TraceExporter(trace_dir, metrics=metrics,
+                               sample_every=trace_sample_every)
+        metrics.tracer = tracer
+        set_host_tracer(tracer)
     # Sequenced feed (feed/): every stream event gets a per-(channel, key)
     # monotonic seq at publish and lands in the retransmission store, so
     # reconnecting/slow clients recover via resume_from_seq instead of
@@ -379,7 +396,8 @@ def build_server(
                 metrics=metrics, native=use_native,
                 native_lanes=native_lanes,
                 mega_max_waves=megadispatch_max_waves,
-                mega_latency_us=megadispatch_latency_us)
+                mega_latency_us=megadispatch_latency_us,
+                busy_poll_us=busy_poll_us)
         shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
         dispatcher = lanes[0].dispatcher
     else:
@@ -400,19 +418,22 @@ def build_server(
             )
 
             dispatcher = LaneRingDispatcher(
-                runner, sink=sink, hub=hub, window_ms=window_ms
+                runner, sink=sink, hub=hub, window_ms=window_ms,
+                busy_poll_us=busy_poll_us,
             )
         elif use_native:
             dispatcher = NativeRingDispatcher(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
+                busy_poll_us=busy_poll_us,
             )
         else:
             dispatcher = BatchDispatcher(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
                 mega_max_waves=megadispatch_max_waves,
-                mega_latency_us=megadispatch_latency_us)
+                mega_latency_us=megadispatch_latency_us,
+                busy_poll_us=busy_poll_us)
     if log:
         layer = ("native lanes (C++ build+decode)" if native_lanes
                  else "native (C++)" if use_native else "python")
@@ -420,7 +441,9 @@ def build_server(
             layer += f" x {serve_shards} partitioned lanes"
         print(f"[SERVER] runtime layer: {layer}")
     service = MatchingEngineService(runner, dispatcher, hub, metrics,
-                                    log=log, shards=shards)
+                                    log=log, shards=shards,
+                                    book_cache_ms=book_cache_ms,
+                                    proto_reuse=proto_reuse)
 
     server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
     add_matching_engine_servicer(service, server)
@@ -461,7 +484,7 @@ def build_server(
         "metrics": metrics, "checkpointer": checkpointer,
         "checkpointers": checkpointers, "shards": shards,
         "bridge": bridge, "gateway_port": gateway_port,
-        "recorder": recorder, "sequencer": sequencer,
+        "recorder": recorder, "sequencer": sequencer, "tracer": tracer,
     }
     return server, port, parts
 
@@ -494,6 +517,10 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
         ckpt.close()
     parts["sink"].close()
     parts["storage"].close()
+    if parts.get("tracer") is not None:
+        # After the sink: its commit spans land before the finalize.
+        set_host_tracer(None)
+        parts["tracer"].close()
     if parts.get("recorder") is not None:
         # Last: the dump captures the fully-drained pipeline's tail.
         parts["recorder"].dump("shutdown")
@@ -581,6 +608,40 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler device trace of the whole "
                         "serving session into this directory (TensorBoard)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="export sampled per-dispatch Chrome trace_event "
+                        "JSON here (Perfetto / chrome://tracing loadable): "
+                        "every Nth dispatch (--trace-sample) plus every "
+                        "dispatch slower than the rolling p99, as nested "
+                        "pipeline-stage slices with host spans and sink "
+                        "commits on their own tracks. Bounded writer "
+                        "queue; a full disk degrades to a rate-limited "
+                        "warning + me_trace_write_errors_total, never a "
+                        "stalled dispatch (omit to disable)")
+    p.add_argument("--trace-sample", type=int, default=64, metavar="N",
+                   help="uniform trace sampling interval for --trace-dir: "
+                        "keep every Nth dispatch (slow outliers past the "
+                        "rolling p99 are always kept; default 64)")
+    p.add_argument("--busy-poll-us", type=float, default=0.0, metavar="US",
+                   help="tail lever: spin this long before every condvar "
+                        "wait on the dispatcher drain and the RPC "
+                        "completion wait, trading CPU for queue-wakeup "
+                        "scheduler latency (~tens of µs per hop in the "
+                        "p99). Output is bit-identical to 0 (the "
+                        "default, off); only worth enabling with spare "
+                        "cores — see docs/BENCH_METHOD.md §tail-latency")
+    p.add_argument("--book-cache-ms", type=float, default=0.0, metavar="MS",
+                   help="tail lever: serve GetOrderBook from a conflated "
+                        "latest-state cache with this TTL so book-read "
+                        "bursts never contend the snapshot lock the "
+                        "device step holds (staleness bounded by the "
+                        "TTL; 0 = off, always live)")
+    p.add_argument("--proto-reuse", action="store_true",
+                   help="tail lever: recycle unary completion protos "
+                        "per RPC thread instead of allocating per "
+                        "response (stream events are never reused — "
+                        "they alias subscriber queues and the feed "
+                        "store)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="serve Prometheus text-format /metrics (+ /healthz, "
                         "/readyz, /flightrecorder) on this port from a "
@@ -697,6 +758,11 @@ def main(argv=None) -> int:
             serve_shards=args.serve_shards,
             megadispatch_max_waves=args.megadispatch_max_waves,
             megadispatch_latency_us=args.megadispatch_latency_us,
+            busy_poll_us=args.busy_poll_us,
+            book_cache_ms=args.book_cache_ms,
+            proto_reuse=args.proto_reuse,
+            trace_dir=args.trace_dir,
+            trace_sample_every=args.trace_sample,
         )
     except SystemExit as e:
         return int(e.code or 3)

@@ -20,6 +20,7 @@ BatchDispatcher, and waits on the op's future; matching happens in dense
 
 from __future__ import annotations
 
+import threading
 import time
 
 import grpc
@@ -36,7 +37,11 @@ from matching_engine_tpu.engine.kernel import (
 )
 from matching_engine_tpu.proto import collapse_otype, pb2
 from matching_engine_tpu.proto.rpc import MatchingEngineServicer
-from matching_engine_tpu.server.dispatcher import BatchDispatcher, RingFull
+from matching_engine_tpu.server.dispatcher import (
+    BatchDispatcher,
+    RingFull,
+    spin_result,
+)
 from matching_engine_tpu.server.engine_runner import EngineOp, EngineRunner, OrderInfo
 from matching_engine_tpu.server.streams import StreamHub
 from matching_engine_tpu.utils.metrics import Metrics
@@ -52,6 +57,8 @@ class MatchingEngineService(MatchingEngineServicer):
         metrics: Metrics | None = None,
         log: bool = True,
         shards=None,  # server/shards.ServingShards | None
+        book_cache_ms: float = 0.0,
+        proto_reuse: bool = False,
     ):
         self.runner = runner
         self.dispatcher = dispatcher
@@ -63,10 +70,60 @@ class MatchingEngineService(MatchingEngineServicer):
         # amends by the order id's birth lane. self.runner/self.dispatcher
         # stay lane 0 for the shard-agnostic surfaces (metrics, streams).
         self.shards = shards
+        # --book-cache-ms: conflated latest-state book snapshots. A
+        # GetOrderBook burst otherwise contends the runner's snapshot
+        # lock — which every device step holds — so read traffic lands
+        # directly on the dispatch path's tail. With a TTL, reads within
+        # it are served from the last materialized response (staleness
+        # bounded by the TTL; same contract as a conflated feed channel).
+        self._book_cache_s = max(0.0, book_cache_ms) / 1e3
+        self._book_cache: dict[str, tuple[float, object]] = {}
+        # Eviction bound sized to the VENUE's symbol axis: under
+        # --serve-shards, runner is lane 0 and its cfg holds the K-way
+        # split — a per-lane bound would make an all-symbols read burst
+        # overflow-clear the cache it exists to serve.
+        k = shards.num_shards if shards is not None else 1
+        self._book_cache_cap = 4 * runner.cfg.num_symbols * k
+        # --proto-reuse: recycle one completion proto per (RPC thread,
+        # message type) instead of allocating per response. Safe because
+        # grpc serializes a unary response on the handler's own thread
+        # before that thread takes another RPC; stream events are NOT
+        # reused (they alias subscriber queues and the feed store).
+        self._proto_reuse = proto_reuse
+        self._tl_protos = threading.local()
 
     def _log(self, msg: str) -> None:
         if self.log:
             print(f"[SERVER] {msg}")
+
+    def _wait(self, fut, dispatcher, timeout: float = 30.0):
+        """The RPC thread's completion wait: busy-polls first when the
+        dispatcher carries --busy-poll-us (the wakeup after this op's
+        dispatch decodes is a condvar round trip squarely in the
+        client-felt tail), then blocks as before. Result semantics are
+        identical either way."""
+        return spin_result(fut, timeout,
+                           getattr(dispatcher, "busy_poll_s", 0.0))
+
+    def _completion(self, cls, **kw):
+        """Build a unary completion proto, recycling a thread-local
+        instance under --proto-reuse (allocation + field-descriptor
+        setup per response is measurable on the submit tail). Reuse is
+        safe for UNARY completions only: gRPC serializes the return
+        value on this worker thread before it picks up another RPC.
+        Never use for stream events — those alias subscriber queues and
+        the feed retransmission store long after the handler returns."""
+        if not self._proto_reuse:
+            return cls(**kw)
+        store = self._tl_protos.__dict__
+        msg = store.get(cls.__name__)
+        if msg is None:
+            msg = store[cls.__name__] = cls()
+        else:
+            msg.Clear()
+        for k, v in kw.items():
+            setattr(msg, k, v)
+        return msg
 
     # -- shard routing -----------------------------------------------------
 
@@ -146,7 +203,8 @@ class MatchingEngineService(MatchingEngineServicer):
         if err is not None:
             self.metrics.inc("orders_rejected")
             self._log(f"reject: {err}")
-            return pb2.OrderResponse(success=False, error_message=err)
+            return self._completion(pb2.OrderResponse, success=False,
+                                    error_message=err)
 
         price_q4 = (
             0 if request.order_type == pb2.MARKET
@@ -168,14 +226,18 @@ class MatchingEngineService(MatchingEngineServicer):
         try:
             # Always OP_SUBMIT here: auction-mode classification happens
             # in the runner under the dispatch lock (atomic with the
-            # RunAuction mode flip; the edge read would race).
-            outcome = dispatcher.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
+            # RunAuction mode flip; the edge read would race). t0 rides
+            # along so a sampled trace export shows the edge-ingress span.
+            outcome = self._wait(
+                dispatcher.submit(EngineOp(OP_SUBMIT, info), t_ingress=t0),
+                dispatcher)
         except RingFull:
             # Known-unqueued: the device never saw this op, recycle now.
             runner.release_unqueued(info)
             self.metrics.inc("orders_rejected")
             self._log(f"reject {order_id}: op ring full")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 order_id=order_id, success=False, error_message="server overloaded"
             )
         except Exception as e:  # noqa: BLE001 — engine failure => app-level reject
@@ -184,7 +246,8 @@ class MatchingEngineService(MatchingEngineServicer):
             # bounded leak beats handle reuse against a possibly-live order.
             self.metrics.inc("orders_errored")
             self._log(f"engine error for {order_id}: {e}")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 order_id=order_id, success=False, error_message="engine error"
             )
 
@@ -196,7 +259,8 @@ class MatchingEngineService(MatchingEngineServicer):
         if outcome.status == REJECTED and outcome.error:
             self.metrics.inc("orders_rejected")
             self._log(f"rejected {order_id}: {outcome.error} ({dur_us:.0f}us)")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 order_id=order_id, success=False, error_message=outcome.error
             )
         self.metrics.inc("orders_accepted")
@@ -204,7 +268,8 @@ class MatchingEngineService(MatchingEngineServicer):
             f"accepted {order_id} status={pb2.OrderUpdate.Status.Name(outcome.status)} "
             f"filled={outcome.filled} remaining={outcome.remaining} ({dur_us:.0f}us)"
         )
-        return pb2.OrderResponse(order_id=order_id, success=True)
+        return self._completion(pb2.OrderResponse, order_id=order_id,
+                                success=True)
 
     def _finish_submit_native(self, request, t0, otype, price_q4,
                               dispatcher=None):
@@ -219,20 +284,22 @@ class MatchingEngineService(MatchingEngineServicer):
         self.metrics.observe(
             STAGE_EDGE_INGRESS, (time.perf_counter() - t0) * 1e6)
         try:
-            outcome = dispatcher.submit_record(
+            outcome = self._wait(dispatcher.submit_record(
                 1, side=request.side, otype=otype, price_q4=price_q4,
                 quantity=request.quantity, symbol=request.symbol.encode(),
-                client_id=request.client_id.encode(),
-            ).result(timeout=30)
+                client_id=request.client_id.encode(), t_ingress=t0,
+            ), dispatcher)
         except RingFull:
             self.metrics.inc("orders_rejected")
             self._log("reject: op ring full")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 success=False, error_message="server overloaded")
         except Exception as e:  # noqa: BLE001 — engine failure => app reject
             self.metrics.inc("orders_errored")
             self._log(f"engine error: {e}")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 success=False, error_message="engine error")
         dur_us = (time.perf_counter() - t0) * 1e6
         self.metrics.ema_gauge("submit_rpc_us", dur_us)
@@ -240,11 +307,13 @@ class MatchingEngineService(MatchingEngineServicer):
         if not outcome.ok:
             self._log(f"rejected {outcome.order_id or '(pre-id)'}: "
                       f"{outcome.error} ({dur_us:.0f}us)")
-            return pb2.OrderResponse(
+            return self._completion(
+                pb2.OrderResponse,
                 order_id=outcome.order_id, success=False,
                 error_message=outcome.error)
         self._log(f"accepted {outcome.order_id} ({dur_us:.0f}us)")
-        return pb2.OrderResponse(order_id=outcome.order_id, success=True)
+        return self._completion(pb2.OrderResponse,
+                                order_id=outcome.order_id, success=True)
 
     # -- CancelOrder -------------------------------------------------------
 
@@ -270,9 +339,9 @@ class MatchingEngineService(MatchingEngineServicer):
                 error_message="order belongs to a different client",
             )
         try:
-            outcome = dispatcher.submit(
+            outcome = self._wait(dispatcher.submit(
                 EngineOp(OP_CANCEL, info, cancel_requester=request.client_id)
-            ).result(timeout=30)
+            ), dispatcher)
         except RingFull:
             # Cancels hold no handle/slot — only the message differs.
             return pb2.CancelResponse(
@@ -320,10 +389,10 @@ class MatchingEngineService(MatchingEngineServicer):
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False, error_message=err)
         try:
-            outcome = dispatcher.submit_record(
+            outcome = self._wait(dispatcher.submit_record(
                 2, order_id=request.order_id.encode(),
                 client_id=request.client_id.encode(),
-            ).result(timeout=30)
+            ), dispatcher)
         except RingFull:
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False,
@@ -374,9 +443,9 @@ class MatchingEngineService(MatchingEngineServicer):
                 error_message="order belongs to a different client",
             )
         try:
-            outcome = dispatcher.submit(
+            outcome = self._wait(dispatcher.submit(
                 EngineOp(OP_AMEND, info, amend_qty=request.new_quantity)
-            ).result(timeout=30)
+            ), dispatcher)
         except RingFull:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False,
@@ -411,11 +480,11 @@ class MatchingEngineService(MatchingEngineServicer):
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False, error_message=err)
         try:
-            outcome = dispatcher.submit_record(
+            outcome = self._wait(dispatcher.submit_record(
                 3, quantity=request.new_quantity,
                 order_id=request.order_id.encode(),
                 client_id=request.client_id.encode(),
-            ).result(timeout=30)
+            ), dispatcher)
         except RingFull:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False,
@@ -440,8 +509,56 @@ class MatchingEngineService(MatchingEngineServicer):
 
     def GetOrderBook(self, request, context):
         self.metrics.inc("rpc_book")
-        runner, _ = self._lane_for_symbol(request.symbol)
-        bids, asks = runner.book_snapshot(request.symbol)
+        if self._book_cache_s > 0.0:
+            # Conflated latest-state snapshot (--book-cache-ms): a read
+            # inside the TTL reuses the last materialized response and
+            # never touches the runner's snapshot lock — which every
+            # device step holds — so book-read bursts stop landing on
+            # the dispatch tail. Staleness is bounded by the TTL; the
+            # response proto is read-only after construction, so serving
+            # one instance to concurrent readers is safe.
+            now = time.monotonic()
+            ent = self._book_cache.get(request.symbol)
+            if ent is not None and now - ent[0] < self._book_cache_s:
+                self.metrics.inc("book_cache_hits")
+                return ent[1]
+            self.metrics.inc("book_cache_misses")
+            resp = self._build_book(request.symbol)
+            runner, _ = self._lane_for_symbol(request.symbol)
+            if runner.symbols.get(request.symbol) is None:
+                # Unknown/empty symbol: serving it fresh is lock-free
+                # and cheap (book_snapshot bails before the device), and
+                # NOT caching it means a bogus-symbol flood can't churn
+                # the hot legitimate entries out before their TTL.
+                return resp
+            # Re-insert at the dict TAIL (pop first — reassignment keeps
+            # the original position, so a refreshed hot entry would sit
+            # at the FIFO evictor's front forever), and stamp AFTER the
+            # build: under snapshot-lock contention comparable to the
+            # TTL, the pre-build stamp would insert entries already
+            # near-expired.
+            self._book_cache.pop(request.symbol, None)
+            while len(self._book_cache) >= self._book_cache_cap:
+                # Keyed by the CLIENT's symbol string, so bound it
+                # against unknown-symbol request floods — evicting ONE
+                # oldest-inserted entry per overflow (a clear-all would
+                # let that same flood continuously wipe the hot
+                # legitimate entries the cache exists to serve). Handler
+                # threads race here unlocked: a concurrent evictor can
+                # empty the dict between len() and next(), so treat an
+                # exhausted/mutated iterator as someone else's eviction.
+                try:
+                    self._book_cache.pop(
+                        next(iter(self._book_cache)), None)
+                except (StopIteration, RuntimeError):
+                    break
+            self._book_cache[request.symbol] = (time.monotonic(), resp)
+            return resp
+        return self._build_book(request.symbol)
+
+    def _build_book(self, symbol: str):
+        runner, _ = self._lane_for_symbol(symbol)
+        bids, asks = runner.book_snapshot(symbol)
 
         def msg(info, qty):
             return pb2.Order(

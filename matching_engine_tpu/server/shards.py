@@ -322,11 +322,14 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
                          window_ms: float = 2.0, metrics=None,
                          native: bool = False, native_lanes: bool = False,
                          mega_max_waves: int = 1,
-                         mega_latency_us: float = 5000.0):
+                         mega_latency_us: float = 5000.0,
+                         busy_poll_us: float = 0.0):
     """One lane's dispatcher (its own ring + drain thread). Each lane
     runs its own megadispatch coalescing controller over its own queue
     (the decision is a per-lane queue-depth function; a venue-wide M
-    would couple lanes the partition exists to decouple)."""
+    would couple lanes the partition exists to decouple). busy_poll_us
+    spins each lane's own drain — mind the core budget: K spinning lanes
+    want K cores."""
     from matching_engine_tpu.server.dispatcher import (
         BatchDispatcher,
         LaneRingDispatcher,
@@ -335,15 +338,18 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
 
     if native_lanes:
         return LaneRingDispatcher(runner, sink=sink, hub=hub,
-                                  window_ms=window_ms, metrics=metrics)
+                                  window_ms=window_ms, metrics=metrics,
+                                  busy_poll_us=busy_poll_us)
     if native:
         return NativeRingDispatcher(runner, sink=sink, hub=hub,
                                     window_ms=window_ms, metrics=metrics,
                                     mega_max_waves=mega_max_waves,
-                                    mega_latency_us=mega_latency_us)
+                                    mega_latency_us=mega_latency_us,
+                                    busy_poll_us=busy_poll_us)
     return BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms,
                            metrics=metrics, mega_max_waves=mega_max_waves,
-                           mega_latency_us=mega_latency_us)
+                           mega_latency_us=mega_latency_us,
+                           busy_poll_us=busy_poll_us)
 
 
 def build_serving_shards(

@@ -188,9 +188,15 @@ class AsyncStorageSink:
         t0 = time.perf_counter()
         self._storage.apply_batch(orders, updates, fills)
         if self._metrics is not None:
-            self._metrics.observe(
-                STAGE_SINK_COMMIT, (time.perf_counter() - t0) * 1e6)
+            t1 = time.perf_counter()
+            self._metrics.observe(STAGE_SINK_COMMIT, (t1 - t0) * 1e6)
             self._metrics.set_gauge("sink_queue_depth", self._q.qsize())
+            tracer = getattr(self._metrics, "tracer", None)
+            if tracer is not None:
+                # The seventh pipeline stage in the --trace-dir file: the
+                # sink runs async to dispatches, so its commits trace on
+                # their own thread track rather than nested per dispatch.
+                tracer.emit_span("sink_commit", t0, t1, thread_label="sink")
 
     def _run(self) -> None:
         while True:

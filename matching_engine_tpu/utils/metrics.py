@@ -1,36 +1,163 @@
-"""Minimal thread-safe metrics registry.
+"""Minimal thread-safe metrics registry with three-nines histograms.
 
 The reference's only observability is per-RPC wall-clock prints
 (matching_engine_service.cpp:46,116-118; SURVEY.md §5.1/5.5). This registry
 backs the GetMetrics RPC and periodic log lines: monotonic counters
 (orders_accepted, fills, ...) and gauges (batch latency EMA, queue depth).
+
+Histograms are HDR-style **log-bucketed** and **time-windowed**:
+
+- Buckets are geometric with ratio 2^(1/8) (~9% relative width), covering
+  sub-microsecond to ~10^9 µs in a fixed int array — observe() is O(1)
+  with no per-sample storage, so a histogram's cost no longer depends on
+  traffic rate, and the tail (p99.9) is as cheap as the median.
+- The window is TIME-bounded (default 60 s, in `window_s` rotating
+  slices), not last-N: under megadispatch the per-dispatch sample rate
+  collapses and a last-4096 ring silently spanned minutes, making "p99"
+  gauges stale snapshots of old load. A scrape now always describes the
+  last `stage_window_seconds` (exported gauge), whatever the rate.
+- Quantiles report the bucket UPPER bound (the HDR convention): the true
+  sample is never above the reported value's bucket, so latency SLO
+  checks err conservative. Exact-sample assertions belong to the raw
+  recorder in benchmarks/latency_bench.py, not the registry.
+
+snapshot() derives `<name>_p50/_p99/_p999` gauges per histogram;
+hist_snapshot() exposes the raw cumulative buckets for native Prometheus
+`le` exposition (utils/obs.render_prometheus).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 
+# Geometric bucket grid: index = floor(log2(v) * _LOG_SUB) + _IDX_OFF.
+# _LOG_SUB sub-buckets per octave => relative width 2^(1/_LOG_SUB) ~ 9%.
+_LOG_SUB = 8
+_IDX_OFF = 10 * _LOG_SUB          # values down to 2^-10 (sub-µs deltas)
+_N_BUCKETS = 40 * _LOG_SUB        # values up to 2^30 µs (~18 minutes)
 
-_HIST_CAP = 4096  # ring-buffer samples per histogram
+_WINDOW_S = 60.0                  # default histogram window
+_N_SLICES = 6                     # rotation granularity (window/6 per slice)
+# The ring holds one EXTRA slice beyond the window's worth: merging N
+# full slices + the current partial one guarantees coverage of at least
+# window_s (never less, as an N-slice ring would right after each
+# rotation) — the stage_window_seconds gauge promises a floor.
+_N_RING = _N_SLICES + 1
 
 
-def _rank(sorted_ring: list, q: float) -> float:
-    """Nearest-rank percentile over a sorted, non-empty sample list."""
-    return sorted_ring[min(int(q * len(sorted_ring)), len(sorted_ring) - 1)]
+def bucket_index(value: float) -> int:
+    """Clamped log-bucket index for one sample."""
+    if value <= 0.0:
+        return 0
+    i = int(math.floor(math.log2(value) * _LOG_SUB)) + _IDX_OFF
+    return min(max(i, 0), _N_BUCKETS - 1)
+
+
+def bucket_upper(i: int) -> float:
+    """Upper bound of bucket i (the value quantiles report)."""
+    return 2.0 ** ((i + 1 - _IDX_OFF) / _LOG_SUB)
+
+
+class _WindowedHist:
+    """One metric's log-bucketed counts over a rotating time window.
+
+    `slices` is a ring of per-slice bucket arrays — one more slice than
+    the window's worth, so the merged view (N full slices + the current
+    partial one) always covers at least window_s and at most
+    window_s + slice_s of history; advancing time zeroes the slices the
+    clock skipped. All methods are called with the registry lock held.
+    """
+
+    __slots__ = ("slices", "epoch", "slice_s",
+                 "life_counts", "life_sum", "life_count")
+
+    def __init__(self, slice_s: float, now: float):
+        self.slices = [[0] * _N_BUCKETS for _ in range(_N_RING)]
+        self.slice_s = slice_s
+        self.epoch = int(now / slice_s)
+        # Lifetime (never-reset) view backing the Prometheus native
+        # histogram series: rate()/histogram_quantile() need cumulative-
+        # forever counts — a windowed count shrinks at slice rotation,
+        # which Prometheus reads as a counter reset and double-counts.
+        self.life_counts = [0] * _N_BUCKETS
+        self.life_sum = 0.0
+        self.life_count = 0
+
+    def _advance(self, now: float) -> None:
+        epoch = int(now / self.slice_s)
+        # `now` is captured BEFORE the registry lock, so a thread
+        # preempted at a slice boundary can arrive with a STALE
+        # timestamp after a newer one already advanced the ring. Never
+        # step backwards: doing so would re-zero the newer thread's
+        # live slice on the next advance (a stale sample lands in the
+        # current slice instead — off by at most one slice).
+        if epoch <= self.epoch:
+            return
+        step = min(epoch - self.epoch, _N_RING)
+        for k in range(1, step + 1):
+            j = (self.epoch + k) % _N_RING
+            s = self.slices[j]
+            for i in range(_N_BUCKETS):
+                s[i] = 0
+        self.epoch = epoch
+
+    def observe(self, value: float, now: float) -> None:
+        self._advance(now)
+        i = bucket_index(value)
+        self.slices[self.epoch % _N_RING][i] += 1
+        self.life_counts[i] += 1
+        self.life_sum += value
+        self.life_count += 1
+
+    def merged(self, now: float) -> list[int]:
+        self._advance(now)
+        out = [0] * _N_BUCKETS
+        for s in self.slices:
+            for i in range(_N_BUCKETS):
+                out[i] += s[i]
+        return out
+
+
+def _quantiles(counts: list[int], qs: tuple[float, ...]) -> list[float] | None:
+    """Bucket-upper-bound quantiles over merged window counts (nearest
+    rank). None when the window holds no samples."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    out = []
+    for q in qs:
+        rank = min(int(q * total), total - 1)  # 0-based nearest rank
+        run = 0
+        for i, c in enumerate(counts):
+            run += c
+            if run > rank:
+                out.append(bucket_upper(i))
+                break
+    return out
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, window_s: float = _WINDOW_S):
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, float] = {}
-        # name -> (ring list, next write index)
-        self._hists: dict[str, tuple[list, int]] = {}
+        self._hists: dict[str, _WindowedHist] = {}
+        self.window_s = float(window_s)
+        self._slice_s = self.window_s / _N_SLICES
+        # The window every *_p50/_p99/_p999 gauge is computed over — a
+        # scrape is only interpretable knowing how much history it spans.
+        self.set_gauge("stage_window_seconds", self.window_s)
+        # Injectable clock (tests advance it to prove window expiry).
+        self._now = time.monotonic
         # Optional utils/obs.py FlightRecorder, attached by build_server.
         # Riding on the registry keeps the recorder reachable from every
         # layer that already holds `metrics`, without constructor churn.
         self.recorder = None
+        # Optional utils/obs.py TraceExporter (--trace-dir), same pattern:
+        # DispatchTimeline.finish offers each dispatch to the sampler.
+        self.tracer = None
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -55,47 +182,77 @@ class Metrics:
             self._gauges[name] = value if prev is None else alpha * value + (1 - alpha) * prev
 
     def observe(self, name: str, value: float) -> None:
-        """Record one sample into `name`'s sliding-window histogram.
+        """Record one sample into `name`'s windowed log-bucket histogram.
 
-        The BASELINE metric is "orders/sec + p99 match latency": percentiles
-        need a sample window, not an EMA. A fixed ring bounds memory; the
-        window covers the last _HIST_CAP dispatches.
+        O(1), no per-sample storage: one bucket increment in the current
+        time slice. The window covers the last `window_s` seconds
+        (stage_window_seconds gauge), however many samples arrived.
         """
+        now = self._now()
         with self._lock:
-            ring, idx = self._hists.get(name, ([], 0))
-            if len(ring) < _HIST_CAP:
-                ring.append(float(value))
-            else:
-                ring[idx] = float(value)
-            self._hists[name] = (ring, (idx + 1) % _HIST_CAP)
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _WindowedHist(self._slice_s, now)
+            h.observe(float(value), now)
 
     def percentile(self, name: str, q: float) -> float | None:
-        """q in [0, 1] over the sliding window; None with no samples."""
+        """q in [0, 1] over the time window; None with no samples.
+        Reports the sample's bucket upper bound (≤ ~9% above the true
+        value, never below it)."""
+        now = self._now()
         with self._lock:
-            ring, _ = self._hists.get(name, ([], 0))
-            ring = list(ring)  # sort OUTSIDE the lock: observe() is hot-path
-        if not ring:
+            h = self._hists.get(name)
+            counts = h.merged(now) if h is not None else None
+        if counts is None:
             return None
-        ring.sort()
-        return _rank(ring, q)
+        out = _quantiles(counts, (q,))
+        return None if out is None else out[0]
 
     def snapshot(self) -> tuple[dict[str, int], dict[str, float]]:
-        """Counters + gauges, with p50/p99 derived gauges per histogram."""
+        """Counters + gauges, with p50/p99/p999 derived gauges per
+        histogram (empty windows surface no derived gauges — absent is
+        distinguishable from zero)."""
+        now = self._now()
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            rings = {n: list(r) for n, (r, _) in self._hists.items()}
-        for name, ring in rings.items():
-            ring.sort()
-            if ring:
-                gauges[f"{name}_p50"] = _rank(ring, 0.50)
-                gauges[f"{name}_p99"] = _rank(ring, 0.99)
+            merged = {n: h.merged(now) for n, h in self._hists.items()}
+        for name, counts in merged.items():
+            qv = _quantiles(counts, (0.50, 0.99, 0.999))
+            if qv is not None:
+                gauges[f"{name}_p50"] = qv[0]
+                gauges[f"{name}_p99"] = qv[1]
+                gauges[f"{name}_p999"] = qv[2]
         return counters, gauges
+
+    def hist_snapshot(self) -> dict[str, dict]:
+        """Raw histogram state for native Prometheus exposition: per name
+        {"buckets": [(upper_bound, cumulative_count)], "sum", "count"} —
+        all LIFETIME-cumulative (proper Prometheus histogram semantics:
+        rate()/increase()/histogram_quantile() need counts that never
+        shrink; the TIME-WINDOWED view lives in the derived
+        _p50/_p99/_p999 gauges instead). A bucket once seen stays listed,
+        so the le label set only grows; only boundaries where the
+        cumulative count changes are listed — the full 320-bucket grid
+        would bloat scrapes."""
+        with self._lock:
+            merged = {n: (list(h.life_counts), h.life_sum, h.life_count)
+                      for n, h in self._hists.items()}
+        out: dict[str, dict] = {}
+        for name, (counts, lsum, lcount) in merged.items():
+            cum = 0
+            buckets = []
+            for i, c in enumerate(counts):
+                if c:
+                    cum += c
+                    buckets.append((bucket_upper(i), cum))
+            out[name] = {"buckets": buckets, "sum": lsum, "count": lcount}
+        return out
 
 
 class Timer:
     """Context manager feeding a microsecond EMA gauge (<name>_ema) plus
-    the sliding-window histogram (surfaced as <name>_p50/_p99 in
+    the windowed histogram (surfaced as <name>_p50/_p99/_p999 in
     snapshot())."""
 
     def __init__(self, metrics: Metrics, gauge: str):

@@ -32,6 +32,7 @@ was the blindest one. Three layers fix that:
 from __future__ import annotations
 
 import http.server
+import itertools
 import json
 import os
 import signal
@@ -67,14 +68,20 @@ class DispatchTimeline:
     a boundary never crossed simply records nothing.
     """
 
-    __slots__ = ("path", "n_ops", "t_enqueue", "t_pop", "t_build",
-                 "t_issue", "t_decode", "t_publish", "shape", "waves",
-                 "mega_m", "counters")
+    __slots__ = ("path", "n_ops", "t_ingress", "t_enqueue", "t_pop",
+                 "t_build", "t_issue", "t_decode", "t_publish", "shape",
+                 "waves", "mega_m", "counters", "trace_id")
+
+    # Process-wide dispatch trace ids (GIL-atomic); every timeline gets
+    # one so a sampled trace export names exactly which dispatch it is
+    # and the flight-recorder entry for the same dispatch correlates.
+    _trace_ids = itertools.count(1)
 
     def __init__(self, path: str, n_ops: int, t_enqueue: float | None = None,
-                 t_pop: float | None = None):
+                 t_pop: float | None = None, t_ingress: float | None = None):
         self.path = path
         self.n_ops = n_ops
+        self.t_ingress = t_ingress   # oldest op's RPC entry (edge ingress)
         self.t_enqueue = t_enqueue   # earliest op enqueue (queue-wait origin)
         self.t_pop = time.perf_counter() if t_pop is None else t_pop
         self.t_build = None
@@ -85,6 +92,7 @@ class DispatchTimeline:
         self.waves = 0
         self.mega_m = 1              # waves stacked per device call (mega)
         self.counters: dict = {}
+        self.trace_id = next(self._trace_ids)
 
     def stamp_build(self) -> None:
         self.t_build = time.perf_counter()
@@ -105,6 +113,11 @@ class DispatchTimeline:
             if a is not None and b is not None and b >= a:
                 out[name] = (b - a) * 1e6
 
+        # t_ingress is deliberately NOT folded here: the service layer
+        # already observes STAGE_EDGE_INGRESS per op (RPC entry -> push);
+        # folding the per-dispatch oldest-op delta too would double-count
+        # the histogram. The stamp exists for the trace exporter's
+        # edge-ingress span.
         delta(STAGE_QUEUE_WAIT, self.t_enqueue, self.t_pop)
         delta(STAGE_LANE_BUILD, self.t_pop, self.t_build)
         delta(STAGE_DEVICE_DISPATCH, self.t_build, self.t_issue)
@@ -125,12 +138,26 @@ class DispatchTimeline:
         stages = self._stages_us()
         for name, us in stages.items():
             metrics.observe(name, us)
+        e2e = self.e2e_us()
+        if e2e is not None and error is None:
+            # Per-dispatch end-to-end (oldest op's first stamp -> last
+            # stamp): the tail the trace sampler's slow threshold rolls
+            # over, and the p99/p50 ratio latency_bench gates on.
+            # Successful dispatches only — an errored dispatch's span is
+            # truncated at whatever stamp it died on, and a burst of
+            # those would deflate the rolling p99 into tagging ordinary
+            # dispatches as slow.
+            metrics.observe("dispatch_e2e_us", e2e)
+        tracer = getattr(metrics, "tracer", None)
+        if tracer is not None and error is None:
+            tracer.offer_dispatch(self, e2e)
         recorder = getattr(metrics, "recorder", None)
         if recorder is None:
             return
         entry = {
             "kind": "dispatch" if error is None else "dispatch_error",
             "path": self.path,
+            "trace_id": self.trace_id,
             "ops": self.n_ops,
             "shape": self.shape,
             "waves": self.waves,
@@ -143,6 +170,19 @@ class DispatchTimeline:
         recorder.record(entry)
         if error is not None:
             recorder.dump_on_error()
+
+    def e2e_us(self) -> float | None:
+        """Oldest-stamp -> newest-stamp span of this dispatch in µs (the
+        client-felt figure minus the RPC transport), None before any
+        pair of stamps exists."""
+        first = next((t for t in (self.t_ingress, self.t_enqueue,
+                                  self.t_pop) if t is not None), None)
+        last = next((t for t in (self.t_publish, self.t_decode,
+                                 self.t_issue, self.t_build, self.t_pop)
+                     if t is not None), None)
+        if first is None or last is None or last < first:
+            return None
+        return (last - first) * 1e6
 
 
 _warn_lock = threading.Lock()
@@ -200,6 +240,10 @@ class FlightRecorder:
         self._prev_sigusr2 = None
         self.dump_dir = dump_dir
         self.error_dump_interval_s = error_dump_interval_s
+        # Attached by build_server: lets dump() capture the controller/
+        # balance context (me_megadispatch_*, me_lane_*) that per-entry
+        # stage deltas alone can't explain a tail spike with.
+        self.metrics = None
 
     def record(self, entry: dict) -> None:
         with self._lock:
@@ -232,6 +276,7 @@ class FlightRecorder:
                 "reason": reason,
                 "wall_ts": time.time(),
                 "pid": os.getpid(),
+                "context": self._dump_context(),
                 "entries": self.snapshot(),
             }
             with open(path, "w") as f:
@@ -243,6 +288,24 @@ class FlightRecorder:
             print(f"[obs] flight recorder dump failed: "
                   f"{type(e).__name__}: {e}")
             return None
+
+    def _dump_context(self) -> dict:
+        """The megadispatch-controller and lane-balance state at dump
+        time: a SIGUSR2 snapshot must carry the M / imbalance context a
+        tail spike happened under, not just per-dispatch stage deltas."""
+        if self.metrics is None:
+            return {}
+        try:
+            counters, gauges = self.metrics.snapshot()
+        except Exception:  # noqa: BLE001 — a post-mortem never raises
+            return {}
+        keep = ("megadispatch", "lane")
+        return {
+            "gauges": {k: v for k, v in sorted(gauges.items())
+                       if k.startswith(keep)},
+            "counters": {k: v for k, v in sorted(counters.items())
+                         if k.startswith(keep)},
+        }
 
     def dump_on_error(self) -> bool:
         """Rate-limited dump for fatal dispatch errors. The write runs on
@@ -262,13 +325,22 @@ class FlightRecorder:
         return True
 
     def install_sigusr2(self) -> bool:
-        """SIGUSR2 -> dump("sigusr2"). Main thread only (signal module
-        restriction); returns False where unavailable (e.g. Windows)."""
+        """SIGUSR2 -> dump("sigusr2") on a BACKGROUND daemon thread
+        (same pattern as dump_on_error): the handler runs on the main
+        thread between bytecodes, and dump() acquires the recorder and
+        registry locks — a synchronous dump while the main thread itself
+        held either would self-deadlock on the non-reentrant lock.
+        Install from the main thread only (signal module restriction);
+        returns False where unavailable (e.g. Windows)."""
         if not hasattr(signal, "SIGUSR2"):
             return False
+
+        def _handler(*_):
+            threading.Thread(target=self.dump, args=("sigusr2",),
+                             name="flight-dump", daemon=True).start()
+
         try:
-            self._prev_sigusr2 = signal.signal(
-                signal.SIGUSR2, lambda *_: self.dump("sigusr2"))
+            self._prev_sigusr2 = signal.signal(signal.SIGUSR2, _handler)
             return True
         except ValueError:  # not the main thread
             return False
@@ -277,6 +349,267 @@ class FlightRecorder:
         if self._prev_sigusr2 is not None:
             signal.signal(signal.SIGUSR2, self._prev_sigusr2)
             self._prev_sigusr2 = None
+
+
+# -- per-dispatch trace export (--trace-dir) ---------------------------------
+
+
+class TraceExporter:
+    """Bounded sampler exporting dispatches as Chrome `trace_event` JSON.
+
+    Rides the registry as `metrics.tracer` (the recorder pattern):
+    DispatchTimeline.finish offers every completed dispatch; the sampler
+    keeps (a) every `sample_every`-th dispatch and (b) every dispatch
+    whose end-to-end latency exceeds the ROLLING p99 of `dispatch_e2e_us`
+    (threshold cached, refreshed at most once per second) — the tail is
+    exactly what a uniform sample misses. A kept dispatch becomes one
+    parent slice with nested child slices for the pipeline stages
+    (edge-ingress → queue-wait → lane-build → device-dispatch →
+    completion-decode → stream-publish), args carrying the trace id,
+    shape, and aux counters (the flight-recorder entry's content, folded
+    into the trace). Host spans from utils/tracing.span (native lane
+    build/decode) and the async sink's commit txns land in the same file
+    on their own threads, so one file opened in Perfetto /
+    chrome://tracing shows the whole seven-stage pipeline.
+
+    Hot-path cost when not sampling: one counter bump and one float
+    compare. Kept events go to a bounded in-memory queue (overflow
+    counted as trace_dropped_events) drained by a background writer —
+    a full disk surfaces as a rate-limited warning plus the
+    trace_write_errors counter, never a stalled dispatch or a log storm.
+
+    The file is a streamed JSON array (the Chrome trace array form):
+    finalized with `]` on close() so it json-parses; Perfetto loads the
+    unterminated prefix too if the process dies mid-run.
+    """
+
+    def __init__(self, trace_dir: str, metrics=None, sample_every: int = 64,
+                 queue_cap: int = 8192, flush_interval_s: float = 0.25):
+        self.trace_dir = trace_dir
+        self.metrics = metrics
+        self.sample_every = max(1, int(sample_every))
+        self._queue_cap = queue_cap
+        self._t0 = time.perf_counter()   # ts origin (µs since start)
+        self._n = 0                      # dispatches offered
+        self._span_seen: dict[str, int] = {}
+        self._slow_p99_us: float | None = None
+        self._slow_refresh = 0.0
+        self._ev_lock = threading.Lock()
+        self._events: list[dict] = []
+        self._tids: dict[str, int] = {}
+        self._tid_seq = 0
+        self._file = None
+        self.path: str | None = None
+        self._wrote_any = False
+        # Serializes whole flushes: the background writer and direct
+        # flush() callers (tests, close) would otherwise race the lazy
+        # file open and interleave writes into the same path.
+        self._flush_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._flush_interval_s = flush_interval_s
+        self._thread = threading.Thread(target=self._run, name="trace-writer",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- sampling (hot path) ----------------------------------------------
+
+    def offer_dispatch(self, tl, e2e_us: float | None) -> None:
+        """Called by DispatchTimeline.finish for EVERY dispatch — must
+        stay O(1) when not sampling. Under --serve-shards K lane drain
+        threads call in concurrently (each under its OWN dispatch lock),
+        so the _n / _span_seen counters race deliberately unlocked: a
+        lost increment only drifts the uniform sampling phase, and a
+        lock here would serialize the lanes the partition decouples.
+        Nothing correctness-bearing may ever ride these counters."""
+        self._n += 1
+        sampled = (self._n % self.sample_every) == 0
+        slow = False
+        if not sampled and e2e_us is not None:
+            thr = self._slow_threshold()
+            slow = thr is not None and e2e_us > thr
+        if not (sampled or slow):
+            return
+        self._export_dispatch(tl, e2e_us, "interval" if sampled else "slow")
+
+    def _slow_threshold(self) -> float | None:
+        """Rolling p99 of dispatch end-to-end latency, refreshed at most
+        once per second (percentile() walks the bucket grid — fine per
+        second, not per dispatch)."""
+        if self.metrics is None:
+            return None
+        now = time.monotonic()
+        if now - self._slow_refresh >= 1.0:
+            self._slow_refresh = now
+            self._slow_p99_us = self.metrics.percentile(
+                "dispatch_e2e_us", 0.99)
+        return self._slow_p99_us
+
+    # -- event construction -------------------------------------------------
+
+    def _rel_us(self, t: float) -> float:
+        return (t - self._t0) * 1e6
+
+    def _tid(self, label: str, events: list[dict]) -> int:
+        with self._ev_lock:  # spans race in from sink/lane threads
+            tid = self._tids.get(label)
+            if tid is None:
+                self._tid_seq += 1  # a seq, not len(): drops unregister
+                tid = self._tids[label] = self._tid_seq
+                events.append({"ph": "M", "pid": os.getpid(), "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": label}})
+        return tid
+
+    def _unregister_meta(self, events: list[dict]) -> None:
+        """A batch carrying a track's one-time thread_name metadata was
+        dropped (queue overflow) or lost (failed write): forget the
+        label so the NEXT event on that track re-emits it — otherwise
+        the whole track renders anonymous for the rest of the file."""
+        with self._ev_lock:
+            for e in events:
+                if e.get("ph") == "M":
+                    self._tids.pop(e["args"]["name"], None)
+
+    def _export_dispatch(self, tl, e2e_us, why: str) -> None:
+        events: list[dict] = []
+        # Track identity includes the DRAIN THREAD, not just the path:
+        # under --serve-shards K lanes share one path string, and
+        # time-overlapping slices on one tid would nest lane B's stages
+        # inside lane A's dispatch in Perfetto. (Thread names collide
+        # too — every lane's drain is "dispatcher" — so use the ident.)
+        tid = self._tid(
+            f"dispatch:{tl.path}@{threading.get_ident()}", events)
+        pid = os.getpid()
+        stamps = [("edge_ingress", tl.t_ingress, tl.t_enqueue),
+                  ("queue_wait", tl.t_enqueue, tl.t_pop),
+                  ("lane_build", tl.t_pop, tl.t_build),
+                  ("device_dispatch", tl.t_build, tl.t_issue),
+                  ("completion_decode", tl.t_issue or tl.t_build,
+                   tl.t_decode),
+                  ("stream_publish", tl.t_decode, tl.t_publish)]
+        present = [(n, a, b) for n, a, b in stamps
+                   if a is not None and b is not None and b >= a]
+        if not present:
+            return
+        first = min(a for _, a, _ in present)
+        last = max(b for _, _, b in present)
+        events.append({
+            "name": f"dispatch#{tl.trace_id}", "cat": "dispatch",
+            "ph": "X", "pid": pid, "tid": tid,
+            "ts": round(self._rel_us(first), 3),
+            "dur": round((last - first) * 1e6, 3),
+            "args": {
+                "trace_id": tl.trace_id, "path": tl.path, "why": why,
+                "ops": tl.n_ops, "shape": tl.shape, "waves": tl.waves,
+                "mega_m": tl.mega_m,
+                "e2e_us": round(e2e_us, 1) if e2e_us is not None else None,
+                "counters": dict(tl.counters),
+            },
+        })
+        for name, a, b in present:
+            events.append({
+                "name": name, "cat": "stage", "ph": "X", "pid": pid,
+                "tid": tid, "ts": round(self._rel_us(a), 3),
+                "dur": round((b - a) * 1e6, 3),
+                "args": {"trace_id": tl.trace_id},
+            })
+        self._enqueue(events)
+        if self.metrics is not None:
+            self.metrics.inc("trace_exported_dispatches")
+
+    def emit_span(self, name: str, t_start: float, t_end: float,
+                  thread_label: str | None = None) -> None:
+        """A host-side span (tracing.span / sink commit) on its own
+        thread track, sampled at the same 1-in-N rate per span name (a
+        span fires per dispatch — unsampled export would swamp the file
+        at exactly the rates worth tracing)."""
+        seen = self._span_seen.get(name, 0) + 1
+        self._span_seen[name] = seen
+        if seen % self.sample_every:
+            return
+        events: list[dict] = []
+        label = thread_label or f"span:{threading.current_thread().name}"
+        tid = self._tid(label, events)
+        events.append({
+            "name": name, "cat": "span", "ph": "X", "pid": os.getpid(),
+            "tid": tid, "ts": round(self._rel_us(t_start), 3),
+            "dur": round((t_end - t_start) * 1e6, 3),
+        })
+        self._enqueue(events)
+
+    def _enqueue(self, events: list[dict]) -> None:
+        with self._ev_lock:
+            dropped = len(self._events) + len(events) > self._queue_cap
+            if not dropped:
+                self._events.extend(events)
+        if dropped:
+            if self.metrics is not None:
+                self.metrics.inc("trace_dropped_events", len(events))
+            self._unregister_meta(events)
+
+    # -- the writer thread --------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._flush_interval_s):
+            self.flush()
+
+    def flush(self) -> None:
+        with self._flush_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._ev_lock:
+            batch, self._events = self._events, []
+        if not batch:
+            return
+        try:
+            if self._file is None:
+                os.makedirs(self.trace_dir, exist_ok=True)
+                ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+                self.path = os.path.join(
+                    self.trace_dir, f"trace_{ts}_{os.getpid()}.json")
+                self._file = open(self.path, "w")
+                self._file.write("[\n")
+            chunks = []
+            for e in batch:
+                if self._wrote_any:
+                    chunks.append(",\n")
+                self._wrote_any = True
+                chunks.append(json.dumps(e, separators=(",", ":")))
+            self._file.write("".join(chunks))
+            self._file.flush()
+        except (OSError, ValueError) as e:
+            # ValueError: write on a file closed by a racing close().
+            # The batch is dropped (bounded memory beats a retry queue on
+            # a full disk); the counter carries the true loss rate and
+            # the log line stays at human rate however fast dispatches
+            # sample. Track metadata in the lost batch unregisters so the
+            # track re-labels itself on its next event.
+            if self.metrics is not None:
+                self.metrics.inc("trace_write_errors")
+            self._unregister_meta(batch)
+            warn_rate_limited(
+                "trace-writer",
+                f"[obs] trace write failed: {type(e).__name__}: {e}")
+
+    def close(self) -> None:
+        """Final flush + JSON finalize. The array closes with `]` so the
+        file json-parses; an uncleanly-killed run leaves the
+        unterminated array, which Perfetto still loads."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+        with self._flush_lock:
+            self._flush_locked()
+            if self._file is not None:
+                try:
+                    self._file.write("\n]\n")
+                    self._file.close()
+                except OSError as e:
+                    warn_rate_limited(
+                        "trace-writer",
+                        f"[obs] trace finalize failed: "
+                        f"{type(e).__name__}: {e}")
+                self._file = None
 
 
 # -- Prometheus text exposition ---------------------------------------------
@@ -294,10 +627,14 @@ def render_prometheus(metrics) -> str:
     """Render the full registry in Prometheus text format 0.0.4.
 
     Counters -> `me_<name>_total` (counter); gauges -> `me_<name>`
-    (gauge). Histogram windows surface through snapshot() as the
-    derived `<name>_p50`/`<name>_p99` gauges — quantiles computed
-    server-side over the sliding window, exported as plain gauges
-    (the scraper gets stable names without native histogram buckets).
+    (gauge). Histograms export BOTH ways: the derived
+    `<name>_p50`/`<name>_p99`/`<name>_p999` gauges (quantiles computed
+    server-side over the time window — stable names, no PromQL needed)
+    AND native `me_<name>_bucket{le="..."}` series with `_sum`/`_count`,
+    so histogram_quantile() and cross-instance aggregation work. The
+    bucket/_sum/_count series are LIFETIME-cumulative (never shrink —
+    proper Prometheus counter semantics for rate()); only the derived
+    quantile gauges describe the `me_stage_window_seconds` time window.
     """
     counters, gauges = metrics.snapshot()
     lines: list[str] = []
@@ -310,6 +647,18 @@ def render_prometheus(metrics) -> str:
         lines.append(f"# TYPE {p} gauge")
         v = float(gauges[name])
         lines.append(f"{p} {v:.6g}")
+    hist_fn = getattr(metrics, "hist_snapshot", None)
+    if hist_fn is not None:
+        hists = hist_fn()
+        for name in sorted(hists):
+            h = hists[name]
+            p = _prom_name(name)
+            lines.append(f"# TYPE {p} histogram")
+            for ub, cum in h["buckets"]:
+                lines.append(f'{p}_bucket{{le="{ub:.6g}"}} {cum}')
+            lines.append(f'{p}_bucket{{le="+Inf"}} {h["count"]}')
+            lines.append(f"{p}_sum {h['sum']:.6g}")
+            lines.append(f"{p}_count {h['count']}")
     return "\n".join(lines) + "\n"
 
 

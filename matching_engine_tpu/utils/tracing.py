@@ -8,6 +8,12 @@ TPU equivalent this module provides:
   everything dispatched inside the block;
 - `step_annotation(name, n)`: label each engine dispatch so device traces
   show per-batch boundaries;
+- `span(name)`: label an arbitrary host-side section — in the device
+  trace (--profile-dir) AND, when a host trace exporter is installed
+  (--trace-dir, utils/obs.TraceExporter via set_host_tracer), as a
+  sampled Chrome trace_event slice in the same file as the per-dispatch
+  pipeline slices, so one Perfetto view holds both.
+
 Host-side wall-clock timing of arbitrary sections feeds the GetMetrics
 registry via utils/metrics.py's Timer. The server enables tracing with
 --profile-dir; bench/benchmark runs can wrap their loops directly.
@@ -16,8 +22,21 @@ registry via utils/metrics.py's Timer. The server enables tracing with
 from __future__ import annotations
 
 import contextlib
+import time
 
 import jax
+
+# The process-wide host-span sink (utils/obs.TraceExporter | None).
+# Installed by build_server when --trace-dir is set; module-global so the
+# native-lanes loop's span() call sites need no plumbing.
+_host_tracer = None
+
+
+def set_host_tracer(tracer) -> None:
+    """Install (or clear, with None) the host trace exporter span()
+    mirrors into."""
+    global _host_tracer
+    _host_tracer = tracer
 
 
 @contextlib.contextmanager
@@ -35,11 +54,26 @@ def step_annotation(name: str, step: int):
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
+@contextlib.contextmanager
+def _span_both(name: str, tracer):
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            yield
+        finally:
+            tracer.emit_span(name, t0, time.perf_counter())
+
+
 def span(name: str):
     """Label an arbitrary host-side section in the device trace (the
     non-step sibling of step_annotation). The native-lanes dispatch loop
     wraps its C++ lane build and completion decode in these so a
     --profile-dir trace shows per-batch boundaries in BOTH serving modes
     — before this, only EngineRunner's device steps were annotated and
-    the native path's host sections were anonymous gaps."""
-    return jax.profiler.TraceAnnotation(name)
+    the native path's host sections were anonymous gaps. With a host
+    tracer installed the same section additionally lands (sampled) in
+    the --trace-dir Chrome trace."""
+    tracer = _host_tracer
+    if tracer is None:
+        return jax.profiler.TraceAnnotation(name)
+    return _span_both(name, tracer)

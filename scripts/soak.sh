@@ -295,6 +295,59 @@ MD_AUDIT=$(echo "$MD_AUDIT" | tail -1 | tr -d '[:space:]')
 [ "$MD_AUDIT" = "0" ] \
   || { echo "FAIL: $MD_AUDIT store integrity violation(s) in the megadispatch round"; exit 1; }
 
+# ---- latency round: open-loop tail gate -----------------------------------
+# Boots a fourth server with the tail levers ON (--busy-poll-us,
+# --book-cache-ms, --proto-reuse) and --trace-dir, runs latency_bench's
+# open-loop gRPC mode at 50% of its measured peak, and fails the round
+# if end-to-end p99 > 10x p50 or the scrape lacks the _p999 gauges.
+# The trace file (finalized at clean shutdown) lands beside the artifact.
+LT_DB="$WORK/soak_latency.db"
+LT_TRACE="$WORK/latrace"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$LT_DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --metrics-port 0 --busy-poll-us 50 --book-cache-ms 5 \
+  --proto-reuse --trace-dir "$LT_TRACE" --trace-sample 32 \
+  ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_latency.log" 2>&1 &
+LT_SRV=$!
+trap 'kill $SRV $LT_SRV 2>/dev/null' EXIT
+LT_PY=""; LT_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  LT_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_latency.log" | head -1)
+  LT_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_latency.log" | head -1)
+  [ -n "$LT_PY" ] && [ -n "$LT_OBS" ] && break
+  kill -0 $LT_SRV 2>/dev/null || { echo "FAIL: latency server died at boot"; tail -5 "$WORK/server_latency.log"; exit 1; }
+  sleep 1
+done
+[ -n "$LT_PY" ] && [ -n "$LT_OBS" ] || { echo "FAIL: latency server ports never appeared"; exit 1; }
+LT_OUT="$WORK/latency_round.json"
+python benchmarks/latency_bench.py --addr "127.0.0.1:$LT_PY" \
+  --load-fractions 0.5 --repeats 2 --duration-s 4 --peak-s 2 \
+  --scrape "http://127.0.0.1:$LT_OBS/metrics" --json-out "$LT_OUT" \
+  >/dev/null 2>"$WORK/latency_bench.err" \
+  || { echo "FAIL: latency_bench failed"; cat "$WORK/latency_bench.err"; exit 1; }
+LT_GATE=$(python - "$LT_OUT" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+row = doc["rows"][0]
+p999 = doc.get("server_p999_gauges", [])
+ok = row["p99_over_p50"] < 10 and bool(p999)
+print(f"{int(ok)} {row['e2e']['p50_ms']} {row['e2e']['p99_ms']} "
+      f"{row['p99_over_p50']} {len(p999)}")
+EOF
+)
+read -r LT_OK LT_P50 LT_P99 LT_RATIO LT_NP999 <<< "$(echo "$LT_GATE" | tail -1)"
+if [ "$LT_OK" != "1" ]; then
+  echo "FAIL: latency round gate (p50=${LT_P50}ms p99=${LT_P99}ms ratio=${LT_RATIO} p999_gauges=${LT_NP999})"
+  exit 1
+fi
+# Clean shutdown finalizes the trace JSON; keep it beside the artifact.
+kill -TERM $LT_SRV 2>/dev/null; wait $LT_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+LT_TRACE_FILE=$(ls -t "$LT_TRACE"/trace_*.json 2>/dev/null | head -1)
+[ -n "$LT_TRACE_FILE" ] || { echo "FAIL: latency round produced no trace file"; exit 1; }
+cp "$LT_TRACE_FILE" "$OUT_DIR/soak_${TS}_trace.json"
+
 sleep 2
 AUDIT=$(python - "$DB" <<'EOF'
 import sys
@@ -336,6 +389,10 @@ artifact = {
                       "id_collisions": int("$SH_COLLISIONS" or -1)},
     "megadispatch_round": {"max_waves": 4, "orders_ok": $MD_OK,
                            "audit_violations": int("$MD_AUDIT" or -1)},
+    "latency_round": {"load_fraction": 0.5, "p50_ms": $LT_P50,
+                      "p99_ms": $LT_P99, "p99_over_p50": $LT_RATIO,
+                      "p999_gauges": $LT_NP999,
+                      "levers": "busy-poll+book-cache+proto-reuse"},
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))

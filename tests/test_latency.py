@@ -1,0 +1,363 @@
+"""Tail-latency observability layer (ISSUE 6): three-nines histograms,
+per-dispatch trace export, and the tail levers.
+
+Four layers under test:
+- unit: log-bucket histogram quantiles on skewed synthetic data (the
+  p999 must resolve a 1-in-1000 outlier), native `le` bucket exposition;
+- trace export: round-trip (file parses as Chrome trace JSON, stage
+  slices nest inside their dispatch slice), the slow-dispatch sampler
+  (a dispatch past the rolling p99 exports even when the uniform sample
+  skips it), and the writer's rate-limited error path (a dead trace dir
+  degrades to a counter, never an exception on the dispatch path);
+- parity: busy-poll on/off produces bit-identical serving output
+  (outcomes + storage rows) on the python AND native-lanes paths —
+  the lever trades CPU for wakeup latency, never behavior;
+- e2e: a real server scraped over HTTP exports the new `_p999` derived
+  gauges, the native bucket series, and the window gauge; the lever
+  flags (busy-poll, book cache, proto reuse) serve correctly end to end
+  and a --trace-dir run leaves a loadable trace.
+"""
+
+import json
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+from matching_engine_tpu.utils.metrics import Metrics
+from matching_engine_tpu.utils.obs import (
+    DispatchTimeline,
+    ObsServer,
+    TraceExporter,
+    render_prometheus,
+)
+
+CFG = EngineConfig(num_symbols=8, capacity=16, batch=4)
+
+
+# -- unit: three-nines histogram ---------------------------------------------
+
+
+def test_p999_resolves_skewed_tail():
+    """990 fast samples + 10 slow ones: the p99 must stay in the fast
+    mode, the p999 must land on the outliers — the distinction the old
+    two-quantile window could not make."""
+    m = Metrics()
+    for _ in range(995):
+        m.observe("lat_us", 100.0)
+    for _ in range(5):
+        m.observe("lat_us", 10_000.0)
+    _, g = m.snapshot()
+    assert g["lat_us_p50"] < 150.0
+    assert g["lat_us_p99"] < 150.0        # rank 990 of 1000: fast mode
+    assert g["lat_us_p999"] >= 10_000.0   # rank 999: the outliers
+    assert g["lat_us_p999"] <= 10_000.0 * 2 ** 0.125  # one bucket width
+
+
+def test_prometheus_le_buckets_and_window_gauge():
+    m = Metrics()
+    for v in (50.0, 50.0, 900.0, 40_000.0):
+        m.observe("lat_us", v)
+    text = render_prometheus(m)
+    assert "# TYPE me_lat_us histogram" in text
+    bucket_lines = [ln for ln in text.splitlines()
+                    if ln.startswith('me_lat_us_bucket{le="')]
+    assert len(bucket_lines) >= 3
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert cums == sorted(cums), "le buckets must be cumulative"
+    assert bucket_lines[-1].startswith('me_lat_us_bucket{le="+Inf"}')
+    assert cums[-1] == 4
+    assert "me_lat_us_count 4" in text
+    assert "me_lat_us_sum " in text
+    # The derived three-nines gauges and the window the scrape describes.
+    assert "me_lat_us_p999" in text
+    assert "me_stage_window_seconds 60" in text
+
+
+# -- trace export -------------------------------------------------------------
+
+
+def _finish_timeline(m, path="python", age_s=0.002, ops=3):
+    tl = DispatchTimeline(path, ops,
+                          t_enqueue=time.perf_counter() - age_s,
+                          t_ingress=time.perf_counter() - age_s - 0.001)
+    tl.shape = "sparse"
+    tl.stamp_build()
+    tl.stamp_issue()
+    tl.stamp_decode()
+    tl.stamp_publish()
+    tl.counters = {"fills": 1}
+    tl.finish(m)
+    return tl
+
+
+def test_trace_export_round_trip(tmp_path):
+    d = str(tmp_path / "trace")
+    m = Metrics()
+    t = TraceExporter(d, metrics=m, sample_every=2)
+    m.tracer = t
+    for _ in range(4):
+        _finish_timeline(m)
+    t.emit_span("sink_commit", time.perf_counter() - 0.001,
+                time.perf_counter(), thread_label="sink")
+    t.emit_span("sink_commit", time.perf_counter() - 0.001,
+                time.perf_counter(), thread_label="sink")
+    t.close()
+    doc = json.load(open(t.path))
+    assert isinstance(doc, list) and doc, "not a Chrome trace JSON array"
+    dispatches = [e for e in doc if e.get("cat") == "dispatch"]
+    assert len(dispatches) == 2  # every 2nd of 4
+    # Stage slices nest inside their dispatch slice (Perfetto nesting is
+    # containment on the same track).
+    for disp in dispatches:
+        kids = [e for e in doc if e.get("cat") == "stage"
+                and e["args"]["trace_id"] == disp["args"]["trace_id"]]
+        names = {k["name"] for k in kids}
+        assert {"edge_ingress", "queue_wait", "lane_build",
+                "device_dispatch", "completion_decode",
+                "stream_publish"} <= names
+        for k in kids:
+            assert k["ts"] >= disp["ts"] - 1e-6
+            assert k["ts"] + k["dur"] <= disp["ts"] + disp["dur"] + 1e-6
+        assert disp["args"]["counters"] == {"fills": 1}
+    # The sink span rides the same file on its own named track (the
+    # seventh pipeline stage), sampled at the same 1-in-N rate.
+    assert sum(1 for e in doc if e.get("name") == "sink_commit") == 1
+    threads = [e for e in doc if e.get("ph") == "M"]
+    assert any(e["args"]["name"] == "sink" for e in threads)
+    c, _ = m.snapshot()
+    assert c["trace_exported_dispatches"] == 2
+
+
+def test_slow_dispatch_sampler_fires(tmp_path):
+    """A dispatch past the rolling p99 exports even when the uniform
+    1-in-N sample would skip it — the tail is what a uniform sample
+    misses by construction."""
+    m = Metrics()
+    t = TraceExporter(str(tmp_path / "trace"), metrics=m,
+                      sample_every=1_000_000)
+    m.tracer = t
+    for _ in range(300):   # establish a fast-mode rolling p99 (~ms)
+        _finish_timeline(m, age_s=0.001)
+    # (~1% of the fast dispatches may legitimately exceed the rolling
+    # p99 and export too — the sampler working as designed; the
+    # assertion is that the genuine straggler ALWAYS does.)
+    _finish_timeline(m, age_s=0.5)  # 500ms straggler >> rolling p99
+    t.close()
+    c, _ = m.snapshot()
+    assert c["trace_exported_dispatches"] >= 1
+    doc = json.load(open(t.path))
+    slow = [e for e in doc if e.get("cat") == "dispatch"
+            and e["args"]["why"] == "slow"
+            and e["args"]["e2e_us"] > 400_000]
+    assert len(slow) == 1, "the 500ms straggler must export as slow"
+
+
+def test_trace_writer_error_path_is_counted_not_fatal(tmp_path):
+    """Satellite: a full/unwritable --trace-dir must degrade to the
+    rate-limited warning + me_trace_write_errors_total — never an
+    exception on (or a stall of) the dispatch path."""
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("occupied")
+    m = Metrics()
+    t = TraceExporter(str(blocker), metrics=m, sample_every=1)
+    m.tracer = t
+    for _ in range(3):
+        _finish_timeline(m)   # must not raise
+        t.flush()             # force the write attempts synchronously
+    t.close()
+    c, _ = m.snapshot()
+    assert c["trace_write_errors"] >= 1
+    assert c["trace_exported_dispatches"] == 3  # sampled, then lost at IO
+
+
+# -- parity: busy-poll on/off ------------------------------------------------
+
+
+class _RecordingSink:
+    """Captures the storage batches the drain publishes (submit
+    signature of AsyncStorageSink, always succeeding)."""
+
+    def __init__(self):
+        self.batches = []
+
+    def submit(self, orders=None, updates=None, fills=None, block=True):
+        self.batches.append((list(orders or []), list(updates or []),
+                             list(fills or [])))  # FillRow: dataclass eq
+        return True
+
+
+_PARITY_FLOW = [
+    # (symbol, side, price_q4, qty) — makers rest, takers cross, plus a
+    # partial fill and a book-capacity mix across two symbols.
+    ("A", 2, 10_000, 5), ("A", 1, 10_100, 3), ("A", 1, 10_100, 2),
+    ("B", 2, 20_000, 4), ("B", 1, 20_000, 4),
+    ("A", 2, 10_050, 7), ("A", 1, 10_060, 10),
+]
+
+
+def _run_python_flow(busy_poll_us):
+    from matching_engine_tpu.engine.kernel import OP_SUBMIT
+    from matching_engine_tpu.server.dispatcher import BatchDispatcher
+    from matching_engine_tpu.server.engine_runner import (
+        EngineOp,
+        EngineRunner,
+        OrderInfo,
+    )
+
+    runner = EngineRunner(CFG)
+    sink = _RecordingSink()
+    disp = BatchDispatcher(runner, sink=sink, window_ms=1.0,
+                           busy_poll_us=busy_poll_us)
+    outs = []
+    for i, (sym, side, price, qty) in enumerate(_PARITY_FLOW):
+        assert runner.slot_acquire(sym) is not None
+        num, oid = runner.assign_oid()
+        info = OrderInfo(oid=num, order_id=oid, client_id=f"c{i % 3}",
+                         symbol=sym, side=side, otype=0, price_q4=price,
+                         quantity=qty, remaining=qty, status=0,
+                         handle=runner.assign_handle())
+        o = disp.submit(EngineOp(OP_SUBMIT, info)).result(timeout=30)
+        outs.append((info.order_id, o.status, o.filled, o.remaining))
+    runner.finish_pending()
+    disp.close()
+    return outs, sink.batches
+
+
+def test_busy_poll_parity_python():
+    """Busy-poll changes WHEN the drain wakes, never what it computes:
+    outcomes and storage rows are bit-identical to the blocking path."""
+    base_outs, base_rows = _run_python_flow(0.0)
+    spun_outs, spun_rows = _run_python_flow(200.0)
+    assert spun_outs == base_outs
+    # Storage content is order-identical per batch stream flattened (the
+    # drain may CHUNK differently depending on wakeup timing — chunking
+    # is a timing artifact, row content and order are the contract).
+    flat = lambda batches: [  # noqa: E731
+        (kind, row) for b in batches
+        for kind, rows in zip(("orders", "updates", "fills"), b)
+        for row in rows]
+    assert flat(spun_rows) == flat(base_rows)
+
+
+def _run_native_flow(busy_poll_us):
+    from matching_engine_tpu.server.dispatcher import LaneRingDispatcher
+    from matching_engine_tpu.server.native_lanes import NativeLanesRunner
+
+    runner = NativeLanesRunner(CFG)
+    sink = _RecordingSink()
+    disp = LaneRingDispatcher(runner, sink=sink, window_ms=1.0,
+                              busy_poll_us=busy_poll_us)
+    outs = []
+    for i, (sym, side, price, qty) in enumerate(_PARITY_FLOW):
+        o = disp.submit_record(
+            1, side=side, otype=0, price_q4=price, quantity=qty,
+            symbol=sym.encode(), client_id=f"c{i % 3}".encode(),
+        ).result(timeout=30)
+        outs.append((o.order_id, o.kind, o.ok, o.remaining, o.error))
+    runner.finish_pending()
+    disp.close()
+    return outs, sink.batches
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_busy_poll_parity_native_lanes():
+    base_outs, base_rows = _run_native_flow(0.0)
+    spun_outs, spun_rows = _run_native_flow(200.0)
+    assert spun_outs == base_outs
+    flat = lambda batches: [  # noqa: E731
+        (kind, row) for b in batches
+        for kind, rows in zip(("orders", "updates", "fills"), b)
+        for row in rows]
+    assert flat(spun_rows) == flat(base_rows)
+
+
+# -- e2e: scrape + levers + trace dir ----------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _submit(stub, client, side, price, qty=5):
+    return stub.SubmitOrder(
+        pb2.OrderRequest(client_id=client, symbol="LAT", order_type=pb2.LIMIT,
+                         side=side, price=price, scale=4, quantity=qty),
+        timeout=10)
+
+
+def test_e2e_p999_buckets_and_levers(tmp_path):
+    """One python-path server with every tail lever ON plus --trace-dir:
+    serving still works (the levers change timing/allocation, not
+    behavior), the scrape carries _p999 + native le buckets + the
+    window gauge, the book cache conflates reads, and shutdown leaves a
+    loadable Chrome trace."""
+    trace_dir = tmp_path / "trace"
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "lat.db"), CFG, window_ms=1.0,
+        log=False, native=False, flight_dir=str(tmp_path / "flight"),
+        busy_poll_us=50.0, book_cache_ms=2000.0, proto_reuse=True,
+        trace_dir=str(trace_dir), trace_sample_every=1)
+    server.start()
+    obs = ObsServer(parts["metrics"], recorder=parts["recorder"],
+                    port=0, host="127.0.0.1")
+    obs.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = MatchingEngineStub(channel)
+    try:
+        for i in range(4):
+            assert _submit(stub, "maker", pb2.SELL, 10_000 + i).success
+            assert _submit(stub, "taker", pb2.BUY, 10_100 + i).success
+        # One resting order keeps the symbol live: a fully-emptied book
+        # releases its slot and the cache (correctly) declines to cache
+        # symbols absent from the venue directory.
+        assert _submit(stub, "maker", pb2.SELL, 99_000).success
+        # Conflated book cache: two reads inside the TTL — the second is
+        # a hit and both return the same (possibly stale) snapshot.
+        b1 = stub.GetOrderBook(pb2.OrderBookRequest(symbol="LAT"),
+                               timeout=10)
+        b2 = stub.GetOrderBook(pb2.OrderBookRequest(symbol="LAT"),
+                               timeout=10)
+        assert b1 == b2
+        parts["sink"].flush()
+        _, body = _get(obs.port, "/metrics")
+        prom = dict(
+            ln.rsplit(" ", 1) for ln in body.splitlines()
+            if ln and not ln.startswith("#"))
+        assert "me_stage_queue_wait_us_p999" in prom
+        assert "me_submit_rpc_us_p999" in prom
+        assert "me_dispatch_e2e_us_p50" in prom
+        assert "me_stage_window_seconds" in prom
+        assert float(prom["me_book_cache_hits_total"]) >= 1
+        assert float(prom["me_book_cache_misses_total"]) >= 1
+        assert any(k.startswith('me_submit_rpc_us_bucket{le="')
+                   for k in prom), "native le buckets missing"
+        assert float(prom["me_trace_exported_dispatches_total"]) >= 1
+    finally:
+        channel.close()
+        shutdown(server, parts)
+        obs.close()
+    traces = list(trace_dir.glob("trace_*.json"))
+    assert traces, "--trace-dir produced no file"
+    doc = json.load(open(traces[0]))
+    dispatches = [e for e in doc if e.get("cat") == "dispatch"]
+    assert dispatches, "trace holds no dispatch slices"
+    stage_names = {e["name"] for e in doc if e.get("cat") == "stage"}
+    assert {"queue_wait", "lane_build", "device_dispatch",
+            "completion_decode", "stream_publish"} <= stage_names
+    assert any(e.get("name") == "sink_commit" for e in doc), \
+        "sink commit spans missing from the trace"
+    # Flight dump (shutdown) carries the controller/balance context.
+    dumps = list((tmp_path / "flight").glob("flight_*_shutdown.json"))
+    assert dumps
+    dump = json.loads(dumps[0].read_text())
+    assert "context" in dump

@@ -1,30 +1,102 @@
-"""Metrics registry: counters, EMA gauges, and p50/p99 histograms.
+"""Metrics registry: counters, EMA gauges, and windowed log-bucket
+histograms.
 
-BASELINE.json's metric is "orders/sec + p99 match latency" — the p99 comes
-from a sliding-window histogram surfaced as derived gauges in snapshot()
-(and therefore over the GetMetrics RPC, tests/test_server.py)."""
+BASELINE.json's metric is "orders/sec + p99 match latency" — quantiles
+come from HDR-style log-bucketed histograms over a TIME-bounded window
+(utils/metrics.py), surfaced as derived _p50/_p99/_p999 gauges in
+snapshot() (and therefore over the GetMetrics RPC, tests/test_server.py).
+Reported quantiles are bucket upper bounds: >= the true sample, within
+one ~9% bucket width above it."""
 
-from matching_engine_tpu.utils.metrics import _HIST_CAP, Metrics, Timer
+from matching_engine_tpu.utils.metrics import (
+    Metrics,
+    Timer,
+    bucket_index,
+    bucket_upper,
+)
 
 
 def test_percentiles_over_window():
     m = Metrics()
     for v in range(1, 101):  # 1..100
         m.observe("lat_us", float(v))
-    assert m.percentile("lat_us", 0.5) == 51.0
-    assert m.percentile("lat_us", 0.99) == 100.0
+    # Bucket-upper-bound quantiles: conservative (>= exact), within one
+    # bucket ratio (2^(1/8)) of the exact nearest-rank values 51 and 100.
+    p50 = m.percentile("lat_us", 0.5)
+    p99 = m.percentile("lat_us", 0.99)
+    assert 51.0 <= p50 <= 51.0 * 2 ** 0.125
+    assert 100.0 <= p99 <= 100.0 * 2 ** 0.125
     assert m.percentile("absent", 0.99) is None
     _, gauges = m.snapshot()
-    assert gauges["lat_us_p50"] == 51.0
-    assert gauges["lat_us_p99"] == 100.0
+    assert gauges["lat_us_p50"] == p50
+    assert gauges["lat_us_p99"] == p99
+    assert gauges["lat_us_p999"] >= p99
 
 
-def test_ring_is_sliding_window():
+def test_window_is_time_bounded():
+    """The satellite fix: quantiles describe the last stage_window_seconds,
+    not the last N samples — a rate collapse (megadispatch) must age old
+    samples out instead of freezing a stale p99."""
+    m = Metrics(window_s=6.0)
+    clock = [0.0]
+    m._now = lambda: clock[0]
+    m.observe("x", 1000.0)          # old-regime sample
+    clock[0] = 3.0
+    m.observe("x", 1.0)             # new-regime sample, later slice
+    assert m.percentile("x", 1.0) >= 1000.0  # both in window
+    clock[0] = 8.0                  # 1000.0's slice aged out; 1.0 remains
+    assert m.percentile("x", 1.0) < 1000.0
+    clock[0] = 60.0                 # everything aged out
+    assert m.percentile("x", 0.5) is None
+    _, gauges = m.snapshot()
+    assert gauges["stage_window_seconds"] == 6.0
+    assert "x_p50" not in gauges    # empty window: absent, not zero
+
+
+def test_stale_timestamp_never_rewinds_the_window():
+    """observe() captures its clock BEFORE the registry lock, so a
+    preempted thread can arrive with a timestamp older than one that
+    already advanced the ring — the ring must never step backwards and
+    re-zero a live slice (the stale sample lands in the current slice,
+    off by at most one slice)."""
+    m = Metrics(window_s=6.0)
+    clock = [0.9999]
+    m._now = lambda: clock[0]
+    m.observe("x", 1.0)       # epoch 0
+    clock[0] = 1.0001
+    m.observe("x", 2.0)       # advances to epoch 1
+    clock[0] = 0.9999         # the preempted thread's stale read
+    m.observe("x", 3.0)       # must NOT rewind to epoch 0
+    clock[0] = 1.1
+    m.observe("x", 4.0)       # re-advance would have wiped epoch 1
+    # The WINDOW (not the lifetime view) must still hold all 4 samples.
+    assert sum(m._hists["x"].merged(clock[0])) == 4
+
+
+def test_bucket_grid_is_monotonic_and_clamped():
+    assert bucket_index(0.0) == 0 and bucket_index(-5.0) == 0
+    last = -1
+    for v in (0.5, 1.0, 3.0, 10.0, 1e3, 1e6, 1e12):
+        i = bucket_index(v)
+        assert i >= last
+        last = i
+        assert bucket_upper(i) >= v or v >= 2.0 ** 30  # clamp at the top
+    # Upper bound is the smallest boundary >= the value's bucket.
+    assert bucket_upper(bucket_index(100.0)) >= 100.0
+
+
+def test_hist_snapshot_cumulative_buckets():
     m = Metrics()
-    for v in range(_HIST_CAP + 100):
-        m.observe("x", float(v))
-    # The first 100 samples were overwritten; min of the window is 100.
-    assert m.percentile("x", 0.0) == 100.0
+    for v in (10.0, 10.0, 500.0, 20000.0):
+        m.observe("lat_us", v)
+    snap = m.hist_snapshot()["lat_us"]
+    assert snap["count"] == 4
+    assert abs(snap["sum"] - 20520.0) < 1e-6
+    bounds = [b for b, _ in snap["buckets"]]
+    cums = [c for _, c in snap["buckets"]]
+    assert bounds == sorted(bounds)
+    assert cums == sorted(cums) and cums[-1] == 4
+    assert cums[0] == 2  # the two 10.0 samples share the first bucket
 
 
 def test_timer_feeds_both_ema_and_histogram():
@@ -38,6 +110,7 @@ def test_timer_feeds_both_ema_and_histogram():
     assert "t_us_ema" in gauges
     assert "t_us" not in gauges
     assert "t_us_p50" in gauges and "t_us_p99" in gauges
+    assert "t_us_p999" in gauges
 
 
 def test_stream_latency_metric_and_wakeup():
