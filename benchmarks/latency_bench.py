@@ -100,9 +100,20 @@ def _failed(fut) -> bool:
         res = fut.result(timeout=0)
     except Exception:  # noqa: BLE001
         return True
+    oks = getattr(res, "ok", None)
+    if oks is not None and not isinstance(oks, bool):
+        # OrderBatchResponse: `ok` is the positional status array — any
+        # rejected position fails the sample (per-op reject counting; a
+        # reject completes fast and must not pose as a quick success).
+        if getattr(res, "success", True) is False:
+            return True
+        try:
+            return not all(oks)
+        except TypeError:
+            return False
     ok = getattr(res, "success", None)
     if ok is None:
-        ok = getattr(res, "ok", True)
+        ok = oks if oks is not None else True
     if not ok:
         return True
     # OpOutcome (python pipeline) has no flag; a non-empty error string
@@ -214,6 +225,17 @@ def main() -> None:
     p.add_argument("--addr", default=None,
                    help="drive a LIVE server's SubmitOrder instead of the "
                         "in-proc pipeline (open-loop RPCs)")
+    p.add_argument("--batch-size", type=int, default=1, metavar="N",
+                   help="with --addr: drive SubmitOrderBatch with N packed "
+                        "op-records per RPC instead of per-op SubmitOrder "
+                        "(the batch edge; domain/oprec.py codec). Rates "
+                        "stay in ORDERS/s — the scheduler issues rate/N "
+                        "batches per second — and each latency sample is "
+                        "one batch's turnaround (every op in it completes "
+                        "with the batch). A batch with ANY positional "
+                        "reject counts as an error, so rejects can't "
+                        "masquerade as fast completions. 1 = per-op "
+                        "(default)")
     p.add_argument("--peak", type=float, default=0.0,
                    help="skip peak measurement and use this orders/s")
     p.add_argument("--scrape", default=None,
@@ -502,6 +524,7 @@ def run_grpc(args) -> dict:
     channel = grpc.insecure_channel(args.addr)
     stub = MatchingEngineStub(channel)
     state = {"i": int(time.time()) % 1000000 * 1000}
+    bs = max(1, args.batch_size)
 
     def make_req():
         i = state["i"]
@@ -513,12 +536,34 @@ def run_grpc(args) -> dict:
             side=pb2.SELL if maker else pb2.BUY,
             price=10_000, scale=4, quantity=5)
 
-    def submit_one(done_cb):
-        fut = stub.SubmitOrder.future(make_req(), timeout=30)
-        fut.add_done_callback(done_cb)
+    if bs > 1:
+        # Batch edge: each scheduled slot is ONE SubmitOrderBatch of bs
+        # maker/taker records (domain/oprec.py payload); rates stay in
+        # orders/s — the caller divides by bs when scheduling slots.
+        from matching_engine_tpu.domain import oprec
+
+        def make_payload():
+            i = state["i"]
+            state["i"] += bs
+            ops = []
+            for j in range(i, i + bs):
+                maker = (j % 2) == 0
+                ops.append((oprec.OPREC_SUBMIT, 2 if maker else 1, 0,
+                            10_000, 5, f"LAT{(j // 2) % 4}",
+                            "lat-m" if maker else "lat-t", ""))
+            return oprec.encode_payload(oprec.pack_records(ops))
+
+        def submit_one(done_cb):
+            fut = stub.SubmitOrderBatch.future(
+                pb2.OrderBatchRequest(ops=make_payload()), timeout=30)
+            fut.add_done_callback(done_cb)
+    else:
+        def submit_one(done_cb):
+            fut = stub.SubmitOrder.future(make_req(), timeout=30)
+            fut.add_done_callback(done_cb)
 
     if args.peak:
-        peak = args.peak
+        peak = args.peak / bs  # --peak is orders/s; slots carry bs each
     else:
         # Closed-loop peak with bounded in-flight RPCs. A dead/refusing
         # server fails futures FAST — without the error gate it would
@@ -575,22 +620,29 @@ def run_grpc(args) -> dict:
                   f"open-loop RPCs failed", file=sys.stderr)
             raise SystemExit(1)
         rows.append({
-            "mode": "grpc", "load_fraction": frac,
-            "target_ops_s": round(peak * frac, 1),
-            "achieved_ops_s": best["achieved_ops_s"],
-            "n_ops": best["n_ops"], "e2e": best["e2e"],
+            "mode": "grpc" if bs == 1 else "grpc-batch",
+            "batch_size": bs,
+            "load_fraction": frac,
+            "target_ops_s": round(peak * bs * frac, 1),
+            "achieved_ops_s": round(best["achieved_ops_s"] * bs, 1),
+            "n_ops": best["n_ops"] * bs,
+            # Each latency sample is one SLOT's turnaround: a single RPC
+            # (bs=1) or a whole batch (every op completes with it).
+            "e2e": best["e2e"],
             "p99_over_p50": round(
                 best["e2e"]["p99_ms"] / best["e2e"]["p50_ms"], 2),
             "repeats": len(reps), "p99_ms_spread": [min(p99s), max(p99s)],
             "errors": best["errors"],
         })
-        print(f"[latency_bench] grpc frac={frac} "
+        print(f"[latency_bench] grpc bs={bs} frac={frac} "
               f"p50={best['e2e']['p50_ms']}ms p99={best['e2e']['p99_ms']}ms")
 
     out = {
         "metric": "serving_latency_tail",
-        "drive": f"grpc open-loop @ {args.addr}",
-        "peak_ops_s": {"grpc": round(peak, 1)},
+        "drive": f"grpc open-loop @ {args.addr}"
+                 + (f" (SubmitOrderBatch x{bs})" if bs > 1 else ""),
+        "batch_size": bs,
+        "peak_ops_s": {"grpc": round(peak * bs, 1)},
         "rows": rows,
     }
     if args.scrape:

@@ -102,6 +102,38 @@ def main() -> None:
                         "like the shards sweep. Composes with --host-only "
                         "(the stacked step is recorded/replayed like the "
                         "serial ones)")
+    p.add_argument("--edge-batch", default="",
+                   help="comma list of batch sizes to sweep over a LIVE "
+                        "loopback gRPC server (the batch-native edge): "
+                        "boots one server subprocess per --mode entry "
+                        "('python' = the default runtime layer, 'native' "
+                        "= --native-lanes) with --edge-mega megadispatch "
+                        "waves, then drives it closed-loop from "
+                        "--edge-threads client threads — batch size 1 is "
+                        "the per-op SubmitOrder baseline, larger sizes "
+                        "drive SubmitOrderBatch with packed op-records "
+                        "(domain/oprec.py). Per-op rejects are counted "
+                        "from the positional statuses so rejects can't "
+                        "masquerade as throughput. Produces the "
+                        "cpu_serving_batch artifact; best-of --repeats "
+                        "with spread like the other sweeps")
+    p.add_argument("--edge-threads", type=int, default=4,
+                   help="concurrent client threads per edge sweep point")
+    p.add_argument("--edge-ops", type=int, default=16384,
+                   help="orders per measured edge point (rounded down to "
+                        "a batch-size multiple)")
+    p.add_argument("--edge-perop-ops", type=int, default=2048,
+                   help="orders per PER-OP baseline point (batch size 1): "
+                        "the per-op edge runs ~two orders of magnitude "
+                        "slower, so the baseline uses a smaller sample to "
+                        "keep sweep wall time sane")
+    p.add_argument("--edge-mega", type=int, default=4,
+                   help="--megadispatch-max-waves for the edge servers: "
+                        "deep batch backlogs stack into mega scans on "
+                        "BOTH paths (python controller / native "
+                        "wave_mega) — engagement is measured into the "
+                        "row via the me_megadispatch_* counters")
+    p.add_argument("--edge-window-ms", type=float, default=1.0)
     p.add_argument("--host-only", action="store_true",
                    help="isolate the serving stack's HOST work (lane "
                         "build, id/slot assignment, status decode, "
@@ -643,12 +675,231 @@ def main() -> None:
             "waves_per_step": round(waves / steps, 2) if steps else 1.0,
         }
 
+    # -- batch edge sweep (SubmitOrderBatch vs per-op, live gRPC) ----------
+
+    def edge_server(mode: str, tmp: str):
+        """Boot one serving subprocess (the real edge: loopback gRPC, its
+        own GIL) and return (proc, port, logpath). mode 'python' is the
+        default runtime layer; 'native' adds --native-lanes."""
+        import subprocess
+
+        log_path = os.path.join(tmp, f"server_{mode}.log")
+        argv = [sys.executable, "-m", "matching_engine_tpu.server.main",
+                "--addr", "127.0.0.1:0",
+                "--db", os.path.join(tmp, f"edge_{mode}.db"),
+                "--symbols", str(args.symbols),
+                "--capacity", str(args.capacity),
+                "--batch", str(args.batch),
+                "--window-ms", str(args.edge_window_ms),
+                "--feed-depth", "0",
+                "--megadispatch-max-waves", str(args.edge_mega)]
+        if mode == "native":
+            argv.append("--native-lanes")
+        env = dict(os.environ, PYTHONUNBUFFERED="1")
+        logf = open(log_path, "w")
+        proc = subprocess.Popen(argv, stdout=logf, stderr=subprocess.STDOUT,
+                                env=env)
+        port = None
+        deadline = time.time() + 180
+        import re as _re
+
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"edge server ({mode}) died at boot; see {log_path}")
+            m = _re.search(r"listening on port (\d+)",
+                           open(log_path).read())
+            if m:
+                port = int(m.group(1))
+                break
+            time.sleep(0.25)
+        if port is None:
+            proc.kill()
+            raise RuntimeError(f"edge server ({mode}) never bound a port")
+        return proc, port, log_path
+
+    def edge_sweep() -> list:
+        import threading as _th
+
+        import grpc
+
+        from matching_engine_tpu.domain import oprec
+        from matching_engine_tpu.proto import pb2
+        from matching_engine_tpu.proto.rpc import MatchingEngineStub
+
+        sizes = [int(x) for x in args.edge_batch.split(",") if x.strip()]
+        T = max(1, args.edge_threads)
+        rows = []
+
+        def gen_ops(n: int, thread: int):
+            """Maker/taker alternation per symbol (SELL rests, the next
+            BUY crosses it out) so books stay shallow however long the
+            sweep runs — rejects stay a counted anomaly, not the load.
+            ONE symbol namespace sized to the engine's axis, shared by
+            every thread: per-thread namespaces would demand T*symbols
+            live slots and reject half the load as axis overflow."""
+            ops = []
+            for i in range(n):
+                sym = f"E{i % args.symbols}"
+                maker = ((i // args.symbols) % 2) == 0
+                ops.append((oprec.OPREC_SUBMIT, 2 if maker else 1, 0,
+                            10_000, 5, sym,
+                            f"em{thread}" if maker else f"et{thread}", ""))
+            return ops
+
+        def scrape(stub):
+            resp = stub.GetMetrics(pb2.MetricsRequest(), timeout=30)
+            return dict(resp.counters)
+
+        def run_point(stubs, bs: int, measured: bool,
+                      n_override: int | None = None) -> dict:
+            budget = n_override or (args.edge_perop_ops if bs == 1
+                                    else args.edge_ops)
+            n_ops = max(bs * T, budget - budget % max(bs, 1))
+            per_thread = n_ops // T
+            work = []
+            for t in range(T):
+                ops = gen_ops(per_thread, t)
+                if bs == 1:
+                    work.append([
+                        pb2.OrderRequest(
+                            client_id=cid.decode()
+                            if isinstance(cid, bytes) else cid,
+                            symbol=sym, order_type=pb2.LIMIT, side=side,
+                            price=price, scale=4, quantity=qty)
+                        for (_op, side, _ot, price, qty, sym, cid, _oid)
+                        in ops])
+                else:
+                    arr = oprec.pack_records(ops)
+                    work.append([oprec.slice_payload(arr, s, bs)
+                                 for s in range(0, per_thread, bs)])
+            counts = [None] * T
+            barrier = _th.Barrier(T + 1)
+
+            def worker(t):
+                stub = stubs[t]
+                acc = rej = err = 0
+                barrier.wait()
+                if bs == 1:
+                    for req in work[t]:
+                        try:
+                            r = stub.SubmitOrder(req, timeout=60)
+                            if r.success:
+                                acc += 1
+                            else:
+                                rej += 1
+                        except grpc.RpcError:
+                            err += 1
+                else:
+                    for payload in work[t]:
+                        try:
+                            r = stub.SubmitOrderBatch(
+                                pb2.OrderBatchRequest(ops=payload),
+                                timeout=120)
+                        except grpc.RpcError:
+                            err += bs
+                            continue
+                        if not r.success:
+                            err += bs
+                            continue
+                        a = sum(r.ok)
+                        acc += a
+                        rej += len(r.ok) - a
+                counts[t] = (acc, rej, err)
+
+            c0 = scrape(stubs[0]) if measured else {}
+            threads = [_th.Thread(target=worker, args=(t,), daemon=True)
+                       for t in range(T)]
+            for th in threads:
+                th.start()
+            barrier.wait()
+            t_begin = time.perf_counter()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t_begin
+            if not measured:
+                return {}
+            c1 = scrape(stubs[0])
+            acc = sum(c[0] for c in counts)
+            rej = sum(c[1] for c in counts)
+            err = sum(c[2] for c in counts)
+            steps = c1.get("megadispatch_steps", 0) - c0.get(
+                "megadispatch_steps", 0)
+            waves = c1.get("megadispatch_stacked_waves", 0) - c0.get(
+                "megadispatch_stacked_waves", 0)
+            return {
+                "batch_size": bs,
+                "threads": T,
+                "n_ops": n_ops,
+                "orders_per_s": round(n_ops / dt, 1),
+                "accepted_per_s": round(acc / dt, 1),
+                "accepted": acc,
+                "rejected": rej,
+                "rpc_errors": err,
+                "wall_s": round(dt, 3),
+                "edge_batches": c1.get("edge_batches", 0) - c0.get(
+                    "edge_batches", 0),
+                "mega_steps": steps,
+                "mega_waves_per_step": round(waves / steps, 2) if steps
+                else 0.0,
+            }
+
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="edge_bench_")
+        for mode in [m.strip() for m in args.mode.split(",") if m.strip()]:
+            if mode == "native":
+                from matching_engine_tpu import native as me_native
+
+                if not me_native.available():
+                    print("[edge] native runtime not built; skipping "
+                          "native mode", file=sys.stderr)
+                    continue
+            proc, port, log_path = edge_server(mode, tmp)
+            try:
+                stubs = [MatchingEngineStub(
+                    grpc.insecure_channel(f"127.0.0.1:{port}"))
+                    for _ in range(T)]
+                # Warm: compile the dispatch shapes (per-op sparse buckets
+                # + the largest batch's dense/mega stack) outside every
+                # measured point, with small op budgets — warming is about
+                # shape coverage, not duration.
+                run_point(stubs, 1, measured=False, n_override=64 * T)
+                run_point(stubs, max(sizes), measured=False,
+                          n_override=2 * max(sizes) * T)
+                for bs in sizes:
+                    reps = [run_point(stubs, bs, measured=True)
+                            for _ in range(max(1, args.repeats))]
+                    rates = [r["orders_per_s"] for r in reps]
+                    best = max(reps, key=lambda r: r["orders_per_s"])
+                    best["mode"] = mode
+                    best["edge"] = ("grpc-perop" if bs == 1
+                                    else "grpc-batch")
+                    best["repeats"] = len(reps)
+                    best["orders_per_s_spread"] = [min(rates), max(rates)]
+                    rows.append(best)
+                    print(f"[edge] {mode} bs={bs}: "
+                          f"{best['orders_per_s']} orders/s "
+                          f"(acc {best['accepted']}, rej "
+                          f"{best['rejected']}, err {best['rpc_errors']}, "
+                          f"megaM {best['mega_waves_per_step']})",
+                          file=sys.stderr)
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+        return rows
+
     grid_cap = args.symbols * args.batch
     mega_list = [int(x) for x in args.megadispatch.split(",")
                  if x.strip()] if args.megadispatch else []
     shard_list = [int(k) for k in args.serve_shards.split(",")
                   if k.strip()] if args.serve_shards else []
-    if mega_list:
+    if args.edge_batch:
+        rows = edge_sweep()
+    elif mega_list:
 
         def best_of_mega(m, k):
             reps = [sweep_point_mega(m, k)
@@ -698,7 +949,8 @@ def main() -> None:
     except Exception:  # noqa: BLE001
         rev = "unknown"
     out = {
-        "metric": "runner_dispatch_throughput",
+        "metric": ("batch_edge_throughput" if args.edge_batch
+                   else "runner_dispatch_throughput"),
         "platform": platform,
         "symbols": args.symbols,
         "capacity": args.capacity,
@@ -711,6 +963,9 @@ def main() -> None:
         "sweep": rows,
         "git_rev": rev,
     }
+    if args.edge_batch:
+        out["edge_mega"] = args.edge_mega
+        out["edge_window_ms"] = args.edge_window_ms
     tmp = args.json_out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f, indent=1)

@@ -32,6 +32,8 @@ USAGE = (
     "                 [--from-seq N] [--epoch N] [--conflate]\n"
     "                 [--no-gap-fill] [--max-events N]\n"
     "                 [--idle-exit SECS] [--summary-json FILE] [--quiet]\n"
+    "   or: client submit-batch <addr> <opfile> [--batch-size N]\n"
+    "                 [--summary-json FILE] [--quiet]\n"
     "   or: client metrics <addr>\n"
     "   or: client auction <addr> [symbol]"
 )
@@ -287,6 +289,85 @@ def _subscribe(argv: list[str]) -> int:
     return rc
 
 
+def _submit_batch(argv: list[str]) -> int:
+    """Replay a recorded op file through SubmitOrderBatch: the file is the
+    flat binary op-record wire (domain/oprec.py — the SAME codec reader
+    the bench replay uses), sliced into --batch-size requests. Per-op
+    statuses come back positionally; the summary counts them. Exit 3 when
+    nothing was accepted, 2 on RPC failure."""
+    import json
+    import time
+
+    from matching_engine_tpu.domain import oprec
+
+    addr, path = argv[0], argv[1]
+    batch_size, summary_json, quiet = 512, None, False
+    it = iter(argv[2:])
+    try:
+        for a in it:
+            if a == "--batch-size":
+                batch_size = int(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            elif a == "--quiet":
+                quiet = True
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except StopIteration:
+        print(USAGE, file=sys.stderr)
+        return 1
+    if batch_size < 1:
+        print(USAGE, file=sys.stderr)
+        return 1
+    try:
+        arr = oprec.read_opfile(path)
+    except (OSError, oprec.OpRecError) as e:
+        print(f"[client] cannot read op file: {e}", file=sys.stderr)
+        return 1
+    stub = _stub(addr)
+    total = len(arr)
+    accepted = rejected = batches = 0
+    errors: dict[str, int] = {}
+    t0 = time.perf_counter()
+    for start in range(0, total, batch_size):
+        payload = oprec.slice_payload(arr, start, batch_size)
+        try:
+            resp = stub.SubmitOrderBatch(
+                pb2.OrderBatchRequest(ops=payload), timeout=60)
+        except grpc.RpcError as e:
+            print(f"[client] rpc failed: {e.code().name}: {e.details()}",
+                  file=sys.stderr)
+            return 2
+        batches += 1
+        if not resp.success:
+            print(f"[client] batch rejected: {resp.error_message}",
+                  file=sys.stderr)
+            return 3
+        for i, ok in enumerate(resp.ok):
+            if ok:
+                accepted += 1
+            else:
+                rejected += 1
+                err = resp.error[i]
+                errors[err] = errors.get(err, 0) + 1
+                if not quiet:
+                    print(f"[client] op {start + i} rejected: {err}")
+    dt = time.perf_counter() - t0
+    rate = accepted / dt if dt > 0 else 0.0
+    summary = {"ops": total, "batches": batches, "batch_size": batch_size,
+               "accepted": accepted, "rejected": rejected,
+               "wall_s": round(dt, 3), "accepted_per_s": round(rate, 1),
+               "reject_reasons": errors}
+    print(f"[client] batch replay: {accepted}/{total} accepted in "
+          f"{batches} batch(es), {dt:.3f}s ({rate:.0f} accepted/s)",
+          file=sys.stderr, flush=True)
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f)
+    return 0 if accepted > 0 or total == 0 else 3
+
+
 def _metrics(addr: str) -> int:
     resp = _stub(addr).GetMetrics(pb2.MetricsRequest(), timeout=10)
     for k in sorted(resp.counters):
@@ -323,6 +404,8 @@ def _dispatch(argv: list[str]) -> int:
         # --summary-json f` is ALSO 8 args.
         if len(argv) >= 4 and argv[0] == "subscribe":
             return _subscribe(argv[1:])
+        if len(argv) >= 3 and argv[0] == "submit-batch":
+            return _submit_batch(argv[1:])
         if len(argv) == 8:
             return _submit(argv)
         if len(argv) == 3 and argv[0] == "book":

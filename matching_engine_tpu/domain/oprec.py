@@ -1,0 +1,287 @@
+"""The flat binary op-record codec — ONE wire format for every edge.
+
+Every ingress path that carries orders in bulk (the SubmitOrderBatch RPC,
+recorded-flow replay in the benches, the CLI's submit-batch verb, and any
+future shared-memory edge) is a codec over the same fixed-width
+little-endian record. The record is the *engine-facing* op tuple: the
+collapsed (order_type, tif) device code and the Q4-normalized price — what
+MeGwOp (native/me_gwop.h) carries across the ring — so decoding a batch
+never re-runs price normalization or tif collapsing per op, and the C++
+lane engine converts a packed payload straight into ring records in one
+crossing (me_oprec_to_gwop).
+
+Layout (little-endian, 384 bytes/record, natural C alignment — mirrored
+byte-for-byte by MeOpRec in native/me_gwop.h; tests fuzz the round trip
+python <-> C++):
+
+    offset  field          type
+    0       op             u8   1=submit / 2=cancel / 3=amend (MeGwOp.op)
+    1       side           u8   BUY=1 / SELL=2 (submits)
+    2       otype          u8   collapsed device code (proto.collapse_otype)
+    3       flags          u8   reserved, must be 0
+    4       price_q4       i32  normalized; 0 for MARKET
+    8       quantity       i64  submit qty / amend new-quantity
+    16      symbol_len     u16
+    18      client_id_len  u16
+    20      order_id_len   u16
+    22      (pad)          u16
+    24      symbol         64 bytes
+    88      client_id      256 bytes
+    344     order_id       36 bytes ("OID-<n>" cancel/amend target)
+    380     (pad)          4 bytes
+
+A batch payload (and a recorded op FILE) is the 8-byte magic ``MEOPREC1``
+followed by N records. Encode/decode are numpy-vectorized: the hot cost is
+one structured-array copy, never per-op python.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MAGIC = b"MEOPREC1"
+RECORD_SIZE = 384
+HEADER_SIZE = len(MAGIC)
+
+# Wire op codes — identical to MeGwOp.op (native/me_gwop.h).
+OPREC_SUBMIT, OPREC_CANCEL, OPREC_AMEND = 1, 2, 3
+
+# Field byte budgets (the struct's fixed string boxes; the symbol box is
+# exactly MAX_SYMBOL_BYTES — domain/order.py — so a record can never carry
+# an identifier the engine would have to truncate).
+SYMBOL_BYTES, CLIENT_ID_BYTES, ORDER_ID_BYTES = 64, 256, 36
+
+OPREC_DTYPE = np.dtype([
+    ("op", "u1"),
+    ("side", "u1"),
+    ("otype", "u1"),
+    ("flags", "u1"),
+    ("price_q4", "<i4"),
+    ("quantity", "<i8"),
+    ("symbol_len", "<u2"),
+    ("client_id_len", "<u2"),
+    ("order_id_len", "<u2"),
+    ("_pad", "<u2"),
+    ("symbol", f"S{SYMBOL_BYTES}"),
+    ("client_id", f"S{CLIENT_ID_BYTES}"),
+    ("order_id", f"S{ORDER_ID_BYTES}"),
+    ("_pad2", "V4"),
+])
+assert OPREC_DTYPE.itemsize == RECORD_SIZE
+
+
+# Raw byte offsets of the string boxes (field extraction would go through
+# numpy's S-dtype scalar, which strips TRAILING NULs — identifiers like
+# b"abc\x00" must round-trip exactly, so reads slice the raw record).
+_SYM_OFF = OPREC_DTYPE.fields["symbol"][1]
+_CID_OFF = OPREC_DTYPE.fields["client_id"][1]
+_OID_OFF = OPREC_DTYPE.fields["order_id"][1]
+
+
+def record_symbol(r) -> bytes:
+    """One record's symbol bytes, exact (trailing NULs preserved)."""
+    return r.tobytes()[_SYM_OFF:_SYM_OFF + int(r["symbol_len"])]
+
+
+def record_order_id(r) -> bytes:
+    """One record's order-id bytes, exact (trailing NULs preserved)."""
+    return r.tobytes()[_OID_OFF:_OID_OFF + int(r["order_id_len"])]
+
+
+class OpRecError(ValueError):
+    """Malformed payload (bad magic / truncated / oversized). Raised by
+    decode_payload for defects that poison the WHOLE batch; per-record
+    flaws surface positionally via record_flaws instead."""
+
+
+def _as_bytes(s) -> bytes:
+    return s.encode() if isinstance(s, str) else bytes(s)
+
+
+def pack_records(ops) -> np.ndarray:
+    """Build a structured record array from op tuples.
+
+    ops: iterable of (op, side, otype, price_q4, quantity, symbol,
+    client_id, order_id) with str-or-bytes strings — the same tuple order
+    the ring record uses (native_lanes.pack_record_batch minus the tag:
+    batch payloads are positional, the tag is assigned server-side).
+    """
+    rows = list(ops)
+    arr = np.zeros(len(rows), dtype=OPREC_DTYPE)
+    for i, (op, side, otype, price_q4, qty, sym, cid, oid) in enumerate(rows):
+        sym, cid, oid = _as_bytes(sym), _as_bytes(cid), _as_bytes(oid)
+        if (len(sym) > SYMBOL_BYTES or len(cid) > CLIENT_ID_BYTES
+                or len(oid) > ORDER_ID_BYTES):
+            raise OpRecError(
+                f"record {i}: identifier exceeds the fixed record box "
+                f"(symbol<={SYMBOL_BYTES}, client_id<={CLIENT_ID_BYTES}, "
+                f"order_id<={ORDER_ID_BYTES} bytes)")
+        r = arr[i]
+        r["op"], r["side"], r["otype"] = op, side, otype
+        r["price_q4"], r["quantity"] = price_q4, qty
+        r["symbol_len"], r["client_id_len"], r["order_id_len"] = (
+            len(sym), len(cid), len(oid))
+        r["symbol"], r["client_id"], r["order_id"] = sym, cid, oid
+    return arr
+
+
+def pack_submit_columns(sides, otypes, prices_q4, quantities, symbols,
+                        client_ids) -> np.ndarray:
+    """Vectorized submit-only builder (bench/replay generators): numeric
+    columns land via bulk numpy assignment; the only per-op python is the
+    byte-length scan for the string columns."""
+    n = len(sides)
+    arr = np.zeros(n, dtype=OPREC_DTYPE)
+    arr["op"] = OPREC_SUBMIT
+    arr["side"] = np.asarray(sides, dtype=np.uint8)
+    arr["otype"] = np.asarray(otypes, dtype=np.uint8)
+    arr["price_q4"] = np.asarray(prices_q4, dtype=np.int32)
+    arr["quantity"] = np.asarray(quantities, dtype=np.int64)
+    syms = [_as_bytes(s) for s in symbols]
+    cids = [_as_bytes(c) for c in client_ids]
+    arr["symbol"] = syms
+    arr["client_id"] = cids
+    arr["symbol_len"] = [len(s) for s in syms]
+    arr["client_id_len"] = [len(c) for c in cids]
+    return arr
+
+
+def encode_payload(arr: np.ndarray) -> bytes:
+    """Records -> one batch payload (the SubmitOrderBatch `ops` bytes and
+    the recorded-op-file body): magic + packed records."""
+    if arr.dtype != OPREC_DTYPE:
+        arr = np.asarray(arr, dtype=OPREC_DTYPE)
+    return MAGIC + arr.tobytes()
+
+
+def decode_payload(payload: bytes, max_records: int | None = None
+                   ) -> np.ndarray:
+    """One batch payload -> records. Raises OpRecError on a malformed
+    payload (wrong magic, truncated/ragged body, over the record cap) —
+    the batch-poisoning defects; per-record problems are reported
+    positionally by record_flaws so one bad op never fails the batch."""
+    if len(payload) < HEADER_SIZE or payload[:HEADER_SIZE] != MAGIC:
+        raise OpRecError("bad op-record magic (not an MEOPREC1 payload)")
+    body = payload[HEADER_SIZE:]
+    if len(body) % RECORD_SIZE != 0:
+        raise OpRecError(
+            f"truncated op-record payload ({len(body)} bytes is not a "
+            f"multiple of the {RECORD_SIZE}-byte record)")
+    n = len(body) // RECORD_SIZE
+    if max_records is not None and n > max_records:
+        raise OpRecError(
+            f"op-record batch of {n} exceeds the per-request cap "
+            f"{max_records}")
+    return np.frombuffer(body, dtype=OPREC_DTYPE)
+
+
+def record_flaws(arr: np.ndarray) -> list[str | None]:
+    """Per-record EDGE validation, vectorized: a list of None (ok) or a
+    reject message, positionally — everything decidable without engine
+    state (codec structure, op codes, value ranges, the Q4 price lane
+    bounds). Semantic checks (symbol ownership, auction mode, directory
+    lookups) stay with the serving path that owns them. Flawed records
+    never reach the native converter, whose structural guards would
+    otherwise fail the WHOLE batch."""
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+    from matching_engine_tpu.domain.price import MAX_DEVICE_PRICE_Q4
+
+    n = len(arr)
+    msgs: list[str | None] = [None] * n
+    op = arr["op"]
+    bad_op = ~np.isin(op, (OPREC_SUBMIT, OPREC_CANCEL, OPREC_AMEND))
+    bad_flags = arr["flags"] != 0
+    bad_lens = ((arr["symbol_len"] > SYMBOL_BYTES)
+                | (arr["client_id_len"] > CLIENT_ID_BYTES)
+                | (arr["order_id_len"] > ORDER_ID_BYTES))
+    is_submit = op == OPREC_SUBMIT
+    is_target = (op == OPREC_CANCEL) | (op == OPREC_AMEND)
+    no_symbol = is_submit & (arr["symbol_len"] == 0)
+    no_target = is_target & (arr["order_id_len"] == 0)
+    no_client = is_target & (arr["client_id_len"] == 0)
+    bad_side = is_submit & ~np.isin(arr["side"], (1, 2))
+    bad_otype = is_submit & (arr["otype"] > 4)  # collapsed device codes 0..4
+    qty = arr["quantity"]
+    bad_qty = (is_submit | (op == OPREC_AMEND)) & (qty <= 0)
+    # Amends share the bound: an over-cap new_quantity could never be a
+    # strict reduction of an in-cap order, and the i64 record field must
+    # not reach the engine's int32 quantity lane.
+    big_qty = (is_submit | (op == OPREC_AMEND)) & (qty > MAX_QUANTITY)
+    # Priced collapsed codes (LIMIT=0 / LIMIT_IOC=2 / LIMIT_FOK=3) need a
+    # positive in-lane Q4 price; market codes (1, 4) must carry 0 — the
+    # record IS the engine tuple, there is no "ignored" price column.
+    price = arr["price_q4"]
+    priced = is_submit & np.isin(arr["otype"], (0, 2, 3))
+    market = is_submit & np.isin(arr["otype"], (1, 4))
+    bad_price = priced & ((price <= 0) | (price > MAX_DEVICE_PRICE_Q4))
+    bad_mkt_price = market & (price != 0)
+    for i in np.nonzero(bad_op | bad_flags | bad_lens | no_symbol
+                        | no_target | no_client | bad_side | bad_otype
+                        | bad_qty | big_qty | bad_price | bad_mkt_price)[0]:
+        if bad_op[i]:
+            msgs[i] = "invalid op code (1=submit, 2=cancel, 3=amend)"
+        elif bad_flags[i]:
+            msgs[i] = "reserved flags must be 0"
+        elif bad_lens[i]:
+            msgs[i] = "identifier length exceeds the record box"
+        elif no_symbol[i]:
+            msgs[i] = "symbol is required"
+        elif no_target[i]:
+            msgs[i] = "unknown order id"
+        elif no_client[i]:
+            msgs[i] = "client_id is required"
+        elif bad_side[i]:
+            msgs[i] = "side must be BUY or SELL"
+        elif bad_otype[i]:
+            msgs[i] = "unsupported (order_type, tif) combination"
+        elif bad_qty[i]:
+            msgs[i] = ("new_quantity must be positive"
+                       if op[i] == OPREC_AMEND
+                       else "quantity must be positive")
+        elif big_qty[i]:
+            msgs[i] = (f"quantity exceeds the engine maximum "
+                       f"{MAX_QUANTITY} (int32 book-sum safety bound)")
+        elif bad_price[i]:
+            msgs[i] = (f"price_q4 out of the engine's int32 price lane "
+                       f"(0, {MAX_DEVICE_PRICE_Q4}]")
+        else:
+            msgs[i] = "MARKET records must carry price_q4=0"
+    return msgs
+
+
+def record_fields(r) -> tuple:
+    """One record -> the (op, side, otype, price_q4, quantity, symbol,
+    client_id, order_id) tuple with length-sliced BYTES strings, read
+    from the RAW record bytes at the field offsets: any numpy S-dtype
+    field extraction strips TRAILING NULs, which would shorten an id
+    like b"abc\\x00" to 3 bytes on the python path while the C++
+    converter memcpys all 4 — embedded AND trailing NULs must
+    round-trip identically (the MeGwOp contract; fuzz-pinned)."""
+    raw = r.tobytes()
+    return (int(r["op"]), int(r["side"]), int(r["otype"]),
+            int(r["price_q4"]), int(r["quantity"]),
+            raw[_SYM_OFF:_SYM_OFF + int(r["symbol_len"])],
+            raw[_CID_OFF:_CID_OFF + int(r["client_id_len"])],
+            raw[_OID_OFF:_OID_OFF + int(r["order_id_len"])])
+
+
+# -- recorded op files --------------------------------------------------------
+#
+# A recorded flow is just a payload on disk: the CLI's submit-batch verb,
+# the soak's codec-replay round, and the benches all read the same file
+# through read_opfile and re-slice it into request payloads.
+
+def write_opfile(path: str, arr: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(encode_payload(arr))
+
+
+def read_opfile(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        return decode_payload(f.read())
+
+
+def slice_payload(arr: np.ndarray, start: int, count: int) -> bytes:
+    """Re-encode records [start, start+count) as one request payload —
+    how a recorded file becomes a stream of SubmitOrderBatch calls."""
+    return encode_payload(arr[start:start + count])

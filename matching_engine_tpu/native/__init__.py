@@ -298,6 +298,7 @@ GW_CALLBACK = ctypes.CFUNCTYPE(
 # Forwarded-method ids (me_gateway.cpp Method enum).
 (GW_SUBMIT, GW_CANCEL, GW_BOOK, GW_METRICS, GW_STREAM_MD, GW_STREAM_OU,
  GW_AUCTION) = range(1, 8)
+GW_BATCH = 9  # SubmitOrderBatch (M_AMEND=8 is a hot-path id, not forwarded)
 
 
 def _load_gateway():
@@ -699,10 +700,19 @@ def _bind_lanes(lib) -> None:
     lib.me_lanes_build.restype = ctypes.c_int
     lib.me_lanes_wave.argtypes = [ctypes.c_void_p, ctypes.c_uint32, i32p]
     lib.me_lanes_wave.restype = ctypes.c_int
+    lib.me_lanes_wave_mega.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32, i32p,
+    ]
+    lib.me_lanes_wave_mega.restype = ctypes.c_int
     lib.me_lanes_decode_wave.argtypes = [
         ctypes.c_void_p, i32p, ctypes.c_longlong, i32p, ctypes.c_longlong,
     ]
     lib.me_lanes_decode_wave.restype = ctypes.c_longlong
+    lib.me_lanes_decode_mega.argtypes = [
+        ctypes.c_void_p, i32p, ctypes.c_longlong, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, i32p, ctypes.c_longlong,
+    ]
+    lib.me_lanes_decode_mega.restype = ctypes.c_longlong
     lib.me_lanes_finish.argtypes = [ctypes.c_void_p, i64p, i64p, i64p]
     lib.me_lanes_finish.restype = ctypes.c_int
     lib.me_lanes_take.argtypes = [ctypes.c_void_p, u8p, u8p, u8p]
@@ -744,6 +754,15 @@ def _bind_lanes(lib) -> None:
     lib.me_gwring_destroy.argtypes = [ctypes.c_void_p]
     lib.me_gwring_push.argtypes = [ctypes.c_void_p, P(MeGwOp)]
     lib.me_gwring_push.restype = ctypes.c_int
+    lib.me_gwring_push_n.argtypes = [
+        ctypes.c_void_p, P(MeGwOp), ctypes.c_uint32,
+    ]
+    lib.me_gwring_push_n.restype = ctypes.c_int
+    lib.me_oprec_to_gwop.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_uint64, P(MeGwOp),
+        ctypes.c_uint32,
+    ]
+    lib.me_oprec_to_gwop.restype = ctypes.c_int
     lib.me_gwring_pop_batch.argtypes = [
         ctypes.c_void_p, P(MeGwOp), ctypes.c_uint32, ctypes.c_uint64,
         ctypes.c_int64,
@@ -752,6 +771,20 @@ def _bind_lanes(lib) -> None:
     lib.me_gwring_close.argtypes = [ctypes.c_void_p]
     lib.me_gwring_dropped.argtypes = [ctypes.c_void_p]
     lib.me_gwring_dropped.restype = ctypes.c_uint64
+
+
+def oprec_to_gwop(body: bytes, n: int, tag_base: int):
+    """Convert a packed op-record run (domain/oprec.py records, WITHOUT
+    the magic header) into a tagged (MeGwOp * n) array in ONE native
+    crossing: record i gets tag tag_base + i. Raises on structural skew
+    (the edge pre-screens per-record flaws positionally, so a failure
+    here is a caller bug, never client input)."""
+    lib = _load()
+    out = (MeGwOp * max(1, n))()
+    rc = lib.me_oprec_to_gwop(body, len(body), tag_base, out, n)
+    if rc != n:
+        raise RuntimeError(f"me_oprec_to_gwop failed (rc={rc}, n={n})")
+    return out
 
 
 def pack_gwop(rec: MeGwOp, tag: int, op: int, side: int = 0, otype: int = 0,
@@ -993,9 +1026,12 @@ class NativeLanes:
     def build(self, recs, n: int, build_ou: bool, build_md: bool):
         """Stage one dispatch from `n` MeGwOp records ((MeGwOp * k) array).
 
-        Returns (shape, n_waves, n_lanes, n_ops, wave_k) or raises on a
-        malformed record / allocator exhaustion (the caller fails the
-        batch; eager registrations were already rolled back natively)."""
+        Returns (shape, n_waves, n_lanes, n_ops, wave_k, wave_n) or raises
+        on a malformed record / allocator exhaustion (the caller fails the
+        batch; eager registrations were already rolled back natively).
+        wave_n (real ops per wave) sizes the megadispatch compacted-result
+        bucket — the host knows every wave's op count, so the compacted
+        readback can never truncate."""
         max_waves = n // self.B + 2
         flags = (ctypes.c_int32 * 4)()
         wave_n = (ctypes.c_int32 * max_waves)()
@@ -1009,7 +1045,8 @@ class NativeLanes:
                                "allocator exhaustion)")
         shape, n_waves, n_lanes, n_ops = (flags[0], flags[1], flags[2],
                                           flags[3])
-        return shape, n_waves, n_lanes, n_ops, list(wave_k[:n_waves])
+        return (shape, n_waves, n_lanes, n_ops, list(wave_k[:n_waves]),
+                list(wave_n[:n_waves]))
 
     def wave(self, w: int, shape: int, k: int):
         """Materialize wave `w`'s lane buffer: sparse -> [K, 9] int32,
@@ -1040,6 +1077,40 @@ class NativeLanes:
         if rc < 0:
             raise RuntimeError("me_lanes_decode_wave failed")
         return int(rc)
+
+    def wave_mega(self, w0: int, m: int):
+        """ONE stacked [m, S, B, 7] megadispatch buffer covering waves
+        [w0, w0+m) of the just-built dispatch (dense only) — ready for
+        kernel.engine_step_mega."""
+        np = self._np
+        arr = np.empty((m, self.S, self.B, 7), dtype=np.int32)
+        if self._lib.me_lanes_wave_mega(self._h, w0, m,
+                                        self._i32p(arr)) != 0:
+            raise RuntimeError("me_lanes_wave_mega failed")
+        return arr
+
+    def decode_mega(self, m: int, rcap: int, lo: int, small,
+                    fills_fetch) -> tuple[int, bool]:
+        """Decode m stacked waves of the OLDEST staged dispatch from one
+        megadispatch readback (kernel.MegaStepOutput.small layout; `lo` =
+        mega_fill_inline rows per wave). `fills_fetch()` lazily fetches
+        the full [m, 5, max_fills] buffer when some wave's fill log
+        exceeded its inline segment. Returns (total fill count,
+        fetched_full)."""
+        np = self._np
+        small = np.ascontiguousarray(small, dtype=np.int32)
+        rc = self._lib.me_lanes_decode_mega(
+            self._h, self._i32p(small), small.size, m, rcap, lo, None, 0)
+        fetched = False
+        if rc == -2:
+            fills = np.ascontiguousarray(fills_fetch(), dtype=np.int32)
+            fetched = True
+            rc = self._lib.me_lanes_decode_mega(
+                self._h, self._i32p(small), small.size, m, rcap, lo,
+                self._i32p(fills), fills.size)
+        if rc < 0:
+            raise RuntimeError("me_lanes_decode_mega failed")
+        return int(rc), fetched
 
     def finish_take(self) -> tuple[bytes, bytes, bytes]:
         """Assemble + copy out the oldest dispatch's (completions, storage,
@@ -1144,6 +1215,14 @@ class LaneRing:
         if self._h is None:
             return False
         return bool(self._lib.me_gwring_push(self._h, ctypes.byref(rec)))
+
+    def push_n(self, recs, n: int) -> bool:
+        """All-or-nothing bulk push ((MeGwOp * k) array, first n records)
+        under one ring lock acquisition — the batch edge's enqueue. False
+        means the ring could not hold the WHOLE batch (nothing entered)."""
+        if self._h is None:
+            return False
+        return bool(self._lib.me_gwring_push_n(self._h, recs, n))
 
     def pop_batch_raw(self, max_ops: int, window_us: int,
                       first_wait_us: int = -1):

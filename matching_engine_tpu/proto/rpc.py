@@ -29,6 +29,8 @@ _METHODS = {
     "AmendOrder": ("unary_unary", pb2.AmendRequest, pb2.AmendResponse),
     "GetMetrics": ("unary_unary", pb2.MetricsRequest, pb2.MetricsResponse),
     "RunAuction": ("unary_unary", pb2.AuctionRequest, pb2.AuctionResponse),
+    "SubmitOrderBatch": ("unary_unary", pb2.OrderBatchRequest,
+                         pb2.OrderBatchResponse),
 }
 
 
@@ -60,6 +62,10 @@ class MatchingEngineServicer:
 
     def RunAuction(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "RunAuction not implemented")
+
+    def SubmitOrderBatch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                      "SubmitOrderBatch not implemented")
 
 
 def add_matching_engine_servicer(servicer: MatchingEngineServicer, server: grpc.Server) -> None:

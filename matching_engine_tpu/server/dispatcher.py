@@ -344,6 +344,76 @@ class BatchDispatcher:
 LaneOutcome = namedtuple("LaneOutcome", "kind ok order_id remaining error")
 
 
+class _BatchSlot:
+    """One position's future-duck in a _BatchWaiter: the drain loop's
+    completion path calls done()/set_result()/set_exception() exactly as
+    it does on a concurrent.futures.Future, but N slots share ONE lock
+    and ONE event — a batch of 1024 ops costs two allocations per op
+    instead of a Future + condition variable each (the batch edge exists
+    to kill per-op cost; its completion plumbing must not reintroduce
+    it)."""
+
+    __slots__ = ("w", "i")
+
+    def __init__(self, w, i):
+        self.w = w
+        self.i = i
+
+    def done(self) -> bool:
+        return self.w.slot_done(self.i)
+
+    def set_result(self, res) -> None:
+        self.w.set_slot(self.i, res, None)
+
+    def set_exception(self, exc) -> None:
+        self.w.set_slot(self.i, None, exc)
+
+
+class _BatchWaiter:
+    """Positional completion collector for one submitted op-record batch:
+    results[i]/errors[i] land for record i, and wait() releases when every
+    position resolved. The RPC handler builds the positional response
+    arrays straight off it."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.results: list = [None] * n
+        self.errors: list = [None] * n
+        self._remaining = n
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+
+    def slot(self, i: int) -> _BatchSlot:
+        return _BatchSlot(self, i)
+
+    def slot_done(self, i: int) -> bool:
+        with self._lock:
+            return self.results[i] is not None or self.errors[i] is not None
+
+    def set_slot(self, i: int, res, exc) -> None:
+        with self._lock:
+            if self.results[i] is not None or self.errors[i] is not None:
+                return
+            if exc is None:
+                self.results[i] = res
+            else:
+                self.errors[i] = exc
+            self._remaining -= 1
+            if self._remaining == 0:
+                self._event.set()
+
+    def fail_all(self, exc) -> None:
+        with self._lock:
+            for i in range(self.n):
+                if self.results[i] is None and self.errors[i] is None:
+                    self.errors[i] = exc
+            self._remaining = 0
+            self._event.set()
+
+    def wait(self, timeout_s: float) -> bool:
+        return self._event.wait(timeout_s)
+
+
 class LaneRingDispatcher:
     """The grpcio edge's dispatcher for the native lane path (server/
     native_lanes.py): RPC threads pack ONE wide MeGwOp record and push it
@@ -369,6 +439,7 @@ class LaneRingDispatcher:
         metrics: Metrics | None = None,
         ring_capacity: int = 1 << 16,
         busy_poll_us: float = 0.0,
+        mega_max_waves: int = 1,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -383,17 +454,60 @@ class LaneRingDispatcher:
         self.busy_poll_s = max(0.0, busy_poll_us) / 1e6
         self.window_us = max(1, int(window_ms * 1e3))
         self.max_batch = max_batch or (runner.cfg.num_symbols * runner.cfg.batch)
+        # Native megadispatch: with the runner stacking M dense waves per
+        # device scan, one pop may carry up to M grid-fulls — popping only
+        # max_batch would cap every dispatch at one wave and the stacking
+        # could never engage under the batch edge's deep backlogs.
+        self._pop_cap = self.max_batch * max(
+            1, int(mega_max_waves),
+            int(getattr(runner, "megadispatch_max_waves", 1)))
         self.metrics = metrics or runner.metrics
         self._ring = me_native.LaneRing(ring_capacity)
         self._rec = threading.local()  # per-RPC-thread scratch record
-        # tag -> (future, t_enqueue, t_ingress | None)
-        self._tags: dict[int, tuple[Future, float, float | None]] = {}
+        # tag -> (future | batch slot, t_enqueue, t_ingress | None)
+        self._tags: dict[int, tuple] = {}
         self._tag_lock = threading.Lock()
-        self._tag_seq = itertools.count(1)
+        # Plain int + lock (not itertools.count): the batch edge reserves
+        # n consecutive tags in one step so positional responses map back
+        # by subtraction.
+        self._tag_next = 1
+        self._tag_alloc_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="lane-dispatcher",
                                         daemon=True)
         self._thread.start()
+
+    def _alloc_tags(self, n: int) -> int:
+        with self._tag_alloc_lock:
+            t = self._tag_next
+            self._tag_next += n
+        return t
+
+    def submit_oprec_batch(self, body: bytes, n: int,
+                           t_ingress: float | None = None) -> _BatchWaiter:
+        """Enqueue one validated op-record batch (domain/oprec.py records,
+        magic stripped): ONE native crossing converts the payload into
+        tagged ring records (tags tag0..tag0+n-1, bit 63 set for local
+        completions) and ONE ring lock pushes them all. Returns the
+        positional _BatchWaiter; a ring that can't hold the whole batch
+        fails every position with RingFull (all-or-nothing — a split
+        batch would interleave with other producers mid-overload)."""
+        from matching_engine_tpu import native as me_native
+
+        waiter = _BatchWaiter(n)
+        tag0 = self._alloc_tags(n) | (1 << 63)
+        recs = me_native.oprec_to_gwop(body, n, tag0)
+        now = time.perf_counter()
+        with self._tag_lock:
+            for i in range(n):
+                self._tags[tag0 + i] = (waiter.slot(i), now, t_ingress)
+        if not self._ring.push_n(recs, n):
+            with self._tag_lock:
+                for i in range(n):
+                    self._tags.pop(tag0 + i, None)
+            self.metrics.inc("ring_rejects", n)
+            waiter.fail_all(RingFull("op ring full"))
+        return waiter
 
     def submit_record(self, op: int, side: int = 0, otype: int = 0,
                       price_q4: int = 0, quantity: int = 0,
@@ -406,7 +520,7 @@ class LaneRingDispatcher:
         from matching_engine_tpu import native as me_native
 
         fut: Future = Future()
-        tag = next(self._tag_seq) | (1 << 63)
+        tag = self._alloc_tags(1) | (1 << 63)
         rec = getattr(self._rec, "r", None)
         if rec is None:
             rec = self._rec.r = me_native.MeGwOp()
@@ -458,7 +572,7 @@ class LaneRingDispatcher:
 
         while not self._stop.is_set():
             buf, n = self._ring.pop_batch_raw(
-                self.max_batch, self.window_us,
+                self._pop_cap, self.window_us,
                 self.window_us if self.runner.has_pending else -1,
             )
             if buf is None:

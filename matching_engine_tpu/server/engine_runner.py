@@ -382,6 +382,14 @@ class EngineRunner:
         self.slot_symbols[slot] = symbol
         return slot
 
+    def owns_all_symbols(self) -> bool:
+        """True when every symbol is homed on this runner (single process,
+        no shard filter) — lets the batch edge skip the per-op ownership
+        check instead of paying per-record python on the path built to
+        avoid it. Sharded lanes route by the same hash before dispatch,
+        so their groups satisfy the filter by construction."""
+        return self._owns_filter is None and self._n_hosts == 1
+
     def owns_symbol(self, symbol: str) -> bool:
         """True when this host is the symbol's home (multi-process routing
         invariant). Slots are recycled, so ownership must be decided by

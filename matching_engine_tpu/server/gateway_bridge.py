@@ -554,6 +554,16 @@ class GatewayBridge:
                     req = pb2.AuctionRequest.FromString(payload)
                     resp = self.service.RunAuction(req, None)
                     self.gateway.respond(tag, resp.SerializeToString(), True)
+                elif method == me_native.GW_BATCH:
+                    # Batch verb on the C++ edge: the gateway forwards the
+                    # request whole (the op-record payload is already the
+                    # flat binary the engine wants) and the SAME service
+                    # handler that serves the grpcio edge splits, routes,
+                    # and dispatches it — one implementation per verb,
+                    # two transports.
+                    req = pb2.OrderBatchRequest.FromString(payload)
+                    resp = self.service.SubmitOrderBatch(req, None)
+                    self.gateway.respond(tag, resp.SerializeToString(), True)
                 elif method in (me_native.GW_STREAM_MD, me_native.GW_STREAM_OU):
                     # Streams hold a worker for their lifetime; run each on
                     # its own thread so they can't starve unary forwards.

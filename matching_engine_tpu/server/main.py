@@ -184,11 +184,13 @@ def build_server(
 
     if serve_shards > 1 and mesh is not None:
         raise SystemExit(3)  # partitioned lanes vs mesh: pick one
-    if megadispatch_max_waves > 1 and (native_lanes or mesh is not None):
-        # The lane engine stages waves in C++ and the mesh decodes from
-        # addressable shards — neither routes through the stacked scan.
-        print("[SERVER] --megadispatch-max-waves applies to the Python "
-              "dispatch path only; ignoring it on this configuration")
+    if megadispatch_max_waves > 1 and mesh is not None:
+        # The mesh decodes from addressable shards — it never routes
+        # through the stacked scan. (The native lane engine DOES: it
+        # builds [M, S, B, 7] stacks and decodes compacted mega
+        # completions in C++ — me_lanes.cpp wave_mega/decode_mega.)
+        print("[SERVER] --megadispatch-max-waves applies to single-device "
+              "serving only; ignoring it under --mesh")
         megadispatch_max_waves = 1
 
     if native_lanes:
@@ -244,8 +246,10 @@ def build_server(
                 NativeLanesRunner,
             )
 
-            return NativeLanesRunner(cfg, metrics, hub=hub,
-                                     pipeline_inflight=pipeline_inflight)
+            return NativeLanesRunner(
+                cfg, metrics, hub=hub,
+                pipeline_inflight=pipeline_inflight,
+                megadispatch_max_waves=megadispatch_max_waves)
         return EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
                             pipeline_inflight=pipeline_inflight,
                             megadispatch_max_waves=megadispatch_max_waves)
@@ -420,6 +424,7 @@ def build_server(
             dispatcher = LaneRingDispatcher(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
                 busy_poll_us=busy_poll_us,
+                mega_max_waves=megadispatch_max_waves,
             )
         elif use_native:
             dispatcher = NativeRingDispatcher(
@@ -445,7 +450,14 @@ def build_server(
                                     book_cache_ms=book_cache_ms,
                                     proto_reuse=proto_reuse)
 
-    server = grpc.server(cf.ThreadPoolExecutor(max_workers=rpc_workers))
+    # Receive limit sized to the batch edge's record cap (service
+    # _BATCH_RECORD_CAP x 384-byte records ~ 25 MB) — the default 4 MB
+    # would bounce a documented-size SubmitOrderBatch at the transport,
+    # before the handler's own cap could answer it application-level.
+    server = grpc.server(
+        cf.ThreadPoolExecutor(max_workers=rpc_workers),
+        options=[("grpc.max_receive_message_length", 32 << 20),
+                 ("grpc.max_send_message_length", 32 << 20)])
     add_matching_engine_servicer(service, server)
     port = server.add_insecure_port(addr)
     if port == 0:
@@ -575,13 +587,16 @@ def main(argv=None) -> int:
     p.add_argument("--megadispatch-max-waves", type=int, default=1,
                    metavar="M",
                    help="coalesce up to M queued dispatch batches into ONE "
-                        "stacked device scan when the queue is deep "
-                        "(engine_runner._prepare_mega + the dispatcher's "
-                        "adaptive controller): one XLA dispatch amortized "
-                        "over M waves, compacted completion readback. 1 "
+                        "stacked device scan when the queue is deep: one "
+                        "XLA dispatch amortized over M waves, compacted "
+                        "completion readback. Python path = "
+                        "engine_runner._prepare_mega + the dispatcher's "
+                        "adaptive controller; --native-lanes builds the "
+                        "[M, S, B, 7] stacks and decodes the compacted "
+                        "mega completions in C++ (me_lanes.cpp). 1 "
                         "(default) = off, exactly today's serial schedule; "
-                        "output is bit-identical at any M. Python dispatch "
-                        "path only (--native-lanes / --mesh ignore it)")
+                        "output is bit-identical at any M. --mesh ignores "
+                        "it")
     p.add_argument("--megadispatch-latency-us", type=float, default=5000.0,
                    metavar="US",
                    help="latency budget for the coalescing controller: M "

@@ -123,12 +123,15 @@ class NativeLanesRunner(EngineRunner):
                  pipeline_inflight: int = 2, oid_offset: int = 0,
                  oid_stride: int = 1, device=None, owns_filter=None,
                  megadispatch_max_waves: int = 1):
-        # megadispatch_max_waves is accepted for constructor parity with
-        # EngineRunner (shards.make_lane_runner passes it uniformly) but
-        # the native record path stages its own lane buffers wave-by-wave
-        # (me_lanes.cpp mirrors the serial schedule); only the Python
-        # EngineOp path (boot recovery replay) could ever stack — and it
-        # is bit-identical either way.
+        # megadispatch_max_waves > 1: multi-wave DENSE record dispatches
+        # stack into native megadispatch — me_lanes.cpp builds ONE
+        # [M, S, B, 7] buffer per stack (wave_mega) and decodes the
+        # compacted mega readback (decode_mega), so the C++ path's per-
+        # wave XLA dispatch cost amortizes exactly like the Python
+        # path's _prepare_mega. Bit-identical to M=1 by construction
+        # (same engine_step_core scan body; parity pinned by
+        # tests/test_batch_edge.py). Sparse dispatches and the Python
+        # EngineOp path (boot recovery replay) keep the serial schedule.
         super().__init__(cfg, metrics, mesh=None, hub=hub,
                          pipeline_inflight=pipeline_inflight,
                          oid_offset=oid_offset, oid_stride=oid_stride,
@@ -180,32 +183,58 @@ class NativeLanesRunner(EngineRunner):
         # handle/slot assignment, wave placement. Raises before any ctx is
         # staged; native registrations are already rolled back on failure.
         with span("lane_build"):
-            shape, n_waves, n_lanes, _n_ops, wave_k = self.lanes.build(
-                recs, n, build_ou, build_md)
+            shape, n_waves, n_lanes, _n_ops, wave_k, wave_n = \
+                self.lanes.build(recs, n, build_ou, build_md)
         if shape == 0:
             self.metrics.inc("sparse_dispatches")
         elif n_lanes:
             self.metrics.inc("dense_dispatches")
+        # Native megadispatch: a multi-wave dense dispatch stacks into
+        # chunks of up to M waves, each one [M', S, B, 7] buffer built in
+        # C++ and run through kernel.engine_step_mega's single lax.scan —
+        # the same coalescing _prepare_mega gives the Python path. Sparse
+        # stays serial (the compacted scan body is dense-shaped).
+        m_cap = self.megadispatch_max_waves
+        use_mega = shape == 1 and n_waves > 1 and m_cap > 1
         if timeline is not None:
-            timeline.shape = "sparse" if shape == 0 else "dense"
+            timeline.shape = ("sparse" if shape == 0
+                              else "mega" if use_mega else "dense")
             timeline.waves = n_waves
-        issue = self._issue_sparse if shape == 0 else self._issue_dense
+            if use_mega:
+                timeline.mega_m = min(m_cap, n_waves)
         try:
-            arrays = [self.lanes.wave(w, shape, wave_k[w] if shape == 0
-                                      else 0)
-                      for w in range(n_waves)]
+            if use_mega:
+                from matching_engine_tpu.engine.kernel import mega_result_cap
+
+                arrays = []
+                for w0 in range(0, n_waves, m_cap):
+                    m = min(m_cap, n_waves - w0)
+                    # The host built the waves, so the deepest wave's real
+                    # op count is known exactly: the compacted-completion
+                    # bucket can never truncate.
+                    rcap = mega_result_cap(self.cfg, max(wave_n[w0:w0 + m]))
+                    arrays.append(("mega", m, rcap,
+                                   self.lanes.wave_mega(w0, m)))
+            else:
+                kind = "sparse" if shape == 0 else "dense"
+                arrays = [(kind,
+                           self.lanes.wave(w, shape, wave_k[w] if shape == 0
+                                           else 0))
+                          for w in range(n_waves)]
             if timeline is not None:
                 timeline.stamp_build()
-            staged = _NativeStaged(shape, arrays, issue, timeline=timeline)
+            staged = _NativeStaged(shape, arrays, self._issue_item,
+                                   timeline=timeline)
             if n_waves <= PIPELINE_DEPTH:
                 # Dispatch every wave now, decode later — the staged
-                # outputs are HBM-bounded by the wave-count cap, and the
-                # async host copy lands while the host batches newer work.
-                for arr in arrays:
-                    out = issue(arr)
-                    staged.items.append(out)
+                # outputs are HBM-bounded by the wave-count cap (a mega
+                # item pins the same waves it replaces), and the async
+                # host copy lands while the host batches newer work.
+                for desc in arrays:
+                    item = self._issue_item(desc)
+                    staged.items.append(item)
                     try:
-                        out.small.copy_to_host_async()
+                        item[-1].small.copy_to_host_async()
                     except (AttributeError, RuntimeError):
                         pass
                 staged.deferred = True
@@ -217,6 +246,25 @@ class NativeLanesRunner(EngineRunner):
             # slots stay consumed — the maybe-applied-on-device policy).
             self.lanes.abort(newest=True)
             raise
+
+    def _issue_item(self, desc):
+        """Run one staged descriptor's device step; returns the tagged
+        (kind, ..., out) item _decode_native consumes FIFO."""
+        if desc[0] == "mega":
+            _, m, rcap, arr = desc
+            from matching_engine_tpu.engine import kernel as _kernel
+
+            self._step_num += 1
+            with self._snapshot_lock, step_annotation("engine_step_mega",
+                                                      self._step_num):
+                self.book, mout = _kernel.engine_step_mega(
+                    self.cfg, self.book, arr, rcap)
+            self.metrics.inc("megadispatch_steps")
+            self.metrics.inc("megadispatch_stacked_waves", m)
+            return ("mega", m, rcap, mout)
+        if desc[0] == "sparse":
+            return ("sparse", self._issue_sparse(desc[1]))
+        return ("dense", self._issue_dense(desc[1]))
 
     def _issue_sparse(self, arr):
         from matching_engine_tpu.engine.sparse import (
@@ -240,9 +288,25 @@ class NativeLanesRunner(EngineRunner):
             self.book, out = engine_step_packed(self.cfg, self.book, arr)
         return out
 
-    def _decode_native(self, out) -> None:
-        self.lanes.decode_wave(np.asarray(out.small),
-                               lambda: np.asarray(out.fills))
+    def _decode_native(self, item) -> None:
+        if item[0] == "mega":
+            _, m, rcap, mout = item
+            from matching_engine_tpu.engine.kernel import mega_fill_inline
+
+            small = np.asarray(mout.small)
+            _fc, fetched = self.lanes.decode_mega(
+                m, rcap, mega_fill_inline(self.cfg, rcap), small,
+                lambda: np.asarray(mout.fills))
+            self.metrics.inc(
+                "readback_bytes",
+                small.size * 4 + (mout.fills.size * 4 if fetched else 0))
+            return
+        out = item[1]
+        small = np.asarray(out.small)
+        fc = self.lanes.decode_wave(small, lambda: np.asarray(out.fills))
+        self.metrics.inc(
+            "readback_bytes",
+            small.size * 4 + (out.fills.size * 4 if fc > self.lanes.L else 0))
 
     def _finish_locked(self, staged):
         if not isinstance(staged, _NativeStaged):

@@ -339,7 +339,8 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
     if native_lanes:
         return LaneRingDispatcher(runner, sink=sink, hub=hub,
                                   window_ms=window_ms, metrics=metrics,
-                                  busy_poll_us=busy_poll_us)
+                                  busy_poll_us=busy_poll_us,
+                                  mega_max_waves=mega_max_waves)
     if native:
         return NativeRingDispatcher(runner, sink=sink, hub=hub,
                                     window_ms=window_ms, metrics=metrics,

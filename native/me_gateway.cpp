@@ -176,6 +176,7 @@ enum Method {
   M_STREAM_OU = 6,
   M_AUCTION = 7,
   M_AMEND = 8,
+  M_BATCH = 9,
 };
 
 int route(const std::string& path) {
@@ -190,6 +191,10 @@ int route(const std::string& path) {
   if (m == "StreamMarketData") return M_STREAM_MD;
   if (m == "StreamOrderUpdates") return M_STREAM_OU;
   if (m == "RunAuction") return M_AUCTION;  // forwarded (service-side)
+  // Forwarded too: the op-record payload is already a flat binary batch,
+  // so the python bridge hands it straight to the shared service handler
+  // — no per-op C++ proto parse to win by keeping it here.
+  if (m == "SubmitOrderBatch") return M_BATCH;
   return M_UNKNOWN;
 }
 

@@ -28,6 +28,33 @@ struct MeGwOp {
   char order_id[36];    // cancel/amend target "OID-<n>"
 };
 
+// MeOpRec: the flat binary op-record — the batch-edge wire format shared
+// with matching_engine_tpu/domain/oprec.py (OPREC_DTYPE mirrors this
+// byte-for-byte; the codec fuzz test pins the round trip). A
+// SubmitOrderBatch payload / recorded op file is the 8-byte "MEOPREC1"
+// magic followed by N of these; me_oprec_to_gwop (me_lanes.cpp) converts
+// a packed run straight into tagged MeGwOp ring records in one crossing.
+// Natural alignment — no packing pragma needed (max member align 8,
+// sizeof == 384).
+struct MeOpRec {
+  uint8_t op;         // 1 = submit, 2 = cancel, 3 = amend (MeGwOp.op)
+  uint8_t side;       // BUY=1 / SELL=2
+  uint8_t otype;      // collapsed device code (see MeGwOp.otype)
+  uint8_t flags;      // reserved, must be 0
+  int32_t price_q4;   // normalized; 0 for MARKET
+  int64_t quantity;   // submit qty / amend new-quantity
+  uint16_t symbol_len;
+  uint16_t client_id_len;
+  uint16_t order_id_len;
+  uint16_t pad;
+  char symbol[64];     // == MAX_SYMBOL_BYTES
+  char client_id[256];  // == MAX_CLIENT_ID_BYTES
+  char order_id[36];
+  char pad2[4];
+};
+
 }  // extern "C"
+
+static_assert(sizeof(MeOpRec) == 384, "MeOpRec must mirror oprec.py");
 
 #endif  // ME_GWOP_H_

@@ -563,6 +563,35 @@ class MeLanes {
     return 0;
   }
 
+  // Materialize ONE stacked [m, S, B, 7] megadispatch buffer covering
+  // waves [w0, w0+m) of the newest staged dispatch — the native twin of
+  // np.stack over _prepare_mega's per-wave arrays, built in one crossing
+  // instead of m wave() calls + a host-side stack copy. Dense only.
+  int wave_mega(uint32_t w0, uint32_t m, int32_t* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty() || m == 0) return -1;
+    Ctx& ctx = *ctxs_.back();  // waves fetched right after build
+    if (ctx.shape != 1) return -1;
+    if (w0 + m > static_cast<uint32_t>(ctx.n_waves)) return -1;
+    const long long plane = static_cast<long long>(S_) * B_ * 7;
+    std::memset(out, 0, sizeof(int32_t) * plane * m);
+    for (uint32_t j = 0; j < m; j++) {
+      int32_t* base = out + plane * j;
+      for (int idx : ctx.wave_order[w0 + j]) {
+        const CtxOp& op = ctx.ops[idx];
+        int32_t* lane = base + (op.slot * B_ + op.row) * 7;
+        lane[0] = op.dev_op;
+        lane[1] = op.side;
+        lane[2] = op.otype;
+        lane[3] = op.price;
+        lane[4] = static_cast<int32_t>(op.qty);
+        lane[5] = op.target->handle;
+        lane[6] = op.owner;
+      }
+    }
+    return 0;
+  }
+
   // -- decode --------------------------------------------------------------
 
   // Consumes the OLDEST staged dispatch's next wave. Returns the wave's
@@ -597,11 +626,6 @@ class MeLanes {
     if (fc < 0 || fc > max_fills_) return -1;
     if (overflow) ctx.overflow_waves += 1;
 
-    // Group fills by taker handle, preserving order (fills_by_taker).
-    std::unordered_map<int32_t, std::vector<int>> fills_by_taker;
-    for (long long j = 0; j < fc; j++)
-      fills_by_taker[frows[1][j]].push_back(static_cast<int>(j));
-
     const int32_t* p_status;
     const int32_t* p_filled;
     const int32_t* p_remaining;
@@ -614,11 +638,137 @@ class MeLanes {
       p_filled = small + S_ * B_;
       p_remaining = small + 2 * S_ * B_;
     }
+    if (apply_wave(ctx, w, p_status, p_filled, p_remaining,
+                   /*by_rank=*/ctx.shape == 0, /*p_handle=*/nullptr, frows,
+                   fc) != 0)
+      return -1;
+
+    // Market data accumulation.
+    if (ctx.build_md) {
+      if (ctx.shape == 0) {
+        int i = 0;
+        for (int idx : ctx.wave_order[w]) {
+          const CtxOp& e = ctx.ops[idx];
+          std::array<int32_t, 4> tob = {small[3 * k + i], small[4 * k + i],
+                                        small[5 * k + i], small[6 * k + i]};
+          auto it = ctx.md_tob.find(e.slot);
+          if (it == ctx.md_tob.end()) {
+            ctx.md_slots.push_back(e.slot);  // first-touch insertion order
+            ctx.md_tob[e.slot] = tob;
+          } else {
+            it->second = tob;  // later waves overwrite
+          }
+          i++;
+        }
+      } else {
+        for (int idx : ctx.wave_order[w])
+          ctx.dense_touched.insert(ctx.ops[idx].slot);
+        const int32_t* base = small + 3 * S_ * B_;
+        ctx.dense_tob.assign(base, base + 4 * S_);  // last wave wins
+      }
+    }
+    ctx.fill_count += fc;
+    ctx.decode_cursor += 1;
+    return fc;
+  }
+
+  // Decode M waves of the OLDEST staged dispatch from ONE megadispatch
+  // readback (kernel.MegaStepOutput.small layout; the native twin of
+  // harness.decode_step_mega): per-wave compacted completions + inline
+  // fill segments, final-book top-of-book in the header. `lo` is the
+  // inline fill rows per wave (kernel.mega_fill_inline). Returns the
+  // stack's total fill count, -2 when some wave's fill log exceeded the
+  // inline segment and the caller must re-call with the full
+  // [M, 5, max_fills] buffer, -1 on error. Dense dispatches only (the
+  // runner never stacks sparse waves — mirroring _prepare_mega).
+  long long decode_mega(const int32_t* small, long long small_len,
+                        int32_t m, int32_t rcap, int32_t lo,
+                        const int32_t* fills, long long fills_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (ctxs_.empty() || m <= 0 || rcap <= 0 || lo <= 0) return -1;
+    Ctx& ctx = *ctxs_.front();
+    if (ctx.shape != 1) return -1;
+    if (ctx.decode_cursor + m > ctx.n_waves) return -1;
+    long long expect = 3LL * m + 4LL * S_ + 5LL * m * rcap + 5LL * m * lo;
+    if (small_len != expect) return -1;
+    const int32_t* res_counts = small;
+    const int32_t* fill_counts = small + m;
+    const int32_t* overflows = small + 2 * m;
+    const int32_t* tob = small + 3 * m;                   // [4, S]
+    const int32_t* res = tob + 4 * S_;                    // [m, 5, rcap]
+    const int32_t* finline = res + 5LL * m * rcap;        // [m, 5, lo]
+    for (int j = 0; j < m; j++) {
+      if (fill_counts[j] < 0 || fill_counts[j] > max_fills_) return -1;
+      if (fill_counts[j] > lo && fills == nullptr) return -2;
+    }
+    if (fills != nullptr && fills_len != 5LL * m * max_fills_) return -1;
+    long long total_fc = 0;
+    for (int j = 0; j < m; j++) {
+      int w = ctx.decode_cursor;
+      const int32_t* r = res + 5LL * j * rcap;
+      // Every lane placed in a wave is a real op, so the compacted count
+      // must equal the wave's op count — anything else is a readback/
+      // schedule mismatch and must fail loudly, never misattribute.
+      if (res_counts[j] !=
+          static_cast<int32_t>(ctx.wave_order[w].size()))
+        return -1;
+      long long fc = fill_counts[j];
+      const int32_t* frows[5];
+      if (fc <= lo) {
+        for (int row = 0; row < 5; row++)
+          frows[row] = finline + 5LL * j * lo + static_cast<long long>(row) * lo;
+      } else {
+        for (int row = 0; row < 5; row++)
+          frows[row] = fills + 5LL * j * max_fills_ +
+                       static_cast<long long>(row) * max_fills_;
+      }
+      if (overflows[j]) ctx.overflow_waves += 1;
+      // Compacted rows: oid | sym | status | filled | remaining, packed
+      // in device row-major order == wave_order's (slot, row) sort, so
+      // rank indexing lines up exactly; row 0 verifies handle identity.
+      if (apply_wave(ctx, w, r + 2LL * rcap, r + 3LL * rcap,
+                     r + 4LL * rcap, /*by_rank=*/true, /*p_handle=*/r,
+                     frows, fc) != 0)
+        return -1;
+      if (ctx.build_md)
+        for (int idx : ctx.wave_order[w])
+          ctx.dense_touched.insert(ctx.ops[idx].slot);
+      ctx.fill_count += fc;
+      total_fc += fc;
+      ctx.decode_cursor += 1;
+    }
+    if (ctx.build_md) {
+      // Final-book top-of-book == the last stacked wave's — identical to
+      // the serial schedule's last-wave overwrite.
+      ctx.dense_tob.assign(tob, tob + 4 * S_);
+    }
+    return total_fc;
+  }
+
+ private:
+  // The per-wave op decode shared by the serial full-plane readback and
+  // the mega compacted readback: apply statuses and fills to the
+  // directory, accumulate storage rows, outcomes, and maker bookkeeping.
+  // by_rank=false: p_* are full [S, B] planes indexed slot*B+row (dense
+  // serial). by_rank=true: p_* are indexed by the op's RANK in wave
+  // order (sparse lanes, and mega compacted rows — whose packing order
+  // is exactly wave_order's (slot, row) sort; p_handle, when non-null,
+  // verifies rank identity against the compacted oid column).
+  int apply_wave(Ctx& ctx, int w, const int32_t* p_status,
+                 const int32_t* p_filled, const int32_t* p_remaining,
+                 bool by_rank, const int32_t* p_handle,
+                 const int32_t* const frows[5], long long fc) {
+    // Group fills by taker handle, preserving order (fills_by_taker).
+    std::unordered_map<int32_t, std::vector<int>> fills_by_taker;
+    for (long long j = 0; j < fc; j++)
+      fills_by_taker[frows[1][j]].push_back(static_cast<int>(j));
 
     int lane_i = 0;
     for (int idx : ctx.wave_order[w]) {
       CtxOp& e = ctx.ops[idx];
-      long long pos = ctx.shape == 0 ? lane_i : e.slot * B_ + e.row;
+      long long pos = by_rank ? lane_i : e.slot * B_ + e.row;
+      if (p_handle != nullptr && p_handle[pos] != e.target->handle)
+        return -1;  // compacted row order diverged from the schedule
       lane_i++;
       int32_t status = p_status[pos];
       long long filled = p_filled[pos];
@@ -754,36 +904,10 @@ class MeLanes {
         }
       }
     }
-
-    // Market data accumulation.
-    if (ctx.build_md) {
-      if (ctx.shape == 0) {
-        int i = 0;
-        for (int idx : ctx.wave_order[w]) {
-          const CtxOp& e = ctx.ops[idx];
-          std::array<int32_t, 4> tob = {small[3 * k + i], small[4 * k + i],
-                                        small[5 * k + i], small[6 * k + i]};
-          auto it = ctx.md_tob.find(e.slot);
-          if (it == ctx.md_tob.end()) {
-            ctx.md_slots.push_back(e.slot);  // first-touch insertion order
-            ctx.md_tob[e.slot] = tob;
-          } else {
-            it->second = tob;  // later waves overwrite
-          }
-          i++;
-        }
-      } else {
-        for (int idx : ctx.wave_order[w])
-          ctx.dense_touched.insert(ctx.ops[idx].slot);
-        const int32_t* base = small + 3 * S_ * B_;
-        ctx.dense_tob.assign(base, base + 4 * S_);  // last wave wins
-      }
-    }
-    ctx.fill_count += fc;
-    ctx.decode_cursor += 1;
-    return fc;
+    return 0;
   }
 
+ public:
   // -- finish / take -------------------------------------------------------
 
   int finish(long long* comp_len, long long* store_len, long long* aux_len) {
@@ -1317,6 +1441,21 @@ class GwRing {
     return true;
   }
 
+  // Bulk push for the batch edge: all-or-nothing under ONE lock
+  // acquisition — a batch the ring can't hold entirely is refused whole
+  // (per-op "server overloaded" at the RPC, positionally), never split
+  // across an overload boundary.
+  bool push_n(const MeGwOp* ops, uint32_t n) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (closed_ || q_.size() + n > cap_) {
+      dropped_ += n;
+      return false;
+    }
+    for (uint32_t i = 0; i < n; i++) q_.push_back(ops[i]);
+    cv_.notify_one();
+    return true;
+  }
+
   int pop_batch(MeGwOp* out, uint32_t max, uint64_t window_us,
                 int64_t first_wait_us) {
     std::unique_lock<std::mutex> lk(mu_);
@@ -1394,12 +1533,26 @@ int me_lanes_wave(void* h, uint32_t wave, int32_t* out) {
   return static_cast<MeLanes*>(h)->wave(wave, out);
 }
 
+int me_lanes_wave_mega(void* h, uint32_t w0, uint32_t m, int32_t* out) {
+  if (!h || !out) return -1;
+  return static_cast<MeLanes*>(h)->wave_mega(w0, m, out);
+}
+
 long long me_lanes_decode_wave(void* h, const int32_t* small,
                                long long small_len, const int32_t* fills,
                                long long fills_len) {
   if (!h || !small) return -1;
   return static_cast<MeLanes*>(h)->decode_wave(small, small_len, fills,
                                                fills_len);
+}
+
+long long me_lanes_decode_mega(void* h, const int32_t* small,
+                               long long small_len, int32_t m, int32_t rcap,
+                               int32_t lo, const int32_t* fills,
+                               long long fills_len) {
+  if (!h || !small) return -1;
+  return static_cast<MeLanes*>(h)->decode_mega(small, small_len, m, rcap, lo,
+                                               fills, fills_len);
 }
 
 int me_lanes_finish(void* h, long long* comp_len, long long* store_len,
@@ -1481,6 +1634,51 @@ void me_gwring_destroy(void* r) { delete static_cast<GwRing*>(r); }
 int me_gwring_push(void* r, const MeGwOp* op) {
   if (!r || !op) return 0;
   return static_cast<GwRing*>(r)->push(*op) ? 1 : 0;
+}
+int me_gwring_push_n(void* r, const MeGwOp* ops, uint32_t n) {
+  if (!r || (!ops && n)) return 0;
+  if (n == 0) return 1;
+  return static_cast<GwRing*>(r)->push_n(ops, n) ? 1 : 0;
+}
+
+// -- the flat op-record codec (me_gwop.h MeOpRec / domain/oprec.py) --------
+//
+// Convert a packed run of op-records (a SubmitOrderBatch payload body /
+// recorded-flow slice, WITHOUT the 8-byte magic — the caller validated
+// it) into tagged MeGwOp ring records in ONE crossing: record i gets tag
+// tag_base + i, so positional responses map back by subtraction. Returns
+// n, or -1 on a structurally invalid record (length over its box /
+// nonzero reserved flags) — the python edge pre-screens those
+// positionally, so -1 here means caller skew, never client input.
+int me_oprec_to_gwop(const uint8_t* payload, long long len,
+                     uint64_t tag_base, MeGwOp* out, uint32_t max_n) {
+  if ((!payload && len) || !out) return -1;
+  if (len % static_cast<long long>(sizeof(MeOpRec)) != 0) return -1;
+  long long n = len / static_cast<long long>(sizeof(MeOpRec));
+  if (n > static_cast<long long>(max_n)) return -1;
+  const MeOpRec* recs = reinterpret_cast<const MeOpRec*>(payload);
+  for (long long i = 0; i < n; i++) {
+    const MeOpRec& r = recs[i];
+    if (r.symbol_len > sizeof(r.symbol) ||
+        r.client_id_len > sizeof(r.client_id) ||
+        r.order_id_len > sizeof(r.order_id) || r.flags != 0 ||
+        r.op < 1 || r.op > 3)
+      return -1;
+    MeGwOp& o = out[i];
+    o.tag = tag_base + static_cast<uint64_t>(i);
+    o.op = r.op;
+    o.side = r.side;
+    o.otype = r.otype;
+    o.price_q4 = r.price_q4;
+    o.quantity = r.quantity;
+    o.symbol_len = r.symbol_len;
+    o.client_id_len = r.client_id_len;
+    o.order_id_len = r.order_id_len;
+    std::memcpy(o.symbol, r.symbol, r.symbol_len);
+    std::memcpy(o.client_id, r.client_id, r.client_id_len);
+    std::memcpy(o.order_id, r.order_id, r.order_id_len);
+  }
+  return static_cast<int>(n);
 }
 int me_gwring_pop_batch(void* r, MeGwOp* out, uint32_t max,
                         uint64_t window_us, int64_t first_wait_us) {

@@ -295,6 +295,112 @@ MD_AUDIT=$(echo "$MD_AUDIT" | tail -1 | tr -d '[:space:]')
 [ "$MD_AUDIT" = "0" ] \
   || { echo "FAIL: $MD_AUDIT store integrity violation(s) in the megadispatch round"; exit 1; }
 
+# ---- batch round: the batch-native edge -----------------------------------
+# Boots a server on the native-lane path with native megadispatch engaged
+# (--native-lanes --megadispatch-max-waves 4), replays a RECORDED op file
+# through `client submit-batch` (the same domain/oprec.py codec reader the
+# bench replay uses) alongside a sequenced subscriber, then fails the
+# round on any positional-status/store mismatch (accepted count from the
+# positional responses must equal the store's order rows) or on missing
+# me_edge_* metrics in the scrape.
+BE_DB="$WORK/soak_batch.db"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$BE_DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --native-lanes --megadispatch-max-waves 4 --metrics-port 0 \
+  ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_batch.log" 2>&1 &
+BE_SRV=$!
+trap 'kill $SRV $BE_SRV 2>/dev/null' EXIT
+BE_PY=""; BE_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  BE_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_batch.log" | head -1)
+  BE_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_batch.log" | head -1)
+  [ -n "$BE_PY" ] && [ -n "$BE_OBS" ] && break
+  kill -0 $BE_SRV 2>/dev/null || { echo "FAIL: batch server died at boot"; tail -5 "$WORK/server_batch.log"; exit 1; }
+  sleep 1
+done
+[ -n "$BE_PY" ] && [ -n "$BE_OBS" ] || { echo "FAIL: batch server ports never appeared"; exit 1; }
+# Recorded flow: maker/taker GTC pairs over the SOAK symbols — every
+# record should accept, so positional statuses reconcile exactly with
+# the store.
+BE_OPS="$WORK/batch_flow.ops"
+python - "$BE_OPS" <<'EOF'
+import sys
+from matching_engine_tpu.domain import oprec
+ops = []
+for i in range(2048):
+    sym = f"BK{i % 16}"
+    maker = ((i // 16) % 2) == 0
+    ops.append((oprec.OPREC_SUBMIT, 2 if maker else 1, 0, 10_000, 5, sym,
+                "bk-m" if maker else "bk-t", ""))
+oprec.write_opfile(sys.argv[1], oprec.pack_records(ops))
+EOF
+BE_FEED="$FEED_DIR/batch.json"
+python -m matching_engine_tpu.client.cli subscribe "127.0.0.1:$BE_PY" \
+  md BK0 --idle-exit 60 --quiet \
+  --summary-json "$BE_FEED" >/dev/null 2>"$FEED_DIR/batch.err" &
+BE_FEED_PID=$!
+BE_SUMMARY="$WORK/batch_replay.json"
+python -m matching_engine_tpu.client.cli submit-batch "127.0.0.1:$BE_PY" \
+  "$BE_OPS" --batch-size 256 --quiet --summary-json "$BE_SUMMARY" \
+  >/dev/null 2>"$WORK/batch_replay.err" \
+  || { echo "FAIL: submit-batch replay failed"; cat "$WORK/batch_replay.err"; exit 1; }
+# Scrape to the round's OWN file first: the me_edge_*/me_megadispatch_*
+# gates below must read THIS server's scrape — grepping the shared
+# accumulator would match the earlier megadispatch round's series and
+# could never fail (the dead-probe false-pass class).
+BE_SCRAPE="$WORK/batch_scrape.prom"
+python - "$BE_OBS" > "$BE_SCRAPE" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-batch {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+cat "$BE_SCRAPE" >> "$METRICS_OUT"
+kill -INT $BE_FEED_PID 2>/dev/null || true
+wait $BE_FEED_PID; BE_FEED_RC=$?
+if [ "$BE_FEED_RC" -eq 4 ]; then
+  echo "FAIL: unrecovered feed gap in the batch round"
+  cat "$FEED_DIR/batch.err"; exit 1
+fi
+if [ "$BE_FEED_RC" -ne 0 ] || [ ! -s "$BE_FEED" ]; then
+  echo "FAIL: feed subscriber broke in the batch round (rc=$BE_FEED_RC)"
+  cat "$FEED_DIR/batch.err"; exit 1
+fi
+# Drain the durable sink before reconciling the store (SIGTERM path
+# flushes; give the async writer its window first).
+sleep 2
+kill -TERM $BE_SRV 2>/dev/null; wait $BE_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+BE_CHECK=$(python - "$BE_SUMMARY" "$BE_DB" <<'EOF'
+import json, sqlite3, sys
+s = json.load(open(sys.argv[1]))
+con = sqlite3.connect(sys.argv[2])
+rows = con.execute("SELECT COUNT(*) FROM orders").fetchone()[0]
+# Positional-status/store reconciliation: every positionally-accepted
+# submit must be a store row, and nothing else may be.
+ok = (s["rejected"] == 0 and s["accepted"] == s["ops"]
+      and rows == s["accepted"])
+print(f"{int(ok)} {s['accepted']} {s['rejected']} {rows}")
+EOF
+)
+read -r BE_OK BE_ACC BE_REJ BE_ROWS <<< "$(echo "$BE_CHECK" | tail -1)"
+if [ "$BE_OK" != "1" ]; then
+  echo "FAIL: batch round positional-status/store mismatch (accepted=$BE_ACC rejected=$BE_REJ store_rows=$BE_ROWS)"
+  exit 1
+fi
+grep -q "^me_edge_batches_total" "$BE_SCRAPE" \
+  || { echo "FAIL: me_edge_* metrics absent from the batch scrape"; exit 1; }
+# Engagement, not presence: the counter exists from boot; the round must
+# have actually stacked waves.
+BE_MEGA=$(sed -n 's/^me_megadispatch_steps_total \([0-9]*\).*/\1/p' "$BE_SCRAPE" | head -1)
+[ -n "$BE_MEGA" ] && [ "$BE_MEGA" -gt 0 ] \
+  || { echo "FAIL: native megadispatch never engaged in the batch round (steps=${BE_MEGA:-absent})"; exit 1; }
+
 # ---- latency round: open-loop tail gate -----------------------------------
 # Boots a fourth server with the tail levers ON (--busy-poll-us,
 # --book-cache-ms, --proto-reuse) and --trace-dir, runs latency_bench's
@@ -389,6 +495,10 @@ artifact = {
                       "id_collisions": int("$SH_COLLISIONS" or -1)},
     "megadispatch_round": {"max_waves": 4, "orders_ok": $MD_OK,
                            "audit_violations": int("$MD_AUDIT" or -1)},
+    "batch_round": {"batch_size": 256, "accepted": int("$BE_ACC" or -1),
+                    "rejected": int("$BE_REJ" or -1),
+                    "store_rows": int("$BE_ROWS" or -1),
+                    "native_lanes": True, "megadispatch_max_waves": 4},
     "latency_round": {"load_fraction": 0.5, "p50_ms": $LT_P50,
                       "p99_ms": $LT_P99, "p99_over_p50": $LT_RATIO,
                       "p999_gauges": $LT_NP999,
