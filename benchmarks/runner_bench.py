@@ -134,6 +134,19 @@ def main() -> None:
                         "wave_mega) — engagement is measured into the "
                         "row via the me_megadispatch_* counters")
     p.add_argument("--edge-window-ms", type=float, default=1.0)
+    p.add_argument("--audit-ab", action="store_true",
+                   help="A/B the online auditor's overhead: run each "
+                        "(mode, inflight, batch-ops) point twice through "
+                        "the SAME sequenced-hub pipeline — once without "
+                        "and once with the drop-copy publisher + "
+                        "InvariantAuditor attached (the --audit serving "
+                        "configuration, store probes excluded: the bench "
+                        "has no durable store) — and emit paired rows. "
+                        "The on-row asserts zero violations: a bench that "
+                        "trips its own auditor measured a broken engine")
+    p.add_argument("--audit-sample", type=int, default=8,
+                   help="--audit-ab shadow-tracking sample (the server "
+                        "flag's default, 8)")
     p.add_argument("--host-only", action="store_true",
                    help="isolate the serving stack's HOST work (lane "
                         "build, id/slot assignment, status decode, "
@@ -257,17 +270,34 @@ def main() -> None:
             (smod.engine_step_sparse, kmod.engine_step_packed,
              rmod.engine_step_packed, kmod.engine_step_mega) = saved
 
-    def make_point(mode: str, inflight: int, batch_ops: int):
+    def make_point(mode: str, inflight: int, batch_ops: int,
+                   audit: str | None = None):
         """Fresh (runner, batches, dispatch) triple for one measured pass —
-        host-only mode runs this twice with an identical op stream. Both
-        runners get a subscriber-less, sequencer-less StreamHub (stream
-        protos gated off — the max-throughput configuration build_server
-        wires under --feed-depth 0; the default sequenced feed always
-        materializes events for its retransmission store, and hub=None
-        would force the same per-op proto materialization)."""
+        host-only mode runs this twice with an identical op stream. By
+        default both runners get a subscriber-less, sequencer-less
+        StreamHub (stream protos gated off — the max-throughput
+        configuration build_server wires under --feed-depth 0; the
+        default sequenced feed always materializes events for its
+        retransmission store, and hub=None would force the same per-op
+        proto materialization).
+
+        --audit-ab passes audit="off"/"on": BOTH arms run the sequenced
+        hub (the production default the auditor ships under), and the
+        "on" arm additionally publishes the drop-copy and feeds the
+        InvariantAuditor from the dispatch callback — exactly the
+        serving drain loops' call shape — so the pair isolates the
+        auditor's cost."""
         from matching_engine_tpu.server.streams import StreamHub
 
-        hub = StreamHub()
+        if audit is None:
+            hub = StreamHub()
+        else:
+            from matching_engine_tpu.feed import FeedSequencer
+            from matching_engine_tpu.utils.metrics import Metrics
+
+            reg = Metrics()
+            hub = StreamHub(metrics=reg,
+                            sequencer=FeedSequencer(metrics=reg))
         batches = build_record_batches(seed=inflight,
                                        n_batches=args.n_batches,
                                        batch_ops=batch_ops)
@@ -284,9 +314,31 @@ def main() -> None:
 
             def dispatch(b, cb, _r=runner):
                 _r.dispatch_pipelined(records_to_ops(_r, b[0], b[1]), cb)
+        if audit == "on":
+            from matching_engine_tpu.audit import (
+                AuditPump,
+                DropCopyPublisher,
+                InvariantAuditor,
+            )
+
+            auditor = InvariantAuditor(reg, sample=args.audit_sample)
+            pump = AuditPump(reg)
+            dc = DropCopyPublisher(hub, reg, auditor=auditor, runner=runner,
+                                   pump=pump)
+            runner._bench_auditor = auditor
+            runner._bench_audit_pump = pump
+            raw = dispatch
+
+            def dispatch(b, cb, _raw=raw, _dc=dc):  # noqa: F811
+                def wrap(result, error, _cb=cb):
+                    if error is None:
+                        _dc.publish(result, None)
+                    return _cb(result, error)
+                _raw(b, wrap)
         return runner, batches, dispatch
 
-    def sweep_point(mode: str, inflight: int, batch_ops: int) -> dict:
+    def sweep_point(mode: str, inflight: int, batch_ops: int,
+                    audit: str | None = None) -> dict:
         lat: list[float] = []
         done = [0]
 
@@ -333,7 +385,8 @@ def main() -> None:
             ctx = patched_steps(lambda c, book, sp: (book, outs.popleft()),
                                 lambda c, book, arr: (book, outs.popleft()))
 
-        runner, batches, dispatch = make_point(mode, inflight, batch_ops)
+        runner, batches, dispatch = make_point(mode, inflight, batch_ops,
+                                               audit=audit)
         with ctx:
             if not args.host_only:
                 # Warm pass (compile both sparse bucket shapes this flow
@@ -344,16 +397,27 @@ def main() -> None:
                 for b in warm:
                     dispatch(b, lambda r, e: None)
                 runner.finish_pending()
+                if audit == "on":
+                    # Drain the WARM batches' audit work before the
+                    # timed region opens — the in-region flush must
+                    # charge the measured batches only.
+                    runner._bench_audit_pump.flush()
 
             t_begin = time.perf_counter()
             for b in batches:
                 dispatch(b, make_cb(time.perf_counter()))
             runner.finish_pending()
+            if audit == "on":
+                # The pump runs out of band; the honest throughput figure
+                # still charges the arm for ALL of its work — the barrier
+                # sits inside the timed region (overlap is the win being
+                # measured, backlog is not free).
+                runner._bench_audit_pump.flush()
             dt = time.perf_counter() - t_begin
         assert done[0] == len(batches)
         lats = np.array(sorted(lat))
         n_ops = args.n_batches * batch_ops
-        return {
+        row = {
             "mode": mode + ("-host" if args.host_only else ""),
             "inflight": inflight,
             "orders_per_s": round(n_ops / dt, 1),
@@ -363,6 +427,19 @@ def main() -> None:
             "p99_ms": round(float(lats[int(len(lats) * 0.99)]) * 1e3, 3),
             "mean_batch_ms": round(dt / len(batches) * 1e3, 3),
         }
+        if audit is not None:
+            row["audit"] = audit
+            if audit == "on":
+                snap = runner._bench_auditor.snapshot()
+                # A bench arm that trips its own auditor measured a
+                # broken engine, not the auditor's cost.
+                assert snap["violations"] == 0, snap["by_kind"]
+                row["audit_records"] = snap["records"]
+                row["audit_sample"] = args.audit_sample
+                # Per-point pump: close it or a long sweep accumulates
+                # one idle thread + its runner/hub graph per point.
+                runner._bench_audit_pump.close()
+        return row
 
     # -- partitioned-lane sweep (server/shards.py) -------------------------
 
@@ -677,22 +754,30 @@ def main() -> None:
 
     # -- batch edge sweep (SubmitOrderBatch vs per-op, live gRPC) ----------
 
-    def edge_server(mode: str, tmp: str):
+    def edge_server(mode: str, tmp: str, audit: str | None = None):
         """Boot one serving subprocess (the real edge: loopback gRPC, its
         own GIL) and return (proc, port, logpath). mode 'python' is the
-        default runtime layer; 'native' adds --native-lanes."""
+        default runtime layer; 'native' adds --native-lanes. An audit
+        arm ('off'/'on') keeps the sequenced feed ON for BOTH arms (the
+        production default the auditor ships under) and adds --audit to
+        the on arm — the pair isolates the auditor through the full
+        shipped server."""
         import subprocess
 
-        log_path = os.path.join(tmp, f"server_{mode}.log")
+        tag = mode if audit is None else f"{mode}_audit_{audit}"
+        log_path = os.path.join(tmp, f"server_{tag}.log")
         argv = [sys.executable, "-m", "matching_engine_tpu.server.main",
                 "--addr", "127.0.0.1:0",
-                "--db", os.path.join(tmp, f"edge_{mode}.db"),
+                "--db", os.path.join(tmp, f"edge_{tag}.db"),
                 "--symbols", str(args.symbols),
                 "--capacity", str(args.capacity),
                 "--batch", str(args.batch),
                 "--window-ms", str(args.edge_window_ms),
-                "--feed-depth", "0",
                 "--megadispatch-max-waves", str(args.edge_mega)]
+        if audit is None:
+            argv += ["--feed-depth", "0"]
+        elif audit == "on":
+            argv += ["--audit", "--audit-sample", str(args.audit_sample)]
         if mode == "native":
             argv.append("--native-lanes")
         env = dict(os.environ, PYTHONUNBUFFERED="1")
@@ -847,6 +932,7 @@ def main() -> None:
         import tempfile
 
         tmp = tempfile.mkdtemp(prefix="edge_bench_")
+        arms = ["off", "on"] if args.audit_ab else [None]
         for mode in [m.strip() for m in args.mode.split(",") if m.strip()]:
             if mode == "native":
                 from matching_engine_tpu import native as me_native
@@ -855,41 +941,62 @@ def main() -> None:
                     print("[edge] native runtime not built; skipping "
                           "native mode", file=sys.stderr)
                     continue
-            proc, port, log_path = edge_server(mode, tmp)
-            try:
-                stubs = [MatchingEngineStub(
-                    grpc.insecure_channel(f"127.0.0.1:{port}"))
-                    for _ in range(T)]
-                # Warm: compile the dispatch shapes (per-op sparse buckets
-                # + the largest batch's dense/mega stack) outside every
-                # measured point, with small op budgets — warming is about
-                # shape coverage, not duration.
-                run_point(stubs, 1, measured=False, n_override=64 * T)
-                run_point(stubs, max(sizes), measured=False,
-                          n_override=2 * max(sizes) * T)
-                for bs in sizes:
-                    reps = [run_point(stubs, bs, measured=True)
-                            for _ in range(max(1, args.repeats))]
-                    rates = [r["orders_per_s"] for r in reps]
-                    best = max(reps, key=lambda r: r["orders_per_s"])
-                    best["mode"] = mode
-                    best["edge"] = ("grpc-perop" if bs == 1
-                                    else "grpc-batch")
-                    best["repeats"] = len(reps)
-                    best["orders_per_s_spread"] = [min(rates), max(rates)]
-                    rows.append(best)
-                    print(f"[edge] {mode} bs={bs}: "
-                          f"{best['orders_per_s']} orders/s "
-                          f"(acc {best['accepted']}, rej "
-                          f"{best['rejected']}, err {best['rpc_errors']}, "
-                          f"megaM {best['mega_waves_per_step']})",
-                          file=sys.stderr)
-            finally:
-                proc.terminate()
+            for arm in arms:
+                proc, port, log_path = edge_server(mode, tmp, audit=arm)
                 try:
-                    proc.wait(timeout=20)
-                except Exception:  # noqa: BLE001
-                    proc.kill()
+                    stubs = [MatchingEngineStub(
+                        grpc.insecure_channel(f"127.0.0.1:{port}"))
+                        for _ in range(T)]
+                    # Warm: compile the dispatch shapes (per-op sparse
+                    # buckets + the largest batch's dense/mega stack)
+                    # outside every measured point, with small op budgets
+                    # — warming is about shape coverage, not duration.
+                    run_point(stubs, 1, measured=False, n_override=64 * T)
+                    run_point(stubs, max(sizes), measured=False,
+                              n_override=2 * max(sizes) * T)
+                    for bs in sizes:
+                        reps = [run_point(stubs, bs, measured=True)
+                                for _ in range(max(1, args.repeats))]
+                        rates = [r["orders_per_s"] for r in reps]
+                        best = max(reps, key=lambda r: r["orders_per_s"])
+                        best["mode"] = mode
+                        best["edge"] = ("grpc-perop" if bs == 1
+                                        else "grpc-batch")
+                        if arm is not None:
+                            best["audit"] = arm
+                            if arm == "on":
+                                best["audit_sample"] = args.audit_sample
+                        best["repeats"] = len(reps)
+                        best["orders_per_s_spread"] = [min(rates),
+                                                       max(rates)]
+                        rows.append(best)
+                        print(f"[edge] {mode}"
+                              f"{'' if arm is None else ' audit=' + arm} "
+                              f"bs={bs}: {best['orders_per_s']} orders/s "
+                              f"(acc {best['accepted']}, rej "
+                              f"{best['rejected']}, err "
+                              f"{best['rpc_errors']}, megaM "
+                              f"{best['mega_waves_per_step']})",
+                              file=sys.stderr)
+                finally:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=20)
+                    except Exception:  # noqa: BLE001
+                        proc.kill()
+        # Paired overhead annotation on the audit arms.
+        if args.audit_ab:
+            for on in rows:
+                if on.get("audit") != "on":
+                    continue
+                off = next((r for r in rows
+                            if r.get("audit") == "off"
+                            and r["mode"] == on["mode"]
+                            and r["batch_size"] == on["batch_size"]), None)
+                if off is not None and off["orders_per_s"]:
+                    on["audit_overhead_pct"] = round(
+                        100.0 * (1.0 - on["orders_per_s"]
+                                 / off["orders_per_s"]), 1)
         return rows
 
     grid_cap = args.symbols * args.batch
@@ -899,6 +1006,52 @@ def main() -> None:
                   if k.strip()] if args.serve_shards else []
     if args.edge_batch:
         rows = edge_sweep()
+    elif args.audit_ab:
+        import sys as _sys
+
+        # The pump thread alternates pure-python slices with the main
+        # thread's GIL-released device calls: at CPython's default 5ms
+        # switch interval the dispatch thread convoys behind the pump's
+        # quantum (the --serve-shards lesson, BENCH_METHOD §partitioned
+        # serving) — restore handoff granularity for BOTH arms.
+        _sys.setswitchinterval(max(1, args.gil_switch_us) / 1e6)
+
+        # INTERLEAVED paired arms: one (off, on) pair per repeat, so both
+        # arms sample the same slow drift of this shared box (block-running
+        # one arm's repeats then the other's let minutes-scale load drift
+        # masquerade as auditor overhead, in either direction). Best-of
+        # per arm over the interleaved reps; the overhead figure is the
+        # best-vs-best ratio with both spreads published.
+        rows = []
+        for mode in args.mode.split(","):
+            for bo in str(args.batch_ops).split(","):
+                for k in args.inflight.split(","):
+                    point = (mode.strip(), int(k), min(int(bo), grid_cap))
+                    reps = {"off": [], "on": []}
+                    for _ in range(max(1, args.repeats)):
+                        for arm in ("off", "on"):
+                            reps[arm].append(
+                                sweep_point(*point, audit=arm))
+                    pair = []
+                    for arm in ("off", "on"):
+                        rates = [r["orders_per_s"] for r in reps[arm]]
+                        best = max(reps[arm],
+                                   key=lambda r: r["orders_per_s"])
+                        best["repeats"] = len(rates)
+                        best["orders_per_s_spread"] = [min(rates),
+                                                       max(rates)]
+                        pair.append(best)
+                    off, on = pair
+                    on["audit_overhead_pct"] = round(
+                        100.0 * (1.0 - on["orders_per_s"]
+                                 / off["orders_per_s"]), 1)
+                    # Median-vs-median too: best-of is the noise floor,
+                    # the median pair is the typical-run figure.
+                    med = [sorted(r["orders_per_s"] for r in reps[a])
+                           [len(reps[a]) // 2] for a in ("off", "on")]
+                    on["audit_overhead_pct_median"] = round(
+                        100.0 * (1.0 - med[1] / med[0]), 1)
+                    rows.extend(pair)
     elif mega_list:
 
         def best_of_mega(m, k):
@@ -949,7 +1102,10 @@ def main() -> None:
     except Exception:  # noqa: BLE001
         rev = "unknown"
     out = {
-        "metric": ("batch_edge_throughput" if args.edge_batch
+        "metric": ("batch_edge_audit_ab" if args.edge_batch
+                   and args.audit_ab
+                   else "batch_edge_throughput" if args.edge_batch
+                   else "auditor_overhead_ab" if args.audit_ab
                    else "runner_dispatch_throughput"),
         "platform": platform,
         "symbols": args.symbols,

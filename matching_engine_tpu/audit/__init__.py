@@ -1,0 +1,34 @@
+"""Online surveillance: per-order drop-copy stream + invariant auditor.
+
+The serving stack maintains three independent truth surfaces — the
+device book, the SQLite store, the sequenced feed — and until this
+package the only correctness check was an OFFLINE audit of the store
+after shutdown. Here:
+
+- `dropcopy` publishes one compact lifecycle record per order event on
+  the sequenced `audit` feed channel, derived from the dispatch's
+  storage rows at the decode boundary on BOTH serving paths (so the
+  records are bit-identical whichever path decoded the dispatch), and
+- `auditor.InvariantAuditor` consumes those records in-process and
+  asserts, continuously, that the surfaces agree — first violation
+  flight-dumps with the offending record inlined and /auditz turns red.
+
+Consume externally via StreamOrderUpdates with the reserved
+`AUDIT_CLIENT` client_id (resume/gap-fill like any sequenced channel),
+`client/cli.py audit`, or `scripts/audit.py --dropcopy` offline.
+"""
+
+from matching_engine_tpu.audit.auditor import VIOLATION_KINDS, InvariantAuditor
+from matching_engine_tpu.audit.dropcopy import (
+    AUDIT_CLIENT,
+    KIND_FILL,
+    KIND_ORDER,
+    KIND_UPDATE,
+    AuditPump,
+    DropCopyPublisher,
+    dropcopy_events,
+)
+
+__all__ = ["AUDIT_CLIENT", "AuditPump", "DropCopyPublisher",
+           "InvariantAuditor", "KIND_FILL", "KIND_ORDER", "KIND_UPDATE",
+           "VIOLATION_KINDS", "dropcopy_events"]

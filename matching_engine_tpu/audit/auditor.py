@@ -1,0 +1,667 @@
+"""InvariantAuditor: the continuous online proof that the three truth
+surfaces — device book, durable store, sequenced feed — agree.
+
+A shadow per-order state machine fed from the drop-copy records (plus
+lazy read-only probes of the durable store), asserting ONLINE what
+scripts/audit.py could previously only prove after the server was dead:
+
+  transition      legal status transitions only (NEW -> PARTIALLY_FILLED
+                  -> {FILLED, CANCELED}; REJECTED terminal; FILLED <=>
+                  remaining == 0, PARTIAL/NEW => remaining > 0)
+  conservation    Σ fills <= original quantity; remaining monotone
+                  non-increasing; fills == quantity - remaining at every
+                  dispatch boundary (REJECTED included; CANCELED holds
+                  no remainder liability — scripts/audit.py's rules)
+  fill_symmetry   every fill references a live maker (and a registered
+                  aggressor) with matching symbol, opposite side, and
+                  the maker's limit price
+  seq_gap         the audit channel's venue-wide seq line is dense — a
+                  hole is an event lost between decode and publish
+  crossed_book    best_bid < best_ask after every dispatch (call-auction
+                  accumulation excepted, where crossed books are legal)
+  store_mismatch  sampled terminal orders' durable rows (status,
+                  remaining, Σ fills) equal the shadow once committed
+  malformed       structurally impossible records (non-positive fill
+                  quantity, negative remaining, self-crossed ids)
+
+Two feeding surfaces share one core:
+
+- `observe_rows(orders, fills, updates, seqs)` — the in-process hot
+  path: the DispatchResult's storage row TUPLES straight from the
+  decode (no proto attribute reads; this runs on the drain loops'
+  publish path under the hub lock), with seq continuity checked from
+  the delivered wire events' seq list;
+- `observe(events)` — wire-shaped drop-copy protos (the client-side
+  checker behind `client audit`), converted to rows and delegated.
+
+Cost model (--audit-sample N): the cheap record-shape, seq, and
+crossed-book invariants run for EVERY record; the full shadow state
+machine (and the store probes) track a deterministic 1-in-N order
+subset (multiplicative hash of the OID number — a plain modulus would
+miss strided shard lanes' residue classes entirely), so overhead is
+bounded and the subset is
+identical across runs/replicas — the determinism-audit substrate the HA
+replica (ROADMAP Open item 3) will reuse to assert primary/standby
+bit-identity. N=1 shadows everything (tests, corruption soaks).
+
+The first violation flight-records the offending record inline and
+schedules a post-mortem dump (rate-limited thereafter);
+me_audit_violations_total{_<kind>} count every one; /readyz stays up but
+/auditz turns red (utils/obs.ObsServer).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+from collections import deque
+
+from matching_engine_tpu.audit.dropcopy import KIND_FILL, KIND_ORDER, KIND_UPDATE
+from matching_engine_tpu.utils.obs import warn_rate_limited
+
+NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = range(5)
+_TERMINAL = (FILLED, CANCELED, REJECTED)
+_LEGAL = {
+    NEW: (NEW, PARTIALLY_FILLED, FILLED, CANCELED),
+    PARTIALLY_FILLED: (PARTIALLY_FILLED, FILLED, CANCELED),
+    FILLED: (),
+    CANCELED: (),
+    REJECTED: (),
+}
+
+VIOLATION_KINDS = ("transition", "conservation", "fill_symmetry",
+                   "seq_gap", "crossed_book", "store_mismatch", "malformed")
+
+
+class _Shadow:
+    __slots__ = ("qty", "remaining", "status", "side", "symbol",
+                 "price_q4", "filled")
+
+    def __init__(self, qty, remaining, status, side, symbol, price_q4):
+        self.qty = qty
+        self.remaining = remaining
+        self.status = status
+        self.side = side
+        self.symbol = symbol
+        self.price_q4 = price_q4
+        self.filled = 0
+
+
+def _oid_num(order_id: str) -> int | None:
+    if order_id.startswith("OID-"):
+        try:
+            return int(order_id[4:])
+        except ValueError:
+            return None
+    return None
+
+
+class InvariantAuditor:
+    """Thread-safe (one lock; every serving lane's drain loop feeds it,
+    serialized through the StreamHub's publish lock)."""
+
+    def __init__(self, metrics=None, sample: int = 8,
+                 db_path: str | None = None, store_check_every: int = 32,
+                 max_tracked: int = 1 << 20, max_pending: int = 8192,
+                 strict: bool = True):
+        if metrics is None:
+            from matching_engine_tpu.utils.metrics import Metrics
+
+            metrics = Metrics()
+        self.metrics = metrics
+        self.sample = max(1, int(sample))
+        # strict=True: the in-process mode — attached from boot, so a
+        # fill/update referencing an unregistered order IS corruption.
+        # strict=False: a client-side checker that may have attached
+        # mid-stream — unknown references are skipped (only references
+        # to orders it SAW go terminal still violate).
+        self.strict = strict
+        self.db_path = db_path
+        self.store_check_every = max(1, int(store_check_every))
+        self.max_tracked = max_tracked
+        self._lock = threading.Lock()
+        self._shadows: dict[str, _Shadow] = {}
+        self._last_seq = 0
+        self._dispatches = 0
+        self._auction_batch = False  # current batch is an uncross
+        self.violations = 0
+        self.by_kind: dict[str, int] = {k: 0 for k in VIOLATION_KINDS}
+        self.records_seen = 0
+        self.store_checks = 0
+        self.max_pending = max(1, int(max_pending))
+        # Sampled terminal orders awaiting their durable-store probe:
+        # (order_id, status, remaining, filled, attempts) — plus a
+        # parallel id set so _retired() stays O(1) (a linear deque scan
+        # per registered order would ride the publish path).
+        self._store_pending: deque = deque()
+        self._store_pending_ids: set[str] = set()
+        self._probe_due = False
+        # Serializes PROBERS only (sink-commit hook vs pump cadence);
+        # the SQL itself runs outside the main auditor lock — the
+        # hub-lock → auditor-lock publish path must never wait on
+        # SQLite.
+        self._probe_lock = threading.Lock()
+        self._recent: deque = deque(maxlen=32)
+        self._conn: sqlite3.Connection | None = None
+        # Orders born before the auditor attached (boot recovery replay
+        # publishes no drop-copy): ids below the floor are exempt from
+        # shadow tracking — a fill referencing one is pre-boot state,
+        # not corruption. Strided lanes recover unequal counts, so the
+        # floor is per OID residue class (set_oid_floors) — one global
+        # max would exempt the other lanes' genuinely new ids.
+        self.oid_floor = 0
+        self._oid_floors: dict[int, int] = {}  # n % stride -> floor
+        self._oid_stride = 1
+        # Pre-register the exported series so a clean server still
+        # exposes zeros (scrapers see names, not absence); the per-kind
+        # registrations stay literal for the OPERATIONS.md doc-lint.
+        m = metrics
+        m.inc("audit_records", 0)
+        m.inc("audit_violations", 0)
+        m.inc("audit_violations_transition", 0)
+        m.inc("audit_violations_conservation", 0)
+        m.inc("audit_violations_fill_symmetry", 0)
+        m.inc("audit_violations_seq_gap", 0)
+        m.inc("audit_violations_crossed_book", 0)
+        m.inc("audit_violations_store_mismatch", 0)
+        m.inc("audit_violations_malformed", 0)
+        m.inc("audit_store_checks", 0)
+        m.set_gauge("audit_tracked_orders", 0)
+        m.set_gauge("audit_store_pending", 0)
+
+    # -- violation plumbing ------------------------------------------------
+
+    def _violation(self, kind: str, detail: str, record=None) -> None:
+        self.violations += 1
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+        self.metrics.inc("audit_violations")
+        self.metrics.inc("audit_violations_" + kind)
+        entry = {
+            "kind": "audit_violation", "violation": kind, "detail": detail,
+            "wall_ts": time.time(),
+        }
+        if record is not None:
+            entry["record"] = record
+        self._recent.append(entry)
+        recorder = getattr(self.metrics, "recorder", None)
+        if recorder is not None:
+            # The offending record rides the flight ring inline; the dump
+            # (rate-limited, background thread) is the operator's
+            # post-mortem with the dispatch context around it.
+            recorder.record(entry)
+            recorder.dump_on_error()
+        warn_rate_limited(
+            "auditor-" + kind,
+            f"[audit] INVARIANT VIOLATION ({kind}): {detail}")
+
+    # -- sampling ----------------------------------------------------------
+
+    def _tracked_id(self, order_id: str) -> bool:
+        n = _oid_num(order_id)
+        if n is None:
+            return False
+        floor = (self._oid_floors.get(n % self._oid_stride, self.oid_floor)
+                 if self._oid_floors else self.oid_floor)
+        if n < floor:
+            return False
+        if self.sample == 1:
+            return True
+        # Multiplicative hash with a high-bit fold, NOT n % sample:
+        # strided shard lanes allocate one residue class each, and a
+        # plain modulus would leave whole lanes with zero shadow
+        # coverage (no odd n has n % 8 == 0; an odd multiplier alone
+        # preserves parity, hence the fold). Still a pure deterministic
+        # function of the OID — identical subset across runs/replicas.
+        h = (n * 2654435761) & 0xFFFFFFFF
+        return ((h ^ (h >> 16)) % self.sample) == 0
+
+    def set_oid_floors(self, lanes) -> None:
+        """Per-residue-class pre-boot floors: lanes is
+        [(next_oid, oid_offset, oid_stride)] over the serving runners
+        after recovery replay."""
+        for next_oid, offset, stride in lanes:
+            if stride <= 1:
+                self.oid_floor = max(self.oid_floor, next_oid)
+            else:
+                self._oid_stride = stride
+                self._oid_floors[(offset + 1) % stride] = next_oid
+
+    def _retired(self, order_id: str) -> bool:
+        return order_id in self._store_pending_ids
+
+    def _pending_add_locked(self, ent) -> None:
+        if len(self._store_pending) >= self.max_pending:
+            evicted = self._store_pending.popleft()
+            self._store_pending_ids.discard(evicted[0])
+        self._store_pending.append(ent)
+        self._store_pending_ids.add(ent[0])
+
+    def seed_seq(self, last_seq: int) -> None:
+        """Set the expected seq cursor (a client-side checker attaching
+        mid-stream seeds from its first event; the in-process auditor
+        keeps the boot default of 0 = expect the line to start at 1)."""
+        with self._lock:
+            self._last_seq = max(self._last_seq, last_seq)
+
+    # -- the per-dispatch feed --------------------------------------------
+
+    def observe_rows(self, orders, fills, updates, seqs=None,
+                     market_data=None, crossed_ok: bool = False,
+                     auction: bool = False) -> None:
+        """Consume one dispatch's delivered drop-copy content as the
+        decode-boundary ROW tuples (orders: storage order rows, fills:
+        FillRows, updates: status rows) plus the delivered wire events'
+        seq list. The in-process hot path — plain tuple/int work, called
+        under the publishing hub lock so concurrent lanes feed in stamp
+        order. `auction` marks an uncross batch: its fills execute at
+        the CLEARING price, which may legitimately improve on a maker's
+        limit — the maker-price equality check is continuous-matching
+        law only."""
+        with self._lock:
+            self._auction_batch = auction
+            self._observe_locked(orders, fills, updates, seqs,
+                                 market_data, crossed_ok)
+
+    def observe(self, events, market_data=None,
+                crossed_ok: bool = False) -> None:
+        """Wire-shaped feed (drop-copy OrderUpdate protos): convert to
+        rows and delegate — the client-side checker's surface."""
+        from matching_engine_tpu.storage.storage import FillRow
+
+        orders, fills, updates, seqs = [], [], [], []
+        for e in events:
+            seqs.append(e.seq)
+            k = e.audit_kind
+            if k == KIND_ORDER:
+                orders.append((e.order_id, e.client_id, e.symbol,
+                               e.audit_side, e.audit_otype, e.fill_price,
+                               e.audit_quantity, e.remaining_quantity,
+                               e.status))
+            elif k == KIND_FILL:
+                fills.append(FillRow(e.order_id, e.counter_order_id,
+                                     e.fill_price, e.fill_quantity))
+            elif k == KIND_UPDATE:
+                if e.audit_quantity:
+                    updates.append((e.order_id, e.status,
+                                    e.remaining_quantity, e.audit_quantity))
+                else:
+                    updates.append((e.order_id, e.status,
+                                    e.remaining_quantity))
+            else:
+                with self._lock:
+                    self._violation("malformed",
+                                    f"unknown audit_kind {k}",
+                                    {"order_id": e.order_id, "seq": e.seq})
+        self.observe_rows(
+            orders, fills, updates, seqs, market_data, crossed_ok,
+            auction=bool(events) and events[0].dispatch_shape == "auction")
+
+    def _observe_locked(self, orders, fills, updates, seqs,
+                        market_data, crossed_ok) -> None:
+        self.records_seen += len(orders) + len(fills) + len(updates)
+        if seqs:
+            last = self._last_seq
+            for seq in seqs:
+                if seq:
+                    # Attached from boot, the audit line is known to
+                    # start at 1: a hole BEFORE the first observed
+                    # record is as much a loss as one in the middle.
+                    # (Client-side checkers attaching mid-stream seed
+                    # the cursor via seed_seq.)
+                    if seq != last + 1:
+                        self._violation(
+                            "seq_gap",
+                            f"audit seq hole: {last} -> {seq} "
+                            f"({seq - last - 1} record(s) lost between "
+                            f"decode and publish)")
+                    if seq > last:
+                        last = seq
+            self._last_seq = last
+        touched: dict[str, _Shadow] = {}
+        for row in orders:
+            self._apply_order(row, touched)
+        for f in fills:
+            self._apply_fill(f, touched)
+        for row in updates:
+            self._apply_update(row, touched)
+        # Dispatch-boundary conservation: every touched shadow's books
+        # must balance NOW — corruption is caught within one dispatch.
+        for oid, s in touched.items():
+            self._check_balance(oid, s)
+        # Terminal shadows retire to the store-probe queue (bounds the
+        # live set at open + in-flight sampled orders).
+        for oid, s in touched.items():
+            if s.status in _TERMINAL and oid in self._shadows:
+                del self._shadows[oid]
+                self._pending_add_locked(
+                    [oid, s.status, s.remaining, s.filled, 0])
+        if market_data:
+            for u in market_data:
+                if (not crossed_ok and u.bid_size > 0 and u.ask_size > 0
+                        and u.best_bid >= u.best_ask):
+                    self._violation(
+                        "crossed_book",
+                        f"{u.symbol}: crossed top-of-book after dispatch "
+                        f"(bid {u.best_bid}x{u.bid_size} >= ask "
+                        f"{u.best_ask}x{u.ask_size})")
+        self._dispatches += 1
+        if self._dispatches % 16 == 0:  # gauge refresh, not per dispatch
+            self.metrics.set_gauge("audit_tracked_orders",
+                                   len(self._shadows))
+            self.metrics.set_gauge("audit_store_pending",
+                                   len(self._store_pending))
+        if (self.db_path is not None and self._store_pending
+                and self._dispatches % self.store_check_every == 0):
+            # NEVER probe here: observe_rows runs under the publishing
+            # hub lock — the caller (pump/client) probes after release.
+            self._probe_due = True
+
+    def _apply_order(self, row, touched) -> None:
+        (oid, _cid, sym, side, _otype, price, qty, rem, status) = row
+        if qty <= 0 or rem < 0 or rem > qty:
+            self._violation(
+                "malformed",
+                f"{oid}: impossible order row qty={qty} remaining={rem}",
+                {"order_id": oid, "row": list(row)})
+            return
+        self._check_status_remaining(oid, status, rem, qty)
+        if not self._tracked_id(oid):
+            return
+        if oid in self._shadows or self._retired(oid):
+            self._violation(
+                "transition", f"{oid}: re-registered (duplicate order row)",
+                {"order_id": oid, "row": list(row)})
+            return
+        if len(self._shadows) >= self.max_tracked:
+            return  # bounded memory: stop adopting, keep existing checks
+        s = _Shadow(qty, rem, status, side, sym,
+                    price if price is not None else 0)
+        self._shadows[oid] = s
+        touched[oid] = s
+
+    def _apply_fill(self, f, touched) -> None:
+        fq = f.quantity
+        oid, coid = f.order_id, f.counter_order_id
+        if fq <= 0:
+            self._violation(
+                "malformed",
+                f"non-positive fill quantity {fq} ({oid}/{coid})",
+                {"order_id": oid, "counter_order_id": coid})
+            return
+        if not coid:
+            self._violation("malformed", f"{oid}: fill without a maker",
+                            {"order_id": oid})
+            return
+        if oid == coid:
+            self._violation(
+                "fill_symmetry", f"{oid}: fill pairs an order with itself",
+                {"order_id": oid})
+            return
+        taker = maker = None
+        if self._tracked_id(oid):
+            taker = self._shadows.get(oid)
+            if taker is None:
+                if self.strict or self._retired(oid):
+                    self._violation(
+                        "fill_symmetry",
+                        f"fill references unregistered or dead aggressor "
+                        f"{oid}",
+                        {"order_id": oid, "counter_order_id": coid,
+                         "fill_quantity": fq, "fill_price": f.price_q4})
+            else:
+                taker.filled += fq
+                touched[oid] = taker
+        if self._tracked_id(coid):
+            maker = self._shadows.get(coid)
+            if maker is None:
+                # Live-maker invariant: terminal shadows retired at the
+                # previous dispatch boundary, so a lookup miss IS a fill
+                # against a dead (or, in strict mode, never-registered)
+                # maker.
+                if self.strict or self._retired(coid):
+                    self._violation(
+                        "fill_symmetry",
+                        f"fill references dead or unknown maker {coid} "
+                        f"(taker {oid})",
+                        {"order_id": oid, "counter_order_id": coid,
+                         "fill_quantity": fq, "fill_price": f.price_q4})
+            else:
+                maker.filled += fq
+                touched[coid] = maker
+                # Continuous matching executes AT the maker's limit; an
+                # auction uncross executes at the clearing price, which
+                # may improve on it — strict equality there would flag
+                # every price-improved auction fill.
+                if f.price_q4 != maker.price_q4 and not self._auction_batch:
+                    self._violation(
+                        "fill_symmetry",
+                        f"fill at {f.price_q4} but maker {coid} rests at "
+                        f"{maker.price_q4}",
+                        {"order_id": oid, "counter_order_id": coid,
+                         "fill_price": f.price_q4})
+        if taker is not None and maker is not None:
+            if taker.side == maker.side:
+                self._violation(
+                    "fill_symmetry",
+                    f"fill pairs same-side orders {oid}/{coid}",
+                    {"order_id": oid, "counter_order_id": coid})
+            if taker.symbol != maker.symbol:
+                self._violation(
+                    "fill_symmetry",
+                    f"fill crosses symbols {oid}/{coid}",
+                    {"order_id": oid, "counter_order_id": coid})
+
+    def _apply_update(self, row, touched) -> None:
+        oid, status, rem = row[0], row[1], row[2]
+        if rem < 0:
+            self._violation("malformed",
+                            f"{oid}: negative remaining {rem}",
+                            {"order_id": oid, "row": list(row)})
+            return
+        if not self._tracked_id(oid):
+            return
+        s = self._shadows.get(oid)
+        if s is None:
+            # Update for an untracked/retired order: a status row after
+            # terminal retirement is itself an illegal transition.
+            if self._retired(oid):
+                self._violation(
+                    "transition",
+                    f"{oid}: status row after terminal state",
+                    {"order_id": oid, "row": list(row)})
+            return
+        if status not in _LEGAL.get(s.status, ()):
+            self._violation(
+                "transition",
+                f"{oid}: illegal transition {s.status} -> {status}",
+                {"order_id": oid, "row": list(row)})
+        if rem > s.remaining:
+            self._violation(
+                "conservation",
+                f"{oid}: remaining increased {s.remaining} -> {rem}",
+                {"order_id": oid, "row": list(row)})
+        if len(row) > 3:  # amend row: quantity reduces with remaining
+            if row[3] > s.qty:
+                self._violation(
+                    "conservation",
+                    f"{oid}: amend RAISED quantity {s.qty} -> {row[3]}",
+                    {"order_id": oid, "row": list(row)})
+            s.qty = row[3]
+        self._check_status_remaining(oid, status, rem, s.qty)
+        s.status = status
+        s.remaining = rem
+        touched[oid] = s
+
+    def _check_status_remaining(self, oid, status, rem, qty) -> None:
+        """Per-record status/remaining machine (kind: transition)."""
+        if status == FILLED:
+            if rem != 0:
+                self._violation(
+                    "transition", f"{oid}: FILLED with remaining={rem}",
+                    {"order_id": oid})
+        elif status == NEW:
+            if rem != qty:
+                self._violation(
+                    "transition",
+                    f"{oid}: NEW with remaining {rem} != quantity {qty}",
+                    {"order_id": oid})
+        elif status == PARTIALLY_FILLED and not (0 < rem < qty):
+            self._violation(
+                "transition",
+                f"{oid}: PARTIALLY_FILLED with remaining={rem} of {qty}",
+                {"order_id": oid})
+
+    def _check_balance(self, oid: str, s: _Shadow) -> None:
+        """scripts/audit.py's per-order arithmetic, held at EVERY
+        dispatch boundary (acknowledged fill-record loss — the
+        me_fill_buffer_overflows_total regime — surfaces here by design:
+        the drop-copy is missing exactly what the fills table is)."""
+        if s.status == CANCELED:
+            if s.filled > s.qty:
+                self._violation(
+                    "conservation",
+                    f"{oid}: overfilled ({s.filled} > {s.qty})")
+            return
+        if s.filled != s.qty - s.remaining:
+            self._violation(
+                "conservation",
+                f"{oid}: fills {s.filled} != quantity {s.qty} - "
+                f"remaining {s.remaining} (status {s.status})")
+
+    # -- durable-store probes ----------------------------------------------
+
+    def _db(self) -> sqlite3.Connection | None:
+        if self._conn is None and self.db_path is not None:
+            try:
+                self._conn = sqlite3.connect(
+                    f"file:{self.db_path}?mode=ro", uri=True,
+                    check_same_thread=False, timeout=1.0)
+            except sqlite3.Error:
+                return None  # store not initialized yet: probes wait
+        return self._conn
+
+    def _store_probe(self, limit: int, strict: bool = False) -> None:
+        """Probe up to `limit` pending entries against the durable
+        store. The SQL runs OUTSIDE the main auditor lock (only
+        _probe_lock serializes concurrent probers — the sink-commit hook
+        vs the pump cadence): the hub-lock → auditor-lock publish path
+        must never wait on SQLite."""
+        with self._probe_lock:
+            with self._lock:
+                conn = self._db()
+                if conn is None:
+                    return
+                n = min(limit, len(self._store_pending))
+                entries = []
+                for _ in range(n):
+                    ent = self._store_pending.popleft()
+                    self._store_pending_ids.discard(ent[0])
+                    entries.append(ent)
+            requeue: list = []
+            findings: list[str] = []
+            checked = 0
+            for ent in entries:
+                oid, status, remaining, filled, attempts = ent
+                try:
+                    row = conn.execute(
+                        "SELECT status, remaining_quantity FROM orders "
+                        "WHERE order_id = ?", (oid,)).fetchone()
+                    if row is None or row[0] not in _TERMINAL:
+                        # The async sink hasn't committed this far yet:
+                        # not a contradiction, re-probe later. Strict
+                        # mode (the caller flushed the sink first) makes
+                        # absence a finding.
+                        if strict:
+                            findings.append(
+                                f"{oid}: terminal on the feed (status "
+                                f"{status}) but store row is "
+                                f"{'absent' if row is None else 'non-terminal'}"
+                                f" after flush")
+                        else:
+                            ent[4] = attempts + 1
+                            requeue.append(ent)
+                        continue
+                    checked += 1
+                    db_fills = conn.execute(
+                        "SELECT COALESCE(SUM(quantity), 0) FROM fills "
+                        "WHERE order_id = ? OR counter_order_id = ?",
+                        (oid, oid)).fetchone()[0]
+                    if row[0] != status or row[1] != remaining:
+                        findings.append(
+                            f"{oid}: store row (status {row[0]}, "
+                            f"remaining {row[1]}) contradicts the feed "
+                            f"(status {status}, remaining {remaining})")
+                    elif db_fills != filled:
+                        findings.append(
+                            f"{oid}: store fills {db_fills} != feed "
+                            f"fills {filled}")
+                except sqlite3.Error:
+                    # Mid-write contention/corrupt file: retry later; a
+                    # persistent failure leaves entries pending, visible
+                    # in audit_store_pending.
+                    ent[4] = attempts + 1
+                    requeue.append(ent)
+            with self._lock:
+                for ent in requeue:
+                    self._pending_add_locked(ent)
+                self.store_checks += checked
+                if checked:
+                    self.metrics.inc("audit_store_checks", checked)
+                for detail in findings:
+                    self._violation("store_mismatch", detail)
+                self.metrics.set_gauge("audit_store_pending",
+                                       len(self._store_pending))
+
+    def maybe_store_check(self) -> None:
+        """Run a bounded probe pass if one came due during observe_rows
+        — called by the pump AFTER the hub lock is released (the cadence
+        fallback for sinks without the commit hook)."""
+        if self._probe_due:
+            self._probe_due = False
+            self._store_probe(limit=8)
+
+    def notify_commit(self) -> None:
+        """Sink-commit notification (wired to AsyncStorageSink.on_commit
+        by build_server): a storage batch just landed, so pending probes
+        have their best chance of resolving — run a bounded pass HERE on
+        the sink's own thread, off every dispatch path."""
+        if self.db_path is None or not self._store_pending:
+            return
+        self._store_probe(limit=8)
+
+    def final_store_check(self) -> None:
+        """Strict pass over every pending probe — call after the caller
+        flushed the sink (tests, shutdown, soak verdicts)."""
+        self._store_probe(limit=len(self._store_pending), strict=True)
+
+    # -- reporting (/auditz) -----------------------------------------------
+
+    @property
+    def red(self) -> bool:
+        return self.violations > 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ok": self.violations == 0,
+                "violations": self.violations,
+                "by_kind": {k: v for k, v in self.by_kind.items() if v},
+                "records": self.records_seen,
+                "dispatches": self._dispatches,
+                "tracked_orders": len(self._shadows),
+                "sample": self.sample,
+                "last_seq": self._last_seq,
+                "store": {"checks": self.store_checks,
+                          "pending": len(self._store_pending)},
+                "recent": list(self._recent),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except sqlite3.Error:
+                    pass
+                self._conn = None

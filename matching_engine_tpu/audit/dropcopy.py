@@ -1,0 +1,388 @@
+"""Drop-copy stream: one compact lifecycle record per order event.
+
+Real venues run a drop-copy feed — an independent, sequenced copy of
+every order's lifecycle — precisely because post-hoc database audits are
+too late (CoinTossX, arXiv:2102.10925, ships per-order event logging as
+a first-class engine output; arXiv:2402.09527 makes online
+reconciliation the precondition for replication). Here the drop-copy is
+derived from the dispatch's STORAGE EVENT ROWS at the decode boundary:
+
+- the storage rows are produced by the decode on BOTH serving paths
+  (DispatchResult.storage_* on the Python path, the unpacked MeSink
+  buffer on --native-lanes) and the lane parity suite already pins them
+  byte-identical — so the drop-copy reflects what the device actually
+  did, with bit-identical payloads whichever path decoded it;
+- every record carries the dispatch envelope (trace_id, shape, waves,
+  oldest-op edge-ingress wall clock) so one record correlates with the
+  flight recorder and the trace export;
+- records publish on the sequenced `audit` channel (ONE venue-wide seq
+  domain) through the StreamHub, so they replay/resume/gap-detect like
+  any sequenced feed channel and the in-process InvariantAuditor can
+  treat a seq hole as evidence of loss between decode and publish.
+
+Record vocabulary (OrderUpdate with audit_kind set — scripts/audit.py
+and the auditor share it):
+
+  kind 1 ORDER   submit decoded: order_id/client_id/symbol, final-of-
+                 dispatch status + remaining, original quantity in
+                 audit_quantity, side/otype, limit price in fill_price
+  kind 2 UPDATE  status row: order_id, status, remaining (amends carry
+                 the reduced quantity in audit_quantity)
+  kind 3 FILL    execution: order_id = aggressor, counter_order_id =
+                 maker, fill_price/fill_quantity
+
+Fault injection (tests + the soak's corruption round): ME_AUDIT_FAULT
+mutates/drops exactly one record between decode and publish, emulating
+the corruption classes the auditor must catch — see _FaultInjector.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from matching_engine_tpu.proto import pb2
+
+# Reserved StreamOrderUpdates client_id that subscribes the caller to the
+# drop-copy audit channel instead of a per-client update stream.
+AUDIT_CLIENT = "__dropcopy__"
+
+KIND_ORDER, KIND_UPDATE, KIND_FILL = 1, 2, 3
+
+
+def dropcopy_events(orders, updates, fills, trace_id: int = 0,
+                    shape: str = "", waves: int = 0,
+                    ingress_ts_us: int = 0) -> list[pb2.OrderUpdate]:
+    """Encode one dispatch's storage rows as drop-copy records.
+
+    Emission order is ORDER rows, then FILL rows, then UPDATE rows: a
+    taker's registration precedes its executions, and maker status
+    transitions reflect post-fill state — the order the auditor's shadow
+    state machine applies them in.
+
+    The dispatch envelope is splatted only for non-default values: this
+    builder runs per storage row on the drain loops' publish path, and
+    proto3 never serializes scalar defaults anyway — the wire bytes are
+    identical, the setter calls are not."""
+    env: dict = {}
+    if trace_id:
+        env["trace_id"] = trace_id
+    if shape:
+        env["dispatch_shape"] = shape
+    if waves:
+        env["dispatch_waves"] = waves
+    if ingress_ts_us:
+        env["ingress_ts_us"] = ingress_ts_us
+    OU = pb2.OrderUpdate
+    out: list[pb2.OrderUpdate] = []
+    for (oid, cid, sym, side, otype, price, qty, remaining, status) in orders:
+        out.append(OU(
+            audit_kind=KIND_ORDER, order_id=oid, client_id=cid, symbol=sym,
+            status=status, remaining_quantity=remaining, scale=4,
+            fill_price=price if price is not None else 0,
+            audit_side=side, audit_otype=otype, audit_quantity=qty, **env))
+    for f in fills:
+        out.append(OU(
+            audit_kind=KIND_FILL, order_id=f.order_id,
+            counter_order_id=f.counter_order_id, fill_price=f.price_q4,
+            fill_quantity=f.quantity, scale=4, **env))
+    for row in updates:
+        if len(row) > 3:  # amend row: the reduced quantity rides along
+            out.append(OU(
+                audit_kind=KIND_UPDATE, order_id=row[0], status=row[1],
+                remaining_quantity=row[2], audit_quantity=row[3], **env))
+        else:
+            out.append(OU(
+                audit_kind=KIND_UPDATE, order_id=row[0], status=row[1],
+                remaining_quantity=row[2], **env))
+    return out
+
+
+def materialize_chunk(rows, env, first_seq: int = 0, epoch: int = 0,
+                      skip: int | None = None, lo: int | None = None,
+                      hi: int | None = None) -> list[pb2.OrderUpdate]:
+    """Build the wire events for one retained dispatch chunk, stamped
+    with its seq run — the ONE copy-on-replay materializer shared by the
+    hub's live fan-out (`skip` = fault-dropped flat index) and the
+    sequencer's replay path (`lo`/`hi` = requested seq range). One
+    definition is what makes replayed bytes == live bytes a structural
+    guarantee rather than a parallel-implementation promise. `rows` is
+    the (orders, updates, fills) triple — the publisher unpacks native
+    store buffers ONCE in _process, and the sequencer retains that same
+    tuple."""
+    orders, updates, fills = rows
+    events = dropcopy_events(orders, updates, fills, *env)
+    out = []
+    for i, e in enumerate(events):
+        if i == skip:
+            continue
+        seq = first_seq + i if first_seq else 0
+        if lo is not None and not (lo <= seq <= hi):
+            continue
+        if seq:
+            e.seq = seq
+            e.feed_epoch = epoch
+        out.append(e)
+    return out
+
+
+class _FaultInjector:
+    """Single-shot corruption injector for the decode→publish seam
+    (ME_AUDIT_FAULT env; tests and the soak's corruption-injection round).
+    Faults apply to the decode-boundary ROWS before encoding, so the
+    external drop-copy subscribers and the in-process auditor observe
+    the identical corruption:
+
+      fill_qty    mutate one fill row's quantity (+1): the corrupt-
+                  decode class — quantity conservation must fire
+      transition  rewrite one terminal status row to PARTIALLY_FILLED:
+                  the skipped/illegal-transition class
+      gap         drop one record AFTER it is stamped: the lost-between-
+                  decode-and-publish class — seq continuity must fire
+
+    ME_AUDIT_FAULT_AFTER=k skips the first k eligible records (default
+    0). The fault fires once per injector, then disarms. Mutations copy
+    the row lists — the async sink already holds references to the
+    originals, and the fault models FEED corruption, not store
+    corruption.
+    """
+
+    def __init__(self, kind: str | None = None, after: int | None = None):
+        if kind is None:
+            kind = os.environ.get("ME_AUDIT_FAULT", "") or None
+        self.kind = kind
+        self.after = (int(os.environ.get("ME_AUDIT_FAULT_AFTER", "0"))
+                      if after is None else after)
+        self.fired = False
+
+    @property
+    def armed(self) -> bool:
+        return self.kind is not None and not self.fired
+
+    def apply_rows(self, orders, fills, updates):
+        """(orders, fills, updates, drop_flat_index | None); flat index
+        counts across the orders → fills → updates emission order."""
+        if self.kind == "fill_qty":
+            for i, f in enumerate(fills):
+                if self.after > 0:
+                    self.after -= 1
+                    continue
+                from matching_engine_tpu.storage.storage import FillRow
+
+                fills = list(fills)
+                fills[i] = FillRow(f.order_id, f.counter_order_id,
+                                   f.price_q4, f.quantity + 1, f.ts)
+                self.fired = True
+                return orders, fills, updates, None
+            return orders, fills, updates, None
+        if self.kind == "transition":
+            for i, row in enumerate(updates):
+                # FILLED/CANCELED rows with remaining 0 only: the
+                # PARTIAL rewrite then provably violates the status/
+                # remaining machine — a row where the rewrite could
+                # pass every invariant must not consume the single shot.
+                if row[1] not in (2, 3) or row[2] != 0:
+                    continue
+                if self.after > 0:
+                    self.after -= 1
+                    continue
+                updates = list(updates)
+                updates[i] = (row[0], 1) + tuple(row[2:])  # -> PARTIAL
+                self.fired = True
+                return orders, fills, updates, None
+            return orders, fills, updates, None
+        if self.kind == "gap":
+            n = len(orders) + len(fills) + len(updates)
+            for i in range(n):
+                if self.after > 0:
+                    self.after -= 1
+                    continue
+                self.fired = True
+                return orders, fills, updates, i
+            return orders, fills, updates, None
+        raise ValueError(f"unknown ME_AUDIT_FAULT kind {self.kind!r}")
+
+
+class AuditPump:
+    """Out-of-band surveillance worker (the async-sink pattern): the
+    drain loops enqueue ONE compact item per dispatch — O(1) on the
+    dispatch path, never per record — and this thread builds the
+    drop-copy records, stamps + fans them out on the hub, and feeds the
+    InvariantAuditor. Real venues run drop-copy out of band for exactly
+    this reason: surveillance must not tax the matching path.
+
+    Ordering: the FIFO queue's enqueue order (each lane enqueues from
+    its own decode callback, in decode order) IS the audit channel's
+    stamp order; one consumer thread makes stamp order == feed order by
+    construction.
+
+    Backpressure: a full queue BLOCKS the publisher (counted as
+    audit_pump_stalls) instead of dropping — an UNSTAMPED loss would be
+    invisible to the very seq-continuity invariant the auditor exists
+    to enforce. The queue bounds memory at maxsize dispatches."""
+
+    def __init__(self, metrics, maxsize: int = 4096):
+        import queue
+
+        self.metrics = metrics
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        # Pre-register so a healthy server exports zeros, not absence.
+        metrics.inc("audit_pump_stalls", 0)
+        metrics.inc("audit_pump_errors", 0)
+        self._thread = threading.Thread(target=self._run, name="audit-pump",
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, publisher, item) -> None:
+        import queue
+
+        try:
+            self._q.put_nowait((publisher, item))
+        except queue.Full:
+            self.metrics.inc("audit_pump_stalls")
+            self._q.put((publisher, item))
+
+    def flush(self) -> None:
+        """Barrier: returns once everything enqueued so far is audited
+        (tests, soak verdicts, shutdown)."""
+        done = threading.Event()
+        self._q.put(("FLUSH", done))
+        done.wait()
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        from matching_engine_tpu.utils.obs import warn_rate_limited
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            pub, work = item
+            if pub == "FLUSH":
+                work.set()
+                continue
+            try:
+                pub._process(work)
+            except Exception as e:  # noqa: BLE001 — surveillance must
+                # degrade (counted + rate-limited), never kill the pump:
+                # a dead pump would silently blind the auditor.
+                self.metrics.inc("audit_pump_errors")
+                warn_rate_limited(
+                    "audit-pump",
+                    f"[audit] pump error: {type(e).__name__}: {e}")
+
+
+class DropCopyPublisher:
+    """Per-lane drop-copy publisher: `publish()` is called by the lane's
+    drain loop on_finish (under that lane's dispatch lock, right where
+    the sink/hub publish happens) and SNAPSHOTS the dispatch's rows +
+    envelope — the row lists must be captured before the async sink's
+    coalescing can extend them, and auction_mode read at dispatch time.
+    With an AuditPump the heavy half (record build, stamp, fan-out,
+    invariant pass — and on the native path the store-buffer unpack)
+    runs out of band on the pump thread; without one it runs inline
+    (tests, the client-side checker)."""
+
+    def __init__(self, hub, metrics, auditor=None, runner=None,
+                 fault: _FaultInjector | None = None, pump=None):
+        self.hub = hub
+        self.metrics = metrics
+        self.auditor = auditor
+        self.runner = runner  # auction_mode: crossed books are legal then
+        self.fault = fault if fault is not None else _FaultInjector()
+        self.pump = pump
+
+    def publish(self, result, timeline=None, shape: str = "") -> None:
+        store_buf = getattr(result, "store_buf", None)
+        if store_buf is not None:  # native path: immutable MeSink wire
+            rows = store_buf if len(store_buf) > 12 else None
+        else:
+            # Tuple snapshots: the sink's coalescing thread EXTENDS the
+            # first queued batch's lists in place — reading them later
+            # (or even concurrently) would replay another dispatch's
+            # rows into this dispatch's drop-copy.
+            rows = (tuple(result.storage_orders),
+                    tuple(result.storage_updates),
+                    tuple(result.storage_fills))
+            if not (rows[0] or rows[1] or rows[2]):
+                rows = None
+        md = getattr(result, "market_data", None)
+        if rows is None and not md:
+            return
+        trace_id, waves, ingress_us = 0, 0, 0
+        if timeline is not None:
+            trace_id = timeline.trace_id
+            shape = timeline.shape or shape
+            waves = timeline.waves
+            if timeline.t_ingress is not None:
+                # perf_counter stamp -> wall clock µs (the envelope is
+                # normalized away in parity comparisons).
+                ingress_us = int((time.time() - (time.perf_counter()
+                                 - timeline.t_ingress)) * 1e6)
+        in_auction = self.runner is not None and self.runner.auction_mode
+        item = (rows, md, (trace_id, shape, waves, ingress_us), in_auction)
+        if self.pump is not None:
+            self.pump.submit(self, item)
+        else:
+            self._process(item)
+
+    def _process(self, item) -> None:
+        rows, md, env, in_auction = item
+        if rows is None:
+            orders, updates, fills = (), (), ()
+        elif isinstance(rows, (bytes, bytearray)):
+            from matching_engine_tpu import native as me_native
+
+            orders, updates, fills = me_native.unpack_store_buf(rows)
+        else:
+            orders, updates, fills = rows
+        drop = None
+        if self.fault.armed:
+            orders, fills, updates, drop = self.fault.apply_rows(
+                orders, fills, updates)
+        n = len(orders) + len(fills) + len(updates)
+        observer = None
+        if self.auditor is not None:
+            a_orders, a_fills, a_updates = orders, fills, updates
+            if drop is not None:
+                # Keep the auditor's row feed aligned with what was
+                # actually delivered (the dropped record is exactly what
+                # its seq-continuity invariant must notice is missing).
+                a_orders, a_fills, a_updates = \
+                    list(orders), list(fills), list(updates)
+                no, nf = len(orders), len(fills)
+                if drop < no:
+                    del a_orders[drop]
+                elif drop < no + nf:
+                    del a_fills[drop - no]
+                else:
+                    del a_updates[drop - no - nf]
+
+            # Runs under the hub lock: the auditor must see batches in
+            # stamp order. Content feeds as the decode-boundary ROWS;
+            # seq continuity checks the delivered stamp list. Uncross
+            # batches (shape "auction") relax the maker-price equality
+            # rule — they execute at the clearing price.
+            is_auction = env[1] == "auction"
+
+            def observer(seqs):
+                self.auditor.observe_rows(
+                    a_orders, a_fills, a_updates, seqs=seqs,
+                    market_data=md, crossed_ok=in_auction,
+                    auction=is_auction)
+
+        if n or observer is not None:
+            delivered = self.hub.publish_audit_rows(
+                (orders, updates, fills), env, n, drop=drop,
+                observer=observer)
+            if delivered:
+                self.metrics.inc("audit_records", len(delivered))
+        if self.auditor is not None:
+            # Store probes that came due during the observe run NOW —
+            # outside the hub lock, on this (pump/caller) thread.
+            self.auditor.maybe_store_check()

@@ -34,6 +34,9 @@ USAGE = (
     "                 [--idle-exit SECS] [--summary-json FILE] [--quiet]\n"
     "   or: client submit-batch <addr> <opfile> [--batch-size N]\n"
     "                 [--summary-json FILE] [--quiet]\n"
+    "   or: client audit <addr> [--from-seq N] [--epoch N]\n"
+    "                 [--no-gap-fill] [--max-events N] [--idle-exit SECS]\n"
+    "                 [--capture FILE] [--summary-json FILE] [--quiet]\n"
     "   or: client metrics <addr>\n"
     "   or: client auction <addr> [symbol]"
 )
@@ -289,6 +292,195 @@ def _subscribe(argv: list[str]) -> int:
     return rc
 
 
+def _audit(argv: list[str]) -> int:
+    """Drop-copy surveillance tap: subscribe to the sequenced audit
+    channel, run the CLIENT-SIDE invariant checker over the lifecycle
+    records (grouped per dispatch by trace_id), optionally capture them
+    as JSON lines for scripts/audit.py --dropcopy, and exit 4 on any
+    violation the checker (or the feed's gap accounting) can see —
+    mirrors the `subscribe` verb's signal/summary contract."""
+    import json
+    import signal
+    import threading
+    import time
+
+    from matching_engine_tpu.audit import InvariantAuditor
+    from matching_engine_tpu.feed.client import SequencedSubscriber
+    from matching_engine_tpu.feed.sequencer import CHANNEL_AUDIT
+
+    addr = argv[0]
+    from_seq, epoch, max_events, idle_exit = 0, 0, 0, 0.0
+    gap_fill, quiet = True, False
+    summary_json = capture = None
+    it = iter(argv[1:])
+    try:
+        for a in it:
+            if a == "--from-seq":
+                from_seq = int(next(it))
+            elif a == "--epoch":
+                epoch = int(next(it))
+            elif a == "--no-gap-fill":
+                gap_fill = False
+            elif a == "--max-events":
+                max_events = int(next(it))
+            elif a == "--idle-exit":
+                idle_exit = float(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            elif a == "--capture":
+                capture = next(it)
+            elif a == "--quiet":
+                quiet = True
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except StopIteration:
+        print(USAGE, file=sys.stderr)
+        return 1
+
+    def on_gap(start, end, filled, missing):
+        print(f"[client] AUDIT FEED GAP: seq {start + 1}..{end - 1} "
+              f"missed upstream; {filled} gap-filled, {missing} "
+              f"UNRECOVERED", file=sys.stderr, flush=True)
+
+    def on_rebase(cursor, seq):
+        print(f"[client] AUDIT FEED EPOCH REBASE: server restarted "
+              f"(cursor {cursor} -> live seq {seq})", file=sys.stderr,
+              flush=True)
+
+    feed = SequencedSubscriber(
+        _stub(addr), CHANNEL_AUDIT, from_seq=from_seq, gap_fill=gap_fill,
+        on_gap=on_gap, on_rebase=on_rebase, epoch=epoch)
+    # Client-side checker: non-strict (a tap may attach mid-stream and
+    # see fills for orders born before it), shadow-everything, no store
+    # access. Seq holes are the SUBSCRIBER's job (it gap-fills; its
+    # unrecovered count feeds the exit code), so the checker's cursor is
+    # seeded per event.
+    checker = InvariantAuditor(sample=1, strict=False)
+    last_event = [time.monotonic()]
+    stop_reason: list[str] = []
+
+    def _stop(why: str) -> None:
+        if not stop_reason:
+            stop_reason.append(why)
+        feed.cancel()
+
+    for s in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(s, lambda *_: _stop("signal"))
+        except ValueError:
+            pass  # not the main thread (tests drive main() directly)
+    if idle_exit > 0:
+        def watchdog():
+            while not stop_reason:
+                if time.monotonic() - last_event[0] > idle_exit:
+                    _stop("idle")
+                    return
+                time.sleep(min(0.25, idle_exit / 4))
+
+        threading.Thread(target=watchdog, daemon=True).start()
+
+    cap_f = open(capture, "w") if capture else None
+    _KINDS = {1: "order", 2: "update", 3: "fill"}
+
+    def cap_line(e) -> dict:
+        return {
+            "kind": _KINDS.get(e.audit_kind, e.audit_kind),
+            "seq": e.seq, "order_id": e.order_id,
+            "counter_order_id": e.counter_order_id,
+            "client_id": e.client_id, "symbol": e.symbol,
+            "status": e.status, "remaining": e.remaining_quantity,
+            "quantity": e.audit_quantity, "side": e.audit_side,
+            "otype": e.audit_otype,
+            "price": e.fill_price if e.audit_kind == 1 else 0,
+            "fill_price": e.fill_price if e.audit_kind == 3 else 0,
+            "fill_quantity": e.fill_quantity,
+            "trace_id": e.trace_id, "shape": e.dispatch_shape,
+            "waves": e.dispatch_waves, "ingress_ts_us": e.ingress_ts_us,
+        }
+
+    rc = 0
+    batch: list = []
+    batch_trace = [None]
+
+    def flush_batch() -> None:
+        if batch:
+            checker.observe(batch)
+            batch.clear()
+
+    try:
+        first = True
+        for e in feed:
+            last_event[0] = time.monotonic()
+            if first and e.seq:
+                checker.seed_seq(e.seq - 1)
+                first = False
+            # One observe() per dispatch: the balance invariants hold at
+            # dispatch boundaries, and every record of a dispatch shares
+            # its trace_id.
+            if batch and e.trace_id != batch_trace[0]:
+                flush_batch()
+            batch_trace[0] = e.trace_id
+            batch.append(e)
+            if cap_f is not None:
+                cap_f.write(json.dumps(cap_line(e)) + "\n")
+            if not quiet:
+                k = _KINDS.get(e.audit_kind, "?")
+                print(f"[client] audit #{e.seq} {k} {e.order_id} "
+                      f"st={e.status} rem={e.remaining_quantity} "
+                      f"fill={e.fill_quantity}@{e.fill_price} "
+                      f"ctr={e.counter_order_id} trace={e.trace_id}",
+                      flush=True)
+            if max_events and feed.events >= max_events:
+                _stop("max-events")
+                break
+    except grpc.RpcError as err:
+        print(f"[client] rpc failed: {err.code().name}: {err.details()}",
+              file=sys.stderr)
+        rc = 2
+    tail_reason = stop_reason[0] if stop_reason else "stream-end"
+    unchecked_tail = 0
+    if rc == 0 and tail_reason in ("idle", "stream-end"):
+        # The stream drained to a dispatch boundary (a dispatch's
+        # records arrive in one burst): the tail group is complete.
+        flush_batch()
+    else:
+        # Signal / --max-events / RPC error can stop ITERATION mid-
+        # dispatch — between an order row and its fills. Balance-
+        # checking that truncated group would report a healthy venue as
+        # corrupt (spurious exit 4); it is unverifiable, not wrong.
+        unchecked_tail = len(batch)
+        batch.clear()
+    if cap_f is not None:
+        cap_f.close()
+    snap = checker.snapshot()
+    summary = feed.summary()
+    summary["stop_reason"] = tail_reason
+    summary["unchecked_tail_records"] = unchecked_tail
+    summary["violations"] = snap["violations"]
+    summary["violations_by_kind"] = snap["by_kind"]
+    summary["tracked_orders"] = snap["tracked_orders"]
+    print(f"[client] audit summary: events={summary['events']} "
+          f"last_seq={summary['last_seq']} violations={snap['violations']} "
+          f"by_kind={snap['by_kind']} gaps={summary['gaps_detected']} "
+          f"unrecovered={summary['unrecovered_events']} "
+          f"rebases={summary['epoch_rebases']}",
+          file=sys.stderr, flush=True)
+    for v in snap["recent"]:
+        print(f"[client] AUDIT VIOLATION ({v['violation']}): {v['detail']}",
+              file=sys.stderr, flush=True)
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f)
+    if snap["violations"] or feed.unrecovered_events:
+        print(f"[client] AUDIT INTEGRITY FAILURE: "
+              f"{snap['violations']} violation(s), "
+              f"{feed.unrecovered_events} unrecoverable event(s)",
+              file=sys.stderr, flush=True)
+        return 4
+    return rc
+
+
 def _submit_batch(argv: list[str]) -> int:
     """Replay a recorded op file through SubmitOrderBatch: the file is the
     flat binary op-record wire (domain/oprec.py — the SAME codec reader
@@ -406,6 +598,8 @@ def _dispatch(argv: list[str]) -> int:
             return _subscribe(argv[1:])
         if len(argv) >= 3 and argv[0] == "submit-batch":
             return _submit_batch(argv[1:])
+        if len(argv) >= 2 and argv[0] == "audit":
+            return _audit(argv[1:])
         if len(argv) == 8:
             return _submit(argv)
         if len(argv) == 3 and argv[0] == "book":

@@ -18,10 +18,13 @@ gap. See docs/OPERATIONS.md "Sequenced feed".
 """
 
 from matching_engine_tpu.feed.sequencer import (
+    AUDIT_DOMAIN_KEY,
+    CHANNEL_AUDIT,
     CHANNEL_MD,
     CHANNEL_OU,
     FeedSequencer,
     RetransmissionRing,
 )
 
-__all__ = ["CHANNEL_MD", "CHANNEL_OU", "FeedSequencer", "RetransmissionRing"]
+__all__ = ["AUDIT_DOMAIN_KEY", "CHANNEL_AUDIT", "CHANNEL_MD", "CHANNEL_OU",
+           "FeedSequencer", "RetransmissionRing"]

@@ -31,24 +31,30 @@ from __future__ import annotations
 
 import grpc
 
-from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
+from matching_engine_tpu.feed.sequencer import (
+    CHANNEL_AUDIT,
+    CHANNEL_MD,
+    CHANNEL_OU,
+)
 from matching_engine_tpu.proto import pb2
 
 
 class SequencedSubscriber:
     """Iterate sequenced events for one (channel, key), auto-gap-filling.
 
-    channel: feed.CHANNEL_MD (key = symbol) or feed.CHANNEL_OU
-    (key = client_id). `from_seq` resumes after a disconnect: the server
+    channel: feed.CHANNEL_MD (key = symbol), feed.CHANNEL_OU (key =
+    client_id), or feed.CHANNEL_AUDIT (the venue-wide drop-copy stream;
+    key ignored — the wire is StreamOrderUpdates with the reserved
+    audit client id). `from_seq` resumes after a disconnect: the server
     replays (from_seq, head] before live events. `on_gap(start, end,
     filled, missing)` fires per detected gap — the CLI prints loudly.
     """
 
-    def __init__(self, stub, channel: str, key: str, from_seq: int = 0,
+    def __init__(self, stub, channel: str, key: str = "", from_seq: int = 0,
                  conflate: bool = False, gap_fill: bool = True,
                  fill_timeout_s: float = 10.0, on_gap=None,
                  on_rebase=None, epoch: int = 0):
-        if channel not in (CHANNEL_MD, CHANNEL_OU):
+        if channel not in (CHANNEL_MD, CHANNEL_OU, CHANNEL_AUDIT):
             raise ValueError(f"unknown feed channel {channel!r}")
         if conflate and channel != CHANNEL_MD:
             raise ValueError("conflation is a market-data channel option")
@@ -88,8 +94,14 @@ class SequencedSubscriber:
                                       conflate=self.conflate,
                                       feed_epoch=self.epoch),
                 timeout=timeout)
+        if self.channel == CHANNEL_AUDIT:
+            from matching_engine_tpu.audit.dropcopy import AUDIT_CLIENT
+
+            key = AUDIT_CLIENT
+        else:
+            key = self.key
         return self.stub.StreamOrderUpdates(
-            pb2.OrderUpdatesRequest(client_id=self.key,
+            pb2.OrderUpdatesRequest(client_id=key,
                                     resume_from_seq=from_seq,
                                     feed_epoch=self.epoch),
             timeout=timeout)

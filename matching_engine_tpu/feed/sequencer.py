@@ -55,10 +55,18 @@ from collections import OrderedDict, deque
 
 from matching_engine_tpu.proto import pb2
 
-CHANNEL_MD = "md"   # keyed by symbol
-CHANNEL_OU = "ou"   # keyed by client_id
+CHANNEL_MD = "md"       # keyed by symbol
+CHANNEL_OU = "ou"       # keyed by client_id
+# Drop-copy audit stream (matching_engine_tpu/audit/): ONE venue-wide seq
+# domain (key "") so the whole lifecycle record stream is densely
+# sequenced — a gap is evidence of loss between decode and publish, the
+# exact failure class the online auditor exists to catch. Events are
+# OrderUpdate messages with audit_kind set.
+CHANNEL_AUDIT = "audit"
+AUDIT_DOMAIN_KEY = ""
 
-_EVENT_CLS = {CHANNEL_MD: pb2.MarketDataUpdate, CHANNEL_OU: pb2.OrderUpdate}
+_EVENT_CLS = {CHANNEL_MD: pb2.MarketDataUpdate, CHANNEL_OU: pb2.OrderUpdate,
+              CHANNEL_AUDIT: pb2.OrderUpdate}
 
 
 class RetransmissionRing:
@@ -246,6 +254,11 @@ class FeedSequencer:
         self._domains: OrderedDict[tuple[str, str], RetransmissionRing] = \
             OrderedDict()
         self._retired: dict[tuple[str, str], int] = {}  # -> next_seq
+        # The drop-copy audit domain (stamp_audit_rows): copy-on-replay
+        # chunks of (first_seq, rows, env, n), bounded at `depth` records.
+        self._audit_next = 1
+        self._audit_chunks: deque = deque()
+        self._audit_retained = 0
         self._published = 0  # global publish counter (feed_publish_seq)
         self._ready: list[tuple[_Spill, list]] = []  # detached, unqueued
         self._flush_q: queue.Queue = queue.Queue(maxsize=64)
@@ -325,6 +338,70 @@ class FeedSequencer:
         if self.metrics is not None:
             self.metrics.inc("feed_ou_published", len(updates))
 
+    def stamp_audit_rows(self, rows, env, n: int) -> int:
+        """Drop-copy records: one venue-wide domain (every serving lane
+        publishes into the same seq line through the hub lock, so the
+        audit stream is densely sequenced across lanes). Returns the
+        FIRST seq of the n-record run [first, first + n).
+
+        Unlike the md/ou channels, retention is COPY-ON-REPLAY: the ring
+        stores one (first_seq, rows, env) chunk per dispatch and replay
+        materializes the OrderUpdate protos on demand — the drop-copy
+        rides the drain loops' publish path, and building + stamping a
+        proto per record there is exactly the per-record python the
+        audit subsystem promises to keep off the hot path. Live
+        subscribers get materialized events from the hub (transient —
+        the ring never aliases subscriber queues). Consequence: the
+        audit window is memory-bounded at the feed depth in RECORDS;
+        --feed-spill-dir does not extend it."""
+        with self._lock:
+            first = self._audit_next
+            self._audit_next = first + n
+            self._audit_chunks.append((first, rows, env, n))
+            self._audit_retained += n
+            # Evict oldest dispatch-chunks past the depth (in RECORDS);
+            # the newest chunk always stays, however large.
+            while (self._audit_retained > self.depth
+                   and len(self._audit_chunks) > 1):
+                self._audit_retained -= self._audit_chunks.popleft()[3]
+            self._published += n
+            if self.metrics is not None:
+                self.metrics.set_gauge("feed_publish_seq", self._published)
+                self.metrics.inc("feed_audit_published", n)
+        return first
+
+    def _audit_materialize(self, chunk, lo: int, hi: int) -> list:
+        """Protos for the chunk's records with lo <= seq <= hi (replay
+        and gap-fill) — the SAME materializer the hub's live fan-out
+        uses, so replayed bytes == live bytes by construction."""
+        from matching_engine_tpu.audit.dropcopy import materialize_chunk
+
+        first, rows, env, n = chunk
+        return materialize_chunk(rows, env, first, self.epoch, lo=lo, hi=hi)
+
+    def _audit_last_seq(self) -> int:
+        return self._audit_next - 1
+
+    def _audit_replay(self, from_seq: int, to_seq: int | None) -> tuple:
+        with self._lock:
+            hi = self._audit_next - 1 if to_seq is None \
+                else min(to_seq, self._audit_next - 1)
+            chunks = [c for c in self._audit_chunks
+                      if c[0] <= hi and c[0] + c[3] > from_seq + 1]
+            if self.metrics is not None:
+                self.metrics.inc("feed_retransmit_requests")
+        events: list = []
+        for c in chunks:  # materialize OUTSIDE the lock (python-proto work)
+            events.extend(self._audit_materialize(c, from_seq + 1, hi))
+        missed = max(0, (hi - from_seq) - len(events)) if hi > from_seq \
+            else 0
+        if self.metrics is not None:
+            if events:
+                self.metrics.inc("feed_retransmit_events", len(events))
+            if missed:
+                self.metrics.inc("feed_retransmit_misses", missed)
+        return events, missed
+
     # -- spill flusher -----------------------------------------------------
 
     def _enqueue_segment(self, spill: _Spill, rows) -> None:
@@ -370,6 +447,9 @@ class FeedSequencer:
     # -- read path ---------------------------------------------------------
 
     def last_seq(self, channel: str, key: str) -> int:
+        if channel == CHANNEL_AUDIT:
+            with self._lock:
+                return self._audit_last_seq()
         with self._lock:
             dom = self._domains.get((channel, key))
             if dom is not None:
@@ -382,7 +462,10 @@ class FeedSequencer:
         first. Returns (events, missed): `missed` counts requested seqs
         already evicted past the spill window — the unrecoverable-
         server-side signal (feed_retransmit_misses). Disk reads happen
-        after the lock is released."""
+        after the lock is released. The audit domain materializes its
+        copy-on-replay chunks here (no spill; memory-bounded window)."""
+        if channel == CHANNEL_AUDIT:
+            return self._audit_replay(from_seq, to_seq)
         cls = _EVENT_CLS[channel]
         with self._lock:
             if self.metrics is not None:

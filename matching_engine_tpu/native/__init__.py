@@ -905,30 +905,62 @@ def parse_lane_aux(buf: bytes) -> dict:
     return out
 
 
+# unpack_store_buf's precompiled row tails (a _Rd method call per field
+# costs ~7us/row in pure python; with --audit the drop-copy publisher
+# unpacks every native dispatch's rows on the drain loop's publish path,
+# so the parse runs one Struct per row instead).
+_ST_U32 = struct.Struct("<I")
+_ST_STR = struct.Struct("<H")
+_ST_ORDER_TAIL = struct.Struct("<BBBqqqB")   # side otype has_price p q r st
+_ST_UPDATE_TAIL = struct.Struct("<BqBq")     # status remaining has_qty qty
+_ST_FILL_TAIL = struct.Struct("<qqq")        # price qty ts
+
+
 def unpack_store_buf(buf: bytes):
     """store_buf -> the (orders, updates, fills) triple pack_batch packs —
-    the Python-sink fallback and the storage-row parity check."""
+    the Python-sink fallback, the storage-row parity check, and the
+    --audit drop-copy source on the native path."""
     from matching_engine_tpu.storage.storage import FillRow
 
-    r = _Rd(buf)
+    o = 0
+    u32, uS = _ST_U32.unpack_from, _ST_STR.unpack_from
+
+    def rs(o: int) -> tuple[str, int]:
+        (n,) = uS(buf, o)
+        o += 2
+        return buf[o:o + n].decode(), o + n
+
+    (n,) = u32(buf, o)
+    o += 4
     orders = []
-    for _ in range(r.u32()):
-        oid, cid, sym = r.s().decode(), r.s().decode(), r.s().decode()
-        side, otype, has_price = r.u8(), r.u8(), r.u8()
-        price, qty, remaining = r.i64(), r.i64(), r.i64()
-        status = r.u8()
+    tail, tail_sz = _ST_ORDER_TAIL.unpack_from, _ST_ORDER_TAIL.size
+    for _ in range(n):
+        oid, o = rs(o)
+        cid, o = rs(o)
+        sym, o = rs(o)
+        side, otype, has_price, price, qty, remaining, status = tail(buf, o)
+        o += tail_sz
         orders.append((oid, cid, sym, side, otype,
                        price if has_price else None, qty, remaining, status))
+    (n,) = u32(buf, o)
+    o += 4
     updates = []
-    for _ in range(r.u32()):
-        oid = r.s().decode()
-        status, remaining, has_qty, qty = r.u8(), r.i64(), r.u8(), r.i64()
+    tail, tail_sz = _ST_UPDATE_TAIL.unpack_from, _ST_UPDATE_TAIL.size
+    for _ in range(n):
+        oid, o = rs(o)
+        status, remaining, has_qty, qty = tail(buf, o)
+        o += tail_sz
         updates.append((oid, status, remaining, qty) if has_qty
                        else (oid, status, remaining))
+    (n,) = u32(buf, o)
+    o += 4
     fills = []
-    for _ in range(r.u32()):
-        oid, coid = r.s().decode(), r.s().decode()
-        price, qty, ts = r.i64(), r.i64(), r.i64()
+    tail, tail_sz = _ST_FILL_TAIL.unpack_from, _ST_FILL_TAIL.size
+    for _ in range(n):
+        oid, o = rs(o)
+        coid, o = rs(o)
+        price, qty, ts = tail(buf, o)
+        o += tail_sz
         fills.append(FillRow(oid, coid, price, qty, ts))
     return orders, updates, fills
 

@@ -75,6 +75,24 @@ def spin_result(fut: Future, timeout_s: float, spin_s: float):
     return fut.result(timeout=timeout_s)
 
 
+def _oid_span(order_ids) -> tuple[int, int] | None:
+    """(lo, hi) numeric order-id range over an id iterable — the failure
+    paths stamp WHICH orders a suppressed sink/hub error window touched,
+    so a post-mortem can bound the blast radius. Error-path only; never
+    on the hot path."""
+    lo = hi = None
+    for oid in order_ids:
+        if not oid or not oid.startswith("OID-"):
+            continue
+        try:
+            n = int(oid[4:])
+        except ValueError:
+            continue
+        lo = n if lo is None else min(lo, n)
+        hi = n if hi is None else max(hi, n)
+    return None if lo is None else (lo, hi)
+
+
 def publish_result(result, sink, hub, metrics) -> None:
     """Enqueue one dispatch's storage/stream events. Shared by every drain
     loop (BatchDispatcher and GatewayBridge): a sink/hub failure must never
@@ -99,11 +117,15 @@ def publish_result(result, sink, hub, metrics) -> None:
     except Exception as e:  # noqa: BLE001
         # Counted at batch rate (me_sink_publish_errors_total is the alert
         # signal); logged at human rate — a flapping sink fails every
-        # drain and must not spam stdout at batch frequency.
+        # drain and must not spam stdout at batch frequency. The oid span
+        # accumulates across the suppressed window.
         metrics.inc("sink_publish_errors")
         warn_rate_limited(
             "dispatcher-sink",
-            f"[dispatcher] sink/hub error: {type(e).__name__}: {e}")
+            f"[dispatcher] sink/hub error: {type(e).__name__}: {e}",
+            oid_span=_oid_span(
+                [r[0] for r in result.storage_orders]
+                + [r[0] for r in result.storage_updates]))
 
 
 class BatchDispatcher:
@@ -121,10 +143,15 @@ class BatchDispatcher:
         mega_max_waves: int = 1,
         mega_latency_us: float = 5000.0,
         busy_poll_us: float = 0.0,
+        dropcopy=None,
     ):
         self.runner = runner
         self.sink = sink
         self.hub = hub
+        # --audit: per-lane drop-copy publisher (audit/dropcopy.py) —
+        # publishes one lifecycle record per storage event at the decode
+        # boundary and feeds the in-process auditor. None = off.
+        self.dropcopy = dropcopy
         self.window_s = window_ms / 1e3
         # --busy-poll-us: spin this long before every condvar wait on the
         # drain loop (spin_get) and, via the service reading this attr,
@@ -297,6 +324,13 @@ class BatchDispatcher:
                             fut.set_exception(error)
                     self.metrics.inc("dispatch_errors")
                 return fail
+            if self.dropcopy is not None:
+                # BEFORE the sink sees the row lists: the sink's
+                # coalescing thread extends the first queued batch's
+                # lists in place, and the drop-copy snapshot must be of
+                # THIS dispatch's rows only. (Also before the publish
+                # stamp — the enqueue is stream-publish work.)
+                self.dropcopy.publish(result, tl)
             self._publish(result)
             tl.stamp_publish()
             tl.finish(self.metrics)
@@ -440,6 +474,7 @@ class LaneRingDispatcher:
         ring_capacity: int = 1 << 16,
         busy_poll_us: float = 0.0,
         mega_max_waves: int = 1,
+        dropcopy=None,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -448,6 +483,7 @@ class LaneRingDispatcher:
         self.runner = runner
         self.sink = sink
         self.hub = hub
+        self.dropcopy = dropcopy  # --audit drop-copy publisher | None
         # The drain's batching window runs inside the native ring pop, so
         # busy-poll on this path covers the RPC threads' completion wait
         # only (the service reads this attr for spin_result).
@@ -599,6 +635,10 @@ class LaneRingDispatcher:
                         self.metrics.set_gauge("inflight_ops",
                                                len(self._tags))
                     return fail
+                if self.dropcopy is not None:
+                    # Before the sink (store_buf is immutable, but keep
+                    # one ordering rule across paths).
+                    self.dropcopy.publish(result, tl)
                 publish_native_result(result, self.sink, self.hub,
                                       self.metrics)
                 tl.stamp_publish()
@@ -666,6 +706,7 @@ class NativeRingDispatcher(BatchDispatcher):
         mega_max_waves: int = 1,
         mega_latency_us: float = 5000.0,
         busy_poll_us: float = 0.0,
+        dropcopy=None,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -687,7 +728,7 @@ class NativeRingDispatcher(BatchDispatcher):
         super().__init__(runner, sink, hub, window_ms, max_batch, metrics,
                          mega_max_waves=mega_max_waves,
                          mega_latency_us=mega_latency_us,
-                         busy_poll_us=busy_poll_us)
+                         busy_poll_us=busy_poll_us, dropcopy=dropcopy)
 
     def submit(self, op: EngineOp, t_ingress: float | None = None) -> Future:
         fut: Future = Future()

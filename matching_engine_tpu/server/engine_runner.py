@@ -301,6 +301,10 @@ class EngineRunner:
         # no subscriber exists — the common serving case. None = always
         # build (library/test use reads DispatchResult directly).
         self.hub = hub
+        # --audit drop-copy publisher (audit/dropcopy.py), wired by
+        # build_server: auctions publish their fills/updates through it
+        # too, and the gateway bridge reads it per routed lane.
+        self.dropcopy = None
 
     def place_book(self, host_book) -> None:
         """Install a host-side BookBatch as the live device book, honoring
@@ -1080,6 +1084,12 @@ class EngineRunner:
         for info in list(touched.values()):
             if info.remaining == 0:
                 self._evict(info)
+        if self.dropcopy is not None:
+            # Auction executions are lifecycle events like any other:
+            # the uncross's fills/updates ride the same drop-copy line
+            # (no timeline — auctions are control-plane dispatches).
+            # Before the sink sees the row lists (snapshot rule).
+            self.dropcopy.publish(res, timeline=None, shape="auction")
         publish_result(res, sink, self.hub, self.metrics)
         self.metrics.inc("auctions")
         self.metrics.inc("auction_fills", len(fills))

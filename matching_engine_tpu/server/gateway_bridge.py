@@ -268,6 +268,9 @@ class GatewayBridge:
                     self._fail_records(recs, n)
                 return fail
             t_pub = time.perf_counter()
+            dc = getattr(self.runner, "dropcopy", None)
+            if dc is not None:
+                dc.publish(result, tl)
             publish_native_result(result, self.sink, self.hub, self.metrics)
             self.metrics.ema_gauge(
                 "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)
@@ -440,6 +443,13 @@ class GatewayBridge:
                             )
                 return fail
             t_pub = time.perf_counter()
+            dc = getattr(runner, "dropcopy", None)
+            if dc is not None:
+                # The GROUP's lane publisher (its runner carries the
+                # auction-mode context the crossed-book check needs),
+                # BEFORE the sink sees — and may coalesce-extend — the
+                # row lists the drop-copy snapshots.
+                dc.publish(result, tl)
             self._publish(result)
             self.metrics.ema_gauge(
                 "bridge_publish_us", (time.perf_counter() - t_pub) * 1e6)

@@ -151,6 +151,8 @@ def build_server(
     proto_reuse: bool = False,
     trace_dir: str | None = None,
     trace_sample_every: int = 64,
+    audit: bool = False,
+    audit_sample: int = 8,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -239,6 +241,43 @@ def build_server(
                                   spill_dir=feed_spill_dir)
     hub = StreamHub(maxsize=stream_maxsize, metrics=metrics,
                     sequencer=sequencer)
+    # Online surveillance (--audit, matching_engine_tpu/audit/): a
+    # per-lane DropCopyPublisher republishes every dispatch's storage
+    # rows as sequenced lifecycle records at the decode boundary, and ONE
+    # shared InvariantAuditor consumes them in-process — proving
+    # continuously that book, store, and feed agree. With the feed
+    # disabled the records still publish/audit, just unsequenced (the
+    # seq-continuity invariant is then vacuous and replay unavailable).
+    auditor = None
+    audit_pump = None
+    if audit:
+        from matching_engine_tpu.audit import AuditPump, InvariantAuditor
+
+        # The pump is one more pure-python thread alternating with the
+        # drain loops' GIL-released native/device calls; at the default
+        # 5ms switch interval a drain thread returning from C convoys
+        # behind the pump's whole quantum (the --serve-shards lesson).
+        sys.setswitchinterval(min(sys.getswitchinterval(), 500 / 1e6))
+
+        if sequencer is None:
+            print("[SERVER] WARNING: --audit without the sequenced feed "
+                  "(--feed-depth 0): drop-copy records are unsequenced — "
+                  "loss between decode and publish is undetectable and "
+                  "resume/replay is unavailable")
+        auditor = InvariantAuditor(metrics, sample=audit_sample,
+                                   db_path=db_path)
+        # One out-of-band worker for all lanes: enqueue order (each
+        # lane's decode order, interleaved) is the audit stamp order.
+        audit_pump = AuditPump(metrics)
+
+    def make_dropcopy(r):
+        if auditor is None:
+            return None
+        from matching_engine_tpu.audit import DropCopyPublisher
+
+        r.dropcopy = DropCopyPublisher(hub, metrics, auditor=auditor,
+                                       runner=r, pump=audit_pump)
+        return r.dropcopy
 
     def make_runner():
         if native_lanes:
@@ -304,6 +343,15 @@ def build_server(
         runner = _boot_runner(make_runner, storage, owner_rows,
                               checkpoint_dir, log)
         runners = [runner]
+    if auditor is not None:
+        # Orders recovered/replayed at boot predate the drop-copy stream:
+        # ids below the floor are exempt from shadow tracking (a fill
+        # against one is pre-boot state, not corruption). Per residue
+        # class — strided lanes recover unequal counts, and one global
+        # max would exempt the other lanes' genuinely new ids.
+        auditor.set_oid_floors(
+            [(r.next_oid_num, r.oid_offset, r.oid_stride)
+             for r in runners])
     # Restore a persisted call period (each host records its own flag in
     # its durable store — crossedness alone can't prove the ABSENCE of a
     # call period, e.g. non-crossing rests only).
@@ -364,10 +412,17 @@ def build_server(
 
     use_native = native and me_native.available()
     if use_native:
-        # C++ writer: stage_sink_commit_us is a python-sink figure only.
+        # C++ writer: stage_sink_commit_us is a python-sink figure only
+        # (and the auditor's store probes run on their dispatch-count
+        # cadence — no commit hook to ride).
         sink = me_native.NativeStorageSink(db_path)
     else:
-        sink = AsyncStorageSink(storage, metrics=metrics)
+        sink = AsyncStorageSink(
+            storage, metrics=metrics,
+            # --audit: store<->feed probes ride each commit, on the sink
+            # thread, where the rows just became readable.
+            on_commit=auditor.notify_commit if auditor is not None
+            else None)
     # Order-preserving overflow buffer: a full sink queue defers batches
     # instead of dropping them; the checkpoint flush barrier drains it.
     from matching_engine_tpu.storage.async_sink import SpillingSink
@@ -401,7 +456,8 @@ def build_server(
                 native_lanes=native_lanes,
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
-                busy_poll_us=busy_poll_us)
+                busy_poll_us=busy_poll_us,
+                dropcopy=make_dropcopy(lane.runner))
         shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
         dispatcher = lanes[0].dispatcher
     else:
@@ -425,6 +481,7 @@ def build_server(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
                 busy_poll_us=busy_poll_us,
                 mega_max_waves=megadispatch_max_waves,
+                dropcopy=make_dropcopy(runner),
             )
         elif use_native:
             dispatcher = NativeRingDispatcher(
@@ -432,13 +489,15 @@ def build_server(
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
                 busy_poll_us=busy_poll_us,
+                dropcopy=make_dropcopy(runner),
             )
         else:
             dispatcher = BatchDispatcher(
                 runner, sink=sink, hub=hub, window_ms=window_ms,
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
-                busy_poll_us=busy_poll_us)
+                busy_poll_us=busy_poll_us,
+                dropcopy=make_dropcopy(runner))
     if log:
         layer = ("native lanes (C++ build+decode)" if native_lanes
                  else "native (C++)" if use_native else "python")
@@ -497,6 +556,7 @@ def build_server(
         "checkpointers": checkpointers, "shards": shards,
         "bridge": bridge, "gateway_port": gateway_port,
         "recorder": recorder, "sequencer": sequencer, "tracer": tracer,
+        "auditor": auditor, "audit_pump": audit_pump,
     }
     return server, port, parts
 
@@ -528,6 +588,16 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
             print(f"[SERVER] final checkpoint failed: {type(e).__name__}: {e}")
         ckpt.close()
     parts["sink"].close()
+    if parts.get("audit_pump") is not None:
+        # Drain the out-of-band surveillance queue BEFORE the final
+        # store check: every dispatch's records must be audited.
+        parts["audit_pump"].close()
+    if parts.get("auditor") is not None:
+        # The sink is flushed and closed: every probe the auditor still
+        # holds must resolve strictly NOW — an order that never reached
+        # the store is a finding, not lag.
+        parts["auditor"].final_store_check()
+        parts["auditor"].close()
     parts["storage"].close()
     if parts.get("tracer") is not None:
         # After the sink: its commit spans land before the finalize.
@@ -701,6 +771,26 @@ def main(argv=None) -> int:
     p.add_argument("--gateway-addr", default=None, metavar="HOST:PORT",
                    help="also serve through the C++ gRPC gateway on this "
                         "address (port 0 = OS-assigned)")
+    p.add_argument("--audit", action="store_true",
+                   help="online surveillance (matching_engine_tpu/audit/): "
+                        "publish a sequenced drop-copy record per order "
+                        "lifecycle event at the decode boundary (consume "
+                        "via `client audit` or StreamOrderUpdates with "
+                        "the reserved __dropcopy__ client id) and run the "
+                        "in-process InvariantAuditor over them — legal "
+                        "transitions, quantity conservation, fill "
+                        "symmetry, seq continuity, crossed-TOB sanity, "
+                        "sampled store<->feed equality. First violation "
+                        "flight-dumps with the offending record; "
+                        "me_audit_violations_total counts; /auditz turns "
+                        "red (while /readyz stays up)")
+    p.add_argument("--audit-sample", type=int, default=8, metavar="N",
+                   help="audit cost bound: full shadow-state tracking for "
+                        "a deterministic 1-in-N order subset (hash of "
+                        "the OID number); the cheap per-record, seq, and "
+                        "crossed-book invariants always run for ALL "
+                        "orders. 1 = shadow everything (corruption "
+                        "soaks/tests; default 8)")
     p.add_argument("--auction-open", action="store_true",
                    help="boot in call-auction accumulation: submits REST "
                         "without matching until a RunAuction uncross opens "
@@ -778,6 +868,8 @@ def main(argv=None) -> int:
             proto_reuse=args.proto_reuse,
             trace_dir=args.trace_dir,
             trace_sample_every=args.trace_sample,
+            audit=args.audit,
+            audit_sample=args.audit_sample,
         )
     except SystemExit as e:
         return int(e.code or 3)
@@ -814,6 +906,7 @@ def main(argv=None) -> int:
                     parts["metrics"], recorder=parts["recorder"],
                     ready_fn=lambda: not stop_evt.is_set(),  # 503 in drain
                     port=args.metrics_port, host=args.metrics_host,
+                    auditor=parts["auditor"],
                 )
             except OSError as e:
                 # Bind failures land AFTER the gRPC edges went live; the

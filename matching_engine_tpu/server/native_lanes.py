@@ -105,13 +105,19 @@ def publish_native_result(result: NativeDispatchResult, sink, hub,
             hub.publish_market_data(result.market_data)
     except Exception as e:  # noqa: BLE001 — a sink/hub failure must never
         # strand the batch's completions or kill the drain loop. Counter
-        # at batch rate, log line rate-limited (see dispatcher twin).
+        # at batch rate, log line rate-limited (see dispatcher twin). The
+        # oid span comes from the dispatch's local completions (already
+        # parsed — unpacking store_buf on the failure path would do the
+        # work the error may stem from); it accumulates across the
+        # suppressed window so the printed line bounds the blast radius.
+        from matching_engine_tpu.server.dispatcher import _oid_span
         from matching_engine_tpu.utils.obs import warn_rate_limited
 
         metrics.inc("sink_publish_errors")
         warn_rate_limited(
             "native-lanes-sink",
-            f"[native-lanes] sink/hub error: {type(e).__name__}: {e}")
+            f"[native-lanes] sink/hub error: {type(e).__name__}: {e}",
+            oid_span=_oid_span([loc[4] or "" for loc in result.local]))
 
 
 class NativeLanesRunner(EngineRunner):

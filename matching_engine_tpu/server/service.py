@@ -25,8 +25,14 @@ import time
 
 import grpc
 
+from matching_engine_tpu.audit.dropcopy import AUDIT_CLIENT
 from matching_engine_tpu.domain import normalize_to_q4, validate_submit
-from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
+from matching_engine_tpu.feed.sequencer import (
+    AUDIT_DOMAIN_KEY,
+    CHANNEL_AUDIT,
+    CHANNEL_MD,
+    CHANNEL_OU,
+)
 from matching_engine_tpu.engine.kernel import (
     CANCELED,
     NEW,
@@ -981,11 +987,21 @@ class MatchingEngineService(MatchingEngineServicer):
             self.hub.unsubscribe(sub)
 
     def StreamOrderUpdates(self, request, context):
-        self.metrics.inc("rpc_stream_ou")
-        sub = self.hub.subscribe_order_updates(request.client_id)
+        if request.client_id == AUDIT_CLIENT:
+            # Drop-copy tap: the reserved client id subscribes to the
+            # venue-wide audit channel (lifecycle records for EVERY
+            # order) — replay/resume/gap-fill work exactly like any
+            # sequenced channel, same RPC surface.
+            self.metrics.inc("rpc_stream_audit")
+            sub = self.hub.subscribe_audit()
+            channel, key = CHANNEL_AUDIT, AUDIT_DOMAIN_KEY
+        else:
+            self.metrics.inc("rpc_stream_ou")
+            sub = self.hub.subscribe_order_updates(request.client_id)
+            channel, key = CHANNEL_OU, request.client_id
         try:
             yield from self._sequenced_stream(
-                sub, CHANNEL_OU, request.client_id, request.resume_from_seq,
+                sub, channel, key, request.resume_from_seq,
                 request.feed_epoch, context)
         finally:
             self.hub.unsubscribe(sub)

@@ -323,7 +323,8 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
                          native: bool = False, native_lanes: bool = False,
                          mega_max_waves: int = 1,
                          mega_latency_us: float = 5000.0,
-                         busy_poll_us: float = 0.0):
+                         busy_poll_us: float = 0.0,
+                         dropcopy=None):
     """One lane's dispatcher (its own ring + drain thread). Each lane
     runs its own megadispatch coalescing controller over its own queue
     (the decision is a per-lane queue-depth function; a venue-wide M
@@ -340,17 +341,19 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
         return LaneRingDispatcher(runner, sink=sink, hub=hub,
                                   window_ms=window_ms, metrics=metrics,
                                   busy_poll_us=busy_poll_us,
-                                  mega_max_waves=mega_max_waves)
+                                  mega_max_waves=mega_max_waves,
+                                  dropcopy=dropcopy)
     if native:
         return NativeRingDispatcher(runner, sink=sink, hub=hub,
                                     window_ms=window_ms, metrics=metrics,
                                     mega_max_waves=mega_max_waves,
                                     mega_latency_us=mega_latency_us,
-                                    busy_poll_us=busy_poll_us)
+                                    busy_poll_us=busy_poll_us,
+                                    dropcopy=dropcopy)
     return BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms,
                            metrics=metrics, mega_max_waves=mega_max_waves,
                            mega_latency_us=mega_latency_us,
-                           busy_poll_us=busy_poll_us)
+                           busy_poll_us=busy_poll_us, dropcopy=dropcopy)
 
 
 def build_serving_shards(

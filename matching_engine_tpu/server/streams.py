@@ -38,7 +38,12 @@ import queue
 import threading
 import time
 
-from matching_engine_tpu.feed.sequencer import CHANNEL_MD, CHANNEL_OU
+from matching_engine_tpu.feed.sequencer import (
+    AUDIT_DOMAIN_KEY,
+    CHANNEL_AUDIT,
+    CHANNEL_MD,
+    CHANNEL_OU,
+)
 from matching_engine_tpu.proto import pb2
 
 _SENTINEL = object()
@@ -137,6 +142,7 @@ class StreamHub:
         self.sequencer = sequencer  # feed.FeedSequencer | None
         self._md_subs: dict[str, list[_Subscription]] = {}      # symbol ->
         self._ou_subs: dict[str, list[_Subscription]] = {}      # client_id ->
+        self._audit_subs: list[_Subscription] = []              # drop-copy
 
     # -- subscription management ------------------------------------------
 
@@ -172,6 +178,17 @@ class StreamHub:
             self._ou_subs.setdefault(client_id, []).append(sub)
         return sub
 
+    def subscribe_audit(self) -> _Subscription:
+        """Attach to the drop-copy audit channel (every lifecycle record
+        from every symbol/client — the venue-wide surveillance tap)."""
+        sub = _Subscription(self._maxsize, self._metrics)
+        if self.sequencer is not None:
+            sub.last_seq = self.sequencer.last_seq(CHANNEL_AUDIT,
+                                                   AUDIT_DOMAIN_KEY)
+        with self._lock:
+            self._audit_subs.append(sub)
+        return sub
+
     def unsubscribe(self, sub: _Subscription) -> None:
         with self._lock:
             for table in (self._md_subs, self._ou_subs):
@@ -180,6 +197,8 @@ class StreamHub:
                         subs.remove(sub)
                         if not subs:
                             del table[key]
+            if sub in self._audit_subs:
+                self._audit_subs.remove(sub)
         sub.close()
 
     # -- publication (called from the dispatcher thread) -------------------
@@ -220,6 +239,56 @@ class StreamHub:
             self._update_lag_locked(CHANNEL_OU,
                                     {u.client_id for u in updates})
 
+    def publish_audit_rows(self, rows, env, n: int, drop=None,
+                           observer=None) -> list[int]:
+        """Stamp + (when tapped) fan out one dispatch's drop-copy rows.
+        Same stamp/fan-out atomicity as the other publish_* paths (the
+        audit seq line interleaves every serving lane's dispatches in
+        stamp order) — but the retained form is the ROW CHUNK, not
+        per-record protos: wire events materialize only for live
+        subscribers here and for replay in the sequencer
+        (copy-on-replay), so the subscriber-less steady state pays no
+        per-record proto work on the publish path.
+
+        `drop` (a flat record index) is the fault-injection seam: the
+        record is STAMPED/retained but not delivered — exactly the
+        "event lost between decode and publish" corruption the
+        auditor's seq-continuity invariant exists to catch.
+        `observer(seqs)` runs INSIDE the hub lock with the delivered
+        seq list: the in-process auditor must consume batches in stamp
+        order, and with K serving lanes publishing concurrently an
+        out-of-lock feed would interleave (reading as spurious seq
+        gaps). The auditor's own lock nests inside the hub lock, same
+        as the sequencer's. Returns the delivered seqs (all zero when
+        the feed is disabled)."""
+        if n == 0:
+            if observer is not None:
+                with self._lock:
+                    observer([])
+            return []
+        with self._lock:
+            if self.sequencer is not None:
+                first = self.sequencer.stamp_audit_rows(rows, env, n)
+                seqs = [first + i for i in range(n) if i != drop]
+            else:
+                first = 0
+                seqs = [0] * (n - (1 if drop is not None else 0))
+            if self._audit_subs:
+                from matching_engine_tpu.audit.dropcopy import (
+                    materialize_chunk,
+                )
+
+                events = materialize_chunk(
+                    rows, env, first,
+                    self.sequencer.epoch if self.sequencer else 0,
+                    skip=drop)
+                for e in events:
+                    for sub in self._audit_subs:
+                        sub.offer(e)
+            if observer is not None:
+                observer(seqs)
+        return seqs
+
     def _update_lag_locked(self, channel: str, keys) -> None:
         """feed_subscriber_lag_max: worst (domain head − last yielded seq)
         across subscribers of the keys THIS batch touched — the
@@ -247,7 +316,9 @@ class StreamHub:
         with self._lock:
             subs = [s for v in self._md_subs.values() for s in v]
             subs += [s for v in self._ou_subs.values() for s in v]
+            subs += list(self._audit_subs)
             self._md_subs.clear()
             self._ou_subs.clear()
+            self._audit_subs.clear()
         for s in subs:
             s.close()

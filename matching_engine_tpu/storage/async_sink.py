@@ -139,9 +139,14 @@ class SpillingSink:
 
 class AsyncStorageSink:
     def __init__(self, storage: Storage, max_queue: int = 4096,
-                 metrics=None):
+                 metrics=None, on_commit=None):
         self._storage = storage
         self._metrics = metrics  # stage_sink_commit_us + sink_queue_depth
+        # Commit notification (--audit): fired after each batch's WAL txn
+        # lands, ON THIS SINK THREAD — the InvariantAuditor runs its
+        # store<->feed probes here, where rows are freshest and the
+        # probe's SQLite read can never sit on a dispatch path.
+        self._on_commit = on_commit
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="storage-sink", daemon=True)
@@ -187,6 +192,13 @@ class AsyncStorageSink:
 
         t0 = time.perf_counter()
         self._storage.apply_batch(orders, updates, fills)
+        if self._on_commit is not None:
+            try:
+                self._on_commit()
+            except Exception as e:  # noqa: BLE001 — surveillance must
+                # never take the durable writer down with it.
+                print(f"[sink] on_commit hook failed: "
+                      f"{type(e).__name__}: {e}")
         if self._metrics is not None:
             t1 = time.perf_counter()
             self._metrics.observe(STAGE_SINK_COMMIT, (t1 - t0) * 1e6)

@@ -188,22 +188,38 @@ class DispatchTimeline:
 _warn_lock = threading.Lock()
 _warn_last: dict[str, float] = {}
 _warn_suppressed: dict[str, int] = {}
+_warn_span: dict[str, tuple[int, int]] = {}
 
 
-def warn_rate_limited(key: str, msg: str, interval_s: float = 5.0) -> None:
+def warn_rate_limited(key: str, msg: str, interval_s: float = 5.0,
+                      oid_span: tuple[int, int] | None = None) -> None:
     """Print `msg` at most once per `interval_s` per `key`, with a count
     of the lines suppressed in between. A flapping sink/hub fails at
     BATCH rate — per-failure print() would melt stdout exactly when the
-    operator needs it; the paired `me_` counter carries the true rate."""
+    operator needs it; the paired `me_` counter carries the true rate.
+
+    `oid_span` (lo, hi order-id numbers touched by this failure) is
+    ACCUMULATED across suppressed calls and printed with the next
+    emitted line, so a post-mortem can bound the blast radius of the
+    whole suppressed window — not just the one batch that happened to
+    print."""
     now = time.monotonic()
     with _warn_lock:
+        if oid_span is not None:
+            prev = _warn_span.get(key)
+            _warn_span[key] = (oid_span if prev is None else
+                               (min(prev[0], oid_span[0]),
+                                max(prev[1], oid_span[1])))
         last = _warn_last.get(key, 0.0)
         if now - last < interval_s:
             _warn_suppressed[key] = _warn_suppressed.get(key, 0) + 1
             return
         suppressed = _warn_suppressed.pop(key, 0)
+        span = _warn_span.pop(key, None)
         _warn_last[key] = now
     tail = f" (+{suppressed} suppressed)" if suppressed else ""
+    if span is not None:
+        tail += f" (orders OID-{span[0]}..OID-{span[1]} affected)"
     print(f"{msg}{tail}")
 
 
@@ -670,19 +686,27 @@ class ObsServer:
       GET /healthz         200 while the process serves requests
       GET /readyz          200 once serving, 503 during shutdown
       GET /flightrecorder  JSON snapshot of the flight-recorder ring
+      GET /auditz          online-surveillance verdict (--audit): 200 +
+                           JSON while every invariant holds, 500 + the
+                           violation summary once any fired — /readyz
+                           deliberately stays green (a red audit means
+                           INVESTIGATE, not drop traffic), 404 with the
+                           auditor off
 
     No third-party exporter dependency: the container must not need a
     pip install to be scrapable.
     """
 
     def __init__(self, metrics, recorder: FlightRecorder | None = None,
-                 ready_fn=None, port: int = 0, host: str = "127.0.0.1"):
+                 ready_fn=None, port: int = 0, host: str = "127.0.0.1",
+                 auditor=None):
         # Loopback by default: /flightrecorder exposes internal dispatch
         # detail — exporting to a scrape network is an explicit choice
         # (--metrics-host 0.0.0.0), not a side effect of enabling metrics.
         self.metrics = metrics
         self.recorder = recorder
         self.ready_fn = ready_fn or (lambda: True)
+        self.auditor = auditor  # audit.InvariantAuditor | None
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -715,6 +739,16 @@ class ObsServer:
                                    if obs.recorder is not None else [])
                         self._send(200, json.dumps(entries).encode(),
                                    "application/json")
+                    elif path == "/auditz":
+                        if obs.auditor is None:
+                            self._send(404, b"auditor disabled\n",
+                                       "text/plain")
+                        else:
+                            snap = obs.auditor.snapshot()
+                            self._send(
+                                200 if snap["ok"] else 500,
+                                json.dumps(snap).encode(),
+                                "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except (BrokenPipeError, ConnectionResetError):

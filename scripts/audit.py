@@ -9,10 +9,20 @@ This tool checks the arithmetic the schema implies, per order:
   CANCELED/REJECTED orders hold no remainder liability, NEW/PARTIAL rest)
   every fill references two known orders on opposite sides
 
+With `--dropcopy FILE` (a JSON-lines capture from `client audit
+--capture`, taken from seq 1 over the store's whole life), the audit
+additionally cross-checks the FEED against the DB — the same invariant
+vocabulary the online InvariantAuditor uses, applied offline:
+
+  every fill in the store appears in the drop-copy and vice versa
+  (order_id/counter_order_id/price/quantity multisets are equal)
+  every order's final (status, remaining, quantity) per the drop-copy's
+  last record equals its store row, and the order sets are equal
+
 Exit 0 and a JSON summary line when clean; exit 1 with per-order violation
 lines otherwise.
 
-Usage: python scripts/audit.py <db_path>
+Usage: python scripts/audit.py <db_path> [--dropcopy FILE]
 """
 
 from __future__ import annotations
@@ -24,7 +34,7 @@ import sys
 NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = range(5)
 
 
-def audit(db_path: str) -> list[str]:
+def audit(db_path: str, summary_out: dict | None = None) -> list[str]:
     conn = sqlite3.connect(db_path)
     orders = {
         row[0]: {"client": row[1], "symbol": row[2], "side": row[3],
@@ -111,15 +121,126 @@ def audit(db_path: str) -> list[str]:
         "fills": len(fills),
         "violations": len(problems),
     }
-    print(json.dumps(summary))
+    if summary_out is None:
+        print(json.dumps(summary))
+    else:  # --dropcopy mode merges everything into ONE summary line
+        summary_out.update(summary)
+    return problems
+
+
+def _load_dropcopy(path: str):
+    """Replay a capture's records (in seq/line order) into the final
+    per-order view + the fills multiset — the offline twin of the online
+    auditor's shadow state."""
+    orders: dict[str, dict] = {}
+    fills: list[tuple] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            kind = r.get("kind")
+            if kind == "order":
+                orders[r["order_id"]] = {
+                    "status": r["status"], "remaining": r["remaining"],
+                    "qty": r["quantity"], "client": r.get("client_id", ""),
+                    "symbol": r.get("symbol", ""), "side": r.get("side", 0),
+                }
+            elif kind == "update":
+                o = orders.get(r["order_id"])
+                if o is None:
+                    continue  # pre-capture order: can't be cross-checked
+                o["status"] = r["status"]
+                o["remaining"] = r["remaining"]
+                if r.get("quantity"):  # amend carries the reduced quantity
+                    o["qty"] = r["quantity"]
+            elif kind == "fill":
+                fills.append((r["order_id"], r["counter_order_id"],
+                              r["fill_price"], r["fill_quantity"]))
+    return orders, fills
+
+
+def cross_check_dropcopy(db_path: str, capture_path: str,
+                         summary_out: dict | None = None) -> list[str]:
+    """The feed<->store reconciliation: orders/fills/status multisets of
+    the drop-copy capture against the durable tables. Requires a capture
+    spanning the store's whole life (fresh db + `client audit --capture`
+    from boot) — a partial capture reports the store's surplus as
+    violations, which is the point for soak/CI use."""
+    from collections import Counter
+
+    cap_orders, cap_fills = _load_dropcopy(capture_path)
+    conn = sqlite3.connect(db_path)
+    db_orders = {
+        row[0]: {"status": row[3], "remaining": row[2], "qty": row[1]}
+        for row in conn.execute(
+            "SELECT order_id, quantity, remaining_quantity, status "
+            "FROM orders")
+    }
+    db_fills = conn.execute(
+        "SELECT order_id, counter_order_id, price, quantity "
+        "FROM fills").fetchall()
+    conn.close()
+
+    problems: list[str] = []
+    cf, df = Counter(cap_fills), Counter(tuple(f) for f in db_fills)
+    for f, n in (cf - df).items():
+        problems.append(f"dropcopy fill absent from store x{n}: {f}")
+    for f, n in (df - cf).items():
+        problems.append(f"store fill absent from dropcopy x{n}: {f}")
+    for oid in sorted(set(cap_orders) - set(db_orders)):
+        problems.append(f"dropcopy order absent from store: {oid}")
+    for oid in sorted(set(db_orders) - set(cap_orders)):
+        problems.append(f"store order absent from dropcopy: {oid}")
+    for oid in sorted(set(cap_orders) & set(db_orders)):
+        c, d = cap_orders[oid], db_orders[oid]
+        if (c["status"], c["remaining"], c["qty"]) != \
+                (d["status"], d["remaining"], d["qty"]):
+            problems.append(
+                f"{oid}: dropcopy final (status {c['status']}, remaining "
+                f"{c['remaining']}, qty {c['qty']}) != store (status "
+                f"{d['status']}, remaining {d['remaining']}, qty "
+                f"{d['qty']})")
+    summary = {
+        "dropcopy_orders": len(cap_orders),
+        "dropcopy_fills": len(cap_fills),
+        "store_orders": len(db_orders),
+        "store_fills": len(db_fills),
+        "cross_violations": len(problems),
+    }
+    if summary_out is None:
+        print(json.dumps(summary))
+    else:
+        summary_out.update(summary)
     return problems
 
 
 def main() -> int:
-    if len(sys.argv) != 2:
-        print("usage: audit.py <db_path>", file=sys.stderr)
+    argv = sys.argv[1:]
+    dropcopy = None
+    if "--dropcopy" in argv:
+        i = argv.index("--dropcopy")
+        try:
+            dropcopy = argv[i + 1]
+        except IndexError:
+            print("usage: audit.py <db_path> [--dropcopy FILE]",
+                  file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 1:
+        print("usage: audit.py <db_path> [--dropcopy FILE]", file=sys.stderr)
         return 2
-    problems = audit(sys.argv[1])
+    if dropcopy is None:
+        problems = audit(argv[0])
+    else:
+        # One merged JSON summary line — the documented stdout contract
+        # holds whether or not the cross-check runs.
+        summary: dict = {}
+        problems = audit(argv[0], summary_out=summary)
+        problems += cross_check_dropcopy(argv[0], dropcopy,
+                                         summary_out=summary)
+        print(json.dumps(summary))
     for p in problems:
         print(f"[audit] {p}", file=sys.stderr)
     return 1 if problems else 0

@@ -61,6 +61,26 @@ ADDITIVE_FIELDS = [
     ("OrderUpdate", "feed_epoch", 10, F.TYPE_UINT64),
     ("MarketDataRequest", "feed_epoch", 4, F.TYPE_UINT64),
     ("OrderUpdatesRequest", "feed_epoch", 3, F.TYPE_UINT64),
+    # Drop-copy audit stream (matching_engine_tpu/audit/): lifecycle
+    # records ride OrderUpdate on the sequenced `audit` channel
+    # (StreamOrderUpdates with the reserved client_id). audit_kind != 0
+    # marks a drop-copy record: 1 = order row (submit decoded; carries
+    # the original quantity in audit_quantity and side/otype), 2 = status
+    # update row (audit_quantity = new quantity on amends), 3 = fill row
+    # (order_id = aggressor, counter_order_id = maker, fill_price/
+    # fill_quantity = the execution). The envelope names the dispatch the
+    # record was decoded from: trace_id (flight-recorder/trace-export
+    # correlation), dispatch shape/waves, and the dispatch's oldest-op
+    # edge-ingress wall clock in µs (0 when the edge recorded none).
+    ("OrderUpdate", "audit_kind", 11, F.TYPE_UINT32),
+    ("OrderUpdate", "trace_id", 12, F.TYPE_UINT64),
+    ("OrderUpdate", "dispatch_shape", 13, F.TYPE_STRING),
+    ("OrderUpdate", "dispatch_waves", 14, F.TYPE_UINT32),
+    ("OrderUpdate", "counter_order_id", 15, F.TYPE_STRING),
+    ("OrderUpdate", "ingress_ts_us", 16, F.TYPE_UINT64),
+    ("OrderUpdate", "audit_side", 17, F.TYPE_UINT32),
+    ("OrderUpdate", "audit_otype", 18, F.TYPE_UINT32),
+    ("OrderUpdate", "audit_quantity", 19, F.TYPE_INT64),
 ]
 
 # Whole new messages (name, [(field, number, type[, label])]) — additive:
@@ -256,6 +276,15 @@ br = pb2.OrderBatchResponse(success=True, ok=[True, False],
 br2 = pb2.OrderBatchResponse.FromString(br.SerializeToString())
 assert list(br2.ok) == [True, False] and list(br2.remaining) == [0, 3]
 assert list(br2.order_id) == ["OID-1", ""] and br2.success
+a = pb2.OrderUpdate(order_id="OID-3", audit_kind=3, trace_id=12,
+                    dispatch_shape="mega", dispatch_waves=4,
+                    counter_order_id="OID-2", ingress_ts_us=99,
+                    audit_side=1, audit_otype=0, audit_quantity=5)
+a2 = pb2.OrderUpdate.FromString(a.SerializeToString())
+assert (a2.audit_kind == 3 and a2.trace_id == 12
+        and a2.dispatch_shape == "mega" and a2.dispatch_waves == 4
+        and a2.counter_order_id == "OID-2" and a2.ingress_ts_us == 99
+        and a2.audit_side == 1 and a2.audit_quantity == 5)
 # Old readers must still parse new writers (additive compatibility).
 assert pb2.OrderRequest.FromString(
     pb2.OrderRequest(client_id="c", symbol="S").SerializeToString()

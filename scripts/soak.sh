@@ -29,6 +29,13 @@ fi
 
 MINUTES="${1:-3}"
 SOAK_SERVER_ARGS="${SOAK_SERVER_ARGS:-}"
+# Online surveillance rides EVERY round: each server boots with the
+# drop-copy stream + InvariantAuditor at full shadow sampling, and each
+# round's verdict includes /auditz staying green with
+# me_audit_violations_total == 0. A dedicated corruption-injection round
+# at the end asserts the INVERSE (the auditor must fire) so a soak can
+# never "pass" with a lobotomized auditor.
+AUDIT_ARGS="--audit --audit-sample 1"
 WORK=$(mktemp -d)
 DB="$WORK/soak.db"
 OUT_DIR="$PWD/benchmarks/results"
@@ -40,7 +47,7 @@ PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --gateway-addr 127.0.0.1:0 --auction-open \
   --metrics-port 0 --flight-dir "$WORK/flight" \
-  ${SOAK_SERVER_ARGS:-} \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   --checkpoint-dir "$WORK/ckpts" --checkpoint-interval-s 5 \
   > "$WORK/server.log" 2>&1 &
 SRV=$!
@@ -78,6 +85,38 @@ EOF
 }
 CLI=matching_engine_tpu/native/me_client
 GW="127.0.0.1:$GW_PORT"; PY="127.0.0.1:$PY_PORT"
+
+# Per-round surveillance verdict: /auditz must answer 200 with zero
+# violations (the JSON is kept for the artifact's auditz section).
+AUDITZ_DIR="$WORK/auditz"; mkdir -p "$AUDITZ_DIR"
+check_audit() {  # $1 = obs port, $2 = section name; non-zero on red
+  python - "$1" "$2" "$AUDITZ_DIR" <<'EOF'
+import json, os, sys, urllib.request, urllib.error
+port, name, outdir = sys.argv[1:4]
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/auditz", timeout=5).read().decode()
+    code = 200
+except urllib.error.HTTPError as e:
+    body, code = e.read().decode(), e.code
+except Exception as e:
+    print(f"auditz {name}: unreachable ({type(e).__name__}: {e})")
+    sys.exit(1)
+try:
+    doc = json.loads(body)
+except ValueError:
+    print(f"auditz {name}: non-JSON answer ({body[:80]!r})")
+    sys.exit(1)
+open(os.path.join(outdir, f"{name}.json"), "w").write(body)
+if code != 200 or not doc.get("ok") or doc.get("violations", -1) != 0:
+    print(f"auditz {name}: RED code={code} "
+          f"violations={doc.get('violations')} by={doc.get('by_kind')} "
+          f"recent={doc.get('recent')}")
+    sys.exit(1)
+print(f"auditz {name}: ok records={doc.get('records')} "
+      f"store_checks={doc.get('store', {}).get('checks')}")
+EOF
+}
 
 # Real opening cross: crossing flow RESTS in the call period, a per-symbol
 # uncross clears it (call period holds), then all-symbols opens trading.
@@ -129,6 +168,10 @@ except Exception: print(0)")
   # dispatch-lock/pending/checkpoint interplay concurrently with traffic).
   "$CLI" auction "$GW" >/dev/null 2>&1 || true
   scrape_metrics
+  # Surveillance verdict for the round: any invariant violation so far
+  # fails the soak NOW, naming the kind and the offending record.
+  check_audit "$OBS_PORT" "round_$ROUNDS" \
+    || { echo "FAIL: audit violations in round $ROUNDS"; exit 1; }
   # Round verdict from the feed subscriber: SIGINT makes it finalize
   # (summary JSON + integrity exit code). 4 = unrecovered gap -> fail.
   kill -INT $FEED_PID 2>/dev/null || true
@@ -159,6 +202,18 @@ done
 [ "$FEED_EVENTS" -gt 0 ] || { echo "FAIL: feed subscribers saw zero events"; exit 1; }
 grep -q "^me_stage_queue_wait_us_p99" "$METRICS_OUT" \
   || { echo "FAIL: stage ledger absent from /metrics scrapes"; exit 1; }
+# The auditor must have actually consumed records (a soak whose auditor
+# saw nothing verified nothing), and NO scrape may ever have shown a
+# nonzero violation count (a "zero exists somewhere" grep would pass
+# vacuously on the round-0 scrape).
+grep -q "^me_audit_violations_total " "$METRICS_OUT" \
+  || { echo "FAIL: me_audit_violations_total absent from scrapes"; exit 1; }
+if grep -qE "^me_audit_violations_total [1-9]" "$METRICS_OUT"; then
+  echo "FAIL: a scrape recorded nonzero me_audit_violations_total"; exit 1
+fi
+AUDIT_RECORDS=$(sed -n 's/^me_audit_records_total \([0-9]*\).*/\1/p' "$METRICS_OUT" | sort -n | tail -1)
+[ -n "$AUDIT_RECORDS" ] && [ "$AUDIT_RECORDS" -gt 0 ] \
+  || { echo "FAIL: auditor consumed no drop-copy records (records=${AUDIT_RECORDS:-absent})"; exit 1; }
 
 # ---- sharded round: K=2 partitioned serving lanes -------------------------
 # Boots a second server with --serve-shards 2 on a fresh store, reuses the
@@ -169,7 +224,7 @@ SH_DB="$WORK/soak_sharded.db"
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$SH_DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --serve-shards 2 --metrics-port 0 \
-  ${SOAK_SERVER_ARGS:-} \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   > "$WORK/server_sharded.log" 2>&1 &
 SH_SRV=$!
 trap 'kill $SRV $SH_SRV 2>/dev/null' EXIT
@@ -201,6 +256,8 @@ try:
 except Exception as e:
     print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
 EOF
+check_audit "$SH_OBS" "sharded" \
+  || { echo "FAIL: audit violations in the sharded round"; exit 1; }
 kill -INT $SH_FEED_PID 2>/dev/null || true
 wait $SH_FEED_PID; SH_FEED_RC=$?
 if [ "$SH_FEED_RC" -eq 4 ]; then
@@ -234,7 +291,7 @@ MD_DB="$WORK/soak_mega.db"
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$MD_DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --no-native --megadispatch-max-waves 4 --metrics-port 0 \
-  ${SOAK_SERVER_ARGS:-} \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   > "$WORK/server_mega.log" 2>&1 &
 MD_SRV=$!
 trap 'kill $SRV $MD_SRV 2>/dev/null' EXIT
@@ -266,6 +323,8 @@ try:
 except Exception as e:
     print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
 EOF
+check_audit "$MD_OBS" "megadispatch" \
+  || { echo "FAIL: audit violations in the megadispatch round"; exit 1; }
 kill -INT $MD_FEED_PID 2>/dev/null || true
 wait $MD_FEED_PID; MD_FEED_RC=$?
 if [ "$MD_FEED_RC" -eq 4 ]; then
@@ -307,7 +366,7 @@ BE_DB="$WORK/soak_batch.db"
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$BE_DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --native-lanes --megadispatch-max-waves 4 --metrics-port 0 \
-  ${SOAK_SERVER_ARGS:-} \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   > "$WORK/server_batch.log" 2>&1 &
 BE_SRV=$!
 trap 'kill $SRV $BE_SRV 2>/dev/null' EXIT
@@ -361,6 +420,8 @@ except Exception as e:
     print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
 EOF
 cat "$BE_SCRAPE" >> "$METRICS_OUT"
+check_audit "$BE_OBS" "batch" \
+  || { echo "FAIL: audit violations in the batch round"; exit 1; }
 kill -INT $BE_FEED_PID 2>/dev/null || true
 wait $BE_FEED_PID; BE_FEED_RC=$?
 if [ "$BE_FEED_RC" -eq 4 ]; then
@@ -401,6 +462,59 @@ BE_MEGA=$(sed -n 's/^me_megadispatch_steps_total \([0-9]*\).*/\1/p' "$BE_SCRAPE"
 [ -n "$BE_MEGA" ] && [ "$BE_MEGA" -gt 0 ] \
   || { echo "FAIL: native megadispatch never engaged in the batch round (steps=${BE_MEGA:-absent})"; exit 1; }
 
+# ---- corruption-injection round: the auditor must fire --------------------
+# Boots a server with ME_AUDIT_FAULT=fill_qty (one fill record's quantity
+# mutated between decode and publish), drives crossing flow, and asserts
+# the INVERSE of every other round: /auditz must go red with
+# me_audit_violations_total > 0 naming the conservation class, and the
+# violation must flight-dump. A soak whose auditor cannot be made to fire
+# proves nothing about the rounds where it stayed quiet.
+CI_DB="$WORK/soak_corrupt.db"
+PYTHONUNBUFFERED=1 ME_AUDIT_FAULT=fill_qty python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$CI_DB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --metrics-port 0 --flight-dir "$WORK/corrupt_flight" \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_corrupt.log" 2>&1 &
+CI_SRV=$!
+trap 'kill $SRV $CI_SRV 2>/dev/null' EXIT
+CI_PY=""; CI_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  CI_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_corrupt.log" | head -1)
+  CI_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_corrupt.log" | head -1)
+  [ -n "$CI_PY" ] && [ -n "$CI_OBS" ] && break
+  kill -0 $CI_SRV 2>/dev/null || { echo "FAIL: corruption server died at boot"; tail -5 "$WORK/server_corrupt.log"; exit 1; }
+  sleep 1
+done
+[ -n "$CI_PY" ] && [ -n "$CI_OBS" ] || { echo "FAIL: corruption server ports never appeared"; exit 1; }
+# Crossing flow guarantees fill records for the injector to corrupt.
+"$CLI" bench "127.0.0.1:$CI_PY" 8 50 12 4 >/dev/null 2>&1 || true
+sleep 2
+CI_VERDICT=$(python - "$CI_OBS" <<'EOF'
+import json, sys, urllib.request, urllib.error
+port = sys.argv[1]
+try:
+    urllib.request.urlopen(f"http://127.0.0.1:{port}/auditz", timeout=5)
+    code, doc = 200, {}
+except urllib.error.HTTPError as e:
+    code, doc = e.code, json.loads(e.read().decode())
+viol = doc.get("violations", 0)
+kinds = doc.get("by_kind", {})
+ok = code == 500 and viol > 0 and "conservation" in kinds
+# Compact JSON (no spaces): the caller word-splits this line.
+print(f"{int(ok)} {code} {viol} {json.dumps(kinds, separators=(',', ':'))}")
+EOF
+)
+read -r CI_OK CI_CODE CI_VIOL CI_KINDS <<< "$(echo "$CI_VERDICT" | tail -1)"
+if [ "$CI_OK" != "1" ]; then
+  echo "FAIL: injected corruption went UNDETECTED (auditz code=$CI_CODE violations=$CI_VIOL kinds=$CI_KINDS)"
+  exit 1
+fi
+kill -TERM $CI_SRV 2>/dev/null; wait $CI_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+CI_DUMP=$(grep -l "audit_violation" "$WORK"/corrupt_flight/flight_*.json 2>/dev/null | head -1)
+[ -n "$CI_DUMP" ] || { echo "FAIL: corruption fired but produced no flight dump"; exit 1; }
+echo "corruption round: auditor fired as required (violations=$CI_VIOL kinds=$CI_KINDS)"
+
 # ---- latency round: open-loop tail gate -----------------------------------
 # Boots a fourth server with the tail levers ON (--busy-poll-us,
 # --book-cache-ms, --proto-reuse) and --trace-dir, runs latency_bench's
@@ -413,7 +527,7 @@ PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$LT_DB" --symbols 16 --capacity 64 --batch 8 \
   --window-ms 1 --metrics-port 0 --busy-poll-us 50 --book-cache-ms 5 \
   --proto-reuse --trace-dir "$LT_TRACE" --trace-sample 32 \
-  ${SOAK_SERVER_ARGS:-} \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   > "$WORK/server_latency.log" 2>&1 &
 LT_SRV=$!
 trap 'kill $SRV $LT_SRV 2>/dev/null' EXIT
@@ -447,6 +561,8 @@ if [ "$LT_OK" != "1" ]; then
   echo "FAIL: latency round gate (p50=${LT_P50}ms p99=${LT_P99}ms ratio=${LT_RATIO} p999_gauges=${LT_NP999})"
   exit 1
 fi
+check_audit "$LT_OBS" "latency" \
+  || { echo "FAIL: audit violations in the latency round"; exit 1; }
 # Clean shutdown finalizes the trace JSON; keep it beside the artifact.
 kill -TERM $LT_SRV 2>/dev/null; wait $LT_SRV 2>/dev/null
 trap 'kill $SRV 2>/dev/null' EXIT
@@ -471,9 +587,27 @@ FLIGHT=$(ls -t "$WORK"/flight/flight_*.json 2>/dev/null | head -1)
 [ -n "$FLIGHT" ] && cp "$FLIGHT" "$OUT_DIR/soak_${TS}_flight.json"
 
 python - "$OUT_DIR/soak_${TS}.json" <<EOF
-import json, subprocess, sys
+import glob, json, os, subprocess, sys
 rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                      capture_output=True, text=True).stdout.strip()
+# Surveillance verdicts: one /auditz snapshot per round. A round whose
+# section is MISSING fails the soak — an artifact without the audit
+# evidence proves nothing about the rounds it claims were clean.
+auditz = {}
+for path in sorted(glob.glob(os.path.join("$AUDITZ_DIR", "*.json"))):
+    name = os.path.basename(path)[:-5]
+    try:
+        doc = json.load(open(path))
+    except ValueError:
+        print(f"FAIL: unreadable auditz section {name}"); sys.exit(1)
+    auditz[name] = {"ok": doc.get("ok"), "records": doc.get("records"),
+                    "violations": doc.get("violations"),
+                    "store_checks": doc.get("store", {}).get("checks")}
+required = ["round_0", "sharded", "megadispatch", "batch", "latency"]
+missing = [n for n in required if n not in auditz]
+if missing:
+    print(f"FAIL: /auditz section(s) missing from the artifact: {missing}")
+    sys.exit(1)
 # Max subscriber lag over the whole soak, from the per-round scrapes.
 max_lag = 0.0
 try:
@@ -503,6 +637,10 @@ artifact = {
                       "p99_ms": $LT_P99, "p99_over_p50": $LT_RATIO,
                       "p999_gauges": $LT_NP999,
                       "levers": "busy-poll+book-cache+proto-reuse"},
+    "auditz": auditz,
+    "corruption_round": {"fault": "fill_qty", "detected": True,
+                         "violations": int("$CI_VIOL" or -1),
+                         "by_kind": json.loads('$CI_KINDS' or "{}")},
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))
