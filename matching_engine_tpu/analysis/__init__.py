@@ -1,0 +1,44 @@
+"""Repo-native static-analysis suite — the guardrails for the
+invariants that exist only as prose and runtime fuzz everywhere else:
+
+- lockorder:  lock acquisition graph vs the declared hierarchy
+              (hub→auditor ordering, nothing slow under the hub lock,
+              with-scoped locking) — analysis/hierarchy.py is the
+              declaration, docs/CONCURRENCY.md the rendered contract;
+- jitpurity:  jax.jit purity (no host-impure calls in traced code),
+              donation discipline (no double-donated / aliased
+              buffers), and the utils/jax_compat routing convention;
+- abi:        byte-for-byte MeOpRec/MeGwOp/MeOp layout agreement
+              between the C headers and the python mirrors, proven
+              without building the .so;
+- doccheck:   metric/flag ⇄ docs/OPERATIONS.md coherence, both
+              directions.
+
+Run as tier-1 tests (tests/test_analysis.py), as one gate
+(scripts/check.sh), or directly:
+
+    python -m matching_engine_tpu.analysis run [--json]
+    python -m matching_engine_tpu.analysis render-concurrency
+"""
+
+from __future__ import annotations
+
+from matching_engine_tpu.analysis.common import Violation  # noqa: F401
+
+
+def run_all() -> dict[str, list[Violation]]:
+    """All four analyzers, keyed by name. Import inside so `import
+    matching_engine_tpu.analysis` stays cheap for tooling."""
+    from matching_engine_tpu.analysis import (
+        abi,
+        doccheck,
+        jitpurity,
+        lockorder,
+    )
+
+    return {
+        "lock-order": lockorder.run(),
+        "jit-purity": jitpurity.run(),
+        "abi": abi.run(),
+        "doc-coherence": doccheck.run(),
+    }

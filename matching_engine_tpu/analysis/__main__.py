@@ -1,0 +1,74 @@
+"""CLI for the static-analysis suite (scripts/check.sh drives this).
+
+    python -m matching_engine_tpu.analysis run [--json FILE]
+    python -m matching_engine_tpu.analysis render-concurrency [--check]
+
+`run` exits nonzero on any violation; `--json` also writes a summary
+artifact (per-analyzer counts + every violation row). `render-concurrency
+--check` exits 3 when docs/CONCURRENCY.md is stale instead of writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="matching_engine_tpu.analysis")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    runp = sub.add_parser("run", help="run all analyzers, exit 1 on "
+                                      "violations")
+    runp.add_argument("--json", default=None, metavar="FILE",
+                      help="write a machine-readable summary artifact")
+    renp = sub.add_parser("render-concurrency",
+                          help="regenerate docs/CONCURRENCY.md")
+    renp.add_argument("--check", action="store_true",
+                      help="exit 3 if the committed doc is stale "
+                           "(write nothing)")
+    args = p.parse_args(argv)
+
+    if args.cmd == "render-concurrency":
+        from matching_engine_tpu.analysis import render
+        from matching_engine_tpu.analysis.common import REPO_ROOT
+
+        path = REPO_ROOT / "docs" / "CONCURRENCY.md"
+        fresh = render.render()
+        if args.check:
+            if not path.exists() or path.read_text() != fresh:
+                print("docs/CONCURRENCY.md is stale — regenerate with "
+                      "`python -m matching_engine_tpu.analysis "
+                      "render-concurrency`", file=sys.stderr)
+                return 3
+            print("docs/CONCURRENCY.md is fresh")
+            return 0
+        print(render.write())
+        return 0
+
+    from matching_engine_tpu.analysis import run_all
+
+    results = run_all()
+    total = 0
+    for name, vs in results.items():
+        status = "clean" if not vs else f"{len(vs)} violation(s)"
+        print(f"[{name}] {status}")
+        for v in vs:
+            print(f"  {v}")
+        total += len(vs)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({
+                "total_violations": total,
+                "analyzers": {
+                    name: [dataclasses.asdict(v) for v in vs]
+                    for name, vs in results.items()
+                },
+            }, f, indent=2, sort_keys=True)
+        print(f"summary: {args.json}")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,260 @@
+"""ABI cross-checker: statically prove the python↔C++ record layouts
+agree without building the .so.
+
+Three byte-contracts are load-bearing and were each the site of review
+churn when they drifted:
+
+- `MeOpRec` (native/me_gwop.h) == `OPREC_DTYPE` (domain/oprec.py): the
+  batch-edge wire format — one skewed offset silently corrupts every
+  SubmitOrderBatch payload the C++ converter decodes;
+- `MeGwOp` (native/me_gwop.h) == the ctypes mirror in
+  native/__init__.py: the gateway ring record both edges push;
+- `MeOp` (native/me_native.cpp) == its ctypes mirror: the lane ring op.
+
+The checker parses the C struct declarations with a small tokenizer,
+computes offsets under natural (System V x86-64 / AArch64) alignment —
+the rule both `static_assert(sizeof...)` pins assume — and compares
+field-by-field against the imported numpy dtype / ctypes Structures
+(imports are layout-only; nothing loads or builds native code). It also
+enforces explicit little-endian `struct` format strings package-wide:
+a bare "@"-aligned format would re-introduce platform-dependent
+padding at the exact seams this checker guards.
+"""
+
+from __future__ import annotations
+
+import ast
+import ctypes
+import re
+import sys
+
+from matching_engine_tpu.analysis.common import (
+    PKG_ROOT,
+    REPO_ROOT,
+    Violation,
+    dotted,
+    load_sources,
+    site,
+)
+
+# C scalar type -> (size, numpy-ish kind). Alignment == size for
+# scalars on every ABI this engine targets (x86-64, AArch64 TPU hosts).
+_C_TYPES = {
+    "uint8_t": (1, "u"), "int8_t": (1, "i"),
+    "uint16_t": (2, "u"), "int16_t": (2, "i"),
+    "uint32_t": (4, "u"), "int32_t": (4, "i"),
+    "uint64_t": (8, "u"), "int64_t": (8, "i"),
+    "char": (1, "S"),
+    "float": (4, "f"), "double": (8, "f"),
+}
+
+_FIELD_RE = re.compile(
+    r"^\s*(?P<type>\w+)\s+(?P<name>\w+)\s*(?:\[\s*(?P<n>\d+)\s*\])?\s*;")
+
+
+def parse_struct(text: str, name: str) -> list[tuple[str, str, int]]:
+    """Extract (type, field, array_n) rows for `struct <name>` from C++
+    source text. Comments are stripped; only simple scalar/char-array
+    members are supported — which is the point: these wire structs must
+    STAY simple enough to mirror."""
+    m = re.search(rf"struct\s+{name}\s*\{{(.*?)\}}\s*;", text, re.S)
+    if m is None:
+        raise ValueError(f"struct {name} not found")
+    body = re.sub(r"//.*?$", "", m.group(1), flags=re.M)
+    body = re.sub(r"/\*.*?\*/", "", body, flags=re.S)
+    fields = []
+    for line in body.splitlines():
+        fm = _FIELD_RE.match(line)
+        if fm:
+            fields.append((fm.group("type"), fm.group("name"),
+                           int(fm.group("n") or 1)))
+    if not fields:
+        raise ValueError(f"struct {name}: no parseable members")
+    return fields
+
+
+def c_layout(fields) -> tuple[dict[str, tuple[int, int, str]], int]:
+    """Natural-alignment offsets: field -> (offset, size, kind), plus
+    sizeof (end padded to max member alignment)."""
+    out: dict[str, tuple[int, int, str]] = {}
+    off = 0
+    max_align = 1
+    for ctype, name, n in fields:
+        if ctype not in _C_TYPES:
+            raise ValueError(f"{name}: unsupported C type {ctype}")
+        size, kind = _C_TYPES[ctype]
+        align = size            # scalar alignment; arrays align as elem
+        off = (off + align - 1) // align * align
+        out[name] = (off, size * n, kind)
+        off += size * n
+        max_align = max(max_align, align)
+    return out, (off + max_align - 1) // max_align * max_align
+
+
+def _norm(name: str) -> str:
+    return name.lstrip("_")
+
+
+def compare_layouts(cname: str, cfields: dict[str, tuple[int, int, str]],
+                    csize: int, pname: str,
+                    pfields: dict[str, tuple[int, int, str]],
+                    psize: int) -> list[Violation]:
+    """Field-by-field agreement between a C layout and a python-side
+    layout (numpy dtype or ctypes). Names match modulo leading
+    underscores; char boxes accept numpy S (bytes) or V (opaque pad)."""
+    vs: list[Violation] = []
+    where = f"{cname} vs {pname}"
+    cn = {_norm(k): v for k, v in cfields.items()}
+    pn = {_norm(k): v for k, v in pfields.items()}
+    for f in cn:
+        if f not in pn:
+            vs.append(Violation(
+                "abi/missing-field", where,
+                f"C field '{f}' has no python-side mirror"))
+    for f in pn:
+        if f not in cn:
+            vs.append(Violation(
+                "abi/missing-field", where,
+                f"python field '{f}' has no C-side member"))
+    for f, (coff, csz, ckind) in sorted(cn.items()):
+        if f not in pn:
+            continue
+        poff, psz, pkind = pn[f]
+        if coff != poff:
+            vs.append(Violation(
+                "abi/offset-mismatch", where,
+                f"'{f}': C offset {coff} != python offset {poff}"))
+        if csz != psz:
+            vs.append(Violation(
+                "abi/width-mismatch", where,
+                f"'{f}': C width {csz} != python width {psz}"))
+        kinds_ok = (ckind == pkind
+                    or (ckind == "S" and pkind in ("S", "V"))
+                    or (pkind == "V" and csz == psz))
+        if not kinds_ok:
+            vs.append(Violation(
+                "abi/kind-mismatch", where,
+                f"'{f}': C kind '{ckind}' != python kind '{pkind}'"))
+    if csize != psize:
+        vs.append(Violation(
+            "abi/total-size", where,
+            f"sizeof mismatch: C {csize} != python {psize} (alignment "
+            f"padding drifted)"))
+    return vs
+
+
+def dtype_layout(dtype) -> tuple[dict[str, tuple[int, int, str]], int,
+                                 list[Violation]]:
+    """numpy structured dtype -> (fields, itemsize, endianness
+    violations). Multi-byte numerics must be EXPLICITLY
+    little-endian — '=' would flip on a big-endian host while the C++
+    side stays LE."""
+    vs: list[Violation] = []
+    out: dict[str, tuple[int, int, str]] = {}
+    for name in dtype.names:
+        ft, off = dtype.fields[name][:2]
+        out[name] = (off, ft.itemsize, ft.kind)
+        if ft.kind in ("i", "u", "f") and ft.itemsize > 1:
+            # numpy canonicalizes '<' to '=' on LE hosts, so only the
+            # EFFECTIVE order is observable here; the wire is LE.
+            if ft.byteorder == ">" or (
+                    ft.byteorder == "=" and sys.byteorder != "little"):
+                vs.append(Violation(
+                    "abi/endianness", f"dtype field {name}",
+                    f"multi-byte field is effectively big-endian "
+                    f"({ft.byteorder!r} on a {sys.byteorder}-endian "
+                    f"host); wire contract is little-endian"))
+    return out, dtype.itemsize, vs
+
+
+def ctypes_layout(cls) -> tuple[dict[str, tuple[int, int, str]], int]:
+    out: dict[str, tuple[int, int, str]] = {}
+    for name, typ in cls._fields_:
+        d = getattr(cls, name)
+        if issubclass(typ, ctypes.Array):
+            kind = "S" if typ._type_ is ctypes.c_char else "V"
+        elif typ in (ctypes.c_float, ctypes.c_double):
+            kind = "f"
+        else:
+            kind = "u" if ctypes.sizeof(typ) and typ(-1).value != -1 \
+                else "i"
+        out[name] = (d.offset, d.size, kind)
+    return out, ctypes.sizeof(cls)
+
+
+def check_struct_formats(sources=None) -> list[Violation]:
+    """Every struct.pack/unpack/Struct format literal in the package
+    must carry an explicit byte order ('<' — the wire is LE; '@'/bare
+    formats add platform padding). `sources` injectable for tests."""
+    vs: list[Violation] = []
+    if sources is None:
+        sources = load_sources([""], root=PKG_ROOT)
+    _FMT_FNS = ("Struct", "pack", "pack_into", "unpack", "unpack_from",
+                "iter_unpack", "calcsize")
+    for src in sources:
+        # `from struct import Struct, pack` spellings count too — the
+        # rule is package-wide, not spelled-one-way.
+        aliases = {
+            a.asname or a.name
+            for n in ast.walk(src.tree)
+            if isinstance(n, ast.ImportFrom) and n.module == "struct"
+            for a in n.names if a.name in _FMT_FNS
+        }
+        for n in ast.walk(src.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted(n.func) or ""
+            bare = isinstance(n.func, ast.Name) and n.func.id in aliases
+            if not (bare or d == "struct.Struct"
+                    or d.startswith("struct.pack")
+                    or d.startswith("struct.unpack")
+                    or d in ("struct.calcsize", "struct.iter_unpack")):
+                continue
+            if not n.args or not isinstance(n.args[0], ast.Constant) \
+                    or not isinstance(n.args[0].value, str):
+                continue
+            fmt = n.args[0].value
+            if not fmt.startswith("<"):
+                vs.append(Violation(
+                    "abi/format-endianness", site(src, n),
+                    f"struct format {fmt!r} lacks explicit '<' — "
+                    f"native alignment/order is not the wire contract"))
+    return vs
+
+
+def run() -> list[Violation]:
+    import numpy as np  # noqa: F401  (dtype import below needs numpy)
+
+    from matching_engine_tpu import native as native_mod
+    from matching_engine_tpu.domain import oprec
+
+    vs: list[Violation] = []
+    gwop_h = (REPO_ROOT / "native" / "me_gwop.h").read_text()
+    me_native_cpp = (REPO_ROOT / "native" / "me_native.cpp").read_text()
+
+    # 1. MeOpRec (header) vs OPREC_DTYPE (batch-edge wire format).
+    cf, csz = c_layout(parse_struct(gwop_h, "MeOpRec"))
+    pf, psz, evs = dtype_layout(oprec.OPREC_DTYPE)
+    vs += evs
+    vs += compare_layouts("native/me_gwop.h:MeOpRec", cf, csz,
+                          "domain/oprec.py:OPREC_DTYPE", pf, psz)
+    if psz != oprec.RECORD_SIZE:
+        vs.append(Violation(
+            "abi/total-size", "domain/oprec.py",
+            f"RECORD_SIZE {oprec.RECORD_SIZE} != dtype itemsize {psz}"))
+
+    # 2. MeGwOp (header) vs the ctypes ring-record mirror.
+    cf, csz = c_layout(parse_struct(gwop_h, "MeGwOp"))
+    pf, psz = ctypes_layout(native_mod.MeGwOp)
+    vs += compare_layouts("native/me_gwop.h:MeGwOp", cf, csz,
+                          "native/__init__.py:MeGwOp", pf, psz)
+
+    # 3. MeOp (me_native.cpp) vs the ctypes lane-op mirror.
+    cf, csz = c_layout(parse_struct(me_native_cpp, "MeOp"))
+    pf, psz = ctypes_layout(native_mod.MeOp)
+    vs += compare_layouts("native/me_native.cpp:MeOp", cf, csz,
+                          "native/__init__.py:MeOp", pf, psz)
+
+    # 4. Explicit-endianness struct formats package-wide.
+    vs += check_struct_formats()
+    return vs

@@ -1,0 +1,123 @@
+"""Shared plumbing for the static-analysis suite.
+
+Every analyzer produces `Violation` rows with a stable (rule, where,
+detail) shape so the CLI, the check.sh gate, and the self-tests consume
+one vocabulary. Analyzers are pure functions over parsed sources — no
+imports of the code under analysis except where a layout is only
+knowable by construction (the ABI checker imports the numpy dtype and
+the ctypes mirrors, which are import-safe by design).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+PKG_ROOT = REPO_ROOT / "matching_engine_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str      # stable rule id, e.g. "lock-order/inversion"
+    where: str     # "path:line" (repo-relative) or a logical site
+    detail: str    # one-line human explanation
+
+    def __str__(self) -> str:  # the check.sh / CLI line format
+        return f"{self.rule}: {self.where}: {self.detail}"
+
+
+@dataclasses.dataclass
+class Source:
+    """One parsed python module."""
+
+    path: pathlib.Path
+    text: str
+    tree: ast.Module
+
+    @property
+    def rel(self) -> str:
+        try:
+            return str(self.path.relative_to(REPO_ROOT))
+        except ValueError:
+            return str(self.path)
+
+    @property
+    def modname(self) -> str:
+        """Fully-qualified dotted module name — UNIQUE per file.
+        (`path.stem` alone would collapse every package __init__.py
+        into one colliding module identity, silently merging their
+        function summaries.)"""
+        rel = self.rel
+        if rel.endswith(".py"):
+            rel = rel[:-3]
+        return rel.replace("/", ".")
+
+
+_CACHE: dict[tuple, list[Source]] = {}
+
+
+def load_sources(dirs, root: pathlib.Path = PKG_ROOT) -> list[Source]:
+    """Parse every .py file under the given package-relative dirs (or a
+    single file name). Deterministic order (sorted paths) — analyzer
+    output feeds generated docs, which must be reproducible. Memoized
+    per (dirs, root): run_all and the tier-1 tests walk the same tree
+    several times per process, and the tree does not change mid-run."""
+    key = (tuple(dirs), str(root))
+    if key in _CACHE:
+        return _CACHE[key]
+    out: list[Source] = []
+    for d in dirs:
+        p = root / d
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            text = f.read_text()
+            out.append(Source(f, text, ast.parse(text, filename=str(f))))
+    _CACHE[key] = out
+    return out
+
+
+def site(src: Source, node: ast.AST) -> str:
+    return f"{src.rel}:{getattr(node, 'lineno', 0)}"
+
+
+def call_name(node: ast.Call) -> str | None:
+    """The rightmost name of a call target: foo() -> "foo",
+    a.b.foo() -> "foo". None for computed targets like fns[i]()."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def receiver_name(node: ast.Call) -> str | None:
+    """The receiver attribute/name a method call goes through:
+    self.hub.publish() -> "hub", seq.stamp() -> "seq",
+    self.observe() -> "self". None for bare-name calls foo()."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    v = f.value
+    if isinstance(v, ast.Attribute):   # self.<attr>.method() / a.b.method()
+        return v.attr
+    if isinstance(v, ast.Name):        # <name>.method()
+        return v.id
+    return None
+
+
+def dotted(node: ast.AST) -> str | None:
+    """Render an attribute chain: jax.experimental.shard_map ->
+    "jax.experimental.shard_map". None when any link is computed."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

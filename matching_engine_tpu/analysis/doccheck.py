@@ -1,0 +1,185 @@
+"""Metric/flag ⇄ docs coherence linter.
+
+Generalizes the tier-1 doc-lint (tests/test_obs.py checks doc→code for
+the metric table) to BOTH directions and to server flags:
+
+- every metric the package emits under a literal name must have a row
+  in docs/OPERATIONS.md's Observability table, and every documented row
+  must be emitted (registry names; the exporter adds `me_`/`_total`);
+- every `--flag` the server registers (server/main.py) must be
+  mentioned in docs/OPERATIONS.md, and every `--flag` token
+  OPERATIONS.md mentions must exist in some shipped entry point
+  (server, CLI client, benches, scripts/*.sh).
+
+Names that only materialize dynamically (f-strings, per-lane series,
+"+ kind" suffixes) are out of scope here — the pre-registration
+convention (register the literal zero first, PR 8) is what makes the
+static table complete, and this linter is the tool that keeps that
+convention honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from matching_engine_tpu.analysis.common import (
+    PKG_ROOT,
+    REPO_ROOT,
+    Violation,
+    call_name,
+    load_sources,
+    site,
+)
+
+OPERATIONS = REPO_ROOT / "docs" / "OPERATIONS.md"
+
+# Emit-call shapes -> the doc row type their names belong to.
+_EMITS = {"inc": "counter", "set_gauge": "gauge", "observe": "histogram"}
+
+# Metrics that are deliberately undocumented: NONE. Keep this empty —
+# document the metric instead (the whole point of the linter).
+ALLOW_UNDOCUMENTED: frozenset[str] = frozenset()
+
+
+def _doc_rows(doc: str) -> list[tuple[str, str]]:
+    return re.findall(
+        r"^\|\s*`([a-z0-9_]+)`\s*\|\s*(counter|gauge|ema|histogram)\s*\|",
+        doc, re.M)
+
+
+def collect_emitted(sources) -> dict[str, tuple[str, str]]:
+    """Literal metric name -> (doc row type, site)."""
+    out: dict[str, tuple[str, str]] = {}
+    for src in sources:
+        for n in ast.walk(src.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            name = call_name(n)
+            lit = None
+            typ = None
+            if name in _EMITS and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                lit, typ = n.args[0].value, _EMITS[name]
+            elif name == "ema_gauge" and n.args \
+                    and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                lit, typ = n.args[0].value + "_ema", "ema"
+            elif name == "Timer" and len(n.args) >= 2 \
+                    and isinstance(n.args[1], ast.Constant) \
+                    and isinstance(n.args[1].value, str):
+                lit, typ = n.args[1].value, "histogram"
+            if lit and re.fullmatch(r"[a-z0-9_]+", lit):
+                out.setdefault(lit, (typ, site(src, n)))
+    return out
+
+
+def check_metrics(doc: str | None = None,
+                  sources=None) -> list[Violation]:
+    """`doc`/`sources` injectable for the self-tests; defaults to the
+    real OPERATIONS.md and the whole package."""
+    vs: list[Violation] = []
+    if doc is None:
+        doc = OPERATIONS.read_text()
+        min_rows = 40
+    else:
+        min_rows = 1
+    rows = dict(_doc_rows(doc))
+    if len(rows) < min_rows:
+        return [Violation("doc-coherence/metric-table", str(OPERATIONS),
+                          "Observability metric table missing or shrunk")]
+    if sources is None:
+        sources = load_sources([""], root=PKG_ROOT)
+    emitted = collect_emitted(sources)
+
+    # Histogram rows document the base name; Timer/observe emit it too,
+    # and ema rows ride the _ema suffix (collect_emitted normalizes).
+    for name, (typ, where) in sorted(emitted.items()):
+        if name in ALLOW_UNDOCUMENTED:
+            continue
+        if name not in rows:
+            vs.append(Violation(
+                "doc-coherence/undocumented-metric", where,
+                f"metric '{name}' ({typ}) is emitted but has no row in "
+                f"docs/OPERATIONS.md's Observability table"))
+        elif rows[name] != typ:
+            vs.append(Violation(
+                "doc-coherence/metric-type", where,
+                f"metric '{name}' emitted as {typ} but documented as "
+                f"{rows[name]}"))
+
+    # Reverse direction: the proven regex surface from the tier-1 lint
+    # (emit literals + native aux tuples + stage constants).
+    src_text = "\n".join(s.text for s in sources)
+
+    def doc_name_emitted(name: str, typ: str) -> bool:
+        if typ == "counter":
+            pats = [rf'inc\(\s*"{name}"', rf'"{name}"\)']
+        elif typ == "gauge":
+            pats = [rf'set_gauge\(\s*"{name}"']
+        elif typ == "ema":
+            base = name[:-len("_ema")] if name.endswith("_ema") else name
+            pats = [rf'ema_gauge\(\s*"{base}"', rf'Timer\([^)]*"{base}"']
+        else:
+            pats = [rf'observe\(\s*"{name}"', rf'Timer\([^)]*"{name}"',
+                    rf'STAGE_[A-Z_]+ = "{name}"']
+        return any(re.search(p, src_text, re.S) for p in pats)
+
+    for name, typ in sorted(rows.items()):
+        if not doc_name_emitted(name, typ):
+            vs.append(Violation(
+                "doc-coherence/orphan-metric-row", f"docs/OPERATIONS.md",
+                f"documented metric '{name}' ({typ}) is never emitted"))
+    return vs
+
+
+def collect_flags(sources) -> dict[str, str]:
+    """--flag -> site, from add_argument literals."""
+    out: dict[str, str] = {}
+    for src in sources:
+        for n in ast.walk(src.tree):
+            if isinstance(n, ast.Call) \
+                    and call_name(n) == "add_argument":
+                for a in n.args:
+                    if isinstance(a, ast.Constant) \
+                            and isinstance(a.value, str) \
+                            and a.value.startswith("--"):
+                        out.setdefault(a.value, site(src, n))
+    return out
+
+
+def check_flags(doc: str | None = None) -> list[Violation]:
+    vs: list[Violation] = []
+    if doc is None:
+        doc = OPERATIONS.read_text()
+    server_flags = collect_flags(load_sources(["server/main.py"]))
+    for flag, where in sorted(server_flags.items()):
+        # Word-boundary match: '--trace' must not ride on the
+        # documented '--trace-dir' (substring containment would let
+        # any prefix-of-a-documented-flag pass undetected).
+        if not re.search(re.escape(flag) + r"(?![a-z0-9-])", doc):
+            vs.append(Violation(
+                "doc-coherence/undocumented-flag", where,
+                f"server flag '{flag}' is not mentioned anywhere in "
+                f"docs/OPERATIONS.md"))
+
+    # Reverse: every --token the doc mentions must exist somewhere.
+    known = dict(server_flags)
+    known.update(collect_flags(load_sources(
+        ["client", "benchmarks"], root=PKG_ROOT.parent) +
+        load_sources(["client"])))
+    for sh in sorted((REPO_ROOT / "scripts").glob("*.sh")):
+        for tok in re.findall(r"--[a-z][a-z0-9-]*", sh.read_text()):
+            known.setdefault(tok, str(sh))
+    for tok in sorted(set(re.findall(r"`(--[a-z][a-z0-9-]*)", doc))):
+        if tok not in known:
+            vs.append(Violation(
+                "doc-coherence/orphan-flag", "docs/OPERATIONS.md",
+                f"documented flag '{tok}' is registered by no entry "
+                f"point (server/CLI/bench/scripts)"))
+    return vs
+
+
+def run() -> list[Violation]:
+    return check_metrics() + check_flags()

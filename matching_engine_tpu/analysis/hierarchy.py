@@ -1,0 +1,172 @@
+"""The declared lock hierarchy — the single source of truth the
+lock-order analyzer checks the extracted acquisition graph against.
+
+This file is *reviewed configuration*, not code: when you add a lock or
+a new nesting, declare it here (and regenerate docs/CONCURRENCY.md via
+`python -m matching_engine_tpu.analysis render-concurrency`) or the
+analyzer fails tier-1. The rules it encodes are the ones each of which
+was the site of a real bug caught late in review:
+
+- the hub lock (StreamHub._lock) is the serialization point every
+  serving lane's publish path funnels through; the sequencer and
+  auditor locks nest INSIDE it, never the other way;
+- nothing reachable while holding the hub lock may touch SQLite or
+  materialize protos (the subscriber-gated drop-copy fan-out is the one
+  reviewed waiver below) — a blocked publish stalls every lane;
+- the auditor's probe lock serializes PROBERS only and is taken
+  OUTSIDE the auditor lock, so the hub→auditor publish path can never
+  wait on a SQL probe;
+- every lock acquisition is `with`-scoped (no bare .acquire() without a
+  try/finally release).
+"""
+
+from __future__ import annotations
+
+# -- lock identities ---------------------------------------------------------
+#
+# level name -> the (Class.attr | module.attr) spellings that are this
+# logical lock. Subclasses that touch an inherited lock attribute list
+# their own spelling too (the analyzer keys sites by the enclosing
+# class it can see).
+
+LEVELS: dict[str, tuple[str, ...]] = {
+    "hub": ("StreamHub._lock",),
+    "sequencer": ("FeedSequencer._lock",),
+    "auditor": ("InvariantAuditor._lock",),
+    "auditor_probe": ("InvariantAuditor._probe_lock",),
+    "store": ("Storage._lock",),
+    # Two distinct locks: the spilling wrapper legitimately holds its
+    # own lock while handing off to the inner async sink.
+    "sink_spill": ("SpillingSink._lock",),
+    "sink": ("AsyncStorageSink._lock",),
+    "dispatch": ("EngineRunner._dispatch_lock",
+                 "NativeLanesRunner._dispatch_lock"),
+    "snapshot": ("EngineRunner._snapshot_lock",
+                 "NativeLanesRunner._snapshot_lock"),
+    "id": ("EngineRunner._id_lock", "NativeLanesRunner._id_lock"),
+    "owner_flush": ("EngineRunner._owner_flush_lock",
+                    "NativeLanesRunner._owner_flush_lock"),
+    "gw_stream": ("GatewayBridge._stream_lock",),
+}
+
+# -- the declared partial order ---------------------------------------------
+#
+# (outer, inner): holding `outer`, acquiring `inner` is legal. The
+# analyzer takes the transitive closure; an extracted edge that
+# contradicts the closure is an INVERSION, an edge between two declared
+# levels that appears in neither direction is UNDECLARED (declare it
+# here, deliberately, or restructure the code). Locks not named in
+# LEVELS are tracked for the graph/doc and cycle check only.
+
+ORDER: tuple[tuple[str, str], ...] = (
+    # The publish funnel: every serving lane serializes through the hub;
+    # stamping (sequencer) and online surveillance (auditor) nest inside
+    # so stamp order == delivery order == audit order across K lanes.
+    ("hub", "sequencer"),
+    ("hub", "auditor"),
+    # Probers (sink-commit hook vs audit-pump cadence) serialize on the
+    # probe lock FIRST, then report verdicts under the auditor lock —
+    # SQL itself runs between the two, under probe only.
+    ("auditor_probe", "auditor"),
+    # The dispatch path: one dispatch at a time; the device-commit
+    # snapshot and the oid/symbol directory nest inside it. The auction
+    # path publishes its results while still holding the dispatch lock
+    # (all-or-nothing fan-out), so the whole publish funnel nests here.
+    ("dispatch", "snapshot"),
+    ("dispatch", "id"),
+    ("dispatch", "hub"),
+    ("dispatch", "auditor_probe"),
+    # Checkpointing quiesces dispatches, then walks the directory and
+    # reads the store.
+    ("dispatch", "store"),
+    ("dispatch", "owner_flush"),
+    ("owner_flush", "store"),
+    ("owner_flush", "id"),
+    # Recovery/restore paths snapshot the directory while reading rows.
+    ("id", "store"),
+    # The async sink's queue lock guards handoff only; the flush thread
+    # takes store inside it when draining synchronously. The spilling
+    # wrapper hands off to the inner sink under its own lock.
+    ("sink_spill", "sink"),
+    ("sink", "store"),
+)
+
+# -- effects forbidden while holding a lock ---------------------------------
+#
+# level -> effect kinds that must not be reachable (lexically or through
+# any resolvable call chain) while the lock is held.
+#   "sqlite": any sqlite3 connection/cursor call
+#   "proto":  pb2 message construction (proto materialization)
+
+FORBIDDEN_UNDER: dict[str, tuple[str, ...]] = {
+    "hub": ("sqlite", "proto"),
+    # The hub-locked publish path feeds the auditor inline: SQL under
+    # the auditor lock would stall every publishing lane (probes run
+    # under auditor_probe only — PR 8's review rule, now enforced).
+    "auditor": ("sqlite",),
+    "snapshot": ("sqlite",),   # the device step holds it; never block on IO
+}
+
+# -- reviewed waivers --------------------------------------------------------
+#
+# (rule, holder_level, reached_function_or_site) triples the review
+# explicitly accepted, each with a justification. Keep this list SHORT:
+# a waiver is a documented debt, not an escape hatch.
+
+WAIVERS: frozenset[tuple[str, str, str]] = frozenset({
+    # Drop-copy fan-out: wire events for LIVE audit subscribers
+    # materialize inside the hub lock by design — stamping and fan-out
+    # must be atomic across K publishing lanes, and the subscriber-less
+    # steady state never enters this branch (PR 8; the retained form is
+    # the row chunk, protos are copy-on-replay).
+    ("lock-order/forbidden-effect", "hub", "materialize_chunk"),
+})
+
+# -- receiver typing for call resolution ------------------------------------
+#
+# Attribute/variable name -> the analyzed class it holds, None for
+# external types the analyzer must not resolve into (their methods
+# never take tracked locks), or "sqlite3" for DB handles (calls through
+# them ARE the sqlite effect).
+
+ATTR_TYPES: dict[str, str | None] = {
+    "hub": "StreamHub",
+    "stream_hub": "StreamHub",
+    "sequencer": "FeedSequencer",
+    "auditor": "InvariantAuditor",
+    "storage": "Storage",
+    "store": "Storage",
+    "sink": "AsyncStorageSink",
+    "_inner": "AsyncStorageSink",   # SpillingSink wraps the async sink
+    "dom": "RetransmissionRing",    # feed replay's per-domain ring
+    "runner": "EngineRunner",
+    "dispatcher": "BatchDispatcher",
+    "publisher": "DropCopyPublisher",
+    "pump": "AuditPump",
+    "conn": "sqlite3",
+    "_conn": "sqlite3",
+    "cur": "sqlite3",
+    "cursor": "sqlite3",
+    # External leaves: their methods never acquire tracked locks, and
+    # several share method names with analyzed classes (Metrics.observe
+    # vs InvariantAuditor.observe).
+    "metrics": None,
+    "q": None,
+    "queue": None,
+    "logger": None,
+    "tracer": None,
+    "recorder": None,
+}
+
+# -- callback bindings -------------------------------------------------------
+#
+# Calls through a bare parameter name the analyzer cannot resolve
+# statically, bound to their one real production target. The hub's
+# `observer` hook is how the auditor consumes delivered seqs INSIDE the
+# hub lock (stamp order across lanes) — the binding makes the
+# hub->auditor edge visible to the graph instead of invisible behind a
+# closure.
+
+CALLBACK_BINDINGS: dict[str, tuple[str, ...]] = {
+    "observer": ("InvariantAuditor.observe_rows",),
+}

@@ -1,0 +1,361 @@
+"""jit-purity / donation analyzer.
+
+Walks every `jax.jit`-rooted function in engine/, parallel/ and sim/
+(decorated defs, `x = jax.jit(f, ...)` bindings, and jit-of-shard_map
+compositions) plus everything they transitively call in those modules,
+and enforces the rules the donated-book kernels live by:
+
+- purity: no host-impure calls (time/random/IO/print) inside traced
+  code — at trace time they freeze one ambient value into the compiled
+  artifact, the classic silent-wrong-kernel bug;
+- donation: a jitted callable with `donate_argnums` must never be
+  passed the same buffer expression in two positions (XLA would alias a
+  donated input), and construction of the donated pytrees (BookBatch)
+  must not feed one array object to two fields — `engine/book.py`'s
+  init_book comment is this rule in prose;
+- version-compat: `jax.experimental.shard_map` / `check_rep=` must not
+  be used directly anywhere in the package — every mesh call routes
+  through utils/jax_compat (the PR 4 triage convention), which owns the
+  0.4.x/0.5.x spelling skew.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from matching_engine_tpu.analysis.common import (
+    PKG_ROOT,
+    Source,
+    Violation,
+    call_name,
+    dotted,
+    load_sources,
+    site,
+)
+
+JIT_SCAN_DIRS = ("engine", "parallel", "sim")
+
+# Pytrees whose construction feeds donated buffers: duplicate argument
+# expressions alias what donation will invalidate.
+DONATED_PYTREES = frozenset({"BookBatch"})
+
+# Host-impure call prefixes (first dotted segment / first two segments).
+_IMPURE_HEADS = frozenset({"time", "random", "datetime", "os", "uuid",
+                           "secrets", "socket"})
+_IMPURE_PAIRS = frozenset({"np.random", "numpy.random"})
+_IMPURE_BARE = frozenset({"open", "print", "input"})
+
+_COMPAT_MODULE = "jax_compat"
+
+
+def _is_impure_call(node: ast.Call) -> str | None:
+    d = dotted(node.func)
+    if d is None:
+        return None
+    head = d.split(".", 1)[0]
+    pair = ".".join(d.split(".")[:2])
+    if d in _IMPURE_BARE:
+        return d
+    if pair in _IMPURE_PAIRS:
+        return d
+    if head in _IMPURE_HEADS and "." in d:
+        return d
+    return None
+
+
+def _int_tuple(node: ast.expr) -> tuple[int, ...]:
+    """Literal donate_argnums/static_argnums value -> positions."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+class _JitRoots(ast.NodeVisitor):
+    """Find jit roots + jitted-callable donation signatures in one
+    module."""
+
+    def __init__(self, src: Source):
+        self.src = src
+        self.roots: list[tuple[str, str]] = []       # (func name, site)
+        self.jitted: dict[str, tuple[int, ...]] = {}  # callable -> donated
+        self.assigns: dict[str, ast.expr] = {}        # local name -> value
+
+    def _jit_call(self, node: ast.expr) -> ast.Call | None:
+        """The jax.jit(...) call inside a decorator/assign value, if
+        any: jax.jit(f, ...) or partial(jax.jit, ...)."""
+        if not isinstance(node, ast.Call):
+            return None
+        d = dotted(node.func)
+        if d in ("jax.jit", "jit"):
+            return node
+        if d in ("partial", "functools.partial") and node.args:
+            inner = dotted(node.args[0])
+            if inner in ("jax.jit", "jit"):
+                return node
+        return None
+
+    def _donated(self, call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return _int_tuple(kw.value)
+        return ()
+
+    def _resolve_fn_name(self, node: ast.expr) -> str | None:
+        """jax.jit's first argument -> the module-level def it traces:
+        a bare Name, possibly through a local `mapped = shard_map(fn,
+        ...)` binding."""
+        if isinstance(node, ast.Name):
+            v = self.assigns.get(node.id)
+            if v is None:
+                return node.id
+            return self._resolve_fn_name(v)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and d.rsplit(".", 1)[-1] in ("shard_map", "vmap", "pmap"):
+                return self._resolve_fn_name(node.args[0]) \
+                    if node.args else None
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.assigns[t.id] = node.value
+        call = self._jit_call(node.value)
+        if call is not None and call.args:
+            fn = self._resolve_fn_name(call.args[0])
+            if fn is not None:
+                self.roots.append((fn, site(self.src, node)))
+            for t in node.targets:
+                name = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None)
+                if name:
+                    self.jitted[name] = self._donated(call)
+        self.generic_visit(node)
+
+    def _visit_def(self, node) -> None:
+        for dec in node.decorator_list:
+            d = dotted(dec)
+            if d in ("jax.jit", "jit"):
+                self.roots.append((node.name, site(self.src, node)))
+                self.jitted[node.name] = ()
+            call = self._jit_call(dec)
+            if call is not None:
+                self.roots.append((node.name, site(self.src, node)))
+                self.jitted[node.name] = self._donated(call)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def _module_functions(src: Source) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for n in src.tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[n.name] = n
+    return out
+
+
+def _imports(src: Source) -> dict[str, tuple[str, str]]:
+    out: dict[str, tuple[str, str]] = {}
+    for n in ast.walk(src.tree):
+        if isinstance(n, ast.ImportFrom) and n.module:
+            for a in n.names:
+                out[a.asname or a.name] = (n.module, a.name)
+    return out
+
+
+def check_traced_purity(sources: list[Source]) -> list[Violation]:
+    """Rule jit-purity/impure-call over the traced closure."""
+    vs: list[Violation] = []
+    fns: dict[str, tuple[Source, ast.AST]] = {}
+    imports: dict[str, dict[str, tuple[str, str]]] = {}
+    roots: list[tuple[str, str, str]] = []   # (mod, fn, site)
+    for src in sources:
+        mod = src.modname
+        for name, node in _module_functions(src).items():
+            fns[f"{mod}.{name}"] = (src, node)
+        imports[mod] = _imports(src)
+        jr = _JitRoots(src)
+        jr.visit(src.tree)
+        for fn, w in jr.roots:
+            roots.append((mod, fn, w))
+
+    # Transitive closure of traced functions, name-resolved through
+    # module locals and package imports.
+    traced: dict[str, str] = {}   # qual -> root site that pulled it in
+    stack = []
+    for mod, fn, w in roots:
+        qual = f"{mod}.{fn}"
+        if qual in fns and qual not in traced:
+            traced[qual] = w
+            stack.append(qual)
+    while stack:
+        qual = stack.pop()
+        src, node = fns[qual]
+        mod = qual.rsplit(".", 1)[0]
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            name = call_name(n)
+            if name is None:
+                continue
+            callee = f"{mod}.{name}"
+            if callee not in fns:
+                bound = imports.get(mod, {}).get(name)
+                callee = f"{bound[0]}.{bound[1]}" if bound else ""
+            if callee in fns and callee not in traced:
+                traced[callee] = traced[qual]
+                stack.append(callee)
+
+    for qual in sorted(traced):
+        src, node = fns[qual]
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                imp = _is_impure_call(n)
+                if imp is not None:
+                    vs.append(Violation(
+                        "jit-purity/impure-call", site(src, n),
+                        f"host-impure call {imp}() inside jit-traced "
+                        f"{qual} (traced via {traced[qual]}) — the value "
+                        f"freezes at trace time"))
+    return vs
+
+
+def check_donation(sources: list[Source],
+                   call_sources: list[Source]) -> list[Violation]:
+    """Rules jit-purity/double-donation and /aliased-pytree."""
+    vs: list[Violation] = []
+    jitted: dict[str, tuple[int, ...]] = {}
+    for src in sources:
+        jr = _JitRoots(src)
+        jr.visit(src.tree)
+        for name, don in jr.jitted.items():
+            if don:
+                jitted[name] = don
+
+    def norm(e: ast.expr) -> str | None:
+        """Comparable form for alias detection: only simple names /
+        attribute chains (two calls like z() are distinct buffers)."""
+        return dotted(e)
+
+    def is_buffer_dup(r: str, assigns: dict[str, ast.expr]) -> bool:
+        """A duplicated expression aliases donated *buffers* only if it
+        can hold an array. A bare name locally bound to a non-array
+        constructor (PartitionSpec etc.) is shared metadata, not a
+        buffer — parallel/sharding.py's spec pytrees are built that
+        way on purpose."""
+        if "." in r:
+            return True                    # book.next_seq-style chains
+        binding = assigns.get(r)
+        if isinstance(binding, ast.Call):
+            d = dotted(binding.func) or ""
+            return d.split(".", 1)[0] in ("jnp", "np", "jax", "jaxlib")
+        return True                        # parameter/outer: assume buffer
+
+    for src in call_sources:
+        scopes: list[tuple[ast.AST, dict[str, ast.expr]]] = []
+        for fn in ast.walk(src.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Module)):
+                assigns = {}
+                for a in ast.walk(fn):
+                    if isinstance(a, ast.Assign):
+                        for t in a.targets:
+                            if isinstance(t, ast.Name):
+                                assigns[t.id] = a.value
+                scopes.append((fn, assigns))
+        scope_of: dict[ast.AST, dict[str, ast.expr]] = {}
+        for fn, assigns in scopes:
+            for n in ast.walk(fn):
+                scope_of[n] = assigns       # innermost wins (walk order)
+        for n in ast.walk(src.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            name = call_name(n)
+            don = jitted.get(name or "")
+            if don:
+                rendered = [norm(a) for a in n.args]
+                for pos in don:
+                    if pos >= len(rendered) or rendered[pos] is None:
+                        continue
+                    for j, other in enumerate(rendered):
+                        if j != pos and other == rendered[pos]:
+                            vs.append(Violation(
+                                "jit-purity/double-donation",
+                                site(src, n),
+                                f"{name}() receives `{other}` at donated "
+                                f"position {pos} and again at position "
+                                f"{j} — a donated buffer may not alias "
+                                f"another argument"))
+            if name in DONATED_PYTREES:
+                seen: dict[str, str] = {}
+                fields = [(f"arg{j}", a) for j, a in enumerate(n.args)]
+                fields += [(kw.arg or "**", kw.value) for kw in n.keywords]
+                for fname, expr in fields:
+                    r = norm(expr)
+                    if r is None:
+                        continue
+                    if r in seen and is_buffer_dup(r, scope_of.get(n, {})):
+                        vs.append(Violation(
+                            "jit-purity/aliased-pytree", site(src, n),
+                            f"{name}(...) feeds `{r}` to both "
+                            f"'{seen[r]}' and '{fname}' — donated "
+                            f"pytree fields must be distinct buffers "
+                            f"(engine/book.py init_book rule)"))
+                    else:
+                        seen[r] = fname
+    return vs
+
+
+def check_compat_routing(pkg_sources: list[Source]) -> list[Violation]:
+    """Rule jit-purity/compat-bypass: direct jax.experimental.shard_map
+    or check_rep spelling outside utils/jax_compat.py."""
+    vs: list[Violation] = []
+    for src in pkg_sources:
+        if src.path.stem == _COMPAT_MODULE:
+            continue
+        if src.path.parts[-2:][0] == "analysis":
+            continue   # this package names the symbols in its rules
+        for n in ast.walk(src.tree):
+            if isinstance(n, ast.ImportFrom) and n.module and \
+                    n.module.startswith("jax.experimental"):
+                names = {a.name for a in n.names}
+                if "shard_map" in names or \
+                        n.module.endswith("shard_map"):
+                    vs.append(Violation(
+                        "jit-purity/compat-bypass", site(src, n),
+                        "direct jax.experimental.shard_map import — "
+                        "route through utils/jax_compat.shard_map "
+                        "(owns the 0.4.x/0.5.x spelling skew)"))
+            elif isinstance(n, ast.Attribute):
+                d = dotted(n)
+                if d in ("jax.experimental.shard_map.shard_map",
+                         "jax.experimental.shard_map"):
+                    vs.append(Violation(
+                        "jit-purity/compat-bypass", site(src, n),
+                        f"direct {d} use — route through "
+                        f"utils/jax_compat.shard_map"))
+            elif isinstance(n, ast.Call):
+                for kw in n.keywords:
+                    if kw.arg == "check_rep":
+                        vs.append(Violation(
+                            "jit-purity/compat-bypass", site(src, n),
+                            "check_rep= is the pre-0.5 spelling — pass "
+                            "check_vma= through utils/jax_compat"))
+    return vs
+
+
+def run() -> list[Violation]:
+    jit_sources = load_sources(JIT_SCAN_DIRS)
+    pkg_sources = load_sources([""], root=PKG_ROOT)
+    vs = check_traced_purity(jit_sources)
+    vs += check_donation(jit_sources, pkg_sources)
+    vs += check_compat_routing(pkg_sources)
+    return vs

@@ -1,0 +1,556 @@
+"""Lock-order analyzer: extract the lock acquisition graph from every
+`with <lock>` / `.acquire()` site across the serving stack and check it
+against the declared hierarchy (analysis/hierarchy.py).
+
+How it works (pure AST, no imports of the code under analysis):
+
+1. Every function/method in the scanned modules is summarized: which
+   locks it acquires lexically, which side effects it performs (sqlite
+   calls, pb2 proto construction), and every call it makes together
+   with the locks held at that call site.
+2. Calls resolve conservatively: `self.x()` through the enclosing class
+   (and analyzed bases), `obj.x()` through the receiver-type table
+   (hierarchy.ATTR_TYPES), callbacks through the declared bindings
+   (hierarchy.CALLBACK_BINDINGS), and otherwise by method name across
+   all analyzed classes — over-approximation by design: a spurious
+   resolution is tuned away in ATTR_TYPES, a missed one would hide a
+   deadlock.
+3. Summaries propagate to a fixpoint, yielding the transitive
+   "acquires" and "effects" sets per function and an edge set
+   holder-lock -> acquired-lock with a witness chain per edge.
+4. The edge set is checked against hierarchy.ORDER (inversions,
+   undeclared nestings, re-acquisition of a held lock, cycles) and
+   hierarchy.FORBIDDEN_UNDER (sqlite / proto materialization reachable
+   under the hub or snapshot lock). `.acquire()` calls outside a
+   try/finally-released discipline are flagged wholesale.
+
+The same machinery renders docs/CONCURRENCY.md (see render.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from matching_engine_tpu.analysis import hierarchy
+from matching_engine_tpu.analysis.common import (
+    Source,
+    Violation,
+    call_name,
+    dotted,
+    load_sources,
+    receiver_name,
+    site,
+)
+
+# Scanned surface: the concurrency-bearing layers. utils/checkpoint.py
+# rides along because it quiesces the dispatch lock from outside server/.
+SCAN_DIRS = ("server", "feed", "audit", "storage", "native",
+             "utils/checkpoint.py")
+
+_SQLITE_RECEIVERS = frozenset(
+    a for a, t in hierarchy.ATTR_TYPES.items() if t == "sqlite3")
+_SQLITE_METHODS = frozenset({
+    "execute", "executemany", "executescript", "commit", "cursor",
+    "fetchone", "fetchall", "fetchmany", "rollback",
+})
+
+_ID_TO_LEVEL: dict[str, str] = {
+    ident: level
+    for level, idents in hierarchy.LEVELS.items()
+    for ident in idents
+}
+
+_DECLARED = frozenset(hierarchy.LEVELS)
+
+
+def _order_closure() -> frozenset[tuple[str, str]]:
+    edges = set(hierarchy.ORDER)
+    changed = True
+    while changed:
+        changed = False
+        for a, b in list(edges):
+            for c, d in list(edges):
+                if b == c and (a, d) not in edges:
+                    edges.add((a, d))
+                    changed = True
+    return frozenset(edges)
+
+
+_CLOSURE = _order_closure()
+
+
+@dataclasses.dataclass
+class CallSite:
+    name: str
+    recv: str | None
+    held: tuple[str, ...]   # lock identities held at the call
+    where: str
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str           # module.Class.method | module.func
+    module: str
+    cls: str | None
+    name: str
+    # lexical facts
+    acquires: dict[str, str] = dataclasses.field(default_factory=dict)
+    effects: dict[str, str] = dataclasses.field(default_factory=dict)
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    edges: list[tuple[str, str, str]] = dataclasses.field(
+        default_factory=list)  # (holder_id, lock_id, witness)
+    bare_acquires: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+    # fixpoint summaries: lock/effect -> witness chain
+    trans_acquires: dict[str, str] = dataclasses.field(default_factory=dict)
+    trans_effects: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _is_lockish(attr: str) -> bool:
+    return "lock" in attr.lower()
+
+
+class _Analyzer(ast.NodeVisitor):
+    """Extracts FuncInfo for every def in one module."""
+
+    def __init__(self, src: Source):
+        self.src = src
+        self.module = src.modname
+        self.cls: str | None = None
+        self.fn: FuncInfo | None = None
+        self.held: list[str] = []
+        self.funcs: list[FuncInfo] = []
+        self.classes: dict[str, list[str]] = {}   # class -> base names
+        # Locks released in an enclosing `finally:` — an .acquire()
+        # covered by one is disciplined, everything else is bare.
+        self.finally_released: list[set[str]] = []
+        # Call-node ids of acquire-then-try disciplined acquires
+        # (computed per function def).
+        self.exempt_acquires: set[int] = set()
+        # `from pkg.mod import name [as alias]` bindings (module and
+        # function scope alike): alias -> (full module path, name), so
+        # bare-name calls to imported functions resolve cross-module.
+        self.imports: dict[str, tuple[str, str]] = {}
+        # Names bound to pb2 message classes (`OU = pb2.OrderUpdate`):
+        # calling one IS proto materialization.
+        self.proto_aliases: set[str] = set()
+        for n in ast.walk(src.tree):
+            if isinstance(n, ast.ImportFrom) and n.module:
+                for a in n.names:
+                    self.imports[a.asname or a.name] = (n.module, a.name)
+            elif isinstance(n, ast.Assign):
+                d = dotted(n.value)
+                if d and d.startswith("pb2."):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            self.proto_aliases.add(t.id)
+
+    # -- identity helpers --------------------------------------------------
+
+    def _lock_id(self, node: ast.expr) -> str | None:
+        """Map a lock expression to its identity, or None if the
+        expression is not lock-like."""
+        if isinstance(node, ast.Name):
+            if _is_lockish(node.id):
+                return f"{self.module}.{node.id}"
+            return None
+        if not isinstance(node, ast.Attribute) or not _is_lockish(node.attr):
+            return None
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            owner = self.cls or self.module
+        elif isinstance(base, ast.Name):
+            owner = hierarchy.ATTR_TYPES.get(base.id) or f"?{base.id}"
+        elif isinstance(base, ast.Attribute):
+            owner = hierarchy.ATTR_TYPES.get(base.attr) or f"?{base.attr}"
+        else:
+            owner = "?"
+        return f"{owner}.{node.attr}"
+
+    def _is_sqlite_cm(self, node: ast.expr) -> bool:
+        """`with self._conn:` — a transaction context manager."""
+        if isinstance(node, ast.Attribute):
+            return node.attr in _SQLITE_RECEIVERS
+        if isinstance(node, ast.Name):
+            return node.id in _SQLITE_RECEIVERS
+        return False
+
+    # -- structure ---------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self.cls
+        self.cls = node.name
+        self.classes[node.name] = [
+            b.id for b in node.bases if isinstance(b, ast.Name)
+        ]
+        self.generic_visit(node)
+        self.cls = prev
+
+    def _visit_def(self, node) -> None:
+        prev_fn, prev_held = self.fn, self.held
+        prev_exempt = self.exempt_acquires
+        qual = (f"{self.module}.{self.cls}.{node.name}" if self.cls
+                else f"{self.module}.{node.name}")
+        if prev_fn is not None:        # nested def (closure): own summary,
+            qual = f"{prev_fn.qualname}.<locals>.{node.name}"
+        self.fn = FuncInfo(qual, self.module, self.cls, node.name)
+        self.held = []                 # a closure runs on its caller's
+        self.funcs.append(self.fn)     # stack, modeled via bindings
+        self.exempt_acquires = self._acquire_then_try(node)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.fn, self.held = prev_fn, prev_held
+        self.exempt_acquires = prev_exempt
+
+    def _acquire_then_try(self, fn_node) -> set[int]:
+        """Call-node ids of the conventional disciplined shape
+
+            lock.acquire()
+            try: ...
+            finally: lock.release()
+
+        — the acquire PRECEDES the try, so the finally-stack check in
+        visit_Try cannot see it."""
+        out: set[int] = set()
+        for n in ast.walk(fn_node):
+            for attr in ("body", "orelse", "finalbody"):
+                stmts = getattr(n, attr, None)
+                if not isinstance(stmts, list):
+                    continue
+                for a, b in zip(stmts, stmts[1:]):
+                    if not (isinstance(a, ast.Expr)
+                            and isinstance(a.value, ast.Call)
+                            and isinstance(a.value.func, ast.Attribute)
+                            and a.value.func.attr == "acquire"
+                            and isinstance(b, ast.Try)):
+                        continue
+                    lid = self._lock_id(a.value.func.value)
+                    if lid is None:
+                        continue
+                    for f in ast.walk(ast.Module(body=b.finalbody,
+                                                 type_ignores=[])):
+                        if (isinstance(f, ast.Call)
+                                and isinstance(f.func, ast.Attribute)
+                                and f.func.attr == "release"
+                                and self._lock_id(f.func.value) == lid):
+                            out.add(id(a.value))
+        return out
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    # -- lock events -------------------------------------------------------
+
+    def _do_with(self, node) -> None:
+        if self.fn is None:
+            self.generic_visit(node)
+            return
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            lid = self._lock_id(expr)
+            if lid is not None:
+                w = site(self.src, expr)
+                self.fn.acquires.setdefault(lid, w)
+                for holder in self.held:
+                    self.fn.edges.append((holder, lid, w))
+                self.held.append(lid)
+                pushed += 1
+            elif self._is_sqlite_cm(expr):
+                self._effect("sqlite", site(self.src, expr))
+            else:
+                self.visit(expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_With = _do_with
+    visit_AsyncWith = _do_with
+
+    def visit_Try(self, node: ast.Try) -> None:
+        released: set[str] = set()
+        for stmt in ast.walk(ast.Module(body=node.finalbody,
+                                        type_ignores=[])):
+            if (isinstance(stmt, ast.Call)
+                    and isinstance(stmt.func, ast.Attribute)
+                    and stmt.func.attr == "release"):
+                lid = self._lock_id(stmt.func.value)
+                if lid is not None:
+                    released.add(lid)
+        self.finally_released.append(released)
+        for stmt in node.body + node.handlers + node.orelse:
+            self.visit(stmt)
+        self.finally_released.pop()
+        for stmt in node.finalbody:
+            self.visit(stmt)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda body runs on some later caller's stack, not here —
+        # attributing its calls to the current held set would be wrong
+        # in both directions; deliberate callbacks go through
+        # hierarchy.CALLBACK_BINDINGS instead.
+        return
+
+    def _effect(self, kind: str, where: str) -> None:
+        self.fn.effects.setdefault(kind, where)
+        for holder in self.held:
+            # Lexical effect-under-lock rides the edge list with a
+            # pseudo-target so the checker sees it uniformly.
+            self.fn.edges.append((holder, f"effect:{kind}", where))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.fn is None:
+            self.generic_visit(node)
+            return
+        name = call_name(node)
+        recv = receiver_name(node)
+        where = site(self.src, node)
+        if name is not None:
+            # Bare .acquire() discipline (with-scoped locking only).
+            if name == "acquire" and isinstance(node.func, ast.Attribute):
+                lid = self._lock_id(node.func.value)
+                if lid is not None \
+                        and id(node) not in self.exempt_acquires \
+                        and not any(
+                            lid in s for s in self.finally_released):
+                    self.fn.bare_acquires.append((where, lid))
+            # Effects.
+            d = dotted(node.func)
+            if ((recv in _SQLITE_RECEIVERS and name in _SQLITE_METHODS)
+                    or (d or "").startswith("sqlite3.")):
+                self._effect("sqlite", where)
+            elif ((recv == "pb2" and name[:1].isupper())
+                  or (recv is None and name in self.proto_aliases)):
+                self._effect("proto", where)
+            else:
+                self.fn.calls.append(
+                    CallSite(name, recv, tuple(self.held), where))
+        self.generic_visit(node)
+
+
+# -- cross-module resolution -------------------------------------------------
+
+
+class Graph:
+    """The whole-program result: function summaries + the lock graph."""
+
+    def __init__(self, sources: list[Source]):
+        self.funcs: dict[str, FuncInfo] = {}
+        self.by_method: dict[str, list[FuncInfo]] = {}
+        self.by_class: dict[str, dict[str, FuncInfo]] = {}
+        self.bases: dict[str, list[str]] = {}
+        self.bare_acquire_sites: list[tuple[str, str]] = []
+        self.mod_imports: dict[str, dict[str, str]] = {}
+        for src in sources:
+            a = _Analyzer(src)
+            a.visit(src.tree)
+            self.bases.update(a.classes)
+            self.mod_imports[a.module] = a.imports
+            for f in a.funcs:
+                self.funcs[f.qualname] = f
+                self.by_method.setdefault(f.name, []).append(f)
+                if f.cls:
+                    self.by_class.setdefault(f.cls, {})[f.name] = f
+                self.bare_acquire_sites.extend(f.bare_acquires)
+        self._fixpoint()
+        self.edges = self._collect_edges()
+
+    # -- call resolution ---------------------------------------------------
+
+    def _lookup(self, cls: str | None, name: str) -> FuncInfo | None:
+        seen = set()
+        while cls and cls not in seen:
+            seen.add(cls)
+            m = self.by_class.get(cls, {}).get(name)
+            if m is not None:
+                return m
+            b = self.bases.get(cls) or []
+            cls = b[0] if b else None
+        return None
+
+    def resolve(self, caller: FuncInfo, c: CallSite) -> list[FuncInfo]:
+        if c.name in hierarchy.CALLBACK_BINDINGS:
+            out = []
+            for target in hierarchy.CALLBACK_BINDINGS[c.name]:
+                tcls, tname = target.rsplit(".", 1)
+                m = self._lookup(tcls, tname)
+                if m is not None:
+                    out.append(m)
+            return out
+        if c.recv is None:
+            # Bare name: module-local function, else an imported one
+            # (`from pkg.mod import f` -> pkg.mod.f; a package import
+            # resolves into its __init__ module).
+            m = self.funcs.get(f"{caller.module}.{c.name}")
+            if m is None:
+                bound = self.mod_imports.get(caller.module, {}).get(c.name)
+                if bound:
+                    mod, name = bound
+                    m = (self.funcs.get(f"{mod}.{name}")
+                         or self.funcs.get(f"{mod}.__init__.{name}"))
+            return [m] if m is not None else []
+        if c.recv == "self":
+            m = self._lookup(caller.cls, c.name)
+            return [m] if m is not None else []
+        if c.recv in hierarchy.ATTR_TYPES:
+            t = hierarchy.ATTR_TYPES[c.recv]
+            if t is None or t == "sqlite3":
+                return []
+            m = self._lookup(t, c.name)
+            return [m] if m is not None else []
+        # Unknown receiver: conservative name-based fan-out.
+        return self.by_method.get(c.name, [])
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for f in self.funcs.values():
+            f.trans_acquires = dict(f.acquires)
+            f.trans_effects = dict(f.effects)
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs.values():
+                for c in f.calls:
+                    for callee in self.resolve(f, c):
+                        for lid, w in callee.trans_acquires.items():
+                            if lid not in f.trans_acquires:
+                                f.trans_acquires[lid] = \
+                                    f"{c.where} -> {w}"
+                                changed = True
+                        for eff, w in callee.trans_effects.items():
+                            if eff not in f.trans_effects:
+                                f.trans_effects[eff] = \
+                                    f"{c.where} -> {w}"
+                                changed = True
+
+    def _collect_edges(self) -> dict[tuple[str, str], str]:
+        """(holder_id, target) -> first witness. target is a lock id or
+        'effect:<kind>' pseudo-node, or 'leaf:<qualname>' annotations
+        are folded into the witness text."""
+        edges: dict[tuple[str, str], str] = {}
+        for f in sorted(self.funcs.values(), key=lambda x: x.qualname):
+            for holder, target, w in f.edges:
+                edges.setdefault((holder, target), w)
+            for c in f.calls:
+                if not c.held:
+                    continue
+                for callee in self.resolve(f, c):
+                    for lid, w in callee.trans_acquires.items():
+                        for holder in c.held:
+                            edges.setdefault(
+                                (holder, lid),
+                                f"{c.where} -> {callee.qualname} ({w})")
+                    for eff, w in callee.trans_effects.items():
+                        for holder in c.held:
+                            edges.setdefault(
+                                (holder, f"effect:{eff}"),
+                                f"{c.where} -> {callee.qualname} ({w})")
+        return edges
+
+
+def level_of(lock_id: str) -> str:
+    """Declared level name, or the raw identity for untracked locks."""
+    return _ID_TO_LEVEL.get(lock_id, lock_id)
+
+
+def _leaf_function(witness: str) -> str:
+    """The last resolved function in a witness chain, for waiver
+    matching (waivers name what is REACHED, not the path)."""
+    leaf = ""
+    for tok in witness.replace("(", " ").replace(")", " ").split():
+        if tok and not tok[0].isdigit() and "/" not in tok and tok != "->":
+            leaf = tok
+    return leaf.rsplit(".", 1)[-1] if leaf else ""
+
+
+def check(graph: Graph) -> list[Violation]:
+    vs: list[Violation] = []
+
+    # 1/2/3: ordering over the extracted edge set.
+    level_edges: dict[tuple[str, str], str] = {}
+    for (holder, target), w in sorted(graph.edges.items()):
+        if target.startswith("effect:"):
+            continue
+        ha, ta = level_of(holder), level_of(target)
+        if (ha, ta) not in level_edges:
+            level_edges[(ha, ta)] = w
+    for (ha, ta), w in sorted(level_edges.items()):
+        if ha == ta:
+            vs.append(Violation(
+                "lock-order/self-deadlock", w,
+                f"'{ha}' re-acquired while already held "
+                f"(threading.Lock is not reentrant)"))
+        elif ha in _DECLARED and ta in _DECLARED:
+            if (ta, ha) in _CLOSURE:
+                vs.append(Violation(
+                    "lock-order/inversion", w,
+                    f"'{ta}' must be acquired before '{ha}' per the "
+                    f"declared hierarchy, but '{ha}' is held here"))
+            elif (ha, ta) not in _CLOSURE:
+                vs.append(Violation(
+                    "lock-order/undeclared-edge", w,
+                    f"'{ha}' -> '{ta}' nesting is not declared in "
+                    f"analysis/hierarchy.py ORDER — declare it "
+                    f"deliberately or restructure"))
+
+    # Cycles among untracked locks (tracked ones are covered above).
+    adj: dict[str, set[str]] = {}
+    for (ha, ta) in level_edges:
+        if ha != ta:
+            adj.setdefault(ha, set()).add(ta)
+    state: dict[str, int] = {}
+
+    def dfs(n: str, path: list[str]) -> None:
+        state[n] = 1
+        for m in sorted(adj.get(n, ())):
+            if state.get(m, 0) == 1:
+                cyc = path[path.index(m):] + [m] if m in path else [n, m]
+                if not all(x in _DECLARED for x in cyc):
+                    vs.append(Violation(
+                        "lock-order/cycle", " -> ".join(cyc + [cyc[0]]),
+                        "cyclic lock acquisition (deadlock window)"))
+            elif state.get(m, 0) == 0:
+                dfs(m, path + [m])
+        state[n] = 2
+
+    for n in sorted(adj):
+        if state.get(n, 0) == 0:
+            dfs(n, [n])
+
+    # 4: forbidden effects under declared locks.
+    for (holder, target), w in sorted(graph.edges.items()):
+        if not target.startswith("effect:"):
+            continue
+        eff = target.split(":", 1)[1]
+        lvl = level_of(holder)
+        if eff in hierarchy.FORBIDDEN_UNDER.get(lvl, ()):
+            leaf = _leaf_function(w)
+            if ("lock-order/forbidden-effect", lvl, leaf) \
+                    in hierarchy.WAIVERS:
+                continue
+            what = ("SQLite call" if eff == "sqlite"
+                    else "proto materialization")
+            vs.append(Violation(
+                "lock-order/forbidden-effect", w,
+                f"{what} reachable while holding '{lvl}'"))
+
+    # 5: bare .acquire() discipline. (try/finally-scoped acquires are
+    # rewritten as `with` in this codebase; any .acquire() is a defect.)
+    for where, lid in sorted(graph.bare_acquire_sites):
+        vs.append(Violation(
+            "lock-order/bare-acquire", where,
+            f"bare {lid}.acquire() — use a `with` block (or a "
+            f"try/finally that provably releases)"))
+
+    return vs
+
+
+def build_graph() -> Graph:
+    return Graph(load_sources(SCAN_DIRS))
+
+
+def run() -> list[Violation]:
+    return check(build_graph())

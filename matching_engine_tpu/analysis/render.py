@@ -1,0 +1,105 @@
+"""Render docs/CONCURRENCY.md from the declared hierarchy + the
+extracted acquisition graph. The committed file must match the
+regenerated text byte-for-byte (tier-1 pins it) — the doc can never
+drift from what the analyzer actually proves.
+"""
+
+from __future__ import annotations
+
+from matching_engine_tpu.analysis import hierarchy, lockorder
+from matching_engine_tpu.analysis.common import REPO_ROOT
+
+_HEADER = """\
+# CONCURRENCY — the lock hierarchy, as enforced
+
+> GENERATED FILE — do not edit by hand. Regenerate with
+> `python -m matching_engine_tpu.analysis render-concurrency`
+> after changing `matching_engine_tpu/analysis/hierarchy.py` or any
+> locking code. `tests/test_analysis.py` fails tier-1 when this file
+> is stale, and `scripts/check.sh` gates the rules themselves.
+
+Every rule below is *checked statically* by the lock-order analyzer
+(`matching_engine_tpu/analysis/lockorder.py`) on every tier-1 run: the
+acquisition graph is re-extracted from the AST of `server/`, `feed/`,
+`audit/`, `storage/`, `native/` and `utils/checkpoint.py`, and compared
+against the declared hierarchy. A new `with <lock>` that nests two
+declared locks in an undeclared order fails the build — amending this
+hierarchy is a reviewed edit to `analysis/hierarchy.py`, not a comment.
+
+## The rules
+
+- **Declared order only.** Holding lock A while acquiring lock B is
+  legal only if A→B is in the declared partial order below (or B is
+  untracked). The inverse order anywhere is a deadlock window.
+- **Nothing slow under the hub lock.** The hub (`StreamHub._lock`) is
+  the one point every serving lane's publish path serializes through:
+  SQLite calls and proto materialization are forbidden under it (one
+  reviewed waiver: the subscriber-gated drop-copy fan-out, which must
+  stamp and deliver atomically).
+- **No SQL under the auditor lock.** Store probes connect and query
+  under `auditor_probe` only; the hub→auditor publish path never waits
+  on SQLite.
+- **`with`-scoped locking only.** A bare `.acquire()` without a
+  provable `finally: release()` is flagged wholesale.
+
+## Declared levels
+
+| Level | Lock object(s) |
+|---|---|
+"""
+
+_AMEND = """\
+
+## Amending the hierarchy
+
+1. Add the lock to `LEVELS` in `matching_engine_tpu/analysis/hierarchy.py`
+   (one level per *logical* lock; list every class spelling that holds it).
+2. Declare its nesting in `ORDER` — think about which existing level it
+   must nest inside or outside, and keep the relation a DAG.
+3. If a callback hides an edge from the AST (the hub's `observer` hook),
+   bind it in `CALLBACK_BINDINGS` so the edge stays visible.
+4. Run `python -m matching_engine_tpu.analysis render-concurrency` and
+   commit the regenerated file together with the code.
+
+A waiver (`WAIVERS`) needs a justification comment and review — it is a
+documented debt, not an escape hatch.
+"""
+
+
+def render() -> str:
+    graph = lockorder.build_graph()
+    out = [_HEADER]
+    for level in sorted(hierarchy.LEVELS):
+        idents = ", ".join(f"`{i}`" for i in hierarchy.LEVELS[level])
+        out.append(f"| `{level}` | {idents} |\n")
+
+    out.append("\n## Declared order (outer → inner)\n\n")
+    for a, b in hierarchy.ORDER:
+        out.append(f"- `{a}` → `{b}`\n")
+
+    out.append("\n## Extracted acquisition graph (with witnesses)\n\n"
+               "Every edge the analyzer currently observes in the tree, "
+               "with the first witness site (call chains abbreviated to "
+               "their entry point):\n\n")
+    lvl_edges: dict[tuple[str, str], str] = {}
+    for (h, t), w in sorted(graph.edges.items()):
+        key = (lockorder.level_of(h), lockorder.level_of(t))
+        lvl_edges.setdefault(key, w)
+    for (ha, ta), w in sorted(lvl_edges.items()):
+        w0 = w.split(" -> ")[0]
+        label = ta.replace("effect:", "⚠ effect: ")
+        out.append(f"- `{ha}` → `{label}` — `{w0}`\n")
+
+    out.append("\n## Reviewed waivers\n\n")
+    for rule, holder, leaf in sorted(hierarchy.WAIVERS):
+        out.append(f"- `{rule}` under `{holder}` reaching `{leaf}` "
+                   f"(see the justification in hierarchy.py)\n")
+    out.append(_AMEND)
+    return "".join(out)
+
+
+def write(path=None) -> str:
+    p = path or (REPO_ROOT / "docs" / "CONCURRENCY.md")
+    text = render()
+    p.write_text(text)
+    return str(p)

@@ -548,10 +548,14 @@ class InvariantAuditor:
         vs the pump cadence): the hub-lock → auditor-lock publish path
         must never wait on SQLite."""
         with self._probe_lock:
+            # Connect (and memoize) OUTSIDE the auditor lock: _conn is a
+            # probers-only resource and sqlite3.connect can block on the
+            # filesystem — under _lock it would stall the hub-locked
+            # publish path (the lock-order analyzer pins this).
+            conn = self._db()
+            if conn is None:
+                return
             with self._lock:
-                conn = self._db()
-                if conn is None:
-                    return
                 n = min(limit, len(self._store_pending))
                 entries = []
                 for _ in range(n):
@@ -658,7 +662,9 @@ class InvariantAuditor:
             }
 
     def close(self) -> None:
-        with self._lock:
+        # _conn is probers-only state: serialize on the probe lock, not
+        # the auditor lock (SQLite teardown never blocks observe_rows).
+        with self._probe_lock:
             if self._conn is not None:
                 try:
                     self._conn.close()

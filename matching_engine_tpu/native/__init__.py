@@ -108,9 +108,27 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not ensure_built():
-            return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        # ME_NATIVE_LIB points the whole wrapper stack at an alternate
+        # build of libme_native.so — the sanitizer smoke (ASan/UBSan
+        # variants from scripts/build_native.sh --sanitize=...) runs the
+        # codec/ring/lane fuzz through the same python surface it
+        # normally serves. No staleness check: the override owner built
+        # it deliberately.
+        override = os.environ.get("ME_NATIVE_LIB")
+        if override:
+            # An explicit override must fail LOUDLY: silently falling
+            # back to the default (or pure-python) runtime would let a
+            # sanitizer run believe it tested an instrumented build it
+            # never loaded. available() maps any OSError (including
+            # this FileNotFoundError) to False for callers that probe.
+            if not os.path.exists(override):
+                raise FileNotFoundError(
+                    f"ME_NATIVE_LIB={override} does not exist")
+            lib = ctypes.CDLL(override)
+        else:
+            if not ensure_built():
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
         lib.me_normalize_to_q4.argtypes = [
             ctypes.c_longlong, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong)
         ]

@@ -6,6 +6,7 @@
 # protobuf-free native-lib target; this is the full entry point).
 #
 #   scripts/build_native.sh [--lib-only] [--force] [--out-dir DIR]
+#                           [--sanitize={address,undefined,thread}]
 #
 # --lib-only   build just libme_native.so (lane engine + ring + sink;
 #              needs only a C++20 compiler, sqlite3 and zlib sonames)
@@ -13,6 +14,14 @@
 # --out-dir    emit artifacts into DIR instead of the package tree
 #              (the smoke test builds into a scratch dir so a test run
 #              never swaps the .so under a live process)
+# --sanitize   build a sanitizer-instrumented lane library instead:
+#              libme_native.<asan|ubsan|tsan>.so (implies --lib-only,
+#              always -B; -O1 -g, frame pointers kept). Load it into a
+#              python process via ME_NATIVE_LIB=<path> with the matching
+#              runtime LD_PRELOADed (an uninstrumented interpreter needs
+#              the sanitizer runtime resident first) — that is exactly
+#              what the skip-guarded codec-fuzz smoke in
+#              tests/test_build_native.py does.
 #
 # The gateway library + CLI client additionally need protoc and the
 # protobuf C++ headers; when they are absent those targets are skipped
@@ -25,6 +34,8 @@ cd "$(dirname "$0")/../native"
 LIB_ONLY=0
 FORCE=()
 PKG_OVERRIDE=()
+OUT_DIR=""
+SANITIZE=""
 while [ $# -gt 0 ]; do
   case "$1" in
     --lib-only) LIB_ONLY=1 ;;
@@ -33,8 +44,11 @@ while [ $# -gt 0 ]; do
       shift
       mkdir -p "$1"
       # Command-line make variables override the Makefile's PKG :=.
-      PKG_OVERRIDE=("PKG=$(cd "$1" && pwd)")
+      OUT_DIR="$(cd "$1" && pwd)"
+      PKG_OVERRIDE=("PKG=$OUT_DIR")
       ;;
+    --sanitize=*) SANITIZE="${1#--sanitize=}" ;;
+    --sanitize) shift; SANITIZE="$1" ;;
     *) echo "unknown arg: $1" >&2; exit 2 ;;
   esac
   shift
@@ -42,6 +56,34 @@ done
 
 CXX="${CXX:-g++}"
 command -v "$CXX" >/dev/null || { echo "no C++ compiler ($CXX)" >&2; exit 1; }
+
+if [ -n "$SANITIZE" ]; then
+  case "$SANITIZE" in
+    address)   SUFFIX=asan ;;
+    undefined) SUFFIX=ubsan ;;
+    thread)    SUFFIX=tsan ;;
+    *) echo "unknown sanitizer: $SANITIZE (address|undefined|thread)" >&2
+       exit 2 ;;
+  esac
+  if [ -z "$OUT_DIR" ]; then
+    # Building in-tree would first overwrite the production .so and
+    # then rename it away — a sanitized build always goes to a scratch
+    # dir and is loaded explicitly via ME_NATIVE_LIB.
+    echo "--sanitize requires --out-dir DIR (never builds in-tree)" >&2
+    exit 2
+  fi
+  DIR="$OUT_DIR"
+  # Same recipe as the Makefile's native-lib target (the make run below
+  # IS that recipe, with the hardening flags layered on): -O1 keeps the
+  # sanitizer's line info honest, frame pointers keep its stacks whole.
+  # -fsanitize=thread subsumes nothing: each variant is its own build.
+  make -B "${PKG_OVERRIDE[@]}" native-lib \
+    CXXFLAGS="-O1 -g -std=c++20 -fPIC -Wall -Wextra -pthread \
+-fno-omit-frame-pointer -fsanitize=$SANITIZE"
+  mv "$DIR/libme_native.so" "$DIR/libme_native.$SUFFIX.so"
+  echo "built: libme_native.$SUFFIX.so (-fsanitize=$SANITIZE)"
+  exit 0
+fi
 
 make "${FORCE[@]}" "${PKG_OVERRIDE[@]}" native-lib
 echo "built: libme_native.so"

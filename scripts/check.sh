@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# The one-shot static gate: every invariant checker this repo ships,
+# chained, exit nonzero on any violation.
+#
+#   scripts/check.sh [--json FILE] [--sanitize]
+#
+# Steps (each independently skippable only by missing toolchain, never
+# silently):
+#   1. the static-analysis suite (matching_engine_tpu/analysis/):
+#      lock-order vs the declared hierarchy, jit-purity/donation,
+#      py<->C++ ABI layouts, metric/flag <-> docs coherence
+#   2. docs/CONCURRENCY.md freshness (generated from the same graph)
+#   3. the tier-1 doc-lint (tests/test_obs.py) — the original
+#      metric-table drift guard the suite generalizes
+#   4. ruff, pinned in pyproject.toml and scoped to matching_engine_tpu/
+#      (skipped with a notice when the image lacks ruff), plus a
+#      compileall syntax gate that always runs
+#   5. [--sanitize] the ASan/UBSan codec-fuzz smokes
+#      (tests/test_build_native.py; needs g++ + sanitizer runtimes)
+#
+# --json FILE writes a machine-readable summary artifact (per-step
+# status + every analyzer violation) for CI to archive.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+JSON_OUT=""
+SANITIZE=0
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --json) shift; JSON_OUT="$1" ;;
+    --sanitize) SANITIZE=1 ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+  shift
+done
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+FAIL=0
+declare -A STATUS
+ANALYSIS_JSON="$(mktemp /tmp/me_analysis.XXXXXX.json)"
+trap 'rm -f "$ANALYSIS_JSON"' EXIT
+
+step() {  # step <name> <cmd...>
+  local name="$1"; shift
+  echo "==> $name"
+  if "$@"; then
+    STATUS[$name]=pass
+  else
+    STATUS[$name]=fail
+    FAIL=1
+  fi
+}
+
+step analysis python -m matching_engine_tpu.analysis run \
+  --json "$ANALYSIS_JSON"
+step concurrency-doc python -m matching_engine_tpu.analysis \
+  render-concurrency --check
+step doc-lint python -m pytest tests/test_obs.py \
+  -k operations_doc -q -p no:cacheprovider
+step syntax python -m compileall -q matching_engine_tpu
+
+if command -v ruff >/dev/null; then
+  step ruff ruff check matching_engine_tpu
+else
+  echo "==> ruff: not in this image, skipping (pyproject.toml pins the"
+  echo "    rule set; any image with ruff runs the identical gate)"
+  STATUS[ruff]=skipped
+fi
+
+if [ "$SANITIZE" = 1 ]; then
+  if command -v g++ >/dev/null && command -v make >/dev/null; then
+    step sanitizer-smoke python -m pytest tests/test_build_native.py \
+      -k sanitized -q -p no:cacheprovider
+  else
+    echo "==> sanitizer-smoke: no C++ toolchain, skipping"
+    STATUS[sanitizer-smoke]=skipped
+  fi
+fi
+
+if [ -n "$JSON_OUT" ]; then
+  STATUS_DUMP=""
+  for k in "${!STATUS[@]}"; do STATUS_DUMP+="$k=${STATUS[$k]} "; done
+  STEPS="$STATUS_DUMP" ANALYSIS="$ANALYSIS_JSON" OUT="$JSON_OUT" \
+  python - <<'EOF'
+import json, os
+steps = dict(kv.split("=") for kv in os.environ["STEPS"].split())
+with open(os.environ["ANALYSIS"]) as f:
+    analysis = json.load(f)
+with open(os.environ["OUT"], "w") as f:
+    json.dump({"steps": steps, "analysis": analysis,
+               "ok": all(v != "fail" for v in steps.values())},
+              f, indent=2, sort_keys=True)
+print(f"summary: {os.environ['OUT']}")
+EOF
+fi
+
+if [ "$FAIL" = 0 ]; then
+  echo "check.sh: all gates green"
+else
+  echo "check.sh: FAILED (see above)" >&2
+fi
+exit $FAIL
