@@ -5,6 +5,18 @@ invariants that exist only as prose and runtime fuzz everywhere else:
               (hub→auditor ordering, nothing slow under the hub lock,
               with-scoped locking) — analysis/hierarchy.py is the
               declaration, docs/CONCURRENCY.md the rendered contract;
+- lockset:    Eraser-style race detection — shared state reachable from
+              two thread roles (hierarchy.THREAD_ROLES) must share a
+              lock or carry a reviewed OWNERSHIP policy (single-writer,
+              init-before-spawn, gil-atomic, instance-confined);
+- determinism: taint from nondeterminism sources (wall clock, random,
+              id(), thread ids, unordered iteration) to the replay
+              surfaces (store rows, feed/drop-copy payloads, seq
+              stamps, checkpoints) — the HA replica's bit-identity
+              contract, with declared wall-clock fields allowlisted;
+- lifecycle:  the order-status machine extracted from its FOUR
+              implementations (proto enum, python engine, me_lanes.cpp,
+              auditor `_LEGAL`) and proven equal;
 - jitpurity:  jax.jit purity (no host-impure calls in traced code),
               donation discipline (no double-donated / aliased
               buffers), and the utils/jax_compat routing convention;
@@ -27,17 +39,23 @@ from matching_engine_tpu.analysis.common import Violation  # noqa: F401
 
 
 def run_all() -> dict[str, list[Violation]]:
-    """All four analyzers, keyed by name. Import inside so `import
+    """All seven analyzers, keyed by name. Import inside so `import
     matching_engine_tpu.analysis` stays cheap for tooling."""
     from matching_engine_tpu.analysis import (
         abi,
+        determinism,
         doccheck,
         jitpurity,
+        lifecycle,
         lockorder,
+        lockset,
     )
 
     return {
         "lock-order": lockorder.run(),
+        "lockset": lockset.run(),
+        "determinism": determinism.run(),
+        "lifecycle": lifecycle.run(),
         "jit-purity": jitpurity.run(),
         "abi": abi.run(),
         "doc-coherence": doccheck.run(),

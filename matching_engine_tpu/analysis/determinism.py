@@ -1,0 +1,627 @@
+"""Determinism-taint analyzer: machine-enforce the bit-identical-replay
+contract the HA design (ROADMAP item 3, arXiv:2402.09527) rests on.
+
+The replay surfaces — storage row construction, feed / drop-copy
+payloads, seq stamping, checkpoint contents — must be pure functions of
+the sequenced op log. Today that is review prose plus parity tests that
+only cover the schedules the tests happen to run. This analyzer walks
+the replay closure statically:
+
+1. SINKS are discovered structurally: any function that appends to the
+   storage/stream row lists, constructs a wire row (FillRow,
+   pb2.OrderUpdate/MarketDataUpdate and their aliases), stamps
+   `.seq`/`.feed_epoch`/`.next_seq`, writes SQL in storage/, or writes
+   checkpoint blocks. The replay closure is those functions plus
+   everything they transitively call (lockorder's conservative call
+   resolution: receiver typing, imports, closures).
+2. determinism/forbidden-source: random / np.random / uuid / secrets /
+   os.urandom / thread identifiers anywhere in the replay closure.
+   These have no legitimate use on a replay path, so plain reachability
+   suffices — no dataflow needed.
+3. determinism/wallclock-taint: a real (interprocedural, fixpoint)
+   taint pass from wall-clock reads (`time.*`, `datetime.*`) and
+   `id()` to the sink expressions. Taint flows through local
+   assignments, attribute stores (`self.epoch = time.time()…` taints
+   every later `sequencer.epoch` read), function returns, and call
+   arguments into scanned callees. Observability stamps that feed
+   metrics/timelines never reach a sink expression and therefore never
+   fire — the matcher is the sink, not the source.
+4. determinism/unordered-iteration: set-typed or dict-view iteration
+   (not wrapped in sorted()) that feeds a sink expression — hash-order
+   (PYTHONHASHSEED) and thread-insertion-order dependence on a replay
+   surface.
+
+Fields *declared* wall-clock — ingress timestamps in the drop-copy
+envelope, the per-boot feed epoch, the store's audit `ts` columns — are
+allowlisted in hierarchy.DETERMINISM_WAIVERS with a witness each, so
+the replica's bit-identity contract is explicit about exactly which
+bytes are exempt (and parity comparisons normalize exactly those).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from matching_engine_tpu.analysis import hierarchy
+from matching_engine_tpu.analysis.common import (
+    Violation,
+    call_name,
+    dotted,
+    load_sources,
+    site,
+)
+from matching_engine_tpu.analysis.lockorder import CallSite, Graph
+
+# The replay-bearing packages: both serving paths' decode/publish
+# layers, the feed, the audit stream, durable storage, the record
+# codecs, the engine harness, and checkpointing.
+REPLAY_SCAN_DIRS = ("server", "feed", "audit", "storage", "domain",
+                    "engine", "utils/checkpoint.py")
+
+# Rule 2 — sources with no legitimate replay-path use (reachability).
+_FORBIDDEN_HEADS = ("random.", "np.random.", "numpy.random.", "uuid.",
+                    "secrets.")
+_FORBIDDEN_CALLS = frozenset({
+    "os.urandom", "threading.get_ident", "threading.current_thread",
+    "get_ident", "current_thread",
+})
+
+# Rule 3 — wall-clock family (taint-tracked, waivable per declared
+# field) plus id(): address-derived values change every run.
+_WALLCLOCK_HEADS = ("time.", "datetime.")
+_TAINT_BARE = frozenset({"id"})
+
+_OUTPUT_LISTS = frozenset({
+    "storage_orders", "storage_updates", "storage_fills",
+    "order_updates", "market_data",
+})
+_ROW_CTORS = frozenset({"FillRow", "OrderUpdate", "MarketDataUpdate"})
+_STAMP_ATTRS = frozenset({"seq", "feed_epoch", "next_seq"})
+_CKPT_WRITERS = frozenset({"savez", "savez_compressed", "dump",
+                           "_atomic_checkpoint_write"})
+_SQL_WRITERS = frozenset({"execute", "executemany", "executescript"})
+
+
+def _shallow_walk(node):
+    """Pre-order, document-order walk that does not descend into nested
+    defs/lambdas (their bodies belong to their own FuncInfo). Document
+    order matters: the taint pass relies on def-before-use converging
+    within its two statement sweeps."""
+    stack = list(ast.iter_child_nodes(node))[::-1]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(list(ast.iter_child_nodes(n))[::-1])
+
+
+def _forbidden_call(node: ast.Call) -> str | None:
+    d = dotted(node.func)
+    if d is None:
+        return None
+    if d in _FORBIDDEN_CALLS:
+        return d
+    for head in _FORBIDDEN_HEADS:
+        if d.startswith(head):
+            return d
+    return None
+
+
+def _wallclock_call(node: ast.Call) -> str | None:
+    """Taint origin for a source call: the wall-clock family and id(),
+    PLUS the forbidden-source family. Rule 1 catches forbidden sources
+    inside the sink→callee closure by reachability; seeding the taint
+    pass with them too closes the caller direction — RNG computed in a
+    caller and passed as an argument into a sink function still reaches
+    the sink as `<origin>-derived`."""
+    d = dotted(node.func)
+    if d is None:
+        return None
+    if d in _TAINT_BARE or d in _FORBIDDEN_CALLS:
+        return d
+    for head in _WALLCLOCK_HEADS + _FORBIDDEN_HEADS:
+        if d.startswith(head):
+            return d
+    return None
+
+
+class _Sinks:
+    """Structural sink matchers for one function, module-aware (proto
+    aliases, storage-only SQL)."""
+
+    def __init__(self, graph: Graph, f):
+        self.graph = graph
+        self.f = f
+        self.aliases = graph.proto_aliases.get(f.module, set())
+        self.in_storage = ".storage." in f.module \
+            or f.module.endswith(".storage")
+
+    def output_call(self, node: ast.Call) -> str | None:
+        """A call whose ARGUMENTS are replay payload, or None."""
+        name = call_name(node)
+        if name in ("append", "extend") \
+                and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            attr = base.attr if isinstance(base, ast.Attribute) else (
+                base.id if isinstance(base, ast.Name) else None)
+            if attr in _OUTPUT_LISTS:
+                return f"{attr}.{name}"
+        if name in _ROW_CTORS or name in self.aliases:
+            return f"{name}()"
+        d = dotted(node.func) or ""
+        if name in _CKPT_WRITERS and (
+                d.startswith("np.") or d.startswith("json.")
+                or name == "_atomic_checkpoint_write"):
+            if "checkpoint" in self.f.module:
+                return f"{name}()"
+        if self.in_storage and name in _SQL_WRITERS:
+            return f"{name}()"
+        return None
+
+    def output_assign(self, node) -> str | None:
+        """A store whose TARGET is replay payload (seq stamping)."""
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr in _STAMP_ATTRS:
+                return f".{t.attr} stamp"
+        return None
+
+
+def _params(node) -> list[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    return names
+
+
+def _find_sinks(graph: Graph):
+    """qual -> list of (kind, node) output expressions."""
+    out: dict[str, list] = {}
+    for qual, f in graph.funcs.items():
+        if f.node is None:
+            continue
+        sinks = _Sinks(graph, f)
+        rows = []
+        for n in _shallow_walk(f.node):
+            if isinstance(n, ast.Call):
+                label = sinks.output_call(n)
+                if label:
+                    rows.append((label, n))
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                label = sinks.output_assign(n)
+                if label:
+                    rows.append((label, n))
+        if rows:
+            out[qual] = rows
+    return out
+
+
+def _replay_closure(graph: Graph, seeds) -> dict[str, str]:
+    """qual -> sink root that pulled it in (BFS over resolvable calls
+    and closures)."""
+    reach: dict[str, str] = {q: q for q in seeds}
+    stack = list(seeds)
+    while stack:
+        qual = stack.pop()
+        f = graph.funcs[qual]
+        nxt = [c.qualname for call in f.calls
+               for c in graph.resolve(f, call, skip_generic=True)]
+        nxt += f.closures
+        for cq in nxt:
+            if cq in graph.funcs and cq not in reach:
+                reach[cq] = reach[qual]
+                stack.append(cq)
+    return reach
+
+
+# -- the taint pass ----------------------------------------------------------
+
+
+class _TaintState:
+    def __init__(self):
+        self.params: dict[str, dict[str, str]] = {}   # qual -> {param: origin}
+        self.attrs: dict[str, str] = {}               # Class.attr -> origin
+        self.returns: dict[str, str] = {}             # qual -> origin
+        self.changed = False
+
+    def taint_param(self, qual: str, param: str, origin: str) -> None:
+        d = self.params.setdefault(qual, {})
+        if param not in d:
+            d[param] = origin
+            self.changed = True
+
+    def taint_attr(self, key: str, origin: str) -> None:
+        if key not in self.attrs:
+            self.attrs[key] = origin
+            self.changed = True
+
+    def taint_return(self, qual: str, origin: str) -> None:
+        if qual not in self.returns:
+            self.returns[qual] = origin
+            self.changed = True
+
+
+class _FuncTaint:
+    """One function's forward taint pass (run to a local fixpoint each
+    global iteration; propagates into callees via the shared state)."""
+
+    def __init__(self, graph: Graph, f, state: _TaintState):
+        self.graph = graph
+        self.f = f
+        self.state = state
+        self.local: dict[str, str] = dict(
+            state.params.get(f.qualname, {}))
+
+    def _attr_key(self, node: ast.Attribute) -> str | None:
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self" and self.f.cls:
+            return f"{self.f.cls}.{node.attr}"
+        if isinstance(base, ast.Name) \
+                and base.id in hierarchy.ATTR_TYPES:
+            t = hierarchy.ATTR_TYPES[base.id]
+            if t and t != "sqlite3":
+                return f"{t}.{node.attr}"
+        return None
+
+    def expr_origin(self, node) -> str | None:
+        """Origin token if the expression carries taint. Also runs the
+        call-argument propagation side effect. Constructor calls of
+        scanned classes are a taint BARRIER at the reference level: the
+        new object is clean, but tainted arguments flow into its
+        __init__ params (and from there into attribute taint) — without
+        the barrier, one wall-clock ctor argument (e.g. the spill dir's
+        epoch path) would mark the object and everything later read off
+        it, drowning the true field-level flows."""
+        if node is None or not isinstance(node, ast.AST):
+            return None
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in self.graph.bases:
+                init = self.graph.by_class.get(name, {}).get("__init__")
+                self._propagate_args(node, [init] if init else [])
+                return None
+            w = _wallclock_call(node)
+            resolved, origin = self._call_origin(node)
+            if not resolved:
+                # Unresolved callee (builtin/external): conservatively,
+                # the result of f(tainted) — or of a method on a tainted
+                # object — is tainted. Resolved callees are trusted: the
+                # returns summary already reflects their body.
+                for arg in node.args:
+                    a = arg.value if isinstance(arg, ast.Starred) else arg
+                    origin = origin or self.expr_origin(a)
+                for kw in node.keywords:
+                    origin = origin or self.expr_origin(kw.value)
+                if isinstance(node.func, ast.Attribute):
+                    origin = origin or self.expr_origin(node.func.value)
+            return w or origin
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                return _collapse(self.local.get(node.id))
+            return None
+        if isinstance(node, ast.Attribute):
+            if not isinstance(node.ctx, ast.Load):
+                return None
+            key = self._attr_key(node)
+            if key is not None and key in self.state.attrs:
+                return self.state.attrs[key]
+            return self.expr_origin(node.value)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return None
+        origin = None
+        for child in ast.iter_child_nodes(node):
+            origin = origin or self.expr_origin(child)
+        return origin
+
+    def _call_origin(self, node: ast.Call) -> tuple[bool, str | None]:
+        """Propagate tainted arguments into resolvable callees; return
+        (resolved-to-a-scanned-body, return-taint origin)."""
+        name = call_name(node)
+        if name is None:
+            return False, None
+        cs = CallSite(name, _recv(node), (), "")
+        callees = [c for c in self.graph.resolve(self.f, cs,
+                                                 skip_generic=True)
+                   if c is not None and c.node is not None]
+        if not callees:
+            return False, None
+        return True, self._propagate_args(node, callees)
+
+    def _propagate_args(self, node: ast.Call, callees) -> str | None:
+        origin = None
+        for callee in callees:
+            if callee is None or callee.node is None:
+                continue
+            params = _params(callee.node)
+            if params and params[0] == "self":
+                params = params[1:]
+            pos = 0
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    s = self.expr_struct(arg.value)
+                    if isinstance(s, list):
+                        # *env with a known tuple shape: element-wise.
+                        for j, el in enumerate(s):
+                            o = _collapse(el)
+                            if o and pos + j < len(params):
+                                self.state.taint_param(
+                                    callee.qualname, params[pos + j], o)
+                        pos += len(s)
+                    else:
+                        o = _collapse(s)
+                        if o:
+                            for p in params[pos:]:
+                                self.state.taint_param(
+                                    callee.qualname, p, o)
+                        pos = len(params)
+                    continue
+                s = self.expr_struct(arg)
+                if _collapse(s) is not None and pos < len(params):
+                    self.state.taint_param(callee.qualname, params[pos], s)
+                pos += 1
+            for kw in node.keywords:
+                o = self.expr_struct(kw.value)
+                if _collapse(o) is not None and kw.arg is not None:
+                    self.state.taint_param(callee.qualname, kw.arg, o)
+            ret = self.state.returns.get(callee.qualname)
+            origin = origin or ret
+        return origin
+
+    def expr_struct(self, node):
+        """Structured origin: a literal tuple/list keeps PER-ELEMENT
+        origins, so `rows, md, env, flag = item` taints only the
+        elements that actually carry wall clock — without this, one
+        ingress stamp in a dispatch envelope tuple would mark every row
+        list travelling beside it."""
+        if isinstance(node, (ast.Tuple, ast.List)) \
+                and isinstance(getattr(node, "ctx", ast.Load()), ast.Load):
+            return [self.expr_struct(e) for e in node.elts]
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            return self.local.get(node.id)
+        return self.expr_origin(node)
+
+    def run(self) -> None:
+        for _ in range(2):   # two passes: later stmts can taint earlier uses
+            for n in _shallow_walk(self.f.node):
+                if isinstance(n, ast.Assign):
+                    o = self.expr_struct(n.value)
+                    if _collapse(o) is None:
+                        continue
+                    for t in n.targets:
+                        self._taint_target(t, o)
+                elif isinstance(n, ast.AugAssign):
+                    o = self.expr_origin(n.value)
+                    if o is not None:
+                        self._taint_target(n.target, o)
+                elif isinstance(n, ast.For):
+                    o = self.expr_origin(n.iter)
+                    if o is not None:
+                        self._taint_target(n.target, o)
+                elif isinstance(n, ast.Return) and n.value is not None:
+                    o = self.expr_origin(n.value)
+                    if o is not None:
+                        self.state.taint_return(self.f.qualname, o)
+                elif isinstance(n, ast.Call):
+                    self._call_origin(n)   # plain-statement propagation
+
+    def _taint_target(self, t: ast.expr, origin) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            if isinstance(origin, list) and len(origin) == len(t.elts):
+                for e, o in zip(t.elts, origin):   # element-wise unpack
+                    if _collapse(o) is not None:
+                        self._taint_target(e, o)
+            else:
+                o = _collapse(origin)
+                for e in t.elts:
+                    self._taint_target(e, o)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value, _collapse(origin))
+        elif isinstance(t, ast.Name):
+            if t.id not in self.local:
+                self.local[t.id] = origin
+        elif isinstance(t, ast.Subscript):
+            # env["k"] = tainted: the container now carries the taint.
+            self._taint_target(t.value, _collapse(origin))
+        elif isinstance(t, ast.Attribute):
+            key = self._attr_key(t)
+            if key is not None:
+                self.state.taint_attr(key, _collapse(origin))
+
+
+def _recv(node: ast.Call) -> str | None:
+    from matching_engine_tpu.analysis.common import receiver_name
+
+    return receiver_name(node)
+
+
+def _collapse(o) -> str | None:
+    """Flatten a structured origin (str | list-of-origins | None) to the
+    first concrete source token, or None."""
+    if o is None or isinstance(o, str):
+        return o
+    for e in o:
+        c = _collapse(e)
+        if c is not None:
+            return c
+    return None
+
+
+# -- unordered iteration -----------------------------------------------------
+
+
+class _OrderCheck:
+    """Set-typed / dict-view iteration feeding a sink expression."""
+
+    def __init__(self, graph: Graph, f):
+        self.graph = graph
+        self.f = f
+        # local name -> True when bound to an unordered collection
+        self.unordered_names: set[str] = set()
+        for n in _shallow_walk(f.node):
+            if isinstance(n, ast.Assign) and self._unordered_expr(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.unordered_names.add(t.id)
+
+    def _unordered_expr(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("set", "frozenset"):
+                return True
+            if name in ("list", "tuple", "sorted", "reversed",
+                        "enumerate"):
+                if name == "sorted":
+                    return False
+                return bool(node.args) and \
+                    self._unordered_expr(node.args[0])
+            if name in ("keys", "values", "items") \
+                    and isinstance(node.func, ast.Attribute):
+                return True
+        if isinstance(node, ast.Name):
+            return node.id in self.unordered_names
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            owner = None
+            if isinstance(base, ast.Name) and base.id == "self":
+                owner = self.f.cls
+            if owner is not None:
+                ctor = self.graph.attr_ctors.get(f"{owner}.{node.attr}")
+                if ctor in ("set", "frozenset"):
+                    return True
+        return False
+
+    def check(self, sinks) -> list[tuple[str, ast.AST]]:
+        """(iteration description, sink node) pairs where an unordered
+        iteration encloses or feeds a sink expression."""
+        hits: list[tuple[str, ast.AST]] = []
+        sink_nodes = {id(n) for _, n in sinks}
+
+        def walk(node, loop_unordered: list):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                entered = False
+                if isinstance(child, ast.For) \
+                        and self._unordered_expr(child.iter):
+                    loop_unordered.append(child)
+                    entered = True
+                if id(child) in sink_nodes:
+                    if loop_unordered:
+                        hits.append(("inside unordered loop", child))
+                    # a comprehension over an unordered iterable INSIDE
+                    # the sink expression
+                    for sub in _shallow_walk(child):
+                        if isinstance(sub, (ast.ListComp, ast.GeneratorExp,
+                                            ast.SetComp)):
+                            for gen in sub.generators:
+                                if self._unordered_expr(gen.iter):
+                                    hits.append(
+                                        ("comprehension over unordered "
+                                         "iterable", child))
+                walk(child, loop_unordered)
+                if entered:
+                    loop_unordered.pop()
+
+        walk(self.f.node, [])
+        return hits
+
+
+# -- the checker -------------------------------------------------------------
+
+
+def _short(qual: str) -> str:
+    """module.Class.meth -> Class.meth | pkg.mod.fn -> mod.fn."""
+    parts = qual.split(".")
+    return ".".join(parts[-2:])
+
+
+def check(graph: Graph) -> list[Violation]:
+    vs: list[Violation] = []
+    sinks = _find_sinks(graph)
+    closure = _replay_closure(graph, sorted(sinks))
+
+    # Rule 1: forbidden sources by reachability.
+    for qual in sorted(closure):
+        f = graph.funcs[qual]
+        if f.node is None:
+            continue
+        for n in _shallow_walk(f.node):
+            if isinstance(n, ast.Call):
+                bad = _forbidden_call(n)
+                if bad is not None and not _waived(
+                        "determinism/forbidden-source", qual, bad):
+                    vs.append(Violation(
+                        "determinism/forbidden-source",
+                        site(f.src, n),
+                        f"{bad}() in {_short(qual)}, reachable from "
+                        f"replay sink {_short(closure[qual])} — a replay "
+                        f"surface may never read nondeterminism"))
+
+    # Rule 2: wall-clock/id taint into sink expressions (fixpoint).
+    state = _TaintState()
+    for _ in range(32):
+        state.changed = False
+        for qual in sorted(graph.funcs):
+            f = graph.funcs[qual]
+            if f.node is not None:
+                _FuncTaint(graph, f, state).run()
+        if not state.changed:
+            break
+    for qual in sorted(sinks):
+        f = graph.funcs[qual]
+        ft = _FuncTaint(graph, f, state)
+        ft.run()    # rebuild local taint for the final read
+        for label, node in sinks[qual]:
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                origin = ft.expr_origin(
+                    node.value if node.value is not None else node)
+            else:
+                origin = None
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    a = arg.value if isinstance(arg, ast.Starred) else arg
+                    origin = origin or ft.expr_origin(a)
+            if origin is not None and not _waived(
+                    "determinism/wallclock-taint", qual, origin):
+                vs.append(Violation(
+                    "determinism/wallclock-taint", site(f.src, node),
+                    f"{origin}-derived value reaches replay output "
+                    f"{label} in {_short(qual)} — declare the field "
+                    f"wall-clock in hierarchy.DETERMINISM_WAIVERS or "
+                    f"derive it from the op log"))
+
+    # Rule 3: unordered iteration feeding sink expressions.
+    for qual in sorted(sinks):
+        f = graph.funcs[qual]
+        oc = _OrderCheck(graph, f)
+        for why, node in oc.check(sinks[qual]):
+            if not _waived("determinism/unordered-iteration", qual, why):
+                vs.append(Violation(
+                    "determinism/unordered-iteration", site(f.src, node),
+                    f"replay output in {_short(qual)} built {why} — "
+                    f"set/dict iteration order is not replay-stable; "
+                    f"sort it"))
+    return list(dict.fromkeys(vs))
+
+
+def _waived(rule: str, qual: str, token: str) -> bool:
+    short = _short(qual)
+    for r, fn, tok in hierarchy.DETERMINISM_WAIVERS:
+        if r == rule and fn == short and (tok == "*" or tok == token
+                                          or token.startswith(tok)):
+            return True
+    return False
+
+
+def build_graph() -> Graph:
+    return Graph(load_sources(REPLAY_SCAN_DIRS))
+
+
+def run() -> list[Violation]:
+    return check(build_graph())

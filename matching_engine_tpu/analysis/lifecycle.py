@@ -1,0 +1,468 @@
+"""Cross-language order-lifecycle equivalence checker.
+
+The order-status state machine exists in FOUR independent
+implementations, any of which can silently drift when a new status or
+order type lands in only some of them:
+
+- the proto enum (`OrderUpdate.Status`, matching_engine.proto) — the
+  wire VOCABULARY and numeric values;
+- the python engine layer (engine/oracle.py binds the names to the
+  proto values; server/engine_runner.py applies status updates to live
+  orders and rejects ops on terminal ones);
+- the C++ lane engine (native/me_lanes.cpp: kNew..kRejected constants,
+  the terminal guard, and the store_updates status writes);
+- the online auditor (audit/auditor.py: the explicit `_LEGAL`
+  transition table the shadow state machine enforces).
+
+Each layer is reduced to the same machine shape and the four are proven
+equal:
+
+  vocabulary   {status name -> numeric value} (value None where a layer
+               defers to the proto, e.g. the oracle's pb2 bindings)
+  terminal     statuses from which no update may depart (the
+               cancel/amend-on-dead guard in both engines, `_TERMINAL`
+               in the auditor)
+  relation     the (from -> to) update transitions. For the engines it
+               is CONSTRUCTED from what the code can actually write to
+               a live order: literal update statuses (CANCELED), the
+               maker fill ternary (PARTIALLY_FILLED/FILLED), and
+               status-PRESERVING updates (amend re-emits the current
+               status => self-loops). For the auditor it is read
+               directly off `_LEGAL`.
+
+A status added to the proto but not the auditor, a terminal set that
+differs between the C++ and python engines, or a new transition taught
+to one layer only — each fails scripts/check.sh until all four agree.
+
+Every extractor takes its source text/AST as an injectable parameter
+(defaulting to the real tree) so the self-tests can prove each skew
+class fires; an extractor that stops parsing its layer reports
+lifecycle/extract-error rather than vacuous agreement.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from matching_engine_tpu.analysis.common import (
+    REPO_ROOT,
+    Violation,
+    load_sources,
+)
+
+_PROTO = REPO_ROOT / "matching_engine_tpu" / "proto" / "matching_engine.proto"
+_ME_LANES = REPO_ROOT / "native" / "me_lanes.cpp"
+
+_STATUS_NAMES = ("NEW", "PARTIALLY_FILLED", "FILLED", "CANCELED",
+                 "REJECTED")
+
+
+@dataclasses.dataclass
+class Machine:
+    layer: str
+    vocab: dict[str, int | None]
+    terminal: frozenset[str] | None          # None: layer doesn't define
+    relation: frozenset[tuple[str, str]] | None
+    errors: list[str] = dataclasses.field(default_factory=list)
+
+
+def _relation_from_updates(vocab, terminal, targets,
+                           preserving: bool) -> frozenset:
+    """The machine an engine layer implies: from any live status, the
+    statuses its update writes can produce, plus self-loops when a
+    status-preserving update (amend) exists. Terminal statuses have no
+    out-edges — the terminal guard rejects the op before the device
+    sees it."""
+    live = [s for s in vocab if s not in terminal]
+    rel = {(s, t) for s in live for t in targets}
+    if preserving:
+        rel |= {(s, s) for s in live}
+    return frozenset(rel)
+
+
+# -- proto -------------------------------------------------------------------
+
+
+def proto_machine(text: str | None = None) -> Machine:
+    if text is None:
+        text = _PROTO.read_text()
+    m = Machine("proto", {}, None, None)
+    em = re.search(r"enum\s+Status\s*\{([^}]*)\}", text)
+    if em is None:
+        m.errors.append("enum Status not found in matching_engine.proto")
+        return m
+    for name, val in re.findall(r"(\w+)\s*=\s*(\d+)\s*;", em.group(1)):
+        m.vocab[name] = int(val)
+    if not m.vocab:
+        m.errors.append("enum Status parsed empty")
+    return m
+
+
+# -- auditor -----------------------------------------------------------------
+
+
+def auditor_machine(tree: ast.Module | None = None) -> Machine:
+    if tree is None:
+        path = REPO_ROOT / "matching_engine_tpu" / "audit" / "auditor.py"
+        tree = ast.parse(path.read_text())
+    m = Machine("auditor", {}, None, None)
+    legal: dict[str, tuple[str, ...]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            # NEW, PARTIALLY_FILLED, ... = range(5)
+            if isinstance(t, ast.Tuple) and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id == "range":
+                names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+                if len(names) == len(t.elts):
+                    m.vocab = {n: i for i, n in enumerate(names)}
+            elif isinstance(t, ast.Name) and t.id == "_TERMINAL" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                m.terminal = frozenset(
+                    e.id for e in node.value.elts
+                    if isinstance(e, ast.Name))
+            elif isinstance(t, ast.Name) and t.id == "_LEGAL" \
+                    and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Name) \
+                            and isinstance(v, (ast.Tuple, ast.List)):
+                        legal[k.id] = tuple(
+                            e.id for e in v.elts
+                            if isinstance(e, ast.Name))
+    if not m.vocab:
+        m.errors.append("status tuple-assign from range() not found")
+    if m.terminal is None:
+        m.errors.append("_TERMINAL not found")
+    if not legal:
+        m.errors.append("_LEGAL not found")
+    else:
+        m.relation = frozenset(
+            (src, dst) for src, dsts in legal.items() for dst in dsts)
+    return m
+
+
+# -- python engine (oracle vocabulary + engine_runner machine) ---------------
+
+
+def _status_tuple(node: ast.expr) -> frozenset[str] | None:
+    """A (FILLED, CANCELED, ...) literal tuple of status names."""
+    if not isinstance(node, (ast.Tuple, ast.List)) or not node.elts:
+        return None
+    names = [e.id for e in node.elts if isinstance(e, ast.Name)
+             and e.id in _STATUS_NAMES]
+    if len(names) != len(node.elts):
+        return None
+    return frozenset(names)
+
+
+def _sub_blocks(stmt) -> list[list]:
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field, None)
+        if isinstance(b, list) and b and all(
+                isinstance(x, ast.stmt) for x in b):
+            out.append(b)
+    for h in getattr(stmt, "handlers", None) or []:
+        if h.body:
+            out.append(h.body)
+    return out
+
+
+def _expr_walk(stmt):
+    """The statement's own expressions — stops at nested statements
+    (those belong to inner blocks and are scanned with their own
+    cursor)."""
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if not isinstance(c, ast.stmt)]
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(c for c in ast.iter_child_nodes(n)
+                     if not isinstance(c, ast.stmt))
+
+
+def _block_resolves(append_stmt, parents, container,
+                    path: str) -> frozenset[str] | None:
+    """Scan the statement blocks enclosing `append_stmt` (innermost
+    first, DIRECT statements only — a sibling branch's assignment must
+    not leak in) for the latest `path.status = <literal | ternary>`
+    before the append. None => no literal assignment dominates: the
+    update PRESERVES the order's current status (the amend shape)."""
+    cursor = append_stmt
+    for block in parents.get(id(append_stmt), []):
+        found = None   # ("lit", names) | ("nonlit",)
+        for stmt in block:
+            if stmt is cursor:
+                break
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Attribute) and t.attr == "status" \
+                        and ast.unparse(t.value) == path:
+                    v = stmt.value
+                    if isinstance(v, ast.Name) and v.id in _STATUS_NAMES:
+                        found = ("lit", frozenset({v.id}))
+                    elif isinstance(v, ast.IfExp) \
+                            and isinstance(v.body, ast.Name) \
+                            and isinstance(v.orelse, ast.Name):
+                        found = ("lit",
+                                 frozenset({v.body.id, v.orelse.id}))
+                    else:
+                        found = ("nonlit",)
+        if found is not None:
+            return found[1] if found[0] == "lit" else None
+        cursor = container.get(id(block))
+        if cursor is None:
+            break
+    return None
+
+
+def python_engine_machine(oracle_tree: ast.Module | None = None,
+                          runner_tree: ast.Module | None = None) -> Machine:
+    if oracle_tree is None:
+        oracle_tree = ast.parse(
+            (REPO_ROOT / "matching_engine_tpu" / "engine" /
+             "oracle.py").read_text())
+    if runner_tree is None:
+        runner_tree = ast.parse(
+            (REPO_ROOT / "matching_engine_tpu" / "server" /
+             "engine_runner.py").read_text())
+    m = Machine("python-engine", {}, None, None)
+
+    # Vocabulary: oracle's NAME = pb2.OrderUpdate.Status.NAME bindings.
+    for node in oracle_tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            src = ast.unparse(node.value)
+            if src.startswith("pb2.OrderUpdate.Status."):
+                bound = src.rsplit(".", 1)[-1]
+                name = node.targets[0].id
+                if name != bound:
+                    m.errors.append(
+                        f"oracle binds {name} to proto status {bound}")
+                m.vocab[name] = None   # numeric value owned by the proto
+    if not m.vocab:
+        m.errors.append("oracle.py pb2 status bindings not found")
+
+    # Terminal: `.status in (A, B, C)` guards whose branch REJECTS.
+    guards: list[frozenset[str]] = []
+    for node in ast.walk(runner_tree):
+        if not isinstance(node, ast.If):
+            continue
+        for cmp_ in ast.walk(node.test):
+            if not (isinstance(cmp_, ast.Compare)
+                    and len(cmp_.ops) == 1
+                    and isinstance(cmp_.ops[0], ast.In)
+                    and isinstance(cmp_.left, ast.Attribute)
+                    and cmp_.left.attr == "status"):
+                continue
+            names = _status_tuple(cmp_.comparators[0])
+            if names is None:
+                continue
+            body_names = {n.id for b in node.body
+                          for n in ast.walk(b) if isinstance(n, ast.Name)}
+            if "REJECTED" in body_names:
+                guards.append(names)
+    if not guards:
+        m.errors.append("engine_runner terminal guard not found")
+    elif len(set(guards)) > 1:
+        m.errors.append(
+            f"engine_runner terminal guards disagree: "
+            f"{sorted(set(map(tuple, map(sorted, guards))))}")
+    else:
+        m.terminal = guards[0]
+
+    # Update writes: storage_updates.append((oid, STATUS, ...)).
+    # Index every statement's enclosing-block chain so the status
+    # element of an update row resolves against the assignments that
+    # DOMINATE it (same block or an enclosing one), never a sibling
+    # branch's.
+    parents: dict[int, list] = {}     # id(stmt) -> [block, ...] inner-first
+    container: dict[int, ast.stmt] = {}   # id(block) -> containing stmt
+
+    def index_stmt(stmt, chain):
+        parents[id(stmt)] = chain
+        for block in _sub_blocks(stmt):
+            container[id(block)] = stmt
+            for s in block:
+                index_stmt(s, [block] + chain)
+
+    for fn in ast.walk(runner_tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for s in fn.body:
+                index_stmt(s, [fn.body])
+
+    targets: set[str] = set()
+    preserving = False
+    for fn in ast.walk(runner_tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.stmt) or id(stmt) not in parents:
+                continue
+            for call in _expr_walk(stmt):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "append"
+                        and isinstance(call.func.value, ast.Attribute)
+                        and call.func.value.attr == "storage_updates"
+                        and call.args
+                        and isinstance(call.args[0], ast.Tuple)
+                        and len(call.args[0].elts) >= 2):
+                    continue
+                el = call.args[0].elts[1]
+                if isinstance(el, ast.Name) and el.id in _STATUS_NAMES:
+                    targets.add(el.id)
+                elif isinstance(el, ast.Attribute) and el.attr == "status":
+                    path = ast.unparse(el.value)
+                    res = _block_resolves(stmt, parents, container, path)
+                    if res is None:
+                        preserving = True
+                    else:
+                        targets |= res
+    if not targets:
+        m.errors.append("engine_runner storage_updates writes not found")
+    if m.vocab and m.terminal is not None and targets:
+        m.relation = _relation_from_updates(
+            m.vocab, m.terminal, targets, preserving)
+    return m
+
+
+# -- C++ lane engine ---------------------------------------------------------
+
+
+_CAMEL = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _k_name(k: str) -> str:
+    """kPartiallyFilled -> PARTIALLY_FILLED."""
+    return _CAMEL.sub("_", k[1:]).upper()
+
+
+def cpp_machine(text: str | None = None) -> Machine:
+    if text is None:
+        text = _ME_LANES.read_text()
+    m = Machine("me_lanes.cpp", {}, None, None)
+    text = re.sub(r"//[^\n]*", "", text)
+
+    cm = re.search(
+        r"constexpr\s+int\s+(kNew\s*=[^;]*);", text)
+    if cm is None:
+        m.errors.append("status constexpr block (kNew = ...) not found")
+    else:
+        for name, val in re.findall(r"(k\w+)\s*=\s*(\d+)", cm.group(1)):
+            m.vocab[_k_name(name)] = int(val)
+
+    # Terminal: every `x.status == kA || x.status == kB || x.status == kC`
+    # chain over an ORDER OBJECT member must name the same set. The
+    # member access ([.>]status) is the discriminator: a bare local
+    # `status == kNew || ...` tests the device RESULT of this op, not
+    # which states reject further ops.
+    chains = re.findall(
+        r"[.>]status\s*==\s*(k\w+)\s*\|\|\s*[\w>\-.]*[.>]status\s*==\s*"
+        r"(k\w+)\s*\|\|\s*[\w>\-.]*[.>]status\s*==\s*(k\w+)", text)
+    sets = {frozenset(_k_name(k) for k in c) for c in chains}
+    if not chains:
+        m.errors.append("terminal status guard chain not found")
+    elif len(sets) > 1:
+        m.errors.append(f"terminal guard chains disagree: {sorted(map(sorted, sets))}")
+    else:
+        m.terminal = next(iter(sets))
+
+    # Update-status writes into the store_updates buffer.
+    writes = re.findall(
+        r"put_u8\(&ctx\.store_updates,\s*static_cast<uint8_t>\(([^()]+)\)\)",
+        text)
+    targets: set[str] = set()
+    preserving = False
+    ternaries = dict(
+        (var, frozenset({_k_name(a), _k_name(b)}))
+        for var, a, b in re.findall(
+            r"(\w+)\.status\s*=\s*[^;?]*\?\s*(k\w+)\s*:\s*(k\w+)\s*;", text))
+    for expr in writes:
+        expr = expr.strip()
+        if expr.startswith("k"):
+            targets.add(_k_name(expr))
+        elif expr.endswith(".status"):
+            var = expr[:-len(".status")].rsplit(".", 1)[-1]
+            if var in ternaries:
+                targets |= ternaries[var]
+            else:
+                preserving = True
+    if not writes:
+        m.errors.append("store_updates status writes not found")
+    if m.vocab and m.terminal is not None and targets:
+        m.relation = _relation_from_updates(
+            m.vocab, m.terminal, targets, preserving)
+    return m
+
+
+# -- the equivalence check ---------------------------------------------------
+
+
+def compare(machines: list[Machine]) -> list[Violation]:
+    vs: list[Violation] = []
+    for m in machines:
+        for err in m.errors:
+            vs.append(Violation(
+                "lifecycle/extract-error", m.layer, err))
+
+    ok = [m for m in machines if not m.errors]
+    if len(ok) < 2:
+        return vs
+
+    names = {m.layer: set(m.vocab) for m in ok if m.vocab}
+    base_layer = ok[0].layer
+    base = names.get(base_layer, set())
+    for layer, n in names.items():
+        if n != base:
+            only_a = sorted(base - n)
+            only_b = sorted(n - base)
+            vs.append(Violation(
+                "lifecycle/vocabulary-skew", layer,
+                f"status vocabulary differs from {base_layer}: "
+                f"missing {only_a or '[]'}, extra {only_b or '[]'}"))
+
+    # Numeric values: any two layers that both pin a value must agree.
+    for name in sorted(base):
+        vals = {m.layer: m.vocab[name] for m in ok
+                if m.vocab.get(name) is not None}
+        if len(set(vals.values())) > 1:
+            vs.append(Violation(
+                "lifecycle/value-skew", name,
+                f"numeric value differs across layers: {vals}"))
+
+    terms = {m.layer: m.terminal for m in ok if m.terminal is not None}
+    tvals = set(terms.values())
+    if len(tvals) > 1:
+        vs.append(Violation(
+            "lifecycle/terminal-skew", "+".join(sorted(terms)),
+            f"terminal sets differ: "
+            f"{ {k: sorted(v) for k, v in sorted(terms.items())} }"))
+
+    rels = {m.layer: m.relation for m in ok if m.relation is not None}
+    if len(set(rels.values())) > 1:
+        layers = sorted(rels)
+        ref = rels[layers[0]]
+        for layer in layers[1:]:
+            if rels[layer] != ref:
+                missing = sorted(ref - rels[layer])
+                extra = sorted(rels[layer] - ref)
+                vs.append(Violation(
+                    "lifecycle/transition-skew", layer,
+                    f"update transitions differ from {layers[0]}: "
+                    f"missing {missing or '[]'}, extra {extra or '[]'}"))
+    return vs
+
+
+def machines() -> list[Machine]:
+    # load_sources keeps the parse cache warm for the other analyzers.
+    load_sources(("audit",))
+    return [proto_machine(), auditor_machine(), python_engine_machine(),
+            cpp_machine()]
+
+
+def run() -> list[Violation]:
+    return compare(machines())

@@ -88,6 +88,18 @@ class CallSite:
     where: str
 
 
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One shared-state touch: a read or mutation of a module-level name
+    or an object attribute, with the locks lexically held at the site.
+    Consumed by the lockset analyzer (analysis/lockset.py)."""
+
+    state: str              # "Class.attr" | "module.name"
+    kind: str               # "read" | "write"
+    held: tuple[str, ...]   # lock identities lexically held
+    where: str
+
+
 @dataclasses.dataclass
 class FuncInfo:
     qualname: str           # module.Class.method | module.func
@@ -102,6 +114,11 @@ class FuncInfo:
         default_factory=list)  # (holder_id, lock_id, witness)
     bare_acquires: list[tuple[str, str]] = dataclasses.field(
         default_factory=list)
+    accesses: list[Access] = dataclasses.field(default_factory=list)
+    closures: list[str] = dataclasses.field(default_factory=list)
+    # AST back-references (the determinism taint pass re-walks bodies).
+    node: object = None
+    src: object = None
     # fixpoint summaries: lock/effect -> witness chain
     trans_acquires: dict[str, str] = dataclasses.field(default_factory=dict)
     trans_effects: dict[str, str] = dataclasses.field(default_factory=dict)
@@ -109,6 +126,28 @@ class FuncInfo:
 
 def _is_lockish(attr: str) -> bool:
     return "lock" in attr.lower()
+
+
+# Container-mutating method names: `self.x.append(v)` is a WRITE to the
+# shared state behind `x` even though the binding never changes. put/get
+# are deliberately absent (queue.Queue is internally synchronized).
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "clear", "update", "setdefault",
+    "move_to_end", "popitem", "sort", "reverse",
+})
+
+# Method names too generic for unknown-receiver fan-out resolution: a
+# bare-local `rows.append(...)` must not resolve into every analyzed
+# class that happens to define `append`.
+_GENERIC_METHODS = _MUTATORS | frozenset({
+    "get", "put", "get_nowait", "put_nowait", "join", "wait", "set",
+    "is_set", "items", "keys", "values", "copy", "count", "index",
+    # flush/close/start exist on file objects, threads, servers AND half
+    # the analyzed classes — production call sites go through typed
+    # receivers (ATTR_TYPES), so the name fan-out would only add noise.
+    "flush", "close", "start",
+})
 
 
 class _Analyzer(ast.NodeVisitor):
@@ -135,6 +174,26 @@ class _Analyzer(ast.NodeVisitor):
         # Names bound to pb2 message classes (`OU = pb2.OrderUpdate`):
         # calling one IS proto materialization.
         self.proto_aliases: set[str] = set()
+        # Module-level mutable bindings: mutations through them inside
+        # functions are shared-state writes (lockset analyzer).
+        self.module_globals: set[str] = set()
+        # "Class.attr" -> constructor dotted name for `self.x = Ctor()`
+        # assignments (any method): lets the lockset analyzer exempt
+        # internally-synchronized containers (queue.Queue, Event, ...).
+        self.attr_ctors: dict[str, str] = {}
+        # Thread entry points spawned in this module:
+        # (resolved target "Cls.meth"|"mod.fn", site).
+        self.thread_targets: list[tuple[str, str]] = []
+        # Names declared `global` in the current function.
+        self._global_decls: set[str] = set()
+        for n in src.tree.body:
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_globals.add(t.id)
+            elif isinstance(n, ast.AnnAssign) and \
+                    isinstance(n.target, ast.Name):
+                self.module_globals.add(n.target.id)
         for n in ast.walk(src.tree):
             if isinstance(n, ast.ImportFrom) and n.module:
                 for a in n.names:
@@ -176,6 +235,56 @@ class _Analyzer(ast.NodeVisitor):
             return node.id in _SQLITE_RECEIVERS
         return False
 
+    def _state_id(self, node: ast.expr) -> str | None:
+        """Shared-state identity for an attribute / module-global
+        expression, or None when the receiver is unknown or external.
+        Lock objects are excluded — they ARE the synchronization, not
+        state it protects."""
+        if isinstance(node, ast.Name):
+            if node.id in self.module_globals \
+                    and not _is_lockish(node.id):
+                return f"{self.module}.{node.id}"
+            return None
+        if not isinstance(node, ast.Attribute) or _is_lockish(node.attr):
+            return None
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            if self.cls is None:
+                return None
+            return f"{self.cls}.{node.attr}"
+        if isinstance(base, ast.Name) and base.id in hierarchy.ATTR_TYPES:
+            t = hierarchy.ATTR_TYPES[base.id]
+            if t is None or t == "sqlite3":
+                return None
+            return f"{t}.{node.attr}"
+        if isinstance(base, ast.Attribute) \
+                and base.attr in hierarchy.ATTR_TYPES:
+            t = hierarchy.ATTR_TYPES[base.attr]
+            if t is None or t == "sqlite3":
+                return None
+            return f"{t}.{node.attr}"
+        return None
+
+    def _access(self, node: ast.expr, kind: str) -> None:
+        sid = self._state_id(node)
+        if sid is not None and self.fn is not None:
+            self.fn.accesses.append(Access(
+                sid, kind, tuple(self.held), site(self.src, node)))
+
+    def _store_target(self, t: ast.expr) -> None:
+        """Record the write behind one assignment target."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._store_target(e)
+        elif isinstance(t, ast.Starred):
+            self._store_target(t.value)
+        elif isinstance(t, ast.Attribute):
+            self._access(t, "write")
+        elif isinstance(t, (ast.Subscript, ast.Slice)):
+            self._access(t.value, "write")
+        elif isinstance(t, ast.Name) and t.id in self._global_decls:
+            self._access(t, "write")
+
     # -- structure ---------------------------------------------------------
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
@@ -190,18 +299,26 @@ class _Analyzer(ast.NodeVisitor):
     def _visit_def(self, node) -> None:
         prev_fn, prev_held = self.fn, self.held
         prev_exempt = self.exempt_acquires
+        prev_globals = self._global_decls
         qual = (f"{self.module}.{self.cls}.{node.name}" if self.cls
                 else f"{self.module}.{node.name}")
         if prev_fn is not None:        # nested def (closure): own summary,
             qual = f"{prev_fn.qualname}.<locals>.{node.name}"
-        self.fn = FuncInfo(qual, self.module, self.cls, node.name)
+            prev_fn.closures.append(qual)
+        self.fn = FuncInfo(qual, self.module, self.cls, node.name,
+                           node=node, src=self.src)
         self.held = []                 # a closure runs on its caller's
         self.funcs.append(self.fn)     # stack, modeled via bindings
         self.exempt_acquires = self._acquire_then_try(node)
+        self._global_decls = {
+            name for n in ast.walk(node)
+            if isinstance(n, ast.Global) for name in n.names
+        }
         for stmt in node.body:
             self.visit(stmt)
         self.fn, self.held = prev_fn, prev_held
         self.exempt_acquires = prev_exempt
+        self._global_decls = prev_globals
 
     def _acquire_then_try(self, fn_node) -> set[int]:
         """Call-node ids of the conventional disciplined shape
@@ -293,6 +410,75 @@ class _Analyzer(ast.NodeVisitor):
         # hierarchy.CALLBACK_BINDINGS instead.
         return
 
+    # -- shared-state accesses (lockset analyzer raw material) -------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.fn is not None:
+            for t in node.targets:
+                self._store_target(t)
+        if (self.cls is not None and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id == "self"
+                and isinstance(node.value, ast.Call)):
+            ctor = dotted(node.value.func)
+            if ctor is not None:
+                self.attr_ctors.setdefault(
+                    f"{self.cls}.{node.targets[0].attr}", ctor)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.fn is not None:
+            self._store_target(node.target)
+            # x += 1 reads x too, but the Store ctx hides it from
+            # visit_Attribute — the write access carries the same held
+            # set, so the lockset math is unaffected.
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self.fn is not None and node.value is not None:
+            self._store_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        if self.fn is not None:
+            for t in node.targets:
+                self._store_target(t)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.fn is not None and isinstance(node.ctx, ast.Load):
+            self._access(node, "read")
+        self.generic_visit(node)
+
+    def _thread_target(self, node: ast.expr) -> list[str]:
+        """Resolve a Thread(target=...) expression to entry identities
+        ("Cls.meth" | "<module-basename>.fn"); [] when the target is an
+        external bound method (e.g. httpd.serve_forever — unknown
+        receiver, nothing in-tree to race-check). A DYNAMIC callable
+        (lambda, functools.partial, a computed expression) resolves to
+        the "<dynamic>" sentinel instead: it wraps in-tree code the
+        role table can never see, so lockset flags the spawn rather
+        than silently skipping it."""
+        if isinstance(node, ast.IfExp):
+            return self._thread_target(node.body) + \
+                self._thread_target(node.orelse)
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and self.cls is not None:
+                return [f"{self.cls}.{node.attr}"]
+            if isinstance(base, ast.Name) \
+                    and base.id in hierarchy.ATTR_TYPES:
+                t = hierarchy.ATTR_TYPES[base.id]
+                return [f"{t}.{node.attr}"] if t else []
+            return []
+        if isinstance(node, ast.Name):
+            return [f"{self.module.rsplit('.', 1)[-1]}.{node.id}"]
+        if isinstance(node, (ast.Lambda, ast.Call)):
+            return ["<dynamic>"]
+        return []
+
     def _effect(self, kind: str, where: str) -> None:
         self.fn.effects.setdefault(kind, where)
         for holder in self.held:
@@ -308,6 +494,20 @@ class _Analyzer(ast.NodeVisitor):
         recv = receiver_name(node)
         where = site(self.src, node)
         if name is not None:
+            # Container mutations through an attribute/global binding
+            # are shared-state writes (lockset analyzer).
+            if name in _MUTATORS and isinstance(node.func, ast.Attribute):
+                self._access(node.func.value, "write")
+            # Thread entry points: Thread(target=...) spawns must map to
+            # a declared role (hierarchy.THREAD_ROLES).
+            if name == "Thread":
+                target = next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "target"),
+                    node.args[0] if node.args else None)
+                if target is not None:
+                    for ident in self._thread_target(target):
+                        self.thread_targets.append((ident, where))
             # Bare .acquire() discipline (with-scoped locking only).
             if name == "acquire" and isinstance(node.func, ast.Attribute):
                 lid = self._lock_id(node.func.value)
@@ -343,11 +543,17 @@ class Graph:
         self.bases: dict[str, list[str]] = {}
         self.bare_acquire_sites: list[tuple[str, str]] = []
         self.mod_imports: dict[str, dict[str, str]] = {}
+        self.attr_ctors: dict[str, str] = {}
+        self.thread_targets: list[tuple[str, str]] = []
+        self.proto_aliases: dict[str, set[str]] = {}
         for src in sources:
             a = _Analyzer(src)
             a.visit(src.tree)
             self.bases.update(a.classes)
             self.mod_imports[a.module] = a.imports
+            self.attr_ctors.update(a.attr_ctors)
+            self.thread_targets.extend(a.thread_targets)
+            self.proto_aliases[a.module] = a.proto_aliases
             for f in a.funcs:
                 self.funcs[f.qualname] = f
                 self.by_method.setdefault(f.name, []).append(f)
@@ -356,6 +562,18 @@ class Graph:
                 self.bare_acquire_sites.extend(f.bare_acquires)
         self._fixpoint()
         self.edges = self._collect_edges()
+
+    def root_class(self, cls: str) -> str:
+        """Topmost analyzed base: attribute state of a subclass IS its
+        base's state (NativeLanesRunner inherits EngineRunner's)."""
+        seen = set()
+        while cls not in seen:
+            seen.add(cls)
+            b = self.bases.get(cls) or []
+            if not b or b[0] not in self.bases:
+                return cls
+            cls = b[0]
+        return cls
 
     # -- call resolution ---------------------------------------------------
 
@@ -370,7 +588,8 @@ class Graph:
             cls = b[0] if b else None
         return None
 
-    def resolve(self, caller: FuncInfo, c: CallSite) -> list[FuncInfo]:
+    def resolve(self, caller: FuncInfo, c: CallSite,
+                skip_generic: bool = False) -> list[FuncInfo]:
         if c.name in hierarchy.CALLBACK_BINDINGS:
             out = []
             for target in hierarchy.CALLBACK_BINDINGS[c.name]:
@@ -400,7 +619,17 @@ class Graph:
                 return []
             m = self._lookup(t, c.name)
             return [m] if m is not None else []
-        # Unknown receiver: conservative name-based fan-out.
+        # Unknown receiver: conservative name-based fan-out. Callers
+        # that PROPAGATE context through the graph (lockset roles, the
+        # determinism closure) pass skip_generic=True to drop container/
+        # queue method names, where the receiver is almost always a
+        # plain list/dict/queue and the fan-out would smear every
+        # analyzed class sharing the name (e.g. a local `events.append`
+        # resolving into RetransmissionRing.append). The lock-order
+        # effect fixpoint keeps the full fan-out — over-approximating
+        # effects is safe, losing a `close`-commits-SQLite edge is not.
+        if skip_generic and c.name in _GENERIC_METHODS:
+            return []
         return self.by_method.get(c.name, [])
 
     # -- fixpoint ----------------------------------------------------------

@@ -1,0 +1,304 @@
+"""Lockset / thread-role race analyzer (Eraser-style, statically).
+
+PR 9's lock-order analyzer proves declared locks NEST correctly, but says
+nothing about shared mutable state touched with no lock at all — the
+defect class TSan finds at runtime only on the schedules a test happens
+to run. This analyzer closes that gap statically:
+
+1. Every module-level binding and `self.`/typed-receiver attribute
+   access in the scanned tree is recorded with the locks lexically held
+   at the site (lockorder._Analyzer extracts them as `Access` rows).
+2. Thread roles (hierarchy.THREAD_ROLES: gRPC handlers, dispatcher
+   drain/lane threads, the async-sink flusher, the audit pump, the feed
+   spill flusher, the scrape server, ...) propagate from their entry
+   points through the resolvable call graph — the same conservative
+   resolution lockorder uses (receiver typing, callback bindings), plus
+   parent→closure edges (a closure runs on some caller's thread later;
+   it inherits its defining function's roles and NO guaranteed locks).
+3. A function's *guaranteed* lockset is computed PER ROLE: the meet
+   (intersection) over that role's reachable call sites of (caller's
+   guarantee ∪ locks lexically held at the site). `_observe_locked` is
+   guaranteed the auditor lock on every role's path because every caller
+   holds it — while a boot-path call with no lock only weakens the
+   `main` role's guarantee, not the serving threads'.
+4. The `main` role (build_server wiring, recovery replay, shutdown) is
+   initialization/teardown: it runs before the serving threads spawn or
+   after they join, so its accesses are not concurrent with anything —
+   exactly Eraser's initialization-phase exemption, role-shaped.
+5. For every location with a write outside `__init__`: if two concurrent
+   roles reach it and the intersection of the effective locksets
+   (per-role guarantee ∪ lexical) over the relevant access instances is
+   empty, it is flagged — unless a reviewed hierarchy.OWNERSHIP policy
+   covers it, and the policy itself is machine-checked (a
+   "single-writer" location acquiring a second writing role becomes
+   lockset/ownership-violation, not a silently-wrong waiver).
+
+Also enforced: every `Thread(target=...)` spawn must resolve to a
+declared role entry (the role table cannot rot; a dynamic
+lambda/partial target is flagged outright — the table can never cover
+it), and OWNERSHIP entries that stopped matching any flagged location
+are themselves flagged (documented debt cannot accrete) — except
+`init-before-spawn` entries, which are declarative: boot-only state
+never flags while healthy, and the entry's job is to turn a future
+post-boot write into an ownership-violation.
+
+Known approximations (by design, tuned via the tables rather than code):
+unresolvable indirect calls don't propagate roles (the guard test in
+tests/test_analysis.py pins that the load-bearing state IS seen), and a
+closure's guaranteed lockset is empty even when every caller invokes it
+under a lock — a false positive there earns an OWNERSHIP entry with a
+witness, which is exactly the reviewed-documentation outcome we want.
+"""
+
+from __future__ import annotations
+
+from matching_engine_tpu.analysis import hierarchy, lockorder
+from matching_engine_tpu.analysis.common import Violation, load_sources
+from matching_engine_tpu.analysis.lockorder import FuncInfo, Graph, level_of
+
+# The lock-order scan surface plus the observability layer (the scrape /
+# trace / flight-dump threads touch state the serving threads write).
+SCAN_DIRS = lockorder.SCAN_DIRS + ("utils/obs.py",)
+
+# Roles that never run concurrently with the serving threads: boot
+# wiring/recovery happens before the spawns, shutdown after the joins.
+NON_CONCURRENT_ROLES = frozenset({"main"})
+
+# Constructors whose objects are internally synchronized (or immutable):
+# accesses THROUGH them are not shared-state races. itertools.count is
+# included deliberately: next() on it is a single C call, atomic under
+# the GIL, and NativeRingDispatcher._tag_seq relies on exactly that.
+SAFE_CTORS = frozenset({
+    "queue.Queue", "Queue", "queue.SimpleQueue",
+    "threading.Event", "Event", "threading.Lock", "Lock",
+    "threading.RLock", "RLock", "threading.Condition", "Condition",
+    "threading.Semaphore", "threading.local",
+    "itertools.count", "Metrics",
+})
+
+_POLICIES = ("single-writer", "init-before-spawn", "gil-atomic",
+             "instance-confined")
+
+
+def _entry_matches(f: FuncInfo, entry: str) -> bool:
+    owner, _, name = entry.partition(".")
+    if name == "*":
+        # Glob = the class's PUBLIC surface (what grpc/http dispatches
+        # into); private helpers are reached through calls, under
+        # whatever locks the handlers hold.
+        return f.cls == owner and not f.name.startswith("_")
+    if f.cls == owner and f.name == name:
+        return True
+    return (f.cls is None and f.name == name
+            and f.module.rsplit(".", 1)[-1] == owner)
+
+
+def _ident_declared(ident: str) -> bool:
+    """Does a Thread-target identity ("Cls.meth" | "mod.fn") match any
+    declared role entry?"""
+    for entries in hierarchy.THREAD_ROLES.values():
+        for entry in entries:
+            owner, _, name = entry.partition(".")
+            iowner, _, iname = ident.partition(".")
+            if iowner != owner:
+                continue
+            # The glob covers exactly what _entry_matches propagates
+            # roles into — the class's PUBLIC surface. A spawn onto a
+            # private method would pass the root check yet never be
+            # race-checked, so it must NOT count as declared.
+            if name == iname or (name == "*"
+                                 and not iname.startswith("_")):
+                return True
+    return False
+
+
+def _levels(lock_ids) -> frozenset[str]:
+    return frozenset(level_of(i) for i in lock_ids)
+
+
+def compute_role_context(graph: Graph):
+    """For each role: {qualname -> guaranteed lock levels} over every
+    function that role's threads can reach. Reachability and the
+    guarantee are computed together: the guarantee of a function is the
+    meet over all of the role's call paths into it of (caller guarantee
+    ∪ locks lexically held at the call site); closures are reached from
+    their defining function but run later, lock-free."""
+    out: dict[str, dict[str, frozenset]] = {}
+    for role, entries in hierarchy.THREAD_ROLES.items():
+        ctx: dict[str, frozenset] = {}
+        for f in graph.funcs.values():
+            if any(_entry_matches(f, e) for e in entries):
+                ctx[f.qualname] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for qual in list(ctx):
+                f = graph.funcs[qual]
+                g = ctx[qual]
+                for call in f.calls:
+                    incoming = g | _levels(call.held)
+                    for callee in graph.resolve(f, call,
+                                                skip_generic=True):
+                        cq = callee.qualname
+                        prev = ctx.get(cq)
+                        new = incoming if prev is None else prev & incoming
+                        if new != prev:
+                            ctx[cq] = frozenset(new)
+                            changed = True
+                for cq in f.closures:
+                    if ctx.get(cq) != frozenset():
+                        ctx[cq] = frozenset()
+                        changed = True
+        out[role] = ctx
+    return out
+
+
+def _location(graph: Graph, state: str) -> str:
+    owner, _, attr = state.rpartition(".")
+    short = owner.rsplit(".", 1)[-1]
+    if short in graph.bases:
+        return f"{graph.root_class(short)}.{attr}"
+    return state
+
+
+def collect_locations(graph: Graph):
+    """location -> list of access instances
+    (kind, role, lockset, where, func). One instance per (access, role)
+    pair: the same site reached by two roles contributes each role's own
+    guaranteed lockset. Accesses in unreachable functions and in
+    `__init__` (initialization happens-before publication of self) are
+    excluded; NON_CONCURRENT_ROLES never produce instances."""
+    contexts = compute_role_context(graph)
+    out: dict[str, list[tuple]] = {}
+    for qual, f in graph.funcs.items():
+        if f.name == "__init__":
+            continue
+        for role, ctx in contexts.items():
+            if role in NON_CONCURRENT_ROLES or qual not in ctx:
+                continue
+            base = ctx[qual]
+            for a in f.accesses:
+                loc = _location(graph, a.state)
+                ctor = graph.attr_ctors.get(a.state) \
+                    or graph.attr_ctors.get(loc)
+                if ctor in SAFE_CTORS:
+                    continue
+                out.setdefault(loc, []).append(
+                    (a.kind, role, base | _levels(a.held), a.where, qual))
+    return out
+
+
+def check(graph: Graph) -> list[Violation]:
+    vs: list[Violation] = []
+    locations = collect_locations(graph)
+
+    flagged: set[str] = set()     # pre-waiver, for the unused-entry rule
+    for loc in sorted(locations):
+        instances = locations[loc]
+        writes = [a for a in instances if a[0] == "write"]
+        if not writes:
+            continue
+        wroles = {a[1] for a in writes}
+        aroles = {a[1] for a in instances}
+        if len(aroles) < 2:
+            continue
+        policy, _witness = hierarchy.OWNERSHIP.get(loc, (None, None))
+
+        if len(wroles) >= 2:
+            inter = frozenset.intersection(*(a[2] for a in writes))
+            if not inter:
+                flagged.add(loc)
+                if policy in ("gil-atomic", "instance-confined"):
+                    continue
+                if policy in ("single-writer", "init-before-spawn"):
+                    vs.append(Violation(
+                        "lockset/ownership-violation",
+                        min(a[3] for a in writes),
+                        f"'{loc}' is declared {policy} but roles "
+                        f"{sorted(wroles)} all write it — the ownership "
+                        f"entry no longer holds"))
+                else:
+                    vs.append(Violation(
+                        "lockset/unguarded-write",
+                        min(a[3] for a in writes),
+                        f"'{loc}' written by roles {sorted(wroles)} with "
+                        f"empty lockset intersection — guard it with one "
+                        f"lock or declare ownership in "
+                        f"analysis/hierarchy.py"))
+                continue
+            # Writers share a lock — but a read-only role outside the
+            # writers' lock discipline still races (torn/stale read).
+            # Fall through to the foreign-read check below.
+        # A race also needs a reader (or second writer, handled above)
+        # on a thread outside the writing roles.
+        foreign_reads = [a for a in instances
+                         if a[0] == "read" and a[1] not in wroles]
+        if not foreign_reads:
+            continue
+        inter = frozenset.intersection(
+            *(a[2] for a in writes + foreign_reads))
+        if inter:
+            continue
+        flagged.add(loc)
+        if policy in ("gil-atomic", "instance-confined"):
+            continue
+        if policy == "single-writer" and len(wroles) == 1:
+            continue
+        if policy in ("single-writer", "init-before-spawn"):
+            vs.append(Violation(
+                "lockset/ownership-violation",
+                min(a[3] for a in writes),
+                f"'{loc}' is declared {policy} but roles "
+                f"{sorted(wroles)} write it — the ownership entry no "
+                f"longer holds"))
+            continue
+        vs.append(Violation(
+            "lockset/unguarded-read",
+            min(a[3] for a in writes + foreign_reads),
+            f"'{loc}' written by role(s) {sorted(wroles)} and read by "
+            f"{sorted({a[1] for a in foreign_reads})} with no common "
+            f"lock — lock it or declare single-writer/gil-atomic "
+            f"ownership in analysis/hierarchy.py"))
+
+    for loc in sorted(hierarchy.OWNERSHIP):
+        policy = hierarchy.OWNERSHIP[loc][0]
+        if policy not in _POLICIES:
+            vs.append(Violation(
+                "lockset/unknown-policy",
+                f"hierarchy.py OWNERSHIP[{loc!r}]",
+                f"unknown ownership policy {policy!r} (expected one of "
+                f"{', '.join(_POLICIES)})"))
+        elif loc not in flagged and policy != "init-before-spawn":
+            # init-before-spawn is DECLARATIVE: boot-only-written state
+            # never produces flaggable instances (main is the
+            # non-concurrent role), so "nothing flagged" is its healthy
+            # steady state, not staleness — the entry exists to turn a
+            # future post-boot write into ownership-violation.
+            vs.append(Violation(
+                "lockset/unused-ownership",
+                f"hierarchy.py OWNERSHIP[{loc!r}]",
+                "entry no longer matches any cross-thread unguarded "
+                "location — delete it (stale waivers hide future races)"))
+
+    for ident, where in sorted(graph.thread_targets):
+        if ident == "<dynamic>":
+            vs.append(Violation(
+                "lockset/undeclared-thread-root", where,
+                "Thread target is a dynamic callable (lambda/partial/"
+                "computed) — the role table can never cover it; spawn "
+                "a named method or function instead"))
+        elif not _ident_declared(ident):
+            vs.append(Violation(
+                "lockset/undeclared-thread-root", where,
+                f"Thread(target={ident}) is not covered by any "
+                f"hierarchy.THREAD_ROLES entry — declare the role so "
+                f"its reachable state is race-checked"))
+    return vs
+
+
+def build_graph() -> Graph:
+    return Graph(load_sources(SCAN_DIRS))
+
+
+def run() -> list[Violation]:
+    return check(build_graph())

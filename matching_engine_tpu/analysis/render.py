@@ -1,12 +1,13 @@
 """Render docs/CONCURRENCY.md from the declared hierarchy + the
-extracted acquisition graph. The committed file must match the
-regenerated text byte-for-byte (tier-1 pins it) — the doc can never
-drift from what the analyzer actually proves.
+extracted acquisition graph + the lockset analyzer's thread-role and
+shared-state view. The committed file must match the regenerated text
+byte-for-byte (tier-1 pins it) — the doc can never drift from what the
+analyzers actually prove.
 """
 
 from __future__ import annotations
 
-from matching_engine_tpu.analysis import hierarchy, lockorder
+from matching_engine_tpu.analysis import hierarchy, lockorder, lockset
 from matching_engine_tpu.analysis.common import REPO_ROOT
 
 _HEADER = """\
@@ -41,6 +42,11 @@ hierarchy is a reviewed edit to `analysis/hierarchy.py`, not a comment.
   on SQLite.
 - **`with`-scoped locking only.** A bare `.acquire()` without a
   provable `finally: release()` is flagged wholesale.
+- **No unguarded shared state.** The lockset analyzer
+  (`matching_engine_tpu/analysis/lockset.py`) classifies every shared
+  location by the locks held at each access and the thread roles that
+  reach it; an empty lockset intersection across roles fails the build
+  unless a reviewed ownership policy below covers it.
 
 ## Declared levels
 
@@ -58,7 +64,10 @@ _AMEND = """\
    must nest inside or outside, and keep the relation a DAG.
 3. If a callback hides an edge from the AST (the hub's `observer` hook),
    bind it in `CALLBACK_BINDINGS` so the edge stays visible.
-4. Run `python -m matching_engine_tpu.analysis render-concurrency` and
+4. A new background thread needs a `THREAD_ROLES` entry (the spawn is
+   rejected otherwise); new cross-thread state either takes a lock or
+   earns an `OWNERSHIP` entry with a policy and a witness.
+5. Run `python -m matching_engine_tpu.analysis render-concurrency` and
    commit the regenerated file together with the code.
 
 A waiver (`WAIVERS`) needs a justification comment and review — it is a
@@ -94,6 +103,41 @@ def render() -> str:
     for rule, holder, leaf in sorted(hierarchy.WAIVERS):
         out.append(f"- `{rule}` under `{holder}` reaching `{leaf}` "
                    f"(see the justification in hierarchy.py)\n")
+
+    # -- lockset sections (analysis/lockset.py) -------------------------
+    ls_graph = lockset.build_graph()
+    contexts = lockset.compute_role_context(ls_graph)
+    locations = lockset.collect_locations(ls_graph)
+    out.append(
+        "\n## Thread roles\n\n"
+        "The lockset race analyzer (`analysis/lockset.py`) propagates "
+        "these roles from their declared entry points "
+        "(`hierarchy.THREAD_ROLES`) through the resolvable call graph; "
+        "shared mutable state reachable from two roles must have a "
+        "non-empty lockset intersection or a reviewed ownership policy "
+        "below. Every `Thread(target=...)` spawn in the scanned tree "
+        "must map to one of these entries or the build fails.\n\n"
+        "| Role | Entry points | Reachable functions |\n|---|---|---|\n")
+    for role in sorted(hierarchy.THREAD_ROLES):
+        entries = ", ".join(f"`{e}`"
+                            for e in hierarchy.THREAD_ROLES[role])
+        out.append(f"| `{role}` | {entries} "
+                   f"| {len(contexts.get(role, {}))} |\n")
+
+    out.append(
+        "\n## Shared-state ownership\n\n"
+        f"{len(locations)} shared locations are currently tracked "
+        "across the roles above; every cross-thread-reachable location "
+        "with an unlocked write must either share a lock (verified by "
+        "the analyzer) or appear here with a reviewed policy — and the "
+        "policy itself is machine-checked (a second writer on a "
+        "`single-writer` entry, or a post-boot write on an "
+        "`init-before-spawn` entry, fails the build; entries that stop "
+        "matching anything are flagged as stale).\n\n"
+        "| Location | Policy | Witness |\n|---|---|---|\n")
+    for loc in sorted(hierarchy.OWNERSHIP):
+        policy, witness = hierarchy.OWNERSHIP[loc]
+        out.append(f"| `{loc}` | {policy} | {witness} |\n")
     out.append(_AMEND)
     return "".join(out)
 

@@ -1508,25 +1508,40 @@ class EngineRunner:
         """Persist a dirty call-period flag (call with no engine locks
         held). A failed write is WARNED and counted — the next boot could
         otherwise resume the wrong trading mode (the crossed-book safety
-        net only covers the stale-continuous direction)."""
+        net only covers the stale-continuous direction).
+
+        Concurrent flushers serialize on _owner_flush_lock (the sibling
+        flush_owner_ids discipline); set_auction_mode stays LOCK-FREE —
+        it may run under the dispatch lock, and a SQLite busy-wait must
+        never sit on the dispatch critical path. Correctness instead
+        rests on ordering: the dirty flag clears BEFORE the value is
+        read, and set_auction_mode writes value-then-dirty — a flip
+        landing mid-persist re-marks dirty after our clear, so the next
+        flush re-persists it. The old persist-then-clear order could
+        clear a concurrent flip it never wrote (lockset analyzer
+        finding; pinned by test_flush_auction_mode_concurrent_flip)."""
         if not self._mode_dirty or self.persist_auction_mode is None:
             return
-        try:
-            ok = self.persist_auction_mode(self.auction_mode)
-        except Exception as e:  # noqa: BLE001 — never unwind into callers
-            print(f"[runner] auction_mode persist raised: "
-                  f"{type(e).__name__}: {e}")
-            ok = False
-        if ok is False:
-            # Stay dirty: the write self-heals at the next flush point
-            # (e.g. the next RunAuction) instead of depending on an
-            # operator noticing the warning.
-            self.metrics.inc("meta_persist_failures")
-            print(f"[runner] WARNING: failed to persist "
-                  f"auction_mode={self.auction_mode}; a restart may resume "
-                  f"the wrong trading mode")
-        else:
+        with self._owner_flush_lock:
+            if not self._mode_dirty:
+                return
             self._mode_dirty = False
+            value = self.auction_mode
+            try:
+                ok = self.persist_auction_mode(value)
+            except Exception as e:  # noqa: BLE001 — never unwind
+                print(f"[runner] auction_mode persist raised: "
+                      f"{type(e).__name__}: {e}")
+                ok = False
+            if ok is False:
+                # Stay dirty: the write self-heals at the next flush
+                # point (e.g. the next RunAuction) instead of depending
+                # on an operator noticing the warning.
+                self._mode_dirty = True
+                self.metrics.inc("meta_persist_failures")
+                print(f"[runner] WARNING: failed to persist "
+                      f"auction_mode={value}; a restart may resume "
+                      f"the wrong trading mode")
 
     def maybe_rebase_seqs(self) -> bool:
         """Renumber book seqs when any book's arrival counter nears the

@@ -151,6 +151,11 @@ class AsyncStorageSink:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="storage-sink", daemon=True)
         self.dropped = 0  # batches dropped on a full queue (backpressure signal)
+        # `dropped += 1` is a read-modify-write: K serving lanes share one
+        # sink and can hit queue.Full together, so the count takes a lock
+        # (cold path — it only runs when the queue is already full;
+        # lockset analyzer finding).
+        self._drop_lock = threading.Lock()
         self._thread.start()
 
     def submit(
@@ -170,7 +175,8 @@ class AsyncStorageSink:
             self._q.put(item, block=block, timeout=None if block else 0)
             return True
         except queue.Full:
-            self.dropped += 1
+            with self._drop_lock:
+                self.dropped += 1
             return False
 
     def flush(self) -> None:

@@ -6,16 +6,21 @@
 #
 # Steps (each independently skippable only by missing toolchain, never
 # silently):
-#   1. the static-analysis suite (matching_engine_tpu/analysis/):
-#      lock-order vs the declared hierarchy, jit-purity/donation,
-#      py<->C++ ABI layouts, metric/flag <-> docs coherence
-#   2. docs/CONCURRENCY.md freshness (generated from the same graph)
+#   1. the static-analysis suite (matching_engine_tpu/analysis/), all
+#      seven analyzers: lock-order vs the declared hierarchy, the
+#      Eraser-style lockset race detector, the determinism-taint
+#      analyzer over the replay surfaces, the four-way order-lifecycle
+#      equivalence checker, jit-purity/donation, py<->C++ ABI layouts,
+#      metric/flag <-> docs coherence
+#   2. docs/CONCURRENCY.md freshness (generated from the same graphs)
 #   3. the tier-1 doc-lint (tests/test_obs.py) — the original
 #      metric-table drift guard the suite generalizes
-#   4. ruff, pinned in pyproject.toml and scoped to matching_engine_tpu/
-#      (skipped with a notice when the image lacks ruff), plus a
-#      compileall syntax gate that always runs
-#   5. [--sanitize] the ASan/UBSan codec-fuzz smokes
+#   4. ruff, pinned in pyproject.toml and scoped to matching_engine_tpu/,
+#      tests/, benchmarks/, and scripts/ (skipped with a notice when the
+#      image lacks ruff), plus a compileall syntax gate over the same
+#      trees that always runs
+#   5. [--sanitize] the ASan/UBSan codec-fuzz smokes and the TSan
+#      concurrent ring/lane-build smoke
 #      (tests/test_build_native.py; needs g++ + sanitizer runtimes)
 #
 # --json FILE writes a machine-readable summary artifact (per-step
@@ -57,10 +62,11 @@ step concurrency-doc python -m matching_engine_tpu.analysis \
   render-concurrency --check
 step doc-lint python -m pytest tests/test_obs.py \
   -k operations_doc -q -p no:cacheprovider
-step syntax python -m compileall -q matching_engine_tpu
+step syntax python -m compileall -q matching_engine_tpu tests \
+  benchmarks scripts
 
 if command -v ruff >/dev/null; then
-  step ruff ruff check matching_engine_tpu
+  step ruff ruff check matching_engine_tpu tests benchmarks scripts
 else
   echo "==> ruff: not in this image, skipping (pyproject.toml pins the"
   echo "    rule set; any image with ruff runs the identical gate)"

@@ -18,10 +18,13 @@ import pytest
 
 from matching_engine_tpu.analysis import (
     abi,
+    determinism,
     doccheck,
     hierarchy,
     jitpurity,
+    lifecycle,
     lockorder,
+    lockset,
     render,
     run_all,
 )
@@ -45,7 +48,8 @@ def test_full_tree_zero_violations():
     flat = [str(v) for vs in results.values() for v in vs]
     assert not flat, "static-analysis violations on the tree:\n" + \
         "\n".join(flat)
-    assert set(results) == {"lock-order", "jit-purity", "abi",
+    assert set(results) == {"lock-order", "lockset", "determinism",
+                            "lifecycle", "jit-purity", "abi",
                             "doc-coherence"}
 
 
@@ -192,6 +196,514 @@ class StreamHub:
                 pass
 """)])
     assert "lock-order/self-deadlock" in _rules(lockorder.check(g))
+
+
+# -- lockset injections ------------------------------------------------------
+#
+# Synthetic sources reuse REAL role entry classes (MatchingEngineService
+# = rpc, AsyncStorageSink = sink, BatchDispatcher._run = dispatch) so
+# the declared THREAD_ROLES table routes them; OWNERSHIP is emptied so
+# the real tree's reviewed entries don't read as stale on a synthetic
+# graph.
+
+
+_RACY = """
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        self.runner.hot_counter += 1
+
+class AsyncStorageSink:
+    def _run(self):
+        self.runner.hot_counter += 1
+"""
+
+
+def test_lockset_detects_empty_lockset_race(monkeypatch):
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src(_RACY)]))
+    assert "lockset/unguarded-write" in _rules(vs)
+    assert any("hot_counter" in v.detail for v in vs)
+
+
+def test_lockset_accepts_shared_lock(monkeypatch):
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        with self.runner._dispatch_lock:
+            self.runner.hot_counter += 1
+
+class AsyncStorageSink:
+    def _run(self):
+        with self.runner._dispatch_lock:
+            self.runner.hot_counter += 1
+""")]))
+    assert not _rules(vs)
+
+
+def test_lockset_guaranteed_lock_spans_callees(monkeypatch):
+    """The meet-over-callers guarantee: the write sits in a helper that
+    every caller invokes under the same lock — no violation, even
+    though the helper itself acquires nothing."""
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        with self.runner._dispatch_lock:
+            self._bump()
+
+    def _bump(self):
+        self.runner.hot_counter += 1
+
+class AsyncStorageSink:
+    def _run(self):
+        with self.runner._dispatch_lock:
+            self.runner.hot_counter += 1
+""")]))
+    assert not _rules(vs)
+
+
+def test_lockset_single_writer_waiver_and_its_abuse(monkeypatch):
+    """A single-writer entry waives a write/read pair — and flips to
+    ownership-violation the moment a second role writes."""
+    monkeypatch.setattr(
+        hierarchy, "OWNERSHIP",
+        {"EngineRunner.hot_counter": ("single-writer", "test witness")})
+    reader = """
+class MatchingEngineService:
+    def GetMetrics(self, request, context):
+        return self.runner.hot_counter
+
+class AsyncStorageSink:
+    def _run(self):
+        self.runner.hot_counter += 1
+"""
+    vs = lockset.check(lockorder.Graph([_src(reader)]))
+    assert "lockset/unguarded-read" not in _rules(vs)
+    assert "lockset/ownership-violation" not in _rules(vs)
+    # Second writing role: the declared policy no longer holds.
+    vs = lockset.check(lockorder.Graph([_src(_RACY)]))
+    assert "lockset/ownership-violation" in _rules(vs)
+
+
+def test_lockset_unguarded_read_without_waiver(monkeypatch):
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+class MatchingEngineService:
+    def GetMetrics(self, request, context):
+        return self.runner.hot_counter
+
+class AsyncStorageSink:
+    def _run(self):
+        self.runner.hot_counter += 1
+""")]))
+    assert "lockset/unguarded-read" in _rules(vs)
+
+
+def test_lockset_detects_undeclared_thread_root(monkeypatch):
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+import threading
+
+class Rogue:
+    def start(self):
+        t = threading.Thread(target=self._mystery_loop, daemon=True)
+        t.start()
+
+    def _mystery_loop(self):
+        pass
+""")]))
+    assert "lockset/undeclared-thread-root" in _rules(vs)
+    assert any("Rogue._mystery_loop" in v.detail for v in vs)
+
+
+def test_lockset_locked_writers_unlocked_reader_still_races(monkeypatch):
+    """Two roles writing under a shared lock don't exempt the location:
+    a read-only role outside that lock is still a torn/stale read."""
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    src = _src("""
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        with self.runner._dispatch_lock:
+            self.runner.hot_counter += 1
+
+class AsyncStorageSink:
+    def _run(self):
+        with self.runner._dispatch_lock:
+            self.runner.hot_counter += 1
+
+class BatchDispatcher:
+    def _run(self):
+        return self.runner.hot_counter
+""")
+    vs = lockset.check(lockorder.Graph([src]))
+    assert "lockset/unguarded-read" in _rules(vs)
+    # A reviewed gil-atomic entry covers exactly this shape.
+    monkeypatch.setattr(
+        hierarchy, "OWNERSHIP",
+        {"EngineRunner.hot_counter": ("gil-atomic", "test witness")})
+    assert not _rules(lockset.check(lockorder.Graph([src])))
+
+
+def test_lockset_glob_role_private_spawn_is_undeclared(monkeypatch):
+    """A `Class.*` role entry covers only the public surface — so a
+    thread spawned onto a private method of that class must still be
+    flagged (roles would never propagate into it)."""
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+import threading
+
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        threading.Thread(target=self._collector, daemon=True).start()
+
+    def _collector(self):
+        pass
+""")]))
+    assert "lockset/undeclared-thread-root" in _rules(vs)
+    assert any("MatchingEngineService._collector" in v.detail for v in vs)
+
+
+def test_lockset_flags_stale_ownership_entry(monkeypatch):
+    monkeypatch.setattr(
+        hierarchy, "OWNERSHIP",
+        {"Ghost.attr": ("gil-atomic", "no longer exists")})
+    vs = lockset.check(lockorder.Graph([_src("class Empty:\n    pass")]))
+    assert "lockset/unused-ownership" in _rules(vs)
+
+
+def test_lockset_dynamic_thread_target_is_flagged(monkeypatch):
+    """A lambda/partial Thread target wraps code the role table can
+    never cover — flagged outright, not silently skipped."""
+    monkeypatch.setattr(hierarchy, "OWNERSHIP", {})
+    vs = lockset.check(lockorder.Graph([_src("""
+import threading
+
+class MatchingEngineService:
+    def SubmitOrder(self, request, context):
+        threading.Thread(target=lambda: None, daemon=True).start()
+""")]))
+    assert "lockset/undeclared-thread-root" in _rules(vs)
+    assert any("dynamic callable" in v.detail for v in vs)
+
+
+def test_lockset_init_before_spawn_is_declarative(monkeypatch):
+    """An init-before-spawn entry on boot-only state is NOT stale while
+    the contract holds (boot writes never flag) — and flips to
+    ownership-violation the moment a serving role writes post-boot."""
+    monkeypatch.setattr(
+        hierarchy, "OWNERSHIP",
+        {"EngineRunner.grid_shape": ("init-before-spawn", "test witness")})
+    vs = lockset.check(lockorder.Graph([_src("""
+class MatchingEngineService:
+    def GetMetrics(self, request, context):
+        return self.runner.grid_shape
+""")]))
+    assert "lockset/unused-ownership" not in _rules(vs)
+    vs = lockset.check(lockorder.Graph([_src("""
+class MatchingEngineService:
+    def GetMetrics(self, request, context):
+        return self.runner.grid_shape
+
+class AsyncStorageSink:
+    def _run(self):
+        self.runner.grid_shape = (1, 2)
+""")]))
+    assert "lockset/ownership-violation" in _rules(vs)
+
+
+def test_lockset_real_tree_sees_load_bearing_facts():
+    """The clean baseline must be clean because the code is, not
+    because the extractor went blind: role reachability, per-role
+    guaranteed locks, and thread-spawn extraction are structural facts
+    of the tree."""
+    g = lockset.build_graph()
+    contexts = lockset.compute_role_context(g)
+    # The sink flusher reaches the commit path; the dispatcher drain
+    # reaches the publish fan-out.
+    assert any(q.endswith("AsyncStorageSink._commit")
+               for q in contexts["sink"])
+    assert any(q.endswith("StreamHub.publish_order_updates")
+               for q in contexts["dispatch"])
+    # Meet-over-callers: _observe_locked is guaranteed the auditor lock
+    # on the dispatch role's paths.
+    obs = [q for q in contexts["dispatch"]
+           if q.endswith("InvariantAuditor._observe_locked")]
+    assert obs and "auditor" in contexts["dispatch"][obs[0]]
+    # Thread-spawn extraction still sees the real roots.
+    idents = {i for i, _ in g.thread_targets}
+    assert {"AsyncStorageSink._run", "AuditPump._run",
+            "FeedSequencer._flush_loop"} <= idents
+    # And the shared-state surface is non-trivial.
+    assert len(lockset.collect_locations(g)) > 50
+
+
+# -- determinism injections --------------------------------------------------
+
+
+def test_determinism_detects_time_taint_into_store_row():
+    g = lockorder.Graph([_src("""
+import time
+
+class Decoder:
+    def finish(self, res, oid):
+        ts = time.time()
+        res.storage_orders.append((oid, ts))
+""")])
+    vs = determinism.check(g)
+    assert "determinism/wallclock-taint" in _rules(vs)
+    assert any("time.time" in v.detail for v in vs)
+
+
+def test_determinism_taint_flows_through_helper_return():
+    g = lockorder.Graph([_src("""
+import time
+
+def _now_us():
+    return time.time_ns() // 1000
+
+class Decoder:
+    def finish(self, res, oid):
+        stamp = _now_us()
+        res.storage_fills.append((oid, stamp))
+""")])
+    assert "determinism/wallclock-taint" in _rules(determinism.check(g))
+
+
+def test_determinism_rng_in_caller_arg_reaches_sink():
+    """Forbidden sources seed the taint pass too: RNG computed in a
+    CALLER (outside the sink→callee closure, so rule 1 can't see it)
+    and passed as an argument into the sink function is still caught."""
+    g = lockorder.Graph([_src("""
+import random
+
+class Handler:
+    def on_result(self, res, oid):
+        jitter = random.random()
+        self.decoder.finish(res, oid, jitter)
+
+class Decoder:
+    def finish(self, res, oid, jitter):
+        res.storage_orders.append((oid, jitter))
+""")])
+    vs = determinism.check(g)
+    assert "determinism/wallclock-taint" in _rules(vs)
+    assert any("random.random" in v.detail for v in vs)
+
+
+def test_determinism_clean_row_is_clean():
+    g = lockorder.Graph([_src("""
+class Decoder:
+    def finish(self, res, oid, qty):
+        res.storage_orders.append((oid, qty))
+""")])
+    assert determinism.check(g) == []
+
+
+def test_determinism_detects_dict_order_taint_into_feed_payload():
+    g = lockorder.Graph([_src("""
+from matching_engine_tpu.proto import pb2
+
+class Publisher:
+    def build(self, out):
+        for sym, size in self.tob.items():
+            out.append(pb2.MarketDataUpdate(symbol=sym, bid_size=size))
+""")])
+    vs = determinism.check(g)
+    assert "determinism/unordered-iteration" in _rules(vs)
+
+
+def test_determinism_sorted_iteration_is_clean():
+    g = lockorder.Graph([_src("""
+from matching_engine_tpu.proto import pb2
+
+class Publisher:
+    def build(self, out):
+        for sym, size in sorted(self.tob.items()):
+            out.append(pb2.MarketDataUpdate(symbol=sym, bid_size=size))
+""")])
+    assert "determinism/unordered-iteration" not in _rules(
+        determinism.check(g))
+
+
+def test_determinism_detects_forbidden_source_in_replay_closure():
+    """The reachability half: random hides in a helper the row builder
+    calls, with no dataflow into the row needed."""
+    g = lockorder.Graph([_src("""
+import random
+
+class Decoder:
+    def finish(self, res, oid):
+        res.storage_orders.append((oid, self._salt()))
+
+    def _salt(self):
+        return random.randint(0, 10)
+""")])
+    vs = determinism.check(g)
+    assert "determinism/forbidden-source" in _rules(vs)
+    assert any("random.randint" in v.detail for v in vs)
+
+
+def test_determinism_waiver_covers_declared_wallclock(monkeypatch):
+    monkeypatch.setattr(
+        hierarchy, "DETERMINISM_WAIVERS",
+        frozenset({("determinism/wallclock-taint", "Decoder.finish",
+                    "time.time")}))
+    g = lockorder.Graph([_src("""
+import time
+
+class Decoder:
+    def finish(self, res, oid):
+        res.storage_orders.append((oid, time.time()))
+""")])
+    assert determinism.check(g) == []
+
+
+def test_determinism_real_tree_waivers_are_load_bearing(monkeypatch):
+    """Emptying the declared wall-clock allowlist must make the real
+    tree fire — the clean baseline is clean because the exempt fields
+    are DECLARED, not because the taint pass sees nothing."""
+    monkeypatch.setattr(hierarchy, "DETERMINISM_WAIVERS", frozenset())
+    vs = determinism.run()
+    rules = _rules(vs)
+    assert "determinism/wallclock-taint" in rules
+    assert any("FeedSequencer._stamp" in v.detail for v in vs)
+    assert any("storage.py" in v.where for v in vs)
+
+
+# -- lifecycle injections ----------------------------------------------------
+
+
+_MINI_AUDITOR = """
+NEW, PARTIALLY_FILLED, FILLED, CANCELED, REJECTED = range(5)
+_TERMINAL = (FILLED, CANCELED, REJECTED)
+_LEGAL = {
+    NEW: (NEW, PARTIALLY_FILLED, FILLED, CANCELED),
+    PARTIALLY_FILLED: (PARTIALLY_FILLED, FILLED, CANCELED),
+    FILLED: (),
+    CANCELED: (),
+    REJECTED: (),
+}
+"""
+
+_MINI_CPP = """
+constexpr int kNew = 0, kPartiallyFilled = 1, kFilled = 2, kCanceled = 3,
+              kRejected = 4;
+void f() {
+  if ((p.op == kOpCancel) &&
+      (info.status == kFilled || info.status == kCanceled ||
+       info.status == kRejected)) {}
+  maker.status = maker.remaining == 0 ? kFilled : kPartiallyFilled;
+  put_u8(&ctx.store_updates, static_cast<uint8_t>(maker.status));
+  put_u8(&ctx.store_updates, static_cast<uint8_t>(kCanceled));
+  put_u8(&ctx.store_updates, static_cast<uint8_t>(info.status));
+}
+"""
+
+
+def test_lifecycle_four_real_machines_extract_and_agree():
+    ms = lifecycle.machines()
+    assert [m.layer for m in ms] == ["proto", "auditor", "python-engine",
+                                     "me_lanes.cpp"]
+    for m in ms:
+        assert not m.errors, (m.layer, m.errors)
+        assert set(m.vocab) == {"NEW", "PARTIALLY_FILLED", "FILLED",
+                                "CANCELED", "REJECTED"}
+    rels = {m.relation for m in ms if m.relation is not None}
+    assert len(rels) == 1 and len(next(iter(rels))) == 7
+    assert lifecycle.run() == []
+
+
+def test_lifecycle_detects_proto_vocabulary_skew():
+    proto = lifecycle.proto_machine(
+        "enum Status { NEW = 0; PARTIALLY_FILLED = 1; FILLED = 2; "
+        "CANCELED = 3; REJECTED = 4; HALTED = 5; }")
+    vs = lifecycle.compare([proto, lifecycle.auditor_machine(),
+                            lifecycle.python_engine_machine(),
+                            lifecycle.cpp_machine()])
+    assert "lifecycle/vocabulary-skew" in _rules(vs)
+    assert any("HALTED" in v.detail for v in vs)
+
+
+def test_lifecycle_detects_auditor_transition_skew():
+    import ast as ast_mod
+
+    skewed = _MINI_AUDITOR.replace(
+        "PARTIALLY_FILLED: (PARTIALLY_FILLED, FILLED, CANCELED),",
+        "PARTIALLY_FILLED: (PARTIALLY_FILLED, NEW, FILLED, CANCELED),")
+    aud = lifecycle.auditor_machine(ast_mod.parse(skewed))
+    assert not aud.errors
+    vs = lifecycle.compare([lifecycle.proto_machine(), aud,
+                            lifecycle.python_engine_machine(),
+                            lifecycle.cpp_machine()])
+    assert "lifecycle/transition-skew" in _rules(vs)
+
+
+def test_lifecycle_detects_python_engine_terminal_skew():
+    import ast as ast_mod
+
+    runner = ast_mod.parse("""
+class EngineRunner:
+    def _finish(self, res, ops):
+        for e in ops:
+            if e.op and e.info.status in (FILLED, REJECTED):
+                res.outcomes.append((e, REJECTED))
+                continue
+            maker.status = FILLED if maker.remaining == 0 \\
+                else PARTIALLY_FILLED
+            res.storage_updates.append((e.oid, maker.status, 0))
+            res.storage_updates.append((e.oid, CANCELED, 0))
+            res.storage_updates.append((e.oid, e.info.status, 0))
+""")
+    m = lifecycle.python_engine_machine(runner_tree=runner)
+    assert m.terminal == frozenset({"FILLED", "REJECTED"})
+    vs = lifecycle.compare([lifecycle.proto_machine(),
+                            lifecycle.auditor_machine(), m,
+                            lifecycle.cpp_machine()])
+    assert "lifecycle/terminal-skew" in _rules(vs)
+
+
+def test_lifecycle_python_engine_update_resolution():
+    """The three update-write shapes resolve exactly: a dominating
+    ternary, a literal, and a status-preserving amend — and a sibling
+    branch's assignment must NOT leak into the preserve decision."""
+    m = lifecycle.python_engine_machine()
+    aud = lifecycle.auditor_machine()
+    assert m.relation == aud.relation
+    # Self-loops exist (amend preserves) and REJECTED has no out-edges.
+    assert ("NEW", "NEW") in m.relation
+    assert not any(src == "REJECTED" for src, _ in m.relation)
+
+
+def test_lifecycle_detects_cpp_value_skew():
+    cpp = lifecycle.cpp_machine(_MINI_CPP.replace("kFilled = 2",
+                                                  "kFilled = 5"))
+    assert not cpp.errors
+    vs = lifecycle.compare([lifecycle.proto_machine(),
+                            lifecycle.auditor_machine(),
+                            lifecycle.python_engine_machine(), cpp])
+    assert "lifecycle/value-skew" in _rules(vs)
+
+
+def test_lifecycle_detects_cpp_transition_skew():
+    # Lose the cancel write: the C++ machine can no longer cancel a
+    # live order, which must read as a transition skew, not agreement.
+    cpp = lifecycle.cpp_machine(_MINI_CPP.replace(
+        "put_u8(&ctx.store_updates, static_cast<uint8_t>(kCanceled));",
+        ""))
+    assert not cpp.errors
+    vs = lifecycle.compare([lifecycle.proto_machine(),
+                            lifecycle.auditor_machine(),
+                            lifecycle.python_engine_machine(), cpp])
+    assert "lifecycle/transition-skew" in _rules(vs)
+    assert any("CANCELED" in v.detail for v in vs)
+
+
+def test_lifecycle_extract_error_is_loud_not_vacuous():
+    cpp = lifecycle.cpp_machine("int main() { return 0; }")
+    assert cpp.errors
+    vs = lifecycle.compare([cpp, lifecycle.auditor_machine()])
+    assert "lifecycle/extract-error" in _rules(vs)
 
 
 # -- jit-purity injections ---------------------------------------------------

@@ -740,6 +740,36 @@ def test_auction_mode_persist_failure_self_heals():
     assert calls == [True, True]
 
 
+def test_flush_auction_mode_concurrent_flip():
+    """A mode flip landing DURING a flush's persist must not be lost:
+    flush clears the dirty bit BEFORE reading the value, so the flip
+    re-marks dirty and the next flush persists it. The historical
+    persist-then-clear order would clear the concurrent flip's dirty
+    bit without ever writing its value — a restart would resume the
+    wrong trading mode (lockset analyzer finding, PR 10)."""
+    from matching_engine_tpu.server.engine_runner import EngineRunner
+
+    r = EngineRunner(EngineConfig(num_symbols=2, capacity=8, batch=4,
+                                  max_fills=64))
+    calls = []
+
+    def persist(value):
+        calls.append(value)
+        if len(calls) == 1:
+            # Models another thread flipping the mode mid-persist.
+            r.set_auction_mode(True)
+        return True
+
+    r.persist_auction_mode = persist
+    r.set_auction_mode(False)
+    r.flush_auction_mode()
+    assert calls == [False]
+    assert r._mode_dirty, "the mid-persist flip must keep the flag dirty"
+    r.flush_auction_mode()
+    assert calls == [False, True]
+    assert not r._mode_dirty
+
+
 def test_auction_rpc_full_abort_maps_to_failure(tmp_path):
     """An uncross whose record log cannot fit fails the RPC (success=false
     + raise-max_fills message) and leaves the books untouched."""

@@ -110,6 +110,50 @@ def test_async_sink_batches_and_flushes(store):
     sink.close()
 
 
+def test_async_sink_dropped_count_is_locked_and_exact(store):
+    """`dropped` is a cross-thread read-modify-write: K serving lanes
+    share one sink and can hit queue.Full together, so the counter
+    increments under _drop_lock (lockset analyzer finding, PR 10) —
+    concurrent failing submits must account every drop exactly."""
+    import threading
+
+    gate = threading.Event()
+    entered = threading.Event()
+    orig = store.apply_batch
+
+    def stalled(*a, **kw):
+        entered.set()
+        gate.wait(10)
+        return orig(*a, **kw)
+
+    store.apply_batch = stalled
+    sink = AsyncStorageSink(store, max_queue=1)
+    # Park the flusher inside the stalled commit, THEN fill the queue —
+    # filling earlier races the coalescing drain and some of the
+    # "failing" submits below would sneak through.
+    sink.submit(orders=[("OID-F", "c", "S", 1, 0, 100, 5, 5,
+                         STATUS_NEW)])
+    assert entered.wait(10)
+    while sink.submit(orders=[("OID-F", "c", "S", 1, 0, 100, 5, 5,
+                               STATUS_NEW)], block=False):
+        pass
+    base = sink.dropped
+    threads = [
+        threading.Thread(target=lambda: [
+            sink.submit(orders=[("OID-X", "c", "S", 1, 0, 100, 5, 5,
+                                 STATUS_NEW)], block=False)
+            for _ in range(50)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sink.dropped == base + 200
+    gate.set()
+    sink.close()
+
+
 def test_async_sink_transaction_per_batch(store):
     sink = AsyncStorageSink(store)
     sink.submit(
