@@ -56,7 +56,7 @@ from matching_engine_tpu.analysis.lockorder import CallSite, Graph
 # layers, the feed, the audit stream, durable storage, the record
 # codecs, the engine harness, and checkpointing.
 REPLAY_SCAN_DIRS = ("server", "feed", "audit", "storage", "domain",
-                    "engine", "utils/checkpoint.py")
+                    "engine", "replication", "utils/checkpoint.py")
 
 # Rule 2 — sources with no legitimate replay-path use (reachability).
 _FORBIDDEN_HEADS = ("random.", "np.random.", "numpy.random.", "uuid.",

@@ -47,6 +47,14 @@ LEVELS: dict[str, tuple[str, ...]] = {
     "owner_flush": ("EngineRunner._owner_flush_lock",
                     "NativeLanesRunner._owner_flush_lock"),
     "gw_stream": ("GatewayBridge._stream_lock",),
+    # Warm-standby replication (matching_engine_tpu/replication/):
+    # repl_promote serializes the standby->primary transition (one
+    # winner; concurrent Promote RPC / heartbeat-lapse callers wait),
+    # repl_pair guards the attestation pairing stores + the in-progress
+    # primary record group. Comparison and flight-dump run OUTSIDE
+    # repl_pair — a slow dump must not stall the applier or attestor.
+    "repl_promote": ("StandbyReplica._lock",),
+    "repl_pair": ("StandbyReplica._attest_lock",),
 }
 
 # -- the declared partial order ---------------------------------------------
@@ -143,6 +151,8 @@ ATTR_TYPES: dict[str, str | None] = {
     "dispatcher": "BatchDispatcher",
     "publisher": "DropCopyPublisher",
     "pump": "AuditPump",
+    "replica": "StandbyReplica",
+    "oplog": "OpLogShipper",
     "sub": "_Subscription",         # stream fan-out subscriptions
     "conn": "sqlite3",
     "_conn": "sqlite3",
@@ -203,6 +213,22 @@ THREAD_ROLES: dict[str, tuple[str, ...]] = {
     "trace_writer": ("TraceExporter._run",),
     # Flight-recorder dump threads (SIGUSR2 / dispatch-error).
     "flight_dump": ("FlightRecorder.dump",),
+    # Warm-standby replication (matching_engine_tpu/replication/). The
+    # primary's op-log heartbeat publisher (dispatch shipping itself runs
+    # on the drain loops — the dispatch role).
+    "oplog_ship": ("OpLogShipper._heartbeat_loop",),
+    # The standby's receive loop: SequencedSubscriber over the primary's
+    # oplog channel, resume/gap-fill, liveness stamping.
+    "repl_rx": ("StandbyReplica._rx_loop",),
+    # The standby's applier: one engine dispatch per oplog event, then
+    # the same sink/hub/drop-copy publish path a primary drain loop runs.
+    "repl_apply": ("StandbyReplica._applier_loop",),
+    # The attestor: drop-copy audit subscriber pairing primary records
+    # with locally produced rows per dispatch trace.
+    "repl_attest": ("StandbyReplica._attestor_loop",),
+    # The promotion watcher: heartbeat-age gauge, idle attestation-group
+    # flush, and the opt-in auto-promote trigger.
+    "repl_watch": ("StandbyReplica._watcher_loop",),
 }
 
 # -- shared-state ownership --------------------------------------------------
@@ -239,6 +265,14 @@ OWNERSHIP: dict[str, tuple[str, str]] = {
         "instance-confined",
         "obs.DispatchTimeline — created per dispatch by one drain loop; "
         "stamps happen on that loop (or under the dispatch lock)"),
+    "DispatchTimeline.t_build": (
+        "instance-confined",
+        "obs.DispatchTimeline — same per-dispatch confinement as "
+        "t_publish (the standby applier is just one more creating loop)"),
+    "DispatchTimeline.t_issue": (
+        "instance-confined",
+        "obs.DispatchTimeline — same per-dispatch confinement as "
+        "t_publish"),
     # Reusable pop buffer on the native ring wrappers: one per
     # dispatcher, touched only by that dispatcher's drain thread.
     "LaneRing._buf": (
@@ -272,6 +306,46 @@ OWNERSHIP: dict[str, tuple[str, str]] = {
         "engine_runner.set_auction_mode — \"persistence happens in "
         "flush_auction_mode, OUTSIDE the dispatch lock\"; sampled by "
         "dropcopy.publish for the in_auction envelope bit"),
+    # Device-step state touched from the dispatch_{sparse,dense,mega}
+    # closures: run_pipelined executes them strictly under the dispatch
+    # lock (_stage_locked/_finish_*_locked build and drive them), but
+    # the analyzer's closure rule deliberately drops lock context ("a
+    # closure runs on some caller's thread later") — the standby applier
+    # reaching run_dispatch made these the first role-visible writes.
+    # The reviewed fact: every writer holds EngineRunner._dispatch_lock.
+    "EngineRunner._step_num": (
+        "gil-atomic",
+        "engine_runner._prepare dispatch closures — executed by "
+        "run_pipelined under the dispatch lock (closure-approximation "
+        "false positive; PR 11 review)"),
+    "EngineRunner.pending_recon": (
+        "gil-atomic",
+        "engine_runner._ledger_lost — called from decode under the "
+        "dispatch lock via the _prepare closures (closure-approximation "
+        "false positive; PR 11 review)"),
+    # Order directories: every WRITE happens under the dispatch lock
+    # (registration in _decode_batch / eviction in _evict, both inside
+    # the locked decode); the lock-free dict probes from the RPC edge
+    # (CancelOrder/AmendOrder/lane_for_order "id-residue-then-directory-
+    # probe", PR 4) and the standby applier's target lookup are the
+    # documented GIL-atomic read contract — a stale probe answers like a
+    # request that arrived one dispatch earlier, and the dispatch itself
+    # re-validates under its own lock.
+    "EngineRunner.orders_by_id": (
+        "gil-atomic",
+        "service.CancelOrder/AmendOrder + standby._apply_dispatch — "
+        "documented lock-free directory probe (PR 4); all writes under "
+        "the dispatch lock in the decode path"),
+    "EngineRunner.orders_by_handle": (
+        "gil-atomic",
+        "engine_runner._decode_batch/_evict — writes under the dispatch "
+        "lock via the _prepare closures (closure-approximation false "
+        "positive; PR 11 review)"),
+    "EngineRunner.pending_owner_ids": (
+        "gil-atomic",
+        "engine_runner owner-id assignment appends under the id lock on "
+        "the decode path; flush_owner_ids drains under _owner_flush_lock "
+        "(closure-approximation false positive; PR 11 review)"),
     # Dispatch counter: incremented on the (locked) commit path, sampled
     # lock-free by the shard balance sampler — a stale single-int read
     # only skews one cadence of the lane_dispatch_rate gauge.
@@ -307,6 +381,84 @@ OWNERSHIP: dict[str, tuple[str, str]] = {
         "gil-atomic",
         "sequencer._Spill — \"GIL-atomic list ops; the replay merge "
         "dedups by seq\""),
+    # Feed epoch: a single int swapped under the sequencer lock exactly
+    # once per boot (init) or promotion (rebase_epoch, publishers
+    # quiesced first). Lock-free readers (resume staleness checks,
+    # /replz snapshots) tolerate one-transition staleness by design — a
+    # stale epoch read can only misclassify a resume as cross-epoch,
+    # which IS the client-rebase path those readers exist to trigger.
+    "FeedSequencer.epoch": (
+        "gil-atomic",
+        "sequencer.rebase_epoch — write under FeedSequencer._lock with "
+        "publishing quiesced (standby.promote step 4); readers are "
+        "epoch-inequality checks that tolerate staleness"),
+    # Subscriber-table peek: the decode path's has_*_subs reads the dict
+    # lock-free to skip proto builds when nobody listens — documented
+    # "Lock-free peek" (streams.py): a subscriber attaching mid-dispatch
+    # just misses that dispatch, same as attaching a moment later.
+    "StreamHub._md_subs": (
+        "gil-atomic",
+        "streams.has_market_data_subs — documented lock-free peek; "
+        "mutations under the hub lock"),
+    "StreamHub._ou_subs": (
+        "gil-atomic",
+        "streams.has_order_update_subs — documented lock-free peek; "
+        "mutations under the hub lock"),
+    # Warm-standby replica state (replication/standby.py). The rx loop
+    # is the only writer of the receive cursors; applier the only writer
+    # of the applied cursors; attestor/rx each own their subscriber
+    # handle. Readers (watcher cadence, /replz snapshot, promote after
+    # quiescing) take monotonic GIL-atomic snapshots.
+    "StandbyReplica._rx_seq": (
+        "single-writer", "standby._rx_loop — receive cursor; snapshot "
+                         "readers tolerate staleness"),
+    "StandbyReplica._rx_dispatch_seq": (
+        "single-writer", "standby._rx_loop — lag baseline; the applier "
+                         "reads a monotonic snapshot"),
+    "StandbyReplica._rx_bytes": (
+        "single-writer", "standby._rx_loop — lag accounting"),
+    "StandbyReplica._last_rx": (
+        "single-writer", "standby._rx_loop — liveness stamp; the "
+                         "watcher's heartbeat-age read is monotonic"),
+    "StandbyReplica._ever_rx": (
+        "single-writer", "standby._rx_loop — monotonic bool latch "
+                         "(False -> True only); the watcher's "
+                         "auto-promote arm check tolerates a one-poll-"
+                         "stale False (it refuses, then arms next poll)"),
+    "StandbyReplica._rx_sub": (
+        "single-writer", "standby._rx_loop — reconnect swaps its own "
+                         "subscriber; promote/close only cancel() the "
+                         "latest (a stale cancel is re-issued on the "
+                         "next loop turn, which sees _stop set)"),
+    "StandbyReplica._attest_sub": (
+        "single-writer", "standby._attestor_loop — same contract as "
+                         "_rx_sub"),
+    "StandbyReplica._applied_seq": (
+        "single-writer", "standby._apply_dispatch — applied cursor; "
+                         "promote reads it after joining the applier"),
+    "StandbyReplica._applied_bytes": (
+        "single-writer", "standby._apply_dispatch — lag accounting"),
+    "StandbyReplica._max_oid": (
+        "single-writer", "standby._apply_dispatch — OID floor input; "
+                         "promote reads it after joining the applier"),
+    # Latches: set-once (or monotonic) flags written by whichever
+    # replication thread observes the condition first, read by /replz.
+    "StandbyReplica.diverged": (
+        "gil-atomic", "standby._compare — monotonic bool latch (False -> "
+                      "True only)"),
+    "StandbyReplica.poisoned": (
+        "gil-atomic", "standby._poison — first-writer-wins string latch "
+                      "(checked-then-set; a second writer's reason is "
+                      "dropped, the replica is equally dead either way)"),
+    "StandbyReplica._promote_started": (
+        "gil-atomic", "standby.promote — bool latch swapped under "
+                      "repl_promote; the watcher/snapshot read a "
+                      "one-transition-stale value at worst"),
+    "StandbyReplica.promoted_epoch": (
+        "gil-atomic", "standby.promote — written once by the single "
+                      "promote winner (started-flag swap under "
+                      "repl_promote); losers wait on _promote_done "
+                      "before reading"),
     # Subscriber bookkeeping: drops is a monotonic counter bumped by
     # whichever publisher hits the full queue; last_seq is written by
     # the one consumer thread and read by the publisher's lag scan,

@@ -46,7 +46,7 @@ from matching_engine_tpu.analysis.common import (
 # Scanned surface: the concurrency-bearing layers. utils/checkpoint.py
 # rides along because it quiesces the dispatch lock from outside server/.
 SCAN_DIRS = ("server", "feed", "audit", "storage", "native",
-             "utils/checkpoint.py")
+             "replication", "utils/checkpoint.py")
 
 _SQLITE_RECEIVERS = frozenset(
     a for a, t in hierarchy.ATTR_TYPES.items() if t == "sqlite3")

@@ -47,6 +47,11 @@ from matching_engine_tpu.proto import pb2
 # Reserved StreamOrderUpdates client_id that subscribes the caller to the
 # drop-copy audit channel instead of a per-client update stream.
 AUDIT_CLIENT = "__dropcopy__"
+# Same channel, but cursor 0 means "from the epoch start" (a full
+# retained-window replay) instead of the legacy live-only attach — the
+# standby attestor's contract: it must pair the primary's audit records
+# for the SAME replayed range its applier consumed from the op log.
+AUDIT_CLIENT_FULL = "__dropcopy_all__"
 
 KIND_ORDER, KIND_UPDATE, KIND_FILL = 1, 2, 3
 

@@ -38,7 +38,8 @@ USAGE = (
     "                 [--no-gap-fill] [--max-events N] [--idle-exit SECS]\n"
     "                 [--capture FILE] [--summary-json FILE] [--quiet]\n"
     "   or: client metrics <addr>\n"
-    "   or: client auction <addr> [symbol]"
+    "   or: client auction <addr> [symbol]\n"
+    "   or: client promote <addr>"
 )
 
 
@@ -560,6 +561,26 @@ def _submit_batch(argv: list[str]) -> int:
     return 0 if accepted > 0 or total == 0 else 3
 
 
+def _promote(addr: str) -> int:
+    """Failover verb: flip the --standby replica at `addr` into the
+    serving primary (replication/standby.py promote — feed-epoch bump,
+    OID floor re-seed, mutation RPCs open). Exit 3 when the target is not
+    a standby, matching the submit-reject convention; connected
+    subscribers observe one epoch rebase and resume with their cursors."""
+    try:
+        resp = _stub(addr).Promote(pb2.PromoteRequest(), timeout=60)
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}: {e.details()}",
+              file=sys.stderr)
+        return 2
+    if not resp.success:
+        print(f"[client] promote rejected: {resp.error_message}",
+              file=sys.stderr)
+        return 3
+    print(f"[client] promoted: feed_epoch={resp.feed_epoch}")
+    return 0
+
+
 def _metrics(addr: str) -> int:
     resp = _stub(addr).GetMetrics(pb2.MetricsRequest(), timeout=10)
     for k in sorted(resp.counters):
@@ -616,6 +637,8 @@ def _dispatch(argv: list[str]) -> int:
             return _watch_orders(argv[1], argv[2])
         if len(argv) == 2 and argv[0] == "metrics":
             return _metrics(argv[1])
+        if len(argv) == 2 and argv[0] == "promote":
+            return _promote(argv[1])
     except (ValueError, IndexError):
         pass
     print(USAGE, file=sys.stderr)

@@ -34,6 +34,7 @@ import grpc
 from matching_engine_tpu.feed.sequencer import (
     CHANNEL_AUDIT,
     CHANNEL_MD,
+    CHANNEL_OPLOG,
     CHANNEL_OU,
 )
 from matching_engine_tpu.proto import pb2
@@ -53,8 +54,9 @@ class SequencedSubscriber:
     def __init__(self, stub, channel: str, key: str = "", from_seq: int = 0,
                  conflate: bool = False, gap_fill: bool = True,
                  fill_timeout_s: float = 10.0, on_gap=None,
-                 on_rebase=None, epoch: int = 0):
-        if channel not in (CHANNEL_MD, CHANNEL_OU, CHANNEL_AUDIT):
+                 on_rebase=None, epoch: int = 0, from_start: bool = False):
+        if channel not in (CHANNEL_MD, CHANNEL_OU, CHANNEL_AUDIT,
+                           CHANNEL_OPLOG):
             raise ValueError(f"unknown feed channel {channel!r}")
         if conflate and channel != CHANNEL_MD:
             raise ValueError("conflation is a market-data channel option")
@@ -62,6 +64,14 @@ class SequencedSubscriber:
         self.channel = channel
         self.key = key
         self.from_seq = from_seq
+        # from_start: treat seq 0 as a REAL cursor — the stream must
+        # begin at the domain's first retained event, so a first live
+        # event with seq > 1 counts as a gap and gap-fills from 0 (the
+        # standby replica's contract: it must see EVERY oplog record or
+        # account the loss). The server grants a full (0, head] replay
+        # for resume_from_seq == 0 on the oplog channel and, via the
+        # __dropcopy_all__ reserved id, on the audit channel.
+        self.from_start = from_start
         self.conflate = conflate
         self.gap_fill = gap_fill
         self.fill_timeout_s = fill_timeout_s
@@ -75,6 +85,9 @@ class SequencedSubscriber:
         self.unrecovered_events = 0  # seqs lost for good (store evicted)
         self.conflated_jumps = 0     # seq jumps on a conflated channel
         self.epoch_rebases = 0       # server restarts observed (seqs reset)
+        self.filling = False         # a gap-fill is in flight (the
+        # consumer may stall up to fill_timeout_s without the stream
+        # being idle — watchers pacing on consumption must not time out)
         # Boot epoch the cursor belongs to (echoed on resume requests;
         # learned/refreshed from events). With it, a cross-restart resume
         # is detected even when the new boot's head outran the cursor.
@@ -95,9 +108,19 @@ class SequencedSubscriber:
                                       feed_epoch=self.epoch),
                 timeout=timeout)
         if self.channel == CHANNEL_AUDIT:
-            from matching_engine_tpu.audit.dropcopy import AUDIT_CLIENT
+            from matching_engine_tpu.audit.dropcopy import (
+                AUDIT_CLIENT,
+                AUDIT_CLIENT_FULL,
+            )
 
-            key = AUDIT_CLIENT
+            # from_start needs the _FULL reserved id: only it makes
+            # cursor 0 a real from-the-epoch-start cursor server-side
+            # (plain __dropcopy__ keeps the legacy live-only attach).
+            key = AUDIT_CLIENT_FULL if self.from_start else AUDIT_CLIENT
+        elif self.channel == CHANNEL_OPLOG:
+            from matching_engine_tpu.replication.oplog import OPLOG_CLIENT
+
+            key = OPLOG_CLIENT
         else:
             key = self.key
         return self.stub.StreamOrderUpdates(
@@ -163,14 +186,16 @@ class SequencedSubscriber:
                     self.events += 1
                     yield e
                     continue
-                if seq <= self._call_max:
-                    continue  # duplicate within this connection
                 ep = e.feed_epoch
                 if ep and self.epoch and ep != self.epoch:
                     # The authoritative rebase signal: a different boot
                     # epoch — detected even when the new boot's head has
                     # outrun the stale cursor (seqs alone can't tell a
-                    # cross-epoch replay from a same-epoch one). Gap
+                    # cross-epoch replay from a same-epoch one). Checked
+                    # BEFORE the connection-duplicate cursor: an in-place
+                    # rebase (standby promotion under a LIVE stream)
+                    # restarts seqs at 1 on the same connection, which
+                    # the duplicate check would silently eat. Gap
                     # accounting cannot span epochs; the old epoch's
                     # unreceived tail is unknowable and reported as the
                     # rebase, never silently blended.
@@ -179,8 +204,12 @@ class SequencedSubscriber:
                         self.on_rebase(self.last_seq, seq)
                     self.epoch = ep
                     self.last_seq = seq - 1
-                elif ep and not self.epoch:
-                    self.epoch = ep
+                    self._call_max = 0  # new seq line, new dedup cursor
+                else:
+                    if ep and not self.epoch:
+                        self.epoch = ep
+                    if seq <= self._call_max:
+                        continue  # duplicate within this connection
                 if seq <= self.last_seq:
                     # Fallback for epoch-less events: below the cursor
                     # yet NOT a duplicate of anything this connection
@@ -190,18 +219,23 @@ class SequencedSubscriber:
                     if self.on_rebase is not None:
                         self.on_rebase(self.last_seq, seq)
                     self.last_seq = seq - 1
-                if self.last_seq and seq > self.last_seq + 1:
+                if (self.last_seq or self.from_start) \
+                        and seq > self.last_seq + 1:
                     if self.conflate:
                         self.conflated_jumps += 1  # expected, not a gap
                     else:
                         self.gaps_detected += 1
                         gap_start, filled = self.last_seq, 0
                         if self.gap_fill:
-                            for g in self._fill(self.last_seq, seq):
-                                filled += 1
-                                self.last_seq = g.seq
-                                self.events += 1
-                                yield g
+                            self.filling = True
+                            try:
+                                for g in self._fill(self.last_seq, seq):
+                                    filled += 1
+                                    self.last_seq = g.seq
+                                    self.events += 1
+                                    yield g
+                            finally:
+                                self.filling = False
                         else:
                             self.unrecovered_events += seq - self.last_seq - 1
                         if self.on_gap is not None:

@@ -64,9 +64,21 @@ CHANNEL_OU = "ou"       # keyed by client_id
 # OrderUpdate messages with audit_kind set.
 CHANNEL_AUDIT = "audit"
 AUDIT_DOMAIN_KEY = ""
+# Warm-standby op log (matching_engine_tpu/replication/): ONE venue-wide
+# seq domain (key "") so the whole admitted-dispatch stream is densely
+# sequenced — a standby replica applies it in seq order and a gap is
+# evidence of lost replication input. Events are OrderUpdate messages
+# with oplog_kind set (dispatch payloads + heartbeats).
+CHANNEL_OPLOG = "oplog"
+OPLOG_DOMAIN_KEY = ""
+# Event kinds on the oplog channel (OrderUpdate.oplog_kind). Defined here
+# rather than in replication/ so the hub can stamp-filter without
+# importing the replication package (whose __init__ pulls the server
+# stack back in). replication/oplog.py re-exports them.
+OPLOG_DISPATCH, OPLOG_HEARTBEAT = 1, 2
 
 _EVENT_CLS = {CHANNEL_MD: pb2.MarketDataUpdate, CHANNEL_OU: pb2.OrderUpdate,
-              CHANNEL_AUDIT: pb2.OrderUpdate}
+              CHANNEL_AUDIT: pb2.OrderUpdate, CHANNEL_OPLOG: pb2.OrderUpdate}
 
 
 class RetransmissionRing:
@@ -277,6 +289,20 @@ class FeedSequencer:
             except OSError:
                 pass
             self.spill_root = os.path.join(spill_dir, f"epoch-{self.epoch}")
+            # Created eagerly: the live line's dir IS the operator-visible
+            # marker of the current epoch (failover runbook), and the
+            # promotion test asserts the active epoch by its presence.
+            try:
+                os.makedirs(self.spill_root, exist_ok=True)
+            except OSError:
+                pass
+            # Spawn the spill flusher here, not lazily on first segment:
+            # segments enqueue from every publishing thread OUTSIDE the
+            # sequencer lock, so a lazy spawn could race two publishers
+            # into two flusher threads (lockset analyzer finding, PR 11).
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="feed-spill", daemon=True)
+            self._flusher.start()
 
     def _domain(self, channel: str, key: str) -> RetransmissionRing:
         dom = self._domains.get((channel, key))
@@ -337,6 +363,64 @@ class FeedSequencer:
         self._stamp(CHANNEL_OU, updates, lambda u: u.client_id)
         if self.metrics is not None:
             self.metrics.inc("feed_ou_published", len(updates))
+
+    def stamp_oplog(self, updates) -> None:
+        """Op-log records (replication/oplog.py): one venue-wide domain,
+        normal ring/spill retention — the events are already-built
+        OrderUpdate protos (one per dispatch + heartbeats), so unlike the
+        audit channel there is no per-record materialization to defer.
+        The retransmission window is what bounds how far behind a standby
+        may fall and still catch up by replay (size --feed-depth /
+        --feed-spill-dir accordingly; docs/OPERATIONS.md runbook)."""
+        self._stamp(CHANNEL_OPLOG, updates, lambda u: OPLOG_DOMAIN_KEY)
+        if self.metrics is not None:
+            self.metrics.inc("feed_oplog_published", len(updates))
+            # .get(), not [] — a promotion's rebase_epoch clears
+            # _domains from another thread; a racing gauge read must
+            # degrade to "no update", not KeyError the publisher.
+            dom = self._domains.get((CHANNEL_OPLOG, OPLOG_DOMAIN_KEY))
+            if dom is not None:
+                self.metrics.set_gauge("repl_oplog_head_seq", dom.last_seq)
+
+    def rebase_epoch(self) -> int:
+        """Promotion epoch bump (replication/standby.py promote): start a
+        FRESH feed epoch — every seq domain rebases to 1, the audit chunk
+        store resets, and spill segments from the pre-promotion line are
+        purged (a resuming subscriber must never be served the old line's
+        payloads as the new epoch's range). Callers quiesce publishing
+        first (the standby applier is stopped and pending dispatches
+        drained before promote rebases); connected clients observe
+        exactly one epoch_rebases increment. Returns the new epoch."""
+        # Drain buffered spill rows to disk first so the flusher holds no
+        # in-flight batches pointed at directories about to be purged.
+        self.flush_spill()
+        with self._lock:
+            old = self.epoch
+            new = (int(time.time()) << 16) | (os.getpid() & 0xFFFF)
+            if new <= old:
+                new = old + 1  # same second + pid: inequality is the contract
+            self.epoch = new
+            self._domains.clear()
+            self._retired.clear()
+            self._audit_next = 1
+            self._audit_chunks.clear()
+            self._audit_retained = 0
+            spill_base = (os.path.dirname(self.spill_root)
+                          if self.spill_root else None)
+            if spill_base:
+                self.spill_root = os.path.join(spill_base,
+                                               f"epoch-{self.epoch}")
+        if spill_base:
+            try:
+                for name in os.listdir(spill_base):
+                    if (name.startswith("epoch-")
+                            and name != f"epoch-{self.epoch}"):
+                        shutil.rmtree(os.path.join(spill_base, name),
+                                      ignore_errors=True)
+                os.makedirs(self.spill_root, exist_ok=True)
+            except OSError:
+                pass
+        return new
 
     def stamp_audit_rows(self, rows, env, n: int) -> int:
         """Drop-copy records: one venue-wide domain (every serving lane
@@ -405,10 +489,8 @@ class FeedSequencer:
     # -- spill flusher -----------------------------------------------------
 
     def _enqueue_segment(self, spill: _Spill, rows) -> None:
-        if self._flusher is None:
-            self._flusher = threading.Thread(
-                target=self._flush_loop, name="feed-spill", daemon=True)
-            self._flusher.start()
+        # self._flusher was started in __init__ (spill configured implies
+        # spill_root implies the thread exists).
         try:
             self._flush_q.put_nowait((spill, rows))
         except queue.Full:

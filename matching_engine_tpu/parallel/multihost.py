@@ -39,6 +39,7 @@ localhost coordinator, 4+4 virtual CPU devices).
 
 from __future__ import annotations
 
+import os
 import zlib
 
 import jax
@@ -74,6 +75,55 @@ def _cluster_detected(env) -> bool:
     return len(env.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1
 
 
+def cpu_collectives_available() -> bool:
+    """True when this jaxlib can run cross-process collectives on the CPU
+    backend (the gloo TCP implementation, jaxlib >= 0.4.34). The
+    capability probe tests/test_multiprocess.py skips on: without it a
+    multiprocess CPU computation dies at compile time with "Multiprocess
+    computations aren't implemented on the CPU backend"."""
+    try:
+        from jax._src.lib import xla_extension
+
+        return hasattr(xla_extension, "make_gloo_tcp_collectives")
+    except ImportError:
+        return False
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo CPU collectives implementation when it exists and
+    none was chosen. jaxlib ships the implementation but jax defaults
+    jax_cpu_collectives_implementation to "none", so a multi-process CPU
+    mesh (every tests/test_multiprocess.py scenario, and CI generally)
+    fails at compile time unless the flag flips BEFORE the CPU client is
+    created — which is why this rides initialize(). Non-CPU backends
+    ignore the flag entirely (it only parameterizes CPU client creation),
+    so real-TPU runs are unaffected; an operator's explicit choice (env
+    JAX_CPU_COLLECTIVES_IMPLEMENTATION or config) is respected."""
+    if not cpu_collectives_available():
+        return
+    try:
+        # The flag holder, not jax.config.<name> — 0.4.x defines the enum
+        # flag without a Config attribute, while update() still works.
+        from jax._src import xla_bridge as _xb
+
+        current = _xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except (ImportError, AttributeError):
+        current = None
+    if os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        # Explicit operator choice — respect it even when it reads back
+        # as "none" (e.g. disabling gloo to dodge a TCP hang); only the
+        # unset default gets auto-selected.
+        return
+    if current in (None, "none"):
+        # None = the private holder moved (API drift) but the capability
+        # exists — still attempt the select, else the capability probe
+        # says "don't skip" while the tests die at compile time.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, KeyError, ValueError):
+            pass  # jax without the flag: nothing to select
+
+
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -104,6 +154,7 @@ def initialize(
         pass  # private probe unavailable on this jax; initialize() below
         # raises RuntimeError if actually double-initialized, which the
         # except arm treats as non-fatal for detected (non-explicit) runs.
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -31,6 +31,7 @@ _METHODS = {
     "RunAuction": ("unary_unary", pb2.AuctionRequest, pb2.AuctionResponse),
     "SubmitOrderBatch": ("unary_unary", pb2.OrderBatchRequest,
                          pb2.OrderBatchResponse),
+    "Promote": ("unary_unary", pb2.PromoteRequest, pb2.PromoteResponse),
 }
 
 
@@ -66,6 +67,10 @@ class MatchingEngineServicer:
     def SubmitOrderBatch(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED,
                       "SubmitOrderBatch not implemented")
+
+    def Promote(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                      "Promote not implemented")
 
 
 def add_matching_engine_servicer(servicer: MatchingEngineServicer, server: grpc.Server) -> None:

@@ -1,0 +1,45 @@
+"""Warm-standby replication (ROADMAP item 2, arXiv:2402.09527's design).
+
+The subsystem composes substrate every prior PR machine-checked into
+hot/warm high availability:
+
+- `oplog.OpLogShipper` — the primary republishes every admitted
+  dispatch's op records (the flat oprec codec, PR 7 — submits carry
+  their primary-assigned order ids) as a new sequenced `oplog` feed
+  channel, so a standby inherits resume/gap-fill/epoch-rebase from the
+  feed layer for free;
+- `standby.StandbyReplica` — a second server process boots
+  `--standby <primary addr>`, applies the op log deterministically
+  through its own runner + SQLite sink (bit-identical replay is the
+  megadispatch-parity + determinism-taint contract, PR 10), serves
+  read-only, and continuously ATTESTS: its locally produced storage
+  rows must be byte-identical to the primary's drop-copy audit records
+  per dispatch — divergence flight-dumps both sides and turns `/replz`
+  red, making the determinism contract observed in production;
+- promotion — on primary loss (heartbeat lapse with
+  `--standby-auto-promote-s`, or the explicit `Promote` RPC /
+  `client promote` verb) the standby bumps its feed epoch, re-seeds the
+  per-residue-class OID floors from its durable store, and opens the
+  mutation RPCs; existing sequenced-feed clients rebase.
+
+Replication is ASYNCHRONOUS: acks do not wait for the standby, so a
+SIGKILLed primary can lose the in-flight tail (bounded by one
+publish->receive window) — the same bound the async SQLite sink already
+accepts. The kill-the-primary soak round and tests/test_replication.py
+pin what IS guaranteed: the applied prefix is bit-identical, gap-free,
+and a promoted replica serves on from it with no order-id collisions.
+"""
+
+from matching_engine_tpu.replication.oplog import (
+    OPLOG_CLIENT,
+    OPLOG_DISPATCH,
+    OPLOG_HEARTBEAT,
+    OpLogShipper,
+    ops_from_oprec,
+    ops_to_oprec,
+)
+from matching_engine_tpu.replication.standby import StandbyReplica
+
+__all__ = ["OPLOG_CLIENT", "OPLOG_DISPATCH", "OPLOG_HEARTBEAT",
+           "OpLogShipper", "StandbyReplica", "ops_from_oprec",
+           "ops_to_oprec"]

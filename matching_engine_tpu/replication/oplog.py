@@ -1,0 +1,149 @@
+"""Op-log shipping: the primary's side of warm-standby replication.
+
+Every dispatch the serving drain loops admit is re-encoded as ONE
+sequenced `oplog` event carrying the dispatch's ops in the flat binary
+op-record codec (domain/oprec.py — already the language-neutral replay
+unit) plus the envelope a standby needs:
+
+- submits ship WITH their primary-assigned "OID-<n>" in the record's
+  order_id box: order-id assignment happens at the RPC edge in arrival
+  order, which can differ from dispatch order under concurrent handlers,
+  so a replica re-assigning ids in dispatch order would diverge — the
+  log is authoritative for identity, the replica's engine only for
+  consequences;
+- the event's `trace_id` is the PRIMARY dispatch's trace id — the same
+  id every drop-copy audit record of that dispatch carries, which is
+  what lets the standby's attestor pair "what I produced" with "what
+  the primary produced" exactly, even when K lanes interleave;
+- `oplog_lane` names the serving lane so a sharded standby routes the
+  whole dispatch to its mirror lane.
+
+Shipping rides the drain loop's on_finish (under the dispatch lock,
+next to the drop-copy publish): the proto and payload are built BEFORE
+`StreamHub.publish_oplog` so nothing materializes under the hub lock,
+and the ship strictly precedes the dispatch's client completions — an
+acked op is always already in the retransmission store. Heartbeats
+publish from a dedicated shipper thread so an idle primary still proves
+liveness (the standby's heartbeat-lapse trigger).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.kernel import OP_AMEND, OP_CANCEL, OP_SUBMIT
+from matching_engine_tpu.feed.sequencer import (  # noqa: F401 — re-export
+    OPLOG_DISPATCH,
+    OPLOG_HEARTBEAT,
+)
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.utils.obs import warn_rate_limited
+
+# Reserved StreamOrderUpdates client_id that subscribes the caller to the
+# sequenced op-log channel (the audit channel's AUDIT_CLIENT pattern).
+OPLOG_CLIENT = "__oplog__"
+
+
+def ops_to_oprec(ops) -> tuple[bytes, int]:
+    """One dispatch's EngineOps -> (oprec payload, count).
+
+    The record is the engine-facing tuple the batch edge already speaks;
+    the one replication-specific convention is that SUBMIT records carry
+    the assigned order id (the batch edge leaves it empty — ids are
+    assigned server-side there, log-side here)."""
+    rows = []
+    for e in ops:
+        i = e.info
+        if e.op == OP_SUBMIT:
+            rows.append((oprec.OPREC_SUBMIT, i.side, i.otype, i.price_q4,
+                         i.quantity, i.symbol, i.client_id, i.order_id))
+        elif e.op == OP_CANCEL:
+            rows.append((oprec.OPREC_CANCEL, 0, 0, 0, 0, "",
+                         e.cancel_requester, i.order_id))
+        elif e.op == OP_AMEND:
+            rows.append((oprec.OPREC_AMEND, 0, 0, 0, e.amend_qty, "",
+                         i.client_id, i.order_id))
+        # OP_REST never ships: it exists only on boot-recovery replays,
+        # which run before any dispatcher (and before the shipper) exists.
+    return oprec.encode_payload(oprec.pack_records(rows)), len(rows)
+
+
+def ops_from_oprec(payload: bytes):
+    """Op-log payload -> [(op, side, otype, price_q4, qty, symbol,
+    client_id, order_id) ...] with str identifiers — the standby
+    applier's input (identifiers were validated UTF-8 at the primary's
+    edge, so decode errors here are transport corruption and raise)."""
+    arr = oprec.decode_payload(payload)
+    out = []
+    for r in arr:
+        op, side, otype, price_q4, qty, sym, cid, oid = oprec.record_fields(r)
+        out.append((op, side, otype, price_q4, qty, sym.decode(),
+                    cid.decode(), oid.decode()))
+    return out
+
+
+class OpLogShipper:
+    """Per-server op-log publisher. `ship()` is called by each lane's
+    drain loop on_finish (under that lane's dispatch lock); the heartbeat
+    loop is this subsystem's own thread. One shipper serves every lane —
+    the hub lock already serializes cross-lane stamping."""
+
+    def __init__(self, hub, metrics, heartbeat_s: float = 0.25):
+        self.hub = hub
+        self.metrics = metrics
+        self.heartbeat_s = heartbeat_s
+        # Pre-register the exported series (zeros, not absence).
+        metrics.inc("repl_oplog_dispatches", 0)
+        metrics.inc("repl_oplog_records", 0)
+        metrics.inc("repl_oplog_bytes", 0)
+        metrics.set_gauge("repl_oplog_head_seq", 0)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        name="oplog-shipper", daemon=True)
+        self._thread.start()
+
+    def ship(self, ops, timeline=None, lane: int = 0) -> None:
+        """Publish one admitted dispatch's ops. Build-then-publish: the
+        proto/payload work happens on the calling drain thread OUTSIDE
+        the hub lock; publish_oplog stamps + fans out inside it."""
+        if not ops:
+            return
+        payload, n = ops_to_oprec(ops)
+        if n == 0:
+            return
+        ev = pb2.OrderUpdate(
+            oplog_kind=OPLOG_DISPATCH, oplog_ops=payload, oplog_count=n,
+            oplog_lane=lane,
+            trace_id=timeline.trace_id if timeline is not None else 0)
+        self.hub.publish_oplog([ev])
+        self.metrics.inc("repl_oplog_dispatches")
+        self.metrics.inc("repl_oplog_records", n)
+        self.metrics.inc("repl_oplog_bytes", len(payload))
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.hub.publish_oplog([pb2.OrderUpdate(
+                    oplog_kind=OPLOG_HEARTBEAT)])
+            except Exception as e:  # noqa: BLE001 — a dead heartbeat
+                # thread reads as primary loss downstream (phantom
+                # auto-promotions); log and keep beating.
+                warn_rate_limited(
+                    "oplog-heartbeat",
+                    f"[repl] heartbeat publish failed: "
+                    f"{type(e).__name__}: {e}")
+
+    def snapshot(self) -> dict:
+        c, g = self.metrics.snapshot()
+        return {
+            "role": "primary", "ok": True,
+            "oplog_dispatches": c.get("repl_oplog_dispatches", 0),
+            "oplog_records": c.get("repl_oplog_records", 0),
+            "oplog_bytes": c.get("repl_oplog_bytes", 0),
+            "oplog_head_seq": int(g.get("repl_oplog_head_seq", 0)),
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,728 @@
+"""The deterministic standby replica + continuous attestation + promotion.
+
+A server booted `--standby <primary addr>` builds its normal serving
+stack (runner(s), dispatcher(s), sink, hub, feed) but keeps the mutation
+RPCs closed (service.read_only) and instead drives the engine from the
+primary's sequenced op log:
+
+- **rx** (`_rx_loop`): a `SequencedSubscriber` on the `oplog` channel —
+  full replay from seq 1 on first attach (`from_start`), resume +
+  gap-fill on reconnect. Received events land in a bounded queue; the
+  split from apply is what makes replication lag measurable (rx cursor
+  vs applied cursor, in seqs and bytes) instead of hidden in gRPC flow
+  control. An UNRECOVERABLE oplog gap (evicted past the primary's
+  retransmission window) poisons the replica — `/replz` goes red; the
+  operator re-bootstraps rather than serving a state with a hole in it.
+
+- **apply** (`_applier_loop`): each oplog dispatch event is applied as
+  ONE engine dispatch on its mirror lane — dispatch boundaries are part
+  of the determinism contract (an ORDER row carries final-of-dispatch
+  status, so merging or splitting primary dispatches would change rows
+  even with identical op order). Submits register with the PRIMARY's
+  order id (the log is authoritative for identity); the engine replay
+  produces everything else, and the standby's own sink/hub/drop-copy
+  publish exactly as a primary's drain loop would.
+
+- **attest** (`_attestor_loop`): subscribes to the primary's drop-copy
+  audit channel and pairs each primary dispatch's records with the
+  locally produced rows by the dispatch trace id (shipped in the oplog
+  envelope; stamped on every audit record). The comparison surface is
+  the normalized drop-copy tuple — every storage-row field, with the
+  declared wall-clock envelope excluded — so "replica == primary" is
+  *observed per dispatch in production*, not just statically proven.
+  First divergence flight-dumps both sides and turns `/replz` red.
+  Requires the primary to run `--audit` (the drop-copy IS the
+  attestation substrate); without it the standby still replicates,
+  with `attested == 0` visible on `/replz`.
+
+- **promotion** (`promote`): on heartbeat lapse (opt-in
+  `--standby-auto-promote-s`) or the explicit `Promote` RPC — quiesce
+  rx/apply (draining every received event), re-seed the
+  per-residue-class OID floors, bump the feed epoch (purging the old
+  line's spill segments), and open the mutation RPCs. Clients rebase on
+  the epoch change; sub-second kill-to-first-accept is measured by
+  benchmarks/failover_bench.py.
+
+Fault injection: ME_REPL_FAULT=row corrupts exactly one standby-side
+row before attestation — the detection path's own proof, mirrored from
+ME_AUDIT_FAULT (tests + the soak's kill round boot the standby with it
+to assert `/replz` CAN go red).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+import grpc
+
+from matching_engine_tpu.audit.dropcopy import dropcopy_events
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.kernel import OP_AMEND, OP_CANCEL, OP_SUBMIT
+from matching_engine_tpu.feed.client import SequencedSubscriber
+from matching_engine_tpu.feed.sequencer import CHANNEL_AUDIT, CHANNEL_OPLOG
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.replication.oplog import OPLOG_DISPATCH, ops_from_oprec
+from matching_engine_tpu.server.dispatcher import publish_result
+from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+from matching_engine_tpu.utils.obs import warn_rate_limited
+
+_STOP = object()
+
+
+def normalize_audit_event(e) -> tuple:
+    """One drop-copy record -> the attestation tuple: every storage-row
+    field, none of the dispatch envelope (trace/shape/waves/ingress are
+    the DECLARED wall-clock surface — hierarchy.DETERMINISM_WAIVERS) and
+    none of the feed stamps (seq/epoch are per-line by design)."""
+    return (e.audit_kind, e.order_id, e.client_id, e.symbol, e.status,
+            e.remaining_quantity, e.audit_quantity, e.audit_side,
+            e.audit_otype, e.fill_price, e.fill_quantity, e.scale,
+            e.counter_order_id)
+
+
+def normalize_rows(orders, updates, fills) -> list[tuple]:
+    """Storage rows -> attestation tuples through the SAME record
+    builder the primary's drop-copy uses (dropcopy_events) — one mapping
+    definition is what makes 'byte-identical rows' a structural
+    comparison, not a parallel re-implementation."""
+    return [normalize_audit_event(e)
+            for e in dropcopy_events(orders, updates, fills)]
+
+
+class _ReplFault:
+    """Single-shot standby-side corruption (ME_REPL_FAULT=row): bump one
+    local row tuple's quantity field before attestation, once."""
+
+    def __init__(self, kind: str | None = None):
+        self.kind = kind if kind is not None \
+            else (os.environ.get("ME_REPL_FAULT", "") or None)
+        self.fired = False
+
+    def apply(self, rows: list[tuple]) -> list[tuple]:
+        if self.kind != "row" or self.fired or not rows:
+            return rows
+        self.fired = True
+        r = rows[0]
+        # Index 5 is remaining_quantity — any field works; the attestor
+        # compares whole tuples.
+        return [r[:5] + (r[5] + 1,) + r[6:]] + rows[1:]
+
+
+class StandbyReplica:
+    """Wires the standby threads over an already-built serving stack
+    (server/main.build_server constructs one, then hands it here)."""
+
+    # Bounded pairing stores: a side that runs ahead parks groups here
+    # until the other side's record for the same trace id arrives.
+    _ATTEST_PENDING_MAX = 8192
+
+    def __init__(self, primary_addr: str, *, runners, shards, sink, hub,
+                 sequencer, storage, metrics, service,
+                 auto_promote_s: float = 0.0, attest: bool = True,
+                 rx_queue: int = 1024, fault: _ReplFault | None = None):
+        self.primary_addr = primary_addr
+        self.runners = runners
+        self.shards = shards  # server/shards.ServingShards | None
+        self.sink = sink
+        self.hub = hub
+        self.sequencer = sequencer
+        self.storage = storage
+        self.metrics = metrics
+        self.service = service
+        self.auto_promote_s = auto_promote_s
+        self.attest = attest
+        self.fault = fault if fault is not None else _ReplFault()
+        # Pre-register every exported me_repl_* series.
+        m = metrics
+        m.inc("repl_applied_dispatches", 0)
+        m.inc("repl_applied_ops", 0)
+        m.inc("repl_apply_errors", 0)
+        m.inc("repl_attested_dispatches", 0)
+        m.inc("repl_divergences", 0)
+        m.inc("repl_attest_unmatched", 0)
+        m.inc("repl_oplog_lost_records", 0)
+        m.inc("repl_promotions", 0)
+        m.inc("repl_epoch_rebases_seen", 0)
+        m.set_gauge("repl_is_standby", 1)
+        m.set_gauge("repl_rx_seq", 0)
+        m.set_gauge("repl_applied_seq", 0)
+        m.set_gauge("repl_lag_seqs", 0)
+        m.set_gauge("repl_lag_bytes", 0)
+        m.set_gauge("repl_heartbeat_age_s", 0)
+        self._q: queue.Queue = queue.Queue(maxsize=rx_queue)
+        self._lock = threading.Lock()          # promote state transition
+        self._attest_lock = threading.Lock()   # pairing stores + rx group
+        self._attest_local: dict[int, list] = {}
+        self._attest_primary: dict[int, list] = {}
+        self._att_group: list = []             # primary records, current run
+        self._att_trace = 0
+        self._att_stamp = 0.0
+        self._stop = threading.Event()
+        self._promote_started = False
+        self._promote_done = threading.Event()
+        self.promoted_epoch = 0
+        self.diverged = False          # attestation mismatch observed
+        self.poisoned: str | None = None  # unrecoverable state (gap/rebase)
+        self._last_rx = time.monotonic()
+        # Auto-promotion arms only after the rx loop has received at
+        # least one event from the primary: a standby that NEVER heard
+        # from it (wrong --standby address, primary not yet up) must not
+        # self-promote an empty replica into a second writable server.
+        self._ever_rx = False
+        self._rx_seq = 0
+        # Seq of the newest received DISPATCH event — the lag baseline.
+        # (Heartbeats arrive unsequenced, seq 0; the split from _rx_seq
+        # guards any future sequenced non-dispatch kind from reading as
+        # phantom lag.)
+        self._rx_dispatch_seq = 0
+        self._rx_bytes = 0
+        self._applied_seq = 0
+        self._applied_bytes = 0
+        self._max_oid = 0
+        self._rx_sub = None
+        self._attest_sub = None
+        self._rx_thread = threading.Thread(target=self._rx_loop,
+                                           name="repl-rx", daemon=True)
+        self._apply_thread = threading.Thread(target=self._applier_loop,
+                                              name="repl-apply", daemon=True)
+        self._threads = [
+            self._rx_thread,
+            self._apply_thread,
+            threading.Thread(target=self._watcher_loop, name="repl-watch",
+                             daemon=True),
+        ]
+        if attest:
+            self._threads.append(
+                threading.Thread(target=self._attestor_loop,
+                                 name="repl-attest", daemon=True))
+        for t in self._threads:
+            t.start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _stub(self) -> tuple[MatchingEngineStub, grpc.Channel]:
+        """One channel per connection attempt; the CALLER owns it and
+        closes it when its subscriber finishes. During an outage the
+        rx/attestor retry loops reconnect ~5x/s each — an accumulating
+        channel list would exhaust fds on exactly the box that must
+        stay healthy to be promoted."""
+        ch = grpc.insecure_channel(self.primary_addr)
+        return MatchingEngineStub(ch), ch
+
+    def _runner_for_lane(self, lane: int):
+        if self.shards is None:
+            return self.runners[0]
+        if lane >= len(self.shards.lanes):
+            return None
+        return self.shards.lanes[lane].runner
+
+    def _poison(self, why: str) -> None:
+        if self.poisoned is None:
+            self.poisoned = why
+        warn_rate_limited("repl-poison", f"[repl] replica POISONED: {why}")
+
+    # -- rx ----------------------------------------------------------------
+
+    def _rx_loop(self) -> None:
+        epoch = 0
+        first = True
+        while not self._stop.is_set():
+            def on_gap(start, end, filled, missing):
+                if missing:
+                    self.metrics.inc("repl_oplog_lost_records", missing)
+                    self._poison(
+                        f"oplog seqs {start + 1}..{end - 1} unrecoverable "
+                        f"({missing} lost past the primary's window)")
+
+            def on_rebase(cursor, seq):
+                # The primary restarted under us: its new op log does not
+                # continue the state we hold.
+                self.metrics.inc("repl_epoch_rebases_seen")
+                self._poison(f"primary feed epoch rebased (cursor {cursor} "
+                             f"-> seq {seq}); re-bootstrap this standby")
+
+            stub, ch = self._stub()
+            sub = SequencedSubscriber(
+                stub, CHANNEL_OPLOG, from_seq=self._rx_seq,
+                epoch=epoch, from_start=first, on_gap=on_gap,
+                on_rebase=on_rebase)
+            self._rx_sub = sub
+            if self._stop.is_set():
+                sub.cancel()
+            try:
+                for e in sub:
+                    self._last_rx = time.monotonic()
+                    self._ever_rx = True
+                    if e.seq:
+                        self._rx_seq = e.seq
+                        self.metrics.set_gauge("repl_rx_seq", e.seq)
+                    if e.oplog_kind == OPLOG_DISPATCH:
+                        if self.poisoned is not None:
+                            # A poisoned replica STOPS applying: past a
+                            # hole (or a primary rebase) the log is no
+                            # longer a continuation of the state we
+                            # hold, and applying it anyway would serve
+                            # (and durably store) a merged fantasy
+                            # history — keep serving the last provably
+                            # consistent state instead.
+                            continue
+                        self._rx_dispatch_seq = e.seq
+                        self._rx_bytes += len(e.oplog_ops)
+                        first = False
+                        # Timed puts, refreshing liveness while blocked:
+                        # a full queue means WE are behind (apply-side
+                        # stall), not that the primary died — letting
+                        # _last_rx freeze here would read backpressure
+                        # as a heartbeat lapse and auto-promote against
+                        # a live primary. Auto-promotion during a deep
+                        # backlog is wrong anyway (promotion must drain
+                        # it first); real heartbeats resume the moment
+                        # the backlog clears. The received event is
+                        # never dropped (promote's drain contract): we
+                        # keep trying while the applier is alive to
+                        # drain — even during the promote quiesce.
+                        while True:
+                            try:
+                                self._q.put(e, timeout=0.2)
+                                break
+                            except queue.Full:
+                                self._last_rx = time.monotonic()
+                                if self._stop.is_set() \
+                                        and not self._apply_thread.is_alive():
+                                    break  # nothing left to drain it
+                    # Heartbeats (and unknown kinds) only refresh liveness.
+            except grpc.RpcError:
+                pass  # connection loss: retried below, promotion-aware
+            finally:
+                ch.close()
+            epoch = sub.epoch or epoch
+            if not self._stop.is_set():
+                time.sleep(0.2)  # primary briefly unreachable: retry
+
+    # -- apply -------------------------------------------------------------
+
+    def _applier_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            try:
+                self._apply_dispatch(item)
+            except Exception as e:  # noqa: BLE001 — one bad dispatch must
+                # not kill the applier silently; it DOES poison the
+                # replica (state no longer provably mirrors the log).
+                self.metrics.inc("repl_apply_errors")
+                self._poison(f"apply failed at oplog seq {item.seq}: "
+                             f"{type(e).__name__}: {e}")
+
+    def _apply_dispatch(self, e) -> None:
+        runner = self._runner_for_lane(e.oplog_lane)
+        if runner is None:
+            self.metrics.inc("repl_apply_errors")
+            self._poison(f"oplog lane {e.oplog_lane} has no mirror lane "
+                         f"(standby --serve-shards must match the primary)")
+            return
+        recs = ops_from_oprec(e.oplog_ops)
+        ops: list[EngineOp] = []
+        skipped = 0
+        for (op, side, otype, price_q4, qty, sym, cid, oid) in recs:
+            if op == oprec.OPREC_SUBMIT:
+                num = int(oid[4:]) if oid.startswith("OID-") else 0
+                if runner.slot_acquire(sym) is None:
+                    # Capacity the primary had but we lack = config skew.
+                    # Abandon the WHOLE dispatch (like the no-mirror-lane
+                    # case): applying the partial remainder would write
+                    # knowingly-wrong rows to the store and publish them
+                    # to live read clients, not just trip the attestor.
+                    self.metrics.inc("repl_apply_errors")
+                    self._poison(f"symbol axis full for {sym} (standby "
+                                 f"config must mirror the primary)")
+                    return
+                info = OrderInfo(
+                    oid=num, order_id=oid, client_id=cid, symbol=sym,
+                    side=side, otype=otype, price_q4=price_q4, quantity=qty,
+                    remaining=qty, status=0, handle=runner.assign_handle())
+                if num > self._max_oid:
+                    self._max_oid = num
+                ops.append(EngineOp(OP_SUBMIT, info))
+            else:
+                info = runner.orders_by_id.get(oid)
+                if info is None:
+                    # The primary dispatched against a stale directory
+                    # entry that we already evicted in an earlier applied
+                    # dispatch — its host reject produced no rows, and
+                    # neither do we by skipping.
+                    skipped += 1
+                    continue
+                ops.append(EngineOp(OP_CANCEL, info, cancel_requester=cid)
+                           if op == oprec.OPREC_CANCEL
+                           else EngineOp(OP_AMEND, info, amend_qty=qty))
+        result = runner.run_dispatch(ops) if ops else None
+        rows = ((), (), ())
+        if result is not None:
+            # Snapshot BEFORE the sink sees the lists (its coalescing
+            # thread extends them in place — the drop-copy rule).
+            rows = (tuple(result.storage_orders),
+                    tuple(result.storage_updates),
+                    tuple(result.storage_fills))
+            dropcopy = getattr(runner, "dropcopy", None)
+            if dropcopy is not None:
+                dropcopy.publish(result)
+            publish_result(result, self.sink, self.hub, self.metrics)
+            self.metrics.inc("repl_applied_ops", len(ops))
+        if self.attest:
+            local = self.fault.apply(normalize_rows(*rows))
+            if local or skipped:
+                # Park even an EMPTY local group when any op was
+                # skipped: a skip is the one case where our rows can
+                # legitimately differ from the primary's, so a primary
+                # that DID produce rows for this dispatch must pair
+                # against our emptiness and report the divergence —
+                # not age out as "unmatched" with /replz green. (When
+                # the primary's reject also produced no rows, our empty
+                # group ages out as repl_attest_unmatched — documented
+                # as not-proof-of-divergence.)
+                self._pair(e.trace_id, local, primary_side=False)
+            else:
+                # Nothing skipped and no rows on either side by
+                # determinism (a row-less dispatch emits no drop-copy
+                # records, so there is no primary group to pair with).
+                self.metrics.inc("repl_attested_dispatches")
+        self.metrics.inc("repl_applied_dispatches")
+        self._applied_seq = max(self._applied_seq, e.seq)
+        self._applied_bytes += len(e.oplog_ops)
+        m = self.metrics
+        m.set_gauge("repl_applied_seq", self._applied_seq)
+        m.set_gauge("repl_lag_seqs",
+                    max(0, self._rx_dispatch_seq - self._applied_seq))
+        m.set_gauge("repl_lag_bytes",
+                    max(0, self._rx_bytes - self._applied_bytes))
+
+    # -- attest ------------------------------------------------------------
+
+    # A dispatch's audit records arrive in one burst; a group idle this
+    # long is complete (the watcher flushes it so the LAST dispatch
+    # before an idle lull still attests — detection "within one
+    # dispatch" even with nothing following it).
+    _GROUP_IDLE_S = 1.0
+
+    def _attestor_loop(self) -> None:
+        # from_start on first attach, like the rx loop: the applier
+        # full-replays the op log from the epoch start, so the audit
+        # subscription must replay the same range — attaching live-only
+        # would leave the whole replayed prefix unattested while its
+        # local groups churn the pairing store as "unmatched".
+        from_seq, epoch = 0, 0
+        while not self._stop.is_set():
+            stub, ch = self._stub()
+            # from_start whenever the cursor is 0, not just on the first
+            # attach: a reconnect after discarding an all-of-it tail
+            # group rewinds from_seq to 0, and without the from-start
+            # grant the re-fetch the tail-regroup below promises would
+            # silently attach live-only (an unattested coverage hole).
+            sub = SequencedSubscriber(stub, CHANNEL_AUDIT,
+                                      from_seq=from_seq, epoch=epoch,
+                                      from_start=from_seq == 0)
+            self._attest_sub = sub
+            if self._stop.is_set():
+                sub.cancel()
+            lost = 0
+            skip_trace = None
+            try:
+                for e in sub:
+                    if sub.unrecovered_events > lost:
+                        # Audit records evicted past the primary's window:
+                        # the dispatch group straddling the hole is
+                        # truncated on BOTH of its edges — comparing either
+                        # part would report a healthy replica as diverged.
+                        # Discard what was built and skip the rest of the
+                        # hole-adjacent trace; its local counterpart ages
+                        # out as repl_attest_unmatched.
+                        lost = sub.unrecovered_events
+                        skip_trace = e.trace_id
+                        with self._attest_lock:
+                            if self._att_group:
+                                self._att_group = []
+                                self.metrics.inc("repl_attest_unmatched")
+                    if e.audit_kind == 0:
+                        continue
+                    if skip_trace is not None:
+                        if e.trace_id == skip_trace:
+                            continue
+                        skip_trace = None
+                    with self._attest_lock:
+                        if self._att_group and e.trace_id != self._att_trace:
+                            trace, group = self._att_trace, self._att_group
+                            self._att_group = []
+                        else:
+                            trace = group = None
+                        self._att_trace = e.trace_id
+                        self._att_group.append(normalize_audit_event(e))
+                        self._att_stamp = time.monotonic()
+                    if group:
+                        self._pair(trace, group, primary_side=True)
+            except grpc.RpcError:
+                pass
+            finally:
+                ch.close()
+            # A truncated tail group is re-fetched on reconnect rather
+            # than compared half-received (records of one dispatch arrive
+            # in one burst; a mid-burst cut would compare a partial
+            # primary side against a full local one).
+            with self._attest_lock:
+                tail = len(self._att_group)
+                self._att_group = []
+            from_seq = max(from_seq, sub.last_seq - tail)
+            epoch = sub.epoch or epoch
+            if not self._stop.is_set():
+                time.sleep(0.2)
+
+    def _flush_idle_group(self) -> None:
+        """Watcher-cadence flush of a complete-but-unfollowed audit
+        group (see _GROUP_IDLE_S)."""
+        sub = self._attest_sub
+        if sub is not None and getattr(sub, "filling", False):
+            # A gap-fill is in flight: the group may be truncated
+            # MID-dispatch (the missing records are being refetched
+            # right now) — flushing it would pair a partial primary
+            # side against the full local rows and latch a false
+            # permanent divergence out of a transient feed hiccup.
+            return
+        with self._attest_lock:
+            if not self._att_group or \
+                    time.monotonic() - self._att_stamp < self._GROUP_IDLE_S:
+                return
+            trace, group = self._att_trace, self._att_group
+            self._att_group = []
+        self._pair(trace, group, primary_side=True)
+
+    def _pair(self, trace_id: int, rows: list, primary_side: bool) -> None:
+        """Meet-in-the-middle pairing by primary dispatch trace id: park
+        under the attest lock, compare outside it."""
+        if not trace_id:
+            self.metrics.inc("repl_attest_unmatched")
+            return
+        mine, theirs = ((self._attest_primary, self._attest_local)
+                        if primary_side
+                        else (self._attest_local, self._attest_primary))
+        with self._attest_lock:
+            other = theirs.pop(trace_id, None)
+            if other is None:
+                mine[trace_id] = rows
+                while len(mine) > self._ATTEST_PENDING_MAX:
+                    mine.pop(next(iter(mine)), None)
+                    self.metrics.inc("repl_attest_unmatched")
+                return
+        local, primary = (other, rows) if primary_side else (rows, other)
+        self._compare(trace_id, local, primary)
+
+    def _compare(self, trace_id: int, local: list, primary: list) -> None:
+        if local == primary:
+            self.metrics.inc("repl_attested_dispatches")
+            return
+        self.diverged = True
+        self.metrics.inc("repl_divergences")
+        detail = (f"dispatch trace={trace_id}: standby rows != primary "
+                  f"drop-copy ({len(local)} vs {len(primary)} records)")
+        entry = {"kind": "repl_divergence", "detail": detail,
+                 "trace_id": trace_id, "wall_ts": time.time(),
+                 "local": [list(r) for r in local[:16]],
+                 "primary": [list(r) for r in primary[:16]]}
+        recorder = getattr(self.metrics, "recorder", None)
+        if recorder is not None:
+            recorder.record(entry)
+            recorder.dump_on_error()
+        warn_rate_limited("repl-diverge",
+                          f"[repl] ATTESTATION DIVERGENCE: {detail}")
+
+    # -- watcher / heartbeat ------------------------------------------------
+
+    def _watcher_loop(self) -> None:
+        while not self._stop.wait(0.2):
+            age = time.monotonic() - self._last_rx
+            self.metrics.set_gauge("repl_heartbeat_age_s", age)
+            if self.attest:
+                self._flush_idle_group()
+            if (self.auto_promote_s > 0 and age > self.auto_promote_s
+                    and not self._promote_started):
+                if not self._ever_rx:
+                    warn_rate_limited(
+                        "repl-no-auto-promote",
+                        f"[repl] heartbeat lapsed ({age:.2f}s) but this "
+                        f"standby NEVER received anything from "
+                        f"{self.primary_addr}: refusing auto-promotion "
+                        f"(check the --standby address; promoting an "
+                        f"empty replica while the real primary serves "
+                        f"would split-brain)")
+                    continue
+                if self.poisoned is not None or self.diverged:
+                    # A replica with a known hole (unrecoverable gap,
+                    # primary rebase) or an attestation mismatch must
+                    # never SELF-promote into the serving primary; the
+                    # operator can still force it with the explicit
+                    # Promote RPC, eyes open on a red /replz.
+                    warn_rate_limited(
+                        "repl-no-auto-promote",
+                        f"[repl] heartbeat lapsed ({age:.2f}s) but "
+                        f"auto-promotion refused: "
+                        f"{self.poisoned or 'attestation divergence'}")
+                    continue
+                print(f"[repl] primary heartbeat lapsed "
+                      f"({age:.2f}s > {self.auto_promote_s:.2f}s): "
+                      f"auto-promoting")
+                self.promote("heartbeat-lapse")
+
+    # -- promotion ----------------------------------------------------------
+
+    def promote(self, reason: str) -> int:
+        """Standby -> primary. Idempotent; concurrent callers wait for
+        the one transition. Returns the post-promotion feed epoch, or 0
+        when the transition is still in flight after the wait budget —
+        callers (service.Promote) must treat 0 as NOT promoted, never as
+        success (the winner's quiesce joins can take minutes when rx is
+        wedged in a dead gRPC read)."""
+        with self._lock:
+            started, self._promote_started = self._promote_started, True
+        if started:
+            if not self._promote_done.wait(timeout=300):
+                return 0
+            return self.promoted_epoch
+        t0 = time.perf_counter()
+        # 1. Quiesce intake: stop rx, drain every event already received
+        #    (zero loss of received log), stop the attestor. The rx
+        #    thread is joined BEFORE the stop sentinel is enqueued — rx
+        #    may hold a received-but-unqueued event, and a sentinel
+        #    racing ahead of its put() would strand that event behind
+        #    _STOP forever (the applier is still draining, so the
+        #    sentinel put cannot deadlock on a full queue).
+        self._stop.set()
+        for sub in (self._rx_sub, self._attest_sub):
+            if sub is not None:
+                sub.cancel()
+        if self._rx_thread is not threading.current_thread():
+            self._rx_thread.join(timeout=30)
+        # The sentinel put must not block forever: the queue can be
+        # FULL when an applier wedge is exactly what backed it up —
+        # blocking here would leave the Promote RPC hung and, with
+        # _promote_started latched, the standby permanently
+        # unpromotable. Timed puts with the same progress test as the
+        # join below: wait while the applier drains, abort if wedged.
+        last_applied = -1
+        while True:
+            try:
+                self._q.put(_STOP, timeout=30)
+                break
+            except queue.Full:
+                if self._applied_seq == last_applied:
+                    self._poison("promotion aborted: applier wedged at "
+                                 f"oplog seq {self._applied_seq} with a "
+                                 "full rx queue")
+                    with self._lock:
+                        self._promote_started = False
+                    return 0
+                last_applied = self._applied_seq
+        # The applier's remaining work is bounded (rx is joined, the
+        # queue is bounded) but can legitimately outlast any fixed
+        # budget on a slow box with a full backlog — and opening the
+        # mutation RPCs before it drains would interleave fresh submits
+        # with old log events (stale OID floor, a history that is no
+        # longer a prefix of the primary's). Wait while it makes
+        # progress; abort the promotion only when it is wedged.
+        last_applied = -1
+        while self._apply_thread.is_alive():
+            self._apply_thread.join(timeout=30)
+            if not self._apply_thread.is_alive():
+                break
+            if self._applied_seq == last_applied:
+                self._poison("promotion aborted: applier wedged at "
+                             f"oplog seq {self._applied_seq}")
+                with self._lock:
+                    self._promote_started = False
+                return 0
+            last_applied = self._applied_seq
+        for t in self._threads:
+            if t is not threading.current_thread() \
+                    and t not in (self._rx_thread, self._apply_thread):
+                t.join(timeout=30)
+        # 2. Decode anything still staged, flush the durable log.
+        for r in self.runners:
+            r.finish_pending()
+        flush = getattr(self.sink, "flush", None)
+        if flush is not None:
+            flush()
+        # 3. Re-seed the per-residue-class OID floors: every future id
+        #    must clear both the durable store's max and the applied
+        #    log's max (the sink tail could still be in flight).
+        next_oid = max(self.storage.load_next_oid_seq(), self._max_oid + 1)
+        for r in self.runners:
+            r.seed_oid_sequence(next_oid)
+        # 4. New feed epoch (old line's spill purged): clients rebase.
+        if self.sequencer is not None:
+            self.promoted_epoch = self.sequencer.rebase_epoch()
+        # 5. Open the mutation RPCs.
+        self.service.read_only = False
+        self.metrics.inc("repl_promotions")
+        self.metrics.set_gauge("repl_is_standby", 0)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        print(f"[repl] PROMOTED ({reason}) in {dt_ms:.1f}ms: "
+              f"feed_epoch={self.promoted_epoch} next_oid={next_oid} "
+              f"applied_seq={self._applied_seq}")
+        self._promote_done.set()
+        return self.promoted_epoch
+
+    # -- reporting (/replz) --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        c, g = self.metrics.snapshot()
+        ok = not self.diverged and self.poisoned is None
+        # "promoted" means the transition COMPLETED (mutations open) —
+        # service.Promote tells operators to poll /replz for the
+        # verdict, and the quiesce window can take minutes; reporting
+        # the started flag here would call a still-read-only server
+        # promoted.
+        promoted = self._promote_done.is_set()
+        return {
+            "role": "primary (promoted)" if promoted
+            else "standby (promoting)" if self._promote_started
+            else "standby",
+            "ok": ok,
+            "primary": self.primary_addr,
+            "promoted": promoted,
+            "feed_epoch": self.promoted_epoch or (
+                self.sequencer.epoch if self.sequencer else 0),
+            "rx_seq": self._rx_seq,
+            "applied_seq": self._applied_seq,
+            "lag_seqs": max(0, self._rx_dispatch_seq - self._applied_seq),
+            "lag_bytes": max(0, self._rx_bytes - self._applied_bytes),
+            "applied_dispatches": c.get("repl_applied_dispatches", 0),
+            "applied_ops": c.get("repl_applied_ops", 0),
+            "apply_errors": c.get("repl_apply_errors", 0),
+            "attested": c.get("repl_attested_dispatches", 0),
+            "divergences": c.get("repl_divergences", 0),
+            "oplog_lost_records": c.get("repl_oplog_lost_records", 0),
+            "heartbeat_age_s": round(g.get("repl_heartbeat_age_s", 0.0), 3),
+            "promotions": c.get("repl_promotions", 0),
+            "diverged": self.diverged,
+            "poisoned": self.poisoned,
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        for sub in (self._rx_sub, self._attest_sub):
+            if sub is not None:
+                sub.cancel()
+        # Same rx-first join order as promote(): no event may land
+        # behind the stop sentinel. (Each loop closes its own channel.)
+        if self._rx_thread is not threading.current_thread():
+            self._rx_thread.join(timeout=10)
+        try:
+            self._q.put_nowait(_STOP)
+        except queue.Full:
+            pass  # shutdown only: the daemon applier dies with us
+        for t in self._threads:
+            if t is not threading.current_thread() \
+                    and t is not self._rx_thread:
+                t.join(timeout=10)

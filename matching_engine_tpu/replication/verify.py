@@ -1,0 +1,173 @@
+"""Store bit-identity verifier for the kill-the-primary contract.
+
+Replication is asynchronous and BOTH durable stores are async-sink cuts
+of the same totally-ordered dispatch sequence (the primary's SQLite sink
+is a dispatch-order prefix; the replica's applied log is another), so
+after a SIGKILL the two databases are cuts A = dispatches 1..M and
+B = 1..N of one deterministic history. The checkable contract is exactly
+prefix-consistency:
+
+- every order present in BOTH stores must be byte-identical on the
+  immutable columns (client_id, symbol, side, order_type, price,
+  quantity, tif) — ANY difference there is corruption;
+- the mutable columns (status, remaining_quantity) must be equal or
+  strictly advanced on ONE consistent side — order X ahead in A while
+  order Y is ahead in B cannot happen on two cuts of one history;
+- orders present in only one store must all be on the AHEAD side (the
+  tail the other cut hasn't reached);
+- for identical order rows, the fill multisets must be identical; for
+  advanced rows, the behind side's fills must be a sub-multiset of the
+  ahead side's.
+
+Wall-clock columns (created_ts/updated_ts, fills.ts) are the DECLARED
+nondeterministic surface (analysis/hierarchy.DETERMINISM_WAIVERS) and
+are excluded.
+
+Library use: `compare_stores(db_a, db_b)` -> report dict with
+`identical_prefix` (bool) and the offending rows. CLI use (the soak's
+kill round): `python -m matching_engine_tpu.replication.verify A.db
+B.db` — exit 0 on prefix identity, 1 with a printed report otherwise.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import sys
+from collections import Counter
+
+# status ranks for the legal-advance check: NEW(0) -> PARTIAL(1) ->
+# terminal {FILLED(2), CANCELED(3), REJECTED(4)}.
+_RANK = {0: 0, 1: 1, 2: 2, 3: 2, 4: 2}
+
+
+def _orders(db: str) -> dict[str, tuple]:
+    con = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    try:
+        rows = con.execute(
+            "SELECT order_id, client_id, symbol, side, order_type, price, "
+            "quantity, remaining_quantity, status, tif FROM orders"
+        ).fetchall()
+    finally:
+        con.close()
+    return {r[0]: r[1:] for r in rows}
+
+
+def _fills(db: str) -> dict[str, Counter]:
+    con = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    try:
+        rows = con.execute(
+            "SELECT order_id, counter_order_id, price, quantity FROM fills"
+        ).fetchall()
+    finally:
+        con.close()
+    out: dict[str, Counter] = {}
+    for oid, ctr, price, qty in rows:
+        out.setdefault(oid, Counter())[(ctr, price, qty)] += 1
+    return out
+
+
+def _advanced(behind: tuple, ahead: tuple) -> bool:
+    """True when `ahead` is a legal later state of the same order row:
+    identical immutable columns, remaining non-increasing, status rank
+    non-decreasing (and actually different)."""
+    if behind[:6] != ahead[:6] or behind[8] != ahead[8]:  # immutables + tif
+        return False
+    rem_b, st_b = behind[6], behind[7]
+    rem_a, st_a = ahead[6], ahead[7]
+    if (rem_b, st_b) == (rem_a, st_a):
+        return False
+    if _RANK.get(st_b, 2) >= 2:
+        # Terminal statuses are absorbing: once a cut recorded
+        # FILLED/CANCELED/REJECTED, no later cut of the SAME history can
+        # hold anything else for that order — a terminal-to-terminal
+        # flip (CANCELED here, FILLED there) is divergence, never an
+        # async-cut artifact.
+        return False
+    return rem_a <= rem_b and _RANK.get(st_a, 2) >= _RANK.get(st_b, 2)
+
+
+def compare_stores(db_a: str, db_b: str, allow_fork: bool = False) -> dict:
+    """Prefix-consistency verdict over two cuts of one deterministic
+    history. allow_fork=True is the POST-PROMOTION contract: the dead
+    primary may hold a durable tail that never shipped (only_a /
+    a_ahead) while the promoted replica accepted fresh flow (only_b,
+    and fresh fills advancing common resting orders = b_ahead), so the
+    two stores legally fork at the promotion point — only disagreement
+    on COMMON rows (mismatched, conflicting fills) is divergence.
+    Without it (two cuts of ONE line) a simultaneous two-sided
+    advance/exclusive is itself corruption and fails."""
+    a_orders, b_orders = _orders(db_a), _orders(db_b)
+    a_fills, b_fills = _fills(db_a), _fills(db_b)
+    mismatched: list[str] = []      # corruption: neither equal nor advanced
+    a_ahead: list[str] = []
+    b_ahead: list[str] = []
+    fill_mismatch: list[str] = []
+    equal = 0
+    for oid, ra in a_orders.items():
+        rb = b_orders.get(oid)
+        if rb is None:
+            continue
+        fa = a_fills.get(oid, Counter())
+        fb = b_fills.get(oid, Counter())
+        if ra == rb:
+            equal += 1
+            if fa != fb:
+                # Same row state, different executions — but an async cut
+                # can land BETWEEN a fill insert and its status update
+                # only per dispatch, and both ride one sink batch; still,
+                # tolerate the subset direction and flag true conflicts.
+                if not (fa <= fb or fb <= fa):
+                    fill_mismatch.append(oid)
+                elif fa < fb:
+                    b_ahead.append(oid)
+                else:
+                    a_ahead.append(oid)
+        elif _advanced(ra, rb):
+            b_ahead.append(oid)
+            if not fa <= fb:
+                fill_mismatch.append(oid)
+        elif _advanced(rb, ra):
+            a_ahead.append(oid)
+            if not fb <= fa:
+                fill_mismatch.append(oid)
+        else:
+            mismatched.append(oid)
+    only_a = sorted(set(a_orders) - set(b_orders))
+    only_b = sorted(set(b_orders) - set(a_orders))
+    # Direction consistency: at most one side may be ahead anywhere, and
+    # only-in-X orders are legal only when X is the (weakly) ahead side.
+    mixed = bool(a_ahead) and bool(b_ahead)
+    tail_ok = not (only_a and (b_ahead or (only_b and not a_ahead))) \
+        and not (only_b and (a_ahead or (only_a and not b_ahead)))
+    ok = not mismatched and not fill_mismatch \
+        and (allow_fork or (not mixed and tail_ok))
+    return {
+        "identical_prefix": ok,
+        "orders_a": len(a_orders), "orders_b": len(b_orders),
+        "common": equal + len(a_ahead) + len(b_ahead) + len(mismatched),
+        "equal": equal,
+        "a_ahead": len(a_ahead), "b_ahead": len(b_ahead),
+        "only_a": len(only_a), "only_b": len(only_b),
+        "mixed_direction": mixed,
+        "mismatched_orders": mismatched[:20],
+        "fill_mismatches": fill_mismatch[:20],
+    }
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    allow_fork = "--promoted" in argv
+    argv = [a for a in argv if a != "--promoted"]
+    if len(argv) != 2:
+        print("usage: python -m matching_engine_tpu.replication.verify "
+              "[--promoted] <primary.db> <replica.db>", file=sys.stderr)
+        return 2
+    rep = compare_stores(argv[0], argv[1], allow_fork=allow_fork)
+    import json
+
+    print(json.dumps(rep, indent=2, sort_keys=True))
+    return 0 if rep["identical_prefix"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

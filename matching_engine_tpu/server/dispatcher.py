@@ -144,6 +144,8 @@ class BatchDispatcher:
         mega_latency_us: float = 5000.0,
         busy_poll_us: float = 0.0,
         dropcopy=None,
+        oplog=None,
+        lane_id: int = 0,
     ):
         self.runner = runner
         self.sink = sink
@@ -152,6 +154,14 @@ class BatchDispatcher:
         # publishes one lifecycle record per storage event at the decode
         # boundary and feeds the in-process auditor. None = off.
         self.dropcopy = dropcopy
+        # --oplog-ship: replication op-log shipper (replication/oplog.py)
+        # — republishes every admitted dispatch's ops on the sequenced
+        # oplog channel for a warm standby, strictly BEFORE the batch's
+        # client completions (an acked op is always already shipped).
+        # None = off. lane_id names this dispatcher's serving lane in the
+        # shipped envelope so a sharded standby mirrors the routing.
+        self.oplog = oplog
+        self.lane_id = lane_id
         self.window_s = window_ms / 1e3
         # --busy-poll-us: spin this long before every condvar wait on the
         # drain loop (spin_get) and, via the service reading this attr,
@@ -331,6 +341,8 @@ class BatchDispatcher:
                 # THIS dispatch's rows only. (Also before the publish
                 # stamp — the enqueue is stream-publish work.)
                 self.dropcopy.publish(result, tl)
+            if self.oplog is not None:
+                self.oplog.ship(ops, tl, self.lane_id)
             self._publish(result)
             tl.stamp_publish()
             tl.finish(self.metrics)
@@ -707,6 +719,8 @@ class NativeRingDispatcher(BatchDispatcher):
         mega_latency_us: float = 5000.0,
         busy_poll_us: float = 0.0,
         dropcopy=None,
+        oplog=None,
+        lane_id: int = 0,
     ):
         from matching_engine_tpu import native as me_native
 
@@ -728,7 +742,8 @@ class NativeRingDispatcher(BatchDispatcher):
         super().__init__(runner, sink, hub, window_ms, max_batch, metrics,
                          mega_max_waves=mega_max_waves,
                          mega_latency_us=mega_latency_us,
-                         busy_poll_us=busy_poll_us, dropcopy=dropcopy)
+                         busy_poll_us=busy_poll_us, dropcopy=dropcopy,
+                         oplog=oplog, lane_id=lane_id)
 
     def submit(self, op: EngineOp, t_ingress: float | None = None) -> Future:
         fut: Future = Future()

@@ -153,6 +153,10 @@ def build_server(
     trace_sample_every: int = 64,
     audit: bool = False,
     audit_sample: int = 8,
+    oplog_ship: bool = False,
+    standby_addr: str | None = None,
+    standby_auto_promote_s: float = 0.0,
+    standby_attest: bool = True,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -207,6 +211,22 @@ def build_server(
     storage = Storage(db_path)
     if not storage.init():
         raise SystemExit(1)
+    if standby_addr is not None:
+        existing = storage.count("orders")
+        if existing:
+            # The runbook's "fresh --db" rule, enforced (and enforced
+            # HERE, before any engine threads start): boot recovery
+            # would restore this store's orders into the books, and the
+            # standby's from-start op-log replay would then apply the
+            # same history ON TOP of them — double-applied fills and a
+            # guaranteed attestation divergence (or, unattested, wrong
+            # read-only answers served with /replz green).
+            print(f"[SERVER] --standby requires a fresh --db: this "
+                  f"store already holds {existing} order(s); the "
+                  f"from-start op-log replay would re-apply the same "
+                  f"history on top of the recovered books. Re-bootstrap "
+                  f"with a new --db file.", file=sys.stderr)
+            raise SystemExit(3)
 
     metrics = Metrics()
     # Flight recorder: always recording (cheap, per dispatch); dumps only
@@ -278,6 +298,32 @@ def build_server(
         r.dropcopy = DropCopyPublisher(hub, metrics, auditor=auditor,
                                        runner=r, pump=audit_pump)
         return r.dropcopy
+
+    # Warm-standby replication, primary side (--oplog-ship,
+    # replication/oplog.py): every admitted dispatch's ops republish as
+    # ONE sequenced oplog event; a standby applies them deterministically.
+    # Needs the sequenced feed (the retransmission window IS the standby's
+    # catch-up budget) and the EngineOp dispatch route.
+    oplog_shipper = None
+    if oplog_ship:
+        if native_lanes or gateway_addr is not None or mesh is not None:
+            # Enforced HERE, not only in main()'s argv parsing: the
+            # shipper re-encodes EngineOps at the drain loops, and the
+            # C++ lane/gateway drains and the mesh path never build
+            # them — a programmatic caller combining these would get a
+            # heartbeat-only shipper whose standby reads lag 0 while
+            # mirroring NOTHING.
+            print("[SERVER] oplog_ship runs on the EngineOp dispatch "
+                  "routes only: drop native_lanes/gateway_addr/mesh",
+                  file=sys.stderr)
+            raise SystemExit(3)
+        if sequencer is None:
+            print("[SERVER] --oplog-ship needs the sequenced feed "
+                  "(--feed-depth > 0)", file=sys.stderr)
+            raise SystemExit(3)
+        from matching_engine_tpu.replication import OpLogShipper
+
+        oplog_shipper = OpLogShipper(hub, metrics)
 
     def make_runner():
         if native_lanes:
@@ -457,7 +503,8 @@ def build_server(
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
                 busy_poll_us=busy_poll_us,
-                dropcopy=make_dropcopy(lane.runner))
+                dropcopy=make_dropcopy(lane.runner),
+                oplog=oplog_shipper, lane_id=lane.shard_id)
         shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
         dispatcher = lanes[0].dispatcher
     else:
@@ -490,6 +537,7 @@ def build_server(
                 mega_latency_us=megadispatch_latency_us,
                 busy_poll_us=busy_poll_us,
                 dropcopy=make_dropcopy(runner),
+                oplog=oplog_shipper,
             )
         else:
             dispatcher = BatchDispatcher(
@@ -497,7 +545,8 @@ def build_server(
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
                 busy_poll_us=busy_poll_us,
-                dropcopy=make_dropcopy(runner))
+                dropcopy=make_dropcopy(runner),
+                oplog=oplog_shipper)
     if log:
         layer = ("native lanes (C++ build+decode)" if native_lanes
                  else "native (C++)" if use_native else "python")
@@ -508,6 +557,36 @@ def build_server(
                                     log=log, shards=shards,
                                     book_cache_ms=book_cache_ms,
                                     proto_reuse=proto_reuse)
+    # RunAuction rejects on an op-log-shipping primary (the uncross
+    # bypasses the drain loops the shipper rides — a standby would
+    # silently diverge); main() additionally refuses --auction-open.
+    service.oplog_ship = oplog_shipper is not None
+
+    # Warm-standby replica (--standby, replication/standby.py): mutation
+    # RPCs stay closed (read_only) while the replica applies the
+    # primary's op log through this very stack; `Promote` (or heartbeat
+    # lapse with --standby-auto-promote-s) opens them.
+    replica = None
+    if standby_addr is not None:
+        if sequencer is None:
+            print("[SERVER] --standby needs the sequenced feed "
+                  "(--feed-depth > 0)", file=sys.stderr)
+            raise SystemExit(3)
+        from matching_engine_tpu.replication import StandbyReplica
+
+        service.read_only = True
+        replica = StandbyReplica(
+            standby_addr, runners=runners, shards=shards, sink=sink,
+            hub=hub, sequencer=sequencer, storage=storage, metrics=metrics,
+            service=service, auto_promote_s=standby_auto_promote_s,
+            attest=standby_attest)
+        service.replica = replica
+        if log:
+            print(f"[SERVER] STANDBY replica of {standby_addr} "
+                  f"(read-only until Promote"
+                  + (f"; auto-promote after "
+                     f"{standby_auto_promote_s:.2f}s heartbeat lapse)"
+                     if standby_auto_promote_s > 0 else ")"))
 
     # Receive limit sized to the batch edge's record cap (service
     # _BATCH_RECORD_CAP x 384-byte records ~ 25 MB) — the default 4 MB
@@ -557,6 +636,7 @@ def build_server(
         "bridge": bridge, "gateway_port": gateway_port,
         "recorder": recorder, "sequencer": sequencer, "tracer": tracer,
         "auditor": auditor, "audit_pump": audit_pump,
+        "oplog": oplog_shipper, "replica": replica, "runners": runners,
     }
     return server, port, parts
 
@@ -565,6 +645,12 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     """Graceful drain: stop RPCs (2s deadline, as the reference's stopper
     thread does), close the dispatcher, flush the storage sink."""
     server.stop(grace_s).wait()
+    if parts.get("replica") is not None:
+        # BEFORE the hub/dispatcher teardown: the applier may be mid-
+        # dispatch against the runner these drain.
+        parts["replica"].close()
+    if parts.get("oplog") is not None:
+        parts["oplog"].close()  # heartbeat thread off the hub first
     if parts.get("bridge") is not None:
         parts["bridge"].close()
     parts["hub"].close_all()
@@ -791,6 +877,50 @@ def main(argv=None) -> int:
                         "crossed-book invariants always run for ALL "
                         "orders. 1 = shadow everything (corruption "
                         "soaks/tests; default 8)")
+    p.add_argument("--oplog-ship", action="store_true",
+                   help="warm-standby replication, primary side "
+                        "(matching_engine_tpu/replication/): republish "
+                        "every admitted dispatch's ops as ONE sequenced "
+                        "`oplog` feed event (flat op-record codec, "
+                        "submits carry their assigned order ids) plus "
+                        "periodic heartbeats, so a --standby replica can "
+                        "apply the identical dispatch sequence. Needs "
+                        "--feed-depth > 0 (the retransmission window is "
+                        "the standby's catch-up budget; --feed-spill-dir "
+                        "extends it); EngineOp dispatch routes only "
+                        "(incompatible with --native-lanes and "
+                        "--gateway-addr, whose ops bypass the shipper; "
+                        "RunAuction/--auction-open refused — the uncross "
+                        "is not replicated)")
+    p.add_argument("--standby", default=None, metavar="HOST:PORT",
+                   help="boot as a warm-standby replica of the primary at "
+                        "this address: apply its sequenced op log "
+                        "deterministically through this server's own "
+                        "engine + SQLite sink, serve READ-ONLY (submits/"
+                        "cancels/amends/auctions reject app-level; books, "
+                        "streams, metrics serve), and continuously attest "
+                        "store bit-identity against the primary's "
+                        "drop-copy audit channel (primary must run "
+                        "--audit for attestation; /replz reports). "
+                        "Promote via the Promote RPC (`client promote`) "
+                        "or --standby-auto-promote-s. Mirror the "
+                        "primary's --symbols/--capacity/--batch/"
+                        "--serve-shards exactly")
+    p.add_argument("--standby-auto-promote-s", type=float, default=0.0,
+                   metavar="SECS",
+                   help="with --standby: self-promote when the primary's "
+                        "oplog heartbeat lapses this long (0 = manual "
+                        "promotion only, the default — split-brain "
+                        "arbitration belongs to the operator or an "
+                        "external lease, not a lone timeout)")
+    p.add_argument("--standby-no-attest", action="store_true",
+                   help="with --standby: replicate without attesting "
+                        "(for a primary that runs --oplog-ship WITHOUT "
+                        "--audit — there is no drop-copy channel to "
+                        "attest against, so the attestor would only park "
+                        "local rows and pump me_repl_attest_unmatched "
+                        "at dispatch rate; /replz then reports "
+                        "attested=0 by design)")
     p.add_argument("--auction-open", action="store_true",
                    help="boot in call-auction accumulation: submits REST "
                         "without matching until a RunAuction uncross opens "
@@ -840,6 +970,29 @@ def main(argv=None) -> int:
                   "python dispatch route (drop --native-lanes) or the "
                   "grpcio edge", file=sys.stderr)
             return 3
+    if args.oplog_ship or args.standby:
+        if args.native_lanes or args.gateway_addr is not None \
+                or mesh is not None:
+            # The shipper re-encodes EngineOps at the drain loops; the
+            # C++ lane/gateway drains and the mesh path never build them.
+            print("[SERVER] replication (--oplog-ship/--standby) runs on "
+                  "the EngineOp dispatch routes only: drop "
+                  "--native-lanes/--gateway-addr/--mesh", file=sys.stderr)
+            return 3
+        if args.feed_depth == 0:
+            print("[SERVER] replication needs the sequenced feed "
+                  "(--feed-depth > 0)", file=sys.stderr)
+            return 3
+    if args.standby and args.auction_open:
+        print("[SERVER] --standby is read-only; it cannot open a call "
+              "period (--auction-open)", file=sys.stderr)
+        return 3
+    if args.oplog_ship and args.auction_open:
+        print("[SERVER] --auction-open needs an uncross to open trading, "
+              "and the auction uncross is not replicated on the op log "
+              "(it bypasses the dispatcher drain loops the shipper rides) "
+              "— drop one of the two flags", file=sys.stderr)
+        return 3
 
     cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
                        batch=args.batch, kernel=args.engine_kernel)
@@ -870,6 +1023,10 @@ def main(argv=None) -> int:
             trace_sample_every=args.trace_sample,
             audit=args.audit,
             audit_sample=args.audit_sample,
+            oplog_ship=args.oplog_ship,
+            standby_addr=args.standby,
+            standby_auto_promote_s=args.standby_auto_promote_s,
+            standby_attest=not args.standby_no_attest,
         )
     except SystemExit as e:
         return int(e.code or 3)
@@ -907,6 +1064,7 @@ def main(argv=None) -> int:
                     ready_fn=lambda: not stop_evt.is_set(),  # 503 in drain
                     port=args.metrics_port, host=args.metrics_host,
                     auditor=parts["auditor"],
+                    repl=parts.get("replica") or parts.get("oplog"),
                 )
             except OSError as e:
                 # Bind failures land AFTER the gRPC edges went live; the

@@ -25,14 +25,17 @@ import time
 
 import grpc
 
-from matching_engine_tpu.audit.dropcopy import AUDIT_CLIENT
+from matching_engine_tpu.audit.dropcopy import AUDIT_CLIENT, AUDIT_CLIENT_FULL
 from matching_engine_tpu.domain import normalize_to_q4, validate_submit
 from matching_engine_tpu.feed.sequencer import (
     AUDIT_DOMAIN_KEY,
     CHANNEL_AUDIT,
     CHANNEL_MD,
+    CHANNEL_OPLOG,
     CHANNEL_OU,
+    OPLOG_DOMAIN_KEY,
 )
+from matching_engine_tpu.replication.oplog import OPLOG_CLIENT
 from matching_engine_tpu.engine.kernel import (
     CANCELED,
     NEW,
@@ -97,6 +100,19 @@ class MatchingEngineService(MatchingEngineServicer):
         # reused (they alias subscriber queues and the feed store).
         self._proto_reuse = proto_reuse
         self._tl_protos = threading.local()
+        # Warm-standby replication (replication/): a --standby server
+        # keeps the mutation RPCs closed until promotion flips this off
+        # (reads and streams serve throughout). `replica` is the
+        # StandbyReplica driving the engine from the primary's op log;
+        # build_server wires both after construction.
+        self.read_only = False
+        self.replica = None
+        # True on an --oplog-ship primary. The auction uncross mutates
+        # books outside the dispatcher drain loops (engine_runner.
+        # run_auction under the dispatch lock), so it never crosses the
+        # op-log shipper — RunAuction must reject rather than silently
+        # diverge every standby.
+        self.oplog_ship = False
 
     def _log(self, msg: str) -> None:
         if self.log:
@@ -145,11 +161,20 @@ class MatchingEngineService(MatchingEngineServicer):
         lane = self.shards.lane_for_order(order_id)
         return lane.runner, lane.dispatcher
 
+    # Application-level reject every mutation RPC answers on a standby
+    # (the SubmitOrder reject convention: success=false, gRPC OK).
+    _STANDBY_ERR = ("standby replica is read-only (Promote it, or submit "
+                    "to the primary)")
+
     # -- SubmitOrder -------------------------------------------------------
 
     def SubmitOrder(self, request, context):
         t0 = time.perf_counter()
         self.metrics.inc("rpc_submit")
+        if self.read_only:
+            self.metrics.inc("orders_rejected")
+            return self._completion(pb2.OrderResponse, success=False,
+                                    error_message=self._STANDBY_ERR)
         side_s = pb2.Side.Name(request.side) if request.side in (1, 2) else str(request.side)
         type_s = (
             pb2.OrderType.Name(request.order_type)
@@ -344,6 +369,9 @@ class MatchingEngineService(MatchingEngineServicer):
         t0 = time.perf_counter()
         m = self.metrics
         m.inc("edge_batches")
+        if self.read_only:
+            return pb2.OrderBatchResponse(success=False,
+                                          error_message=self._STANDBY_ERR)
         try:
             arr = oprec.decode_payload(request.ops,
                                        max_records=self._BATCH_RECORD_CAP)
@@ -635,6 +663,10 @@ class MatchingEngineService(MatchingEngineServicer):
 
     def CancelOrder(self, request, context):
         self.metrics.inc("rpc_cancel")
+        if self.read_only:
+            return pb2.CancelResponse(
+                order_id=request.order_id, success=False,
+                error_message=self._STANDBY_ERR)
         if not request.client_id:
             return pb2.CancelResponse(
                 order_id=request.order_id, success=False,
@@ -734,6 +766,10 @@ class MatchingEngineService(MatchingEngineServicer):
         to a positive quantity succeeds. Allowed in call periods too — an
         amend-down never crosses anything."""
         self.metrics.inc("rpc_amend")
+        if self.read_only:
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message=self._STANDBY_ERR)
         if not request.client_id:
             return pb2.AmendResponse(
                 order_id=request.order_id, success=False,
@@ -925,7 +961,7 @@ class MatchingEngineService(MatchingEngineServicer):
     _REPLAY_CHUNK = 1024
 
     def _sequenced_stream(self, sub, channel, key, resume_from,
-                          resume_epoch, context):
+                          resume_epoch, context, from_start=False):
         """Replay-then-live for the sequenced feed: the live subscription
         is already registered (events landing during the replay scan
         queue up in it), the retransmission store replays
@@ -935,9 +971,28 @@ class MatchingEngineService(MatchingEngineServicer):
         alive = self._stream_alive(context, sub)
         sequencer = self.hub.sequencer
         last = 0
-        if sequencer is not None and resume_from:
+        replay_epoch = 0
+        # Replication bootstrap: an oplog subscriber with cursor 0 means
+        # "from the beginning of this epoch" — a standby must see EVERY
+        # retained record, so seq 0 grants a full (0, head] replay here
+        # (on the md/ou/audit channels 0 keeps the legacy live-only
+        # meaning — existing clients attach live by default).
+        # Cursor 0 is a real from-the-epoch-start cursor here — also
+        # when the client echoes the CURRENT epoch (a gap-fill for a
+        # dropped first event sends resume_from_seq=0 with the learned
+        # epoch; treating that as live-only would make the fill a
+        # guaranteed no-op and falsely poison a standby whose missing
+        # seqs are still retained). A MISMATCHED epoch keeps the stale-
+        # cursor rebase semantics below.
+        full = (resume_from == 0
+                and (channel == CHANNEL_OPLOG or from_start)
+                and (not resume_epoch
+                     or (sequencer is not None
+                         and resume_epoch == sequencer.epoch)))
+        if sequencer is not None and (resume_from or full):
             stale = (resume_epoch and resume_epoch != sequencer.epoch)
-            if stale or resume_from > sequencer.last_seq(channel, key):
+            if not full and (
+                    stale or resume_from > sequencer.last_seq(channel, key)):
                 # Seq domains are per boot: a cursor from another epoch
                 # (or ahead of the current head, for clients that never
                 # learned an epoch) is stale — the server restarted.
@@ -951,6 +1006,7 @@ class MatchingEngineService(MatchingEngineServicer):
                           f"(epoch rebase); serving live")
             else:
                 last, missed_total = resume_from, 0
+                replay_epoch = sequencer.epoch
                 while True:
                     head = sequencer.last_seq(channel, key)
                     if last >= head:
@@ -971,8 +1027,15 @@ class MatchingEngineService(MatchingEngineServicer):
                         f"events past the retransmission window (client "
                         f"will report an unrecovered gap)")
         for e in sub.stream(alive=alive):
-            if last and getattr(e, "seq", 0) and e.seq <= last:
-                continue  # replay/live overlap
+            if last and getattr(e, "seq", 0) and e.seq <= last \
+                    and getattr(e, "feed_epoch", replay_epoch) == replay_epoch:
+                # Replay/live overlap — SAME epoch only: an in-place
+                # promotion rebase restarts the seq domain on this live
+                # connection, and filtering the new epoch's first events
+                # against the old epoch's replay cursor would silently
+                # swallow them (the client's rebase detection never sees
+                # a gap to account).
+                continue
             yield e
 
     def StreamMarketData(self, request, context):
@@ -987,14 +1050,27 @@ class MatchingEngineService(MatchingEngineServicer):
             self.hub.unsubscribe(sub)
 
     def StreamOrderUpdates(self, request, context):
-        if request.client_id == AUDIT_CLIENT:
+        from_start = False
+        if request.client_id in (AUDIT_CLIENT, AUDIT_CLIENT_FULL):
             # Drop-copy tap: the reserved client id subscribes to the
             # venue-wide audit channel (lifecycle records for EVERY
             # order) — replay/resume/gap-fill work exactly like any
-            # sequenced channel, same RPC surface.
+            # sequenced channel, same RPC surface. The _FULL variant
+            # makes cursor 0 a REAL from-the-epoch-start cursor (full
+            # retained replay) instead of the legacy live attach — the
+            # standby attestor must cover the same replayed range its
+            # applier consumes from the op log.
             self.metrics.inc("rpc_stream_audit")
             sub = self.hub.subscribe_audit()
             channel, key = CHANNEL_AUDIT, AUDIT_DOMAIN_KEY
+            from_start = request.client_id == AUDIT_CLIENT_FULL
+        elif request.client_id == OPLOG_CLIENT:
+            # Replication tap: the op-log channel a warm standby applies
+            # (replication/standby.py). Cursor 0 = full replay from the
+            # epoch start; see _sequenced_stream.
+            self.metrics.inc("rpc_stream_oplog")
+            sub = self.hub.subscribe_oplog()
+            channel, key = CHANNEL_OPLOG, OPLOG_DOMAIN_KEY
         else:
             self.metrics.inc("rpc_stream_ou")
             sub = self.hub.subscribe_order_updates(request.client_id)
@@ -1002,7 +1078,7 @@ class MatchingEngineService(MatchingEngineServicer):
         try:
             yield from self._sequenced_stream(
                 sub, channel, key, request.resume_from_seq,
-                request.feed_epoch, context)
+                request.feed_epoch, context, from_start=from_start)
         finally:
             self.hub.unsubscribe(sub)
 
@@ -1012,6 +1088,37 @@ class MatchingEngineService(MatchingEngineServicer):
         counters, gauges = self.metrics.snapshot()
         return pb2.MetricsResponse(gauges=gauges, counters=counters)
 
+    # -- replication --------------------------------------------------------
+
+    def Promote(self, request, context):
+        """Flip a --standby replica into the serving primary
+        (replication/standby.py promote): feed-epoch bump, OID floor
+        re-seed, mutation RPCs open. Application-level failure semantics
+        match SubmitOrder — a non-standby server answers success=false."""
+        self.metrics.inc("rpc_promote")
+        if self.replica is None:
+            return pb2.PromoteResponse(
+                success=False,
+                error_message="not a standby replica (no --standby)")
+        self._log("Promote requested via RPC")
+        epoch = self.replica.promote("rpc")
+        if not epoch:
+            # Two distinct falsy outcomes, and the operator mid-incident
+            # must not confuse them: the winner ABORTED (wedged applier
+            # — it poisoned the replica with the reason, and a retry
+            # fails identically), or a concurrent promotion holds the
+            # transition and outlived our wait (not promoted YET).
+            poisoned = self.replica.poisoned
+            if poisoned is not None:
+                return pb2.PromoteResponse(
+                    success=False,
+                    error_message=f"promotion FAILED: {poisoned}")
+            return pb2.PromoteResponse(
+                success=False,
+                error_message="promotion already in progress and still "
+                              "quiescing; poll /replz for the verdict")
+        return pb2.PromoteResponse(success=True, feed_epoch=epoch)
+
     # -- call auction ------------------------------------------------------
 
     def RunAuction(self, request, context):
@@ -1020,6 +1127,16 @@ class MatchingEngineService(MatchingEngineServicer):
         application-level (success=false + message, gRPC OK) — the
         SubmitOrder reject convention."""
         symbol = request.symbol or None
+        if self.read_only:
+            return pb2.AuctionResponse(success=False,
+                                       error_message=self._STANDBY_ERR)
+        if self.oplog_ship:
+            return pb2.AuctionResponse(
+                success=False,
+                error_message="auction uncross is not replicated on the "
+                              "op log: running it would silently diverge "
+                              "every standby — drop --oplog-ship to run "
+                              "auctions")
         if self.shards is not None:
             # Partitioned serving: one symbol touches only its owning
             # lane; the all-symbols close fans out across every lane and

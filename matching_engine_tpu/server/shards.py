@@ -324,7 +324,7 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
                          mega_max_waves: int = 1,
                          mega_latency_us: float = 5000.0,
                          busy_poll_us: float = 0.0,
-                         dropcopy=None):
+                         dropcopy=None, oplog=None, lane_id: int = 0):
     """One lane's dispatcher (its own ring + drain thread). Each lane
     runs its own megadispatch coalescing controller over its own queue
     (the decision is a per-lane queue-depth function; a venue-wide M
@@ -349,11 +349,13 @@ def make_lane_dispatcher(runner, *, sink=None, hub=None,
                                     mega_max_waves=mega_max_waves,
                                     mega_latency_us=mega_latency_us,
                                     busy_poll_us=busy_poll_us,
-                                    dropcopy=dropcopy)
+                                    dropcopy=dropcopy, oplog=oplog,
+                                    lane_id=lane_id)
     return BatchDispatcher(runner, sink=sink, hub=hub, window_ms=window_ms,
                            metrics=metrics, mega_max_waves=mega_max_waves,
                            mega_latency_us=mega_latency_us,
-                           busy_poll_us=busy_poll_us, dropcopy=dropcopy)
+                           busy_poll_us=busy_poll_us, dropcopy=dropcopy,
+                           oplog=oplog, lane_id=lane_id)
 
 
 def build_serving_shards(

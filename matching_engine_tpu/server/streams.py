@@ -43,6 +43,9 @@ from matching_engine_tpu.feed.sequencer import (
     CHANNEL_AUDIT,
     CHANNEL_MD,
     CHANNEL_OU,
+    CHANNEL_OPLOG,
+    OPLOG_DISPATCH,
+    OPLOG_DOMAIN_KEY,
 )
 from matching_engine_tpu.proto import pb2
 
@@ -143,6 +146,7 @@ class StreamHub:
         self._md_subs: dict[str, list[_Subscription]] = {}      # symbol ->
         self._ou_subs: dict[str, list[_Subscription]] = {}      # client_id ->
         self._audit_subs: list[_Subscription] = []              # drop-copy
+        self._oplog_subs: list[_Subscription] = []              # replication
 
     # -- subscription management ------------------------------------------
 
@@ -189,6 +193,17 @@ class StreamHub:
             self._audit_subs.append(sub)
         return sub
 
+    def subscribe_oplog(self) -> _Subscription:
+        """Attach to the replication op-log channel (every admitted
+        dispatch's op records + heartbeats — the warm-standby input)."""
+        sub = _Subscription(self._maxsize, self._metrics)
+        if self.sequencer is not None:
+            sub.last_seq = self.sequencer.last_seq(CHANNEL_OPLOG,
+                                                   OPLOG_DOMAIN_KEY)
+        with self._lock:
+            self._oplog_subs.append(sub)
+        return sub
+
     def unsubscribe(self, sub: _Subscription) -> None:
         with self._lock:
             for table in (self._md_subs, self._ou_subs):
@@ -199,6 +214,8 @@ class StreamHub:
                             del table[key]
             if sub in self._audit_subs:
                 self._audit_subs.remove(sub)
+            if sub in self._oplog_subs:
+                self._oplog_subs.remove(sub)
         sub.close()
 
     # -- publication (called from the dispatcher thread) -------------------
@@ -238,6 +255,27 @@ class StreamHub:
                     sub.offer(u)
             self._update_lag_locked(CHANNEL_OU,
                                     {u.client_id for u in updates})
+
+    def publish_oplog(self, updates: list[pb2.OrderUpdate]) -> None:
+        """Stamp + fan out op-log events (replication/oplog.py builds the
+        protos OUTSIDE this call — nothing materializes under the hub
+        lock). Same stamp/fan-out atomicity as the other publish_* paths:
+        with K serving lanes shipping concurrently, the venue-wide oplog
+        seq line interleaves dispatches in stamp order and a standby
+        applies exactly that order. Only DISPATCH events are stamped and
+        retained: heartbeats (4/s, forever) fan out live with seq 0 —
+        sequencing them would evict real dispatches from the standby's
+        catch-up window and make a long idle disconnect read as
+        unrecoverable loss when nothing but liveness pings were missed."""
+        if not updates:
+            return
+        stamped = [u for u in updates if u.oplog_kind == OPLOG_DISPATCH]
+        with self._lock:
+            if self.sequencer is not None and stamped:
+                self.sequencer.stamp_oplog(stamped)
+            for u in updates:
+                for sub in self._oplog_subs:
+                    sub.offer(u)
 
     def publish_audit_rows(self, rows, env, n: int, drop=None,
                            observer=None) -> list[int]:
@@ -317,8 +355,10 @@ class StreamHub:
             subs = [s for v in self._md_subs.values() for s in v]
             subs += [s for v in self._ou_subs.values() for s in v]
             subs += list(self._audit_subs)
+            subs += list(self._oplog_subs)
             self._md_subs.clear()
             self._ou_subs.clear()
             self._audit_subs.clear()
+            self._oplog_subs.clear()
         for s in subs:
             s.close()

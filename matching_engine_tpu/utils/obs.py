@@ -692,6 +692,13 @@ class ObsServer:
                            deliberately stays green (a red audit means
                            INVESTIGATE, not drop traffic), 404 with the
                            auditor off
+      GET /replz           replication verdict (--standby / --oplog-ship):
+                           200 + the role/lag/attestation JSON while the
+                           replica provably mirrors the primary, 500 once
+                           an attestation divergence or an unrecoverable
+                           op-log gap poisoned it (same investigate-not-
+                           drop contract as /auditz), 404 with
+                           replication off
 
     No third-party exporter dependency: the container must not need a
     pip install to be scrapable.
@@ -699,7 +706,7 @@ class ObsServer:
 
     def __init__(self, metrics, recorder: FlightRecorder | None = None,
                  ready_fn=None, port: int = 0, host: str = "127.0.0.1",
-                 auditor=None):
+                 auditor=None, repl=None):
         # Loopback by default: /flightrecorder exposes internal dispatch
         # detail — exporting to a scrape network is an explicit choice
         # (--metrics-host 0.0.0.0), not a side effect of enabling metrics.
@@ -707,6 +714,9 @@ class ObsServer:
         self.recorder = recorder
         self.ready_fn = ready_fn or (lambda: True)
         self.auditor = auditor  # audit.InvariantAuditor | None
+        # replication.StandbyReplica | replication.OpLogShipper | None —
+        # anything with a snapshot() carrying an "ok" verdict.
+        self.repl = repl
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -749,6 +759,16 @@ class ObsServer:
                                 200 if snap["ok"] else 500,
                                 json.dumps(snap).encode(),
                                 "application/json")
+                    elif path == "/replz":
+                        if obs.repl is None:
+                            self._send(404, b"replication disabled\n",
+                                       "text/plain")
+                        else:
+                            snap = obs.repl.snapshot()
+                            self._send(
+                                200 if snap["ok"] else 500,
+                                json.dumps(snap).encode(),
+                                "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except (BrokenPipeError, ConnectionResetError):
@@ -765,6 +785,9 @@ class ObsServer:
         return self.port
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._thread.join(timeout=5)
+        # shutdown() blocks on a flag only serve_forever sets; calling it
+        # on a never-started server would wait forever.
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
         self._httpd.server_close()

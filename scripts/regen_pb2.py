@@ -81,6 +81,19 @@ ADDITIVE_FIELDS = [
     ("OrderUpdate", "audit_side", 17, F.TYPE_UINT32),
     ("OrderUpdate", "audit_otype", 18, F.TYPE_UINT32),
     ("OrderUpdate", "audit_quantity", 19, F.TYPE_INT64),
+    # Warm-standby replication (matching_engine_tpu/replication/): op-log
+    # records ride OrderUpdate on the sequenced `oplog` channel
+    # (StreamOrderUpdates with the reserved __oplog__ client_id).
+    # oplog_kind != 0 marks one: 1 = dispatch (oplog_ops carries the
+    # dispatch's packed flat op-records — domain/oprec.py wire, submits
+    # with their primary-assigned order ids — oplog_count the record
+    # count, oplog_lane the serving lane, trace_id the primary dispatch's
+    # trace id for attestation alignment), 2 = heartbeat (empty payload;
+    # the standby's liveness/lag signal).
+    ("OrderUpdate", "oplog_kind", 20, F.TYPE_UINT32),
+    ("OrderUpdate", "oplog_ops", 21, F.TYPE_BYTES),
+    ("OrderUpdate", "oplog_count", 22, F.TYPE_UINT32),
+    ("OrderUpdate", "oplog_lane", 23, F.TYPE_UINT32),
 ]
 
 # Whole new messages (name, [(field, number, type[, label])]) — additive:
@@ -105,6 +118,19 @@ ADDITIVE_MESSAGES = [
         ("error", 5, F.TYPE_STRING, F.LABEL_REPEATED),
         ("remaining", 6, F.TYPE_INT64, F.LABEL_REPEATED),
     ]),
+    # Warm-standby promotion (replication/standby.py): flips a --standby
+    # replica into the serving primary — bumps the feed epoch, re-seeds
+    # the per-residue-class OID floors from the durable store, and opens
+    # the mutation RPCs. Application-level failure semantics match
+    # SubmitOrder (success=false + error_message, gRPC OK).
+    ("PromoteRequest", []),
+    ("PromoteResponse", [
+        ("success", 1, F.TYPE_BOOL),
+        ("error_message", 2, F.TYPE_STRING),
+        # The promoted server's NEW feed epoch: clients carrying cursors
+        # from the dead primary (or the pre-promotion replica) rebase.
+        ("feed_epoch", 3, F.TYPE_UINT64),
+    ]),
 ]
 
 # New service methods (service, method, input message, output message) —
@@ -112,6 +138,7 @@ ADDITIVE_MESSAGES = [
 ADDITIVE_METHODS = [
     ("MatchingEngine", "SubmitOrderBatch",
      "OrderBatchRequest", "OrderBatchResponse"),
+    ("MatchingEngine", "Promote", "PromoteRequest", "PromoteResponse"),
 ]
 
 HEADER = '''\
@@ -285,6 +312,16 @@ assert (a2.audit_kind == 3 and a2.trace_id == 12
         and a2.dispatch_shape == "mega" and a2.dispatch_waves == 4
         and a2.counter_order_id == "OID-2" and a2.ingress_ts_us == 99
         and a2.audit_side == 1 and a2.audit_quantity == 5)
+g = pb2.OrderUpdate(oplog_kind=1, oplog_ops=b"MEOPREC1" + b"r" * 8,
+                    oplog_count=3, oplog_lane=2, trace_id=44, seq=5)
+g2 = pb2.OrderUpdate.FromString(g.SerializeToString())
+assert (g2.oplog_kind == 1 and g2.oplog_ops[:8] == b"MEOPREC1"
+        and g2.oplog_count == 3 and g2.oplog_lane == 2 and g2.trace_id == 44)
+pr = pb2.PromoteResponse(success=True, feed_epoch=123)
+pr2 = pb2.PromoteResponse.FromString(pr.SerializeToString())
+assert pr2.success and pr2.feed_epoch == 123
+assert pb2.PromoteRequest.FromString(
+    pb2.PromoteRequest().SerializeToString()) is not None
 # Old readers must still parse new writers (additive compatibility).
 assert pb2.OrderRequest.FromString(
     pb2.OrderRequest(client_id="c", symbol="S").SerializeToString()

@@ -515,6 +515,167 @@ CI_DUMP=$(grep -l "audit_violation" "$WORK"/corrupt_flight/flight_*.json 2>/dev/
 [ -n "$CI_DUMP" ] || { echo "FAIL: corruption fired but produced no flight dump"; exit 1; }
 echo "corruption round: auditor fired as required (violations=$CI_VIOL kinds=$CI_KINDS)"
 
+# ---- failover round: kill the primary, promote the standby ----------------
+# Warm-standby HA under fire (replication/, ISSUE 11): an --oplog-ship
+# primary + a --standby replica + the native bench as concurrent load +
+# a sequenced subscriber riding the STANDBY's own feed line. SIGKILL the
+# primary mid-flow, `client promote` the standby, and FAIL on:
+#   - store bit-identity mismatch between the promoted replica and the
+#     dead primary's db for the acknowledged prefix (replication/verify),
+#   - any unrecovered client gap or != 1 epoch rebase at the subscriber,
+#   - missing me_repl_* metrics on either side,
+#   - /replz red (the replica must stay provably clean through the kill).
+HA_PDB="$WORK/soak_ha_primary.db"
+HA_SDB="$WORK/soak_ha_standby.db"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$HA_PDB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --metrics-port 0 --oplog-ship \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_ha_primary.log" 2>&1 &
+HA_PSRV=$!
+trap 'kill $SRV $HA_PSRV 2>/dev/null' EXIT
+HA_PPY=""; HA_POBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  HA_PPY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_ha_primary.log" | head -1)
+  HA_POBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_ha_primary.log" | head -1)
+  [ -n "$HA_PPY" ] && [ -n "$HA_POBS" ] && break
+  kill -0 $HA_PSRV 2>/dev/null || { echo "FAIL: HA primary died at boot"; tail -5 "$WORK/server_ha_primary.log"; exit 1; }
+  sleep 1
+done
+[ -n "$HA_PPY" ] && [ -n "$HA_POBS" ] || { echo "FAIL: HA primary ports never appeared"; exit 1; }
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$HA_SDB" --symbols 16 --capacity 64 --batch 8 \
+  --window-ms 1 --metrics-port 0 --standby "127.0.0.1:$HA_PPY" \
+  --flight-dir "$WORK/ha_flight" ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_ha_standby.log" 2>&1 &
+HA_SSRV=$!
+trap 'kill $SRV $HA_PSRV $HA_SSRV 2>/dev/null' EXIT
+HA_SPY=""; HA_SOBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  HA_SPY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_ha_standby.log" | head -1)
+  HA_SOBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_ha_standby.log" | head -1)
+  [ -n "$HA_SPY" ] && [ -n "$HA_SOBS" ] && break
+  kill -0 $HA_SSRV 2>/dev/null || { echo "FAIL: HA standby died at boot"; tail -5 "$WORK/server_ha_standby.log"; exit 1; }
+  sleep 1
+done
+[ -n "$HA_SPY" ] && [ -n "$HA_SOBS" ] || { echo "FAIL: HA standby ports never appeared"; exit 1; }
+# Sequenced subscriber on the STANDBY's feed line: it must cross the
+# promotion with zero unrecovered gaps and exactly one epoch rebase.
+HA_FEED="$FEED_DIR/ha.json"
+python -m matching_engine_tpu.client.cli subscribe "127.0.0.1:$HA_SPY" \
+  md S1 --idle-exit 120 --quiet \
+  --summary-json "$HA_FEED" >/dev/null 2>"$FEED_DIR/ha.err" &
+HA_FEED_PID=$!
+# Concurrent load at the primary; the kill lands while it still runs.
+"$CLI" bench "127.0.0.1:$HA_PPY" 4 4000 8 1 \
+  > "$WORK/ha_bench.json" 2>/dev/null &
+HA_LOAD=$!
+HA_SYNC=$(python - "$HA_SOBS" <<'EOF'
+import sys, time, urllib.request
+port = sys.argv[1]
+deadline = time.monotonic() + 120
+applied = -1.0
+while time.monotonic() < deadline:
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    except Exception:
+        time.sleep(0.5); continue
+    m = {l.split()[0]: float(l.split()[1]) for l in body.splitlines()
+         if l.startswith("me_repl_")}
+    applied = m.get("me_repl_applied_dispatches_total", 0)
+    # Mid-flow, not drained: some dispatches applied and the replica
+    # keeps up (bounded lag), while the bench is still submitting.
+    if applied >= 20 and m.get("me_repl_lag_seqs", 1e9) <= 64:
+        print(f"1 {int(applied)}"); sys.exit(0)
+    time.sleep(0.2)
+print(f"0 {int(applied)}")
+EOF
+)
+read -r HA_SYNCED HA_APPLIED <<< "$(echo "$HA_SYNC" | tail -1)"
+[ "$HA_SYNCED" = "1" ] || { echo "FAIL: standby never synced under load (applied=$HA_APPLIED)"; exit 1; }
+# Primary-side me_repl_* must exist BEFORE the kill (after it there is
+# nothing left to scrape).
+HA_PSCRAPE="$WORK/ha_primary_scrape.prom"
+python - "$HA_POBS" > "$HA_PSCRAPE" <<'EOF'
+import sys, urllib.request
+print(urllib.request.urlopen(
+    f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode())
+EOF
+grep -q "^me_repl_oplog_dispatches_total" "$HA_PSCRAPE" \
+  || { echo "FAIL: me_repl_oplog_* metrics absent from the primary scrape"; exit 1; }
+# The kill: SIGKILL, no drain, no flush, load still in flight.
+kill -9 $HA_PSRV 2>/dev/null; wait $HA_PSRV 2>/dev/null
+trap 'kill $SRV $HA_SSRV 2>/dev/null' EXIT
+python -m matching_engine_tpu.client.cli promote "127.0.0.1:$HA_SPY" \
+  || { echo "FAIL: promote RPC failed"; exit 1; }
+# Fresh flow must be accepted by the promoted replica (on the
+# subscriber's symbol so the feed line provably carries the new epoch).
+python -m matching_engine_tpu.client.cli "127.0.0.1:$HA_SPY" \
+  ha-post S1 BUY LIMIT 9000 4 1 | grep -q accepted \
+  || { echo "FAIL: promoted replica rejected fresh flow"; exit 1; }
+wait $HA_LOAD 2>/dev/null || true  # died with the primary mid-RPC: expected
+# Standby-side me_repl_* + /replz verdict (must be green: promoted,
+# zero divergences, no poison).
+HA_SSCRAPE="$WORK/ha_standby_scrape.prom"
+python - "$HA_SOBS" > "$HA_SSCRAPE" <<'EOF'
+import sys, urllib.request
+print(urllib.request.urlopen(
+    f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode())
+EOF
+cat "$HA_PSCRAPE" "$HA_SSCRAPE" >> "$METRICS_OUT"
+for series in me_repl_applied_dispatches_total me_repl_attested_dispatches_total \
+    me_repl_divergences_total me_repl_heartbeat_age_s me_repl_lag_seqs \
+    me_repl_lag_bytes me_repl_promotions_total; do
+  grep -q "^$series" "$HA_SSCRAPE" \
+    || { echo "FAIL: $series absent from the standby scrape"; exit 1; }
+done
+HA_REPLZ=$(python - "$HA_SOBS" <<'EOF'
+import json, sys, urllib.request, urllib.error
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/replz", timeout=5).read().decode()
+    code = 200
+except urllib.error.HTTPError as e:
+    body, code = e.read().decode(), e.code
+doc = json.loads(body)
+ok = (code == 200 and doc.get("ok") and doc.get("promoted")
+      and doc.get("divergences") == 0 and not doc.get("poisoned"))
+print(f"{int(ok)} {code} {doc.get('divergences')} {doc.get('applied_dispatches')}")
+EOF
+)
+read -r HA_ROK HA_RCODE HA_DIVERGENCES HA_SAPPLIED <<< "$(echo "$HA_REPLZ" | tail -1)"
+[ "$HA_ROK" = "1" ] || { echo "FAIL: /replz red after promotion (code=$HA_RCODE divergences=$HA_DIVERGENCES)"; exit 1; }
+# Subscriber crossed the epoch bump: zero unrecovered gaps (exit 4 is
+# the cli's unrecovered-gap verdict), exactly one rebase in the summary.
+kill -INT $HA_FEED_PID 2>/dev/null || true
+wait $HA_FEED_PID; HA_FEED_RC=$?
+if [ "$HA_FEED_RC" -eq 4 ]; then
+  echo "FAIL: unrecovered feed gap across the failover"
+  cat "$FEED_DIR/ha.err"; exit 1
+fi
+if [ "$HA_FEED_RC" -ne 0 ] || [ ! -s "$HA_FEED" ]; then
+  echo "FAIL: feed subscriber broke in the failover round (rc=$HA_FEED_RC)"
+  cat "$FEED_DIR/ha.err"; exit 1
+fi
+HA_REBASES=$(python - "$HA_FEED" <<'EOF'
+import json, sys
+print(json.load(open(sys.argv[1])).get("epoch_rebases", -1))
+EOF
+)
+[ "$HA_REBASES" = "1" ] \
+  || { echo "FAIL: subscriber saw $HA_REBASES epoch rebases across promotion (want exactly 1)"; exit 1; }
+# Graceful stop drains the promoted replica's sink, then the store
+# bit-identity verdict: the dead primary's db and the promoted
+# replica's db must be prefix-consistent cuts of one history.
+kill -TERM $HA_SSRV 2>/dev/null; wait $HA_SSRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+python -m matching_engine_tpu.replication.verify --promoted "$HA_PDB" "$HA_SDB" \
+  > "$WORK/ha_verify.json" \
+  || { echo "FAIL: store bit-identity mismatch between dead primary and promoted replica"; \
+       cat "$WORK/ha_verify.json"; exit 1; }
+echo "failover round: promoted after SIGKILL (applied=$HA_SAPPLIED divergences=$HA_DIVERGENCES rebases=$HA_REBASES), stores prefix-identical"
+
 # ---- latency round: open-loop tail gate -----------------------------------
 # Boots a fourth server with the tail levers ON (--busy-poll-us,
 # --book-cache-ms, --proto-reuse) and --trace-dir, runs latency_bench's
@@ -641,6 +802,13 @@ artifact = {
     "corruption_round": {"fault": "fill_qty", "detected": True,
                          "violations": int("$CI_VIOL" or -1),
                          "by_kind": json.loads('$CI_KINDS' or "{}")},
+    "failover_round": {
+        "killed": "SIGKILL mid-flow", "promoted": True,
+        "applied_dispatches": int("$HA_SAPPLIED" or -1),
+        "divergences": int("$HA_DIVERGENCES" or -1),
+        "subscriber_epoch_rebases": int("$HA_REBASES" or -1),
+        "stores_prefix_identical": True,
+    },
 }
 json.dump(artifact, open(sys.argv[1], "w"))
 print(json.dumps(artifact))

@@ -1,0 +1,739 @@
+"""Warm-standby replication tests (matching_engine_tpu/replication/).
+
+Layers under test:
+- unit: the op-log codec round trip (EngineOps -> flat op records ->
+  applier tuples, submits carrying their primary-assigned ids) and the
+  prefix-consistency store verifier (identical, legally-advanced, and
+  corrupted store pairs).
+- e2e (in-proc, the ci.yaml fast smoke): a --standby replica of a live
+  --oplog-ship primary applies the identical dispatch sequence, attests
+  byte-identity per dispatch against the drop-copy channel, rejects
+  every mutation RPC app-level while standby, serves reads, and
+  promotes: feed-epoch bump, OID floors past the replicated history,
+  mutation RPCs open.
+- fault injection: ME_REPL_FAULT=row corrupts exactly one standby-side
+  row — the attestor must count a divergence within one dispatch,
+  /replz must go red, and the flight recorder must dump both sides.
+- promotion hygiene: stale-epoch spill segments purge at the epoch bump,
+  and a sequenced subscriber riding across promotion (or resuming after
+  it with a pre-promotion cursor — the restart shape) observes exactly
+  one epoch rebase and zero unrecovered gaps.
+- kill-the-primary: SIGKILL a real primary subprocess under concurrent
+  load, promote the in-proc standby, and prove the two stores are
+  prefix-consistent cuts of one history (bit-identical rows for every
+  dispatch both applied), the promoted server accepts fresh flow with
+  collision-free order ids, and a live subscriber crossed the epoch
+  bump with zero loss.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import re
+import sqlite3
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import OP_AMEND, OP_CANCEL, OP_SUBMIT
+from matching_engine_tpu.feed.client import SequencedSubscriber
+from matching_engine_tpu.feed.sequencer import CHANNEL_MD
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.replication import ops_from_oprec, ops_to_oprec
+from matching_engine_tpu.replication.verify import compare_stores
+from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+from matching_engine_tpu.server.main import build_server, shutdown
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+CFG = EngineConfig(num_symbols=8, capacity=32, batch=8)
+
+NEW, PARTIAL, FILLED, CANCELED = 0, 1, 2, 3
+
+
+# -- unit: the op-log codec ---------------------------------------------------
+
+
+def _info(oid, **kw):
+    d = dict(oid=oid, order_id=f"OID-{oid}", client_id="c1", symbol="AAA",
+             side=2, otype=0, price_q4=10_000, quantity=5, remaining=5,
+             status=NEW, handle=0)
+    d.update(kw)
+    return OrderInfo(**d)
+
+
+def test_oplog_codec_round_trip():
+    ops = [
+        EngineOp(OP_SUBMIT, _info(7, side=1, otype=1, price_q4=0,
+                                  quantity=3, client_id="mk")),
+        EngineOp(OP_CANCEL, _info(4), cancel_requester="other"),
+        EngineOp(OP_AMEND, _info(5, quantity=9), amend_qty=2),
+    ]
+    payload, n = ops_to_oprec(ops)
+    assert n == 3
+    recs = ops_from_oprec(payload)
+    # Submits carry the PRIMARY-assigned id — the log is authoritative
+    # for identity; a replica re-assigning in dispatch order would
+    # diverge under concurrent edge handlers.
+    op, side, otype, price_q4, qty, sym, cid, oid = recs[0]
+    assert (side, otype, price_q4, qty, sym, cid, oid) == \
+        (1, 1, 0, 3, "AAA", "mk", "OID-7")
+    # Cancels ship the requester (STP ownership check replays too).
+    assert (recs[1][6], recs[1][7]) == ("other", "OID-4")
+    # Amends ship the new quantity in the qty box.
+    assert (recs[2][4], recs[2][7]) == (2, "OID-5")
+
+
+def test_oplog_codec_empty_dispatch():
+    payload, n = ops_to_oprec([])
+    assert n == 0
+    assert ops_from_oprec(payload) == []
+
+
+# -- unit: the prefix-consistency verifier -----------------------------------
+
+
+def _mkstore(path, orders, fills=()):
+    con = sqlite3.connect(path)
+    con.execute("CREATE TABLE orders (order_id TEXT PRIMARY KEY, client_id "
+                "TEXT, symbol TEXT, side INT, order_type INT, price INT, "
+                "quantity INT, remaining_quantity INT, status INT, tif INT)")
+    con.execute("CREATE TABLE fills (order_id TEXT, counter_order_id TEXT, "
+                "price INT, quantity INT)")
+    con.executemany("INSERT INTO orders VALUES (?,?,?,?,?,?,?,?,?,?)", orders)
+    con.executemany("INSERT INTO fills VALUES (?,?,?,?)", fills)
+    con.commit()
+    con.close()
+    return path
+
+
+def _row(oid, rem=5, status=NEW, qty=5, price=10_000):
+    return (oid, "c", "AAA", 2, 0, price, qty, rem, status, 0)
+
+
+def test_verify_identical_stores(tmp_path):
+    rows = [_row("OID-1"), _row("OID-2", rem=0, status=FILLED)]
+    fills = [("OID-2", "OID-1", 10_000, 5)]
+    a = _mkstore(str(tmp_path / "a.db"), rows, fills)
+    b = _mkstore(str(tmp_path / "b.db"), rows, fills)
+    rep = compare_stores(a, b)
+    assert rep["identical_prefix"] and rep["equal"] == 2
+
+
+def test_verify_one_sided_advance_is_prefix(tmp_path):
+    # B applied one more dispatch: OID-1 canceled + a new OID-3. Legal.
+    a = _mkstore(str(tmp_path / "a.db"), [_row("OID-1")])
+    b = _mkstore(str(tmp_path / "b.db"),
+                 [_row("OID-1", rem=0, status=CANCELED), _row("OID-3")])
+    rep = compare_stores(a, b)
+    assert rep["identical_prefix"]
+    assert rep["b_ahead"] == 1 and rep["only_b"] == 1
+
+
+def test_verify_catches_corruption(tmp_path):
+    # Same order, different immutable column (price): neither equal nor
+    # a legal advance — corruption, never an async-cut artifact.
+    a = _mkstore(str(tmp_path / "a.db"), [_row("OID-1", price=10_000)])
+    b = _mkstore(str(tmp_path / "b.db"), [_row("OID-1", price=10_001)])
+    rep = compare_stores(a, b)
+    assert not rep["identical_prefix"]
+    assert rep["mismatched_orders"] == ["OID-1"]
+
+
+def test_verify_catches_mixed_direction(tmp_path):
+    # OID-1 ahead in A while OID-2 is ahead in B: impossible for two
+    # cuts of one totally-ordered history.
+    a = _mkstore(str(tmp_path / "a.db"),
+                 [_row("OID-1", rem=0, status=CANCELED), _row("OID-2")])
+    b = _mkstore(str(tmp_path / "b.db"),
+                 [_row("OID-1"), _row("OID-2", rem=0, status=CANCELED)])
+    rep = compare_stores(a, b)
+    assert not rep["identical_prefix"] and rep["mixed_direction"]
+
+
+def test_verify_catches_terminal_flip(tmp_path):
+    # CANCELED in one cut, FILLED in the other: terminal statuses are
+    # absorbing, so two cuts of ONE history can never disagree on WHICH
+    # terminal an order reached — this is divergence even though
+    # remaining/status "advance" monotonically in isolation (and even
+    # under the --promoted fork contract: the row is common).
+    a = _mkstore(str(tmp_path / "a.db"),
+                 [_row("OID-1", rem=10, qty=10, status=CANCELED)])
+    b = _mkstore(str(tmp_path / "b.db"),
+                 [_row("OID-1", rem=0, qty=10, status=FILLED)],
+                 [("OID-1", "OID-9", 10_000, 10)])
+    for kw in ({}, {"allow_fork": True}):
+        rep = compare_stores(a, b, **kw)
+        assert not rep["identical_prefix"]
+        assert rep["mismatched_orders"] == ["OID-1"]
+
+
+def test_verify_promoted_fork_tolerated(tmp_path):
+    # Post-promotion: a (the dead primary) holds a durable tail that
+    # never shipped (only_a) while b (the promoted replica) accepted
+    # fresh flow (only_b). Two-sided exclusives are the legal promotion
+    # fork under allow_fork, and corruption for two cuts of ONE line.
+    a = _mkstore(str(tmp_path / "a.db"), [_row("OID-1"), _row("OID-2")])
+    b = _mkstore(str(tmp_path / "b.db"), [_row("OID-1"), _row("OID-3")])
+    assert not compare_stores(a, b)["identical_prefix"]
+    assert compare_stores(a, b, allow_fork=True)["identical_prefix"]
+    # Disagreement on a COMMON row stays divergence even when forked.
+    c = _mkstore(str(tmp_path / "c.db"), [_row("OID-1", price=10_001),
+                                          _row("OID-3")])
+    assert not compare_stores(a, c, allow_fork=True)["identical_prefix"]
+
+
+def test_verify_catches_fill_conflict(tmp_path):
+    rows = [_row("OID-1", rem=0, status=FILLED)]
+    a = _mkstore(str(tmp_path / "a.db"), rows,
+                 [("OID-1", "OID-9", 10_000, 5)])
+    b = _mkstore(str(tmp_path / "b.db"), rows,
+                 [("OID-1", "OID-8", 10_000, 5)])
+    rep = compare_stores(a, b)
+    assert not rep["identical_prefix"]
+    assert rep["fill_mismatches"] == ["OID-1"]
+
+
+# -- e2e plumbing -------------------------------------------------------------
+
+
+def _boot_pair(tmp_path, *, fault=None, spill=False, standby_kw=None):
+    """In-proc primary (--oplog-ship --audit) + standby replica pair."""
+    if fault is not None:
+        os.environ["ME_REPL_FAULT"] = fault
+    try:
+        psrv, pport, pparts = build_server(
+            "127.0.0.1:0", str(tmp_path / "primary.db"), CFG, window_ms=1.0,
+            log=False, oplog_ship=True, audit=True, audit_sample=1)
+        psrv.start()
+        kw = dict(standby_kw or {})
+        kw.setdefault("flight_dir", str(tmp_path / "flight"))
+        if spill:
+            kw["feed_spill_dir"] = str(tmp_path / "spill")
+        ssrv, sport, sparts = build_server(
+            "127.0.0.1:0", str(tmp_path / "standby.db"), CFG, window_ms=1.0,
+            log=False, standby_addr=f"127.0.0.1:{pport}", **kw)
+        ssrv.start()
+    finally:
+        if fault is not None:
+            del os.environ["ME_REPL_FAULT"]
+    return (psrv, pport, pparts), (ssrv, sport, sparts)
+
+
+def _stub(port):
+    return MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+
+
+def _drive(stub, n=20, cancel_every=5, start=0):
+    """Deterministic mixed flow: resting + crossing limits, sprinkled
+    cancels. Returns the acked order ids."""
+    acked = []
+    for i in range(start, start + n):
+        side = pb2.BUY if i % 2 == 0 else pb2.SELL
+        r = stub.SubmitOrder(pb2.OrderRequest(
+            client_id=f"c{i % 3}", symbol=f"S{i % 4}", order_type=pb2.LIMIT,
+            side=side, price=10_000 + (i % 5) * 100, scale=4, quantity=5),
+            timeout=30)
+        assert r.success, r.error_message
+        acked.append(r.order_id)
+        if cancel_every and i % cancel_every == cancel_every - 1:
+            stub.CancelOrder(pb2.CancelRequest(
+                client_id=f"c{i % 3}", order_id=r.order_id), timeout=30)
+    return acked
+
+
+def _wait(pred, timeout_s=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _settle_stores(pparts, sparts, replica, min_applied):
+    assert _wait(lambda: replica.snapshot()["applied_ops"] >= min_applied
+                 and replica.snapshot()["lag_seqs"] == 0), replica.snapshot()
+    pparts["sink"].flush()
+    sparts["sink"].flush()
+
+
+# -- e2e: the in-proc smoke (ci.yaml runs exactly this test) ------------------
+
+
+def test_standby_replicates_attests_and_promotes(tmp_path):
+    (psrv, pport, pparts), (ssrv, sport, sparts) = _boot_pair(tmp_path)
+    try:
+        pstub, sstub = _stub(pport), _stub(sport)
+        replica = sparts["replica"]
+
+        # Read-only: every mutation RPC rejects app-level while standby.
+        ro = sstub.SubmitOrder(pb2.OrderRequest(
+            client_id="x", symbol="S0", order_type=pb2.LIMIT, side=pb2.BUY,
+            price=10_000, scale=4, quantity=1), timeout=30)
+        assert not ro.success and "read-only" in ro.error_message
+        assert not sstub.CancelOrder(pb2.CancelRequest(
+            client_id="x", order_id="OID-1"), timeout=30).success
+        assert not sstub.AmendOrder(pb2.AmendRequest(
+            client_id="x", order_id="OID-1", new_quantity=1),
+            timeout=30).success
+        assert not sstub.RunAuction(pb2.AuctionRequest(), timeout=30).success
+        # Promote against a non-standby rejects app-level too.
+        assert not pstub.Promote(pb2.PromoteRequest(), timeout=30).success
+        # RunAuction rejects on the PRIMARY as well: the uncross bypasses
+        # the drain loops the op-log shipper rides, so running it would
+        # silently diverge the standby.
+        ra = pstub.RunAuction(pb2.AuctionRequest(), timeout=30)
+        assert not ra.success and "op log" in ra.error_message
+
+        acked = _drive(pstub, n=20)
+        _settle_stores(pparts, sparts, replica, min_applied=24)
+
+        snap = replica.snapshot()
+        assert snap["applied_dispatches"] >= 1
+        assert snap["apply_errors"] == 0 and snap["divergences"] == 0
+        assert snap["oplog_lost_records"] == 0 and snap["ok"]
+        # Attestation ran (every fully-paired dispatch matched); the
+        # in-flight last group may still be pending its idle flush.
+        assert _wait(lambda: replica.snapshot()["attested"]
+                     >= snap["applied_dispatches"] - 2)
+        assert replica.snapshot()["divergences"] == 0
+
+        # The standby serves reads: its book mirrors the primary's.
+        pbook = pstub.GetOrderBook(
+            pb2.OrderBookRequest(symbol="S1"), timeout=30)
+        sbook = sstub.GetOrderBook(
+            pb2.OrderBookRequest(symbol="S1"), timeout=30)
+        assert [(b.price, b.quantity) for b in pbook.bids] == \
+            [(b.price, b.quantity) for b in sbook.bids]
+        assert [(a.price, a.quantity) for a in pbook.asks] == \
+            [(a.price, a.quantity) for a in sbook.asks]
+
+        # Both durable stores are bit-identical cuts of one history.
+        rep = compare_stores(str(tmp_path / "primary.db"),
+                             str(tmp_path / "standby.db"))
+        assert rep["identical_prefix"], rep
+        assert rep["orders_a"] == rep["orders_b"] == len(acked)
+
+        # Promote: epoch bumps, mutation RPCs open, ids collision-free.
+        old_epoch = sparts["sequencer"].epoch
+        pr = sstub.Promote(pb2.PromoteRequest(), timeout=60)
+        assert pr.success and pr.feed_epoch != old_epoch
+        assert replica.snapshot()["promotions"] == 1
+        r = sstub.SubmitOrder(pb2.OrderRequest(
+            client_id="post", symbol="S0", order_type=pb2.LIMIT,
+            side=pb2.BUY, price=9_000, scale=4, quantity=1), timeout=30)
+        assert r.success
+        assert r.order_id not in acked
+        assert int(r.order_id[4:]) > max(int(o[4:]) for o in acked)
+    finally:
+        shutdown(ssrv, sparts)
+        shutdown(psrv, pparts)
+
+
+# -- e2e: fault injection proves the detection path ---------------------------
+
+
+def test_attestation_divergence_flips_replz_and_flight_dumps(tmp_path):
+    (psrv, pport, pparts), (ssrv, sport, sparts) = \
+        _boot_pair(tmp_path, fault="row")
+    try:
+        pstub = _stub(pport)
+        replica = sparts["replica"]
+        # ONE dispatch: the corrupted row must be detected without any
+        # further flow (the idle-group flush closes the pairing window).
+        r = pstub.SubmitOrder(pb2.OrderRequest(
+            client_id="c", symbol="S0", order_type=pb2.LIMIT, side=pb2.BUY,
+            price=10_000, scale=4, quantity=5), timeout=30)
+        assert r.success
+        assert _wait(lambda: replica.snapshot()["divergences"] >= 1), \
+            replica.snapshot()
+        snap = replica.snapshot()
+        assert snap["diverged"] and not snap["ok"]
+
+        # /replz is red: 500 + the same snapshot JSON.
+        from matching_engine_tpu.utils.obs import ObsServer
+
+        obs = ObsServer(sparts["metrics"], recorder=sparts["recorder"],
+                        port=0, repl=replica)
+        obs.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{obs.port}/replz", timeout=10)
+            assert ei.value.code == 500
+            body = ei.value.read().decode()
+            assert '"diverged": true' in body
+        finally:
+            obs.close()
+
+        # The divergence flight-dumped both sides' rows.
+        flight_dir = tmp_path / "flight"
+        assert _wait(lambda: list(flight_dir.glob("flight_*.json")),
+                     timeout_s=10)
+        dump = max(flight_dir.glob("flight_*.json"),
+                   key=lambda p: p.stat().st_mtime).read_text()
+        assert "repl_divergence" in dump
+    finally:
+        shutdown(ssrv, sparts)
+        shutdown(psrv, pparts)
+
+
+# -- e2e: a LATE-attaching standby attests the replayed history ---------------
+
+
+def test_late_attach_standby_attests_replayed_history(tmp_path):
+    """Boot the standby AFTER the primary already served traffic: the
+    applier full-replays the op log from the epoch start, and the
+    attestor must replay the audit channel over the SAME range (the
+    __dropcopy_all__ from-start grant) — a live-only audit attach would
+    leave the whole replayed prefix unattested while its local groups
+    churn the pairing store as unmatched."""
+    psrv, pport, pparts = build_server(
+        "127.0.0.1:0", str(tmp_path / "primary.db"), CFG, window_ms=1.0,
+        log=False, oplog_ship=True, audit=True, audit_sample=1)
+    psrv.start()
+    ssrv = sparts = None
+    try:
+        pstub = _stub(pport)
+        _drive(pstub, n=12, cancel_every=0)
+        ssrv, sport, sparts = build_server(
+            "127.0.0.1:0", str(tmp_path / "standby.db"), CFG,
+            window_ms=1.0, log=False,
+            standby_addr=f"127.0.0.1:{pport}",
+            flight_dir=str(tmp_path / "flight"))
+        ssrv.start()
+        replica = sparts["replica"]
+        assert _wait(lambda: replica.snapshot()["applied_dispatches"] >= 1
+                     and replica.snapshot()["lag_seqs"] == 0)
+        # The replayed prefix attests (the in-flight last group may
+        # still be pending its idle flush).
+        assert _wait(lambda: replica.snapshot()["attested"]
+                     >= replica.snapshot()["applied_dispatches"] - 1), \
+            replica.snapshot()
+        assert replica.snapshot()["divergences"] == 0
+        assert replica.snapshot()["ok"]
+    finally:
+        if ssrv is not None:
+            shutdown(ssrv, sparts)
+        shutdown(psrv, pparts)
+
+
+# -- boot: the runbook's fresh-db rule is enforced ----------------------------
+
+
+def test_standby_refuses_non_empty_db(tmp_path):
+    """A standby booted onto a used store would recover it into the
+    books and then re-apply the same history via the from-start op-log
+    replay (double-applied fills) — build_server must refuse at boot,
+    before any engine threads start."""
+    db = _mkstore(str(tmp_path / "used.db"), [_row("OID-1")], [])
+    with pytest.raises(SystemExit):
+        build_server("127.0.0.1:0", db, CFG, window_ms=1.0, log=False,
+                     standby_addr="127.0.0.1:1")
+
+
+# -- e2e: a known-bad replica must not SELF-promote ---------------------------
+
+
+def test_standby_never_heard_refuses_auto_promotion(tmp_path):
+    """A standby that never received ANYTHING from its configured
+    primary (wrong --standby address, primary never up) must not
+    self-promote on heartbeat lapse: auto-promoting an empty replica
+    while the real primary may be serving elsewhere is split-brain by
+    typo. (rx retries every 0.2s, the watcher polls every 0.2s, so an
+    unguarded watcher would promote within a poll or two.)"""
+    srv, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "s.db"), CFG, window_ms=1.0,
+        log=False, standby_addr="127.0.0.1:1",
+        standby_auto_promote_s=0.05)
+    srv.start()
+    try:
+        replica = parts["replica"]
+        time.sleep(1.0)
+        snap = replica.snapshot()
+        assert not snap["promoted"] and snap["promotions"] == 0, snap
+        assert parts["service"].read_only
+    finally:
+        shutdown(srv, parts)
+
+
+def test_poisoned_replica_refuses_auto_promotion(tmp_path):
+    """Heartbeat-lapse auto-promotion is guarded: a replica with a known
+    hole (poisoned) never self-promotes into the serving primary — only
+    the explicit operator Promote (eyes open on a red /replz) can."""
+    (psrv, pport, pparts), (ssrv, sport, sparts) = _boot_pair(tmp_path)
+    try:
+        pstub = _stub(pport)
+        replica = sparts["replica"]
+        _drive(pstub, n=4, cancel_every=0)
+        assert _wait(lambda: replica.snapshot()["applied_dispatches"] >= 1)
+        replica._poison("test: simulated unrecoverable oplog gap")
+        # Heartbeats land every 0.25s, the watcher polls every 0.2s: with
+        # this threshold nearly every poll observes a "lapse", so an
+        # unguarded watcher would promote within a poll or two.
+        replica.auto_promote_s = 0.01
+        time.sleep(1.0)
+        snap = replica.snapshot()
+        assert not snap["promoted"] and snap["promotions"] == 0, snap
+        assert sparts["service"].read_only
+        # The explicit operator path stays available.
+        pr = _stub(sport).Promote(pb2.PromoteRequest(), timeout=60)
+        assert pr.success
+        assert replica.snapshot()["promotions"] == 1
+    finally:
+        shutdown(ssrv, sparts)
+        shutdown(psrv, pparts)
+
+
+# -- e2e: promotion hygiene (spill purge + exactly one rebase) ----------------
+
+
+def test_promotion_purges_stale_spill_and_rebases_once(tmp_path):
+    (psrv, pport, pparts), (ssrv, sport, sparts) = \
+        _boot_pair(tmp_path, spill=True)
+    try:
+        pstub, sstub = _stub(pport), _stub(sport)
+        replica = sparts["replica"]
+        seq = sparts["sequencer"]
+        spill_base = tmp_path / "spill"
+        old_epoch = seq.epoch
+        assert (spill_base / f"epoch-{old_epoch}").is_dir()
+        # A leftover segment dir from an older line (the restart shape:
+        # a standby rebooted into the same spill dir) must also purge.
+        stale = spill_base / "epoch-123"
+        stale.mkdir()
+        (stale / "seg-1").write_bytes(b"stale payload")
+
+        acked = _drive(pstub, n=8, cancel_every=0)
+        _settle_stores(pparts, sparts, replica, min_applied=8)
+
+        # A live sequenced subscriber on the STANDBY's own feed line
+        # rides across the promotion.
+        rebases = []
+        sub = SequencedSubscriber(
+            sstub, CHANNEL_MD, key="S1",
+            on_rebase=lambda cur, seq_: rebases.append((cur, seq_)))
+        got: list = []
+        t = threading.Thread(
+            target=lambda: [got.append(e) for e in sub], daemon=True)
+        t.start()
+        # More pre-promotion flow so the subscriber holds a live cursor.
+        _drive(pstub, n=8, cancel_every=0, start=100)
+        _settle_stores(pparts, sparts, replica, min_applied=16)
+        assert _wait(lambda: any(e.feed_epoch == old_epoch for e in got))
+
+        # A subscriber attached with a REPLAY cursor before promotion
+        # (server-side overlap filter armed with last > 0) must still
+        # receive the new epoch's first events after the in-place
+        # rebase: the filter is epoch-aware, not seq-only — a seq-only
+        # filter would silently swallow every new-epoch event whose seq
+        # is below the old epoch's replay cursor.
+        mid_cursor = max(e.seq for e in got if e.feed_epoch == old_epoch)
+        rebases3 = []
+        sub3 = SequencedSubscriber(
+            sstub, CHANNEL_MD, key="S1", from_seq=max(1, mid_cursor - 2),
+            epoch=old_epoch,
+            on_rebase=lambda cur, seq_: rebases3.append((cur, seq_)))
+        got3: list = []
+        t3 = threading.Thread(
+            target=lambda: [got3.append(e) for e in sub3], daemon=True)
+        t3.start()
+
+        pr = sstub.Promote(pb2.PromoteRequest(), timeout=60)
+        assert pr.success and pr.feed_epoch != old_epoch
+
+        # Stale-epoch spill segments are gone; the new line's dir stands.
+        assert _wait(lambda: not stale.exists(), timeout_s=10)
+        assert not (spill_base / f"epoch-{old_epoch}").exists()
+        assert (spill_base / f"epoch-{pr.feed_epoch}").is_dir()
+
+        # Post-promotion flow reaches the SAME live subscriber with the
+        # new epoch: exactly one rebase, zero unrecovered gaps.
+        r = sstub.SubmitOrder(pb2.OrderRequest(
+            client_id="post", symbol="S1", order_type=pb2.LIMIT,
+            side=pb2.BUY, price=9_000, scale=4, quantity=1), timeout=30)
+        assert r.success
+        assert _wait(lambda: any(e.feed_epoch == pr.feed_epoch for e in got))
+        assert len(rebases) == 1
+        assert sub.gaps_detected == sub.unrecovered_events == 0
+        sub.cancel()
+        t.join(timeout=10)
+        # The replay-cursor subscriber crossed the rebase too: the new
+        # epoch's events (seqs BELOW its old-epoch cursor) arrived.
+        assert _wait(lambda: any(e.feed_epoch == pr.feed_epoch
+                                 for e in got3)), \
+            (len(got3), [e.seq for e in got3])
+        assert len(rebases3) == 1 and sub3.unrecovered_events == 0
+        sub3.cancel()
+        t3.join(timeout=10)
+
+        # The restart shape: a subscriber RESUMING with its pre-promotion
+        # cursor + epoch sees exactly one rebase too, then live events —
+        # never the old line's payloads replayed as the new epoch's range.
+        old_cursor = max(e.seq for e in got if e.feed_epoch == old_epoch)
+        rebases2 = []
+        sub2 = SequencedSubscriber(
+            sstub, CHANNEL_MD, key="S1", from_seq=old_cursor,
+            epoch=old_epoch,
+            on_rebase=lambda cur, seq_: rebases2.append((cur, seq_)))
+        got2: list = []
+        t2 = threading.Thread(
+            target=lambda: [got2.append(e) for e in sub2], daemon=True)
+        t2.start()
+        r = sstub.SubmitOrder(pb2.OrderRequest(
+            client_id="post2", symbol="S1", order_type=pb2.LIMIT,
+            side=pb2.BUY, price=9_100, scale=4, quantity=1), timeout=30)
+        assert r.success
+        assert _wait(lambda: len(got2) >= 1)
+        assert len(rebases2) == 1 and sub2.unrecovered_events == 0
+        assert all(e.feed_epoch == pr.feed_epoch for e in got2)
+        sub2.cancel()
+        t2.join(timeout=10)
+        assert len(acked) == 8
+    finally:
+        shutdown(ssrv, sparts)
+        shutdown(psrv, pparts)
+
+
+# -- e2e: kill the primary ----------------------------------------------------
+
+
+def _spawn_primary(tmp_path, db: str):
+    """A REAL primary subprocess (SIGKILL needs a process boundary). The
+    bound port is parsed from the boot log (--addr :0) — pre-binding a
+    probe socket and reusing its port races other tests for the bind."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU; never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = f"{env.get('PYTHONPATH', '')}:{REPO}"
+    log_path = tmp_path / "primary.log"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "matching_engine_tpu.server.main",
+         "--addr", "127.0.0.1:0", "--db", db,
+         "--symbols", "8", "--capacity", "32", "--batch", "8",
+         "--window-ms", "1", "--oplog-ship", "--audit",
+         "--audit-sample", "1"],
+        env=env, cwd=REPO,
+        stdout=log_path.open("w"), stderr=subprocess.STDOUT)
+    return proc, log_path
+
+
+def _primary_port(proc, log_path, timeout_s=240.0) -> int:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, \
+            f"primary died at boot:\n{log_path.read_text()}"
+        m = re.search(r"listening on port (\d+)", log_path.read_text())
+        if m:
+            return int(m.group(1))
+        time.sleep(0.5)
+    raise AssertionError(
+        f"primary never listened:\n{log_path.read_text()}")
+
+
+def test_kill_primary_promote_standby_prefix_identical(tmp_path):
+    pdb = str(tmp_path / "primary.db")
+    proc, log_path = _spawn_primary(tmp_path, pdb)
+    ssrv = sparts = None
+    try:
+        pport = _primary_port(proc, log_path)
+        pstub = _stub(pport)
+        assert _wait(lambda: _ping(pstub), timeout_s=60), \
+            log_path.read_text()
+        # Pre-existing history BEFORE the standby attaches: the standby
+        # must bootstrap via the full oplog replay, not just live flow.
+        pre = _drive(pstub, n=10)
+
+        ssrv, sport, sparts = build_server(
+            "127.0.0.1:0", str(tmp_path / "standby.db"), CFG, window_ms=1.0,
+            log=False, standby_addr=f"127.0.0.1:{pport}")
+        ssrv.start()
+        sstub = _stub(sport)
+        replica = sparts["replica"]
+
+        # Concurrent load until the kill; acks collected up to the cut.
+        acked: list[str] = []
+        stop = threading.Event()
+
+        def load():
+            i = 1000
+            while not stop.is_set():
+                try:
+                    r = pstub.SubmitOrder(pb2.OrderRequest(
+                        client_id=f"c{i % 3}", symbol=f"S{i % 4}",
+                        order_type=pb2.LIMIT,
+                        side=pb2.BUY if i % 2 == 0 else pb2.SELL,
+                        price=10_000 + (i % 5) * 100, scale=4, quantity=5),
+                        timeout=5)
+                except grpc.RpcError:
+                    return  # the kill landed mid-RPC
+                if r.success:
+                    acked.append(r.order_id)
+                i += 1
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        assert _wait(lambda: len(acked) >= 30
+                     and replica.snapshot()["applied_ops"] >= 20)
+
+        proc.kill()  # SIGKILL: no drain, no flush, mid-flow
+        proc.wait(timeout=30)
+        stop.set()
+        loader.join(timeout=30)
+
+        # Promote. Everything already received is drained and applied;
+        # fresh flow is accepted with ids past the replicated history.
+        pr = sstub.Promote(pb2.PromoteRequest(), timeout=60)
+        assert pr.success
+        sparts["sink"].flush()
+
+        r = sstub.SubmitOrder(pb2.OrderRequest(
+            client_id="post", symbol="S0", order_type=pb2.LIMIT,
+            side=pb2.BUY, price=9_000, scale=4, quantity=1), timeout=30)
+        assert r.success
+        all_acked = pre + acked
+        assert r.order_id not in all_acked
+        assert int(r.order_id[4:]) > max(int(o[4:]) for o in all_acked)
+
+        # (a) Bit-identity for the acknowledged prefix: the dead
+        # primary's WAL and the promoted replica's store are two cuts of
+        # one deterministic history — every common row identical, every
+        # difference a one-sided legal advance (the async tails).
+        rep = compare_stores(pdb, str(tmp_path / "standby.db"),
+                             allow_fork=True)
+        assert rep["identical_prefix"], rep
+        assert rep["common"] >= len(pre)
+
+        # Every order the standby applied from the log landed (the
+        # promoted store can't be missing applied history; the post-
+        # promotion order rides on top).
+        con = sqlite3.connect(str(tmp_path / "standby.db"))
+        try:
+            n_orders = con.execute(
+                "SELECT COUNT(*) FROM orders").fetchone()[0]
+        finally:
+            con.close()
+        assert n_orders >= rep["common"]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        if ssrv is not None:
+            shutdown(ssrv, sparts)
+
+
+def _ping(stub) -> bool:
+    try:
+        stub.GetOrderBook(pb2.OrderBookRequest(symbol="S0"),
+                          timeout=2)
+        return True
+    except grpc.RpcError:
+        return False
