@@ -121,7 +121,8 @@ def _failed(fut) -> bool:
     return bool(getattr(res, "error", ""))
 
 
-def _open_loop(submit_one, rate_ops_s: float, duration_s: float):
+def _open_loop(submit_one, rate_ops_s: float, duration_s: float,
+               failed=_failed):
     """Issue ops on a fixed schedule for `duration_s`, latency measured
     from each op's SCHEDULED time (the open-loop/coordinated-omission
     contract: a pipeline stall bills every op it delays, not just the
@@ -139,7 +140,7 @@ def _open_loop(submit_one, rate_ops_s: float, duration_s: float):
     def on_done(seq, t_sched):
         def cb(fut=None):
             t = time.perf_counter() - t_sched
-            bad = _failed(fut)
+            bad = failed(fut)
             with lock:
                 if outstanding.pop(seq, None) is None:
                     return  # already written off at the drain deadline
@@ -238,12 +239,26 @@ def main() -> None:
                         "(default)")
     p.add_argument("--peak", type=float, default=0.0,
                    help="skip peak measurement and use this orders/s")
+    p.add_argument("--workload", default=None, metavar="OPFILE",
+                   help="recorded workload opfile (sim/record.py): the "
+                        "open-loop stream draws its submits from the "
+                        "recording's SUBMIT records (cyclic) instead of "
+                        "the synthetic maker/taker alternation, so the "
+                        "tail is measured under recorded sizes/symbol "
+                        "skew/side mix. Cancels and auction phases are "
+                        "dropped — open-loop slots cannot serialize "
+                        "against server id assignment — and positional "
+                        "rejects count as backpressure, not errors "
+                        "(BENCH_METHOD §workload-replay). --addr mode "
+                        "only")
     p.add_argument("--scrape", default=None,
                    help="with --addr: GET this /metrics URL after the run "
                         "and embed the me_stage_* quantile gauges")
     p.add_argument("--json-out", required=True)
     args = p.parse_args()
 
+    if args.workload and not args.addr:
+        p.error("--workload drives a live server: pass --addr")
     if args.addr:
         out = run_grpc(args)
     else:
@@ -526,9 +541,62 @@ def run_grpc(args) -> dict:
     state = {"i": int(time.time()) % 1000000 * 1000}
     bs = max(1, args.batch_size)
 
+    workload = None
+    failed = _failed
+    if args.workload:
+        # Recorded-flow drive: cycle the workload's SUBMIT records. The
+        # open-loop generator cannot serialize against the server's id
+        # assignment, so cancels (renumbered-target records) and auction
+        # phases are dropped here — the faithful in-order replay is
+        # runner_bench --workload; this mode measures the TAIL under the
+        # recording's sizes, symbol skew, and side mix. Positional
+        # rejects under recorded stress are backpressure (counted by the
+        # server's orders_rejected), not sample errors.
+        from matching_engine_tpu.domain import oprec
+
+        from matching_engine_tpu.proto import split_otype as _split_otype
+
+        _record_fields = oprec.record_fields
+        arr = oprec.read_opfile(args.workload)
+        workload = arr[arr["op"] == oprec.OPREC_SUBMIT]
+        if len(workload) == 0:
+            print("[latency_bench] FATAL: workload has no submit records",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        state["i"] = 0
+
+        def failed(fut):  # noqa: F811 — workload-aware error gate
+            if fut is None:
+                return False
+            try:
+                if fut.exception(timeout=0) is not None:
+                    return True
+                res = fut.result(timeout=0)
+            except Exception:  # noqa: BLE001
+                return True
+            oks = getattr(res, "ok", None)
+            if oks is not None and not isinstance(oks, bool):
+                # Batch response: success=False means the PAYLOAD was
+                # undecodable — a real error; positional rejects are
+                # recorded-stress backpressure, never sample errors.
+                return getattr(res, "success", True) is False
+            # Per-op response: an app-level reject (success=False, gRPC
+            # OK) is the same backpressure — cycling resting LIMIT flow
+            # without its cancels drives books to capacity by design.
+            # Dead/refusing servers still fail via the RpcError path.
+            return False
+
     def make_req():
         i = state["i"]
         state["i"] += 1
+        if workload is not None:
+            (_op, side, otype, price_q4, qty, sym, cid,
+             _oid) = _record_fields(workload[i % len(workload)])
+            order_type, tif = _split_otype(otype)
+            return pb2.OrderRequest(
+                client_id=cid.decode(), symbol=sym.decode(),
+                order_type=order_type, side=side, price=price_q4,
+                scale=4, quantity=qty, tif=tif)
         maker = (i % 2) == 0
         return pb2.OrderRequest(
             client_id="lat-m" if maker else "lat-t",
@@ -545,6 +613,9 @@ def run_grpc(args) -> dict:
         def make_payload():
             i = state["i"]
             state["i"] += bs
+            if workload is not None:
+                idx = [(i + j) % len(workload) for j in range(bs)]
+                return oprec.encode_payload(workload[idx])
             ops = []
             for j in range(i, i + bs):
                 maker = (j % 2) == 0
@@ -573,7 +644,7 @@ def run_grpc(args) -> dict:
         errs = [0]
 
         def cb(fut=None):
-            bad = _failed(fut)
+            bad = failed(fut)
             sem.release()
             done[0] += 1
             errs[0] += bad
@@ -608,7 +679,8 @@ def run_grpc(args) -> dict:
         reps = []
         for _ in range(max(1, args.repeats)):
             lats, n, wall, errors = _open_loop(submit_one, peak * frac,
-                                               args.duration_s)
+                                               args.duration_s,
+                                               failed=failed)
             e2e = _pctls(lats)
             reps.append({"e2e": e2e,
                          "achieved_ops_s": round(len(lats) / wall, 1),
@@ -640,11 +712,15 @@ def run_grpc(args) -> dict:
     out = {
         "metric": "serving_latency_tail",
         "drive": f"grpc open-loop @ {args.addr}"
-                 + (f" (SubmitOrderBatch x{bs})" if bs > 1 else ""),
+                 + (f" (SubmitOrderBatch x{bs})" if bs > 1 else "")
+                 + (f" [workload {args.workload}]" if args.workload
+                    else ""),
         "batch_size": bs,
         "peak_ops_s": {"grpc": round(peak * bs, 1)},
         "rows": rows,
     }
+    if args.workload:
+        out["workload"] = args.workload
     if args.scrape:
         import urllib.request
 

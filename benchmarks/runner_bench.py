@@ -147,6 +147,27 @@ def main() -> None:
     p.add_argument("--audit-sample", type=int, default=8,
                    help="--audit-ab shadow-tracking sample (the server "
                         "flag's default, 8)")
+    p.add_argument("--workload", default="",
+                   help="comma list of recorded workload opfiles "
+                        "(sim/record.py artifacts, manifest beside each): "
+                        "replay every scenario through the serving stack "
+                        "instead of synthetic flow — phase-aware (auction "
+                        "call periods open/uncross via RunAuction), "
+                        "in-order on one stream so the recorder's "
+                        "order-id renumbering holds. One sweep row per "
+                        "(scenario, path); selects the workload-replay "
+                        "sweep family")
+    p.add_argument("--workload-paths", default="inproc,edge",
+                   help="serving paths to replay through: 'inproc' "
+                        "(build_server in this process, no network — the "
+                        "host-only serving figure) and/or 'edge' (server "
+                        "SUBPROCESS + loopback gRPC SubmitOrderBatch — "
+                        "the batch-edge figure)")
+    p.add_argument("--workload-batch", type=int, default=0,
+                   help="records per SubmitOrderBatch during workload "
+                        "replay; 0 = min(512, the manifest's "
+                        "min_cancel_gap) so intra-batch cancel targets "
+                        "can never precede their submits")
     p.add_argument("--host-only", action="store_true",
                    help="isolate the serving stack's HOST work (lane "
                         "build, id/slot assignment, status decode, "
@@ -999,12 +1020,245 @@ def main() -> None:
                                  / off["orders_per_s"]), 1)
         return rows
 
+    # -- workload replay (sim/record.py artifacts) -------------------------
+
+    def workload_sweep() -> list:
+        """Replay recorded scenario opfiles through the live serving
+        stack — in-proc (host-only serving figure) and/or the loopback
+        gRPC batch edge — one row per (scenario, path). Replay is
+        IN ORDER on one stream (the recorder renumbered cancel targets
+        to the ids a fresh server assigns in record order), phase-aware
+        (auction phases open the call period via RunAuction open_call
+        and uncross at the phase end), and reconciled against the sim's
+        own ground truth (fills / uncross volume from the manifest)."""
+        import tempfile
+
+        import grpc
+
+        from matching_engine_tpu.domain import oprec
+        from matching_engine_tpu.proto import pb2
+        from matching_engine_tpu.proto.rpc import MatchingEngineStub
+        from matching_engine_tpu.sim.record import read_manifest
+
+        files = [f.strip() for f in args.workload.split(",") if f.strip()]
+        paths = [s.strip() for s in args.workload_paths.split(",")
+                 if s.strip()]
+        bad = [s for s in paths if s not in ("inproc", "edge")]
+        if bad:
+            raise SystemExit(
+                f"--workload-paths: unknown path(s) {bad} "
+                f"(valid: inproc, edge)")
+        rows = []
+
+        def replay(man, arr, submit_batch, run_auction, get_metrics,
+                   tag) -> dict:
+            gap = man.get("min_cancel_gap") or 512
+            bs = args.workload_batch or max(1, min(512, gap))
+            c0, g0 = get_metrics()
+            lat: list[float] = []
+            acc = rej = 0
+            reasons: dict[str, int] = {}
+            uncross_total = 0
+            t0 = time.perf_counter()
+            for ph in man["phases"]:
+                if ph["kind"] == "auction":
+                    r = run_auction(open_call=True)
+                    if not r.success:
+                        raise RuntimeError(
+                            f"open_call rejected: {r.error_message}")
+                for s0 in range(ph["start_record"], ph["end_record"], bs):
+                    n = min(bs, ph["end_record"] - s0)
+                    payload = oprec.slice_payload(arr, s0, n)
+                    tb = time.perf_counter()
+                    resp = submit_batch(payload)
+                    lat.append(time.perf_counter() - tb)
+                    if not resp.success:
+                        raise RuntimeError(
+                            f"batch rejected: {resp.error_message}")
+                    for i, ok in enumerate(resp.ok):
+                        if ok:
+                            acc += 1
+                        else:
+                            rej += 1
+                            reasons[resp.error[i]] = (
+                                reasons.get(resp.error[i], 0) + 1)
+                if ph["kind"] == "auction":
+                    r = run_auction(open_call=False)
+                    if not r.success:
+                        raise RuntimeError(
+                            f"uncross rejected: {r.error_message}")
+                    uncross_total += int(r.executed_quantity)
+            wall = time.perf_counter() - t0
+            c1, g1 = get_metrics()
+            # Steady-state batch percentiles: the first batches carry the
+            # one-time jit/trace warm costs of each dispatch shape (the
+            # persistent compile cache bounds them, but the first sight
+            # per process still traces) — excluded from p50/p99, with the
+            # burn-in count and the all-in wall published beside them
+            # (BENCH_METHOD §workload-replay).
+            burn = min(len(lat) - 1, max(3, len(lat) // 20))
+            steady = sorted(lat[burn:]) or [0.0]
+            mega = c1.get("megadispatch_steps", 0) - c0.get(
+                "megadispatch_steps", 0)
+            waves = c1.get("megadispatch_stacked_waves", 0) - c0.get(
+                "megadispatch_stacked_waves", 0)
+            row = {
+                "scenario": man["name"],
+                "path": tag,
+                "serve_shards": man.get("serve_shards", 1),
+                "ops": man["ops"],
+                "batch_records": bs,
+                "orders_per_s": round(man["ops"] / wall, 1),
+                "accepted": acc,
+                "rejected": rej,
+                "reject_rate": round(rej / max(1, man["ops"]), 4),
+                "reject_reasons": reasons,
+                "fills": c1.get("fills", 0) - c0.get("fills", 0),
+                "sim_fills": man["sim_fills"],
+                "auctions": c1.get("auctions", 0) - c0.get("auctions", 0),
+                "uncross_executed": uncross_total,
+                "wall_s": round(wall, 3),
+                "batch_p50_ms": round(
+                    steady[len(steady) // 2] * 1e3, 3),
+                "batch_p99_ms": round(
+                    steady[min(len(steady) - 1,
+                               int(len(steady) * 0.99))] * 1e3, 3),
+                "burn_in_batches": burn,
+                "mega_steps": mega,
+                "mega_waves_per_step": round(waves / mega, 2) if mega
+                else 0.0,
+            }
+            lanes = {k: round(v, 2) for k, v in g1.items()
+                     if k.startswith("lane")}
+            if lanes:
+                row["lane_gauges"] = lanes
+            if row["fills"] != man["sim_fills"]:
+                # The replay is expected bit-faithful (same per-symbol op
+                # order, same capacity): a fill-count drift is a finding,
+                # not noise — publish it loudly in the row.
+                row["fill_drift"] = row["fills"] - man["sim_fills"]
+            return row
+
+        def inproc_point(man, arr, path) -> dict:
+            from matching_engine_tpu.server.main import (
+                build_server,
+                shutdown,
+            )
+
+            wcfg = EngineConfig(
+                num_symbols=man["symbols"], capacity=man["capacity"],
+                batch=args.batch, max_fills=man["max_fills"],
+                kernel=args.kernel)
+            tmp = tempfile.mkdtemp(prefix="workload_inproc_")
+            kw = dict(window_ms=args.edge_window_ms, log=False,
+                      feed_depth=0,
+                      megadispatch_max_waves=args.edge_mega)
+            if man["serve_shards"] > 1:
+                kw["serve_shards"] = man["serve_shards"]
+            server, _port, parts = build_server(
+                "127.0.0.1:0", os.path.join(tmp, "w.db"), wcfg, **kw)
+            svc = parts["service"]
+            try:
+                def get_metrics():
+                    resp = svc.GetMetrics(pb2.MetricsRequest(), None)
+                    return dict(resp.counters), dict(resp.gauges)
+
+                return replay(
+                    man, arr,
+                    lambda payload: svc.SubmitOrderBatch(
+                        pb2.OrderBatchRequest(ops=payload), None),
+                    lambda open_call: svc.RunAuction(
+                        pb2.AuctionRequest(open_call=open_call), None),
+                    get_metrics, "inproc-host")
+            finally:
+                shutdown(server, parts)
+
+        def edge_point(man, arr, path) -> dict:
+            import subprocess
+            import re as _re
+
+            tmp = tempfile.mkdtemp(prefix="workload_edge_")
+            log_path = os.path.join(tmp, "server.log")
+            argv = [sys.executable, "-m",
+                    "matching_engine_tpu.server.main",
+                    "--addr", "127.0.0.1:0",
+                    "--db", os.path.join(tmp, "w.db"),
+                    "--symbols", str(man["symbols"]),
+                    "--capacity", str(man["capacity"]),
+                    "--batch", str(args.batch),
+                    "--window-ms", str(args.edge_window_ms),
+                    "--megadispatch-max-waves", str(args.edge_mega),
+                    "--feed-depth", "0"]
+            if man["serve_shards"] > 1:
+                argv += ["--serve-shards", str(man["serve_shards"])]
+            logf = open(log_path, "w")
+            proc = subprocess.Popen(
+                argv, stdout=logf, stderr=subprocess.STDOUT,
+                env=dict(os.environ, PYTHONUNBUFFERED="1"))
+            port = None
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"workload edge server died; see {log_path}")
+                mm = _re.search(r"listening on port (\d+)",
+                                open(log_path).read())
+                if mm:
+                    port = int(mm.group(1))
+                    break
+                time.sleep(0.25)
+            if port is None:
+                proc.kill()
+                raise RuntimeError("workload edge server never bound")
+            try:
+                stub = MatchingEngineStub(
+                    grpc.insecure_channel(f"127.0.0.1:{port}"))
+
+                def get_metrics():
+                    resp = stub.GetMetrics(pb2.MetricsRequest(),
+                                           timeout=30)
+                    return dict(resp.counters), dict(resp.gauges)
+
+                return replay(
+                    man, arr,
+                    lambda payload: stub.SubmitOrderBatch(
+                        pb2.OrderBatchRequest(ops=payload), timeout=120),
+                    lambda open_call: stub.RunAuction(
+                        pb2.AuctionRequest(open_call=open_call),
+                        timeout=120),
+                    get_metrics, "grpc-batch-edge")
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+
+        for f in files:
+            man = read_manifest(f)
+            arr = oprec.read_opfile(f)
+            assert len(arr) == man["ops"], (f, len(arr), man["ops"])
+            for path in paths:
+                point = inproc_point if path == "inproc" else edge_point
+                row = point(man, arr, path)
+                row["workload_file"] = f
+                rows.append(row)
+                print(f"[workload] {man['name']} {row['path']}: "
+                      f"{row['orders_per_s']} orders/s, rej "
+                      f"{row['rejected']} ({row['reject_rate']:.1%}), "
+                      f"fills {row['fills']}/{row['sim_fills']}, p99 "
+                      f"{row['batch_p99_ms']}ms, megaM "
+                      f"{row['mega_waves_per_step']}", file=sys.stderr)
+        return rows
+
     grid_cap = args.symbols * args.batch
     mega_list = [int(x) for x in args.megadispatch.split(",")
                  if x.strip()] if args.megadispatch else []
     shard_list = [int(k) for k in args.serve_shards.split(",")
                   if k.strip()] if args.serve_shards else []
-    if args.edge_batch:
+    if args.workload:
+        rows = workload_sweep()
+    elif args.edge_batch:
         rows = edge_sweep()
     elif args.audit_ab:
         import sys as _sys
@@ -1102,7 +1356,8 @@ def main() -> None:
     except Exception:  # noqa: BLE001
         rev = "unknown"
     out = {
-        "metric": ("batch_edge_audit_ab" if args.edge_batch
+        "metric": ("workload_replay" if args.workload
+                   else "batch_edge_audit_ab" if args.edge_batch
                    and args.audit_ab
                    else "batch_edge_throughput" if args.edge_batch
                    else "auditor_overhead_ab" if args.audit_ab
@@ -1120,6 +1375,11 @@ def main() -> None:
         "git_rev": rev,
     }
     if args.edge_batch:
+        out["edge_mega"] = args.edge_mega
+        out["edge_window_ms"] = args.edge_window_ms
+    if args.workload:
+        out["workloads"] = [f.strip() for f in args.workload.split(",")
+                            if f.strip()]
         out["edge_mega"] = args.edge_mega
         out["edge_window_ms"] = args.edge_window_ms
     tmp = args.json_out + ".tmp"

@@ -54,9 +54,12 @@ from matching_engine_tpu.analysis.lockorder import CallSite, Graph
 
 # The replay-bearing packages: both serving paths' decode/publish
 # layers, the feed, the audit stream, durable storage, the record
-# codecs, the engine harness, and checkpointing.
+# codecs, the engine harness, checkpointing, and the scenario-workload
+# recorder (sim/record.py — a recorded opfile is a replay artifact whose
+# bytes must be a pure function of (config, scenario, seed)).
 REPLAY_SCAN_DIRS = ("server", "feed", "audit", "storage", "domain",
-                    "engine", "replication", "utils/checkpoint.py")
+                    "engine", "replication", "sim",
+                    "utils/checkpoint.py")
 
 # Rule 2 — sources with no legitimate replay-path use (reachability).
 _FORBIDDEN_HEADS = ("random.", "np.random.", "numpy.random.", "uuid.",
@@ -80,6 +83,9 @@ _STAMP_ATTRS = frozenset({"seq", "feed_epoch", "next_seq"})
 _CKPT_WRITERS = frozenset({"savez", "savez_compressed", "dump",
                            "_atomic_checkpoint_write"})
 _SQL_WRITERS = frozenset({"execute", "executemany", "executescript"})
+# Recorded workload artifacts (domain/oprec.write_opfile): every byte of
+# an opfile is replay payload — the sim recorder's determinism contract.
+_OPFILE_WRITERS = frozenset({"write_opfile"})
 
 
 def _shallow_walk(node):
@@ -157,6 +163,8 @@ class _Sinks:
             if "checkpoint" in self.f.module:
                 return f"{name}()"
         if self.in_storage and name in _SQL_WRITERS:
+            return f"{name}()"
+        if name in _OPFILE_WRITERS:
             return f"{name}()"
         return None
 

@@ -38,7 +38,10 @@ USAGE = (
     "                 [--no-gap-fill] [--max-events N] [--idle-exit SECS]\n"
     "                 [--capture FILE] [--summary-json FILE] [--quiet]\n"
     "   or: client metrics <addr>\n"
-    "   or: client auction <addr> [symbol]\n"
+    "   or: client auction <addr> [symbol | --open]\n"
+    "   or: client simulate --scenario NAME --out FILE [--steps N]\n"
+    "                 [--seed N] [--symbols N] [--serve-shards K]\n"
+    "                 [--summary-json FILE]\n"
     "   or: client promote <addr>"
 )
 
@@ -97,6 +100,17 @@ def _book(addr: str, symbol: str) -> int:
 
 
 def _auction(addr: str, symbol: str) -> int:
+    if symbol == "--open":
+        # (Re)open the venue-wide call period without uncrossing — the
+        # workload replay driver's phase hook (sim/scenarios.py).
+        resp = _stub(addr).RunAuction(
+            pb2.AuctionRequest(open_call=True), timeout=60)
+        if not resp.success:
+            print(f"[client] auction open rejected: {resp.error_message}")
+            return 3
+        print("[client] auction call period OPEN (submits rest until the "
+              "next all-symbols auction)")
+        return 0
     resp = _stub(addr).RunAuction(pb2.AuctionRequest(symbol=symbol),
                                   timeout=60)
     if not resp.success:
@@ -561,6 +575,96 @@ def _submit_batch(argv: list[str]) -> int:
     return 0 if accepted > 0 or total == 0 else 3
 
 
+def _simulate(argv: list[str]) -> int:
+    """Record a named scenario to a workload opfile WITHOUT any server or
+    bench harness: run the on-device agent market (sim/scenarios.py),
+    decode the generated flow into oprec records (sim/record.py), and
+    write `--out` plus its manifest. The artifact replays through
+    `client submit-batch`, `runner_bench --workload`, the soak's
+    flash-crash round, and CI's smoke — all through the same codec
+    reader. Exit 1 on usage, 3 on a scenario that produced no ops."""
+    import json
+
+    scenario_name = out = summary_json = None
+    steps = seed = None
+    symbols, serve_shards = 16, 1
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--scenario":
+                scenario_name = next(it)
+            elif a == "--out":
+                out = next(it)
+            elif a == "--steps":
+                steps = int(next(it))
+            elif a == "--seed":
+                seed = int(next(it))
+            elif a == "--symbols":
+                symbols = int(next(it))
+            elif a == "--serve-shards":
+                serve_shards = int(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except (StopIteration, ValueError):
+        print(USAGE, file=sys.stderr)
+        return 1
+    if not scenario_name or not out or symbols < 1 or serve_shards < 1:
+        print(USAGE, file=sys.stderr)
+        return 1
+
+    # Heavy imports gated behind the verb: the other subcommands must not
+    # pay jax startup.
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.sim.agents import AgentMix
+    from matching_engine_tpu.sim.record import record_scenario
+    from matching_engine_tpu.sim.scenarios import make_scenario
+    from matching_engine_tpu.utils.metrics import Metrics
+
+    try:
+        scenario = make_scenario(scenario_name, steps=steps)
+    except ValueError as e:
+        print(f"[client] {e}", file=sys.stderr)
+        return 1
+    mix = AgentMix()
+    cfg = EngineConfig(num_symbols=symbols, capacity=128,
+                       batch=mix.batch_for(), max_fills=1 << 15)
+    metrics = Metrics()
+    try:
+        manifest = record_scenario(cfg, mix, scenario, seed=seed or 0,
+                                   out_path=out, serve_shards=serve_shards,
+                                   metrics=metrics)
+    except (RuntimeError, OSError) as e:
+        # Scenario too big for the fixed recording config (uncross fill-
+        # log overflow), recorder/codec skew, or an unwritable --out: the
+        # verb's contract is a reason + exit 3, never a traceback.
+        print(f"[client] simulate failed: {e}", file=sys.stderr)
+        return 3
+    summary = {
+        "scenario": manifest["name"], "seed": manifest["seed"],
+        "ops": manifest["ops"], "steps": manifest["steps"],
+        "symbols": manifest["symbols"],
+        "per_class_ops": manifest["per_class_ops"],
+        "phases": [{k: p[k] for k in ("kind", "steps", "start_record",
+                                      "end_record")}
+                   for p in manifest["phases"]],
+        "min_cancel_gap": manifest["min_cancel_gap"],
+        "sim_fills": manifest["sim_fills"],
+        "sim_volume": manifest["sim_volume"],
+        "out": out,
+    }
+    print(f"[client] simulate {manifest['name']}: {manifest['ops']} ops "
+          f"over {manifest['steps']} steps x {manifest['symbols']} symbols "
+          f"-> {out}", file=sys.stderr, flush=True)
+    print(json.dumps(summary))
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if manifest["ops"] > 0 else 3
+
+
 def _promote(addr: str) -> int:
     """Failover verb: flip the --standby replica at `addr` into the
     serving primary (replication/standby.py promote — feed-epoch bump,
@@ -619,6 +723,8 @@ def _dispatch(argv: list[str]) -> int:
             return _subscribe(argv[1:])
         if len(argv) >= 3 and argv[0] == "submit-batch":
             return _submit_batch(argv[1:])
+        if len(argv) >= 3 and argv[0] == "simulate":
+            return _simulate(argv[1:])
         if len(argv) >= 2 and argv[0] == "audit":
             return _audit(argv[1:])
         if len(argv) == 8:

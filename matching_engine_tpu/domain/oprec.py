@@ -269,16 +269,42 @@ def record_fields(r) -> tuple:
 #
 # A recorded flow is just a payload on disk: the CLI's submit-batch verb,
 # the soak's codec-replay round, and the benches all read the same file
-# through read_opfile and re-slice it into request payloads.
+# through read_opfile and re-slice it into request payloads. Files may be
+# gzip-compressed (records are sparse fixed boxes, ~50-100x): a ".gz"
+# path writes compressed, and read_opfile sniffs the gzip magic so every
+# consumer reads either form transparently. Compressed writes pin
+# mtime=0 — a workload artifact's bytes must be a pure function of its
+# records (the determinism contract tests/test_scenarios.py byte-compares
+# on), never of the recording wall clock.
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
 
 def write_opfile(path: str, arr: np.ndarray) -> None:
+    payload = encode_payload(arr)
+    if path.endswith(".gz"):
+        import gzip
+
+        with open(path, "wb") as raw:
+            # filename="" + mtime=0: the container must not embed the
+            # output path or the recording wall clock — artifact bytes
+            # are a pure function of the records.
+            with gzip.GzipFile(filename="", fileobj=raw, mode="wb",
+                               mtime=0) as f:
+                f.write(payload)
+        return
     with open(path, "wb") as f:
-        f.write(encode_payload(arr))
+        f.write(payload)
 
 
 def read_opfile(path: str) -> np.ndarray:
     with open(path, "rb") as f:
-        return decode_payload(f.read())
+        data = f.read()
+    if data[:2] == _GZIP_MAGIC:
+        import gzip
+
+        data = gzip.decompress(data)
+    return decode_payload(data)
 
 
 def slice_payload(arr: np.ndarray, start: int, count: int) -> bytes:

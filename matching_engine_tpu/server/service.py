@@ -1137,6 +1137,27 @@ class MatchingEngineService(MatchingEngineServicer):
                               "op log: running it would silently diverge "
                               "every standby — drop --oplog-ship to run "
                               "auctions")
+        if getattr(request, "open_call", False):
+            # Scenario/workload replay hook: (re)open the venue-wide call
+            # period without uncrossing — submits rest unmatched until a
+            # later all-symbols RunAuction clears them. Mirrors
+            # --auction-open's boot-time flip, now reachable mid-session
+            # so recorded auction-day flow (open -> continuous -> halt ->
+            # reopen -> close) replays through a live server.
+            if symbol is not None:
+                return pb2.AuctionResponse(
+                    success=False,
+                    error_message="a call period is venue-wide: open_call "
+                                  "requires an empty symbol")
+            target = self.shards if self.shards is not None else self.runner
+            try:
+                target.set_auction_mode(True)
+            except ValueError as e:  # venue-depth capacity: no call periods
+                return pb2.AuctionResponse(success=False,
+                                           error_message=str(e))
+            target.flush_auction_mode()
+            self._log("auction call period OPEN (RunAuction open_call)")
+            return pb2.AuctionResponse(success=True)
         if self.shards is not None:
             # Partitioned serving: one symbol touches only its owning
             # lane; the all-symbols close fans out across every lane and

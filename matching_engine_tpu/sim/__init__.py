@@ -8,4 +8,25 @@ from matching_engine_tpu.sim.market_sim import (
 )
 
 __all__ = ["SimConfig", "SimState", "init_sim", "run_sim", "run_sim_sharded",
-           "sim_step_impl"]
+           "sim_step_impl", "AgentMix", "Scenario", "Phase", "make_scenario",
+           "run_scenario", "record_scenario"]
+
+
+def __getattr__(name):
+    # The scenario subsystem imports lazily: sim/__init__ is imported by
+    # light-weight consumers (the CLI) that must not pay the agents/
+    # scenarios module graph unless a scenario is actually used.
+    if name in ("AgentMix", "init_agents", "agent_orders", "column_roles"):
+        from matching_engine_tpu.sim import agents
+
+        return getattr(agents, name)
+    if name in ("Scenario", "Phase", "make_scenario", "run_scenario",
+                "SCENARIO_NAMES", "zipf_weights_q15"):
+        from matching_engine_tpu.sim import scenarios
+
+        return getattr(scenarios, name)
+    if name in ("record_scenario", "read_manifest", "manifest_path_for"):
+        from matching_engine_tpu.sim import record
+
+        return getattr(record, name)
+    raise AttributeError(name)

@@ -1,0 +1,326 @@
+"""Heterogeneous on-device agent populations (ROADMAP Open item 4).
+
+market_sim.py drives one agent type (market makers). Real venues face
+*mixed* flow — passive quoting, trend-chasing, heavy-tailed retail noise,
+aggressive liquidity taking — whose correlations produce the stress
+shapes uniform fuzz never does (JAX-LOB, arXiv:2308.13289, runs exactly
+such populations vmapped on device; CoinTossX, arXiv:2102.10925,
+catalogues the resulting scenarios). This module generalizes the sim to
+four agent classes, all generated *inside the same jit'd scan* as the
+match kernel, all int32, all `jax.random`-keyed per symbol — one seed
+reproduces the whole market bit-for-bit, and the generated flow replays
+through the host oracle (tests/test_scenarios.py).
+
+Per step and symbol the batch layout is STATIC (shape-stable under jit):
+
+    [mm cancel bid]*K [mm cancel ask]*K [mm bid]*K [mm ask]*K
+    [momentum]*Mo [noise]*Nz [taker]*Tk          (B = 4K+Mo+Nz+Tk)
+
+- **Market makers** (class 0): the market_sim design — K agents refreshed
+  round-robin per step cancel their old quotes and re-quote around the
+  fair-value random walk.
+- **Momentum / trend followers** (class 1): react to the TOP-OF-BOOK
+  return. An integer EMA of mid-price changes (`mom_sig`) accumulates per
+  symbol; when it exceeds a threshold, momentum lanes fire MARKET orders
+  *in the direction of the move*, sized by signal strength — the
+  amplification loop that turns an injected shock into a cascade
+  (scenarios.flash_crash).
+- **Noise traders** (class 2): random-side LIMIT orders priced around
+  fair value with HEAVY-TAILED sizes — an integer Pareto draw
+  (`qty ~ scale // uniform`, P(q >= x) ~ 1/x) clipped to a cap, so a
+  small fraction of orders are book-sweeping blocks.
+- **Aggressive takers** (class 3): probabilistic MARKET orders; under a
+  scenario's sell-bias window (the shock) they all hit bids at double
+  size.
+
+Per-symbol gating (Zipf hot-symbol skew, burst on/off, halts) suppresses
+whole symbols via kernel.apply_halt_mask — gated symbols advance no agent
+state, so their quotes simply stand.
+
+Everything here must stay pure under trace: the jit-purity analyzer
+walks this module as part of the sim jit roots' closure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import EngineConfig, OrderBatch
+from matching_engine_tpu.engine.kernel import (
+    OP_CANCEL,
+    OP_SUBMIT,
+    apply_halt_mask,
+)
+from matching_engine_tpu.proto import BUY, LIMIT, MARKET, SELL
+
+I32 = jnp.int32
+
+# Agent-class ids, positional in the batch layout (column_roles). The
+# recorder derives per-op client identities from these + the static
+# layout, so the opfile knows which class produced every record.
+CLASS_MM, CLASS_MOMENTUM, CLASS_NOISE, CLASS_TAKER = 0, 1, 2, 3
+CLASS_TAGS = ("mm", "mom", "nz", "tk")
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentMix:
+    """Static population configuration (hashable; jit-static). Counts are
+    LANES per symbol per step; the market-maker population additionally
+    has `mm_agents` resting identities refreshed `mm_refresh` at a time
+    (round-robin, the market_sim contract)."""
+
+    mm_agents: int = 64
+    mm_refresh: int = 4
+    momentum: int = 2          # momentum lanes per symbol per step
+    noise: int = 4             # noise-trader lanes
+    takers: int = 2            # aggressive-taker lanes
+    half_spread: int = 5       # Q4 ticks each side of fair value
+    spread_jitter: int = 8     # extra per-quote price noise in [0, jitter)
+    qty_max: int = 100         # mm quote size in [1, qty_max]
+    fair_vol: int = 3          # fair-value random-walk step in [-vol, vol]
+    fair_init: int = 10_000
+    fair_min: int = 100
+    fair_max: int = 1 << 24
+    noise_scale: int = 1 << 11  # Pareto numerator: qty ~ scale // u
+    noise_qty_cap: int = 500    # heavy-tail clamp (<< MAX_QUANTITY)
+    noise_p: int = 70           # percent chance a noise lane fires
+    mom_threshold: int = 4      # |mid-return EMA| (Q4) before momentum acts
+    mom_p: int = 60             # percent chance an eligible momentum lane fires
+    mom_qty: int = 25           # momentum base size (scaled by signal)
+    taker_p: int = 35           # percent chance a taker lane fires
+    taker_qty: int = 40
+
+    def batch_for(self) -> int:
+        return 4 * self.mm_refresh + self.momentum + self.noise + self.takers
+
+    def __post_init__(self):
+        assert 0 < self.mm_refresh <= self.mm_agents
+        assert self.half_spread >= 1, "quotes must not self-cross"
+        assert self.mom_threshold >= 1 and self.noise_scale >= 2
+
+
+class AgentState(NamedTuple):
+    """Device-resident state for the whole population. Shapes [S]/[S, A].
+
+    PRNG keys are PER SYMBOL (market_sim's SPMD contract: every symbol is
+    an independent stochastic process). `prev_mid`/`mom_sig` carry the
+    top-of-book memory the momentum class trades on — updated from the
+    engine step's own output inside the scan (observe_market), so the
+    trend loop is fully closed on device."""
+
+    keys: jax.Array        # [S, 2]
+    step: jax.Array        # scalar int32 global step
+    fair: jax.Array        # [S] fair-value random walk (Q4)
+    mm_bid_oid: jax.Array  # [S, A]
+    mm_ask_oid: jax.Array  # [S, A]
+    next_oid: jax.Array    # [S] per-symbol oid counter
+    prev_mid: jax.Array    # [S] last step's TOB mid (0 = none yet)
+    mom_sig: jax.Array     # [S] integer EMA of mid returns
+
+
+def init_agents(cfg: EngineConfig, mix: AgentMix, seed: int = 0) -> AgentState:
+    s, a = cfg.num_symbols, mix.mm_agents
+    base = jax.random.PRNGKey(seed)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(s))
+    return AgentState(
+        keys=keys,
+        step=jnp.zeros((), I32),
+        fair=jnp.full((s,), mix.fair_init, I32),
+        mm_bid_oid=jnp.zeros((s, a), I32),
+        mm_ask_oid=jnp.zeros((s, a), I32),
+        next_oid=jnp.ones((s,), I32),
+        prev_mid=jnp.zeros((s,), I32),
+        mom_sig=jnp.zeros((s,), I32),
+    )
+
+
+def column_roles(mix: AgentMix) -> list[tuple[int, str, int]]:
+    """Static batch-column layout: per column (class_id, role, lane).
+    role in {"cancel_bid", "cancel_ask", "bid", "ask", "flow"}. The
+    recorder (sim/record.py) uses this to attribute every generated op to
+    its agent class/lane without any extra device lanes."""
+    k = mix.mm_refresh
+    out: list[tuple[int, str, int]] = []
+    out += [(CLASS_MM, "cancel_bid", j) for j in range(k)]
+    out += [(CLASS_MM, "cancel_ask", j) for j in range(k)]
+    out += [(CLASS_MM, "bid", j) for j in range(k)]
+    out += [(CLASS_MM, "ask", j) for j in range(k)]
+    out += [(CLASS_MOMENTUM, "flow", j) for j in range(mix.momentum)]
+    out += [(CLASS_NOISE, "flow", j) for j in range(mix.noise)]
+    out += [(CLASS_TAKER, "flow", j) for j in range(mix.takers)]
+    return out
+
+
+def mm_agent_index(mix: AgentMix, step: int, lane: int) -> int:
+    """The resting-identity index a market-maker column refreshes at a
+    given global step — the round-robin formula the device uses, exposed
+    for the recorder's client-id attribution."""
+    return (step * mix.mm_refresh + lane) % mix.mm_agents
+
+
+def agent_orders(
+    cfg: EngineConfig,
+    mix: AgentMix,
+    state: AgentState,
+    zipf_w: jax.Array,
+    *,
+    call_mode: bool,
+    halt: bool,
+    burst_on,
+    shock,
+    sell_bias,
+):
+    """One step of population decisions -> (new_state, OrderBatch).
+
+    Static flags: `call_mode` (auction call period: LIMIT flow rests via
+    the serving layer's OP_REST mapping — here we keep OP_SUBMIT and let
+    the caller map it, see scenarios._phase_step — and market-type
+    classes are gated off), `halt` (every symbol suppressed). Traced
+    scalars: `burst_on` (bool — off-period suppresses all symbols),
+    `shock` (int32 — per-step fair-value decrement while a scenario shock
+    is active), `sell_bias` (bool — takers all SELL at double size).
+    `zipf_w` is the [S] per-symbol activity weight in Q15 (32768 = always
+    active)."""
+    s = cfg.num_symbols
+    k, mo, nz, tk = mix.mm_refresh, mix.momentum, mix.noise, mix.takers
+
+    subs = jax.vmap(lambda kk: jax.random.split(kk, 13))(state.keys)
+    keys = subs[:, 0]
+
+    def draw(col, fn):
+        return jax.vmap(fn)(subs[:, col])
+
+    # Fair-value random walk, minus the scenario shock while active.
+    fair = jnp.clip(
+        state.fair
+        + draw(1, lambda kk: jax.random.randint(
+            kk, (), -mix.fair_vol, mix.fair_vol + 1, I32))
+        - shock,
+        mix.fair_min, mix.fair_max,
+    )
+
+    # Per-symbol activity gate: Zipf weight x burst window x halt.
+    gate_draw = draw(2, lambda kk: jax.random.randint(kk, (), 0, 1 << 15, I32))
+    active = (gate_draw < zipf_w) & burst_on
+    if halt:
+        active = jnp.zeros_like(active)
+
+    # ---- market makers (market_sim's round-robin refresh) ----------------
+    idx = (state.step * k + jnp.arange(k, dtype=I32)) % mix.mm_agents
+    old_bid = state.mm_bid_oid[:, idx]
+    old_ask = state.mm_ask_oid[:, idx]
+    jb = draw(3, lambda kk: jax.random.randint(kk, (k,), 0, mix.spread_jitter, I32))
+    ja = draw(4, lambda kk: jax.random.randint(kk, (k,), 0, mix.spread_jitter, I32))
+    bid_px = jnp.maximum(fair[:, None] - mix.half_spread - jb, 1)
+    ask_px = fair[:, None] + mix.half_spread + ja
+    mm_qty = draw(5, lambda kk: jax.random.randint(kk, (2 * k,), 1,
+                                                   mix.qty_max + 1, I32))
+
+    base = state.next_oid[:, None]
+    bid_oid = base + jnp.arange(k, dtype=I32)[None, :]
+    ask_oid = base + k + jnp.arange(k, dtype=I32)[None, :]
+    mom_oid = base + 2 * k + jnp.arange(mo, dtype=I32)[None, :]
+    nz_oid = base + 2 * k + mo + jnp.arange(nz, dtype=I32)[None, :]
+    tk_oid = base + 2 * k + mo + nz + jnp.arange(tk, dtype=I32)[None, :]
+
+    # ---- momentum: trade the TOB-return signal ---------------------------
+    sig = state.mom_sig
+    amp = jnp.clip(jnp.abs(sig) // mix.mom_threshold, 1, 4)
+    mom_pct = draw(6, lambda kk: jax.random.randint(kk, (mo,), 0, 100, I32))
+    mom_fire = (jnp.abs(sig)[:, None] >= mix.mom_threshold) & (
+        mom_pct < mix.mom_p)
+    mom_side = jnp.broadcast_to(jnp.where(sig[:, None] < 0, SELL, BUY),
+                                (s, mo)).astype(I32)
+    mom_qty = jnp.broadcast_to((mix.mom_qty * amp)[:, None], (s, mo))
+
+    # ---- noise: heavy-tailed sizes around fair ---------------------------
+    nz_pct = draw(7, lambda kk: jax.random.randint(kk, (nz,), 0, 100, I32))
+    nz_fire = nz_pct < mix.noise_p
+    nz_side = draw(8, lambda kk: jax.random.randint(kk, (nz,), 0, 2, I32)) + BUY
+    span = 3 * mix.half_spread
+    nz_off = draw(9, lambda kk: jax.random.randint(kk, (nz,), -span,
+                                                   span + 1, I32))
+    # Price on the order's own side of fair plus jitter: mostly passive,
+    # occasionally crossing (the jitter can step through the spread).
+    nz_px = jnp.maximum(
+        fair[:, None] + jnp.where(nz_side == BUY, -1, 1) * mix.half_spread
+        + nz_off, 1)
+    # Integer Pareto: u ~ U[1, scale), qty = clip(scale // u, 1, cap)
+    # gives P(qty >= q) ~ 1/q — a genuine heavy tail in pure int32.
+    nz_u = draw(10, lambda kk: jax.random.randint(kk, (nz,), 1,
+                                                  mix.noise_scale, I32))
+    nz_qty = jnp.clip(mix.noise_scale // nz_u, 1, mix.noise_qty_cap)
+
+    # ---- takers: aggressive MARKET flow ----------------------------------
+    tk_pct = draw(11, lambda kk: jax.random.randint(kk, (tk,), 0, 100, I32))
+    tk_fire = (tk_pct < mix.taker_p) | sell_bias
+    tk_rand_side = draw(12, lambda kk: jax.random.randint(kk, (tk,), 0, 2,
+                                                          I32)) + BUY
+    tk_side = jnp.where(sell_bias, SELL, tk_rand_side)
+    tk_qty = jnp.broadcast_to(
+        jnp.where(sell_bias, 2 * mix.taker_qty, mix.taker_qty).astype(I32),
+        (s, tk))
+
+    def seg(op, side, otype, price, q, oid):
+        # owner 0: sim agents opt out of device self-trade prevention
+        # (the recorder assigns per-agent client ids instead, so server
+        # replay can never STP-diverge either — sim/record.py).
+        return (op, side, otype, price, q, oid, jnp.zeros_like(op))
+
+    zeros_k = jnp.zeros((s, k), I32)
+    market_gate = not call_mode  # market-type classes are off in a call
+    segs = [
+        seg(jnp.where(old_bid > 0, OP_CANCEL, 0), jnp.full((s, k), BUY, I32),
+            zeros_k, zeros_k, zeros_k, old_bid),
+        seg(jnp.where(old_ask > 0, OP_CANCEL, 0), jnp.full((s, k), SELL, I32),
+            zeros_k, zeros_k, zeros_k, old_ask),
+        seg(jnp.full((s, k), OP_SUBMIT, I32), jnp.full((s, k), BUY, I32),
+            jnp.full((s, k), LIMIT, I32), bid_px, mm_qty[:, :k], bid_oid),
+        seg(jnp.full((s, k), OP_SUBMIT, I32), jnp.full((s, k), SELL, I32),
+            jnp.full((s, k), LIMIT, I32), ask_px, mm_qty[:, k:], ask_oid),
+        seg(jnp.where(mom_fire & market_gate, OP_SUBMIT, 0), mom_side,
+            jnp.full((s, mo), MARKET, I32), jnp.zeros((s, mo), I32),
+            mom_qty, mom_oid),
+        seg(jnp.where(nz_fire, OP_SUBMIT, 0), nz_side,
+            jnp.full((s, nz), LIMIT, I32), nz_px, nz_qty, nz_oid),
+        seg(jnp.where(tk_fire & market_gate, OP_SUBMIT, 0), tk_side,
+            jnp.full((s, tk), MARKET, I32), jnp.zeros((s, tk), I32),
+            tk_qty, tk_oid),
+    ]
+    orders = OrderBatch(*(jnp.concatenate(parts, axis=1)
+                          for parts in zip(*segs)))
+    # Gated symbols emit nothing this step (the engine halt hook).
+    orders = apply_halt_mask(orders, ~active)
+
+    adv = jnp.where(active, 1, 0).astype(I32)
+    new_state = AgentState(
+        keys=keys,
+        step=state.step + 1,
+        fair=jnp.where(active, fair, state.fair),
+        mm_bid_oid=state.mm_bid_oid.at[:, idx].set(
+            jnp.where(active[:, None], bid_oid, old_bid)),
+        mm_ask_oid=state.mm_ask_oid.at[:, idx].set(
+            jnp.where(active[:, None], ask_oid, old_ask)),
+        next_oid=state.next_oid + adv * (2 * k + mo + nz + tk),
+        prev_mid=state.prev_mid,   # updated post-match (observe_market)
+        mom_sig=state.mom_sig,
+    )
+    return new_state, orders
+
+
+def observe_market(mix: AgentMix, state: AgentState, best_bid, best_ask
+                   ) -> AgentState:
+    """Close the trend loop: fold the engine step's post-match top of book
+    into the momentum signal. `mom_sig` is a decaying integer EMA of mid
+    returns (half-decay per step plus the fresh return), clamped so one
+    wild print cannot saturate the signal forever."""
+    both = (best_bid > 0) & (best_ask > 0)
+    mid = jnp.where(both, (best_bid + best_ask) // 2, state.fair)
+    ret = jnp.where(state.prev_mid > 0, mid - state.prev_mid, 0)
+    lim = 16 * mix.mom_threshold
+    sig = jnp.clip(state.mom_sig - state.mom_sig // 2 + ret, -lim, lim)
+    return state._replace(prev_mid=mid, mom_sig=sig.astype(I32))

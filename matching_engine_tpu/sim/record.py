@@ -1,0 +1,229 @@
+"""Decode generated device flow into replayable oprec workload opfiles.
+
+The bridge between the on-device agent market (sim/agents.py +
+sim/scenarios.py) and the serving stack: a recorded scenario becomes a
+flat binary op-record file (domain/oprec.py — the PR 7 MAGIC framing)
+plus a JSON manifest, landing under benchmarks/workloads/ as a
+versioned, language-neutral workload artifact. `client submit-batch`,
+`runner_bench --workload`, `latency_bench --workload`, the soak's
+flash-crash round, and CI's smoke all replay the SAME file through the
+SAME codec reader.
+
+The one non-trivial mapping is order-id renumbering. The sim assigns
+per-symbol int32 oids; the server assigns its own global "OID-<n>"
+sequence at admission (strided per lane under --serve-shards). Because a
+fresh server assigns ids deterministically in record order (the
+tests/test_batch_edge.py `_script` contract), the recorder can PREDICT
+every submit's server id — lane = the shard router's crc32 symbol home,
+id = lane + 1 + n_lane * K for the lane's n-th recorded submit — and
+rewrite every cancel's target to the id the server will actually assign.
+Cancels also carry the owning agent's client id (the server enforces
+client/order ownership). Replay therefore must be IN ORDER on one
+connection, with the batch size below the manifest's `min_cancel_gap`
+(intra-batch targets resolve against the pre-batch directory; the gap
+for market-maker flow is many steps of records, so the default 512 is
+far inside it).
+
+Every byte of the opfile is a pure function of (config, mix, scenario,
+seed): the determinism-taint analyzer walks this module as part of the
+replay closure (write_opfile is a declared replay sink), and
+tests/test_scenarios.py byte-compares two recordings of one seed.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_REST, OP_SUBMIT
+from matching_engine_tpu.parallel.multihost import symbol_home
+from matching_engine_tpu.sim.agents import (
+    CLASS_MM,
+    CLASS_TAGS,
+    AgentMix,
+    column_roles,
+    mm_agent_index,
+)
+from matching_engine_tpu.sim.scenarios import Scenario, run_scenario
+
+MANIFEST_FORMAT = 1
+
+
+def manifest_path_for(opfile_path: str) -> str:
+    """<name>.opfile[.gz] -> <name>.manifest.json (same directory)."""
+    base = opfile_path
+    if base.endswith(".gz"):
+        base = base[:-3]
+    if base.endswith(".opfile"):
+        base = base[:-len(".opfile")]
+    return base + ".manifest.json"
+
+
+def _client_id(cls: int, role: str, lane: int, sym: int, step: int,
+               mix: AgentMix) -> str:
+    """Per-op client identity. Market makers keep a STABLE id per resting
+    identity (cancels must present the submitting client); the
+    taker-style classes get a step-unique id so server-side self-trade
+    prevention can never fire between a client's own orders — the device
+    sim runs owner=0 (STP opted out), and replay must not diverge."""
+    tag = CLASS_TAGS[cls]
+    if cls == CLASS_MM:
+        return f"{tag}{sym}-{mm_agent_index(mix, step, lane)}"
+    return f"{tag}{sym}-{lane}-{step}"
+
+
+def record_scenario(
+    cfg: EngineConfig,
+    mix: AgentMix,
+    scenario: Scenario,
+    seed: int,
+    out_path: str,
+    serve_shards: int = 1,
+    metrics=None,
+    symbol_prefix: str = "S",
+) -> dict:
+    """Run + record one scenario; write the opfile and its manifest.
+
+    Returns the manifest dict (phases with record ranges, per-class and
+    per-symbol op counts, the sim's own fill/volume ground truth, and
+    the replay constraints)."""
+    book, state, phases = run_scenario(cfg, mix, scenario, seed=seed,
+                                       collect_orders=True)
+    roles = column_roles(mix)
+    symbols = [f"{symbol_prefix}{s}" for s in range(cfg.num_symbols)]
+    lanes = ([symbol_home(sym, serve_shards) for sym in symbols]
+             if serve_shards > 1 else [0] * cfg.num_symbols)
+
+    records: list[tuple] = []
+    # (sym, sim_oid) -> (server "OID-<n>", client_id, record index)
+    oid_map: dict[tuple[int, int], tuple[str, str, int]] = {}
+    lane_counts = [0] * max(1, serve_shards)
+    per_class = {tag: {"submits": 0, "cancels": 0} for tag in CLASS_TAGS}
+    per_symbol = [0] * cfg.num_symbols
+    skipped_cancels = 0
+    min_cancel_gap = None
+
+    manifest_phases = []
+    step0 = 0
+    for pr in phases:
+        start_rec = len(records)
+        op = np.asarray(pr.orders.op)
+        side = np.asarray(pr.orders.side)
+        otype = np.asarray(pr.orders.otype)
+        price = np.asarray(pr.orders.price)
+        qty = np.asarray(pr.orders.qty)
+        oid = np.asarray(pr.orders.oid)
+        t_steps, s_syms, b_cols = op.shape
+        for t in range(t_steps):
+            g_step = step0 + t
+            for s in range(s_syms):
+                row_op = op[t, s]
+                if not row_op.any():
+                    continue
+                for b in range(b_cols):
+                    o = int(row_op[b])
+                    if o == 0:
+                        continue
+                    cls, role, lane_idx = roles[b]
+                    if o in (OP_SUBMIT, OP_REST):
+                        lane = lanes[s]
+                        n = lane_counts[lane]
+                        lane_counts[lane] += 1
+                        srv_oid = (f"OID-{lane + 1 + n * serve_shards}"
+                                   if serve_shards > 1 else f"OID-{n + 1}")
+                        cid = _client_id(cls, role, lane_idx, s, g_step, mix)
+                        oid_map[(s, int(oid[t, s, b]))] = (
+                            srv_oid, cid, len(records))
+                        records.append((
+                            oprec.OPREC_SUBMIT, int(side[t, s, b]),
+                            int(otype[t, s, b]), int(price[t, s, b]),
+                            int(qty[t, s, b]), symbols[s], cid, ""))
+                        per_class[CLASS_TAGS[cls]]["submits"] += 1
+                        per_symbol[s] += 1
+                    elif o == OP_CANCEL:
+                        hit = oid_map.get((s, int(oid[t, s, b])))
+                        if hit is None:
+                            # A cancel of flow that was never recorded
+                            # (cannot happen for the shipped mixes; kept
+                            # as a counted guard, never silent).
+                            skipped_cancels += 1
+                            continue
+                        srv_oid, cid, born_at = hit
+                        gap = len(records) - born_at
+                        if min_cancel_gap is None or gap < min_cancel_gap:
+                            min_cancel_gap = gap
+                        records.append((
+                            oprec.OPREC_CANCEL, 0, 0, 0, 0, "", cid,
+                            srv_oid))
+                        per_class[CLASS_TAGS[cls]]["cancels"] += 1
+                        per_symbol[s] += 1
+        manifest_phases.append({
+            "kind": pr.phase.kind,
+            "steps": pr.phase.steps,
+            "start_record": start_rec,
+            "end_record": len(records),
+            "uncross": pr.phase.kind == "auction",
+            "uncross_executed": (int(np.sum(pr.uncross.executed))
+                                 if pr.uncross is not None else 0),
+        })
+        step0 += pr.phase.steps
+
+    arr = oprec.pack_records(records)
+    flaws = [m for m in oprec.record_flaws(arr) if m is not None]
+    if flaws:
+        raise RuntimeError(
+            f"recorded flow failed edge validation ({len(flaws)} flawed "
+            f"records; first: {flaws[0]}) — recorder/codec skew")
+    oprec.write_opfile(out_path, arr)
+
+    sim_fills = sum(int(np.sum(np.asarray(pr.stats.fills))) for pr in phases)
+    sim_volume = sum(int(np.sum(np.asarray(pr.stats.volume)))
+                     for pr in phases)
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "name": scenario.name,
+        "seed": seed,
+        "symbols": cfg.num_symbols,
+        "capacity": cfg.capacity,
+        "batch": cfg.batch,
+        "kernel": cfg.kernel,
+        "max_fills": cfg.max_fills,
+        "serve_shards": serve_shards,
+        "zipf_alpha_q8": scenario.zipf_alpha_q8,
+        "steps": scenario.total_steps(),
+        "ops": len(records),
+        "phases": manifest_phases,
+        "per_class_ops": per_class,
+        "per_symbol_ops": per_symbol,
+        "min_cancel_gap": min_cancel_gap,
+        "skipped_cancels": skipped_cancels,
+        "sim_fills": sim_fills,
+        "sim_volume": sim_volume,
+        "agent_mix": {
+            "mm_agents": mix.mm_agents, "mm_refresh": mix.mm_refresh,
+            "momentum": mix.momentum, "noise": mix.noise,
+            "takers": mix.takers,
+        },
+    }
+    with open(manifest_path_for(out_path), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+
+    if metrics is not None:
+        metrics.inc("sim_record_ops", len(records))
+        metrics.inc("sim_record_steps", scenario.total_steps())
+        metrics.inc("sim_record_phases", len(manifest_phases))
+        metrics.inc("sim_record_bytes", len(arr) * oprec.RECORD_SIZE)
+    return manifest
+
+
+def read_manifest(opfile_path: str) -> dict:
+    with open(manifest_path_for(opfile_path)) as f:
+        m = json.load(f)
+    if m.get("format") != MANIFEST_FORMAT:
+        raise ValueError(
+            f"unsupported workload manifest format {m.get('format')!r} "
+            f"for {opfile_path}")
+    return m

@@ -1,0 +1,274 @@
+"""Declarative scenario programs over the heterogeneous agent market.
+
+A Scenario is a sequence of timed PHASES over the agent mix — the
+CoinTossX stress catalogue (arXiv:2102.10925) as data, not prose:
+
+- ``continuous``: normal trading; optional burst gating (on/off arrival
+  waves) and a shock window (per-step fair-value decrements + all-sell
+  takers — the flash-crash injection the momentum class then amplifies
+  through the top-of-book return loop).
+- ``auction``: a call period. LIMIT flow RESTS without matching
+  (kernel OP_REST — the books may stand crossed), market-type classes
+  are gated off, and the phase ends with a call-auction uncross
+  (engine/auction.py auction_step) clearing every book at one price.
+  This is exactly the serving stack's auction-mode plumbing: on replay,
+  the workload driver opens the call period (RunAuction open_call) and
+  uncrosses at the phase end (RunAuction), so recorded auction-day flow
+  exercises the live server's call machinery.
+- ``halt``: a trading halt — every symbol suppressed via the engine's
+  halt hook (kernel.apply_halt_mask); books stand frozen, zero ops and
+  zero fills admitted (tests pin it).
+
+Hot-symbol skew rides the whole scenario: ``zipf_alpha_q8 > 0`` gates
+each symbol's per-step activity by a Zipf weight, so a few symbols carry
+most of the flow while the tail idles (engine/flow.py's power-law
+regime, now closed-loop).
+
+Each phase runs as ONE jit'd lax.scan (static phase config => the
+compile cache holds one program per distinct phase shape); state and
+book carry across phases, so a scenario is bit-reproducible from (config,
+mix, program, seed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matching_engine_tpu.engine.auction import auction_step, decode_auction
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig, init_book
+from matching_engine_tpu.engine.kernel import (
+    LIMIT,
+    OP_REST,
+    OP_SUBMIT,
+    engine_step_impl,
+)
+from matching_engine_tpu.sim.agents import (
+    AgentMix,
+    AgentState,
+    agent_orders,
+    init_agents,
+    observe_market,
+)
+from matching_engine_tpu.sim.market_sim import StepStats
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One timed phase (hashable; jit-static)."""
+
+    kind: str                 # "continuous" | "auction" | "halt"
+    steps: int
+    burst_period: int = 0     # 0 = no burst gating
+    burst_on: int = 0         # active steps per period
+    shock_bp: int = 0         # per-step fair decrement while shocked (Q4)
+    shock_start: int = 0      # step offset within the phase
+    shock_len: int = 0
+
+    def __post_init__(self):
+        assert self.kind in ("continuous", "auction", "halt"), self.kind
+        assert self.steps > 0
+        if self.burst_period:
+            assert 0 < self.burst_on <= self.burst_period
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    phases: tuple[Phase, ...]
+    zipf_alpha_q8: int = 0    # Zipf exponent * 256 over symbol activity
+
+    def total_steps(self) -> int:
+        return sum(p.steps for p in self.phases)
+
+
+def zipf_weights_q15(num_symbols: int, alpha_q8: int) -> np.ndarray:
+    """[S] per-symbol activity weights in Q15 (32768 = always active).
+    Slot 0 is the hottest symbol — deterministic, no RNG, so the weights
+    are part of the scenario's reproducible identity. alpha_q8 == 0 =>
+    uniform full activity."""
+    if alpha_q8 <= 0:
+        return np.full(num_symbols, 1 << 15, dtype=np.int32)
+    alpha = alpha_q8 / 256.0
+    w = np.array([(1.0 / (i + 1) ** alpha) for i in range(num_symbols)])
+    return np.maximum((w * (1 << 15)).astype(np.int32), 1)
+
+
+class PhaseResult:
+    """Host-side per-phase outcome."""
+
+    __slots__ = ("phase", "stats", "orders", "uncross", "uncross_fills")
+
+    def __init__(self, phase, stats, orders, uncross=None, uncross_fills=None):
+        self.phase = phase
+        self.stats = stats            # StepStats, stacked [steps]
+        self.orders = orders          # OrderBatch [steps, S, B] | None
+        self.uncross = uncross        # AuctionDecoded | None
+        self.uncross_fills = uncross_fills
+
+
+def _phase_impl(cfg: EngineConfig, mix: AgentMix, phase: Phase,
+                phase_start: int, collect: bool,
+                book: BookBatch, state: AgentState, zipf_w: jax.Array):
+    call_mode = phase.kind == "auction"
+    halt = phase.kind == "halt"
+
+    def scan_body(carry, _):
+        book, state = carry
+        t = state.step - phase_start
+        if phase.burst_period:
+            burst_on = (t % phase.burst_period) < phase.burst_on
+        else:
+            burst_on = jnp.ones((), bool)
+        if phase.shock_len:
+            in_shock = (t >= phase.shock_start) & (
+                t < phase.shock_start + phase.shock_len)
+        else:
+            in_shock = jnp.zeros((), bool)
+        shock = jnp.where(in_shock, phase.shock_bp, 0).astype(I32)
+        state, orders = agent_orders(
+            cfg, mix, state, zipf_w, call_mode=call_mode, halt=halt,
+            burst_on=burst_on, shock=shock, sell_bias=in_shock)
+        if call_mode:
+            # Call period: LIMIT flow accumulates without matching — the
+            # serving stack's auction-mode mapping (engine_runner turns
+            # admitted submits into OP_REST while the call is open).
+            orders = orders._replace(op=jnp.where(
+                (orders.op == OP_SUBMIT) & (orders.otype == LIMIT),
+                OP_REST, orders.op))
+        book, out = engine_step_impl(cfg, book, orders)
+        state = observe_market(mix, state, out.best_bid, out.best_ask)
+
+        both = (out.best_bid > 0) & (out.best_ask > 0)
+        n_both = jnp.sum(both)
+        stats = StepStats(
+            real_ops=jnp.sum(orders.op != 0).astype(I32),
+            fills=out.fill_count.astype(I32),
+            volume=jnp.sum(out.fill_qty).astype(I32),
+            spread=jnp.where(
+                n_both > 0,
+                jnp.sum(jnp.where(both, out.best_ask - out.best_bid, 0))
+                // jnp.maximum(n_both, 1), 0).astype(I32),
+            resting=(jnp.sum(book.bid_qty > 0)
+                     + jnp.sum(book.ask_qty > 0)).astype(I32),
+        )
+        return (book, state), (stats, orders if collect else None)
+
+    (book, state), (stats, orders) = jax.lax.scan(
+        scan_body, (book, state), None, length=phase.steps)
+    return book, state, stats, orders
+
+
+# Module-level jit: repeated phases with the same static config hit the
+# compile cache (the market_sim convention).
+_phase_run = jax.jit(_phase_impl, static_argnums=(0, 1, 2, 3, 4))
+
+
+def run_scenario(
+    cfg: EngineConfig,
+    mix: AgentMix,
+    scenario: Scenario,
+    seed: int = 0,
+    collect_orders: bool = False,
+):
+    """Run a scenario program end to end on device.
+
+    Returns (book, state, [PhaseResult...]). Auction phases end with an
+    all-symbols uncross whose decoded summary + bilateral fills ride the
+    PhaseResult (the oracle parity test replays them; the recorder maps
+    them onto the replay driver's RunAuction calls)."""
+    assert cfg.batch == mix.batch_for(), (
+        f"EngineConfig.batch must be {mix.batch_for()} for this AgentMix")
+    book = init_book(cfg)
+    state = init_agents(cfg, mix, seed)
+    zipf_w = jnp.asarray(zipf_weights_q15(cfg.num_symbols,
+                                          scenario.zipf_alpha_q8))
+    results: list[PhaseResult] = []
+    start = 0
+    for phase in scenario.phases:
+        book, state, stats, orders = _phase_run(
+            cfg, mix, phase, start, collect_orders, book, state, zipf_w)
+        uncross = uncross_fills = None
+        if phase.kind == "auction":
+            mask = jnp.ones((cfg.num_symbols,), bool)
+            book, aout = auction_step(cfg, book, mask)
+            uncross, uncross_fills = decode_auction(cfg, aout)
+            if uncross.aborted:
+                raise RuntimeError(
+                    "scenario uncross aborted: fill log overflow — raise "
+                    "EngineConfig.max_fills for this population")
+        results.append(PhaseResult(phase, stats, orders, uncross,
+                                   uncross_fills))
+        start += phase.steps
+    return book, state, results
+
+
+# -- the scenario catalogue ---------------------------------------------------
+
+def _scaled(phases: list[Phase], steps: int | None) -> tuple[Phase, ...]:
+    """Proportionally rescale a program to ~`steps` total (each phase
+    keeps at least one step, so the program's structure survives any
+    scale)."""
+    if steps is None:
+        return tuple(phases)
+    base = sum(p.steps for p in phases)
+    out = []
+    for p in phases:
+        n = max(1, round(p.steps * steps / base))
+        f = {fld.name: getattr(p, fld.name)
+             for fld in dataclasses.fields(Phase)}
+        # Keep shock/burst windows inside the rescaled phase.
+        f["steps"] = n
+        if f["shock_len"]:
+            f["shock_start"] = min(f["shock_start"], max(0, n - 2))
+            f["shock_len"] = max(1, min(f["shock_len"],
+                                        n - f["shock_start"]))
+        out.append(Phase(**f))
+    return tuple(out)
+
+
+def make_scenario(name: str, steps: int | None = None) -> Scenario:
+    """The named stress catalogue. `steps` proportionally rescales the
+    program's total length (CLI `simulate --steps`)."""
+    if name == "auction_day":
+        # Open call -> continuous -> halt -> reopen call -> continuous ->
+        # closing call: the full exchange trading day.
+        phases = [
+            Phase("auction", 12),
+            Phase("continuous", 60),
+            Phase("halt", 10),
+            Phase("auction", 12),
+            Phase("continuous", 46),
+            Phase("auction", 12),
+        ]
+        return Scenario("auction_day", _scaled(phases, steps))
+    if name == "flash_crash":
+        # Warm-up, then an injected sell shock the momentum population
+        # amplifies, then the recovery tail.
+        phases = [
+            Phase("continuous", 40),
+            Phase("continuous", 50, shock_bp=60, shock_start=8,
+                  shock_len=12),
+            Phase("continuous", 40),
+        ]
+        return Scenario("flash_crash", _scaled(phases, steps))
+    if name == "hot_symbols":
+        # Zipf(1.2) activity skew: slot 0 runs hot, the tail idles.
+        return Scenario("hot_symbols",
+                        _scaled([Phase("continuous", 130)], steps),
+                        zipf_alpha_q8=int(1.2 * 256))
+    if name == "bursts":
+        # On/off arrival waves: 6 active steps in every 20.
+        return Scenario("bursts",
+                        _scaled([Phase("continuous", 130, burst_period=20,
+                                       burst_on=6)], steps))
+    raise ValueError(
+        f"unknown scenario {name!r} (have: {', '.join(SCENARIO_NAMES)})")
+
+
+SCENARIO_NAMES = ("auction_day", "flash_crash", "hot_symbols", "bursts")

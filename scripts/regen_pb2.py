@@ -94,6 +94,15 @@ ADDITIVE_FIELDS = [
     ("OrderUpdate", "oplog_ops", 21, F.TYPE_BYTES),
     ("OrderUpdate", "oplog_count", 22, F.TYPE_UINT32),
     ("OrderUpdate", "oplog_lane", 23, F.TYPE_UINT32),
+    # Scenario/workload replay (sim/scenarios.py): (re)open the venue-wide
+    # auction call period over RPC WITHOUT uncrossing — submits rest
+    # unmatched until a later all-symbols RunAuction clears them. Before
+    # this field a call period could only open at boot (--auction-open),
+    # so a recorded auction-day workload (open -> continuous -> halt ->
+    # reopen -> close) could not replay through a live server. symbol
+    # must be empty (a call period is venue-wide, the --auction-open
+    # rule).
+    ("AuctionRequest", "open_call", 2, F.TYPE_BOOL),
 ]
 
 # Whole new messages (name, [(field, number, type[, label])]) — additive:

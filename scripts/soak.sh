@@ -462,6 +462,89 @@ BE_MEGA=$(sed -n 's/^me_megadispatch_steps_total \([0-9]*\).*/\1/p' "$BE_SCRAPE"
 [ -n "$BE_MEGA" ] && [ "$BE_MEGA" -gt 0 ] \
   || { echo "FAIL: native megadispatch never engaged in the batch round (steps=${BE_MEGA:-absent})"; exit 1; }
 
+# ---- flash-crash round: recorded scenario workload under full audit -------
+# Scenario stress through the REAL stack (ISSUE 12): record a flash-crash
+# cascade with the on-device agent market (`client simulate` — momentum
+# agents amplifying an injected sell shock), replay the opfile through
+# `client submit-batch` against a server running the auditor at sample 1,
+# and FAIL on any auditor violation or on rejects past a metered
+# threshold. Rejects ARE expected under stress (cancels racing fills,
+# capacity backpressure) — the round asserts they are counted and
+# bounded, never fatal and never an invariant break.
+FC_OPS_FILE="$WORK/flash_crash.opfile.gz"
+FC_SIM_SUMMARY="$WORK/flash_crash_sim.json"
+python -m matching_engine_tpu.client.cli simulate \
+  --scenario flash_crash --steps 80 --symbols 16 --seed 13 \
+  --out "$FC_OPS_FILE" --summary-json "$FC_SIM_SUMMARY" \
+  >/dev/null 2>"$WORK/flash_crash_sim.err" \
+  || { echo "FAIL: flash-crash scenario recording failed"; cat "$WORK/flash_crash_sim.err"; exit 1; }
+FC_DB="$WORK/soak_flash.db"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$FC_DB" --symbols 16 --capacity 128 --batch 8 \
+  --window-ms 1 --megadispatch-max-waves 4 --metrics-port 0 \
+  --flight-dir "$WORK/flash_flight" \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_flash.log" 2>&1 &
+FC_SRV=$!
+trap 'kill $SRV $FC_SRV 2>/dev/null' EXIT
+FC_PY=""; FC_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  FC_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_flash.log" | head -1)
+  FC_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_flash.log" | head -1)
+  [ -n "$FC_PY" ] && [ -n "$FC_OBS" ] && break
+  kill -0 $FC_SRV 2>/dev/null || { echo "FAIL: flash-crash server died at boot"; tail -5 "$WORK/server_flash.log"; exit 1; }
+  sleep 1
+done
+[ -n "$FC_PY" ] && [ -n "$FC_OBS" ] || { echo "FAIL: flash-crash server ports never appeared"; exit 1; }
+FC_SUMMARY="$WORK/flash_crash_replay.json"
+python -m matching_engine_tpu.client.cli submit-batch "127.0.0.1:$FC_PY" \
+  "$FC_OPS_FILE" --batch-size 256 --quiet --summary-json "$FC_SUMMARY" \
+  >/dev/null 2>"$WORK/flash_crash_replay.err" \
+  || { echo "FAIL: flash-crash replay failed"; cat "$WORK/flash_crash_replay.err"; exit 1; }
+FC_SCRAPE="$WORK/flash_scrape.prom"
+python - "$FC_OBS" > "$FC_SCRAPE" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-flash {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+cat "$FC_SCRAPE" >> "$METRICS_OUT"
+# The auditor must stay green through the cascade — a crash scenario
+# that trips conservation/lifecycle invariants is an engine bug, not
+# acceptable stress.
+check_audit "$FC_OBS" "flash_crash" \
+  || { echo "FAIL: audit violations in the flash-crash round"; exit 1; }
+kill -TERM $FC_SRV 2>/dev/null; wait $FC_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+# Metered rejects: counted, bounded, never fatal. The structural reject
+# classes (cancels of already-filled orders) ride every crash replay;
+# past 25% of ops something is actually broken (codec skew, id
+# renumbering, capacity collapse).
+FC_CHECK=$(python - "$FC_SUMMARY" "$FC_SCRAPE" <<'EOF'
+import json, re, sys
+s = json.load(open(sys.argv[1]))
+scrape = open(sys.argv[2]).read()
+# Capacity-full submits land in me_orders_rejected_total (absent series
+# = the counter never fired = zero); cancel-of-terminal rejects are
+# positional-only and ride the summary's reject_reasons.
+m = re.search(r"^me_orders_rejected_total (\d+)", scrape, re.M)
+counted = int(m.group(1)) if m else 0
+ok = (s["accepted"] > 0 and s["rejected"] <= 0.25 * s["ops"]
+      and counted <= s["rejected"])
+print(f"{int(ok)} {s['accepted']} {s['rejected']} {s['ops']} {counted}")
+EOF
+)
+read -r FC_OK FC_ACC FC_REJ FC_TOTAL FC_COUNTED <<< "$(echo "$FC_CHECK" | tail -1)"
+if [ "$FC_OK" != "1" ]; then
+  echo "FAIL: flash-crash round rejects unmetered or past threshold (accepted=$FC_ACC rejected=$FC_REJ ops=$FC_TOTAL counter=$FC_COUNTED)"
+  exit 1
+fi
+echo "flash-crash round: $FC_ACC/$FC_TOTAL accepted, $FC_REJ rejects metered (counter=$FC_COUNTED), auditor green"
+
 # ---- corruption-injection round: the auditor must fire --------------------
 # Boots a server with ME_AUDIT_FAULT=fill_qty (one fill record's quantity
 # mutated between decode and publish), drives crossing flow, and asserts
@@ -798,6 +881,13 @@ artifact = {
                       "p99_ms": $LT_P99, "p99_over_p50": $LT_RATIO,
                       "p999_gauges": $LT_NP999,
                       "levers": "busy-poll+book-cache+proto-reuse"},
+    "flash_crash_round": {"scenario": "flash_crash", "batch_size": 256,
+                          "accepted": int("$FC_ACC" or -1),
+                          "rejected": int("$FC_REJ" or -1),
+                          "ops": int("$FC_TOTAL" or -1),
+                          "rejects_counter": int("$FC_COUNTED" or -1),
+                          "reject_threshold": 0.25,
+                          "audit_sample": 1},
     "auditz": auditz,
     "corruption_round": {"fault": "fill_qty", "detected": True,
                          "violations": int("$CI_VIOL" or -1),

@@ -1,0 +1,315 @@
+"""Scenario-workload subsystem: determinism, phase semantics, oracle
+parity on heterogeneous flow, Zipf skew, and serving-stack replay.
+
+The strongest checks close two loops:
+- device -> oracle: the heterogeneous agent flow (all four classes, call
+  phases included) replays through the host oracle bit-identically on
+  BOTH kernels — continuous fills, rested call-period interest, and the
+  call-auction uncross all match (test_sim.py's pattern, generalized).
+- device -> serving stack: a recorded opfile replays through a real
+  in-proc server (build_server + SubmitOrderBatch + RunAuction
+  open_call/uncross) with the recorder's order-id renumbering holding —
+  the server's fill count and every uncross's executed volume equal the
+  sim's own ground truth. This test is also CI's workload smoke.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.harness import snapshot_books
+from matching_engine_tpu.engine.kernel import (
+    OP_CANCEL,
+    OP_REST,
+    OP_SUBMIT,
+)
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.sim.agents import AgentMix
+from matching_engine_tpu.sim.record import (
+    read_manifest,
+    record_scenario,
+)
+from matching_engine_tpu.sim.scenarios import (
+    Phase,
+    Scenario,
+    make_scenario,
+    run_scenario,
+    zipf_weights_q15,
+)
+
+MIX = AgentMix(mm_agents=8, mm_refresh=2, momentum=2, noise=3, takers=2,
+               half_spread=2, spread_jitter=4, qty_max=50, fair_init=1_000,
+               noise_qty_cap=120)
+CFG = EngineConfig(num_symbols=4, capacity=48, batch=MIX.batch_for(),
+                   max_fills=1 << 14)
+
+
+def _total(phases, field):
+    return sum(int(np.sum(np.asarray(getattr(p.stats, field))))
+               for p in phases)
+
+
+# -- determinism ---------------------------------------------------------------
+
+
+def test_same_seed_records_byte_identical_opfile(tmp_path):
+    sc = make_scenario("auction_day", steps=40)
+    a, b, c = (str(tmp_path / f"{n}.opfile.gz") for n in "abc")
+    ma = record_scenario(CFG, MIX, sc, seed=11, out_path=a)
+    mb = record_scenario(CFG, MIX, sc, seed=11, out_path=b)
+    mc = record_scenario(CFG, MIX, sc, seed=12, out_path=c)
+    assert open(a, "rb").read() == open(b, "rb").read(), \
+        "one seed must reproduce the workload artifact byte-for-byte"
+    assert ma == mb
+    assert open(a, "rb").read() != open(c, "rb").read()
+    assert mc["ops"] != ma["ops"] or \
+        oprec.read_opfile(c).tobytes() != oprec.read_opfile(a).tobytes()
+    # The artifact round-trips through the shared reader (gzip sniffed).
+    arr = oprec.read_opfile(a)
+    assert len(arr) == ma["ops"] > 0
+    assert all(m is None for m in oprec.record_flaws(arr))
+    # Manifest rides beside it.
+    man = read_manifest(a)
+    assert man["name"] == "auction_day" and len(man["phases"]) == 6
+
+
+# -- phase semantics -----------------------------------------------------------
+#
+# One auction_day run (the same static phase shapes as the determinism
+# and parity tests, so the in-process jit cache is hit, not recompiled)
+# covers the halt AND call-period assertions.
+
+
+def test_auction_day_phase_transitions():
+    sc = make_scenario("auction_day", steps=40)
+    book, _, phases = run_scenario(CFG, MIX, sc, seed=5,
+                                   collect_orders=True)
+    kinds = [p.phase.kind for p in phases]
+    assert kinds == ["auction", "continuous", "halt", "auction",
+                     "continuous", "auction"]
+    open_call, cont1, halt, reopen = phases[0], phases[1], phases[2], \
+        phases[3]
+
+    # Call periods admit no fills; flow is OP_REST/OP_CANCEL only.
+    for call in (open_call, reopen):
+        assert int(np.sum(np.asarray(call.stats.fills))) == 0
+        ops = np.asarray(call.orders.op)
+        assert set(np.unique(ops)) <= {0, OP_CANCEL, OP_REST}
+        assert (ops == OP_REST).sum() > 0
+        # The accumulated interest crossed and the uncross executed.
+        assert call.uncross is not None
+        assert int(np.sum(call.uncross.executed)) > 0
+
+    # The halt admits NOTHING: zero ops, zero fills, books frozen.
+    assert int(np.sum(np.asarray(halt.stats.real_ops))) == 0
+    assert int(np.sum(np.asarray(halt.stats.fills))) == 0
+    resting = np.asarray(halt.stats.resting)
+    pre = np.asarray(cont1.stats.resting)[-1]
+    assert (resting == pre).all()
+    # Trading resumes at the reopen (rests) and after it (fills).
+    assert int(np.sum(np.asarray(phases[4].stats.fills))) > 0
+
+    # Post-close books are never crossed.
+    for bids, asks in snapshot_books(book):
+        if bids and asks:
+            assert bids[0][1] < asks[0][1]
+
+
+def test_flash_crash_momentum_amplifies_shock():
+    sc = make_scenario("flash_crash", steps=60)
+    _, _, phases = run_scenario(CFG, MIX, sc, seed=9, collect_orders=True)
+    shock_phase = sc.phases[1]
+    assert shock_phase.shock_len > 0
+    # Momentum lanes (MARKET ops in the momentum columns) fire more
+    # during/after the shock window than in the calm warm-up.
+    k = MIX.mm_refresh
+    mom_cols = slice(4 * k, 4 * k + MIX.momentum)
+    calm = np.asarray(phases[0].orders.op)[:, :, mom_cols]
+    crash = np.asarray(phases[1].orders.op)[:, :, mom_cols]
+    assert (crash == OP_SUBMIT).sum() > (calm == OP_SUBMIT).sum()
+    # The shock actually moves the market down: min mid in the shock
+    # phase sits well below the warm-up's last mid.
+    assert int(np.asarray(phases[1].stats.volume).sum()) > 0
+
+
+def test_zipf_skew_skews_per_symbol_op_counts(tmp_path):
+    w = zipf_weights_q15(8, int(1.2 * 256))
+    assert w[0] == 1 << 15 and w[-1] < w[0] // 8
+    sc = make_scenario("hot_symbols", steps=80)
+    out = str(tmp_path / "hot.opfile.gz")
+    man = record_scenario(CFG, MIX, sc, seed=2, out_path=out)
+    per_sym = man["per_symbol_ops"]
+    assert per_sym[0] > 3 * min(per_sym[1:]), per_sym
+    assert per_sym[0] == max(per_sym), per_sym
+
+
+def test_bursts_gate_flow_on_and_off():
+    sc = Scenario("t", (Phase("continuous", 20, burst_period=10,
+                              burst_on=3),))
+    _, _, phases = run_scenario(CFG, MIX, sc, seed=4)
+    ops = np.asarray(phases[0].stats.real_ops)
+    # Off-steps admit nothing; on-steps trade.
+    for t in range(20):
+        if t % 10 < 3:
+            assert ops[t] > 0, t
+        else:
+            assert ops[t] == 0, t
+
+
+# -- oracle parity on heterogeneous flow --------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+def test_heterogeneous_flow_oracle_parity(kernel):
+    """Device scenario run == host oracle replay of its own flow, on both
+    kernels: continuous fills, call-period rests, and every call-auction
+    uncross."""
+    cfg = dataclasses.replace(CFG, kernel=kernel)
+    sc = make_scenario("auction_day", steps=40)
+    book, _, phases = run_scenario(cfg, MIX, sc, seed=13,
+                                   collect_orders=True)
+
+    oracles = [OracleBook(capacity=cfg.capacity)
+               for _ in range(cfg.num_symbols)]
+    o_volume = 0
+    o_auction_volume = 0
+    for pr in phases:
+        op = np.asarray(pr.orders.op)
+        side = np.asarray(pr.orders.side)
+        otype = np.asarray(pr.orders.otype)
+        price = np.asarray(pr.orders.price)
+        qty = np.asarray(pr.orders.qty)
+        oid = np.asarray(pr.orders.oid)
+        t_steps, s_syms, b = op.shape
+        for t in range(t_steps):
+            for s in range(s_syms):
+                for j in range(b):
+                    o = int(op[t, s, j])
+                    if o == OP_SUBMIT:
+                        r = oracles[s].submit(
+                            int(oid[t, s, j]), int(side[t, s, j]),
+                            int(otype[t, s, j]), int(price[t, s, j]),
+                            int(qty[t, s, j]))
+                        o_volume += sum(f.quantity for f in r.fills)
+                    elif o == OP_REST:
+                        oracles[s].rest(
+                            int(oid[t, s, j]), int(side[t, s, j]),
+                            int(price[t, s, j]), int(qty[t, s, j]))
+                    elif o == OP_CANCEL:
+                        oracles[s].cancel(int(oid[t, s, j]))
+        if pr.uncross is not None:
+            dev_exec = np.asarray(pr.uncross.executed)
+            dev_price = np.asarray(pr.uncross.clear_price)
+            for s in range(s_syms):
+                p_star, q, fills = oracles[s].auction()
+                assert q == int(dev_exec[s]), f"sym {s} auction volume"
+                assert p_star == int(dev_price[s]), f"sym {s} clearing px"
+                o_auction_volume += q
+
+    snaps = snapshot_books(book)
+    for s in range(cfg.num_symbols):
+        ob = oracles[s].snapshot()
+        assert snaps[s][0] == ob[0], f"bid book mismatch sym {s}"
+        assert snaps[s][1] == ob[1], f"ask book mismatch sym {s}"
+    dev_volume = _total(phases, "volume")
+    dev_auction = sum(int(np.sum(np.asarray(pr.uncross.executed)))
+                      for pr in phases if pr.uncross is not None)
+    assert o_volume == dev_volume
+    assert o_auction_volume == dev_auction > 0
+
+
+# -- serving-stack replay (also CI's workload smoke) --------------------------
+
+
+def test_record_replay_through_inproc_server(tmp_path):
+    """A recorded auction-day workload replays through a REAL server —
+    call periods opened via RunAuction open_call, uncrossed at phase
+    ends, cancels landing on the renumbered ids — and the serving
+    stack's fills/uncross volumes equal the sim's ground truth."""
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    sc = make_scenario("auction_day", steps=40)
+    out = str(tmp_path / "ad.opfile.gz")
+    man = record_scenario(CFG, MIX, sc, seed=7, out_path=out)
+    arr = oprec.read_opfile(out)
+
+    scfg = EngineConfig(num_symbols=CFG.num_symbols, capacity=CFG.capacity,
+                        batch=8, max_fills=CFG.max_fills)
+    server, _port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "w.db"), scfg, window_ms=1.0,
+        log=False, feed_depth=0)
+    svc = parts["service"]
+    try:
+        bs = max(1, min(128, man["min_cancel_gap"] or 128))
+        acc = rej = 0
+        reasons = {}
+        uncross = []
+        for ph in man["phases"]:
+            if ph["kind"] == "auction":
+                r = svc.RunAuction(pb2.AuctionRequest(open_call=True),
+                                   None)
+                assert r.success, r.error_message
+                # Venue-wide only: a symbol-scoped open_call refuses.
+                bad = svc.RunAuction(
+                    pb2.AuctionRequest(symbol="S0", open_call=True), None)
+                assert not bad.success
+            for s0 in range(ph["start_record"], ph["end_record"], bs):
+                payload = oprec.slice_payload(
+                    arr, s0, min(bs, ph["end_record"] - s0))
+                resp = svc.SubmitOrderBatch(
+                    pb2.OrderBatchRequest(ops=payload), None)
+                assert resp.success, resp.error_message
+                for i, ok in enumerate(resp.ok):
+                    if ok:
+                        acc += 1
+                    else:
+                        rej += 1
+                        reasons[resp.error[i]] = (
+                            reasons.get(resp.error[i], 0) + 1)
+            if ph["kind"] == "auction":
+                r = svc.RunAuction(pb2.AuctionRequest(), None)
+                assert r.success, r.error_message
+                uncross.append(int(r.executed_quantity))
+        gm = svc.GetMetrics(pb2.MetricsRequest(), None)
+        # Bit-faithful replay: the serving stack produced exactly the
+        # sim's fills, and every uncross cleared the sim's volume.
+        assert gm.counters.get("fills") == man["sim_fills"] > 0
+        assert uncross == [p["uncross_executed"] for p in man["phases"]
+                           if p["kind"] == "auction"]
+        assert acc > 0
+        # Rejects are only the structural classes the sim itself rejects
+        # (cancels of already-terminal orders) — never codec/ownership/
+        # unknown-symbol trouble.
+        assert set(reasons) <= {"unknown order id", "order not open"}, \
+            reasons
+    finally:
+        shutdown(server, parts)
+
+
+def test_simulate_cli_verb(tmp_path):
+    """The simulate verb records without any server and reports per-class
+    op counts (the workload-artifact production path the soak and CI
+    drive)."""
+    import json
+
+    from matching_engine_tpu.client.cli import main as cli_main
+
+    out = str(tmp_path / "fc.opfile.gz")
+    summary = str(tmp_path / "fc.json")
+    rc = cli_main(["simulate", "--scenario", "flash_crash", "--steps",
+                   "30", "--seed", "4", "--symbols", "4", "--out", out,
+                   "--summary-json", summary])
+    assert rc == 0
+    s = json.load(open(summary))
+    assert s["ops"] > 0 and s["scenario"] == "flash_crash"
+    assert set(s["per_class_ops"]) == {"mm", "mom", "nz", "tk"}
+    assert s["per_class_ops"]["mm"]["submits"] > 0
+    arr = oprec.read_opfile(out)
+    assert len(arr) == s["ops"]
+    # Unknown scenario: usage-style failure, not a stack trace.
+    assert cli_main(["simulate", "--scenario", "nope", "--out",
+                     str(tmp_path / "x")]) == 1
