@@ -57,7 +57,8 @@ def main() -> None:
                         "built libme_native.so). Records are pre-packed "
                         "outside the timed loop, mirroring the gateway "
                         "edge where C++ fills the ring")
-    p.add_argument("--kernel", choices=("matrix", "sorted"), default="matrix")
+    p.add_argument("--kernel", choices=("matrix", "sorted", "levels"),
+                   default="matrix")
     p.add_argument("--serve-shards", default="",
                    help="comma list of partitioned-lane counts K to sweep "
                         "(server/shards.py): each point builds K "
@@ -163,6 +164,14 @@ def main() -> None:
                         "host-only serving figure) and/or 'edge' (server "
                         "SUBPROCESS + loopback gRPC SubmitOrderBatch — "
                         "the batch-edge figure)")
+    p.add_argument("--workload-tiers", default="",
+                   help="--book-tiers spec for the workload replay's "
+                        "in-proc server (e.g. '4x1024:S0;S1;S2;S3,"
+                        "*x256'): before driving anything, the manifest's "
+                        "per-symbol max_resting_depth is checked against "
+                        "the spec (sim/record.py check_tier_depth) and a "
+                        "too-shallow spec fails loudly — the replay must "
+                        "not depend on borrowed deep slots")
     p.add_argument("--workload-batch", type=int, default=0,
                    help="records per SubmitOrderBatch during workload "
                         "replay; 0 = min(512, the manifest's "
@@ -180,6 +189,29 @@ def main() -> None:
                         "this repo's serving numbers are bounded by; this "
                         "mode is how the native-vs-python host ratio is "
                         "measured off-TPU (docs/BENCH_METHOD.md)")
+    p.add_argument("--capacity-sweep", default="",
+                   help="comma list of book capacities (e.g. "
+                        "'128,1024,8192'): selects the kernel capacity "
+                        "sweep — per (kernel, capacity), prefill every "
+                        "book to --sweep-depth-frac of capacity with "
+                        "price-level ladders, then time a steady-state "
+                        "churn stream (takers + replenishing rests + "
+                        "cancels) straight through engine_step_packed "
+                        "(no serving stack, no decode: the KERNEL cost "
+                        "of depth). Matrix rows beyond its 1024 bound "
+                        "record supported=false — that inadmissibility "
+                        "is the point of the sweep")
+    p.add_argument("--sweep-kernels", default="matrix,sorted,levels",
+                   help="kernels for --capacity-sweep")
+    p.add_argument("--sweep-ops", type=int, default=2048,
+                   help="measured churn ops per --capacity-sweep point")
+    p.add_argument("--sweep-symbols", type=int, default=4,
+                   help="symbol-axis size for --capacity-sweep (small on "
+                        "purpose: the sweep isolates per-book depth cost, "
+                        "not symbol-axis width)")
+    p.add_argument("--sweep-depth-frac", type=float, default=0.5,
+                   help="prefilled resting depth per side as a fraction "
+                        "of capacity")
     p.add_argument("--json-out", required=True)
     args = p.parse_args()
 
@@ -1145,14 +1177,31 @@ def main() -> None:
                 shutdown,
             )
 
+            tiers, pins = (), None
+            if args.workload_tiers:
+                from matching_engine_tpu.server.tiered_runner import (
+                    parse_book_tiers,
+                )
+                from matching_engine_tpu.sim.record import check_tier_depth
+
+                tiers, pins = parse_book_tiers(args.workload_tiers,
+                                               man["symbols"])
+                bad_depth = check_tier_depth(man, tiers, pins)
+                if bad_depth:
+                    raise SystemExit(
+                        "--workload-tiers too shallow for this "
+                        "recording:\n  " + "\n  ".join(bad_depth))
             wcfg = EngineConfig(
-                num_symbols=man["symbols"], capacity=man["capacity"],
+                num_symbols=man["symbols"],
+                capacity=(max(c for _, c in tiers) if tiers
+                          else man["capacity"]),
                 batch=args.batch, max_fills=man["max_fills"],
-                kernel=args.kernel)
+                kernel=args.kernel, tiers=tiers)
             tmp = tempfile.mkdtemp(prefix="workload_inproc_")
             kw = dict(window_ms=args.edge_window_ms, log=False,
                       feed_depth=0,
-                      megadispatch_max_waves=args.edge_mega)
+                      megadispatch_max_waves=args.edge_mega,
+                      tier_pins=pins)
             if man["serve_shards"] > 1:
                 kw["serve_shards"] = man["serve_shards"]
             server, _port, parts = build_server(
@@ -1251,12 +1300,175 @@ def main() -> None:
                       f"{row['mega_waves_per_step']}", file=sys.stderr)
         return rows
 
+    def capacity_sweep():
+        """Per-(kernel, capacity) steady-state deep-book throughput:
+        the O(levels)-vs-O(capacity) comparison ROADMAP item 5 asks for.
+        Books are prefilled to --sweep-depth-frac of capacity as
+        price-level ladders (ladder prices spread over the levels
+        kernel's own L rows, so every kernel faces the identical
+        stream); the timed region is a balanced churn mix — one
+        single-maker IOC taker, one cancel, two replenishing rests per
+        cycle — dispatched as packed dense waves with NO host decode, so
+        the number is the device kernel's cost of depth, not the serving
+        stack's. Each point warms the jit cache with one untimed pass,
+        then takes best-of --repeats from identical device_put'd books
+        (the step donates its input, so every repeat re-uploads the same
+        prefilled host copy)."""
+        from matching_engine_tpu.engine.book import (
+            default_levels,
+            init_book,
+        )
+        from matching_engine_tpu.engine.harness import (
+            HostOrder,
+            build_batch_arrays,
+        )
+        from matching_engine_tpu.engine.kernel import (
+            LIMIT,
+            LIMIT_IOC,
+            OP_CANCEL,
+            engine_step_packed,
+        )
+
+        S, B = args.sweep_symbols, args.batch
+        frac = args.sweep_depth_frac
+        rows = []
+        for cap in [int(c) for c in args.capacity_sweep.split(",")]:
+            lvl = default_levels(cap)
+            fifo = cap // lvl
+            depth = max(4, int(cap * frac))
+            step_px = 10
+            ask_px = [10_000 + step_px * i for i in range(lvl)]
+            bid_px = [9_990 - step_px * i for i in range(lvl)]
+            rng = random.Random(1234 + cap)
+
+            # Prefill: `depth` resting orders per side per symbol,
+            # round-robin over the ladder (per-price count = depth/L <=
+            # frac*F, inside every kernel's structural capacity).
+            oid = 0
+            prefill: list = []
+            # sym -> [(oid, side, price)] — the cancel pool; lvl0[s] is
+            # the FIFO of best-ask (ask_px[0]) sells, the takers' prey.
+            live: dict[int, list[tuple[int, int, int]]] = {
+                s: [] for s in range(S)}
+            lvl0: dict[int, list[int]] = {s: [] for s in range(S)}
+            for s in range(S):
+                for d in range(depth):
+                    for side, px in ((SELL, ask_px[d % lvl]),
+                                     (BUY, bid_px[d % lvl])):
+                        oid += 1
+                        prefill.append(HostOrder(
+                            s, OP_SUBMIT, side, LIMIT, px, 5, oid=oid))
+                        live[s].append((oid, side, px))
+                        if side == SELL and px == ask_px[0]:
+                            lvl0[s].append(oid)
+
+            # Measured churn: DEPTH-NEUTRAL by construction — per cycle
+            # one taker fully consumes the best-ask FIFO head (equal
+            # quantities; the consumed oid leaves the cancel pool so
+            # later cancels never target a dead order), one rest
+            # restocks that exact level, one cancel removes a random
+            # resting order, one rest replaces it at a random ladder
+            # point. Same stream for every kernel at this capacity.
+            churn: list = []
+            for i in range(args.sweep_ops):
+                s = i % S
+                # Decoupled from s (i//S), so EVERY symbol rotates
+                # through all four op kinds — s = i % S and k = i % 4
+                # would lock each symbol to one kind whenever S | 4.
+                k = (i // S) % 4
+                if k == 0:
+                    oid += 1
+                    churn.append(HostOrder(
+                        s, OP_SUBMIT, BUY, LIMIT_IOC, ask_px[0], 5,
+                        oid=oid))
+                    if lvl0[s]:
+                        victim = lvl0[s].pop(0)
+                        live[s] = [t for t in live[s] if t[0] != victim]
+                elif k == 1:
+                    oid += 1
+                    churn.append(HostOrder(
+                        s, OP_SUBMIT, SELL, LIMIT, ask_px[0], 5, oid=oid))
+                    live[s].append((oid, SELL, ask_px[0]))
+                    lvl0[s].append(oid)
+                elif k == 2 and live[s]:
+                    t_oid, t_side, t_px = live[s].pop(
+                        rng.randrange(len(live[s])))
+                    churn.append(HostOrder(s, OP_CANCEL, t_side,
+                                           oid=t_oid))
+                    if t_side == SELL and t_px == ask_px[0]:
+                        lvl0[s] = [o for o in lvl0[s] if o != t_oid]
+                else:
+                    oid += 1
+                    side = SELL if (i // 4) % 2 == 0 else BUY
+                    px = (ask_px if side == SELL else bid_px)[
+                        rng.randrange(lvl)]
+                    churn.append(HostOrder(
+                        s, OP_SUBMIT, side, LIMIT, px, 5, oid=oid))
+                    live[s].append((oid, side, px))
+                    if side == SELL and px == ask_px[0]:
+                        lvl0[s].append(oid)
+
+            for kern in [k.strip() for k in args.sweep_kernels.split(",")]:
+                if kern == "matrix" and cap > 1024:
+                    rows.append({
+                        "kernel": kern, "capacity": cap,
+                        "supported": False,
+                        "reason": "matrix kernel inadmissible past 1024 "
+                                  "(int32 qty-sum wrap + [C, C] "
+                                  "intermediates)",
+                    })
+                    print(f"[capacity-sweep] {kern}@{cap}: unsupported",
+                          file=sys.stderr)
+                    continue
+                kcfg = EngineConfig(
+                    num_symbols=S, capacity=cap, batch=B,
+                    max_fills=1 << 15, kernel=kern)
+                p_arrays = build_batch_arrays(kcfg, prefill)
+                c_arrays = build_batch_arrays(kcfg, churn)
+                n_real = sum(int(np.count_nonzero(a[:, :, 0]))
+                             for a in c_arrays)
+
+                book = init_book(kcfg)
+                for arr in p_arrays:
+                    book, _ = engine_step_packed(kcfg, book, arr)
+                jax.block_until_ready(book)
+                host_book = type(book)(*(np.asarray(x) for x in book))
+
+                def one_pass():
+                    b = jax.device_put(host_book)
+                    t0 = time.perf_counter()
+                    out = None
+                    for arr in c_arrays:
+                        b, out = engine_step_packed(kcfg, b, arr)
+                    jax.block_until_ready((b, out.small))
+                    return n_real / (time.perf_counter() - t0)
+
+                one_pass()  # warm the jit cache (compile excluded)
+                rates = [one_pass() for _ in range(max(1, args.repeats))]
+                rows.append({
+                    "kernel": kern, "capacity": cap, "supported": True,
+                    "levels": ([lvl, fifo] if kern == "levels" else None),
+                    "depth_per_side": depth,
+                    "measured_ops": n_real,
+                    "orders_per_s": round(max(rates), 1),
+                    "orders_per_s_spread": [round(min(rates), 1),
+                                            round(max(rates), 1)],
+                    "repeats": len(rates),
+                })
+                print(f"[capacity-sweep] {kern}@{cap} depth {depth}: "
+                      f"{max(rates):,.0f} orders/s "
+                      f"(spread {min(rates):,.0f}-{max(rates):,.0f})",
+                      file=sys.stderr)
+        return rows
+
     grid_cap = args.symbols * args.batch
     mega_list = [int(x) for x in args.megadispatch.split(",")
                  if x.strip()] if args.megadispatch else []
     shard_list = [int(k) for k in args.serve_shards.split(",")
                   if k.strip()] if args.serve_shards else []
-    if args.workload:
+    if args.capacity_sweep:
+        rows = capacity_sweep()
+    elif args.workload:
         rows = workload_sweep()
     elif args.edge_batch:
         rows = edge_sweep()
@@ -1356,7 +1568,8 @@ def main() -> None:
     except Exception:  # noqa: BLE001
         rev = "unknown"
     out = {
-        "metric": ("workload_replay" if args.workload
+        "metric": ("kernel_capacity_sweep" if args.capacity_sweep
+                   else "workload_replay" if args.workload
                    else "batch_edge_audit_ab" if args.edge_batch
                    and args.audit_ab
                    else "batch_edge_throughput" if args.edge_batch

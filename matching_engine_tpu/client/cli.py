@@ -618,9 +618,13 @@ def _simulate(argv: list[str]) -> int:
     # Heavy imports gated behind the verb: the other subcommands must not
     # pay jax startup.
     from matching_engine_tpu.engine.book import EngineConfig
-    from matching_engine_tpu.sim.agents import AgentMix
     from matching_engine_tpu.sim.record import record_scenario
-    from matching_engine_tpu.sim.scenarios import make_scenario
+    from matching_engine_tpu.sim.scenarios import (
+        default_mix,
+        make_scenario,
+        recording_capacity,
+        recording_kernel,
+    )
     from matching_engine_tpu.utils.metrics import Metrics
 
     try:
@@ -628,9 +632,11 @@ def _simulate(argv: list[str]) -> int:
     except ValueError as e:
         print(f"[client] {e}", file=sys.stderr)
         return 1
-    mix = AgentMix()
-    cfg = EngineConfig(num_symbols=symbols, capacity=128,
-                       batch=mix.batch_for(), max_fills=1 << 15)
+    mix = default_mix(scenario_name)
+    rcap = recording_capacity(mix, scenario_name)
+    cfg = EngineConfig(num_symbols=symbols, capacity=rcap,
+                       batch=mix.batch_for(), max_fills=1 << 15,
+                       kernel=recording_kernel(rcap))
     metrics = Metrics()
     try:
         manifest = record_scenario(cfg, mix, scenario, seed=seed or 0,

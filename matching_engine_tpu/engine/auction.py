@@ -150,18 +150,43 @@ def _records_one(fill_b, fill_a, start_b, start_a, bid_oid, ask_oid):
 
 
 def apply_uncross(book: BookBatch, fill_b, fill_a, apply,
-                  kernel: str = "matrix") -> BookBatch:
+                  kernel: str = "matrix", levels: int = 0) -> BookBatch:
     """Decrement both sides' executed quantities where `apply` ([S] bool)
     holds — THE one book-update rule for single-device and mesh uncross.
 
     Under the sorted-book kernel (EngineConfig.kernel == "sorted") the
     fully-filled makers' holes are re-packed so the dense-sorted-prefix
     invariant survives the auction: decrements never change relative
-    priority order, so an order-preserving compact restores it exactly."""
+    priority order, so an order-preserving compact restores it exactly.
+    Under the levels kernel the same repack runs PER FIFO ROW (each side's
+    [C] plane viewed as [levels, C // levels]) so every level keeps its
+    dense FIFO prefix."""
     out = book._replace(
         bid_qty=book.bid_qty - jnp.where(apply[:, None], fill_b, 0),
         ask_qty=book.ask_qty - jnp.where(apply[:, None], fill_a, 0),
     )
+    if kernel == "levels":
+        from matching_engine_tpu.engine.kernel_sorted import _compact
+
+        s, cap = out.bid_qty.shape
+        fifo = cap // levels
+
+        def repack(qty, price, oid, seq, owner):
+            def r(x):
+                return x.reshape(s * levels, fifo)
+
+            q2, p2, o2, sq2, w2 = jax.vmap(_compact)(
+                r(qty), r(price), r(oid), r(seq), r(owner))
+            return tuple(x.reshape(s, cap) for x in (q2, p2, o2, sq2, w2))
+
+        bq, bp, bo, bs, bw = repack(out.bid_qty, out.bid_price, out.bid_oid,
+                                    out.bid_seq, out.bid_owner)
+        aq, ap, ao, as_, aw = repack(out.ask_qty, out.ask_price, out.ask_oid,
+                                     out.ask_seq, out.ask_owner)
+        return out._replace(
+            bid_qty=bq, bid_price=bp, bid_oid=bo, bid_seq=bs, bid_owner=bw,
+            ask_qty=aq, ask_price=ap, ask_oid=ao, ask_seq=as_, ask_owner=aw,
+        )
     if kernel != "sorted":
         return out
     from matching_engine_tpu.engine.kernel_sorted import _compact
@@ -207,10 +232,11 @@ def uncross_and_records(cfg: EngineConfig, book: BookBatch, mask):
 
     Matrix-kernel books use the [C, C] formulation above (its int32
     volume sums are exact at matrix capacities — EngineConfig pins
-    capacity <= 1024 < 2^31 / MAX_QUANTITY); sorted-kernel books use the
-    O(C log C) wide-sum formulation (engine/auction_sorted.py), exact at
-    any supported depth."""
-    if cfg.kernel == "sorted":
+    capacity <= 1024 < 2^31 / MAX_QUANTITY); sorted- and levels-kernel
+    books use the O(C log C) wide-sum formulation
+    (engine/auction_sorted.py — it priority-sorts its input lanes first,
+    so any lane layout is admissible), exact at any supported depth."""
+    if cfg.kernel in ("sorted", "levels"):
         from matching_engine_tpu.engine.auction_sorted import (
             _uncross_records_one,
         )
@@ -253,7 +279,7 @@ def auction_step(cfg: EngineConfig, book: BookBatch, mask: jax.Array):
 
     # All-or-nothing: an overflow leaves every book untouched.
     new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
-                             kernel=cfg.kernel)
+                             kernel=cfg.kernel, levels=cfg.levels)
 
     # Stage 2: global compaction over the per-symbol record lanes
     # (row-major, so records stay symbol-major in per-symbol rank order).

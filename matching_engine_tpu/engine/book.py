@@ -43,15 +43,33 @@ class EngineConfig:
     max_fills: int = 1 << 15     # global fill-buffer slots per engine step
     # Match formulation: "matrix" = the [CAP, CAP] priority-matrix kernel
     # (engine/kernel.py), "sorted" = the O(CAP) dense-sorted-prefix kernel
-    # (engine/kernel_sorted.py). Both bit-match the oracle; books are NOT
-    # interchangeable between them mid-lifetime (the matrix kernel leaves
-    # holes; the sorted kernel requires its invariant), so the choice is
-    # part of semantic_key and a checkpoint from the other kernel restores
-    # via full replay.
+    # (engine/kernel_sorted.py), "levels" = the price-level [L, F] kernel
+    # (engine/kernel_levels.py: L level rows x F FIFO slots per side, match
+    # sweep over levels instead of orders). All bit-match the oracle
+    # (kernel="levels" against the level-capacity-aware oracle); books are
+    # NOT interchangeable between kernels mid-lifetime (each layout has its
+    # own invariant), so the choice is part of semantic_key and a
+    # checkpoint from another kernel restores via full replay.
     kernel: str = "matrix"
+    # kernel="levels" only: price-level rows per book side (the book's [C]
+    # lane plane is viewed as [levels, capacity // levels]). 0 = derive a
+    # default from capacity at construction (normalized in __post_init__,
+    # so two configs spelling the same choice compare equal). Must divide
+    # capacity. A submit at a NEW price when all `levels` rows are live, or
+    # at an EXISTING price whose FIFO row is full, is a (metered) capacity
+    # reject even below total capacity — the oracle models the same rule.
+    levels: int = 0
+    # Tiered capacity classes (server/tiered_runner.py): a static partition
+    # of the symbol axis into contiguous groups, each with its own book
+    # capacity — ((count, capacity), ...), sum of counts == num_symbols.
+    # The jit'd kernels never see a tiered config (the tiered runner steps
+    # one per-tier sub-config each); `capacity` must equal the deepest
+    # tier. Part of semantic_key: a checkpoint written under one tier spec
+    # refuses to restore under another (full-replay fallback).
+    tiers: tuple = ()
 
     def __post_init__(self):
-        assert self.kernel in ("matrix", "sorted"), self.kernel
+        assert self.kernel in ("matrix", "sorted", "levels"), self.kernel
         if self.kernel == "matrix":
             # The matrix kernel accumulates qty sums at int32 lane width
             # (capacity * MAX_QUANTITY must not wrap) and materializes
@@ -59,13 +77,48 @@ class EngineConfig:
             assert self.capacity <= 1024, \
                 "matrix kernel: capacity beyond 1024 breaks int32 qty sums"
         else:
-            # The sorted kernel switches its ahead-of-maker accumulator
-            # to a SATURATING int32 prefix sum when capacity *
+            # The sorted/levels kernels switch their ahead-of-maker
+            # accumulators to SATURATING int32 prefix sums when capacity *
             # MAX_QUANTITY could wrap (venue-depth books; exact below
             # saturation, clamped far past any take quantity above it —
-            # kernel_sorted.py); 8192 bounds the shift/scatter shapes.
+            # kernel_sorted.py / kernel_levels.py); 8192 bounds the
+            # shift/scatter shapes.
             assert self.capacity <= 8192, \
-                "sorted kernel: capacity beyond 8192 unsupported"
+                f"{self.kernel} kernel: capacity beyond 8192 unsupported"
+        if self.kernel == "levels":
+            if self.levels == 0:
+                object.__setattr__(
+                    self, "levels", default_levels(self.capacity))
+            assert 1 <= self.levels <= self.capacity, self.levels
+            assert self.capacity % self.levels == 0, \
+                f"levels {self.levels} must divide capacity {self.capacity}"
+        else:
+            assert self.levels == 0, \
+                "levels is only meaningful for kernel='levels'"
+        if self.tiers:
+            # Normalize to a tuple of int pairs: checkpoint meta round-
+            # trips through JSON (lists of lists), and semantic_key /
+            # equality must not depend on the container spelling.
+            object.__setattr__(
+                self, "tiers",
+                tuple((int(n), int(c)) for n, c in self.tiers))
+            counts = [t[0] for t in self.tiers]
+            caps = [t[1] for t in self.tiers]
+            # ValueError, not assert: these validate OPERATOR input
+            # (--book-tiers) and must survive `python -O`.
+            if not all(c > 0 for c in counts) or not all(
+                    c >= 1 for c in caps):
+                raise ValueError(f"non-positive tier in {self.tiers}")
+            if sum(counts) != self.num_symbols:
+                raise ValueError(
+                    f"tier symbol counts {counts} must sum to "
+                    f"num_symbols {self.num_symbols}")
+            if self.capacity != max(caps):
+                raise ValueError(
+                    "capacity must equal the deepest tier's capacity")
+            if self.kernel == "matrix" and max(caps) > 1024:
+                raise ValueError(
+                    "matrix kernel: tier capacity beyond 1024")
 
     def semantic_key(self) -> tuple:
         """The fields that define book/kernel SEMANTICS (shapes, buffer
@@ -73,7 +126,43 @@ class EngineConfig:
         knobs that may be added later. Checkpoint compatibility compares
         this."""
         return (self.num_symbols, self.capacity, self.batch, self.max_fills,
-                self.kernel)
+                self.kernel, self.levels, tuple(self.tiers))
+
+    def tier_configs(self) -> list:
+        """The per-tier sub-configs the tiered runner steps (empty when
+        untiered). Each is a plain single-capacity EngineConfig over the
+        tier's contiguous symbol rows; kernel='levels' re-derives its
+        per-tier level count from the tier's own capacity."""
+        import dataclasses as _dc
+
+        return [
+            _dc.replace(self, num_symbols=n, capacity=cap, tiers=(),
+                        levels=0)
+            for n, cap in self.tiers
+        ]
+
+
+def default_levels(capacity: int) -> int:
+    """Default price-level row count for kernel='levels': aim for 16 rows
+    on shallow books and 64-slot FIFO rows on deep ones, then settle on
+    the largest divisor of `capacity` at or under that target (levels must
+    tile the lane plane exactly)."""
+    if capacity <= 64:
+        target = max(2, capacity // 4)
+    else:
+        target = max(16, capacity // 64)
+    target = min(target, 256, capacity)
+    for cand in range(target, 0, -1):
+        if capacity % cand == 0:
+            return cand
+    return 1
+
+
+def level_shape(cfg: EngineConfig) -> tuple[int, int]:
+    """(L, F) of a levels-kernel config: L price-level rows of F FIFO
+    slots each; L * F == capacity."""
+    assert cfg.kernel == "levels", cfg.kernel
+    return cfg.levels, cfg.capacity // cfg.levels
 
 
 def auction_capacity_max(kernel: str = "matrix") -> int:
@@ -81,11 +170,15 @@ def auction_capacity_max(kernel: str = "matrix") -> int:
     kernel. Matrix books use the [C, C] formulation whose int32
     demand/supply sums are exact up to 2^31 / MAX_QUANTITY (= 1073 —
     above the matrix kernel's own 1024 capacity bound, so every matrix
-    config can auction). Sorted books use the O(C log C) wide-sum
-    formulation (engine/auction_sorted.py), exact at every capacity the
-    sorted kernel itself supports — both market mechanisms now cover the
-    full venue-depth range (VERDICT r4 missing #4 closed)."""
-    return 8192 if kernel == "sorted" else (2**31 - 1) // MAX_QUANTITY
+    config can auction). Sorted and levels books use the O(C log C)
+    wide-sum formulation (engine/auction_sorted.py — it priority-sorts its
+    input, so it is correct for ANY lane order, the levels layout
+    included), exact at every capacity those kernels themselves support —
+    both market mechanisms cover the full venue-depth range (VERDICT r4
+    missing #4 closed)."""
+    if kernel in ("sorted", "levels"):
+        return 8192
+    return (2**31 - 1) // MAX_QUANTITY
 
 
 class BookBatch(NamedTuple):

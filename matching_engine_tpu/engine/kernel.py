@@ -320,6 +320,12 @@ def engine_step_core(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
         )
 
         return engine_step_sorted_core(cfg, book, orders)
+    if cfg.kernel == "levels":
+        from matching_engine_tpu.engine.kernel_levels import (
+            engine_step_levels_core,
+        )
+
+        return engine_step_levels_core(cfg, book, orders)
     sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
     # vmap over the symbol axis; scan over the batch axis inside.
     new_sym_book, raw = jax.vmap(_sym_scan)(sym_book, orders)
@@ -338,8 +344,9 @@ def engine_step_impl(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):
     priority-matrix broadcasts relayout poorly under Mosaic).
 
     cfg.kernel selects the formulation at trace time: "matrix" (this
-    file's [CAP, CAP] priority matrix) or "sorted" (kernel_sorted.py's
-    O(CAP) dense-sorted-prefix variant) — every serving path (packed
+    file's [CAP, CAP] priority matrix), "sorted" (kernel_sorted.py's
+    O(CAP) dense-sorted-prefix variant) or "levels" (kernel_levels.py's
+    price-level [L, F] FIFO-row variant) — every serving path (packed
     dense, sparse, shard_map mesh) dispatches through here, so the
     config knob covers them all."""
     new_book, (status, filled, remaining, f_oid, f_qty, f_price) = (

@@ -1,0 +1,359 @@
+"""Price-level book kernel: O(levels) match sweep over [L, F] FIFO rows.
+
+The third match formulation (beside kernel.py's [CAP, CAP] priority matrix
+and kernel_sorted.py's dense sorted prefix), and the classic design real
+venues use ("The World's Fastest Matching Engine Algorithm",
+arXiv:2606.01183; KineticSim, arXiv:2606.21784): the book is **price
+levels with per-level FIFO queues**, so the hot-path match decision runs
+at level granularity — O(L) price comparisons and one [L] prefix sum —
+instead of per-resting-order work that grows with raw capacity. At venue
+depth (capacity 8192) the matrix kernel is inadmissible ([C, C]
+intermediates, int32 sum wrap) and the sorted kernel's per-order
+shift/compact sweeps pay O(C) lanes per op whether the book is deep or
+empty; here the per-op work concentrates in [L]- and [F]-width vectors
+(L, F ~ sqrt-ish factors of C), with only cheap elementwise masks left at
+full [L, F] = [C] width.
+
+Layout: the standard BookBatch [S, C] lane planes, with each side's [C]
+plane viewed as [L, F] (L = cfg.levels rows, F = C // L FIFO slots per
+row). Invariant per side:
+
+- a row is either EMPTY (all qty 0) or carries one price level: its live
+  slots form a dense prefix along F, all share one price, in seq (FIFO =
+  price-time) order;
+- distinct live rows carry distinct prices; row ORDER is arbitrary (no
+  shifting level directory — a freed row is simply reused).
+
+Because "qty == 0 marks a free slot and every read masks on qty > 0"
+still holds (the book.py core invariant), everything layout-agnostic
+composes untouched: init_book, checkpoint encode/restore, snapshot_books,
+book_snapshot joins, _top_of_book, crossed_symbols, seq rebasing
+(position-preserving), and the wide-sum auction uncross (auction_sorted
+priority-sorts its input lanes, so the levels layout needs no special
+casing there — only apply_uncross re-packs the row prefixes afterwards).
+
+Capacity semantics (the metered-backpressure contract): a LIMIT remainder
+rests iff its price level has FIFO room — an existing row with a free
+slot, or a free row for a new price. A full row (F orders at one price)
+or a full level directory (L live prices) REJECTS the rest even below
+total capacity; the oracle (engine/oracle.py, levels/level_fifo params)
+models the identical rule, and the serving layer meters every such
+reject as book-capacity backpressure (me_book_capacity_rejects_total).
+
+Everything else — eligibility, STP, FOK, statuses, fill-log rank
+contract, finalize_step — is shared with or identical to the sibling
+kernels; bit-parity with the level-aware oracle is pinned by
+tests/test_kernel_levels.py and the lifecycle-fuzz/megadispatch legs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.book import (
+    I32,
+    BookBatch,
+    EngineConfig,
+    OrderBatch,
+    level_shape,
+)
+from matching_engine_tpu.engine.kernel import (
+    BUY,
+    CANCELED,
+    FILLED,
+    LIMIT_FOK,
+    LIMIT_IOC,
+    MARKET,
+    MARKET_FOK,
+    NEW,
+    NOOP_STATUS,
+    OP_AMEND,
+    OP_CANCEL,
+    OP_REST,
+    OP_SUBMIT,
+    PARTIALLY_FILLED,
+    REJECTED,
+    _SymBook,
+    finalize_step,
+)
+IMAX = jnp.iinfo(jnp.int32).max
+# Plain Python int, cast at trace time: a module-level jnp constant would
+# be created inside whatever jit trace first imports this module (the
+# engine_step_core dispatch imports lazily) and leak as a tracer.
+_SAT = (1 << 30) - 1
+
+
+def _cumsum_sat(x, axis, saturate: bool):
+    """Inclusive cumsum; saturating min(a+b, 2^30-1) when quantity sums
+    could wrap int32 (same exactness argument as kernel_sorted: saturation
+    is only reached far past any take quantity, where the fill is zero
+    regardless, so the allocation stays exact)."""
+    if saturate:
+        sat = jnp.int32(_SAT)
+        return jax.lax.associative_scan(
+            lambda a, b: jnp.minimum(a + b, sat), x, axis=axis)
+    return jnp.cumsum(x, axis=axis)
+
+
+def _compact_rows(qty, *arrays):
+    """Re-pack every row's live slots into a dense FIFO prefix (order
+    preserved; freed tail slots zero).
+
+    GATHER formulation, not kernel_sorted's cumsum-scatter: output slot
+    f of row l reads the (f+1)-th live slot (searchsorted into the
+    row's inclusive live-count cumsum). XLA-CPU scatters cost ~40x a
+    same-size gather (measured; docs/BENCH_METHOD.md §capacity-sweep),
+    and this repack runs twice per op — it is the levels kernel's
+    hottest fixed cost at depth."""
+    fifo = qty.shape[1]
+    keep = (qty > 0).astype(I32)
+    cnt = jnp.cumsum(keep, axis=1)                      # inclusive
+    j = jnp.arange(1, fifo + 1, dtype=I32)
+    src = jax.vmap(lambda c: jnp.searchsorted(c, j, side="left"))(cnt)
+    valid = j[None, :] <= cnt[:, -1:]
+    src = jnp.clip(src, 0, fifo - 1)
+
+    def g(x):
+        return jnp.where(valid, jnp.take_along_axis(x, src, axis=1), 0)
+
+    return (g(qty), *(g(x) for x in arrays))
+
+
+def _match_one_levels(book: _SymBook, order, lvl: int, fifo: int,
+                      saturate: bool):
+    """Apply one order to one LEVELS book (see module docstring invariant).
+    Same return contract as kernel._match_one; `lvl`/`fifo`/`saturate`
+    are trace-time statics."""
+    op, side, otype, price, qty, oid, owner = (
+        order.op, order.side, order.otype, order.price, order.qty,
+        order.oid, order.owner,
+    )
+    is_submit = op == OP_SUBMIT
+    is_cancel = op == OP_CANCEL
+    is_rest = op == OP_REST
+    is_amend = op == OP_AMEND
+    is_submit_like = is_submit | is_rest
+    is_buy = side == BUY
+    # Same tif collapse as kernel._match_one.
+    px_any = (otype == MARKET) | (otype == MARKET_FOK)
+    is_fok = (otype == LIMIT_FOK) | (otype == MARKET_FOK)
+    never_rests = px_any | (otype == LIMIT_IOC) | (otype == LIMIT_FOK)
+    cap = lvl * fifo
+
+    def rows(x):
+        return x.reshape(lvl, fifo)
+
+    # ---- opposite side (maker candidates), [L, F] rows -------------------
+    opp_price = rows(jnp.where(is_buy, book.ask_price, book.bid_price))
+    opp_qty = rows(jnp.where(is_buy, book.ask_qty, book.bid_qty))
+    opp_oid = rows(jnp.where(is_buy, book.ask_oid, book.bid_oid))
+    opp_seq = rows(jnp.where(is_buy, book.ask_seq, book.bid_seq))
+    opp_owner = rows(jnp.where(is_buy, book.ask_owner, book.bid_owner))
+
+    live = opp_qty > 0
+    row_live = live[:, 0]          # dense prefix: row live iff slot 0 live
+    row_price = opp_price[:, 0]    # the level price (shared across the row)
+    # Direction-normalized level key: smaller = better maker priority.
+    key = jnp.where(is_buy, row_price, -row_price)
+    price_ok_row = jnp.where(is_buy, row_price <= price, row_price >= price)
+    not_self = (owner == 0) | (opp_owner != owner)
+    elig = live & (px_any | price_ok_row[:, None]) & is_submit & not_self
+    self_blocked = is_submit & (~never_rests) & jnp.any(
+        live & price_ok_row[:, None] & (owner != 0) & (opp_owner == owner))
+
+    # The O(L) sweep: per-level eligible volume, cumulated in level
+    # priority order (argsort of the level keys; dead rows sort last, and
+    # live rows carry distinct prices so live keys never tie).
+    elig_qty = jnp.where(elig, opp_qty, 0)
+    in_cum = _cumsum_sat(elig_qty, 1, saturate)   # within-row inclusive
+    row_elig_qty = in_cum[:, -1]
+    order_ix = jnp.argsort(jnp.where(row_live, key, IMAX))
+    sorted_q = row_elig_qty[order_ix]
+    cum = _cumsum_sat(sorted_q, 0, saturate)
+    row_ahead = jnp.zeros((lvl,), I32).at[order_ix].set(cum - sorted_q)
+
+    # Per-slot ahead = level ahead + within-row exclusive FIFO cumsum.
+    # Both terms saturate at 2^30-1, so their sum fits int32; either one
+    # at/"past" saturation already exceeds any take quantity (fill 0).
+    ahead = row_ahead[:, None] + (in_cum - elig_qty)
+
+    # Fill-or-kill gate: the level cumsum's last element is the total
+    # eligible liquidity (saturates far above MAX_QUANTITY >= qty, so the
+    # comparison is exact either way).
+    avail = cum[-1]
+    fok_fail = is_fok & (avail < qty)
+
+    take_q = jnp.where(is_submit_like & ~fok_fail, qty, 0)
+    fill = jnp.where(elig, jnp.clip(take_q - ahead, 0, opp_qty), 0)
+    filled_total = jnp.sum(fill)
+    remaining = jnp.where(is_submit_like, qty, 0) - filled_total
+
+    # Priority rank among eligible makers = level rank base (exclusive
+    # count of eligible makers on better levels) + within-row exclusive
+    # eligibility count — the same unique prefix-dense ranks the sibling
+    # kernels scatter the fill log by.
+    elig_i = elig.astype(I32)
+    row_cnt = jnp.sum(elig_i, axis=1)
+    sorted_cnt = row_cnt[order_ix]
+    cnt_cum = jnp.cumsum(sorted_cnt)
+    rank_base = jnp.zeros((lvl,), I32).at[order_ix].set(cnt_cum - sorted_cnt)
+    rank = rank_base[:, None] + (jnp.cumsum(elig_i, axis=1) - elig_i)
+    has_fill = fill > 0
+    slot = jnp.where(has_fill, rank, cap).reshape(-1)
+    fill_oid = jnp.zeros((cap + 1,), I32).at[slot].set(
+        jnp.where(has_fill, opp_oid, 0).reshape(-1))[:cap]
+    fill_qty_out = jnp.zeros((cap + 1,), I32).at[slot].set(
+        fill.reshape(-1))[:cap]
+    fill_price = jnp.zeros((cap + 1,), I32).at[slot].set(
+        jnp.where(has_fill, opp_price, 0).reshape(-1))[:cap]
+
+    # Consumed makers leave holes in their rows' FIFO prefixes (a skipped
+    # self-owned maker can sit ahead of a consumed one): re-pack per row.
+    new_opp_qty, opp_price, opp_oid, opp_seq, opp_owner = _compact_rows(
+        opp_qty - fill, opp_price, opp_oid, opp_seq, opp_owner)
+
+    # ---- own side: FIFO-append a LIMIT remainder, or cancel/amend --------
+    own_price = rows(jnp.where(is_buy, book.bid_price, book.ask_price))
+    own_qty = rows(jnp.where(is_buy, book.bid_qty, book.ask_qty))
+    own_oid = rows(jnp.where(is_buy, book.bid_oid, book.ask_oid))
+    own_seq = rows(jnp.where(is_buy, book.bid_seq, book.ask_seq))
+    own_owner = rows(jnp.where(is_buy, book.bid_owner, book.ask_owner))
+
+    own_live = own_qty > 0
+    orow_live = own_live[:, 0]
+    orow_price = own_price[:, 0]
+    orow_cnt = jnp.sum(own_live.astype(I32), axis=1)
+
+    match_row = orow_live & (orow_price == price)
+    has_row = jnp.any(match_row)
+    row_i = jnp.argmax(match_row)
+    free_rows = ~orow_live
+    has_free_row = jnp.any(free_rows)
+    new_row_i = jnp.argmax(free_rows)
+    target_row = jnp.where(has_row, row_i, new_row_i)
+    cnt_t = orow_cnt[target_row]
+    target_slot = jnp.where(has_row, cnt_t, 0)
+    # Level-structured capacity: an existing level rests at its FIFO tail
+    # (if the row has room), a new price claims a free row (if the level
+    # directory has one). No room either way = capacity REJECT.
+    room = jnp.where(has_row, cnt_t < fifo, has_free_row)
+
+    do_rest = is_submit_like & (~never_rests) & (remaining > 0) & ~self_blocked
+    rested = do_rest & room
+
+    li = jnp.arange(lvl)[:, None]
+    fi = jnp.arange(fifo)[None, :]
+    at_slot = rested & (li == target_row) & (fi == target_slot)
+    own_price = jnp.where(at_slot, price, own_price)
+    own_qty = jnp.where(at_slot, remaining, own_qty)
+    own_oid = jnp.where(at_slot, oid, own_oid)
+    own_seq = jnp.where(at_slot, book.next_seq, own_seq)
+    own_owner = jnp.where(at_slot, owner, own_owner)
+    next_seq = book.next_seq + jnp.where(rested, 1, 0).astype(I32)
+
+    cancel_mask = is_cancel & (own_oid == oid) & own_live
+    cancel_qty = jnp.sum(jnp.where(cancel_mask, own_qty, 0))
+    cancel_ok = jnp.any(cancel_mask)
+    # Amend down in place: qty drops but stays > 0 — row density and FIFO
+    # position untouched, so the compact below is an identity for amends.
+    amend_mask = is_amend & (own_oid == oid) & own_live
+    amend_feasible = amend_mask & (qty > 0) & (qty < own_qty)
+    amend_ok = jnp.any(amend_feasible)
+    c_qty = jnp.where(cancel_mask, 0,
+                      jnp.where(amend_feasible, qty, own_qty))
+    own_qty2, own_price2, own_oid2, own_seq2, own_owner2 = _compact_rows(
+        c_qty, own_price, own_oid, own_seq, own_owner)
+
+    def flat(x):
+        return x.reshape(cap)
+
+    new_book = _SymBook(
+        bid_price=flat(jnp.where(is_buy, own_price2, opp_price)),
+        bid_qty=flat(jnp.where(is_buy, own_qty2, new_opp_qty)),
+        bid_oid=flat(jnp.where(is_buy, own_oid2, opp_oid)),
+        bid_seq=flat(jnp.where(is_buy, own_seq2, opp_seq)),
+        bid_owner=flat(jnp.where(is_buy, own_owner2, opp_owner)),
+        ask_price=flat(jnp.where(is_buy, opp_price, own_price2)),
+        ask_qty=flat(jnp.where(is_buy, new_opp_qty, own_qty2)),
+        ask_oid=flat(jnp.where(is_buy, opp_oid, own_oid2)),
+        ask_seq=flat(jnp.where(is_buy, opp_seq, own_seq2)),
+        ask_owner=flat(jnp.where(is_buy, opp_owner, own_owner2)),
+        next_seq=next_seq,
+    )
+
+    # ---- status (identical decision tree to kernel._match_one) -----------
+    submit_status = jnp.where(
+        remaining == 0,
+        FILLED,
+        jnp.where(
+            never_rests | self_blocked,
+            CANCELED,
+            jnp.where(
+                rested,
+                jnp.where(filled_total > 0, PARTIALLY_FILLED, NEW),
+                REJECTED,  # level row full / level directory full
+            ),
+        ),
+    )
+    cancel_status = jnp.where(cancel_ok, CANCELED, REJECTED)
+    amend_status = jnp.where(amend_ok, NEW, REJECTED)
+    status = jnp.where(
+        is_submit_like,
+        submit_status,
+        jnp.where(
+            is_cancel, cancel_status,
+            jnp.where(is_amend, amend_status, NOOP_STATUS)),
+    ).astype(I32)
+    out_remaining = jnp.where(
+        is_submit_like, remaining,
+        jnp.where(is_cancel, cancel_qty,
+                  jnp.where(is_amend & amend_ok, qty, 0))
+    ).astype(I32)
+
+    return new_book, (
+        status,
+        filled_total.astype(I32),
+        out_remaining,
+        fill_oid,
+        fill_qty_out,
+        fill_price,
+    )
+
+
+def _sym_scan_levels(lvl, fifo, saturate, book: _SymBook, orders):
+    return jax.lax.scan(
+        lambda b, o: _match_one_levels(b, o, lvl, fifo, saturate),
+        book, orders)
+
+
+def engine_step_levels_core(cfg: EngineConfig, book: BookBatch,
+                            orders: OrderBatch):
+    """Raw levels-formulation match pass (same contract as
+    kernel.engine_step_core): no finalize epilogue, so the megadispatch
+    scan can compact per wave instead."""
+    from functools import partial
+
+    from matching_engine_tpu.engine.book import MAX_QUANTITY
+
+    lvl, fifo = level_shape(cfg)
+    saturate = cfg.capacity * MAX_QUANTITY >= 2**31
+    sym_book = _SymBook(*book[:-1], next_seq=book.next_seq)
+    new_sym_book, raw = jax.vmap(
+        partial(_sym_scan_levels, lvl, fifo, saturate))(sym_book, orders)
+    return BookBatch(*new_sym_book[:-1], next_seq=new_sym_book.next_seq), raw
+
+
+def engine_step_levels_impl(cfg: EngineConfig, book: BookBatch,
+                            orders: OrderBatch):
+    """Un-jitted levels-formulation step (same contract as
+    kernel.engine_step_impl; shares finalize_step)."""
+    new_book, (status, filled, remaining, f_oid, f_qty, f_price) = (
+        engine_step_levels_core(cfg, book, orders))
+    return new_book, finalize_step(
+        cfg, new_book, orders, status, filled, remaining, f_oid, f_qty,
+        f_price)
+
+
+engine_step_levels = jax.jit(engine_step_levels_impl, static_argnums=0,
+                             donate_argnums=1)

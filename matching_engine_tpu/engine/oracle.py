@@ -75,10 +75,21 @@ class _Resting:
 
 
 class OracleBook:
-    """Single-symbol CLOB with fixed per-side capacity."""
+    """Single-symbol CLOB with fixed per-side capacity.
 
-    def __init__(self, capacity: int = 256):
+    With `levels`/`level_fifo` set, capacity is LEVEL-STRUCTURED (the
+    kernel_levels.py contract): a side holds at most `levels` distinct
+    live prices, each with at most `level_fifo` resting orders; a rest at
+    a new price with the level directory full, or at an existing price
+    whose FIFO is full, REJECTS even below total capacity. Matching
+    semantics are identical either way."""
+
+    def __init__(self, capacity: int = 256, levels: int | None = None,
+                 level_fifo: int | None = None):
         self.capacity = capacity
+        self.levels = levels
+        self.level_fifo = level_fifo
+        assert (levels is None) == (level_fifo is None)
         self.bids: list[_Resting] = []
         self.asks: list[_Resting] = []
         self.next_seq = 0
@@ -90,6 +101,15 @@ class OracleBook:
 
     def _own(self, side: int) -> list[_Resting]:
         return self.bids if side == pb2.BUY else self.asks
+
+    def _side_full(self, own: list[_Resting], price_q4: int) -> bool:
+        """Would a rest at `price_q4` exceed this side's capacity?"""
+        if self.levels is None:
+            return len(own) >= self.capacity
+        at_level = sum(1 for r in own if r.price_q4 == price_q4)
+        if at_level:
+            return at_level >= self.level_fifo
+        return len({r.price_q4 for r in own}) >= self.levels
 
     def _priority_sorted(self, side_of_resting: int, resting: list[_Resting]):
         # Lowest ask first / highest bid first; FIFO (seq) within a level.
@@ -170,7 +190,7 @@ class OracleBook:
                                    tuple(fills))
 
         own = self._own(side)
-        if len(own) >= self.capacity:
+        if self._side_full(own, price_q4):
             return OrderResult(oid, REJECTED, filled, remaining, False, tuple(fills))
         own.append(_Resting(oid, price_q4, remaining, self.next_seq, owner))
         self.next_seq += 1
@@ -184,7 +204,7 @@ class OracleBook:
         when the side is at capacity."""
         assert qty > 0
         own = self._own(side)
-        if len(own) >= self.capacity:
+        if self._side_full(own, price_q4):
             return OrderResult(oid, REJECTED, 0, qty, False, ())
         own.append(_Resting(oid, price_q4, qty, self.next_seq, owner))
         self.next_seq += 1

@@ -225,7 +225,8 @@ class ShardedEngine:
             # PER-SHARD all-or-nothing (no collective — see docstring).
             aborted = local_total > n
             new_book = apply_uncross(book, fill_b, fill_a, mask & ~aborted,
-                                     kernel=local_cfg.kernel)
+                                     kernel=local_cfg.kernel,
+                                     levels=local_cfg.levels)
             r = rec_qty.shape[1]
             off = jax.lax.axis_index(AXIS).astype(I32) * local_s
             sym_ids = jnp.broadcast_to(

@@ -197,14 +197,24 @@ class EngineRunner:
             self._host = jax.process_index()
         else:
             self._sharded = None
-            self.book = init_book(cfg)
-            if device is not None:
-                # Partitioned serving (server/shards.py): pin this lane's
-                # books to one device. The book is COMMITTED there, so
-                # every jit'd step (whose other inputs are host numpy)
-                # runs on — and donates back to — that device; K lanes on
-                # K chips dispatch with no collectives between them.
-                self.book = jax.device_put(self.book, device)
+            if cfg.tiers:
+                # Tiered capacity classes: the TieredEngineRunner subclass
+                # owns one book PER TIER (server/tiered_runner.py); a
+                # single [S, max_capacity] book here would allocate
+                # exactly the memory the tiers exist to avoid.
+                assert type(self).__name__ != "EngineRunner", \
+                    "a tiered EngineConfig needs TieredEngineRunner"
+                self.book = None
+            else:
+                self.book = init_book(cfg)
+                if device is not None:
+                    # Partitioned serving (server/shards.py): pin this
+                    # lane's books to one device. The book is COMMITTED
+                    # there, so every jit'd step (whose other inputs are
+                    # host numpy) runs on — and donates back to — that
+                    # device; K lanes on K chips dispatch with no
+                    # collectives between them.
+                    self.book = jax.device_put(self.book, device)
             self._slot_lo, self._slot_hi = 0, cfg.num_symbols
             self._n_hosts, self._host = 1, 0
         self.device = device
@@ -386,6 +396,17 @@ class EngineRunner:
         self.slot_symbols[slot] = symbol
         return slot
 
+    def rebuild_slot_allocator(self) -> None:
+        """Recompute the slot allocator from the (restored) symbol
+        directory — checkpoint restore path. The tiered runner overrides
+        with its per-group allocators."""
+        self._next_slot = max(
+            self._slot_lo, 1 + max(self.symbols.values(), default=-1))
+        self._free_slots = [
+            s for s in range(self._slot_lo, self._next_slot)
+            if self.slot_symbols[s] is None
+        ]
+
     def owns_all_symbols(self) -> bool:
         """True when every symbol is homed on this runner (single process,
         no shard filter) — lets the batch edge skip the per-op ownership
@@ -431,7 +452,13 @@ class EngineRunner:
                 if sym is not None:
                     del self.symbols[sym]
                     self.slot_symbols[slot] = None
-                    self._free_slots.append(slot)
+                    self._recycle_slot(slot)
+
+    def _recycle_slot(self, slot: int) -> None:
+        """Return a freed slot to its allocator free list (id lock held).
+        The tiered runner overrides: the slot goes back to its GROUP's
+        free list, not the flat one."""
+        self._free_slots.append(slot)
 
     # -- the dispatch ------------------------------------------------------
 
@@ -989,44 +1016,8 @@ class EngineRunner:
         self._build_md = self.hub is None or self.hub.has_market_data_subs()
 
         self._step_num += 1
-        if self._sharded is not None:
-            with self._snapshot_lock, step_annotation("auction_step",
-                                                      self._step_num):
-                # Assign under the snapshot lock: the input book was
-                # DONATED, so a concurrent snapshot reader between the
-                # step and the assignment would touch deleted buffers.
-                self.book, out = self._sharded.auction(self.book, mask)
-            view, fills, aborted_shards = self._sharded.decode_auction(out)
-            lo = view["lo"]
-            clear_price, executed = view["clear_price"], view["executed"]
-            best_bid, bid_size = view["best_bid"], view["bid_size"]
-            best_ask, ask_size = view["best_ask"], view["ask_size"]
-            aborted_flags = view["aborted_flags"]
-            shard_lo = view["shard_lo"]
-            local_syms = self._sharded.local_cfg.num_symbols
-        else:
-            from matching_engine_tpu.engine.auction import (
-                auction_step,
-                decode_auction,
-            )
-
-            with self._snapshot_lock, step_annotation("auction_step",
-                                                      self._step_num):
-                # Same donation rule as the mesh branch: assign in-lock.
-                self.book, out = auction_step(self.cfg, self.book, mask)
-            dec, fills = decode_auction(self.cfg, out)
-            aborted_shards = 1 if dec.aborted else 0
-            lo = 0
-            clear_price, executed = dec.clear_price, dec.executed
-            best_bid, bid_size = dec.best_bid, dec.bid_size
-            best_ask, ask_size = dec.best_ask, dec.ask_size
-            aborted_flags = np.array([dec.aborted])
-            shard_lo = 0
-            local_syms = self.cfg.num_symbols
-
-        def slot_aborted(slot: int) -> bool:
-            i = slot // local_syms - shard_lo
-            return bool(0 <= i < len(aborted_flags) and aborted_flags[i])
+        (lo, clear_price, executed, best_bid, bid_size, best_ask, ask_size,
+         fills, aborted_shards, slot_aborted) = self._auction_device(mask)
 
         if aborted_shards:
             self.metrics.inc("auction_aborts", aborted_shards)
@@ -1113,6 +1104,56 @@ class EngineRunner:
         return {"crossed": crossed, "aborted": aborted_shards > 0,
                 "error": "", "warning": warning}
 
+    def _auction_device(self, mask):
+        """The auction's device step + raw decode (refactored hook so the
+        tiered runner can run one uncross per tier group): returns
+        (lo, clear_price, executed, best_bid, bid_size, best_ask,
+        ask_size, fills, aborted_shards, slot_aborted) where the [.]
+        arrays cover this host's local symbol block starting at `lo` and
+        slot_aborted(slot) reports whether the shard/tier owning a global
+        slot hit the all-or-nothing overflow."""
+        if self._sharded is not None:
+            with self._snapshot_lock, step_annotation("auction_step",
+                                                      self._step_num):
+                # Assign under the snapshot lock: the input book was
+                # DONATED, so a concurrent snapshot reader between the
+                # step and the assignment would touch deleted buffers.
+                self.book, out = self._sharded.auction(self.book, mask)
+            view, fills, aborted_shards = self._sharded.decode_auction(out)
+            lo = view["lo"]
+            clear_price, executed = view["clear_price"], view["executed"]
+            best_bid, bid_size = view["best_bid"], view["bid_size"]
+            best_ask, ask_size = view["best_ask"], view["ask_size"]
+            aborted_flags = view["aborted_flags"]
+            shard_lo = view["shard_lo"]
+            local_syms = self._sharded.local_cfg.num_symbols
+        else:
+            from matching_engine_tpu.engine.auction import (
+                auction_step,
+                decode_auction,
+            )
+
+            with self._snapshot_lock, step_annotation("auction_step",
+                                                      self._step_num):
+                # Same donation rule as the mesh branch: assign in-lock.
+                self.book, out = auction_step(self.cfg, self.book, mask)
+            dec, fills = decode_auction(self.cfg, out)
+            aborted_shards = 1 if dec.aborted else 0
+            lo = 0
+            clear_price, executed = dec.clear_price, dec.executed
+            best_bid, bid_size = dec.best_bid, dec.bid_size
+            best_ask, ask_size = dec.best_ask, dec.ask_size
+            aborted_flags = np.array([dec.aborted])
+            shard_lo = 0
+            local_syms = self.cfg.num_symbols
+
+        def slot_aborted(slot: int) -> bool:
+            i = slot // local_syms - shard_lo
+            return bool(0 <= i < len(aborted_flags) and aborted_flags[i])
+
+        return (lo, clear_price, executed, best_bid, bid_size, best_ask,
+                ask_size, fills, aborted_shards, slot_aborted)
+
     def _evict_terminal(self, ops, res: DispatchResult, by_handle,
                         terminal_makers: set[int]) -> None:
         # Evict terminal orders from the directories: once FILLED / CANCELED /
@@ -1188,7 +1229,12 @@ class EngineRunner:
                 info.status = r.status
                 info.remaining = r.remaining
                 if r.status == REJECTED:
-                    # Book-capacity reject after any fills were honored.
+                    # Book-capacity reject after any fills were honored:
+                    # metered backpressure, never a silent drop — the
+                    # positional reject reason below rides the batch
+                    # statuses (record_flaws vocabulary) and the counter
+                    # is the operator's re-tiering signal.
+                    self._meter_capacity_reject(r.sym)
                     res.outcomes.append(
                         OpOutcome(e, r.status, r.filled, r.remaining,
                                   "book side at capacity" if r.filled == 0 else
@@ -1285,6 +1331,22 @@ class EngineRunner:
                         OpOutcome(e, REJECTED, 0, 0, "order not open")
                     )
 
+    def tier_of_slot(self, slot: int) -> int:
+        """Capacity-tier group index owning a symbol slot — 0 for the
+        single implicit tier of an untiered runner; the tiered runner
+        overrides (server/tiered_runner.py)."""
+        return 0
+
+    def _meter_capacity_reject(self, slot: int) -> None:
+        """Count one full-book submit reject: the venue-wide counter plus
+        the per-tier series the operator re-tiers by (prose-documented
+        like the per-lane series; OPERATIONS.md). Registry name has no
+        _total suffix — the exposition appends it (the operator-facing
+        series is me_book_capacity_rejects_total)."""
+        self.metrics.inc("book_capacity_rejects")
+        self.metrics.inc(
+            f"book_capacity_rejects_tier{self.tier_of_slot(slot)}")
+
     def _update(self, info: OrderInfo, status, fprice, fqty, remaining) -> pb2.OrderUpdate:
         return pb2.OrderUpdate(
             order_id=info.order_id,
@@ -1354,22 +1416,7 @@ class EngineRunner:
         lost_qty)] repair rows for the durable store; matching
         ("fills_lost") entries are appended to pending_recon.
         """
-        from matching_engine_tpu.parallel import hostlocal
-
-        lanes: dict[int, int] = {}
-        with self._snapshot_lock:
-            # Local block only: this host's directory can only reference
-            # handles resting in its own symbol rows.
-            arrs = [
-                hostlocal.local_block(x)[0]
-                for x in (self.book.bid_oid, self.book.bid_qty,
-                          self.book.ask_oid, self.book.ask_qty)
-            ]
-        for oid_arr, qty_arr in ((arrs[0], arrs[1]), (arrs[2], arrs[3])):
-            mask = qty_arr > 0
-            for h, q in zip(oid_arr[mask].tolist(), qty_arr[mask].tolist()):
-                lanes[int(h)] = int(q)
-
+        lanes = self._live_lane_qtys()
         repairs: list[tuple] = []
         for handle, info in list(self.orders_by_handle.items()):
             dev_rem = lanes.get(handle)
@@ -1391,6 +1438,27 @@ class EngineRunner:
                     (info.order_id, dev_rem, PARTIALLY_FILLED, lost))
                 self._ledger_lost(info.order_id, lost)
         return repairs
+
+    def _live_lane_qtys(self) -> dict[int, int]:
+        """handle -> device remaining for every live resting lane (the
+        reconcile join source; the tiered runner unions its per-tier
+        books)."""
+        from matching_engine_tpu.parallel import hostlocal
+
+        lanes: dict[int, int] = {}
+        with self._snapshot_lock:
+            # Local block only: this host's directory can only reference
+            # handles resting in its own symbol rows.
+            arrs = [
+                hostlocal.local_block(x)[0]
+                for x in (self.book.bid_oid, self.book.bid_qty,
+                          self.book.ask_oid, self.book.ask_qty)
+            ]
+        for oid_arr, qty_arr in ((arrs[0], arrs[1]), (arrs[2], arrs[3])):
+            mask = qty_arr > 0
+            for h, q in zip(oid_arr[mask].tolist(), qty_arr[mask].tolist()):
+                lanes[int(h)] = int(q)
+        return lanes
 
     def drain_recon(self) -> list[tuple[str, str, int]]:
         """Take (and clear) the pending durability-gap ledger entries."""
@@ -1583,6 +1651,17 @@ class EngineRunner:
         during an auction call period — the caller must resume it
         (auction_mode) rather than expose the book to continuous matching.
         Reads addressable shards only (multi-process safe)."""
+        out = []
+        for lo, crossed in self._crossed_blocks():
+            for i in np.nonzero(crossed)[0]:
+                sym = self.slot_symbols[lo + int(i)]
+                if sym is not None:
+                    out.append(sym)
+        return out
+
+    def _crossed_blocks(self):
+        """[(block_lo, crossed_mask)] over this runner's book(s) — one
+        block here, one per tier in the tiered runner."""
         from matching_engine_tpu.parallel import hostlocal
 
         with self._snapshot_lock:
@@ -1595,12 +1674,26 @@ class EngineRunner:
         best_ask = np.where(aq > 0, ap, imax).min(axis=1)
         crossed = ((bq > 0).any(axis=1) & (aq > 0).any(axis=1)
                    & (best_bid >= best_ask))
-        out = []
-        for i in np.nonzero(crossed)[0]:
-            sym = self.slot_symbols[lo + int(i)]
-            if sym is not None:
-                out.append(sym)
-        return out
+        return [(lo, crossed)]
+
+    def _snapshot_row(self, slot: int):
+        """One symbol's 8 book-lane rows (bid p/q/oid/seq, ask p/q/oid/
+        seq) as host arrays — the snapshot source both runner flavors'
+        joins read; the tiered runner serves it from the owning tier's
+        book."""
+        with self._snapshot_lock:
+            # read_row touches only the shard holding this symbol's lanes —
+            # valid on a multi-process mesh, where a whole-array read isn't.
+            from matching_engine_tpu.parallel import hostlocal
+
+            return [
+                hostlocal.read_row(x, slot)
+                for x in (
+                    self.book.bid_price, self.book.bid_qty, self.book.bid_oid,
+                    self.book.bid_seq, self.book.ask_price, self.book.ask_qty,
+                    self.book.ask_oid, self.book.ask_seq,
+                )
+            ]
 
     def book_snapshot(self, symbol: str) -> tuple[list, list]:
         """Priority-sorted (OrderInfo, qty) lists (bids, asks) for one symbol.
@@ -1611,20 +1704,7 @@ class EngineRunner:
         slot = self.symbols.get(symbol)
         if slot is None:
             return [], []
-        with self._snapshot_lock:
-            # read_row touches only the shard holding this symbol's lanes —
-            # valid on a multi-process mesh, where a whole-array read isn't.
-            from matching_engine_tpu.parallel import hostlocal
-
-            arrs = [
-                hostlocal.read_row(x, slot)
-                for x in (
-                    self.book.bid_price, self.book.bid_qty, self.book.bid_oid,
-                    self.book.bid_seq, self.book.ask_price, self.book.ask_qty,
-                    self.book.ask_oid, self.book.ask_seq,
-                )
-            ]
-        bp, bq, bo, bs_, ap, aq, ao, as_ = arrs
+        bp, bq, bo, bs_, ap, aq, ao, as_ = self._snapshot_row(slot)
 
         def side(price, qty, oid, seq, desc, want_side):
             rows = [

@@ -157,6 +157,7 @@ def build_server(
     standby_addr: str | None = None,
     standby_auto_promote_s: float = 0.0,
     standby_attest: bool = True,
+    tier_pins: dict | None = None,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -325,6 +326,16 @@ def build_server(
 
         oplog_shipper = OpLogShipper(hub, metrics)
 
+    if cfg.tiers and (native_lanes or mesh is not None):
+        # Enforced HERE, not only in main()'s argv parsing: the C++ lane
+        # engine builds whole-grid waves for ONE capacity and the mesh
+        # shards one uniform book — a programmatic caller combining them
+        # with a tier spec would step books that don't exist.
+        print("[SERVER] --book-tiers runs on the single-process python "
+              "dispatch routes (composes with --serve-shards): drop "
+              "native_lanes/mesh", file=sys.stderr)
+        raise SystemExit(3)
+
     def make_runner():
         if native_lanes:
             from matching_engine_tpu.server.native_lanes import (
@@ -335,6 +346,16 @@ def build_server(
                 cfg, metrics, hub=hub,
                 pipeline_inflight=pipeline_inflight,
                 megadispatch_max_waves=megadispatch_max_waves)
+        if cfg.tiers:
+            from matching_engine_tpu.server.tiered_runner import (
+                TieredEngineRunner,
+            )
+
+            return TieredEngineRunner(
+                cfg, metrics, hub=hub,
+                pipeline_inflight=pipeline_inflight,
+                megadispatch_max_waves=megadispatch_max_waves,
+                tier_pins=tier_pins)
         return EngineRunner(cfg, metrics, mesh=mesh, hub=hub,
                             pipeline_inflight=pipeline_inflight,
                             megadispatch_max_waves=megadispatch_max_waves)
@@ -376,7 +397,8 @@ def build_server(
                     cfg, router, _i, metrics=metrics, hub=hub,
                     pipeline_inflight=pipeline_inflight,
                     native_lanes=native_lanes,
-                    megadispatch_max_waves=megadispatch_max_waves),
+                    megadispatch_max_waves=megadispatch_max_waves,
+                    tier_pins=tier_pins),
                 storage, owner_rows,
                 os.path.join(checkpoint_dir, f"shard-{i}")
                 if checkpoint_dir else None,
@@ -733,12 +755,30 @@ def main(argv=None) -> int:
     p.add_argument("--symbols", type=int, default=1024, help="symbol-axis size")
     p.add_argument("--capacity", type=int, default=128, help="resting orders per side")
     p.add_argument("--batch", type=int, default=8, help="orders per symbol per dispatch")
-    p.add_argument("--engine-kernel", choices=("matrix", "sorted"),
+    p.add_argument("--book-tiers", default=None, metavar="SPEC",
+                   help="tiered book capacity classes: comma-separated "
+                        "<count>x<capacity> groups partitioning the "
+                        "symbol axis (one may use '*' for the remainder),"
+                        " each optionally pinning symbols with "
+                        ":SYM;SYM — e.g. '8x8192:HOT-0,56x1024,*x128'. "
+                        "Unpinned symbols fill the last group first and "
+                        "spill toward deeper groups. Full books are "
+                        "metered backpressure (me_book_capacity_rejects_"
+                        "total + per-tier high-watermark gauges). "
+                        "Composes with --serve-shards (every count "
+                        "divisible by K); refused with --native-lanes/"
+                        "--mesh. The spec is part of checkpoint "
+                        "compatibility: restoring under a different spec "
+                        "falls back to full replay")
+    p.add_argument("--engine-kernel", choices=("matrix", "sorted", "levels"),
                    default="matrix",
-                   help="match formulation (engine/kernel.py matrix vs "
-                        "engine/kernel_sorted.py sorted — both "
+                   help="match formulation (engine/kernel.py matrix, "
+                        "engine/kernel_sorted.py sorted, "
+                        "engine/kernel_levels.py levels — all "
                         "oracle-parity; sorted is O(CAP) per order for "
-                        "deep books)")
+                        "deep books, levels matches over price-level "
+                        "FIFO rows so the sweep is O(levels) and deep "
+                        "books stop costing what empty books cost)")
     p.add_argument("--window-ms", type=float, default=2.0, help="dispatch batching window")
     p.add_argument("--megadispatch-max-waves", type=int, default=1,
                    metavar="M",
@@ -994,8 +1034,35 @@ def main(argv=None) -> int:
               "— drop one of the two flags", file=sys.stderr)
         return 3
 
-    cfg = EngineConfig(num_symbols=args.symbols, capacity=args.capacity,
-                       batch=args.batch, kernel=args.engine_kernel)
+    tiers, tier_pins = (), None
+    if args.book_tiers:
+        if args.native_lanes or mesh is not None:
+            print("[SERVER] --book-tiers runs on the python dispatch "
+                  "routes (composes with --serve-shards): drop "
+                  "--native-lanes/--mesh", file=sys.stderr)
+            return 3
+        from matching_engine_tpu.server.tiered_runner import (
+            parse_book_tiers,
+        )
+
+        try:
+            tiers, tier_pins = parse_book_tiers(args.book_tiers,
+                                                args.symbols)
+        except ValueError as e:
+            print(f"[SERVER] bad --book-tiers: {e}", file=sys.stderr)
+            return 3
+        cap = max(c for _, c in tiers)
+        if args.capacity != cap and args.capacity != 128:
+            print(f"[SERVER] note: --capacity {args.capacity} superseded "
+                  f"by the deepest tier ({cap})")
+    try:
+        cfg = EngineConfig(
+            num_symbols=args.symbols,
+            capacity=max(c for _, c in tiers) if tiers else args.capacity,
+            batch=args.batch, kernel=args.engine_kernel, tiers=tiers)
+    except (AssertionError, ValueError) as e:
+        print(f"[SERVER] bad engine config: {e}", file=sys.stderr)
+        return 3
     flight_dir = args.flight_dir or os.path.join(
         os.path.dirname(os.path.abspath(args.db)), "flight")
     try:
@@ -1027,6 +1094,7 @@ def main(argv=None) -> int:
             standby_addr=args.standby,
             standby_auto_promote_s=args.standby_auto_promote_s,
             standby_attest=not args.standby_no_attest,
+            tier_pins=tier_pins,
         )
     except SystemExit as e:
         return int(e.code or 3)

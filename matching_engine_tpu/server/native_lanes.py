@@ -359,6 +359,25 @@ class NativeLanesRunner(EngineRunner):
                             ("owner_collisions", "owner_hash_collisions")):
             if c.get(key):
                 m.inc(metric, c[key])
+        if c.get("rejected"):
+            # Book-capacity backpressure metering on the NATIVE path: the
+            # C++ decode already stamps the positional "book side at
+            # capacity" reject reason (me_lanes.cpp) — count those here so
+            # both serving paths feed the same me_book_* series. Both
+            # completion routes are covered: bit-63 tags (grpcio lane
+            # ring) ride aux["local"], gateway-batch tags ride the comp
+            # wire buffer. The gate is effective: the C++ `rejected`
+            # counter covers edge rejects + device SUBMIT rejects only —
+            # cancel-of-filled rejects (the common structural class,
+            # ~13% of ops in crash replays) never bump it — so the extra
+            # comp parse runs on genuinely rare dispatches, never per op
+            # on the clean hot path.
+            for loc in aux["local"]:
+                if "book side at capacity" in loc[5]:
+                    self._meter_capacity_reject(0)
+            for comp in me_native.parse_comp_buf(comp_buf):
+                if "book side at capacity" in comp[4]:
+                    self._meter_capacity_reject(0)
         # Slot mirror deltas FIRST (market data below resolves symbol
         # names through the mirror), releases LAST (the Python finalize
         # also publishes before eviction recycles slots).
@@ -515,19 +534,7 @@ class NativeLanesRunner(EngineRunner):
         slot = self.symbols.get(symbol)
         if slot is None:
             return [], []
-        with self._snapshot_lock:
-            from matching_engine_tpu.parallel import hostlocal
-
-            arrs = [
-                hostlocal.read_row(x, slot)
-                for x in (
-                    self.book.bid_price, self.book.bid_qty,
-                    self.book.bid_oid, self.book.bid_seq,
-                    self.book.ask_price, self.book.ask_qty,
-                    self.book.ask_oid, self.book.ask_seq,
-                )
-            ]
-        bp, bq, bo, bs_, ap, aq, ao, as_ = arrs
+        bp, bq, bo, bs_, ap, aq, ao, as_ = self._snapshot_row(slot)
 
         def side(price, qty, oid, seq, desc, want_side):
             rows = [

@@ -286,11 +286,18 @@ class ServingShards:
 def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
                      metrics=None, hub=None, pipeline_inflight: int = 2,
                      native_lanes: bool = False, devices=None,
-                     megadispatch_max_waves: int = 1):
+                     megadispatch_max_waves: int = 1, tier_pins=None):
     """One lane's runner over a K-way split of `cfg`: the shard gets
     ``cfg.num_symbols // K`` engine rows, the strided OID residue class
     `shard_id`, the shard-ownership filter, and — when more than one
-    device is visible — its own device (round-robin)."""
+    device is visible — its own device (round-robin).
+
+    A tiered `cfg` (cfg.tiers, --book-tiers) splits PROPORTIONALLY: every
+    tier group's symbol count must divide by K, each lane gets the same
+    spec at 1/K scale, and the whole pin map passes through (a lane only
+    ever allocates symbols its owns_filter admits, so foreign pins are
+    inert). Tiers route dispatches to the owning tier group inside each
+    lane exactly like the router routes symbols to lanes."""
     import dataclasses
 
     import jax
@@ -302,20 +309,40 @@ def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
         raise ValueError(
             f"num_symbols {cfg.num_symbols} not divisible by "
             f"serve-shards {k}")
-    shard_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // k)
+    lane_tiers = ()
+    if cfg.tiers:
+        if native_lanes:
+            raise ValueError("--book-tiers does not compose with "
+                             "--native-lanes")
+        for n, cap in cfg.tiers:
+            if n % k != 0:
+                raise ValueError(
+                    f"tier group {n}x{cap} not divisible by "
+                    f"serve-shards {k} (every tier splits per lane)")
+        lane_tiers = tuple((n // k, cap) for n, cap in cfg.tiers)
+    shard_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // k,
+                                    tiers=lane_tiers)
     devices = devices if devices is not None else jax.devices()
     device = devices[shard_id % len(devices)] if len(devices) > 1 else None
     owns = (lambda s, _i=shard_id: router.shard_of(s) == _i)
+    kwargs = {}
     cls = EngineRunner
     if native_lanes:
         from matching_engine_tpu.server.native_lanes import NativeLanesRunner
 
         cls = NativeLanesRunner
+    elif cfg.tiers:
+        from matching_engine_tpu.server.tiered_runner import (
+            TieredEngineRunner,
+        )
+
+        cls = TieredEngineRunner
+        kwargs["tier_pins"] = tier_pins
     return cls(shard_cfg, metrics, hub=hub,
                pipeline_inflight=pipeline_inflight,
                oid_offset=shard_id, oid_stride=k, device=device,
                owns_filter=owns,
-               megadispatch_max_waves=megadispatch_max_waves)
+               megadispatch_max_waves=megadispatch_max_waves, **kwargs)
 
 
 def make_lane_dispatcher(runner, *, sink=None, hub=None,
@@ -373,6 +400,7 @@ def build_serving_shards(
     sample_interval_s: float = 1.0,
     megadispatch_max_waves: int = 1,
     megadispatch_latency_us: float = 5000.0,
+    tier_pins=None,
 ) -> ServingShards:
     """Wire K (runner → dispatcher) lanes over a K-way split of `cfg`.
 
@@ -385,7 +413,8 @@ def build_serving_shards(
         runner = make_lane_runner(
             cfg, router, i, metrics=metrics, hub=hub,
             pipeline_inflight=pipeline_inflight, native_lanes=native_lanes,
-            megadispatch_max_waves=megadispatch_max_waves)
+            megadispatch_max_waves=megadispatch_max_waves,
+            tier_pins=tier_pins)
         dispatcher = None
         if with_dispatchers:
             dispatcher = make_lane_dispatcher(

@@ -1,0 +1,543 @@
+"""Tiered capacity classes: one runner, K capacity-tier book groups.
+
+The resident kernel's throughput was always quoted at a FIXED capacity per
+book (128), and that capacity is a correctness wall: order 129 on a deep
+book rejects. Real venues hold thousands of resting orders on hot symbols
+while the tail idles near-empty — paying [S, 8192] lanes for every symbol
+to serve a handful of deep books is exactly the waste the tier spec
+removes (ROADMAP Open item 5).
+
+`EngineConfig.tiers` partitions the symbol axis into contiguous groups,
+each with its own capacity; this runner owns one device book PER TIER and
+steps each tier group through its own jit'd kernel (vmapped over that
+tier's symbols only). Dispatch building is unchanged — the host still
+builds global [S, B, 7] waves — and the tier split is row slicing: tier t
+sees rows [lo_t, lo_t + n_t), a zero-copy contiguous view. Waves with no
+real ops for a tier skip that tier's device call entirely, so a dispatch
+touching only hot symbols costs one small step, not T. Decoded results
+and fills merge back in ascending tier order, which IS global
+(symbol, batch-row) device order — bit-identical to an untiered runner
+over the same (symbol -> slot, capacity) layout, pinned by
+tests/test_tiers.py.
+
+Symbol -> tier assignment is static at boot: `--book-tiers` pins named
+symbols to groups; unpinned symbols allocate from the LAST (shallowest)
+group first and spill toward deeper groups only when it fills — deep
+tiers are for the pinned hot symbols, the tail gets standard books, and
+a burst of new names borrows deep slots rather than rejecting.
+
+Composition rules: serving shards split the tier spec proportionally
+(every tier count divisible by K — server/shards.py); --native-lanes,
+--mesh, and the sparse dispatch shape are refused/skipped (the tiered
+_prepare always runs dense or mega). Checkpoints store one block per
+tier, and the tier spec rides semantic_key: a store checkpointed under
+one spec REFUSES to restore under another (clear error; boot falls back
+to full replay, which re-rests orders into the new layout).
+
+The backpressure story this enables: a full book is a metered positional
+reject (me_book_capacity_rejects_total + per-tier series) and the
+per-tier high-watermark gauges (me_book_depth_hwm*) tell the operator
+which group to deepen — capacity stops being a silent correctness hazard.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import jax
+import numpy as np
+
+from matching_engine_tpu.engine.book import EngineConfig, init_book
+from matching_engine_tpu.engine.harness import (
+    DenseDecoded,
+    HostFill,
+    HostResult,
+    batch_view,
+    build_batch_arrays,
+    decode_fills,
+    decode_results,
+    decode_step_mega,
+)
+from matching_engine_tpu.engine.kernel import engine_step_packed
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.server.engine_runner import (
+    DispatchResult,
+    EngineRunner,
+)
+from matching_engine_tpu.utils.tracing import step_annotation
+
+
+def parse_book_tiers(spec: str, num_symbols: int):
+    """Parse a --book-tiers spec into (tiers, pins).
+
+    Spec grammar: comma-separated groups `<count>x<capacity>` (one group
+    may use `*` for count = every remaining symbol row), each optionally
+    pinning symbols with `:<sym>;<sym>;...`. Example::
+
+        --book-tiers "8x8192:HOT-0;HOT-1,56x1024,*x128"
+
+    Returns (((count, capacity), ...), {symbol: group_index}). Raises
+    ValueError on malformed specs or counts that do not cover the symbol
+    axis exactly.
+    """
+    groups: list[tuple[int | None, int]] = []
+    pins: dict[str, int] = {}
+    if not spec.strip():
+        raise ValueError("empty --book-tiers spec")
+    for gi, part in enumerate(spec.split(",")):
+        part = part.strip()
+        body, _, pinned = part.partition(":")
+        try:
+            count_s, cap_s = body.split("x", 1)
+            count = None if count_s.strip() == "*" else int(count_s)
+            cap = int(cap_s)
+        except ValueError:
+            raise ValueError(
+                f"malformed --book-tiers group {part!r} "
+                "(want <count>x<capacity>[:SYM;SYM...])") from None
+        if cap < 1 or (count is not None and count < 1):
+            raise ValueError(f"non-positive tier in {part!r}")
+        groups.append((count, cap))
+        for sym in filter(None, (s.strip() for s in pinned.split(";"))):
+            if sym in pins:
+                raise ValueError(f"symbol {sym!r} pinned to two tiers")
+            pins[sym] = gi
+    stars = [i for i, (n, _) in enumerate(groups) if n is None]
+    if len(stars) > 1:
+        raise ValueError("at most one '*' tier group")
+    fixed = sum(n for n, _ in groups if n is not None)
+    if stars:
+        rest = num_symbols - fixed
+        if rest < 1:
+            raise ValueError(
+                f"fixed tier counts ({fixed}) leave no rows for the '*' "
+                f"group of --symbols {num_symbols}")
+        groups[stars[0]] = (rest, groups[stars[0]][1])
+    elif fixed != num_symbols:
+        raise ValueError(
+            f"tier counts sum to {fixed}, --symbols is {num_symbols}")
+    return tuple((int(n), int(c)) for n, c in groups), pins
+
+
+class TieredEngineRunner(EngineRunner):
+    """EngineRunner over per-tier device books (cfg.tiers non-empty).
+
+    Single-process, python/EngineOp serving path only (native lanes and
+    the mesh are refused at build time); composes with --serve-shards via
+    a proportional per-lane tier split."""
+
+    def __init__(self, cfg: EngineConfig, metrics=None, hub=None,
+                 pipeline_inflight: int = 2, oid_offset: int = 0,
+                 oid_stride: int = 1, device=None, owns_filter=None,
+                 megadispatch_max_waves: int = 1, tier_pins=None):
+        assert cfg.tiers, "TieredEngineRunner needs cfg.tiers"
+        super().__init__(cfg, metrics, mesh=None, hub=hub,
+                         pipeline_inflight=pipeline_inflight,
+                         oid_offset=oid_offset, oid_stride=oid_stride,
+                         device=device, owns_filter=owns_filter,
+                         megadispatch_max_waves=megadispatch_max_waves)
+        self.tier_cfgs = cfg.tier_configs()
+        lo, los = 0, []
+        for tcfg in self.tier_cfgs:
+            los.append(lo)
+            lo += tcfg.num_symbols
+        self.tier_lo = los                       # group start slots
+        self.tier_books = []
+        for tcfg in self.tier_cfgs:
+            b = init_book(tcfg)
+            if device is not None:
+                b = jax.device_put(b, device)
+            self.tier_books.append(b)
+        # Static symbol -> group pinning; unpinned symbols allocate from
+        # the last group and spill toward group 0 (see module docstring).
+        self.tier_pins = dict(tier_pins or {})
+        for sym, g in self.tier_pins.items():
+            if not (0 <= g < len(self.tier_cfgs)):
+                raise ValueError(f"pin {sym!r} -> tier {g} out of range")
+        # Per-group slot allocators (replace the base linear allocator).
+        self._g_next = list(self.tier_lo)
+        self._g_free: list[list[int]] = [[] for _ in self.tier_cfgs]
+        # Unpinned allocation order: shallowest capacity first (spec
+        # position breaks ties), regardless of how the spec is ordered.
+        self._shallow_first = sorted(
+            range(len(self.tier_cfgs)),
+            key=lambda g: (self.tier_cfgs[g].capacity, g))
+        # Per-group live-order high watermark (the re-tiering signal).
+        self._depth_hwm = [0] * len(self.tier_cfgs)
+
+    # -- tier geometry -----------------------------------------------------
+
+    def tier_of_slot(self, slot: int) -> int:
+        return bisect.bisect_right(self.tier_lo, slot) - 1
+
+    def _tier_span(self, t: int) -> tuple[int, int]:
+        lo = self.tier_lo[t]
+        return lo, lo + self.tier_cfgs[t].num_symbols
+
+    # -- slot allocation (per-group) ---------------------------------------
+
+    def _slot_locked(self, symbol: str) -> int | None:
+        slot = self.symbols.get(symbol)
+        if slot is not None:
+            return slot
+        pin = self.tier_pins.get(symbol)
+        # Pinned symbols allocate ONLY in their group (a full pinned group
+        # is the same "symbol capacity exhausted" reject as a full axis);
+        # unpinned search shallow-to-deep BY CAPACITY (not spec position —
+        # a shallow-first spec must not invert the policy) so deep rows
+        # stay available for pins and genuine spill.
+        order = ([pin] if pin is not None else self._shallow_first)
+        for g in order:
+            if self._g_free[g]:
+                slot = self._g_free[g].pop()
+                break
+            lo, hi = self._tier_span(g)
+            if self._g_next[g] < hi:
+                slot = self._g_next[g]
+                self._g_next[g] += 1
+                break
+        else:
+            return None
+        self.symbols[symbol] = slot
+        self.slot_symbols[slot] = symbol
+        return slot
+
+    def _recycle_slot(self, slot: int) -> None:
+        self._g_free[self.tier_of_slot(slot)].append(slot)
+
+    def slot_acquire(self, symbol: str) -> int | None:
+        slot = super().slot_acquire(symbol)
+        if slot is not None:
+            # High-watermark of live orders per tier group — the
+            # operator's re-tiering signal. _slot_live counts open AND
+            # in-flight orders, a slight over-estimate of resting depth
+            # (documented with the gauge). Under the id lock: concurrent
+            # RPC threads race the read-modify-write otherwise.
+            with self._id_lock:
+                g = self.tier_of_slot(slot)
+                d = self._slot_live[slot]
+                if d > self._depth_hwm[g]:
+                    self._depth_hwm[g] = d
+                    self.metrics.set_gauge(f"book_depth_hwm_tier{g}", d)
+                    self.metrics.set_gauge("book_depth_hwm",
+                                           max(self._depth_hwm))
+        return slot
+
+    def rebuild_slot_allocator(self) -> None:
+        for g in range(len(self.tier_cfgs)):
+            lo, hi = self._tier_span(g)
+            used = [s for s in self.symbols.values() if lo <= s < hi]
+            nxt = max(lo, 1 + max(used, default=lo - 1))
+            self._g_next[g] = min(nxt, hi)
+            self._g_free[g] = [s for s in range(lo, self._g_next[g])
+                               if self.slot_symbols[s] is None]
+
+    # -- book placement / read-only views ----------------------------------
+
+    def place_book(self, host_books) -> None:
+        """Install per-tier host BookBatches (checkpoint restore)."""
+        assert len(host_books) == len(self.tier_cfgs)
+        self.tier_books = [
+            jax.device_put(b, self.device) if self.device is not None
+            else jax.device_put(b)
+            for b in host_books
+        ]
+
+    def _snapshot_row(self, slot: int):
+        t = self.tier_of_slot(slot)
+        b = self.tier_books[t]
+        r = slot - self.tier_lo[t]
+        with self._snapshot_lock:
+            return [
+                np.asarray(x[r])
+                for x in (b.bid_price, b.bid_qty, b.bid_oid, b.bid_seq,
+                          b.ask_price, b.ask_qty, b.ask_oid, b.ask_seq)
+            ]
+
+    def _live_lane_qtys(self) -> dict[int, int]:
+        lanes: dict[int, int] = {}
+        with self._snapshot_lock:
+            arrs = [
+                (np.asarray(b.bid_oid), np.asarray(b.bid_qty),
+                 np.asarray(b.ask_oid), np.asarray(b.ask_qty))
+                for b in self.tier_books
+            ]
+        for bo, bq, ao, aq in arrs:
+            for oid_arr, qty_arr in ((bo, bq), (ao, aq)):
+                mask = qty_arr > 0
+                for h, q in zip(oid_arr[mask].tolist(),
+                                qty_arr[mask].tolist()):
+                    lanes[int(h)] = int(q)
+        return lanes
+
+    def _crossed_blocks(self):
+        out = []
+        imin, imax = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        for t, b in enumerate(self.tier_books):
+            with self._snapshot_lock:
+                bp, bq = np.asarray(b.bid_price), np.asarray(b.bid_qty)
+                ap, aq = np.asarray(b.ask_price), np.asarray(b.ask_qty)
+            best_bid = np.where(bq > 0, bp, imin).max(axis=1)
+            best_ask = np.where(aq > 0, ap, imax).min(axis=1)
+            crossed = ((bq > 0).any(axis=1) & (aq > 0).any(axis=1)
+                       & (best_bid >= best_ask))
+            out.append((self.tier_lo[t], crossed))
+        return out
+
+    def maybe_rebase_seqs(self) -> bool:
+        from matching_engine_tpu.engine.maintenance import (
+            REBASE_THRESHOLD,
+            rebase_seqs,
+        )
+
+        did = False
+        for t, tcfg in enumerate(self.tier_cfgs):
+            mx = int(np.max(np.asarray(self.tier_books[t].next_seq)))
+            if mx < REBASE_THRESHOLD:
+                continue
+            with self._snapshot_lock:
+                self.tier_books[t] = rebase_seqs(tcfg, self.tier_books[t])
+            self.metrics.inc("seq_rebases")
+            did = True
+        return did
+
+    # -- dispatch shapes ----------------------------------------------------
+
+    def _prepare(self, ops, host_orders, by_handle,
+                 res: DispatchResult, terminal_makers: set[int],
+                 timeline=None):
+        """Dense/mega only: every wave is the global [S, B, 7] array,
+        row-sliced per tier (a contiguous zero-copy view); tiers with no
+        real ops in a wave skip their device call. Per-wave decode merges
+        the tier outputs in ascending tier order == global (symbol,
+        batch-row) device order, so all host consequences are identical
+        to an untiered runner over the same layout. (The sparse shape is
+        intentionally skipped: per-tier coordinate re-bucketing would buy
+        back per-op host work the tier split exists to avoid.)"""
+        if host_orders:
+            self.metrics.inc("dense_dispatches")
+        arrays = build_batch_arrays(self.cfg, host_orders)
+        if self.megadispatch_max_waves > 1 and len(arrays) > 1:
+            return self._prepare_mega_tiered(
+                arrays, by_handle, res, terminal_makers, timeline=timeline)
+        if timeline is not None:
+            timeline.shape = "dense"
+        n_tiers = len(self.tier_cfgs)
+        touched_syms: set[int] = set()
+        last_dec: list = [None] * n_tiers
+
+        def dispatch():
+            for arr in arrays:
+                self._step_num += 1
+                outs: list = [None] * n_tiers
+                with self._snapshot_lock, step_annotation(
+                        "engine_step", self._step_num):
+                    for t, tcfg in enumerate(self.tier_cfgs):
+                        lo, hi = self._tier_span(t)
+                        sub = arr[lo:hi]
+                        if not sub[:, :, 0].any():
+                            continue
+                        self.tier_books[t], pout = engine_step_packed(
+                            tcfg, self.tier_books[t], sub)
+                        outs[t] = (sub, pout)
+                        try:
+                            pout.small.copy_to_host_async()
+                        except (AttributeError, RuntimeError):
+                            pass
+                yield outs
+
+        def decode(outs):
+            results: list = []
+            fills: list = []
+            overflow = False
+            for t, item in enumerate(outs):
+                if item is None:
+                    continue
+                sub, pout = item
+                tcfg, lo = self.tier_cfgs[t], self.tier_lo[t]
+                dec = DenseDecoded(tcfg, np.asarray(pout.small))
+                results.extend(decode_results(
+                    batch_view(sub), dec.status, dec.filled, dec.remaining,
+                    sym_offset=lo))
+                fills.extend(self._decode_tier_fills(
+                    dec.fill_count, dec.fills_inline, pout.fills, lo))
+                self.metrics.inc(
+                    "readback_bytes",
+                    pout.small.size * 4
+                    + (pout.fills.size * 4
+                       if dec.fill_count > dec.fills_inline.shape[1]
+                       else 0))
+                overflow = overflow or dec.fill_overflow
+                last_dec[t] = dec
+            self._account(results, fills, overflow, by_handle, res,
+                          terminal_makers)
+            touched_syms.update(r.sym for r in results)
+
+        def finalize():
+            self._tiered_market_data(touched_syms, last_dec, res)
+
+        return len(arrays), dispatch(), decode, finalize
+
+    def _decode_tier_fills(self, count, inline, full_buf, lo):
+        if count == 0:
+            return []
+        packed = (inline if count <= inline.shape[1]
+                  else np.asarray(full_buf))
+        fills = decode_fills(packed[0], packed[1], packed[2], packed[3],
+                             packed[4], count)
+        if lo == 0:
+            return fills
+        return [HostFill(f.sym + lo, f.taker_oid, f.maker_oid, f.price_q4,
+                         f.quantity) for f in fills]
+
+    def _tiered_market_data(self, touched_syms, last_dec, res) -> None:
+        if not touched_syms or not self._build_md:
+            return
+        for s in touched_syms:
+            t = self.tier_of_slot(s)
+            dec = last_dec[t]
+            sym = self.slot_symbols[s]
+            if dec is None or sym is None:
+                continue
+            i = s - self.tier_lo[t]
+            res.market_data.append(pb2.MarketDataUpdate(
+                symbol=sym,
+                best_bid=int(dec.best_bid[i]),
+                best_ask=int(dec.best_ask[i]),
+                scale=4,
+                bid_size=int(dec.bid_size[i]),
+                ask_size=int(dec.ask_size[i]),
+            ))
+
+    def _prepare_mega_tiered(self, arrays, by_handle, res: DispatchResult,
+                             terminal_makers: set[int], timeline=None):
+        """Megadispatch per tier: each chunk of up to M waves stacks
+        per-tier row slices into per-tier [M, S_t, B, 7] scans. Decode
+        merges tier outputs PER WAVE (ascending tier order), replaying
+        the exact serial event order."""
+        from matching_engine_tpu.engine import kernel as _kernel
+
+        m_cap = self.megadispatch_max_waves
+        if timeline is not None:
+            timeline.shape = "mega"
+            timeline.mega_m = min(m_cap, len(arrays))
+        chunks = [arrays[i:i + m_cap] for i in range(0, len(arrays), m_cap)]
+        n_tiers = len(self.tier_cfgs)
+        touched_syms: set[int] = set()
+        last_dec: list = [None] * n_tiers
+
+        def dispatch():
+            for group in chunks:
+                m = len(group)
+                self._step_num += 1
+                outs: list = [None] * n_tiers
+                with self._snapshot_lock, step_annotation(
+                        "engine_step_mega", self._step_num):
+                    for t, tcfg in enumerate(self.tier_cfgs):
+                        lo, hi = self._tier_span(t)
+                        subs = [a[lo:hi] for a in group]
+                        deepest = max(
+                            int(np.count_nonzero(s[:, :, 0])) for s in subs)
+                        if deepest == 0:
+                            continue
+                        rcap = _kernel.mega_result_cap(tcfg, deepest)
+                        self.tier_books[t], mout = _kernel.engine_step_mega(
+                            tcfg, self.tier_books[t], np.stack(subs), rcap)
+                        outs[t] = (m, rcap, mout)
+                        try:
+                            mout.small.copy_to_host_async()
+                        except (AttributeError, RuntimeError):
+                            pass
+                self.metrics.inc("megadispatch_steps")
+                self.metrics.inc("megadispatch_stacked_waves", m)
+                yield m, outs
+
+        def decode(item):
+            m, outs = item
+            per_tier: list = [None] * n_tiers
+            for t, out in enumerate(outs):
+                if out is None:
+                    continue
+                _, rcap, mout = out
+                tcfg = self.tier_cfgs[t]
+                waves, dec, fetched_full = decode_step_mega(
+                    tcfg, mout, m, rcap)
+                self.metrics.inc(
+                    "readback_bytes",
+                    mout.small.size * 4
+                    + (mout.fills.size * 4 if fetched_full else 0))
+                per_tier[t] = waves
+                last_dec[t] = dec
+            for w in range(m):
+                results: list = []
+                fills: list = []
+                overflow = False
+                for t, waves in enumerate(per_tier):
+                    if waves is None:
+                        continue
+                    r, f, ov = waves[w]
+                    lo = self.tier_lo[t]
+                    if lo:
+                        r = [HostResult(x.oid, x.sym + lo, x.status,
+                                        x.filled, x.remaining) for x in r]
+                        f = [HostFill(x.sym + lo, x.taker_oid, x.maker_oid,
+                                      x.price_q4, x.quantity) for x in f]
+                    results.extend(r)
+                    fills.extend(f)
+                    overflow = overflow or ov
+                self._account(results, fills, overflow, by_handle, res,
+                              terminal_makers)
+                touched_syms.update(r.sym for r in results)
+
+        def finalize():
+            self._tiered_market_data(touched_syms, last_dec, res)
+
+        return len(arrays), dispatch(), decode, finalize
+
+    # -- auction ------------------------------------------------------------
+
+    def _auction_device(self, mask):
+        """One uncross per tier group (per-tier all-or-nothing, mirroring
+        the mesh path's per-shard abort semantics); outputs concatenate
+        in tier order into the global [S] view the shared summary code
+        reads."""
+        from matching_engine_tpu.engine.auction import (
+            auction_step,
+            decode_auction,
+        )
+
+        parts: list = []
+        fills_all: list = []
+        flags: list[bool] = []
+        aborted_shards = 0
+        for t, tcfg in enumerate(self.tier_cfgs):
+            lo, hi = self._tier_span(t)
+            mask_t = np.ascontiguousarray(mask[lo:hi])
+            if not mask_t.any():
+                z = np.zeros((tcfg.num_symbols,), dtype=np.int64)
+                parts.append((z, z, z, z, z, z))
+                flags.append(False)
+                continue
+            with self._snapshot_lock, step_annotation("auction_step",
+                                                      self._step_num):
+                self.tier_books[t], out = auction_step(
+                    tcfg, self.tier_books[t], mask_t)
+            dec, fills = decode_auction(tcfg, out)
+            flags.append(bool(dec.aborted))
+            if dec.aborted:
+                aborted_shards += 1
+            parts.append((dec.clear_price, dec.executed, dec.best_bid,
+                          dec.bid_size, dec.best_ask, dec.ask_size))
+            if lo:
+                fills = [HostFill(f.sym + lo, f.taker_oid, f.maker_oid,
+                                  f.price_q4, f.quantity) for f in fills]
+            fills_all.extend(fills)
+
+        cat = [np.concatenate([p[i] for p in parts]) for i in range(6)]
+        clear_price, executed, best_bid, bid_size, best_ask, ask_size = cat
+
+        def slot_aborted(slot: int) -> bool:
+            return flags[self.tier_of_slot(slot)]
+
+        return (0, clear_price, executed, best_bid, bid_size, best_ask,
+                ask_size, fills_all, aborted_shards, slot_aborted)

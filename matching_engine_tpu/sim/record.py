@@ -105,6 +105,14 @@ def record_scenario(
     per_symbol = [0] * cfg.num_symbols
     skipped_cancels = 0
     min_cancel_gap = None
+    # Per-symbol resting-depth UPPER BOUND over the recording: live GTC
+    # LIMIT count ignoring fills (a fill only ever lowers true depth).
+    # Replay uses it to assert a --book-tiers spec is deep enough BEFORE
+    # driving a server (check_tier_depth below).
+    live_limits = [0] * cfg.num_symbols
+    max_resting_depth = [0] * cfg.num_symbols
+    # sim oid -> symbol of a still-live recorded LIMIT (for the decrement)
+    limit_sym: dict[tuple[int, int], int] = {}
 
     manifest_phases = []
     step0 = 0
@@ -143,6 +151,11 @@ def record_scenario(
                             int(qty[t, s, b]), symbols[s], cid, ""))
                         per_class[CLASS_TAGS[cls]]["submits"] += 1
                         per_symbol[s] += 1
+                        if int(otype[t, s, b]) == 0:  # GTC LIMIT rests
+                            live_limits[s] += 1
+                            max_resting_depth[s] = max(
+                                max_resting_depth[s], live_limits[s])
+                            limit_sym[(s, int(oid[t, s, b]))] = s
                     elif o == OP_CANCEL:
                         hit = oid_map.get((s, int(oid[t, s, b])))
                         if hit is None:
@@ -160,6 +173,9 @@ def record_scenario(
                             srv_oid))
                         per_class[CLASS_TAGS[cls]]["cancels"] += 1
                         per_symbol[s] += 1
+                        if limit_sym.pop((s, int(oid[t, s, b])),
+                                         None) is not None:
+                            live_limits[s] -= 1
         manifest_phases.append({
             "kind": pr.phase.kind,
             "steps": pr.phase.steps,
@@ -199,6 +215,7 @@ def record_scenario(
         "per_class_ops": per_class,
         "per_symbol_ops": per_symbol,
         "min_cancel_gap": min_cancel_gap,
+        "max_resting_depth": max_resting_depth,
         "skipped_cancels": skipped_cancels,
         "sim_fills": sim_fills,
         "sim_volume": sim_volume,
@@ -217,6 +234,39 @@ def record_scenario(
         metrics.inc("sim_record_phases", len(manifest_phases))
         metrics.inc("sim_record_bytes", len(arr) * oprec.RECORD_SIZE)
     return manifest
+
+
+def check_tier_depth(manifest: dict, tiers, pins=None,
+                     symbol_prefix: str = "S") -> list[str]:
+    """Assert a --book-tiers spec is deep enough for a recorded workload
+    BEFORE driving a server with it: every symbol's recorded
+    `max_resting_depth` (a fill-ignoring upper bound) must fit the
+    capacity of the tier group the symbol would land in — its pinned
+    group, else the SHALLOWEST group of the spec (unpinned allocation
+    starts at the last group and may spill into any other, and which one
+    a given symbol lands in depends on arrival order — so the sound
+    static judgment is the worst case); spill into deeper groups is
+    deliberately NOT credited, so passing this check means the replay
+    cannot depend on borrowed deep slots. Returns a list of
+    human-readable violations (empty = spec is deep enough)."""
+    depths = manifest.get("max_resting_depth")
+    if not depths:
+        return [
+            "manifest has no max_resting_depth (recorded before the "
+            "tier-aware format) — re-record with client simulate"]
+    pins = pins or {}
+    shallowest = min(range(len(tiers)), key=lambda g: tiers[g][1])
+    out = []
+    for s, depth in enumerate(depths):
+        sym = f"{symbol_prefix}{s}"
+        g = pins.get(sym, shallowest)
+        cap = tiers[g][1]
+        if depth > cap:
+            out.append(
+                f"{sym}: recorded resting depth {depth} exceeds tier "
+                f"group {g} capacity {cap} (pin it to a deeper group or "
+                f"deepen the spec)")
+    return out
 
 
 def read_manifest(opfile_path: str) -> dict:

@@ -267,8 +267,57 @@ def make_scenario(name: str, steps: int | None = None) -> Scenario:
         return Scenario("bursts",
                         _scaled([Phase("continuous", 130, burst_period=20,
                                        burst_on=6)], steps))
+    if name == "deep_books":
+        # Zipf-hot flow under an OVERSIZED market-maker ladder population
+        # (default_mix below: 192 resting identities per symbol): the
+        # head symbols accumulate resting depth far past the legacy
+        # 128-order book — the workload that motivates --book-tiers deep
+        # groups and the levels kernel, and the one whose replay meters
+        # capacity backpressure on an under-tiered server.
+        return Scenario("deep_books",
+                        _scaled([Phase("continuous", 130)], steps),
+                        zipf_alpha_q8=int(1.2 * 256))
     raise ValueError(
         f"unknown scenario {name!r} (have: {', '.join(SCENARIO_NAMES)})")
 
 
-SCENARIO_NAMES = ("auction_day", "flash_crash", "hot_symbols", "bursts")
+SCENARIO_NAMES = ("auction_day", "flash_crash", "hot_symbols", "bursts",
+                  "deep_books")
+
+
+def default_mix(name: str):
+    """The agent mix a named scenario records with (client simulate).
+    Everything runs the stock AgentMix except deep_books, whose point is
+    an ungated market-maker LADDER deeper than the legacy capacity: 192
+    resting identities per symbol, refreshed 8 at a time."""
+    from matching_engine_tpu.sim.agents import AgentMix
+
+    if name == "deep_books":
+        return AgentMix(mm_agents=192, mm_refresh=8, qty_max=40)
+    return AgentMix()
+
+
+def recording_capacity(mix, name: str = "") -> int:
+    """Book capacity for RECORDING a scenario (the sim's own engine run):
+    headroom over the deepest population a mix can rest. The stock mixes
+    keep the legacy 128; deep_books records at 1024 — uncanceled noise
+    residue accumulates on the Zipf-hot head far past the
+    market-makers' own 192-quote ladder, and a recording that hit its
+    own capacity wall would bake rejects into the artifact that a
+    deeper replay server then legitimately fills (fill_drift)."""
+    if name == "deep_books":
+        return 1024
+    cap = 128
+    while cap < mix.mm_agents + 64:
+        cap <<= 1
+    return cap
+
+
+def recording_kernel(capacity: int) -> str:
+    """Kernel for the recording run: matrix at the legacy depth (the
+    committed pre-deep_books artifacts' exact configuration — their
+    regeneration commands must keep reproducing identical bytes), sorted
+    past it (matrix [C, C] intermediates are quadratic; all kernels are
+    bit-identical on the flow the recorder captures, so the artifact
+    bytes do not depend on this choice except through capacity)."""
+    return "sorted" if capacity > 256 else "matrix"

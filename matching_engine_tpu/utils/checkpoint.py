@@ -102,7 +102,18 @@ def save_checkpoint(path: str, runner) -> None:
     if jax.process_count() > 1:
         _save_checkpoint_hostlocal(path, runner)
         return
-    book_host = {f: np.asarray(getattr(runner.book, f)) for f in _BOOK_FIELDS}
+    if runner.cfg.tiers:
+        # Tiered runner: one block set per tier group (shapes differ per
+        # tier, so they cannot share one array). The tier spec rides
+        # semantic_key, so a spec change refuses the restore loudly.
+        book_host = {
+            f"t{i}_{f}": np.asarray(getattr(b, f))
+            for i, b in enumerate(runner.tier_books)
+            for f in _BOOK_FIELDS
+        }
+    else:
+        book_host = {
+            f: np.asarray(getattr(runner.book, f)) for f in _BOOK_FIELDS}
     # The dispatch lock (held by the caller) quiesces the book and order
     # directories, but RPC threads allocate symbols/OIDs outside it — copy
     # those under the id lock so json.dump never walks a mutating dict.
@@ -192,8 +203,17 @@ def load_checkpoint(path: str) -> tuple[EngineConfig, BookBatch, dict]:
         meta = json.load(f)
     cfg = _cfg_from_meta(meta)
     with np.load(os.path.join(path, "book.npz")) as z:
-        book = BookBatch(
-            **{f: _field_or_default(z, f, cfg) for f in _BOOK_FIELDS})
+        if cfg.tiers:
+            # Tiered checkpoint: one BookBatch per tier group (the tiered
+            # format postdates every BookBatch field, so no
+            # forward-compat zero-fill is needed).
+            book = [
+                BookBatch(**{f: z[f"t{i}_{f}"] for f in _BOOK_FIELDS})
+                for i in range(len(cfg.tiers))
+            ]
+        else:
+            book = BookBatch(
+                **{f: _field_or_default(z, f, cfg) for f in _BOOK_FIELDS})
     return cfg, book, meta
 
 
@@ -220,6 +240,10 @@ def _rebuild_owner_lanes(runner) -> None:
 
     from matching_engine_tpu.parallel import hostlocal
 
+    if runner.cfg.tiers:
+        # The tiered checkpoint format postdates the owner lanes: every
+        # tiered snapshot already carries them.
+        return
     book = runner.book
     has_owners = (np.asarray(hostlocal.local_block(book.bid_owner)[0]).any()
                   or np.asarray(
@@ -268,6 +292,15 @@ def restore_runner(runner, path: str, storage=None) -> int:
             f"unsupported checkpoint version {meta.get('version')} "
             "(pre-handle formats restore via full replay)"
         )
+    if tuple(cfg.tiers) != tuple(runner.cfg.tiers):
+        # Its own clear error, distinct from generic config skew: a tier
+        # re-spec changes which rows hold which books, so restoring the
+        # old blocks would silently misplace depth. Callers fall back to
+        # full replay, which re-rests open orders into the NEW layout.
+        raise ValueError(
+            f"checkpoint written under book-tier spec {tuple(cfg.tiers)} "
+            f"but this server boots with {tuple(runner.cfg.tiers)} — "
+            "restore refused; recover via full replay")
     if cfg.semantic_key() != runner.cfg.semantic_key():
         raise ValueError(
             f"checkpoint config {cfg} does not match runner config {runner.cfg}"
@@ -314,12 +347,7 @@ def restore_runner(runner, path: str, storage=None) -> int:
         if runner._slot_live[slot] == 0:
             del runner.symbols[sym]
             runner.slot_symbols[slot] = None
-    runner._next_slot = max(
-        runner._slot_lo, 1 + max(runner.symbols.values(), default=-1))
-    runner._free_slots = [
-        s for s in range(runner._slot_lo, runner._next_slot)
-        if runner.slot_symbols[s] is None
-    ]
+    runner.rebuild_slot_allocator()
 
     if storage is None:
         return 0

@@ -479,8 +479,14 @@ python -m matching_engine_tpu.client.cli simulate \
   >/dev/null 2>"$WORK/flash_crash_sim.err" \
   || { echo "FAIL: flash-crash scenario recording failed"; cat "$WORK/flash_crash_sim.err"; exit 1; }
 FC_DB="$WORK/soak_flash.db"
+# Tiered books (PR 14): the Zipf-hot head symbols get deep books, the
+# tail standard ones — the 128-capacity wall that used to meter ~13%
+# rejects in this round is now a tier-spec decision, so the reject
+# budget below drops to 10% and any full-book reject that remains shows
+# up in me_book_capacity_rejects_total instead of being inevitable.
 PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
-  --addr 127.0.0.1:0 --db "$FC_DB" --symbols 16 --capacity 128 --batch 8 \
+  --addr 127.0.0.1:0 --db "$FC_DB" --symbols 16 --batch 8 \
+  --book-tiers "4x512:S0;S1;S2;S3,*x256" \
   --window-ms 1 --megadispatch-max-waves 4 --metrics-port 0 \
   --flight-dir "$WORK/flash_flight" \
   $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
@@ -521,9 +527,13 @@ check_audit "$FC_OBS" "flash_crash" \
 kill -TERM $FC_SRV 2>/dev/null; wait $FC_SRV 2>/dev/null
 trap 'kill $SRV 2>/dev/null' EXIT
 # Metered rejects: counted, bounded, never fatal. The structural reject
-# classes (cancels of already-filled orders) ride every crash replay;
-# past 25% of ops something is actually broken (codec skew, id
-# renumbering, capacity collapse).
+# class (market-maker cancels of quotes the cascade already filled —
+# measured 13.4% on this recording) rides every crash replay; with the
+# tiered books full-book rejects are no longer inevitable, so the budget
+# drops from 25% to 15% (just above the structural floor) and
+# book-capacity rejects specifically must EQUAL the positional
+# "book side at capacity" count — every one metered in
+# me_book_capacity_rejects_total, zero on a spec as deep as this one.
 FC_CHECK=$(python - "$FC_SUMMARY" "$FC_SCRAPE" <<'EOF'
 import json, re, sys
 s = json.load(open(sys.argv[1]))
@@ -533,17 +543,23 @@ scrape = open(sys.argv[2]).read()
 # positional-only and ride the summary's reject_reasons.
 m = re.search(r"^me_orders_rejected_total (\d+)", scrape, re.M)
 counted = int(m.group(1)) if m else 0
-ok = (s["accepted"] > 0 and s["rejected"] <= 0.25 * s["ops"]
-      and counted <= s["rejected"])
-print(f"{int(ok)} {s['accepted']} {s['rejected']} {s['ops']} {counted}")
+m = re.search(r"^me_book_capacity_rejects_total (\d+)", scrape, re.M)
+cap_rejects = int(m.group(1)) if m else 0
+book_full = sum(n for reason, n in s.get("reject_reasons", {}).items()
+                if "book side at capacity" in reason)
+ok = (s["accepted"] > 0 and s["rejected"] <= 0.15 * s["ops"]
+      and counted <= s["rejected"]
+      and cap_rejects == book_full)  # every full-book reject is metered
+print(f"{int(ok)} {s['accepted']} {s['rejected']} {s['ops']} {counted} "
+      f"{cap_rejects}")
 EOF
 )
-read -r FC_OK FC_ACC FC_REJ FC_TOTAL FC_COUNTED <<< "$(echo "$FC_CHECK" | tail -1)"
+read -r FC_OK FC_ACC FC_REJ FC_TOTAL FC_COUNTED FC_CAP <<< "$(echo "$FC_CHECK" | tail -1)"
 if [ "$FC_OK" != "1" ]; then
-  echo "FAIL: flash-crash round rejects unmetered or past threshold (accepted=$FC_ACC rejected=$FC_REJ ops=$FC_TOTAL counter=$FC_COUNTED)"
+  echo "FAIL: flash-crash round rejects unmetered or past threshold (accepted=$FC_ACC rejected=$FC_REJ ops=$FC_TOTAL counter=$FC_COUNTED book_capacity=$FC_CAP)"
   exit 1
 fi
-echo "flash-crash round: $FC_ACC/$FC_TOTAL accepted, $FC_REJ rejects metered (counter=$FC_COUNTED), auditor green"
+echo "flash-crash round: $FC_ACC/$FC_TOTAL accepted, $FC_REJ rejects metered (counter=$FC_COUNTED, book_capacity=$FC_CAP), auditor green"
 
 # ---- corruption-injection round: the auditor must fire --------------------
 # Boots a server with ME_AUDIT_FAULT=fill_qty (one fill record's quantity

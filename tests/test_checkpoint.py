@@ -144,4 +144,4 @@ def test_cfg_from_meta_tolerates_retired_fields():
         "num_symbols": 8, "capacity": 16, "batch": 4, "max_fills": 256,
         "pallas": False, "pallas_interpret": None,
     }})
-    assert cfg.semantic_key() == (8, 16, 4, 256, "matrix")
+    assert cfg.semantic_key() == (8, 16, 4, 256, "matrix", 0, ())

@@ -1,0 +1,261 @@
+"""Levels-kernel parity: price-level [L, F] FIFO books vs the oracle.
+
+The third match formulation (engine/kernel_levels.py) must be
+bit-identical to the LEVEL-AWARE oracle — same matching semantics as the
+other kernels, but capacity is level-structured: at most L distinct live
+prices per side, at most F resting orders per price, and a rest that
+finds either full REJECTS even below total capacity (the metered-
+backpressure contract). OracleBook models the identical rule via its
+levels/level_fifo params.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.engine.auction import auction_step, decode_auction
+from matching_engine_tpu.engine.book import (
+    EngineConfig,
+    default_levels,
+    init_book,
+    level_shape,
+)
+from matching_engine_tpu.engine.harness import (
+    HostOrder,
+    apply_orders,
+    random_order_stream,
+    snapshot_books,
+)
+from matching_engine_tpu.engine.kernel import OP_CANCEL, OP_REST, OP_SUBMIT
+from matching_engine_tpu.engine.oracle import OracleBook
+from matching_engine_tpu.proto import BUY, LIMIT, SELL
+
+
+def levels_oracles(cfg: EngineConfig) -> list[OracleBook]:
+    lvl, fifo = level_shape(cfg)
+    return [OracleBook(cfg.capacity, levels=lvl, level_fifo=fifo)
+            for _ in range(cfg.num_symbols)]
+
+
+def run_both(cfg, host_orders):
+    oracles = levels_oracles(cfg)
+    o_res, o_fills = [], []
+    for o in host_orders:
+        if o.op == OP_SUBMIT:
+            r = oracles[o.sym].submit(o.oid, o.side, o.otype, o.price,
+                                      o.qty, owner=o.owner)
+        elif o.op == OP_REST:
+            r = oracles[o.sym].rest(o.oid, o.side, o.price, o.qty,
+                                    owner=o.owner)
+        else:
+            r = oracles[o.sym].cancel(o.oid)
+        o_res.append((o.oid, o.sym, int(r.status), r.filled, r.remaining))
+        o_fills.extend((o.sym, f.taker_oid, f.maker_oid, f.price_q4,
+                        f.quantity) for f in r.fills)
+
+    book = init_book(cfg)
+    book, d_res, d_fills = apply_orders(cfg, book, host_orders)
+    d_res = [(r.oid, r.sym, r.status, r.filled, r.remaining) for r in d_res]
+    d_fills = [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+               for f in d_fills]
+    return book, oracles, (d_res, d_fills), (o_res, o_fills)
+
+
+def assert_parity(cfg, host_orders):
+    book, oracles, (d_res, d_fills), (o_res, o_fills) = run_both(
+        cfg, host_orders)
+    assert sorted(d_res) == sorted(o_res)
+    for s in range(cfg.num_symbols):
+        dev = [f for f in d_fills if f[0] == s]
+        orc = [f for f in o_fills if f[0] == s]
+        assert dev == orc, f"fill mismatch sym {s}:\n {dev}\n {orc}"
+    d_snaps = snapshot_books(book)
+    for s in range(cfg.num_symbols):
+        assert d_snaps[s] == oracles[s].snapshot(), f"book mismatch sym {s}"
+    return book, oracles
+
+
+def test_default_levels_tile_capacity():
+    for cap in (6, 16, 24, 128, 1024, 8192):
+        lvl = default_levels(cap)
+        assert cap % lvl == 0 and 1 <= lvl <= cap
+    # The headline shapes.
+    assert level_shape(EngineConfig(capacity=128, kernel="levels")) == (16, 8)
+    assert level_shape(
+        EngineConfig(capacity=8192, kernel="levels")) == (128, 64)
+
+
+def test_levels_field_refused_for_other_kernels():
+    with pytest.raises(AssertionError):
+        EngineConfig(capacity=128, kernel="matrix", levels=8)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_randomized_parity(seed):
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=8, kernel="levels")
+    assert_parity(cfg, random_order_stream(cfg.num_symbols, 200, seed=seed))
+
+
+def test_parity_tif_flows():
+    cfg = EngineConfig(num_symbols=4, capacity=16, batch=8, kernel="levels")
+    assert_parity(cfg, random_order_stream(cfg.num_symbols, 300, seed=5,
+                                           tif_p=0.3))
+
+
+def test_fuzz_parity_tight_structural_capacity():
+    """Tiny L and F: directory-full and row-full rejects dominate — both
+    sides must reject the identical ops."""
+    cfg = EngineConfig(num_symbols=3, capacity=6, batch=5, kernel="levels",
+                       levels=3)
+    assert_parity(cfg, random_order_stream(
+        cfg.num_symbols, 300, seed=7, cancel_p=0.3, market_p=0.25,
+        price_levels=4, qty_max=20))
+
+
+def test_fuzz_parity_single_price_fifo():
+    """Everything at one price: within-level FIFO order is the whole
+    game, and one row's F slots are the only capacity that matters."""
+    cfg = EngineConfig(num_symbols=2, capacity=32, batch=8, kernel="levels",
+                       levels=4)
+    assert_parity(cfg, random_order_stream(
+        cfg.num_symbols, 300, seed=21, cancel_p=0.2, market_p=0.2,
+        price_levels=1, qty_max=10))
+
+
+def test_level_row_full_rejects_below_total_capacity():
+    """F orders at one price fill the row; the F+1st REJECTS even though
+    the side holds far fewer than L*F orders — and a different price
+    still rests."""
+    cfg = EngineConfig(num_symbols=1, capacity=16, batch=4, kernel="levels",
+                       levels=4)  # F = 4
+    orders = [HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 2, oid=i + 1)
+              for i in range(5)]
+    orders.append(HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_100, 2, oid=6))
+    book, oracles, (d_res, _), _ = run_both(cfg, orders)
+    by_oid = {r[0]: r for r in d_res}
+    assert by_oid[5][2] == 4, by_oid[5]   # REJECTED: row full
+    assert by_oid[6][2] == 0              # NEW: fresh level rests
+    assert_parity(cfg, orders)
+
+
+def test_level_directory_full_rejects():
+    """L distinct prices exhaust the level directory; a new price
+    REJECTS while an existing price keeps resting."""
+    cfg = EngineConfig(num_symbols=1, capacity=16, batch=4, kernel="levels",
+                       levels=4)
+    orders = [HostOrder(0, OP_SUBMIT, BUY, LIMIT, 9_000 + 100 * i, 2,
+                        oid=i + 1) for i in range(4)]
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 9_800, 2, oid=5))
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 9_000, 2, oid=6))
+    book, oracles, (d_res, _), _ = run_both(cfg, orders)
+    by_oid = {r[0]: r for r in d_res}
+    assert by_oid[5][2] == 4              # REJECTED: directory full
+    assert by_oid[6][2] == 0              # NEW: existing level has room
+    assert_parity(cfg, orders)
+
+
+def test_freed_level_row_is_reusable():
+    """Canceling a level's last order frees its row for a new price."""
+    cfg = EngineConfig(num_symbols=1, capacity=8, batch=4, kernel="levels",
+                       levels=2)
+    orders = [
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_000, 2, oid=1),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_100, 2, oid=2),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_200, 2, oid=3),  # reject
+        HostOrder(0, OP_CANCEL, SELL, oid=1),
+        HostOrder(0, OP_SUBMIT, SELL, LIMIT, 10_200, 2, oid=4),  # rests
+    ]
+    assert_parity(cfg, orders)
+
+
+def test_lifecycle_auction_uncross_parity():
+    """Continuous -> crossing call-period rests -> uncross -> continuous,
+    against the level-aware oracle (the wide-sum uncross sorts its input,
+    so the levels layout needs no special casing; apply_uncross re-packs
+    the FIFO rows afterwards)."""
+    cfg = EngineConfig(num_symbols=4, capacity=24, batch=8, kernel="levels",
+                       max_fills=1 << 12)
+    rng = random.Random(3)
+    oracles = levels_oracles(cfg)
+    book = init_book(cfg)
+
+    def sync(stream):
+        nonlocal book
+        for o in stream:
+            ob = oracles[o.sym]
+            if o.op == OP_CANCEL:
+                ob.cancel(o.oid)
+            elif o.op == OP_REST:
+                ob.rest(o.oid, o.side, o.price, o.qty)
+            else:
+                ob.submit(o.oid, o.side, o.otype, o.price, o.qty)
+        book, _, _ = apply_orders(cfg, book, stream)
+
+    sync(random_order_stream(cfg.num_symbols, 120, seed=3))
+    oid = 10_000
+    rests = []
+    for _ in range(60):
+        oid += 1
+        rests.append(HostOrder(
+            rng.randrange(cfg.num_symbols), OP_REST,
+            BUY if rng.random() < 0.5 else SELL, LIMIT,
+            10_000 + 100 * rng.randrange(-3, 4), rng.randrange(1, 15),
+            oid=oid))
+    sync(rests)
+
+    book, out = auction_step(cfg, book, np.ones((cfg.num_symbols,), bool))
+    dec, fills = decode_auction(cfg, out)
+    assert not dec.aborted
+    want = []
+    for s, ob in enumerate(oracles):
+        p, q, ofills = ob.auction()
+        assert p == int(dec.clear_price[s])
+        assert q == int(dec.executed[s])
+        want.extend((s, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+                    for f in ofills)
+    got = [(f.sym, f.taker_oid, f.maker_oid, f.price_q4, f.quantity)
+           for f in fills]
+    assert sorted(got) == sorted(want)
+    snaps = snapshot_books(book)
+    for s in range(cfg.num_symbols):
+        assert snaps[s] == oracles[s].snapshot(), f"post-uncross sym {s}"
+
+    # Continuous trading again on the post-auction layout.
+    stream = [
+        HostOrder(o.sym, o.op, o.side, o.otype, o.price, o.qty,
+                  oid=(o.oid + 20_000 if o.oid else 0))
+        for o in random_order_stream(cfg.num_symbols, 120, seed=9)
+    ]
+    for o in stream:
+        if o.op == OP_SUBMIT:
+            oracles[o.sym].submit(o.oid, o.side, o.otype, o.price, o.qty)
+        else:
+            oracles[o.sym].cancel(o.oid)
+    book, _, _ = apply_orders(cfg, book, stream)
+    snaps = snapshot_books(book)
+    for s in range(cfg.num_symbols):
+        assert snaps[s] == oracles[s].snapshot(), f"post-continuous sym {s}"
+
+
+@pytest.mark.slow
+def test_venue_depth_deep_sweep():
+    """Capacity 8192 ([128, 64] levels, saturating quantity sums): a
+    2000-order ladder and a taker that sweeps exactly half of it."""
+    cfg = EngineConfig(num_symbols=1, capacity=8192, batch=64,
+                       kernel="levels", max_fills=1 << 15)
+    orders = []
+    oid = 0
+    for i in range(2000):
+        oid += 1
+        orders.append(HostOrder(0, OP_SUBMIT, SELL, LIMIT,
+                                10_000 + 10 * (i % 50), 5, oid=oid))
+    oid += 1
+    orders.append(HostOrder(0, OP_SUBMIT, BUY, LIMIT, 10_000 + 10 * 24,
+                            5 * 1000, oid=oid))
+    book = init_book(cfg)
+    book, res, fills = apply_orders(cfg, book, orders)
+    taker = [r for r in res if r.oid == oid][0]
+    assert taker.filled == 5_000
+    assert len(fills) == 1000
+    assert sum(f.quantity for f in fills) == 5_000

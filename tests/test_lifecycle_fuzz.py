@@ -39,13 +39,22 @@ from matching_engine_tpu.proto import (
 S, CAP = 4, 24
 
 
-@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("kernel", ["matrix", "sorted", "levels"])
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_lifecycle_continuous_auction_interleave(kernel, seed):
     cfg = EngineConfig(num_symbols=S, capacity=CAP, batch=8,
                        max_fills=1 << 12, kernel=kernel)
     rng = random.Random(seed)
-    oracles = [OracleBook(CAP) for _ in range(S)]
+    if kernel == "levels":
+        # The levels kernel's capacity is level-structured; the oracle
+        # must model the same (L, F) bounds or reject parity breaks.
+        from matching_engine_tpu.engine.book import level_shape
+
+        lvl, fifo = level_shape(cfg)
+        oracles = [OracleBook(CAP, levels=lvl, level_fifo=fifo)
+                   for _ in range(S)]
+    else:
+        oracles = [OracleBook(CAP) for _ in range(S)]
     book = init_book(cfg)
     next_oid = 1
     # (oid, side) of LIMIT submits/rests per symbol — cancel targets need

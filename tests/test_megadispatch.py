@@ -134,7 +134,7 @@ def _assert_step_parity(cfg, orders):
     return mega
 
 
-@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("kernel", ["matrix", "sorted", "levels"])
 def test_mega_step_zero_fills(kernel):
     """Non-crossing rests only: every wave's compacted fill log is empty
     and the completion rows still decode bit-identically."""
@@ -150,7 +150,7 @@ def test_mega_step_zero_fills(kernel):
     assert all(r.filled == 0 for results, _, _ in mega for r in results)
 
 
-@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("kernel", ["matrix", "sorted", "levels"])
 def test_mega_step_all_lanes_full(kernel):
     """Every grid row of every wave carries a real op (the compaction's
     count == rcap edge) and the crossing flow produces fills in every
@@ -171,7 +171,7 @@ def test_mega_step_all_lanes_full(kernel):
     assert any(fills for _, fills, _ in mega)
 
 
-@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("kernel", ["matrix", "sorted", "levels"])
 def test_mega_step_mid_batch_cancel(kernel):
     """A maker partially filled in wave 1 and canceled mid-wave-2 (with
     more flow behind the cancel in the same wave): the scan's carry must
@@ -284,7 +284,7 @@ def _drive(runner, hub, metrics, seed):
     return out
 
 
-@pytest.mark.parametrize("kernel", ["matrix", "sorted"])
+@pytest.mark.parametrize("kernel", ["matrix", "sorted", "levels"])
 def test_megadispatch_parity_lifecycle_fuzz(kernel):
     """M=4 serving output is bit-identical to the serial M=1 schedule:
     completions, storage rows, stream protos INCLUDING the stamped feed

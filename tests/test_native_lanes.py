@@ -390,6 +390,54 @@ def test_lane_parity_lifecycle_fuzz_more_seeds(kernel, seed):
     test_lane_parity_lifecycle_fuzz(kernel, seed)
 
 
+def test_native_path_capacity_reject_metered():
+    """Full-book backpressure on the NATIVE path: the C++ decode stamps
+    the positional 'book side at capacity' reject reason, and the runner
+    feeds me_book_capacity_rejects_total from the aux completions —
+    never a silent drop. Bit-63 tags = the grpcio LaneRingDispatcher
+    route, whose completions ride the aux local section the meter
+    scans."""
+    from matching_engine_tpu.server.native_lanes import NativeLanesRunner
+
+    from matching_engine_tpu.server.native_lanes import pack_record_batch
+
+    cfg = make_cfg("matrix")
+    runner = NativeLanesRunner(cfg)
+    hi = 1 << 63
+    recs = [(hi | (i + 1), 1, 2, 0, 10_000 + i, 3, "S0", "c1", "")
+            for i in range(CAP + 3)]  # 3 past the side's capacity
+    buf, n = pack_record_batch(recs)
+    box = {}
+    runner.dispatch_records(
+        buf, n, lambda result, error: box.update(result=result, err=error))
+    runner.finish_pending()
+    assert box["err"] is None
+    errs = [loc for loc in box["result"].local if loc[5]]
+    assert len(errs) == 3
+    assert all("book side at capacity" in loc[5] for loc in errs)
+    counters, _ = runner.metrics.snapshot()
+    assert counters["book_capacity_rejects"] == 3
+    assert counters["book_capacity_rejects_tier0"] == 3
+
+    # Same overflow via LOW tags — the C++ GATEWAY batch completion
+    # route, whose rejects ride the comp wire buffer instead of the aux
+    # local section. The meter must count those too.
+    runner2 = NativeLanesRunner(make_cfg("matrix"))
+    recs2 = [(i + 1, 1, 2, 0, 10_000 + i, 3, "S0", "c1", "")
+             for i in range(CAP + 2)]
+    buf2, n2 = pack_record_batch(recs2)
+    box2 = {}
+    runner2.dispatch_records(
+        buf2, n2,
+        lambda result, error: box2.update(result=result, err=error))
+    runner2.finish_pending()
+    assert box2["err"] is None
+    comp = me_native.parse_comp_buf(box2["result"].comp_buf)
+    assert sum("book side at capacity" in c[4] for c in comp) == 2
+    counters2, _ = runner2.metrics.snapshot()
+    assert counters2["book_capacity_rejects"] == 2
+
+
 # -- full-stack e2e: build_server(native_lanes=True), grpcio edge ------------
 
 def test_native_lanes_full_stack_e2e(tmp_path):

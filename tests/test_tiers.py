@@ -1,0 +1,347 @@
+"""Tiered capacity classes: --book-tiers spec, TieredEngineRunner parity,
+tier routing, metered capacity backpressure, and restart semantics.
+
+The tier split must be INVISIBLE to everything above the runner: a
+tiered runner over the same (symbol -> slot, capacity) layout produces
+bit-identical outcomes, storage rows, fills, and market data to an
+untiered one (the per-tier decode merges in ascending tier order ==
+global device order). What tiers ADD: deep books for pinned hot symbols
+without venue-wide [S, deep] lanes, full-book rejects as metered
+backpressure (me_book_capacity_rejects_total + per-tier series), the
+per-tier high-watermark re-tiering signal, and a checkpoint format that
+refuses to restore under a changed spec (full-replay fallback).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.engine.kernel import (
+    CANCELED,
+    NEW,
+    OP_CANCEL,
+    OP_REST,
+    OP_SUBMIT,
+    REJECTED,
+)
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.server.engine_runner import (
+    EngineOp,
+    EngineRunner,
+    OrderInfo,
+)
+from matching_engine_tpu.server.tiered_runner import (
+    TieredEngineRunner,
+    parse_book_tiers,
+)
+from matching_engine_tpu.utils.checkpoint import (
+    restore_runner,
+    save_checkpoint,
+)
+
+SPEC = "2x64:HOT,*x16"
+S = 8
+
+
+def make_tiered(megadispatch_max_waves=1, oid_offset=0, oid_stride=1):
+    tiers, pins = parse_book_tiers(SPEC, S)
+    cfg = EngineConfig(num_symbols=S, capacity=64, batch=4, tiers=tiers)
+    return TieredEngineRunner(cfg, tier_pins=pins,
+                              megadispatch_max_waves=megadispatch_max_waves,
+                              oid_offset=oid_offset, oid_stride=oid_stride)
+
+
+def submit_info(runner, sym, side, price, qty, client="c"):
+    assert runner.slot_acquire(sym) is not None
+    num, oid = runner.assign_oid()
+    return OrderInfo(
+        oid=num, order_id=oid, client_id=client, symbol=sym, side=side,
+        otype=pb2.LIMIT, price_q4=price, quantity=qty, remaining=qty,
+        status=0, handle=runner.assign_handle())
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def test_parse_spec_star_and_pins():
+    tiers, pins = parse_book_tiers("8x8192:HOT-0;HOT-1,56x1024,*x128", 1024)
+    assert tiers == ((8, 8192), (56, 1024), (960, 128))
+    assert pins == {"HOT-0": 0, "HOT-1": 0}
+
+
+@pytest.mark.parametrize("spec,err", [
+    ("", "empty"),
+    ("8y128", "malformed"),
+    ("4x128,*x64,*x32", "one '*'"),
+    ("4x128", "sum to 4"),
+    ("1024x128,*x64", "leave no rows"),
+    ("2x64:A,2x32:A,*x16", "pinned to two tiers"),
+    ("0x128,*x64", "non-positive"),
+])
+def test_parse_spec_rejects(spec, err):
+    with pytest.raises(ValueError, match=err):
+        parse_book_tiers(spec, 8)
+
+
+def test_config_validates_tiers():
+    # ValueError, not AssertionError: these validate operator input
+    # (--book-tiers) and must survive `python -O`.
+    with pytest.raises(ValueError):
+        EngineConfig(num_symbols=8, capacity=64,
+                     tiers=((2, 64), (2, 16)))  # counts don't cover axis
+    with pytest.raises(ValueError):
+        EngineConfig(num_symbols=8, capacity=16,
+                     tiers=((2, 64), (6, 16)))  # capacity != deepest tier
+    cfg = EngineConfig(num_symbols=8, capacity=64,
+                       tiers=[[2, 64], [6, 16]])  # JSON round-trip shape
+    assert cfg.tiers == ((2, 64), (6, 16))
+    assert [t.semantic_key()[:2] for t in cfg.tier_configs()] == \
+        [(2, 64), (6, 16)]
+
+
+# -- dispatch parity vs the untiered runner ----------------------------------
+
+
+def drive(runner, seed, syms, n=250):
+    rng = random.Random(seed)
+    live, out = [], []
+    for _ in range(n):
+        ops = []
+        for _ in range(rng.randrange(1, 8)):
+            if live and rng.random() < 0.25:
+                ops.append(EngineOp(OP_CANCEL,
+                                    live.pop(rng.randrange(len(live))),
+                                    cancel_requester="c"))
+                continue
+            side = rng.choice((pb2.BUY, pb2.SELL))
+            info = submit_info(runner, rng.choice(syms), side,
+                               10_000 + 100 * rng.randrange(5),
+                               rng.randrange(1, 9), client=f"c{side}")
+            ops.append(EngineOp(OP_SUBMIT, info))
+            live.append(info)
+        res = runner.run_dispatch(ops)
+        out.append([(o.op.info.order_id, o.status, o.filled, o.remaining,
+                     o.error) for o in res.outcomes])
+        out.append([(f.order_id, f.counter_order_id, f.price_q4, f.quantity)
+                    for f in res.storage_fills])
+        out.append(sorted(res.storage_updates))
+        out.append([tuple(t) for t in res.storage_orders])
+        out.append(sorted((m.symbol, m.best_bid, m.best_ask, m.bid_size,
+                           m.ask_size) for m in res.market_data))
+    return out
+
+
+def test_tiered_runner_parity_with_untiered():
+    """Symbols landing in the 16-cap default group behave bit-identically
+    to an untiered capacity-16 runner over the same flow."""
+    syms = [f"S{i}" for i in range(4)]
+    tiered = make_tiered()
+    flat = EngineRunner(EngineConfig(num_symbols=S, capacity=16, batch=4))
+    assert drive(tiered, 42, syms) == drive(flat, 42, syms)
+
+
+def test_tiered_mega_parity_with_serial():
+    """M=4 megadispatch through the tiered runner == the serial tiered
+    schedule (per-tier stacked scans decode per wave in tier order)."""
+    syms = ["HOT", "S3", "S4", "S5"]
+    a = drive(make_tiered(), 7, syms)
+    b = drive(make_tiered(megadispatch_max_waves=4), 7, syms)
+    assert a == b
+
+
+# -- tier routing ------------------------------------------------------------
+
+
+def test_pinned_symbol_lands_in_its_group_and_holds_depth():
+    r = make_tiered()
+    assert r.slot_acquire("HOT") is not None
+    assert r.tier_of_slot(r.symbols["HOT"]) == 0
+    # 40 resting bids: far past the 16-cap default group, fine in tier 0.
+    for i in range(40):
+        info = submit_info(r, "HOT", pb2.BUY, 9_000 - i, 5, client="mm")
+        res = r.run_dispatch([EngineOp(OP_SUBMIT, info)])
+        assert res.outcomes[-1].status == NEW
+    bids, asks = r.book_snapshot("HOT")
+    assert len(bids) == 40 and not asks
+    # Unpinned symbols fill the LAST (shallow) group first.
+    assert r.tier_of_slot(r.slot_acquire("COLD")) == 1
+    # The high watermark followed the deep book.
+    _, gauges = r.metrics.snapshot()
+    assert gauges["book_depth_hwm_tier0"] >= 40
+    assert gauges["book_depth_hwm"] >= 40
+
+
+def test_unpinned_spill_into_deeper_group_when_shallow_full():
+    r = make_tiered()
+    for i in range(6):  # fill the 6-slot default group
+        assert r.tier_of_slot(r.slot_acquire(f"T{i}")) == 1
+    assert r.tier_of_slot(r.slot_acquire("SPILL")) == 0
+    r.slot_acquire("HOT")  # one pinned slot still free in group 0
+    assert r.tier_of_slot(r.symbols["HOT"]) == 0
+    # Now every slot is taken: the next NEW symbol is refused.
+    assert r.slot_acquire("NOPE") is None
+
+
+def test_capacity_reject_metered_with_reason():
+    """A full 16-cap book REJECTS with the positional 'book side at
+    capacity' reason and feeds me_book_capacity_rejects_total plus the
+    owning tier's series — never a silent drop."""
+    r = make_tiered()
+    rejects = 0
+    for i in range(20):
+        info = submit_info(r, "T0", pb2.SELL, 10_000 + i, 3)
+        res = r.run_dispatch([EngineOp(OP_SUBMIT, info)])
+        if res.outcomes[0].status == REJECTED:
+            rejects += 1
+            assert "book side at capacity" in res.outcomes[0].error
+    assert rejects == 4
+    counters, _ = r.metrics.snapshot()
+    assert counters["book_capacity_rejects"] == 4
+    assert counters["book_capacity_rejects_tier1"] == 4
+    assert "book_capacity_rejects_tier0" not in counters
+
+
+def test_untiered_runner_meters_capacity_rejects_too():
+    r = EngineRunner(EngineConfig(num_symbols=2, capacity=4, batch=4))
+    for i in range(6):
+        r.run_dispatch([EngineOp(OP_SUBMIT, submit_info(
+            r, "A", pb2.BUY, 9_000 - i, 2))])
+    counters, _ = r.metrics.snapshot()
+    assert counters["book_capacity_rejects"] == 2
+    assert counters["book_capacity_rejects_tier0"] == 2
+
+
+# -- auction + crossed detection across tiers --------------------------------
+
+
+def test_auction_and_crossed_span_tiers():
+    r = make_tiered()
+    r.set_auction_mode(True)
+    ops = []
+    for sym, cl in (("HOT", "a"), ("S5", "b")):
+        ops.append(EngineOp(OP_REST, submit_info(r, sym, pb2.BUY, 10_100,
+                                                 10, cl + "1")))
+        ops.append(EngineOp(OP_REST, submit_info(r, sym, pb2.SELL, 9_900,
+                                                 6, cl + "2")))
+    res = r.run_dispatch(ops)
+    assert all(o.status == NEW for o in res.outcomes)
+    assert sorted(r.crossed_symbols()) == ["HOT", "S5"]
+    summary = r.run_auction()
+    assert not summary["error"]
+    assert sorted(s for s, _, _ in summary["crossed"]) == ["HOT", "S5"]
+    assert all(q == 6 for _, _, q in summary["crossed"])
+    assert not r.auction_mode
+    assert r.crossed_symbols() == []
+
+
+# -- checkpoints + restart ---------------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_changed_spec_refused(tmp_path):
+    r = make_tiered(oid_offset=1, oid_stride=2)
+    info = submit_info(r, "HOT", pb2.BUY, 10_000, 5, "mm")
+    cold = submit_info(r, "S5", pb2.SELL, 11_000, 3, "x")
+    r.run_dispatch([EngineOp(OP_SUBMIT, info), EngineOp(OP_SUBMIT, cold)])
+    path = str(tmp_path / "ckpt")
+    with r._dispatch_lock:
+        save_checkpoint(path, r)
+
+    # Same spec restores; the strided OID line resumes on its residue.
+    r2 = make_tiered(oid_offset=1, oid_stride=2)
+    restore_runner(r2, path)
+    bids, _ = r2.book_snapshot("HOT")
+    assert len(bids) == 1 and bids[0][0].order_id == info.order_id
+    n, _ = r2.assign_oid()
+    assert n % 2 == 0 and n > info.oid  # offset-1/stride-2 residue class
+    # A cancel against the restored directory dispatches cleanly.
+    target = r2.orders_by_id[cold.order_id]
+    res = r2.run_dispatch([EngineOp(OP_CANCEL, target,
+                                    cancel_requester="x")])
+    assert res.outcomes[0].status == CANCELED
+
+    # A CHANGED tier spec refuses with a clear error (replay fallback).
+    tiers2, _ = parse_book_tiers("4x64,*x16", S)
+    r3 = TieredEngineRunner(
+        EngineConfig(num_symbols=S, capacity=64, batch=4, tiers=tiers2))
+    with pytest.raises(ValueError, match="book-tier spec"):
+        restore_runner(r3, path)
+
+
+# -- full-stack e2e: build_server with tiers + levels kernel -----------------
+
+
+@pytest.mark.slow
+def test_tiered_server_e2e_with_levels_kernel(tmp_path):
+    """build_server over a tiered levels-kernel config: deep resting on
+    the pinned hot symbol past the default group's capacity, full-book
+    backpressure on a tail symbol surfaced as a reject (not a crash),
+    and a restart recovering the books via store replay."""
+    import grpc
+
+    from matching_engine_tpu.proto.rpc import MatchingEngineStub
+    from matching_engine_tpu.server.main import build_server, shutdown
+    from matching_engine_tpu.server.tiered_runner import parse_book_tiers
+
+    tiers, pins = parse_book_tiers("2x128:HOT,*x16", 8)
+    cfg = EngineConfig(num_symbols=8, capacity=128, batch=4,
+                       kernel="levels", tiers=tiers, max_fills=1 << 12)
+    db = str(tmp_path / "t.db")
+
+    def boot():
+        server, port, parts = build_server(
+            "127.0.0.1:0", db, cfg, window_ms=1, log=False, native=False,
+            tier_pins=pins)
+        server.start()
+        stub = MatchingEngineStub(
+            grpc.insecure_channel(f"127.0.0.1:{port}"))
+        return server, parts, stub
+
+    server, parts, stub = boot()
+    # 24 resting bids on HOT at 12 distinct prices: past the 16-cap
+    # default group, comfortably inside the 128 deep group's [16, 8]
+    # levels.
+    for i in range(24):
+        r = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="mm", symbol="HOT", side=pb2.BUY,
+            order_type=pb2.LIMIT, price=9_000 - (i % 12), scale=4,
+            quantity=3))
+        assert r.success, r.error_message
+    # Tail symbol: the 16-cap group's levels config is [4, 4] — 4 FIFO
+    # slots at one price; the 5th submit there is a metered reject.
+    last = None
+    for i in range(5):
+        last = stub.SubmitOrder(pb2.OrderRequest(
+            client_id="c", symbol="TAIL", side=pb2.SELL,
+            order_type=pb2.LIMIT, price=11_000, scale=4, quantity=2))
+    assert not last.success and "capacity" in last.error_message
+    counters = dict(stub.GetMetrics(pb2.MetricsRequest()).counters)
+    assert counters["book_capacity_rejects"] == 1
+    book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="HOT"))
+    assert len(book.bids) == 24
+    shutdown(server, parts)
+
+    # Restart: store replay re-rests everything into the same tiers.
+    server, parts, stub = boot()
+    book = stub.GetOrderBook(pb2.OrderBookRequest(symbol="HOT"))
+    assert len(book.bids) == 24
+    tail = stub.GetOrderBook(pb2.OrderBookRequest(symbol="TAIL"))
+    assert len(tail.asks) == 4
+    shutdown(server, parts)
+
+
+# -- workload manifest depth check -------------------------------------------
+
+
+def test_check_tier_depth():
+    from matching_engine_tpu.sim.record import check_tier_depth
+
+    man = {"max_resting_depth": [300, 40, 40, 200]}
+    tiers = ((1, 1024), (3, 128))
+    # Unpinned symbols are judged against the LAST group.
+    bad = check_tier_depth(man, tiers, pins={"S0": 0})
+    assert len(bad) == 1 and "S3" in bad[0] and "128" in bad[0]
+    assert check_tier_depth(man, tiers, pins={"S0": 0, "S3": 0}) == []
+    assert check_tier_depth({}, tiers) != []  # pre-format manifest
