@@ -135,6 +135,61 @@ def main() -> None:
                         "wave_mega) — engagement is measured into the "
                         "row via the me_megadispatch_* counters")
     p.add_argument("--edge-window-ms", type=float, default=1.0)
+    p.add_argument("--ingress", action="store_true",
+                   help="zero-copy ingress rung sweep: replay ONE recorded "
+                        "workload (--ingress-workload) through four edges "
+                        "against a fresh server subprocess per rung — "
+                        "per-op RPC, SubmitOrderBatch at "
+                        "--ingress-batch-size, the client-streaming "
+                        "SubmitOrderStream, and the shared-memory oprec "
+                        "ring (--shm-ingress) — with the vectorized "
+                        "admission screens ENABLED in every measured path "
+                        "(permissive limits: the screens run, nothing "
+                        "extra rejects). Produces the cpu_ingress "
+                        "artifact; one row per rung, best-of --repeats")
+    p.add_argument("--ingress-workload", default="",
+                   help="a recorded scenario opfile for every rung to "
+                        "replay (must have min_cancel_gap >= "
+                        "--ingress-batch-size so batched replay can "
+                        "never see a cancel before its target's batch). "
+                        "Empty (default) = the bench RECORDS a synthetic "
+                        "edge flow first (maker/taker alternation, "
+                        "submit-only, shallow books — the r10 edge "
+                        "shape) and replays THAT identical file through "
+                        "every rung: scenario workloads are ENGINE-bound "
+                        "on this box (BENCH_METHOD §zero-copy-ingress), "
+                        "so only a light flow lets the rungs differ by "
+                        "their edge cost, which is what this sweep "
+                        "measures")
+    p.add_argument("--ingress-synthetic-ops", type=int, default=30720,
+                   help="records in the synthetic edge workload")
+    p.add_argument("--ingress-rungs", default="perop,batch,stream,shm",
+                   help="comma list of rungs to run")
+    p.add_argument("--ingress-sections", default="real,screened",
+                   help="comma list of engine sections per rung: 'real' "
+                        "= the full serving pipeline (on an XLA-CPU box "
+                        "every bulk rung converges at the DEVICE step's "
+                        "~10k/s ceiling — the finding, not a flaw); "
+                        "'screened' = the same records against a server "
+                        "whose admission screens reject everything "
+                        "(--admission-max-qty 1), so the measured path "
+                        "is decode -> vectorized screens -> positional "
+                        "responses with no device dispatch — each "
+                        "edge's INTRINSIC capacity, the figure that "
+                        "matters once the engine moves to hardware "
+                        "(BENCH_METHOD §zero-copy-ingress)")
+    p.add_argument("--ingress-batch-size", type=int, default=1024,
+                   help="records per SubmitOrderBatch request / per shm "
+                        "push on the batch and shm rungs")
+    p.add_argument("--ingress-chunk", type=int, default=256,
+                   help="records per stream chunk on the stream rung "
+                        "(smaller than the batch rung BY DESIGN: the "
+                        "stream exists for flow that can't batch "
+                        "client-side)")
+    p.add_argument("--ingress-perop-ops", type=int, default=400,
+                   help="workload PREFIX replayed on the per-op rung "
+                        "(~100/s: the full workload would take minutes "
+                        "for a figure that is only the baseline)")
     p.add_argument("--audit-ab", action="store_true",
                    help="A/B the online auditor's overhead: run each "
                         "(mode, inflight, batch-ops) point twice through "
@@ -1052,6 +1107,348 @@ def main() -> None:
                                  / off["orders_per_s"]), 1)
         return rows
 
+    # -- zero-copy ingress rung sweep --------------------------------------
+
+    def ingress_sweep() -> list:
+        """One recorded workload through four ingress rungs, each
+        against a fresh server subprocess (fresh OID line — the
+        recorder's cancel renumbering must hold per rung), with the
+        vectorized admission screens enabled in every measured path.
+        Throughput is ops-through-the-edge per second (accepted +
+        replay-expected rejects — a recorded cancel whose maker already
+        filled rejects 'order not open' by design; the rung comparison
+        is about the EDGE, and every rung replays the identical
+        stream)."""
+        import json as _json
+        import subprocess as _sp
+        import tempfile
+
+        import grpc
+
+        from matching_engine_tpu import native as me_native
+        from matching_engine_tpu.domain import oprec
+        from matching_engine_tpu.proto import pb2
+        from matching_engine_tpu.proto.rpc import MatchingEngineStub
+
+        bs = args.ingress_batch_size
+        tmpd = tempfile.mkdtemp(prefix="ingress_bench_")
+        if args.ingress_workload:
+            arr = oprec.read_opfile(args.ingress_workload)
+            man_path = args.ingress_workload.split(".opfile")[0] \
+                + ".manifest.json"
+            man = _json.load(open(man_path))
+            gap = man.get("min_cancel_gap") or 0
+            if gap and bs > gap:
+                raise SystemExit(
+                    f"--ingress-batch-size {bs} > the workload's "
+                    f"min_cancel_gap {gap}: an intra-batch cancel could "
+                    f"precede its target (pick a workload with a larger "
+                    f"gap or a smaller batch)")
+            workload_name = args.ingress_workload
+            srv_symbols = man["symbols"]
+            srv_capacity = man["capacity"]
+            srv_batch = man["batch"]
+        else:
+            # Record the synthetic edge flow ONCE (a real opfile —
+            # every rung replays the identical bytes): per-symbol
+            # maker/taker alternation so books stay shallow (the SELL
+            # rests, the next BUY crosses it out) — the engine stays
+            # cheap and the rung comparison isolates the EDGE.
+            n = args.ingress_synthetic_ops
+            srv_symbols, srv_capacity, srv_batch = 16, 128, 8
+            rows_syn = []
+            for i in range(n):
+                sym = f"E{i % srv_symbols}"
+                maker = ((i // srv_symbols) % 2) == 0
+                rows_syn.append(
+                    (oprec.OPREC_SUBMIT, 2 if maker else 1, 0, 10_000, 5,
+                     sym, "im" if maker else "it", ""))
+            arr = oprec.pack_records(rows_syn)
+            workload_name = os.path.join(tmpd, "synthetic_edge.opfile")
+            oprec.write_opfile(workload_name, arr)
+            gap = 0
+        rungs = [r.strip() for r in args.ingress_rungs.split(",")
+                 if r.strip()]
+        if not me_native.available() and "shm" in rungs:
+            print("[ingress] native runtime not built; skipping shm rung",
+                  file=sys.stderr)
+            rungs = [r for r in rungs if r != "shm"]
+
+        def boot(tag: str, shm_path: str | None, screened: bool = False):
+            log_path = os.path.join(tmpd, f"server_{tag}.log")
+            argv = [sys.executable, "-m",
+                    "matching_engine_tpu.server.main",
+                    "--addr", "127.0.0.1:0",
+                    "--db", os.path.join(tmpd, f"ingress_{tag}.db"),
+                    "--symbols", str(srv_symbols),
+                    "--capacity", str(srv_capacity),
+                    "--batch", str(srv_batch),
+                    "--window-ms", str(args.edge_window_ms),
+                    "--megadispatch-max-waves", str(args.edge_mega),
+                    "--feed-depth", "0",
+                    # Screens ON in every measured path. 'real': the
+                    # permissive limits run the vectorized passes
+                    # without adding rejects. 'screened': max-qty 1
+                    # rejects every submit AT the screen — the edge +
+                    # admission pipeline in isolation, no dispatch.
+                    "--admission-rate", "1000000000",
+                    "--admission-window-s", "1.0",
+                    "--admission-max-qty",
+                    "1" if screened else "2000000"]
+            if me_native.available():
+                argv.append("--native-lanes")
+            if shm_path is not None:
+                argv += ["--shm-ingress", shm_path]
+            logf = open(log_path, "w")
+            proc = _sp.Popen(argv, stdout=logf, stderr=_sp.STDOUT,
+                             env=dict(os.environ, PYTHONUNBUFFERED="1"))
+            import re as _re
+
+            port = None
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"ingress server ({tag}) died; see {log_path}")
+                m = _re.search(r"listening on port (\d+)",
+                               open(log_path).read())
+                if m:
+                    port = int(m.group(1))
+                    break
+                time.sleep(0.25)
+            if port is None:
+                proc.kill()
+                raise RuntimeError(f"ingress server ({tag}) never bound")
+            return proc, port
+
+        def scrape(stub):
+            resp = stub.GetMetrics(pb2.MetricsRequest(), timeout=30)
+            return dict(resp.counters)
+
+        def replay_perop(stub) -> tuple[int, int, int]:
+            n = min(len(arr), args.ingress_perop_ops)
+            acc = rej = 0
+            _OT = {0: (pb2.LIMIT, 0), 1: (pb2.MARKET, 0),
+                   2: (pb2.LIMIT, pb2.TIF_IOC), 3: (pb2.LIMIT, pb2.TIF_FOK),
+                   4: (pb2.MARKET, pb2.TIF_FOK)}
+            for i in range(n):
+                (op, side, otype, price_q4, qty, sym, cid,
+                 oid) = oprec.record_fields(arr[i])
+                if op == oprec.OPREC_SUBMIT:
+                    ot, tif = _OT[otype]
+                    r = stub.SubmitOrder(pb2.OrderRequest(
+                        client_id=cid.decode(), symbol=sym.decode(),
+                        side=side, order_type=ot, tif=tif,
+                        price=price_q4, scale=4, quantity=qty),
+                        timeout=60)
+                elif op == oprec.OPREC_CANCEL:
+                    r = stub.CancelOrder(pb2.CancelRequest(
+                        client_id=cid.decode(), order_id=oid.decode()),
+                        timeout=60)
+                else:
+                    r = stub.AmendOrder(pb2.AmendRequest(
+                        client_id=cid.decode(), order_id=oid.decode(),
+                        new_quantity=qty), timeout=60)
+                if r.success:
+                    acc += 1
+                else:
+                    rej += 1
+            return n, acc, rej
+
+        def replay_batch(stub) -> tuple[int, int, int]:
+            acc = rej = 0
+            for s0 in range(0, len(arr), bs):
+                resp = stub.SubmitOrderBatch(pb2.OrderBatchRequest(
+                    ops=oprec.slice_payload(arr, s0, bs)), timeout=300)
+                if not resp.success:
+                    raise RuntimeError(
+                        f"batch rejected: {resp.error_message}")
+                a = sum(resp.ok)
+                acc += a
+                rej += len(resp.ok) - a
+            return len(arr), acc, rej
+
+        def replay_stream(stub) -> tuple[int, int, int]:
+            def chunks():
+                for s0 in range(0, len(arr), args.ingress_chunk):
+                    yield pb2.OrderBatchRequest(
+                        ops=oprec.slice_payload(arr, s0,
+                                                args.ingress_chunk))
+
+            resp = stub.SubmitOrderStream(chunks(), timeout=600)
+            if not resp.success:
+                raise RuntimeError(
+                    f"stream rejected: {resp.error_message}")
+            a = sum(resp.ok)
+            return len(resp.ok), a, len(resp.ok) - a
+
+        def replay_shm(shm_path: str) -> tuple[int, int, int]:
+            ring = me_native.ShmRing(shm_path)
+            # Cancel-gap flow control for recorded scenarios: the poller
+            # dispatches whatever run it pops, and a cancel landing in
+            # the SAME dispatch as its target resolves against the
+            # pre-batch directory ('unknown order id'). Bounding the
+            # in-flight backlog below min_cancel_gap keeps a target's
+            # dispatch strictly ahead of its cancel's. Submit-only
+            # synthetic flow needs no bound beyond the ring itself.
+            max_inflight = max(bs, gap - bs) if gap else (1 << 30)
+            try:
+                acc = rej = pending = pushed = 0
+
+                def drain(wait_us):
+                    nonlocal acc, rej, pending
+                    raw = ring.resp_poll_raw(4096, wait_us)
+                    if raw is None:
+                        raise RuntimeError(
+                            "shm segment shut down mid-replay (server "
+                            "died?)")
+                    if not raw:
+                        return
+                    rs = np.frombuffer(raw, dtype=oprec.SHM_RESP_DTYPE)
+                    pending -= len(rs)
+                    a = int(np.count_nonzero(rs["ok"]))
+                    acc += a
+                    rej += len(rs) - a
+
+                push_deadline = time.perf_counter() + 300
+                while pushed < len(arr):
+                    if time.perf_counter() > push_deadline:
+                        raise RuntimeError(
+                            f"shm replay stalled ({pushed}/{len(arr)} "
+                            f"pushed)")
+                    n = min(bs, len(arr) - pushed)
+                    if pending + n > max_inflight:
+                        drain(2_000)
+                        continue
+                    base = ring.push_payload(
+                        arr[pushed:pushed + n].tobytes(), n)
+                    if base == -2:
+                        raise RuntimeError(
+                            "shm segment shut down mid-replay")
+                    if base < 0:
+                        drain(5_000)  # full: let the poller catch up
+                        continue
+                    pushed += n
+                    pending += n
+                    drain(0)
+                deadline = time.perf_counter() + 120
+                while pending > 0 and time.perf_counter() < deadline:
+                    drain(100_000)
+                if pending:
+                    raise RuntimeError(
+                        f"shm replay: {pending} responses missing")
+                return pushed, acc, rej
+            finally:
+                ring.close()
+
+        # One THROWAWAY boot warms the persistent jax compile cache with
+        # this workload's dispatch shapes. Warming inside a measured
+        # server would consume OIDs and break the recorder's cancel
+        # renumbering (every id shifts); warming a server nobody
+        # measures leaves each rung's OID line pristine while its first
+        # dispatch hits the compile cache instead of a cold trace.
+        proc, port = boot("cachewarm", None)
+        try:
+            stub = MatchingEngineStub(grpc.insecure_channel(
+                f"127.0.0.1:{port}"))
+            for s0 in (0, bs):
+                stub.SubmitOrderBatch(pb2.OrderBatchRequest(
+                    ops=oprec.slice_payload(arr, s0, bs)), timeout=300)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=20)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+        rows = []
+        sections = [s.strip() for s in args.ingress_sections.split(",")
+                    if s.strip()]
+        for section, rung in [(s, r) for s in sections for r in rungs]:
+            screened = section == "screened"
+            reps = []
+            for rep in range(max(1, args.repeats)):
+                shm_path = (os.path.join(tmpd,
+                                         f"ring_{section}_{rung}_{rep}")
+                            if rung == "shm" else None)
+                proc, port = boot(f"{section}_{rung}_{rep}", shm_path,
+                                  screened)
+                try:
+                    stub = MatchingEngineStub(grpc.insecure_channel(
+                        f"127.0.0.1:{port}"))
+                    c0 = scrape(stub)
+                    t0 = time.perf_counter()
+                    if rung == "perop":
+                        n, acc, rej = replay_perop(stub)
+                    elif rung == "batch":
+                        n, acc, rej = replay_batch(stub)
+                    elif rung == "stream":
+                        n, acc, rej = replay_stream(stub)
+                    elif rung == "shm":
+                        n, acc, rej = replay_shm(shm_path)
+                    else:
+                        raise SystemExit(f"unknown rung {rung!r}")
+                    dt = time.perf_counter() - t0
+                    c1 = scrape(stub)
+                    row = {
+                        "rung": rung,
+                        "engine": section,
+                        "n_ops": n,
+                        "orders_per_s": round(n / dt, 1),
+                        "accepted": acc,
+                        "rejected": rej,
+                        "wall_s": round(dt, 3),
+                        # Proof the screens ran in the measured path:
+                        # the admission counters exist on the scrape
+                        # (zero rejects — the limits are permissive).
+                        "screens_active":
+                            "admission_rate_rejects" in c1,
+                        "screen_rejects": sum(
+                            c1.get(k, 0) - c0.get(k, 0)
+                            for k in ("admission_rate_rejects",
+                                      "admission_qty_rejects",
+                                      "admission_band_rejects",
+                                      "admission_stp_rejects")),
+                        "mega_steps": c1.get("megadispatch_steps", 0)
+                        - c0.get("megadispatch_steps", 0),
+                    }
+                    if rung == "shm":
+                        row["ingress_records"] = (
+                            c1.get("ingress_records", 0)
+                            - c0.get("ingress_records", 0))
+                        row["ingress_torn_recoveries"] = c1.get(
+                            "ingress_torn_recoveries", 0)
+                    if rung == "batch":
+                        row["batch_size"] = bs
+                    if rung == "stream":
+                        row["chunk"] = args.ingress_chunk
+                    reps.append(row)
+                finally:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=20)
+                    except Exception:  # noqa: BLE001
+                        proc.kill()
+            rates = [r["orders_per_s"] for r in reps]
+            best = max(reps, key=lambda r: r["orders_per_s"])
+            best["repeats"] = len(reps)
+            best["orders_per_s_spread"] = [min(rates), max(rates)]
+            rows.append(best)
+            print(f"[ingress] {section}/{rung}: "
+                  f"{best['orders_per_s']} orders/s "
+                  f"(n {best['n_ops']}, acc {best['accepted']}, rej "
+                  f"{best['rejected']}, wall {best['wall_s']}s)",
+                  file=sys.stderr)
+        # The headline ratios, per section.
+        for section in sections:
+            by = {r["rung"]: r for r in rows if r["engine"] == section}
+            if "shm" in by and "batch" in by \
+                    and by["batch"]["orders_per_s"]:
+                by["shm"]["vs_batch_x"] = round(
+                    by["shm"]["orders_per_s"]
+                    / by["batch"]["orders_per_s"], 2)
+        return rows
+
     # -- workload replay (sim/record.py artifacts) -------------------------
 
     def workload_sweep() -> list:
@@ -1468,6 +1865,8 @@ def main() -> None:
                   if k.strip()] if args.serve_shards else []
     if args.capacity_sweep:
         rows = capacity_sweep()
+    elif args.ingress:
+        rows = ingress_sweep()
     elif args.workload:
         rows = workload_sweep()
     elif args.edge_batch:
@@ -1569,6 +1968,7 @@ def main() -> None:
         rev = "unknown"
     out = {
         "metric": ("kernel_capacity_sweep" if args.capacity_sweep
+                   else "ingress_rungs" if args.ingress
                    else "workload_replay" if args.workload
                    else "batch_edge_audit_ab" if args.edge_batch
                    and args.audit_ab
@@ -1593,6 +1993,15 @@ def main() -> None:
     if args.workload:
         out["workloads"] = [f.strip() for f in args.workload.split(",")
                             if f.strip()]
+        out["edge_mega"] = args.edge_mega
+        out["edge_window_ms"] = args.edge_window_ms
+    if args.ingress:
+        out["ingress_workload"] = (args.ingress_workload
+                                   or f"synthetic_edge "
+                                      f"({args.ingress_synthetic_ops} "
+                                      f"submit-only maker/taker records)")
+        out["ingress_batch_size"] = args.ingress_batch_size
+        out["ingress_chunk"] = args.ingress_chunk
         out["edge_mega"] = args.edge_mega
         out["edge_window_ms"] = args.edge_window_ms
     tmp = args.json_out + ".tmp"

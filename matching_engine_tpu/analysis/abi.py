@@ -249,6 +249,18 @@ def run() -> list[Violation]:
     vs += compare_layouts("native/me_gwop.h:MeGwOp", cf, csz,
                           "native/__init__.py:MeGwOp", pf, psz)
 
+    # 2b. MeShmResp (header) vs BOTH python mirrors: the shm ingress
+    # response record (dtype for vectorized client decode, ctypes for
+    # the poller's response builder).
+    cf, csz = c_layout(parse_struct(gwop_h, "MeShmResp"))
+    pf, psz, evs = dtype_layout(oprec.SHM_RESP_DTYPE)
+    vs += evs
+    vs += compare_layouts("native/me_gwop.h:MeShmResp", cf, csz,
+                          "domain/oprec.py:SHM_RESP_DTYPE", pf, psz)
+    pf, psz = ctypes_layout(native_mod.MeShmResp)
+    vs += compare_layouts("native/me_gwop.h:MeShmResp", cf, csz,
+                          "native/__init__.py:MeShmResp", pf, psz)
+
     # 3. MeOp (me_native.cpp) vs the ctypes lane-op mirror.
     cf, csz = c_layout(parse_struct(me_native_cpp, "MeOp"))
     pf, psz = ctypes_layout(native_mod.MeOp)

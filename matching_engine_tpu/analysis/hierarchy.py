@@ -55,6 +55,12 @@ LEVELS: dict[str, tuple[str, ...]] = {
     # repl_pair — a slow dump must not stall the applier or attestor.
     "repl_promote": ("StandbyReplica._lock",),
     "repl_pair": ("StandbyReplica._attest_lock",),
+    # Vectorized admission screens (server/admission.py): one batch-
+    # granular lock serializes the screen state (rate windows, price
+    # anchors, STP tables) across every ingress thread (rpc handlers,
+    # the shm poller, the gateway bridge's forwarded batch). Nothing
+    # nests inside it — the lock body is numpy passes + dict updates.
+    "admission": ("AdmissionScreens._lock",),
 }
 
 # -- the declared partial order ---------------------------------------------
@@ -154,6 +160,10 @@ ATTR_TYPES: dict[str, str | None] = {
     "replica": "StandbyReplica",
     "oplog": "OpLogShipper",
     "sub": "_Subscription",         # stream fan-out subscriptions
+    "admission": "AdmissionScreens",
+    # The shm ring wrapper: its methods are ctypes crossings into
+    # me_shmring.cpp, never tracked-lock acquisitions.
+    "ring": None,
     "conn": "sqlite3",
     "_conn": "sqlite3",
     "cur": "sqlite3",
@@ -229,6 +239,11 @@ THREAD_ROLES: dict[str, tuple[str, ...]] = {
     # The promotion watcher: heartbeat-age gauge, idle attestation-group
     # flush, and the opt-in auto-promote trigger.
     "repl_watch": ("StandbyReplica._watcher_loop",),
+    # The shared-memory ingress poller (server/shm_ingress.py): pops
+    # committed record runs from the shm ring, screens them through the
+    # service's shared batch pipeline (admission + routing + dispatch),
+    # and answers through the response ring.
+    "shm_poller": ("ShmIngress._run",),
 }
 
 # -- shared-state ownership --------------------------------------------------
@@ -323,6 +338,16 @@ OWNERSHIP: dict[str, tuple[str, str]] = {
         "engine_runner._ledger_lost — called from decode under the "
         "dispatch lock via the _prepare closures (closure-approximation "
         "false positive; PR 11 review)"),
+    # Subscriber-gated proto-build flag: refreshed at the top of every
+    # dispatch/auction (under the dispatch lock on the serving paths)
+    # from the hub's documented lock-free peek; a one-dispatch-stale
+    # read only builds (or skips) protos for subscribers that attached
+    # or left mid-dispatch — the same contract as StreamHub._ou_subs.
+    "EngineRunner._build_ou": (
+        "gil-atomic",
+        "engine_runner._stage_locked/run_auction — single bool refreshed "
+        "per dispatch from streams.has_order_update_subs (the documented "
+        "lock-free peek); readers tolerate one-dispatch staleness"),
     # Order directories: every WRITE happens under the dispatch lock
     # (registration in _decode_batch / eviction in _evict, both inside
     # the locked decode); the lock-free dict probes from the RPC edge

@@ -34,6 +34,10 @@ USAGE = (
     "                 [--idle-exit SECS] [--summary-json FILE] [--quiet]\n"
     "   or: client submit-batch <addr> <opfile> [--batch-size N]\n"
     "                 [--summary-json FILE] [--quiet]\n"
+    "   or: client submit-stream <addr> <opfile> [--chunk N]\n"
+    "                 [--summary-json FILE] [--quiet]\n"
+    "   or: client submit-shm <segment> <opfile> [--chunk N]\n"
+    "                 [--timeout SECS] [--summary-json FILE] [--quiet]\n"
     "   or: client audit <addr> [--from-seq N] [--epoch N]\n"
     "                 [--no-gap-fill] [--max-events N] [--idle-exit SECS]\n"
     "                 [--capture FILE] [--summary-json FILE] [--quiet]\n"
@@ -575,6 +579,225 @@ def _submit_batch(argv: list[str]) -> int:
     return 0 if accepted > 0 or total == 0 else 3
 
 
+def _submit_stream(argv: list[str]) -> int:
+    """Replay a recorded op file through the client-streaming
+    SubmitOrderStream RPC: the file slices into --chunk payloads sent as
+    one stream; ONE positional response spans the whole stream. Exit 3
+    when nothing was accepted, 2 on RPC failure."""
+    import json
+    import time
+
+    from matching_engine_tpu.domain import oprec
+
+    addr, path = argv[0], argv[1]
+    chunk, summary_json, quiet = 64, None, False
+    it = iter(argv[2:])
+    try:
+        for a in it:
+            if a == "--chunk":
+                chunk = int(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            elif a == "--quiet":
+                quiet = True
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except StopIteration:
+        print(USAGE, file=sys.stderr)
+        return 1
+    if chunk < 1:
+        print(USAGE, file=sys.stderr)
+        return 1
+    try:
+        arr = oprec.read_opfile(path)
+    except (OSError, oprec.OpRecError) as e:
+        print(f"[client] cannot read op file: {e}", file=sys.stderr)
+        return 1
+    stub = _stub(addr)
+    total = len(arr)
+
+    def chunks():
+        for start in range(0, total, chunk):
+            yield pb2.OrderBatchRequest(
+                ops=oprec.slice_payload(arr, start, chunk))
+
+    t0 = time.perf_counter()
+    try:
+        resp = stub.SubmitOrderStream(chunks(), timeout=300)
+    except grpc.RpcError as e:
+        print(f"[client] rpc failed: {e.code().name}: {e.details()}",
+              file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+    if not resp.success:
+        print(f"[client] stream rejected: {resp.error_message}",
+              file=sys.stderr)
+        return 3
+    accepted = sum(1 for ok in resp.ok if ok)
+    rejected = len(resp.ok) - accepted
+    errors: dict[str, int] = {}
+    for i, ok in enumerate(resp.ok):
+        if not ok:
+            err = resp.error[i]
+            errors[err] = errors.get(err, 0) + 1
+            if not quiet:
+                print(f"[client] op {i} rejected: {err}")
+    rate = accepted / dt if dt > 0 else 0.0
+    summary = {"ops": total, "chunk": chunk, "accepted": accepted,
+               "rejected": rejected, "wall_s": round(dt, 3),
+               "accepted_per_s": round(rate, 1), "reject_reasons": errors}
+    print(f"[client] stream replay: {accepted}/{total} accepted, "
+          f"{dt:.3f}s ({rate:.0f} accepted/s)", file=sys.stderr, flush=True)
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f)
+    return 0 if accepted > 0 or total == 0 else 3
+
+
+def _submit_shm(argv: list[str]) -> int:
+    """Replay a recorded op file through a server's shared-memory
+    ingress segment (--shm-ingress PATH on the server): attach, write
+    records straight into the mapped ring in --chunk claims, and collect
+    positional responses (by ring sequence) from the response ring.
+    Backpressure (a full ring) retries until --timeout. Exit 3 when
+    nothing was accepted, 2 when the segment is unavailable or responses
+    go missing."""
+    import json
+    import time
+
+    from matching_engine_tpu import native as me_native
+    from matching_engine_tpu.domain import oprec
+
+    seg, path = argv[0], argv[1]
+    chunk, timeout_s, summary_json, quiet = 256, 60.0, None, False
+    max_inflight = 1 << 30
+    it = iter(argv[2:])
+    try:
+        for a in it:
+            if a == "--chunk":
+                chunk = int(next(it))
+            elif a == "--timeout":
+                timeout_s = float(next(it))
+            elif a == "--max-inflight":
+                # Cancel-gap flow control for recorded scenarios: keep
+                # the un-acked backlog below the manifest's
+                # min_cancel_gap so the poller can never dispatch a
+                # cancel in the same batch as its target submit.
+                max_inflight = int(next(it))
+            elif a == "--summary-json":
+                summary_json = next(it)
+            elif a == "--quiet":
+                quiet = True
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except StopIteration:
+        print(USAGE, file=sys.stderr)
+        return 1
+    if chunk < 1:
+        print(USAGE, file=sys.stderr)
+        return 1
+    try:
+        arr = oprec.read_opfile(path)
+    except (OSError, oprec.OpRecError) as e:
+        print(f"[client] cannot read op file: {e}", file=sys.stderr)
+        return 1
+    try:
+        ring = me_native.ShmRing(seg)
+    except RuntimeError as e:
+        print(f"[client] cannot attach shm segment: {e}", file=sys.stderr)
+        return 2
+    total = len(arr)
+    deadline = time.perf_counter() + timeout_s
+    accepted = rejected = accepted_submits = 0
+    reasons: dict[str, int] = {}
+    pending = 0
+    pushed = 0
+    t0 = time.perf_counter()
+
+    import numpy as np
+
+    def drain(wait_us: int) -> bool:
+        """Vectorized response drain: decode the raw MeShmResp run as
+        ONE numpy array — the client stays per-batch python like the
+        server's poller."""
+        nonlocal pending, accepted, rejected, accepted_submits
+        raw = ring.resp_poll_raw(4096, wait_us)
+        if raw is None:
+            return False  # server shut the segment down
+        if not raw:
+            return True
+        rs = np.frombuffer(raw, dtype=oprec.SHM_RESP_DTYPE)
+        pending -= len(rs)
+        okv = rs["ok"] != 0
+        accepted += int(np.count_nonzero(okv))
+        accepted_submits += int(np.count_nonzero(okv & (rs["kind"] == 0)))
+        nbad = len(rs) - int(np.count_nonzero(okv))
+        rejected += nbad
+        if nbad:
+            for code, cnt in zip(*np.unique(rs["reason"][~okv],
+                                            return_counts=True)):
+                msg = oprec.REASON_MESSAGES.get(int(code),
+                                                f"reason {code}")
+                reasons[msg] = reasons.get(msg, 0) + int(cnt)
+            if not quiet:
+                for r in rs[~okv]:
+                    msg = oprec.REASON_MESSAGES.get(int(r["reason"]),
+                                                    "?")
+                    print(f"[client] seq {int(r['seq'])} rejected: "
+                          f"{msg}")
+        return True
+
+    alive = True
+    while pushed < total and alive:
+        n = min(chunk, total - pushed)
+        if pending + n > max_inflight:
+            alive = drain(2_000)
+            if time.perf_counter() > deadline:
+                print("[client] responses stalled past --timeout",
+                      file=sys.stderr)
+                break
+            continue
+        body = arr[pushed:pushed + n].tobytes()
+        base = ring.push_payload(body, n)
+        if base == -2:
+            alive = False
+            break
+        if base < 0:
+            # Ring full: drain responses (frees nothing here, but keeps
+            # the response ring moving) and retry until the deadline.
+            alive = drain(10_000)
+            if time.perf_counter() > deadline:
+                print("[client] shm ring full past --timeout",
+                      file=sys.stderr)
+                break
+            continue
+        pushed += n
+        pending += n
+        alive = drain(0)
+    while pending > 0 and alive and time.perf_counter() < deadline:
+        alive = drain(100_000)
+    dt = time.perf_counter() - t0
+    ring.close()
+    if pending > 0:
+        print(f"[client] {pending} response(s) missing after "
+              f"{timeout_s:.0f}s", file=sys.stderr)
+        return 2
+    rate = accepted / dt if dt > 0 else 0.0
+    summary = {"ops": total, "pushed": pushed, "chunk": chunk,
+               "accepted": accepted, "accepted_submits": accepted_submits,
+               "rejected": rejected,
+               "wall_s": round(dt, 3), "accepted_per_s": round(rate, 1),
+               "reject_reasons": reasons}
+    print(f"[client] shm replay: {accepted}/{total} accepted, "
+          f"{dt:.3f}s ({rate:.0f} accepted/s)", file=sys.stderr, flush=True)
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f)
+    return 0 if accepted > 0 or total == 0 else 3
+
+
 def _simulate(argv: list[str]) -> int:
     """Record a named scenario to a workload opfile WITHOUT any server or
     bench harness: run the on-device agent market (sim/scenarios.py),
@@ -729,6 +952,10 @@ def _dispatch(argv: list[str]) -> int:
             return _subscribe(argv[1:])
         if len(argv) >= 3 and argv[0] == "submit-batch":
             return _submit_batch(argv[1:])
+        if len(argv) >= 3 and argv[0] == "submit-stream":
+            return _submit_stream(argv[1:])
+        if len(argv) >= 3 and argv[0] == "submit-shm":
+            return _submit_shm(argv[1:])
         if len(argv) >= 3 and argv[0] == "simulate":
             return _simulate(argv[1:])
         if len(argv) >= 2 and argv[0] == "audit":

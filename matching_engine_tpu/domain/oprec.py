@@ -249,6 +249,81 @@ def record_flaws(arr: np.ndarray) -> list[str | None]:
     return msgs
 
 
+# -- shm ingress response records + reason vocabulary -------------------------
+#
+# The shared-memory edge (native/me_shmring.cpp) answers positionally
+# through a ring of fixed 48-byte response records (MeShmResp in
+# native/me_gwop.h; the ABI cross-checker pins this dtype against the C
+# struct and the ctypes mirror). Rejects carry CODES, not free text —
+# one vocabulary across the C++ structural screen (me_oprec_flaws), the
+# vectorized admission pipeline (server/admission.py) and the client.
+
+SHM_RESP_DTYPE = np.dtype([
+    ("seq", "<u8"),
+    ("remaining", "<i8"),
+    ("order_id", "S24"),
+    ("ok", "u1"),
+    ("kind", "u1"),
+    ("reason", "u1"),
+    ("oid_len", "u1"),
+    ("_pad", "V4"),
+])
+assert SHM_RESP_DTYPE.itemsize == 48
+
+# MeIngressReason (native/me_gwop.h) — the shm edge's reject vocabulary.
+(REASON_NONE, REASON_MALFORMED, REASON_RATE, REASON_QTY, REASON_BAND,
+ REASON_STP, REASON_RING_FULL, REASON_ENGINE, REASON_REJECTED) = range(9)
+
+REASON_MESSAGES = {
+    REASON_NONE: "",
+    REASON_MALFORMED: "malformed record (structural screen)",
+    REASON_RATE: "per-client rate limit exceeded",
+    REASON_QTY: "order size exceeds the per-client maximum",
+    REASON_BAND: "price outside the admission band",
+    REASON_STP: "self-trade prevention (crosses own resting order)",
+    REASON_RING_FULL: "server overloaded",
+    REASON_ENGINE: "engine error",
+    REASON_REJECTED: "rejected",
+}
+
+# me_oprec_flaws (me_lanes.cpp) code -> the record_flaws message branch.
+# Code 9 depends on the op (amend vs submit wording); flaw_message
+# resolves it. tests/test_shm_ingress.py pins code<->message parity by
+# fuzzing both screens over the same records.
+_FLAW_MESSAGES = {
+    1: "invalid op code (1=submit, 2=cancel, 3=amend)",
+    2: "reserved flags must be 0",
+    3: "identifier length exceeds the record box",
+    4: "symbol is required",
+    5: "unknown order id",
+    6: "client_id is required",
+    7: "side must be BUY or SELL",
+    8: "unsupported (order_type, tif) combination",
+    11: None,  # price bound (built below: value-dependent)
+    12: "MARKET records must carry price_q4=0",
+}
+
+
+def flaw_message(code: int, op: int) -> str | None:
+    """me_oprec_flaws code -> the exact record_flaws message (None for
+    code 0 / clean)."""
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+    from matching_engine_tpu.domain.price import MAX_DEVICE_PRICE_Q4
+
+    if code == 0:
+        return None
+    if code == 9:
+        return ("new_quantity must be positive" if op == OPREC_AMEND
+                else "quantity must be positive")
+    if code == 10:
+        return (f"quantity exceeds the engine maximum "
+                f"{MAX_QUANTITY} (int32 book-sum safety bound)")
+    if code == 11:
+        return (f"price_q4 out of the engine's int32 price lane "
+                f"(0, {MAX_DEVICE_PRICE_Q4}]")
+    return _FLAW_MESSAGES.get(code, "malformed record")
+
+
 def record_fields(r) -> tuple:
     """One record -> the (op, side, otype, price_q4, quantity, symbol,
     client_id, order_id) tuple with length-sliced BYTES strings, read

@@ -59,6 +59,7 @@ class MeOp(ctypes.Structure):
 
 
 _SRCS = [_SRC, os.path.join(_SRC_DIR, "me_lanes.cpp"),
+         os.path.join(_SRC_DIR, "me_shmring.cpp"),
          os.path.join(_SRC_DIR, "me_gwop.h")]
 
 
@@ -308,6 +309,22 @@ class MeGwOp(ctypes.Structure):
     ]
 
 
+# Python mirror of MeShmResp (native/me_gwop.h) — one positional response
+# record on the shm ingress ring; oprec.SHM_RESP_DTYPE is the numpy twin
+# and the ABI cross-checker (analysis/abi.py) pins all three layouts.
+class MeShmResp(ctypes.Structure):
+    _fields_ = [
+        ("seq", ctypes.c_uint64),
+        ("remaining", ctypes.c_int64),
+        ("order_id", ctypes.c_char * 24),
+        ("ok", ctypes.c_uint8),
+        ("kind", ctypes.c_uint8),
+        ("reason", ctypes.c_uint8),
+        ("oid_len", ctypes.c_uint8),
+        ("pad", ctypes.c_char * 4),
+    ]
+
+
 GW_CALLBACK = ctypes.CFUNCTYPE(
     None, ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
     ctypes.c_uint64,
@@ -339,6 +356,14 @@ def _load_gateway():
         lib.me_gateway_port.argtypes = [ctypes.c_void_p]
         lib.me_gateway_port.restype = ctypes.c_int
         lib.me_gateway_set_callback.argtypes = [ctypes.c_void_p, GW_CALLBACK]
+        try:
+            lib.me_gateway_set_forward_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+            ]
+        except AttributeError:
+            # A stale pre-batch-path build: the native M_BATCH path is
+            # simply always-forward there (the python wrapper guards).
+            pass
         lib.me_gw_pop_batch_timed.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(MeGwOp), ctypes.c_uint32,
             ctypes.c_uint64, ctypes.c_int64,
@@ -444,6 +469,18 @@ class NativeGateway:
 
         self._cb_ref = GW_CALLBACK(_trampoline)
         self._lib.me_gateway_set_callback(self._h, self._cb_ref)
+
+    def set_forward_batch(self, forward: bool) -> None:
+        """M_BATCH routing: False (default) = the in-gateway native
+        batch path (me_oprec_flaws + me_oprec_to_gwop + ring_push_n,
+        answered positionally from ring completions); True = forward the
+        payload through the python callback into the shared service
+        handler (the bridge sets this when the vectorized admission
+        screens are enabled — those run python-side)."""
+        fn = getattr(self._lib, "me_gateway_set_forward_batch", None)
+        if fn is None:
+            return  # stale build: M_BATCH always forwards there
+        fn(self._h, 1 if forward else 0)
 
     def pop_batch(self, max_ops: int, window_us: int,
                   first_wait_us: int = -1):
@@ -789,6 +826,62 @@ def _bind_lanes(lib) -> None:
     lib.me_gwring_close.argtypes = [ctypes.c_void_p]
     lib.me_gwring_dropped.argtypes = [ctypes.c_void_p]
     lib.me_gwring_dropped.restype = ctypes.c_uint64
+    lib.me_oprec_flaws.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_int32), ctypes.c_uint32,
+    ]
+    lib.me_oprec_flaws.restype = ctypes.c_int
+
+    # Shared-memory ingress ring (native/me_shmring.cpp).
+    lib.me_shmring_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+    ]
+    lib.me_shmring_create.restype = ctypes.c_void_p
+    lib.me_shmring_attach.argtypes = [ctypes.c_char_p]
+    lib.me_shmring_attach.restype = ctypes.c_void_p
+    lib.me_shmring_close.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_shutdown.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_claim.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.me_shmring_claim.restype = ctypes.c_longlong
+    lib.me_shmring_slot.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.me_shmring_slot.restype = ctypes.c_void_p
+    lib.me_shmring_commit.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.me_shmring_wake.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_push_n.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.me_shmring_push_n.restype = ctypes.c_longlong
+    lib.me_shmring_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64p, ctypes.c_uint32,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i64p,
+    ]
+    lib.me_shmring_poll.restype = ctypes.c_int
+    lib.me_shmring_respond_n.argtypes = [
+        ctypes.c_void_p, P(MeShmResp), ctypes.c_uint32,
+    ]
+    lib.me_shmring_respond_n.restype = ctypes.c_int
+    lib.me_shmring_resp_poll.argtypes = [
+        ctypes.c_void_p, P(MeShmResp), ctypes.c_uint32, ctypes.c_int64,
+    ]
+    lib.me_shmring_resp_poll.restype = ctypes.c_int
+    lib.me_shmring_stats.argtypes = [ctypes.c_void_p, i64p, i64p, i64p, i64p]
+
+
+def oprec_flaw_codes(body: bytes, n: int, max_price_q4: int,
+                     max_quantity: int) -> list[int]:
+    """Native twin of domain/oprec.record_flaws over a packed run (no
+    magic): per-record flaw CODES (0 = clean; codes index the same
+    branches record_flaws reports as messages — oprec.FLAW_MESSAGES maps
+    back). The C++ gateway's M_BATCH path runs the identical function
+    in-process; this wrapper exists for the parity test and any python
+    caller that wants codes instead of strings."""
+    lib = _load()
+    out = (ctypes.c_int32 * max(1, n))()
+    rc = lib.me_oprec_flaws(body, len(body), max_price_q4, max_quantity,
+                            out, n)
+    if rc != n:
+        raise RuntimeError(f"me_oprec_flaws failed (rc={rc}, n={n})")
+    return list(out[:n])
 
 
 def oprec_to_gwop(body: bytes, n: int, tag_base: int):
@@ -1301,3 +1394,177 @@ class LaneRing:
     @property
     def dropped(self) -> int:
         return 0 if self._h is None else self._lib.me_gwring_dropped(self._h)
+
+
+class ShmRing:
+    """The shared-memory ingress segment (native/me_shmring.cpp): a
+    file-backed ring of 384-byte op-records with per-slot commit words, a
+    futex doorbell, and a response ring of MeShmResp records.
+
+    Server: ShmRing(path, create=True) + poll()/respond()/stats();
+    client: ShmRing(path) + push_payload()/resp_poll(). One instance per
+    process side; the poller is the single consumer, the server the
+    single response writer. Crash-safety (torn-slot recovery) lives in
+    the C++ layer — see the me_shmring.cpp header comment."""
+
+    def __init__(self, path: str, create: bool = False,
+                 slots: int = 4096, resp_slots: int = 8192):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if create:
+            self._h = self._lib.me_shmring_create(path.encode(), slots,
+                                                  resp_slots)
+        else:
+            self._h = self._lib.me_shmring_attach(path.encode())
+        if not self._h:
+            raise RuntimeError(
+                f"me_shmring_{'create' if create else 'attach'} failed "
+                f"for {path} (caps must be powers of two; attach needs a "
+                f"live server segment)")
+        self.path = path
+        self.owner = create
+        self._buf = None
+        self._seqs = None
+        self._resp_buf = None
+
+    # -- writer (client process) ------------------------------------------
+
+    def push_payload(self, body: bytes, n: int) -> int:
+        """Copy-in write of a packed record run (no magic): claim n
+        slots, write, commit each, ring the doorbell. Returns the base
+        ring sequence; -1 full (caller backs off), -2 server shutdown."""
+        if n <= 0:
+            return -1
+        return int(self._lib.me_shmring_push_n(self._h, body, n))
+
+    def claim(self, n: int) -> int:
+        return int(self._lib.me_shmring_claim(self._h, n))
+
+    def write_slot(self, seq: int, record: bytes) -> None:
+        """Write one claimed slot's bytes WITHOUT committing (the
+        kill-fuzz writer splits write and commit so SIGKILL can land
+        between them)."""
+        p = self._lib.me_shmring_slot(self._h, seq)
+        ctypes.memmove(p, record, len(record))
+
+    def commit(self, seq: int) -> None:
+        self._lib.me_shmring_commit(self._h, seq)
+
+    def wake(self) -> None:
+        self._lib.me_shmring_wake(self._h)
+
+    # -- poller (server thread) -------------------------------------------
+
+    def poll(self, max_records: int, wait_us: int, torn_wait_us: int,
+             window_us: int = 2000):
+        """(records_bytes, seqs_list, torn) — records_bytes is the packed
+        run of admitted records (length n*384, decode with
+        np.frombuffer(OPREC_DTYPE)); seqs_list maps each record to its
+        ring sequence (torn recovery makes runs non-contiguous). Waits
+        up to wait_us for the first record, then collects for up to
+        window_us more (the batching-window semantics every ring pop in
+        this repo uses). n == 0 on timeout; records_bytes is None when
+        the segment shut down."""
+        import numpy as np
+
+        buf = self._buf
+        if buf is None or len(buf) < max_records * 384:
+            buf = self._buf = np.zeros(max_records * 384, dtype=np.uint8)
+            self._seqs = (ctypes.c_longlong * max_records)()
+        torn = ctypes.c_longlong()
+        n = self._lib.me_shmring_poll(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), self._seqs,
+            max_records, wait_us, window_us, torn_wait_us,
+            ctypes.byref(torn))
+        if n == -2:
+            return None, [], int(torn.value)
+        if n <= 0:
+            return b"", [], int(torn.value)
+        return (buf[:n * 384].tobytes(), list(self._seqs[:n]),
+                int(torn.value))
+
+    def respond(self, resps) -> int:
+        """Publish a (MeShmResp * k) array's first len slice (or a list
+        of MeShmResp); returns the number written (the rest counted as
+        resp_dropped — the server never blocks on a slow client)."""
+        if isinstance(resps, list):
+            arr = (MeShmResp * max(1, len(resps)))(*resps)
+            k = len(resps)
+        else:
+            arr, k = resps, len(resps)
+        if k == 0:
+            return 0
+        return int(self._lib.me_shmring_respond_n(self._h, arr, k))
+
+    def respond_payload(self, buf: bytes, n: int) -> int:
+        """Publish n packed MeShmResp records from raw bytes (the
+        poller builds them as ONE numpy SHM_RESP_DTYPE array — no
+        per-op ctypes objects on the response path)."""
+        if n == 0:
+            return 0
+        arr = ctypes.cast(ctypes.c_char_p(buf),
+                          ctypes.POINTER(MeShmResp))
+        return int(self._lib.me_shmring_respond_n(self._h, arr, n))
+
+    def resp_poll_raw(self, max_records: int, wait_us: int):
+        """Client fast path: up to max_records responses as RAW bytes
+        (n * 48, decode vectorized with oprec.SHM_RESP_DTYPE), or None
+        when the server shut down and the ring is drained."""
+        buf = self._resp_buf
+        if buf is None or len(buf) < max_records:
+            buf = self._resp_buf = (MeShmResp * max_records)()
+        n = self._lib.me_shmring_resp_poll(self._h, buf, max_records,
+                                           wait_us)
+        if n == -2:
+            return None
+        if n <= 0:
+            return b""
+        return ctypes.string_at(buf, n * ctypes.sizeof(MeShmResp))
+
+    def resp_poll(self, max_records: int, wait_us: int):
+        """Client: list of MeShmResp copies (empty on timeout), or None
+        when the server shut down and the ring is drained."""
+        buf = self._resp_buf
+        if buf is None or len(buf) < max_records:
+            buf = self._resp_buf = (MeShmResp * max_records)()
+        n = self._lib.me_shmring_resp_poll(self._h, buf, max_records,
+                                           wait_us)
+        if n == -2:
+            return None
+        out = []
+        for i in range(max(0, n)):
+            r = buf[i]
+            out.append((int(r.seq), bool(r.ok), int(r.kind),
+                        int(r.reason),
+                        bytes(r.order_id[:r.oid_len]).decode(
+                            errors="replace"),
+                        int(r.remaining)))
+        return out
+
+    def stats(self) -> dict:
+        depth = ctypes.c_longlong()
+        torn = ctypes.c_longlong()
+        dropped = ctypes.c_longlong()
+        wakes = ctypes.c_longlong()
+        self._lib.me_shmring_stats(self._h, ctypes.byref(depth),
+                                   ctypes.byref(torn), ctypes.byref(dropped),
+                                   ctypes.byref(wakes))
+        return {"depth": depth.value, "torn_recovered": torn.value,
+                "resp_dropped": dropped.value,
+                "doorbell_wakes": wakes.value}
+
+    def shutdown(self) -> None:
+        """Server: latch the segment closed (writers/readers unblock)."""
+        if self._h:
+            self._lib.me_shmring_shutdown(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.me_shmring_close(self._h)
+            self._h = None
+        if self.owner:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass

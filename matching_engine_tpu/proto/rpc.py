@@ -31,6 +31,10 @@ _METHODS = {
     "RunAuction": ("unary_unary", pb2.AuctionRequest, pb2.AuctionResponse),
     "SubmitOrderBatch": ("unary_unary", pb2.OrderBatchRequest,
                          pb2.OrderBatchResponse),
+    # Client-streaming ingest: chunks of the batch payload in, ONE
+    # positional response for the whole stream.
+    "SubmitOrderStream": ("stream_unary", pb2.OrderBatchRequest,
+                          pb2.OrderBatchResponse),
     "Promote": ("unary_unary", pb2.PromoteRequest, pb2.PromoteResponse),
 }
 
@@ -67,6 +71,10 @@ class MatchingEngineServicer:
     def SubmitOrderBatch(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED,
                       "SubmitOrderBatch not implemented")
+
+    def SubmitOrderStream(self, request_iterator, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                      "SubmitOrderStream not implemented")
 
     def Promote(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED,

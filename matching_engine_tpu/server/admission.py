@@ -1,0 +1,303 @@
+"""Vectorized per-client admission screens — edge risk control at codec
+speed (ROADMAP Open item 3: "admission control runs at codec speed, not
+RPC speed").
+
+`record_flaws` (domain/oprec.py) is the structural screen: everything
+decidable from one record alone. This module layers the PER-CLIENT
+production screens a million-user edge implies — rate limiting, max
+order size, price banding, self-trade prevention — as numpy batch
+passes over the same record arrays, shared by every bulk ingress path:
+the shm ring poller (server/shm_ingress.py), SubmitOrderBatch and
+SubmitOrderStream (server/service.py), and the C++ gateway's batch verb
+(which forwards into the same service handler). The per-op RPCs run the
+identical rules through `screen_one` (a 1-record batch), so admission
+is venue-wide consistent.
+
+Semantics — BATCH-BOUNDARY, deliberately, so every screen stays a pure
+vector pass with no per-op python:
+
+- rate limit: a fixed window of `rate_window_s` seconds per client id.
+  EVERY structurally-clean op counts toward the window, admitted or not
+  (abuse spends budget); within a batch the count is cumulative, so op
+  k of one client's burst is op `pre + k` of its window.
+- max order size: submits and amends with quantity above the configured
+  per-client cap reject. (record_flaws already enforces the ENGINE cap;
+  this is the venue's risk knob below it.)
+- price band: priced submits must land within `price_band_bps` of the
+  symbol's ANCHOR — the last admitted priced submit's price as of batch
+  entry (the first priced submit for a symbol sets the anchor and
+  passes). Anchors update once per batch, after screening.
+- self-trade prevention: a submit that would CROSS the client's own
+  resting opposite-side interest rejects. The screen tracks its own
+  window-scoped table of admitted GTC LIMIT submits per
+  (client, symbol): best own bid / best own ask, expiring `stp_ttl_s`
+  after the last insert. Frozen at batch entry, updated after — a
+  conservative EDGE screen in front of the engine's owner-lane STP, not
+  a book-exact guarantee (documented in OPERATIONS.md).
+
+Reject reasons are REASON_* codes (domain/oprec.py — the MeIngressReason
+vocabulary shared with the shm response ring and the C++ structural
+screen); RPC paths render them through REASON_MESSAGES.
+
+tests/test_admission.py pins the vectorized passes against an
+independent per-op python oracle over property-fuzzed flows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from matching_engine_tpu.domain.oprec import (
+    OPREC_AMEND,
+    OPREC_SUBMIT,
+    REASON_BAND,
+    REASON_MESSAGES,
+    REASON_QTY,
+    REASON_RATE,
+    REASON_STP,
+)
+
+# Collapsed device codes that carry a price (LIMIT / LIMIT_IOC /
+# LIMIT_FOK) and the one that RESTS (GTC LIMIT) — proto.collapse_otype.
+_PRICED_OTYPES = (0, 2, 3)
+_RESTING_OTYPE = 0
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """One knob per screen; None/0 disables that screen. A config with
+    every screen disabled makes AdmissionScreens.enabled False and
+    screen() a no-op."""
+    rate_limit: int | None = None     # clean ops per client per window
+    rate_window_s: float = 1.0
+    max_quantity: int | None = None   # per-op submit/amend size cap
+    price_band_bps: int | None = None  # band around the symbol anchor
+    stp: bool = False
+    stp_ttl_s: float = 5.0            # own-quote table entry lifetime
+
+    @property
+    def any_enabled(self) -> bool:
+        return bool(self.rate_limit or self.max_quantity
+                    or self.price_band_bps or self.stp)
+
+
+class AdmissionScreens:
+    """The shared, thread-safe screen state. One instance per server;
+    callers from any ingress thread (rpc handlers, the shm poller, the
+    stream drain) serialize on one lock per BATCH — the per-op cost is
+    the numpy pass, never the lock."""
+
+    def __init__(self, cfg: AdmissionConfig, metrics=None):
+        self.cfg = cfg
+        self.enabled = cfg.any_enabled
+        self.metrics = metrics
+        if metrics is not None and self.enabled:
+            # Register the literal zeros (PR 8 convention) so a scrape
+            # shows the reject-by-reason series from boot.
+            for name in ("admission_rate_rejects", "admission_qty_rejects",
+                         "admission_band_rejects", "admission_stp_rejects"):
+                metrics.inc(name, 0)
+        self._lock = threading.Lock()
+        # rate: client bytes -> ops counted in the current fixed window.
+        self._rate_counts: dict[bytes, int] = {}
+        self._rate_window_start = 0.0
+        # price band: symbol bytes -> last admitted priced-submit price.
+        self._anchors: dict[bytes, int] = {}
+        # stp: (client, symbol) bytes -> [max own bid, min own ask,
+        # expiry stamp] from admitted GTC LIMIT submits.
+        self._stp: dict[tuple[bytes, bytes], list] = {}
+
+    # -- the vectorized pass ------------------------------------------------
+
+    def screen(self, arr: np.ndarray, flaws: list, now: float | None = None
+               ) -> np.ndarray:
+        """Run every enabled screen over the structurally-clean records
+        (flaws[i] is None). Returns a per-record uint8 REASON_* array
+        (0 = admitted) and fills the corresponding `flaws` slots with
+        the reason messages, positionally — the record_flaws contract
+        extended."""
+        n = len(arr)
+        reasons = np.zeros(n, dtype=np.uint8)
+        if not self.enabled or n == 0:
+            return reasons
+        clean = np.fromiter((f is None for f in flaws), dtype=bool, count=n)
+        idx = np.nonzero(clean)[0]
+        if len(idx) == 0:
+            return reasons
+        sub = arr[idx]
+        if now is None:
+            now = time.monotonic()
+        cfg = self.cfg
+        with self._lock:
+            rej = np.zeros(len(idx), dtype=np.uint8)
+            if cfg.rate_limit:
+                self._screen_rate(sub, rej, now)
+            if cfg.max_quantity:
+                self._screen_qty(sub, rej)
+            if cfg.price_band_bps:
+                self._screen_band(sub, rej)
+            if cfg.stp:
+                self._screen_stp(sub, rej, now)
+            # State updates see only ADMITTED records (batch-boundary
+            # semantics: screens above read the pre-batch tables).
+            ok = rej == 0
+            if cfg.price_band_bps:
+                self._update_anchors(sub[ok])
+            if cfg.stp:
+                self._update_stp(sub[ok], now)
+        reasons[idx] = rej
+        hit = np.nonzero(rej)[0]
+        for j in hit:
+            flaws[idx[j]] = REASON_MESSAGES[int(rej[j])]
+        if self.metrics is not None and len(hit):
+            m = self.metrics
+            counts = np.bincount(rej[hit], minlength=6)
+            if counts[REASON_RATE]:
+                m.inc("admission_rate_rejects", int(counts[REASON_RATE]))
+            if counts[REASON_QTY]:
+                m.inc("admission_qty_rejects", int(counts[REASON_QTY]))
+            if counts[REASON_BAND]:
+                m.inc("admission_band_rejects", int(counts[REASON_BAND]))
+            if counts[REASON_STP]:
+                m.inc("admission_stp_rejects", int(counts[REASON_STP]))
+        return reasons
+
+    def screen_one(self, op: int, side: int, otype: int, price_q4: int,
+                   quantity: int, symbol: bytes, client_id: bytes,
+                   now: float | None = None) -> str | None:
+        """The per-op RPCs' entry: a 1-record batch through the same
+        vector pass (SubmitOrder/CancelOrder/AmendOrder call this so the
+        per-op edge obeys the same rules as the bulk paths)."""
+        if not self.enabled:
+            return None
+        from matching_engine_tpu.domain import oprec
+
+        # Clamp identifiers to the record boxes: Cancel/Amend reach here
+        # with only a non-empty check behind them, and an oversized id
+        # must screen (by its box-sized prefix), not raise out of the
+        # RPC as a transport error. It can't own anything either way —
+        # the directory lookup downstream still answers it.
+        arr = oprec.pack_records(
+            [(op, side, otype, price_q4, quantity,
+              symbol[:oprec.SYMBOL_BYTES],
+              client_id[:oprec.CLIENT_ID_BYTES], b"")])
+        flaws: list = [None]
+        self.screen(arr, flaws, now=now)
+        return flaws[0]
+
+    # -- individual screens (lock held, clean records only) ------------------
+
+    def _rotate_rate_window(self, now: float) -> None:
+        if now - self._rate_window_start >= self.cfg.rate_window_s:
+            self._rate_counts.clear()
+            self._rate_window_start = now
+
+    def _screen_rate(self, sub: np.ndarray, rej: np.ndarray,
+                     now: float) -> None:
+        self._rotate_rate_window(now)
+        limit = self.cfg.rate_limit
+        cids = sub["client_id"]
+        uniq, inv, counts = np.unique(cids, return_inverse=True,
+                                      return_counts=True)
+        # Rank of each record within its client's run of this batch
+        # (stable sort -> 0..count-1 per group, in record order).
+        order = np.argsort(inv, kind="stable")
+        starts = np.zeros(len(uniq), dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        ranks = np.empty(len(sub), dtype=np.int64)
+        ranks[order] = np.arange(len(sub)) - np.repeat(starts, counts)
+        pre = np.fromiter(
+            (self._rate_counts.get(u.tobytes(), 0) for u in uniq),
+            dtype=np.int64, count=len(uniq))
+        over = (pre[inv] + ranks) >= limit
+        rej[over & (rej == 0)] = REASON_RATE
+        # Every clean op spends budget, admitted or not.
+        for u, c in zip(uniq, counts):
+            key = u.tobytes()
+            self._rate_counts[key] = self._rate_counts.get(key, 0) + int(c)
+
+    def _screen_qty(self, sub: np.ndarray, rej: np.ndarray) -> None:
+        sized = ((sub["op"] == OPREC_SUBMIT) | (sub["op"] == OPREC_AMEND))
+        over = sized & (sub["quantity"] > self.cfg.max_quantity)
+        rej[over & (rej == 0)] = REASON_QTY
+
+    def _screen_band(self, sub: np.ndarray, rej: np.ndarray) -> None:
+        bps = self.cfg.price_band_bps
+        priced = ((sub["op"] == OPREC_SUBMIT)
+                  & np.isin(sub["otype"], _PRICED_OTYPES))
+        pidx = np.nonzero(priced)[0]
+        if len(pidx) == 0:
+            return
+        syms = sub["symbol"][pidx]
+        anchors = np.fromiter(
+            (self._anchors.get(s.tobytes(), 0) for s in syms),
+            dtype=np.int64, count=len(pidx))
+        prices = sub["price_q4"][pidx].astype(np.int64)
+        # |p - anchor| * 10000 > bps * anchor, integer exact; anchor 0 =
+        # no anchor yet, passes (and sets it in the update pass).
+        out = (anchors > 0) & (np.abs(prices - anchors) * 10000
+                               > bps * anchors)
+        tgt = pidx[out]
+        rej[tgt[rej[tgt] == 0]] = REASON_BAND
+
+    def _update_anchors(self, admitted: np.ndarray) -> None:
+        priced = ((admitted["op"] == OPREC_SUBMIT)
+                  & np.isin(admitted["otype"], _PRICED_OTYPES))
+        recs = admitted[priced]
+        # Last admitted priced submit per symbol wins: iterate in order,
+        # one dict store per record run (unique symbols per batch).
+        for s, p in zip(recs["symbol"], recs["price_q4"]):
+            self._anchors[s.tobytes()] = int(p)
+
+    def _screen_stp(self, sub: np.ndarray, rej: np.ndarray,
+                    now: float) -> None:
+        submits = np.nonzero(sub["op"] == OPREC_SUBMIT)[0]
+        if len(submits) == 0:
+            return
+        recs = sub[submits]
+        quotes = np.zeros((len(submits), 2), dtype=np.int64)  # [bid, ask]
+        have = np.zeros(len(submits), dtype=bool)
+        for j, (c, s) in enumerate(zip(recs["client_id"], recs["symbol"])):
+            q = self._stp.get((c.tobytes(), s.tobytes()))
+            if q is not None and q[2] > now:
+                quotes[j] = (q[0], q[1])
+                have[j] = True
+        prices = recs["price_q4"].astype(np.int64)
+        is_buy = recs["side"] == 1
+        is_mkt = np.isin(recs["otype"], (1, 4))
+        own_bid, own_ask = quotes[:, 0], quotes[:, 1]
+        # A buy crosses own resting ask at price >= ask; a sell crosses
+        # own resting bid at price <= bid; a MARKET order crosses any
+        # opposite-side own quote.
+        cross = have & np.where(
+            is_buy,
+            (own_ask > 0) & (is_mkt | (prices >= own_ask)),
+            (own_bid > 0) & (is_mkt | (prices <= own_bid)))
+        tgt = submits[np.nonzero(cross)[0]]
+        rej[tgt[rej[tgt] == 0]] = REASON_STP
+
+    def _update_stp(self, admitted: np.ndarray, now: float) -> None:
+        resting = ((admitted["op"] == OPREC_SUBMIT)
+                   & (admitted["otype"] == _RESTING_OTYPE))
+        recs = admitted[resting]
+        expiry = now + self.cfg.stp_ttl_s
+        for r in recs:
+            key = (r["client_id"].tobytes(), r["symbol"].tobytes())
+            q = self._stp.get(key)
+            if q is None or q[2] <= now:
+                q = [0, 0, expiry]
+                self._stp[key] = q
+            price = int(r["price_q4"])
+            if int(r["side"]) == 1:
+                q[0] = max(q[0], price)
+            else:
+                q[1] = min(q[1], price) if q[1] else price
+            q[2] = expiry
+        # Opportunistic expiry sweep, bounded: drop dead entries once the
+        # table outgrows a soft cap so it can't accrete unboundedly.
+        if len(self._stp) > 65536:
+            self._stp = {k: v for k, v in self._stp.items() if v[2] > now}

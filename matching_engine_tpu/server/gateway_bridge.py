@@ -96,6 +96,16 @@ class GatewayBridge:
         self._stream_lock = threading.Lock()
         self._fwd_q: queue.Queue = queue.Queue()
         self.gateway.set_callback(self._on_forwarded)
+        # M_BATCH routing: by default the gateway runs the in-gateway
+        # native batch path (structural screen + conversion + bulk ring
+        # push, answered positionally from ring completions — no python
+        # on the payload). The vectorized admission screens run
+        # python-side only, so with them enabled batches forward through
+        # the shared service handler instead.
+        admission = getattr(service, "admission", None)
+        set_fwd = getattr(self.gateway, "set_forward_batch", None)
+        if set_fwd is not None:  # duck-typed test gateways skip it
+            set_fwd(admission is not None and admission.enabled)
         self._drain_thread = threading.Thread(
             target=self._run_native if native_lanes else self._run,
             name="gw-bridge", daemon=True

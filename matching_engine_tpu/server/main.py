@@ -158,6 +158,11 @@ def build_server(
     standby_auto_promote_s: float = 0.0,
     standby_attest: bool = True,
     tier_pins: dict | None = None,
+    admission_cfg=None,          # admission.AdmissionConfig | None
+    shm_ingress_path: str | None = None,
+    shm_slots: int = 4096,
+    shm_resp_slots: int = 8192,
+    shm_torn_ms: float = 50.0,
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -575,10 +580,21 @@ def build_server(
         if serve_shards > 1:
             layer += f" x {serve_shards} partitioned lanes"
         print(f"[SERVER] runtime layer: {layer}")
+    # Vectorized per-client admission screens (server/admission.py): one
+    # shared instance screens every ingress path — bulk edges as numpy
+    # passes, per-op RPCs as 1-record batches.
+    admission = None
+    if admission_cfg is not None and admission_cfg.any_enabled:
+        from matching_engine_tpu.server.admission import AdmissionScreens
+
+        admission = AdmissionScreens(admission_cfg, metrics=metrics)
+        if log:
+            print(f"[SERVER] admission screens: {admission_cfg}")
     service = MatchingEngineService(runner, dispatcher, hub, metrics,
                                     log=log, shards=shards,
                                     book_cache_ms=book_cache_ms,
-                                    proto_reuse=proto_reuse)
+                                    proto_reuse=proto_reuse,
+                                    admission=admission)
     # RunAuction rejects on an op-log-shipping primary (the uncross
     # bypasses the drain loops the shipper rides — a standby would
     # silently diverge); main() additionally refuses --auction-open.
@@ -650,6 +666,34 @@ def build_server(
         if log:
             print(f"[SERVER] native gateway on port {gateway_port}")
 
+    # Zero-copy shared-memory ingress (--shm-ingress PATH,
+    # server/shm_ingress.py): a co-located client writes oprec records
+    # straight into a mapped ring; the poller thread screens and
+    # dispatches them through the same pipeline as the batch RPCs.
+    shm_ingress = None
+    if shm_ingress_path is not None:
+        if standby_addr is not None:
+            # A standby's mutation surface is closed; an shm segment
+            # would answer every record with the read-only reject while
+            # looking like a live ingress edge. Refuse at boot.
+            print("[SERVER] --shm-ingress is a mutation edge: not "
+                  "available on a --standby replica", file=sys.stderr)
+            raise SystemExit(3)
+        if not (native and _me_native.available()):
+            print("[SERVER] --shm-ingress needs the built native runtime "
+                  "(libme_native.so); run scripts/build_native.sh",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        from matching_engine_tpu.server.shm_ingress import ShmIngress
+
+        shm_ingress = ShmIngress(
+            shm_ingress_path, service, metrics, slots=shm_slots,
+            resp_slots=shm_resp_slots, torn_wait_ms=shm_torn_ms,
+            window_ms=window_ms).start()
+        if log:
+            print(f"[SERVER] shm ingress ring at {shm_ingress_path} "
+                  f"({shm_slots} slots, {shm_resp_slots} response slots)")
+
     parts = {
         "storage": storage, "sink": sink, "hub": hub,
         "dispatcher": dispatcher, "runner": runner, "service": service,
@@ -659,6 +703,7 @@ def build_server(
         "recorder": recorder, "sequencer": sequencer, "tracer": tracer,
         "auditor": auditor, "audit_pump": audit_pump,
         "oplog": oplog_shipper, "replica": replica, "runners": runners,
+        "shm_ingress": shm_ingress, "admission": admission,
     }
     return server, port, parts
 
@@ -667,6 +712,10 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
     """Graceful drain: stop RPCs (2s deadline, as the reference's stopper
     thread does), close the dispatcher, flush the storage sink."""
     server.stop(grace_s).wait()
+    if parts.get("shm_ingress") is not None:
+        # BEFORE the dispatcher drain: the poller's in-flight batch
+        # resolves through the normal waiters, then the segment unlinks.
+        parts["shm_ingress"].close()
     if parts.get("replica") is not None:
         # BEFORE the hub/dispatcher teardown: the applier may be mid-
         # dispatch against the runner these drain.
@@ -965,6 +1014,43 @@ def main(argv=None) -> int:
                    help="boot in call-auction accumulation: submits REST "
                         "without matching until a RunAuction uncross opens "
                         "continuous trading (engine/auction.py)")
+    p.add_argument("--shm-ingress", default=None, metavar="PATH",
+                   help="zero-copy shared-memory ingress: create an oprec "
+                        "ring segment at PATH (a co-located client writes "
+                        "flat 384-byte records straight into the mapped "
+                        "ring; server/shm_ingress.py polls, screens, and "
+                        "dispatches them — no proto, no python per-op). "
+                        "Put PATH on a ram-backed fs (/dev/shm) for the "
+                        "zero-copy win")
+    p.add_argument("--shm-slots", type=int, default=4096, metavar="N",
+                   help="shm ingress request-ring slots (power of two)")
+    p.add_argument("--shm-resp-slots", type=int, default=8192, metavar="N",
+                   help="shm ingress response-ring slots (power of two)")
+    p.add_argument("--shm-torn-ms", type=float, default=50.0, metavar="MS",
+                   help="how long the shm poller waits for a claimed "
+                        "slot's commit before recovering it as torn (a "
+                        "writer SIGKILLed mid-record)")
+    p.add_argument("--admission-rate", type=int, default=0, metavar="N",
+                   help="admission screen: max ops per client per "
+                        "--admission-window-s fixed window (0 = off); "
+                        "vectorized, shared by every ingress path "
+                        "(server/admission.py)")
+    p.add_argument("--admission-window-s", type=float, default=1.0,
+                   metavar="S",
+                   help="admission rate-limit window seconds")
+    p.add_argument("--admission-max-qty", type=int, default=0, metavar="N",
+                   help="admission screen: per-op submit/amend quantity "
+                        "cap below the engine maximum (0 = off)")
+    p.add_argument("--admission-band-bps", type=int, default=0,
+                   metavar="BPS",
+                   help="admission screen: priced submits must land "
+                        "within BPS basis points of the symbol's anchor "
+                        "(last admitted priced submit; 0 = off)")
+    p.add_argument("--admission-stp", action="store_true",
+                   help="admission screen: reject submits that would "
+                        "cross the client's own recently admitted "
+                        "resting interest (window-scoped edge STP in "
+                        "front of the engine's owner-lane STP)")
     args = p.parse_args(argv)
 
     # Persistent compile cache (same default as benchmarks/bench_child.py):
@@ -1065,6 +1151,16 @@ def main(argv=None) -> int:
         return 3
     flight_dir = args.flight_dir or os.path.join(
         os.path.dirname(os.path.abspath(args.db)), "flight")
+    from matching_engine_tpu.server.admission import AdmissionConfig
+
+    admission_cfg = AdmissionConfig(
+        rate_limit=args.admission_rate or None,
+        rate_window_s=args.admission_window_s,
+        max_quantity=args.admission_max_qty or None,
+        price_band_bps=args.admission_band_bps or None,
+        stp=args.admission_stp)
+    if not admission_cfg.any_enabled:
+        admission_cfg = None
     try:
         server, port, parts = build_server(
             args.addr, args.db, cfg, window_ms=args.window_ms,
@@ -1095,6 +1191,11 @@ def main(argv=None) -> int:
             standby_auto_promote_s=args.standby_auto_promote_s,
             standby_attest=not args.standby_no_attest,
             tier_pins=tier_pins,
+            admission_cfg=admission_cfg,
+            shm_ingress_path=args.shm_ingress,
+            shm_slots=args.shm_slots,
+            shm_resp_slots=args.shm_resp_slots,
+            shm_torn_ms=args.shm_torn_ms,
         )
     except SystemExit as e:
         return int(e.code or 3)

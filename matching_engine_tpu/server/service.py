@@ -68,12 +68,19 @@ class MatchingEngineService(MatchingEngineServicer):
         shards=None,  # server/shards.ServingShards | None
         book_cache_ms: float = 0.0,
         proto_reuse: bool = False,
+        admission=None,  # server/admission.AdmissionScreens | None
     ):
         self.runner = runner
         self.dispatcher = dispatcher
         self.hub = hub
         self.metrics = metrics or runner.metrics
         self.log = log
+        # Vectorized per-client admission screens (server/admission.py):
+        # one shared instance screens every ingress path — the bulk
+        # paths (SubmitOrderBatch / SubmitOrderStream / the shm poller /
+        # the gateway's forwarded batch) as numpy passes, the per-op
+        # RPCs as 1-record batches through screen_one.
+        self.admission = admission
         # Partitioned serving (server/shards.py): requests route to one of
         # K independent lanes — submits/books by symbol shard, cancels/
         # amends by the order id's birth lane. self.runner/self.dispatcher
@@ -202,6 +209,17 @@ class MatchingEngineService(MatchingEngineServicer):
         otype = collapse_otype(request.order_type, request.tif)
         if err is None and otype is None:
             err = "unsupported (order_type, tif) combination"
+        if (err is None and self.admission is not None
+                and self.admission.enabled):
+            # The per-op edge obeys the same admission rules as the bulk
+            # paths: one 1-record batch through the shared screens,
+            # BEFORE any slot/handle allocation (a screened-out op must
+            # consume nothing).
+            price_q4 = (0 if request.order_type == pb2.MARKET
+                        else normalize_to_q4(request.price, request.scale))
+            err = self.admission.screen_one(
+                1, request.side, otype, price_q4, request.quantity,
+                request.symbol.encode(), request.client_id.encode())
         native = getattr(dispatcher, "native_lanes", False)
         if err is None and native:
             # Native lane path: proto validation stays here; the host
@@ -386,12 +404,46 @@ class MatchingEngineService(MatchingEngineServicer):
         m.observe("edge_batch_size", n)
         self._log(f"SubmitOrderBatch ops={n} bytes={len(request.ops)} "
                   f"peer={context.peer() if context else '-'}")
+        ok, oids, errs, rems, _, _ = self.run_oprec_records(arr, t0=t0)
+        rejects = n - sum(ok)
+        if rejects:
+            m.inc("edge_batch_rejects", rejects)
+        dur_us = (time.perf_counter() - t0) * 1e6
+        m.ema_gauge("submit_rpc_us", dur_us)
+        m.observe("submit_rpc_us", dur_us)
+        self._log(f"SubmitOrderBatch done ops={n} rejects={rejects} "
+                  f"({dur_us:.0f}us)")
+        # Never through _completion: repeated fields don't setattr, so
+        # the proto-reuse recycling path cannot serve batch responses.
+        return pb2.OrderBatchResponse(success=True, ok=ok, order_id=oids,
+                                      error=errs, remaining=rems)
+
+    def run_oprec_records(self, arr, t0: float | None = None):
+        """Screen + dispatch one decoded record array through the shared
+        batch machinery (the structural flaw screen, the vectorized
+        admission screens, lane routing, two-phase enqueue/finish) and
+        return positional (ok, oids, errs, rems, reasons, flaws).
+        `reasons` is the admission pass's REASON_* array (None when
+        admission is off) and `flaws` the pre-dispatch screen verdicts —
+        the shm poller keys its response codes off both. Every bulk
+        ingress path funnels here: SubmitOrderBatch, SubmitOrderStream,
+        the shm ring poller, and the gateway's forwarded batch verb."""
+        from matching_engine_tpu.domain import oprec
+
+        if t0 is None:
+            t0 = time.perf_counter()
+        m = self.metrics
+        n = len(arr)
         ok: list[bool] = [False] * n
         oids: list[str] = [""] * n
         errs: list[str] = [""] * n
         rems: list[int] = [0] * n
+        reasons = None
+        flaws: list = [None] * n
         if n:
             flaws = oprec.record_flaws(arr)
+            if self.admission is not None and self.admission.enabled:
+                reasons = self.admission.screen(arr, flaws)
             clean = [i for i in range(n) if flaws[i] is None]
             for i in range(n):
                 if flaws[i] is not None:
@@ -408,23 +460,73 @@ class MatchingEngineService(MatchingEngineServicer):
                                   errs, rems, t0, deadline, routed)
                 for runner, dispatcher, idxs, routed in self._batch_groups(
                     arr, clean)]
-            # Edge-ingress stage: RPC entry -> every lane's slice
-            # enqueued (decode, flaw screen, routing, ring pushes).
+            # Edge-ingress stage: entry -> every lane's slice enqueued
+            # (decode, flaw + admission screens, routing, ring pushes).
             m.observe(STAGE_EDGE_INGRESS, (time.perf_counter() - t0) * 1e6)
             for finish in finishers:
                 finish()
-        rejects = n - sum(ok)
+        return ok, oids, errs, rems, reasons, flaws
+
+    # -- SubmitOrderStream -------------------------------------------------
+
+    # Total records across one stream: bounds the response arrays (the
+    # single positional reply spans the whole stream).
+    _STREAM_RECORD_CAP = 1 << 20
+
+    def SubmitOrderStream(self, request_iterator, context):
+        """Client-streaming ingest for remote flow that can't batch
+        client-side: the client sends a stream of OrderBatchRequest
+        chunks (each the usual oprec payload — a chunk may carry ONE
+        record) and the server drains them into the same vectorized
+        screen + dispatch pipeline as SubmitOrderBatch, chunk by chunk,
+        so dispatch overlaps the stream instead of waiting for its end.
+        One OrderBatchResponse answers the whole stream with positional
+        arrays in arrival order. An undecodable chunk fails the stream
+        (success=false) — everything already dispatched stays dispatched,
+        mirroring the batch edge's payload-poisoning rule per chunk."""
+        from matching_engine_tpu.domain import oprec
+
+        t0 = time.perf_counter()
+        m = self.metrics
+        m.inc("edge_streams")
+        if self.read_only:
+            return pb2.OrderBatchResponse(success=False,
+                                          error_message=self._STANDBY_ERR)
+        all_ok: list[bool] = []
+        all_oids: list[str] = []
+        all_errs: list[str] = []
+        all_rems: list[int] = []
+        chunks = 0
+        for req in request_iterator:
+            try:
+                arr = oprec.decode_payload(
+                    req.ops, max_records=self._BATCH_RECORD_CAP)
+            except oprec.OpRecError as e:
+                m.inc("edge_codec_errors")
+                self._log(f"SubmitOrderStream codec reject: {e}")
+                return pb2.OrderBatchResponse(success=False,
+                                              error_message=str(e))
+            if len(all_ok) + len(arr) > self._STREAM_RECORD_CAP:
+                return pb2.OrderBatchResponse(
+                    success=False,
+                    error_message=(f"stream exceeds "
+                                   f"{self._STREAM_RECORD_CAP} records"))
+            chunks += 1
+            m.inc("edge_stream_ops", len(arr))
+            ok, oids, errs, rems, _, _ = self.run_oprec_records(arr)
+            all_ok.extend(ok)
+            all_oids.extend(oids)
+            all_errs.extend(errs)
+            all_rems.extend(rems)
+        rejects = len(all_ok) - sum(all_ok)
         if rejects:
             m.inc("edge_batch_rejects", rejects)
         dur_us = (time.perf_counter() - t0) * 1e6
-        m.ema_gauge("submit_rpc_us", dur_us)
-        m.observe("submit_rpc_us", dur_us)
-        self._log(f"SubmitOrderBatch done ops={n} rejects={rejects} "
-                  f"({dur_us:.0f}us)")
-        # Never through _completion: repeated fields don't setattr, so
-        # the proto-reuse recycling path cannot serve batch responses.
-        return pb2.OrderBatchResponse(success=True, ok=ok, order_id=oids,
-                                      error=errs, remaining=rems)
+        self._log(f"SubmitOrderStream done chunks={chunks} "
+                  f"ops={len(all_ok)} rejects={rejects} ({dur_us:.0f}us)")
+        return pb2.OrderBatchResponse(success=True, ok=all_ok,
+                                      order_id=all_oids, error=all_errs,
+                                      remaining=all_rems)
 
     def _batch_groups(self, arr, clean: list[int]):
         """Split a batch's clean record indices across serving lanes:
@@ -672,6 +774,13 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="client_id is required",
             )
+        if self.admission is not None and self.admission.enabled:
+            aerr = self.admission.screen_one(
+                2, 0, 0, 0, 0, b"", request.client_id.encode())
+            if aerr is not None:
+                return pb2.CancelResponse(
+                    order_id=request.order_id, success=False,
+                    error_message=aerr)
         runner, dispatcher = self._lane_for_order(request.order_id)
         if getattr(dispatcher, "native_lanes", False):
             return self._cancel_native(request, dispatcher)
@@ -780,6 +889,14 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="new_quantity must be positive",
             )
+        if self.admission is not None and self.admission.enabled:
+            aerr = self.admission.screen_one(
+                3, 0, 0, 0, request.new_quantity, b"",
+                request.client_id.encode())
+            if aerr is not None:
+                return pb2.AmendResponse(
+                    order_id=request.order_id, success=False,
+                    error_message=aerr)
         runner, dispatcher = self._lane_for_order(request.order_id)
         if getattr(dispatcher, "native_lanes", False):
             return self._amend_native(request, dispatcher)

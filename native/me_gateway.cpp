@@ -33,6 +33,7 @@
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -209,6 +210,15 @@ extern "C" {
 
 typedef void (*MeGwCallback)(uint64_t tag, int method, const uint8_t* data,
                              uint64_t len);
+
+// From libme_native.so (me_lanes.cpp — the gateway links against it):
+// the one op-record -> ring-record converter and the structural screen
+// shared with the python edge (record_flaws' native twin).
+int me_oprec_to_gwop(const uint8_t* payload, long long len,
+                     uint64_t tag_base, MeGwOp* out, uint32_t max_n);
+int me_oprec_flaws(const uint8_t* payload, long long len,
+                   long long max_price_q4, long long max_quantity,
+                   int32_t* codes, uint32_t max_n);
 
 }  // extern "C"
 
@@ -416,6 +426,7 @@ class Conn : public std::enable_shared_from_this<Conn> {
   void handle_submit(uint32_t stream_id, const std::string& payload);
   void handle_cancel(uint32_t stream_id, const std::string& payload);
   void handle_amend(uint32_t stream_id, const std::string& payload);
+  void handle_batch(uint32_t stream_id, const std::string& payload);
   void reject_submit(uint32_t stream_id, const std::string& order_id,
                      const std::string& error);
   void reject_amend(uint32_t stream_id, const std::string& order_id,
@@ -446,6 +457,22 @@ struct Pending {
   uint32_t stream_id = 0;
   bool streaming = false;
   bool headers_sent = false;
+};
+
+// One in-gateway SubmitOrderBatch in flight (the native M_BATCH path):
+// n positional slots, a run of consecutive ring tags for the records
+// that passed the structural screen (pos maps tag offset -> original
+// position), answered as ONE OrderBatchResponse once every ring member
+// completes. Slots for screened-out records are prefilled.
+struct BatchCtx {
+  std::weak_ptr<Conn> conn;
+  uint32_t stream_id = 0;
+  uint32_t ring_n = 0;     // records pushed to the ring (tag run length)
+  uint32_t unresolved = 0;  // ring members still awaiting completion
+  std::vector<int32_t> pos;  // tag offset -> original record position
+  std::vector<uint8_t> ok;
+  std::vector<std::string> oid, err;
+  std::vector<long long> remaining;
 };
 
 class Gateway {
@@ -634,6 +661,82 @@ class Gateway {
     pending_.erase(tag);
   }
 
+  // -- in-gateway batch registry (native M_BATCH path) -------------------
+
+  // Bulk push for the batch path: all-or-nothing under one ring lock —
+  // a batch the ring can't hold entirely is refused whole (every
+  // position answers "server overloaded"), never split.
+  bool ring_push_n(const MeGwOp* ops, uint32_t n) {
+    std::unique_lock<std::mutex> lk(ring_mu_);
+    if (ring_closed_ || ring_.size() + n > ring_cap_) {
+      ring_rejects_.fetch_add(n, std::memory_order_relaxed);
+      return false;
+    }
+    for (uint32_t i = 0; i < n; i++) ring_.push_back(ops[i]);
+    ring_cv_.notify_one();
+    return true;
+  }
+
+  // Reserve a run of ring_n consecutive tags for one batch and register
+  // its context. The completion entry points route member tags here via
+  // try_complete_batch_member.
+  uint64_t register_batch(std::shared_ptr<BatchCtx> ctx) {
+    uint64_t base = next_tag_.fetch_add(ctx->ring_n,
+                                        std::memory_order_relaxed);
+    std::lock_guard<std::mutex> lk(batch_mu_);
+    batches_[base] = std::move(ctx);
+    return base;
+  }
+
+  void drop_batch(uint64_t base) {
+    std::lock_guard<std::mutex> lk(batch_mu_);
+    batches_.erase(base);
+  }
+
+  // Fill one batch member's slot; when the last member resolves, pop
+  // the context out for the caller to serialize + answer. Returns false
+  // when the tag belongs to no batch (a plain per-op pending tag).
+  bool complete_batch_member(uint64_t tag, int kind, bool ok,
+                             const std::string& oid, const std::string& err,
+                             long long remaining,
+                             std::shared_ptr<BatchCtx>* done) {
+    std::lock_guard<std::mutex> lk(batch_mu_);
+    auto it = batches_.upper_bound(tag);
+    if (it == batches_.begin()) return false;
+    --it;
+    BatchCtx& b = *it->second;
+    uint64_t off = tag - it->first;
+    if (off >= b.ring_n) return false;
+    int32_t p = b.pos[off];
+    (void)kind;
+    b.ok[p] = ok ? 1 : 0;
+    b.oid[p] = oid;
+    b.err[p] = err;
+    b.remaining[p] = remaining;
+    if (--b.unresolved == 0) {
+      *done = std::move(it->second);
+      batches_.erase(it);
+    }
+    return true;
+  }
+
+  std::mutex batch_mu_;
+  std::map<uint64_t, std::shared_ptr<BatchCtx>> batches_;  // by base tag
+
+  // Truncation sweep companion: take every in-flight native-batch
+  // context too — a batch whose member completions fell in a truncated
+  // tail would otherwise never resolve (its client hangs to the RPC
+  // deadline and the BatchCtx entry leaks in batches_ forever). A late
+  // completion for a swept member is a no-op (the map entry is gone).
+  std::vector<std::shared_ptr<BatchCtx>> sweep_batches() {
+    std::lock_guard<std::mutex> lk(batch_mu_);
+    std::vector<std::shared_ptr<BatchCtx>> out;
+    out.reserve(batches_.size());
+    for (auto& [base, ctx] : batches_) out.push_back(ctx);
+    batches_.clear();
+    return out;
+  }
+
   // Truncation sweep (me_gateway_complete_batch): take EVERY non-streaming
   // pending entry. A malformed completion buffer leaves the unparsed
   // tail's tags unknown, and pending_ doesn't record dispatch membership,
@@ -657,6 +760,16 @@ class Gateway {
 
   MeGwCallback callback() const { return callback_; }
   void set_callback(MeGwCallback cb) { callback_ = cb; }
+
+  // M_BATCH routing: 0 (default) = the in-gateway native path; 1 =
+  // forward through the python callback (the bridge sets this when the
+  // vectorized admission screens are enabled — they run python-side).
+  bool forward_batch() const {
+    return forward_batch_.load(std::memory_order_relaxed) != 0;
+  }
+  void set_forward_batch(int v) {
+    forward_batch_.store(v, std::memory_order_relaxed);
+  }
 
   long long max_price_q4() const { return max_price_q4_; }
   long long max_quantity() const { return max_quantity_; }
@@ -725,6 +838,7 @@ class Gateway {
   std::atomic<uint64_t> next_tag_{1};
 
   MeGwCallback callback_ = nullptr;
+  std::atomic<int> forward_batch_{0};
 
   const long long max_price_q4_;
   const long long max_quantity_;
@@ -1167,6 +1281,18 @@ void Conn::handle_request(uint32_t stream_id, Stream& st) {
     case M_AMEND:
       handle_amend(stream_id, payload);
       return;
+    case M_BATCH:
+      // In-gateway native batch path: structural screen + record
+      // conversion + one bulk ring push, all here — the python bridge
+      // never sees the payload (it used to forward it whole through the
+      // callback worker and back through the grpcio service handler).
+      // With forward_batch set (the bridge runs vectorized admission
+      // screens only python-side), fall through to the callback path.
+      if (!gw_->forward_batch()) {
+        handle_batch(stream_id, payload);
+        return;
+      }
+      [[fallthrough]];  // forwarded like book/metrics/streams
     default: {
       // Forwarded methods (book/metrics/streams) go through the Python
       // callback; the response arrives via me_gateway_respond.
@@ -1326,6 +1452,152 @@ void Conn::handle_amend(uint32_t stream_id, const std::string& payload) {
   }
 }
 
+// Serialize a finished BatchCtx as ONE OrderBatchResponse and answer the
+// RPC (positional parallel arrays — the grpcio edge's exact contract).
+void send_batch_response(const std::shared_ptr<BatchCtx>& b) {
+  auto conn = b->conn.lock();
+  if (!conn || conn->dead()) return;
+  pb::OrderBatchResponse resp;
+  resp.set_success(true);
+  for (size_t i = 0; i < b->ok.size(); i++) {
+    resp.add_ok(b->ok[i] != 0);
+    resp.add_order_id(b->oid[i]);
+    resp.add_error(b->err[i]);
+    resp.add_remaining(b->remaining[i]);
+  }
+  std::string bytes;
+  resp.SerializeToString(&bytes);
+  conn->write_unary(b->stream_id, bytes, 0, nullptr);
+}
+
+// me_oprec_flaws code -> the record_flaws message (domain/oprec.py
+// flaw_message — keep the strings in lockstep; the skip-guarded gateway
+// test compares against the python screen's wording).
+std::string flaw_message(int32_t code, uint8_t op, long long max_qty,
+                         long long max_price_q4) {
+  switch (code) {
+    case 1: return "invalid op code (1=submit, 2=cancel, 3=amend)";
+    case 2: return "reserved flags must be 0";
+    case 3: return "identifier length exceeds the record box";
+    case 4: return "symbol is required";
+    case 5: return "unknown order id";
+    case 6: return "client_id is required";
+    case 7: return "side must be BUY or SELL";
+    case 8: return "unsupported (order_type, tif) combination";
+    case 9: return op == 3 ? "new_quantity must be positive"
+                           : "quantity must be positive";
+    case 10:
+      return "quantity exceeds the engine maximum " +
+             std::to_string(max_qty) + " (int32 book-sum safety bound)";
+    case 11:
+      return "price_q4 out of the engine's int32 price lane (0, " +
+             std::to_string(max_price_q4) + "]";
+    case 12: return "MARKET records must carry price_q4=0";
+    default: return "malformed record";
+  }
+}
+
+// The in-gateway native batch path (ROADMAP Open item 3c): decode the
+// OrderBatchRequest HERE, run the structural screen (me_oprec_flaws —
+// record_flaws' native twin), convert the clean run straight into
+// tagged ring records (me_oprec_to_gwop) and bulk-push them under one
+// ring lock (ring_push_n) — the python bridge no longer sees batch
+// payloads at all. Host checks / id assignment stay with the ring
+// consumer (the native-lane dispatch or the bridge record loop), whose
+// completions resolve the batch's positional slots by tag.
+void Conn::handle_batch(uint32_t stream_id, const std::string& payload) {
+  pb::OrderBatchRequest req;
+  if (!req.ParseFromString(payload)) {
+    write_trailers(stream_id, 13, "unparsable OrderBatchRequest", false);
+    return;
+  }
+  auto fail_whole = [&](const std::string& msg) {
+    // Payload-poisoning defects answer like the grpcio edge: an
+    // app-level success=false, never a transport error.
+    pb::OrderBatchResponse resp;
+    resp.set_success(false);
+    resp.set_error_message(msg);
+    std::string bytes;
+    resp.SerializeToString(&bytes);
+    write_unary(stream_id, bytes, 0, nullptr);
+  };
+  const std::string& ops = req.ops();
+  if (ops.size() < 8 || std::memcmp(ops.data(), "MEOPREC1", 8) != 0) {
+    fail_whole("bad op-record magic (not an MEOPREC1 payload)");
+    return;
+  }
+  const uint8_t* body = reinterpret_cast<const uint8_t*>(ops.data()) + 8;
+  long long blen = static_cast<long long>(ops.size()) - 8;
+  if (blen % static_cast<long long>(sizeof(MeOpRec)) != 0) {
+    fail_whole("truncated op-record payload (" + std::to_string(blen) +
+               " bytes is not a multiple of the " +
+               std::to_string(sizeof(MeOpRec)) + "-byte record)");
+    return;
+  }
+  long long n = blen / static_cast<long long>(sizeof(MeOpRec));
+  constexpr long long kBatchCap = 1 << 16;  // service._BATCH_RECORD_CAP
+  if (n > kBatchCap) {
+    fail_whole("op-record batch of " + std::to_string(n) +
+               " exceeds the per-request cap " + std::to_string(kBatchCap));
+    return;
+  }
+  auto ctx = std::make_shared<BatchCtx>();
+  ctx->conn = shared_from_this();
+  ctx->stream_id = stream_id;
+  ctx->ok.assign(n, 0);
+  ctx->oid.assign(n, std::string());
+  ctx->err.assign(n, std::string());
+  ctx->remaining.assign(n, 0);
+  if (n == 0) {
+    send_batch_response(ctx);
+    return;
+  }
+  std::vector<int32_t> codes(n, 0);
+  if (me_oprec_flaws(body, blen, gw_->max_price_q4(), gw_->max_quantity(),
+                     codes.data(), static_cast<uint32_t>(n)) != n) {
+    fail_whole("malformed op-record payload");
+    return;
+  }
+  const MeOpRec* recs = reinterpret_cast<const MeOpRec*>(body);
+  std::vector<MeOpRec> clean;
+  clean.reserve(n);
+  for (long long i = 0; i < n; i++) {
+    if (codes[i] != 0) {
+      ctx->err[i] = flaw_message(codes[i], recs[i].op, gw_->max_quantity(),
+                                 gw_->max_price_q4());
+    } else {
+      ctx->pos.push_back(static_cast<int32_t>(i));
+      clean.push_back(recs[i]);
+    }
+  }
+  if (clean.empty()) {
+    send_batch_response(ctx);
+    return;
+  }
+  ctx->ring_n = static_cast<uint32_t>(clean.size());
+  ctx->unresolved = ctx->ring_n;
+  std::shared_ptr<BatchCtx> local = ctx;  // keep alive past register
+  uint64_t base = gw_->register_batch(std::move(ctx));
+  std::vector<MeGwOp> gwops(clean.size());
+  if (me_oprec_to_gwop(reinterpret_cast<const uint8_t*>(clean.data()),
+                       static_cast<long long>(clean.size() *
+                                              sizeof(MeOpRec)),
+                       base, gwops.data(),
+                       static_cast<uint32_t>(clean.size())) !=
+      static_cast<int>(clean.size())) {
+    // The screen already vetted structure — this is converter skew.
+    gw_->drop_batch(base);
+    fail_whole("op-record conversion failed (server-side skew)");
+    return;
+  }
+  if (!gw_->ring_push_n(gwops.data(), static_cast<uint32_t>(gwops.size()))) {
+    gw_->drop_batch(base);
+    for (int32_t p : local->pos) local->err[p] = "server overloaded";
+    send_batch_response(local);
+    return;
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1349,6 +1621,10 @@ void me_gateway_set_callback(void* g, MeGwCallback cb) {
   static_cast<Gateway*>(g)->set_callback(cb);
 }
 
+void me_gateway_set_forward_batch(void* g, int v) {
+  static_cast<Gateway*>(g)->set_forward_batch(v);
+}
+
 int me_gw_pop_batch(void* g, MeGwOp* out, uint32_t max, uint64_t window_us) {
   return static_cast<Gateway*>(g)->ring_pop_batch(out, max, window_us);
 }
@@ -1363,6 +1639,15 @@ int me_gw_pop_batch_timed(void* g, MeGwOp* out, uint32_t max,
 void me_gateway_complete_submit(void* g, uint64_t tag, int success,
                                 const char* order_id, const char* error) {
   auto* gw = static_cast<Gateway*>(g);
+  {
+    std::shared_ptr<BatchCtx> done;
+    if (gw->complete_batch_member(tag, 0, success != 0,
+                                  order_id ? order_id : "",
+                                  error ? error : "", 0, &done)) {
+      if (done) send_batch_response(done);
+      return;
+    }
+  }
   Pending p;
   if (!gw->take_pending(tag, &p)) return;
   auto conn = p.conn.lock();
@@ -1379,6 +1664,15 @@ void me_gateway_complete_submit(void* g, uint64_t tag, int success,
 void me_gateway_complete_cancel(void* g, uint64_t tag, int success,
                                 const char* order_id, const char* error) {
   auto* gw = static_cast<Gateway*>(g);
+  {
+    std::shared_ptr<BatchCtx> done;
+    if (gw->complete_batch_member(tag, 1, success != 0,
+                                  order_id ? order_id : "",
+                                  error ? error : "", 0, &done)) {
+      if (done) send_batch_response(done);
+      return;
+    }
+  }
   Pending p;
   if (!gw->take_pending(tag, &p)) return;
   auto conn = p.conn.lock();
@@ -1399,6 +1693,16 @@ void me_gateway_complete_amend(void* g, uint64_t tag, int success,
                                const char* order_id, long long remaining,
                                const char* error) {
   auto* gw = static_cast<Gateway*>(g);
+  {
+    std::shared_ptr<BatchCtx> done;
+    if (gw->complete_batch_member(tag, 2, success != 0,
+                                  order_id ? order_id : "",
+                                  error ? error : "", success ? remaining : 0,
+                                  &done)) {
+      if (done) send_batch_response(done);
+      return;
+    }
+  }
   Pending p;
   if (!gw->take_pending(tag, &p)) return;
   auto conn = p.conn.lock();
@@ -1471,6 +1775,16 @@ void me_gateway_complete_batch(void* g, const uint8_t* buf, uint64_t len) {
     std::string err(reinterpret_cast<const char*>(buf + off), err_len);
     off += err_len;
 
+    {
+      // A tag from an in-gateway native batch resolves its positional
+      // slot instead of writing a per-op unary response.
+      std::shared_ptr<BatchCtx> done;
+      if (gw->complete_batch_member(tag, kind, ok != 0, oid, err, 0,
+                                    &done)) {
+        if (done) send_batch_response(done);
+        continue;
+      }
+    }
     Pending p;
     if (!gw->take_pending(tag, &p)) continue;
     auto conn = p.conn.lock();
@@ -1536,6 +1850,20 @@ void me_gateway_complete_batch(void* g, const uint8_t* buf, uint64_t len) {
       conn->write_trailers(p.stream_id, 13,
                            "completion batch truncated (encoder/parser skew)",
                            p.headers_sent);
+    }
+    // In-flight native batches suffer the same unknown-tail problem:
+    // answer each whole (app-level, like every batch-poisoning defect)
+    // instead of letting its client hang on unresolved members.
+    for (const auto& b : gw->sweep_batches()) {
+      auto conn = b->conn.lock();
+      if (!conn || conn->dead()) continue;
+      pb::OrderBatchResponse resp;
+      resp.set_success(false);
+      resp.set_error_message(
+          "completion batch truncated (encoder/parser skew)");
+      std::string bytes;
+      resp.SerializeToString(&bytes);
+      conn->write_unary(b->stream_id, bytes, 0, nullptr);
     }
   }
 }

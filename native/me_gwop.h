@@ -53,8 +53,41 @@ struct MeOpRec {
   char pad2[4];
 };
 
+// MeShmResp: one positional response record on the shared-memory ingress
+// ring (native/me_shmring.cpp) — fixed 48 bytes, mirrored by
+// SHM_RESP_DTYPE in domain/oprec.py (the ABI cross-checker pins the
+// layout). `seq` is the request record's ring sequence; `reason` is a
+// MeIngressReason code (the shm edge carries codes, not free text — the
+// python client maps them via oprec.REASON_MESSAGES).
+struct MeShmResp {
+  uint64_t seq;
+  int64_t remaining;   // amend ack: post-amend remaining quantity
+  char order_id[24];   // "OID-<n>" (i64 fits in 24 with the prefix)
+  uint8_t ok;
+  uint8_t kind;        // 0 submit / 1 cancel / 2 amend
+  uint8_t reason;      // MeIngressReason (0 when ok)
+  uint8_t oid_len;
+  char pad[4];
+};
+
+// Reject reason codes on the shm ingress edge — ONE vocabulary across
+// the C++ structural screen (me_oprec_flaws), the vectorized admission
+// pipeline (server/admission.py) and the client (oprec.REASON_MESSAGES).
+enum MeIngressReason {
+  ME_REASON_NONE = 0,
+  ME_REASON_MALFORMED = 1,   // codec-structural (record_flaws vocabulary)
+  ME_REASON_RATE = 2,        // per-client rate limit
+  ME_REASON_QTY = 3,         // per-client max order size
+  ME_REASON_BAND = 4,        // price band around the symbol anchor
+  ME_REASON_STP = 5,         // self-trade prevention
+  ME_REASON_RING_FULL = 6,   // lane ring backpressure
+  ME_REASON_ENGINE = 7,      // server-side dispatch failure
+  ME_REASON_REJECTED = 8,    // engine app-level reject (capacity, unknown id)
+};
+
 }  // extern "C"
 
 static_assert(sizeof(MeOpRec) == 384, "MeOpRec must mirror oprec.py");
+static_assert(sizeof(MeShmResp) == 48, "MeShmResp must mirror oprec.py");
 
 #endif  // ME_GWOP_H_

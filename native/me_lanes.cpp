@@ -1680,6 +1680,58 @@ int me_oprec_to_gwop(const uint8_t* payload, long long len,
   }
   return static_cast<int>(n);
 }
+// Native twin of domain/oprec.record_flaws: per-record EDGE validation
+// over a packed run (no magic), emitting one flaw code per record into
+// `codes` (0 = clean; the codes map positionally onto record_flaws'
+// message branches — tests/test_shm_ingress.py pins code<->message
+// parity against the python screen). Used by the C++ gateway's native
+// M_BATCH path and available to any native ingress that must screen
+// without python. Returns n, or -1 on a ragged payload.
+int me_oprec_flaws(const uint8_t* payload, long long len,
+                   long long max_price_q4, long long max_quantity,
+                   int32_t* codes, uint32_t max_n) {
+  if ((!payload && len) || !codes) return -1;
+  if (len % static_cast<long long>(sizeof(MeOpRec)) != 0) return -1;
+  long long n = len / static_cast<long long>(sizeof(MeOpRec));
+  if (n > static_cast<long long>(max_n)) return -1;
+  const MeOpRec* recs = reinterpret_cast<const MeOpRec*>(payload);
+  for (long long i = 0; i < n; i++) {
+    const MeOpRec& r = recs[i];
+    bool is_submit = r.op == 1;
+    bool is_target = r.op == 2 || r.op == 3;
+    bool priced = is_submit && (r.otype == 0 || r.otype == 2 || r.otype == 3);
+    bool market = is_submit && (r.otype == 1 || r.otype == 4);
+    int32_t c = 0;  // branch order mirrors record_flaws exactly
+    if (r.op < 1 || r.op > 3)
+      c = 1;   // invalid op code
+    else if (r.flags != 0)
+      c = 2;   // reserved flags
+    else if (r.symbol_len > sizeof(r.symbol) ||
+             r.client_id_len > sizeof(r.client_id) ||
+             r.order_id_len > sizeof(r.order_id))
+      c = 3;   // identifier length over the record box
+    else if (is_submit && r.symbol_len == 0)
+      c = 4;   // symbol required
+    else if (is_target && r.order_id_len == 0)
+      c = 5;   // unknown order id
+    else if (is_target && r.client_id_len == 0)
+      c = 6;   // client_id required
+    else if (is_submit && r.side != 1 && r.side != 2)
+      c = 7;   // side
+    else if (is_submit && r.otype > 4)
+      c = 8;   // otype
+    else if ((is_submit || r.op == 3) && r.quantity <= 0)
+      c = 9;   // non-positive quantity
+    else if ((is_submit || r.op == 3) && r.quantity > max_quantity)
+      c = 10;  // over the engine cap
+    else if (priced && (r.price_q4 <= 0 || r.price_q4 > max_price_q4))
+      c = 11;  // price out of the device lane
+    else if (market && r.price_q4 != 0)
+      c = 12;  // MARKET must carry price 0
+    codes[i] = c;
+  }
+  return static_cast<int>(n);
+}
 int me_gwring_pop_batch(void* r, MeGwOp* out, uint32_t max,
                         uint64_t window_us, int64_t first_wait_us) {
   if (!r || !out) return -1;

@@ -1,0 +1,491 @@
+// me_shmring: the zero-copy shared-memory ingress ring (ROADMAP Open
+// item 3a — the CoinTossX design point, arXiv:2102.10925).
+//
+// A co-located client process maps one file-backed segment and writes
+// flat 384-byte op-records (MeOpRec — the PR 7 codec, unchanged on the
+// wire) straight into ring slots; the server's poller thread consumes
+// committed runs, screens them through the vectorized admission
+// pipeline, and bulk-pushes them into the lane rings — no proto, no
+// python per-op, no copy beyond the ring slot. Responses flow back
+// through a second single-writer ring of fixed 48-byte MeShmResp
+// records keyed by the request's ring sequence.
+//
+// CRASH-SAFETY CONTRACT (pinned by the kill-fuzz test): a writer
+// SIGKILLed at ANY instruction must never yield a torn, lost, or
+// duplicated admitted record.
+//   - Every slot has a COMMIT/SEQ word. A writer first CLAIMS a run of
+//     sequences (CAS on req_tail), then writes the record bytes, then
+//     publishes with a release-store of seq+1 into the slot's commit
+//     word. The poller admits a slot only when its commit word equals
+//     seq+1 (acquire) — a record the death interrupted mid-write was
+//     never published and can never be read torn.
+//   - A claimed-but-never-committed slot would stall the FIFO forever
+//     (claims are unique; the dead writer can't finish). The poller
+//     waits `torn_wait_us` for the commit and then RECOVERS the slot:
+//     skips it, counts torn_recovered, admits nothing for it. The
+//     client never saw an ack for that sequence, so nothing
+//     acknowledged is lost; the sequence is consumed, so nothing can
+//     be admitted twice.
+//   - Cursors are monotonic uint64 (never wrapped); slot reuse a lap
+//     later re-publishes with a strictly larger commit value, so a
+//     stale commit word can never satisfy a newer sequence.
+//
+// The doorbell is a futex word in the shared mapping (eventfd would
+// need fd passing between unrelated processes): writers bump-and-wake
+// after a committed run, the poller waits on the word's value with a
+// timeout — a wake between the value read and the wait returns
+// immediately (classic futex protocol), so no doorbell is ever missed.
+//
+// Compiled into libme_native.so (no protobuf dependency). Linux-only
+// (SYS_futex); every entry point degrades to an error return, never a
+// crash, on a bad handle.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include "me_gwop.h"
+
+namespace {
+
+constexpr char kMagic[8] = {'M', 'E', 'S', 'H', 'M', 'R', 'G', '1'};
+constexpr uint32_t kVersion = 1;
+constexpr size_t kHeaderBytes = 4096;  // one page; sections follow aligned
+
+struct ShmHeader {
+  char magic[8];
+  uint32_t version;
+  uint32_t req_cap;     // request slots (power of two)
+  uint32_t resp_cap;    // response slots (power of two)
+  uint32_t record_size;  // sizeof(MeOpRec); attach refuses a skewed build
+  // Cursors are monotonic sequence numbers, never wrapped; slot index is
+  // seq & (cap - 1). Cacheline-separated: the claim word is contended by
+  // writers, the head only by the poller.
+  alignas(64) std::atomic<uint64_t> req_tail;   // writer claim cursor
+  alignas(64) std::atomic<uint64_t> req_head;   // poller consume cursor
+  alignas(64) std::atomic<uint32_t> req_doorbell;
+  std::atomic<uint32_t> resp_doorbell;
+  std::atomic<uint32_t> closed;                 // server shutdown latch
+  alignas(64) std::atomic<uint64_t> resp_tail;  // server publish cursor
+  alignas(64) std::atomic<uint64_t> resp_head;  // client consume cursor
+  // Shared counters (the server scrapes these into me_ingress_*).
+  alignas(64) std::atomic<uint64_t> torn_recovered;
+  std::atomic<uint64_t> resp_dropped;
+  std::atomic<uint64_t> doorbell_wakes;
+};
+static_assert(sizeof(ShmHeader) <= kHeaderBytes, "header must fit its page");
+
+struct ShmRing {
+  void* map = nullptr;
+  size_t map_len = 0;
+  int fd = -1;
+  bool owner = false;
+
+  ShmHeader* hdr = nullptr;
+  std::atomic<uint64_t>* req_seq = nullptr;  // [req_cap] commit words
+  uint8_t* req_recs = nullptr;               // [req_cap] MeOpRec slots
+  MeShmResp* resp_recs = nullptr;            // [resp_cap]
+};
+
+bool pow2(uint32_t v) { return v != 0 && (v & (v - 1)) == 0; }
+
+size_t layout_len(uint32_t req_cap, uint32_t resp_cap) {
+  size_t n = kHeaderBytes;
+  n += sizeof(uint64_t) * req_cap;           // commit words
+  n = (n + 63) & ~size_t{63};
+  n += sizeof(MeOpRec) * req_cap;
+  n = (n + 63) & ~size_t{63};
+  n += sizeof(MeShmResp) * resp_cap;
+  return (n + 4095) & ~size_t{4095};
+}
+
+void wire_sections(ShmRing* r) {
+  uint8_t* base = static_cast<uint8_t*>(r->map);
+  r->hdr = reinterpret_cast<ShmHeader*>(base);
+  size_t off = kHeaderBytes;
+  r->req_seq = reinterpret_cast<std::atomic<uint64_t>*>(base + off);
+  off += sizeof(uint64_t) * r->hdr->req_cap;
+  off = (off + 63) & ~size_t{63};
+  r->req_recs = base + off;
+  off += sizeof(MeOpRec) * r->hdr->req_cap;
+  off = (off + 63) & ~size_t{63};
+  r->resp_recs = reinterpret_cast<MeShmResp*>(base + off);
+}
+
+int futex_wait(std::atomic<uint32_t>* addr, uint32_t expect,
+               int64_t timeout_us) {
+  struct timespec ts;
+  ts.tv_sec = timeout_us / 1000000;
+  ts.tv_nsec = (timeout_us % 1000000) * 1000;
+  // Shared futex (no PRIVATE flag): the waiter and waker are different
+  // processes mapping the same file.
+  return static_cast<int>(syscall(SYS_futex, addr, FUTEX_WAIT, expect,
+                                  timeout_us >= 0 ? &ts : nullptr, nullptr,
+                                  0));
+}
+
+void futex_wake_all(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, addr, FUTEX_WAKE, 0x7fffffff, nullptr, nullptr, 0);
+}
+
+int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Server side: create (or truncate) the segment file and initialize the
+// layout. Caps must be powers of two. Returns a handle or nullptr.
+void* me_shmring_create(const char* path, uint32_t req_cap,
+                        uint32_t resp_cap) {
+  if (!path || !pow2(req_cap) || !pow2(resp_cap)) return nullptr;
+  int fd = ::open(path, O_CREAT | O_RDWR | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = layout_len(req_cap, resp_cap);
+  if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  std::memset(map, 0, len);
+  auto* r = new ShmRing;
+  r->map = map;
+  r->map_len = len;
+  r->fd = fd;
+  r->owner = true;
+  auto* h = reinterpret_cast<ShmHeader*>(map);
+  h->version = kVersion;
+  h->req_cap = req_cap;
+  h->resp_cap = resp_cap;
+  h->record_size = static_cast<uint32_t>(sizeof(MeOpRec));
+  wire_sections(r);
+  // Magic LAST (release): an attacher that sees the magic sees a fully
+  // initialized header.
+  std::atomic_thread_fence(std::memory_order_release);
+  std::memcpy(h->magic, kMagic, sizeof(kMagic));
+  return r;
+}
+
+// Client side: map an existing segment. Refuses a bad magic/version or a
+// record-size skew (a mismatched build must fail loudly, not corrupt).
+void* me_shmring_attach(const char* path) {
+  if (!path) return nullptr;
+  int fd = ::open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)kHeaderBytes) {
+    ::close(fd);
+    return nullptr;
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void* map = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* h = reinterpret_cast<ShmHeader*>(map);
+  if (std::memcmp(h->magic, kMagic, sizeof(kMagic)) != 0 ||
+      h->version != kVersion ||
+      h->record_size != sizeof(MeOpRec) ||
+      !pow2(h->req_cap) || !pow2(h->resp_cap) ||
+      layout_len(h->req_cap, h->resp_cap) > len) {
+    munmap(map, len);
+    ::close(fd);
+    return nullptr;
+  }
+  auto* r = new ShmRing;
+  r->map = map;
+  r->map_len = len;
+  r->fd = fd;
+  r->owner = false;
+  wire_sections(r);
+  return r;
+}
+
+void me_shmring_close(void* h) {
+  if (!h) return;
+  auto* r = static_cast<ShmRing*>(h);
+  if (r->map) munmap(r->map, r->map_len);
+  if (r->fd >= 0) ::close(r->fd);
+  delete r;
+}
+
+// Server shutdown latch: attached writers see -2 from claim/push and the
+// client's response poll returns -2 once drained.
+void me_shmring_shutdown(void* h) {
+  if (!h) return;
+  auto* r = static_cast<ShmRing*>(h);
+  r->hdr->closed.store(1, std::memory_order_release);
+  r->hdr->req_doorbell.fetch_add(1, std::memory_order_release);
+  r->hdr->resp_doorbell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&r->hdr->req_doorbell);
+  futex_wake_all(&r->hdr->resp_doorbell);
+}
+
+// -- writer (client process) ------------------------------------------------
+
+// Claim n consecutive sequences. Returns the base sequence, -1 when the
+// ring can't hold n more records (backpressure: the writer retries), -2
+// when the server shut the segment down.
+long long me_shmring_claim(void* h, uint32_t n) {
+  if (!h || n == 0) return -1;
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  if (hd->closed.load(std::memory_order_acquire)) return -2;
+  for (;;) {
+    uint64_t t = hd->req_tail.load(std::memory_order_relaxed);
+    uint64_t head = hd->req_head.load(std::memory_order_acquire);
+    if (t + n - head > hd->req_cap) return -1;  // full
+    if (hd->req_tail.compare_exchange_weak(t, t + n,
+                                           std::memory_order_acq_rel))
+      return static_cast<long long>(t);
+  }
+}
+
+// Zero-copy slot access: the writer builds the record IN the mapped slot.
+uint8_t* me_shmring_slot(void* h, long long seq) {
+  if (!h || seq < 0) return nullptr;
+  auto* r = static_cast<ShmRing*>(h);
+  uint64_t idx = static_cast<uint64_t>(seq) & (r->hdr->req_cap - 1);
+  return r->req_recs + idx * sizeof(MeOpRec);
+}
+
+// Publish one claimed slot (release): after this store the poller may
+// admit the record — the record bytes must be fully written first.
+void me_shmring_commit(void* h, long long seq) {
+  if (!h || seq < 0) return;
+  auto* r = static_cast<ShmRing*>(h);
+  uint64_t s = static_cast<uint64_t>(seq);
+  r->req_seq[s & (r->hdr->req_cap - 1)].store(s + 1,
+                                              std::memory_order_release);
+}
+
+// Ring the request doorbell (after a run of commits — one wake per
+// batch, not per record).
+void me_shmring_wake(void* h) {
+  if (!h) return;
+  auto* r = static_cast<ShmRing*>(h);
+  r->hdr->req_doorbell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&r->hdr->req_doorbell);
+}
+
+// Copy-in convenience writer: claim + write + commit + wake for a packed
+// run of records. Returns the base sequence, -1 full, -2 closed.
+long long me_shmring_push_n(void* h, const MeOpRec* recs, uint32_t n) {
+  if (!h || (!recs && n)) return -1;
+  long long base = me_shmring_claim(h, n);
+  if (base < 0) return base;
+  for (uint32_t i = 0; i < n; i++) {
+    std::memcpy(me_shmring_slot(h, base + i), &recs[i], sizeof(MeOpRec));
+    me_shmring_commit(h, base + i);
+  }
+  me_shmring_wake(h);
+  return base;
+}
+
+// -- poller (server thread) -------------------------------------------------
+
+// Pop committed records: up to `max` copied into `out`, their ring
+// sequences into `seqs` (torn-slot recovery makes runs non-contiguous,
+// so responses key by sequence, not position). Blocks up to wait_us for
+// the FIRST record, then keeps collecting for up to window_us more (the
+// GwRing batching-window semantics: one big dispatch beats many small
+// ones). A claimed slot whose commit doesn't arrive within torn_wait_us
+// is recovered: skipped, counted (shared header counter + *torn for
+// this call). Returns n (possibly 0 on timeout), or -2 when the segment
+// is shut down and drained.
+int me_shmring_poll(void* h, MeOpRec* out, long long* seqs, uint32_t max,
+                    int64_t wait_us, int64_t window_us,
+                    int64_t torn_wait_us, long long* torn) {
+  if (torn) *torn = 0;
+  if (!h || !out || !seqs || max == 0) return -1;
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  const uint32_t mask = hd->req_cap - 1;
+  int64_t deadline = now_us() + (wait_us >= 0 ? wait_us : 0);
+  int64_t window_deadline = -1;  // armed by the first collected record
+  int64_t torn_deadline = -1;
+  uint32_t n = 0;
+  for (;;) {
+    uint64_t head = hd->req_head.load(std::memory_order_relaxed);
+    uint64_t tail = hd->req_tail.load(std::memory_order_acquire);
+    uint64_t pos = head;
+    long long torn_now = 0;
+    uint32_t got = 0;
+    while (n < max && pos < tail) {
+      uint64_t s = r->req_seq[pos & mask].load(std::memory_order_acquire);
+      if (s == pos + 1) {
+        std::memcpy(&out[n], r->req_recs + (pos & mask) * sizeof(MeOpRec),
+                    sizeof(MeOpRec));
+        seqs[n] = static_cast<long long>(pos);
+        n++;
+        got++;
+        pos++;
+        torn_deadline = -1;  // progress: any later gap restarts the clock
+      } else if (got == 0 && n == 0 && torn_deadline >= 0 &&
+                 now_us() >= torn_deadline) {
+        // The slot's claimant died mid-write (SIGKILL between claim and
+        // commit): recover it. Only ever at the FRONT with nothing
+        // collected — a gap behind collected records gets its own full
+        // torn window on the next call.
+        pos++;
+        torn_now++;
+        torn_deadline = -1;
+      } else {
+        break;  // uncommitted claim: stop at the contiguous prefix
+      }
+    }
+    if (torn_now) {
+      hd->torn_recovered.fetch_add(static_cast<uint64_t>(torn_now),
+                                   std::memory_order_relaxed);
+      if (torn) *torn += torn_now;
+    }
+    if (got > 0 || torn_now > 0) {
+      // Release: writers' fullness check (claim) must observe the freed
+      // slots only after our record copies are done.
+      hd->req_head.store(pos, std::memory_order_release);
+    }
+    if (n >= max) return static_cast<int>(n);
+    if (n > 0) {
+      // Batching window: first record arms it; keep collecting until it
+      // closes or the buffer fills.
+      int64_t now = now_us();
+      if (window_deadline < 0) window_deadline = now + window_us;
+      if (now >= window_deadline) return static_cast<int>(n);
+      if (got > 0) continue;  // something arrived: rescan immediately
+      uint32_t d = hd->req_doorbell.load(std::memory_order_acquire);
+      if (hd->req_tail.load(std::memory_order_acquire) ==
+          hd->req_head.load(std::memory_order_relaxed)) {
+        futex_wait(&hd->req_doorbell, d, window_deadline - now);
+      } else {
+        struct timespec ts = {0, 100 * 1000};  // gap mid-window: 100us
+        nanosleep(&ts, nullptr);
+      }
+      continue;
+    }
+    if (head < tail && got == 0 && torn_now == 0) {
+      // Claimed but uncommitted at the front: arm the torn clock and
+      // wait it out in short slices (the writer is normally a few
+      // STORES away from committing; death is the rare case).
+      if (torn_deadline < 0) torn_deadline = now_us() + torn_wait_us;
+      struct timespec ts = {0, 200 * 1000};  // 200us
+      nanosleep(&ts, nullptr);
+    } else if (got == 0 && torn_now == 0) {
+      if (hd->closed.load(std::memory_order_acquire)) return -2;
+      uint32_t d = hd->req_doorbell.load(std::memory_order_acquire);
+      // Re-check after the doorbell read (the futex protocol: a writer
+      // that committed and bumped between our tail read and here makes
+      // the wait return immediately on value mismatch).
+      if (hd->req_tail.load(std::memory_order_acquire) == head) {
+        int64_t left = deadline - now_us();
+        if (left <= 0) return 0;
+        if (futex_wait(&hd->req_doorbell, d, left) == 0)
+          hd->doorbell_wakes.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    if (n == 0 && now_us() >= deadline && torn_deadline < 0) return 0;
+  }
+}
+
+// -- responses (server single-writer, client consumer) ----------------------
+
+// Publish n response records. The server never blocks the serving path
+// on a slow client: when the client's unread backlog leaves no room, the
+// remainder is DROPPED and counted (the client re-derives outcomes from
+// the store / re-submits; acks are a convenience channel, admission is
+// what is durable). Returns the number written.
+int me_shmring_respond_n(void* h, const MeShmResp* rs, uint32_t n) {
+  if (!h || (!rs && n)) return -1;
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  const uint32_t cap = hd->resp_cap;
+  uint64_t tail = hd->resp_tail.load(std::memory_order_relaxed);
+  uint64_t head = hd->resp_head.load(std::memory_order_acquire);
+  uint32_t room = static_cast<uint32_t>(cap - (tail - head));
+  uint32_t w = n < room ? n : room;
+  for (uint32_t i = 0; i < w; i++)
+    r->resp_recs[(tail + i) & (cap - 1)] = rs[i];
+  hd->resp_tail.store(tail + w, std::memory_order_release);
+  if (w < n)
+    hd->resp_dropped.fetch_add(n - w, std::memory_order_relaxed);
+  hd->resp_doorbell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&hd->resp_doorbell);
+  return static_cast<int>(w);
+}
+
+// Client: pop up to max responses, blocking up to wait_us for the first.
+// Returns n (0 on timeout), -2 when the server shut down AND every
+// published response was consumed.
+int me_shmring_resp_poll(void* h, MeShmResp* out, uint32_t max,
+                         int64_t wait_us) {
+  if (!h || !out || max == 0) return -1;
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  const uint32_t cap = hd->resp_cap;
+  int64_t deadline = now_us() + (wait_us >= 0 ? wait_us : 0);
+  for (;;) {
+    uint64_t head = hd->resp_head.load(std::memory_order_relaxed);
+    uint64_t tail = hd->resp_tail.load(std::memory_order_acquire);
+    if (tail > head) {
+      uint32_t n = static_cast<uint32_t>(tail - head);
+      if (n > max) n = max;
+      for (uint32_t i = 0; i < n; i++)
+        out[i] = r->resp_recs[(head + i) & (cap - 1)];
+      hd->resp_head.store(head + n, std::memory_order_release);
+      return static_cast<int>(n);
+    }
+    if (hd->closed.load(std::memory_order_acquire)) return -2;
+    uint32_t d = hd->resp_doorbell.load(std::memory_order_acquire);
+    if (hd->resp_tail.load(std::memory_order_acquire) == head) {
+      int64_t left = deadline - now_us();
+      if (left <= 0) return 0;
+      futex_wait(&hd->resp_doorbell, d, left);
+    }
+  }
+}
+
+// Shared-header stats for the server's metrics sampler.
+void me_shmring_stats(void* h, long long* depth, long long* torn,
+                      long long* resp_dropped, long long* wakes) {
+  if (!h) {
+    if (depth) *depth = 0;
+    if (torn) *torn = 0;
+    if (resp_dropped) *resp_dropped = 0;
+    if (wakes) *wakes = 0;
+    return;
+  }
+  auto* r = static_cast<ShmRing*>(h);
+  ShmHeader* hd = r->hdr;
+  if (depth)
+    *depth = static_cast<long long>(
+        hd->req_tail.load(std::memory_order_acquire) -
+        hd->req_head.load(std::memory_order_acquire));
+  if (torn)
+    *torn = static_cast<long long>(
+        hd->torn_recovered.load(std::memory_order_relaxed));
+  if (resp_dropped)
+    *resp_dropped = static_cast<long long>(
+        hd->resp_dropped.load(std::memory_order_relaxed));
+  if (wakes)
+    *wakes = static_cast<long long>(
+        hd->doorbell_wakes.load(std::memory_order_relaxed));
+}
+
+}  // extern "C"

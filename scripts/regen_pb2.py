@@ -142,12 +142,19 @@ ADDITIVE_MESSAGES = [
     ]),
 ]
 
-# New service methods (service, method, input message, output message) —
-# additive; an unknown method on an old server answers UNIMPLEMENTED.
+# New service methods (service, method, input message, output message
+# [, streaming]) — additive; an unknown method on an old server answers
+# UNIMPLEMENTED. `streaming` is "client_streaming" / "server_streaming"
+# (or both, comma-separated); absent = unary-unary.
 ADDITIVE_METHODS = [
     ("MatchingEngine", "SubmitOrderBatch",
      "OrderBatchRequest", "OrderBatchResponse"),
     ("MatchingEngine", "Promote", "PromoteRequest", "PromoteResponse"),
+    # Zero-copy ingress (ROADMAP Open item 3b): client-streaming ingest
+    # for remote flow that can't batch client-side — chunks of the same
+    # oprec payload, one positional OrderBatchResponse for the stream.
+    ("MatchingEngine", "SubmitOrderStream",
+     "OrderBatchRequest", "OrderBatchResponse", "client_streaming"),
 ]
 
 HEADER = '''\
@@ -232,7 +239,11 @@ def apply_fields(fdp: descriptor_pb2.FileDescriptorProto) -> list:
             name, number, ftype = spec[0], spec[1], spec[2]
             label = spec[3] if len(spec) > 3 else F.LABEL_OPTIONAL
             _add_field(msg, name, number, ftype, label, added)
-    for svc_name, method, in_msg, out_msg in ADDITIVE_METHODS:
+    for spec in ADDITIVE_METHODS:
+        svc_name, method, in_msg, out_msg = spec[:4]
+        streaming = spec[4] if len(spec) > 4 else ""
+        client_streaming = "client_streaming" in streaming
+        server_streaming = "server_streaming" in streaming
         svc = next((s for s in fdp.service if s.name == svc_name), None)
         if svc is None:
             raise SystemExit(f"service {svc_name} not found")
@@ -240,7 +251,9 @@ def apply_fields(fdp: descriptor_pb2.FileDescriptorProto) -> list:
         in_t, out_t = f".{pkg}.{in_msg}", f".{pkg}.{out_msg}"
         existing = next((m for m in svc.method if m.name == method), None)
         if existing is not None:
-            if existing.input_type != in_t or existing.output_type != out_t:
+            if (existing.input_type != in_t or existing.output_type != out_t
+                    or existing.client_streaming != client_streaming
+                    or existing.server_streaming != server_streaming):
                 raise SystemExit(
                     f"{svc_name}.{method} exists with different types — "
                     f"refusing a non-additive edit")
@@ -249,6 +262,10 @@ def apply_fields(fdp: descriptor_pb2.FileDescriptorProto) -> list:
         m.name = method
         m.input_type = in_t
         m.output_type = out_t
+        if client_streaming:
+            m.client_streaming = True
+        if server_streaming:
+            m.server_streaming = True
         added.append((svc_name, method))
     return added
 

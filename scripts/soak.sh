@@ -561,6 +561,98 @@ if [ "$FC_OK" != "1" ]; then
 fi
 echo "flash-crash round: $FC_ACC/$FC_TOTAL accepted, $FC_REJ rejects metered (counter=$FC_COUNTED, book_capacity=$FC_CAP), auditor green"
 
+# ---- ingress round: zero-copy shm ring under full audit --------------------
+# The shared-memory edge through the REAL stack (ISSUE 15): replay the
+# flash-crash recording (reused from the round above) through `client
+# submit-shm` — a separate process writing 384-byte records straight
+# into the server's mapped ring — against a server running the auditor
+# at sample 1. FAIL on any auditor violation, on a store/positional-
+# status mismatch (orders rows MUST equal the client's accepted-submit
+# acks — a lost or doubled admit is exactly what the ring's commit-word
+# protocol exists to prevent), or on missing me_ingress_* series.
+IN_DB="$WORK/soak_ingress.db"
+IN_RING="$WORK/ingress.ring"
+PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+  --addr 127.0.0.1:0 --db "$IN_DB" --symbols 16 --batch 8 \
+  --window-ms 1 --megadispatch-max-waves 4 --metrics-port 0 \
+  --shm-ingress "$IN_RING" --shm-torn-ms 25 \
+  --admission-rate 1000000000 --admission-max-qty 2000000 \
+  --flight-dir "$WORK/ingress_flight" \
+  $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
+  > "$WORK/server_ingress.log" 2>&1 &
+IN_SRV=$!
+trap 'kill $SRV $IN_SRV 2>/dev/null' EXIT
+IN_PY=""; IN_OBS=""
+for i in $(seq 1 "$BOOT_WAIT"); do
+  IN_PY=$(sed -n 's/.*listening on port \([0-9]*\).*/\1/p' "$WORK/server_ingress.log" | head -1)
+  IN_OBS=$(sed -n 's/.*metrics on port \([0-9]*\).*/\1/p' "$WORK/server_ingress.log" | head -1)
+  [ -n "$IN_PY" ] && [ -n "$IN_OBS" ] && break
+  kill -0 $IN_SRV 2>/dev/null || { echo "FAIL: ingress server died at boot"; tail -5 "$WORK/server_ingress.log"; exit 1; }
+  sleep 1
+done
+[ -n "$IN_PY" ] && [ -n "$IN_OBS" ] || { echo "FAIL: ingress server ports never appeared"; exit 1; }
+# Cancel-gap flow control: the poller dispatches whatever run it pops,
+# so the un-acked backlog must stay below the recording's
+# min_cancel_gap (a cancel landing in the same dispatch as its target
+# resolves against the pre-batch directory).
+IN_GAP=$(python -c "import json,sys; print(json.load(open(sys.argv[1])).get('min_cancel_gap') or 512)" "${FC_OPS_FILE%.opfile.gz}.manifest.json")
+IN_CHUNK=128
+IN_INFLIGHT=$(( IN_GAP - IN_CHUNK > IN_CHUNK ? IN_GAP - IN_CHUNK : IN_CHUNK ))
+IN_SUMMARY="$WORK/ingress_replay.json"
+python -m matching_engine_tpu.client.cli submit-shm "$IN_RING" \
+  "$FC_OPS_FILE" --chunk "$IN_CHUNK" --max-inflight "$IN_INFLIGHT" \
+  --timeout 300 --quiet --summary-json "$IN_SUMMARY" \
+  >/dev/null 2>"$WORK/ingress_replay.err" \
+  || { echo "FAIL: shm ingress replay failed"; cat "$WORK/ingress_replay.err"; exit 1; }
+IN_SCRAPE="$WORK/ingress_scrape.prom"
+python - "$IN_OBS" > "$IN_SCRAPE" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-ingress {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+cat "$IN_SCRAPE" >> "$METRICS_OUT"
+check_audit "$IN_OBS" "ingress" \
+  || { echo "FAIL: audit violations in the ingress round"; exit 1; }
+# Store/positional-status agreement + the me_ingress_* contract.
+IN_CHECK=$(python - "$IN_SUMMARY" "$IN_SCRAPE" "$IN_DB" <<'EOF'
+import json, re, sqlite3, sys
+s = json.load(open(sys.argv[1]))
+scrape = open(sys.argv[2]).read()
+# Engine-rejected submits also land in the store (status REJECTED=4, the
+# decode-path semantics) — the bit-identity claim is accepted submits ==
+# non-REJECTED order rows.
+orders = sqlite3.connect(sys.argv[3]).execute(
+    "SELECT COUNT(*) FROM orders WHERE status != 4").fetchone()[0]
+m = re.search(r"^me_ingress_records_total (\d+)", scrape, re.M)
+ing_records = int(m.group(1)) if m else -1
+have_series = all(
+    re.search(rf"^me_ingress_{n}", scrape, re.M)
+    for n in ("records_total", "batches_total", "rejects_total",
+              "torn_recoveries_total", "ring_depth", "doorbell_wakes",
+              "resp_dropped"))
+ok = (s["accepted"] > 0
+      and s["pushed"] == s["ops"]              # everything entered the ring
+      and ing_records == s["ops"]              # ...and was admitted off it
+      and orders == s["accepted_submits"]      # store == positional acks
+      and have_series)
+print(f"{int(ok)} {s['accepted']} {s['rejected']} {s['ops']} "
+      f"{orders} {s['accepted_submits']} {ing_records} {int(have_series)}")
+EOF
+)
+read -r IN_OK IN_ACC IN_REJ IN_TOTAL IN_ORDERS IN_SUBMITS IN_RECORDS IN_SERIES <<< "$(echo "$IN_CHECK" | tail -1)"
+kill -TERM $IN_SRV 2>/dev/null; wait $IN_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+if [ "$IN_OK" != "1" ]; then
+  echo "FAIL: ingress round mismatch (accepted=$IN_ACC rejected=$IN_REJ ops=$IN_TOTAL store_orders=$IN_ORDERS accepted_submits=$IN_SUBMITS me_ingress_records=$IN_RECORDS series_ok=$IN_SERIES)"
+  exit 1
+fi
+echo "ingress round: $IN_ACC/$IN_TOTAL accepted via shm ring, store rows == positional submit acks ($IN_ORDERS), me_ingress_* green"
+
 # ---- corruption-injection round: the auditor must fire --------------------
 # Boots a server with ME_AUDIT_FAULT=fill_qty (one fill record's quantity
 # mutated between decode and publish), drives crossing flow, and asserts
@@ -904,6 +996,14 @@ artifact = {
                           "rejects_counter": int("$FC_COUNTED" or -1),
                           "reject_threshold": 0.25,
                           "audit_sample": 1},
+    "ingress_round": {"edge": "shm-ring", "scenario": "flash_crash",
+                      "accepted": int("$IN_ACC" or -1),
+                      "rejected": int("$IN_REJ" or -1),
+                      "ops": int("$IN_TOTAL" or -1),
+                      "store_rows": int("$IN_ORDERS" or -1),
+                      "accepted_submits": int("$IN_SUBMITS" or -1),
+                      "ingress_records": int("$IN_RECORDS" or -1),
+                      "audit_sample": 1},
     "auditz": auditz,
     "corruption_round": {"fault": "fill_qty", "detected": True,
                          "violations": int("$CI_VIOL" or -1),

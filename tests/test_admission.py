@@ -1,0 +1,320 @@
+"""Vectorized admission screens (server/admission.py) vs a per-op
+python oracle.
+
+The oracle below is an INDEPENDENT re-implementation of the documented
+batch-boundary semantics — per-op dict-and-loop, no numpy — so the
+property fuzz catches a vectorization bug in either direction (a screen
+that fires where the spec says no, or sleeps where it says reject).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.server.admission import (
+    AdmissionConfig,
+    AdmissionScreens,
+)
+
+R = oprec  # reason-code namespace
+
+
+# -- the per-op oracle -------------------------------------------------------
+
+
+class Oracle:
+    """Per-op reference: same config, same batch-boundary semantics,
+    implemented with plain dicts and one loop per batch."""
+
+    def __init__(self, cfg: AdmissionConfig):
+        self.cfg = cfg
+        self.rate: dict[bytes, int] = {}
+        self.window_start = 0.0
+        self.anchors: dict[bytes, int] = {}
+        self.stp: dict[tuple[bytes, bytes], list] = {}
+
+    def screen_batch(self, records, now: float):
+        """records: list of (op, side, otype, price_q4, qty, symbol,
+        client_id) python tuples. Returns per-record reason codes."""
+        cfg = self.cfg
+        if cfg.rate_limit and now - self.window_start >= cfg.rate_window_s:
+            self.rate.clear()
+            self.window_start = now
+        # Frozen-at-batch-entry tables (the documented semantics).
+        anchors = dict(self.anchors)
+        stp = {k: list(v) for k, v in self.stp.items()}
+        seen_rate: dict[bytes, int] = {}
+        out = []
+        admitted = []
+        for (op, side, otype, price, qty, sym, cid) in records:
+            code = 0
+            if cfg.rate_limit:
+                pre = self.rate.get(cid, 0) + seen_rate.get(cid, 0)
+                if pre >= cfg.rate_limit:
+                    code = code or R.REASON_RATE
+                seen_rate[cid] = seen_rate.get(cid, 0) + 1
+            if cfg.max_quantity and op in (1, 3) \
+                    and qty > cfg.max_quantity:
+                code = code or R.REASON_QTY
+            if cfg.price_band_bps and op == 1 and otype in (0, 2, 3):
+                a = anchors.get(sym, 0)
+                if a > 0 and abs(price - a) * 10000 > cfg.price_band_bps * a:
+                    code = code or R.REASON_BAND
+            if cfg.stp and op == 1:
+                q = stp.get((cid, sym))
+                if q is not None and q[2] > now:
+                    bid, ask = q[0], q[1]
+                    mkt = otype in (1, 4)
+                    if side == 1 and ask > 0 and (mkt or price >= ask):
+                        code = code or R.REASON_STP
+                    if side == 2 and bid > 0 and (mkt or price <= bid):
+                        code = code or R.REASON_STP
+            out.append(code)
+            if code == 0:
+                admitted.append((op, side, otype, price, qty, sym, cid))
+        # Post-batch state updates (admitted records only, in order).
+        for cid, c in seen_rate.items():
+            self.rate[cid] = self.rate.get(cid, 0) + c
+        for (op, side, otype, price, qty, sym, cid) in admitted:
+            if op == 1 and otype in (0, 2, 3):
+                self.anchors[sym] = price
+            if op == 1 and otype == 0:
+                key = (cid, sym)
+                q = self.stp.get(key)
+                if q is None or q[2] <= now:
+                    q = [0, 0, now + self.cfg.stp_ttl_s]
+                    self.stp[key] = q
+                if side == 1:
+                    q[0] = max(q[0], price)
+                else:
+                    q[1] = min(q[1], price) if q[1] else price
+                q[2] = now + self.cfg.stp_ttl_s
+        return out
+
+
+def _pack(records):
+    """(op, side, otype, price, qty, sym, cid) tuples -> record array.
+    Cancels/amends get a syntactically valid target id (the screens
+    never read it; record_flaws requires it nonempty)."""
+    return oprec.pack_records(
+        [(op, side, otype, price, qty, sym, cid,
+          b"" if op == 1 else b"OID-1") for
+         (op, side, otype, price, qty, sym, cid) in records])
+
+
+def _keyed(records):
+    """Oracle variant of the same records with box-padded keys."""
+    out = []
+    for (op, side, otype, price, qty, sym, cid) in records:
+        out.append((op, side, otype, price, qty,
+                    sym.ljust(oprec.SYMBOL_BYTES, b"\x00"),
+                    cid.ljust(oprec.CLIENT_ID_BYTES, b"\x00")))
+    return out
+
+
+def _random_flow(rng, n, n_clients=4, n_syms=3):
+    recs = []
+    for _ in range(n):
+        op = rng.choice([1, 1, 1, 1, 2, 3])
+        side = rng.choice([1, 2])
+        otype = rng.choice([0, 0, 0, 1, 2, 3, 4])
+        price = 0 if (otype in (1, 4) or op != 1) \
+            else rng.randint(90, 110) * 100
+        qty = rng.randint(1, 40)
+        sym = f"S{rng.randrange(n_syms)}".encode()
+        cid = f"c{rng.randrange(n_clients)}".encode()
+        recs.append((op, side, otype, price, qty, sym, cid))
+    return recs
+
+
+FUZZ_CFGS = [
+    AdmissionConfig(rate_limit=7, rate_window_s=10.0),
+    AdmissionConfig(max_quantity=20),
+    AdmissionConfig(price_band_bps=300),
+    AdmissionConfig(stp=True, stp_ttl_s=100.0),
+    AdmissionConfig(rate_limit=11, rate_window_s=10.0, max_quantity=25,
+                    price_band_bps=500, stp=True, stp_ttl_s=100.0),
+]
+
+
+@pytest.mark.parametrize("cfg", FUZZ_CFGS,
+                         ids=["rate", "qty", "band", "stp", "all"])
+def test_vectorized_matches_oracle_fuzz(cfg):
+    """Property fuzz: over random multi-batch flows the vectorized
+    screens and the per-op oracle agree positionally, batch after batch
+    (state carried across batches on both sides)."""
+    rng = random.Random(0xA5)
+    for trial in range(10):
+        screens = AdmissionScreens(cfg)
+        oracle = Oracle(cfg)
+        now = 100.0
+        for batch in range(6):
+            recs = _random_flow(rng, rng.randint(1, 40))
+            arr = _pack(recs)
+            flaws = oprec.record_flaws(arr)
+            # The fuzz generator only produces structurally-clean
+            # records; the screens must see flaws=None positions.
+            assert all(f is None for f in flaws)
+            got = screens.screen(arr, flaws, now=now)
+            want = oracle.screen_batch(_keyed(recs), now)
+            assert list(got) == want, (
+                f"trial {trial} batch {batch}: vectorized {list(got)} "
+                f"!= oracle {want} for {recs}")
+            # Reason messages landed positionally in flaws.
+            for i, code in enumerate(want):
+                if code:
+                    assert flaws[i] == oprec.REASON_MESSAGES[code]
+                else:
+                    assert flaws[i] is None
+            now += 0.5
+
+
+def test_rate_window_rotation():
+    cfg = AdmissionConfig(rate_limit=2, rate_window_s=1.0)
+    s = AdmissionScreens(cfg)
+    recs = [(1, 1, 0, 10000, 5, b"S", b"c")] * 3
+    arr = _pack(recs)
+    flaws = [None] * 3
+    got = s.screen(arr, flaws, now=0.0)
+    assert list(got) == [0, 0, R.REASON_RATE]
+    # Same window: budget already spent.
+    flaws = [None] * 3
+    got = s.screen(_pack(recs), flaws, now=0.5)
+    assert list(got) == [R.REASON_RATE] * 3
+    # Window rotated: budget back.
+    flaws = [None] * 3
+    got = s.screen(_pack(recs), flaws, now=2.0)
+    assert list(got) == [0, 0, R.REASON_RATE]
+
+
+def test_band_anchor_is_batch_boundary():
+    cfg = AdmissionConfig(price_band_bps=100)  # 1%
+    s = AdmissionScreens(cfg)
+    # First batch sets the anchor at its LAST admitted priced submit.
+    arr = _pack([(1, 1, 0, 10000, 5, b"S", b"c"),
+                 (1, 1, 0, 10050, 5, b"S", b"c")])
+    flaws = [None, None]
+    assert list(s.screen(arr, flaws, now=0.0)) == [0, 0]
+    # Anchor is 10050 now: 10050 ± 1% = [9950, 10150].
+    arr = _pack([(1, 1, 0, 10150, 5, b"S", b"c"),
+                 (1, 1, 0, 10200, 5, b"S", b"c"),
+                 (1, 2, 0, 9900, 5, b"S", b"c")])
+    flaws = [None] * 3
+    got = s.screen(arr, flaws, now=0.0)
+    assert list(got) == [0, R.REASON_BAND, R.REASON_BAND]
+    assert flaws[1] == oprec.REASON_MESSAGES[R.REASON_BAND]
+
+
+def test_stp_crosses_own_quote_only():
+    cfg = AdmissionConfig(stp=True, stp_ttl_s=10.0)
+    s = AdmissionScreens(cfg)
+    # c1 rests a sell at 100.00; c2 rests a buy at 99.00.
+    arr = _pack([(1, 2, 0, 10000, 5, b"S", b"c1"),
+                 (1, 1, 0, 9900, 5, b"S", b"c2")])
+    flaws = [None, None]
+    assert list(s.screen(arr, flaws, now=0.0)) == [0, 0]
+    arr = _pack([
+        (1, 1, 0, 10000, 5, b"S", b"c1"),   # c1 buy at own ask: STP
+        (1, 1, 0, 9950, 5, b"S", b"c1"),    # below own ask: fine
+        (1, 1, 1, 0, 5, b"S", b"c1"),       # c1 MARKET buy: STP
+        (1, 1, 0, 10000, 5, b"S", b"c2"),   # c2 has no ask: fine
+        (1, 2, 0, 9900, 5, b"S", b"c2"),    # c2 sell at own bid: STP
+    ])
+    flaws = [None] * 5
+    got = s.screen(arr, flaws, now=1.0)
+    assert list(got) == [R.REASON_STP, 0, R.REASON_STP, 0, R.REASON_STP]
+    # TTL expiry clears the table.
+    arr = _pack([(1, 1, 0, 10000, 5, b"S", b"c1")])
+    flaws = [None]
+    assert list(s.screen(arr, flaws, now=30.0)) == [0]
+
+
+def test_screen_one_matches_batch_of_one():
+    cfg = AdmissionConfig(max_quantity=10, rate_limit=3,
+                          rate_window_s=100.0)
+    s = AdmissionScreens(cfg)
+    assert s.screen_one(1, 1, 0, 10000, 5, b"S", b"c") is None
+    assert s.screen_one(1, 1, 0, 10000, 50, b"S", b"c") == \
+        oprec.REASON_MESSAGES[R.REASON_QTY]
+    # Two ops spent (rejects spend budget too); third passes, fourth
+    # hits the rate wall.
+    assert s.screen_one(1, 1, 0, 10000, 5, b"S", b"c") is None
+    assert s.screen_one(2, 0, 0, 0, 0, b"", b"c") == \
+        oprec.REASON_MESSAGES[R.REASON_RATE]
+
+
+def test_disabled_config_is_noop():
+    s = AdmissionScreens(AdmissionConfig())
+    assert not s.enabled
+    arr = _pack([(1, 1, 0, 10000, 5, b"S", b"c")])
+    flaws = [None]
+    assert list(s.screen(arr, flaws)) == [0]
+    assert flaws == [None]
+
+
+def test_screens_skip_flawed_records():
+    """Structurally flawed positions keep their record_flaws message and
+    never touch screen state (a malformed record must not spend rate
+    budget or move an anchor)."""
+    cfg = AdmissionConfig(rate_limit=1, rate_window_s=100.0)
+    s = AdmissionScreens(cfg)
+    arr = _pack([(9, 1, 0, 10000, 5, b"S", b"c"),   # bad op
+                 (1, 1, 0, 10000, 5, b"S", b"c")])
+    flaws = oprec.record_flaws(arr)
+    assert flaws[0] is not None
+    got = s.screen(arr, flaws, now=0.0)
+    # The flawed record spent nothing: the clean one is op 1 of 1.
+    assert list(got) == [0, 0]
+    assert flaws[0] == "invalid op code (1=submit, 2=cancel, 3=amend)"
+
+
+def test_native_flaw_codes_match_python_messages():
+    """me_oprec_flaws (the C++ structural screen the gateway's native
+    batch path runs) agrees code-for-message with record_flaws over a
+    fuzzed mix of clean and flawed records."""
+    me = pytest.importorskip("matching_engine_tpu.native")
+    if not me.available():
+        pytest.skip("native library unavailable")
+    from matching_engine_tpu.domain.order import MAX_QUANTITY
+    from matching_engine_tpu.domain.price import MAX_DEVICE_PRICE_Q4
+
+    rng = random.Random(7)
+    rows = []
+    for _ in range(300):
+        op = rng.choice([0, 1, 1, 1, 2, 3, 9])
+        side = rng.choice([0, 1, 2, 7])
+        otype = rng.choice([0, 1, 2, 3, 4, 9])
+        price = rng.choice([0, -5, 100, MAX_DEVICE_PRICE_Q4])
+        qty = rng.choice([-1, 0, 1, 50, MAX_QUANTITY, MAX_QUANTITY + 1])
+        sym = rng.choice([b"", b"SYM"])
+        cid = rng.choice([b"", b"cli"])
+        oid = rng.choice([b"", b"OID-3"])
+        rows.append((op, side, otype, price, qty, sym, cid, oid))
+    arr = oprec.pack_records(rows)
+    # Flag fuzz: a few records with reserved flags set.
+    arr["flags"][::17] = 1
+    msgs = oprec.record_flaws(arr)
+    codes = me.oprec_flaw_codes(arr.tobytes(), len(arr),
+                                MAX_DEVICE_PRICE_Q4, MAX_QUANTITY)
+    for i, (msg, code) in enumerate(zip(msgs, codes)):
+        assert oprec.flaw_message(code, int(arr[i]["op"])) == msg, (
+            f"record {i} ({rows[i]}, flags={arr[i]['flags']}): "
+            f"python {msg!r} vs native code {code}")
+
+
+def test_screen_one_clamps_oversized_identifiers():
+    """Cancel/Amend reach screen_one with only a non-empty check behind
+    them: an id over the record box must screen by its box-sized prefix,
+    never raise out of the RPC (review fix, PR 16)."""
+    s = AdmissionScreens(AdmissionConfig(rate_limit=1, rate_window_s=100.0))
+    big = b"x" * 700  # > CLIENT_ID_BYTES
+    assert s.screen_one(2, 0, 0, 0, 0, b"", big) is None
+    # Same client (same clamped prefix): second op hits the rate wall.
+    assert s.screen_one(2, 0, 0, 0, 0, b"", big) == \
+        oprec.REASON_MESSAGES[R.REASON_RATE]
+    # Oversized symbols clamp too (band/STP key by the box).
+    assert s.screen_one(1, 1, 0, 10000, 5, b"s" * 99, b"other") is None

@@ -704,3 +704,60 @@ def test_complete_batch_truncation_sweeps_pending():
         channel.close()
         gw.shutdown()
         gw.destroy()
+
+
+def test_native_batch_path_through_gateway(tmp_path):
+    """The in-gateway native M_BATCH path (no python on the payload):
+    SubmitOrderBatch over the C++ edge converts + bulk-pushes records in
+    the gateway itself (me_oprec_flaws + me_oprec_to_gwop + ring_push_n)
+    and assembles the positional response from ring completions. The
+    structural screen's messages must match record_flaws' wording, and
+    the whole flow must behave exactly like the grpcio batch edge."""
+    from matching_engine_tpu.domain import oprec
+
+    hs = GwHarness(str(tmp_path / "gwbatch.db"))
+    try:
+        arr = oprec.pack_records([
+            (1, 1, 0, 10000, 5, b"BAT-0", b"alice", b""),
+            (1, 2, 0, 10000, 5, b"BAT-0", b"bob", b""),   # crosses alice
+            (1, 9, 0, 10000, 5, b"BAT-1", b"carol", b""),  # bad side
+            (2, 0, 0, 0, 0, b"", b"mallory", b"OID-99999"),  # unknown id
+        ])
+        resp = hs.stub.SubmitOrderBatch(
+            pb2.OrderBatchRequest(ops=oprec.encode_payload(arr)),
+            timeout=30)
+        assert resp.success
+        assert list(resp.ok) == [True, True, False, False]
+        # The C++ structural screen answers with record_flaws' words.
+        assert resp.error[2] == "side must be BUY or SELL"
+        assert resp.error[3] == "unknown order id"
+        assert resp.order_id[0].startswith("OID-")
+        assert resp.order_id[1].startswith("OID-")
+        # The matched pair landed durably, like any other edge.
+        hs.flush()
+        st = Storage(hs.db_path)
+        st.init()
+        try:
+            assert st.count("fills") >= 1
+        finally:
+            st.close()
+        # Whole-payload poisoning answers app-level, not transport.
+        bad = hs.stub.SubmitOrderBatch(
+            pb2.OrderBatchRequest(ops=b"NOTMAGIC" + b"\x00" * 384),
+            timeout=30)
+        assert not bad.success and "magic" in bad.error_message
+        # An amend through the batch verb reports remaining positionally.
+        sub = oprec.pack_records(
+            [(1, 1, 0, 10000, 9, b"BAT-2", b"dave", b"")])
+        r1 = hs.stub.SubmitOrderBatch(
+            pb2.OrderBatchRequest(ops=oprec.encode_payload(sub)),
+            timeout=30)
+        assert r1.ok[0]
+        am = oprec.pack_records(
+            [(3, 0, 0, 0, 4, b"", b"dave", r1.order_id[0].encode())])
+        r2 = hs.stub.SubmitOrderBatch(
+            pb2.OrderBatchRequest(ops=oprec.encode_payload(am)),
+            timeout=30)
+        assert r2.ok[0] and r2.remaining[0] == 4
+    finally:
+        hs.close()

@@ -1,0 +1,450 @@
+"""Shared-memory ingress: ring unit tests, server e2e, and the
+crash-safety kill-fuzz.
+
+The kill-fuzz is the contract test for the ring's commit-word protocol
+(native/me_shmring.cpp): a writer process is SIGKILLed at random points
+mid-record, over and over, and the consumer side must observe
+
+  - NO TORN admit: every admitted record is bit-exact the pure function
+    of its ring sequence the writer computes (a partial write surfacing
+    would corrupt the pattern);
+  - NO DUPLICATED admit: ring sequences are admitted at most once;
+  - NO LOST admit: every sequence the writer logged as committed (the
+    log write happens strictly AFTER the commit store) is admitted.
+
+The same fuzz body runs under ASan via ME_NATIVE_LIB (slow-marked),
+mirroring tests/test_build_native.py's sanitized smokes.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from matching_engine_tpu.domain import oprec
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "build_native.sh"
+
+
+def _native():
+    me = pytest.importorskip("matching_engine_tpu.native")
+    if not me.available():
+        pytest.skip("native library unavailable")
+    return me
+
+
+def pattern_bytes(seq: int) -> bytes:
+    """The kill-fuzz wire pattern: one submit record as a pure function
+    of its ring sequence. The writer subprocess carries a byte-identical
+    copy (_WRITER below — import-light so it boots in ~100ms; drift
+    between the two copies fails the fuzz loudly as a "torn" record)."""
+    import struct
+
+    sym = ("S%d" % (seq % 8)).encode()
+    cid = (b"w%08d" % seq) * 8  # 72 bytes of seq-derived client id
+    rec = bytearray(384)
+    struct.pack_into("<BBBBiq", rec, 0, 1, 1 + seq % 2, 0, 0,
+                     10000 + seq % 97, 1 + seq % 999)
+    struct.pack_into("<HHH", rec, 16, len(sym), len(cid), 0)
+    rec[24:24 + len(sym)] = sym
+    rec[88:88 + len(cid)] = cid
+    return bytes(rec)
+
+
+def pattern_record(seq: int) -> np.ndarray:
+    """pattern_bytes as a decoded record array (unit-test convenience;
+    also proves the pattern is a valid codec record)."""
+    arr = np.frombuffer(pattern_bytes(seq), dtype=oprec.OPREC_DTYPE).copy()
+    assert oprec.record_flaws(arr) == [None]
+    return arr
+
+
+# -- ring unit tests ---------------------------------------------------------
+
+
+def test_shm_roundtrip_inproc(tmp_path):
+    """The CI smoke: create/attach, push a payload, poll it back
+    bit-exact, answer positionally, read the response."""
+    me = _native()
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=64, resp_slots=64)
+    cli = me.ShmRing(path)
+    arr = oprec.pack_records([
+        (1, 1, 0, 10000, 5, b"AAPL", b"alice", b""),
+        (2, 0, 0, 0, 0, b"", b"bob", b"OID-7"),
+    ])
+    assert cli.push_payload(arr.tobytes(), 2) == 0
+    body, seqs, torn = srv.poll(16, 200_000, 5_000)
+    assert torn == 0 and seqs == [0, 1]
+    assert body == arr.tobytes()  # bit-exact through the ring
+    srv.respond([me.MeShmResp(seq=0, ok=1, kind=0, reason=0,
+                              order_id=b"OID-1", oid_len=5),
+                 me.MeShmResp(seq=1, ok=0, kind=1,
+                              reason=oprec.REASON_REJECTED)])
+    got = cli.resp_poll(8, 200_000)
+    assert got == [(0, True, 0, 0, "OID-1", 0),
+                   (1, False, 1, oprec.REASON_REJECTED, "", 0)]
+    stats = srv.stats()
+    assert stats["torn_recovered"] == 0 and stats["depth"] == 0
+    srv.shutdown()
+    assert cli.resp_poll(8, 100_000) is None  # shutdown drains to -2
+    cli.close()
+    srv.close()
+    assert not os.path.exists(path)  # owner unlinks
+
+
+def test_shm_backpressure_and_wrap(tmp_path):
+    """A full ring refuses the push (the writer backs off, nothing is
+    split); consuming frees the slots and the ring wraps cleanly."""
+    me = _native()
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=8, resp_slots=8)
+    cli = me.ShmRing(path)
+    one = pattern_record(0).tobytes()
+    for lap in range(5):
+        for i in range(8):
+            assert cli.push_payload(one, 1) == lap * 8 + i
+        assert cli.push_payload(one, 1) == -1  # full: refused whole
+        body, seqs, _ = srv.poll(16, 100_000, 5_000)
+        assert len(seqs) == 8
+        assert body == one * 8
+    cli.close()
+    srv.close()
+
+
+def test_shm_torn_slot_recovery(tmp_path):
+    """A claimed-but-never-committed slot (the SIGKILL window) is
+    recovered after the torn wait: later committed records flow, the
+    recovery is counted, and the dead sequence is never admitted."""
+    me = _native()
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=32, resp_slots=32)
+    cli = me.ShmRing(path)
+    assert cli.push_payload(pattern_record(0).tobytes(), 1) == 0
+    dead = cli.claim(1)  # claim, write half, never commit
+    assert dead == 1
+    cli.write_slot(dead, pattern_record(1).tobytes()[:100])
+    assert cli.push_payload(pattern_record(2).tobytes(), 1) == 2
+    body, seqs, torn = srv.poll(16, 100_000, 5_000)
+    assert seqs == [0]  # committed prefix stops at the gap
+    body, seqs, torn = srv.poll(16, 300_000, 10_000)
+    assert seqs == [2] and torn == 1
+    assert body == pattern_record(2).tobytes()
+    assert srv.stats()["torn_recovered"] == 1
+    cli.close()
+    srv.close()
+
+
+def test_shm_attach_refuses_garbage(tmp_path):
+    me = _native()
+    bad = tmp_path / "not-a-ring"
+    bad.write_bytes(b"\x00" * 8192)
+    with pytest.raises(RuntimeError):
+        me.ShmRing(str(bad))
+    with pytest.raises(RuntimeError):
+        me.ShmRing(str(tmp_path / "absent"))
+    # Caps must be powers of two.
+    with pytest.raises(RuntimeError):
+        me.ShmRing(str(tmp_path / "r2"), create=True, slots=100)
+
+
+# -- the kill-fuzz -----------------------------------------------------------
+
+_WRITER = r"""
+import random, struct, sys, time
+from matching_engine_tpu import native as me  # ctypes only, no numpy
+
+path, log_path, ready_path, seed = (sys.argv[1], sys.argv[2], sys.argv[3],
+                                    int(sys.argv[4]))
+
+def pattern_bytes(seq):  # byte-identical twin of the test module's copy
+    sym = ("S%d" % (seq % 8)).encode()
+    cid = (b"w%08d" % seq) * 8
+    rec = bytearray(384)
+    struct.pack_into("<BBBBiq", rec, 0, 1, 1 + seq % 2, 0, 0,
+                     10000 + seq % 97, 1 + seq % 999)
+    struct.pack_into("<HHH", rec, 16, len(sym), len(cid), 0)
+    rec[24:24 + len(sym)] = sym
+    rec[88:88 + len(cid)] = cid
+    return bytes(rec)
+
+rng = random.Random(seed)
+ring = me.ShmRing(path)
+log = open(log_path, "a", buffering=1)
+open(ready_path, "w").write("up")
+while True:
+    seq = ring.claim(1)
+    if seq == -2:
+        break
+    if seq < 0:
+        time.sleep(0.0002)
+        continue
+    rec = pattern_bytes(seq)
+    # Split write so SIGKILL can land mid-record; occasionally dawdle
+    # between the halves and before the commit to widen the window.
+    ring.write_slot(seq, rec[:192])
+    if rng.random() < 0.3:
+        time.sleep(rng.random() * 0.002)
+    ring.write_slot(seq, rec)
+    if rng.random() < 0.3:
+        time.sleep(rng.random() * 0.002)
+    ring.commit(seq)
+    # Logged strictly AFTER the commit store: the log understates
+    # commits (a kill between commit and log is legal), never overstates.
+    log.write("%d\n" % seq)
+    ring.wake()
+"""
+
+
+def run_kill_fuzz(tmp_path: Path, rounds: int, torn_wait_us: int = 20_000):
+    """The fuzz body (also driven under ASan via __main__): SIGKILL a
+    writer subprocess mid-record `rounds` times, polling throughout;
+    returns (admitted dict seq->bytes, logged committed seqs, torn)."""
+    from matching_engine_tpu import native as me
+
+    path = str(tmp_path / "ring")
+    log_path = str(tmp_path / "committed.log")
+    srv = me.ShmRing(path, create=True, slots=256, resp_slots=256)
+    admitted: dict[int, bytes] = {}
+    torn_total = 0
+
+    def drain(wait_us=1_000):
+        nonlocal torn_total
+        body, seqs, torn = srv.poll(256, wait_us, torn_wait_us)
+        torn_total += torn
+        if body:
+            for j, s in enumerate(seqs):
+                assert s not in admitted, f"DUPLICATED admit of seq {s}"
+                admitted[s] = body[j * 384:(j + 1) * 384]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    for r in range(rounds):
+        ready = tmp_path / f"ready.{r}"
+        w = subprocess.Popen([sys.executable, "-c", _WRITER, path,
+                              log_path, str(ready), str(r)], env=env,
+                             cwd=str(REPO),
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        # Wait for the writer to attach, let it run a moment, then kill
+        # mid-flight. The writer sleeps inside the claim->commit window
+        # 60% of the time, so kills land there often.
+        t0 = time.perf_counter()
+        while not ready.exists() and time.perf_counter() - t0 < 10.0:
+            drain()
+        deadline = time.perf_counter() + 0.01 + (r % 7) * 0.005
+        while time.perf_counter() < deadline:
+            drain()
+        os.kill(w.pid, signal.SIGKILL)
+        w.wait()
+        # Post-kill: recover any torn slot and drain the tail.
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 2.0:
+            before = (len(admitted), torn_total)
+            drain(wait_us=30_000)
+            depth = srv.stats()["depth"]
+            if depth == 0 and (len(admitted), torn_total) == before:
+                break
+    # Final drain until the ring is empty.
+    t0 = time.perf_counter()
+    while srv.stats()["depth"] > 0 and time.perf_counter() - t0 < 10.0:
+        drain(wait_us=50_000)
+    logged = [int(x) for x in
+              Path(log_path).read_text().split()] if \
+        Path(log_path).exists() else []
+    srv.shutdown()
+    srv.close()
+    return admitted, logged, torn_total
+
+
+def check_kill_fuzz(admitted, logged, torn):
+    # No lost admit: everything logged-committed was admitted.
+    missing = [s for s in logged if s not in admitted]
+    assert not missing, f"LOST admitted records: {missing[:10]}"
+    # No torn admit: every admitted record is bit-exact its pattern.
+    for s, rec in admitted.items():
+        assert rec == pattern_bytes(s), f"TORN record at seq {s}"
+    # The log may understate (kill between commit and log) but a healthy
+    # run admits at least everything logged; duplicates were asserted
+    # inline. Torn recoveries are expected (> 0 proves the fuzz bit).
+    assert len(admitted) >= len(logged)
+
+
+def test_shm_kill_fuzz_quick(tmp_path):
+    """10 mid-write SIGKILLs (the tier-1 version; the 100x contract run
+    is the slow-marked test below)."""
+    _native()
+    admitted, logged, torn = run_kill_fuzz(tmp_path, rounds=10)
+    check_kill_fuzz(admitted, logged, torn)
+    assert len(admitted) > 0
+
+
+@pytest.mark.slow
+def test_shm_kill_fuzz_100(tmp_path):
+    """The acceptance-criteria run: 100 mid-write client kills, no
+    torn/lost/duplicated admitted record."""
+    _native()
+    admitted, logged, torn = run_kill_fuzz(tmp_path, rounds=100)
+    check_kill_fuzz(admitted, logged, torn)
+    assert len(admitted) > 0
+    # Across 100 kills with 60% in-window dawdles, some kills must have
+    # landed between claim and commit — the recovery path genuinely ran.
+    assert torn > 0
+
+
+def _san_runtime(name: str) -> str | None:
+    try:
+        out = subprocess.run(["g++", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+    except OSError:
+        return None
+    p = out.stdout.strip()
+    return p if p and Path(p).exists() and "/" in p else None
+
+
+@pytest.mark.slow
+def test_shm_kill_fuzz_asan(tmp_path):
+    """The same fuzz with the ring library built under ASan (memory
+    errors in the torn-recovery / wraparound paths abort the run)."""
+    _native()
+    rt = _san_runtime("libasan.so")
+    if rt is None:
+        pytest.skip("no libasan runtime in this toolchain")
+    r = subprocess.run(
+        ["bash", str(SCRIPT), "--sanitize=address",
+         "--out-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    so = tmp_path / "libme_native.asan.so"
+    env = dict(os.environ, LD_PRELOAD=rt, ME_NATIVE_LIB=str(so),
+               ASAN_OPTIONS="detect_leaks=0", JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    run = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()), "20"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(REPO))
+    assert run.returncode == 0, (
+        f"asan kill-fuzz failed:\n{run.stdout[-1000:]}\n"
+        f"{run.stderr[-3000:]}")
+    assert "kill-fuzz OK" in run.stdout
+
+
+# -- server e2e --------------------------------------------------------------
+
+
+def _boot(tmp_path, **kw):
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.server.main import build_server
+
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=4)
+    server, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "db.sqlite"), cfg, log=False,
+        shm_ingress_path=str(tmp_path / "ingress.ring"), **kw)
+    server.start()
+    return server, port, parts
+
+
+def _push_and_collect(me, tmp_path, arr, n_expect, timeout_s=15.0):
+    cli = me.ShmRing(str(tmp_path / "ingress.ring"))
+    base = cli.push_payload(arr.tobytes(), len(arr))
+    assert base >= 0
+    resps = []
+    deadline = time.time() + timeout_s
+    while len(resps) < n_expect and time.time() < deadline:
+        got = cli.resp_poll(256, 200_000)
+        resps.extend(got or [])
+    cli.close()
+    assert len(resps) == n_expect, resps
+    return {r[0] - base: r for r in resps}
+
+
+def test_shm_e2e_lifecycle_and_store(tmp_path):
+    """Full server: submits, a resting cancel, an amend and a screened
+    reject through the shm ring; positional responses and the durable
+    store agree with the same flow's semantics."""
+    me = _native()
+    from matching_engine_tpu.server.admission import AdmissionConfig
+    from matching_engine_tpu.server.main import shutdown
+
+    server, _port, parts = _boot(
+        tmp_path, admission_cfg=AdmissionConfig(max_quantity=100))
+    try:
+        arr = oprec.pack_records([
+            (1, 1, 0, 10000, 5, b"S0", b"alice", b""),   # rests
+            (1, 2, 0, 10100, 7, b"S1", b"bob", b""),     # rests
+            (1, 1, 0, 10000, 500, b"S2", b"carol", b""),  # qty screen
+        ])
+        by = _push_and_collect(me, tmp_path, arr, 3)
+        assert by[0][1] and by[0][4].startswith("OID-")
+        assert by[1][1]
+        assert not by[2][1] and by[2][3] == oprec.REASON_QTY
+        oid_a, oid_b = by[0][4], by[1][4]
+        # Second wave: cancel alice's order (by the id the server just
+        # assigned), amend bob's down, and a bogus cancel.
+        arr2 = oprec.pack_records([
+            (2, 0, 0, 0, 0, b"", b"alice", oid_a.encode()),
+            (3, 0, 0, 0, 3, b"", b"bob", oid_b.encode()),
+            (2, 0, 0, 0, 0, b"", b"mallory", oid_b.encode()),
+        ])
+        by2 = _push_and_collect(me, tmp_path, arr2, 3)
+        assert by2[0][1] and by2[0][2] == 1          # canceled
+        assert by2[1][1] and by2[1][2] == 2 and by2[1][5] == 3  # amended
+        assert not by2[2][1] and by2[2][3] == oprec.REASON_REJECTED
+        # Store: exactly the two admitted orders, alice's CANCELED.
+        st = parts["storage"]
+        assert st.count("orders") == 2
+        counters, _gauges = parts["metrics"].snapshot()
+        assert counters["ingress_records"] == 6
+        assert counters["ingress_rejects"] == 2
+        assert counters["admission_qty_rejects"] == 1
+    finally:
+        shutdown(server, parts)
+    assert not os.path.exists(tmp_path / "ingress.ring")
+
+
+@pytest.mark.parametrize("mode", ["shards", "native"])
+def test_shm_e2e_routed_paths(tmp_path, mode):
+    """The poller rides the same lane routing as the batch RPCs: K=2
+    partitioned lanes and the C++ lane engine both serve the ring."""
+    me = _native()
+    from matching_engine_tpu.server.main import shutdown
+
+    kw = {"serve_shards": 2} if mode == "shards" else {"native_lanes": True}
+    server, _port, parts = _boot(tmp_path, **kw)
+    try:
+        rows = [(1, 1 + i % 2, 0, 10000 + 100 * (i % 3), 1 + i,
+                 f"S{i % 6}".encode(), b"cli-%d" % (i % 3), b"")
+                for i in range(24)]
+        arr = oprec.pack_records(rows)
+        by = _push_and_collect(me, tmp_path, arr, 24)
+        assert all(by[i][1] for i in range(24)), by
+        oids = [by[i][4] for i in range(24)]
+        assert len(set(oids)) == 24
+        # Every admitted submit landed in the store exactly once.
+        st = parts["storage"]
+        assert st.count("orders") == 24
+    finally:
+        shutdown(server, parts)
+
+
+if __name__ == "__main__":
+    # ASan driver: run the kill-fuzz body directly (the sanitized .so is
+    # selected by ME_NATIVE_LIB in the environment).
+    import tempfile
+
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    with tempfile.TemporaryDirectory() as td:
+        admitted, logged, torn = run_kill_fuzz(Path(td), rounds=rounds)
+        check_kill_fuzz(admitted, logged, torn)
+    print(f"kill-fuzz OK ({rounds} kills, {len(admitted)} admitted, "
+          f"{torn} torn recoveries)")

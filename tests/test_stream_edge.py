@@ -1,0 +1,138 @@
+"""SubmitOrderStream — the client-streaming ingest rung between
+batch RPCs and the shm ring (ROADMAP Open item 3b)."""
+
+from __future__ import annotations
+
+import grpc
+import pytest
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.proto import pb2
+from matching_engine_tpu.proto.rpc import MatchingEngineStub
+from matching_engine_tpu.server.main import build_server, shutdown
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=4)
+    srv, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "db.sqlite"), cfg, log=False)
+    srv.start()
+    stub = MatchingEngineStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+    yield stub, parts
+    shutdown(srv, parts)
+
+
+def _flow(n):
+    return oprec.pack_records(
+        [(1, 1 + i % 2, 0, 10000 + 100 * (i % 3), 1 + i,
+          f"S{i % 4}".encode(), b"c%d" % (i % 3), b"")
+         for i in range(n)])
+
+
+def test_stream_positional_parity_with_batch(server):
+    """The same records through one stream of 1-record chunks and
+    through one SubmitOrderBatch produce the same positional accept/
+    reject pattern and the same number of store rows."""
+    stub, parts = server
+    arr = _flow(12)
+    # Poison two positions structurally.
+    arr["side"][3] = 9
+    arr["quantity"][7] = 0
+    resp_b = stub.SubmitOrderBatch(
+        pb2.OrderBatchRequest(ops=oprec.encode_payload(arr)), timeout=30)
+    assert resp_b.success
+
+    def chunks():
+        for i in range(len(arr)):
+            yield pb2.OrderBatchRequest(ops=oprec.slice_payload(arr, i, 1))
+
+    resp_s = stub.SubmitOrderStream(chunks(), timeout=60)
+    assert resp_s.success
+    assert list(resp_s.ok) == list(resp_b.ok)
+    assert list(resp_s.error) == list(resp_b.error)
+    # Both runs admitted the same 10 submits -> 20 store rows.
+    assert parts["storage"].count("orders") == 20
+    counters, _ = parts["metrics"].snapshot()
+    assert counters["edge_streams"] == 1
+    assert counters["edge_stream_ops"] == 12
+
+
+def test_stream_chunked_multi_record(server):
+    """Chunks bigger than one record dispatch as they arrive; the one
+    response spans the whole stream in arrival order."""
+    stub, _parts = server
+    arr = _flow(10)
+
+    def chunks():
+        for start in range(0, 10, 4):
+            yield pb2.OrderBatchRequest(
+                ops=oprec.slice_payload(arr, start, 4))
+
+    resp = stub.SubmitOrderStream(chunks(), timeout=60)
+    assert resp.success and len(resp.ok) == 10 and all(resp.ok)
+    assert len({oid for oid in resp.order_id}) == 10
+
+
+def test_stream_codec_reject_fails_stream(server):
+    stub, _parts = server
+
+    def chunks():
+        yield pb2.OrderBatchRequest(
+            ops=oprec.slice_payload(_flow(2), 0, 2))
+        yield pb2.OrderBatchRequest(ops=b"NOTMAGIC" + b"\x00" * 384)
+
+    resp = stub.SubmitOrderStream(chunks(), timeout=60)
+    assert not resp.success
+    assert "magic" in resp.error_message
+
+
+def test_stream_respects_admission(tmp_path):
+    from matching_engine_tpu.server.admission import AdmissionConfig
+
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=4)
+    srv, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "db.sqlite"), cfg, log=False,
+        admission_cfg=AdmissionConfig(rate_limit=3, rate_window_s=60.0))
+    srv.start()
+    try:
+        stub = MatchingEngineStub(
+            grpc.insecure_channel(f"127.0.0.1:{port}"))
+        arr = oprec.pack_records(
+            [(1, 1, 0, 10000, 5, b"S0", b"one-client", b"")] * 5)
+
+        def chunks():
+            yield pb2.OrderBatchRequest(ops=oprec.encode_payload(arr))
+
+        resp = stub.SubmitOrderStream(chunks(), timeout=60)
+        assert resp.success
+        assert list(resp.ok) == [True] * 3 + [False] * 2
+        assert resp.error[3] == oprec.REASON_MESSAGES[oprec.REASON_RATE]
+        counters, _ = parts["metrics"].snapshot()
+        assert counters["admission_rate_rejects"] == 2
+    finally:
+        shutdown(srv, parts)
+
+
+def test_stream_on_standby_rejects(tmp_path):
+    """A read-only standby answers the stream app-level, like every
+    other mutation RPC."""
+    cfg = EngineConfig(num_symbols=8, capacity=32, batch=4)
+    srv, port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "db.sqlite"), cfg, log=False)
+    parts["service"].read_only = True
+    srv.start()
+    try:
+        stub = MatchingEngineStub(
+            grpc.insecure_channel(f"127.0.0.1:{port}"))
+
+        def chunks():
+            yield pb2.OrderBatchRequest(
+                ops=oprec.encode_payload(_flow(1)))
+
+        resp = stub.SubmitOrderStream(chunks(), timeout=30)
+        assert not resp.success
+        assert "read-only" in resp.error_message
+    finally:
+        shutdown(srv, parts)
