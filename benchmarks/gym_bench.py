@@ -1,0 +1,162 @@
+"""Many-venue gym throughput: agent-steps/s vs venue count (ISSUE 18).
+
+Sweeps the venue axis of gym/env.py — V independent heterogeneous
+markets (scenario programs cycle over venues; seeds differ per venue)
+stepped in ONE jit'd lax.scan — and reports sustained venue-steps/s and
+agent-steps/s per sweep point. Each point compiles its own program
+(V is a shape), so compile time is reported separately and the timed
+region is rollout-only, best-of --best-of repeats with the min..max
+spread alongside (the JAX-LOB comparison convention, arXiv:2308.13289:
+their headline is steps/s scaling vs parallel-env count on one device).
+
+An agent-step is one agent population member observing one venue step:
+  agent_steps/s = venues * steps * symbols * population / wall
+where population = mm_agents + momentum + noise + takers (the per-symbol
+agent head-count of the mix; mm_refresh re-quotes existing agents).
+
+Usage: python benchmarks/gym_bench.py --json-out out.json
+       [--venues 1,4,16,64,256,1024] [--steps 32] [--symbols 4]
+       [--scenario auction_day,flash_crash,bursts,hot_symbols]
+       [--kernel matrix] [--best-of 3] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--venues", default="1,4,16,64,256,1024",
+                   help="comma list of venue counts to sweep; each point "
+                        "is its own jit program (V is a shape)")
+    p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--symbols", type=int, default=4)
+    p.add_argument("--scenario",
+                   default="auction_day,flash_crash,bursts,hot_symbols",
+                   help="scenario programs cycled over the venue axis — "
+                        "the heterogeneity of the population (phase "
+                        "programs, zipf skew, episode lengths differ "
+                        "across venues)")
+    p.add_argument("--kernel", choices=("matrix", "sorted", "levels"),
+                   default="matrix")
+    p.add_argument("--best-of", type=int, default=3,
+                   help="timed rollout repeats per point; best is the "
+                        "headline, min..max spread rides along")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", required=True)
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    cache_dir = os.environ.get(
+        "ME_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    devices = jax.devices()
+    platform = devices[0].platform
+    backend_init_s = time.perf_counter() - t0
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.gym import VenueGym
+    from matching_engine_tpu.sim.scenarios import (
+        default_mix,
+        make_scenario,
+        recording_capacity,
+    )
+
+    names = [n for n in args.scenario.split(",") if n]
+    scens = [make_scenario(n) for n in names]
+    mix = default_mix(names[0])
+    population = mix.mm_agents + mix.momentum + mix.noise + mix.takers
+    cap = max(recording_capacity(mix, n) for n in names)
+    cfg = EngineConfig(num_symbols=args.symbols, capacity=cap,
+                       batch=mix.batch_for(), max_fills=1 << 15,
+                       kernel=args.kernel)
+
+    sweep = []
+    for v in [int(x) for x in args.venues.split(",") if x]:
+        env = VenueGym.from_scenarios(cfg, mix, v, scens)
+        state0, _ = env.reset([args.seed + i for i in range(v)])
+        # First rollout pays compilation; timed repeats replay the same
+        # initial state so every repeat measures identical work.
+        tc = time.perf_counter()
+        _, stats, _, _ = env.rollout(state0, args.steps)
+        jax.block_until_ready(stats.fills)
+        compile_s = time.perf_counter() - tc
+        walls = []
+        for _ in range(max(1, args.best_of)):
+            tr = time.perf_counter()
+            _, stats, _, _ = env.rollout(state0, args.steps)
+            jax.block_until_ready(stats.fills)
+            walls.append(time.perf_counter() - tr)
+        best = min(walls)
+        venue_steps = v * args.steps
+        sweep.append({
+            "venues": v,
+            "steps": args.steps,
+            "wall_s_best": round(best, 5),
+            "wall_s_spread": [round(min(walls), 5), round(max(walls), 5)],
+            "compile_s": round(compile_s, 2),
+            "venue_steps_per_s": round(venue_steps / best, 1),
+            "agent_steps_per_s": round(
+                venue_steps * args.symbols * population / best, 1),
+            "ops": int(np.asarray(stats.real_ops).sum()),
+            "fills": int(np.asarray(stats.fills).sum()),
+        })
+        print(f"[gym_bench] V={v}: {sweep[-1]['venue_steps_per_s']:.0f} "
+              f"venue-steps/s ({sweep[-1]['agent_steps_per_s']:.0f} "
+              f"agent-steps/s), compile {compile_s:.1f}s",
+              file=sys.stderr, flush=True)
+
+    try:
+        import subprocess
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        rev = "unknown"
+    peak = max(sweep, key=lambda r: r["agent_steps_per_s"])
+    out = {
+        "metric": "gym_agent_steps_per_s",
+        "value": peak["agent_steps_per_s"],
+        "unit": "agent-steps/sec",
+        "at_venues": peak["venues"],
+        "platform": platform,
+        "n_devices": len(devices),
+        "symbols": args.symbols,
+        "capacity": cap,
+        "batch": mix.batch_for(),
+        "kernel": args.kernel,
+        "population_per_symbol": population,
+        "scenarios": names,
+        "best_of": args.best_of,
+        "backend_init_s": round(backend_init_s, 1),
+        "sweep": sweep,
+        "git_rev": rev,
+    }
+    tmp = args.json_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, args.json_out)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,9 +56,10 @@ from matching_engine_tpu.analysis.lockorder import CallSite, Graph
 # layers, the feed, the audit stream, durable storage, the record
 # codecs, the engine harness, checkpointing, and the scenario-workload
 # recorder (sim/record.py — a recorded opfile is a replay artifact whose
-# bytes must be a pure function of (config, scenario, seed)).
+# bytes must be a pure function of (config, scenario, seed)), and the
+# many-venue gym (gym/ — a frozen episode is the same artifact class).
 REPLAY_SCAN_DIRS = ("server", "feed", "audit", "storage", "domain",
-                    "engine", "replication", "sim",
+                    "engine", "replication", "sim", "gym",
                     "utils/checkpoint.py")
 
 # Rule 2 — sources with no legitimate replay-path use (reachability).

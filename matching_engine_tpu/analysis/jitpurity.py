@@ -33,7 +33,7 @@ from matching_engine_tpu.analysis.common import (
     site,
 )
 
-JIT_SCAN_DIRS = ("engine", "parallel", "sim")
+JIT_SCAN_DIRS = ("engine", "parallel", "sim", "gym")
 
 # Pytrees whose construction feeds donated buffers: duplicate argument
 # expressions alias what donation will invalidate.

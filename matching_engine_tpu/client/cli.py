@@ -46,6 +46,9 @@ USAGE = (
     "   or: client simulate --scenario NAME --out FILE [--steps N]\n"
     "                 [--seed N] [--symbols N] [--serve-shards K]\n"
     "                 [--summary-json FILE]\n"
+    "   or: client gym-rollout --venues V --scenario NAME[,NAME...]\n"
+    "                 [--steps N] [--seed N] [--symbols N] [--kernel K]\n"
+    "                 [--freeze VENUE --out FILE] [--summary-json FILE]\n"
     "   or: client promote <addr>"
 )
 
@@ -876,8 +879,11 @@ def _simulate(argv: list[str]) -> int:
         "ops": manifest["ops"], "steps": manifest["steps"],
         "symbols": manifest["symbols"],
         "per_class_ops": manifest["per_class_ops"],
+        # Per-phase ground truth (fills/volume/uncross) rides along so a
+        # replay driver can reconcile phase by phase, not just end-state.
         "phases": [{k: p[k] for k in ("kind", "steps", "start_record",
-                                      "end_record")}
+                                      "end_record", "fills", "volume",
+                                      "uncross", "uncross_executed")}
                    for p in manifest["phases"]],
         "min_cancel_gap": manifest["min_cancel_gap"],
         "sim_fills": manifest["sim_fills"],
@@ -892,6 +898,143 @@ def _simulate(argv: list[str]) -> int:
         with open(summary_json, "w") as f:
             json.dump(summary, f, indent=1)
     return 0 if manifest["ops"] > 0 else 3
+
+
+def _gym_rollout(argv: list[str]) -> int:
+    """Roll the many-venue gym (gym/env.py) serverless: V venues in one
+    jit'd scan, scenario programs cycling over the venue axis, per-venue
+    seeds `--seed + v`. `--steps` defaults to one full episode of the
+    longest scenario (auto-reset covers shorter venues). `--freeze V
+    --out FILE` additionally freezes venue V's first episode into a
+    replayable workload artifact (gym/episode.py) — the same opfile +
+    manifest pair `client simulate` writes, replayable through
+    `submit-batch` with exact fill reconciliation. Exit 1 on usage, 3 on
+    a rollout that produced no ops."""
+    import json
+
+    scenario_arg = out = summary_json = None
+    steps = freeze = None
+    venues, seed, symbols, kernel = 4, 0, 16, None
+    it = iter(argv)
+    try:
+        for a in it:
+            if a == "--venues":
+                venues = int(next(it))
+            elif a == "--scenario":
+                scenario_arg = next(it)
+            elif a == "--steps":
+                steps = int(next(it))
+            elif a == "--seed":
+                seed = int(next(it))
+            elif a == "--symbols":
+                symbols = int(next(it))
+            elif a == "--kernel":
+                kernel = next(it)
+            elif a == "--freeze":
+                freeze = int(next(it))
+            elif a == "--out":
+                out = next(it)
+            elif a == "--summary-json":
+                summary_json = next(it)
+            else:
+                print(USAGE, file=sys.stderr)
+                return 1
+    except (StopIteration, ValueError):
+        print(USAGE, file=sys.stderr)
+        return 1
+    if not scenario_arg or venues < 1 or symbols < 1:
+        print(USAGE, file=sys.stderr)
+        return 1
+    if (freeze is None) != (out is None) \
+            or (freeze is not None and not 0 <= freeze < venues):
+        print(USAGE, file=sys.stderr)
+        return 1
+
+    import numpy as np
+
+    from matching_engine_tpu.engine.book import EngineConfig
+    from matching_engine_tpu.gym import VenueGym, freeze_episode
+    from matching_engine_tpu.sim.scenarios import (
+        default_mix,
+        make_scenario,
+        recording_capacity,
+        recording_kernel,
+    )
+    from matching_engine_tpu.utils.metrics import Metrics
+
+    names = [n for n in scenario_arg.split(",") if n]
+    try:
+        scens = [make_scenario(n, steps=steps) for n in names]
+    except ValueError as e:
+        print(f"[client] {e}", file=sys.stderr)
+        return 1
+    # One engine config for all venues: the recording sizing of the
+    # heaviest scenario in the cycle (venues differ by program/seed/
+    # population, not capacity — capacity is jit-static).
+    mix = default_mix(names[0])
+    rcap = max(recording_capacity(mix, n) for n in names)
+    cfg = EngineConfig(num_symbols=symbols, capacity=rcap,
+                       batch=mix.batch_for(), max_fills=1 << 15,
+                       kernel=kernel or recording_kernel(rcap))
+    metrics = Metrics()
+    record = (freeze,) if freeze is not None else ()
+    try:
+        env = VenueGym.from_scenarios(cfg, mix, venues, scens,
+                                      record=record)
+        state, _obs = env.reset([seed + v for v in range(venues)])
+        ep_len = np.asarray(env.controls.ep_len)
+        run_steps = steps if steps is not None else int(ep_len.max())
+        state, stats, rec, _obs = env.rollout(state, run_steps,
+                                              metrics=metrics)
+    except (RuntimeError, ValueError) as e:
+        print(f"[client] gym-rollout failed: {e}", file=sys.stderr)
+        return 3
+    ops = int(np.asarray(stats.real_ops).sum())
+    summary = {
+        "venues": venues, "steps": run_steps,
+        "scenarios": names, "kernel": cfg.kernel, "seed": seed,
+        "symbols": symbols, "ops": ops,
+        "venue_steps": venues * run_steps,
+        "episodes_done": int(np.asarray(stats.done).sum()),
+        "fills": [int(x) for x in np.asarray(stats.fills).sum(axis=0)],
+        "volume": [int(x) for x in np.asarray(stats.volume).sum(axis=0)],
+        "uncrossed": int(np.asarray(stats.uncrossed).sum()),
+    }
+    if freeze is not None:
+        scen_v = scens[freeze % len(scens)]
+        if run_steps < int(ep_len[freeze]):
+            print(f"[client] gym-rollout failed: --steps {run_steps} < "
+                  f"venue {freeze} episode length {int(ep_len[freeze])} "
+                  f"(cannot freeze a partial episode)", file=sys.stderr)
+            return 3
+        try:
+            man = freeze_episode(env.spec, scen_v, freeze, rec, stats,
+                                 out, seed=seed + freeze, metrics=metrics)
+        except (RuntimeError, ValueError, OSError) as e:
+            print(f"[client] gym-rollout freeze failed: {e}",
+                  file=sys.stderr)
+            return 3
+        summary["frozen"] = {
+            "out": out, "venue": freeze, "ops": man["ops"],
+            "sim_fills": man["sim_fills"],
+            "sim_volume": man["sim_volume"],
+            "min_cancel_gap": man["min_cancel_gap"],
+            "phases": [{k: p[k] for k in ("kind", "steps", "fills",
+                                          "volume", "uncross",
+                                          "uncross_executed")}
+                       for p in man["phases"]],
+        }
+    print(f"[client] gym-rollout: {venues} venue(s) x {run_steps} steps "
+          f"({cfg.kernel}), {ops} ops, "
+          f"{summary['episodes_done']} episode(s) done"
+          + (f", froze venue {freeze} -> {out}" if freeze is not None
+             else ""),
+          file=sys.stderr, flush=True)
+    print(json.dumps(summary))
+    if summary_json:
+        with open(summary_json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if ops > 0 else 3
 
 
 def _promote(addr: str) -> int:
@@ -958,6 +1101,8 @@ def _dispatch(argv: list[str]) -> int:
             return _submit_shm(argv[1:])
         if len(argv) >= 3 and argv[0] == "simulate":
             return _simulate(argv[1:])
+        if len(argv) >= 3 and argv[0] == "gym-rollout":
+            return _gym_rollout(argv[1:])
         if len(argv) >= 2 and argv[0] == "audit":
             return _audit(argv[1:])
         if len(argv) == 8:

@@ -298,13 +298,15 @@ def _top_of_book(price, qty, best_is_max):
 
 def apply_halt_mask(orders: OrderBatch, halted) -> OrderBatch:
     """Trading-halt hook: suppress every op of the halted symbols
-    (`halted` is a [S] bool mask) to OP_NOOP. The kernel ignores NOOP
-    rows, so a halted symbol's book stands frozen — no submits, no
+    (`halted` is a [S] bool mask — or [V, S] when the orders carry a
+    leading venue axis, engine/venues.py) to OP_NOOP. The kernel ignores
+    NOOP rows, so a halted symbol's book stands frozen — no submits, no
     cancels, no fills — while the other symbols keep trading in the same
     dispatch. This is the per-symbol halt primitive the scenario sim
     (sim/scenarios.py) drives for halt phases, hot-symbol gating, and
     burst off-periods; pure jnp, safe inside jit/scan bodies."""
-    return orders._replace(op=jnp.where(halted[:, None], OP_NOOP, orders.op))
+    return orders._replace(
+        op=jnp.where(halted[..., None], OP_NOOP, orders.op))
 
 
 def engine_step_core(cfg: EngineConfig, book: BookBatch, orders: OrderBatch):

@@ -1,0 +1,83 @@
+"""Venue axis: every engine primitive, vmapped over V independent books.
+
+The kernels in this package step ONE venue — a [S, CAP] book batch per
+formulation. The many-venue gym (gym/env.py, ROADMAP Open item 5) steps
+V independent venues `[V, S, CAP]` in one jit'd scan, JAX-LOB style
+(arXiv:2308.13289): same compiled program, a leading venue axis on every
+buffer. This module is the engine-side seam — thin `jax.vmap` wrappers
+over the existing single-venue primitives, so the venue axis can never
+drift from the single-venue semantics (the gym's parity oracle is
+literally "V-venue run == V single-venue runs, bit for bit", pinned by
+tests/test_gym.py on all three kernel formulations).
+
+Everything here is pure jnp/vmap — safe inside jit/scan bodies, no jit
+roots of its own (the gym owns the jit boundary and its donation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from matching_engine_tpu.engine.auction import (
+    apply_uncross,
+    uncross_and_records,
+)
+from matching_engine_tpu.engine.book import BookBatch, EngineConfig, OrderBatch
+from matching_engine_tpu.engine.kernel import _top_of_book, engine_step_core
+
+I32 = jnp.int32
+
+
+def venue_step_core(cfg: EngineConfig, books: BookBatch,
+                    orders: OrderBatch):
+    """One match pass for every venue: engine_step_core vmapped over the
+    leading venue axis. `books` fields are [V, S, CAP] ([V, S] for
+    next_seq), `orders` fields [V, S, B]. Returns (new_books, raw) with
+    raw = (status, filled, remaining, f_oid, f_qty, f_price), each
+    carrying the [V] axis in front of the single-venue shapes. Dispatches
+    on cfg.kernel exactly like the single-venue entry — all three
+    formulations are venue-vmappable (pure jnp inside)."""
+    return jax.vmap(lambda b, o: engine_step_core(cfg, b, o))(books, orders)
+
+
+def venue_top_of_book(books: BookBatch):
+    """Per-venue TOB: (best_bid, bid_size, best_ask, ask_size), [V, S]
+    each (0 where the side is empty — the single-venue masking rule)."""
+    bb, bs = jax.vmap(lambda p, q: _top_of_book(p, q, True))(
+        books.bid_price, books.bid_qty)
+    ba, az = jax.vmap(lambda p, q: _top_of_book(p, q, False))(
+        books.ask_price, books.ask_qty)
+    return bb, bs, ba, az
+
+
+def venue_uncross(cfg: EngineConfig, books: BookBatch, mask: jax.Array):
+    """Call-auction uncross, one venue at a time under vmap: `mask` is
+    [V, S] bool (which symbols of which venues uncross this step — the
+    gym raises a whole venue's row at its call phases' closing steps).
+
+    Returns (new_books, p_star [V, S], exec_hi [V, S], exec_lo [V, S],
+    aborted [V]). The abort rule is PER VENUE and matches
+    auction.auction_step exactly: if a venue's bilateral record count
+    would overflow cfg.max_fills, that venue applies NOTHING (books
+    stand, exec/p_star zeroed) while the other venues uncross normally —
+    bit-identical to running auction_step per venue. Executed volume
+    comes back as base-2^15 limbs (exec_hi << 15) + exec_lo like the
+    single-venue AuctionOutput; recombine on host at int64."""
+    (fill_b, fill_a, p_star, exec_hi, exec_lo, _rt, _rm, _rq,
+     rec_counts) = jax.vmap(
+        lambda b, m: uncross_and_records(cfg, b, m))(books, mask)
+    total = jnp.sum(rec_counts, axis=1)
+    aborted = total > cfg.max_fills
+    apply = mask & jnp.logical_not(aborted)[:, None]
+    new_books = jax.vmap(
+        lambda b, fb, fa, ap: apply_uncross(
+            b, fb, fa, ap, kernel=cfg.kernel, levels=cfg.levels))(
+        books, fill_b, fill_a, apply)
+    ok = jnp.logical_not(aborted)[:, None]
+    zero = jnp.zeros((), I32)
+    return (new_books,
+            jnp.where(ok, p_star, zero),
+            jnp.where(ok, exec_hi, zero),
+            jnp.where(ok, exec_lo, zero),
+            aborted)

@@ -1,0 +1,33 @@
+"""Many-venue market gym: V independent venues in one jit'd scan.
+
+ROADMAP Open item 5 ("Simulation as a product"). See gym/env.py for the
+step/reset environment and gym/episode.py for freezing an episode into a
+replayable workload artifact.
+"""
+
+from matching_engine_tpu.gym.episode import episode_roles, freeze_episode
+from matching_engine_tpu.gym.env import (
+    GymObs,
+    GymSpec,
+    GymState,
+    GymStepStats,
+    VenueControls,
+    VenueGym,
+    build_controls,
+    restore_state,
+    save_state,
+)
+
+__all__ = [
+    "GymObs",
+    "GymSpec",
+    "GymState",
+    "GymStepStats",
+    "VenueControls",
+    "VenueGym",
+    "build_controls",
+    "episode_roles",
+    "freeze_episode",
+    "restore_state",
+    "save_state",
+]

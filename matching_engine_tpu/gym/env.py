@@ -1,0 +1,595 @@
+"""Vmapped many-venue simulation gym: step/reset over [V] markets.
+
+JAX-LOB (arXiv:2308.13289) demonstrated that thousands of *parallel*
+limit-order-book environments on one accelerator are what unlock
+RL-scale trading research. This module lifts the venue axis over the
+whole sim stack: V independent venues — each a full [S, CAP] book batch
+with its own heterogeneous agent population — step together inside ONE
+jit'd program (and `rollout` runs T such steps in one lax.scan), behind
+a gym-style step/reset API.
+
+Heterogeneity across the V axis (all traced, one compiled program):
+
+- **seeds**: per-venue PRNG bases. Venue v's stream is
+  `fold_in(PRNGKey(seed_v + episode), symbol)` — exactly the
+  single-venue scenario derivation at episode 0, so a V-venue rollout is
+  bit-identical to V independent `run_scenario` runs (the parity oracle,
+  tests/test_gym.py), and changing venue w's seed can never perturb
+  venue v (PRNG independence, pinned).
+- **phase programs**: each venue runs its own Scenario. Phase kinds /
+  burst windows / shock schedules compile to [V, T] control tables
+  (build_controls) indexed by each venue's own episode step, so venues
+  in different phases coexist in one step: one venue holds a call
+  auction while another is halted and a third trades continuously.
+- **Zipf mixes**: per-venue hot-symbol skew ([V, S] activity weights).
+- **class gates**: per-venue agent fire-probability overrides
+  (sim/agents.ClassGates) — venues can run noisier or more aggressive
+  populations than their neighbours without recompiling.
+
+Episode lifecycle: a venue's episode is its scenario program, length
+`ep_len[v]` steps. When a venue's episode ends it AUTO-RESETS in the
+same step (fresh book, fresh agent state, next episode's seed =
+`seed_v + episode`) — the returned observation is already the reset
+venue's; `done[v]` marks the boundary. Episode boundaries are pure step
+arithmetic: NO wall clock enters the state, the artifacts, or the
+checkpoints (save_state/restore_state write step-indexed state only via
+the checkpoint machinery's atomic writer), so a restored run continues
+bit-identically — the determinism analyzer scans this module and there
+is nothing to waive.
+
+Observations are TOB/depth slices per venue ([V, S] best bid/ask,
+sizes, resting depth per side); actions are oprec-style order lanes
+`[V, S, action_slots, 7]` (book.batch_from_lanes columns) injected
+alongside the agent flow each step — they ride the same engine
+dispatch, the same call-period OP_REST mapping and the same halt gating
+as agent orders. Any interesting episode freezes into a replayable
+opfile + manifest via gym/episode.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from matching_engine_tpu.engine.book import (
+    BookBatch,
+    EngineConfig,
+    batch_from_lanes,
+)
+from matching_engine_tpu.engine.kernel import (
+    LIMIT,
+    OP_REST,
+    OP_SUBMIT,
+    apply_halt_mask,
+)
+from matching_engine_tpu.engine.venues import (
+    venue_step_core,
+    venue_top_of_book,
+    venue_uncross,
+)
+from matching_engine_tpu.sim.agents import (
+    AgentMix,
+    AgentState,
+    ClassGates,
+    agent_orders,
+    init_agents,
+    observe_market,
+)
+from matching_engine_tpu.sim.scenarios import Scenario, zipf_weights_q15
+
+I32 = jnp.int32
+
+# Recommended base for caller-assigned action-lane order ids: far above
+# any oid the agent populations can reach in an episode (next_oid grows
+# by the batch width per active step), so injected orders never collide
+# with agent orders in the per-symbol id space. The episode freezer
+# renumbers both through one map, so this is a convention, not a
+# correctness requirement.
+ACTION_OID_BASE = 1 << 28
+
+
+@dataclasses.dataclass(frozen=True)
+class GymSpec:
+    """Static gym configuration (hashable; jit-static).
+
+    cfg is the PER-VENUE engine config ([S, CAP] books; untiered — the
+    venue axis is the scaling dimension here); mix is the shared batch
+    LAYOUT (lane counts are shape-static; per-venue behaviour varies
+    through traced controls, not through the layout)."""
+
+    cfg: EngineConfig
+    mix: AgentMix
+    venues: int
+    action_slots: int = 0
+    # Static auction switch: when NO venue's program contains a call
+    # phase the compiled step omits the uncross branch entirely.
+    has_auction: bool = False
+    # Venues whose per-step order lanes the step/rollout additionally
+    # returns (the episode freezer's capture hook). Keep this small —
+    # each recorded venue stacks [T, S, B + action_slots, 7] on host.
+    record: tuple[int, ...] = ()
+
+    def __post_init__(self):
+        assert self.venues >= 1
+        assert self.cfg.batch == self.mix.batch_for(), (
+            f"EngineConfig.batch must be {self.mix.batch_for()} "
+            f"for this AgentMix")
+        assert not self.cfg.tiers, "gym venues are untiered"
+        assert all(0 <= v < self.venues for v in self.record)
+
+    def lanes(self) -> int:
+        """Engine batch width per symbol: agent lanes + action slots."""
+        return self.mix.batch_for() + self.action_slots
+
+    def engine_cfg(self) -> EngineConfig:
+        """The per-venue engine config the kernels actually step (batch
+        widened by the action slots)."""
+        if self.action_slots == 0:
+            return self.cfg
+        return dataclasses.replace(self.cfg, batch=self.lanes())
+
+
+class VenueControls(NamedTuple):
+    """Per-venue episode programs as device tables ([V, T] indexed by
+    each venue's own episode step; T = max episode length). Built once
+    per env (build_controls) from per-venue Scenarios — deterministic
+    numpy, part of the gym's reproducible identity."""
+
+    call: jax.Array       # [V, T] bool — call period (auction phase)
+    halt: jax.Array       # [V, T] bool — trading halt
+    burst_on: jax.Array   # [V, T] bool — burst-window arrival gate
+    shock: jax.Array      # [V, T] i32 — per-step fair-value decrement
+    sell_bias: jax.Array  # [V, T] bool — shock window (takers all SELL)
+    uncross: jax.Array    # [V, T] bool — call phase closes after step t
+    ep_len: jax.Array     # [V] i32 episode length (scenario total)
+    zipf_w: jax.Array     # [V, S] i32 Q15 per-symbol activity weights
+    noise_p: jax.Array    # [V] i32 per-venue class-gate overrides
+    mom_p: jax.Array      # [V] i32
+    taker_p: jax.Array    # [V] i32
+
+
+class GymState(NamedTuple):
+    """Device-resident state of all V venues."""
+
+    books: BookBatch      # fields [V, S, CAP] ([V, S] next_seq)
+    agents: AgentState    # fields [V, ...]
+    ep_step: jax.Array    # [V] i32 step within the current episode
+    episode: jax.Array    # [V] i32 episode counter
+    seed: jax.Array       # [V] i32 per-venue base seed
+
+
+class GymObs(NamedTuple):
+    """Per-venue market observation (all [V, S] unless noted)."""
+
+    best_bid: jax.Array
+    bid_size: jax.Array
+    best_ask: jax.Array
+    ask_size: jax.Array
+    depth_bid: jax.Array  # resting order count, bid side
+    depth_ask: jax.Array  # resting order count, ask side
+    ep_step: jax.Array    # [V]
+    episode: jax.Array    # [V]
+    done: jax.Array       # [V] bool — episode ended (and auto-reset)
+
+
+class GymStepStats(NamedTuple):
+    """Per-venue step ground truth (all [V]). Continuous fills and
+    call-auction executions are reported separately; auction volume
+    comes back as base-2^15 limbs like the engine's AuctionOutput
+    (recombine `(hi << 15) + lo` at int64 on host)."""
+
+    real_ops: jax.Array
+    fills: jax.Array
+    volume: jax.Array
+    uncrossed: jax.Array     # bool — this step closed a call phase
+    uncross_hi: jax.Array
+    uncross_lo: jax.Array
+    uncross_aborted: jax.Array
+    done: jax.Array
+
+
+def build_controls(spec: GymSpec, scenarios, *, gates=None,
+                   zipf_alpha_q8=None) -> VenueControls:
+    """Compile per-venue Scenario programs into device control tables.
+
+    `scenarios` is one Scenario per venue (a shorter list is cycled —
+    the cheap way to spread a catalogue across many venues). Optional
+    per-venue overrides: `gates` (list of ClassGates or None entries)
+    and `zipf_alpha_q8` (list of ints; None entries fall back to the
+    venue scenario's own zipf_alpha_q8). The table semantics replicate
+    scenarios._phase_impl exactly — same burst/shock window arithmetic,
+    same call/halt flags — so a venue's trajectory is bit-identical to
+    run_scenario on its program."""
+    v, s = spec.venues, spec.cfg.num_symbols
+    progs = [scenarios[i % len(scenarios)] for i in range(v)]
+    assert all(isinstance(p, Scenario) for p in progs)
+    t_max = max(p.total_steps() for p in progs)
+
+    call = np.zeros((v, t_max), dtype=bool)
+    halt = np.zeros((v, t_max), dtype=bool)
+    burst = np.ones((v, t_max), dtype=bool)
+    shock = np.zeros((v, t_max), dtype=np.int32)
+    bias = np.zeros((v, t_max), dtype=bool)
+    uncx = np.zeros((v, t_max), dtype=bool)
+    ep_len = np.zeros((v,), dtype=np.int32)
+    zipf = np.zeros((v, s), dtype=np.int32)
+
+    for i, prog in enumerate(progs):
+        start = 0
+        for ph in prog.phases:
+            end = start + ph.steps
+            if ph.kind == "auction":
+                call[i, start:end] = True
+                uncx[i, end - 1] = True
+            elif ph.kind == "halt":
+                halt[i, start:end] = True
+            t = np.arange(ph.steps)
+            if ph.burst_period:
+                burst[i, start:end] = (t % ph.burst_period) < ph.burst_on
+            if ph.shock_len:
+                in_shock = (t >= ph.shock_start) & (
+                    t < ph.shock_start + ph.shock_len)
+                shock[i, start:end] = np.where(in_shock, ph.shock_bp, 0)
+                bias[i, start:end] = in_shock
+            start = end
+        ep_len[i] = start
+        alpha = prog.zipf_alpha_q8
+        if zipf_alpha_q8 is not None and zipf_alpha_q8[i] is not None:
+            alpha = zipf_alpha_q8[i]
+        zipf[i] = zipf_weights_q15(s, alpha)
+
+    if spec.has_auction != bool(uncx.any()):
+        raise ValueError(
+            f"GymSpec.has_auction={spec.has_auction} but the venue "
+            f"programs {'do' if uncx.any() else 'do not'} contain call "
+            f"phases — the static switch must match the programs")
+
+    mix = spec.mix
+    g_nz = np.full((v,), mix.noise_p, dtype=np.int32)
+    g_mo = np.full((v,), mix.mom_p, dtype=np.int32)
+    g_tk = np.full((v,), mix.taker_p, dtype=np.int32)
+    if gates is not None:
+        for i, g in enumerate(gates):
+            if g is not None:
+                g_nz[i], g_mo[i], g_tk[i] = g.noise_p, g.mom_p, g.taker_p
+
+    return VenueControls(
+        call=jnp.asarray(call), halt=jnp.asarray(halt),
+        burst_on=jnp.asarray(burst), shock=jnp.asarray(shock),
+        sell_bias=jnp.asarray(bias), uncross=jnp.asarray(uncx),
+        ep_len=jnp.asarray(ep_len), zipf_w=jnp.asarray(zipf),
+        noise_p=jnp.asarray(g_nz), mom_p=jnp.asarray(g_mo),
+        taker_p=jnp.asarray(g_tk),
+    )
+
+
+def _init_books(spec: GymSpec) -> BookBatch:
+    v, s, c = spec.venues, spec.cfg.num_symbols, spec.cfg.capacity
+
+    # Distinct buffers per field (engine/book.py init_book rule).
+    def z():
+        return jnp.zeros((v, s, c), dtype=I32)
+
+    return BookBatch(
+        bid_price=z(), bid_qty=z(), bid_oid=z(), bid_seq=z(), bid_owner=z(),
+        ask_price=z(), ask_qty=z(), ask_oid=z(), ask_seq=z(), ask_owner=z(),
+        next_seq=jnp.zeros((v, s), dtype=I32),
+    )
+
+
+def _reset_impl(spec: GymSpec, seeds: jax.Array) -> GymState:
+    agents = jax.vmap(
+        lambda sd: init_agents(spec.cfg, spec.mix, sd))(seeds)
+    v = spec.venues
+    return GymState(
+        books=_init_books(spec),
+        agents=agents,
+        ep_step=jnp.zeros((v,), I32),
+        episode=jnp.zeros((v,), I32),
+        seed=seeds.astype(I32),
+    )
+
+
+def _obs_of(spec: GymSpec, state: GymState, done) -> GymObs:
+    bb, bs, ba, az = venue_top_of_book(state.books)
+    return GymObs(
+        best_bid=bb, bid_size=bs, best_ask=ba, ask_size=az,
+        depth_bid=jnp.sum(state.books.bid_qty > 0, axis=2).astype(I32),
+        depth_ask=jnp.sum(state.books.ask_qty > 0, axis=2).astype(I32),
+        ep_step=state.ep_step, episode=state.episode, done=done,
+    )
+
+
+def _step_impl(spec: GymSpec, state: GymState, controls: VenueControls,
+               actions: jax.Array):
+    """One gym step for all venues. Returns (state, obs, stats, rec)
+    where rec is the recorded venues' consumed order lanes
+    [R, S, lanes, 7] (R = len(spec.record); zero-size when none)."""
+    cfg, mix, v = spec.cfg, spec.mix, spec.venues
+    s = cfg.num_symbols
+    t = state.ep_step
+
+    def at_t(tab):
+        return jnp.take_along_axis(tab, t[:, None], axis=1)[:, 0]
+
+    call = at_t(controls.call)
+    halt = at_t(controls.halt)
+    burst = at_t(controls.burst_on)
+    shock = at_t(controls.shock)
+    bias = at_t(controls.sell_bias)
+    gates = ClassGates(noise_p=controls.noise_p, mom_p=controls.mom_p,
+                       taker_p=controls.taker_p)
+
+    def one_venue(astate, zw, c_, h_, b_, sh_, sb_, g):
+        return agent_orders(cfg, mix, astate, zw, call_mode=c_, halt=h_,
+                            burst_on=b_, shock=sh_, sell_bias=sb_, gates=g)
+
+    agents, orders = jax.vmap(one_venue)(
+        state.agents, controls.zipf_w, call, halt, burst, shock, bias,
+        gates)
+
+    if spec.action_slots:
+        act = batch_from_lanes(actions)
+        # Injected flow obeys the same venue state machinery as agent
+        # flow: nothing is admitted during a halt.
+        act = apply_halt_mask(
+            act, jnp.broadcast_to(halt[:, None], (v, s)))
+        orders = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=2), orders, act)
+
+    # Call period: LIMIT submits rest without matching — the serving
+    # stack's auction-mode mapping, applied to agent AND action flow.
+    orders = orders._replace(op=jnp.where(
+        call[:, None, None] & (orders.op == OP_SUBMIT)
+        & (orders.otype == LIMIT), OP_REST, orders.op))
+
+    books, raw = venue_step_core(spec.engine_cfg(), state.books, orders)
+    _status, _filled, _remaining, _f_oid, f_qty, _f_price = raw
+
+    # Close the momentum loop on the post-match TOB (the single-venue
+    # scan observes BEFORE any phase-end uncross; same here).
+    bb, _, ba, _ = venue_top_of_book(books)
+    agents = jax.vmap(
+        lambda st, b1, a1: observe_market(mix, st, b1, a1))(agents, bb, ba)
+
+    fills = jnp.sum(f_qty > 0, axis=(1, 2, 3)).astype(I32)
+    volume = jnp.sum(f_qty, axis=(1, 2, 3)).astype(I32)
+    real_ops = jnp.sum(orders.op != 0, axis=(1, 2)).astype(I32)
+
+    if spec.has_auction:
+        uncx = at_t(controls.uncross)
+        mask = jnp.broadcast_to(uncx[:, None], (v, s))
+
+        def do_uncross(bks):
+            return venue_uncross(spec.engine_cfg(), bks, mask)
+
+        def no_uncross(bks):
+            zvs = jnp.zeros((v, s), I32)
+            return (bks, zvs, zvs, zvs, jnp.zeros((v,), bool))
+
+        books, _p_star, ex_hi, ex_lo, aborted = jax.lax.cond(
+            jnp.any(uncx), do_uncross, no_uncross, books)
+        un_hi = jnp.sum(ex_hi, axis=1).astype(I32)
+        un_lo = jnp.sum(ex_lo, axis=1).astype(I32)
+    else:
+        uncx = jnp.zeros((v,), bool)
+        un_hi = un_lo = jnp.zeros((v,), I32)
+        aborted = jnp.zeros((v,), bool)
+
+    # Episode boundary: pure step arithmetic, auto-reset in-step. The
+    # next episode reseeds at base_seed + episode — deterministic, no
+    # wall clock anywhere near the boundary.
+    t2 = t + 1
+    done = t2 >= controls.ep_len
+    episode = state.episode + done.astype(I32)
+    reseed = state.seed + episode
+
+    def with_reset(operand):
+        agents_c, books_c = operand
+        fresh = jax.vmap(
+            lambda sd: init_agents(cfg, mix, sd))(reseed)
+
+        def sel(f, c):
+            m = done.reshape((v,) + (1,) * (f.ndim - 1))
+            return jnp.where(m, f, c)
+
+        agents_r = jax.tree_util.tree_map(sel, fresh, agents_c)
+        books_r = jax.tree_util.tree_map(
+            lambda c: sel(jnp.zeros_like(c), c), books_c)
+        return agents_r, books_r
+
+    agents, books = jax.lax.cond(
+        jnp.any(done), with_reset, lambda op: op, (agents, books))
+
+    new_state = GymState(
+        books=books, agents=agents,
+        ep_step=jnp.where(done, 0, t2),
+        episode=episode, seed=state.seed,
+    )
+    obs = _obs_of(spec, new_state, done)
+    stats = GymStepStats(
+        real_ops=real_ops, fills=fills, volume=volume,
+        uncrossed=uncx, uncross_hi=un_hi, uncross_lo=un_lo,
+        uncross_aborted=aborted, done=done,
+    )
+    rec_idx = jnp.asarray(spec.record, dtype=I32).reshape((-1,))
+    lanes = jnp.stack(
+        [orders.op, orders.side, orders.otype, orders.price, orders.qty,
+         orders.oid, orders.owner], axis=-1)[rec_idx]
+    return new_state, obs, stats, lanes
+
+
+def _rollout_impl(spec: GymSpec, steps: int, state: GymState,
+                  controls: VenueControls, actions: jax.Array):
+    """T gym steps in ONE lax.scan — the many-venue throughput path.
+    `actions` is [T, V, S, A, 7] (A may be 0). Returns (state, stats
+    stacked [T, V], recorded lanes [T, R, S, lanes, 7], final obs)."""
+
+    def body(carry, act_t):
+        st, obs_t, stats_t, rec_t = _step_impl(spec, carry, controls,
+                                               act_t)
+        return st, (stats_t, rec_t)
+
+    state, (stats, rec) = jax.lax.scan(body, state, actions,
+                                       length=steps)
+    done_last = state.ep_step == 0
+    return state, stats, rec, _obs_of(spec, state, done_last)
+
+
+_reset_jit = jax.jit(_reset_impl, static_argnums=0)
+_step_jit = jax.jit(_step_impl, static_argnums=0)
+_rollout_jit = jax.jit(_rollout_impl, static_argnums=(0, 1))
+
+
+class VenueGym:
+    """The step/reset product surface over _step_impl/_rollout_impl.
+
+    Functional state (gym-in-JAX convention, JAX-LOB/gymnax style): the
+    env object holds only the STATIC spec and the device control
+    tables; every transition takes and returns an explicit GymState, so
+    callers can fork, replay, or checkpoint any state at will.
+    """
+
+    def __init__(self, spec: GymSpec, controls: VenueControls):
+        self.spec = spec
+        self.controls = controls
+
+    @classmethod
+    def from_scenarios(cls, cfg: EngineConfig, mix: AgentMix, venues: int,
+                       scenarios, *, action_slots: int = 0,
+                       record: tuple[int, ...] = (), gates=None,
+                       zipf_alpha_q8=None) -> "VenueGym":
+        progs = [scenarios[i % len(scenarios)] for i in range(venues)]
+        has_auction = any(
+            ph.kind == "auction" for p in progs for ph in p.phases)
+        spec = GymSpec(cfg=cfg, mix=mix, venues=venues,
+                       action_slots=action_slots, has_auction=has_auction,
+                       record=tuple(record))
+        return cls(spec, build_controls(spec, progs, gates=gates,
+                                        zipf_alpha_q8=zipf_alpha_q8))
+
+    def reset(self, seeds) -> tuple[GymState, GymObs]:
+        """Fresh episode 0 for every venue. `seeds` is the [V] per-venue
+        base seed vector (venue v, episode e draws from PRNGKey(
+        seeds[v] + e))."""
+        seeds = jnp.asarray(seeds, dtype=I32)
+        assert seeds.shape == (self.spec.venues,), seeds.shape
+        state = _reset_jit(self.spec, seeds)
+        return state, _obs_of(self.spec, state,
+                              jnp.zeros((self.spec.venues,), bool))
+
+    def empty_actions(self, steps: int | None = None) -> jax.Array:
+        """All-noop action lanes: [V, S, A, 7], or [T, V, S, A, 7] when
+        `steps` is given (the rollout shape). A == spec.action_slots
+        (possibly 0 — the zero-size array is a valid 'no actions')."""
+        sp = self.spec
+        shape = (sp.venues, sp.cfg.num_symbols, sp.action_slots, 7)
+        if steps is not None:
+            shape = (steps,) + shape
+        return jnp.zeros(shape, dtype=I32)
+
+    def step(self, state: GymState, actions=None):
+        """(state, obs, stats, recorded_lanes)."""
+        if actions is None:
+            actions = self.empty_actions()
+        return _step_jit(self.spec, state, self.controls, actions)
+
+    def rollout(self, state: GymState, steps: int, actions=None,
+                metrics=None):
+        """T steps in one jit'd scan -> (state, stats [T, V], recorded
+        lanes [T, R, S, lanes, 7], final obs)."""
+        if actions is None:
+            actions = self.empty_actions(steps)
+        state, stats, rec, obs = _rollout_jit(
+            self.spec, steps, state, self.controls, actions)
+        if metrics is not None:
+            sp = self.spec
+            metrics.set_gauge("gym_venues", sp.venues)
+            metrics.inc("gym_steps", steps)
+            metrics.inc("gym_venue_steps", steps * sp.venues)
+            metrics.inc("gym_fills", int(jnp.sum(stats.fills)))
+            metrics.inc("gym_resets", int(jnp.sum(stats.done)))
+        return state, stats, rec, obs
+
+
+def gym_meta(spec: GymSpec) -> dict:
+    """The checkpoint identity of a gym spec (JSON-shaped). Restore
+    compatibility compares the engine semantic key + the population
+    layout + the venue/action shape — the gym analogue of
+    EngineConfig.semantic_key."""
+    return {
+        "cfg": dataclasses.asdict(spec.cfg),
+        "mix": dataclasses.asdict(spec.mix),
+        "venues": spec.venues,
+        "action_slots": spec.action_slots,
+    }
+
+
+def save_state(spec: GymSpec, state: GymState, path: str) -> None:
+    """Atomically checkpoint a gym state (tmp dir + rename — the
+    checkpoint machinery's one atomic-swap implementation). The blocks
+    are the raw [V]-axis arrays; the meta carries the gym identity and
+    NO wall clock — a gym checkpoint is a pure function of (spec, state)
+    and restoring it continues bit-identically (tests/test_gym.py pins
+    it across the [V] axis on matrix and levels books)."""
+    from matching_engine_tpu.utils.checkpoint import (
+        _atomic_checkpoint_write,
+    )
+
+    blocks = {f"book_{f}": np.asarray(getattr(state.books, f))
+              for f in BookBatch._fields}
+    blocks.update({f"agent_{f}": np.asarray(getattr(state.agents, f))
+                   for f in AgentState._fields})
+    blocks.update({
+        "ep_step": np.asarray(state.ep_step),
+        "episode": np.asarray(state.episode),
+        "seed": np.asarray(state.seed),
+    })
+    meta = {"format": 1, "kind": "gym", **gym_meta(spec)}
+    _atomic_checkpoint_write(path, blocks, meta)
+
+
+def restore_state(spec: GymSpec, path: str) -> GymState:
+    """Load a gym checkpoint written by save_state, refusing on any
+    semantic mismatch (different engine semantics, population layout,
+    venue count, or action width)."""
+    import json
+    import os
+
+    from matching_engine_tpu.utils.checkpoint import _cfg_from_meta
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "gym":
+        raise ValueError(f"{path}: not a gym checkpoint")
+    ck_cfg = _cfg_from_meta(meta)
+    if ck_cfg.semantic_key() != spec.cfg.semantic_key():
+        raise ValueError(
+            f"{path}: engine semantics {ck_cfg.semantic_key()} != "
+            f"{spec.cfg.semantic_key()}")
+    known = {f.name for f in dataclasses.fields(AgentMix)}
+    ck_mix = AgentMix(**{k: v for k, v in meta["mix"].items()
+                         if k in known})
+    if ck_mix != spec.mix:
+        raise ValueError(f"{path}: agent mix differs from the spec")
+    if (meta["venues"], meta["action_slots"]) != (spec.venues,
+                                                  spec.action_slots):
+        raise ValueError(
+            f"{path}: venue/action shape {meta['venues']}/"
+            f"{meta['action_slots']} != {spec.venues}/"
+            f"{spec.action_slots}")
+    with np.load(os.path.join(path, "book.npz")) as z:
+        books = BookBatch(**{f: jnp.asarray(z[f"book_{f}"])
+                             for f in BookBatch._fields})
+        agents = AgentState(**{f: jnp.asarray(z[f"agent_{f}"])
+                               for f in AgentState._fields})
+        return GymState(
+            books=books, agents=agents,
+            ep_step=jnp.asarray(z["ep_step"]),
+            episode=jnp.asarray(z["episode"]),
+            seed=jnp.asarray(z["seed"]),
+        )

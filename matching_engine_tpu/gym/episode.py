@@ -1,0 +1,159 @@
+"""Freeze a gym episode into a replayable workload artifact.
+
+Any interesting episode a venue runs — agent flow plus whatever actions
+the caller injected — freezes into the SAME artifact pair the scenario
+recorder writes (oprec opfile + JSON manifest, sim/record.py): the
+serving stack replays it bit-faithfully with exact fill reconciliation,
+`runner_bench --workload` drives it, and CI archives it. The decode is
+sim/record.OpfileBuilder — one OID-renumbering rule, one client-identity
+rule, one manifest schema for scenario recordings and gym episodes
+alike (injected action lanes record under the "act" class tag).
+
+The capture side is gym/env.py's `record` spec: recorded venues'
+consumed order lanes come back from step/rollout as [T, R, S, B, 7]
+arrays — the exact ops the engine matched, call-period OP_REST mapping
+and halt gating included — so the freezer never re-simulates and an
+episode with injected actions freezes exactly as it played.
+
+No wall clock enters the artifact: every manifest field is a pure
+function of (spec, scenario, seed, actions), so a frozen episode is as
+reproducible as the scenario recordings beside it.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from matching_engine_tpu.gym.env import GymSpec
+from matching_engine_tpu.sim.agents import column_roles
+from matching_engine_tpu.sim.record import (
+    ACTION_CLASS,
+    MANIFEST_FORMAT,
+    OpfileBuilder,
+    manifest_path_for,
+)
+from matching_engine_tpu.sim.scenarios import Scenario
+
+
+def episode_roles(spec: GymSpec) -> list[tuple[int, str, int]]:
+    """Batch-column roles of a gym dispatch: the agent mix's static
+    layout, then one "act" column per action slot."""
+    roles = column_roles(spec.mix)
+    roles += [(ACTION_CLASS, "flow", a)
+              for a in range(spec.action_slots)]
+    return roles
+
+
+def freeze_episode(
+    spec: GymSpec,
+    scenario: Scenario,
+    venue: int,
+    rec_lanes,
+    stats,
+    out_path: str,
+    *,
+    seed: int,
+    episode: int = 0,
+    serve_shards: int = 1,
+    metrics=None,
+    symbol_prefix: str = "S",
+) -> dict:
+    """Write one venue's episode as an opfile + manifest; returns the
+    manifest dict (the scenario-recording schema plus source/venue/
+    episode provenance).
+
+    `rec_lanes`/`stats` are a rollout's captured outputs ([T, R, S, B,
+    7] and GymStepStats over [T, V]); the rollout must START at the
+    episode's first step (reset or a `done` boundary) and cover it
+    fully. `venue` must be one of spec.record. `seed` is the venue's
+    base seed and `episode` its episode counter at capture — together
+    the artifact's reproducible identity (episode e draws from
+    PRNGKey(seed + e))."""
+    if venue not in spec.record:
+        raise ValueError(f"venue {venue} is not recorded ({spec.record})")
+    r = spec.record.index(venue)
+    ep_len = scenario.total_steps()
+    lanes = np.asarray(rec_lanes)[:, r]
+    if lanes.shape[0] < ep_len:
+        raise ValueError(
+            f"rollout captured {lanes.shape[0]} steps < episode length "
+            f"{ep_len}")
+    done = np.asarray(stats.done)[:ep_len, venue]
+    if not done[-1] or done[:-1].any():
+        raise ValueError(
+            "capture is not aligned to an episode: the rollout must "
+            "start at the venue's episode start (reset/done boundary)")
+    if np.asarray(stats.uncross_aborted)[:ep_len, venue].any():
+        raise RuntimeError(
+            "episode uncross aborted: fill log overflow — raise "
+            "EngineConfig.max_fills for this population")
+
+    cfg = spec.cfg
+    bld = OpfileBuilder(cfg.num_symbols, spec.mix, episode_roles(spec),
+                        serve_shards=serve_shards,
+                        symbol_prefix=symbol_prefix)
+    op, side, otype = lanes[..., 0], lanes[..., 1], lanes[..., 2]
+    price, qty, oid = lanes[..., 3], lanes[..., 4], lanes[..., 5]
+    fills = np.asarray(stats.fills)[:ep_len, venue]
+    volume = np.asarray(stats.volume)[:ep_len, venue]
+    un_hi = np.asarray(stats.uncross_hi)[:ep_len, venue].astype(np.int64)
+    un_lo = np.asarray(stats.uncross_lo)[:ep_len, venue].astype(np.int64)
+
+    manifest_phases = []
+    step0 = 0
+    for ph in scenario.phases:
+        start_rec = len(bld.records)
+        end = step0 + ph.steps
+        for t in range(step0, end):
+            bld.add_step(t, op[t], side[t], otype[t], price[t], qty[t],
+                         oid[t])
+        manifest_phases.append({
+            "kind": ph.kind,
+            "steps": ph.steps,
+            "start_record": start_rec,
+            "end_record": len(bld.records),
+            "fills": int(fills[step0:end].sum()),
+            "volume": int(volume[step0:end].sum()),
+            "uncross": ph.kind == "auction",
+            "uncross_executed": int((un_hi[end - 1] << 15)
+                                    + un_lo[end - 1]),
+        })
+        step0 = end
+
+    bld.write(out_path)
+
+    mix = spec.mix
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "name": scenario.name,
+        "seed": seed,
+        "symbols": cfg.num_symbols,
+        "capacity": cfg.capacity,
+        "batch": spec.lanes(),
+        "kernel": cfg.kernel,
+        "max_fills": cfg.max_fills,
+        "serve_shards": serve_shards,
+        "zipf_alpha_q8": scenario.zipf_alpha_q8,
+        "steps": ep_len,
+        "phases": manifest_phases,
+        **bld.manifest_accounting(),
+        "sim_fills": sum(p["fills"] for p in manifest_phases),
+        "sim_volume": sum(p["volume"] for p in manifest_phases),
+        "agent_mix": {
+            "mm_agents": mix.mm_agents, "mm_refresh": mix.mm_refresh,
+            "momentum": mix.momentum, "noise": mix.noise,
+            "takers": mix.takers,
+        },
+        "source": "gym",
+        "venue": venue,
+        "episode": episode,
+        "action_slots": spec.action_slots,
+    }
+    with open(manifest_path_for(out_path), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    if metrics is not None:
+        metrics.inc("gym_episodes_frozen")
+        metrics.inc("gym_frozen_ops", len(bld.records))
+    return manifest

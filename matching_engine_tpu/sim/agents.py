@@ -162,31 +162,56 @@ def mm_agent_index(mix: AgentMix, step: int, lane: int) -> int:
     return (step * mix.mm_refresh + lane) % mix.mm_agents
 
 
+class ClassGates(NamedTuple):
+    """Per-population fire-probability overrides (percent, int32). The
+    defaults mirror AgentMix's static constants; the many-venue gym
+    (gym/env.py) passes TRACED per-venue values instead, so one compiled
+    step serves V venues with genuinely different populations while a
+    venue whose gates equal the mix constants stays bit-identical to the
+    single-venue scenario run (the parity oracle)."""
+
+    noise_p: jax.Array | int
+    mom_p: jax.Array | int
+    taker_p: jax.Array | int
+
+
+def default_gates(mix: AgentMix) -> ClassGates:
+    return ClassGates(noise_p=mix.noise_p, mom_p=mix.mom_p,
+                      taker_p=mix.taker_p)
+
+
 def agent_orders(
     cfg: EngineConfig,
     mix: AgentMix,
     state: AgentState,
     zipf_w: jax.Array,
     *,
-    call_mode: bool,
-    halt: bool,
+    call_mode,
+    halt,
     burst_on,
     shock,
     sell_bias,
+    gates: ClassGates | None = None,
 ):
     """One step of population decisions -> (new_state, OrderBatch).
 
-    Static flags: `call_mode` (auction call period: LIMIT flow rests via
-    the serving layer's OP_REST mapping — here we keep OP_SUBMIT and let
-    the caller map it, see scenarios._phase_step — and market-type
-    classes are gated off), `halt` (every symbol suppressed). Traced
-    scalars: `burst_on` (bool — off-period suppresses all symbols),
-    `shock` (int32 — per-step fair-value decrement while a scenario shock
-    is active), `sell_bias` (bool — takers all SELL at double size).
-    `zipf_w` is the [S] per-symbol activity weight in Q15 (32768 = always
-    active)."""
+    Flags accept python bools (constant-folded, the scenario runner's
+    static per-phase jit) OR traced bool scalars (the many-venue gym,
+    where phase programs differ per venue inside one vmapped step):
+    `call_mode` (auction call period: LIMIT flow rests via the serving
+    layer's OP_REST mapping — here we keep OP_SUBMIT and let the caller
+    map it, see scenarios._phase_step — and market-type classes are
+    gated off), `halt` (every symbol suppressed), `burst_on` (off-period
+    suppresses all symbols), `shock` (int32 — per-step fair-value
+    decrement while a scenario shock is active), `sell_bias` (bool —
+    takers all SELL at double size). `zipf_w` is the [S] per-symbol
+    activity weight in Q15 (32768 = always active). `gates` optionally
+    overrides the class fire probabilities with traced per-venue values
+    (defaults to the mix constants — bit-identical)."""
     s = cfg.num_symbols
     k, mo, nz, tk = mix.mm_refresh, mix.momentum, mix.noise, mix.takers
+    if gates is None:
+        gates = default_gates(mix)
 
     subs = jax.vmap(lambda kk: jax.random.split(kk, 13))(state.keys)
     keys = subs[:, 0]
@@ -205,9 +230,7 @@ def agent_orders(
 
     # Per-symbol activity gate: Zipf weight x burst window x halt.
     gate_draw = draw(2, lambda kk: jax.random.randint(kk, (), 0, 1 << 15, I32))
-    active = (gate_draw < zipf_w) & burst_on
-    if halt:
-        active = jnp.zeros_like(active)
+    active = (gate_draw < zipf_w) & burst_on & jnp.logical_not(halt)
 
     # ---- market makers (market_sim's round-robin refresh) ----------------
     idx = (state.step * k + jnp.arange(k, dtype=I32)) % mix.mm_agents
@@ -232,14 +255,14 @@ def agent_orders(
     amp = jnp.clip(jnp.abs(sig) // mix.mom_threshold, 1, 4)
     mom_pct = draw(6, lambda kk: jax.random.randint(kk, (mo,), 0, 100, I32))
     mom_fire = (jnp.abs(sig)[:, None] >= mix.mom_threshold) & (
-        mom_pct < mix.mom_p)
+        mom_pct < gates.mom_p)
     mom_side = jnp.broadcast_to(jnp.where(sig[:, None] < 0, SELL, BUY),
                                 (s, mo)).astype(I32)
     mom_qty = jnp.broadcast_to((mix.mom_qty * amp)[:, None], (s, mo))
 
     # ---- noise: heavy-tailed sizes around fair ---------------------------
     nz_pct = draw(7, lambda kk: jax.random.randint(kk, (nz,), 0, 100, I32))
-    nz_fire = nz_pct < mix.noise_p
+    nz_fire = nz_pct < gates.noise_p
     nz_side = draw(8, lambda kk: jax.random.randint(kk, (nz,), 0, 2, I32)) + BUY
     span = 3 * mix.half_spread
     nz_off = draw(9, lambda kk: jax.random.randint(kk, (nz,), -span,
@@ -257,7 +280,7 @@ def agent_orders(
 
     # ---- takers: aggressive MARKET flow ----------------------------------
     tk_pct = draw(11, lambda kk: jax.random.randint(kk, (tk,), 0, 100, I32))
-    tk_fire = (tk_pct < mix.taker_p) | sell_bias
+    tk_fire = (tk_pct < gates.taker_p) | sell_bias
     tk_rand_side = draw(12, lambda kk: jax.random.randint(kk, (tk,), 0, 2,
                                                           I32)) + BUY
     tk_side = jnp.where(sell_bias, SELL, tk_rand_side)
@@ -272,7 +295,10 @@ def agent_orders(
         return (op, side, otype, price, q, oid, jnp.zeros_like(op))
 
     zeros_k = jnp.zeros((s, k), I32)
-    market_gate = not call_mode  # market-type classes are off in a call
+    # Market-type classes are off in a call period. logical_not keeps
+    # this correct for BOTH python-bool call_mode (folded to a constant)
+    # and traced per-venue scalars under the gym's venue vmap.
+    market_gate = jnp.logical_not(call_mode)
     segs = [
         seg(jnp.where(old_bid > 0, OP_CANCEL, 0), jnp.full((s, k), BUY, I32),
             zeros_k, zeros_k, zeros_k, old_bid),

@@ -51,6 +51,17 @@ from matching_engine_tpu.sim.scenarios import Scenario, run_scenario
 
 MANIFEST_FORMAT = 1
 
+# Injected gym-action flow records under its own class tag: the gym's
+# action lanes (gym/env.py) are no agent class, but their ops must ride
+# the same opfile/manifest schema — column role (ACTION_CLASS, "flow",
+# slot) appended after column_roles(mix).
+ACTION_CLASS = len(CLASS_TAGS)
+ACTION_TAG = "act"
+
+
+def _class_tag(cls: int) -> str:
+    return CLASS_TAGS[cls] if cls < len(CLASS_TAGS) else ACTION_TAG
+
 
 def manifest_path_for(opfile_path: str) -> str:
     """<name>.opfile[.gz] -> <name>.manifest.json (same directory)."""
@@ -69,10 +80,132 @@ def _client_id(cls: int, role: str, lane: int, sym: int, step: int,
     taker-style classes get a step-unique id so server-side self-trade
     prevention can never fire between a client's own orders — the device
     sim runs owner=0 (STP opted out), and replay must not diverge."""
-    tag = CLASS_TAGS[cls]
+    tag = _class_tag(cls)
     if cls == CLASS_MM:
         return f"{tag}{sym}-{mm_agent_index(mix, step, lane)}"
     return f"{tag}{sym}-{lane}-{step}"
+
+
+class OpfileBuilder:
+    """THE device-lanes -> oprec-records decode, shared by the scenario
+    recorder below and the gym episode freezer (gym/episode.py) so the
+    two artifact producers cannot drift: one OID-renumbering rule, one
+    client-identity rule, one set of replay constraints, one manifest
+    accounting. Feed one step at a time (add_step, [S, B] int arrays in
+    batch-column order per `roles`); iteration order (step, symbol,
+    column) IS the record order the server will see — byte-stable."""
+
+    def __init__(self, num_symbols: int, mix: AgentMix, roles,
+                 serve_shards: int = 1, symbol_prefix: str = "S"):
+        self.mix = mix
+        self.roles = roles
+        self.serve_shards = serve_shards
+        self.symbols = [f"{symbol_prefix}{s}" for s in range(num_symbols)]
+        self.lanes = ([symbol_home(sym, serve_shards)
+                       for sym in self.symbols]
+                      if serve_shards > 1 else [0] * num_symbols)
+        self.records: list[tuple] = []
+        # (sym, sim_oid) -> (server "OID-<n>", client_id, record index)
+        self.oid_map: dict[tuple[int, int], tuple[str, str, int]] = {}
+        self.lane_counts = [0] * max(1, serve_shards)
+        tags = list(CLASS_TAGS)
+        if any(cls == ACTION_CLASS for cls, _r, _l in roles):
+            tags.append(ACTION_TAG)
+        self.per_class = {tag: {"submits": 0, "cancels": 0}
+                          for tag in tags}
+        self.per_symbol = [0] * num_symbols
+        self.skipped_cancels = 0
+        self.min_cancel_gap: int | None = None
+        # Per-symbol resting-depth UPPER BOUND over the recording: live
+        # GTC LIMIT count ignoring fills (a fill only ever lowers true
+        # depth). Replay uses it to assert a --book-tiers spec is deep
+        # enough BEFORE driving a server (check_tier_depth below).
+        self.live_limits = [0] * num_symbols
+        self.max_resting_depth = [0] * num_symbols
+        # sim oid -> symbol of a still-live recorded LIMIT
+        self.limit_sym: dict[tuple[int, int], int] = {}
+
+    def add_step(self, g_step: int, op, side, otype, price, qty,
+                 oid) -> None:
+        """Decode one step's [S, B] lanes into records (in place)."""
+        s_syms, b_cols = op.shape
+        for s in range(s_syms):
+            row_op = op[s]
+            if not row_op.any():
+                continue
+            for b in range(b_cols):
+                o = int(row_op[b])
+                if o == 0:
+                    continue
+                cls, role, lane_idx = self.roles[b]
+                if o in (OP_SUBMIT, OP_REST):
+                    lane = self.lanes[s]
+                    n = self.lane_counts[lane]
+                    self.lane_counts[lane] += 1
+                    srv_oid = (
+                        f"OID-{lane + 1 + n * self.serve_shards}"
+                        if self.serve_shards > 1 else f"OID-{n + 1}")
+                    cid = _client_id(cls, role, lane_idx, s, g_step,
+                                     self.mix)
+                    self.oid_map[(s, int(oid[s, b]))] = (
+                        srv_oid, cid, len(self.records))
+                    self.records.append((
+                        oprec.OPREC_SUBMIT, int(side[s, b]),
+                        int(otype[s, b]), int(price[s, b]),
+                        int(qty[s, b]), self.symbols[s], cid, ""))
+                    self.per_class[_class_tag(cls)]["submits"] += 1
+                    self.per_symbol[s] += 1
+                    if int(otype[s, b]) == 0:  # GTC LIMIT rests
+                        self.live_limits[s] += 1
+                        self.max_resting_depth[s] = max(
+                            self.max_resting_depth[s],
+                            self.live_limits[s])
+                        self.limit_sym[(s, int(oid[s, b]))] = s
+                elif o == OP_CANCEL:
+                    hit = self.oid_map.get((s, int(oid[s, b])))
+                    if hit is None:
+                        # A cancel of flow that was never recorded
+                        # (cannot happen for the shipped mixes; kept
+                        # as a counted guard, never silent).
+                        self.skipped_cancels += 1
+                        continue
+                    srv_oid, cid, born_at = hit
+                    gap = len(self.records) - born_at
+                    if self.min_cancel_gap is None \
+                            or gap < self.min_cancel_gap:
+                        self.min_cancel_gap = gap
+                    self.records.append((
+                        oprec.OPREC_CANCEL, 0, 0, 0, 0, "", cid,
+                        srv_oid))
+                    self.per_class[_class_tag(cls)]["cancels"] += 1
+                    self.per_symbol[s] += 1
+                    if self.limit_sym.pop((s, int(oid[s, b])),
+                                          None) is not None:
+                        self.live_limits[s] -= 1
+
+    def write(self, out_path: str):
+        """Validate with the codec's own edge rules and write the
+        opfile. Returns the packed record array."""
+        arr = oprec.pack_records(self.records)
+        flaws = [m for m in oprec.record_flaws(arr) if m is not None]
+        if flaws:
+            raise RuntimeError(
+                f"recorded flow failed edge validation ({len(flaws)} "
+                f"flawed records; first: {flaws[0]}) — recorder/codec "
+                f"skew")
+        oprec.write_opfile(out_path, arr)
+        return arr
+
+    def manifest_accounting(self) -> dict:
+        """The builder-owned manifest fields (shared schema slice)."""
+        return {
+            "ops": len(self.records),
+            "per_class_ops": self.per_class,
+            "per_symbol_ops": self.per_symbol,
+            "min_cancel_gap": self.min_cancel_gap,
+            "max_resting_depth": self.max_resting_depth,
+            "skipped_cancels": self.skipped_cancels,
+        }
 
 
 def record_scenario(
@@ -87,117 +220,51 @@ def record_scenario(
 ) -> dict:
     """Run + record one scenario; write the opfile and its manifest.
 
-    Returns the manifest dict (phases with record ranges, per-class and
-    per-symbol op counts, the sim's own fill/volume ground truth, and
-    the replay constraints)."""
+    Returns the manifest dict (phases with record ranges AND the sim's
+    per-phase fill/volume/uncross ground truth — one schema with the
+    gym's frozen-episode manifests, so every replay reconciler reads
+    the same shape — plus per-class/per-symbol op counts and the replay
+    constraints)."""
     book, state, phases = run_scenario(cfg, mix, scenario, seed=seed,
                                        collect_orders=True)
-    roles = column_roles(mix)
-    symbols = [f"{symbol_prefix}{s}" for s in range(cfg.num_symbols)]
-    lanes = ([symbol_home(sym, serve_shards) for sym in symbols]
-             if serve_shards > 1 else [0] * cfg.num_symbols)
-
-    records: list[tuple] = []
-    # (sym, sim_oid) -> (server "OID-<n>", client_id, record index)
-    oid_map: dict[tuple[int, int], tuple[str, str, int]] = {}
-    lane_counts = [0] * max(1, serve_shards)
-    per_class = {tag: {"submits": 0, "cancels": 0} for tag in CLASS_TAGS}
-    per_symbol = [0] * cfg.num_symbols
-    skipped_cancels = 0
-    min_cancel_gap = None
-    # Per-symbol resting-depth UPPER BOUND over the recording: live GTC
-    # LIMIT count ignoring fills (a fill only ever lowers true depth).
-    # Replay uses it to assert a --book-tiers spec is deep enough BEFORE
-    # driving a server (check_tier_depth below).
-    live_limits = [0] * cfg.num_symbols
-    max_resting_depth = [0] * cfg.num_symbols
-    # sim oid -> symbol of a still-live recorded LIMIT (for the decrement)
-    limit_sym: dict[tuple[int, int], int] = {}
+    bld = OpfileBuilder(cfg.num_symbols, mix, column_roles(mix),
+                        serve_shards=serve_shards,
+                        symbol_prefix=symbol_prefix)
 
     manifest_phases = []
     step0 = 0
     for pr in phases:
-        start_rec = len(records)
+        start_rec = len(bld.records)
         op = np.asarray(pr.orders.op)
         side = np.asarray(pr.orders.side)
         otype = np.asarray(pr.orders.otype)
         price = np.asarray(pr.orders.price)
         qty = np.asarray(pr.orders.qty)
         oid = np.asarray(pr.orders.oid)
-        t_steps, s_syms, b_cols = op.shape
-        for t in range(t_steps):
-            g_step = step0 + t
-            for s in range(s_syms):
-                row_op = op[t, s]
-                if not row_op.any():
-                    continue
-                for b in range(b_cols):
-                    o = int(row_op[b])
-                    if o == 0:
-                        continue
-                    cls, role, lane_idx = roles[b]
-                    if o in (OP_SUBMIT, OP_REST):
-                        lane = lanes[s]
-                        n = lane_counts[lane]
-                        lane_counts[lane] += 1
-                        srv_oid = (f"OID-{lane + 1 + n * serve_shards}"
-                                   if serve_shards > 1 else f"OID-{n + 1}")
-                        cid = _client_id(cls, role, lane_idx, s, g_step, mix)
-                        oid_map[(s, int(oid[t, s, b]))] = (
-                            srv_oid, cid, len(records))
-                        records.append((
-                            oprec.OPREC_SUBMIT, int(side[t, s, b]),
-                            int(otype[t, s, b]), int(price[t, s, b]),
-                            int(qty[t, s, b]), symbols[s], cid, ""))
-                        per_class[CLASS_TAGS[cls]]["submits"] += 1
-                        per_symbol[s] += 1
-                        if int(otype[t, s, b]) == 0:  # GTC LIMIT rests
-                            live_limits[s] += 1
-                            max_resting_depth[s] = max(
-                                max_resting_depth[s], live_limits[s])
-                            limit_sym[(s, int(oid[t, s, b]))] = s
-                    elif o == OP_CANCEL:
-                        hit = oid_map.get((s, int(oid[t, s, b])))
-                        if hit is None:
-                            # A cancel of flow that was never recorded
-                            # (cannot happen for the shipped mixes; kept
-                            # as a counted guard, never silent).
-                            skipped_cancels += 1
-                            continue
-                        srv_oid, cid, born_at = hit
-                        gap = len(records) - born_at
-                        if min_cancel_gap is None or gap < min_cancel_gap:
-                            min_cancel_gap = gap
-                        records.append((
-                            oprec.OPREC_CANCEL, 0, 0, 0, 0, "", cid,
-                            srv_oid))
-                        per_class[CLASS_TAGS[cls]]["cancels"] += 1
-                        per_symbol[s] += 1
-                        if limit_sym.pop((s, int(oid[t, s, b])),
-                                         None) is not None:
-                            live_limits[s] -= 1
+        for t in range(op.shape[0]):
+            bld.add_step(step0 + t, op[t], side[t], otype[t], price[t],
+                         qty[t], oid[t])
         manifest_phases.append({
             "kind": pr.phase.kind,
             "steps": pr.phase.steps,
             "start_record": start_rec,
-            "end_record": len(records),
+            "end_record": len(bld.records),
+            # Per-phase ground truth: continuous fills/volume from the
+            # sim's own step stats, call executions separately — the
+            # per-phase slice of the totals below, so a phase-aware
+            # replay can reconcile each phase, not just the end state.
+            "fills": int(np.sum(np.asarray(pr.stats.fills))),
+            "volume": int(np.sum(np.asarray(pr.stats.volume))),
             "uncross": pr.phase.kind == "auction",
             "uncross_executed": (int(np.sum(pr.uncross.executed))
                                  if pr.uncross is not None else 0),
         })
         step0 += pr.phase.steps
 
-    arr = oprec.pack_records(records)
-    flaws = [m for m in oprec.record_flaws(arr) if m is not None]
-    if flaws:
-        raise RuntimeError(
-            f"recorded flow failed edge validation ({len(flaws)} flawed "
-            f"records; first: {flaws[0]}) — recorder/codec skew")
-    oprec.write_opfile(out_path, arr)
+    arr = bld.write(out_path)
 
-    sim_fills = sum(int(np.sum(np.asarray(pr.stats.fills))) for pr in phases)
-    sim_volume = sum(int(np.sum(np.asarray(pr.stats.volume)))
-                     for pr in phases)
+    sim_fills = sum(p["fills"] for p in manifest_phases)
+    sim_volume = sum(p["volume"] for p in manifest_phases)
     manifest = {
         "format": MANIFEST_FORMAT,
         "name": scenario.name,
@@ -210,13 +277,8 @@ def record_scenario(
         "serve_shards": serve_shards,
         "zipf_alpha_q8": scenario.zipf_alpha_q8,
         "steps": scenario.total_steps(),
-        "ops": len(records),
         "phases": manifest_phases,
-        "per_class_ops": per_class,
-        "per_symbol_ops": per_symbol,
-        "min_cancel_gap": min_cancel_gap,
-        "max_resting_depth": max_resting_depth,
-        "skipped_cancels": skipped_cancels,
+        **bld.manifest_accounting(),
         "sim_fills": sim_fills,
         "sim_volume": sim_volume,
         "agent_mix": {
@@ -229,7 +291,7 @@ def record_scenario(
         json.dump(manifest, f, indent=1, sort_keys=True)
 
     if metrics is not None:
-        metrics.inc("sim_record_ops", len(records))
+        metrics.inc("sim_record_ops", len(bld.records))
         metrics.inc("sim_record_steps", scenario.total_steps())
         metrics.inc("sim_record_phases", len(manifest_phases))
         metrics.inc("sim_record_bytes", len(arr) * oprec.RECORD_SIZE)

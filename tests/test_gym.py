@@ -1,0 +1,280 @@
+"""Many-venue gym (gym/env.py, gym/episode.py): parity oracle, PRNG
+independence, checkpoint bit-identity, scale, and the freeze->replay
+loop.
+
+The load-bearing checks:
+- parity: a V-venue rollout over HETEROGENEOUS scenarios (auction day
+  with three uncrosses, a halt-and-shock crash, bursts, Zipf-skewed hot
+  symbols) is bit-identical per venue to V independent single-venue
+  run_scenario() runs — fills, volume, and every uncross's executed
+  volume — on all three kernels. The gym is the engine vmapped over a
+  venue axis, never a reimplementation.
+- PRNG independence: perturbing one venue's seed changes only that
+  venue's lane of every output (satellite 3).
+- save/restore: a checkpoint mid-rollout restores to bit-identical
+  continuation across the whole [V] axis, matrix AND levels kernels.
+- freeze->replay: a frozen gym episode replays through a real in-proc
+  server with the serving stack's fills/uncross volumes equal to the
+  sim's per-phase ground truth (CI's gym smoke, satellite 5).
+
+Compile budget: the 4-venue matrix rollout is computed ONCE by a
+module-scope fixture and shared by the parity oracle, the freeze ->
+serving replay, and the freeze-validation checks (which synthesize
+misaligned captures by array surgery instead of extra rollouts); the
+sorted/levels parity points run 2 venues (auction + crash — the phase
+kinds that diverge across kernels).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.engine.book import EngineConfig
+from matching_engine_tpu.gym import VenueGym, freeze_episode, restore_state, save_state
+from matching_engine_tpu.sim.agents import AgentMix
+from matching_engine_tpu.sim.scenarios import make_scenario, run_scenario
+
+MIX = AgentMix(mm_agents=8, mm_refresh=2, momentum=2, noise=3, takers=2,
+               half_spread=2, spread_jitter=4, qty_max=50, fair_init=1_000,
+               noise_qty_cap=120)
+CFG = EngineConfig(num_symbols=4, capacity=48, batch=MIX.batch_for(),
+                   max_fills=1 << 14)
+SEEDS = [11, 22, 33, 44]
+
+
+def _scens(steps=40):
+    return [make_scenario("auction_day", steps),
+            make_scenario("flash_crash", steps),
+            make_scenario("bursts", steps),
+            make_scenario("hot_symbols", steps)]
+
+
+def _uncross_vol(stats, n, i):
+    hi = np.asarray(stats.uncross_hi)[:n, i].astype(np.int64)
+    lo = np.asarray(stats.uncross_lo)[:n, i].astype(np.int64)
+    return int((hi << 15).sum() + lo.sum())
+
+
+def _assert_venue_matches_oracle(cfg, stats, i, scen, seed):
+    """Venue i's gym lane vs its single-venue run_scenario() run."""
+    _book, _st, results = run_scenario(cfg, MIX, scen, seed=seed)
+    fills = sum(int(np.asarray(pr.stats.fills).sum()) for pr in results)
+    vol = sum(int(np.asarray(pr.stats.volume).sum()) for pr in results)
+    uv = sum(int(pr.uncross.executed.sum()) for pr in results
+             if pr.uncross is not None)
+    n = scen.total_steps()
+    assert int(np.asarray(stats.fills)[:n, i].sum()) == fills
+    assert int(np.asarray(stats.volume)[:n, i].sum()) == vol
+    assert _uncross_vol(stats, n, i) == uv
+    assert fills > 0
+
+
+@pytest.fixture(scope="module")
+def rolled4():
+    """One 4-venue heterogeneous matrix rollout, venue 0 recorded —
+    shared by the parity oracle and the freeze/replay family."""
+    scens = _scens()
+    env = VenueGym.from_scenarios(CFG, MIX, 4, scens, record=(0,))
+    state, _ = env.reset(SEEDS)
+    T = max(int(x) for x in np.asarray(env.controls.ep_len))
+    state, stats, rec, obs = env.rollout(state, T)
+    return env, scens, stats, rec
+
+
+# -- parity oracle: gym == V single-venue runs, all kernels --------------------
+
+
+def test_parity_vs_single_venue_runs_matrix(rolled4):
+    env, scens, stats, _rec = rolled4
+    assert int(np.asarray(stats.done).sum()) == 4  # every venue finished
+    for i, (scen, seed) in enumerate(zip(scens, SEEDS)):
+        _assert_venue_matches_oracle(CFG, stats, i, scen, seed)
+    # The heterogeneity is real: the auction venue actually uncrossed.
+    assert int(np.asarray(stats.uncrossed)[:, 0].sum()) == 3
+
+
+@pytest.mark.parametrize("kernel", ["sorted", "levels"])
+def test_parity_vs_single_venue_runs(kernel):
+    cfg = dataclasses.replace(CFG, capacity=64, kernel=kernel)
+    scens = _scens()[:2]  # auction (uncross) + crash (halt/shock)
+    env = VenueGym.from_scenarios(cfg, MIX, 2, scens)
+    state, _ = env.reset(SEEDS[:2])
+    T = max(int(x) for x in np.asarray(env.controls.ep_len))
+    _, stats, _, _ = env.rollout(state, T)
+    for i, (scen, seed) in enumerate(zip(scens, SEEDS)):
+        _assert_venue_matches_oracle(cfg, stats, i, scen, seed)
+
+
+# -- per-venue PRNG independence (satellite 3) ---------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "levels"])
+def test_per_venue_prng_independence(kernel):
+    """Changing venue 1's seed must change ONLY venue 1's lane: every
+    stats/obs column of venues 0 and 2 stays bit-identical."""
+    cfg = dataclasses.replace(CFG, capacity=64, kernel=kernel)
+    scens = _scens()[:3]
+    env = VenueGym.from_scenarios(cfg, MIX, 3, scens)
+    sa, _ = env.reset([5, 6, 7])
+    sb, _ = env.reset([5, 999, 7])
+    _, st_a, _, obs_a = env.rollout(sa, 16)
+    _, st_b, _, obs_b = env.rollout(sb, 16)
+    for f_a, f_b in zip(st_a, st_b):
+        a, b = np.asarray(f_a), np.asarray(f_b)
+        np.testing.assert_array_equal(a[:, 0], b[:, 0])
+        np.testing.assert_array_equal(a[:, 2], b[:, 2])
+    for f_a, f_b in zip(obs_a, obs_b):
+        a, b = np.asarray(f_a), np.asarray(f_b)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[2], b[2])
+    # ...and venue 1 did actually diverge.
+    assert (np.asarray(st_a.fills)[:, 1] != np.asarray(st_b.fills)[:, 1]).any()
+
+
+def test_episode_reseed_matches_fresh_reset():
+    """Episode e of a venue draws from PRNGKey(seed + e): the steps after
+    an auto-reset are bit-identical to a fresh reset at seed + 1."""
+    scens = [make_scenario("bursts", 12)] * 2
+    env = VenueGym.from_scenarios(CFG, MIX, 2, scens)
+    T = int(np.asarray(env.controls.ep_len)[0])
+    state, _ = env.reset([3, 4])
+    state, _, _, _ = env.rollout(state, T)  # episode 0 ends, auto-reset
+    _, tail, _, _ = env.rollout(state, 6)
+    fresh, _ = env.reset([4, 5])
+    _, fresh_stats, _, _ = env.rollout(fresh, 6)
+    for f_t, f_f in zip(tail, fresh_stats):
+        np.testing.assert_array_equal(np.asarray(f_t), np.asarray(f_f))
+
+
+# -- checkpoint: save/restore bit-identity across [V] --------------------------
+
+
+@pytest.mark.parametrize("kernel", ["matrix", "levels"])
+def test_save_restore_bit_identical_continuation(tmp_path, kernel):
+    cfg = dataclasses.replace(CFG, capacity=64, kernel=kernel)
+    env = VenueGym.from_scenarios(cfg, MIX, 3, _scens()[:3])
+    state, _ = env.reset([5, 6, 7])
+    state, _, _, _ = env.rollout(state, 16)  # mid-episode
+    path = str(tmp_path / "gym.ckpt")
+    save_state(env.spec, state, path)
+    restored = restore_state(env.spec, path)
+    for f_a, f_b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(f_a), np.asarray(f_b))
+    _, st_a, _, obs_a = env.rollout(state, 16)
+    _, st_b, _, obs_b = env.rollout(restored, 16)
+    for f_a, f_b in zip(jax.tree_util.tree_leaves((st_a, obs_a)),
+                        jax.tree_util.tree_leaves((st_b, obs_b))):
+        np.testing.assert_array_equal(np.asarray(f_a), np.asarray(f_b))
+
+
+def test_restore_rejects_mismatched_spec(tmp_path, rolled4):
+    env, _scens_, _stats, _rec = rolled4
+    state, _ = env.reset(SEEDS)
+    path = str(tmp_path / "gym.ckpt")
+    save_state(env.spec, state, path)
+    other = VenueGym.from_scenarios(CFG, MIX, 3, _scens()[:3])
+    with pytest.raises(ValueError):
+        restore_state(other.spec, path)
+
+
+# -- scale: 1024 heterogeneous venues in one jit'd scan ------------------------
+
+
+def test_1024_venues_one_scan():
+    """V=1024 is data-parallel width, not program size: one compile, one
+    lax.scan, four distinct scenario programs cycling over the axis."""
+    mix = AgentMix(mm_agents=4, mm_refresh=1, momentum=1, noise=2, takers=1,
+                   half_spread=2, spread_jitter=4, qty_max=50,
+                   fair_init=1_000, noise_qty_cap=120)
+    cfg = EngineConfig(num_symbols=2, capacity=16, batch=mix.batch_for(),
+                       max_fills=1 << 12)
+    env = VenueGym.from_scenarios(cfg, mix, 1024, _scens(20))
+    state, obs = env.reset(list(range(1024)))
+    assert np.asarray(obs.best_bid).shape == (1024, 2)
+    state, stats, _, _ = env.rollout(state, 6)
+    assert np.asarray(stats.fills).shape == (6, 1024)
+    assert int(np.asarray(stats.real_ops).sum()) > 0
+    # Distinct programs did run: bursts venues idle outside bursts while
+    # hot-symbol venues trade every step — per-venue op totals differ.
+    per_venue = np.asarray(stats.real_ops).sum(axis=0)
+    assert len(np.unique(per_venue)) > 1
+
+
+# -- freeze -> serving-stack replay (satellite 5 / CI gym smoke) ---------------
+
+
+def test_freeze_episode_replays_through_inproc_server(tmp_path, rolled4):
+    """A frozen gym episode IS a workload artifact: replayed through a
+    real in-proc server (call periods opened, uncrossed at phase ends),
+    the serving stack reproduces the gym's fills exactly and every
+    uncross clears the gym's per-phase ground-truth volume."""
+    from matching_engine_tpu.proto import pb2
+    from matching_engine_tpu.server.main import build_server, shutdown
+
+    env, scens, stats, rec = rolled4
+    out = str(tmp_path / "ep.opfile.gz")
+    man = freeze_episode(env.spec, scens[0], 0, rec, stats, out,
+                         seed=SEEDS[0])
+    assert man["source"] == "gym" and man["sim_fills"] > 0
+    arr = oprec.read_opfile(out)
+
+    scfg = EngineConfig(num_symbols=CFG.num_symbols, capacity=CFG.capacity,
+                        batch=8, max_fills=CFG.max_fills)
+    server, _port, parts = build_server(
+        "127.0.0.1:0", str(tmp_path / "w.db"), scfg, window_ms=1.0,
+        log=False, feed_depth=0)
+    svc = parts["service"]
+    try:
+        bs = max(1, min(128, man["min_cancel_gap"] or 128))
+        reasons = {}
+        uncross = []
+        for ph in man["phases"]:
+            if ph["kind"] == "auction":
+                r = svc.RunAuction(pb2.AuctionRequest(open_call=True), None)
+                assert r.success, r.error_message
+            for s0 in range(ph["start_record"], ph["end_record"], bs):
+                payload = oprec.slice_payload(
+                    arr, s0, min(bs, ph["end_record"] - s0))
+                resp = svc.SubmitOrderBatch(
+                    pb2.OrderBatchRequest(ops=payload), None)
+                assert resp.success, resp.error_message
+                for i, ok in enumerate(resp.ok):
+                    if not ok:
+                        reasons[resp.error[i]] = (
+                            reasons.get(resp.error[i], 0) + 1)
+            if ph["kind"] == "auction":
+                r = svc.RunAuction(pb2.AuctionRequest(), None)
+                assert r.success, r.error_message
+                uncross.append(int(r.executed_quantity))
+        gm = svc.GetMetrics(pb2.MetricsRequest(), None)
+        assert gm.counters.get("fills") == man["sim_fills"]
+        assert uncross == [p["uncross_executed"] for p in man["phases"]
+                           if p["kind"] == "auction"]
+        assert sum(p["fills"] for p in man["phases"]) == man["sim_fills"]
+        assert set(reasons) <= {"unknown order id", "order not open"}, \
+            reasons
+    finally:
+        shutdown(server, parts)
+
+
+def test_freeze_rejects_bad_captures(rolled4):
+    """Validation without extra rollouts: misaligned captures are the
+    shared capture with its done flags shifted (a rollout that did not
+    start at the episode boundary presents exactly this shape)."""
+    env, scens, stats, rec = rolled4
+    shifted = stats._replace(done=np.roll(np.asarray(stats.done), 1,
+                                          axis=0))
+    with pytest.raises(ValueError, match="episode"):
+        freeze_episode(env.spec, scens[0], 0, rec, shifted,
+                       "/tmp/never-written.opfile.gz", seed=SEEDS[0])
+    with pytest.raises(ValueError, match="not recorded"):
+        freeze_episode(env.spec, scens[1], 1, rec, stats,
+                       "/tmp/never-written.opfile.gz", seed=SEEDS[1])
+    short = np.asarray(rec)[: scens[0].total_steps() - 1]
+    with pytest.raises(ValueError, match="episode length"):
+        freeze_episode(env.spec, scens[0], 0, short, stats,
+                       "/tmp/never-written.opfile.gz", seed=SEEDS[0])

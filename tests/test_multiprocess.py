@@ -18,15 +18,20 @@ import sys
 import pytest
 
 
-def _cpu_lacks_collectives() -> bool:
+def _probe() -> tuple[bool, str]:
     """Capability probe: multiprocess computations on the CPU backend
-    need the gloo TCP collectives (jaxlib >= 0.4.34, selected by
+    need the gloo TCP collectives (selected by
     parallel/multihost.initialize); without them every worker dies at
     compile time with "Multiprocess computations aren't implemented on
     the CPU backend". Real accelerators don't route through the CPU
     collectives at all, so this only ever skips CPU-only environments
-    pinned to an old jaxlib — the suite runs unchanged elsewhere."""
+    whose jaxlib lacks the capability — the suite runs unchanged
+    elsewhere. Returns (skip, reason) with the reason DERIVED from the
+    live probe result (the versions observed now, not the ones some
+    past environment pinned), so an upgrade that grows the capability
+    un-skips with an accurate explanation."""
     import jax
+    import jaxlib
 
     from matching_engine_tpu.parallel.multihost import (
         cpu_collectives_available,
@@ -36,14 +41,25 @@ def _cpu_lacks_collectives() -> bool:
         platform = jax.default_backend()
     except RuntimeError:
         platform = "cpu"
-    return platform == "cpu" and not cpu_collectives_available()
+    have = cpu_collectives_available()
+    skip = platform == "cpu" and not have
+    jl_ver = getattr(jaxlib, "__version__", "unknown")
+    if skip:
+        reason = (
+            f"CPU backend lacks multiprocess collectives: this jaxlib "
+            f"({jl_ver}) exposes no gloo TCP collectives factory "
+            f"(probe: parallel/multihost.cpu_collectives_available). "
+            f"Runs unchanged on a jaxlib that has it, or on a real "
+            f"accelerator backend.")
+    else:
+        reason = (
+            f"not skipped: backend={platform!r}, jaxlib {jl_ver} gloo "
+            f"TCP collectives available={have}")
+    return skip, reason
 
 
-pytestmark = pytest.mark.skipif(
-    _cpu_lacks_collectives(),
-    reason="CPU backend lacks multiprocess collectives "
-           "(jaxlib without gloo TCP collectives; runs unchanged on "
-           "newer jaxlib or real TPU)")
+_SKIP, _SKIP_REASON = _probe()
+pytestmark = pytest.mark.skipif(_SKIP, reason=_SKIP_REASON)
 
 _WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
 _SERVER_WORKER = os.path.join(os.path.dirname(__file__),
