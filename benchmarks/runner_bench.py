@@ -71,6 +71,21 @@ def main() -> None:
                         "scaling saturates at min(K, host cores): the "
                         "native path's lane build/decode releases the "
                         "GIL, the python path mostly holds it")
+    p.add_argument("--device-sweep", default="",
+                   help="comma list of forced host device counts N to "
+                        "sweep (e.g. 1,2,4,8): each rung boots the "
+                        "shipped server subprocess under XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N with "
+                        "--serve-shards N --shard-devices roundrobin "
+                        "(N=1 = the single-lane baseline), drives the "
+                        "SubmitOrderBatch edge, and samples the "
+                        "me_lane<i>_device / me_device<d>_ops_per_s "
+                        "placement gauges mid-drive. CPU rungs share "
+                        "cores — expect a sublinear slope (BENCH_METHOD"
+                        ".md §device-sweep)")
+    p.add_argument("--device-sweep-batch", type=int, default=1024,
+                   help="records per SubmitOrderBatch request on the "
+                        "device-sweep rungs")
     p.add_argument("--repeats", type=int, default=1,
                    help="repetitions per sharded sweep point; the row "
                         "reports the BEST repetition (uncontended host "
@@ -1107,6 +1122,239 @@ def main() -> None:
                                  / off["orders_per_s"]), 1)
         return rows
 
+    # -- device sweep (forced host devices × sharded serving) --------------
+
+    def device_sweep() -> list:
+        """Linear-scaling probe for mesh-scale serving: for each forced
+        host device count N, boot the shipped server subprocess under
+        ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` with
+        ``--serve-shards N --shard-devices roundrobin`` (N=1 boots the
+        single-lane server — the scaling baseline), drive the
+        SubmitOrderBatch edge from T client threads, and sample the
+        lane/device placement gauges MID-DRIVE (a sampler thread in this
+        bench process scrapes GetMetrics while load runs, keeping the
+        busiest sample — post-drive gauges would show the idle tail).
+
+        Forced host devices share the box's physical cores, so the CPU
+        slope is expected SUBLINEAR (BENCH_METHOD.md §device-sweep);
+        what the rungs isolate is the per-lane shape win ([S/N, B]
+        grids dispatch cheaper than one [S, B]) plus the placement
+        plumbing itself — the slope approaching N belongs to real
+        multi-chip hosts, where each lane's jit lands on its own
+        silicon."""
+        import subprocess
+        import tempfile
+        import threading as _th
+
+        import grpc
+
+        from matching_engine_tpu.domain import oprec
+        from matching_engine_tpu.proto import pb2
+        from matching_engine_tpu.proto.rpc import MatchingEngineStub
+
+        counts = [int(x) for x in args.device_sweep.split(",")
+                  if x.strip()]
+        T = max(1, args.edge_threads)
+        bs = args.device_sweep_batch
+        tmp = tempfile.mkdtemp(prefix="device_sweep_")
+        rows = []
+
+        def boot(n_dev: int):
+            log_path = os.path.join(tmp, f"server_dev{n_dev}.log")
+            argv = [sys.executable, "-m",
+                    "matching_engine_tpu.server.main",
+                    "--addr", "127.0.0.1:0",
+                    "--db", os.path.join(tmp, f"dev{n_dev}.db"),
+                    "--symbols", str(args.symbols),
+                    "--capacity", str(args.capacity),
+                    "--batch", str(args.batch),
+                    "--window-ms", str(args.edge_window_ms),
+                    "--megadispatch-max-waves", str(args.edge_mega),
+                    "--feed-depth", "0"]
+            if n_dev > 1:
+                argv += ["--serve-shards", str(n_dev),
+                         "--shard-devices", "roundrobin"]
+            env = dict(os.environ, PYTHONUNBUFFERED="1",
+                       JAX_PLATFORMS="cpu")
+            kept = [f for f in env.get("XLA_FLAGS", "").split()
+                    if "xla_force_host_platform_device_count" not in f]
+            env["XLA_FLAGS"] = " ".join(
+                kept + ["--xla_force_host_platform_device_count="
+                        f"{n_dev}"]).strip()
+            logf = open(log_path, "w")
+            proc = subprocess.Popen(argv, stdout=logf,
+                                    stderr=subprocess.STDOUT, env=env)
+            port = None
+            deadline = time.time() + 180
+            import re as _re
+
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"device-sweep server (N={n_dev}) "
+                                       f"died at boot; see {log_path}")
+                m = _re.search(r"listening on port (\d+)",
+                               open(log_path).read())
+                if m:
+                    port = int(m.group(1))
+                    break
+                time.sleep(0.25)
+            if port is None:
+                proc.kill()
+                raise RuntimeError(
+                    f"device-sweep server (N={n_dev}) never bound a port")
+            return proc, port, log_path
+
+        def gen_ops(n: int, thread: int):
+            # Maker/taker alternation per symbol (the edge_sweep shape):
+            # books stay shallow for the whole drive.
+            ops = []
+            for i in range(n):
+                sym = f"E{i % args.symbols}"
+                maker = ((i // args.symbols) % 2) == 0
+                ops.append((oprec.OPREC_SUBMIT, 2 if maker else 1, 0,
+                            10_000, 5, sym,
+                            f"dm{thread}" if maker else f"dt{thread}", ""))
+            return ops
+
+        def run_rung(n_dev: int) -> dict:
+            proc, port, log_path = boot(n_dev)
+            try:
+                stubs = [MatchingEngineStub(
+                    grpc.insecure_channel(f"127.0.0.1:{port}"))
+                    for _ in range(T + 1)]
+                scr = stubs[T]
+
+                def drive(n_ops: int, measured: bool) -> dict:
+                    per_thread = max(bs, n_ops // T)
+                    per_thread -= per_thread % bs
+                    work = []
+                    for t in range(T):
+                        arr = oprec.pack_records(gen_ops(per_thread, t))
+                        work.append([oprec.slice_payload(arr, s, bs)
+                                     for s in range(0, per_thread, bs)])
+                    acc = [0] * T
+                    barrier = _th.Barrier(T + 1)
+
+                    def worker(t):
+                        stub = stubs[t]
+                        barrier.wait()
+                        for payload in work[t]:
+                            try:
+                                r = stub.SubmitOrderBatch(
+                                    pb2.OrderBatchRequest(ops=payload),
+                                    timeout=300)
+                                acc[t] += sum(r.ok)
+                            except grpc.RpcError:
+                                pass
+
+                    # The device-sweep sampler: scrape the lane/device
+                    # gauges while the drive runs; keep the busiest
+                    # sample (max summed lane rate).
+                    stop = _th.Event()
+                    best_sample: dict = {}
+
+                    def sampler():
+                        while not stop.wait(0.3):
+                            try:
+                                resp = scr.GetMetrics(
+                                    pb2.MetricsRequest(), timeout=10)
+                            except grpc.RpcError:
+                                continue
+                            g = dict(resp.gauges)
+                            rate = g.get("lane_dispatch_rate", 0.0)
+                            if rate >= best_sample.get(
+                                    "lane_dispatch_rate", 0.0):
+                                best_sample.clear()
+                                best_sample.update(g)
+
+                    threads = [_th.Thread(target=worker, args=(t,),
+                                          daemon=True) for t in range(T)]
+                    samp = None
+                    if measured and n_dev > 1:
+                        samp = _th.Thread(target=sampler, daemon=True)
+                        samp.start()
+                    for th in threads:
+                        th.start()
+                    barrier.wait()
+                    t0 = time.perf_counter()
+                    for th in threads:
+                        th.join()
+                    dt = time.perf_counter() - t0
+                    if samp is not None:
+                        stop.set()
+                        samp.join(timeout=5)
+                        if not best_sample:
+                            # Drive finished before the first sampler
+                            # tick (toy sizes): the placement identity
+                            # gauges are static, so a post-drive scrape
+                            # still answers "which lane on which
+                            # device" (rates show the idle tail).
+                            try:
+                                resp = scr.GetMetrics(
+                                    pb2.MetricsRequest(), timeout=10)
+                                best_sample.update(dict(resp.gauges))
+                            except grpc.RpcError:
+                                pass
+                    if not measured:
+                        return {}
+                    n_total = per_thread * T
+                    row = {
+                        "device_count": n_dev,
+                        "serve_shards": n_dev if n_dev > 1 else 1,
+                        "batch_size": bs,
+                        "threads": T,
+                        "n_ops": n_total,
+                        "accepted": sum(acc),
+                        "orders_per_s": round(n_total / dt, 1),
+                        "wall_s": round(dt, 3),
+                    }
+                    if n_dev > 1 and best_sample:
+                        lanes = {}
+                        devices = {}
+                        for k, v in best_sample.items():
+                            if k.startswith("lane") and \
+                                    k.endswith("_device"):
+                                lanes[k] = int(v)
+                            if k.startswith("device") and \
+                                    k.endswith("_ops_per_s"):
+                                devices[k] = round(v, 1)
+                        row["lane_devices"] = lanes
+                        row["device_ops_per_s"] = devices
+                        row["lane_imbalance"] = round(
+                            best_sample.get("lane_imbalance", 0.0), 2)
+                        row["sampled_lane_rate"] = round(
+                            best_sample.get("lane_dispatch_rate", 0.0), 1)
+                    return row
+
+                drive(2 * bs * T, measured=False)   # compile the shapes
+                reps = [drive(args.edge_ops, measured=True)
+                        for _ in range(max(1, args.repeats))]
+                rates = [r["orders_per_s"] for r in reps]
+                best = max(reps, key=lambda r: r["orders_per_s"])
+                best["repeats"] = len(reps)
+                best["orders_per_s_spread"] = [min(rates), max(rates)]
+                print(f"[device-sweep] N={n_dev}: "
+                      f"{best['orders_per_s']} orders/s "
+                      f"(imbalance {best.get('lane_imbalance', '-')}, "
+                      f"devices {best.get('device_ops_per_s', '-')})",
+                      file=sys.stderr)
+                return best
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+
+        for n_dev in counts:
+            rows.append(run_rung(n_dev))
+        base = next((r["orders_per_s"] for r in rows
+                     if r["device_count"] == 1), None)
+        for r in rows:
+            if base:
+                r["speedup_vs_1"] = round(r["orders_per_s"] / base, 3)
+        return rows
+
     # -- zero-copy ingress rung sweep --------------------------------------
 
     def ingress_sweep() -> list:
@@ -1865,6 +2113,8 @@ def main() -> None:
                   if k.strip()] if args.serve_shards else []
     if args.capacity_sweep:
         rows = capacity_sweep()
+    elif args.device_sweep:
+        rows = device_sweep()
     elif args.ingress:
         rows = ingress_sweep()
     elif args.workload:
@@ -1968,6 +2218,7 @@ def main() -> None:
         rev = "unknown"
     out = {
         "metric": ("kernel_capacity_sweep" if args.capacity_sweep
+                   else "device_mesh_serving" if args.device_sweep
                    else "ingress_rungs" if args.ingress
                    else "workload_replay" if args.workload
                    else "batch_edge_audit_ab" if args.edge_batch
@@ -1988,6 +2239,12 @@ def main() -> None:
         "git_rev": rev,
     }
     if args.edge_batch:
+        out["edge_mega"] = args.edge_mega
+        out["edge_window_ms"] = args.edge_window_ms
+    if args.device_sweep:
+        out["device_counts"] = [int(x) for x in
+                                args.device_sweep.split(",") if x.strip()]
+        out["device_sweep_batch"] = args.device_sweep_batch
         out["edge_mega"] = args.edge_mega
         out["edge_window_ms"] = args.edge_window_ms
     if args.workload:

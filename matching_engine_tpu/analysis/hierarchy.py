@@ -61,6 +61,16 @@ LEVELS: dict[str, tuple[str, ...]] = {
     # the shm poller, the gateway bridge's forwarded batch). Nothing
     # nests inside it — the lock body is numpy passes + dict updates.
     "admission": ("AdmissionScreens._lock",),
+    # Feed fan-in (feed/fanin.py, --feed-fanin merged): each lane's
+    # publisher lock makes the (lane_seq++, enqueue) pair atomic — the
+    # merger's contiguity check depends on queue order == seq order per
+    # lane. A leaf: the body is an increment and a Queue.put.
+    "fanin_lane": ("LaneFeedPublisher._lock",),
+    # The cross-lane auction barrier (server/shards.py): each lane's
+    # barrier worker votes under this lock while HOLDING its own lane's
+    # dispatch lock — the one sanctioned cross-lane rendezvous. A leaf:
+    # the body mutates vote counters and sets an Event.
+    "barrier": ("_AuctionBarrier._lock",),
 }
 
 # -- the declared partial order ---------------------------------------------
@@ -103,6 +113,15 @@ ORDER: tuple[tuple[str, str], ...] = (
     # wrapper hands off to the inner sink under its own lock.
     ("sink_spill", "sink"),
     ("sink", "store"),
+    # Merged feed fan-in: the runner/dispatcher publish tail (still under
+    # the dispatch lock on the auction path) enqueues through the lane
+    # publisher's leaf lock instead of the hub.
+    ("dispatch", "fanin_lane"),
+    # Cross-lane auction barrier: run_auction_phased votes (barrier lock)
+    # while holding ITS OWN lane's dispatch lock. K workers each hold a
+    # DIFFERENT dispatch-lock instance, so the shared barrier lock is the
+    # only cross-lane acquisition — no cycle is expressible.
+    ("dispatch", "barrier"),
 )
 
 # -- effects forbidden while holding a lock ---------------------------------
@@ -172,6 +191,10 @@ ATTR_TYPES: dict[str, str | None] = {
     # several share method names with analyzed classes (Metrics.observe
     # vs InvariantAuditor.observe).
     "metrics": None,
+    "fanin": "FeedFanIn",
+    "_fanin": "FeedFanIn",
+    "_real_hub": "StreamHub",       # LaneFeedPublisher's delegation target
+    "barrier": "_AuctionBarrier",
     "q": None,
     "queue": None,
     "logger": None,
@@ -244,6 +267,17 @@ THREAD_ROLES: dict[str, tuple[str, ...]] = {
     # service's shared batch pipeline (admission + routing + dispatch),
     # and answers through the response ring.
     "shm_poller": ("ShmIngress._run",),
+    # The merged feed fan-in's single merger (feed/fanin.py): drains the
+    # K lanes' publish queue, enforces per-lane seq contiguity, delivers
+    # into the real hub — the only thread contending for the hub lock in
+    # merged mode.
+    "feed_merger": ("FeedFanIn._run",),
+    # Cross-lane auction barrier workers (server/shards.py): one per
+    # lane for the all-symbols uncross, each driving its OWN lane's
+    # run_auction_phased and voting into the two-phase barrier. (The
+    # device-sweep bench observes the booted server from outside the
+    # scanned tree; its in-server sampling is the "sampler" role.)
+    "auction_barrier": ("ServingShards._barrier_lane",),
 }
 
 # -- shared-state ownership --------------------------------------------------
@@ -547,7 +581,7 @@ DETERMINISM_WAIVERS: frozenset[tuple[str, str, str]] = frozenset({
     # deterministic function of the op log; per-symbol feed domains make
     # the cross-symbol interleaving irrelevant to per-domain seq lines.
     ("determinism/unordered-iteration", "<locals>.finalize_sparse", "*"),
-    ("determinism/unordered-iteration", "EngineRunner._run_auction_locked",
+    ("determinism/unordered-iteration", "EngineRunner._auction_commit_locked",
      "*"),
 })
 

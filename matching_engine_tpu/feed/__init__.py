@@ -17,6 +17,7 @@ lost — global counters would make every other key's traffic look like a
 gap. See docs/OPERATIONS.md "Sequenced feed".
 """
 
+from matching_engine_tpu.feed.fanin import FeedFanIn, LaneFeedPublisher
 from matching_engine_tpu.feed.sequencer import (
     AUDIT_DOMAIN_KEY,
     CHANNEL_AUDIT,
@@ -27,4 +28,5 @@ from matching_engine_tpu.feed.sequencer import (
 )
 
 __all__ = ["AUDIT_DOMAIN_KEY", "CHANNEL_AUDIT", "CHANNEL_MD", "CHANNEL_OU",
-           "FeedSequencer", "RetransmissionRing"]
+           "FeedFanIn", "FeedSequencer", "LaneFeedPublisher",
+           "RetransmissionRing"]

@@ -1,0 +1,262 @@
+"""Epoch-consistent feed fan-in: K serving lanes → one venue stream.
+
+With ``--serve-shards K`` every lane publishes market data, order
+updates, op-log and drop-copy rows into ONE StreamHub, whose single lock
+stamps (FeedSequencer) and fans out atomically. That atomicity is the
+correctness anchor of the feed layer — and, at K lanes, its scaling
+ceiling: every dispatch on every lane serializes its publish tail
+through the same hub lock, so feed publishing re-couples the lanes the
+shard partition exists to decouple.
+
+This module decouples them with a SEQUENCED MERGE (``--feed-fanin
+merged``):
+
+- Each lane publishes through its own `LaneFeedPublisher` — a hub facade
+  with its own lock and its own sequencer domain: a per-lane monotonic
+  `lane_seq` plus the venue epoch, stamped atomically with enqueue into
+  the shared merge queue. A lane's publish tail now costs one uncontended
+  lock + one queue put, regardless of K.
+- One `FeedFanIn` merger thread (declared role "feed_merger") drains the
+  queue, enforces per-lane seq contiguity (out-of-order items park in a
+  per-lane reorder buffer; a hole that outlives the gap window is
+  DECLARED — ``feed_fanin_gaps`` counts the missing items — and delivery
+  continues, mirroring the consumer-side gap-fill contract in
+  feed/client.py), and delivers into the real hub. Venue-order stamping
+  is UNCHANGED: the merger calls the same `hub.publish_*` entry points,
+  so the FeedSequencer stamps inside the hub lock exactly as before —
+  but now exactly ONE thread ever contends for it. The auditor's
+  stamp-order invariant (observer inside the hub lock) holds for free:
+  a single merger delivers in merge order.
+
+Venue order across lanes is ARRIVAL order at the merge (within a lane:
+lane_seq order, always). That is the same contract the locked hub gave —
+cross-lane interleaving was lock-acquisition order there — so single-hub
+mode (``--feed-fanin hub``, the default and the K=1 path) stays
+bit-parity-pinned while merged mode changes only WHO serializes.
+
+Trade-off (documented in OPERATIONS.md): merged mode defers the stamp
+until the merger delivers, so a dispatch can retire before its feed
+events are retained — a crash window the synchronous hub didn't have.
+The feed layer is loss-ACCOUNTING by design (seq gaps are detectable and
+replayable); deployments that need stamp-before-ack keep ``hub`` mode.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from matching_engine_tpu.utils.obs import warn_rate_limited
+
+_CLOSE = object()
+
+# Payload kinds riding the merge queue.
+_MD, _OU, _OPLOG, _AUDIT = 0, 1, 2, 3
+
+# How long a per-lane seq hole may park younger items before the merger
+# declares the gap and moves on. Generous: holes only occur when a
+# publisher died mid-publish (or a test injected one) — contiguous
+# enqueue is atomic with the seq stamp on the healthy path.
+GAP_WAIT_S = 0.25
+
+
+class LaneFeedPublisher:
+    """One lane's hub facade: its own sequencer domain (venue epoch +
+    per-lane monotonic seq), its own lock, publishing into the shared
+    merge queue. Mirrors the StreamHub publish/peek surface the
+    dispatcher, runner and drop-copy paths touch; subscription
+    management stays on the real hub (readers attach there)."""
+
+    def __init__(self, fanin: "FeedFanIn", lane_id: int):
+        self._fanin = fanin
+        self._lane_id = lane_id
+        self._real_hub = fanin.hub
+        # LEVEL "fanin_lane": leaf on the publish path — held only for
+        # the (seq++, enqueue) pair, which MUST be atomic: the merger's
+        # contiguity check assumes a lane's items enter the queue in seq
+        # order (auction/barrier/drop-copy threads publish on a lane too,
+        # not just its dispatcher).
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- peeks / identity (delegated: the real hub owns subscriptions) --
+
+    @property
+    def sequencer(self):
+        return self._real_hub.sequencer
+
+    def has_market_data_subs(self) -> bool:
+        return self._real_hub.has_market_data_subs()
+
+    def has_order_update_subs(self) -> bool:
+        return self._real_hub.has_order_update_subs()
+
+    # -- publish surface -----------------------------------------------
+
+    def _submit(self, kind: int, payload) -> None:
+        seqr = self._real_hub.sequencer
+        epoch = seqr.epoch if seqr is not None else 0
+        with self._lock:
+            self._seq += 1
+            self._fanin._q.put(
+                (self._lane_id, epoch, self._seq, kind, payload))
+
+    def publish_market_data(self, updates) -> None:
+        if updates:
+            self._submit(_MD, updates)
+
+    def publish_order_updates(self, updates) -> None:
+        if updates:
+            self._submit(_OU, updates)
+
+    def publish_oplog(self, updates) -> None:
+        if updates:
+            self._submit(_OPLOG, updates)
+
+    def publish_audit_rows(self, rows, env, n: int, drop=None,
+                           observer=None) -> list[int]:
+        """Async contract: seqs are assigned at merge delivery, so this
+        returns [] — the merger increments ``audit_records`` itself
+        (audit/dropcopy.py only uses the return for that counter)."""
+        self._submit(_AUDIT, (rows, env, n, drop, observer))
+        return []
+
+
+class _LaneMergeState:
+    __slots__ = ("expected", "parked", "deadline")
+
+    def __init__(self):
+        self.expected = 1          # next lane_seq due from this lane
+        self.parked: dict = {}     # lane_seq -> queued item (reorder buf)
+        self.deadline = 0.0        # when the oldest hole is declared
+
+
+class FeedFanIn:
+    """The merge point: K LaneFeedPublishers → one merger thread → the
+    real StreamHub. Construct with the real hub, hand
+    ``lane_publisher(i)`` to each lane's runner/dispatcher/drop-copy as
+    their `hub`, and close() AFTER the lanes' dispatchers (drains every
+    queued publish before returning)."""
+
+    def __init__(self, hub, num_lanes: int, metrics=None,
+                 gap_wait_s: float = GAP_WAIT_S):
+        self.hub = hub
+        self.metrics = metrics
+        self._gap_wait_s = gap_wait_s
+        self._q: queue.Queue = queue.Queue()   # unbounded: put never blocks
+        self._state = [_LaneMergeState() for _ in range(num_lanes)]
+        self._closed = False
+        self._merger = threading.Thread(
+            target=self._run, name="feed-fanin-merger", daemon=True)
+        self._merger.start()
+
+    def lane_publisher(self, lane_id: int) -> LaneFeedPublisher:
+        return LaneFeedPublisher(self, lane_id)
+
+    # -- merger thread (declared role "feed_merger") --------------------
+
+    def _run(self) -> None:
+        while True:
+            # Poll at a CONSTANT fraction of the gap window while any
+            # hole is parked (deadline math must not flow into the get:
+            # its result carries the payloads onto the replay surfaces,
+            # and a wall-clock-derived timeout would taint them for the
+            # determinism analyzer); block indefinitely when contiguous.
+            timeout = None
+            if any(st.parked for st in self._state):
+                timeout = self._gap_wait_s / 4
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                self._expire_gaps()
+                continue
+            if item is _CLOSE:
+                # Everything enqueued before close() is already drained
+                # (FIFO); flush any parked tail as declared gaps so no
+                # delivered-after-a-hole item is silently dropped.
+                self._expire_gaps(force=True)
+                return
+            self._ingest(item)
+
+    def _ingest(self, item) -> None:
+        lane, _epoch, seq, kind, payload = item
+        st = self._state[lane]
+        if seq == st.expected:
+            st.expected += 1
+            self._deliver(kind, payload)
+            while st.expected in st.parked:
+                _, k, p = st.parked.pop(st.expected)
+                st.expected += 1
+                self._deliver(k, p)
+            if st.parked:
+                st.deadline = time.monotonic() + self._gap_wait_s
+        elif seq > st.expected:
+            # Hole in the lane's seq line: park until contiguity resumes
+            # or the gap window lapses.
+            if not st.parked:
+                st.deadline = time.monotonic() + self._gap_wait_s
+            st.parked[seq] = (seq, kind, payload)
+        else:
+            # Duplicate/stale (seq already delivered or declared lost).
+            if self.metrics is not None:
+                self.metrics.inc("feed_fanin_dups")
+
+    def _expire_gaps(self, force: bool = False) -> None:
+        now = time.monotonic()
+        for lane in range(len(self._state)):
+            st = self._state[lane]
+            if not st.parked or (not force and now < st.deadline):
+                continue
+            head = min(st.parked)
+            missing = head - st.expected
+            if self.metrics is not None:
+                self.metrics.inc("feed_fanin_gaps", missing)
+            warn_rate_limited(
+                "feed-fanin", f"lane {lane}: declared gap of {missing} "
+                f"publish batch(es) (seq {st.expected}..{head - 1}); "
+                f"resuming at {head}")
+            st.expected = head
+            while st.expected in st.parked:
+                _, k, p = st.parked.pop(st.expected)
+                st.expected += 1
+                self._deliver(k, p)
+            if st.parked:
+                st.deadline = now + self._gap_wait_s
+
+    def _deliver(self, kind: int, payload) -> None:
+        try:
+            if kind == _MD:
+                self.hub.publish_market_data(payload)
+            elif kind == _OU:
+                self.hub.publish_order_updates(payload)
+            elif kind == _OPLOG:
+                self.hub.publish_oplog(payload)
+            else:
+                rows, env, n, drop, observer = payload
+                delivered = self.hub.publish_audit_rows(
+                    rows, env, n, drop=drop, observer=observer)
+                if delivered and self.metrics is not None:
+                    # The lane facade returned [] to dropcopy; the real
+                    # count lands here (same counter, same meaning).
+                    self.metrics.inc("audit_records", len(delivered))
+        except Exception as e:
+            if self.metrics is not None:
+                self.metrics.inc("feed_fanin_errors")
+            warn_rate_limited(
+                "feed-fanin", f"merge delivery failed: "
+                f"{type(e).__name__}: {e}")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain-then-stop: every publish enqueued before this call is
+        delivered (the close sentinel is FIFO-ordered behind them).
+        Call after the lane dispatchers are closed — late publishers
+        racing close() may lose their tail, exactly like publishing
+        into a closed hub."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_CLOSE)
+        self._merger.join(timeout=10)

@@ -990,9 +990,108 @@ class EngineRunner:
             self.flush_owner_ids()
         return summary
 
-    def _run_auction_locked(self, symbols, sink) -> dict:
-        from matching_engine_tpu.server.dispatcher import publish_result
+    def run_auction_phased(self, decide, sink=None) -> dict:
+        """Two-phase cross-lane uncross, driven by the serving shard
+        barrier (server/shards.py): quiesce this lane under its dispatch
+        lock, snapshot books, run the device uncross (prepare), then call
+        `decide(ok, error)` — the barrier's vote-and-wait, which returns
+        True only when EVERY lane prepared cleanly. On True the prepared
+        uncross commits exactly like run_auction; on False the book
+        snapshot is restored, leaving the lane bit-identical to never
+        having auctioned (all-or-nothing ACROSS lanes, the cross-lane
+        analogue of the kernel's per-shard all-or-nothing). Always
+        all-symbols: the barrier exists for venue-wide uncross points."""
+        posts: list = []
+        summary = None
+        try:
+            with self._dispatch_lock, Timer(self.metrics,
+                                            "engine_dispatch_us"):
+                self._finish_pending_locked(posts)
+                try:
+                    prep = self.auction_prepare(None)
+                except Exception as e:
+                    # Vote abort BEFORE propagating so peer lanes are
+                    # released from the barrier rather than timing out.
+                    decide(False, f"{type(e).__name__}: {e}")
+                    raise
+                err = prep["error"]
+                if decide(not err, err):
+                    summary = self.auction_commit(prep, sink)
+                    self.maybe_rebase_seqs()
+                else:
+                    self.auction_abort(prep)
+                    summary = {"crossed": [], "aborted": True,
+                               "error": err or "cross-lane barrier abort",
+                               "warning": ""}
+        finally:
+            for p in posts:
+                p()
+            self.flush_auction_mode()
+            self.flush_owner_ids()
+        return summary
 
+    def auction_prepare(self, symbols) -> dict:
+        """Barrier phase 1 (call under the dispatch lock with the pipeline
+        drained): snapshot books, then run the device uncross and abort
+        analysis WITHOUT any host/directory mutation. The returned prep
+        dict feeds exactly one of auction_commit / auction_abort."""
+        saved = self._auction_books_copy()
+        prep = self._auction_prepare_locked(symbols)
+        prep["saved_books"] = saved
+        return prep
+
+    def auction_commit(self, prep, sink=None) -> dict:
+        """Barrier phase 2a: apply the prepared uncross's host mutations
+        (directories, storage rows, stream/drop-copy publishes, metrics)
+        and drop the book snapshot. Same summary shape as run_auction."""
+        prep.pop("saved_books", None)
+        return self._auction_commit_locked(prep, sink)
+
+    def auction_abort(self, prep) -> None:
+        """Barrier phase 2b: restore the pre-auction book snapshot so the
+        lane is bit-identical to never having auctioned. Directories were
+        never touched (prepare is mutation-free), so only device state
+        rolls back."""
+        saved = prep.pop("saved_books", None)
+        if saved is not None:
+            with self._snapshot_lock:
+                self._auction_books_restore(saved)
+
+    def _copy_book_tree(self, tree):
+        """Deep (host round-trip) copy of a book pytree. A plain
+        device_put of a device array may ALIAS the source buffers, and
+        the auction step DONATES the live book — the snapshot must own
+        distinct memory or the restore would resurrect deleted buffers.
+        Auctions are rare control-plane ops; one [S]-book round trip is
+        acceptable."""
+        def _copy(leaf):
+            host = np.asarray(leaf)
+            try:
+                # Preserves placement for both single-device (committed
+                # lane) and mesh-sharded leaves.
+                return jax.device_put(host, leaf.sharding)
+            except (AttributeError, ValueError):
+                dev = getattr(self, "device", None)
+                return (jax.device_put(host, dev) if dev is not None
+                        else jax.device_put(host))
+        return jax.tree_util.tree_map(_copy, tree)
+
+    def _auction_books_copy(self):
+        with self._snapshot_lock:
+            return self._copy_book_tree(self.book)
+
+    def _auction_books_restore(self, saved) -> None:
+        # Caller holds _snapshot_lock (auction_abort).
+        self.book = saved
+
+    def _run_auction_locked(self, symbols, sink) -> dict:
+        prep = self._auction_prepare_locked(symbols)
+        if prep["error"]:
+            return {"crossed": [], "aborted": prep["aborted"],
+                    "error": prep["error"], "warning": ""}
+        return self._auction_commit_locked(prep, sink)
+
+    def _auction_prepare_locked(self, symbols) -> dict:
         from matching_engine_tpu.engine.book import auction_capacity_max
 
         if self.cfg.capacity > auction_capacity_max(self.cfg.kernel):
@@ -1000,7 +1099,7 @@ class EngineRunner:
             # constructor admits (matrix <= 1024 < 1073; sorted <= 8192
             # with the wide-sum uncross) — kept so a future capacity
             # bump cannot silently run a wrapping uncross.
-            return {"crossed": [], "aborted": False, "warning": "",
+            return {"symbols": symbols, "aborted": False,
                     "error": f"call auction unsupported at capacity "
                              f"{self.cfg.capacity} (kernel "
                              f"{self.cfg.kernel}); max supported is "
@@ -1027,9 +1126,25 @@ class EngineRunner:
                                if wanted is None or n in wanted]
             if requested_slots and all(
                     slot_aborted(s) for s in requested_slots):
-                return {"crossed": [], "aborted": True,
+                return {"symbols": symbols, "aborted": True,
                         "error": "fill buffer too small for the uncross "
-                                 "(raise max_fills)", "warning": ""}
+                                 "(raise max_fills)"}
+        return {"symbols": symbols, "aborted": aborted_shards > 0,
+                "error": "", "lo": lo, "clear_price": clear_price,
+                "executed": executed, "best_bid": best_bid,
+                "bid_size": bid_size, "best_ask": best_ask,
+                "ask_size": ask_size, "fills": fills,
+                "aborted_shards": aborted_shards}
+
+    def _auction_commit_locked(self, prep, sink) -> dict:
+        from matching_engine_tpu.server.dispatcher import publish_result
+
+        symbols = prep["symbols"]
+        lo, fills = prep["lo"], prep["fills"]
+        clear_price, executed = prep["clear_price"], prep["executed"]
+        best_bid, bid_size = prep["best_bid"], prep["bid_size"]
+        best_ask, ask_size = prep["best_ask"], prep["ask_size"]
+        aborted_shards = prep["aborted_shards"]
 
         res = DispatchResult([], [], [], [], [], [], len(fills))
         touched: dict[int, OrderInfo] = {}

@@ -125,6 +125,16 @@ def _boot_runner(make, storage, owner_rows, ckpt_root, log, tag=""):
     return runner
 
 
+def config_error(combo: str, detail: str, supported: str) -> None:
+    """Structured boot refusal: ONE parseable stderr line naming the
+    refused flag combination, why, and the supported alternatives —
+    mirroring the compatibility matrix in docs/OPERATIONS.md so an
+    operator (or a boot-wrapping script grepping CONFIG-ERROR) gets the
+    fix, not just the failure."""
+    print(f"[SERVER] CONFIG-ERROR combo=[{combo}]: {detail}; "
+          f"supported: {supported}", file=sys.stderr)
+
+
 def build_server(
     addr: str,
     db_path: str,
@@ -163,6 +173,8 @@ def build_server(
     shm_slots: int = 4096,
     shm_resp_slots: int = 8192,
     shm_torn_ms: float = 50.0,
+    shard_devices: str | None = None,
+    feed_fanin: str = "hub",
 ):
     """Wire the full stack; returns (grpc server, bound port, parts dict).
 
@@ -267,6 +279,41 @@ def build_server(
                                   spill_dir=feed_spill_dir)
     hub = StreamHub(maxsize=stream_maxsize, metrics=metrics,
                     sequencer=sequencer)
+    # Epoch-consistent feed fan-in (--feed-fanin merged, feed/fanin.py):
+    # each lane publishes through its own sequencer domain (per-lane seq
+    # + venue epoch) into one merger thread, so K lanes stop serializing
+    # their publish tails through the hub lock. "hub" (default) keeps
+    # the single locked hub — the K=1/compat path, bit-parity pinned.
+    fanin = None
+    if feed_fanin not in ("hub", "merged"):
+        print(f"[SERVER] --feed-fanin {feed_fanin!r}: expected hub|merged",
+              file=sys.stderr)
+        raise SystemExit(3)
+    if feed_fanin == "merged":
+        # Enforced HERE, not only in main()'s argv parsing (programmatic
+        # callers take the same seam):
+        if serve_shards <= 1:
+            config_error(
+                "--feed-fanin merged without --serve-shards K>1",
+                "the merge exists to decouple K lanes' publish tails",
+                "--feed-fanin merged with --serve-shards K>1; "
+                "--feed-fanin hub at any K")
+            raise SystemExit(3)
+        if gateway_addr is not None or standby_addr is not None:
+            config_error(
+                "--feed-fanin merged with --gateway-addr/--standby",
+                "the gateway bridge and the standby applier publish "
+                "through the hub directly — bypassing the merge would "
+                "interleave stamped and unstamped lanes",
+                "--feed-fanin merged with the grpcio/shm edges on a "
+                "primary; --feed-fanin hub otherwise")
+            raise SystemExit(3)
+        from matching_engine_tpu.feed.fanin import FeedFanIn
+
+        fanin = FeedFanIn(hub, serve_shards, metrics=metrics)
+        if log:
+            print(f"[SERVER] feed fan-in: sequenced merge over "
+                  f"{serve_shards} lane domains")
     # Online surveillance (--audit, matching_engine_tpu/audit/): a
     # per-lane DropCopyPublisher republishes every dispatch's storage
     # rows as sequenced lifecycle records at the decode boundary, and ONE
@@ -296,13 +343,17 @@ def build_server(
         # lane's decode order, interleaved) is the audit stamp order.
         audit_pump = AuditPump(metrics)
 
-    def make_dropcopy(r):
+    def make_dropcopy(r, lane_hub=None):
         if auditor is None:
             return None
         from matching_engine_tpu.audit import DropCopyPublisher
 
-        r.dropcopy = DropCopyPublisher(hub, metrics, auditor=auditor,
-                                       runner=r, pump=audit_pump)
+        # With merged fan-in the lane's drop-copy rows ride its sequencer
+        # domain too (the audit stamp-order invariant holds because ONE
+        # merger delivers into the hub lock in merge order).
+        r.dropcopy = DropCopyPublisher(
+            lane_hub if lane_hub is not None else hub, metrics,
+            auditor=auditor, runner=r, pump=audit_pump)
         return r.dropcopy
 
     # Warm-standby replication, primary side (--oplog-ship,
@@ -392,16 +443,37 @@ def build_server(
             ServingLane,
             ShardRouter,
             make_lane_runner,
+            parse_shard_devices,
         )
 
         router = ShardRouter(serve_shards)
+        try:
+            # Device-aware placement: each lane's books and jit
+            # executables commit to its device (EngineRunner device_put's
+            # at construction; jit dispatches follow the operands).
+            placement = parse_shard_devices(shard_devices, serve_shards)
+        except ValueError as e:
+            print(f"[SERVER] bad --shard-devices: {e}", file=sys.stderr)
+            raise SystemExit(3)
+        # ONE publisher per lane: a lane's seq domain must be a single
+        # monotonic line across its runner, dispatcher and drop-copy.
+        lane_hubs = [fanin.lane_publisher(i) if fanin is not None else hub
+                     for i in range(serve_shards)]
+        if log and any(d is not None for d in placement):
+            placed = ", ".join(
+                f"lane{i}->dev{getattr(d, 'id', '?')}" if d is not None
+                else f"lane{i}->default"
+                for i, d in enumerate(placement))
+            print(f"[SERVER] shard placement "
+                  f"({shard_devices or 'auto'}): {placed}")
         lanes = []
         for i in range(serve_shards):
             lanes.append(ServingLane(i, _boot_runner(
                 lambda _i=i: make_lane_runner(
-                    cfg, router, _i, metrics=metrics, hub=hub,
+                    cfg, router, _i, metrics=metrics, hub=lane_hubs[_i],
                     pipeline_inflight=pipeline_inflight,
                     native_lanes=native_lanes,
+                    device=placement[_i],
                     megadispatch_max_waves=megadispatch_max_waves,
                     tier_pins=tier_pins),
                 storage, owner_rows,
@@ -524,13 +596,15 @@ def build_server(
                 # serving loop can dispatch.
                 lane.runner.adopt_from_python()
             lane.dispatcher = make_lane_dispatcher(
-                lane.runner, sink=sink, hub=hub, window_ms=window_ms,
+                lane.runner, sink=sink, hub=lane_hubs[lane.shard_id],
+                window_ms=window_ms,
                 metrics=metrics, native=use_native,
                 native_lanes=native_lanes,
                 mega_max_waves=megadispatch_max_waves,
                 mega_latency_us=megadispatch_latency_us,
                 busy_poll_us=busy_poll_us,
-                dropcopy=make_dropcopy(lane.runner),
+                dropcopy=make_dropcopy(lane.runner,
+                                       lane_hubs[lane.shard_id]),
                 oplog=oplog_shipper, lane_id=lane.shard_id)
         shards = ServingShards(lanes, router, metrics=metrics, sink=sink)
         dispatcher = lanes[0].dispatcher
@@ -704,6 +778,7 @@ def build_server(
         "auditor": auditor, "audit_pump": audit_pump,
         "oplog": oplog_shipper, "replica": replica, "runners": runners,
         "shm_ingress": shm_ingress, "admission": admission,
+        "fanin": fanin,
     }
     return server, port, parts
 
@@ -729,6 +804,11 @@ def shutdown(server, parts, grace_s: float = 2.0) -> None:
         parts["shards"].close()  # every lane's dispatcher + the sampler
     else:
         parts["dispatcher"].close()
+    if parts.get("fanin") is not None:
+        # AFTER the lane dispatchers (no new publishes), BEFORE the
+        # sequencer flush: the merger drains every queued lane publish
+        # into the hub — stamping/retaining them — then exits.
+        parts["fanin"].close()
     if parts.get("sequencer") is not None:
         # Drain the spill flusher (completes any in-flight gap-fill
         # window and leaves a forensic record of the tail). The store —
@@ -940,9 +1020,43 @@ def main(argv=None) -> int:
                         "allocation, per-lane checkpoints under "
                         "<dir>/shard-<i>. K must divide --symbols; "
                         "incompatible with --mesh (1 = off)")
+    p.add_argument("--shard-devices", default="auto", metavar="POLICY",
+                   help="with --serve-shards: lane->device placement "
+                        "policy. 'auto' (default) round-robins lanes "
+                        "across all visible devices when more than one "
+                        "is visible; 'roundrobin' always places "
+                        "explicitly (lane i -> device i%%D, even at "
+                        "D=1); 'pinned:<o0,o1,...>' gives exactly one "
+                        "device ordinal per lane (e.g. pinned:0,0,1,1). "
+                        "Each lane's books and jit executables commit "
+                        "to its device. See the OPERATIONS.md "
+                        "compatibility matrix")
+    p.add_argument("--feed-fanin", choices=("hub", "merged"),
+                   default="hub",
+                   help="with --serve-shards: feed publication topology. "
+                        "'hub' (default, and the K=1 path) stamps every "
+                        "lane's events under the one StreamHub lock; "
+                        "'merged' gives each lane its own sequencer "
+                        "domain (per-lane seq + venue epoch) feeding ONE "
+                        "merger thread that enforces per-lane seq "
+                        "contiguity (gap-fill aware, "
+                        "me_feed_fanin_gaps_total) and delivers into "
+                        "the hub — lanes stop serializing their publish "
+                        "tails through the hub lock. Incompatible with "
+                        "--gateway-addr/--standby")
     p.add_argument("--mesh", type=int, default=0, metavar="N",
                    help="shard the symbol axis over an N-device mesh "
                         "(0 = single device); N must divide --symbols")
+    p.add_argument("--mesh-serve", action="store_true",
+                   help="serve ONE mesh-sharded engine over ALL visible "
+                        "devices (sugar for --mesh <device count>): the "
+                        "serving dispatcher drives parallel/sharding.py's "
+                        "ShardedEngine — one shard_map'd jit stepping "
+                        "every device per dispatch. The measurable "
+                        "counterpart to --serve-shards+--shard-devices "
+                        "(K independent jits); see BENCH_METHOD "
+                        "§device-sweep. Carries --mesh's compatibility "
+                        "constraints")
     p.add_argument("--gateway-addr", default=None, metavar="HOST:PORT",
                    help="also serve through the C++ gRPC gateway on this "
                         "address (port 0 = OS-assigned)")
@@ -1071,6 +1185,26 @@ def main(argv=None) -> int:
         except Exception:  # noqa: BLE001 — older jax: run uncached
             pass
 
+    if args.mesh_serve:
+        if args.mesh:
+            config_error(
+                "--mesh-serve with --mesh N",
+                "--mesh-serve IS --mesh sized to every visible device",
+                "--mesh-serve alone, or an explicit --mesh N")
+            return 3
+        if args.serve_shards > 1:
+            config_error(
+                "--mesh-serve with --serve-shards",
+                "one meshed jit vs K independent jits: pick one cut",
+                "--serve-shards K [--shard-devices POLICY] for "
+                "partitioned lanes; --mesh-serve for the shard_map'd "
+                "engine")
+            return 3
+        import jax
+
+        args.mesh = len(jax.devices())
+        print(f"[SERVER] --mesh-serve: meshing all "
+              f"{args.mesh} visible device(s)")
     try:
         mesh = resolve_mesh(args.mesh, args.symbols)
     except ValueError as e:
@@ -1079,6 +1213,13 @@ def main(argv=None) -> int:
     if args.native_lanes and (mesh is not None or args.no_native):
         print("[SERVER] --native-lanes is single-device and needs the "
               "native runtime (drop --mesh/--no-native)", file=sys.stderr)
+        return 3
+    if args.shard_devices != "auto" and args.serve_shards <= 1:
+        config_error(
+            "--shard-devices without --serve-shards K>1",
+            "placement policies place the K partitioned lanes",
+            "--serve-shards K --shard-devices auto|roundrobin|"
+            "pinned:<o0,..,oK-1>; --mesh-serve places via the mesh")
         return 3
     if args.serve_shards > 1:
         if mesh is not None:
@@ -1090,11 +1231,37 @@ def main(argv=None) -> int:
             print(f"[SERVER] --symbols {args.symbols} not divisible by "
                   f"--serve-shards {args.serve_shards}", file=sys.stderr)
             return 3
+        from matching_engine_tpu.server.shards import parse_shard_devices
+
+        try:
+            parse_shard_devices(args.shard_devices, args.serve_shards)
+        except ValueError as e:
+            print(f"[SERVER] bad --shard-devices: {e}", file=sys.stderr)
+            return 3
         if args.native_lanes and args.gateway_addr is not None:
-            print("[SERVER] the C++ gateway's native-lane drain is "
-                  "single-lane; with --serve-shards use the gateway's "
-                  "python dispatch route (drop --native-lanes) or the "
-                  "grpcio edge", file=sys.stderr)
+            config_error(
+                "--serve-shards with --native-lanes and --gateway-addr",
+                "the C++ gateway's native-lane drain is single-lane",
+                "--serve-shards + --gateway-addr (python dispatch "
+                "route); --serve-shards + --native-lanes on the "
+                "grpcio/shm edges; --native-lanes + --gateway-addr "
+                "single-lane")
+            return 3
+    if args.feed_fanin == "merged":
+        if args.serve_shards <= 1:
+            config_error(
+                "--feed-fanin merged without --serve-shards K>1",
+                "the merge exists to decouple K lanes' publish tails",
+                "--feed-fanin merged with --serve-shards K>1; "
+                "--feed-fanin hub at any K")
+            return 3
+        if args.gateway_addr is not None or args.standby:
+            config_error(
+                "--feed-fanin merged with --gateway-addr/--standby",
+                "the gateway bridge and the standby applier publish "
+                "through the hub directly, bypassing the merge",
+                "--feed-fanin merged on a primary's grpcio/shm edges; "
+                "--feed-fanin hub otherwise")
             return 3
     if args.oplog_ship or args.standby:
         if args.native_lanes or args.gateway_addr is not None \
@@ -1196,6 +1363,8 @@ def main(argv=None) -> int:
             shm_slots=args.shm_slots,
             shm_resp_slots=args.shm_resp_slots,
             shm_torn_ms=args.shm_torn_ms,
+            shard_devices=args.shard_devices,
+            feed_fanin=args.feed_fanin,
         )
     except SystemExit as e:
         return int(e.code or 3)

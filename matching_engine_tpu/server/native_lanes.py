@@ -496,6 +496,28 @@ class NativeLanesRunner(EngineRunner):
         finally:
             self.adopt_from_python()
 
+    # Cross-lane barrier hooks (run_auction_phased): prepare imports the
+    # native directory state into the python mirror exactly like the
+    # single-lane auction entry; commit/abort push the (mutated or
+    # untouched) mirror back so the native directory never desyncs, on
+    # either barrier outcome.
+
+    def auction_prepare(self, symbols):
+        self.refresh_directory_mirror_locked()
+        return super().auction_prepare(symbols)
+
+    def auction_commit(self, prep, sink=None):
+        try:
+            return super().auction_commit(prep, sink)
+        finally:
+            self.adopt_from_python()
+
+    def auction_abort(self, prep) -> None:
+        try:
+            super().auction_abort(prep)
+        finally:
+            self.adopt_from_python()
+
     def reconcile_fill_overflow(self):
         self.refresh_directory_mirror_locked()
         try:

@@ -34,9 +34,12 @@ per-lane invariant; the explicit cross-lane aggregation points are:
   writer. The durable store is shard-agnostic (recovery re-routes rows
   by symbol), so a store written at any K restores at any other K.
 - **Book views / auctions**: GetOrderBook routes to the one lane owning
-  the symbol; an all-symbols RunAuction fans out to every lane and
-  merges the per-lane summaries (per-lane all-or-nothing, mirroring the
-  mesh path's per-shard abort semantics).
+  the symbol; symbol-targeted RunAuctions run per owning lane (per-lane
+  all-or-nothing, mirroring the mesh path's per-shard abort semantics),
+  while the all-symbols call-period close runs a TWO-PHASE barrier —
+  every lane quiesces, snapshots books, prepares its device uncross,
+  and only a unanimous vote commits; any lane failure rolls every lane
+  back bit-identically (_AuctionBarrier + EngineRunner's phased hooks).
 - **Checkpoints**: one CheckpointDaemon per lane under
   ``<root>/shard-<i>/`` (wired by build_server), restored per lane.
 
@@ -60,6 +63,59 @@ import time
 
 from matching_engine_tpu.parallel.multihost import symbol_home
 from matching_engine_tpu.utils.metrics import Metrics
+
+# Sentinel for make_lane_runner's `device` parameter: "not passed" must
+# stay distinct from an explicit None (= jax default placement).
+_AUTO = object()
+
+
+def parse_shard_devices(spec, num_shards: int, devices=None) -> list:
+    """Resolve a ``--shard-devices`` placement spec into one device per
+    lane (None = jax default placement, no device_put):
+
+    - ``auto`` (or empty): round-robin across all visible devices when
+      more than one is visible; default placement on single-device boxes
+      (skips the boot-time device_put a 1-device round-robin would pay).
+    - ``roundrobin``: ALWAYS explicit — lane i commits its books and jit
+      executables to ``devices[i % len(devices)]``, even with one device.
+    - ``pinned:<o0,o1,...>``: one device ordinal per lane, exactly
+      ``num_shards`` of them (e.g. ``pinned:0,0,1,1`` packs lane pairs).
+
+    Raises ValueError (a boot CONFIG-ERROR) on malformed specs, ordinal
+    counts that don't match the lane count, or out-of-range ordinals."""
+    import jax
+
+    devices = list(devices) if devices is not None else list(jax.devices())
+    spec = (spec or "auto").strip()
+    if spec == "auto":
+        if len(devices) > 1:
+            return [devices[i % len(devices)] for i in range(num_shards)]
+        return [None] * num_shards
+    if spec == "roundrobin":
+        return [devices[i % len(devices)] for i in range(num_shards)]
+    if spec.startswith("pinned:"):
+        body = spec[len("pinned:"):]
+        try:
+            ordinals = [int(x) for x in body.split(",")] if body else []
+        except ValueError:
+            raise ValueError(
+                f"--shard-devices pinned spec {body!r}: ordinals must be "
+                f"comma-separated integers")
+        if len(ordinals) != num_shards:
+            raise ValueError(
+                f"--shard-devices pinned:{body} names {len(ordinals)} "
+                f"lane(s); --serve-shards is {num_shards} (give exactly "
+                f"one device ordinal per lane)")
+        bad = sorted({o for o in ordinals if not 0 <= o < len(devices)})
+        if bad:
+            raise ValueError(
+                f"--shard-devices ordinal(s) {bad} out of range: "
+                f"{len(devices)} visible device(s) "
+                f"(valid: 0..{len(devices) - 1})")
+        return [devices[o] for o in ordinals]
+    raise ValueError(
+        f"--shard-devices {spec!r}: expected auto | roundrobin | "
+        f"pinned:<o0,o1,...>")
 
 
 class ShardRouter:
@@ -117,6 +173,60 @@ class ServingLane:
             return len(tags)
         q = getattr(d, "_q", None)
         return q.qsize() if q is not None and hasattr(q, "qsize") else 0
+
+
+class _AuctionBarrier:
+    """Two-phase commit vote for the cross-lane all-symbols uncross.
+
+    Each lane worker, having PREPARED its uncross (device step done,
+    host directories untouched, pre-auction books snapshotted), calls
+    vote_and_wait: the call blocks until every lane has voted — or any
+    lane votes abort, or the decision timeout lapses — and returns the
+    collective decision. True (commit) only when ALL K lanes voted ok.
+    An abort seals the decision immediately (healthy lanes are released
+    rather than held for stragglers); a lane that times out waiting
+    seals abort itself, so a wedged peer can never leave the venue
+    half-uncrossed — the wedged lane, when it finally votes, reads the
+    sealed abort and rolls its snapshot back."""
+
+    def __init__(self, n: int, timeout_s: float = 60.0):
+        self._lock = threading.Lock()
+        self._decided = threading.Event()
+        self._n = n
+        self._timeout_s = timeout_s
+        self._votes = 0
+        self._ok = True
+        self.committed = False
+        self.reasons: list[str] = []
+
+    def vote_and_wait(self, ok: bool, reason: str = "") -> bool:
+        with self._lock:
+            self._votes += 1
+            if not ok:
+                self._ok = False
+                if reason:
+                    self.reasons.append(reason)
+            if not self._ok or self._votes == self._n:
+                self.committed = self._ok and self._votes == self._n
+                self._decided.set()
+        if not self._decided.wait(self._timeout_s):
+            with self._lock:
+                if not self._decided.is_set():
+                    self._ok = False
+                    self.committed = False
+                    self.reasons.append(
+                        f"barrier decision timeout after "
+                        f"{self._timeout_s:.0f}s")
+                    self._decided.set()
+        with self._lock:
+            return self.committed
+
+    def outcome(self) -> tuple[bool, list[str]]:
+        """The sealed decision, read under the barrier lock (the
+        worker joins already order these reads; the lock makes the
+        rendezvous visible to the lockset analyzer too)."""
+        with self._lock:
+            return self.committed, list(self.reasons)
 
 
 class ServingShards:
@@ -208,13 +318,17 @@ class ServingShards:
 
     def run_auction(self, symbols=None, sink=None) -> dict:
         """Auction across lanes. With `symbols` the uncross touches only
-        the lanes owning them; None = every lane (the all-symbols call-
-        period close). Lanes run sequentially — each uncross holds only
-        its own lane's dispatch lock — and the per-lane summaries merge
-        with per-lane all-or-nothing semantics (a lane that aborts keeps
-        its books untouched and, if open, its call period; the merged
-        request fails only when EVERY touched lane failed)."""
+        the lanes owning them, sequentially, with per-lane all-or-nothing
+        semantics (a lane that aborts keeps its books untouched and, if
+        open, its call period; the merged request fails only when EVERY
+        touched lane failed). None/empty = the all-symbols call-period
+        close: with K > 1 lanes that runs through a two-phase
+        quiesce/commit BARRIER so every lane uncrosses at one consistent
+        venue point, all-or-nothing ACROSS lanes — any lane failing to
+        prepare rolls every lane back bit-identically."""
         sink = sink if sink is not None else self.sink
+        if not symbols and len(self.lanes) > 1:
+            return self._run_auction_barrier(sink)
         if symbols:
             by_lane: dict[int, list[str]] = {}
             for s in symbols:
@@ -240,6 +354,70 @@ class ServingShards:
         warnings.extend(errors)  # partial failure: success with a warning
         return {"crossed": crossed, "aborted": aborted, "error": "",
                 "warning": "; ".join(w for w in warnings if w)}
+
+    def _run_auction_barrier(self, sink) -> dict:
+        """All-symbols uncross across K > 1 lanes at ONE consistent venue
+        point: one worker per lane quiesces its dispatcher, snapshots its
+        books, runs the device uncross (prepare), then votes into a
+        two-phase barrier. Only a unanimous vote commits — any lane
+        failure (prepare error, exception, wedge) aborts EVERY lane,
+        restoring each snapshot so the venue is bit-identical to never
+        having auctioned. Each worker holds only its own lane's dispatch
+        lock; the barrier's internal lock is the only cross-lane point,
+        so no lock-order cycle is possible."""
+        barrier = _AuctionBarrier(len(self.lanes))
+        results: list = [None] * len(self.lanes)
+        workers = [
+            threading.Thread(
+                target=self._barrier_lane,
+                args=(lane, sink, barrier, results),
+                name=f"auction-barrier-{lane.shard_id}", daemon=True)
+            for lane in self.lanes
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        committed, reasons = barrier.outcome()
+        if not committed:
+            self.metrics.inc("auction_barrier_aborts")
+            return {"crossed": [], "aborted": True,
+                    "error": "cross-lane auction barrier aborted: "
+                             + ("; ".join(reasons) or "lane failure"),
+                    "warning": ""}
+        self.metrics.inc("auction_barrier_commits")
+        crossed: list = []
+        warnings: list[str] = []
+        aborted = False
+        for summary in results:
+            if summary is None:
+                continue
+            crossed.extend(summary["crossed"])
+            aborted = aborted or summary["aborted"]
+            if summary.get("warning"):
+                warnings.append(summary["warning"])
+        return {"crossed": crossed, "aborted": aborted, "error": "",
+                "warning": "; ".join(w for w in warnings if w)}
+
+    def _barrier_lane(self, lane, sink, barrier, results) -> None:
+        """Barrier worker (declared thread role "auction_barrier"):
+        drives ONE lane's run_auction_phased, voting the lane's prepare
+        outcome and abiding by the collective decision."""
+        runner = lane.runner
+
+        def decide(ok: bool, err: str) -> bool:
+            return barrier.vote_and_wait(
+                ok, f"lane {lane.shard_id}: {err}" if err else "")
+
+        try:
+            results[lane.shard_id] = runner.run_auction_phased(
+                decide, sink=sink)
+        except Exception as e:
+            # run_auction_phased voted abort before re-raising, so peers
+            # are already released; surface the failure in the merge.
+            results[lane.shard_id] = {
+                "crossed": [], "aborted": True,
+                "error": f"{type(e).__name__}: {e}", "warning": ""}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -280,17 +458,32 @@ class ServingShards:
         m.set_gauge("lane_dispatch_rate", total)
         mean = total / len(rates)
         m.set_gauge("lane_imbalance", max(rates) / mean if mean > 0 else 1.0)
+        # Placement identity + per-device aggregates: the imbalance gauge
+        # is only ACTIONABLE when attributable to placement — lane<i>_device
+        # pins each lane to its device ordinal, device<d>_ops_per_s sums
+        # the lanes each device carries.
+        by_dev: dict[int, float] = {}
+        for i, lane in enumerate(self.lanes):
+            dev = getattr(lane.runner, "device", None)
+            did = int(getattr(dev, "id", 0)) if dev is not None else 0
+            m.set_gauge(f"lane{i}_device", did)
+            by_dev[did] = by_dev.get(did, 0.0) + rates[i]
+        for did in sorted(by_dev):
+            m.set_gauge(f"device{did}_ops_per_s", by_dev[did])
         return ops, now
 
 
 def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
                      metrics=None, hub=None, pipeline_inflight: int = 2,
                      native_lanes: bool = False, devices=None,
+                     device=_AUTO,
                      megadispatch_max_waves: int = 1, tier_pins=None):
     """One lane's runner over a K-way split of `cfg`: the shard gets
     ``cfg.num_symbols // K`` engine rows, the strided OID residue class
-    `shard_id`, the shard-ownership filter, and — when more than one
-    device is visible — its own device (round-robin).
+    `shard_id`, the shard-ownership filter, and its device: pass
+    `device` explicitly (from parse_shard_devices; None = jax default
+    placement) or leave it unset for the auto policy — round-robin when
+    more than one device is visible.
 
     A tiered `cfg` (cfg.tiers, --book-tiers) splits PROPORTIONALLY: every
     tier group's symbol count must divide by K, each lane gets the same
@@ -322,8 +515,10 @@ def make_lane_runner(cfg, router: ShardRouter, shard_id: int, *,
         lane_tiers = tuple((n // k, cap) for n, cap in cfg.tiers)
     shard_cfg = dataclasses.replace(cfg, num_symbols=cfg.num_symbols // k,
                                     tiers=lane_tiers)
-    devices = devices if devices is not None else jax.devices()
-    device = devices[shard_id % len(devices)] if len(devices) > 1 else None
+    if device is _AUTO:
+        devices = devices if devices is not None else jax.devices()
+        device = (devices[shard_id % len(devices)]
+                  if len(devices) > 1 else None)
     owns = (lambda s, _i=shard_id: router.shard_of(s) == _i)
     kwargs = {}
     cls = EngineRunner
@@ -401,18 +596,24 @@ def build_serving_shards(
     megadispatch_max_waves: int = 1,
     megadispatch_latency_us: float = 5000.0,
     tier_pins=None,
+    shard_devices: str | None = None,
 ) -> ServingShards:
     """Wire K (runner → dispatcher) lanes over a K-way split of `cfg`.
 
-    All lanes share `metrics`, `hub` and `sink`. With `with_dispatchers`
-    False the caller drives dispatch itself (benches/tests)."""
+    All lanes share `metrics`, `hub` and `sink`. `shard_devices` is the
+    ``--shard-devices`` placement spec (parse_shard_devices) committing
+    each lane's books and jit executables to its device. With
+    `with_dispatchers` False the caller drives dispatch itself
+    (benches/tests)."""
     metrics = metrics or Metrics()
     router = ShardRouter(num_shards)
+    placement = parse_shard_devices(shard_devices, num_shards)
     lanes: list[ServingLane] = []
     for i in range(num_shards):
         runner = make_lane_runner(
             cfg, router, i, metrics=metrics, hub=hub,
             pipeline_inflight=pipeline_inflight, native_lanes=native_lanes,
+            device=placement[i],
             megadispatch_max_waves=megadispatch_max_waves,
             tier_pins=tier_pins)
         dispatcher = None

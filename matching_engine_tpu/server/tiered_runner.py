@@ -541,3 +541,13 @@ class TieredEngineRunner(EngineRunner):
 
         return (0, clear_price, executed, best_bid, bid_size, best_ask,
                 ask_size, fills_all, aborted_shards, slot_aborted)
+
+    def _auction_books_copy(self):
+        # Barrier snapshot covers every tier book (self.book is None on
+        # tiered runners).
+        with self._snapshot_lock:
+            return [self._copy_book_tree(b) for b in self.tier_books]
+
+    def _auction_books_restore(self, saved) -> None:
+        # Caller holds _snapshot_lock (auction_abort).
+        self.tier_books = list(saved)

@@ -215,15 +215,24 @@ AUDIT_RECORDS=$(sed -n 's/^me_audit_records_total \([0-9]*\).*/\1/p' "$METRICS_O
 [ -n "$AUDIT_RECORDS" ] && [ "$AUDIT_RECORDS" -gt 0 ] \
   || { echo "FAIL: auditor consumed no drop-copy records (records=${AUDIT_RECORDS:-absent})"; exit 1; }
 
-# ---- sharded round: K=2 partitioned serving lanes -------------------------
-# Boots a second server with --serve-shards 2 on a fresh store, reuses the
-# per-round bench + sequenced subscriber + metrics scrape, then fails the
-# round on ANY cross-lane order-id collision in the durable store (the
-# strided-allocation invariant) or on missing per-lane metrics.
+# ---- sharded round: K=2 partitioned serving lanes, one per device ---------
+# Boots a second server with --serve-shards 2 on a fresh store — under a
+# FORCED 2-device host (XLA_FLAGS=--xla_force_host_platform_device_count=2)
+# with --shard-devices roundrobin, so each lane's book and jits commit to
+# their own device. Reuses the per-round bench + sequenced subscriber +
+# metrics scrape, then fails the round on ANY cross-lane order-id collision
+# in the durable store (the strided-allocation invariant), on missing
+# per-lane metrics, or on missing per-device placement gauges
+# (me_lane<i>_device / me_device<d>_ops_per_s).
 SH_DB="$WORK/soak_sharded.db"
-PYTHONUNBUFFERED=1 python -m matching_engine_tpu.server.main \
+SH_XLA_KEPT=$(echo "${XLA_FLAGS:-}" | tr ' ' '\n' \
+  | grep -v xla_force_host_platform_device_count | tr '\n' ' ')
+PYTHONUNBUFFERED=1 \
+XLA_FLAGS="$SH_XLA_KEPT--xla_force_host_platform_device_count=2" \
+python -m matching_engine_tpu.server.main \
   --addr 127.0.0.1:0 --db "$SH_DB" --symbols 16 --capacity 64 --batch 8 \
-  --window-ms 1 --serve-shards 2 --metrics-port 0 \
+  --window-ms 1 --serve-shards 2 --shard-devices roundrobin \
+  --metrics-port 0 \
   $AUDIT_ARGS ${SOAK_SERVER_ARGS:-} \
   > "$WORK/server_sharded.log" 2>&1 &
 SH_SRV=$!
@@ -269,6 +278,15 @@ trap 'kill $SRV 2>/dev/null' EXIT
 [ "$SH_OK" -gt 0 ] || { echo "FAIL: sharded round served no orders"; exit 1; }
 grep -q "^me_lane_dispatch_rate" "$METRICS_OUT" \
   || { echo "FAIL: me_lane_* metrics absent from the sharded scrape"; exit 1; }
+# Placement identity: both lanes must report which forced device they
+# committed to, and each device's throughput gauge must exist (the
+# lanes were placed roundrobin on a 2-device host, so device ordinals
+# 0 AND 1 must both appear).
+for G in me_lane0_device me_lane1_device \
+         me_device0_ops_per_s me_device1_ops_per_s; do
+  grep -q "^$G" "$METRICS_OUT" \
+    || { echo "FAIL: $G absent from the sharded scrape (per-device placement gauges missing)"; exit 1; }
+done
 SH_COLLISIONS=$(python - "$SH_DB" <<'EOF'
 import sqlite3, sys
 con = sqlite3.connect(sys.argv[1])

@@ -1,0 +1,400 @@
+"""Mesh-scale serving (server/shards.py + feed/fanin.py + main.py).
+
+Three cross-lane guarantees behind --serve-shards at device scale:
+
+- PLACEMENT is behavior-free: K lanes pinned onto one device and K
+  lanes spread round-robin across every visible device produce
+  bit-identical books/fills/rejects for the same stream (conftest forces
+  8 virtual CPU devices, so this runs multi-device without a TPU).
+- The all-symbols call-auction close is ATOMIC across lanes: a lane
+  failing mid-barrier rolls every lane's books back bit-identically and
+  keeps the call period open; the retry without the fault commits.
+- The sequenced feed fan-in (--feed-fanin merged) delivers every lane's
+  publishes in lane order, declares (and survives) seq gaps, and is
+  observationally identical to single-hub mode per (channel, key).
+
+Plus the --shard-devices placement parser, the sampler's device
+identity/aggregate gauges, and main()'s structured CONFIG-ERROR
+refusals for unsupported flag combinations.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import test_serve_shards as tss
+from matching_engine_tpu import native as me_native
+from matching_engine_tpu.engine.kernel import OP_SUBMIT
+from matching_engine_tpu.server.engine_runner import EngineOp, OrderInfo
+from matching_engine_tpu.server.shards import (
+    build_serving_shards,
+    parse_shard_devices,
+)
+from matching_engine_tpu.server.streams import StreamHub
+from matching_engine_tpu.utils.metrics import Metrics
+
+# -- placement parsing -------------------------------------------------------
+
+
+def test_parse_shard_devices_policies():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces 8 virtual CPU devices"
+    auto = parse_shard_devices("auto", 4)
+    assert auto == [devs[i % len(devs)] for i in range(4)]
+    assert parse_shard_devices(None, 4) == auto
+    assert parse_shard_devices("", 4) == auto
+    rr = parse_shard_devices("roundrobin", 10)
+    assert rr == [devs[i % len(devs)] for i in range(10)]
+    pinned = parse_shard_devices("pinned:0,0,3,3", 4)
+    assert [d.id for d in pinned] == [0, 0, 3, 3]
+    # Auto on a single visible device keeps jax default placement.
+    assert parse_shard_devices("auto", 2, devices=devs[:1]) == [None, None]
+    # Roundrobin commits explicitly even on one device.
+    assert parse_shard_devices("roundrobin", 2, devices=devs[:1]) \
+        == [devs[0], devs[0]]
+
+
+def test_parse_shard_devices_refusals():
+    for bad in ("pinned:0",        # count != K
+                "pinned:0,99",     # ordinal out of range
+                "pinned:0,x",      # non-integer ordinal
+                "pinned:",
+                "sideways"):       # unknown policy
+        with pytest.raises(ValueError):
+            parse_shard_devices(bad, 2)
+
+
+def test_lane_books_committed_to_devices():
+    """roundrobin at K=4 lands four DISTINCT devices and each lane's
+    book arrays actually live on its device."""
+    shards = build_serving_shards(
+        tss.make_cfg(), 4, with_dispatchers=False, sample_interval_s=0,
+        shard_devices="roundrobin")
+    try:
+        ids = []
+        for lane in shards.lanes:
+            dev = lane.runner.device
+            assert dev is not None
+            ids.append(dev.id)
+            leaf = jax.tree_util.tree_leaves(lane.runner.book)[0]
+            assert {d.id for d in leaf.devices()} == {dev.id}
+        assert sorted(ids) == [0, 1, 2, 3]
+    finally:
+        shards.close()
+
+
+# -- K-lanes-on-1-device vs K-lanes-on-N-devices bit-parity ------------------
+
+
+def test_device_placement_parity_python():
+    pinned = tss.drive_python(tss.make_cfg(), 4, tss.gen_stream(3),
+                              shard_devices="pinned:0,0,0,0")
+    spread = tss.drive_python(tss.make_cfg(), 4, tss.gen_stream(3),
+                              shard_devices="roundrobin")
+    assert pinned["books"] == spread["books"]
+    assert sorted(pinned["fills"]) == sorted(spread["fills"])
+    assert pinned["rejected"].keys() == spread["rejected"].keys()
+
+
+@pytest.mark.skipif(not me_native.available(),
+                    reason="native runtime not built")
+def test_device_placement_parity_native():
+    pinned = tss.drive_native(tss.make_cfg(), 4, tss.gen_stream(3),
+                              shard_devices="pinned:0,0,0,0")
+    spread = tss.drive_native(tss.make_cfg(), 4, tss.gen_stream(3),
+                              shard_devices="roundrobin")
+    assert pinned["books"] == spread["books"]
+    assert sorted(pinned["fills"]) == sorted(spread["fills"])
+
+
+# -- cross-lane auction barrier ----------------------------------------------
+
+
+def _rest_crossed(shards):
+    """Open the call period and rest a crossed pair on every symbol
+    (bid 10100 over ask 10000 — auction-mode submits never match)."""
+    shards.set_auction_mode(True)
+    for s in range(tss.SYMS):
+        sym = f"S{s}"
+        runner = shards.lane_for_symbol(sym).runner
+        for side, price in ((1, 10_100), (2, 10_000)):
+            assert runner.slot_acquire(sym) is not None
+            num, oid = runner.assign_oid()
+            info = OrderInfo(
+                oid=num, order_id=oid, client_id="c0", symbol=sym,
+                side=side, otype=0, price_q4=price, quantity=5,
+                remaining=5, status=0, handle=runner.assign_handle())
+            box = {}
+            runner.dispatch_pipelined(
+                [EngineOp(OP_SUBMIT, info)],
+                lambda r, e, box=box: box.update(r=r, e=e))
+            runner.finish_pending()
+            assert box["e"] is None, box["e"]
+
+
+def _books_host(shards):
+    return [jax.tree_util.tree_map(np.asarray, lane.runner.book)
+            for lane in shards.lanes]
+
+
+def test_cross_lane_barrier_abort_is_atomic_then_retry_commits():
+    """A lane failing MID-BARRIER aborts the whole uncross: every lane's
+    books come back bit-identical to never having auctioned, the call
+    period stays open, and both barrier counters account for it. The
+    retry without the fault commits all lanes at one venue point."""
+    metrics = Metrics()
+    shards = build_serving_shards(
+        tss.make_cfg(), 4, metrics=metrics, with_dispatchers=False,
+        sample_interval_s=0, shard_devices="roundrobin")
+    try:
+        _rest_crossed(shards)
+        all_syms = sorted(f"S{s}" for s in range(tss.SYMS))
+        assert sorted(shards.crossed_symbols()) == all_syms
+        before = _books_host(shards)
+
+        victim = shards.lanes[2].runner
+        orig_prepare = victim.auction_prepare
+
+        def boom(symbols):
+            raise RuntimeError("injected mid-barrier lane failure")
+
+        victim.auction_prepare = boom
+        summary = shards.run_auction(None)
+        assert summary["aborted"]
+        assert summary["crossed"] == []
+        assert "barrier aborted" in summary["error"]
+        assert "lane 2" in summary["error"]
+        counters, _ = metrics.snapshot()
+        assert counters.get("auction_barrier_aborts") == 1
+        assert not counters.get("auction_barrier_commits")
+        # All-or-nothing: every lane (not just the victim) restored
+        # bit-identically, call period still open, books still crossed.
+        for b, a in zip(before, _books_host(shards)):
+            bl, al = (jax.tree_util.tree_leaves(b),
+                      jax.tree_util.tree_leaves(a))
+            assert len(bl) == len(al)
+            for x, y in zip(bl, al):
+                np.testing.assert_array_equal(x, y)
+        assert shards.auction_mode
+        assert sorted(shards.crossed_symbols()) == all_syms
+
+        victim.auction_prepare = orig_prepare
+        retry = shards.run_auction(None)
+        assert retry["error"] == "", retry["error"]
+        # crossed entries are (symbol, clear_price, executed) triples.
+        assert sorted(c[0] for c in retry["crossed"]) == all_syms
+        assert all(c[1] in (10_000, 10_100) or 10_000 <= c[1] <= 10_100
+                   for c in retry["crossed"])
+        assert all(c[2] == 5 for c in retry["crossed"])
+        counters, _ = metrics.snapshot()
+        assert counters.get("auction_barrier_commits") == 1
+        assert not shards.auction_mode, "commit must close the call period"
+        assert shards.crossed_symbols() == []
+    finally:
+        shards.close()
+
+
+# -- sequenced feed fan-in ---------------------------------------------------
+
+
+class _RecordingHub:
+    """Hub stand-in for direct merger tests: records delivery order."""
+
+    sequencer = None
+
+    def __init__(self, fail_md: bool = False):
+        self.events: list = []
+        self.fail_md = fail_md
+
+    def has_market_data_subs(self):
+        return True
+
+    def has_order_update_subs(self):
+        return True
+
+    def publish_market_data(self, updates):
+        if self.fail_md:
+            raise RuntimeError("md pipe broken")
+        self.events.append(("md", updates))
+
+    def publish_order_updates(self, updates):
+        self.events.append(("ou", updates))
+
+    def publish_oplog(self, updates):
+        self.events.append(("oplog", updates))
+
+    def publish_audit_rows(self, rows, env, n, drop=None, observer=None):
+        self.events.append(("audit", rows))
+        return list(range(n))
+
+
+def _wait_until(pred, timeout_s: float = 5.0):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        assert time.monotonic() < deadline, "fan-in merger never caught up"
+        time.sleep(0.01)
+
+
+def test_fanin_delivers_in_lane_order_and_drains_on_close():
+    from matching_engine_tpu.feed import FeedFanIn
+
+    metrics = Metrics()
+    hub = _RecordingHub()
+    fanin = FeedFanIn(hub, 2, metrics=metrics)
+    p0, p1 = fanin.lane_publisher(0), fanin.lane_publisher(1)
+    p0.publish_market_data(["a"])
+    p0.publish_order_updates(["b"])
+    p1.publish_oplog(["c"])
+    p0.publish_market_data(["d"])
+    assert p0.publish_audit_rows(["row"], None, 1) == []
+    p0.publish_market_data([])   # empty batches never enqueue
+    fanin.close()
+    assert len(hub.events) == 5
+    # Per-lane relative order is the lane's publish order.
+    ev = hub.events
+    assert ev.index(("md", ["a"])) < ev.index(("ou", ["b"])) \
+        < ev.index(("md", ["d"]))
+    assert ("oplog", ["c"]) in ev and ("audit", ["row"]) in ev
+    counters, _ = metrics.snapshot()
+    # The lane facade returned []; the merger accounts the audit rows.
+    assert counters.get("audit_records") == 1
+    assert not counters.get("feed_fanin_gaps")
+    fanin.close()   # idempotent
+
+
+def test_fanin_declares_gaps_and_counts_stale_dups():
+    from matching_engine_tpu.feed import FeedFanIn
+
+    metrics = Metrics()
+    hub = _RecordingHub()
+    fanin = FeedFanIn(hub, 1, metrics=metrics, gap_wait_s=0.05)
+    # Lane 0's seq line with a hole at 2: 1 delivers; 3 and 4 park until
+    # the gap window lapses, then the gap is DECLARED and they flush.
+    fanin._q.put((0, 0, 1, 0, ["s1"]))
+    fanin._q.put((0, 0, 3, 0, ["s3"]))
+    fanin._q.put((0, 0, 4, 0, ["s4"]))
+    _wait_until(lambda: len(hub.events) == 3)
+    assert hub.events == [("md", ["s1"]), ("md", ["s3"]), ("md", ["s4"])]
+    counters, _ = metrics.snapshot()
+    assert counters.get("feed_fanin_gaps") == 1
+    # The straggler arriving after its gap was declared is stale.
+    fanin._q.put((0, 0, 2, 0, ["s2"]))
+    _wait_until(lambda: metrics.snapshot()[0].get("feed_fanin_dups") == 1)
+    assert len(hub.events) == 3
+    fanin.close()
+
+
+def test_fanin_delivery_errors_are_counted_not_fatal():
+    from matching_engine_tpu.feed import FeedFanIn
+
+    metrics = Metrics()
+    hub = _RecordingHub(fail_md=True)
+    fanin = FeedFanIn(hub, 1, metrics=metrics)
+    pub = fanin.lane_publisher(0)
+    pub.publish_market_data(["boom"])
+    pub.publish_order_updates(["fine"])
+    fanin.close()
+    assert hub.events == [("ou", ["fine"])]
+    counters, _ = metrics.snapshot()
+    assert counters.get("feed_fanin_errors") == 1
+
+
+def test_fanin_merged_matches_hub_mode_per_key():
+    """hub vs merged over the same per-lane publish sequences: every
+    (channel, key) domain's delivered payloads and seq line must be
+    identical — merged mode changes WHO serializes, not what the
+    subscriber sees."""
+    from matching_engine_tpu.feed import FeedFanIn, FeedSequencer
+    from matching_engine_tpu.proto import pb2
+
+    clients = ("c0", "c1")
+
+    def run(mode: str):
+        metrics = Metrics()
+        hub = StreamHub(maxsize=100_000, metrics=metrics,
+                        sequencer=FeedSequencer(metrics=metrics))
+        subs = {c: hub.subscribe_order_updates(c) for c in clients}
+        fanin = (FeedFanIn(hub, 2, metrics=metrics)
+                 if mode == "merged" else None)
+        pubs = [fanin.lane_publisher(i) if fanin is not None else hub
+                for i in range(2)]
+        for j in range(50):
+            for i, p in enumerate(pubs):
+                p.publish_order_updates([
+                    pb2.OrderUpdate(order_id=f"OID-{1 + i + 2 * j}",
+                                    client_id=c, symbol=f"S{i}", status=0)
+                    for c in clients])
+        if fanin is not None:
+            fanin.close()   # drains every queued publish first
+        hub.close_all()
+        out = {}
+        for c, sub in subs.items():
+            items = []
+            while True:
+                try:
+                    _, item = sub.q.get_nowait()
+                except Exception:
+                    break
+                if hasattr(item, "seq"):
+                    items.append(item)
+            assert [it.seq for it in items] == \
+                list(range(1, len(items) + 1)), f"{c}: seq line has gaps"
+            out[c] = [(it.order_id, it.symbol, it.status) for it in items]
+        return out
+
+    assert run("hub") == run("merged")
+
+
+# -- sampler placement gauges ------------------------------------------------
+
+
+def test_sampler_publishes_device_identity_and_aggregates():
+    metrics = Metrics()
+    shards = build_serving_shards(
+        tss.make_cfg(), 2, metrics=metrics, with_dispatchers=False,
+        sample_interval_s=0, shard_devices="pinned:0,1")
+    try:
+        shards._sample_once([0, 0], time.perf_counter() - 0.1)
+        _, gauges = metrics.snapshot()
+        assert gauges["lane0_device"] == 0
+        assert gauges["lane1_device"] == 1
+        assert "device0_ops_per_s" in gauges
+        assert "device1_ops_per_s" in gauges
+        assert "lane_imbalance" in gauges
+    finally:
+        shards.close()
+
+
+# -- main() structured refusals ----------------------------------------------
+
+
+REFUSALS = [
+    (["--shard-devices", "roundrobin"], "CONFIG-ERROR"),
+    (["--serve-shards", "2", "--shard-devices", "pinned:0"],
+     "bad --shard-devices"),
+    (["--feed-fanin", "merged"], "CONFIG-ERROR"),
+    (["--serve-shards", "2", "--feed-fanin", "merged",
+      "--gateway-addr", "127.0.0.1:1"], "CONFIG-ERROR"),
+    (["--mesh-serve", "--mesh", "2"], "CONFIG-ERROR"),
+    (["--mesh-serve", "--serve-shards", "2"], "CONFIG-ERROR"),
+    (["--serve-shards", "2", "--native-lanes",
+      "--gateway-addr", "127.0.0.1:1"], "CONFIG-ERROR"),
+]
+
+
+@pytest.mark.parametrize("argv,marker", REFUSALS,
+                         ids=[" ".join(a) for a, _ in REFUSALS])
+def test_main_refuses_unsupported_combos(argv, marker, capsys):
+    """Unsupported flag combinations exit 3 with a structured line an
+    operator can grep — CONFIG-ERROR lines NAME the supported combos."""
+    from matching_engine_tpu.server.main import main
+
+    assert main(argv) == 3
+    err = capsys.readouterr().err
+    assert marker in err, err
+    if marker == "CONFIG-ERROR":
+        assert "supported:" in err, err

@@ -134,14 +134,20 @@ def gen_stream(seed: int, n_batches: int = 10, batch_n: int = 16):
 # -- drains ------------------------------------------------------------------
 
 
-def drive_python(cfg: EngineConfig, K: int, stream) -> dict:
+def drive_python(cfg: EngineConfig, K: int, stream,
+                 shard_devices: str | None = None) -> dict:
     """Run the stream through K python lanes; returns the normalized
     per-symbol surface. Submits route by symbol shard, cancels/amends to
     their target's lane — each lane sees its ops in stream order, as its
-    dispatcher thread would pop them."""
+    dispatcher thread would pop them. `shard_devices` is the placement
+    spec (--shard-devices); None keeps the auto policy."""
+    from matching_engine_tpu.server.shards import parse_shard_devices
+
     router = ShardRouter(K)
     hub = StreamHub()
-    runners = [make_lane_runner(cfg, router, i, hub=hub) for i in range(K)]
+    placement = parse_shard_devices(shard_devices, K)
+    runners = [make_lane_runner(cfg, router, i, hub=hub,
+                                device=placement[i]) for i in range(K)]
     tag_oid: dict[int, str] = {}      # submit tag -> order id
     oid_tag: dict[str, str] = {}
     tag_info: dict[int, OrderInfo] = {}
@@ -219,13 +225,17 @@ def drive_python(cfg: EngineConfig, K: int, stream) -> dict:
     return _surface(runners, router, oid_tag, statuses, fills, rejected)
 
 
-def drive_native(cfg: EngineConfig, K: int, stream) -> dict:
+def drive_native(cfg: EngineConfig, K: int, stream,
+                 shard_devices: str | None = None) -> dict:
     """Same stream through K C++ lane engines (dispatch_records)."""
     from matching_engine_tpu.server.native_lanes import pack_record_batch
+    from matching_engine_tpu.server.shards import parse_shard_devices
 
     router = ShardRouter(K)
     hub = StreamHub()
-    runners = [make_lane_runner(cfg, router, i, hub=hub, native_lanes=True)
+    placement = parse_shard_devices(shard_devices, K)
+    runners = [make_lane_runner(cfg, router, i, hub=hub, native_lanes=True,
+                                device=placement[i])
                for i in range(K)]
     tag_oid: dict[int, str] = {}
     oid_tag: dict[str, str] = {}
