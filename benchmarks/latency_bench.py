@@ -226,6 +226,14 @@ def main() -> None:
     p.add_argument("--addr", default=None,
                    help="drive a LIVE server's SubmitOrder instead of the "
                         "in-proc pipeline (open-loop RPCs)")
+    p.add_argument("--shm", default=None, metavar="SEGMENT",
+                   help="drive a LIVE server's shared-memory ingress "
+                        "segment (--shm-ingress on the server) instead of "
+                        "RPCs: each scheduled slot pushes ONE record into "
+                        "the ring and its latency runs from the scheduled "
+                        "time to the positional ack on this writer's "
+                        "response lane — the zero-copy edge's tail, no "
+                        "proto or HTTP/2 in the path")
     p.add_argument("--batch-size", type=int, default=1, metavar="N",
                    help="with --addr: drive SubmitOrderBatch with N packed "
                         "op-records per RPC instead of per-op SubmitOrder "
@@ -259,7 +267,11 @@ def main() -> None:
 
     if args.workload and not args.addr:
         p.error("--workload drives a live server: pass --addr")
-    if args.addr:
+    if args.shm and args.addr:
+        p.error("--shm and --addr are alternative drive modes")
+    if args.shm:
+        out = run_shm(args)
+    elif args.addr:
         out = run_grpc(args)
     else:
         out = run_inproc(args)
@@ -738,6 +750,200 @@ def run_grpc(args) -> dict:
             }
             out["server_p999_gauges"] = sorted(
                 k for k in out["server_stage_gauges"] if k.endswith("_p999"))
+        except Exception as e:  # noqa: BLE001
+            out["scrape_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+# -- live-server shm drive (the zero-copy edge's tail) ------------------------
+
+
+def run_shm(args) -> dict:
+    """Open-loop single-record pushes into a live server's shm ingress
+    ring. Same two-phase protocol as run_grpc — closed-loop peak through
+    the identical per-record path, then fixed-rate fractions with
+    latency from each op's SCHEDULED slot to its positional ack — so the
+    rows land next to the RPC rungs in one artifact. A drain thread owns
+    this writer's response lane and resolves completions by ring
+    sequence; a push finding the ring full retries briefly and then
+    counts as an error (open-loop backpressure must not silently thin
+    the schedule)."""
+    import numpy as np
+
+    from matching_engine_tpu import native as me_native
+    from matching_engine_tpu.domain import oprec
+
+    if not me_native.available():
+        print("[latency_bench] FATAL: --shm needs the native runtime",
+              file=sys.stderr)
+        raise SystemExit(1)
+    ring = me_native.ShmRing(args.shm)
+    writer_id = ring.register_writer()
+
+    # Maker/taker alternation over 4 symbols (the grpc drive's synthetic
+    # flow, packed as oprec records): makers rest, takers cross them out,
+    # books stay shallow however long the run.
+    recs = []
+    for j in range(8):
+        maker = j % 2 == 0
+        recs.append(oprec.pack_records([
+            (oprec.OPREC_SUBMIT, 2 if maker else 1, 0, 10_000, 5,
+             f"LAT{(j // 2) % 4}", "lat-m" if maker else "lat-t", ""),
+        ]).tobytes())
+
+    lock = threading.Lock()
+    cbs: dict[int, object] = {}      # ring seq -> completion callback
+    orphans: dict[int, bool] = {}    # ack arrived before registration
+    stop = threading.Event()
+
+    def drain_loop():
+        while not stop.is_set():
+            raw = ring.resp_poll_raw(4096, 20_000)
+            if raw is None:
+                break  # server shut the segment down
+            if not raw:
+                continue
+            rs = np.frombuffer(raw, dtype=oprec.SHM_RESP_DTYPE)
+            fire = []
+            with lock:
+                for seq, ok in zip(rs["seq"].tolist(),
+                                   (rs["ok"] != 0).tolist()):
+                    cb = cbs.pop(seq, None)
+                    if cb is None:
+                        # Push→ack can beat push→register: stash it.
+                        orphans[seq] = ok
+                    else:
+                        fire.append((cb, ok))
+            for cb, ok in fire:
+                cb(ok)
+
+    drainer = threading.Thread(target=drain_loop, name="shm-lat-drain",
+                               daemon=True)
+    drainer.start()
+    state = {"i": 0}
+
+    def submit_one(done_cb):
+        i = state["i"]
+        state["i"] += 1
+        body = recs[i % 8]
+        base = ring.push_payload(body, 1)
+        tries = 0
+        while base == -1 and tries < 200:
+            time.sleep(0.0005)
+            base = ring.push_payload(body, 1)
+            tries += 1
+        if base < 0:
+            done_cb(False)  # sustained-full / shutdown: a counted error
+            return
+        seq = int(base)
+        with lock:
+            if seq in orphans:
+                ok, direct = orphans.pop(seq), True
+            else:
+                cbs[seq] = done_cb
+                ok, direct = False, False
+        if direct:
+            done_cb(ok)
+
+    def failed(ok) -> bool:
+        # Completions carry the positional ack's ok flag directly (no
+        # future object on this edge).
+        return not ok
+
+    if args.peak:
+        peak = args.peak
+    else:
+        sem = threading.Semaphore(64)
+        done = [0]
+        errs = [0]
+
+        def cb(ok=None):
+            bad = failed(ok)
+            sem.release()
+            done[0] += 1
+            errs[0] += bad
+
+        # Warm phase (discarded): first-sight dispatch shapes compile
+        # outside the measured window; drain the in-flight window before
+        # resetting counters.
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < max(1.0, args.peak_s / 2):
+            sem.acquire()
+            submit_one(cb)
+        for _ in range(64):
+            sem.acquire()
+        sem = threading.Semaphore(64)
+        done[0] = 0
+        errs[0] = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < args.peak_s:
+            sem.acquire()
+            submit_one(cb)
+        for _ in range(64):  # drain
+            sem.acquire()
+        peak = done[0] / (time.perf_counter() - t0)
+        if done[0] == 0 or errs[0] > done[0] * 0.01:
+            print(f"[latency_bench] FATAL: {errs[0]}/{done[0]} peak-phase "
+                  f"shm pushes failed — is the segment served?",
+                  file=sys.stderr)
+            raise SystemExit(1)
+
+    rows = []
+    for frac in [float(f) for f in args.load_fractions.split(",")]:
+        reps = []
+        for _ in range(max(1, args.repeats)):
+            lats, n, wall, errors = _open_loop(submit_one, peak * frac,
+                                               args.duration_s,
+                                               failed=failed)
+            e2e = _pctls(lats)
+            reps.append({"e2e": e2e,
+                         "achieved_ops_s": round(len(lats) / wall, 1),
+                         "n_ops": n, "errors": errors})
+        best = min(reps, key=lambda r: r["e2e"]["p99_ms"])
+        p99s = [r["e2e"]["p99_ms"] for r in reps]
+        if best["errors"] > best["n_ops"] * 0.01:
+            print(f"[latency_bench] FATAL: {best['errors']}/"
+                  f"{best['n_ops']} open-loop shm ops failed",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        rows.append({
+            "mode": "shm",
+            "load_fraction": frac,
+            "target_ops_s": round(peak * frac, 1),
+            "achieved_ops_s": best["achieved_ops_s"],
+            "n_ops": best["n_ops"],
+            "e2e": best["e2e"],
+            "p99_over_p50": round(
+                best["e2e"]["p99_ms"] / best["e2e"]["p50_ms"], 2),
+            "repeats": len(reps),
+            "p99_ms_spread": [min(p99s), max(p99s)],
+            "errors": best["errors"],
+        })
+        print(f"[latency_bench] shm frac={frac} "
+              f"p50={best['e2e']['p50_ms']}ms p99={best['e2e']['p99_ms']}ms "
+              f"p999={best['e2e']['p999_ms']}ms")
+
+    stop.set()
+    ring.close()
+    out = {
+        "metric": "serving_latency_tail",
+        "drive": f"shm open-loop @ {args.shm}",
+        "writer_id": writer_id,
+        "peak_ops_s": {"shm": round(peak, 1)},
+        "rows": rows,
+    }
+    if args.scrape:
+        import urllib.request
+
+        try:
+            body = urllib.request.urlopen(args.scrape, timeout=10) \
+                .read().decode()
+            out["server_stage_gauges"] = {
+                parts[0]: float(parts[1])
+                for parts in (ln.split() for ln in body.splitlines())
+                if len(parts) == 2 and parts[0].startswith("me_stage_")
+                and parts[0].endswith(("_p50", "_p99", "_p999", "_ema"))
+            }
         except Exception as e:  # noqa: BLE001
             out["scrape_error"] = f"{type(e).__name__}: {e}"
     return out

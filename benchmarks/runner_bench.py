@@ -205,6 +205,17 @@ def main() -> None:
                    help="workload PREFIX replayed on the per-op rung "
                         "(~100/s: the full workload would take minutes "
                         "for a figure that is only the baseline)")
+    p.add_argument("--shm-writers", default="",
+                   help="comma list of concurrent shm writer PROCESS "
+                        "counts (e.g. 1,2,4,8): each count W replays the "
+                        "ingress workload split into W disjoint slices "
+                        "through one ring via W `client submit-shm` "
+                        "processes (start-barrier synchronized), one "
+                        "shm_wW row per ingress section with per-writer "
+                        "fairness columns. Needs a submit-only workload "
+                        "(the synthetic default) — concurrent writers "
+                        "interleave, so recorded cancel targets would "
+                        "not resolve")
     p.add_argument("--audit-ab", action="store_true",
                    help="A/B the online auditor's overhead: run each "
                         "(mode, inflight, batch-ops) point twice through "
@@ -1687,6 +1698,132 @@ def main() -> None:
                   f"(n {best['n_ops']}, acc {best['accepted']}, rej "
                   f"{best['rejected']}, wall {best['wall_s']}s)",
                   file=sys.stderr)
+        # -- multi-writer saturation sweep (shm_wW rows) -------------------
+        def replay_shm_multi(section: str, W: int, rep: int) -> dict:
+            """W concurrent `client submit-shm` PROCESSES over disjoint
+            slices of the workload into one ring: spawn, wait for every
+            writer to attach + register, release a start barrier, and
+            measure the aggregate window from the release to the last
+            exit (python startup excluded on every writer equally)."""
+            tag = f"{section}_shm_w{W}_{rep}"
+            shm_path = os.path.join(tmpd, f"ring_{tag}")
+            proc, port = boot(tag, shm_path, section == "screened")
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONUNBUFFERED="1")
+            writers = []
+            try:
+                stub = MatchingEngineStub(grpc.insecure_channel(
+                    f"127.0.0.1:{port}"))
+                c0 = scrape(stub)
+                barrier = os.path.join(tmpd, f"go_{tag}")
+                per = len(arr) // W
+                for i in range(W):
+                    cnt = per if i < W - 1 else len(arr) - per * (W - 1)
+                    summ = os.path.join(tmpd, f"w_{tag}_{i}.json")
+                    ready = os.path.join(tmpd, f"ready_{tag}_{i}")
+                    writers.append((summ, ready, _sp.Popen(
+                        [sys.executable, "-m",
+                         "matching_engine_tpu.client.cli", "submit-shm",
+                         shm_path, workload_name,
+                         "--offset", str(i * per), "--count", str(cnt),
+                         "--chunk", str(bs), "--timeout", "300",
+                         "--quiet", "--summary-json", summ,
+                         "--ready-file", ready,
+                         "--start-barrier", barrier],
+                        env=env, stdout=_sp.DEVNULL,
+                        stderr=_sp.DEVNULL)))
+                deadline = time.time() + 120
+                while (not all(os.path.exists(r) for _s, r, _p in writers)
+                       and time.time() < deadline):
+                    time.sleep(0.01)
+                open(barrier, "w").write("go")
+                t0 = time.perf_counter()
+                for _s, _r, p_ in writers:
+                    # Exit 3 = replay completed with zero accepts — the
+                    # screened section rejects every submit BY DESIGN.
+                    if p_.wait(timeout=600) not in (0, 3):
+                        raise RuntimeError(
+                            f"shm writer exited {p_.returncode} "
+                            f"({tag})")
+                spawn_wall = time.perf_counter() - t0
+                sums = [_json.load(open(s)) for s, _r, _p in writers]
+                c1 = scrape(stub)
+                # The aggregate window: barrier release to the LAST
+                # writer's final drain — max over the (barrier-
+                # synchronized) per-writer windows, which excludes each
+                # interpreter's teardown (spawn_to_exit_s keeps the
+                # raw parent-side figure for comparison).
+                wall = max(s["wall_s"] for s in sums)
+                # Per-writer fairness over each writer's OWN post-
+                # barrier window: ops-through-the-edge per second.
+                rates = [(s["accepted"] + s["rejected"]) / s["wall_s"]
+                         for s in sums if s["wall_s"] > 0]
+                wids = [s["writer_id"] for s in sums]
+                perw = {w: c1.get(f"ingress_writer{w}_records", 0)
+                        - c0.get(f"ingress_writer{w}_records", 0)
+                        for w in wids}
+                return {
+                    "rung": f"shm_w{W}",
+                    "engine": section,
+                    "writers": W,
+                    "n_ops": len(arr),
+                    "orders_per_s": round(len(arr) / wall, 1),
+                    "accepted": sum(s["accepted"] for s in sums),
+                    "rejected": sum(s["rejected"] for s in sums),
+                    "wall_s": round(wall, 3),
+                    "spawn_to_exit_s": round(spawn_wall, 3),
+                    "per_writer_ops_per_s": [round(r, 1)
+                                             for r in sorted(rates)],
+                    "fairness_min_over_max": round(
+                        min(rates) / max(rates), 3) if rates else 0.0,
+                    # The poller's per-writer series must account for
+                    # every record, attributed to a registered lane.
+                    "per_writer_records": perw,
+                    "per_writer_records_ok":
+                        all(w > 0 for w in wids)
+                        and sum(perw.values()) == len(arr),
+                    "ingress_torn_recoveries":
+                        c1.get("ingress_torn_recoveries", 0),
+                }
+            finally:
+                for _s, _r, p_ in writers:
+                    if p_.poll() is None:
+                        p_.kill()
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+
+        wlist = [int(x) for x in args.shm_writers.split(",")
+                 if x.strip()]
+        if wlist and "shm" in rungs and gap:
+            print("[ingress] --shm-writers needs a submit-only workload "
+                  "(recorded cancel targets do not survive concurrent "
+                  "interleaving); skipping the multi-writer sweep",
+                  file=sys.stderr)
+            wlist = []
+        if wlist and "shm" in rungs:
+            for section in sections:
+                base_rate = None
+                for W in wlist:
+                    reps = [replay_shm_multi(section, W, rep)
+                            for rep in range(max(1, args.repeats))]
+                    rates = [r["orders_per_s"] for r in reps]
+                    best = max(reps, key=lambda r: r["orders_per_s"])
+                    best["repeats"] = len(reps)
+                    best["orders_per_s_spread"] = [min(rates),
+                                                   max(rates)]
+                    if W == 1 or base_rate is None:
+                        base_rate = best["orders_per_s"]
+                    best["vs_1writer_x"] = round(
+                        best["orders_per_s"] / base_rate, 2)
+                    rows.append(best)
+                    print(f"[ingress] {section}/shm_w{W}: "
+                          f"{best['orders_per_s']} orders/s "
+                          f"({best['vs_1writer_x']}x vs w1, fairness "
+                          f"{best['fairness_min_over_max']}, wall "
+                          f"{best['wall_s']}s)", file=sys.stderr)
         # The headline ratios, per section.
         for section in sections:
             by = {r["rung"]: r for r in rows if r["engine"] == section}
@@ -2259,6 +2396,10 @@ def main() -> None:
                                       f"submit-only maker/taker records)")
         out["ingress_batch_size"] = args.ingress_batch_size
         out["ingress_chunk"] = args.ingress_chunk
+        if args.shm_writers:
+            out["shm_writers"] = [int(x) for x in
+                                  args.shm_writers.split(",")
+                                  if x.strip()]
         out["edge_mega"] = args.edge_mega
         out["edge_window_ms"] = args.edge_window_ms
     tmp = args.json_out + ".tmp"

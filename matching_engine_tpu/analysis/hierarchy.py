@@ -263,9 +263,14 @@ THREAD_ROLES: dict[str, tuple[str, ...]] = {
     # flush, and the opt-in auto-promote trigger.
     "repl_watch": ("StandbyReplica._watcher_loop",),
     # The shared-memory ingress poller (server/shm_ingress.py): pops
-    # committed record runs from the shm ring, screens them through the
-    # service's shared batch pipeline (admission + routing + dispatch),
-    # and answers through the response ring.
+    # committed record runs from the shm ring (ring v2: N registered
+    # writer lanes fan into one ring; commit words carry the lane id),
+    # screens them through the service's shared batch pipeline
+    # (admission + routing + dispatch), accounts per-writer admit/reject
+    # series off the commit-stamped lane column, and answers through the
+    # response ring's per-lane demux cursors. Single consumer by
+    # design — the multi-producer side lives in native/me_shmring.cpp
+    # (lock-free claim CAS), not in python threads.
     "shm_poller": ("ShmIngress._run",),
     # The merged feed fan-in's single merger (feed/fanin.py): drains the
     # K lanes' publish queue, enforces per-lane seq contiguity, delivers

@@ -37,7 +37,8 @@ USAGE = (
     "   or: client submit-stream <addr> <opfile> [--chunk N]\n"
     "                 [--summary-json FILE] [--quiet]\n"
     "   or: client submit-shm <segment> <opfile> [--chunk N]\n"
-    "                 [--timeout SECS] [--summary-json FILE] [--quiet]\n"
+    "                 [--timeout SECS] [--offset N] [--count N]\n"
+    "                 [--summary-json FILE] [--quiet]\n"
     "   or: client audit <addr> [--from-seq N] [--epoch N]\n"
     "                 [--no-gap-fill] [--max-events N] [--idle-exit SECS]\n"
     "                 [--capture FILE] [--summary-json FILE] [--quiet]\n"
@@ -675,6 +676,8 @@ def _submit_shm(argv: list[str]) -> int:
     seg, path = argv[0], argv[1]
     chunk, timeout_s, summary_json, quiet = 256, 60.0, None, False
     max_inflight = 1 << 30
+    offset, count = 0, -1
+    ready_file = start_barrier = None
     it = iter(argv[2:])
     try:
         for a in it:
@@ -688,6 +691,21 @@ def _submit_shm(argv: list[str]) -> int:
                 # min_cancel_gap so the poller can never dispatch a
                 # cancel in the same batch as its target submit.
                 max_inflight = int(next(it))
+            elif a == "--offset":
+                # Multi-writer partitioning: N concurrent submit-shm
+                # processes each replay a disjoint [offset, offset+count)
+                # slice of one op file through the same segment.
+                offset = int(next(it))
+            elif a == "--count":
+                count = int(next(it))
+            elif a == "--ready-file":
+                # Multi-writer start synchronization (the bench and the
+                # soak): touch ready-file once attached + registered,
+                # then hold at the barrier so every writer's measured
+                # window starts together (python startup excluded).
+                ready_file = next(it)
+            elif a == "--start-barrier":
+                start_barrier = next(it)
             elif a == "--summary-json":
                 summary_json = next(it)
             elif a == "--quiet":
@@ -698,7 +716,7 @@ def _submit_shm(argv: list[str]) -> int:
     except StopIteration:
         print(USAGE, file=sys.stderr)
         return 1
-    if chunk < 1:
+    if chunk < 1 or offset < 0:
         print(USAGE, file=sys.stderr)
         return 1
     try:
@@ -706,11 +724,33 @@ def _submit_shm(argv: list[str]) -> int:
     except (OSError, oprec.OpRecError) as e:
         print(f"[client] cannot read op file: {e}", file=sys.stderr)
         return 1
+    if offset or count >= 0:
+        end = len(arr) if count < 0 else min(len(arr), offset + count)
+        arr = arr[offset:end]
     try:
         ring = me_native.ShmRing(seg)
     except RuntimeError as e:
         print(f"[client] cannot attach shm segment: {e}", file=sys.stderr)
         return 2
+    # Claim a writer lane: responses come back on this lane's private
+    # sub-ring, so N concurrent clients each see exactly their own acks.
+    # A full registry (>15 writers) falls back to the shared anonymous
+    # lane 0 — correct, but acks are then interleaved with other
+    # anonymous writers'.
+    writer_id = ring.register_writer()
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(str(writer_id))
+    if start_barrier:
+        import os as _os
+        barrier_deadline = time.perf_counter() + timeout_s
+        while not _os.path.exists(start_barrier):
+            if time.perf_counter() > barrier_deadline:
+                print("[client] start barrier never released",
+                      file=sys.stderr)
+                ring.close()
+                return 2
+            time.sleep(0.002)
     total = len(arr)
     deadline = time.perf_counter() + timeout_s
     accepted = rejected = accepted_submits = 0
@@ -790,7 +830,7 @@ def _submit_shm(argv: list[str]) -> int:
     rate = accepted / dt if dt > 0 else 0.0
     summary = {"ops": total, "pushed": pushed, "chunk": chunk,
                "accepted": accepted, "accepted_submits": accepted_submits,
-               "rejected": rejected,
+               "rejected": rejected, "writer_id": writer_id,
                "wall_s": round(dt, 3), "accepted_per_s": round(rate, 1),
                "reject_reasons": reasons}
     print(f"[client] shm replay: {accepted}/{total} accepted, "

@@ -24,7 +24,7 @@ python <-> C++):
     16      symbol_len     u16
     18      client_id_len  u16
     20      order_id_len   u16
-    22      (pad)          u16
+    22      writer         u16  shm multi-producer lane id (0 elsewhere)
     24      symbol         64 bytes
     88      client_id      256 bytes
     344     order_id       36 bytes ("OID-<n>" cancel/amend target)
@@ -61,7 +61,10 @@ OPREC_DTYPE = np.dtype([
     ("symbol_len", "<u2"),
     ("client_id_len", "<u2"),
     ("order_id_len", "<u2"),
-    ("_pad", "<u2"),
+    # Shm multi-producer lane: me_shmring_commit stamps the committing
+    # handle's writer id here (0 = anonymous/legacy). Every other edge
+    # carries 0 — the old reserved pad, renamed, byte-identical.
+    ("writer", "<u2"),
     ("symbol", f"S{SYMBOL_BYTES}"),
     ("client_id", f"S{CLIENT_ID_BYTES}"),
     ("order_id", f"S{ORDER_ID_BYTES}"),
@@ -266,7 +269,11 @@ SHM_RESP_DTYPE = np.dtype([
     ("kind", "u1"),
     ("reason", "u1"),
     ("oid_len", "u1"),
-    ("_pad", "V4"),
+    # Writer lane echoed from the request record: me_shmring_respond_n
+    # routes the response into THIS writer's private sub-ring, and the
+    # stamp lets a client self-check it only ever sees its own acks.
+    ("writer", "u1"),
+    ("_pad", "V3"),
 ])
 assert SHM_RESP_DTYPE.itemsize == 48
 

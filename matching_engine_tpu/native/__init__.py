@@ -321,7 +321,10 @@ class MeShmResp(ctypes.Structure):
         ("kind", ctypes.c_uint8),
         ("reason", ctypes.c_uint8),
         ("oid_len", ctypes.c_uint8),
-        ("pad", ctypes.c_char * 4),
+        # Writer lane echoed from the request record (per-writer response
+        # demux — see MeShmResp in native/me_gwop.h).
+        ("writer", ctypes.c_uint8),
+        ("pad", ctypes.c_char * 3),
     ]
 
 
@@ -865,6 +868,13 @@ def _bind_lanes(lib) -> None:
     ]
     lib.me_shmring_resp_poll.restype = ctypes.c_int
     lib.me_shmring_stats.argtypes = [ctypes.c_void_p, i64p, i64p, i64p, i64p]
+    lib.me_shmring_register.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_register.restype = ctypes.c_int
+    lib.me_shmring_deregister.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_writer_id.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_writer_id.restype = ctypes.c_int
+    lib.me_shmring_writer_count.argtypes = [ctypes.c_void_p]
+    lib.me_shmring_writer_count.restype = ctypes.c_int
 
 
 def oprec_flaw_codes(body: bytes, n: int, max_price_q4: int,
@@ -1402,10 +1412,15 @@ class ShmRing:
     futex doorbell, and a response ring of MeShmResp records.
 
     Server: ShmRing(path, create=True) + poll()/respond()/stats();
-    client: ShmRing(path) + push_payload()/resp_poll(). One instance per
-    process side; the poller is the single consumer, the server the
-    single response writer. Crash-safety (torn-slot recovery) lives in
-    the C++ layer — see the me_shmring.cpp header comment."""
+    client: ShmRing(path) + push_payload()/resp_poll(). The request ring
+    is MULTI-PRODUCER (v2): any number of attached processes may
+    claim/commit concurrently; register_writer() leases a private
+    response lane (ids 1..15) so each client sees exactly its own acks,
+    while an unregistered handle rides the anonymous lane 0 (the v1
+    single-client behavior). The poller stays the single consumer and
+    the server the single response publisher. Crash-safety (claim-stamp
+    attribution, pid-leased torn recovery) lives in the C++ layer — see
+    the me_shmring.cpp header comment."""
 
     def __init__(self, path: str, create: bool = False,
                  slots: int = 4096, resp_slots: int = 8192):
@@ -1429,6 +1444,24 @@ class ShmRing:
         self._resp_buf = None
 
     # -- writer (client process) ------------------------------------------
+
+    def register_writer(self) -> int:
+        """Lease a writer lane (ids 1..15): claims stamped under this
+        registration are recovered only once this process is DEAD (the
+        poller checks the registry pid), and responses to its records
+        land on its private sub-ring. Returns the writer id; falls back
+        to the anonymous lane 0 (deadline-only recovery, shared lane)
+        when every slot is held by a live registrant."""
+        wid = int(self._lib.me_shmring_register(self._h))
+        return max(wid, 0)
+
+    @property
+    def writer_id(self) -> int:
+        return int(self._lib.me_shmring_writer_id(self._h))
+
+    def writer_count(self) -> int:
+        """Live registered writers (the me_ingress_writers gauge)."""
+        return int(self._lib.me_shmring_writer_count(self._h))
 
     def push_payload(self, body: bytes, n: int) -> int:
         """Copy-in write of a packed record run (no magic): claim n

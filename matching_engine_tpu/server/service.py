@@ -889,6 +889,18 @@ class MatchingEngineService(MatchingEngineServicer):
                 order_id=request.order_id, success=False,
                 error_message="new_quantity must be positive",
             )
+        from matching_engine_tpu.domain.order import MAX_QUANTITY
+        if request.new_quantity > MAX_QUANTITY:
+            # The bulk edges (record_flaws / me_oprec_flaws code 10) have
+            # always enforced the engine cap on amends; the per-op paths
+            # screen it too now — byte-identical wording on both edges
+            # (the C++ gateway runs perop_flaw, this mirrors it).
+            return pb2.AmendResponse(
+                order_id=request.order_id, success=False,
+                error_message=(f"quantity exceeds the engine maximum "
+                               f"{MAX_QUANTITY} (int32 book-sum safety "
+                               f"bound)"),
+            )
         if self.admission is not None and self.admission.enabled:
             aerr = self.admission.screen_one(
                 3, 0, 0, 0, request.new_quantity, b"",

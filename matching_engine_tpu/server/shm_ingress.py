@@ -10,9 +10,15 @@ through the response ring as fixed 48-byte MeShmResp records keyed by
 ring sequence. Per-op work on the ingress side is one memcpy out of the
 ring slot and the numpy screen passes — no proto, no python per-op.
 
-Crash-safety is the ring's contract (per-slot commit words + torn-slot
-recovery — see the me_shmring.cpp header); this module just surfaces the
-recoveries as me_ingress_torn_recoveries and keeps serving.
+The request ring is MULTI-PRODUCER (ring v2): every admitted record
+carries the writer lane that committed it, the poller meters per-writer
+flow (me_ingress_writer<i>_records / _rejects, f-string series — one per
+lane that has actually published) and stamps the writer into each
+response so the ring demuxes it onto that writer's private response
+sub-ring. Crash-safety is the ring's contract (per-slot commit words,
+claim-stamp attribution, pid-leased torn recovery — see the
+me_shmring.cpp header); this module just surfaces the recoveries as
+me_ingress_torn_recoveries and keeps serving.
 """
 
 from __future__ import annotations
@@ -22,6 +28,7 @@ import threading
 import numpy as np
 
 from matching_engine_tpu.domain import oprec
+from matching_engine_tpu.utils.obs import warn_rate_limited
 
 
 class ShmIngress:
@@ -45,7 +52,8 @@ class ShmIngress:
         # the me_ingress_* series from boot, not first traffic — the
         # soak's missing-metric check depends on it.
         for name in ("ingress_records", "ingress_batches",
-                     "ingress_rejects", "ingress_torn_recoveries"):
+                     "ingress_rejects", "ingress_torn_recoveries",
+                     "ingress_batch_failures"):
             metrics.inc(name, 0)
         self._sample_gauges()
         self._stop = threading.Event()
@@ -91,16 +99,34 @@ class ShmIngress:
                 # survive any per-batch failure; answer the batch as
                 # engine errors instead of stranding the client.
                 m.inc("dispatch_errors")
-                print(f"[shm-ingress] batch failed: {type(e).__name__}: {e}")
+                m.inc("ingress_batch_failures")
+                warn_rate_limited(
+                    "shm-ingress-batch",
+                    f"[shm-ingress] batch failed: "
+                    f"{type(e).__name__}: {e} "
+                    f"(me_ingress_batch_failures_total carries the rate)")
                 ok = [False] * n
                 oids = [""] * n
                 errs = ["engine error"] * n
                 rems = [0] * n
                 reasons = None
                 flaws = [None] * n
-            rejects = n - sum(ok)
+            okv = np.fromiter(ok, dtype=bool, count=n)
+            rejects = n - int(np.count_nonzero(okv))
             if rejects:
                 m.inc("ingress_rejects", rejects)
+            # Per-writer metering (multi-producer ring): the commit path
+            # stamped each record's writer lane; count records/rejects
+            # per lane that actually published this batch (f-string
+            # series — the doc-lint dynamic-name rule, like the per-lane
+            # queue gauges).
+            for w, cnt in zip(*np.unique(arr["writer"],
+                                         return_counts=True)):
+                m.inc(f"ingress_writer{int(w)}_records", int(cnt))
+            if rejects:
+                for w, cnt in zip(*np.unique(arr["writer"][~okv],
+                                             return_counts=True)):
+                    m.inc(f"ingress_writer{int(w)}_rejects", int(cnt))
             # Positional responses, keyed by ring sequence, built as ONE
             # numpy SHM_RESP_DTYPE array (no per-op python on the common
             # all-accepted path). Reject reasons are codes (the shm edge
@@ -111,7 +137,9 @@ class ShmIngress:
             resp["seq"] = seqs
             resp["kind"] = np.maximum(
                 arr["op"].astype(np.int16) - 1, 0).astype(np.uint8)
-            okv = np.fromiter(ok, dtype=bool, count=n)
+            # Echo the writer lane: the ring demuxes each response onto
+            # this writer's private sub-ring (per-writer ack exactness).
+            resp["writer"] = arr["writer"].astype(np.uint8)
             resp["ok"] = okv
             if okv.any():
                 resp["remaining"][okv] = np.fromiter(
@@ -153,3 +181,4 @@ class ShmIngress:
         m.set_gauge("ingress_ring_depth", s["depth"])
         m.set_gauge("ingress_doorbell_wakes", s["doorbell_wakes"])
         m.set_gauge("ingress_resp_dropped", s["resp_dropped"])
+        m.set_gauge("ingress_writers", self.ring.writer_count())

@@ -1345,6 +1345,27 @@ void Conn::reject_cancel(uint32_t stream_id, const std::string& order_id,
   write_unary(stream_id, bytes, 0, nullptr);
 }
 
+std::string flaw_message(int32_t code, uint8_t op, long long max_qty,
+                         long long max_price_q4);  // defined below handle_batch
+
+// Native per-op admission screen (the PR 16 residual): run the SAME
+// structural pass every bulk edge runs (me_oprec_flaws — record_flaws'
+// native twin) over the single validated record, so per-op RPC traffic
+// gets the identical screen vocabulary without a python hop. For
+// submits the proto validation above is a superset and this is
+// belt-and-braces; for cancels/amends it is where the per-op path picks
+// up the record-box rules (empty target -> "unknown order id") and the
+// engine quantity cap the batch edge already enforced on amends.
+int32_t perop_flaw(const MeOpRec& rec, long long max_price_q4,
+                   long long max_quantity) {
+  int32_t code = 0;
+  if (me_oprec_flaws(reinterpret_cast<const uint8_t*>(&rec),
+                     static_cast<long long>(sizeof(MeOpRec)), max_price_q4,
+                     max_quantity, &code, 1) != 1)
+    return 0;  // a ragged single record can't happen for an in-stack rec
+  return code;
+}
+
 void Conn::handle_submit(uint32_t stream_id, const std::string& payload) {
   pb::OrderRequest req;
   if (!req.ParseFromString(payload)) {
@@ -1361,6 +1382,27 @@ void Conn::handle_submit(uint32_t stream_id, const std::string& payload) {
                            &price_q4, &otype, &err)) {
     reject_submit(stream_id, "", err);
     return;
+  }
+  {
+    MeOpRec rec{};
+    rec.op = 1;
+    rec.side = static_cast<uint8_t>(req.side());
+    rec.otype = static_cast<uint8_t>(otype);
+    rec.price_q4 = static_cast<int32_t>(price_q4);
+    rec.quantity = req.quantity();
+    rec.symbol_len = static_cast<uint16_t>(req.symbol().size());
+    std::memcpy(rec.symbol, req.symbol().data(),
+                std::min(req.symbol().size(), sizeof(rec.symbol)));
+    rec.client_id_len = static_cast<uint16_t>(
+        std::min(req.client_id().size(), sizeof(rec.client_id)));
+    std::memcpy(rec.client_id, req.client_id().data(), rec.client_id_len);
+    int32_t code = perop_flaw(rec, gw_->max_price_q4(), gw_->max_quantity());
+    if (code != 0) {
+      reject_submit(stream_id, "",
+                    flaw_message(code, rec.op, gw_->max_quantity(),
+                                 gw_->max_price_q4()));
+      return;
+    }
   }
   MeGwOp op{};
   op.op = 1;
@@ -1395,6 +1437,26 @@ void Conn::handle_cancel(uint32_t stream_id, const std::string& payload) {
   if (req.order_id().size() > sizeof(MeGwOp::order_id)) {
     reject_cancel(stream_id, req.order_id(), "unknown order id");
     return;
+  }
+  {
+    // Screen rec lengths are CLAMPED to the record boxes (like the
+    // MeGwOp copy below): an over-long requester id must keep resolving
+    // as wrong-owner in the bridge, not trip the box rule here.
+    MeOpRec rec{};
+    rec.op = 2;
+    rec.order_id_len = static_cast<uint16_t>(
+        std::min(req.order_id().size(), sizeof(rec.order_id)));
+    std::memcpy(rec.order_id, req.order_id().data(), rec.order_id_len);
+    rec.client_id_len = static_cast<uint16_t>(
+        std::min(req.client_id().size(), sizeof(rec.client_id)));
+    std::memcpy(rec.client_id, req.client_id().data(), rec.client_id_len);
+    int32_t code = perop_flaw(rec, gw_->max_price_q4(), gw_->max_quantity());
+    if (code != 0) {
+      reject_cancel(stream_id, req.order_id(),
+                    flaw_message(code, rec.op, gw_->max_quantity(),
+                                 gw_->max_price_q4()));
+      return;
+    }
   }
   MeGwOp op{};
   op.op = 2;
@@ -1435,6 +1497,27 @@ void Conn::handle_amend(uint32_t stream_id, const std::string& payload) {
   if (req.order_id().size() > sizeof(MeGwOp::order_id)) {
     reject_amend(stream_id, req.order_id(), "unknown order id");
     return;
+  }
+  {
+    MeOpRec rec{};
+    rec.op = 3;
+    rec.quantity = req.new_quantity();
+    rec.order_id_len = static_cast<uint16_t>(
+        std::min(req.order_id().size(), sizeof(rec.order_id)));
+    std::memcpy(rec.order_id, req.order_id().data(), rec.order_id_len);
+    rec.client_id_len = static_cast<uint16_t>(
+        std::min(req.client_id().size(), sizeof(rec.client_id)));
+    std::memcpy(rec.client_id, req.client_id().data(), rec.client_id_len);
+    int32_t code = perop_flaw(rec, gw_->max_price_q4(), gw_->max_quantity());
+    if (code != 0) {
+      // The one per-op screen with real teeth: an amend new_quantity
+      // over the engine cap (code 10) — the bulk edges always enforced
+      // it; service.AmendOrder mirrors the check for edge parity.
+      reject_amend(stream_id, req.order_id(),
+                   flaw_message(code, rec.op, gw_->max_quantity(),
+                                gw_->max_price_q4()));
+      return;
+    }
   }
   MeGwOp op{};
   op.op = 3;

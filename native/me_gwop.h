@@ -46,7 +46,12 @@ struct MeOpRec {
   uint16_t symbol_len;
   uint16_t client_id_len;
   uint16_t order_id_len;
-  uint16_t pad;
+  // Shm multi-producer lane: me_shmring_commit stamps the committing
+  // handle's writer id here (0 = the anonymous/legacy single writer), so
+  // the poller can demux responses and meter per-writer flow. On every
+  // other edge (opfiles, batch RPC payloads) the field rides as 0 — the
+  // old reserved pad, renamed, byte-identical.
+  uint16_t writer;
   char symbol[64];     // == MAX_SYMBOL_BYTES
   char client_id[256];  // == MAX_CLIENT_ID_BYTES
   char order_id[36];
@@ -67,7 +72,12 @@ struct MeShmResp {
   uint8_t kind;        // 0 submit / 1 cancel / 2 amend
   uint8_t reason;      // MeIngressReason (0 when ok)
   uint8_t oid_len;
-  char pad[4];
+  // Writer id echoed from the request record (MeOpRec.writer):
+  // me_shmring_respond_n routes each response into THIS writer's private
+  // response sub-ring, and the stamp lets a client self-check that it
+  // only ever sees its own acks.
+  uint8_t writer;
+  char pad[3];
 };
 
 // Reject reason codes on the shm ingress edge — ONE vocabulary across

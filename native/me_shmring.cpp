@@ -1,40 +1,64 @@
 // me_shmring: the zero-copy shared-memory ingress ring (ROADMAP Open
-// item 3a — the CoinTossX design point, arXiv:2102.10925).
+// item 3a — the CoinTossX design point, arXiv:2102.10925), version 2:
+// a true MULTI-PRODUCER edge (ROADMAP Open item 2 — N co-located
+// producer processes is the realistic heavy-traffic shape).
 //
-// A co-located client process maps one file-backed segment and writes
+// N co-located client processes map one file-backed segment and write
 // flat 384-byte op-records (MeOpRec — the PR 7 codec, unchanged on the
 // wire) straight into ring slots; the server's poller thread consumes
 // committed runs, screens them through the vectorized admission
 // pipeline, and bulk-pushes them into the lane rings — no proto, no
 // python per-op, no copy beyond the ring slot. Responses flow back
-// through a second single-writer ring of fixed 48-byte MeShmResp
-// records keyed by the request's ring sequence.
+// through PER-WRITER response sub-rings of fixed 48-byte MeShmResp
+// records keyed by the request's ring sequence: each registered writer
+// owns a private lane (cursors + doorbell + slots), so every client
+// sees exactly its own positional acks and nothing else.
 //
-// CRASH-SAFETY CONTRACT (pinned by the kill-fuzz test): a writer
-// SIGKILLed at ANY instruction must never yield a torn, lost, or
-// duplicated admitted record.
+// CRASH-SAFETY CONTRACT (pinned by the kill-fuzz tests, single- and
+// multi-writer): a writer SIGKILLed at ANY instruction must never yield
+// a torn, lost, or duplicated admitted record, and must never stall the
+// OTHER writers' committed runs for longer than the torn window.
 //   - Every slot has a COMMIT/SEQ word. A writer first CLAIMS a run of
-//     sequences (CAS on req_tail), then writes the record bytes, then
-//     publishes with a release-store of seq+1 into the slot's commit
-//     word. The poller admits a slot only when its commit word equals
-//     seq+1 (acquire) — a record the death interrupted mid-write was
-//     never published and can never be read torn.
+//     sequences (CAS on req_tail) and stamps each claimed slot's word
+//     with a CLAIM marker carrying its writer id + registration
+//     generation, then writes the record bytes, then publishes with a
+//     release-store of seq+1 into the word. The poller admits a slot
+//     only when its word equals seq+1 (acquire) — a record the death
+//     interrupted mid-write was never published and can never be read
+//     torn.
 //   - A claimed-but-never-committed slot would stall the FIFO forever
 //     (claims are unique; the dead writer can't finish). The poller
-//     waits `torn_wait_us` for the commit and then RECOVERS the slot:
-//     skips it, counts torn_recovered, admits nothing for it. The
-//     client never saw an ack for that sequence, so nothing
-//     acknowledged is lost; the sequence is consumed, so nothing can
-//     be admitted twice.
+//     waits `torn_wait_us` for the commit and then RECOVERS the slot —
+//     but only once the claim is provably ORPHANED: the marker's
+//     (writer, generation) is checked against the registry and the
+//     registrant's pid against the kernel (kill(pid, 0) == ESRCH). A
+//     merely SLOW registered writer is waited out (its claim is leased
+//     on its life); a dead one's consecutive claims are swept in ONE
+//     recovery pass, so a victim holding a chunk claim costs one torn
+//     window, not one per slot. Anonymous (unregistered, writer 0)
+//     claims keep the v1 deadline-only rule — there is no pid to
+//     check. The client never saw an ack for a recovered sequence, so
+//     nothing acknowledged is lost; the sequence is consumed, so
+//     nothing can be admitted twice.
 //   - Cursors are monotonic uint64 (never wrapped); slot reuse a lap
-//     later re-publishes with a strictly larger commit value, so a
-//     stale commit word can never satisfy a newer sequence.
+//     later re-publishes with a strictly larger commit value and claim
+//     markers embed the sequence, so a stale word can never satisfy a
+//     newer sequence.
+//   - Residual (documented, not closed): liveness is by pid — a zombie
+//     (dead but unreaped) or a recycled pid reads as alive and extends
+//     the wait; an ANONYMOUS claimant recovered while alive-but-stalled
+//     can, if the ring also wraps back to that slot within the torn
+//     window, race its late bytes against the new claimant's. Register
+//     writers (ids 1..15) to get the leased behavior; keep torn windows
+//     well above scheduler jitter.
 //
 // The doorbell is a futex word in the shared mapping (eventfd would
 // need fd passing between unrelated processes): writers bump-and-wake
 // after a committed run, the poller waits on the word's value with a
 // timeout — a wake between the value read and the wait returns
 // immediately (classic futex protocol), so no doorbell is ever missed.
+// Each response lane has its own doorbell so one client's wake never
+// spuriously rouses another.
 //
 // Compiled into libme_native.so (no protobuf dependency). Linux-only
 // (SYS_futex); every entry point degrades to an error return, never a
@@ -42,6 +66,7 @@
 
 #include <atomic>
 #include <cerrno>
+#include <csignal>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
@@ -58,29 +83,64 @@
 namespace {
 
 constexpr char kMagic[8] = {'M', 'E', 'S', 'H', 'M', 'R', 'G', '1'};
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;       // v2: multi-producer + resp lanes
 constexpr size_t kHeaderBytes = 4096;  // one page; sections follow aligned
+constexpr uint32_t kMaxWriters = 16;   // lane 0 = anonymous; 1..15 register
+
+// Commit-word states. A slot word is exactly one of:
+//   seq + 1                         committed (record readable)
+//   kClaimBit | gen | wid | seq+1   claimed by (wid, gen), uncommitted
+//   anything else                   stale (prior lap) or pre-stamp claim
+// The claim marker embeds the low 51 bits of seq+1 so a stale marker
+// from an earlier lap can never be mistaken for the current claim.
+constexpr uint64_t kClaimBit = 1ull << 63;
+constexpr int kGenShift = 55;  // 8 bits of registration generation
+constexpr int kWidShift = 51;  // 4 bits of writer id
+constexpr uint64_t kSeqMask = (1ull << 51) - 1;
+
+uint64_t claim_word(uint64_t seq, uint32_t wid, uint32_t gen) {
+  return kClaimBit | (uint64_t{gen & 0xff} << kGenShift) |
+         (uint64_t{wid & 0xf} << kWidShift) | ((seq + 1) & kSeqMask);
+}
+
+// One writer's private response lane: the server is the sole publisher
+// (tail), the owning client the sole consumer (head). One cacheline per
+// lane keeps lanes from false-sharing each other; tail/head sharing a
+// line within a lane is the classic SPSC trade accepted here.
+struct RespLane {
+  alignas(64) std::atomic<uint64_t> tail;  // server publish cursor
+  std::atomic<uint64_t> head;              // owning client consume cursor
+  std::atomic<uint64_t> dropped;           // lane-full response drops
+  std::atomic<uint32_t> doorbell;
+};
+static_assert(sizeof(RespLane) == 64, "one cacheline per response lane");
+
+// Writer registry entry. pid == 0 marks a free slot; gen bumps on every
+// (re)registration of the slot so a claim stamped under a previous
+// registrant is recognizably orphaned even after the slot is reused.
+struct WriterEnt {
+  std::atomic<uint32_t> pid;
+  std::atomic<uint32_t> gen;
+};
 
 struct ShmHeader {
   char magic[8];
   uint32_t version;
-  uint32_t req_cap;     // request slots (power of two)
-  uint32_t resp_cap;    // response slots (power of two)
+  uint32_t req_cap;      // request slots (power of two)
+  uint32_t resp_cap;     // response slots PER WRITER LANE (power of two)
   uint32_t record_size;  // sizeof(MeOpRec); attach refuses a skewed build
   // Cursors are monotonic sequence numbers, never wrapped; slot index is
   // seq & (cap - 1). Cacheline-separated: the claim word is contended by
   // writers, the head only by the poller.
-  alignas(64) std::atomic<uint64_t> req_tail;   // writer claim cursor
-  alignas(64) std::atomic<uint64_t> req_head;   // poller consume cursor
+  alignas(64) std::atomic<uint64_t> req_tail;  // writer claim cursor
+  alignas(64) std::atomic<uint64_t> req_head;  // poller consume cursor
   alignas(64) std::atomic<uint32_t> req_doorbell;
-  std::atomic<uint32_t> resp_doorbell;
-  std::atomic<uint32_t> closed;                 // server shutdown latch
-  alignas(64) std::atomic<uint64_t> resp_tail;  // server publish cursor
-  alignas(64) std::atomic<uint64_t> resp_head;  // client consume cursor
+  std::atomic<uint32_t> closed;  // server shutdown latch
   // Shared counters (the server scrapes these into me_ingress_*).
   alignas(64) std::atomic<uint64_t> torn_recovered;
-  std::atomic<uint64_t> resp_dropped;
   std::atomic<uint64_t> doorbell_wakes;
+  alignas(64) WriterEnt writers[kMaxWriters];
+  alignas(64) RespLane resp[kMaxWriters];
 };
 static_assert(sizeof(ShmHeader) <= kHeaderBytes, "header must fit its page");
 
@@ -89,22 +149,24 @@ struct ShmRing {
   size_t map_len = 0;
   int fd = -1;
   bool owner = false;
+  uint32_t wid = 0;  // this handle's writer lane (0 = anonymous)
+  uint32_t gen = 0;  // registration generation stamped into claims
 
   ShmHeader* hdr = nullptr;
   std::atomic<uint64_t>* req_seq = nullptr;  // [req_cap] commit words
   uint8_t* req_recs = nullptr;               // [req_cap] MeOpRec slots
-  MeShmResp* resp_recs = nullptr;            // [resp_cap]
+  MeShmResp* resp_recs = nullptr;            // [kMaxWriters * resp_cap]
 };
 
 bool pow2(uint32_t v) { return v != 0 && (v & (v - 1)) == 0; }
 
 size_t layout_len(uint32_t req_cap, uint32_t resp_cap) {
   size_t n = kHeaderBytes;
-  n += sizeof(uint64_t) * req_cap;           // commit words
+  n += sizeof(uint64_t) * req_cap;  // commit words
   n = (n + 63) & ~size_t{63};
   n += sizeof(MeOpRec) * req_cap;
   n = (n + 63) & ~size_t{63};
-  n += sizeof(MeShmResp) * resp_cap;
+  n += sizeof(MeShmResp) * resp_cap * kMaxWriters;
   return (n + 4095) & ~size_t{4095};
 }
 
@@ -143,12 +205,27 @@ int64_t now_us() {
   return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
 }
 
+// Is the claim stamped (wid, gen) provably orphaned? True for anonymous
+// claims (no pid to lease on — the caller's torn deadline is the only
+// protection, the v1 rule), for claims whose registry slot moved on to a
+// new generation or was cleanly freed, and for registrants the kernel
+// says are gone. Zombies and recycled pids read as alive (documented).
+bool claim_orphaned(ShmHeader* hd, uint32_t wid, uint32_t gen) {
+  if (wid == 0 || wid >= kMaxWriters) return true;
+  if ((hd->writers[wid].gen.load(std::memory_order_acquire) & 0xff) != gen)
+    return true;
+  uint32_t pid = hd->writers[wid].pid.load(std::memory_order_acquire);
+  if (pid == 0) return true;
+  return ::kill(static_cast<pid_t>(pid), 0) != 0 && errno == ESRCH;
+}
+
 }  // namespace
 
 extern "C" {
 
 // Server side: create (or truncate) the segment file and initialize the
-// layout. Caps must be powers of two. Returns a handle or nullptr.
+// layout. Caps must be powers of two; resp_cap is PER writer lane.
+// Returns a handle or nullptr.
 void* me_shmring_create(const char* path, uint32_t req_cap,
                         uint32_t resp_cap) {
   if (!path || !pow2(req_cap) || !pow2(resp_cap)) return nullptr;
@@ -219,31 +296,112 @@ void* me_shmring_attach(const char* path) {
   return r;
 }
 
+// Register this handle as a writer: claim a registry slot (ids 1..15),
+// bump its generation, record our pid — claims stamped under this
+// registration are leased on our life (the poller recovers them only
+// once we are dead). Returns the writer id, or -1 when every slot is
+// held by a live registrant (the caller may fall back to anonymous
+// writer 0, which keeps v1 deadline-only recovery semantics).
+int me_shmring_register(void* h) {
+  if (!h) return -1;
+  auto* r = static_cast<ShmRing*>(h);
+  if (r->wid != 0) return static_cast<int>(r->wid);  // idempotent
+  ShmHeader* hd = r->hdr;
+  uint32_t me = static_cast<uint32_t>(::getpid());
+  for (int pass = 0; pass < 2; pass++) {
+    for (uint32_t i = 1; i < kMaxWriters; i++) {
+      uint32_t cur = hd->writers[i].pid.load(std::memory_order_acquire);
+      if (pass == 0 && cur != 0) continue;  // first pass: free slots only
+      if (pass == 1) {
+        // Reap pass: take over a slot whose registrant is gone (its
+        // pending claims, if any, are orphaned by the gen bump and will
+        // be recovered by the poller's torn sweep).
+        if (cur == 0 || me == cur) continue;
+        if (::kill(static_cast<pid_t>(cur), 0) == 0 || errno != ESRCH)
+          continue;
+      } else {
+        cur = 0;
+      }
+      if (hd->writers[i].pid.compare_exchange_strong(
+              cur, me, std::memory_order_acq_rel))
+      {
+        uint32_t g =
+            hd->writers[i].gen.fetch_add(1, std::memory_order_acq_rel) + 1;
+        r->wid = i;
+        r->gen = g & 0xff;
+        return static_cast<int>(i);
+      }
+    }
+  }
+  return -1;
+}
+
+// Release this handle's registry slot (clean shutdown). Pending
+// uncommitted claims, if the caller leaked any, become orphaned and are
+// recovered by the poller after the torn window.
+void me_shmring_deregister(void* h) {
+  if (!h) return;
+  auto* r = static_cast<ShmRing*>(h);
+  if (r->wid == 0 || r->wid >= kMaxWriters) return;
+  uint32_t me = static_cast<uint32_t>(::getpid());
+  r->hdr->writers[r->wid].pid.compare_exchange_strong(
+      me, 0u, std::memory_order_acq_rel);
+  r->wid = 0;
+  r->gen = 0;
+}
+
+// This handle's writer id (0 = anonymous / unregistered).
+int me_shmring_writer_id(void* h) {
+  if (!h) return 0;
+  return static_cast<int>(static_cast<ShmRing*>(h)->wid);
+}
+
+// Live registered writers (the me_ingress_writers gauge): registry slots
+// whose registrant pid still resolves. The anonymous lane is not counted.
+int me_shmring_writer_count(void* h) {
+  if (!h) return 0;
+  auto* r = static_cast<ShmRing*>(h);
+  int n = 0;
+  for (uint32_t i = 1; i < kMaxWriters; i++) {
+    uint32_t pid = r->hdr->writers[i].pid.load(std::memory_order_acquire);
+    if (pid != 0 &&
+        (::kill(static_cast<pid_t>(pid), 0) == 0 || errno != ESRCH))
+      n++;
+  }
+  return n;
+}
+
 void me_shmring_close(void* h) {
   if (!h) return;
   auto* r = static_cast<ShmRing*>(h);
+  me_shmring_deregister(h);
   if (r->map) munmap(r->map, r->map_len);
   if (r->fd >= 0) ::close(r->fd);
   delete r;
 }
 
-// Server shutdown latch: attached writers see -2 from claim/push and the
-// client's response poll returns -2 once drained.
+// Server shutdown latch: attached writers see -2 from claim/push and
+// every client's response poll returns -2 once its lane is drained.
 void me_shmring_shutdown(void* h) {
   if (!h) return;
   auto* r = static_cast<ShmRing*>(h);
-  r->hdr->closed.store(1, std::memory_order_release);
-  r->hdr->req_doorbell.fetch_add(1, std::memory_order_release);
-  r->hdr->resp_doorbell.fetch_add(1, std::memory_order_release);
-  futex_wake_all(&r->hdr->req_doorbell);
-  futex_wake_all(&r->hdr->resp_doorbell);
+  ShmHeader* hd = r->hdr;
+  hd->closed.store(1, std::memory_order_release);
+  hd->req_doorbell.fetch_add(1, std::memory_order_release);
+  futex_wake_all(&hd->req_doorbell);
+  for (uint32_t w = 0; w < kMaxWriters; w++) {
+    hd->resp[w].doorbell.fetch_add(1, std::memory_order_release);
+    futex_wake_all(&hd->resp[w].doorbell);
+  }
 }
 
 // -- writer (client process) ------------------------------------------------
 
-// Claim n consecutive sequences. Returns the base sequence, -1 when the
-// ring can't hold n more records (backpressure: the writer retries), -2
-// when the server shut the segment down.
+// Claim n consecutive sequences and stamp each claimed slot's commit
+// word with this handle's (writer, generation) marker — the poller's
+// torn recovery attributes the claim through the stamp. Returns the base
+// sequence, -1 when the ring can't hold n more records (backpressure:
+// the writer retries), -2 when the server shut the segment down.
 long long me_shmring_claim(void* h, uint32_t n) {
   if (!h || n == 0) return -1;
   auto* r = static_cast<ShmRing*>(h);
@@ -254,8 +412,15 @@ long long me_shmring_claim(void* h, uint32_t n) {
     uint64_t head = hd->req_head.load(std::memory_order_acquire);
     if (t + n - head > hd->req_cap) return -1;  // full
     if (hd->req_tail.compare_exchange_weak(t, t + n,
-                                           std::memory_order_acq_rel))
+                                           std::memory_order_acq_rel)) {
+      const uint32_t mask = hd->req_cap - 1;
+      for (uint32_t i = 0; i < n; i++) {
+        uint64_t s = t + i;
+        r->req_seq[s & mask].store(claim_word(s, r->wid, r->gen),
+                                   std::memory_order_release);
+      }
       return static_cast<long long>(t);
+    }
   }
 }
 
@@ -268,13 +433,18 @@ uint8_t* me_shmring_slot(void* h, long long seq) {
 }
 
 // Publish one claimed slot (release): after this store the poller may
-// admit the record — the record bytes must be fully written first.
+// admit the record — the record bytes must be fully written first. The
+// record's writer field is stamped HERE from the committing handle (not
+// trusted from the payload), so responses demux to the lane that
+// actually owns the claim.
 void me_shmring_commit(void* h, long long seq) {
   if (!h || seq < 0) return;
   auto* r = static_cast<ShmRing*>(h);
   uint64_t s = static_cast<uint64_t>(seq);
-  r->req_seq[s & (r->hdr->req_cap - 1)].store(s + 1,
-                                              std::memory_order_release);
+  uint64_t idx = s & (r->hdr->req_cap - 1);
+  reinterpret_cast<MeOpRec*>(r->req_recs + idx * sizeof(MeOpRec))->writer =
+      static_cast<uint16_t>(r->wid);
+  r->req_seq[idx].store(s + 1, std::memory_order_release);
 }
 
 // Ring the request doorbell (after a run of commits — one wake per
@@ -308,9 +478,15 @@ long long me_shmring_push_n(void* h, const MeOpRec* recs, uint32_t n) {
 // the FIRST record, then keeps collecting for up to window_us more (the
 // GwRing batching-window semantics: one big dispatch beats many small
 // ones). A claimed slot whose commit doesn't arrive within torn_wait_us
-// is recovered: skipped, counted (shared header counter + *torn for
-// this call). Returns n (possibly 0 on timeout), or -2 when the segment
-// is shut down and drained.
+// is a recovery CANDIDATE; it is actually recovered only when the claim
+// is orphaned (registrant dead / superseded, or anonymous): skipped,
+// counted (shared header counter + *torn for this call), and — for a
+// dead registrant — swept together with its consecutive same-claim
+// neighbors, so one dead chunk claim costs one torn window. A live
+// registrant's claim is waited out indefinitely (leased on its life);
+// committed runs BEHIND the gap are therefore delayed at most one torn
+// window per dead writer, never lost. Returns n (possibly 0 on
+// timeout), or -2 when the segment is shut down and drained.
 int me_shmring_poll(void* h, MeOpRec* out, long long* seqs, uint32_t max,
                     int64_t wait_us, int64_t window_us,
                     int64_t torn_wait_us, long long* torn) {
@@ -341,12 +517,33 @@ int me_shmring_poll(void* h, MeOpRec* out, long long* seqs, uint32_t max,
         torn_deadline = -1;  // progress: any later gap restarts the clock
       } else if (got == 0 && n == 0 && torn_deadline >= 0 &&
                  now_us() >= torn_deadline) {
-        // The slot's claimant died mid-write (SIGKILL between claim and
-        // commit): recover it. Only ever at the FRONT with nothing
-        // collected — a gap behind collected records gets its own full
-        // torn window on the next call.
+        // The front slot's commit never arrived within the torn window.
+        // Attribute the claim through its stamp and recover it only if
+        // it is provably orphaned; a live registered claimant re-arms
+        // the window instead (its claim is leased on its life).
+        bool attributed = (s & kClaimBit) != 0 &&
+                          (s & kSeqMask) == ((pos + 1) & kSeqMask);
+        uint32_t wid =
+            attributed ? static_cast<uint32_t>((s >> kWidShift) & 0xf) : 0;
+        uint32_t gen =
+            attributed ? static_cast<uint32_t>((s >> kGenShift) & 0xff) : 0;
+        if (attributed && wid != 0 && !claim_orphaned(hd, wid, gen)) {
+          torn_deadline = now_us() + torn_wait_us;
+          break;  // claimant alive: keep waiting at the gap
+        }
         pos++;
         torn_now++;
+        if (attributed && wid != 0) {
+          // Dead registrant: sweep its consecutive claims in one pass —
+          // same (writer, generation) markers can never commit now.
+          while (pos < tail) {
+            uint64_t w = r->req_seq[pos & mask].load(
+                std::memory_order_acquire);
+            if (w != claim_word(pos, wid, gen)) break;
+            pos++;
+            torn_now++;
+          }
+        }
         torn_deadline = -1;
       } else {
         break;  // uncommitted claim: stop at the contiguous prefix
@@ -404,64 +601,85 @@ int me_shmring_poll(void* h, MeOpRec* out, long long* seqs, uint32_t max,
   }
 }
 
-// -- responses (server single-writer, client consumer) ----------------------
+// -- responses (server publisher, per-writer consumer lanes) ----------------
 
-// Publish n response records. The server never blocks the serving path
-// on a slow client: when the client's unread backlog leaves no room, the
-// remainder is DROPPED and counted (the client re-derives outcomes from
-// the store / re-submits; acks are a convenience channel, admission is
-// what is durable). Returns the number written.
+// Publish n response records, each routed into ITS writer's lane by the
+// record's `writer` stamp (echoed by the poller from the request
+// record, which me_shmring_commit stamped from the claiming handle).
+// The server never blocks the serving path on a slow client: when a
+// lane's unread backlog leaves no room, that record is DROPPED and
+// counted on the lane (the client re-derives outcomes from the store /
+// re-submits; acks are a convenience channel, admission is what is
+// durable). Returns the number written across all lanes.
 int me_shmring_respond_n(void* h, const MeShmResp* rs, uint32_t n) {
   if (!h || (!rs && n)) return -1;
   auto* r = static_cast<ShmRing*>(h);
   ShmHeader* hd = r->hdr;
   const uint32_t cap = hd->resp_cap;
-  uint64_t tail = hd->resp_tail.load(std::memory_order_relaxed);
-  uint64_t head = hd->resp_head.load(std::memory_order_acquire);
-  uint32_t room = static_cast<uint32_t>(cap - (tail - head));
-  uint32_t w = n < room ? n : room;
-  for (uint32_t i = 0; i < w; i++)
-    r->resp_recs[(tail + i) & (cap - 1)] = rs[i];
-  hd->resp_tail.store(tail + w, std::memory_order_release);
-  if (w < n)
-    hd->resp_dropped.fetch_add(n - w, std::memory_order_relaxed);
-  hd->resp_doorbell.fetch_add(1, std::memory_order_release);
-  futex_wake_all(&hd->resp_doorbell);
-  return static_cast<int>(w);
+  uint32_t wrote = 0;
+  uint32_t touched = 0;  // bitmask of lanes to ring after the batch
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t w = rs[i].writer;
+    if (w >= kMaxWriters) w = 0;  // stale/garbage stamp: anonymous lane
+    RespLane& lane = hd->resp[w];
+    uint64_t tail = lane.tail.load(std::memory_order_relaxed);
+    uint64_t head = lane.head.load(std::memory_order_acquire);
+    if (tail - head >= cap) {
+      lane.dropped.fetch_add(1, std::memory_order_relaxed);
+      continue;
+    }
+    r->resp_recs[static_cast<size_t>(w) * cap + (tail & (cap - 1))] = rs[i];
+    lane.tail.store(tail + 1, std::memory_order_release);
+    touched |= 1u << w;
+    wrote++;
+  }
+  for (uint32_t w = 0; w < kMaxWriters; w++) {
+    if (!(touched & (1u << w))) continue;
+    hd->resp[w].doorbell.fetch_add(1, std::memory_order_release);
+    futex_wake_all(&hd->resp[w].doorbell);
+  }
+  return static_cast<int>(wrote);
 }
 
-// Client: pop up to max responses, blocking up to wait_us for the first.
-// Returns n (0 on timeout), -2 when the server shut down AND every
-// published response was consumed.
+// Client: pop up to max responses from THIS handle's writer lane,
+// blocking up to wait_us for the first. An anonymous handle consumes
+// lane 0 (the v1 single-client behavior, unchanged); a registered
+// handle sees exactly its own acks. Returns n (0 on timeout), -2 when
+// the server shut down AND every published response on the lane was
+// consumed.
 int me_shmring_resp_poll(void* h, MeShmResp* out, uint32_t max,
                          int64_t wait_us) {
   if (!h || !out || max == 0) return -1;
   auto* r = static_cast<ShmRing*>(h);
   ShmHeader* hd = r->hdr;
   const uint32_t cap = hd->resp_cap;
+  RespLane& lane = hd->resp[r->wid];
+  const MeShmResp* recs =
+      r->resp_recs + static_cast<size_t>(r->wid) * cap;
   int64_t deadline = now_us() + (wait_us >= 0 ? wait_us : 0);
   for (;;) {
-    uint64_t head = hd->resp_head.load(std::memory_order_relaxed);
-    uint64_t tail = hd->resp_tail.load(std::memory_order_acquire);
+    uint64_t head = lane.head.load(std::memory_order_relaxed);
+    uint64_t tail = lane.tail.load(std::memory_order_acquire);
     if (tail > head) {
       uint32_t n = static_cast<uint32_t>(tail - head);
       if (n > max) n = max;
       for (uint32_t i = 0; i < n; i++)
-        out[i] = r->resp_recs[(head + i) & (cap - 1)];
-      hd->resp_head.store(head + n, std::memory_order_release);
+        out[i] = recs[(head + i) & (cap - 1)];
+      lane.head.store(head + n, std::memory_order_release);
       return static_cast<int>(n);
     }
     if (hd->closed.load(std::memory_order_acquire)) return -2;
-    uint32_t d = hd->resp_doorbell.load(std::memory_order_acquire);
-    if (hd->resp_tail.load(std::memory_order_acquire) == head) {
+    uint32_t d = lane.doorbell.load(std::memory_order_acquire);
+    if (lane.tail.load(std::memory_order_acquire) == head) {
       int64_t left = deadline - now_us();
       if (left <= 0) return 0;
-      futex_wait(&hd->resp_doorbell, d, left);
+      futex_wait(&lane.doorbell, d, left);
     }
   }
 }
 
-// Shared-header stats for the server's metrics sampler.
+// Shared-header stats for the server's metrics sampler. resp_dropped
+// aggregates every writer lane's drop counter.
 void me_shmring_stats(void* h, long long* depth, long long* torn,
                       long long* resp_dropped, long long* wakes) {
   if (!h) {
@@ -480,9 +698,12 @@ void me_shmring_stats(void* h, long long* depth, long long* torn,
   if (torn)
     *torn = static_cast<long long>(
         hd->torn_recovered.load(std::memory_order_relaxed));
-  if (resp_dropped)
-    *resp_dropped = static_cast<long long>(
-        hd->resp_dropped.load(std::memory_order_relaxed));
+  if (resp_dropped) {
+    uint64_t d = 0;
+    for (uint32_t w = 0; w < kMaxWriters; w++)
+      d += hd->resp[w].dropped.load(std::memory_order_relaxed);
+    *resp_dropped = static_cast<long long>(d);
+  }
   if (wakes)
     *wakes = static_cast<long long>(
         hd->doorbell_wakes.load(std::memory_order_relaxed));

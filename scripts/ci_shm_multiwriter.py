@@ -1,0 +1,226 @@
+"""CI smoke for the multi-producer shm ring (ring v2): two registered
+`client submit-shm` writer processes replay disjoint submit-only slices
+through a LIVE server while a third registered writer is SIGKILLed
+mid-record, and the run must show
+
+  - every record both writers pushed admitted exactly once
+    (me_ingress_records == the summed pushes; the victim's torn claim is
+    recovered, never admitted);
+  - per-writer attribution: me_ingress_writer<i>_records equals each
+    writer's own push count, on distinct non-zero lanes;
+  - at least one torn recovery (the kill really left a claim behind);
+  - each client's summary shows its own acks complete (pushed == ops and
+    no missing responses — the submit-shm exit code covers that).
+
+Writes the JSON artifact `--out` (archived by CI) and exits non-zero on
+any violation. Run locally: python scripts/ci_shm_multiwriter.py --out /tmp/x.json
+"""
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Claims one slot, writes half a record, then parks: the parent SIGKILLs
+# it mid-record, so the poller must attribute the torn claim to this
+# registered-but-dead lane and recover it.
+_VICTIM = r"""
+import sys, time
+from matching_engine_tpu import native as me
+ring = me.ShmRing(sys.argv[1])
+wid = ring.register_writer()
+seq = ring.claim(1)
+assert seq >= 0, seq
+ring.write_slot(seq, b"\x01" * 100)
+open(sys.argv[2], "w").write(f"{wid} {seq}")
+time.sleep(120)
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--ops", type=int, default=1024,
+                    help="submit records per writer")
+    args = ap.parse_args()
+
+    from matching_engine_tpu import native as me_native
+    from matching_engine_tpu.domain import oprec
+
+    if not me_native.available():
+        print("[shm-mw-smoke] FATAL: native runtime not built",
+              file=sys.stderr)
+        return 1
+
+    tmpd = tempfile.mkdtemp(prefix="ci_shm_mw_")
+    ring_path = os.path.join(tmpd, "ring")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+
+    # Submit-only maker/taker flow (books stay shallow), split in two
+    # disjoint halves — one per writer.
+    rows = []
+    for i in range(2 * args.ops):
+        maker = (i // 8) % 2 == 0
+        rows.append((oprec.OPREC_SUBMIT, 2 if maker else 1, 0, 10_000, 5,
+                     f"S{i % 8}", "m" if maker else "t", ""))
+    opfile = os.path.join(tmpd, "submits.opfile")
+    oprec.write_opfile(opfile, oprec.pack_records(rows))
+
+    log_path = os.path.join(tmpd, "server.log")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "matching_engine_tpu.server.main",
+         "--addr", "127.0.0.1:0", "--db", os.path.join(tmpd, "db.sqlite"),
+         "--symbols", "8", "--capacity", "64", "--batch", "8",
+         "--feed-depth", "0", "--shm-ingress", ring_path,
+         "--shm-torn-ms", "25"],
+        env=env, stdout=open(log_path, "w"), stderr=subprocess.STDOUT)
+    failures: list[str] = []
+    summary: dict = {"metric": "shm_multiwriter_smoke", "ops_per_writer":
+                     args.ops}
+    writers = []
+    victim = None
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline and port is None:
+            if srv.poll() is not None:
+                print(open(log_path).read()[-3000:], file=sys.stderr)
+                print("[shm-mw-smoke] FATAL: server died at boot",
+                      file=sys.stderr)
+                return 1
+            m = re.search(r"listening on port (\d+)",
+                          open(log_path).read())
+            if m:
+                port = int(m.group(1))
+            else:
+                time.sleep(0.25)
+        if port is None:
+            print("[shm-mw-smoke] FATAL: server never bound",
+                  file=sys.stderr)
+            return 1
+
+        # The kill-one: a registered writer dies holding a claim.
+        vready = os.path.join(tmpd, "victim.ready")
+        victim = subprocess.Popen([sys.executable, "-c", _VICTIM,
+                                   ring_path, vready], env=env,
+                                  cwd=REPO, stdout=subprocess.DEVNULL,
+                                  stderr=subprocess.DEVNULL)
+        deadline = time.time() + 60
+        while not os.path.exists(vready) and time.time() < deadline:
+            if victim.poll() is not None:
+                print("[shm-mw-smoke] FATAL: victim writer died before "
+                      "claiming", file=sys.stderr)
+                return 1
+            time.sleep(0.02)
+        if not os.path.exists(vready):
+            print("[shm-mw-smoke] FATAL: victim never claimed",
+                  file=sys.stderr)
+            return 1
+        victim_wid = int(open(vready).read().split()[0])
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait()  # reap: a zombie pid still probes alive
+        summary["victim_writer_id"] = victim_wid
+
+        # Two concurrent registered writers over disjoint halves,
+        # start-barrier synchronized.
+        barrier = os.path.join(tmpd, "go")
+        for i in range(2):
+            summ = os.path.join(tmpd, f"w{i}.json")
+            ready = os.path.join(tmpd, f"ready.{i}")
+            writers.append((summ, ready, subprocess.Popen(
+                [sys.executable, "-m", "matching_engine_tpu.client.cli",
+                 "submit-shm", ring_path, opfile,
+                 "--offset", str(i * args.ops), "--count", str(args.ops),
+                 "--chunk", "128", "--timeout", "120", "--quiet",
+                 "--summary-json", summ, "--ready-file", ready,
+                 "--start-barrier", barrier],
+                env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)))
+        deadline = time.time() + 120
+        while (not all(os.path.exists(r) for _s, r, _p in writers)
+               and time.time() < deadline):
+            time.sleep(0.02)
+        open(barrier, "w").write("go")
+        for _s, _r, p in writers:
+            if p.wait(timeout=300) != 0:
+                failures.append(f"writer exited {p.returncode}")
+        sums = [json.load(open(s)) for s, _r, _p in writers
+                if os.path.exists(s)]
+        summary["writers"] = sums
+
+        import grpc
+
+        from matching_engine_tpu.proto import pb2
+        from matching_engine_tpu.proto.rpc import MatchingEngineStub
+
+        stub = MatchingEngineStub(
+            grpc.insecure_channel(f"127.0.0.1:{port}"))
+        counters = dict(stub.GetMetrics(pb2.MetricsRequest(),
+                                        timeout=30).counters)
+        summary["ingress_counters"] = {
+            k: v for k, v in counters.items() if k.startswith("ingress")}
+
+        wids = [s.get("writer_id", 0) for s in sums]
+        if len(sums) != 2:
+            failures.append("a writer produced no summary")
+        if len(set(wids)) != len(wids) or any(w <= 0 for w in wids):
+            failures.append(f"writer lanes not distinct/registered: "
+                            f"{wids}")
+        for s in sums:
+            if s["pushed"] != s["ops"]:
+                failures.append(f"writer {s.get('writer_id')}: pushed "
+                                f"{s['pushed']} != ops {s['ops']}")
+            got = counters.get(
+                f"ingress_writer{s.get('writer_id')}_records", 0)
+            if got != s["ops"]:
+                failures.append(
+                    f"per-writer attribution: lane "
+                    f"{s.get('writer_id')} records {got} != pushed "
+                    f"{s['ops']}")
+        if counters.get("ingress_records", 0) != 2 * args.ops:
+            failures.append(
+                f"ingress_records {counters.get('ingress_records')} != "
+                f"{2 * args.ops} (lost/duplicated admit, or the "
+                f"victim's torn claim was admitted)")
+        if counters.get("ingress_torn_recoveries", 0) < 1:
+            failures.append("no torn recovery — the victim's claim was "
+                            "never reclaimed")
+    finally:
+        for _s, _r, p in writers:
+            if p.poll() is None:
+                p.kill()
+        if victim is not None and victim.poll() is None:
+            victim.kill()
+        srv.terminate()
+        try:
+            srv.wait(timeout=20)
+        except Exception:  # noqa: BLE001
+            srv.kill()
+
+    summary["failures"] = failures
+    summary["ok"] = not failures
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    if failures:
+        for msg in failures:
+            print(f"[shm-mw-smoke] FAIL: {msg}", file=sys.stderr)
+        return 1
+    print(f"[shm-mw-smoke] OK: 2x{args.ops} records on lanes "
+          f"{[s['writer_id'] for s in sums]}, victim lane "
+          f"{victim_wid} recovered "
+          f"({counters.get('ingress_torn_recoveries')} torn)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -663,13 +663,104 @@ print(f"{int(ok)} {s['accepted']} {s['rejected']} {s['ops']} "
 EOF
 )
 read -r IN_OK IN_ACC IN_REJ IN_TOTAL IN_ORDERS IN_SUBMITS IN_RECORDS IN_SERIES <<< "$(echo "$IN_CHECK" | tail -1)"
-kill -TERM $IN_SRV 2>/dev/null; wait $IN_SRV 2>/dev/null
-trap 'kill $SRV 2>/dev/null' EXIT
 if [ "$IN_OK" != "1" ]; then
   echo "FAIL: ingress round mismatch (accepted=$IN_ACC rejected=$IN_REJ ops=$IN_TOTAL store_orders=$IN_ORDERS accepted_submits=$IN_SUBMITS me_ingress_records=$IN_RECORDS series_ok=$IN_SERIES)"
   exit 1
 fi
-echo "ingress round: $IN_ACC/$IN_TOTAL accepted via shm ring, store rows == positional submit acks ($IN_ORDERS), me_ingress_* green"
+echo "ingress round (1 writer): $IN_ACC/$IN_TOTAL accepted via shm ring, store rows == positional submit acks ($IN_ORDERS), me_ingress_* green"
+
+# ---- 4 concurrent writers into the SAME ring (ring v2) ---------------------
+# Four `client submit-shm` processes, each a registered writer lane,
+# replay disjoint slices of the recording's SUBMIT records concurrently
+# (submits only: the server assigns OIDs globally, so a recording's
+# cancel targets do not survive concurrent interleaving — the in-order
+# phase above already exercised cancels/amends). FAIL on store rows !=
+# phase-1 + summed per-writer accepted acks (a lost or doubled commit
+# under writer concurrency), on colliding writer lanes, or on missing
+# me_ingress_writer* / me_ingress_writers series.
+MW_OPS="$WORK/ingress_submits.opfile"
+MW_N=$(python - "$FC_OPS_FILE" "$MW_OPS" <<'EOF'
+import sys
+from matching_engine_tpu.domain import oprec
+arr = oprec.read_opfile(sys.argv[1])
+sub = arr[arr["op"] == oprec.OPREC_SUBMIT]
+oprec.write_opfile(sys.argv[2], sub)
+print(len(sub))
+EOF
+)
+MW_PER=$(( MW_N / 4 ))
+MW_BARRIER="$WORK/ingress_go"
+MW_PIDS=()
+for i in 0 1 2 3; do
+  MW_OFF=$(( i * MW_PER ))
+  MW_CNT=$MW_PER
+  [ "$i" = "3" ] && MW_CNT=$(( MW_N - MW_PER * 3 ))
+  python -m matching_engine_tpu.client.cli submit-shm "$IN_RING" "$MW_OPS" \
+    --offset "$MW_OFF" --count "$MW_CNT" --chunk 128 --timeout 300 --quiet \
+    --summary-json "$WORK/ingress_w$i.json" \
+    --ready-file "$WORK/ingress_ready.$i" --start-barrier "$MW_BARRIER" \
+    >/dev/null 2>"$WORK/ingress_w$i.err" &
+  MW_PIDS+=($!)
+done
+for i in 0 1 2 3; do
+  for t in $(seq 1 120); do [ -f "$WORK/ingress_ready.$i" ] && break; sleep 0.5; done
+  [ -f "$WORK/ingress_ready.$i" ] || { echo "FAIL: concurrent shm writer $i never attached"; cat "$WORK/ingress_w$i.err" 2>/dev/null; exit 1; }
+done
+: > "$MW_BARRIER"
+MW_FAIL=0
+for p in "${MW_PIDS[@]}"; do
+  wait "$p"; rc=$?
+  # Exit 3 = replay completed with zero accepts (books at capacity under
+  # concurrent re-submission) — the store identity below still holds.
+  [ "$rc" = "0" ] || [ "$rc" = "3" ] || MW_FAIL=1
+done
+[ "$MW_FAIL" = "0" ] || { echo "FAIL: a concurrent shm writer failed"; cat "$WORK"/ingress_w*.err; exit 1; }
+IN_SCRAPE2="$WORK/ingress_scrape_mw.prom"
+python - "$IN_OBS" > "$IN_SCRAPE2" <<'EOF'
+import sys, time, urllib.request
+try:
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=5).read().decode()
+    print(f"# scrape-ingress-mw {time.time():.3f}")
+    print(body)
+except Exception as e:
+    print(f"# scrape-failed {time.time():.3f} {type(e).__name__}: {e}")
+EOF
+cat "$IN_SCRAPE2" >> "$METRICS_OUT"
+check_audit "$IN_OBS" "ingress-mw" \
+  || { echo "FAIL: audit violations in the multi-writer ingress phase"; exit 1; }
+MW_CHECK=$(python - "$WORK" "$IN_SCRAPE2" "$IN_DB" "$IN_SUBMITS" <<'EOF'
+import glob, json, re, sqlite3, sys
+work, scrape_p, db = sys.argv[1], sys.argv[2], sys.argv[3]
+base_submits = int(sys.argv[4])
+sums = [json.load(open(p))
+        for p in sorted(glob.glob(f"{work}/ingress_w[0-3].json"))]
+scrape = open(scrape_p).read()
+mw_sum = sum(s["accepted_submits"] for s in sums)
+pushed_ok = (len(sums) == 4
+             and all(s["pushed"] == s["ops"] for s in sums))
+wids = [s["writer_id"] for s in sums]
+distinct = len(set(wids)) == 4 and all(w > 0 for w in wids)
+orders = sqlite3.connect(db).execute(
+    "SELECT COUNT(*) FROM orders WHERE status != 4").fetchone()[0]
+have_w = all(
+    re.search(rf"^me_ingress_writer{w}_records_total ", scrape, re.M)
+    for w in wids)
+have_gauge = re.search(r"^me_ingress_writers ", scrape, re.M) is not None
+ok = (pushed_ok and distinct and mw_sum > 0
+      and orders == base_submits + mw_sum and have_w and have_gauge)
+print(f"{int(ok)} {mw_sum} {orders} {base_submits} {int(have_w)} "
+      f"{int(have_gauge)} {','.join(map(str, wids))}")
+EOF
+)
+read -r MW_OK MW_SUM MW_ORDERS MW_BASE MW_HAVEW MW_GAUGE MW_WIDS <<< "$(echo "$MW_CHECK" | tail -1)"
+kill -TERM $IN_SRV 2>/dev/null; wait $IN_SRV 2>/dev/null
+trap 'kill $SRV 2>/dev/null' EXIT
+if [ "$MW_OK" != "1" ]; then
+  echo "FAIL: multi-writer ingress mismatch (summed_writer_acks=$MW_SUM store_orders=$MW_ORDERS phase1_acks=$MW_BASE writer_series_ok=$MW_HAVEW writers_gauge_ok=$MW_GAUGE wids=$MW_WIDS)"
+  exit 1
+fi
+echo "ingress round (4 writers): store rows == phase-1 + summed per-writer acks ($MW_ORDERS == $MW_BASE + $MW_SUM), lanes $MW_WIDS, me_ingress_writer* green"
 
 # ---- corruption-injection round: the auditor must fire --------------------
 # Boots a server with ME_AUDIT_FAULT=fill_qty (one fill record's quantity

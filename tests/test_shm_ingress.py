@@ -12,7 +12,14 @@ mid-record, over and over, and the consumer side must observe
   - NO LOST admit: every sequence the writer logged as committed (the
     log write happens strictly AFTER the commit store) is admitted.
 
-The same fuzz body runs under ASan via ME_NATIVE_LIB (slow-marked),
+The multi-writer fuzz is the same contract under concurrency (ring v2):
+four REGISTERED writer processes publish into one ring, one is SIGKILLed
+mid-record each round, and on top of the three invariants above the
+survivors' committed records must keep flowing — recovery may reclaim
+ONLY the victim's claims (a survivor's logged commit going missing would
+mean a live claim was stolen).
+
+The same fuzz bodies run under ASan via ME_NATIVE_LIB (slow-marked),
 mirroring tests/test_build_native.py's sanitized smokes.
 """
 
@@ -140,6 +147,92 @@ def test_shm_torn_slot_recovery(tmp_path):
     assert body == pattern_record(2).tobytes()
     assert srv.stats()["torn_recovered"] == 1
     cli.close()
+    srv.close()
+
+
+def test_shm_writer_registry(tmp_path):
+    """Writer lanes: register hands out distinct non-zero ids, close
+    deregisters, and a registrant that dies without deregistering stops
+    counting (pid liveness probe) and its lane is reclaimable."""
+    me = _native()
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=64, resp_slots=64)
+    a = me.ShmRing(path)
+    b = me.ShmRing(path)
+    assert srv.writer_id == 0  # never registered: anonymous lane
+    wa, wb = a.register_writer(), b.register_writer()
+    assert wa > 0 and wb > 0 and wa != wb
+    assert a.writer_id == wa and a.register_writer() == wa  # idempotent
+    assert srv.writer_count() == 2
+    a.close()  # clean deregister
+    assert srv.writer_count() == 1
+    # A registrant that is killed without deregistering: its pid probes
+    # dead, so the gauge drops and a later register() reaps the entry.
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, os\n"
+         "from matching_engine_tpu import native as me\n"
+         "r = me.ShmRing(sys.argv[1])\n"
+         "print(r.register_writer(), flush=True)\n"
+         "os._exit(0)\n",  # no close(): dies registered
+         path],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    dead_wid = int(out.stdout.split()[0])
+    assert dead_wid > 0
+    assert srv.writer_count() == 1  # dead registrant not counted
+    c = me.ShmRing(path)
+    assert c.register_writer() > 0  # reap path leaves lanes available
+    assert srv.writer_count() == 2
+    b.close()
+    c.close()
+    srv.close()
+
+
+def test_shm_writer_demux_inproc(tmp_path):
+    """Per-writer response demux at the ring level: commit stamps each
+    record with its writer lane, and respond routes each response onto
+    that writer's private sub-ring — every client reads exactly its own
+    acks, in its own lane, nothing else's."""
+    me = _native()
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=64, resp_slots=64)
+    clis = [me.ShmRing(path) for _ in range(3)]
+    wids = [c.register_writer() for c in clis]
+    assert len(set(wids)) == 3 and all(w > 0 for w in wids)
+    sent: dict[int, list[int]] = {w: [] for w in wids}
+    one = pattern_record(0).tobytes()
+    for _ in range(4):  # interleave pushes across writers
+        for c, w in zip(clis, wids):
+            s = c.push_payload(one, 1)
+            assert s >= 0
+            sent[w].append(s)
+    body, seqs, torn = srv.poll(64, 200_000, 5_000)
+    assert torn == 0 and len(seqs) == 12
+    arr = np.frombuffer(body, dtype=oprec.OPREC_DTYPE)
+    # Commit stamped the committing handle's lane into every record.
+    stamped = dict(zip(seqs, (int(w) for w in arr["writer"])))
+    for w, ss in sent.items():
+        assert all(stamped[s] == w for s in ss)
+    resp = np.zeros(len(seqs), dtype=oprec.SHM_RESP_DTYPE)
+    resp["seq"] = seqs
+    resp["ok"] = 1
+    resp["writer"] = arr["writer"].astype(np.uint8)
+    srv.respond_payload(resp.tobytes(), len(seqs))
+    for c, w in zip(clis, wids):
+        got: list = []
+        deadline = time.time() + 10.0
+        while len(got) < 4 and time.time() < deadline:
+            got.extend(c.resp_poll(16, 100_000) or [])
+        assert sorted(g[0] for g in got) == sorted(sent[w])
+        # The lane is drained: nothing of anyone else's arrives later.
+        assert not c.resp_poll(16, 10_000)
+    for c in clis:
+        c.close()
     srv.close()
 
 
@@ -301,6 +394,175 @@ def test_shm_kill_fuzz_100(tmp_path):
     assert torn > 0
 
 
+# -- the multi-writer kill-fuzz ----------------------------------------------
+
+_MW_WRITER = r"""
+import os, random, struct, sys, time
+from matching_engine_tpu import native as me  # ctypes only, no numpy
+
+path, log_path, ready_path, stop_path, seed = (
+    sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4], int(sys.argv[5]))
+
+def pattern_bytes(seq):  # byte-identical twin of the test module's copy
+    sym = ("S%d" % (seq % 8)).encode()
+    cid = (b"w%08d" % seq) * 8
+    rec = bytearray(384)
+    struct.pack_into("<BBBBiq", rec, 0, 1, 1 + seq % 2, 0, 0,
+                     10000 + seq % 97, 1 + seq % 999)
+    struct.pack_into("<HHH", rec, 16, len(sym), len(cid), 0)
+    rec[24:24 + len(sym)] = sym
+    rec[88:88 + len(cid)] = cid
+    return bytes(rec)
+
+rng = random.Random(seed)
+ring = me.ShmRing(path)
+wid = ring.register_writer()
+log = open(log_path, "a", buffering=1)
+open(ready_path, "w").write(str(wid))
+# The stop file is the GRACEFUL exit: survivors must never die
+# mid-record, so only the fuzz's SIGKILL leaves torn claims — that is
+# what lets the checker attribute every recovery to the victim.
+while not os.path.exists(stop_path):
+    seq = ring.claim(1)
+    if seq == -2:
+        break
+    if seq < 0:
+        time.sleep(0.0002)
+        continue
+    rec = pattern_bytes(seq)
+    ring.write_slot(seq, rec[:192])
+    if rng.random() < 0.25:
+        time.sleep(rng.random() * 0.002)
+    ring.write_slot(seq, rec)
+    if rng.random() < 0.25:
+        time.sleep(rng.random() * 0.002)
+    ring.commit(seq)
+    # Logged strictly AFTER the commit store: understates, never
+    # overstates.
+    log.write("%d\n" % seq)
+    ring.wake()
+ring.close()
+"""
+
+
+def run_mw_kill_fuzz(tmp_path: Path, rounds: int,
+                     writers: int = 4, torn_wait_us: int = 20_000):
+    """Four registered writers publish into one ring; each round one is
+    SIGKILLed mid-record while the other three keep going and then exit
+    gracefully. Returns (admitted seq->bytes, logged seqs, torn)."""
+    from matching_engine_tpu import native as me
+
+    path = str(tmp_path / "ring")
+    srv = me.ShmRing(path, create=True, slots=256, resp_slots=256)
+    admitted: dict[int, bytes] = {}
+    logged: list[int] = []
+    torn_total = 0
+
+    def drain(wait_us=1_000):
+        nonlocal torn_total
+        body, seqs, torn = srv.poll(256, wait_us, torn_wait_us)
+        torn_total += torn
+        if body:
+            for j, s in enumerate(seqs):
+                assert s not in admitted, f"DUPLICATED admit of seq {s}"
+                admitted[s] = body[j * 384:(j + 1) * 384]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    for r in range(rounds):
+        stop = tmp_path / f"stop.{r}"
+        procs = []
+        logs = []
+        for i in range(writers):
+            ready = tmp_path / f"ready.{r}.{i}"
+            log_path = tmp_path / f"committed.{r}.{i}.log"
+            logs.append(log_path)
+            procs.append((subprocess.Popen(
+                [sys.executable, "-c", _MW_WRITER, path, str(log_path),
+                 str(ready), str(stop), str(r * writers + i)], env=env,
+                cwd=str(REPO), stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL), ready))
+        t0 = time.perf_counter()
+        while (not all(rd.exists() for _, rd in procs)
+               and time.perf_counter() - t0 < 20.0):
+            drain()
+        # Let all four publish concurrently for a while, then kill one
+        # mid-flight (the in-window dawdles make that likely).
+        deadline = time.perf_counter() + 0.02 + (r % 5) * 0.005
+        while time.perf_counter() < deadline:
+            drain()
+        victim = procs[r % writers][0]
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait()  # REAP: a zombie pid still probes alive
+        # Survivors: a little more concurrent traffic over the victim's
+        # torn claims, then a graceful stop.
+        deadline = time.perf_counter() + 0.02
+        while time.perf_counter() < deadline:
+            drain()
+        stop.write_text("stop")
+        for i, (p, _rd) in enumerate(procs):
+            if i != r % writers:
+                p.wait(timeout=30)
+        # Post-round: recover the victim's claims and drain the tail.
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 3.0:
+            before = (len(admitted), torn_total)
+            drain(wait_us=30_000)
+            if (srv.stats()["depth"] == 0
+                    and (len(admitted), torn_total) == before):
+                break
+        for lp in logs:
+            if lp.exists():
+                logged.extend(int(x) for x in lp.read_text().split())
+    srv.shutdown()
+    srv.close()
+    return admitted, logged, torn_total
+
+
+def check_mw_kill_fuzz(admitted, logged, torn):
+    import struct
+
+    # No lost admit from ANY writer — survivor or victim: a logged
+    # commit that vanished would mean recovery reclaimed a live (or
+    # already-committed) claim, not just the victim's torn ones.
+    missing = [s for s in logged if s not in admitted]
+    assert not missing, f"LOST admitted records: {missing[:10]}"
+    assert len(set(logged)) == len(logged)  # seqs claimed exactly once
+    # Bit-exact modulo the writer stamp: commit writes the committing
+    # lane id into the record's `writer` u16 at offset 22.
+    for s, rec in admitted.items():
+        w = rec[22] | (rec[23] << 8)
+        assert 0 < w < 16, f"unstamped writer {w} at seq {s}"
+        exp = bytearray(pattern_bytes(s))
+        struct.pack_into("<H", exp, 22, w)
+        assert rec == bytes(exp), f"TORN record at seq {s}"
+    assert len(admitted) >= len(logged)
+
+
+def test_shm_mw_kill_fuzz_quick(tmp_path):
+    """4 concurrent registered writers, 5 rounds of kill-one (the tier-1
+    version; the 100x contract run is the slow-marked test below)."""
+    _native()
+    admitted, logged, torn = run_mw_kill_fuzz(tmp_path, rounds=5)
+    check_mw_kill_fuzz(admitted, logged, torn)
+    assert len(admitted) > 0
+
+
+@pytest.mark.slow
+def test_shm_mw_kill_fuzz_100(tmp_path):
+    """The acceptance-criteria run: 100 rounds of one SIGKILL among four
+    live writers; zero lost/duplicated records from survivors and
+    recovery only of the victim's claims."""
+    _native()
+    admitted, logged, torn = run_mw_kill_fuzz(tmp_path, rounds=100)
+    check_mw_kill_fuzz(admitted, logged, torn)
+    assert len(admitted) > 0
+    # Across 100 kills with in-window dawdles, some landed between claim
+    # and commit — the attributed (writer, gen) recovery path really ran.
+    assert torn > 0
+
+
 def _san_runtime(name: str) -> str | None:
     try:
         out = subprocess.run(["g++", f"-print-file-name={name}"],
@@ -330,13 +592,14 @@ def test_shm_kill_fuzz_asan(tmp_path):
                PYTHONPATH=str(REPO) + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
     run = subprocess.run(
-        [sys.executable, str(Path(__file__).resolve()), "20"],
+        [sys.executable, str(Path(__file__).resolve()), "20", "5"],
         capture_output=True, text=True, timeout=600, env=env,
         cwd=str(REPO))
     assert run.returncode == 0, (
         f"asan kill-fuzz failed:\n{run.stdout[-1000:]}\n"
         f"{run.stderr[-3000:]}")
     assert "kill-fuzz OK" in run.stdout
+    assert "mw kill-fuzz OK" in run.stdout
 
 
 # -- server e2e --------------------------------------------------------------
@@ -412,6 +675,51 @@ def test_shm_e2e_lifecycle_and_store(tmp_path):
     assert not os.path.exists(tmp_path / "ingress.ring")
 
 
+def test_shm_e2e_writer_demux(tmp_path):
+    """The acceptance pin for per-writer demux through a REAL server:
+    three registered clients push interleaved submits into one segment
+    and each client's response lane carries exactly its own positional
+    acks; the poller's per-writer series and the writers gauge agree."""
+    me = _native()
+    from matching_engine_tpu.server.main import shutdown
+
+    server, _port, parts = _boot(tmp_path)
+    clis = []
+    try:
+        seg = str(tmp_path / "ingress.ring")
+        clis = [me.ShmRing(seg) for _ in range(3)]
+        wids = [c.register_writer() for c in clis]
+        assert len(set(wids)) == 3 and all(w > 0 for w in wids)
+        sent: dict[int, list[int]] = {}
+        for k, (c, w) in enumerate(zip(clis, wids)):
+            rows = [(1, 1 + i % 2, 0, 10000 + 100 * i, 1 + i,
+                     f"S{k}".encode(), b"cli-%d" % w, b"")
+                    for i in range(5)]
+            base = c.push_payload(oprec.pack_records(rows).tobytes(), 5)
+            assert base >= 0
+            sent[w] = list(range(base, base + 5))
+        for c, w in zip(clis, wids):
+            got: list = []
+            deadline = time.time() + 15.0
+            while len(got) < 5 and time.time() < deadline:
+                got.extend(c.resp_poll(64, 200_000) or [])
+            assert sorted(g[0] for g in got) == sent[w], (w, got)
+            assert all(g[1] for g in got)  # every submit accepted
+            assert not c.resp_poll(64, 10_000)  # nothing extra arrives
+        # Per-writer observability: one series per publishing lane plus
+        # the live-writers gauge (clients still attached here).
+        counters, gauges = parts["metrics"].snapshot()
+        assert counters["ingress_records"] == 15
+        for w in wids:
+            assert counters[f"ingress_writer{w}_records"] == 5
+        assert gauges["ingress_writers"] == 3
+        assert parts["storage"].count("orders") == 15
+    finally:
+        for c in clis:
+            c.close()
+        shutdown(server, parts)
+
+
 @pytest.mark.parametrize("mode", ["shards", "native"])
 def test_shm_e2e_routed_paths(tmp_path, mode):
     """The poller rides the same lane routing as the batch RPCs: K=2
@@ -438,13 +746,21 @@ def test_shm_e2e_routed_paths(tmp_path, mode):
 
 
 if __name__ == "__main__":
-    # ASan driver: run the kill-fuzz body directly (the sanitized .so is
-    # selected by ME_NATIVE_LIB in the environment).
+    # ASan driver: run the kill-fuzz bodies directly (the sanitized .so
+    # is selected by ME_NATIVE_LIB in the environment).
     import tempfile
 
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    mw_rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 0
     with tempfile.TemporaryDirectory() as td:
         admitted, logged, torn = run_kill_fuzz(Path(td), rounds=rounds)
         check_kill_fuzz(admitted, logged, torn)
     print(f"kill-fuzz OK ({rounds} kills, {len(admitted)} admitted, "
           f"{torn} torn recoveries)")
+    if mw_rounds:
+        with tempfile.TemporaryDirectory() as td:
+            admitted, logged, torn = run_mw_kill_fuzz(
+                Path(td), rounds=mw_rounds)
+            check_mw_kill_fuzz(admitted, logged, torn)
+        print(f"mw kill-fuzz OK ({mw_rounds} rounds, {len(admitted)} "
+              f"admitted, {torn} torn recoveries)")
